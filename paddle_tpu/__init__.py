@@ -1,0 +1,59 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, re-architected for JAX/XLA/Pallas/pjit.
+
+Reference: baiyfbupt/Paddle (see SURVEY.md). This is not a port -- the compute
+path lowers through XLA:TPU, distributed execution uses jax.sharding Meshes
+with ICI collectives, and the imperative/static dual API compiles whole steps
+into single XLA computations.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: F401
+    Tensor, to_tensor, set_device, get_device, device_count,
+    CPUPlace, TPUPlace, CUDAPlace, XPUPlace, CUDAPinnedPlace,
+    set_default_dtype, get_default_dtype, seed, get_rng_state, set_rng_state,
+    set_flags, get_flags, enable_static, disable_static, in_dygraph_mode,
+    grad, is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_tpu,
+    bfloat16, float16, float32, float64, int8, int16, int32, int64, uint8,
+    complex64,
+)
+from .framework import bool_ as bool  # noqa: F401  (paddle.bool)
+from .framework.core import no_grad_guard as no_grad, set_grad_enabled  # noqa: F401
+from .ops import *  # noqa: F401,F403  (tensor API surface: paddle.add, ...)
+from .ops import creation as _creation  # noqa: F401
+
+from .ops.creation import rand, randn, randint, randperm, uniform, normal  # noqa: F401
+
+# subpackages -- soft-imported during bring-up; all are required by release
+import importlib as _importlib
+
+_SUBPACKAGES = ["nn", "optimizer", "static", "io", "metric", "amp", "jit",
+                "distributed", "vision", "text", "autograd", "hapi",
+                "incubate", "inference", "profiler", "device"]
+for _name in _SUBPACKAGES:
+    try:
+        globals()[_name] = _importlib.import_module(f".{_name}", __name__)
+    except ImportError as _e:  # pragma: no cover - only during partial builds
+        import os as _os
+        if _os.environ.get("PADDLE_TPU_STRICT_IMPORT"):
+            raise
+        globals()[_name] = None
+
+try:
+    from .framework.io_state import save, load  # noqa: F401
+    from .hapi import Model  # noqa: F401
+    from .nn.layer.layers import ParamAttr  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+
+
+def DataParallel(layer, *args, **kwargs):
+    from .distributed.parallel import DataParallel as _DP
+    return _DP(layer, *args, **kwargs)
+
+
+def summary(net, input_size=None, dtypes=None):
+    from .hapi.summary import summary as _summary
+    return _summary(net, input_size, dtypes)

@@ -1,0 +1,140 @@
+"""Primitive op machinery: registry, jitted dispatch, cached VJPs.
+
+Reference parity: this is the TPU replacement for the whole
+OperatorWithKernel::RunImpl pipeline (paddle/fluid/framework/operator.cc:1093)
+plus the op registry (op_registry.h:256) and the dygraph PreparedOp cache
+(imperative/prepared_operator.cc). Where Paddle dispatches a hand-written
+CUDA/Eigen kernel per OpKernelType, here every primitive is a pure jax function
+lowered by XLA:TPU; "kernel choice" collapses to one jit cache keyed by
+(op, static attrs) with shape/dtype specialization handled by jax.jit itself.
+
+Backward: instead of registering a grad op per forward op (GradOpMaker), each
+primitive's VJP is derived by jax.vjp and jitted once per (op, attrs, shapes).
+Ops that need custom gradients (e.g. Pallas kernels) use jax.custom_vjp inside
+their ``fn`` -- the tape machinery is agnostic.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from . import core
+from .flags import flag
+from .autograd import GradNode
+from .tensor import Tensor
+
+_PRIMS: Dict[str, "Primitive"] = {}
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    import numpy as np
+    if isinstance(v, np.dtype):
+        return str(v)
+    return v
+
+
+def _attrs_key(attrs):
+    return tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+
+
+class Primitive:
+    """A registered op: pure jax fn (*arrays, **static_attrs) -> array|tuple."""
+
+    def __init__(self, name: str, fn: Callable, multi_output: bool = False,
+                 differentiable: bool = True):
+        self.name = name
+        self.fn = fn
+        self.multi_output = multi_output
+        self.differentiable = differentiable
+        self._fwd_cache: Dict = {}
+        self._bwd_cache: Dict = {}
+        _PRIMS[name] = self
+
+    # -- compiled callables --------------------------------------------------
+    def _fwd(self, key, attrs):
+        f = self._fwd_cache.get(key)
+        if f is None:
+            base = functools.partial(self.fn, **attrs) if attrs else self.fn
+            f = jax.jit(base)
+            self._fwd_cache[key] = f
+        return f
+
+    def _bwd(self, key, attrs):
+        f = self._bwd_cache.get(key)
+        if f is None:
+            base = functools.partial(self.fn, **attrs) if attrs else self.fn
+            multi = self.multi_output
+
+            def backward(cts, *primals):
+                _, vjp = jax.vjp(base, *primals)
+                return vjp(cts if multi else cts[0])
+
+            f = jax.jit(backward)
+            self._bwd_cache[key] = f
+        return f
+
+    # -- eager application ---------------------------------------------------
+    def __call__(self, *args, **attrs):
+        arrs = tuple(a._value if isinstance(a, Tensor) else a for a in args)
+        key = _attrs_key(attrs)
+        out = self._fwd(key, attrs)(*arrs)
+
+        if flag("benchmark"):
+            jax.block_until_ready(out)
+        if flag("check_nan_inf"):
+            _check_finite(self.name, out)
+
+        needs_grad = self.differentiable and core.grad_enabled() and any(
+            isinstance(a, Tensor) and not a.stop_gradient for a in args)
+
+        outs = out if self.multi_output else (out,)
+        tensors = tuple(Tensor(o, stop_gradient=not needs_grad) for o in outs)
+
+        if needs_grad:
+            node = GradNode(
+                self.name, self._bwd(key, attrs), arrs,
+                tuple(a if isinstance(a, Tensor) else None for a in args),
+                [(o.shape, o.dtype) for o in outs])
+            for i, t in enumerate(tensors):
+                t._node = node
+                t._out_index = i
+                t.is_leaf = False
+        return tensors if self.multi_output else tensors[0]
+
+    # raw (no tape, no wrap): used by static executor / jit tracer
+    def raw(self, *arrs, **attrs):
+        return self._fwd(_attrs_key(attrs), attrs)(*arrs)
+
+
+def _check_finite(name, out):
+    """FLAGS_check_nan_inf parity (details/nan_inf_utils_detail.cc:301)."""
+    leaves = jax.tree_util.tree_leaves(out)
+    for leaf in leaves:
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            if not bool(jnp.all(jnp.isfinite(leaf))):
+                raise FloatingPointError(
+                    f"Operator {name} output contains NaN/Inf "
+                    f"(FLAGS_check_nan_inf)")
+
+
+def primitive(name: str, multi_output: bool = False, differentiable: bool = True):
+    """Decorator: register a pure jax function as a framework primitive."""
+    def deco(fn):
+        return Primitive(name, fn, multi_output=multi_output,
+                         differentiable=differentiable)
+    return deco
+
+
+def get_primitive(name: str) -> Primitive:
+    return _PRIMS[name]
+
+
+def all_primitives():
+    return dict(_PRIMS)

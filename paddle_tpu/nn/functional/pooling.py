@@ -1,0 +1,168 @@
+"""Pooling functionals.
+
+Reference parity: paddle/fluid/operators/pool_op.cc and
+python/paddle/nn/functional/pooling.py. Lowered to lax.reduce_window (XLA
+pooling primitive). Paddle's ``exclusive=True`` average (divide by the number
+of valid elements, not window size) is implemented by reduce-window-summing a
+ones mask.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.primitive import Primitive
+from ...framework.tensor import Tensor, unwrap
+from .conv import _norm_tuple, _norm_padding
+
+
+def _window(nsp, channel_last, kernel, stride):
+    if channel_last:
+        return (1,) + kernel + (1,), (1,) + stride + (1,)
+    return (1, 1) + kernel, (1, 1) + stride
+
+
+def _pad_spec(pad, nsp, channel_last):
+    if isinstance(pad, str):
+        return pad
+    if channel_last:
+        return ((0, 0),) + tuple(pad) + ((0, 0),)
+    return ((0, 0), (0, 0)) + tuple(pad)
+
+
+def _max_pool_fn(x, kernel=(2, 2), stride=(2, 2), padding="VALID",
+                 channel_last=False, nsp=2):
+    win, strd = _window(nsp, channel_last, kernel, stride)
+    pad = _pad_spec(padding, nsp, channel_last)
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return jax.lax.reduce_window(x, init, jax.lax.max, win, strd, pad)
+
+
+def _avg_pool_fn(x, kernel=(2, 2), stride=(2, 2), padding="VALID",
+                 channel_last=False, nsp=2, exclusive=True):
+    win, strd = _window(nsp, channel_last, kernel, stride)
+    pad = _pad_spec(padding, nsp, channel_last)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, win, strd, pad)
+    if exclusive and pad != "VALID":
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, win, strd, pad)
+        return summed / counts
+    return summed / float(np.prod(kernel))
+
+
+_max_pool_p = Primitive("max_pool", _max_pool_fn)
+_avg_pool_p = Primitive("avg_pool", _avg_pool_fn)
+
+
+def _pool(kind, x, kernel_size, stride, padding, nsp, data_format, exclusive=True,
+          ceil_mode=False):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    kernel = _norm_tuple(kernel_size, nsp)
+    stride = _norm_tuple(stride if stride is not None else kernel_size, nsp)
+    pad = _norm_padding(padding, nsp)
+    if kind == "max":
+        return _max_pool_p(x, kernel=kernel, stride=stride, padding=pad,
+                           channel_last=channel_last, nsp=nsp)
+    return _avg_pool_p(x, kernel=kernel, stride=stride, padding=pad,
+                       channel_last=channel_last, nsp=nsp, exclusive=exclusive)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool("max", x, kernel_size, stride, padding, 1, df)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool("max", x, kernel_size, stride, padding, 2, data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool("max", x, kernel_size, stride, padding, 3, data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool("avg", x, kernel_size, stride, padding, 1, df, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool("avg", x, kernel_size, stride, padding, 2, data_format,
+                 exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool("avg", x, kernel_size, stride, padding, 3, data_format,
+                 exclusive)
+
+
+def _adaptive_pool_fn(x, out_size=(1, 1), kind="avg", channel_last=False,
+                      nsp=2):
+    spatial_axes = tuple(range(1, 1 + nsp)) if channel_last \
+        else tuple(range(2, 2 + nsp))
+    # adaptive pooling with uniform bins when divisible; general case uses
+    # mean over index buckets
+    for ax, osz in zip(spatial_axes, out_size):
+        isz = x.shape[ax]
+        if isz % osz == 0:
+            k = isz // osz
+            shape = list(x.shape)
+            shape[ax] = osz
+            shape.insert(ax + 1, k)
+            x = jnp.reshape(x, shape)
+            x = jnp.max(x, axis=ax + 1) if kind == "max" else jnp.mean(x, axis=ax + 1)
+        else:
+            # bucketed gather: start/end per output position (static python loop)
+            segs = []
+            for o in range(osz):
+                s = (o * isz) // osz
+                e = -(-((o + 1) * isz) // osz)
+                sl = [slice(None)] * x.ndim
+                sl[ax] = slice(s, e)
+                seg = x[tuple(sl)]
+                seg = jnp.max(seg, axis=ax, keepdims=True) if kind == "max" \
+                    else jnp.mean(seg, axis=ax, keepdims=True)
+                segs.append(seg)
+            x = jnp.concatenate(segs, axis=ax)
+    return x
+
+
+_adaptive_p = Primitive("adaptive_pool", _adaptive_pool_fn)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_p(x, out_size=_norm_tuple(output_size, 1), kind="avg",
+                       channel_last=False, nsp=1)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_p(x, out_size=_norm_tuple(output_size, 2), kind="avg",
+                       channel_last=data_format == "NHWC", nsp=2)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_p(x, out_size=_norm_tuple(output_size, 3), kind="avg",
+                       channel_last=data_format == "NDHWC", nsp=3)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_p(x, out_size=_norm_tuple(output_size, 1), kind="max",
+                       channel_last=False, nsp=1)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_p(x, out_size=_norm_tuple(output_size, 2), kind="max",
+                       channel_last=False, nsp=2)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_p(x, out_size=_norm_tuple(output_size, 3), kind="max",
+                       channel_last=False, nsp=3)

@@ -1,0 +1,18 @@
+"""Op library: the TPU-native operator surface.
+
+Reference parity: the union of paddle/fluid/operators registrations surfaced
+through python/paddle/tensor/*. Importing this package patches Tensor methods
+(math_op_patch.py parity).
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import (  # noqa: F401
+    norm, cholesky, inverse, det, slogdet, matrix_power, svd, eig, eigh,
+    eigvals, eigvalsh, qr, lstsq, solve, triangular_solve, matrix_rank, pinv,
+    cond, multi_dot, cross, bincount,
+)
+from . import creation, math, manipulation, linalg  # noqa: F401
+from .patch import apply_patches as _apply_patches
+
+_apply_patches()

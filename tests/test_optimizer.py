@@ -1,0 +1,129 @@
+"""Optimizer tests (operators/optimizers/ parity, SURVEY.md §2.3)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def make_problem(seed=0):
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 1))
+    rs = np.random.RandomState(seed)
+    X = rs.randn(128, 2).astype(np.float32)
+    Y = (X[:, :1] * 0.5 - X[:, 1:] * 0.3).astype(np.float32)
+    return m, paddle.to_tensor(X), paddle.to_tensor(Y)
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (opt.SGD, dict(learning_rate=0.1)),
+    (opt.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (opt.Momentum, dict(learning_rate=0.05, momentum=0.9, use_nesterov=True)),
+    (opt.Adam, dict(learning_rate=0.02)),
+    (opt.AdamW, dict(learning_rate=0.02, weight_decay=0.01)),
+    (opt.Lamb, dict(learning_rate=0.05)),
+    (opt.RMSProp, dict(learning_rate=0.005)),
+    (opt.Adagrad, dict(learning_rate=0.1)),
+    (opt.Adadelta, dict(learning_rate=1.0)),
+    (opt.Adamax, dict(learning_rate=0.02)),
+    (opt.LarsMomentum, dict(learning_rate=5.0)),  # lars_coeff=1e-3 scales lr down
+])
+def test_optimizer_converges(cls, kw):
+    m, x, y = make_problem()
+    o = cls(parameters=m.parameters(), **kw)
+    loss_fn = nn.MSELoss()
+    first = None
+    for _ in range(40):
+        loss = loss_fn(m(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        first = first if first is not None else loss.item()
+    assert loss.item() < first * 0.8, f"{cls.__name__} failed to converge"
+
+
+def test_sgd_matches_manual():
+    p = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    from paddle_tpu.framework.tensor import Parameter
+    param = Parameter(np.ones(3, np.float32))
+    o = opt.SGD(learning_rate=0.5, parameters=[param])
+    loss = (param * param).sum()
+    loss.backward()
+    o.step()
+    np.testing.assert_allclose(param.numpy(), 1 - 0.5 * 2, rtol=1e-6)
+
+
+def test_adam_matches_torch():
+    torch = pytest.importorskip("torch")
+    w0 = np.random.RandomState(4).randn(4, 3).astype(np.float32)
+    from paddle_tpu.framework.tensor import Parameter
+    p = Parameter(w0.copy())
+    o = opt.Adam(learning_rate=0.1, parameters=[p])
+    tp = torch.nn.Parameter(torch.tensor(w0.copy()))
+    to = torch.optim.Adam([tp], lr=0.1)
+    for _ in range(5):
+        (p * p).sum().backward()
+        o.step()
+        o.clear_grad()
+        to.zero_grad()
+        (tp * tp).sum().backward()
+        to.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_weight_decay_l2():
+    from paddle_tpu.framework.tensor import Parameter
+    p = Parameter(np.ones(2, np.float32))
+    o = opt.SGD(learning_rate=0.1, parameters=[p],
+                weight_decay=opt.L2Decay(0.5))
+    (p.sum()).backward()
+    o.step()
+    # grad = 1 + 0.5*1 = 1.5 -> p = 1 - 0.15
+    np.testing.assert_allclose(p.numpy(), 0.85 * np.ones(2), rtol=1e-5)
+
+
+def test_state_dict_roundtrip():
+    m, x, y = make_problem()
+    o = opt.Adam(learning_rate=0.01, parameters=m.parameters())
+    loss = nn.MSELoss()(m(x), y)
+    loss.backward()
+    o.step()
+    sd = o.state_dict()
+    o2 = opt.Adam(learning_rate=0.01, parameters=m.parameters())
+    # warm up accumulators then load
+    loss = nn.MSELoss()(m(x), y)
+    loss.backward()
+    o2.step()
+    o2.set_state_dict(sd)
+    assert o2._step_count == 1
+    k = list(o._accumulators["moment1"])[0]
+    np.testing.assert_allclose(o2._accumulators["moment1"][k],
+                               o._accumulators["moment1"][k])
+
+
+def test_grad_clip_global_norm():
+    from paddle_tpu.framework.tensor import Parameter
+    p = Parameter(np.ones(4, np.float32))
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    o = opt.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+    (10 * p).sum().backward()  # grad = 10*ones, norm=20
+    o.step()
+    # clipped grad = 10/20 = 0.5 each
+    np.testing.assert_allclose(p.numpy(), 1 - 0.5, rtol=1e-5)
+
+
+def test_lr_schedulers():
+    s = opt.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(round(s(), 5))
+        s.step()
+    assert lrs == [0.1, 0.1, 0.05, 0.05, 0.025]
+    noam = opt.lr.NoamDecay(d_model=64, warmup_steps=10, learning_rate=1.0)
+    vals = []
+    for _ in range(20):
+        noam.step()
+        vals.append(noam())
+    assert max(vals[:11]) == vals[9]  # peak at warmup boundary
