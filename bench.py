@@ -1,0 +1,70 @@
+"""Benchmark: BERT-base MLM pretraining throughput (seq/s) on one chip.
+
+Headline workload = BASELINE.json config 3 (BERT-base pretraining). The
+reference repo publishes no numbers (BASELINE.md); the denominator for
+``vs_baseline`` is the north-star parity target from BASELINE.json — match
+paddlepaddle-gpu BERT-base throughput, nominally 200 seq/s/chip (V100-class,
+seq128) — so the ratio is comparable across rounds.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_SEQ_PER_S = 200.0  # parity target (see module docstring)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.parallel import init_mesh, TrainStep
+    from paddle_tpu.text.models.bert import BertConfig, BertForPretraining
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        cfg, batch, seq, iters = BertConfig.base(), 32, 128, 20
+    else:  # CPU smoke fallback so the script always emits a result
+        cfg, batch, seq, iters = BertConfig.tiny(seq=128), 8, 32, 3
+
+    mesh = init_mesh({"dp": -1})
+    model = BertForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-4, weight_decay=0.01)
+    step = TrainStep(model, opt, mesh=mesh,
+                     compute_dtype=jnp.bfloat16 if on_tpu else None)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq))
+    labels = np.where(rng.rand(batch, seq) < 0.15, ids, -100)
+    batch_args = (ids, None, None, labels)
+
+    # warmup/compile; host-fetch of the loss is the completion fence (the
+    # axon tunnel dispatches asynchronously and block_until_ready does not
+    # wait on remote buffers — a D2H read does)
+    loss = step(batch_args)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(batch_args)
+    float(loss)  # final loss depends on every prior donated state
+    dt = time.perf_counter() - t0
+
+    seq_per_s = batch * iters / dt
+    result = {
+        "metric": "bert_base_pretrain_seq_per_s" if on_tpu
+                  else "bert_tiny_cpu_smoke_seq_per_s",
+        "value": round(seq_per_s, 2),
+        "unit": "seq/s/chip",
+        "vs_baseline": round(seq_per_s / BASELINE_SEQ_PER_S, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Launch CLI: ``python -m paddle_tpu.distributed.fleet.launch train.py``.
+
+Reference parity: python/paddle/distributed/fleet/launch.py:321 —
+launch_collective (:198) spawns one process per GPU with PADDLE_TRAINER_ID /
+endpoints env and watches children (launch_utils.py:451,517).
+
+TPU-native: the process unit is a *host*, not a chip (PJRT owns all local
+chips).  On a single host this launcher therefore spawns ONE training
+process by default; --nproc_per_node>1 exists for CPU-simulated cluster
+tests, mirroring how the reference's own test suite fakes topology
+(SURVEY.md §4.3).  Fail-fast watching matches launch_utils.py:517: any child
+death tears the job down.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_ports(n):
+    ports, socks = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.fleet.launch")
+    p.add_argument("--ips", default="127.0.0.1",
+                   help="comma-separated host ips")
+    p.add_argument("--host_rank", type=int,
+                   default=int(os.getenv("PADDLE_HOST_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 on TPU: PJRT owns all chips)")
+    p.add_argument("--started_port", type=int, default=None)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def get_cluster(ips, nproc_per_node, started_port=None):
+    """launch.py:257 parity: (endpoints, world_size)."""
+    hosts = ips.split(",")
+    nranks = len(hosts) * nproc_per_node
+    ports = ([started_port + i for i in range(nproc_per_node)]
+             if started_port else _free_ports(nproc_per_node))
+    endpoints = [f"{h}:{p}" for h in hosts for p in ports]
+    return endpoints, nranks
+
+
+def launch_collective(args):
+    endpoints, nranks = get_cluster(args.ips, args.nproc_per_node,
+                                    args.started_port)
+    procs = []
+    log_fps = []
+    base_rank = args.host_rank * args.nproc_per_node
+    for local in range(args.nproc_per_node):
+        rank = base_rank + local
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nranks),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "FLAGS_selected_tpus": str(local),
+        })
+        cmd = [sys.executable, "-u", args.training_script] + \
+            args.training_script_args
+        out = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            out = open(os.path.join(args.log_dir, f"workerlog.{local}"), "w")
+            log_fps.append(out)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=out, stderr=out))
+
+    # watch_local_trainers (launch_utils.py:517) parity: fail-fast
+    rc = 0
+    try:
+        while procs:
+            for p in list(procs):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                procs.remove(p)
+                if ret != 0:
+                    rc = ret
+                    for q in procs:
+                        q.send_signal(signal.SIGTERM)
+                    procs = []
+                    break
+            time.sleep(0.5)
+    finally:
+        for f in log_fps:
+            f.close()
+    return rc
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    sys.exit(launch_collective(args))
+
+
+if __name__ == "__main__":
+    launch()

@@ -77,6 +77,39 @@ class ClipGradByGlobalNorm(ClipGradBase):
         return out
 
 
+def functional_clip(clip, params, grads, skip=None):
+    """Apply a ClipGrad* policy to a {name: array} grads dict inside a trace
+    (used by Optimizer.functional_apply in the compiled train step).
+
+    ``skip``: names with need_clip=False — left untouched and excluded from
+    the global norm, matching the eager _dygraph_clip paths.
+    """
+    skip = skip or set()
+    if isinstance(clip, ClipGradByValue):
+        return {k: (g if k in skip else jnp.clip(g, clip.min, clip.max))
+                for k, g in grads.items()}
+    if isinstance(clip, ClipGradByNorm):
+        out = {}
+        for k, g in grads.items():
+            if k in skip:
+                out[k] = g
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(clip.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out[k] = (g * scale).astype(g.dtype)
+        return out
+    if isinstance(clip, ClipGradByGlobalNorm):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for k, g in grads.items() if k not in skip]
+        if not sq:
+            return grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = jnp.minimum(clip.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        return {k: (g if k in skip else (g * scale).astype(g.dtype))
+                for k, g in grads.items()}
+    raise TypeError(f"unsupported grad clip {type(clip).__name__}")
+
+
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
                     error_if_nonfinite=False):
     params = [p for p in parameters if p.grad is not None]

@@ -323,12 +323,66 @@ class Optimizer:
 
     set_dict = set_state_dict
 
+    # -- functional interface (compiled/pjit train step) ---------------------
+    # The TPU-idiomatic path (parallel/train_step.py) folds the optimizer
+    # update into the jitted step function, the analogue of Paddle running
+    # sgd/adam as graph ops (paddle/fluid/operators/optimizers/) inside the
+    # same Program as forward/backward.
+
+    def functional_state(self, params):
+        """Accumulator pytree for a {name: array} params dict: reuses any
+        existing eager accumulator values (so eager → compiled switching
+        keeps Adam moments etc.), zero-init otherwise."""
+        out = {}
+        for n in self._state_names:
+            acc = self._accumulators.get(n, {})
+            out[n] = {k: (jnp.asarray(acc[k], jnp.float32) if k in acc
+                          else jnp.zeros(v.shape, jnp.float32))
+                      for k, v in params.items()}
+        return out
+
+    def _no_clip_names(self):
+        return {p.name for p in (self._parameters or [])
+                if not getattr(p, "need_clip", True)}
+
+    def _functional_grads(self, params, grads):
+        """Coupled L2 + grad clip, applied inside the trace."""
+        if self._grad_clip is not None:
+            from ..nn.clip import functional_clip
+            grads = functional_clip(self._grad_clip, params, grads,
+                                    skip=self._no_clip_names())
+        if self._weight_decay and not self._decoupled:
+            grads = {k: g + self._weight_decay * params[k].astype(g.dtype)
+                     for k, g in grads.items()}
+        return grads
+
+    def functional_apply(self, params, grads, state, step, lr=None):
+        """Pure update: (params, grads, accum-state, step[, lr]) -> (params', state').
+
+        ``step`` and ``lr`` are traced scalars so LR schedules don't force
+        recompiles. Must be overridden per optimizer family.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} has no functional_apply")
+
+    def adopt_functional_state(self, state):
+        """Write a functional accumulator pytree back into eager accumulators.
+        Keys already match p.name because layer_state() canonicalizes
+        Parameter names to their qualified paths."""
+        for sname, acc in state.items():
+            self._accumulators[sname] = dict(acc)
+
 
 class SGD(Optimizer):
     def _apply(self, pg):
         params, grads = self._trees(pg)
         new = _sgd_rule(params, grads, jnp.float32(self.get_lr()))
         self._writeback(pg, new)
+
+    def functional_apply(self, params, grads, state, step, lr=None):
+        grads = self._functional_grads(params, grads)
+        lr = jnp.float32(self.get_lr()) if lr is None else lr
+        return _sgd_rule(params, grads, lr), state
 
 
 class Momentum(Optimizer):
@@ -351,6 +405,14 @@ class Momentum(Optimizer):
                                       use_nesterov=self._nesterov)
         self._writeback(pg, new_p)
         self._accumulators["velocity"].update(new_v)
+
+    def functional_apply(self, params, grads, state, step, lr=None):
+        grads = self._functional_grads(params, grads)
+        lr = jnp.float32(self.get_lr()) if lr is None else lr
+        new_p, new_v = _momentum_rule(params, grads, state["velocity"], lr,
+                                      jnp.float32(self._momentum),
+                                      use_nesterov=self._nesterov)
+        return new_p, {"velocity": new_v}
 
 
 class Adam(Optimizer):
@@ -375,6 +437,15 @@ class Adam(Optimizer):
         self._writeback(pg, new_p)
         self._accumulators["moment1"].update(new_m)
         self._accumulators["moment2"].update(new_v)
+
+    def functional_apply(self, params, grads, state, step, lr=None):
+        grads = self._functional_grads(params, grads)
+        lr = jnp.float32(self.get_lr()) if lr is None else lr
+        new_p, new_m, new_v = _adam_rule(
+            params, grads, state["moment1"], state["moment2"], lr,
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), jnp.float32(step))
+        return new_p, {"moment1": new_m, "moment2": new_v}
 
 
 class AdamW(Adam):
@@ -410,6 +481,25 @@ class AdamW(Adam):
             self._accumulators["moment1"].update(new_m)
             self._accumulators["moment2"].update(new_v)
 
+    def functional_apply(self, params, grads, state, step, lr=None):
+        grads = self._functional_grads(params, grads)
+        lr = jnp.float32(self.get_lr()) if lr is None else lr
+        decay_fn = self._apply_decay_fn or (lambda n: True)
+        new_p, new_m, new_v = dict(params), dict(state["moment1"]), dict(state["moment2"])
+        for names, wd in (
+                ([n for n in grads if decay_fn(n)], self._wd),
+                ([n for n in grads if not decay_fn(n)], 0.0)):
+            if not names:
+                continue
+            sub = lambda d: {n: d[n] for n in names}
+            p2, m2, v2 = _adamw_rule(
+                sub(params), sub(grads), sub(state["moment1"]),
+                sub(state["moment2"]), lr, jnp.float32(self._beta1),
+                jnp.float32(self._beta2), jnp.float32(self._eps),
+                jnp.float32(step), jnp.float32(wd))
+            new_p.update(p2); new_m.update(m2); new_v.update(v2)
+        return new_p, {"moment1": new_m, "moment2": new_v}
+
 
 class Lamb(Optimizer):
     _state_names = ["moment1", "moment2"]
@@ -443,6 +533,31 @@ class Lamb(Optimizer):
             self._writeback(group, new_p)
             self._accumulators["moment1"].update(new_m)
             self._accumulators["moment2"].update(new_v)
+
+    def functional_apply(self, params, grads, state, step, lr=None):
+        grads = self._functional_grads(params, grads)
+        lr = jnp.float32(self.get_lr()) if lr is None else lr
+        # exclude_from_weight_decay_fn takes a Parameter; evaluate it on the
+        # live params (names are canonical after layer_state()).
+        excluded = set()
+        if self._exclude_fn is not None:
+            excluded = {p.name for p in (self._parameters or [])
+                        if self._exclude_fn(p)}
+        new_p, new_m, new_v = dict(params), dict(state["moment1"]), \
+            dict(state["moment2"])
+        for names, wd in (
+                ([n for n in grads if n not in excluded], self._wd),
+                ([n for n in grads if n in excluded], 0.0)):
+            if not names:
+                continue
+            sub = lambda d: {n: d[n] for n in names}
+            p2, m2, v2 = _lamb_rule(
+                sub(params), sub(grads), sub(state["moment1"]),
+                sub(state["moment2"]), lr, jnp.float32(self._beta1),
+                jnp.float32(self._beta2), jnp.float32(self._eps),
+                jnp.float32(step), jnp.float32(wd))
+            new_p.update(p2); new_m.update(m2); new_v.update(v2)
+        return new_p, {"moment1": new_m, "moment2": new_v}
 
 
 class LarsMomentum(Optimizer):

@@ -1,0 +1,287 @@
+"""The compiled, sharded train step — the framework's execution heart.
+
+Reference parity: this one class replaces the reference's entire hot path —
+the Executor op loop (paddle/fluid/framework/executor.cc:473), the
+ParallelExecutor SSA-graph engine with its AllReduceOpHandles
+(parallel_executor.cc:613, details/all_reduce_op_handle.cc), the dygraph
+Reducer's bucketed overlap-allreduce (imperative/reducer.cc:100), and the
+optimizer graph ops (operators/optimizers/).
+
+TPU-first: forward + loss + backward (jax.grad over the functional bridge)
++ optimizer update are ONE jitted function.  pjit/GSPMD shards it over the
+global mesh from PartitionSpec annotations, so DP gradient all-reduce,
+TP activation collectives and ZeRO-sharded optimizer states all come out of
+the same compiled program, overlapped by the XLA scheduler (the hand-built
+overlap machinery of reducer.cc is the compiler's job here).
+
+Options map to reference strategies:
+  remat=True            ≙ RecomputeOptimizer (fluid/optimizer.py:4533)
+  zero=1                ≙ ShardingOptimizer stage-1 (sharding_optimizer.py:33)
+  accumulate_steps=k    ≙ GradientMergeOptimizer (fluid/optimizer.py:5011)
+  loss_scale / bf16     ≙ mixed-precision decorator (contrib/mixed_precision/)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework.tensor import Tensor
+from ..framework import functional as F
+from .mesh import get_mesh, DP_AXIS
+from .api import named_shardings, batch_sharding
+
+
+def _as_array(x):
+    if x is None:
+        return None
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+def _wrap_loss(loss_fn):
+    """Run a Tensor-level loss (e.g. nn.CrossEntropyLoss) on raw arrays."""
+    def run(out, label):
+        from ..framework import core
+        with core.no_grad_guard():
+            o = Tensor(out) if not isinstance(out, Tensor) else out
+            l = Tensor(label)
+            res = loss_fn(o, l)
+        return res._value if isinstance(res, Tensor) else res
+    return run
+
+
+class TrainStep:
+    """Compile ``layer`` + ``loss_fn`` + ``optimizer`` into one sharded step.
+
+    step semantics: ``loss = loss_fn(layer(*inputs), label)``; if ``loss_fn``
+    is None the layer is called with the full batch and must return the loss.
+    """
+
+    def __init__(self, layer, optimizer, loss_fn=None, *, mesh=None,
+                 remat: bool = False, zero: int = 0, accumulate_steps: int = 1,
+                 donate: bool = True, seed: int = 0,
+                 batch_spec=None, compute_dtype=None):
+        self.layer = layer
+        self.optimizer = optimizer
+        self.loss_fn = _wrap_loss(loss_fn) if loss_fn is not None else None
+        self.mesh = mesh or get_mesh()
+        self.remat = remat
+        self.zero = zero
+        self.accumulate_steps = int(accumulate_steps)
+        self.seed = seed
+        self.batch_spec = batch_spec
+        self.compute_dtype = compute_dtype
+        self._state = None
+        self._compiled = None
+        self._donate = donate
+
+    # -- state ---------------------------------------------------------------
+    def _param_sharding_tree(self, params):
+        shardings = named_shardings(self.layer, self.mesh)
+        return {n: shardings.get(n, NamedSharding(self.mesh, P()))
+                for n in params}
+
+    def _opt_sharding(self, param_shardings, opt_state):
+        """Optimizer accumulators inherit their param's spec; with zero>=1 the
+        first fully-replicated dim additionally shards over dp (ZeRO-1:
+        sharding_optimizer.py:33 equivalent, but as a layout annotation)."""
+        dp = self.mesh.shape.get(DP_AXIS, 1)
+        out = {}
+        for sname, acc in opt_state.items():
+            out[sname] = {}
+            for pname, arr in acc.items():
+                spec = list(param_shardings[pname].spec)
+                spec += [None] * (arr.ndim - len(spec))
+                if self.zero >= 1 and dp > 1:
+                    for d in range(arr.ndim):
+                        if spec[d] is None and arr.shape[d] % dp == 0:
+                            spec[d] = DP_AXIS
+                            break
+                out[sname][pname] = NamedSharding(self.mesh, P(*spec))
+        return out
+
+    def init_state(self):
+        params, buffers = F.layer_state(self.layer)
+        pshard = self._param_sharding_tree(params)
+        params = {n: jax.device_put(v, pshard[n]) for n, v in params.items()}
+        rep = NamedSharding(self.mesh, P())
+        buffers = {n: jax.device_put(v, rep) for n, v in buffers.items()}
+        opt_state = self.optimizer.functional_state(params)
+        oshard = self._opt_sharding(pshard, opt_state)
+        opt_state = {s: {n: jax.device_put(v, oshard[s][n])
+                         for n, v in acc.items()}
+                     for s, acc in opt_state.items()}
+        self._state = {
+            "params": params, "buffers": buffers, "opt": opt_state,
+            "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
+        }
+        self._shardings = {"params": pshard, "buffers": {n: rep for n in buffers},
+                          "opt": oshard, "step": rep}
+        return self._state
+
+    @property
+    def state(self):
+        if self._state is None:
+            self.init_state()
+        return self._state
+
+    # -- step function -------------------------------------------------------
+    def _loss_of(self, params, buffers, inputs, label, rng_key):
+        if self.compute_dtype is not None:
+            cd = self.compute_dtype
+            params = {n: (v.astype(cd) if jnp.issubdtype(v.dtype, jnp.floating)
+                          else v) for n, v in params.items()}
+            inputs = tuple(x.astype(cd) if x is not None and
+                           jnp.issubdtype(x.dtype, jnp.floating)
+                           else x for x in inputs)
+        if self.loss_fn is None:
+            args = inputs if label is None else inputs + (label,)
+            out, new_buffers = F.functional_call(
+                self.layer, params, buffers, args, training=True,
+                rng_key=rng_key, mutable_buffers=True)
+            loss = out[0] if isinstance(out, (tuple, list)) else out
+        else:
+            out, new_buffers = F.functional_call(
+                self.layer, params, buffers, inputs, training=True,
+                rng_key=rng_key, mutable_buffers=True)
+            if isinstance(out, (tuple, list)):
+                out = out[0]
+            loss = self.loss_fn(out, label)
+        return loss.astype(jnp.float32).mean(), new_buffers
+
+    def _build_step(self):
+        loss_of = self._loss_of
+        if self.remat:
+            # RecomputeOptimizer ≙ jax.checkpoint over the whole loss fn;
+            # per-layer policies live in nn layers via recompute() wrapper.
+            loss_of = jax.checkpoint(loss_of, static_argnums=())
+
+        acc_k = self.accumulate_steps
+
+        def step(state, inputs, label, lr):
+            new_step = state["step"] + 1
+            rng_key = jax.random.fold_in(jax.random.key(self.seed),
+                                         new_step)
+            grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+
+            if acc_k > 1:
+                # GradientMerge: microbatch scan accumulating grads; the
+                # optimizer runs once on the mean gradient.
+                def micro(carry, mb):
+                    g_acc, l_acc, buf = carry
+                    mb_in, mb_lb = mb
+                    (loss, buf), g = grad_fn(state["params"], buf, mb_in,
+                                             mb_lb, rng_key)
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                    return (g_acc, l_acc + loss, buf), None
+
+                def split(x):
+                    if x is None:
+                        return None
+                    return x.reshape((acc_k, x.shape[0] // acc_k) + x.shape[1:])
+                mb_inputs = tuple(split(x) for x in inputs)
+                mb_label = None if label is None else split(label)
+                g0 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state["params"])
+                (grads, loss, new_buffers), _ = jax.lax.scan(
+                    micro, (g0, jnp.float32(0.0), state["buffers"]),
+                    (mb_inputs, mb_label))
+                grads = jax.tree_util.tree_map(lambda g: g / acc_k, grads)
+                loss = loss / acc_k
+            else:
+                (loss, new_buffers), grads = grad_fn(
+                    state["params"], state["buffers"], inputs, label, rng_key)
+
+            new_params, new_opt = self.optimizer.functional_apply(
+                state["params"], grads, state["opt"], new_step, lr)
+            return {"params": new_params, "buffers": new_buffers,
+                    "opt": new_opt, "step": new_step}, loss
+
+        return step
+
+    def compile(self):
+        if self._compiled is not None:
+            return self._compiled
+        self.state  # materialize
+        step = self._build_step()
+        state_shardings = {
+            "params": self._shardings["params"],
+            "buffers": self._shardings["buffers"],
+            "opt": self._shardings["opt"],
+            "step": self._shardings["step"],
+        }
+        self._compiled = jax.jit(
+            step,
+            in_shardings=(state_shardings, None, None, None),
+            out_shardings=(state_shardings, NamedSharding(self.mesh, P())),
+            donate_argnums=(0,) if self._donate else (),
+        )
+        return self._compiled
+
+    # -- eager entry ---------------------------------------------------------
+    def __call__(self, inputs, label=None):
+        if not isinstance(inputs, (tuple, list)):
+            inputs = (inputs,)
+        inputs = tuple(_as_array(x) for x in inputs)
+        label = None if label is None else _as_array(label)
+        ndim = inputs[0].ndim
+        bsh = self.batch_spec or batch_sharding(self.mesh, ndim=ndim)
+        inputs = tuple(
+            None if x is None else
+            jax.device_put(x, bsh if x.ndim == ndim else
+                           batch_sharding(self.mesh, ndim=x.ndim))
+            for x in inputs)
+        if label is not None:
+            label = jax.device_put(
+                label, batch_sharding(self.mesh, ndim=max(label.ndim, 1)))
+        fn = self.compile()
+        lr = jnp.float32(self.optimizer.get_lr())
+        self._state, loss = fn(self.state, inputs, label, lr)
+        self.optimizer._step_count += 1
+        return Tensor(loss)
+
+    def sync_to_layer(self):
+        """Write compiled-state params/buffers back into the eager Layer and
+        optimizer accumulators (for save/eval interop)."""
+        F.load_layer_state(self.layer, self.state["params"],
+                           self.state["buffers"])
+        self.optimizer.adopt_functional_state(self.state["opt"])
+        self.optimizer._step_count = int(self.state["step"])
+
+
+class EvalStep:
+    """Jitted, sharded forward pass for evaluation/prediction."""
+
+    def __init__(self, layer, *, mesh=None, loss_fn=None):
+        self.layer = layer
+        self.mesh = mesh or get_mesh()
+        self.loss_fn = _wrap_loss(loss_fn) if loss_fn is not None else None
+        self._compiled = None
+
+    def _build(self):
+        def fwd(params, buffers, inputs, label):
+            out = F.functional_call(self.layer, params, buffers, inputs,
+                                    training=False)
+            if self.loss_fn is not None and label is not None:
+                first = out[0] if isinstance(out, (tuple, list)) else out
+                return out, self.loss_fn(first, label)
+            return out, None
+        return jax.jit(fwd)
+
+    def __call__(self, inputs, label=None):
+        if not isinstance(inputs, (tuple, list)):
+            inputs = (inputs,)
+        inputs = tuple(_as_array(x) for x in inputs)
+        params, buffers = F.layer_state(self.layer)
+        if self._compiled is None:
+            self._compiled = self._build()
+        out, loss = self._compiled(params, buffers, inputs,
+                                   None if label is None else _as_array(label))
+        wrap = lambda o: Tensor(o) if o is not None else None
+        if isinstance(out, (tuple, list)):
+            out = type(out)(Tensor(o) for o in out)
+        else:
+            out = Tensor(out)
+        return (out, wrap(loss)) if self.loss_fn is not None else out

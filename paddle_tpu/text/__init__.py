@@ -1,0 +1,10 @@
+"""paddle.text parity package: text models + datasets.
+
+Reference parity: python/paddle/text/ (RNN-era model zoo + datasets). The TPU
+build additionally ships the transformer-LM family (bert.py) because BERT-base
+pretraining is a headline benchmark workload (BASELINE.json config 3).
+"""
+from . import models  # noqa: F401
+from .models import (  # noqa: F401
+    BertModel, BertConfig, BertForPretraining, GPTModel, GPTConfig,
+)

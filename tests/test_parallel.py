@@ -1,0 +1,232 @@
+"""SPMD engine tests: mesh, sharding annotations, compiled TrainStep.
+
+Mirrors the reference's fleet meta-optimizer compile-only tests
+(test_fleet_sharding_meta_optimizer.py etc., SURVEY.md §4.3): build with a
+strategy, assert on the resulting layout/behavior — plus numeric convergence
+checks the OpTest way.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.parallel import (
+    init_mesh, get_mesh, make_mesh, TrainStep, EvalStep, shard_parameter,
+    mesh_axis_size,
+)
+
+
+class MLP(nn.Layer):
+    def __init__(self, din=16, dh=32, dout=10):
+        super().__init__()
+        self.fc1 = nn.Linear(din, dh)
+        self.fc2 = nn.Linear(dh, dout)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+@pytest.fixture()
+def dp_mp_mesh():
+    return init_mesh({"dp": 4, "mp": 2})
+
+
+def _batch(n=8, din=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, din).astype("float32"),
+            rng.randint(0, 10, (n,)))
+
+
+def test_mesh_axes_order_and_sizes(dp_mp_mesh):
+    mesh = get_mesh()
+    assert mesh.shape == {"dp": 4, "mp": 2}
+    assert mesh_axis_size("dp") == 4
+    assert mesh_axis_size("pp") == 1
+
+
+def test_mesh_infer_axis():
+    mesh = make_mesh({"dp": -1, "mp": 2})
+    assert mesh.shape["dp"] == 4
+
+
+def test_train_step_converges(dp_mp_mesh):
+    m = MLP()
+    shard_parameter(m.fc1.weight, P(None, "mp"))
+    shard_parameter(m.fc2.weight, P("mp", None))
+    opt = paddle.optimizer.Adam(parameters=m.parameters(), learning_rate=1e-2)
+    step = TrainStep(m, opt, loss_fn=nn.CrossEntropyLoss())
+    x, y = _batch()
+    l0 = float(step(x, y))
+    for _ in range(30):
+        l = float(step(x, y))
+    assert l < l0 * 0.2, f"no convergence: {l0} -> {l}"
+    # TP layout survived compilation
+    sh = step.state["params"]["fc1.weight"].sharding
+    assert sh.spec == P(None, "mp")
+
+
+def test_train_step_matches_eager_sgd(dp_mp_mesh):
+    """Compiled sharded step == eager tape step (OpTest-style numeric check)."""
+    paddle.seed(7)
+    m1 = MLP(8, 8, 4)
+    m2 = MLP(8, 8, 4)
+    m2.set_state_dict(m1.state_dict())
+    x, y = (np.random.RandomState(1).randn(8, 8).astype("float32"),
+            np.random.RandomState(1).randint(0, 4, (8,)))
+
+    opt1 = paddle.optimizer.SGD(parameters=m1.parameters(), learning_rate=0.1)
+    step = TrainStep(m1, opt1, loss_fn=nn.CrossEntropyLoss())
+    for _ in range(3):
+        loss_c = step(x, y)
+    step.sync_to_layer()
+
+    opt2 = paddle.optimizer.SGD(parameters=m2.parameters(), learning_rate=0.1)
+    lossf = nn.CrossEntropyLoss()
+    for _ in range(3):
+        xt = paddle.to_tensor(x)
+        yt = paddle.to_tensor(y)
+        loss_e = lossf(m2(xt), yt)
+        loss_e.backward()
+        opt2.step()
+        opt2.clear_grad()
+
+    np.testing.assert_allclose(float(loss_c), float(loss_e), rtol=1e-4)
+    for (n1, p1), (n2, p2) in zip(m1.named_parameters(),
+                                  m2.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4,
+                                   atol=1e-5, err_msg=n1)
+
+
+def test_gradient_merge_equals_big_batch(dp_mp_mesh):
+    """accumulate_steps=k on batch 2B == one step on mean grads (GradientMerge
+    semantics, fluid/optimizer.py:5011)."""
+    paddle.seed(3)
+    m1 = MLP(8, 8, 4)
+    m2 = MLP(8, 8, 4)
+    m2.set_state_dict(m1.state_dict())
+    x, y = (np.random.RandomState(2).randn(8, 8).astype("float32"),
+            np.random.RandomState(2).randint(0, 4, (8,)))
+
+    s1 = TrainStep(m1, paddle.optimizer.SGD(parameters=m1.parameters(),
+                                            learning_rate=0.1),
+                   loss_fn=nn.CrossEntropyLoss())
+    s2 = TrainStep(m2, paddle.optimizer.SGD(parameters=m2.parameters(),
+                                            learning_rate=0.1),
+                   loss_fn=nn.CrossEntropyLoss(), accumulate_steps=2)
+    l1 = s1(x, y)
+    l2 = s2(x, y)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for n, p1 in s1.state["params"].items():
+        np.testing.assert_allclose(np.asarray(p1),
+                                   np.asarray(s2.state["params"][n]),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_remat_same_numerics(dp_mp_mesh):
+    paddle.seed(5)
+    m1 = MLP(8, 8, 4)
+    m2 = MLP(8, 8, 4)
+    m2.set_state_dict(m1.state_dict())
+    x, y = _batch(8, 8, seed=5)
+    y = y % 4
+    s1 = TrainStep(m1, paddle.optimizer.SGD(parameters=m1.parameters(),
+                                            learning_rate=0.1),
+                   loss_fn=nn.CrossEntropyLoss())
+    s2 = TrainStep(m2, paddle.optimizer.SGD(parameters=m2.parameters(),
+                                            learning_rate=0.1),
+                   loss_fn=nn.CrossEntropyLoss(), remat=True)
+    np.testing.assert_allclose(float(s1(x, y)), float(s2(x, y)), rtol=1e-6)
+
+
+def test_zero_shards_optimizer_state(dp_mp_mesh):
+    m = MLP(16, 32, 8)
+    opt = paddle.optimizer.Adam(parameters=m.parameters())
+    step = TrainStep(m, opt, loss_fn=nn.CrossEntropyLoss(), zero=1)
+    x, y = _batch(8, 16)
+    y = y % 8
+    step(x, y)
+    mom = step.state["opt"]["moment1"]["fc1.weight"]
+    assert "dp" in jax.tree_util.tree_leaves(
+        [ax for ax in mom.sharding.spec if ax is not None])
+
+
+def test_eval_step(dp_mp_mesh):
+    m = MLP()
+    m.eval()
+    x, _ = _batch()
+    out = EvalStep(m)(x)
+    ref = m(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+
+def test_eager_to_compiled_keeps_optimizer_state(dp_mp_mesh):
+    """Adam moments built eagerly must carry into the compiled step (name
+    canonicalization: layer_state sets p.name = qualified path)."""
+    paddle.seed(11)
+    m = MLP(8, 8, 4)
+    opt = paddle.optimizer.Adam(parameters=m.parameters(), learning_rate=1e-3)
+    lossf = nn.CrossEntropyLoss()
+    x, y = _batch(8, 8, seed=4)
+    y = y % 4
+    # canonicalize names first (as any compiled path does), then run eagerly
+    from paddle_tpu.framework.functional import layer_state
+    layer_state(m)
+    loss = lossf(m(paddle.to_tensor(x)), paddle.to_tensor(y))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    step = TrainStep(m, opt, loss_fn=lossf)
+    st = step.state
+    m1 = np.asarray(st["opt"]["moment1"]["fc1.weight"])
+    assert np.abs(m1).sum() > 0, "eager Adam moment did not carry over"
+    # and back: compiled -> eager
+    step(x, y)
+    step.sync_to_layer()
+    acc = opt._accumulators["moment1"]
+    assert "fc1.weight" in acc
+
+
+def test_need_clip_respected_in_functional(dp_mp_mesh):
+    m = MLP(8, 8, 4)
+    m.fc1.weight.need_clip = False
+    clip = nn.ClipGradByGlobalNorm(1e-8)  # crush everything clippable
+    opt = paddle.optimizer.SGD(parameters=m.parameters(), learning_rate=1.0,
+                               grad_clip=clip)
+    step = TrainStep(m, opt, loss_fn=nn.CrossEntropyLoss())
+    before = {n: np.asarray(v) for n, v in step.state["params"].items()}
+    x, y = _batch(8, 8, seed=9)
+    step(x, y % 4)
+    after = step.state["params"]
+    # clipped params barely move; need_clip=False param moves freely
+    moved_free = np.abs(np.asarray(after["fc1.weight"]) -
+                        before["fc1.weight"]).max()
+    moved_clipped = np.abs(np.asarray(after["fc2.weight"]) -
+                           before["fc2.weight"]).max()
+    assert moved_free > 1e-4
+    assert moved_clipped < 1e-6
+
+
+def test_buffers_update_under_jit(dp_mp_mesh):
+    """BN running stats must mutate through the functional bridge."""
+    class BNNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 8)
+            self.bn = nn.BatchNorm1D(8)
+            self.out = nn.Linear(8, 4)
+
+        def forward(self, x):
+            return self.out(self.bn(self.fc(x)))
+
+    m = BNNet()
+    before = m.bn._mean.numpy().copy()
+    step = TrainStep(m, paddle.optimizer.SGD(parameters=m.parameters()),
+                     loss_fn=nn.CrossEntropyLoss())
+    x, y = _batch(8, 16)
+    step(x, y % 4)
+    step.sync_to_layer()
+    after = m.bn._mean.numpy()
+    assert not np.allclose(before, after), "BN running mean did not update"
