@@ -53,6 +53,12 @@ def convert_dtype(dtype):
     """Normalize a user-provided dtype (str | np.dtype | jnp dtype | None)."""
     if dtype is None:
         return None
+    import jax
+    try:
+        if jax.dtypes.issubdtype(dtype, jax.dtypes.extended):
+            return dtype  # PRNG key dtypes etc.: pass through unchanged
+    except TypeError:
+        pass
     if isinstance(dtype, str):
         key = dtype.lower()
         if key.startswith("paddle."):

@@ -80,8 +80,57 @@ class Primitive:
             self._bwd_cache[key] = f
         return f
 
+    # -- static-graph recording ----------------------------------------------
+    def _append_static(self, args, attrs):
+        """In static mode, ops are RECORDED into the current Program block
+        instead of executed — the TPU replacement for Block.append_op +
+        InferShape at append time (python/paddle/fluid/framework.py:1970).
+        The Executor later replays the whole block as one XLA computation."""
+        from ..static.program import current_block, Variable
+        block = current_block()
+        inputs = []
+        for a in args:
+            if isinstance(a, Variable):
+                inputs.append(a)
+            elif isinstance(a, Tensor) and (a.persistable or
+                                            type(a).__name__ == "Parameter"):
+                # an eager Parameter used inside a static program (the 2.0
+                # dual-mode Layer story): register it as a persistable var
+                # seeded into the global scope, so paddle.nn layers build
+                # static graphs directly
+                from ..static.executor import global_scope
+                if block.has_var(a.name):
+                    inputs.append(block.var(a.name))
+                else:
+                    v = block.create_var(
+                        name=a.name, shape=list(a._value.shape),
+                        dtype=a._value.dtype, persistable=True,
+                        stop_gradient=a.stop_gradient,
+                        trainable=getattr(a, "trainable",
+                                          not a.stop_gradient))
+                    block.program._parameters.append(a.name)
+                    global_scope().set_var(a.name, a._value)
+                    inputs.append(v)
+            else:
+                # literal operand -> inline constant op
+                val = a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                cv = block.create_var(shape=list(val.shape), dtype=val.dtype)
+                block.ops.append(_ConstOp(block, cv.name, val))
+                inputs.append(cv)
+        stop = not (core.grad_enabled() and any(
+            isinstance(a, Variable) and not a.stop_gradient for a in args))
+        return block.append_op(self.name, inputs, attrs,
+                               out_stop_gradient=stop)
+
     # -- eager application ---------------------------------------------------
     def __call__(self, *args, **attrs):
+        if core.in_static_mode():
+            from ..static.program import Variable
+            if any(isinstance(a, Variable) or
+                   (isinstance(a, Tensor) and
+                    (a.persistable or type(a).__name__ == "Parameter"))
+                   for a in args):
+                return self._append_static(args, attrs)
         arrs = tuple(a._value if isinstance(a, Tensor) else a for a in args)
         key = _attrs_key(attrs)
         out = self._fwd(key, attrs)(*arrs)
@@ -111,6 +160,16 @@ class Primitive:
     # raw (no tape, no wrap): used by static executor / jit tracer
     def raw(self, *arrs, **attrs):
         return self._fwd(_attrs_key(attrs), attrs)(*arrs)
+
+
+def _ConstOp(block, out_name, value):
+    """Inline literal in a static program (fill_constant-with-value parity)."""
+    from ..static.program import Operator
+
+    def fn():
+        return (value,)
+    return Operator(block, prim="@const", inputs=[], outputs=[out_name],
+                    attrs={}, fn=fn, type_name="const")
 
 
 def _check_finite(name, out):

@@ -1,0 +1,251 @@
+"""DataLoader: batched, shuffled, multiprocess host pipeline with async
+device prefetch.
+
+Reference parity: python/paddle/fluid/reader.py:148 (DataLoader) +
+dataloader/dataloader_iter.py — single-process iterator (:264) and
+multi-process workers with shared-memory tensors and a SIGCHLD watchdog
+(:469); C++ side does async H2D via buffered_reader.cc (double buffering).
+
+TPU-first: workers produce numpy batches over mp queues; a prefetch thread
+performs jax.device_put ahead of consumption (the buffered_reader double
+buffer) so the accelerator never waits on host collate; with a dp-sharded
+mesh the put scatters the batch across local chips (one fused transfer per
+device) — the TPU analogue of per-GPU feed splitting in ParallelExecutor.
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import queue as queue_mod
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    """Stack samples: list of tuples -> tuple of stacked arrays."""
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([s.numpy() for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    return np.asarray(batch)
+
+
+def _to_tensor_tree(obj, device_put):
+    if isinstance(obj, tuple):
+        return tuple(_to_tensor_tree(o, device_put) for o in obj)
+    if isinstance(obj, list):
+        return [_to_tensor_tree(o, device_put) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v, device_put) for k, v in obj.items()}
+    return Tensor(device_put(obj))
+
+
+def _mp_worker(dataset, index_queue, data_queue, collate_fn, worker_id,
+               num_workers):
+    _worker_info.info = WorkerInfo(worker_id, num_workers, dataset)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        seq, indices = item
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            data_queue.put((seq, batch, None))
+        except Exception as e:  # surface worker errors to the main process
+            data_queue.put((seq, None, repr(e)))
+
+
+class DataLoader:
+    """reader.py:148 parity."""
+
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn: Optional[Callable] = None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=False, timeout=120, worker_init_fn=None):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = max(int(prefetch_factor), 1)
+        self.use_buffer_reader = use_buffer_reader
+        self.timeout = timeout
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    # -- device placement ----------------------------------------------------
+    @staticmethod
+    def _device_put(arr):
+        import jax
+        from ..parallel import mesh as mesh_mod
+        if mesh_mod.has_mesh():
+            from ..parallel.api import batch_sharding
+            a = np.asarray(arr)
+            mesh = mesh_mod.get_mesh()
+            dp = mesh.shape.get("dp", 1)
+            if a.ndim >= 1 and dp > 1 and a.shape[0] % dp == 0:
+                return jax.device_put(
+                    a, batch_sharding(mesh, ndim=a.ndim))
+        return jax.device_put(np.asarray(arr))
+
+    # -- iteration -----------------------------------------------------------
+    def _batches_single(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            while True:
+                chunk = list(itertools.islice(it, self.batch_size))
+                if not chunk:
+                    return
+                if len(chunk) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(chunk)
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _batches_multiproc(self):
+        import multiprocessing as mp
+        # spawn by default: fork is unsafe in a process where JAX threads
+        # are live. Unpicklable datasets (lambdas in transforms) fall back
+        # to fork, matching the reference's fork-based workers.
+        try:
+            import pickle
+            pickle.dumps(self.dataset)
+            pickle.dumps(self.collate_fn)
+            ctx = mp.get_context("spawn")
+        except Exception:
+            ctx = mp.get_context("fork")
+        index_queue = ctx.Queue()
+        data_queue = ctx.Queue()
+        workers = []
+        for wid in range(self.num_workers):
+            w = ctx.Process(target=_mp_worker,
+                            args=(self.dataset, index_queue, data_queue,
+                                  self.collate_fn, wid, self.num_workers),
+                            daemon=True)
+            w.start()
+            workers.append(w)
+
+        def shutdown():
+            for _ in workers:
+                index_queue.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()
+        atexit.register(shutdown)
+
+        try:
+            pending = {}
+            next_seq = 0
+            submitted = 0
+            it = iter(self.batch_sampler)
+            # pre-fill
+            done_submitting = False
+            for _ in range(self.num_workers * self.prefetch_factor):
+                try:
+                    index_queue.put((submitted, next(it)))
+                    submitted += 1
+                except StopIteration:
+                    done_submitting = True
+                    break
+            while next_seq < submitted or not done_submitting:
+                if next_seq in pending:
+                    batch = pending.pop(next_seq)
+                else:
+                    try:
+                        seq, batch, err = data_queue.get(timeout=self.timeout)
+                    except queue_mod.Empty:
+                        dead = [w for w in workers if not w.is_alive()]
+                        raise RuntimeError(
+                            f"DataLoader timed out; {len(dead)} dead workers "
+                            f"(SIGCHLD watchdog parity)")
+                    if err is not None:
+                        raise RuntimeError(f"DataLoader worker error: {err}")
+                    if seq != next_seq:
+                        pending[seq] = batch
+                        continue
+                try:
+                    index_queue.put((submitted, next(it)))
+                    submitted += 1
+                except StopIteration:
+                    done_submitting = True
+                yield batch
+                next_seq += 1
+        finally:
+            atexit.unregister(shutdown)
+            shutdown()
+
+    def __iter__(self):
+        gen = (self._batches_multiproc() if self.num_workers > 0
+               and not self._iterable_mode else self._batches_single())
+        if not self.use_buffer_reader:
+            for batch in gen:
+                yield _to_tensor_tree(batch, self._device_put)
+            return
+        # async H2D double-buffer (buffered_reader.cc parity)
+        buf = queue_mod.Queue(maxsize=self.prefetch_factor)
+        stop = object()
+        err_holder = []
+
+        def producer():
+            try:
+                for batch in gen:
+                    buf.put(_to_tensor_tree(batch, self._device_put))
+            except Exception as e:
+                err_holder.append(e)
+            finally:
+                buf.put(stop)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = buf.get()
+            if item is stop:
+                if err_holder:
+                    raise err_holder[0]
+                return
+            yield item

@@ -33,12 +33,12 @@ def _encode_index(idx, nd):
         idx = (idx,)
     spec, dynamic = [], []
     for it in idx:
-        if isinstance(it, Tensor):
+        if isinstance(it, Tensor) or type(it).__name__ == "Variable":
             if it.dtype == jnp.bool_:
                 spec.append(("mask",))
             else:
                 spec.append(("arr",))
-            dynamic.append(unwrap(it))
+            dynamic.append(unwrap(it) if isinstance(it, Tensor) else it)
         elif isinstance(it, (np.ndarray, list)):
             arr = jnp.asarray(np.asarray(it))
             spec.append(("mask",) if arr.dtype == jnp.bool_ else ("arr",))
@@ -84,6 +84,14 @@ _getitem = Primitive("getitem", _getitem_fn)
 def _tensor_getitem(self, idx):
     spec, dynamic = _encode_index(idx, self.ndim)
     if any(s[0] == "mask" for s in spec):
+        if not isinstance(self, Tensor) or \
+                any(not isinstance(d, (Tensor, jnp.ndarray, np.ndarray))
+                    and hasattr(d, "shape") for d in dynamic):
+            raise TypeError(
+                "boolean-mask indexing has a data-dependent shape and "
+                "cannot be recorded in a static program; use "
+                "paddle.masked_select with a fixed-size fallback or index "
+                "eagerly")
         # boolean masking has a data-dependent shape: eager numpy path
         full = _decode_index(spec, dynamic)
         return Tensor(jnp.asarray(np.asarray(self.numpy()[
@@ -113,8 +121,12 @@ def _tensor_setitem(self, idx, value):
         self.is_leaf = False
 
 
-def apply_patches():
-    T = Tensor
+def apply_patches(T=None, eager=True):
+    """Install operator methods. Called with the eager Tensor at import and
+    with the static Variable class by paddle_tpu.static (the math_op_patch
+    dual of framework.py's static Variable operator overloads)."""
+    if T is None:
+        T = Tensor
     # arithmetic
     T.__add__ = lambda s, o: m.add(s, _coerce(o, s))
     T.__radd__ = lambda s, o: m.add(_coerce(o, s), s)
@@ -143,9 +155,10 @@ def apply_patches():
     T.__and__ = lambda s, o: m.logical_and(s, o) if s.dtype == jnp.bool_ else m.bitwise_and(s, o)
     T.__or__ = lambda s, o: m.logical_or(s, o) if s.dtype == jnp.bool_ else m.bitwise_or(s, o)
     T.__xor__ = lambda s, o: m.logical_xor(s, o) if s.dtype == jnp.bool_ else m.bitwise_xor(s, o)
-    # indexing
+    # indexing (in-place setitem is eager-only; static programs are SSA)
     T.__getitem__ = _tensor_getitem
-    T.__setitem__ = _tensor_setitem
+    if eager:
+        T.__setitem__ = _tensor_setitem
 
     # methods: math
     for name in ["add", "subtract", "multiply", "divide", "pow", "mod",
@@ -174,8 +187,9 @@ def apply_patches():
     T.cast = lambda s, dtype: manipulation.cast(s, dtype)
     T.astype = lambda s, dtype: manipulation.cast(s, dtype)
     T.masked_fill = _method(m.masked_fill)
-    T.fill_ = lambda s, v: s.set_value(jnp.full_like(s._value, float(v)))
-    T.zero_ = lambda s: s.set_value(jnp.zeros_like(s._value))
+    if eager:
+        T.fill_ = lambda s, v: s.set_value(jnp.full_like(s._value, float(v)))
+        T.zero_ = lambda s: s.set_value(jnp.zeros_like(s._value))
     T.norm = _method_norm
 
 

@@ -277,12 +277,96 @@ class Optimizer:
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
         """fluid Optimizer.minimize parity: in dygraph, backward has already
-        populated .grad (or we trigger it), then apply."""
+        populated .grad (or we trigger it), then apply.  In static mode,
+        appends backward + update ops to the loss's program (optimizer.py:916
+        = backward :739 + apply_gradients :808)."""
+        from ..framework import core as _core
+        if _core.in_static_mode() and not isinstance(loss, Tensor):
+            return self._minimize_static(loss, parameters, no_grad_set)
         if loss._node is not None or loss.grad is None:
             if loss._node is not None:
                 loss.backward()
         self.step()
         return None, [(p, p.grad) for p in (self._parameters or [])]
+
+    def _minimize_static(self, loss, parameters=None, no_grad_set=None):
+        """Append @backward + one fused @optimize macro op. The update math
+        is the same functional_apply the compiled TrainStep uses, so static
+        programs get the optimizer fused into the XLA computation — the
+        analogue of sgd/adam ops inside the Program
+        (operators/optimizers/)."""
+        from ..static.program import Operator
+        from ..static.backward import append_backward
+        from ..static.executor import global_scope
+
+        block = loss.block
+        program = block.program
+        pgs = append_backward(loss, parameter_list=parameters,
+                              no_grad_set=no_grad_set)
+        param_names = [p.name for p, _ in pgs]
+        grad_names = [g.name for _, g in pgs]
+
+        # persistable accumulator vars, zero-seeded in the scope
+        scope = global_scope()
+        for sname in self._state_names:
+            for p, _ in pgs:
+                acc_name = f"{p.name}_{sname}_0"
+                if not block.has_var(acc_name):
+                    block.create_var(name=acc_name, shape=p.shape,
+                                     dtype="float32", persistable=True)
+                    scope.set_var(acc_name,
+                                  jnp.zeros([d for d in p.shape], jnp.float32))
+        step_name = f"@optimizer_step_{id(self)}"
+        if not block.has_var(step_name):
+            block.create_var(name=step_name, shape=[], dtype="int32",
+                             persistable=True)
+            scope.set_var(step_name, jnp.zeros((), jnp.int32))
+        # LR is a scope INPUT refreshed before every run, never a traced
+        # constant — so LRScheduler.step()/set_lr() take effect without
+        # recompiling (the eager TrainStep passes lr as an argument for the
+        # same reason)
+        lr_name = f"@optimizer_lr_{id(self)}"
+        if not block.has_var(lr_name):
+            block.create_var(name=lr_name, shape=[], dtype="float32",
+                             persistable=True)
+            scope.set_var(lr_name, jnp.float32(self.get_lr()))
+        program._pre_run_hooks.append(
+            lambda sc, opt=self, n=lr_name: sc.set_var(
+                n, jnp.float32(opt.get_lr())))
+
+        acc_names = [f"{p}_{s}_0" for s in self._state_names
+                     for p in param_names]
+        opt = self
+
+        def update_fn(*arrs):
+            k = len(param_names)
+            params = dict(zip(param_names, arrs[:k]))
+            grads = dict(zip(param_names, arrs[k:2 * k]))
+            state = {}
+            idx = 2 * k
+            for sname in opt._state_names:
+                state[sname] = dict(zip(param_names,
+                                        arrs[idx:idx + k]))
+                idx += k
+            step = arrs[idx] + 1
+            lr = arrs[idx + 1]
+            new_p, new_state = opt.functional_apply(params, grads, state,
+                                                    step, lr)
+            outs = [new_p[n] for n in param_names]
+            for sname in opt._state_names:
+                outs += [new_state[sname][n] for n in param_names]
+            outs.append(step)
+            return tuple(outs)
+
+        op = Operator(block, prim="@optimize",
+                      inputs=param_names + grad_names + acc_names
+                      + [step_name, lr_name],
+                      outputs=param_names + acc_names + [step_name],
+                      attrs={}, fn=update_fn,
+                      type_name=type(self).__name__.lower())
+        block.ops.append(op)
+        program._version += 1
+        return None, pgs
 
     def clear_grad(self, set_to_zero=False):
         for p in (self._parameters or []):

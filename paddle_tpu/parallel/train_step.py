@@ -226,16 +226,25 @@ class TrainStep:
             inputs = (inputs,)
         inputs = tuple(_as_array(x) for x in inputs)
         label = None if label is None else _as_array(label)
-        ndim = inputs[0].ndim
-        bsh = self.batch_spec or batch_sharding(self.mesh, ndim=ndim)
-        inputs = tuple(
-            None if x is None else
-            jax.device_put(x, bsh if x.ndim == ndim else
-                           batch_sharding(self.mesh, ndim=x.ndim))
-            for x in inputs)
-        if label is not None:
-            label = jax.device_put(
-                label, batch_sharding(self.mesh, ndim=max(label.ndim, 1)))
+
+        dp = self.mesh.shape.get(DP_AXIS, 1)
+        lead_ndim = inputs[0].ndim
+
+        def put(x):
+            if x is None:
+                return None
+            # explicit batch_spec only applies to arrays of the lead rank;
+            # lower-rank labels get their own rank-matched sharding
+            if self.batch_spec is not None and x.ndim == lead_ndim:
+                return jax.device_put(x, self.batch_spec)
+            if x.ndim >= 1 and dp > 1 and x.shape[0] % dp == 0:
+                return jax.device_put(x, batch_sharding(self.mesh,
+                                                        ndim=x.ndim))
+            # batch not divisible by dp: replicate rather than fail
+            return jax.device_put(x, NamedSharding(self.mesh, P()))
+
+        inputs = tuple(put(x) for x in inputs)
+        label = put(label)
         fn = self.compile()
         lr = jnp.float32(self.optimizer.get_lr())
         self._state, loss = fn(self.state, inputs, label, lr)

@@ -1,0 +1,88 @@
+"""paddle.static.nn: static-graph layer builders.
+
+Reference parity: python/paddle/fluid/layers/nn.py (the 36K-LoC layers DSL,
+SURVEY.md §2.4) — here each builder creates eager Parameters (registered into
+the program as persistables by the primitive recorder) and invokes the same
+nn.functional ops that dygraph uses, so the static DSL is a thin veneer
+rather than a parallel implementation.
+"""
+from __future__ import annotations
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.layers import ParamAttr
+from ..framework.tensor import Parameter
+from ..framework.dtype import convert_dtype
+
+
+def _make_param(shape, dtype, attr, default_init, name_hint):
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = attr.initializer or default_init
+    value = init(shape, convert_dtype(dtype) or "float32")
+    p = Parameter(value, name=attr.name)
+    return p
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """fluid.layers.fc parity."""
+    from .. import ops
+    in_dim = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_dim *= d
+    if len(x.shape) > num_flatten_dims + 1:
+        lead = [-1 if (d is None or d < 0) else d
+                for d in x.shape[:num_flatten_dims]]
+        x = ops.reshape(x, lead + [in_dim])
+    w = _make_param([in_dim, size], "float32", weight_attr,
+                    I.XavierUniform(), "fc_w")
+    b = _make_param([size], "float32", bias_attr, I.Constant(0.0), "fc_b")
+    out = F.linear(x, w, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    w = _make_param([num_filters, in_ch // groups] + list(ks), "float32",
+                    param_attr, I.XavierUniform(), "conv_w")
+    b = _make_param([num_filters], "float32", bias_attr, I.Constant(0.0),
+                    "conv_b")
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    w = _make_param(list(size), dtype, param_attr, I.XavierUniform(), "emb_w")
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None):
+    from .. import ops
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = _make_param([c], "float32", param_attr, I.Constant(1.0), "bn_s")
+    bias = _make_param([c], "float32", bias_attr, I.Constant(0.0), "bn_b")
+    mean = Parameter(jnp.zeros([c], jnp.float32))
+    var = Parameter(jnp.ones([c], jnp.float32))
+    mean.stop_gradient = True
+    var.stop_gradient = True
+    out = F.batch_norm(input, mean, var, weight=scale, bias=bias,
+                       training=not is_test, momentum=momentum,
+                       epsilon=epsilon, data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out

@@ -1,0 +1,3 @@
+"""paddle.vision parity: model zoo, transforms, datasets."""
+from . import models, transforms, datasets  # noqa: F401
+from .models import *  # noqa: F401,F403

@@ -1,0 +1,156 @@
+"""hapi Model + vision/text model zoo + metric tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import Model, EarlyStopping
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.metric import Accuracy, Precision, Recall, Auc
+from paddle_tpu.parallel import init_mesh
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    init_mesh({"dp": -1})
+
+
+def _cls_dataset(n=64, din=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, din).astype("float32")
+    w = rng.randn(din, classes)
+    y = (x @ w).argmax(-1).astype("int64")
+    return TensorDataset([x, y])
+
+
+class MLP(nn.Layer):
+    def __init__(self, din=16, classes=4):
+        super().__init__()
+        self.net = nn.Sequential(nn.Linear(din, 64), nn.ReLU(),
+                                 nn.Linear(64, classes))
+
+    def forward(self, x):
+        return self.net(x)
+
+
+def test_model_fit_learns():
+    ds = _cls_dataset()
+    model = Model(MLP())
+    model.prepare(paddle.optimizer.Adam(parameters=model.parameters(),
+                                        learning_rate=1e-2),
+                  nn.CrossEntropyLoss(), Accuracy())
+    model.fit(ds, epochs=8, batch_size=32, verbose=0)
+    logs = model.evaluate(ds, batch_size=32, verbose=0)
+    assert logs["eval_acc"] > 0.9, logs
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    ds = _cls_dataset(32)
+    model = Model(MLP())
+    model.prepare(paddle.optimizer.Adam(parameters=model.parameters()),
+                  nn.CrossEntropyLoss())
+    model.fit(ds, epochs=1, batch_size=16, verbose=0)
+    path = str(tmp_path / "ckpt")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+
+    model2 = Model(MLP())
+    model2.prepare(paddle.optimizer.Adam(parameters=model2.parameters()),
+                   nn.CrossEntropyLoss())
+    model2.load(path)
+    x = np.random.randn(4, 16).astype("float32")
+    np.testing.assert_allclose(
+        model2.predict_batch(x).numpy(),
+        model.predict_batch(x).numpy(), rtol=1e-5)
+
+
+def test_early_stopping_stops():
+    ds = _cls_dataset(32)
+    model = Model(MLP())
+    model.prepare(paddle.optimizer.SGD(parameters=model.parameters(),
+                                       learning_rate=0.0),
+                  nn.CrossEntropyLoss())
+    es = EarlyStopping(monitor="eval_loss", mode="min", patience=1)
+    model.fit(ds, eval_data=ds, epochs=10, batch_size=32, verbose=0,
+              callbacks=[es])
+    assert model.stop_training
+
+
+def test_metrics():
+    acc = Accuracy()
+    pred = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])
+    label = np.array([0, 1, 1])
+    acc.update(acc.compute(pred, label))
+    assert abs(acc.accumulate() - 2 / 3) < 1e-6
+
+    p = Precision()
+    p.update(np.array([0.9, 0.8, 0.2]), np.array([1, 0, 1]))
+    assert abs(p.accumulate() - 0.5) < 1e-6
+
+    r = Recall()
+    r.update(np.array([0.9, 0.8, 0.2]), np.array([1, 0, 1]))
+    assert abs(r.accumulate() - 0.5) < 1e-6
+
+    auc = Auc()
+    auc.update(np.array([0.1, 0.4, 0.35, 0.8]), np.array([0, 0, 1, 1]))
+    assert 0.5 < auc.accumulate() <= 1.0
+
+
+def test_lenet_shapes():
+    from paddle_tpu.vision.models import LeNet
+    m = LeNet()
+    out = m(paddle.to_tensor(np.random.randn(2, 1, 28, 28).astype("float32")))
+    assert out.shape == [2, 10]
+
+
+@pytest.mark.parametrize("ctor,shape", [
+    ("resnet18", (2, 3, 64, 64)),
+    ("mobilenet_v2", (2, 3, 64, 64)),
+])
+def test_vision_models_forward(ctor, shape):
+    import paddle_tpu.vision.models as zoo
+    m = getattr(zoo, ctor)(num_classes=7)
+    m.eval()
+    out = m(paddle.to_tensor(np.random.randn(*shape).astype("float32")))
+    assert out.shape == [2, 7]
+
+
+def test_vision_transforms():
+    from paddle_tpu.vision.transforms import (
+        Compose, ToTensor, Normalize, Resize, CenterCrop)
+    img = (np.random.rand(32, 32, 3) * 255).astype("uint8")
+    t = Compose([ToTensor(), Normalize(mean=[0.5] * 3, std=[0.5] * 3)])
+    out = t(img)
+    assert out.shape == (3, 32, 32)
+    assert out.min() >= -1.01 and out.max() <= 1.01
+    r = Resize((16, 16))(img)
+    assert r.shape[:2] == (16, 16)
+    c = CenterCrop(16)(img)
+    assert c.shape[:2] == (16, 16)
+
+
+def test_mnist_synthetic_dataset():
+    from paddle_tpu.vision.datasets import MNIST
+    ds = MNIST(mode="train", synthetic_size=16)
+    img, label = ds[0]
+    assert img.shape == (1, 28, 28)
+    assert 0 <= int(label) < 10
+
+
+def test_bert_tiny_trains_via_model():
+    from paddle_tpu.text.models.bert import BertConfig, BertForPretraining
+    cfg = BertConfig.tiny()
+    net = BertForPretraining(cfg)
+    from paddle_tpu.parallel import TrainStep
+    step = TrainStep(net, paddle.optimizer.AdamW(
+        parameters=net.parameters(), learning_rate=1e-3))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 16))
+    labels = np.where(rng.rand(4, 16) < 0.15, ids, -100)
+    l0 = float(step((ids, None, None, labels)))
+    for _ in range(10):
+        l = float(step((ids, None, None, labels)))
+    assert l < l0
