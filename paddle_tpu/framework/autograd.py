@@ -41,6 +41,14 @@ class GradNode:
     def seed(self, index, ct):
         if self.out_ct is None:
             self.out_ct = [None] * len(self.out_avals)
+        # dtype coercion: AMP casts at op dispatch are not part of any
+        # recorded vjp, so a downstream node may hand back a cotangent in a
+        # different precision than this node's output (fp32 ct for a bf16
+        # out); align to the recorded output dtype
+        dtype = self.out_avals[index][1]
+        if hasattr(ct, "dtype") and ct.dtype != dtype and \
+                ct.dtype != _float0:
+            ct = ct.astype(dtype)
         cur = self.out_ct[index]
         self.out_ct[index] = ct if cur is None else cur + ct
 

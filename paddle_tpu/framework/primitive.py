@@ -132,6 +132,23 @@ class Primitive:
                    for a in args):
                 return self._append_static(args, attrs)
         arrs = tuple(a._value if isinstance(a, Tensor) else a for a in args)
+
+        # AMP autocast at dispatch (imperative/amp_auto_cast.cc via
+        # tracer.cc:158 parity): white-listed ops compute in bf16/fp16,
+        # black-listed ops are promoted back to fp32
+        amp = core.amp_state()
+        if amp is not None:
+            policy = amp.cast_policy(self.name)
+            if policy == "low":
+                arrs = tuple(
+                    a.astype(amp.dtype) if hasattr(a, "dtype")
+                    and a.dtype == jnp.float32 else a for a in arrs)
+            elif policy == "high":
+                arrs = tuple(
+                    a.astype(jnp.float32) if hasattr(a, "dtype")
+                    and a.dtype in (jnp.bfloat16, jnp.float16) else a
+                    for a in arrs)
+
         key = _attrs_key(attrs)
         out = self._fwd(key, attrs)(*arrs)
 

@@ -136,7 +136,10 @@ class EarlyStopping(Callback):
             self.best = float("inf")
 
     def on_eval_end(self, logs=None):
-        cur = (logs or {}).get(self.monitor)
+        logs = logs or {}
+        # Model.fit emits eval logs as 'eval_loss'/'eval_<metric>'; accept
+        # the paddle-style bare names ('loss', 'acc') transparently
+        cur = logs.get(self.monitor, logs.get(f"eval_{self.monitor}"))
         if cur is None:
             return
         if isinstance(cur, (list, tuple)):

@@ -83,8 +83,15 @@ class Model:
 
     def eval_batch(self, inputs, labels=None):
         self._sync_from_train()
+        return self._eval_batch_nosync(inputs, labels)
+
+    def _eval_batch_nosync(self, inputs, labels=None):
         step = self._ensure_eval_step()
-        out, loss = step(inputs, labels)
+        res = step(inputs, labels)
+        if self._loss is not None:
+            out, loss = res  # EvalStep with loss_fn returns (out, loss)
+        else:
+            out, loss = res, None
         metrics = []
         for m in self._metrics:
             first = out[0] if isinstance(out, (tuple, list)) else out
@@ -95,6 +102,9 @@ class Model:
 
     def predict_batch(self, inputs):
         self._sync_from_train()
+        return self._predict_batch_nosync(inputs)
+
+    def _predict_batch_nosync(self, inputs):
         step = self._ensure_eval_step()  # reuse the jitted forward
         out = step(inputs)
         if self._loss is not None:  # EvalStep with loss_fn returns (out, loss)
@@ -172,9 +182,10 @@ class Model:
         for m in self._metrics:
             m.reset()
         losses = []
+        self._sync_from_train()  # once, not per batch
         for batch in loader:
             inputs, labels = _split_batch(batch)
-            vals = self.eval_batch(inputs, labels)
+            vals = self._eval_batch_nosync(inputs, labels)
             if self._loss is not None and vals:
                 losses.append(vals[0])
         logs = {}
@@ -192,15 +203,20 @@ class Model:
         loader = DataLoader(test_data, batch_size=batch_size,
                             num_workers=num_workers) \
             if isinstance(test_data, Dataset) else test_data
-        outputs = []
+        self._sync_from_train()  # once, not per batch
+        per_output = None
         for batch in loader:
             inputs, _ = _split_batch(batch)
-            out = self.predict_batch(inputs)
-            first = out[0] if isinstance(out, (tuple, list)) else out
-            outputs.append(first.numpy())
+            out = self._predict_batch_nosync(inputs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            if per_output is None:
+                per_output = [[] for _ in outs]
+            for slot, o in zip(per_output, outs):
+                slot.append(o.numpy())
+        per_output = per_output or [[]]
         if stack_outputs:
-            return [np.concatenate(outputs)]
-        return [outputs]
+            return [np.concatenate(slot) for slot in per_output]
+        return per_output
 
     # -- persistence ---------------------------------------------------------
     def save(self, path, training=True):

@@ -1,0 +1,6 @@
+"""paddle.incubate parity: experimental features.
+
+Reference: python/paddle/incubate/ — notably auto-checkpoint
+(incubate/checkpoint/auto_checkpoint.py:598 train_epoch_range).
+"""
+from . import checkpoint  # noqa: F401

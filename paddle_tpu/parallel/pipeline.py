@@ -1,0 +1,157 @@
+"""Pipeline parallelism: GPipe over the ``pp`` mesh axis.
+
+Reference parity: PipelineOptimizer (python/paddle/fluid/optimizer.py:3702)
+splits the program into per-device section programs by device_guard and
+inserts send_v2/recv_v2 at boundaries (:4178); C++ PipelineTrainer +
+SectionWorker run the GPipe schedule — all-forward over microbatches
+(section_worker.cc:61), all-backward (:87), then update (:106).
+
+TPU-first: the pipeline is ONE SPMD program.  Stages are shards of the
+``pp`` mesh axis; the per-stage weights are the same pytree stacked along a
+leading [S, ...] dim sharded P('pp'); microbatch activations flow between
+stages with lax.ppermute inside a lax.scan over schedule ticks.  The
+backward schedule is not hand-written (no section_worker backward loop):
+jax.grad differentiates through scan+ppermute and emits the reverse
+pipeline automatically, and XLA overlaps the permutes with compute.
+"""
+from __future__ import annotations
+
+from typing import Callable, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework import functional as F
+from ..framework.tensor import Tensor
+from .mesh import get_mesh, PP_AXIS, DP_AXIS
+
+
+def pipeline_spmd(stage_fn: Callable, num_stages: int, num_microbatches: int):
+    """Build the per-shard GPipe body (call inside shard_map with axis pp).
+
+    stage_fn(stage_params, x) -> y applies ONE stage's layers.
+    Input x_mb: [M, mb, ...] microbatched activations (same on every stage;
+    only stage 0's injection is used).  Returns [M, mb, ...] outputs valid on
+    the LAST stage (other stages hold garbage — callers psum-select).
+    """
+    S, M = num_stages, num_microbatches
+
+    def run(stage_params, x_mb):
+        idx = lax.axis_index(PP_AXIS)
+        # carry becomes pp-varying after the first ppermute; mark the initial
+        # zeros as varying over pp so scan's carry types line up (VMA rule)
+        zero = lax.pvary(jnp.zeros_like(x_mb[0]), (PP_AXIS,))
+
+        def tick(carry, t):
+            incoming = carry
+            mb_idx = jnp.clip(t, 0, M - 1)
+            inject = x_mb[mb_idx]
+            act_in = jnp.where(idx == 0, inject, incoming)
+            out = stage_fn(stage_params, act_in)
+            shifted = lax.ppermute(
+                out, PP_AXIS, [(i, (i + 1) % S) for i in range(S)])
+            return shifted, out
+
+        _, outs = lax.scan(tick, zero, jnp.arange(M + S - 1))
+        # last stage emits microbatch m at tick m + S - 1
+        final = outs[S - 1:]
+        # broadcast the last stage's result to every stage so downstream
+        # (loss) code is stage-agnostic: mask + psum
+        mine = jnp.where(idx == S - 1, final, jnp.zeros_like(final))
+        return lax.psum(mine, PP_AXIS)
+
+    return run
+
+
+class GPipe:
+    """Pipeline a homogeneous stack of blocks (e.g. transformer layers).
+
+    ≙ PipelineOptimizer + PipelineTrainer as one object. Blocks must share
+    structure (same param pytree); layers are grouped into ``num_stages``
+    stages of equal depth. Embedding/head layers stay replicated outside the
+    pipelined trunk.
+    """
+
+    def __init__(self, blocks: List, num_stages: int = None, mesh=None,
+                 num_microbatches: int = 2):
+        self.mesh = mesh or get_mesh()
+        self.S = num_stages or self.mesh.shape.get(PP_AXIS, 1)
+        assert len(blocks) % self.S == 0, \
+            f"{len(blocks)} blocks not divisible by {self.S} stages"
+        self.blocks = blocks
+        self.M = num_microbatches
+        self.per_stage = len(blocks) // self.S
+
+        # stack params: [n_blocks, ...] -> grouped [S, per_stage, ...]
+        names = None
+        all_params = []
+        for b in blocks:
+            p, _ = F.layer_state(b)
+            if names is None:
+                names = list(p)
+            all_params.append([p[n] for n in names])
+        self.param_names = names
+        self.stacked = {
+            n: jnp.stack([all_params[i][j] for i in range(len(blocks))])
+                 .reshape((self.S, self.per_stage)
+                          + all_params[0][j].shape)
+            for j, n in enumerate(names)}
+        # shard leading stage dim over pp
+        self.stacked = {
+            n: jax.device_put(v, NamedSharding(
+                self.mesh, P(PP_AXIS) if self.mesh.shape.get(PP_AXIS, 1) > 1
+                else P()))
+            for n, v in self.stacked.items()}
+
+    def _stage_fn(self):
+        block0 = self.blocks[0]
+        names = self.param_names
+        per_stage = self.per_stage
+
+        def apply_block(x, block_params):
+            params = dict(zip(names, block_params))
+            return F.functional_call(block0, params, None, (x,),
+                                     training=False)
+
+        def stage(stage_params, x):
+            # inside shard_map the leading [S] dim is sliced to [1]:
+            # stage_params[n]: [1, per_stage, ...]
+            def body(x, i):
+                bp = [stage_params[n][0, i] for n in names]
+                return apply_block(x, bp), None
+            out, _ = lax.scan(body, x, jnp.arange(per_stage))
+            return out
+
+        return stage
+
+    def build_forward(self):
+        """Return pure fn(stacked_params, x [B, ...]) -> y executed as SPMD
+        over the pp (and dp) axes of the mesh."""
+        from jax import shard_map
+        S, M = self.S, self.M
+        body = pipeline_spmd(self._stage_fn(), S, M)
+        mesh = self.mesh
+        dp = mesh.shape.get(DP_AXIS, 1)
+
+        param_specs = {n: P(PP_AXIS) for n in self.param_names}
+
+        def fwd(stacked, x):
+            mb = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+            # shard the per-microbatch batch dim over dp only when divisible
+            bshard = DP_AXIS if (dp > 1 and mb.shape[1] % dp == 0) else None
+            data_spec = P(None, bshard)
+            out_mb = shard_map(
+                body, mesh=mesh,
+                in_specs=(param_specs, data_spec),
+                out_specs=data_spec,
+            )({n: stacked[n] for n in self.param_names}, mb)
+            return out_mb.reshape((-1,) + out_mb.shape[2:])
+
+        return fwd
+
+    def __call__(self, x):
+        fwd = self.build_forward()
+        arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        return Tensor(fwd(self.stacked, arr))

@@ -168,7 +168,7 @@ class Executor:
                    if any(n in op.output_names
                           for op in program.global_block().ops)]
 
-        key = (id(program), program._version, tuple(fetch_names),
+        key = (program._uid, program._version, tuple(fetch_names),
                tuple((n, v.shape, str(v.dtype))
                      for n, v in zip(feed_names, feed_vals)))
         entry = self._cache.get(key) if use_program_cache else None

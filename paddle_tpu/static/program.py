@@ -242,7 +242,13 @@ class Block:
 class Program:
     """framework.py:4055 parity."""
 
+    _UID = [0]
+
     def __init__(self):
+        # monotonically unique id for the Executor's compile cache: id() of
+        # a dead Program can be recycled by the allocator, a _uid cannot
+        Program._UID[0] += 1
+        self._uid = Program._UID[0]
         self.blocks = [Block(self, 0)]
         self._parameters: List[str] = []
         self._version = 0

@@ -72,26 +72,31 @@ class FashionMNIST(MNIST):
 class Cifar10(Dataset):
     """CIFAR-10 from local pickled batches; synthetic fallback."""
 
+    _DIR = "cifar-10-batches-py"
+    _TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
+    _TEST_FILES = ["test_batch"]
+    _LABEL_KEY = b"labels"
+    num_classes = 10
+
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None, synthetic_size=1024):
         self.transform = transform
-        self.num_classes = 10
-        path = data_file or os.path.join(DATA_HOME, "cifar-10-batches-py")
+        path = data_file or os.path.join(DATA_HOME, self._DIR)
         if os.path.isdir(path):
             import pickle
             xs, ys = [], []
-            names = [f"data_batch_{i}" for i in range(1, 6)] \
-                if mode == "train" else ["test_batch"]
+            names = self._TRAIN_FILES if mode == "train" else self._TEST_FILES
             for nm in names:
                 with open(os.path.join(path, nm), "rb") as f:
                     d = pickle.load(f, encoding="bytes")
                 xs.append(d[b"data"])
-                ys.extend(d[b"labels"])
+                ys.extend(d[self._LABEL_KEY])
             self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
             self.labels = np.asarray(ys, dtype="int64")
         else:
             rng = np.random.RandomState(0 if mode == "train" else 1)
-            self.labels = rng.randint(0, 10, synthetic_size).astype("int64")
+            self.labels = rng.randint(0, self.num_classes,
+                                      synthetic_size).astype("int64")
             self.images = (rng.rand(synthetic_size, 3, 32, 32) * 255) \
                 .astype("uint8")
 
@@ -106,9 +111,8 @@ class Cifar10(Dataset):
 
 
 class Cifar100(Cifar10):
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
-        self.num_classes = 100
-        if self.labels.max() < 100:  # synthetic path: spread to 100 classes
-            rng = np.random.RandomState(2)
-            self.labels = rng.randint(0, 100, len(self.labels)).astype("int64")
+    _DIR = "cifar-100-python"
+    _TRAIN_FILES = ["train"]
+    _TEST_FILES = ["test"]
+    _LABEL_KEY = b"fine_labels"
+    num_classes = 100

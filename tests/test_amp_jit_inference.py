@@ -1,0 +1,231 @@
+"""AMP, jit.to_static/save/load, inference Predictor, profiler, autograd,
+auto-checkpoint tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# -- amp ---------------------------------------------------------------------
+
+def test_auto_cast_white_black():
+    with paddle.amp.auto_cast():
+        a = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        mm = paddle.matmul(a, a)
+        sm = paddle.nn.functional.softmax(mm)
+    import jax.numpy as jnp
+    assert mm.dtype == jnp.bfloat16
+    assert sm.dtype == jnp.float32
+
+
+def test_auto_cast_backward_finite():
+    m = nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(parameters=m.parameters(), learning_rate=0.1)
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    with paddle.amp.auto_cast():
+        loss = m(x).sum()
+    loss.backward()
+    assert np.isfinite(m.weight.grad.numpy()).all()
+    opt.step()
+
+
+def test_grad_scaler_skips_on_inf():
+    m = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(parameters=m.parameters(), learning_rate=0.1)
+    w0 = m.weight.numpy().copy()
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                   decr_every_n_nan_or_inf=1)
+    x = paddle.to_tensor(np.array([[np.inf, 1.0]], dtype="float32"))
+    loss = m(x).sum()
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    np.testing.assert_allclose(m.weight.numpy(), w0)  # step skipped
+    assert scaler.get_loss_scaling() < 4.0  # scale decreased
+
+
+def test_custom_lists():
+    with paddle.amp.auto_cast(custom_black_list=["matmul_v2"]):
+        a = paddle.to_tensor(np.random.randn(2, 2).astype("float32"))
+        out = paddle.matmul(a, a)
+    import jax.numpy as jnp
+    assert out.dtype == jnp.float32
+
+
+# -- jit ---------------------------------------------------------------------
+
+def test_to_static_function_and_grad():
+    calls = []
+
+    @paddle.jit.to_static
+    def f(x):
+        calls.append(1)
+        return paddle.nn.functional.relu(x) * 3
+
+    x = paddle.to_tensor(np.array([-2.0, 5.0], "float32"),
+                         stop_gradient=False)
+    y = f(x)
+    np.testing.assert_allclose(y.numpy(), [0.0, 15.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 3.0])
+    n_traces = len(calls)
+    f(x)  # same signature: cached, no retrace
+    assert len(calls) == n_traces
+
+
+def test_to_static_layer_params_grad():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        @paddle.jit.to_static
+        def forward(self, x):
+            return self.fc(x).sum()
+
+    m = M()
+    x = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+    m(x).backward()
+    assert m.fc.weight.grad is not None
+    # matches eager
+    ref = nn.Linear(4, 2)
+    ref.set_state_dict(m.fc.state_dict())
+    np.testing.assert_allclose(float(m(x)), float(ref(x).sum()), rtol=1e-5)
+
+
+def test_to_static_tensor_kwargs_not_stale():
+    @paddle.jit.to_static
+    def f(x, scale=None):
+        return x * scale
+
+    x = paddle.to_tensor(np.ones(3, "float32"))
+    a = f(x, scale=paddle.to_tensor(np.float32(2.0)))
+    b = f(x, scale=paddle.to_tensor(np.float32(5.0)))
+    np.testing.assert_allclose(a.numpy(), 2 * np.ones(3))
+    np.testing.assert_allclose(b.numpy(), 5 * np.ones(3))
+
+
+def test_to_static_retraces_on_new_shape():
+    calls = []
+
+    @paddle.jit.to_static
+    def f(x):
+        calls.append(1)
+        return x + 1
+
+    f(paddle.to_tensor(np.zeros((2, 2), "float32")))
+    f(paddle.to_tensor(np.zeros((3, 2), "float32")))
+    assert len(calls) == 2
+
+
+def test_jit_save_load(tmp_path):
+    from paddle_tpu.static import InputSpec
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 4])])
+    assert os.path.exists(path + ".pdmodel")
+    assert os.path.exists(path + ".pdiparams")
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(np.random.randn(1, 4).astype("float32"))
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), rtol=1e-5)
+
+
+# -- inference ---------------------------------------------------------------
+
+def test_inference_predictor(tmp_path):
+    paddle.enable_static()
+    try:
+        import paddle_tpu.static as static
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8], "float32")
+            out = static.nn.fc(x, 3)
+        exe = static.Executor()
+        exe.run(startup)
+        xd = np.random.randn(4, 8).astype("float32")
+        ref = exe.run(main, feed={"x": xd}, fetch_list=[out])[0]
+        static.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                    main_program=main)
+    finally:
+        paddle.disable_static()
+
+    from paddle_tpu import inference
+    config = inference.Config(str(tmp_path))
+    predictor = inference.create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(xd)
+    predictor.run()
+    got = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+# -- profiler ----------------------------------------------------------------
+
+def test_record_event_summary(capsys):
+    from paddle_tpu import profiler
+    profiler.start_profiler()
+    with profiler.RecordEvent("my_op"):
+        _ = paddle.to_tensor(np.zeros(4)) + 1
+    profiler.stop_profiler()
+    out = capsys.readouterr().out
+    assert "my_op" in out
+
+
+def test_profiler_chrome_trace(tmp_path):
+    from paddle_tpu import profiler
+    p = profiler.Profiler(
+        timer_only=True,
+        on_trace_ready=profiler.export_chrome_tracing(str(tmp_path)))
+    p.start()
+    with profiler.RecordEvent("step"):
+        pass
+    p.stop()
+    import json
+    with open(tmp_path / "paddle_tpu_trace.json") as f:
+        trace = json.load(f)
+    assert any(e["name"] == "step" for e in trace["traceEvents"])
+
+
+# -- autograd ----------------------------------------------------------------
+
+def test_pylayer_custom_backward():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 5  # deliberately not the true grad
+
+    x = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), 2 * np.ones(3))
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 5 * np.ones(3))
+
+
+# -- auto checkpoint ---------------------------------------------------------
+
+def test_train_epoch_range_resumes(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECKPOINT_DIR", str(tmp_path))
+    from paddle_tpu.incubate.checkpoint import train_epoch_range
+
+    m = nn.Linear(2, 2)
+    done = []
+    for epoch in train_epoch_range(3, model=m):
+        done.append(epoch)
+    assert done == [0, 1, 2]
+    # "restart": all epochs already checkpointed -> nothing to do
+    done2 = list(train_epoch_range(3, model=m))
+    assert done2 == []
+    # extend: resumes at 3
+    done3 = list(train_epoch_range(5, model=m))
+    assert done3 == [3, 4]

@@ -79,6 +79,45 @@ def test_early_stopping_stops():
     assert model.stop_training
 
 
+def test_model_evaluate_without_loss():
+    ds = _cls_dataset(32)
+    model = Model(MLP())
+    model.prepare(metrics=Accuracy())
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert "eval_acc" in logs and "eval_loss" not in logs
+
+
+def test_model_predict_multi_output():
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(16, 4)
+            self.b = nn.Linear(16, 2)
+
+        def forward(self, x):
+            return self.a(x), self.b(x)
+
+    ds = _cls_dataset(32)
+    model = Model(TwoHead())
+    model.prepare()
+    outs = model.predict(ds, batch_size=16, stack_outputs=True, verbose=0)
+    assert len(outs) == 2
+    assert outs[0].shape == (32, 4)
+    assert outs[1].shape == (32, 2)
+
+
+def test_early_stopping_default_monitor():
+    from paddle_tpu.hapi.callbacks import EarlyStopping
+    es = EarlyStopping(monitor="loss", mode="min", patience=0)
+
+    class FakeModel:
+        stop_training = False
+    es.set_model(FakeModel())
+    es.on_eval_end({"eval_loss": 1.0})
+    es.on_eval_end({"eval_loss": 2.0})  # worse -> patience 0 -> stop
+    assert es.model.stop_training
+
+
 def test_metrics():
     acc = Accuracy()
     pred = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])
