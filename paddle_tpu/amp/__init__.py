@@ -124,7 +124,14 @@ class GradScaler:
         self._update_scale()
 
     def minimize(self, optimizer, scaled_loss):
-        scaled_loss.backward()
+        # Reference contract (loss_scaler.py docstring): the caller runs
+        # scaled.backward() first, then minimize().  Only trigger backward
+        # here if it hasn't run on THIS loss yet (graph live, no prior
+        # backward) — a retain_graph backward must not be re-run, which
+        # would double every grad; a fresh un-backwarded loss still works
+        # even when grads from earlier micro-batches are being accumulated.
+        if scaled_loss._node is not None and not scaled_loss._bwd_done:
+            scaled_loss.backward()
         self.step(optimizer)
 
     def update(self):
@@ -167,9 +174,14 @@ AmpScaler = GradScaler
 
 def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16",
              master_weight=None, save_dtype=None):
-    """paddle.amp.decorate parity. O2 on TPU: cast model params to bf16
-    (master fp32 copies live in the optimizer accumulators, which are always
-    fp32 in this framework)."""
+    """paddle.amp.decorate parity (contrib/mixed_precision/decorator.py:36).
+
+    O2 on TPU: cast model params to bf16 for storage/compute; the optimizer
+    keeps true fp32 master weights (Optimizer._trees seeds an ``@master``
+    accumulator the first time it sees a low-precision param, updates the
+    master in fp32, and casts back to the stored dtype) — matching the
+    reference multi_precision path, so sub-ulp updates are not lost.
+    ``master_weight=False`` opts out."""
     if level == "O2" and models is not None:
         targets = models if isinstance(models, (list, tuple)) else [models]
         for m in targets:
@@ -178,6 +190,11 @@ def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16",
                     p._value = p._value.astype(
                         jnp.bfloat16 if dtype in ("bfloat16", "bf16")
                         else jnp.float16)
+    if optimizers is not None:
+        opts = optimizers if isinstance(optimizers, (list, tuple)) \
+            else [optimizers]
+        for o in opts:
+            o._use_master_weights = master_weight
     if optimizers is None:
         return models
     return models, optimizers

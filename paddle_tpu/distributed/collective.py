@@ -103,24 +103,56 @@ def _rewrap(x, out):
     return Tensor(out)
 
 
+def _is_subgroup(g: Group) -> bool:
+    """True if g.ranks is a proper subset of its mesh axis."""
+    if g.ranks is None:
+        return False
+    axis_size = get_mesh().shape.get(g.axis, 1)
+    return len(g.ranks) < axis_size
+
+
+def _member_mask(g: Group):
+    """Bool scalar (traced): is this rank a member of the group?"""
+    idx = lax.axis_index(g.axis)
+    return jnp.isin(idx, jnp.asarray(g.ranks, jnp.int32))
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
                use_calc_stream=False):
-    """c_allreduce_{sum,max,min,prod} (collective/c_allreduce_op.h)."""
+    """c_allreduce_{sum,max,min,prod} (collective/c_allreduce_op.h).
+
+    Subgroups (new_group(ranks=...) covering a proper subset of the axis)
+    are honored by masking non-members with the reduction identity before
+    the axis-wide collective — members get the ring-scoped result the
+    reference's per-ring c_allreduce computes; values on non-member ranks
+    are undefined there and here come out as the subgroup result.
+    """
     g = group or _default_group
     x = _unwrap(tensor)
     if _axis_bound(g.axis):
+        sub = _is_subgroup(g)
+        if sub:
+            member = _member_mask(g)
         if op == ReduceOp.SUM:
-            out = lax.psum(x, g.axis)
+            out = lax.psum(jnp.where(member, x, 0) if sub else x, g.axis)
         elif op == ReduceOp.MAX:
-            out = lax.pmax(x, g.axis)
+            out = lax.pmax(
+                jnp.where(member, x, -jnp.inf).astype(x.dtype) if sub else x,
+                g.axis)
         elif op == ReduceOp.MIN:
-            out = lax.pmin(x, g.axis)
+            out = lax.pmin(
+                jnp.where(member, x, jnp.inf).astype(x.dtype) if sub else x,
+                g.axis)
         elif op == ReduceOp.AVG:
-            out = lax.pmean(x, g.axis)
+            if sub:
+                out = lax.psum(jnp.where(member, x, 0), g.axis) / len(g.ranks)
+            else:
+                out = lax.pmean(x, g.axis)
         elif op == ReduceOp.PROD:
             # no native product-reduce in XLA collectives; gather then
             # multiply (log/exp would NaN on non-positive inputs)
-            out = jnp.prod(lax.all_gather(x, g.axis), axis=0)
+            xg = jnp.where(member, x, jnp.ones_like(x)) if sub else x
+            out = jnp.prod(lax.all_gather(xg, g.axis), axis=0)
         else:
             raise ValueError(f"unknown ReduceOp {op}")
     else:
@@ -139,6 +171,11 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     g = group or _default_group
     x = _unwrap(tensor)
     if _axis_bound(g.axis):
+        if _is_subgroup(g):
+            raise NotImplementedError(
+                "all_gather over a proper subgroup of a mesh axis is not "
+                "supported; create the group over a dedicated mesh axis "
+                "(new_group(axis=...)) so the collective is ring-scoped")
         gathered = lax.all_gather(x, g.axis)  # [n, ...]
         n = gathered.shape[0]
         if isinstance(tensor_list, list):
@@ -159,6 +196,10 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
     else:
         x = _unwrap(src)
     if _axis_bound(g.axis):
+        if _is_subgroup(g):
+            raise NotImplementedError(
+                "reduce_scatter over a proper subgroup of a mesh axis is not "
+                "supported; use a dedicated mesh axis for the group")
         out = lax.psum_scatter(x, g.axis, scatter_dimension=0, tiled=True)
     else:
         out = x
@@ -173,8 +214,10 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     x = _unwrap(tensor)
     if _axis_bound(g.axis):
         idx = lax.axis_index(g.axis)
-        src_rank = g.get_group_rank(src) if g.ranks else src
-        masked = jnp.where(idx == src_rank, x, jnp.zeros_like(x))
+        # src is the GLOBAL rank (= axis index), for full-axis groups and
+        # subgroups alike; only the src rank contributes to the psum, so a
+        # subgroup broadcast is naturally ring-scoped.
+        masked = jnp.where(idx == src, x, jnp.zeros_like(x))
         out = lax.psum(masked, g.axis)
     else:
         out = x

@@ -150,6 +150,7 @@ def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None,
             n.release()
     if not retain_graph:
         root._node = None
+    root._bwd_done = True
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,

@@ -30,7 +30,7 @@ def _auto_name(prefix="tmp"):
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "persistable", "name", "grad",
                  "_node", "_out_index", "_retain_grads", "_hooks", "is_leaf",
-                 "__weakref__")
+                 "_bwd_done", "__weakref__")
 
     def __init__(self, value, stop_gradient=True, name=None, persistable=False):
         if isinstance(value, Tensor):
@@ -47,6 +47,7 @@ class Tensor:
         self._retain_grads = False
         self._hooks = []
         self.is_leaf = True
+        self._bwd_done = False
 
     # -- structural ----------------------------------------------------------
     @property

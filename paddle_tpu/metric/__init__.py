@@ -138,4 +138,5 @@ class Auc(Metric):
         fp = np.cumsum(neg)
         tpr = np.concatenate([[0.0], tp / tot_pos])
         fpr = np.concatenate([[0.0], fp / tot_neg])
-        return float(np.trapezoid(tpr, fpr))
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2
+        return float(trapezoid(tpr, fpr))

@@ -153,6 +153,27 @@ def _rmsprop_rule(params, grads, mean_sq, moment, lr, rho, eps, momentum):
 
 
 @jax.jit
+def _rmsprop_centered_rule(params, grads, mean_sq, mean_grad, moment,
+                           lr, rho, eps, momentum):
+    """Centered variant (rmsprop_op.h centered path): variance estimate is
+    E[g^2] - E[g]^2."""
+    def upd(p, g, ms, mg, mom):
+        g = g.astype(jnp.float32)
+        ms_new = rho * ms + (1 - rho) * jnp.square(g)
+        mg_new = rho * mg + (1 - rho) * g
+        denom = jnp.sqrt(ms_new - jnp.square(mg_new) + eps)
+        mom_new = momentum * mom + lr * g / denom
+        return ((p.astype(jnp.float32) - mom_new).astype(p.dtype),
+                ms_new, mg_new, mom_new)
+    flat = jax.tree_util.tree_map(upd, params, grads, mean_sq, mean_grad,
+                                  moment)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()},
+            {k: x[3] for k, x in flat.items()})
+
+
+@jax.jit
 def _adagrad_rule(params, grads, moment, lr, eps):
     def upd(p, g, m_):
         g = g.astype(jnp.float32)
@@ -215,6 +236,11 @@ class Optimizer:
         self._accumulators: Dict[str, Dict[str, jnp.ndarray]] = {}
         self._step_count = 0
         self.helper = None
+        # fp32 master weights for low-precision params (reference
+        # multi_precision path, operators/optimizers/adam_op.h master_param).
+        # None = auto: keep masters whenever a param is bf16/fp16 so that
+        # updates smaller than one low-precision ulp are never lost.
+        self._use_master_weights: Optional[bool] = None
 
     # -- lr ------------------------------------------------------------------
     def get_lr(self):
@@ -249,20 +275,42 @@ class Optimizer:
                 if p.name not in acc:
                     acc[p.name] = jnp.zeros(p._value.shape, jnp.float32)
 
+    def _needs_master(self, p):
+        if self._use_master_weights is False:
+            return False
+        dt = p._value.dtype
+        # only sub-fp32 floats (bf16/fp16) get fp32 masters; fp32/fp64
+        # params are already at full update precision
+        return jnp.issubdtype(dt, jnp.floating) and jnp.finfo(dt).bits < 32
+
     def _trees(self, pg):
-        params = {p.name: p._value for p, _ in pg}
+        masters = self._accumulators.setdefault("@master", {})
+        params = {}
+        for p, _ in pg:
+            if self._needs_master(p):
+                if p.name not in masters:
+                    masters[p.name] = p._value.astype(jnp.float32)
+                params[p.name] = masters[p.name]
+            else:
+                params[p.name] = p._value
         grads = {}
         for p, g in pg:
             gv = g._value
             if self._weight_decay and not self._decoupled:
                 # coupled L2: grad += wd * param (fluid regularizer append)
-                gv = gv + self._weight_decay * p._value.astype(gv.dtype)
+                gv = gv + self._weight_decay * params[p.name].astype(gv.dtype)
             grads[p.name] = gv
         return params, grads
 
     def _writeback(self, pg, new_params):
+        masters = self._accumulators.get("@master", {})
         for p, _ in pg:
-            p._value = new_params[p.name]
+            new = new_params[p.name]
+            if p.name in masters:
+                masters[p.name] = new  # fp32 master updated first
+                p._value = new.astype(p._value.dtype)
+            else:
+                p._value = new
 
     def step(self):
         pg = self._collect()
@@ -395,11 +443,17 @@ class Optimizer:
                 if key in state:
                     v = state[key]
                     acc[pname] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
-        # also lazily import unknown accumulators
+        # also lazily import unknown accumulators ("@master" and any
+        # extra-state accumulators like RMSProp's centered "mean_grad" are
+        # always importable, even into a fresh optimizer whose _state_names
+        # don't list them — dropping masters on restore would re-seed them
+        # from rounded bf16 params and lose all sub-ulp progress)
+        known = set(self._state_names) | set(self._accumulators) | \
+            {"@master", "mean_grad"}
         for key, v in state.items():
             if key in ("@step", "LR_Scheduler"):
                 continue
-            for name in self._state_names:
+            for name in known:
                 if key.endswith("_" + name):
                     pname = key[: -(len(name) + 1)]
                     self._accumulators.setdefault(name, {})[pname] = \
@@ -678,16 +732,28 @@ class RMSProp(Optimizer):
                  weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._rho, self._eps, self._momentum = rho, epsilon, momentum
+        self._centered = bool(centered)
 
     def _apply(self, pg):
-        self._ensure_state(["mean_square", "moment"], pg)
+        names = ["mean_square", "moment"] + (
+            ["mean_grad"] if self._centered else [])
+        self._ensure_state(names, pg)
         params, grads = self._trees(pg)
         ms = {p.name: self._accumulators["mean_square"][p.name] for p, _ in pg}
         mom = {p.name: self._accumulators["moment"][p.name] for p, _ in pg}
-        new_p, new_ms, new_mom = _rmsprop_rule(
-            params, grads, ms, mom, jnp.float32(self.get_lr()),
-            jnp.float32(self._rho), jnp.float32(self._eps),
-            jnp.float32(self._momentum))
+        if self._centered:
+            mg = {p.name: self._accumulators["mean_grad"][p.name]
+                  for p, _ in pg}
+            new_p, new_ms, new_mg, new_mom = _rmsprop_centered_rule(
+                params, grads, ms, mg, mom, jnp.float32(self.get_lr()),
+                jnp.float32(self._rho), jnp.float32(self._eps),
+                jnp.float32(self._momentum))
+            self._accumulators["mean_grad"].update(new_mg)
+        else:
+            new_p, new_ms, new_mom = _rmsprop_rule(
+                params, grads, ms, mom, jnp.float32(self.get_lr()),
+                jnp.float32(self._rho), jnp.float32(self._eps),
+                jnp.float32(self._momentum))
         self._writeback(pg, new_p)
         self._accumulators["mean_square"].update(new_ms)
         self._accumulators["moment"].update(new_mom)

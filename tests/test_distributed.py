@@ -214,3 +214,33 @@ def test_data_parallel_wrapper():
     scaled.backward()
     dp.apply_collective_grads()  # 1-proc: no-op
     assert m.weight.grad is not None
+
+
+def test_subgroup_all_reduce(world):
+    """new_group(ranks=subset): collectives are scoped to the subgroup
+    (ADVICE r1: previously reduced over the whole axis)."""
+    g = dist.new_group(ranks=[2, 3, 5])
+    x = jnp.arange(8.0)
+    out = _spmd(lambda v: dist.all_reduce(Tensor(v), group=g)._value,
+                world)(x)
+    # members' values 2+3+5 = 10 everywhere (non-members are undefined in the
+    # reference; here they see the subgroup sum too)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 10.0))
+
+    out = _spmd(lambda v: dist.all_reduce(Tensor(v), group=g,
+                                          op=dist.ReduceOp.MAX)._value,
+                world)(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 5.0))
+
+    out = _spmd(lambda v: dist.all_reduce(Tensor(v), group=g,
+                                          op=dist.ReduceOp.AVG)._value,
+                world)(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 10.0 / 3),
+                               rtol=1e-6)
+
+
+def test_subgroup_all_gather_raises(world):
+    g = dist.new_group(ranks=[0, 1])
+    with pytest.raises(NotImplementedError):
+        _spmd(lambda v: dist.all_gather([], Tensor(v), group=g)._value,
+              world)(jnp.arange(8.0))

@@ -127,3 +127,46 @@ def test_lr_schedulers():
         noam.step()
         vals.append(noam())
     assert max(vals[:11]) == vals[9]  # peak at warmup boundary
+
+
+def test_bf16_master_weights():
+    """fp32 masters survive sub-ulp bf16 updates (ADVICE r1: O2 decorate
+    previously lost any update smaller than one bf16 ulp)."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.tensor import Tensor
+
+    p = Tensor(jnp.ones((4,), jnp.bfloat16))
+    p.stop_gradient = False
+    p.name = "p0"
+    opt = paddle.optimizer.SGD(learning_rate=1e-5, parameters=[p])
+    # 1e-5 << bf16 ulp at 1.0 (~0.0078): without masters, 100 steps are
+    # all rounded away; with masters the fp32 copy accumulates -1e-3.
+    for _ in range(100):
+        p.grad = Tensor(jnp.ones((4,), jnp.float32))
+        opt.step()
+    master = opt._accumulators["@master"]["p0"]
+    np.testing.assert_allclose(np.asarray(master), np.full(4, 1.0 - 1e-3),
+                               rtol=1e-5)
+
+
+def test_rmsprop_centered():
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.tensor import Tensor
+
+    p = Tensor(jnp.ones((3,), jnp.float32))
+    p.stop_gradient = False
+    p.name = "pc"
+    opt = paddle.optimizer.RMSProp(learning_rate=0.1, rho=0.9, epsilon=1e-6,
+                                   momentum=0.0, centered=True,
+                                   parameters=[p])
+    g = np.array([1.0, -2.0, 0.5], np.float32)
+    p.grad = Tensor(jnp.asarray(g))
+    opt.step()
+    # manual centered rmsprop step 1
+    ms = 0.1 * g ** 2
+    mg = 0.1 * g
+    expect = 1.0 - 0.1 * g / np.sqrt(ms - mg ** 2 + 1e-6)
+    np.testing.assert_allclose(np.asarray(p._value), expect, rtol=1e-5)
+    assert "mean_grad" in opt._accumulators
