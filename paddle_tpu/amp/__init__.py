@@ -25,7 +25,8 @@ from ..framework.tensor import Tensor
 WHITE_LIST = {"matmul_v2", "mul", "conv2d", "conv2d_nobias",
               "conv2d_transpose", "conv2d_transpose_nobias", "einsum",
               "scaled_dot_product_attention",
-              "scaled_dot_product_attention_mask", "bilinear_nobias"}
+              "scaled_dot_product_attention_mask",
+              "flash_attention", "flash_attention_bias", "bilinear_nobias"}
 BLACK_LIST = {"exp", "log", "softmax", "log_softmax",
               "softmax_with_cross_entropy", "softmax_with_cross_entropy_soft",
               "layer_norm", "layer_norm_nogb", "batch_norm_train",

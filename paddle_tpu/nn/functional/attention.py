@@ -34,12 +34,16 @@ def _sdpa_fn(q, k, v, scale=None, causal=False):
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
-def _sdpa_mask_fn(q, k, v, mask, scale=None):
+def _sdpa_mask_fn(q, k, v, mask, scale=None, causal=False):
     hd = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(hd)
     logits = jnp.einsum("bnsh,bnth->bnst", q, k,
                         preferred_element_type=jnp.float32) * s
     logits = logits + mask.astype(logits.dtype)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, jnp.asarray(-1e30, logits.dtype))
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bnst,bnth->bnsh", probs, v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
@@ -49,7 +53,7 @@ _sdpa = Primitive("scaled_dot_product_attention", _sdpa_fn)
 _sdpa_mask = Primitive("scaled_dot_product_attention_mask", _sdpa_mask_fn)
 
 
-def _use_pallas(q):
+def _use_pallas(q, k, mask=None, causal=False):
     if not flag("use_pallas_kernels"):
         return False
     try:
@@ -58,9 +62,19 @@ def _use_pallas(q):
         return False
     if platform not in ("tpu", "axon"):
         return False
-    arr = unwrap(q)
-    # pallas kernel wants seq multiple of block and head_dim >= 128-friendly
-    return arr.ndim == 4 and arr.shape[-1] % 128 == 0 and arr.shape[-2] % 128 == 0
+    # the flash kernel's bias input is non-differentiable; a trainable mask
+    # (learned relative-position bias) must take the XLA path
+    if isinstance(mask, Tensor) and not mask.stop_gradient:
+        return False
+    from ...ops.pallas import supports
+    from ...ops.pallas.flash_attention import MIN_SEQ_FOR_FLASH
+    kshape = unwrap(k).shape
+    # short sequences are dispatch/bandwidth-bound: the one-expression XLA
+    # path wins there (measured crossover at Sk=1024 on v5e)
+    if len(kshape) != 4 or kshape[-2] < MIN_SEQ_FOR_FLASH:
+        return False
+    mk = unwrap(mask).shape if mask is not None else None
+    return supports(unwrap(q).shape, kshape, mk, causal=causal)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -72,11 +86,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     q = transpose(query, [0, 2, 1, 3])
     k = transpose(key, [0, 2, 1, 3])
     v = transpose(value, [0, 2, 1, 3])
-    if attn_mask is None and _use_pallas(q):
+    if _use_pallas(q, k, attn_mask, causal=bool(is_causal)):
         from ...ops.pallas import flash_attention
-        out = flash_attention(q, k, v, causal=is_causal)
+        out = flash_attention(q, k, v, bias=attn_mask, causal=is_causal)
     elif attn_mask is not None:
-        out = _sdpa_mask(q, k, v, attn_mask)
+        out = _sdpa_mask(q, k, v, attn_mask, causal=bool(is_causal))
     else:
         out = _sdpa(q, k, v, causal=bool(is_causal))
     if dropout_p and training:
@@ -87,9 +101,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
 def attention_bnsh(q, k, v, attn_mask=None, is_causal=False):
     """(B, N, S, H) layout fast path used by our MultiHeadAttention layer."""
-    if attn_mask is None and _use_pallas(q):
+    if _use_pallas(q, k, attn_mask, causal=bool(is_causal)):
         from ...ops.pallas import flash_attention
-        return flash_attention(q, k, v, causal=is_causal)
+        return flash_attention(q, k, v, bias=attn_mask, causal=is_causal)
     if attn_mask is not None:
-        return _sdpa_mask(q, k, v, attn_mask)
+        return _sdpa_mask(q, k, v, attn_mask, causal=bool(is_causal))
     return _sdpa(q, k, v, causal=bool(is_causal))

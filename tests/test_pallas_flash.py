@@ -1,0 +1,143 @@
+"""Pallas flash-attention kernel vs the plain XLA softmax-attention path.
+
+Runs in interpret mode on the CPU backend (conftest). Mirrors the grad-check
+style of the reference op tests (op_test.py check_grad) but compares against
+the framework's own XLA attention instead of numeric differentiation — the
+two paths must agree to float tolerance in both passes.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.flash_attention import (
+    flash_attention_fn, supports, _pick_block)
+from paddle_tpu.nn.functional.attention import _sdpa_fn, _sdpa_mask_fn
+
+rng = np.random.RandomState(7)
+
+
+def _qkv(B=2, N=2, S=256, H=64, dtype=jnp.float32):
+    mk = lambda: jnp.asarray(rng.randn(B, N, S, H), dtype)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("H", [64, 128])
+def test_forward_matches_xla(causal, H):
+    q, k, v = _qkv(H=H)
+    out = flash_attention_fn(q, k, v, causal=causal)
+    ref = _sdpa_fn(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_xla(causal):
+    q, k, v = _qkv(S=256)
+    w = jnp.asarray(rng.randn(*q.shape), jnp.float32)
+
+    gf = jax.grad(lambda *a: (flash_attention_fn(*a, causal=causal) * w)
+                  .sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: (_sdpa_fn(*a, causal=causal) * w)
+                  .sum(), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=1e-4, err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("mask_shape", [(2, 1, 1, 256), (2, 2, 256, 256),
+                                        (1, 1, 256, 256)])
+def test_bias_variants(mask_shape):
+    q, k, v = _qkv(S=256)
+    mask = jnp.asarray(
+        np.where(rng.rand(*mask_shape) < 0.2, -1e9, 0.0), jnp.float32)
+    out = flash_attention_fn(q, k, v, bias=mask)
+    ref = _sdpa_mask_fn(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_bias_grad_matches():
+    q, k, v = _qkv(S=128)
+    mask = jnp.asarray(rng.randn(2, 2, 128, 128), jnp.float32)
+    gf = jax.grad(lambda q: (flash_attention_fn(q, k, v, bias=mask) ** 2)
+                  .sum())(q)
+    gr = jax.grad(lambda q: (_sdpa_mask_fn(q, k, v, mask) ** 2).sum())(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                               atol=5e-4, rtol=1e-4)
+
+
+def test_cross_attention_lengths():
+    q = jnp.asarray(rng.randn(2, 2, 128, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 2, 384, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 2, 384, 64), jnp.float32)
+    out = flash_attention_fn(q, k, v)
+    ref = _sdpa_fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_causal_cross_lengths_bottom_right():
+    """Sq < Sk causal must be bottom-right aligned like _sdpa_fn's
+    tril(k=Sk-Sq) (chunked-decode shape)."""
+    q = jnp.asarray(rng.randn(1, 2, 128, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 512, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 512, 64), jnp.float32)
+    out = flash_attention_fn(q, k, v, causal=True)
+    ref = _sdpa_fn(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-5)
+    gf = jax.grad(lambda *a: (flash_attention_fn(*a, causal=True) ** 2)
+                  .sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: (_sdpa_fn(*a, causal=True) ** 2)
+                  .sum(), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=1e-4, err_msg=f"d{name}")
+    with pytest.raises(ValueError):
+        flash_attention_fn(k, q, q, causal=True)  # Sq > Sk rejected
+
+
+def test_mask_plus_causal_consistent():
+    """attn_mask + is_causal must mean the same thing on both paths."""
+    from paddle_tpu.nn.functional.attention import _sdpa_mask_fn as mf
+    q, k, v = _qkv(S=256)
+    mask = jnp.asarray(
+        np.where(rng.rand(2, 1, 1, 256) < 0.2, -1e9, 0.0), jnp.float32)
+    out = flash_attention_fn(q, k, v, bias=mask, causal=True)
+    ref = mf(q, k, v, mask, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_tensor_primitive_tape():
+    """flash_attention through the Primitive tape (eager Tensor autograd)."""
+    from paddle_tpu.ops.pallas import flash_attention
+    from paddle_tpu.framework.tensor import Tensor
+
+    qa, ka, va = _qkv(S=128)
+    q = Tensor(qa, stop_gradient=False)
+    k = Tensor(ka, stop_gradient=False)
+    v = Tensor(va, stop_gradient=False)
+    out = flash_attention(q, k, v, causal=True)
+    loss = (out * out).sum()
+    loss.backward()
+    gr = jax.grad(lambda q: (_sdpa_fn(q, ka, va, causal=True) ** 2).sum())(qa)
+    np.testing.assert_allclose(np.asarray(q.grad._value), np.asarray(gr),
+                               atol=5e-4, rtol=1e-4)
+
+
+def test_supports_gate():
+    assert supports((2, 4, 256, 64), (2, 4, 256, 64))
+    assert not supports((2, 4, 200, 64), (2, 4, 256, 64))   # seq % 128
+    assert not supports((2, 4, 256, 80), (2, 4, 256, 80))   # head_dim
+    assert supports((2, 4, 256, 64), (2, 4, 256, 64), (2, 1, 1, 256))
+    assert not supports((2, 4, 256, 64), (2, 4, 256, 64), (3, 1, 1, 256))
+    assert supports((2, 4, 128, 64), (2, 4, 256, 64), causal=True)
+    assert not supports((2, 4, 256, 64), (2, 4, 128, 64), causal=True)
+    assert _pick_block(640, 512) == 128
+    assert _pick_block(1024, 512) == 512
+    assert _pick_block(4096, 1024) == 1024
+    assert _pick_block(128, 512) == 128
+    assert _pick_block(384, 512) == 384
