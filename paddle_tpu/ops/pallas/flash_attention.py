@@ -425,7 +425,9 @@ def flash_attention_fn(q, k, v, bias=None, *, causal=False, scale=None,
     bq = _pick_block(Sq, block_q)
     bk = _pick_block(Sk, block_k)
     if causal and bq != bk:
-        bq = bk = _pick_block(min(Sq, Sk), min(bq, bk))
+        # equal blocks that divide BOTH lengths (a divisor of gcd), so no
+        # trailing q/k block is dropped by the grid floor-division
+        bq = bk = _pick_block(math.gcd(Sq, Sk), min(bq, bk))
     q3 = q.reshape(B * N, Sq, H)
     k3 = k.reshape(B * N, Sk, H)
     v3 = v.reshape(B * N, Sk, H)

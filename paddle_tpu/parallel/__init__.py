@@ -25,5 +25,5 @@ from .api import (  # noqa: F401
     replicated_sharding, shard_tensor,
 )
 from .train_step import TrainStep, EvalStep  # noqa: F401
-from .pipeline import GPipe  # noqa: F401
+from .pipeline import GPipe, PipelineModule  # noqa: F401
 from .sp import ring_attention  # noqa: F401

@@ -77,34 +77,92 @@ class TrainStep:
         self._compiled = None
         self._donate = donate
 
+        from .pipeline import PipelineModule
+        self._pipe = layer if isinstance(layer, PipelineModule) else None
+        if self._pipe is not None:
+            # microbatching IS the gradient accumulation in a pipeline:
+            # strategy accumulate_steps sets the GPipe microbatch count
+            if self.accumulate_steps > 1:
+                self._pipe.M = self.accumulate_steps
+                self.accumulate_steps = 1
+            self._pipe_fwd = self._pipe.build_body(remat=self.remat)
+
     # -- state ---------------------------------------------------------------
     def _param_sharding_tree(self, params):
-        shardings = named_shardings(self.layer, self.mesh)
+        if self._pipe is not None:
+            from .mesh import PP_AXIS
+            shardings = {}
+            for tag, layer in (("embed", self._pipe.embed),
+                               ("head", self._pipe.head)):
+                if layer is None:
+                    continue
+                sub = named_shardings(layer, self.mesh)
+                shardings.update({f"{tag}::{n}": s for n, s in sub.items()})
+            pp_live = self.mesh.shape.get(PP_AXIS, 1) > 1
+            for n in params:
+                if n.startswith("pipe::"):
+                    shardings[n] = NamedSharding(
+                        self.mesh, P(PP_AXIS) if pp_live else P())
+        else:
+            shardings = named_shardings(self.layer, self.mesh)
         return {n: shardings.get(n, NamedSharding(self.mesh, P()))
                 for n in params}
+
+    def _zero_spec(self, base_spec, shape):
+        """Add a dp shard onto the first replicated, dp-divisible dim of a
+        per-param array (the ZeRO layout rule)."""
+        spec = list(base_spec) + [None] * (len(shape) - len(base_spec))
+
+        def has_dp(entry):
+            return entry == DP_AXIS or (
+                isinstance(entry, (tuple, list)) and DP_AXIS in entry)
+        if any(has_dp(e) for e in spec):
+            return P(*spec)  # already ZeRO-laid-out (idempotent)
+        if self.mesh.shape.get(DP_AXIS, 1) > 1:
+            for d in range(len(shape)):
+                if spec[d] is None and shape[d] % self.mesh.shape[DP_AXIS] == 0:
+                    spec[d] = DP_AXIS
+                    break
+        return P(*spec)
 
     def _opt_sharding(self, param_shardings, opt_state):
         """Optimizer accumulators inherit their param's spec; with zero>=1 the
         first fully-replicated dim additionally shards over dp (ZeRO-1:
         sharding_optimizer.py:33 equivalent, but as a layout annotation)."""
-        dp = self.mesh.shape.get(DP_AXIS, 1)
         out = {}
         for sname, acc in opt_state.items():
             out[sname] = {}
             for pname, arr in acc.items():
-                spec = list(param_shardings[pname].spec)
-                spec += [None] * (arr.ndim - len(spec))
-                if self.zero >= 1 and dp > 1:
-                    for d in range(arr.ndim):
-                        if spec[d] is None and arr.shape[d] % dp == 0:
-                            spec[d] = DP_AXIS
-                            break
-                out[sname][pname] = NamedSharding(self.mesh, P(*spec))
+                spec = param_shardings[pname].spec
+                if self.zero >= 1:
+                    spec = self._zero_spec(spec, arr.shape)
+                out[sname][pname] = NamedSharding(self.mesh, spec)
         return out
 
     def init_state(self):
-        params, buffers = F.layer_state(self.layer)
+        if self._pipe is not None:
+            params, buffers = self._pipe.flat_state()
+        else:
+            params, buffers = F.layer_state(self.layer)
         pshard = self._param_sharding_tree(params)
+        if self.zero >= 3:
+            # ZeRO-3: parameters themselves are stored dp-sharded; GSPMD
+            # all-gathers each param at its use sites inside the step
+            # (sharding_optimizer.py stage-3 param shard + broadcast)
+            pshard = {n: NamedSharding(
+                self.mesh, self._zero_spec(s.spec, params[n].shape))
+                for n, s in pshard.items()}
+        if self.zero >= 2:
+            # ZeRO-2: gradients leave the backward pass reduce-scattered
+            # over dp (sharding_optimizer.py stage-2 grad shard); the same
+            # layout rule as the opt state so the update is local
+            self._grad_shardings = {
+                n: NamedSharding(self.mesh,
+                                 self._zero_spec(pshard[n].spec,
+                                                 params[n].shape))
+                for n in params}
+        else:
+            self._grad_shardings = None
         params = {n: jax.device_put(v, pshard[n]) for n, v in params.items()}
         rep = NamedSharding(self.mesh, P())
         buffers = {n: jax.device_put(v, rep) for n, v in buffers.items()}
@@ -128,6 +186,53 @@ class TrainStep:
         return self._state
 
     # -- step function -------------------------------------------------------
+    def _pipe_loss_of(self, params, buffers, inputs, label, rng_key):
+        """Pipelined forward: embed (replicated) → GPipe trunk over pp →
+        head (replicated) → loss.  One SPMD program; jax.grad reverses the
+        whole schedule."""
+        if self.compute_dtype is not None:
+            cd = self.compute_dtype
+            params = {n: (v.astype(cd) if jnp.issubdtype(v.dtype, jnp.floating)
+                          else v) for n, v in params.items()}
+            inputs = tuple(x.astype(cd) if x is not None and
+                           jnp.issubdtype(x.dtype, jnp.floating)
+                           else x for x in inputs)
+
+        def sub(tree, tag):
+            pre = tag + "::"
+            return {n[len(pre):]: v for n, v in tree.items()
+                    if n.startswith(pre)}
+
+        pipe = self._pipe
+        new_buffers = dict(buffers)
+        if pipe.embed is not None:
+            x, eb = F.functional_call(
+                pipe.embed, sub(params, "embed"), sub(buffers, "embed"),
+                inputs, training=True, rng_key=rng_key, mutable_buffers=True)
+            if isinstance(x, (tuple, list)):
+                x = x[0]
+            new_buffers.update({f"embed::{n}": v for n, v in eb.items()})
+        else:
+            x = inputs[0]
+
+        h = self._pipe_fwd(sub(params, "pipe"), x,
+                           jax.random.fold_in(rng_key, 1))
+
+        if pipe.head is not None:
+            head_args = (h,) if self.loss_fn is not None or label is None \
+                else (h, label)
+            out, hb = F.functional_call(
+                pipe.head, sub(params, "head"), sub(buffers, "head"),
+                head_args, training=True,
+                rng_key=jax.random.fold_in(rng_key, 2), mutable_buffers=True)
+            new_buffers.update({f"head::{n}": v for n, v in hb.items()})
+        else:
+            out = h
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        loss = self.loss_fn(out, label) if self.loss_fn is not None else out
+        return loss.astype(jnp.float32).mean(), new_buffers
+
     def _loss_of(self, params, buffers, inputs, label, rng_key):
         if self.compute_dtype is not None:
             cd = self.compute_dtype
@@ -152,13 +257,23 @@ class TrainStep:
         return loss.astype(jnp.float32).mean(), new_buffers
 
     def _build_step(self):
-        loss_of = self._loss_of
-        if self.remat:
-            # RecomputeOptimizer ≙ jax.checkpoint over the whole loss fn;
-            # per-layer policies live in nn layers via recompute() wrapper.
-            loss_of = jax.checkpoint(loss_of, static_argnums=())
+        if self._pipe is not None:
+            # remat happens per trunk block inside build_body
+            loss_of = self._pipe_loss_of
+        else:
+            loss_of = self._loss_of
+            if self.remat:
+                # RecomputeOptimizer ≙ jax.checkpoint over the whole loss fn;
+                # per-layer policies live in nn layers via recompute() wrapper.
+                loss_of = jax.checkpoint(loss_of, static_argnums=())
 
         acc_k = self.accumulate_steps
+
+        def constrain_grads(grads):
+            if self._grad_shardings is None:
+                return grads
+            return {n: jax.lax.with_sharding_constraint(
+                g, self._grad_shardings[n]) for n, g in grads.items()}
 
         def step(state, inputs, label, lr):
             new_step = state["step"] + 1
@@ -193,6 +308,7 @@ class TrainStep:
             else:
                 (loss, new_buffers), grads = grad_fn(
                     state["params"], state["buffers"], inputs, label, rng_key)
+            grads = constrain_grads(grads)
 
             new_params, new_opt = self.optimizer.functional_apply(
                 state["params"], grads, state["opt"], new_step, lr)
@@ -254,8 +370,12 @@ class TrainStep:
     def sync_to_layer(self):
         """Write compiled-state params/buffers back into the eager Layer and
         optimizer accumulators (for save/eval interop)."""
-        F.load_layer_state(self.layer, self.state["params"],
-                           self.state["buffers"])
+        if self._pipe is not None:
+            self._pipe.load_flat_state(self.state["params"],
+                                       self.state["buffers"])
+        else:
+            F.load_layer_state(self.layer, self.state["params"],
+                               self.state["buffers"])
         self.optimizer.adopt_functional_state(self.state["opt"])
         self.optimizer._step_count = int(self.state["step"])
 

@@ -166,6 +166,55 @@ class BertForPretraining(nn.Layer):
         return loss
 
 
+class BertMLMHead(nn.Layer):
+    """MLM head producing the loss directly (pipeline tail stage).
+
+    Untied from the word embedding: in the pipelined decomposition embed and
+    head live in separate param groups, so the reference's tied
+    decoder_weight (modeling's BertPretrainingHeads) becomes an independent
+    decoder matrix — the standard trade when pipelining the reference model.
+    """
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.activation = getattr(F, cfg.hidden_act)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=1e-12)
+        self.decoder = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+
+    def forward(self, sequence_output, masked_lm_labels=None):
+        h = self.layer_norm(self.activation(self.transform(sequence_output)))
+        logits = self.decoder(h)
+        if masked_lm_labels is None:
+            return logits
+        return F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            masked_lm_labels.reshape([-1]), ignore_index=-100)
+
+
+def build_pipeline_model(cfg: BertConfig = None, num_stages: int = None,
+                         num_microbatches: int = 2, mesh=None):
+    """BERT MLM as a PipelineModule: BertEmbeddings → encoder-layer trunk
+    over the pp axis → BertMLMHead.  Train via
+    TrainStep(module, opt)((input_ids,), labels) or
+    fleet.distributed_optimizer with strategy.pipeline=True
+    (≙ PipelineOptimizer's device_guard section split of this model,
+    fluid/optimizer.py:3702)."""
+    from ...parallel.pipeline import PipelineModule
+
+    cfg = cfg or BertConfig.base()
+    embed = BertEmbeddings(cfg)
+    blocks = [nn.TransformerEncoderLayer(
+        cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+        dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
+        attn_dropout=cfg.attention_probs_dropout_prob, act_dropout=0.0)
+        for _ in range(cfg.num_hidden_layers)]
+    head = BertMLMHead(cfg)
+    return PipelineModule(embed, blocks, head, num_stages=num_stages,
+                          num_microbatches=num_microbatches, mesh=mesh)
+
+
 def apply_tensor_parallel(model: BertModel):
     """Annotate Megatron-style TP shardings over the ``mp`` mesh axis.
 

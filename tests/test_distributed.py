@@ -244,3 +244,14 @@ def test_subgroup_all_gather_raises(world):
     with pytest.raises(NotImplementedError):
         _spmd(lambda v: dist.all_gather([], Tensor(v), group=g)._value,
               world)(jnp.arange(8.0))
+
+
+def test_subgroup_int_max_exact(world):
+    """Integer MAX over a subgroup must not round through float32."""
+    g = dist.new_group(ranks=[1, 4])
+    big = 16_777_217  # 2**24 + 1: not representable in float32
+    x = jnp.arange(8, dtype=jnp.int32) + big - 4
+    out = _spmd(lambda v: dist.all_reduce(Tensor(v), group=g,
+                                          op=dist.ReduceOp.MAX)._value,
+                world, in_specs=P("dp"), out_specs=P("dp"))(x)
+    np.testing.assert_array_equal(np.asarray(out), np.full(8, big))

@@ -141,3 +141,22 @@ def test_supports_gate():
     assert _pick_block(4096, 1024) == 1024
     assert _pick_block(128, 512) == 128
     assert _pick_block(384, 512) == 384
+
+
+def test_causal_block_unification_no_dropped_keys():
+    """Sq=768, Sk=1024 causal: unified block must divide BOTH lengths
+    (regression: gcd-based pick, no silently dropped trailing key blocks)."""
+    q = jnp.asarray(rng.randn(1, 2, 768, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 1024, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 1024, 64), jnp.float32)
+    out = flash_attention_fn(q, k, v, causal=True)
+    ref = _sdpa_fn(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-5)
+    gf = jax.grad(lambda *a: (flash_attention_fn(*a, causal=True) ** 2)
+                  .sum(), argnums=(1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: (_sdpa_fn(*a, causal=True) ** 2)
+                  .sum(), argnums=(1, 2))(q, k, v)
+    for name, a, b in zip("kv", gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=1e-4, err_msg=f"d{name}")

@@ -1,0 +1,141 @@
+"""Pipeline parallelism integrated in TrainStep (VERDICT r1 item 3).
+
+Mirrors the reference's pipeline tests (section_worker GPipe schedule,
+test_pipeline.py) but as one SPMD program on the pp x dp CPU mesh: a
+PipelineModule (embed -> pp-sharded trunk -> head) trains end-to-end through
+TrainStep / fleet.distributed_optimizer, and matches the math of the same
+model run unpipelined.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.parallel import (init_mesh, MeshGuard, TrainStep,
+                                 PipelineModule, make_mesh)
+
+
+def _mlp_parts(hidden=16, blocks=4, seed=0):
+    paddle.seed(seed)
+    embed = nn.Linear(8, hidden)
+    trunk = [nn.Sequential(nn.Linear(hidden, hidden), nn.Tanh())
+             for _ in range(blocks)]
+    head = nn.Linear(hidden, 1)
+    return embed, trunk, head
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+    return x, y
+
+
+def test_pipeline_trainstep_converges():
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    with MeshGuard(mesh):
+        embed, trunk, head = _mlp_parts()
+        model = PipelineModule(embed, trunk, head, num_stages=2,
+                               num_microbatches=2, mesh=mesh)
+        opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                     learning_rate=5e-3)
+        step = TrainStep(model, opt, loss_fn=nn.MSELoss(), mesh=mesh)
+        x, y = _batch(16)
+        losses = [float(step((x,), y)) for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+def test_pipeline_matches_unpipelined():
+    """Same weights, same batch: pipelined loss == sequential loss."""
+    x, y = _batch(8)
+
+    # sequential reference on a trivial mesh
+    with MeshGuard(make_mesh({"dp": 1}, devices=jax.devices()[:1])):
+        embed, trunk, head = _mlp_parts(seed=3)
+        seq_model = nn.Sequential(embed, *trunk, head)
+        out = seq_model(paddle.to_tensor(x))
+        ref_loss = float(((out - paddle.to_tensor(y)) ** 2).mean())
+
+    mesh = make_mesh({"pp": 2, "dp": 2}, devices=jax.devices()[:4])
+    with MeshGuard(mesh):
+        embed, trunk, head = _mlp_parts(seed=3)  # same init (same seed)
+        model = PipelineModule(embed, trunk, head, num_stages=2,
+                               num_microbatches=2, mesh=mesh)
+        opt = paddle.optimizer.SGD(parameters=model.parameters(),
+                                   learning_rate=0.0)
+        step = TrainStep(model, opt, loss_fn=nn.MSELoss(), mesh=mesh)
+        pipe_loss = float(step((x,), y))
+
+    np.testing.assert_allclose(pipe_loss, ref_loss, rtol=2e-5)
+
+
+def test_pipeline_remat_and_microbatches():
+    mesh = make_mesh({"pp": 2, "dp": 2}, devices=jax.devices()[:4])
+    with MeshGuard(mesh):
+        embed, trunk, head = _mlp_parts(seed=5)
+        model = PipelineModule(embed, trunk, head, num_stages=2,
+                               num_microbatches=4, mesh=mesh)
+        opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                     learning_rate=5e-3)
+        step = TrainStep(model, opt, loss_fn=nn.MSELoss(), mesh=mesh,
+                         remat=True)
+        x, y = _batch(16, seed=5)
+        l0 = float(step((x,), y))
+        for _ in range(20):
+            loss = float(step((x,), y))
+        assert loss < l0
+
+
+def test_pipeline_through_fleet():
+    """strategy.pipeline=True -> fleet.distributed_optimizer trains a
+    PipelineModule (accumulate_steps becomes the microbatch count)."""
+    from paddle_tpu.distributed import fleet
+
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    with MeshGuard(mesh):
+        strategy = fleet.DistributedStrategy()
+        strategy.pipeline = True
+        strategy.pipeline_configs = {"accumulate_steps": 2, "pp_degree": 2}
+        fleet.init(is_collective=False, strategy=strategy)
+
+        embed, trunk, head = _mlp_parts(seed=7)
+        model = PipelineModule(embed, trunk, head, num_stages=2, mesh=mesh)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(parameters=model.parameters(),
+                                   learning_rate=5e-3))
+        step = opt.build_train_step(model, loss_fn=nn.MSELoss(), mesh=mesh)
+        assert model.M == 2  # accumulate_steps -> microbatches
+        x, y = _batch(16, seed=7)
+        losses = [float(step((x,), y)) for _ in range(25)]
+        assert losses[-1] < losses[0] * 0.7
+
+
+def test_pipeline_state_roundtrip():
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    with MeshGuard(mesh):
+        embed, trunk, head = _mlp_parts(seed=9)
+        model = PipelineModule(embed, trunk, head, num_stages=2, mesh=mesh)
+        opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                     learning_rate=5e-3)
+        step = TrainStep(model, opt, loss_fn=nn.MSELoss(), mesh=mesh)
+        x, y = _batch(16, seed=9)
+        for _ in range(3):
+            step((x,), y)
+        step.sync_to_layer()
+        # trunk block 3 = stage 1, slot 1 of the stacked params
+        stacked = step.state["params"]
+        name = model.block_param_names[0]
+        got = np.asarray(stacked[f"pipe::{name}"][1, 1])
+        p3, _ = paddle.framework.functional.layer_state(trunk[3])
+        np.testing.assert_allclose(np.asarray(p3[name]), got, rtol=1e-6)
+
+
+def test_pipeline_rejects_buffered_trunk():
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    with MeshGuard(mesh):
+        blocks = [nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4))
+                  for _ in range(2)]
+        with pytest.raises(ValueError):
+            PipelineModule(None, blocks, None, num_stages=2, mesh=mesh)

@@ -1,0 +1,127 @@
+"""ZeRO sharding stages 1/2/3 in TrainStep (VERDICT r1 item 4).
+
+≙ fleet ShardingOptimizer stages (python/paddle/distributed/fleet/
+meta_optimizers/sharding_optimizer.py:33,103,161): stage-1 shards optimizer
+state, stage-2 reduce-scatters grads, stage-3 shards the parameters
+themselves.  Here each stage is a sharding-layout rule on the one jitted
+step; the memory assertions check actual per-device shard bytes on the
+8-way CPU mesh.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.parallel import TrainStep, MeshGuard, make_mesh
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(16, 64), nn.Tanh(), nn.Linear(64, 8))
+
+
+def _batch(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 16).astype(np.float32)
+    y = rng.randn(n, 8).astype(np.float32)
+    return x, y
+
+
+def _shard_frac(arr):
+    """Fraction of the array each device actually stores."""
+    shard = arr.addressable_shards[0].data
+    return shard.size / arr.size
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_stage_memory_layout(stage):
+    mesh = make_mesh({"dp": 8})
+    with MeshGuard(mesh):
+        model = _model()
+        opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                     learning_rate=1e-2)
+        step = TrainStep(model, opt, loss_fn=nn.MSELoss(), mesh=mesh,
+                         zero=stage)
+        x, y = _batch()
+        l0 = float(step((x,), y))
+        for _ in range(5):
+            loss = float(step((x,), y))
+        assert np.isfinite(loss) and loss < l0
+
+        state = step.state
+        # stage >=1: every dp-divisible opt accumulator is 1/8 per device
+        for acc in state["opt"].values():
+            for name, arr in acc.items():
+                if any(d % 8 == 0 for d in arr.shape):
+                    assert _shard_frac(arr) == pytest.approx(1 / 8), name
+        # stage 3: params themselves sharded 1/8
+        for name, arr in state["params"].items():
+            if any(d % 8 == 0 for d in arr.shape):
+                frac = _shard_frac(arr)
+                if stage >= 3:
+                    assert frac == pytest.approx(1 / 8), name
+                else:
+                    assert frac == 1.0, name
+
+
+def test_zero_stages_match_baseline():
+    """All stages compute the same math as the unsharded step."""
+    x, y = _batch(seed=4)
+    losses = {}
+    for stage in (0, 1, 2, 3):
+        mesh = make_mesh({"dp": 8})
+        with MeshGuard(mesh):
+            model = _model(seed=4)
+            opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                         learning_rate=1e-2)
+            step = TrainStep(model, opt, loss_fn=nn.MSELoss(), mesh=mesh,
+                             zero=stage)
+            seq = [float(step((x,), y)) for _ in range(3)]
+            losses[stage] = seq
+    for stage in (1, 2, 3):
+        np.testing.assert_allclose(losses[stage], losses[0], rtol=1e-5,
+                                   err_msg=f"stage {stage}")
+
+
+def test_zero_through_fleet_strategy():
+    from paddle_tpu.distributed import fleet
+
+    mesh = make_mesh({"dp": 8})
+    with MeshGuard(mesh):
+        strategy = fleet.DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 2}
+        fleet.init(is_collective=False, strategy=strategy)
+        model = _model(seed=2)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(parameters=model.parameters(),
+                                   learning_rate=1e-2))
+        step = opt.build_train_step(model, loss_fn=nn.MSELoss(), mesh=mesh)
+        assert step.zero == 2
+        x, y = _batch(seed=2)
+        l0 = float(step((x,), y))
+        for _ in range(5):
+            loss = float(step((x,), y))
+        assert loss < l0
+
+
+def test_zero3_with_tensor_parallel():
+    """zero=3 composes with a tp axis: mp dims stay mp, a free dim gets dp."""
+    from paddle_tpu.parallel import shard_parameter
+
+    mesh = make_mesh({"dp": 4, "mp": 2})
+    with MeshGuard(mesh):
+        model = _model(seed=6)
+        # column-parallel first linear over mp
+        shard_parameter(model[0].weight, ("mp", None))
+        opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                     learning_rate=1e-2)
+        step = TrainStep(model, opt, loss_fn=nn.MSELoss(), mesh=mesh, zero=3)
+        x, y = _batch(seed=6)
+        l0 = float(step((x,), y))
+        loss = float(step((x,), y))
+        assert np.isfinite(loss)
+        w0 = step.state["params"]["0.weight"]  # (16, 64), spec (mp, dp-able)
+        assert _shard_frac(w0) == pytest.approx(1 / 8)
