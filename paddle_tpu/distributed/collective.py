@@ -133,17 +133,17 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
         sub = _is_subgroup(g)
         if sub:
             member = _member_mask(g)
-        if sub:
-            # reduction identities in the tensor's OWN dtype (float ±inf /
-            # integer iinfo bounds) — no silent promotion through float32,
-            # which would corrupt int values above 2^24
-            if jnp.issubdtype(x.dtype, jnp.floating):
-                lo, hi = -jnp.inf, jnp.inf
-            else:
-                info = jnp.iinfo(x.dtype)
-                lo, hi = info.min, info.max
-            lo = jnp.asarray(lo, x.dtype)
-            hi = jnp.asarray(hi, x.dtype)
+            if op in (ReduceOp.MAX, ReduceOp.MIN):
+                # reduction identities in the tensor's OWN dtype (float
+                # ±inf / integer iinfo bounds) — no promotion through
+                # float32, which would corrupt int values above 2^24
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    lo, hi = -jnp.inf, jnp.inf
+                else:
+                    info = jnp.iinfo(x.dtype)
+                    lo, hi = info.min, info.max
+                lo = jnp.asarray(lo, x.dtype)
+                hi = jnp.asarray(hi, x.dtype)
         if op == ReduceOp.SUM:
             out = lax.psum(jnp.where(member, x, 0) if sub else x, g.axis)
         elif op == ReduceOp.MAX:

@@ -24,6 +24,9 @@ distributed_optimizer = _fleet.distributed_optimizer
 distributed_model = _fleet.distributed_model
 minimize = _fleet.minimize
 save_persistables = _fleet.save_persistables
+init_server = _fleet.init_server
+run_server = _fleet.run_server
+init_worker = _fleet.init_worker
 stop_worker = _fleet.stop_worker
 
 

@@ -185,8 +185,41 @@ class Fleet:
                 "paddle_tpu.static)")
         save(target.state_dict(), os.path.join(dirname, "model.pdparams"))
 
+    # -- parameter-server mode (fleet_base.py init_server/run_server/
+    #    init_worker; served by the ps/ stack — server.h:50 analogue) --------
+    def init_server(self, *args, **kwargs):
+        from ..ps import PsServer
+        ep = None
+        if self._role_maker is not None:
+            eps = self._role_maker.get_pserver_endpoints()
+            if eps:
+                ep = eps[self._role_maker.server_index() % len(eps)]
+        host, port = (ep.rsplit(":", 1) if ep else ("127.0.0.1", "0"))
+        self._ps_server = PsServer(host=host, port=int(port))
+        return self._ps_server
+
+    def run_server(self):
+        """Serve until stop (listen_and_serv_op's blocking loop)."""
+        import time
+        srv = self._ps_server
+        srv.start()
+        while srv._running:
+            time.sleep(0.05)
+
+    def init_worker(self):
+        """Connect this trainer to the pserver(s).  Returns the PS client
+        (single-endpoint for now; multi-server table sharding is a host-side
+        concern, not a chip one)."""
+        from ..ps import PsClient, LocalPsEndpoint
+        eps = (self._role_maker.get_pserver_endpoints()
+               if self._role_maker else [])
+        self._ps_client = PsClient(eps[0]) if eps else LocalPsEndpoint()
+        return self._ps_client
+
     def stop_worker(self):
-        pass
+        client = getattr(self, "_ps_client", None)
+        if client is not None:
+            client.close()
 
     @property
     def util(self):

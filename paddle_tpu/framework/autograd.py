@@ -72,6 +72,33 @@ _tag_counter = [0]
 
 
 def _accumulate_into_tensor(t: Tensor, ct):
+    from .selected_rows import SelectedRows
+    if isinstance(ct, SelectedRows):
+        # sparse accumulation (GradientAccumulator's SelectedRows branch,
+        # imperative/gradient_accumulator.cc): sparse+sparse concatenates,
+        # sparse+dense densifies.  Grad hooks see the SelectedRows itself
+        # (a hook may return a replacement — SelectedRows or dense).
+        for hook in t._hooks:
+            out = hook(ct)
+            if out is not None:
+                ct = out
+        if not isinstance(ct, SelectedRows):
+            ct = ct._value if isinstance(ct, Tensor) else ct
+            t.grad = Tensor(ct, stop_gradient=True) if t.grad is None \
+                else Tensor(t.grad._value + ct, stop_gradient=True)
+            return
+        if t.grad is None:
+            t.grad = ct
+        elif isinstance(t.grad, SelectedRows):
+            t.grad = t.grad + ct
+        else:
+            t.grad = Tensor(t.grad._value + ct.to_dense(),
+                            stop_gradient=True, name=t.name + "@GRAD")
+        return
+    if isinstance(t.grad, SelectedRows):
+        t.grad = Tensor(t.grad.to_dense() + ct, stop_gradient=True,
+                        name=t.name + "@GRAD")
+        return
     if ct.dtype == _float0:
         return
     for hook in t._hooks:

@@ -12,6 +12,15 @@ import jax.numpy as jnp
 from ..framework.tensor import Tensor
 
 
+def _rewrap(g, new_vals):
+    """Preserve sparse-ness: a clipped SelectedRows stays a SelectedRows
+    (clip.py's merge_selected_rows + scale path in the reference)."""
+    from ..framework.selected_rows import SelectedRows
+    if isinstance(g, SelectedRows):
+        return SelectedRows(g.rows, new_vals, g.height)
+    return Tensor(new_vals)
+
+
 class ClipGradBase:
     def __call__(self, params_grads):
         return self._dygraph_clip(params_grads)
@@ -31,7 +40,8 @@ class ClipGradByValue(ClipGradBase):
             if g is None or (hasattr(p, "need_clip") and not p.need_clip):
                 out.append((p, g))
                 continue
-            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+            out.append((p, _rewrap(g, jnp.clip(g._value, self.min,
+                                               self.max))))
         return out
 
 
@@ -48,7 +58,7 @@ class ClipGradByNorm(ClipGradBase):
             gv = g._value
             norm = jnp.sqrt(jnp.sum(jnp.square(gv.astype(jnp.float32))))
             scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
-            out.append((p, Tensor((gv * scale).astype(gv.dtype))))
+            out.append((p, _rewrap(g, (gv * scale).astype(gv.dtype))))
         return out
 
 
@@ -73,7 +83,8 @@ class ClipGradByGlobalNorm(ClipGradBase):
             if g is None or (hasattr(p, "need_clip") and not p.need_clip):
                 out.append((p, g))
                 continue
-            out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
+            out.append((p, _rewrap(g, (g._value * scale)
+                                   .astype(g._value.dtype))))
         return out
 
 

@@ -118,12 +118,24 @@ def _embedding_fn(w, ids, padding_idx):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """lookup_table_v2 parity; ``sparse`` is accepted for API parity -- the
-    gradient is an XLA scatter-add (dense buffer), the TPU-correct analogue of
-    SelectedRows."""
+    """lookup_table_v2 parity.  With ``sparse=True`` in eager mode the
+    weight gradient is a SelectedRows (rows = the looked-up ids) instead of
+    a dense vocab-sized buffer — the reference's is_sparse grad path
+    (lookup_table_v2_op.cc); sparse optimizers then update only those rows.
+    Inside traced/static code the dense scatter-add path is used (XLA has no
+    sparse tensors)."""
     pi = None if padding_idx is None else int(padding_idx)
     if pi is not None and pi < 0:
         pi = int(unwrap(weight).shape[0]) + pi
+    if sparse:
+        import jax as _jax
+        from ...framework import core as _core
+        from ...framework.tensor import Tensor as _T
+        concrete = isinstance(weight, _T) and \
+            not isinstance(unwrap(weight), _jax.core.Tracer)
+        if not _core.in_static_mode() and concrete:
+            from ...framework.selected_rows import sparse_lookup
+            return sparse_lookup(weight, x, padding_idx=pi)
     return _embedding_p(weight, x, padding_idx=pi)
 
 

@@ -39,6 +39,47 @@ class L1Decay:
         self.coeff = float(coeff)
 
 
+# ---- sparse (SelectedRows) row-update rules ----------------------------------
+# sgd_op/adam_op SelectedRows branches: only the touched rows are read,
+# updated and scattered back — O(rows) instead of O(vocab) work per step.
+
+@jax.jit
+def _sgd_sparse_rule(p, rows, vals, lr):
+    return p.at[rows].add(-(lr * vals.astype(jnp.float32)).astype(p.dtype))
+
+
+@jax.jit
+def _adam_sparse_rule(p, m, v, rows, vals, lr, b1, b2, eps, t):
+    g = vals.astype(jnp.float32)
+    m_new = b1 * m[rows] + (1 - b1) * g
+    v_new = b2 * v[rows] + (1 - b2) * jnp.square(g)
+    step = lr * (m_new / (1 - b1 ** t)) / \
+        (jnp.sqrt(v_new / (1 - b2 ** t)) + eps)
+    return (p.at[rows].add(-step.astype(p.dtype)),
+            m.at[rows].set(m_new), v.at[rows].set(v_new))
+
+
+@jax.jit
+def _adamw_sparse_rule(p, m, v, rows, vals, lr, b1, b2, eps, t, wd):
+    g = vals.astype(jnp.float32)
+    p_rows = p[rows].astype(jnp.float32)
+    m_new = b1 * m[rows] + (1 - b1) * g
+    v_new = b2 * v[rows] + (1 - b2) * jnp.square(g)
+    step = lr * ((m_new / (1 - b1 ** t)) /
+                 (jnp.sqrt(v_new / (1 - b2 ** t)) + eps) + wd * p_rows)
+    return (p.at[rows].add(-step.astype(p.dtype)),
+            m.at[rows].set(m_new), v.at[rows].set(v_new))
+
+
+@jax.jit
+def _adagrad_sparse_rule(p, mom, rows, vals, lr, eps):
+    g = vals.astype(jnp.float32)
+    m_new = mom[rows] + jnp.square(g)
+    step = lr * g / (jnp.sqrt(m_new) + eps)
+    return (p.at[rows].add(-step.astype(p.dtype)),
+            mom.at[rows].set(m_new))
+
+
 # ---- functional update rules (jitted, donated) -------------------------------
 # Each takes (params_tree, grads_tree, state_trees..., scalars...) and returns
 # updated trees. Trees are dicts name->array so one XLA computation covers the
@@ -259,12 +300,25 @@ class Optimizer:
 
     # -- stepping ------------------------------------------------------------
     def _collect(self):
+        from ..framework.selected_rows import SelectedRows
         params = [p for p in (self._parameters or []) if not p.stop_gradient
                   and getattr(p, "trainable", True)]
-        pg = [(p, p.grad) for p in params if p.grad is not None]
+        pg = []
+        for p in params:
+            g = p.grad
+            if g is None:
+                continue
+            if isinstance(g, SelectedRows):
+                # canonicalize duplicates first so clip norms match the
+                # reference's merge_selected_rows-then-clip order
+                rows, vals = g.merged()
+                g = SelectedRows(rows, vals, g.height)
+            pg.append((p, g))
         if self._grad_clip is not None:
-            pg = self._grad_clip(pg)
-        return pg
+            pg = self._grad_clip(pg)  # SelectedRows-aware (nn/clip._rewrap)
+        self._sparse_pg = [(p, g) for p, g in pg
+                           if isinstance(g, SelectedRows)]
+        return [(p, g) for p, g in pg if not isinstance(g, SelectedRows)]
 
     def _ensure_state(self, names, pg, like_fp32=True):
         for n in names:
@@ -314,13 +368,27 @@ class Optimizer:
 
     def step(self):
         pg = self._collect()
-        if not pg:
+        sparse_pg = self._sparse_pg
+        if not pg and not sparse_pg:
             return
         self._step_count += 1
-        self._apply(pg)
+        if pg:
+            self._apply(pg)
+        for p, g in sparse_pg:
+            rows, vals = g.merged()
+            self._apply_sparse(p, rows, vals)
 
     def _apply(self, pg):
         raise NotImplementedError
+
+    def _apply_sparse(self, p, rows, vals):
+        """Row-wise update for a SelectedRows gradient. Default: densify the
+        merged grad and run the dense rule on this one param (correct but
+        not memory-sparse); SGD/Adam/Adagrad override with true row-sliced
+        updates (sgd_op/adam_op SelectedRows branches, lazy_mode)."""
+        dense = jnp.zeros(p._value.shape, vals.dtype).at[rows].add(vals)
+        g = Tensor(dense, stop_gradient=True)
+        self._apply([(p, g)])
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
@@ -517,6 +585,16 @@ class SGD(Optimizer):
         new = _sgd_rule(params, grads, jnp.float32(self.get_lr()))
         self._writeback(pg, new)
 
+    def _apply_sparse(self, p, rows, vals):
+        masters = self._accumulators.get("@master", {})
+        tgt = masters.get(p.name, p._value)
+        new = _sgd_sparse_rule(tgt, rows, vals, jnp.float32(self.get_lr()))
+        if p.name in masters:
+            masters[p.name] = new
+            p._value = new.astype(p._value.dtype)
+        else:
+            p._value = new
+
     def functional_apply(self, params, grads, state, step, lr=None):
         grads = self._functional_grads(params, grads)
         lr = jnp.float32(self.get_lr()) if lr is None else lr
@@ -576,6 +654,26 @@ class Adam(Optimizer):
         self._accumulators["moment1"].update(new_m)
         self._accumulators["moment2"].update(new_v)
 
+    def _apply_sparse(self, p, rows, vals):
+        """lazy-mode Adam (adam_op.h SelectedRows + lazy_mode): moments and
+        param update only on the touched rows."""
+        self._ensure_state(["moment1", "moment2"], [(p, None)])
+        m = self._accumulators["moment1"][p.name]
+        v = self._accumulators["moment2"][p.name]
+        masters = self._accumulators.get("@master", {})
+        tgt = masters.get(p.name, p._value)
+        new_p, new_m, new_v = _adam_sparse_rule(
+            tgt, m, v, rows, vals, jnp.float32(self.get_lr()),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), jnp.float32(self._step_count))
+        if p.name in masters:
+            masters[p.name] = new_p
+            p._value = new_p.astype(p._value.dtype)
+        else:
+            p._value = new_p
+        self._accumulators["moment1"][p.name] = new_m
+        self._accumulators["moment2"][p.name] = new_v
+
     def functional_apply(self, params, grads, state, step, lr=None):
         grads = self._functional_grads(params, grads)
         lr = jnp.float32(self.get_lr()) if lr is None else lr
@@ -618,6 +716,31 @@ class AdamW(Adam):
             self._writeback(group, new_p)
             self._accumulators["moment1"].update(new_m)
             self._accumulators["moment2"].update(new_v)
+
+    def _apply_sparse(self, p, rows, vals):
+        """lazy AdamW: decoupled decay applies only to the touched rows
+        (matching the dense _adamw_rule semantics row-wise)."""
+        wd = self._wd
+        if self._apply_decay_fn is not None and \
+                not self._apply_decay_fn(p.name):
+            wd = 0.0
+        self._ensure_state(["moment1", "moment2"], [(p, None)])
+        m = self._accumulators["moment1"][p.name]
+        v = self._accumulators["moment2"][p.name]
+        masters = self._accumulators.get("@master", {})
+        tgt = masters.get(p.name, p._value)
+        new_p, new_m, new_v = _adamw_sparse_rule(
+            tgt, m, v, rows, vals, jnp.float32(self.get_lr()),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), jnp.float32(self._step_count),
+            jnp.float32(wd))
+        if p.name in masters:
+            masters[p.name] = new_p
+            p._value = new_p.astype(p._value.dtype)
+        else:
+            p._value = new_p
+        self._accumulators["moment1"][p.name] = new_m
+        self._accumulators["moment2"][p.name] = new_v
 
     def functional_apply(self, params, grads, state, step, lr=None):
         grads = self._functional_grads(params, grads)
@@ -778,6 +901,21 @@ class Adagrad(Optimizer):
                                      jnp.float32(self._eps))
         self._writeback(pg, new_p)
         self._accumulators["moment"].update(new_m)
+
+    def _apply_sparse(self, p, rows, vals):
+        self._ensure_state(["moment"], [(p, None)])
+        mom = self._accumulators["moment"][p.name]
+        masters = self._accumulators.get("@master", {})
+        tgt = masters.get(p.name, p._value)
+        new_p, new_m = _adagrad_sparse_rule(
+            tgt, mom, rows, vals, jnp.float32(self.get_lr()),
+            jnp.float32(self._eps))
+        if p.name in masters:
+            masters[p.name] = new_p
+            p._value = new_p.astype(p._value.dtype)
+        else:
+            p._value = new_p
+        self._accumulators["moment"][p.name] = new_m
 
 
 class Adadelta(Optimizer):

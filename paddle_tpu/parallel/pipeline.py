@@ -170,13 +170,25 @@ class PipelineModule:
 
     def state_dict(self):
         sd = {}
-        for layer in (self.embed, self.head):
+        for tag, layer in (("embed", self.embed), ("head", self.head)):
             if layer is not None:
-                sd.update(layer.state_dict())
+                sd.update({f"{tag}.{k}": v
+                           for k, v in layer.state_dict().items()})
         for j, blk in enumerate(self.blocks):
             sd.update({f"trunk.{j}.{k}": v
                        for k, v in blk.state_dict().items()})
         return sd
+
+    def set_state_dict(self, sd):
+        for tag, layer in (("embed", self.embed), ("head", self.head)):
+            if layer is not None:
+                layer.set_state_dict({k[len(tag) + 1:]: v
+                                      for k, v in sd.items()
+                                      if k.startswith(tag + ".")})
+        for j, blk in enumerate(self.blocks):
+            pre = f"trunk.{j}."
+            blk.set_state_dict({k[len(pre):]: v for k, v in sd.items()
+                                if k.startswith(pre)})
 
     # -- compiled body -------------------------------------------------------
     def build_body(self, remat: bool = False):
