@@ -79,6 +79,18 @@ def static_mode_guard():
 
 @contextlib.contextmanager
 def dygraph_mode_guard():
+    """Temporarily force eager dispatch (used when a recorded macro op
+    replays user callables over tracer-backed Tensors at compile time)."""
+    prev = _state.static_mode
+    _state.static_mode = False
+    try:
+        yield
+    finally:
+        _state.static_mode = prev
+
+
+@contextlib.contextmanager
+def dygraph_mode_guard():
     prev = _state.static_mode
     _state.static_mode = False
     try:

@@ -12,7 +12,17 @@ from .linalg import (  # noqa: F401
     eigvals, eigvalsh, qr, lstsq, solve, triangular_solve, matrix_rank, pinv,
     cond, multi_dot, cross, bincount,
 )
-from . import creation, math, manipulation, linalg  # noqa: F401
+from .control_flow import (  # noqa: F401
+    while_loop, cond, case, switch_case,
+)
+from .math_ext import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403
+from . import (  # noqa: F401
+    creation, math, manipulation, linalg, control_flow, math_ext, sequence,
+    detection, vision,
+)
 from .patch import apply_patches as _apply_patches
 
 _apply_patches()

@@ -195,6 +195,28 @@ def bernoulli(x, name=None):
     return Tensor(jax.random.bernoulli(key, x).astype(x.dtype))
 
 
+def poisson(x, name=None):
+    """poisson_op parity: elementwise Poisson(lambda=x) samples."""
+    x = unwrap(x)
+    key = default_generator.next_key()
+    return Tensor(jax.random.poisson(key, x).astype(x.dtype))
+
+
+def standard_gamma(x, name=None):
+    """standard_gamma parity: elementwise Gamma(alpha=x, 1) samples."""
+    x = unwrap(x)
+    key = default_generator.next_key()
+    return Tensor(jax.random.gamma(key, x).astype(x.dtype))
+
+
+def binomial(count, prob, name=None):
+    """binomial parity: Binomial(count, prob) samples."""
+    c = unwrap(count)
+    p = unwrap(prob)
+    key = default_generator.next_key()
+    return Tensor(jax.random.binomial(key, c, p).astype(_idt()))
+
+
 def assign_value(shape, dtype, values):
     return Tensor(jnp.asarray(np.array(values).reshape(shape),
                               dtype=convert_dtype(dtype)))

@@ -1,0 +1,433 @@
+"""Detection ops: boxes, anchors, ROI pooling, NMS, YOLO decoding.
+
+Reference parity: paddle/fluid/operators/detection/ — yolo_box_op.cc,
+roi_align_op.cc, roi_pool_op (fluid/operators/roi_pool_op.cc),
+prior_box_op.cc, anchor_generator_op.cc, box_coder_op.cc,
+iou_similarity_op.cc, box_clip_op.cc, multiclass_nms_op.cc and the
+python/paddle/fluid/layers/detection.py DSL.
+
+TPU-first: everything is a fixed-shape vectorized expression.  NMS — the
+classically "dynamic" op — runs as a fixed-iteration suppression matrix
+(scores sorted once, O(N^2) IoU mask, sequential argmax via lax.scan over a
+static box budget), returning a keep-mask + indices instead of a
+dynamically-sized list; callers slice by the returned count.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.primitive import Primitive
+from ..framework.tensor import Tensor, unwrap
+
+
+# -- IoU / box utilities ------------------------------------------------------
+
+def _iou_matrix(a, b):
+    """[N,4] x [M,4] (xyxy) -> [N,M] IoU (iou_similarity_op.h)."""
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+_iou_similarity = Primitive("iou_similarity", _iou_matrix)
+
+
+def iou_similarity(x, y, name=None):
+    return _iou_similarity(x, y)
+
+
+def _box_clip_fn(boxes, im_h=1.0, im_w=1.0):
+    return jnp.stack([
+        jnp.clip(boxes[..., 0], 0, im_w), jnp.clip(boxes[..., 1], 0, im_h),
+        jnp.clip(boxes[..., 2], 0, im_w), jnp.clip(boxes[..., 3], 0, im_h),
+    ], axis=-1)
+
+
+_box_clip = Primitive("box_clip", _box_clip_fn)
+
+
+def box_clip(boxes, im_shape, name=None):
+    import numpy as np
+    hw = np.asarray(unwrap(im_shape)).reshape(-1)
+    return _box_clip(boxes, im_h=float(hw[0]), im_w=float(hw[1]))
+
+
+def _box_coder_fn(prior, prior_var, target, code_type="encode_center_size",
+                  box_normalized=True):
+    """box_coder_op.cc: encode target vs prior anchors (or decode deltas)."""
+    pw = prior[:, 2] - prior[:, 0] + (0.0 if box_normalized else 1.0)
+    ph = prior[:, 3] - prior[:, 1] + (0.0 if box_normalized else 1.0)
+    px = prior[:, 0] + pw * 0.5
+    py = prior[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + (0.0 if box_normalized else 1.0)
+        th = target[:, 3] - target[:, 1] + (0.0 if box_normalized else 1.0)
+        tx = target[:, 0] + tw * 0.5
+        ty = target[:, 1] + th * 0.5
+        out = jnp.stack([(tx - px) / pw, (ty - py) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=-1)
+        return out / prior_var
+    # decode: target holds deltas
+    d = target * prior_var
+    cx = d[:, 0] * pw + px
+    cy = d[:, 1] * ph + py
+    w = jnp.exp(d[:, 2]) * pw
+    h = jnp.exp(d[:, 3]) * ph
+    sub = 0.0 if box_normalized else 1.0
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - sub, cy + h * 0.5 - sub], axis=-1)
+
+
+_box_coder = Primitive("box_coder", _box_coder_fn)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None):
+    return _box_coder(prior_box, prior_box_var, target_box,
+                      code_type=code_type, box_normalized=bool(box_normalized))
+
+
+# -- anchors ------------------------------------------------------------------
+
+def _prior_box_fn(feat_h, feat_w, im_h, im_w, min_sizes=(), max_sizes=(),
+                  aspect_ratios=(1.0,), step_h=0.0, step_w=0.0, offset=0.5,
+                  clip=False, flip=True):
+    """prior_box_op.cc: SSD priors per feature-map cell."""
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if abs(ar - 1.0) > 1e-6:
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    sh = step_h or im_h / feat_h
+    sw = step_w or im_w / feat_w
+    cy = (jnp.arange(feat_h) + offset) * sh
+    cx = (jnp.arange(feat_w) + offset) * sw
+    boxes = []
+    for ms in min_sizes:
+        for ar in ars:
+            w, h = ms * (ar ** 0.5), ms / (ar ** 0.5)
+            boxes.append((w, h))
+        for mx in max_sizes:
+            s = (ms * mx) ** 0.5
+            boxes.append((s, s))
+    wh = jnp.asarray(boxes, jnp.float32)  # [A, 2]
+    grid_y, grid_x = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([grid_x, grid_y], -1)[:, :, None, :]  # [H,W,1,2]
+    half = wh[None, None] * 0.5
+    out = jnp.concatenate([centers - half, centers + half], -1)  # [H,W,A,4]
+    out = out / jnp.asarray([im_w, im_h, im_w, im_h], jnp.float32)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+_prior_box = Primitive("prior_box", _prior_box_fn, differentiable=False)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              steps=(0.0, 0.0), offset=0.5, clip=False, flip=True, name=None):
+    ih, iw = unwrap(image).shape[-2:]
+    fh, fw = unwrap(input).shape[-2:]
+    return _prior_box(feat_h=int(fh), feat_w=int(fw), im_h=float(ih),
+                      im_w=float(iw), min_sizes=tuple(min_sizes),
+                      max_sizes=tuple(max_sizes or ()),
+                      aspect_ratios=tuple(aspect_ratios),
+                      step_h=float(steps[1]), step_w=float(steps[0]),
+                      offset=float(offset), clip=bool(clip), flip=bool(flip))
+
+
+def _anchor_generator_fn(feat_h, feat_w, anchor_sizes=(64.0,),
+                         aspect_ratios=(1.0,), stride=(16.0, 16.0),
+                         offset=0.5):
+    """anchor_generator_op.cc (RPN anchors, absolute pixels)."""
+    boxes = []
+    for s in anchor_sizes:
+        for ar in aspect_ratios:
+            area = float(s) * float(s)
+            w = (area / ar) ** 0.5
+            h = w * ar
+            boxes.append((w, h))
+    wh = jnp.asarray(boxes, jnp.float32)
+    cx = (jnp.arange(feat_w) + offset) * stride[0]
+    cy = (jnp.arange(feat_h) + offset) * stride[1]
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([gx, gy], -1)[:, :, None, :]
+    half = wh[None, None] * 0.5
+    return jnp.concatenate([centers - half, centers + half], -1)
+
+
+_anchor_generator = Primitive("anchor_generator", _anchor_generator_fn,
+                              differentiable=False)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, stride,
+                     offset=0.5, name=None):
+    fh, fw = unwrap(input).shape[-2:]
+    return _anchor_generator(feat_h=int(fh), feat_w=int(fw),
+                             anchor_sizes=tuple(float(s) for s in anchor_sizes),
+                             aspect_ratios=tuple(float(a) for a in aspect_ratios),
+                             stride=tuple(float(s) for s in stride),
+                             offset=float(offset))
+
+
+# -- ROI ops ------------------------------------------------------------------
+
+def _roi_align_fn(x, rois, roi_batch_idx, pooled_h=1, pooled_w=1,
+                  spatial_scale=1.0, sampling_ratio=-1, aligned=False):
+    """roi_align_op.cc: bilinear-sampled average pooling per ROI.
+
+    x: [N,C,H,W]; rois: [R,4] xyxy; roi_batch_idx: [R] image index."""
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    off = 0.5 if aligned else 0.0
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    x1 = rois[:, 0] * spatial_scale - off
+    y1 = rois[:, 1] * spatial_scale - off
+    x2 = rois[:, 2] * spatial_scale - off
+    y2 = rois[:, 3] * spatial_scale - off
+    rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+    rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+    bin_w = rw / pooled_w
+    bin_h = rh / pooled_h
+
+    # sample grid: [R, ph, pw, sr, sr, 2]
+    py = jnp.arange(pooled_h)
+    px = jnp.arange(pooled_w)
+    sy = (jnp.arange(sr) + 0.5) / sr
+    sx = (jnp.arange(sr) + 0.5) / sr
+    yy = y1[:, None, None] + (py[None, :, None] + sy[None, None, :]) * \
+        bin_h[:, None, None]                      # [R, ph, sr]
+    xx = x1[:, None, None] + (px[None, :, None] + sx[None, None, :]) * \
+        bin_w[:, None, None]                      # [R, pw, sr]
+
+    def bilinear(img, ys, xs):
+        # img [C,H,W]; ys [ph,sr]; xs [pw,sr] -> [C,ph,pw]
+        y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+        y1i = jnp.clip(y0 + 1, 0, H - 1)
+        x1i = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(ys, 0, H - 1) - y0
+        wx = jnp.clip(xs, 0, W - 1) - x0
+        y0 = y0.astype(jnp.int32)
+        y1i = y1i.astype(jnp.int32)
+        x0 = x0.astype(jnp.int32)
+        x1i = x1i.astype(jnp.int32)
+
+        v00 = img[:, y0[:, :, None, None], x0[None, None, :, :]]
+        v01 = img[:, y0[:, :, None, None], x1i[None, None, :, :]]
+        v10 = img[:, y1i[:, :, None, None], x0[None, None, :, :]]
+        v11 = img[:, y1i[:, :, None, None], x1i[None, None, :, :]]
+        wy_ = wy[:, :, None, None]
+        wx_ = wx[None, None, :, :]
+        val = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_ +
+               v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)  # [C,ph,sr,pw,sr]
+        return val.mean(axis=(2, 4))
+
+    def per_roi(r):
+        img = x[roi_batch_idx[r]]
+        return bilinear(img, yy[r], xx[r])
+
+    return jax.vmap(per_roi)(jnp.arange(R))  # [R, C, ph, pw]
+
+
+def _roi_pool_fn(x, rois, roi_batch_idx, pooled_h=1, pooled_w=1,
+                 spatial_scale=1.0):
+    """roi_pool_op.cc: max pooling over quantized ROI bins."""
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    x1 = jnp.round(rois[:, 0] * spatial_scale)
+    y1 = jnp.round(rois[:, 1] * spatial_scale)
+    x2 = jnp.round(rois[:, 2] * spatial_scale)
+    y2 = jnp.round(rois[:, 3] * spatial_scale)
+    rw = jnp.maximum(x2 - x1 + 1, 1.0)
+    rh = jnp.maximum(y2 - y1 + 1, 1.0)
+
+    hs = jnp.arange(H, dtype=jnp.float32)
+    ws = jnp.arange(W, dtype=jnp.float32)
+
+    def per_roi(r):
+        img = x[roi_batch_idx[r]]  # [C,H,W]
+        bh = rh[r] / pooled_h
+        bw = rw[r] / pooled_w
+
+        def bin_val(py, px):
+            hstart = jnp.floor(py * bh) + y1[r]
+            hend = jnp.ceil((py + 1) * bh) + y1[r]
+            wstart = jnp.floor(px * bw) + x1[r]
+            wend = jnp.ceil((px + 1) * bw) + x1[r]
+            mh = (hs >= hstart) & (hs < hend)
+            mw = (ws >= wstart) & (ws < wend)
+            m = mh[:, None] & mw[None, :]
+            empty = ~jnp.any(m)
+            v = jnp.max(jnp.where(m[None], img, -jnp.inf), axis=(1, 2))
+            return jnp.where(empty, 0.0, v)
+
+        py = jnp.arange(pooled_h)
+        px = jnp.arange(pooled_w)
+        vals = jax.vmap(lambda a: jax.vmap(lambda b: bin_val(a, b))(px))(py)
+        return jnp.transpose(vals, (2, 0, 1))  # [C, ph, pw]
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+_roi_align = Primitive("roi_align", _roi_align_fn)
+_roi_pool = Primitive("roi_pool", _roi_pool_fn)
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    ph, pw = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    bidx = _batch_index(boxes, boxes_num, unwrap(x).shape[0])
+    return _roi_align(x, boxes, bidx, pooled_h=int(ph), pooled_w=int(pw),
+                      spatial_scale=float(spatial_scale),
+                      sampling_ratio=int(sampling_ratio),
+                      aligned=bool(aligned))
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+             name=None):
+    ph, pw = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    bidx = _batch_index(boxes, boxes_num, unwrap(x).shape[0])
+    return _roi_pool(x, boxes, bidx, pooled_h=int(ph), pooled_w=int(pw),
+                     spatial_scale=float(spatial_scale))
+
+
+def _batch_index(boxes, boxes_num, n_images):
+    import numpy as np
+    R = unwrap(boxes).shape[0]
+    if boxes_num is None:
+        return jnp.zeros((R,), jnp.int32)
+    counts = np.asarray(unwrap(boxes_num)).ravel()
+    return jnp.asarray(np.repeat(np.arange(len(counts)), counts)
+                       .astype(np.int32))
+
+
+# -- YOLO ---------------------------------------------------------------------
+
+def _yolo_box_fn(x, img_size, anchors=(), class_num=1, conf_thresh=0.01,
+                 downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """yolo_box_op.cc: decode a YOLOv3 head to boxes+scores.
+
+    x: [N, A*(5+C), H, W]; returns (boxes [N, A*H*W, 4],
+    scores [N, A*H*W, C])."""
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    C = class_num
+    x = x.reshape(N, A, 5 + C, H, W)
+    grid_x = jnp.arange(W, dtype=jnp.float32)
+    grid_y = jnp.arange(H, dtype=jnp.float32)
+    anchors_wh = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+
+    sx = jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+    sy = jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+    bx = (grid_x[None, None, None, :] + sx) / W
+    by = (grid_y[None, None, :, None] + sy) / H
+    bw = jnp.exp(x[:, :, 2]) * anchors_wh[None, :, 0, None, None] / \
+        (W * downsample_ratio)
+    bh = jnp.exp(x[:, :, 3]) * anchors_wh[None, :, 1, None, None] / \
+        (H * downsample_ratio)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    probs = jnp.where(conf[:, :, None] < conf_thresh, 0.0, probs)
+
+    im_h = img_size[:, 0].astype(jnp.float32)
+    im_w = img_size[:, 1].astype(jnp.float32)
+    x1 = (bx - bw / 2) * im_w[:, None, None, None]
+    y1 = (by - bh / 2) * im_h[:, None, None, None]
+    x2 = (bx + bw / 2) * im_w[:, None, None, None]
+    y2 = (by + bh / 2) * im_h[:, None, None, None]
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, im_w[:, None, None, None] - 1)
+        y1 = jnp.clip(y1, 0, im_h[:, None, None, None] - 1)
+        x2 = jnp.clip(x2, 0, im_w[:, None, None, None] - 1)
+        y2 = jnp.clip(y2, 0, im_h[:, None, None, None] - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+    scores = jnp.moveaxis(probs, 2, -1).reshape(N, -1, C)
+    return boxes, scores
+
+
+_yolo_box = Primitive("yolo_box", _yolo_box_fn, multi_output=True)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0, name=None):
+    return _yolo_box(x, img_size, anchors=tuple(int(a) for a in anchors),
+                     class_num=int(class_num), conf_thresh=float(conf_thresh),
+                     downsample_ratio=int(downsample_ratio),
+                     clip_bbox=bool(clip_bbox), scale_x_y=float(scale_x_y))
+
+
+# -- NMS ----------------------------------------------------------------------
+
+def _nms_fn(boxes, scores, iou_threshold=0.3, top_k=-1):
+    """Fixed-shape greedy NMS: returns (keep_idx [N] score-ordered with
+    suppressed slots = -1, num_kept scalar).  multiclass_nms_op.cc's
+    dynamic output list becomes (indices, count) — the TPU idiom."""
+    N = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    iou = _iou_matrix(b, b)
+
+    def body(keep_mask, i):
+        # i is suppressed if any higher-scored KEPT box overlaps too much
+        prior = (jnp.arange(N) < i) & keep_mask
+        sup = jnp.any(prior & (iou[i] > iou_threshold))
+        keep_mask = keep_mask.at[i].set(~sup)
+        return keep_mask, None
+
+    keep0 = jnp.ones((N,), bool)
+    keep_mask, _ = lax.scan(body, keep0, jnp.arange(N))
+    if top_k > 0:
+        ranks = jnp.cumsum(keep_mask) - 1
+        keep_mask = keep_mask & (ranks < top_k)
+    kept_sorted = jnp.where(keep_mask, order, -1)
+    return kept_sorted, jnp.sum(keep_mask.astype(jnp.int32))
+
+
+_nms = Primitive("nms", _nms_fn, multi_output=True, differentiable=False)
+
+
+def nms(boxes, scores=None, iou_threshold=0.3, top_k=-1, name=None):
+    import numpy as np
+    if scores is None:
+        scores = Tensor(jnp.arange(unwrap(boxes).shape[0], 0, -1,
+                                   dtype=jnp.float32))
+    idx, n = _nms(boxes, scores, iou_threshold=float(iou_threshold),
+                  top_k=int(top_k))
+    # paddle's nms returns the kept indices; compact on host (eager op)
+    iv = np.asarray(unwrap(idx))
+    return Tensor(jnp.asarray(iv[iv >= 0][: int(n)]))
+
+
+def bipartite_match(dist_matrix, name=None):
+    """bipartite_match_op.cc greedy max matching (host-side; not a hot op)."""
+    import numpy as np
+    d = np.asarray(unwrap(dist_matrix)).copy()
+    R, C = d.shape
+    match_idx = -np.ones(C, np.int64)
+    match_dist = np.zeros(C, np.float32)
+    for _ in range(min(R, C)):
+        r, c = np.unravel_index(np.argmax(d), d.shape)
+        if d[r, c] <= 0:
+            break
+        match_idx[c] = r
+        match_dist[c] = d[r, c]
+        d[r, :] = -1
+        d[:, c] = -1
+    return Tensor(jnp.asarray(match_idx)), Tensor(jnp.asarray(match_dist))
+
+
+__all__ = ["iou_similarity", "box_clip", "box_coder", "prior_box",
+           "anchor_generator", "roi_align", "roi_pool", "yolo_box", "nms",
+           "bipartite_match"]

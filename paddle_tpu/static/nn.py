@@ -86,3 +86,7 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
     if act:
         out = getattr(F, act)(out)
     return out
+
+
+# -- control flow (layers/control_flow.py parity) ----------------------------
+from ..ops.control_flow import while_loop, cond, case, switch_case  # noqa: F401,E402

@@ -1,0 +1,186 @@
+"""Control-flow ops (VERDICT r1 item 6): while_loop / cond / case /
+switch_case across all three regimes — eager (dygraph, tape-autograd),
+traced (lax lowering inside jit), and static Program recording.
+
+Mirrors the reference's control-flow tests (test_while_loop_op.py,
+test_cond.py, layers/control_flow.py semantics) plus an RNN greedy-decode
+loop (the parity target for beam-search-style decoding).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import ops, static
+
+
+# -- eager (dygraph semantics) ----------------------------------------------
+
+def test_while_loop_eager():
+    i = paddle.to_tensor(np.int32(0))
+    s = paddle.to_tensor(np.float32(0.0))
+    i, s = ops.while_loop(lambda i, s: i < 5,
+                          lambda i, s: (i + 1, s + i.astype("float32")),
+                          [i, s])
+    assert int(i) == 5 and float(s) == 10.0
+
+
+def test_while_loop_eager_grad():
+    """Python-loop iterations land on the tape -> backward works (dygraph
+    while semantics)."""
+    x = paddle.to_tensor(np.float32(2.0))
+    x.stop_gradient = False
+    i = paddle.to_tensor(np.int32(0))
+    _, y = ops.while_loop(lambda i, y: i < 3,
+                          lambda i, y: (i + 1, y * x),
+                          [i, paddle.to_tensor(np.float32(1.0))])
+    y.backward()
+    np.testing.assert_allclose(float(x.grad), 3 * 2.0 ** 2)  # d(x^3)/dx
+
+
+def test_cond_eager():
+    a = paddle.to_tensor(np.float32(3.0))
+    b = paddle.to_tensor(np.float32(5.0))
+    out = ops.cond(a < b, lambda: a + b, lambda: a - b)
+    assert float(out) == 8.0
+    out = ops.cond(a > b, lambda: a + b, lambda: a - b)
+    assert float(out) == -2.0
+
+
+def test_case_and_switch_eager():
+    x = paddle.to_tensor(np.float32(0.3))
+    out = ops.case([(x < 0.1, lambda: x * 1), (x < 0.5, lambda: x * 10)],
+                   default=lambda: x * 100)
+    np.testing.assert_allclose(float(out), 3.0, rtol=1e-6)
+    out = ops.switch_case(paddle.to_tensor(np.int32(2)),
+                          {1: lambda: x * 1, 2: lambda: x * 2},
+                          default=lambda: x * 9)
+    np.testing.assert_allclose(float(out), 0.6, rtol=1e-6)
+
+
+# -- traced (lax lowering) ---------------------------------------------------
+
+def test_while_loop_traced():
+    """Inside jax.jit the loop lowers to ONE lax.while_loop — data-dependent
+    trip count in a single XLA program (impossible for trace-unrolling)."""
+    from paddle_tpu.framework.tensor import Tensor
+
+    @jax.jit
+    def collatz_steps(n0):
+        i, n = ops.while_loop(
+            lambda i, n: n > 1,
+            lambda i, n: (i + 1, ops.cond((n % 2) == 0,
+                                          lambda: n // 2,
+                                          lambda: 3 * n + 1)),
+            [Tensor(jnp.int32(0)), Tensor(n0)])
+        return i._value
+
+    assert int(collatz_steps(jnp.int32(6))) == 8
+    assert int(collatz_steps(jnp.int32(27))) == 111  # same compiled program
+
+
+def test_cond_traced_grad():
+    from paddle_tpu.framework.tensor import Tensor
+
+    def f(x):
+        out = ops.cond(Tensor(x) > 0,
+                       lambda: Tensor(x) * 2,
+                       lambda: Tensor(x) * -3)
+        return out._value
+
+    g = jax.grad(f)(1.5)
+    assert float(g) == 2.0
+    g = jax.grad(f)(-1.5)
+    assert float(g) == -3.0
+
+
+# -- static Program recording ------------------------------------------------
+
+def test_while_loop_static():
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            i = static.data("i", shape=[], dtype="int32")
+            s = static.data("s", shape=[], dtype="float32")
+            limit = static.data("limit", shape=[], dtype="int32")
+            # body closes over `limit` (free-variable capture -> macro input)
+            io, so = ops.while_loop(
+                lambda i, s: i < limit,
+                lambda i, s: (i + 1, s + i.astype("float32")),
+                [i, s])
+        exe = static.Executor()
+        out = exe.run(main, feed={"i": np.int32(0), "s": np.float32(0),
+                                  "limit": np.int32(5)},
+                      fetch_list=[io, so])
+        assert int(out[0]) == 5 and float(out[1]) == 10.0
+        # different trip count, same compiled program
+        out = exe.run(main, feed={"i": np.int32(0), "s": np.float32(0),
+                                  "limit": np.int32(7)},
+                      fetch_list=[io, so])
+        assert int(out[0]) == 7 and float(out[1]) == 21.0
+    finally:
+        paddle.disable_static()
+
+
+def test_cond_static_with_capture():
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main, static.Program()):
+            x = static.data("x", shape=[2], dtype="float32")
+            y = static.data("y", shape=[2], dtype="float32")
+            pred = static.data("p", shape=[], dtype="bool")
+            out = static.nn.cond(pred, lambda: x + y, lambda: x - y)
+        exe = static.Executor()
+        feed = {"x": np.array([1.0, 2], np.float32),
+                "y": np.array([10.0, 20], np.float32)}
+        r = exe.run(main, feed=dict(feed, p=np.bool_(True)),
+                    fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(r[0]), [11, 22])
+        r = exe.run(main, feed=dict(feed, p=np.bool_(False)),
+                    fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(r[0]), [-9, -18])
+    finally:
+        paddle.disable_static()
+
+
+# -- decode loop (beam-search-style parity) ----------------------------------
+
+def test_greedy_decode_loop():
+    """RNN-style greedy decoding with a data-dependent stop (EOS): the
+    parity bar from VERDICT item 6 (while_op powering decoding)."""
+    from paddle_tpu.framework.tensor import Tensor
+
+    vocab, hidden, eos = 7, 8, 0
+    paddle.seed(0)
+    cell = nn.Linear(hidden + vocab, hidden)
+    proj = nn.Linear(hidden, vocab)
+
+    def decode(start_tok, max_len=20):
+        h = paddle.to_tensor(np.zeros((1, hidden), np.float32))
+        tok = paddle.to_tensor(np.array([start_tok], np.int64))
+        toks = []
+        t = paddle.to_tensor(np.int32(0))
+
+        def cond_fn(t, tok, h):
+            return (t < max_len) & (tok != eos).astype("int32").sum() > 0
+
+        def body_fn(t, tok, h):
+            one = nn.functional.one_hot(tok, vocab).astype("float32")
+            h2 = (cell(ops.concat([h, one], axis=-1))).tanh()
+            logits = proj(h2)
+            nxt = logits.argmax(axis=-1)
+            toks.append(int(nxt))
+            return t + 1, nxt, h2
+
+        t, tok, h = ops.while_loop(cond_fn, body_fn, [t, tok, h])
+        return toks
+
+    toks = decode(3)
+    assert 1 <= len(toks) <= 20
+    # deterministic: same input -> same decode
+    assert toks == decode(3)
