@@ -180,22 +180,187 @@ def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None,
     root._bwd_done = True
 
 
+# ---------------------------------------------------------------------------
+# Double grad (create_graph=True): a *recording* backward pass.  Instead of
+# running each node's jitted grad_fn on raw arrays, the backward computation
+# itself is applied through the tape — cotangents are Tensors, each node
+# application records a new GradNode whose grad_fn is jax.vjp of the first
+# backward.  The returned gradients therefore carry a live autograd graph and
+# can be differentiated again (PartialGradEngine / partial_grad_engine.cc
+# ``create_graph`` parity).  Known limitation: AMP autocast inside the first
+# forward is replayed at the original input dtypes, so mixing auto_cast with
+# double grad is unsupported.
+# ---------------------------------------------------------------------------
+
+_second_order_cache: dict = {}
+
+
+def _recorded_grad_apply(n: GradNode):
+    """Apply node n's grad_fn with Tensor cotangents, recording the result."""
+    import numpy as np
+    n_cts = len(n.out_avals)
+
+    cts = []
+    for i, (shape, dtype) in enumerate(n.out_avals):
+        ct = None if n.out_ct is None else n.out_ct[i]
+        if ct is None:
+            ct = Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
+        cts.append(ct)
+
+    args = list(cts)
+    for i, t in enumerate(n.inputs):
+        args.append(t if isinstance(t, Tensor) else n.primals[i])
+
+    grad_fn = n.grad_fn
+    key = (id(grad_fn), n_cts)
+    hit = _second_order_cache.get(key)
+    if hit is None:
+        def flat(*a, _g=grad_fn, _n=n_cts):
+            return _g(tuple(a[:_n]), *a[_n:])
+        # the strong ref to grad_fn pins its id so the cache key can't alias
+        # a recycled id after the node releases its own reference
+        _second_order_cache[key] = (flat, grad_fn)
+    else:
+        flat = hit[0]
+
+    arrs = tuple(a._value if isinstance(a, Tensor) else a for a in args)
+    outs = flat(*arrs)
+
+    from . import core
+    needs = core.grad_enabled() and any(
+        isinstance(a, Tensor) and not a.stop_gradient for a in args)
+    tensors = []
+    rec_idx = []           # output slots that participate in the new node
+    for i, o in enumerate(outs):
+        sg = (not needs) or o.dtype == _float0
+        tensors.append(Tensor(o, stop_gradient=sg))
+        if not sg:
+            rec_idx.append(i)
+    if needs and rec_idx:
+        node = GradNode(
+            n.name + "_grad", None, arrs,
+            tuple(a if isinstance(a, Tensor) else None for a in args),
+            [(np.shape(o), o.dtype) for o in outs])
+
+        def bwd(cts2, *primals, _flat=flat):
+            _, vjp = jax.vjp(_flat, *primals)
+            return vjp(cts2)
+        node.grad_fn = bwd
+        for i in rec_idx:
+            t = tensors[i]
+            t._node = node
+            t._out_index = i
+            t.is_leaf = False
+    return tensors
+
+
+def _seed_recorded(out_ct, index, aval, ct):
+    """Tensor-valued GradNode.seed: accumulate via recorded add/cast ops."""
+    dtype = aval[1]
+    if ct._value.dtype != dtype and ct._value.dtype != _float0:
+        ct = ct.astype(dtype) if hasattr(ct, "astype") else ct
+    cur = out_ct[index]
+    out_ct[index] = ct if cur is None else cur + ct
+
+
+def _backward_recorded(root: Tensor, seed: Tensor, wanted, table,
+                       retain_graph: bool):
+    """run_backward twin where cotangents are Tensors on a live tape."""
+    node = root._node
+    if node is None:
+        if id(root) in wanted:
+            cur = table.get(id(root))
+            table[id(root)] = seed if cur is None else cur + seed
+        return
+
+    _tag_counter[0] += 1
+    tag = _tag_counter[0]
+    deps = {}
+    stack = [node]
+    node.visited_tag = tag
+    while stack:
+        n = stack.pop()
+        for t in n.inputs:
+            p = t._node if isinstance(t, Tensor) else None
+            if p is None:
+                continue
+            deps[id(p)] = deps.get(id(p), 0) + 1
+            if p.visited_tag != tag:
+                p.visited_tag = tag
+                stack.append(p)
+
+    # Tensor-valued cotangent accumulation lives in a side dict so the
+    # original nodes' out_ct slots stay array-typed for later plain backward
+    out_cts = {id(node): [None] * len(node.out_avals)}
+    _seed_recorded(out_cts[id(node)], root._out_index, node.out_avals[root._out_index], seed)
+    queue = deque([node])
+    while queue:
+        n = queue.popleft()
+        n.out_ct = out_cts.get(id(n))        # borrowed by _recorded_grad_apply
+        in_cts = _recorded_grad_apply(n)
+        n.out_ct = None
+        for t, ct in zip(n.inputs, in_cts):
+            if not isinstance(t, Tensor):
+                continue
+            if ct._value.dtype == _float0:
+                continue
+            p = t._node
+            if id(t) in wanted:
+                cur = table.get(id(t))
+                table[id(t)] = ct if cur is None else cur + ct
+            if p is not None:
+                slot = out_cts.get(id(p))
+                if slot is None:
+                    slot = out_cts[id(p)] = [None] * len(p.out_avals)
+                _seed_recorded(slot, t._out_index, p.out_avals[t._out_index], ct)
+                deps[id(p)] -= 1
+                if deps[id(p)] == 0:
+                    queue.append(p)
+        if not retain_graph:
+            n.release()
+    if not retain_graph:
+        root._node = None
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False):
     """paddle.grad parity (partial_grad_engine.cc).
 
     Returns grads of ``outputs`` w.r.t. ``inputs`` without touching ``.grad``
-    slots. ``create_graph`` (double grad) is supported by replaying through
-    jax.vjp of the recorded subgraph; for round 1 we implement the common
-    first-order path and a functional second-order path via jax.grad in
-    paddle_tpu.incubate.autograd.
+    slots. With ``create_graph=True`` the backward pass itself is recorded on
+    the tape (each grad op's VJP derived by jax.vjp of the first backward), so
+    the returned gradients can be differentiated again — double/higher-order
+    grad.
     """
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and len(grad_outputs) != len(outputs):
+        raise ValueError(
+            f"grad_outputs has {len(grad_outputs)} entries but outputs has "
+            f"{len(outputs)}; they must match (use None entries for "
+            "default ones-like seeds)")
     if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle_tpu.incubate.autograd (jax.grad "
-            "composition) for higher-order gradients in round 1")
+        retain = True if retain_graph is None else bool(retain_graph)
+        table: dict = {}
+        wanted = {id(t) for t in inputs}
+        gos = grad_outputs or [None] * len(outputs)
+        for o, go in zip(outputs, gos):
+            if go is None:
+                seed = Tensor(jnp.ones(o._value.shape, o._value.dtype),
+                              stop_gradient=True)
+            elif isinstance(go, Tensor):
+                seed = go
+            else:
+                seed = Tensor(jnp.asarray(go), stop_gradient=True)
+            _backward_recorded(o, seed, wanted, table, retain)
+        results = []
+        for t in inputs:
+            g = table.get(id(t))
+            if g is None and not allow_unused:
+                raise RuntimeError(f"input {t.name} unused in graph "
+                                   "(pass allow_unused=True to permit)")
+            results.append(g)
+        return results
     # run a private backward that records into a side table
     saved = [(t, t.grad, t._retain_grads, t.stop_gradient) for t in inputs]
     for t in inputs:

@@ -90,16 +90,6 @@ def dygraph_mode_guard():
 
 
 @contextlib.contextmanager
-def dygraph_mode_guard():
-    prev = _state.static_mode
-    _state.static_mode = False
-    try:
-        yield
-    finally:
-        _state.static_mode = prev
-
-
-@contextlib.contextmanager
 def amp_guard_state(state):
     prev = _state.amp_state
     _state.amp_state = state

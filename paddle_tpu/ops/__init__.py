@@ -12,8 +12,11 @@ from .linalg import (  # noqa: F401
     eigvals, eigvalsh, qr, lstsq, solve, triangular_solve, matrix_rank, pinv,
     cond, multi_dot, cross, bincount,
 )
+# NB: control_flow.cond is deliberately NOT star-exported — the public
+# ``cond`` stays linalg's matrix condition number (reference has no top-level
+# paddle.cond; control-flow cond lives at static.nn.cond / ops.control_flow.cond)
 from .control_flow import (  # noqa: F401
-    while_loop, cond, case, switch_case,
+    while_loop, case, switch_case,
 )
 from .math_ext import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403

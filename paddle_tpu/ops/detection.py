@@ -111,12 +111,14 @@ def _prior_box_fn(feat_h, feat_w, im_h, im_w, min_sizes=(), max_sizes=(),
     cy = (jnp.arange(feat_h) + offset) * sh
     cx = (jnp.arange(feat_w) + offset) * sw
     boxes = []
-    for ms in min_sizes:
+    # prior_box_op.h pairs min_sizes[i] with max_sizes[i] (not a cross
+    # product): per min size, the AR variants then one sqrt(min*max) square
+    for i, ms in enumerate(min_sizes):
         for ar in ars:
             w, h = ms * (ar ** 0.5), ms / (ar ** 0.5)
             boxes.append((w, h))
-        for mx in max_sizes:
-            s = (ms * mx) ** 0.5
+        if i < len(max_sizes):
+            s = (ms * max_sizes[i]) ** 0.5
             boxes.append((s, s))
     wh = jnp.asarray(boxes, jnp.float32)  # [A, 2]
     grid_y, grid_x = jnp.meshgrid(cy, cx, indexing="ij")

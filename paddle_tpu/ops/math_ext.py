@@ -294,10 +294,18 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
     """unique_consecutive_op.cc (host; output size is data-dependent)."""
     import numpy as np
     xv = np.asarray(unwrap(x))
-    flat = xv if axis is not None else xv.reshape(-1)
+    if axis is None:
+        flat = xv.reshape(-1)
+    else:
+        flat = np.moveaxis(xv, int(axis), 0)
     keep = np.ones(len(flat), bool)
-    keep[1:] = flat[1:] != flat[:-1]
+    if len(flat) > 1:
+        diff = flat[1:] != flat[:-1]
+        keep[1:] = diff.reshape(len(flat) - 1, -1).any(axis=1) \
+            if diff.ndim > 1 else diff
     out = flat[keep]
+    if axis is not None:
+        out = np.moveaxis(out, 0, int(axis))
     rets = [Tensor(jnp.asarray(out))]
     if return_inverse:
         inv = np.cumsum(keep) - 1

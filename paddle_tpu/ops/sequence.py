@@ -118,7 +118,7 @@ def _sequence_slice_fn(x, offset, length, max_len):
     """Per-row slice [offset, offset+length) left-aligned into a
     [B, max_len, ...] buffer (sequence_slice_op.h)."""
     T = x.shape[1]
-    idx = jnp.arange(T)[None, :]
+    idx = jnp.arange(max_len)[None, :]
     src = jnp.clip(idx + jnp.reshape(offset, (-1, 1)), 0, T - 1)
     g = jnp.take_along_axis(
         x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
@@ -138,12 +138,6 @@ _seq_last = Primitive("sequence_last_step", _sequence_last_step_fn)
 _seq_erase = Primitive("sequence_erase", _sequence_erase_fn,
                        multi_output=True, differentiable=False)
 _seq_slice = Primitive("sequence_slice", _sequence_slice_fn)
-
-
-def _wrap2(prim):
-    def f(x, lengths, **kw):
-        return prim(x, lengths, **kw)
-    return f
 
 
 def sequence_pool(x, lengths, pool_type="SUM", name=None):
@@ -185,8 +179,11 @@ def sequence_erase(x, lengths, tokens, name=None):
     return _seq_erase(x, lengths, tokens=tuple(int(t) for t in tokens))
 
 
-def sequence_slice(x, offset, length, name=None):
-    return _seq_slice(x, offset, length, max_len=int(unwrap(x).shape[1]))
+def sequence_slice(x, offset, length, max_len=None, name=None):
+    """Output width is max_len when given, else the input's time dim."""
+    if max_len is None:
+        max_len = int(unwrap(x).shape[1])
+    return _seq_slice(x, offset, length, max_len=int(max_len))
 
 
 def sequence_expand(x, y_lengths, name=None):

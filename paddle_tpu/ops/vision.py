@@ -37,11 +37,11 @@ def _grid_sample_fn(x, grid, mode="bilinear", padding_mode="zeros",
                 span = 2 * (size - 1)
                 v = jnp.abs(jnp.mod(v, span))
                 return jnp.where(v > size - 1, span - v, v)
-            span = 2 * size
-            v = jnp.mod(v + 0.5, span)
-            v = jnp.abs(v) - 0.5
-            return jnp.clip(jnp.where(v > size - 1, span - 1 - v - 1, v),
-                            0, size - 1)
+            # borders at -0.5 and size-0.5: shift so borders land on 0 and
+            # size, fold the triangular wave, shift back
+            v = jnp.mod(v + 0.5, 2 * size)
+            v = jnp.where(v >= size, 2 * size - v, v) - 0.5
+            return jnp.clip(v, 0, size - 1)
         gx = reflect(gx, W)
         gy = reflect(gy, H)
 

@@ -43,9 +43,9 @@ def test_while_loop_eager_grad():
 def test_cond_eager():
     a = paddle.to_tensor(np.float32(3.0))
     b = paddle.to_tensor(np.float32(5.0))
-    out = ops.cond(a < b, lambda: a + b, lambda: a - b)
+    out = ops.control_flow.cond(a < b, lambda: a + b, lambda: a - b)
     assert float(out) == 8.0
-    out = ops.cond(a > b, lambda: a + b, lambda: a - b)
+    out = ops.control_flow.cond(a > b, lambda: a + b, lambda: a - b)
     assert float(out) == -2.0
 
 
@@ -71,7 +71,7 @@ def test_while_loop_traced():
     def collatz_steps(n0):
         i, n = ops.while_loop(
             lambda i, n: n > 1,
-            lambda i, n: (i + 1, ops.cond((n % 2) == 0,
+            lambda i, n: (i + 1, ops.control_flow.cond((n % 2) == 0,
                                           lambda: n // 2,
                                           lambda: 3 * n + 1)),
             [Tensor(jnp.int32(0)), Tensor(n0)])
@@ -85,7 +85,7 @@ def test_cond_traced_grad():
     from paddle_tpu.framework.tensor import Tensor
 
     def f(x):
-        out = ops.cond(Tensor(x) > 0,
+        out = ops.control_flow.cond(Tensor(x) > 0,
                        lambda: Tensor(x) * 2,
                        lambda: Tensor(x) * -3)
         return out._value
