@@ -1,0 +1,76 @@
+"""Launcher CLI tests (VERDICT r1 item 10).
+
+Reference parity: test_launch_coverage.sh / launch_utils.py:517 — drive
+``python -m paddle_tpu.distributed.fleet.launch`` as a subprocess with an
+env-faked topology on the CPU backend; assert every rank runs with the right
+env, and that fail-fast teardown kills surviving ranks when one dies.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launch(tmp_path, script_body, nproc=2, extra_env=None):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.fleet.launch",
+         "--nproc_per_node", str(nproc),
+         "--log_dir", str(tmp_path / "logs"), str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_launch_runs_all_ranks(tmp_path):
+    marker = tmp_path / "rank"
+    proc = _run_launch(tmp_path, f"""
+        import os
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        nranks = os.environ["PADDLE_TRAINERS_NUM"]
+        eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        cur = os.environ["PADDLE_CURRENT_ENDPOINT"]
+        assert len(eps) == int(nranks) == 2, (eps, nranks)
+        assert cur == eps[int(rank)]
+        open(r"{marker}" + rank, "w").write(cur)
+    """)
+    assert proc.returncode == 0, proc.stderr
+    assert (tmp_path / "rank0").exists() and (tmp_path / "rank1").exists()
+    # distinct endpoints per rank
+    assert (tmp_path / "rank0").read_text() != (tmp_path / "rank1").read_text()
+
+
+def test_launch_failfast_teardown(tmp_path):
+    """Rank 1 dies; rank 0 (an infinite sleeper) must be torn down and the
+    launcher must exit nonzero — watch_local_trainers fail-fast parity."""
+    proc = _run_launch(tmp_path, """
+        import os, sys, time
+        if os.environ["PADDLE_TRAINER_ID"] == "1":
+            sys.exit(3)
+        time.sleep(300)   # would hang forever without fail-fast SIGTERM
+    """)
+    assert proc.returncode != 0
+    # reaching here within the timeout proves the sleeper was SIGTERMed
+    logs = (tmp_path / "logs")
+    assert (logs / "workerlog.0").exists() and (logs / "workerlog.1").exists()
+
+
+def test_launch_role_maker_reads_env(tmp_path):
+    """fleet.init inside a launched worker sees the faked cluster topology
+    (PaddleCloudRoleMaker env parsing, role_maker.py:528 parity)."""
+    proc = _run_launch(tmp_path, """
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import paddle_tpu.distributed.fleet as fleet
+        fleet.init()
+        assert fleet.worker_num() == 2, fleet.worker_num()
+        assert fleet.worker_index() in (0, 1)
+    """)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
