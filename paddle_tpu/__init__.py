@@ -31,7 +31,8 @@ import importlib as _importlib
 
 _SUBPACKAGES = ["nn", "optimizer", "static", "io", "metric", "amp", "jit",
                 "distributed", "vision", "text", "autograd", "hapi",
-                "incubate", "inference", "profiler", "device"]
+                "incubate", "inference", "profiler", "device",
+                "quantization"]
 for _name in _SUBPACKAGES:
     try:
         globals()[_name] = _importlib.import_module(f".{_name}", __name__)

@@ -68,20 +68,136 @@ def _pool(kind, x, kernel_size, stride, padding, nsp, data_format, exclusive=Tru
                        channel_last=channel_last, nsp=nsp, exclusive=exclusive)
 
 
+def _max_pool_mask_fn(x, kernel=(2, 2), stride=(2, 2), padding=((0, 0),),
+                      nsp=2):
+    """Max pool + argmax indices (max_pool2d_with_index_op.cc). NC-first
+    only. Indices are flat offsets into the input's spatial volume — the
+    layout unpool_op.cc consumes. TPU-shape: one patches-extraction
+    (conv_general_dilated_patches) + argmax, no serial window walk."""
+    N, C = x.shape[:2]
+    spatial = x.shape[2:]
+    pad = padding
+    neg = jnp.asarray(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                      else jnp.iinfo(x.dtype).min, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0)) + tuple(pad), constant_values=neg)
+    out_sp = tuple((xp.shape[2 + d] - kernel[d]) // stride[d] + 1
+                   for d in range(nsp))
+    # exact patch extraction by strided slicing (one slice per kernel tap;
+    # no conv/matmul, so no precision loss under bf16 matmul defaults)
+    taps = []
+    for loc in np.ndindex(*kernel):
+        idx = (slice(None), slice(None)) + tuple(
+            slice(loc[d], loc[d] + stride[d] * out_sp[d], stride[d])
+            for d in range(nsp))
+        taps.append(xp[idx])
+    patches = jnp.stack(taps, axis=2)                    # [N, C, K, *out_sp]
+    pooled = jnp.max(patches, axis=2)
+    local = jnp.argmax(patches, axis=2)                  # [N, C, *out_sp]
+    # local index (row-major within the window) -> global flat spatial index
+    flat = jnp.zeros(local.shape, dtype=jnp.int32)
+    strides_sp = []
+    acc = 1
+    for s in reversed(spatial):
+        strides_sp.insert(0, acc)
+        acc *= s
+    # per spatial dim: window origin at each output position + local coord
+    for d, (k, st, sp_stride) in enumerate(zip(kernel, stride, strides_sp)):
+        origin = (jnp.arange(out_sp[d]) * st -
+                  (0 if isinstance(pad, str) else pad[d][0]))
+        shape = [1] * local.ndim
+        shape[2 + d] = out_sp[d]
+        origin = origin.reshape(shape)
+        inner = int(np.prod(kernel[d + 1:]))
+        coord = (local // inner) % k
+        flat = flat + (origin + coord) * sp_stride
+    return pooled, flat
+
+
+_max_pool_mask_p = Primitive("max_pool_with_index", _max_pool_mask_fn,
+                             multi_output=True)
+
+
+def _pool_with_mask(x, kernel_size, stride, padding, nsp):
+    kernel = _norm_tuple(kernel_size, nsp)
+    strd = _norm_tuple(stride if stride is not None else kernel_size, nsp)
+    pad = _norm_padding(padding, nsp)
+    if isinstance(pad, str):
+        raise ValueError("return_mask needs explicit int padding")
+    return _max_pool_mask_p(x, kernel=kernel, stride=strd, padding=pad,
+                            nsp=nsp)
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        if data_format != "NCL":
+            raise ValueError("return_mask requires NCL")
+        return _pool_with_mask(x, kernel_size, stride, padding, 1)
     df = "NWC" if data_format == "NLC" else "NCW"
     return _pool("max", x, kernel_size, stride, padding, 1, df)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if data_format != "NCHW":
+            raise ValueError("return_mask requires NCHW")
+        return _pool_with_mask(x, kernel_size, stride, padding, 2)
     return _pool("max", x, kernel_size, stride, padding, 2, data_format)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        if data_format != "NCDHW":
+            raise ValueError("return_mask requires NCDHW")
+        return _pool_with_mask(x, kernel_size, stride, padding, 3)
     return _pool("max", x, kernel_size, stride, padding, 3, data_format)
+
+
+def _max_unpool_fn(x, indices, out_spatial=(4, 4)):
+    """unpool_op.cc: scatter pooled values back to their argmax positions;
+    everything else zero. indices are flat offsets into out_spatial."""
+    N, C = x.shape[:2]
+    vol = int(np.prod(out_spatial))
+    vals = x.reshape(N * C, -1)
+    idx = indices.reshape(N * C, -1)
+    out = jnp.zeros((N * C, vol), x.dtype)
+    rows = jnp.arange(N * C)[:, None]
+    out = out.at[rows, idx].set(vals)
+    return out.reshape((N, C) + tuple(out_spatial))
+
+
+_max_unpool_p = Primitive("max_unpool", _max_unpool_fn)
+
+
+def _unpool(x, indices, kernel_size, stride, padding, output_size, nsp):
+    kernel = _norm_tuple(kernel_size, nsp)
+    strd = _norm_tuple(stride if stride is not None else kernel_size, nsp)
+    padt = _norm_tuple(padding, nsp)
+    xs = x.shape[2:] if hasattr(x, "shape") else unwrap(x).shape[2:]
+    if output_size is None:
+        out_sp = tuple((xs[i] - 1) * strd[i] - 2 * padt[i] + kernel[i]
+                       for i in range(nsp))
+    else:
+        out_sp = tuple(output_size)[-nsp:]
+    return _max_unpool_p(x, unwrap(indices), out_spatial=out_sp)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d(return_mask=True) (unpool_op.cc)."""
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 3)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

@@ -22,9 +22,14 @@ from .math_ext import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 from .vision import *  # noqa: F401,F403
+from .decode import (  # noqa: F401
+    gather_tree, beam_search_step, beam_search_decode, beam_search,
+    linear_chain_crf, crf_decoding, viterbi_decode, edit_distance,
+)
+from .linalg import cov, corrcoef  # noqa: F401
 from . import (  # noqa: F401
     creation, math, manipulation, linalg, control_flow, math_ext, sequence,
-    detection, vision,
+    detection, vision, decode,
 )
 from .patch import apply_patches as _apply_patches
 

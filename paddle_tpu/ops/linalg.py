@@ -176,4 +176,35 @@ def bincount(x, weights=None, minlength=0, name=None):
     return _bincount(x, length=length)
 
 
-_histogramdd = None  # niche; not in round-1 surface
+_cov = Primitive("cov", lambda x, ddof=1: jnp.cov(x, ddof=ddof))
+_cov_w = Primitive(
+    "cov_weighted",
+    lambda x, fw, aw, ddof=1: jnp.cov(x, ddof=ddof, fweights=fw,
+                                      aweights=aw))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    """paddle.linalg.cov: covariance of rows (or columns) of a 2-D tensor."""
+    xt = x if isinstance(x, Tensor) else Tensor(unwrap(x))
+    if not rowvar and len(xt.shape) == 2:
+        from .manipulation import transpose
+        xt = transpose(xt, [1, 0])     # stays on the tape
+    if fweights is not None or aweights is not None:
+        n = xt.shape[-1]
+        fw = jnp.ones((n,), jnp.int32) if fweights is None \
+            else unwrap(fweights)
+        aw = jnp.ones((n,), jnp.float32) if aweights is None \
+            else unwrap(aweights)
+        return _cov_w(xt, fw, aw, ddof=1 if ddof else 0)
+    return _cov(xt, ddof=1 if ddof else 0)
+
+
+_corrcoef = Primitive("corrcoef", jnp.corrcoef)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    """paddle.linalg.corrcoef: normalised covariance (correlation matrix)."""
+    xv = unwrap(x)
+    if not rowvar and xv.ndim == 2:
+        xv = xv.T
+    return _corrcoef(Tensor(xv) if isinstance(x, Tensor) else xv)

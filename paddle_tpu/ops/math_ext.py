@@ -436,6 +436,100 @@ def reduce_min(x, dim=None, keep_dim=False, name=None):
     return _m.min(x, axis=dim, keepdim=keep_dim)
 
 
+# -- long-tail additions (round 2) --------------------------------------------
+
+polar = _binary("polar", lambda r, t: jax.lax.complex(r * jnp.cos(t),
+                                                      r * jnp.sin(t)))
+sgn = _unary("sgn", lambda x: jnp.where(
+    jnp.abs(x) == 0, jnp.zeros_like(x), x / jnp.abs(x))
+    if jnp.iscomplexobj(x) else jnp.sign(x))
+isposinf = _unary("isposinf", jnp.isposinf, differentiable=False)
+isneginf = _unary("isneginf", jnp.isneginf, differentiable=False)
+
+
+def _take_fn(x, idx, mode="raise"):
+    flat = x.reshape(-1)
+    if mode in ("raise", "clip"):
+        idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
+        return jnp.take(flat, idx, mode="clip")
+    return jnp.take(flat, idx, mode=mode)
+
+
+_take = Primitive("take", _take_fn)
+
+
+def take(x, index, mode="raise", name=None):
+    """take_op parity (paddle.take): flattened gather with clip/wrap modes.
+    ``raise`` degrades to clip under jit (no data-dependent errors on TPU)."""
+    return _take(x, unwrap(index), mode=mode)
+
+
+def reverse(x, axis, name=None):
+    """reverse_op.cc (fluid.layers.reverse): flip along the given axes."""
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+_nanquantile = Primitive(
+    "nanquantile", lambda x, q, axis=None, keepdim=False:
+    jnp.nanquantile(x, q, axis=axis, keepdims=keepdim))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return _nanquantile(x, q=q, axis=axis, keepdim=keepdim)
+
+
+def _histogramdd_fn(x, weights=None, bins=10, ranges=None, density=False):
+    h, edges = jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                               weights=weights)
+    return (h,) + tuple(edges)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """histogramdd (paddle.histogramdd). Returns (hist, [edges...]).
+    ``ranges`` uses paddle's flat [min0, max0, min1, max1, ...] layout."""
+    x = unwrap(x)
+    w = None if weights is None else unwrap(weights)
+    if ranges is not None:
+        r = [float(v) for v in ranges]
+        ranges = [(r[2 * i], r[2 * i + 1]) for i in range(len(r) // 2)]
+    h, *edges = _histogramdd_fn(x, w, bins=bins, ranges=ranges,
+                                density=density)
+    return Tensor(h), [Tensor(e) for e in edges]
+
+
+def _partial_concat_fn(*xs, start_index=0, length=-1):
+    sl = [x[:, start_index:] if length < 0
+          else x[:, start_index:start_index + length] for x in xs]
+    return jnp.concatenate(sl, axis=1)
+
+
+_partial_concat = Primitive("partial_concat", _partial_concat_fn)
+
+
+def partial_concat(x, start_index=0, length=-1, name=None):
+    """partial_concat_op.cc: concat a [start:start+length] column slice of
+    each [B, D] input."""
+    return _partial_concat(*[unwrap(t) for t in x],
+                           start_index=int(start_index), length=int(length))
+
+
+def _partial_sum_fn(*xs, start_index=0, length=-1):
+    sl = [x[:, start_index:] if length < 0
+          else x[:, start_index:start_index + length] for x in xs]
+    return sum(sl[1:], sl[0])
+
+
+_partial_sum = Primitive("partial_sum", _partial_sum_fn)
+
+
+def partial_sum(x, start_index=0, length=-1, name=None):
+    """partial_sum_op.cc: sum the same column slice of each input."""
+    return _partial_sum(*[unwrap(t) for t in x],
+                        start_index=int(start_index), length=int(length))
+
+
 __all__ = [
     "logaddexp", "heaviside", "gcd", "lcm", "copysign", "nextafter",
     "signbit", "sinc", "exp2", "erfc", "ldexp", "nanmean", "nanmedian",
@@ -445,4 +539,6 @@ __all__ = [
     "diag_embed", "unique_consecutive", "tensor_split", "unflatten",
     "block_diag", "complex", "tensordot", "vander", "renorm", "accuracy",
     "rank", "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+    "polar", "sgn", "isposinf", "isneginf", "take", "reverse",
+    "nanquantile", "histogramdd", "partial_concat", "partial_sum",
 ]

@@ -1,0 +1,173 @@
+"""Quantization tests: fake-quant ops (STE gradients), QAT layer swapping +
+training, out-scale collection, PTQ calibration, weight-only int8.
+
+Reference strategy parity: test_fake_quantize_op.py (quant-dequant numeric
+checks), test_imperative_qat.py (swap + train + eval), test_post_training_
+quantization_mnist.py (calibrate on batches then compare outputs),
+test_weight_quantization_mobilenetv1.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    ImperativeQuantAware, PostTrainingQuantization, WeightQuantization,
+    QuantizedConv2D, QuantizedLinear,
+    fake_quantize_dequantize_abs_max,
+    fake_channel_wise_quantize_dequantize_abs_max,
+    fake_quantize_dequantize_moving_average_abs_max,
+    quantize_weight_int8, dequantize_weight,
+)
+
+
+def _qdq_ref(x, scale, bits=8):
+    qmax = 2 ** (bits - 1) - 1
+    s = max(scale, 1e-9)
+    return np.round(np.clip(x / s, -1, 1) * qmax) * (s / qmax)
+
+
+def test_fake_qdq_abs_max_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 5).astype("float32")
+    out, scale = fake_quantize_dequantize_abs_max(paddle.to_tensor(x))
+    assert abs(float(scale.numpy()) - np.abs(x).max()) < 1e-6
+    assert np.allclose(out.numpy(), _qdq_ref(x, np.abs(x).max()), atol=1e-6)
+    # max quantization error is scale / qmax / 2
+    assert np.abs(out.numpy() - x).max() <= np.abs(x).max() / 127 / 2 + 1e-6
+
+
+def test_fake_qdq_channel_wise():
+    rng = np.random.RandomState(1)
+    w = rng.randn(6, 4, 3, 3).astype("float32")
+    out, scales = fake_channel_wise_quantize_dequantize_abs_max(
+        paddle.to_tensor(w), quant_axis=0)
+    assert list(scales.shape) == [6]
+    for c in range(6):
+        assert np.allclose(out.numpy()[c],
+                           _qdq_ref(w[c], np.abs(w[c]).max()), atol=1e-6)
+
+
+def test_fake_qdq_ste_gradient():
+    x = paddle.to_tensor(np.array([-2.0, -0.5, 0.3, 1.5], "float32"),
+                         stop_gradient=False)
+    out, _ = fake_quantize_dequantize_abs_max(x)
+    loss = paddle.sum(out)
+    loss.backward()
+    # straight-through: grad 1 everywhere inside [-max_abs, max_abs]
+    assert np.allclose(x.grad.numpy(), np.ones(4), atol=1e-6)
+
+
+def test_fake_qdq_moving_average_state():
+    x1 = paddle.to_tensor(np.full((3,), 2.0, "float32"))
+    s = paddle.to_tensor(np.array(1.0, "float32"))
+    a = paddle.to_tensor(np.array(1.0, "float32"))
+    st = paddle.to_tensor(np.array(1.0, "float32"))
+    out, s1, a1, st1 = fake_quantize_dequantize_moving_average_abs_max(
+        x1, s, a, st, moving_rate=0.9)
+    # accum = 0.9*1 + 2 = 2.9 ; state = 0.9*1 + 1 = 1.9
+    assert abs(float(a1.numpy()) - 2.9) < 1e-6
+    assert abs(float(st1.numpy()) - 1.9) < 1e-6
+    assert abs(float(s1.numpy()) - 2.9 / 1.9) < 1e-6
+    # is_test: state unchanged, uses in_scale
+    out2, s2, a2, st2 = fake_quantize_dequantize_moving_average_abs_max(
+        x1, s1, a1, st1, is_test=True)
+    assert float(a2.numpy()) == float(a1.numpy())
+
+
+class _SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(1, 4, 3, padding=1)
+        self.relu = nn.ReLU()
+        self.fc = nn.Linear(4 * 4 * 4, 10)
+
+    def forward(self, x):
+        h = self.relu(self.conv(x))
+        h = paddle.reshape(h, [h.shape[0], -1])
+        return self.fc(h)
+
+
+def test_imperative_qat_swaps_and_trains():
+    model = _SmallNet()
+    ImperativeQuantAware().quantize(model)
+    assert isinstance(model.conv, QuantizedConv2D)
+    assert isinstance(model.fc, QuantizedLinear)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.randn(8, 1, 4, 4).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 10, (8,)))
+    losses = []
+    for _ in range(12):
+        logits = model(x)
+        loss = paddle.nn.functional.cross_entropy(logits, y)
+        loss.backward()
+        opt.step()
+        opt.minimize(loss) if False else None
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], losses  # QAT model actually learns
+    # moving-average scale was updated away from init
+    assert float(model.fc._fake_quant_input.scale.numpy()) != 1.0
+
+
+def test_qat_eval_close_to_fp32():
+    paddle.seed(3)
+    rng = np.random.RandomState(3)
+    fp32 = _SmallNet()
+    x = paddle.to_tensor(rng.randn(4, 1, 4, 4).astype("float32"))
+    ref = fp32(x).numpy()
+    ImperativeQuantAware().quantize(fp32)
+    fp32.train()
+    for _ in range(30):   # converge the moving-average scales
+        fp32(x)
+    fp32.eval()
+    got = fp32(x).numpy()
+    # int8 simulation stays close to fp32 on a small net
+    assert np.abs(got - ref).max() < 0.2, np.abs(got - ref).max()
+
+
+def test_post_training_quantization():
+    paddle.seed(4)           # model init must not depend on test order
+    rng = np.random.RandomState(4)
+    model = _SmallNet()
+    x_ref = paddle.to_tensor(rng.randn(4, 1, 4, 4).astype("float32"))
+    ref = model(x_ref).numpy()
+
+    def loader():
+        for _ in range(4):
+            yield (paddle.to_tensor(
+                rng.randn(4, 1, 4, 4).astype("float32")),)
+
+    ptq = PostTrainingQuantization(model=model, data_loader=loader(),
+                                   batch_nums=4, algo="abs_max")
+    qmodel = ptq.quantize()
+    assert isinstance(qmodel.conv, QuantizedConv2D)
+    # calibrated scale must be positive and roughly the observed abs-max
+    s = float(qmodel.fc._fake_quant_input.scale.numpy())
+    assert s > 0.1
+    got = qmodel(x_ref).numpy()
+    assert np.abs(got - ref).max() < 0.25
+
+
+def test_weight_quantization_int8_roundtrip():
+    rng = np.random.RandomState(5)
+    w = rng.randn(8, 3, 3, 3).astype("float32")
+    q, s = quantize_weight_int8(paddle.to_tensor(w), quant_axis=0)
+    assert q.numpy().dtype == np.int8
+    deq = dequantize_weight(q, s).numpy()
+    # error bounded by half a quantization step per channel
+    step = np.abs(w).reshape(8, -1).max(axis=1) / 127
+    assert (np.abs(deq - w).reshape(8, -1).max(axis=1) <=
+            step / 2 + 1e-7).all()
+
+
+def test_weight_quantization_model():
+    model = _SmallNet()
+    w0 = model.fc.weight.numpy().copy()
+    packed = WeightQuantization(model).quantize_weight_to_int8()
+    assert "fc" in packed and "conv" in packed
+    w1 = model.fc.weight.numpy()
+    assert not np.array_equal(w0, w1)        # weights were re-quantized
+    assert np.abs(w0 - w1).max() < np.abs(w0).max() / 64
