@@ -18,3 +18,10 @@ from .random import seed, get_rng_state, set_rng_state, default_generator  # noq
 from .tensor import Tensor, Parameter, to_tensor, unwrap, wrap  # noqa: F401
 from .autograd import grad, run_backward  # noqa: F401
 from .primitive import Primitive, primitive, get_primitive, all_primitives  # noqa: F401
+from . import enforce  # noqa: F401
+from .enforce import (  # noqa: F401
+    EnforceNotMet, InvalidArgumentError, NotFoundError, OutOfRangeError,
+    AlreadyExistsError, ResourceExhaustedError, PreconditionNotMetError,
+    PermissionDeniedError, ExecutionTimeoutError, UnimplementedError,
+    UnavailableError, FatalError, ExternalError,
+)

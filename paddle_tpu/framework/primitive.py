@@ -150,7 +150,14 @@ class Primitive:
                     for a in arrs)
 
         key = _attrs_key(attrs)
-        out = self._fwd(key, attrs)(*arrs)
+        try:
+            out = self._fwd(key, attrs)(*arrs)
+        except Exception as e:   # re-raise with op provenance (enforce.py)
+            from .enforce import EnforceNotMet, op_context
+            if isinstance(e, EnforceNotMet):
+                raise
+            with op_context(self.name, arrs):
+                raise
 
         if flag("benchmark"):
             jax.block_until_ready(out)

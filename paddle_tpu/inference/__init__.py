@@ -96,16 +96,42 @@ class Predictor:
     def __init__(self, config: Config):
         from ..static.io import load_inference_model
         from ..static.executor import Executor
-        d = config.model_dir()
+        d = config.model_dir() or config.prog_file()
         if d is None:
             raise ValueError("Config needs a model dir (save_inference_model"
                              " output or jit.save prefix dir)")
-        self._program, self._feed_names, self._fetch_vars = \
-            load_inference_model(d)
-        self._fetch_names = [v.name for v in self._fetch_vars]
-        self._exe = Executor()
+        self._translated = None
+        prefix = self._jit_prefix(d)
+        if prefix is not None:
+            # jit.save'd model (StableHLO + params): dynamic dims exported
+            # as symbolic shapes, so any batch size runs without recompile
+            from .. import jit as _jit
+            self._translated = _jit.load(prefix)
+            self._feed_names = [f"x{i}" for i in range(
+                self._translated.num_inputs)]
+            self._fetch_names = [f"out{i}" for i in range(
+                self._translated.num_outputs)]
+        else:
+            self._program, self._feed_names, self._fetch_vars = \
+                load_inference_model(d)
+            self._fetch_names = [v.name for v in self._fetch_vars]
+            self._exe = Executor()
         self._feeds: Dict[str, np.ndarray] = {}
         self._results: Dict[str, np.ndarray] = {}
+
+    @staticmethod
+    def _jit_prefix(d):
+        import glob
+        if d.endswith(".pdmodel"):
+            return d[:-len(".pdmodel")]
+        if os.path.isfile(d + ".pdmodel"):
+            return d
+        if os.path.isdir(d) and not os.path.exists(
+                os.path.join(d, "__model__")):
+            pdm = sorted(glob.glob(os.path.join(d, "*.pdmodel")))
+            if pdm:
+                return pdm[0][:-len(".pdmodel")]
+        return None
 
     def get_input_names(self) -> List[str]:
         return list(self._feed_names)
@@ -126,8 +152,14 @@ class Predictor:
             for name, arr in zip(self._feed_names, inputs):
                 self._feeds[name] = np.asarray(
                     arr.numpy() if isinstance(arr, Tensor) else arr)
-        outs = self._exe.run(self._program, feed=dict(self._feeds),
-                             fetch_list=self._fetch_names)
+        if self._translated is not None:
+            out = self._translated(
+                *[self._feeds[n] for n in self._feed_names])
+            outs = [np.asarray(o.numpy()) for o in
+                    (out if isinstance(out, (list, tuple)) else [out])]
+        else:
+            outs = self._exe.run(self._program, feed=dict(self._feeds),
+                                 fetch_list=self._fetch_names)
         self._results = dict(zip(self._fetch_names, outs))
         return [self._results[n] for n in self._fetch_names]
 

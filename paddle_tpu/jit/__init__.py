@@ -6,14 +6,15 @@ with per-input-signature ConcreteProgram cache (:579), executed by
 PartialProgramLayer via run_program_op (partial_program.py:108); jit.save /
 jit.load + TranslatedLayer (dygraph/io.py).
 
-TPU-first: no AST rewriting — jax tracing IS the translator.  A @to_static
-function becomes, per input signature, a dynamically registered framework
-primitive whose forward is the traced whole-function XLA computation and
-whose backward is its derived VJP — so it composes with the eager tape
-exactly like any single op (the run_program_op analogue, but compiled).
-Python control flow is captured at trace time; data-dependent control flow
-should use paddle.where / lax-style ops (matching XLA's model rather than
-emulating Python loops with a While graph op).
+TPU-first: jax tracing is the translator, fronted by a slim AST pass
+(dy2static.py) that rewrites Python if/while over Tensors into
+lax.cond/lax.while_loop converter calls — so data-dependent control flow
+compiles into real XLA control flow instead of freezing at trace time.
+A @to_static function becomes, per input signature, a dynamically
+registered framework primitive whose forward is the traced whole-function
+XLA computation and whose backward is its derived VJP — so it composes
+with the eager tape exactly like any single op (the run_program_op
+analogue, but compiled).
 
 jit.save exports serialized StableHLO (jax.export) + params; jit.load wraps
 it in a TranslatedLayer. The export is hardware-portable (any PJRT backend).
@@ -65,6 +66,29 @@ class StaticFunction:
         return StaticFunction(self._function.__get__(instance, owner),
                               self._input_spec, layer=instance)
 
+    def _ast_converted(self):
+        """AST-rewrite Python if/while into lax control flow before tracing
+        (dy2static.py; ast_transformer.py parity). Falls back to the
+        original function when the source can't be transformed — then
+        data-dependent branching surfaces as jax's tracer-bool error
+        instead of being silently frozen."""
+        if not hasattr(self, "_ast_fn"):
+            from .dy2static import ast_transform
+            fn = self._function
+            raw = getattr(fn, "__func__", fn)
+            bound = getattr(fn, "__self__", None)
+            if bound is None and self._layer is not None:
+                # instance-wrapped form (to_static(layer) stores the raw
+                # unbound forward): bind the layer as self
+                bound = self._layer
+            try:
+                new = ast_transform(fn)
+            except Exception:
+                new = None
+            out = new if (new is not None and new is not raw) else raw
+            self._ast_fn = out.__get__(bound) if bound is not None else out
+        return self._ast_fn
+
     # -- concrete program construction --------------------------------------
     def _concrete(self, args, kwargs):
         layer = self._layer or getattr(self._function, "__self__", None)
@@ -72,8 +96,17 @@ class StaticFunction:
             layer = None
         param_names = [n for n, _ in layer.named_parameters()] if layer \
             else []
-        fn = self._function
-        n_args = len(args)
+        fn = self._ast_converted()
+        # non-Tensor positional args are STATIC constants (the signature
+        # cache keys on their values): a Python bool/int steering control
+        # flow must not become a traced array
+        def _dynamic(a):
+            return isinstance(a, Tensor) or (hasattr(a, "shape") and
+                                             hasattr(a, "dtype"))
+
+        t_idx = [i for i, a in enumerate(args) if _dynamic(a)]
+        const_args = {i: a for i, a in enumerate(args) if not _dynamic(a)}
+        n_args = len(t_idx)
         # Tensor-valued kwargs become dynamic inputs (NOT closed over: a
         # later call with a different Tensor must not reuse stale values)
         tkw_names = sorted(k for k, v in kwargs.items()
@@ -85,6 +118,9 @@ class StaticFunction:
             tkw_arrs = arrs[n_args:n_args + len(tkw_names)]
             param_arrs = arrs[n_args + len(tkw_names):-1]
             key = arrs[-1]
+            full_args = list(const_args.get(i) for i in range(len(args)))
+            for i, a in zip(t_idx, arg_arrs):
+                full_args[i] = Tensor(a)
             kw = dict(const_kw)
             kw.update({k: Tensor(a) for k, a in zip(tkw_names, tkw_arrs)})
             gen = random_mod.default_generator
@@ -93,9 +129,9 @@ class StaticFunction:
                 if layer is not None:
                     params = dict(zip(param_names, param_arrs))
                     with F._bound_state(layer, params, None):
-                        out = fn(*[Tensor(a) for a in arg_arrs], **kw)
+                        out = fn(*full_args, **kw)
                 else:
-                    out = fn(*[Tensor(a) for a in arg_arrs], **kw)
+                    out = fn(*full_args, **kw)
             finally:
                 gen.pop_traced_key()
             flat = out if isinstance(out, (tuple, list)) else (out,)
@@ -105,7 +141,7 @@ class StaticFunction:
         self._COUNTER[0] += 1
         name = f"@to_static_{getattr(fn, '__name__', 'fn')}_{self._COUNTER[0]}"
         prim = Primitive(name, pure, multi_output=True)
-        return prim, param_names, layer, tkw_names
+        return prim, param_names, layer, tkw_names, t_idx
 
     def __call__(self, *args, **kwargs):
         tkw = {k: v for k, v in kwargs.items() if isinstance(v, Tensor)}
@@ -117,10 +153,10 @@ class StaticFunction:
         if entry is None:
             entry = self._concrete(args, kwargs)
             self._cache[sig] = entry
-        prim, param_names, layer, tkw_names = entry
+        prim, param_names, layer, tkw_names, t_idx = entry
         params = dict(layer.named_parameters()) if layer else {}
         key = random_mod.default_generator.next_key()
-        ins = (list(args) + [kwargs[k] for k in tkw_names]
+        ins = ([args[i] for i in t_idx] + [kwargs[k] for k in tkw_names]
                + [params[n] for n in param_names] + [key])
         out = prim(*ins)
         if isinstance(out, tuple) and len(out) == 1:
@@ -162,6 +198,14 @@ class TranslatedLayer:
         self._exported = exported
         self._params = params
         self.training = False
+
+    @property
+    def num_inputs(self):
+        return len(self._exported.in_avals) - len(self._params)
+
+    @property
+    def num_outputs(self):
+        return len(self._exported.out_avals)
 
     def __call__(self, *args):
         arrs = [a._value if isinstance(a, Tensor) else np.asarray(a)

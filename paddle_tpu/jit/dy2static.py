@@ -1,0 +1,356 @@
+"""AST-based dygraph-to-static conversion.
+
+Reference parity: python/paddle/fluid/dygraph/dygraph_to_static/ —
+ast_transformer.py (DygraphToStaticAst, the 15-transformer pipeline),
+ifelse_transformer.py, loop_transformer.py, logical_transformer.py, and
+convert_operators.py (convert_ifelse / convert_while_loop /
+convert_logical_and...).
+
+TPU-shape: the reference rewrites Python control flow into
+cond_op/while_op graph ops; here the same AST rewrite targets the
+framework's ``ops.control_flow.cond`` / ``while_loop``, which lower to
+``lax.cond`` / ``lax.while_loop`` under the jax trace — so a @to_static
+function with data-dependent Python ``if``/``while`` compiles into real
+XLA control flow instead of being silently frozen at trace time (the
+round-1 gap).
+
+Mechanics: branches/bodies become nested functions that mutate the
+enclosing frame via ``nonlocal`` (the reference's get_args/set_args
+scheme); the runtime converters snapshot + restore those locals around
+each traced branch so both arms see the pre-branch state.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, unwrap
+from ..ops import control_flow as _cf
+
+
+class Dy2StaticError(RuntimeError):
+    pass
+
+
+def _is_traced(v):
+    x = unwrap(v)
+    return isinstance(x, jax.core.Tracer)
+
+
+def _is_tensorish(v):
+    return isinstance(v, Tensor) or isinstance(unwrap(v), jax.Array) \
+        or _is_traced(v)
+
+
+# -- runtime converters (convert_operators.py parity) ---------------------------
+
+def convert_ifelse(pred, true_fn, false_fn, get_args, set_args):
+    """convert_operators.py convert_ifelse: run both branches under
+    lax.cond when pred is a traced Tensor; plain Python branch otherwise."""
+    if _is_traced(pred):
+        try:
+            init = get_args()
+        except (NameError, UnboundLocalError) as e:
+            raise Dy2StaticError(
+                "variables assigned inside a Tensor-dependent `if` must be "
+                f"initialized before it ({e})") from e
+
+        def _branch(fn):
+            def run():
+                set_args(init)
+                fn()
+                return tuple(unwrap(v) for v in get_args())
+            return run
+
+        out = _cf.cond(pred, _branch(true_fn), _branch(false_fn))
+        out = out if isinstance(out, (tuple, list)) else (out,)
+        set_args(tuple(out))
+        return
+    if bool(unwrap(pred)):
+        true_fn()
+    else:
+        false_fn()
+
+
+def convert_while_loop(cond_fn, body_fn, get_args, set_args):
+    """convert_operators.py convert_while_loop: lax.while_loop when the
+    condition is traced; Python while otherwise."""
+    first = cond_fn()
+    if _is_traced(first):
+        try:
+            init = tuple(unwrap(v) for v in get_args())
+        except (NameError, UnboundLocalError) as e:
+            raise Dy2StaticError(
+                "loop variables of a Tensor-dependent `while` must be "
+                f"initialized before it ({e})") from e
+
+        def c(vals):
+            set_args(vals)
+            return jnp.reshape(unwrap(cond_fn()), ()).astype(bool)
+
+        def b(vals):
+            set_args(vals)
+            body_fn()
+            return tuple(jnp.asarray(unwrap(v)) for v in get_args())
+
+        out = jax.lax.while_loop(c, b, init)
+        set_args(tuple(out))
+        return
+    while bool(unwrap(cond_fn())):
+        body_fn()
+
+
+def convert_logical_and(x_fn, y_fn):
+    x = x_fn()
+    if _is_tensorish(x):
+        from ..ops import logical_and
+        return logical_and(x, y_fn())
+    return x and y_fn()
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    if _is_tensorish(x):
+        from ..ops import logical_or
+        return logical_or(x, y_fn())
+    return x or y_fn()
+
+
+def convert_logical_not(x):
+    if _is_tensorish(x):
+        from ..ops import logical_not
+        return logical_not(x)
+    return not x
+
+
+_JST = {
+    "_jst_ifelse": convert_ifelse,
+    "_jst_while": convert_while_loop,
+    "_jst_and": convert_logical_and,
+    "_jst_or": convert_logical_or,
+    "_jst_not": convert_logical_not,
+}
+
+
+# -- AST transformer ------------------------------------------------------------
+
+def _assigned_names(nodes):
+    """Names bound (Store ctx) in a statement list, excluding nested
+    function/class scopes."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        # function/class defs neither descend (new scope) nor count as
+        # branch outputs: a def is not a lax.cond-carriable value (and the
+        # transformer's own __pt_* helpers must never become loop vars)
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_ClassDef(self, node):
+            pass
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Store):
+                names.append(node.id)
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    out = []
+    for n in names:
+        if n not in out:
+            out.append(n)
+    return out
+
+
+def _has_escape(nodes):
+    """True if the statement list contains a return, or a break/continue
+    that would escape the branch (break/continue inside a nested loop
+    belong to that loop and are fine)."""
+    found = False
+
+    def walk(n, in_loop):
+        nonlocal found
+        if found:
+            return
+        if isinstance(n, ast.Return):
+            found = True
+            return
+        if isinstance(n, (ast.Break, ast.Continue)) and not in_loop:
+            found = True
+            return
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            return
+        nested = in_loop or isinstance(n, (ast.For, ast.AsyncFor,
+                                           ast.While))
+        for c in ast.iter_child_nodes(n):
+            walk(c, nested)
+
+    for n in nodes:
+        walk(n, False)
+    return found
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrite if/while into converter calls (ifelse_transformer.py /
+    loop_transformer.py)."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- helpers (build nodes from parsed templates so every field the
+    # running Python version requires — e.g. 3.12's type_params — is set)
+    def _fn_def(self, name, body, nonlocals):
+        f = ast.parse(f"def {name}():\n    pass").body[0]
+        stmts = []
+        if nonlocals:
+            stmts.append(ast.Nonlocal(names=list(nonlocals)))
+        stmts.extend(body)
+        f.body = stmts or [ast.Pass()]
+        return f
+
+    def _getter(self, name, names):
+        tup = ", ".join(names)
+        src = f"def {name}():\n    return ({tup}{',' if names else ''})"
+        return ast.parse(src).body[0]
+
+    def _setter(self, name, names):
+        if names:
+            tup = ", ".join(names)
+            src = (f"def {name}(__pt_vals):\n"
+                   f"    nonlocal {tup}\n"
+                   f"    ({tup},) = __pt_vals")
+        else:
+            src = f"def {name}(__pt_vals):\n    pass"
+        return ast.parse(src).body[0]
+
+    @staticmethod
+    def _initializers(names):
+        """Guarantee an enclosing-scope binding for every branch-assigned
+        name (ifelse_transformer's create_undefined_var): names already
+        bound keep their value; names first bound inside the branch start
+        as None."""
+        stmts = []
+        for n in names:
+            src = (f"try:\n    {n}\n"
+                   f"except (NameError, UnboundLocalError):\n"
+                   f"    {n} = None")
+            stmts.extend(ast.parse(src).body)
+        return stmts
+
+    # -- boolean operators in conditions --------------------------------------
+    @staticmethod
+    def _lambda_of(expr):
+        lam = ast.parse("lambda: 0", mode="eval").body
+        lam.body = expr
+        return lam
+
+    def _convert_bool_ops(self, node):
+        if isinstance(node, ast.BoolOp):
+            fn = "_jst_and" if isinstance(node.op, ast.And) else "_jst_or"
+            out = self._convert_bool_ops(node.values[-1])
+            for v in reversed(node.values[:-1]):
+                out = ast.Call(
+                    func=ast.Name(id=fn, ctx=ast.Load()),
+                    args=[self._lambda_of(self._convert_bool_ops(v)),
+                          self._lambda_of(out)],
+                    keywords=[])
+            return out
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return ast.Call(func=ast.Name(id="_jst_not", ctx=ast.Load()),
+                            args=[self._convert_bool_ops(node.operand)],
+                            keywords=[])
+        return node
+
+    # -- if ------------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node     # early return/break: keep Python semantics
+        uid = self._uid()
+        names = _assigned_names(node.body + node.orelse)
+        test = self._convert_bool_ops(node.test)
+        true_fn = self._fn_def(f"__pt_true_{uid}", node.body, names)
+        false_fn = self._fn_def(f"__pt_false_{uid}", node.orelse, names)
+        getter = self._getter(f"__pt_get_{uid}", names)
+        setter = self._setter(f"__pt_set_{uid}", names)
+        call = ast.Expr(value=ast.Call(
+            func=ast.Name(id="_jst_ifelse", ctx=ast.Load()),
+            args=[test,
+                  ast.Name(id=f"__pt_true_{uid}", ctx=ast.Load()),
+                  ast.Name(id=f"__pt_false_{uid}", ctx=ast.Load()),
+                  ast.Name(id=f"__pt_get_{uid}", ctx=ast.Load()),
+                  ast.Name(id=f"__pt_set_{uid}", ctx=ast.Load())],
+            keywords=[]))
+        return self._initializers(names) + \
+            [true_fn, false_fn, getter, setter, call]
+
+    # -- while ----------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or node.orelse:
+            return node
+        uid = self._uid()
+        names = _assigned_names(node.body)
+        test = self._convert_bool_ops(node.test)
+        cond_fn = ast.parse(f"def __pt_cond_{uid}():\n    return 0").body[0]
+        cond_fn.body[0].value = test
+        body_fn = self._fn_def(f"__pt_body_{uid}", node.body, names)
+        getter = self._getter(f"__pt_get_{uid}", names)
+        setter = self._setter(f"__pt_set_{uid}", names)
+        call = ast.Expr(value=ast.Call(
+            func=ast.Name(id="_jst_while", ctx=ast.Load()),
+            args=[ast.Name(id=f"__pt_cond_{uid}", ctx=ast.Load()),
+                  ast.Name(id=f"__pt_body_{uid}", ctx=ast.Load()),
+                  ast.Name(id=f"__pt_get_{uid}", ctx=ast.Load()),
+                  ast.Name(id=f"__pt_set_{uid}", ctx=ast.Load())],
+            keywords=[]))
+        return self._initializers(names) + \
+            [cond_fn, body_fn, getter, setter, call]
+
+
+def ast_transform(func):
+    """Rewrite ``func``'s if/while into converter calls. Returns the new
+    function, or None when the source is unavailable/untransformable
+    (lambdas, closures, C extensions) — callers fall back to plain tracing
+    (program_translator.py's to-static fallback)."""
+    raw = getattr(func, "__func__", func)
+    if raw.__closure__:          # can't rebuild closure cells faithfully
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []
+    t = _ControlFlowTransformer()
+    new_tree = t.visit(tree)
+    if t._n == 0:
+        return raw               # nothing to rewrite
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, filename=f"<dy2static {raw.__name__}>",
+                   mode="exec")
+    globs = dict(raw.__globals__)
+    globs.update(_JST)
+    ns = {}
+    exec(code, globs, ns)
+    new = ns[fdef.name]
+    functools.update_wrapper(new, raw)
+    new.__pt_dy2static__ = True
+    return new

@@ -748,16 +748,24 @@ def _psroi_pool_fn(x, rois, roi_batch_idx, output_channels=1, pooled_h=1,
 _psroi_pool = Primitive("psroi_pool", _psroi_pool_fn)
 
 
-def psroi_pool(x, boxes, boxes_num, output_channels, spatial_scale=1.0,
-               output_size=7, name=None):
-    """Position-sensitive ROI pooling [R, out_c, ph, pw]."""
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive ROI pooling [R, out_c, ph, pw] with the
+    paddle.vision.ops.psroi_pool signature: output_channels is derived as
+    C // (ph * pw)."""
     if isinstance(output_size, int):
         ph = pw = output_size
     else:
         ph, pw = output_size
+    C = unwrap(x).shape[1]
+    if C % (ph * pw) != 0:
+        from ..framework.enforce import InvalidArgumentError
+        raise InvalidArgumentError(
+            f"input channels {C} must be divisible by output_size^2 "
+            f"({ph}*{pw})", op="psroi_pool")
     bidx = _batch_index(boxes, boxes_num, unwrap(x).shape[0])
     return _psroi_pool(x, unwrap(boxes), bidx,
-                       output_channels=int(output_channels),
+                       output_channels=int(C // (ph * pw)),
                        pooled_h=int(ph), pooled_w=int(pw),
                        spatial_scale=float(spatial_scale))
 

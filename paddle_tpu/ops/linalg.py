@@ -204,7 +204,8 @@ _corrcoef = Primitive("corrcoef", jnp.corrcoef)
 
 def corrcoef(x, rowvar=True, name=None):
     """paddle.linalg.corrcoef: normalised covariance (correlation matrix)."""
-    xv = unwrap(x)
-    if not rowvar and xv.ndim == 2:
-        xv = xv.T
-    return _corrcoef(Tensor(xv) if isinstance(x, Tensor) else xv)
+    xt = x if isinstance(x, Tensor) else Tensor(unwrap(x))
+    if not rowvar and len(xt.shape) == 2:
+        from .manipulation import transpose
+        xt = transpose(xt, [1, 0])     # stays on the tape
+    return _corrcoef(xt)

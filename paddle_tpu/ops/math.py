@@ -79,6 +79,10 @@ def atan2(x, y, name=None):
     return _atan2(x, y)
 
 
+def hypot(x, y, name=None):
+    return _hypot(x, y)
+
+
 def fmax(x, y, name=None):
     return _fmax(x, y)
 

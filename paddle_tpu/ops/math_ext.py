@@ -79,7 +79,8 @@ def nanmedian(x, axis=None, keepdim=False, name=None):
 
 
 def logcumsumexp(x, axis=-1, name=None):
-    return _logcumsumexp(x, axis=int(axis))
+    nd = len(x.shape) if isinstance(x, Tensor) else unwrap(x).ndim
+    return _logcumsumexp(x, axis=int(axis) % nd)
 
 
 def _cummin_idx_fn(x, axis=-1):
@@ -499,10 +500,15 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
     return Tensor(h), [Tensor(e) for e in edges]
 
 
+def _partial_slice(x, start_index, length):
+    # partial_concat_op.cc normalizes negative start by the column count
+    s = start_index if start_index >= 0 else start_index + x.shape[1]
+    return x[:, s:] if length < 0 else x[:, s:s + length]
+
+
 def _partial_concat_fn(*xs, start_index=0, length=-1):
-    sl = [x[:, start_index:] if length < 0
-          else x[:, start_index:start_index + length] for x in xs]
-    return jnp.concatenate(sl, axis=1)
+    return jnp.concatenate(
+        [_partial_slice(x, start_index, length) for x in xs], axis=1)
 
 
 _partial_concat = Primitive("partial_concat", _partial_concat_fn)
@@ -516,8 +522,7 @@ def partial_concat(x, start_index=0, length=-1, name=None):
 
 
 def _partial_sum_fn(*xs, start_index=0, length=-1):
-    sl = [x[:, start_index:] if length < 0
-          else x[:, start_index:start_index + length] for x in xs]
+    sl = [_partial_slice(x, start_index, length) for x in xs]
     return sum(sl[1:], sl[0])
 
 

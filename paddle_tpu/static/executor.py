@@ -168,17 +168,25 @@ class Executor:
                    if any(n in op.output_names
                           for op in program.global_block().ops)]
 
-        key = (program._uid, program._version, tuple(fetch_names),
+        # cache per (program, feed signature); the compiled replay returns
+        # the UNION of all fetch sets seen so far, so alternating fetch
+        # lists (loss-only vs loss+acc) share one compiled program instead
+        # of one per distinct fetch tuple. A new fetch name recompiles
+        # once, then the union is stable.
+        key = (program._uid, program._version,
                tuple((n, v.shape, str(v.dtype))
                      for n, v in zip(feed_names, feed_vals)))
         entry = self._cache.get(key) if use_program_cache else None
-        if entry is None:
-            replay = self._build_replay(program, feed_names, fetch_names,
+        if entry is None or not set(fetch_names) <= set(entry[0]):
+            union = list(entry[0]) if entry else []
+            union += [n for n in fetch_names if n not in union]
+            replay = self._build_replay(program, feed_names, union,
                                         persist_names, written)
             jitted = jax.jit(replay)
-            entry = (jitted, persist_names, written)
+            entry = (union, jitted, persist_names, written)
             self._cache[key] = entry
-        jitted, persist_names, written = entry
+        union, jitted, persist_names, written = entry
+        fetch_pos = [union.index(n) for n in fetch_names]
 
         for hook in getattr(program, "_pre_run_hooks", []):
             hook(scope)
@@ -203,9 +211,10 @@ class Executor:
         fetches, updates = jitted(feed_vals, persist_vals)
         for n, val in zip(written, updates):
             scope.set_var(n, val)
+        picked = [fetches[i] for i in fetch_pos]
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return [Tensor(f) for f in fetches]
+            return [np.asarray(f) for f in picked]
+        return [Tensor(f) for f in picked]
 
     def close(self):
         self._cache.clear()

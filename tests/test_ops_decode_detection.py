@@ -295,7 +295,7 @@ def test_psroi_pool_position_sensitivity():
     rois = np.array([[0, 0, 31, 31]], "float32")
     out = paddle.vision.ops.psroi_pool(
         paddle.to_tensor(x), paddle.to_tensor(rois),
-        paddle.to_tensor(np.array([1], "int32")), oc, 0.25, 2).numpy()
+        paddle.to_tensor(np.array([1], "int32")), 2, 0.25).numpy()
     assert out.shape == (1, 1, 2, 2)
     assert np.allclose(out[0, 0], [[1, 2], [3, 4]], atol=1e-5)
 
