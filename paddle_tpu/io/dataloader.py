@@ -69,9 +69,44 @@ def _to_tensor_tree(obj, device_put):
     return Tensor(device_put(obj))
 
 
+def _flatten_batch(obj):
+    """Batch tree -> (spec, flat ndarray list). spec mirrors the tree with
+    integer leaf slots, so reconstruction needs no pickle of array data."""
+    arrays = []
+
+    def walk(o):
+        if isinstance(o, tuple):
+            return ("t",) + tuple(walk(x) for x in o)
+        if isinstance(o, list):
+            return ["l"] + [walk(x) for x in o]
+        if isinstance(o, dict):
+            return {k: walk(v) for k, v in o.items()}
+        arrays.append(np.asarray(o))
+        return len(arrays) - 1
+
+    return walk(obj), arrays
+
+
+def _unflatten_batch(spec, arrays):
+    if isinstance(spec, tuple) and spec and spec[0] == "t":
+        return tuple(_unflatten_batch(s, arrays) for s in spec[1:])
+    if isinstance(spec, list) and spec and spec[0] == "l":
+        return [_unflatten_batch(s, arrays) for s in spec[1:]]
+    if isinstance(spec, dict):
+        return {k: _unflatten_batch(v, arrays) for k, v in spec.items()}
+    return arrays[spec]
+
+
 def _mp_worker(dataset, index_queue, data_queue, collate_fn, worker_id,
-               num_workers):
+               num_workers, ring_name=None):
     _worker_info.info = WorkerInfo(worker_id, num_workers, dataset)
+    ring = None
+    if ring_name is not None:
+        try:
+            from .shm_ring import ShmRing
+            ring = ShmRing(name=ring_name, create=False)
+        except Exception:
+            ring = None   # fall back to the queue below
     while True:
         item = index_queue.get()
         if item is None:
@@ -79,9 +114,24 @@ def _mp_worker(dataset, index_queue, data_queue, collate_fn, worker_id,
         seq, indices = item
         try:
             batch = collate_fn([dataset[i] for i in indices])
-            data_queue.put((seq, batch, None))
+            sent = False
+            if ring is not None:
+                # bulk path: raw-buffer memcpy through shared memory
+                # (mmap_allocator.cc parity); spec travels on the queue
+                try:
+                    spec, arrays = _flatten_batch(batch)
+                    if not any(a.dtype == object for a in arrays):
+                        ring.push_batch(seq, arrays)
+                        data_queue.put((seq, ("@shm", spec), None))
+                        sent = True
+                except (ValueError, TypeError):
+                    sent = False   # unpackable payload: queue fallback
+            if not sent:
+                data_queue.put((seq, batch, None))
         except Exception as e:  # surface worker errors to the main process
             data_queue.put((seq, None, repr(e)))
+    if ring is not None:
+        ring.free()
 
 
 class DataLoader:
@@ -91,11 +141,12 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn: Optional[Callable] = None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=False, timeout=120, worker_init_fn=None):
+                 use_shared_memory=True, timeout=120, worker_init_fn=None):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
+        self.use_shared_memory = bool(use_shared_memory)
         self.prefetch_factor = max(int(prefetch_factor), 1)
         self.use_buffer_reader = use_buffer_reader
         self.timeout = timeout
@@ -148,26 +199,86 @@ class DataLoader:
 
     def _batches_multiproc(self):
         import multiprocessing as mp
-        # spawn by default: fork is unsafe in a process where JAX threads
-        # are live. Unpicklable datasets (lambdas in transforms) fall back
-        # to fork, matching the reference's fork-based workers.
+        # fork by default (the reference's worker model): workers run only
+        # dataset/collate numpy code, so inheriting the parent's runtime
+        # threads is safe — while spawn would re-execute the user's
+        # __main__ (requiring a __main__ guard) and re-register the TPU
+        # plugin in every worker. Exception: datasets yielding paddle
+        # Tensors make workers call into jax, which is NOT fork-safe once
+        # the parent's client is live — those use spawn (with the CPU
+        # pinning below so children never attach the chip).
+        def _has_tensor(o):
+            if isinstance(o, Tensor):
+                return True
+            if isinstance(o, (tuple, list)):
+                return any(_has_tensor(x) for x in o)
+            if isinstance(o, dict):
+                return any(_has_tensor(v) for v in o.values())
+            return False
+
+        needs_jax = False
+        if not self._iterable_mode and len(self.dataset) > 0:
+            try:
+                needs_jax = _has_tensor(self.dataset[0])
+            except Exception:
+                pass
         try:
-            import pickle
-            pickle.dumps(self.dataset)
-            pickle.dumps(self.collate_fn)
+            ctx = mp.get_context("spawn" if needs_jax else "fork")
+        except ValueError:
             ctx = mp.get_context("spawn")
-        except Exception:
-            ctx = mp.get_context("fork")
         index_queue = ctx.Queue()
         data_queue = ctx.Queue()
+        ring = None
+        if self.use_shared_memory:
+            try:
+                from .shm_ring import ShmRing
+                ring = ShmRing(capacity=128 << 20)
+            except Exception:
+                ring = None   # no native toolchain: queue path
         workers = []
-        for wid in range(self.num_workers):
-            w = ctx.Process(target=_mp_worker,
-                            args=(self.dataset, index_queue, data_queue,
-                                  self.collate_fn, wid, self.num_workers),
-                            daemon=True)
-            w.start()
-            workers.append(w)
+        # workers are host-side producers: pin them to the CPU backend so a
+        # spawned child never tries to attach the (single, busy) TPU chip —
+        # env is captured by the child at start()
+        import os
+        child_env = {"JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "cpu",
+                     "PALLAS_AXON_POOL_IPS": ""}
+        saved_env = {k: os.environ.get(k) for k in child_env}
+        os.environ.update(child_env)
+        try:
+            for wid in range(self.num_workers):
+                w = ctx.Process(target=_mp_worker,
+                                args=(self.dataset, index_queue, data_queue,
+                                      self.collate_fn, wid, self.num_workers,
+                                      ring.name if ring else None),
+                                daemon=True)
+                w.start()
+                workers.append(w)
+        finally:
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+        ring_pending = {}
+
+        def _resolve(seq, payload):
+            """Reassemble a shared-memory batch: spec from the queue, raw
+            arrays from the ring (matched by seq — ring and queue order
+            can differ across workers)."""
+            if not (isinstance(payload, tuple) and len(payload) == 2
+                    and payload[0] == "@shm"):
+                return payload
+            spec = payload[1]
+            while seq not in ring_pending:
+                msg = ring.pop_batch()
+                if msg is None:
+                    raise RuntimeError("shm ring closed mid-epoch")
+                rseq, rerr, arrays = msg
+                if rerr:
+                    raise RuntimeError(f"DataLoader worker error: {rerr}")
+                ring_pending[rseq] = arrays
+            return _unflatten_batch(spec, ring_pending.pop(seq))
 
         def shutdown():
             for _ in workers:
@@ -176,6 +287,9 @@ class DataLoader:
                 w.join(timeout=1)
                 if w.is_alive():
                     w.terminate()
+            if ring is not None:
+                ring.close()
+                ring.free()
         atexit.register(shutdown)
 
         try:
@@ -196,15 +310,29 @@ class DataLoader:
                 if next_seq in pending:
                     batch = pending.pop(next_seq)
                 else:
-                    try:
-                        seq, batch, err = data_queue.get(timeout=self.timeout)
-                    except queue_mod.Empty:
-                        dead = [w for w in workers if not w.is_alive()]
-                        raise RuntimeError(
-                            f"DataLoader timed out; {len(dead)} dead workers "
-                            f"(SIGCHLD watchdog parity)")
+                    # poll in short slices: dead workers are reported in
+                    # seconds, not after the full timeout (SIGCHLD watchdog)
+                    waited = 0.0
+                    slice_s = min(5.0, self.timeout)
+                    while True:
+                        try:
+                            seq, batch, err = data_queue.get(
+                                timeout=slice_s)
+                            break
+                        except queue_mod.Empty:
+                            waited += slice_s
+                            dead = [w for w in workers if not w.is_alive()]
+                            if dead:
+                                raise RuntimeError(
+                                    f"DataLoader: {len(dead)} worker(s) "
+                                    f"died (SIGCHLD watchdog parity)")
+                            if waited >= self.timeout:
+                                raise RuntimeError(
+                                    "DataLoader timed out waiting for "
+                                    "worker data")
                     if err is not None:
                         raise RuntimeError(f"DataLoader worker error: {err}")
+                    batch = _resolve(seq, batch)
                     if seq != next_seq:
                         pending[seq] = batch
                         continue

@@ -44,7 +44,9 @@ def _sig_of(args):
         elif hasattr(a, "shape"):
             sig.append(("a", tuple(a.shape), str(getattr(a, "dtype", "?"))))
         else:
-            sig.append(("c", a))
+            # include the type: baked constants must not alias across
+            # 1 / True / 1.0 (equal under ==, different programs)
+            sig.append(("c", type(a).__name__, a))
     return tuple(sig)
 
 

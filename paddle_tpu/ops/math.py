@@ -166,9 +166,14 @@ _scale = Primitive("scale", lambda x, s, b, bias_after_scale=True:
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
-    x_arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-    s = jnp.asarray(unwrap(scale), x_arr.dtype)
-    b = jnp.asarray(unwrap(bias), x_arr.dtype)
+    if isinstance(x, Tensor):
+        dt = x._value.dtype
+    elif hasattr(x, "dtype"):      # static Variable
+        dt = jnp.dtype(x.dtype)
+    else:
+        dt = jnp.asarray(x).dtype
+    s = jnp.asarray(unwrap(scale), dt)
+    b = jnp.asarray(unwrap(bias), dt)
     out = _scale(x, s, b, bias_after_scale=bias_after_scale)
     if act is not None:
         from ..nn import functional as F

