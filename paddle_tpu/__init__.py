@@ -32,7 +32,7 @@ import importlib as _importlib
 _SUBPACKAGES = ["nn", "optimizer", "static", "io", "metric", "amp", "jit",
                 "distributed", "vision", "text", "autograd", "hapi",
                 "incubate", "inference", "profiler", "device",
-                "quantization"]
+                "quantization", "utils"]
 for _name in _SUBPACKAGES:
     try:
         globals()[_name] = _importlib.import_module(f".{_name}", __name__)

@@ -173,3 +173,41 @@ class LRScheduler(Callback):
         s = self._sched()
         if self.by_epoch and s is not None:
             s.step()
+
+
+class VisualDL(Callback):
+    """hapi VisualDL callback parity (python/paddle/hapi/callbacks.py
+    VisualDL) over utils.monitor.LogWriter: logs per-step train metrics
+    and per-epoch eval metrics as scalar curves."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._writer = None
+        self._step = 0
+
+    def _w(self):
+        if self._writer is None:
+            from ..utils.monitor import LogWriter
+            self._writer = LogWriter(self.log_dir)
+        return self._writer
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            try:
+                self._w().add_scalar(f"train/{k}", float(v), self._step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._w().add_scalar(f"eval/{k}", float(v), self._step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None   # a later fit() reopens a fresh file

@@ -23,6 +23,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..framework.tensor import Tensor
+from ..utils.monitor import stat_add as _stat_add
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
@@ -216,12 +217,19 @@ class DataLoader:
                 return any(_has_tensor(v) for v in o.values())
             return False
 
+        # heuristic probe (first/middle/last sample): a mixed dataset that
+        # yields Tensors only at unprobed indices would still fork — such
+        # datasets should pass num_workers=0 or return numpy
         needs_jax = False
         if not self._iterable_mode and len(self.dataset) > 0:
-            try:
-                needs_jax = _has_tensor(self.dataset[0])
-            except Exception:
-                pass
+            n = len(self.dataset)
+            for i in {0, n // 2, n - 1}:
+                try:
+                    if _has_tensor(self.dataset[i]):
+                        needs_jax = True
+                        break
+                except Exception:
+                    pass
         try:
             ctx = mp.get_context("spawn" if needs_jax else "fork")
         except ValueError:
@@ -341,6 +349,7 @@ class DataLoader:
                     submitted += 1
                 except StopIteration:
                     done_submitting = True
+                _stat_add("STAT_dataloader_batches")
                 yield batch
                 next_seq += 1
         finally:

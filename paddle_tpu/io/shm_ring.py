@@ -59,7 +59,10 @@ class ShmRing:
         self._lib = _lib()
         if self._lib is None:
             raise RuntimeError("native ring buffer unavailable")
-        self.name = name or f"/pt_ring_{os.getpid()}_{id(self) & 0xffff}"
+        if name is None:
+            import uuid
+            name = f"/pt_ring_{os.getpid()}_{uuid.uuid4().hex[:12]}"
+        self.name = name
         if create:
             self._h = self._lib.ptring_create(self.name.encode(),
                                               capacity)
@@ -162,4 +165,6 @@ class ShmRing:
             self._h = None
 
     def used(self):
+        if not self._h:
+            return 0
         return int(self._lib.ptring_used(self._h))

@@ -31,10 +31,13 @@ def _build(src_name: str, lib_base: str):
     os.makedirs(out_dir, exist_ok=True)
     out = os.path.join(out_dir, f"{lib_base}-{tag}.so")
     if not os.path.exists(out):
+        # pid-unique temp: concurrent builders (two processes on a cold
+        # cache) must not interleave writes into one .tmp
+        tmp = f"{out}.tmp.{os.getpid()}"
         cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
-               "-o", out + ".tmp", "-lpthread", "-lrt"]
+               "-o", tmp, "-lpthread", "-lrt"]
         subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(out + ".tmp", out)
+        os.replace(tmp, out)
     return out
 
 

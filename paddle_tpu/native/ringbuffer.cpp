@@ -51,14 +51,25 @@ struct Ring {
 // mutex consistent and poison the ring — a frame may be half-written, so
 // the only safe continuation is "closed" (the Python side then raises its
 // dead-worker error instead of hanging).
+int ring_poison(RingHdr* h) {
+  pthread_mutex_consistent(&h->mu);
+  h->closed = 1;
+  pthread_cond_broadcast(&h->not_empty);
+  pthread_cond_broadcast(&h->not_full);
+  return 0;
+}
+
 int ring_lock(RingHdr* h) {
   int rc = pthread_mutex_lock(&h->mu);
-  if (rc == EOWNERDEAD) {
-    pthread_mutex_consistent(&h->mu);
-    h->closed = 1;
-    pthread_cond_broadcast(&h->not_empty);
-    pthread_cond_broadcast(&h->not_full);
-  }
+  if (rc == EOWNERDEAD) ring_poison(h);
+  return rc;
+}
+
+// cond_wait on a robust mutex can itself return EOWNERDEAD (the holder
+// died while we slept) — recover exactly like ring_lock does
+int ring_wait(RingHdr* h, pthread_cond_t* c) {
+  int rc = pthread_cond_wait(c, &h->mu);
+  if (rc == EOWNERDEAD) ring_poison(h);
   return rc;
 }
 
@@ -98,7 +109,6 @@ extern "C" {
 
 // Create (main process). Returns NULL on failure.
 void* ptring_create(const char* name, uint64_t capacity) {
-  shm_unlink(name);  // stale ring from a crashed run
   int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) return nullptr;
   uint64_t map_len = sizeof(RingHdr) + capacity;
@@ -165,9 +175,9 @@ int ptring_push(void* ring, const void* buf, uint64_t len) {
   Ring* r = (Ring*)ring;
   RingHdr* h = r->hdr;
   if (len + 8 > h->capacity) return -2;
-  ring_lock(h);
+  if (ring_lock(h) == ENOTRECOVERABLE) return -1;
   while (h->capacity - h->used < len + 8 && !h->closed)
-    pthread_cond_wait(&h->not_full, &h->mu);
+    if (ring_wait(h, &h->not_full) == ENOTRECOVERABLE) return -1;
   if (h->closed) {
     pthread_mutex_unlock(&h->mu);
     return -1;
@@ -185,9 +195,9 @@ int ptring_push(void* ring, const void* buf, uint64_t len) {
 int64_t ptring_pop_len(void* ring) {
   Ring* r = (Ring*)ring;
   RingHdr* h = r->hdr;
-  ring_lock(h);
+  if (ring_lock(h) == ENOTRECOVERABLE) return -1;
   while (h->used == 0 && !h->closed)
-    pthread_cond_wait(&h->not_empty, &h->mu);
+    if (ring_wait(h, &h->not_empty) == ENOTRECOVERABLE) return -1;
   if (h->used == 0 && h->closed) {
     pthread_mutex_unlock(&h->mu);
     return -1;
@@ -202,9 +212,9 @@ int64_t ptring_pop_len(void* ring) {
 int64_t ptring_pop(void* ring, void* out, uint64_t maxlen) {
   Ring* r = (Ring*)ring;
   RingHdr* h = r->hdr;
-  ring_lock(h);
+  if (ring_lock(h) == ENOTRECOVERABLE) return -1;
   while (h->used == 0 && !h->closed)
-    pthread_cond_wait(&h->not_empty, &h->mu);
+    if (ring_wait(h, &h->not_empty) == ENOTRECOVERABLE) return -1;
   if (h->used == 0 && h->closed) {
     pthread_mutex_unlock(&h->mu);
     return -1;
@@ -225,11 +235,11 @@ int64_t ptring_pop(void* ring, void* out, uint64_t maxlen) {
 
 void ptring_close(void* ring) {
   Ring* r = (Ring*)ring;
-  ring_lock(r->hdr);
+  int rc = ring_lock(r->hdr);
   r->hdr->closed = 1;
   pthread_cond_broadcast(&r->hdr->not_empty);
   pthread_cond_broadcast(&r->hdr->not_full);
-  pthread_mutex_unlock(&r->hdr->mu);
+  if (rc != ENOTRECOVERABLE) pthread_mutex_unlock(&r->hdr->mu);
 }
 
 void ptring_free(void* ring) {

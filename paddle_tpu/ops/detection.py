@@ -943,8 +943,123 @@ def density_prior_box(input, image, densities=None, fixed_sizes=None,
     return b, v
 
 
+# -- polygon box transform -----------------------------------------------------
+
+def _polygon_box_transform_fn(x):
+    """polygon_box_transform_op.cc: quad geometry maps (EAST-style) from
+    offset encoding to absolute coords: even channels use 4*w - v, odd use
+    4*h - v. x [N, geo_c, H, W]."""
+    N, C, H, W = x.shape
+    ww = jnp.arange(W, dtype=x.dtype)[None, None, None, :] * 4.0
+    hh = jnp.arange(H, dtype=x.dtype)[None, None, :, None] * 4.0
+    even = jnp.arange(C) % 2 == 0
+    base = jnp.where(even[None, :, None, None], ww, hh)
+    return base - x
+
+
+_polygon_box_transform = Primitive("polygon_box_transform",
+                                   _polygon_box_transform_fn)
+
+
+def polygon_box_transform(input, name=None):
+    return _polygon_box_transform(input)
+
+
+# -- target assign -------------------------------------------------------------
+
+def _target_assign_fn(x, match_indices, neg_mask=None, mismatch_value=0.0):
+    """target_assign_op.h: out[i,j] = x[match[i,j], j] when matched, else
+    mismatch_value; weight 1 for matched (and for negatives when a neg
+    mask is given). x [M, P, K], match_indices [N, P] int32."""
+    M, P, K = x.shape
+    N = match_indices.shape[0]
+    safe = jnp.maximum(match_indices, 0)                   # [N, P]
+    gathered = x[safe, jnp.arange(P)[None, :]]             # [N, P, K]
+    matched = (match_indices >= 0)[..., None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch_value, x.dtype))
+    w = matched.astype(x.dtype)
+    if neg_mask is not None:
+        w = jnp.maximum(w, neg_mask[..., None].astype(x.dtype))
+    return out, w
+
+
+_target_assign = Primitive("target_assign", _target_assign_fn,
+                           multi_output=True, differentiable=False)
+
+
+def target_assign(x, match_indices, negative_indices=None,
+                  mismatch_value=0.0, name=None):
+    neg = None if negative_indices is None else unwrap(negative_indices)
+    return _target_assign(x, unwrap(match_indices).astype(jnp.int32), neg,
+                          mismatch_value=float(mismatch_value))
+
+
+# -- box decoder and assign ----------------------------------------------------
+
+def _box_decoder_and_assign_fn(prior_box, prior_box_var, target_box,
+                               box_score, box_clip=4.135):
+    """box_decoder_and_assign_op.h: per-class decode + argmax-class assign.
+    prior_box [R,4]; prior_box_var [4]; target_box [R, C*4];
+    box_score [R, C]."""
+    R = prior_box.shape[0]
+    C = box_score.shape[1]
+    pw = prior_box[:, 2] - prior_box[:, 0] + 1.0
+    ph = prior_box[:, 3] - prior_box[:, 1] + 1.0
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    t = target_box.reshape(R, C, 4)
+    dw = jnp.minimum(prior_box_var[2] * t[..., 2], box_clip)
+    dh = jnp.minimum(prior_box_var[3] * t[..., 3], box_clip)
+    cx = prior_box_var[0] * t[..., 0] * pw[:, None] + pcx[:, None]
+    cy = prior_box_var[1] * t[..., 1] * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                         cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], axis=-1)
+    # assign: best non-background class (j > 0)
+    score_nobg = box_score.at[:, 0].set(-jnp.inf) if C > 1 else box_score
+    best = jnp.argmax(score_nobg, axis=1)                   # [R]
+    has_fg = jnp.max(score_nobg, axis=1) > -jnp.inf
+    assigned = decoded[jnp.arange(R), best]
+    # rows with no positive class keep the background (class 0) decode
+    assigned = jnp.where(has_fg[:, None], assigned, decoded[:, 0])
+    return decoded.reshape(R, C * 4), assigned
+
+
+_box_decoder_and_assign = Primitive("box_decoder_and_assign",
+                                    _box_decoder_and_assign_fn,
+                                    multi_output=True,
+                                    differentiable=False)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=4.135, name=None):
+    return _box_decoder_and_assign(prior_box, unwrap(prior_box_var),
+                                   target_box, box_score,
+                                   box_clip=float(box_clip))
+
+
+# -- collect FPN proposals -----------------------------------------------------
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """collect_fpn_proposals_op.cc: merge per-level RoIs and keep the
+    global top-scoring post_nms_top_n (single image; levels are variable
+    length, so the merge is a host-side concat + one device top_k)."""
+    rois = jnp.concatenate([unwrap(r) for r in multi_rois], axis=0)
+    scores = jnp.concatenate([unwrap(s).reshape(-1)
+                              for s in multi_scores], axis=0)
+    k = min(int(post_nms_top_n), scores.shape[0])
+    top_s, top_i = lax.top_k(scores, k)
+    return Tensor(rois[top_i]), Tensor(top_s)
+
+
 __all__ = ["iou_similarity", "box_clip", "box_coder", "prior_box",
            "anchor_generator", "roi_align", "roi_pool", "yolo_box", "nms",
            "bipartite_match", "matrix_nms", "multiclass_nms",
            "generate_proposals", "distribute_fpn_proposals", "psroi_pool",
-           "deform_conv2d", "density_prior_box"]
+           "deform_conv2d", "density_prior_box", "polygon_box_transform",
+           "target_assign", "box_decoder_and_assign",
+           "collect_fpn_proposals"]

@@ -209,6 +209,9 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     """fluid.layers.spectral_norm parity (spectral_norm_op.cc): normalize
     the weight by its largest singular value via power iteration."""
+    if power_iters < 1:
+        raise ValueError("spectral_norm needs power_iters >= 1 (no "
+                         "persisted u/v state to reuse)")
     from .. import ops
     import jax.numpy as jnp
     from ..framework.tensor import Tensor, unwrap

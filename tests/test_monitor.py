@@ -1,0 +1,63 @@
+"""Stats registry + LogWriter + VisualDL callback tests.
+
+Reference strategy parity: monitor.h STAT_INT macro behavior and the
+hapi VisualDL callback contract (scalar curves per train step / eval).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.monitor import (stat_add, stat_sub, stat_set,
+                                      stat_get, all_stats, LogWriter)
+
+
+def test_stat_registry():
+    stat_set("STAT_test_gauge", 0)
+    stat_add("STAT_test_gauge", 5)
+    stat_add("STAT_test_gauge")
+    stat_sub("STAT_test_gauge", 2)
+    assert stat_get("STAT_test_gauge") == 4
+    assert "STAT_test_gauge" in all_stats()
+
+
+def test_executor_compile_stat():
+    import paddle_tpu.static as static
+    base = stat_get("STAT_executor_compiles")
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3], "float32")
+            out = static.nn.fc(x, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        xd = np.zeros((2, 3), "float32")
+        exe.run(main, feed={"x": xd}, fetch_list=[out])
+        exe.run(main, feed={"x": xd}, fetch_list=[out])  # cached
+    finally:
+        paddle.disable_static()
+    grew = stat_get("STAT_executor_compiles") - base
+    assert grew >= 1    # exactly one compile for the repeated run
+
+
+def test_log_writer_roundtrip(tmp_path):
+    d = str(tmp_path / "vdl")
+    with LogWriter(logdir=d) as w:
+        for i in range(5):
+            w.add_scalar("train/loss", 1.0 / (i + 1), step=i)
+        w.add_scalar("eval/acc", 0.9, step=4)
+    scalars = LogWriter.read_scalars(d)
+    assert len(scalars["train/loss"]) == 5
+    assert scalars["train/loss"][0] == (0, 1.0)
+    assert scalars["eval/acc"] == [(4, 0.9)]
+
+
+def test_visualdl_callback(tmp_path):
+    from paddle_tpu.hapi import VisualDL
+    cb = VisualDL(log_dir=str(tmp_path / "run"))
+    cb.on_train_batch_end(0, {"loss": 0.5})
+    cb.on_train_batch_end(1, {"loss": 0.25})
+    cb.on_eval_end({"acc": 0.8})
+    cb.on_train_end()
+    scalars = LogWriter.read_scalars(str(tmp_path / "run"))
+    assert [v for _, v in scalars["train/loss"]] == [0.5, 0.25]
+    assert scalars["eval/acc"][0][1] == 0.8

@@ -395,3 +395,48 @@ def test_isposinf_isneginf_polar():
     p = paddle.polar(paddle.to_tensor(np.array([2.0], "float32")),
                      paddle.to_tensor(np.array([np.pi / 2], "float32")))
     assert abs(p.numpy()[0].imag - 2.0) < 1e-5
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 4, 2, 3), "float32")
+    out = paddle.polygon_box_transform(paddle.to_tensor(x)).numpy()
+    # zero offsets: even channels = 4*w grid, odd = 4*h grid
+    assert np.allclose(out[0, 0], [[0, 4, 8], [0, 4, 8]])
+    assert np.allclose(out[0, 1], [[0, 0, 0], [4, 4, 4]])
+
+
+def test_target_assign():
+    x = np.arange(2 * 3 * 2, dtype="float32").reshape(2, 3, 2)  # [M,P,K]
+    match = np.array([[0, -1, 1], [1, 1, -1]], "int32")          # [N,P]
+    out, w = paddle.target_assign(paddle.to_tensor(x),
+                                  paddle.to_tensor(match),
+                                  mismatch_value=9.0)
+    o = out.numpy()
+    assert np.allclose(o[0, 0], x[0, 0]) and np.allclose(o[0, 2], x[1, 2])
+    assert np.allclose(o[0, 1], [9.0, 9.0])
+    assert w.numpy()[:, :, 0].tolist() == [[1, 0, 1], [1, 1, 0]]
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 9, 9]], "float32")
+    var = np.array([0.1, 0.1, 0.2, 0.2], "float32")
+    target = np.zeros((1, 8), "float32")    # 2 classes, zero deltas
+    score = np.array([[0.1, 0.9]], "float32")
+    dec, assign = paddle.box_decoder_and_assign(
+        paddle.to_tensor(prior), paddle.to_tensor(var),
+        paddle.to_tensor(target), paddle.to_tensor(score))
+    # zero deltas decode back to the prior box
+    assert np.allclose(assign.numpy()[0], [0, 0, 9, 9], atol=1e-4)
+    assert dec.numpy().shape == (1, 8)
+
+
+def test_collect_fpn_proposals():
+    rois = [np.array([[0, 0, 1, 1], [2, 2, 3, 3]], "float32"),
+            np.array([[4, 4, 5, 5]], "float32")]
+    scores = [np.array([0.9, 0.1], "float32"),
+              np.array([0.5], "float32")]
+    out, s = paddle.collect_fpn_proposals(
+        [paddle.to_tensor(r) for r in rois],
+        [paddle.to_tensor(x) for x in scores], 2, 3, 2)
+    assert np.allclose(s.numpy(), [0.9, 0.5])
+    assert np.allclose(out.numpy()[1], [4, 4, 5, 5])

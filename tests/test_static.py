@@ -365,3 +365,52 @@ def test_static_nn_spectral_norm_eager():
     wn = static.nn.spectral_norm(w, power_iters=20)
     s = np.linalg.svd(wn.numpy(), compute_uv=False)
     assert abs(s[0] - 1.0) < 0.05     # largest singular value normalized
+
+
+def test_train_from_dataset_scanned_epoch():
+    """Trainer/DeviceWorker parity: one-jit whole-epoch training must move
+    the loss like the per-step Executor loop does (trainer.h:51)."""
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 4], "float32")
+            y = static.data("y", [8, 1], "float32")
+            h = static.nn.fc(x, 8, activation="relu")
+            out = static.nn.fc(h, 1)
+            loss = paddle.mean((out - y) * (out - y))
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        W = rng.randn(4, 1).astype("float32")
+        feeds = []
+        for _ in range(16):
+            xd = rng.randn(8, 4).astype("float32")
+            feeds.append({"x": xd, "y": xd @ W})
+        res = exe.train_from_dataset(main, dataset=feeds,
+                                     fetch_list=[loss], epochs=3)
+        losses = res[loss.name]
+        assert losses.shape == (48,)
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    finally:
+        paddle.disable_static()
+
+
+def test_infer_from_dataset():
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3], "float32")
+            out = static.nn.fc(x, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        feeds = [{"x": np.ones((4, 3), "float32") * i} for i in range(5)]
+        res = exe.infer_from_dataset(main, dataset=feeds,
+                                     fetch_list=[out])
+        assert res[out.name].shape == (5, 4, 2)
+    finally:
+        paddle.disable_static()
