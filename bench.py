@@ -244,24 +244,64 @@ WORKLOADS = [
 ]
 
 
+def _run_one(name):
+    """Child-process entry: run one workload, print its JSON result."""
+    import jax
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/paddle_tpu_jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+    except Exception:
+        pass
+    on_tpu = jax.devices()[0].platform != "cpu"
+    fn = dict(WORKLOADS)[name]
+    try:
+        out = fn(on_tpu)
+    except Exception as e:
+        out = {"error": f"{type(e).__name__}: {e}"}
+        _note(traceback.format_exc())
+    print("@@RESULT@@" + json.dumps(out))
+
+
+def _run_subprocess(name, timeout_s):
+    """Run a workload in a fresh subprocess (the axon tunnel's XLA compile
+    RPC occasionally hangs; a hung workload must not take the whole bench
+    down). One retry — the persistent compilation cache makes the retry
+    cheap when the first attempt got partway."""
+    import subprocess
+    for attempt in (1, 2):
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--workload", name],
+                capture_output=True, text=True, timeout=timeout_s)
+            for ln in p.stdout.splitlines():
+                if ln.startswith("@@RESULT@@"):
+                    return json.loads(ln[len("@@RESULT@@"):])
+            _note(f"[bench] {name} attempt {attempt}: no result "
+                  f"(rc={p.returncode})\n{p.stderr[-2000:]}")
+        except subprocess.TimeoutExpired:
+            _note(f"[bench] {name} attempt {attempt}: timed out "
+                  f"after {timeout_s}s (hung compile?)")
+    return {"error": f"timed out/failed after 2 attempts x {timeout_s}s"}
+
+
 def main():
     import jax
 
     on_tpu = jax.devices()[0].platform != "cpu"
     only = os.environ.get("PADDLE_TPU_BENCH_ONLY")
     selected = [w for w in WORKLOADS if not only or w[0] in only.split(",")]
+    timeout_s = int(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "900"))
 
     results = {}
     for name, fn in selected:
         _note(f"[bench] {name} ...")
         t0 = time.perf_counter()
-        try:
-            results[name] = fn(on_tpu)
-            _note(f"[bench] {name}: {results[name]} "
-                  f"({time.perf_counter() - t0:.0f}s)")
-        except Exception as e:  # record, keep going — one bad workload
-            results[name] = {"error": f"{type(e).__name__}: {e}"}
-            _note(f"[bench] {name} FAILED: {e}\n{traceback.format_exc()}")
+        results[name] = _run_subprocess(name, timeout_s)
+        _note(f"[bench] {name}: {results[name]} "
+              f"({time.perf_counter() - t0:.0f}s)")
 
     head = results.get("bert_base_pretrain", {})
     line = {
@@ -276,4 +316,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--workload":
+        _run_one(sys.argv[2])
+    else:
+        main()

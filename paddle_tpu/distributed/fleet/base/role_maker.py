@@ -80,6 +80,55 @@ class PaddleCloudRoleMaker(RoleMakerBase):
     def _is_collective(self):
         return self._is_collective
 
+    # -- rendezvous / barrier (Gloo-store parity, role_maker.py:33) ----------
+    def _store_endpoint(self):
+        ep = os.getenv("PADDLE_STORE_ENDPOINT")
+        if ep:
+            host, port = ep.rsplit(":", 1)
+            return host, int(port)
+        # default: rank 0's trainer endpoint host, side-channel port
+        host = self._worker_endpoints[0].rsplit(":", 1)[0] or "127.0.0.1"
+        port = int(os.getenv("PADDLE_STORE_PORT", "61001"))
+        return host, port
+
+    def _ensure_store(self, timeout=120.0):
+        if getattr(self, "_store", None) is None:
+            from .tcp_store import TCPStore
+            host, port = self._store_endpoint()
+            self._store = TCPStore(
+                "127.0.0.1" if self.is_first_worker() else host, port,
+                world_size=self._worker_num,
+                is_master=self.is_first_worker(), timeout=timeout)
+        return self._store
+
+    def rendezvous(self, timeout=120.0):
+        """Exchange endpoints through the store and wait for the full
+        cluster: returns the ordered endpoint list once every rank has
+        registered."""
+        store = self._ensure_store(timeout)
+        store.set(f"__ep/{self._worker_index}",
+                  self._current_endpoint.encode())
+        eps = []
+        for r in range(self._worker_num):
+            if not store.wait(f"__ep/{r}", timeout):
+                raise TimeoutError(
+                    f"rendezvous: rank {r} never registered within "
+                    f"{timeout}s")
+            eps.append(store.get(f"__ep/{r}", wait=False).decode())
+        self._worker_endpoints = eps
+        return eps
+
+    def barrier(self, comm_world="worker", timeout=None):
+        """Cluster-wide barrier over the store (_barrier parity)."""
+        if self._worker_num <= 1:
+            return
+        if not hasattr(self, "_barrier_seq"):
+            self._barrier_seq = {}
+        seq = self._barrier_seq.get(comm_world, 0)
+        self._barrier_seq[comm_world] = seq + 1
+        self._ensure_store().barrier(f"{comm_world}/{seq}",
+                                     self._worker_num, timeout)
+
 
 class UserDefinedRoleMaker(PaddleCloudRoleMaker):
     """role_maker.py:875 parity: explicit topology."""

@@ -1020,10 +1020,7 @@ def _box_decoder_and_assign_fn(prior_box, prior_box_var, target_box,
     # assign: best non-background class (j > 0)
     score_nobg = box_score.at[:, 0].set(-jnp.inf) if C > 1 else box_score
     best = jnp.argmax(score_nobg, axis=1)                   # [R]
-    has_fg = jnp.max(score_nobg, axis=1) > -jnp.inf
     assigned = decoded[jnp.arange(R), best]
-    # rows with no positive class keep the background (class 0) decode
-    assigned = jnp.where(has_fg[:, None], assigned, decoded[:, 0])
     return decoded.reshape(R, C * 4), assigned
 
 

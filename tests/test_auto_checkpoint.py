@@ -1,0 +1,78 @@
+"""Auto-checkpoint resume tests (incubate/checkpoint/auto_checkpoint.py).
+
+Reference strategy parity: test_auto_checkpoint.py — run an epoch range,
+simulate a job restart, and assert completed epochs are skipped and model/
+optimizer state restored.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.checkpoint.auto_checkpoint import train_epoch_range
+
+
+def _make(seed):
+    paddle.seed(seed)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=model.parameters())
+    return model, opt
+
+
+def _train_one_epoch(model, opt, rng):
+    x = paddle.to_tensor(rng.randn(8, 4).astype("float32"))
+    loss = paddle.mean(model(x) ** 2)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+
+
+def test_train_epoch_range_resumes(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECKPOINT_DIR", str(tmp_path))
+
+    # first "job": epochs 0..2; the save happens AFTER each epoch's
+    # training resumes the generator, so breaking inside epoch 2 means the
+    # last COMPLETE checkpoint is epoch 1 — a half-trained epoch must
+    # never be checkpointed
+    model, opt = _make(0)
+    rng = np.random.RandomState(0)
+    done = []
+    snap = {}
+    for epoch in train_epoch_range(5, model=model, opt=opt):
+        _train_one_epoch(model, opt, rng)
+        snap[epoch] = model.weight.numpy().copy()
+        done.append(epoch)
+        if epoch == 2:
+            break                       # simulated crash inside epoch 2
+    assert done == [0, 1, 2]
+
+    # "restart": fresh objects, same checkpoint dir
+    model2, opt2 = _make(1)             # different init on purpose
+    resumed = []
+    for epoch in train_epoch_range(5, model=model2, opt=opt2):
+        if not resumed:
+            # state restored to the last checkpoint (end of epoch 1)
+            assert np.allclose(model2.weight.numpy(), snap[1])
+        _train_one_epoch(model2, opt2, rng)
+        resumed.append(epoch)
+    assert resumed == [2, 3, 4]
+
+
+def test_train_epoch_range_fresh_run(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECKPOINT_DIR", str(tmp_path / "new"))
+    model, opt = _make(2)
+    epochs = list(e for e in train_epoch_range(3, model=model, opt=opt))
+    assert epochs == [0, 1, 2]
+
+
+def test_save_interval(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECKPOINT_DIR", str(tmp_path))
+    model, opt = _make(3)
+    rng = np.random.RandomState(1)
+    for epoch in train_epoch_range(4, save_checkpoint_inter=2,
+                                   model=model, opt=opt):
+        _train_one_epoch(model, opt, rng)
+    # last checkpoint at epoch 3 (epochs 1 and 3 hit the interval)
+    import json, os
+    with open(os.path.join(str(tmp_path), "status.json")) as f:
+        assert json.load(f)["epoch_no"] == 3

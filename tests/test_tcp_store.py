@@ -1,0 +1,110 @@
+"""TCP store rendezvous/barrier tests (fleet/base/tcp_store.py).
+
+Reference strategy parity: the Gloo-store rendezvous tests — multiple
+processes register endpoints through one store, barrier synchronizes, and
+stragglers time out with a diagnostic.
+"""
+import multiprocessing as mp
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.base.tcp_store import TCPStore
+
+
+def test_set_get_add_single():
+    s = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        s.set("k", b"v1")
+        assert s.get("k") == b"v1"
+        assert s.add("ctr", 3) == 3
+        assert s.add("ctr", 2) == 5
+        assert s.get("missing", wait=False) is None
+    finally:
+        s.close()
+
+
+def test_wait_blocks_until_set():
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        client = TCPStore("127.0.0.1", master.port)
+
+        def setter():
+            time.sleep(0.2)
+            c2 = TCPStore("127.0.0.1", master.port)
+            c2.set("late", b"now")
+            c2.close()
+
+        import threading
+        t = threading.Thread(target=setter)
+        t.start()
+        assert client.get("late") == b"now"   # blocks ~0.2s
+        t.join()
+        client.close()
+    finally:
+        master.close()
+
+
+def _rank_proc(rank, world, port, q):
+    import os
+    try:
+        os.environ.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(
+                f"127.0.0.1:{9000 + r}" for r in range(world)),
+            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{9000 + rank}",
+            "PADDLE_STORE_ENDPOINT": f"127.0.0.1:{port}",
+        })
+        from paddle_tpu.distributed.fleet.base.role_maker import \
+            PaddleCloudRoleMaker
+        rm = PaddleCloudRoleMaker(is_collective=True)
+        eps = rm.rendezvous(timeout=30)
+        rm.barrier()
+        t0 = time.time()
+        rm.barrier()          # second barrier: distinct sequence key
+        q.put((rank, eps, time.time() - t0))
+        # keep the master alive until every rank is fully done — rank 0
+        # hosts the store, and exiting early would sever in-flight waits
+        store = rm._ensure_store()
+        store.add("__done", 1)
+        if rank == 0:
+            while int(store.get("__done") or b"0") < world:
+                time.sleep(0.02)
+    except BaseException as e:   # surface child failures to the test
+        import traceback
+        q.put((rank, f"ERR {e}: {traceback.format_exc()}", 0.0))
+
+
+def test_multiprocess_rendezvous_and_barrier():
+    # rank 0's process hosts the store (the deployment shape); pick a free
+    # port up front
+    import socket as _s
+    probe = _s.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    world = 3
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_rank_proc, args=(r, world, port, q))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=30) for _ in range(world)]
+    for p in procs:
+        p.join(timeout=10)
+    want = [f"127.0.0.1:{9000 + r}" for r in range(world)]
+    for rank, eps, _ in results:
+        assert eps == want
+
+
+def test_barrier_times_out_without_peers():
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        with pytest.raises(TimeoutError, match="1/2 arrived"):
+            master.barrier("lonely", world_size=2, timeout=0.5)
+    finally:
+        master.close()
