@@ -68,39 +68,51 @@ class _Server(threading.Thread):
         try:
             while True:
                 cmd, *args = _recv_msg(conn)
-                if cmd == b"set":
-                    with self._cv:
-                        self._kv[args[0]] = args[1]
-                        self._cv.notify_all()
-                    _send_msg(conn, b"ok")
-                elif cmd == b"get":
-                    with self._cv:
-                        v = self._kv.get(args[0])
-                    _send_msg(conn, v if v is not None else b"",
-                              b"1" if v is not None else b"0")
-                elif cmd == b"add":
-                    with self._cv:
-                        cur = int(self._kv.get(args[0], b"0")) + \
-                            int(args[1])
-                        self._kv[args[0]] = str(cur).encode()
-                        self._cv.notify_all()
-                    _send_msg(conn, str(cur).encode())
-                elif cmd == b"wait":
-                    key, timeout = args[0], float(args[1])
-                    deadline = time.time() + timeout
-                    with self._cv:
-                        while key not in self._kv:
-                            left = deadline - time.time()
-                            if left <= 0 or not self._cv.wait(left):
-                                break
-                        ok = key in self._kv
-                    _send_msg(conn, b"1" if ok else b"0")
-                else:
-                    _send_msg(conn, b"err")
+                try:
+                    self._handle(conn, cmd, args)
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as e:
+                    # malformed request (e.g. add on a non-int value):
+                    # reply with a diagnostic instead of killing the
+                    # connection thread and leaving the client hanging
+                    _send_msg(conn, b"err", repr(e).encode())
         except (ConnectionError, OSError):
             pass
         finally:
             conn.close()
+
+    def _handle(self, conn, cmd, args):
+        # every reply leads with b"ok"/b"err" so clients can distinguish
+        # payloads from error diagnostics unambiguously
+        if cmd == b"set":
+            with self._cv:
+                self._kv[args[0]] = args[1]
+                self._cv.notify_all()
+            _send_msg(conn, b"ok")
+        elif cmd == b"get":
+            with self._cv:
+                v = self._kv.get(args[0])
+            _send_msg(conn, b"ok", v if v is not None else b"",
+                      b"1" if v is not None else b"0")
+        elif cmd == b"add":
+            with self._cv:
+                cur = int(self._kv.get(args[0], b"0")) + int(args[1])
+                self._kv[args[0]] = str(cur).encode()
+                self._cv.notify_all()
+            _send_msg(conn, b"ok", str(cur).encode())
+        elif cmd == b"wait":
+            key, timeout = args[0], float(args[1])
+            deadline = time.time() + timeout
+            with self._cv:
+                while key not in self._kv:
+                    left = deadline - time.time()
+                    if left <= 0 or not self._cv.wait(left):
+                        break
+                ok = key in self._kv
+            _send_msg(conn, b"ok", b"1" if ok else b"0")
+        else:
+            _send_msg(conn, b"err", b"unknown command")
 
     def shutdown(self):
         self._stop = True
@@ -137,33 +149,50 @@ class TCPStore:
                 time.sleep(0.05)
         self._lock = threading.Lock()
 
+    def _reply(self):
+        parts = _recv_msg(self._sock)
+        if parts and parts[0] == b"err":
+            raise RuntimeError(f"store error: "
+                               f"{parts[1].decode() if len(parts) > 1 else '?'}")
+        if not parts or parts[0] != b"ok":
+            raise ConnectionError("store protocol desync")
+        return parts[1:]
+
     def set(self, key: str, value: bytes):
         with self._lock:
             _send_msg(self._sock, b"set", key.encode(),
                       value if isinstance(value, bytes) else
                       str(value).encode())
-            _recv_msg(self._sock)
+            self._reply()
 
     def get(self, key: str, wait=True):
         if wait and not self.wait(key, self._timeout):
             raise TimeoutError(f"store key {key!r} never set")
         with self._lock:
             _send_msg(self._sock, b"get", key.encode())
-            v, present = _recv_msg(self._sock)
+            v, present = self._reply()
         return v if present == b"1" else None
 
     def add(self, key: str, amount: int = 1) -> int:
         with self._lock:
             _send_msg(self._sock, b"add", key.encode(),
                       str(amount).encode())
-            (v,) = _recv_msg(self._sock)
+            (v,) = self._reply()
         return int(v)
 
     def wait(self, key: str, timeout: float = None) -> bool:
+        t = timeout or self._timeout
         with self._lock:
-            _send_msg(self._sock, b"wait", key.encode(),
-                      str(timeout or self._timeout).encode())
-            (ok,) = _recv_msg(self._sock)
+            # the server's wait deadline starts when it RECEIVES the
+            # request; the socket recv timeout must outlive it or the late
+            # '0' reply desyncs the connection protocol
+            self._sock.settimeout(t + 30.0)
+            try:
+                _send_msg(self._sock, b"wait", key.encode(),
+                          str(t).encode())
+                (ok,) = self._reply()
+            finally:
+                self._sock.settimeout(self._timeout)
         return ok == b"1"
 
     def barrier(self, name: str, world_size: int, timeout: float = None):

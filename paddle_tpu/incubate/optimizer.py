@@ -17,6 +17,11 @@ from ..framework.tensor import Tensor
 
 
 def _params_of(obj):
+    if obj is None:
+        raise ValueError(
+            "parameters is required in dygraph mode: pass a Layer or a "
+            "parameter list (the reference's parameters=None means 'all "
+            "program parameters', which only exists in static graphs)")
     if hasattr(obj, "parameters"):
         return [p for p in obj.parameters() if not p.stop_gradient]
     return list(obj)

@@ -60,11 +60,14 @@ def _conv_fn(x, w, b=None, stride=(1, 1), padding="VALID", dilation=(1, 1),
         w = jnp.transpose(w, perm)
     dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
                                         (lhs_spec, rhs_spec, out_spec))
+    # NB: no preferred_element_type=f32 here — it makes the VJP's
+    # transpose-rhs conv see (bf16 activations, f32 cotangent) and the
+    # dtype rule rejects that; XLA:TPU already accumulates bf16 convs in
+    # f32 on the MXU, so bf16-in/bf16-out loses nothing
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=stride, padding=padding,
         rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        feature_group_count=groups)
     out = out.astype(x.dtype)
     if b is not None:
         bshape = (1, -1) + (1,) * nsp if not channel_last else (1,) * (1 + nsp) + (-1,)
