@@ -58,3 +58,96 @@ def DataParallel(layer, *args, **kwargs):
 def summary(net, input_size=None, dtypes=None):
     from .hapi.summary import summary as _summary
     return _summary(net, input_size, dtypes)
+
+
+# -- top-level long tail (python/paddle/__init__.py parity) -------------------
+
+def add_n(inputs, name=None):
+    """sum_op parity: elementwise sum of a tensor list."""
+    if isinstance(inputs, (list, tuple)):
+        out = inputs[0]
+        for t in inputs[1:]:
+            out = out + t
+        return out
+    return inputs
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """layers.create_parameter parity."""
+    from .nn import initializer as _I
+    from .framework.tensor import Parameter as _Param
+    from .framework.dtype import convert_dtype as _cd
+    init = default_initializer or (_I.Constant(0.0) if is_bias
+                                   else _I.XavierUniform())
+    return _Param(init(list(shape), _cd(dtype) or "float32"), name=name)
+
+
+def is_tensor(x):
+    from .framework.tensor import Tensor as _T
+    return isinstance(x, _T)
+
+
+def is_empty(x, name=None):
+    from .framework.tensor import Tensor as _T, unwrap as _u
+    import jax.numpy as _jnp
+    return _T(_jnp.asarray(_u(x).size == 0))
+
+
+def in_dynamic_mode():
+    from .framework import core as _core
+    return not _core.in_static_mode()
+
+
+in_dygraph_mode = in_dynamic_mode
+
+
+def get_cuda_rng_state():
+    """CUDA-generator parity shim: TPU builds have no CUDA generator; the
+    framework RNG state is returned so checkpoint round-trips still work."""
+    from .framework.random import get_rng_state as _g
+    return _g()
+
+
+def set_cuda_rng_state(state):
+    from .framework.random import set_rng_state as _s
+    return _s(state)
+
+
+def get_cudnn_version():
+    return None      # no cuDNN in a TPU build (matches CPU-only paddle)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Delegates to numpy's global print options (Tensor repr prints via
+    numpy)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """hapi dynamic_flops parity: count multiply-add FLOPs of a dygraph
+    net by a forward pass with per-layer hooks."""
+    from .hapi.flops import flops as _flops
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail)
+
+
+from .hapi import callbacks  # noqa: F401,E402

@@ -40,20 +40,25 @@ class DistributedEmbedding(Layer):
                             init_scale=init_scale)
         self._pending: List[Tuple[np.ndarray, Tensor]] = []
 
-    def forward(self, ids):
-        from ...nn import functional as F
-        ids_arr = ids._value if isinstance(ids, Tensor) else np.asarray(ids)
-        ids_np = np.asarray(ids_arr)
-        uniq, inv = np.unique(ids_np, return_inverse=True)
+    def pull_padded_rows(self, uniq):
+        """Host pull + power-of-two padding. A stable [U_pad, D] shape
+        means the downstream XLA programs are compiled once, not per
+        distinct unique-id count (recompile-per-batch would dominate).
+        Shared by the eager forward and the fused PS trainers."""
         rows = self.client.pull_sparse(self.table_id, uniq)       # host
-        # pad the row block to a power-of-two bucket: a stable [U_pad, D]
-        # shape means the downstream XLA programs are compiled once, not per
-        # distinct unique-id count (recompile-per-batch would dominate)
         n = len(uniq)
         n_pad = max(8, 1 << (n - 1).bit_length())
         if n_pad != n:
             rows = np.concatenate(
                 [rows, np.zeros((n_pad - n, self.dim), np.float32)])
+        return rows
+
+    def forward(self, ids):
+        from ...nn import functional as F
+        ids_arr = ids._value if isinstance(ids, Tensor) else np.asarray(ids)
+        ids_np = np.asarray(ids_arr)
+        uniq, inv = np.unique(ids_np, return_inverse=True)
+        rows = self.pull_padded_rows(uniq)
         w_rows = Tensor(jnp.asarray(rows), stop_gradient=False)   # leaf
         w_rows.name = f"dist_emb_{self.table_id}_rows"
         if self.training:

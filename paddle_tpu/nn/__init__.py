@@ -46,3 +46,11 @@ from .layer.rnn import (  # noqa: F401
     RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
     LSTM, GRU,
 )
+from .layer.loss import HSigmoidLoss  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from . import utils  # noqa: F401
+from . import decode  # noqa: F401
+# reference exposes the layer submodules under paddle.nn too
+from .layer import (  # noqa: F401
+    common, conv, loss, norm, rnn,
+)

@@ -25,5 +25,14 @@ from .loss import (  # noqa: F401
     sigmoid_cross_entropy_with_logits, kl_div, smooth_l1_loss, huber_loss,
     log_loss, margin_ranking_loss, hinge_loss, sigmoid_focal_loss,
     cosine_embedding_loss, ctc_loss, square_error_cost, triplet_margin_loss,
+    dice_loss, npair_loss, hsigmoid_loss,
 )
 from .attention import scaled_dot_product_attention  # noqa: F401
+# re-exports the 2.x functional namespace also carries (the kernels live
+# in ops/)
+from ...ops.vision import (  # noqa: F401
+    grid_sample, affine_grid, temporal_shift,
+)
+from ...ops.math_ext import diag_embed  # noqa: F401
+from ...ops.math import assign  # noqa: F401
+from ...ops.decode import gather_tree  # noqa: F401

@@ -386,3 +386,112 @@ def _triplet_fn(a, pos, neg, margin=1.0, p=2.0, eps=1e-6, reduction="mean"):
 
 
 _triplet = Primitive("triplet_margin_loss", _triplet_fn)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss for segmentation (fluid/layers/nn.py:7069): label one-hot
+    over the last dim; score per sample reduced over all non-batch dims."""
+    from ... import ops
+    from .common import one_hot
+    lab = label
+    if len(lab.shape) == len(input.shape) and lab.shape[-1] == 1:
+        lab = ops.squeeze(lab, axis=[-1])
+    lab1h = one_hot(lab, input.shape[-1])
+    reduce_dim = list(range(1, len(input.shape)))
+    inse = ops.sum(input * lab1h, axis=reduce_dim)
+    denom = ops.sum(input, axis=reduce_dim) + ops.sum(lab1h,
+                                                      axis=reduce_dim)
+    score = 1 - inse * 2 / (denom + epsilon)
+    return ops.mean(score)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric loss (fluid/layers/loss.py:1653): soft-label CE over
+    the anchor/positive similarity matrix + Beta*l2 embedding penalty."""
+    from ... import ops
+    beta = 0.25
+    b = labels.shape[0]
+    lab = ops.reshape(labels, [b, 1]).astype("float32")
+    same = ops.equal(lab, ops.transpose(lab, [1, 0])).astype("float32")
+    same = same / ops.sum(same, axis=1, keepdim=True)
+    l2loss = ops.mean(ops.sum(anchor * anchor, axis=1)) + \
+        ops.mean(ops.sum(positive * positive, axis=1))
+    l2loss = l2loss * beta * float(l2_reg)
+    sim = ops.matmul(anchor, positive, transpose_y=True)
+    ce = softmax_with_cross_entropy(sim, same, soft_label=True)
+    return l2loss + ops.mean(ops.sum(same * ce, axis=0))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (hierarchical_sigmoid_op.cc). Default
+    tree: complete binary tree over ``num_classes`` leaves — internal node
+    ids follow the heap layout the reference's default path uses; custom
+    trees come in via path_table/path_code.
+
+    input [B, D]; label [B] int; weight [num_classes-1, D];
+    bias [num_classes-1] or None. Returns [B, 1].
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    from ... import ops
+    from ...framework.tensor import Tensor, unwrap
+
+    B, D = input.shape
+    if path_table is None:
+        table_dev, code_dev = _hsigmoid_default_tree(int(num_classes))
+    else:
+        table_dev = jnp.asarray(np.asarray(unwrap(path_table), np.int32))
+        code_dev = jnp.asarray(np.asarray(unwrap(path_code), np.int32))
+
+    lab = unwrap(label).astype(jnp.int32).reshape(-1)
+    t = Tensor(table_dev[lab])                           # [B, depth]
+    c = Tensor(code_dev[lab])                            # [B, depth]
+    w_rows = ops.gather(weight, ops.reshape(t, [-1]))    # [B*depth, D]
+    w_rows = ops.reshape(w_rows, [B, -1, D])
+    logits = ops.sum(w_rows * ops.reshape(input, [B, 1, D]), axis=2)
+    if bias is not None:
+        logits = logits + ops.reshape(
+            ops.gather(bias, ops.reshape(t, [-1])), [B, -1])
+    # sign from the code bit; padded steps (code -1) contribute zero
+    cv = c.astype("float32")
+    valid = ops.cast(c != -1, "float32")
+    sign = 2.0 * cv - 1.0
+    # log(1 + exp(-sign*logit)), numerically stable
+    z = -sign * logits
+    per_node = ops.maximum(z, z * 0) + ops.log1p(ops.exp(-ops.abs(z)))
+    loss = ops.sum(per_node * valid, axis=1, keepdim=True)
+    return loss
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=64)
+def _hsigmoid_default_tree(num_classes):
+    """Complete-binary-tree path table/codes for the default hsigmoid tree
+    (cached: pure function of num_classes, built once and kept on device).
+    Leaf l sits at heap position num_classes-1+l; internal node i's row in
+    `weight` is i."""
+    import numpy as np
+    import jax.numpy as jnp
+    depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+    tables, codes = [], []
+    for leaf in range(num_classes):
+        pos = num_classes - 1 + leaf
+        t, c = [], []
+        while pos > 0:
+            parent = (pos - 1) // 2
+            t.append(parent)
+            c.append(pos % 2)       # 1 if left child else 0
+            pos = parent
+        t = t[::-1][:depth]
+        c = c[::-1][:depth]
+        while len(t) < depth:       # pad short paths, masked out in loss
+            t.append(0)
+            c.append(-1)
+        tables.append(t)
+        codes.append(c)
+    return (jnp.asarray(np.asarray(tables, np.int32)),
+            jnp.asarray(np.asarray(codes, np.int32)))

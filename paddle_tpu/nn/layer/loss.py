@@ -159,3 +159,26 @@ class TripletMarginLoss(Layer):
         return F.triplet_margin_loss(input, positive, negative, self.margin,
                                      self.p, self.epsilon, self.swap,
                                      self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (python/paddle/nn/layer/loss.py
+    HSigmoidLoss over hierarchical_sigmoid_op.cc)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self._num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter([num_classes - 1], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        from ..functional import hsigmoid_loss
+        return hsigmoid_loss(input, label, self._num_classes, self.weight,
+                             self.bias, path_table=path_table,
+                             path_code=path_code)

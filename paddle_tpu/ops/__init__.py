@@ -17,6 +17,7 @@ from .linalg import (  # noqa: F401
 # paddle.cond; control-flow cond lives at static.nn.cond / ops.control_flow.cond)
 from .control_flow import (  # noqa: F401
     while_loop, case, switch_case,
+    create_array, array_write, array_read, array_length,
 )
 from .math_ext import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403

@@ -308,3 +308,41 @@ def _record_cond(pred, true_fn, false_fn):
     for o in outs:
         o.op = op
     return outs[0] if len(outs) == 1 else outs
+
+
+# -- TensorArray DSL (fluid/layers/control_flow.py array ops) -----------------
+
+class TensorArray(list):
+    """LoDTensorArray stand-in: a Python list of Tensors in eager mode; the
+    static path records writes/reads as ops over the same object
+    (lod_tensor_array / array_write_op, array_read_op)."""
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """fluid.layers.create_array parity."""
+    arr = TensorArray()
+    if initialized_list:
+        arr.extend(initialized_list)
+    return arr
+
+
+def array_write(x, i, array=None):
+    """array_write_op: array[i] = x (grows the array as needed)."""
+    if array is None:
+        array = create_array()
+    idx = int(_unwrap(i))
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    """array_read_op: array[i]."""
+    return array[int(_unwrap(i))]
+
+
+def array_length(array):
+    """lod_array_length_op."""
+    from ..framework.tensor import Tensor
+    return Tensor(jnp.asarray(len(array), jnp.int64))

@@ -193,28 +193,20 @@ class TrainStep:
         output to the activation dtype, so stats never leak fp32 into the
         compute path, and casting them would round-trip the running
         averages through bf16 every step (losing small-momentum updates).
-        Returns (params, buffers, inputs, None) — the None keeps the
-        uncast hook available if a future dtype ever needs it."""
+        Returns (params, buffers, inputs)."""
         fl = lambda v: jnp.issubdtype(v.dtype, jnp.floating)
         params = {n: (v.astype(cd) if fl(v) else v)
                   for n, v in params.items()}
         inputs = tuple(x.astype(cd) if x is not None and fl(x) else x
                        for x in inputs)
-        return params, buffers, inputs, None
-
-    @staticmethod
-    def _uncast_buffers(new_buffers, orig_dtypes):
-        return {n: (v.astype(orig_dtypes[n])
-                    if n in orig_dtypes and hasattr(v, "astype") else v)
-                for n, v in new_buffers.items()}
+        return params, buffers, inputs
 
     def _pipe_loss_of(self, params, buffers, inputs, label, rng_key):
         """Pipelined forward: embed (replicated) → GPipe trunk over pp →
         head (replicated) → loss.  One SPMD program; jax.grad reverses the
         whole schedule."""
-        orig_bdt = None
         if self.compute_dtype is not None:
-            params, buffers, inputs, orig_bdt = self._cast_compute(
+            params, buffers, inputs = self._cast_compute(
                 params, buffers, inputs, self.compute_dtype)
 
         def sub(tree, tag):
@@ -250,14 +242,11 @@ class TrainStep:
         if isinstance(out, (tuple, list)):
             out = out[0]
         loss = self.loss_fn(out, label) if self.loss_fn is not None else out
-        if orig_bdt is not None:
-            new_buffers = self._uncast_buffers(new_buffers, orig_bdt)
         return loss.astype(jnp.float32).mean(), new_buffers
 
     def _loss_of(self, params, buffers, inputs, label, rng_key):
-        orig_bdt = None
         if self.compute_dtype is not None:
-            params, buffers, inputs, orig_bdt = self._cast_compute(
+            params, buffers, inputs = self._cast_compute(
                 params, buffers, inputs, self.compute_dtype)
         if self.loss_fn is None:
             args = inputs if label is None else inputs + (label,)
@@ -272,8 +261,6 @@ class TrainStep:
             if isinstance(out, (tuple, list)):
                 out = out[0]
             loss = self.loss_fn(out, label)
-        if orig_bdt is not None:
-            new_buffers = self._uncast_buffers(new_buffers, orig_bdt)
         return loss.astype(jnp.float32).mean(), new_buffers
 
     def _build_step(self):

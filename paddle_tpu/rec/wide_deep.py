@@ -122,8 +122,8 @@ class WideDeepTrainer:
         we, de = self.model.wide_emb, self.model.deep_emb
         # one unique/inverse shared by both tables (same id space)
         uniq, inv = np.unique(ids, return_inverse=True)
-        w_rows = _pull_padded_rows(we, uniq)
-        d_rows = _pull_padded_rows(de, uniq)
+        w_rows = jnp.asarray(we.pull_padded_rows(uniq))
+        d_rows = jnp.asarray(de.pull_padded_rows(uniq))
         inv_dev = jnp.asarray(inv.reshape(ids.shape), jnp.int32)
         self._params, self._adam, loss, gw, gd = self._fused(
             self._params, self._adam, w_rows, d_rows, inv_dev, inv_dev,
@@ -132,15 +132,21 @@ class WideDeepTrainer:
                               np.asarray(gw)[:len(uniq)])
         de.client.push_sparse(de.table_id, uniq,
                               np.asarray(gd)[:len(uniq)])
+        # keep the eager model in sync: rebinding _value to the updated
+        # device arrays is a pointer swap (no transfer), so eval /
+        # state_dict always see the trained weights
+        self.sync_params()
         return float(loss)
 
     def sync_params(self):
-        """Write the jit-updated dense params back into the eager model
-        (for eval/save paths that read model.parameters())."""
-        core = _DenseCore(self.model)
-        for (name, p) in core.named_parameters():
-            if name in self._params:
-                p.set_value(self._params[name])
+        """Point the eager model's dense params at the jit-updated device
+        arrays (free — same buffers, no copy)."""
+        if not hasattr(self, "_name_map"):
+            core = _DenseCore(self.model)
+            self._name_map = [(n, p) for n, p in core.named_parameters()
+                              if n in self._params]
+        for name, p in self._name_map:
+            p._value = self._params[name]
 
 
 class _DenseCore(nn.Layer):
@@ -166,16 +172,6 @@ class _DenseCore(nn.Layer):
         return wide + deep
 
 
-def _pull_padded_rows(emb, uniq):
-    """Host pull + power-of-two padding (same bucketing as
-    DistributedEmbedding.forward, so the jitted step compiles once)."""
-    rows = emb.client.pull_sparse(emb.table_id, uniq)
-    n = len(uniq)
-    n_pad = max(8, 1 << (n - 1).bit_length())
-    if n_pad != n:
-        rows = np.concatenate(
-            [rows, np.zeros((n_pad - n, emb.dim), np.float32)])
-    return jnp.asarray(rows)
 
 
 def synthetic_ctr_batch(batch: int, num_slots: int = 26, dense_dim: int = 13,
