@@ -32,7 +32,7 @@ import importlib as _importlib
 _SUBPACKAGES = ["nn", "optimizer", "static", "io", "metric", "amp", "jit",
                 "distributed", "vision", "text", "autograd", "hapi",
                 "incubate", "inference", "profiler", "device",
-                "quantization", "utils"]
+                "quantization", "utils", "distribution", "onnx"]
 for _name in _SUBPACKAGES:
     try:
         globals()[_name] = _importlib.import_module(f".{_name}", __name__)
@@ -150,4 +150,7 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
                   print_detail=print_detail)
 
 
-from .hapi import callbacks  # noqa: F401,E402
+try:
+    from .hapi import callbacks  # noqa: F401
+except ImportError:  # pragma: no cover — partial builds degrade softly
+    callbacks = None

@@ -44,6 +44,10 @@ def _parse_args(argv=None):
                    help="processes per host (1 on TPU: PJRT owns all chips)")
     p.add_argument("--started_port", type=int, default=None)
     p.add_argument("--log_dir", default=None)
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help="0 = fail-fast (default); 1 = restart dead local "
+                        "ranks up to --max_restarts (fleet/elastic parity)")
+    p.add_argument("--max_restarts", type=int, default=3)
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -62,10 +66,10 @@ def get_cluster(ips, nproc_per_node, started_port=None):
 def launch_collective(args):
     endpoints, nranks = get_cluster(args.ips, args.nproc_per_node,
                                     args.started_port)
-    procs = []
     log_fps = []
     base_rank = args.host_rank * args.nproc_per_node
-    for local in range(args.nproc_per_node):
+
+    def spawn(local):
         rank = base_rank + local
         env = dict(os.environ)
         env.update({
@@ -80,13 +84,28 @@ def launch_collective(args):
         out = None
         if args.log_dir:
             os.makedirs(args.log_dir, exist_ok=True)
-            out = open(os.path.join(args.log_dir, f"workerlog.{local}"), "w")
+            # append only under elastic supervision (restart logs belong
+            # together); plain runs truncate like the reference launcher
+            mode = "a" if args.elastic_level >= 1 else "w"
+            out = open(os.path.join(args.log_dir, f"workerlog.{local}"),
+                       mode)
             log_fps.append(out)
-        procs.append(subprocess.Popen(cmd, env=env, stdout=out, stderr=out))
+        return subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
 
-    # watch_local_trainers (launch_utils.py:517) parity: fail-fast
-    rc = 0
     try:
+        if args.elastic_level >= 1:
+            # bounded-restart supervision (fleet/elastic parity)
+            from .elastic import ElasticLaunch
+            rc, restarts = ElasticLaunch(
+                spawn, args.nproc_per_node,
+                max_restarts=args.max_restarts).run()
+            if any(restarts.values()):
+                print(f"[launch] restarts per rank: {restarts}",
+                      file=sys.stderr)
+            return rc
+        # watch_local_trainers (launch_utils.py:517) parity: fail-fast
+        procs = [spawn(local) for local in range(args.nproc_per_node)]
+        rc = 0
         while procs:
             for p in list(procs):
                 ret = p.poll()
@@ -100,10 +119,10 @@ def launch_collective(args):
                     procs = []
                     break
             time.sleep(0.5)
+        return rc
     finally:
         for f in log_fps:
             f.close()
-    return rc
 
 
 def launch(argv=None):

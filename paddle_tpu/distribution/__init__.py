@@ -147,6 +147,8 @@ class Categorical(Distribution):
     def log_prob(self, value):
         v = _v(value).astype(jnp.int32)
         logp = jax.nn.log_softmax(self.logits, axis=-1)
+        if logp.ndim == 1:           # single distribution, batched values
+            return Tensor(logp[v])
         return Tensor(jnp.take_along_axis(logp, v[..., None],
                                           axis=-1).squeeze(-1))
 

@@ -27,6 +27,17 @@ def _count_conv(layer, inputs, output):
     return out_numel * (kernel_ops + bias_ops)
 
 
+def _count_conv_transpose(layer, inputs, output):
+    # transposed conv weight is [in_ch, out_ch/groups, kh, kw]: per output
+    # element the muls are in_ch/groups * kh * kw
+    w = layer.weight
+    out_numel = _numel(output.shape)
+    groups = getattr(layer, "_groups", 1)
+    kernel_ops = (w.shape[0] // groups) * _numel(w.shape[2:])
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    return out_numel * (kernel_ops + bias_ops)
+
+
 def _count_linear(layer, inputs, output):
     in_f = layer.weight.shape[0]
     out_numel = _numel(output.shape)
@@ -48,7 +59,9 @@ def _count_pool(layer, inputs, output):
 
 _COUNTERS = {
     "Conv1D": _count_conv, "Conv2D": _count_conv, "Conv3D": _count_conv,
-    "Conv2DTranspose": _count_conv,
+    "Conv1DTranspose": _count_conv_transpose,
+    "Conv2DTranspose": _count_conv_transpose,
+    "Conv3DTranspose": _count_conv_transpose,
     "Linear": _count_linear,
     "BatchNorm": _count_norm, "BatchNorm1D": _count_norm,
     "BatchNorm2D": _count_norm, "BatchNorm3D": _count_norm,

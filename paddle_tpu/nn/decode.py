@@ -62,9 +62,9 @@ class BeamSearchDecoder:
         out, new_states = self.cell(inp, cell_states)
         logits = self.output_fn(out) if self.output_fn is not None else out
         V = unwrap(logits).shape[-1]
-        lg = unwrap(logits).reshape(B, W, V)
-        lg = lg - jnp.max(lg, axis=-1, keepdims=True)
-        logp = lg - jnp.log(jnp.sum(jnp.exp(lg), axis=-1, keepdims=True))
+        import jax
+        logp = jax.nn.log_softmax(
+            unwrap(logits).reshape(B, W, V), axis=-1)
         new_ids, new_scores, parents = _beam_search_step_fn(
             ids, scores, logp, beam_size=W, end_id=self.end_token,
             is_accumulated=True)

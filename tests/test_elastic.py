@@ -1,0 +1,111 @@
+"""Elastic heartbeat + bounded-restart launch tests.
+
+Reference strategy parity: fleet/elastic tests — heartbeat staleness
+detection and ElasticManager restart budgets.
+"""
+import os
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.base.tcp_store import TCPStore
+from paddle_tpu.distributed.fleet.elastic import (HeartbeatReporter,
+                                                  HeartbeatMonitor,
+                                                  ElasticLaunch)
+
+
+def test_heartbeat_reporter_and_monitor():
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        mon = HeartbeatMonitor(store, world_size=2, stale_after=1.0)
+        assert mon.stale_ranks() == [0, 1]        # nothing published yet
+        hb = HeartbeatReporter(store, rank=0, interval=0.1).start()
+        time.sleep(0.3)
+        assert mon.stale_ranks() == [1]           # rank 0 alive
+        hb.stop()
+        time.sleep(1.2)
+        assert mon.stale_ranks() == [0, 1]        # rank 0 went stale
+    finally:
+        store.close()
+
+
+def test_elastic_launch_restarts_then_succeeds(tmp_path):
+    """A rank that crashes twice then succeeds must be restarted within the
+    budget and the job must exit 0."""
+    marker = tmp_path / "attempts"
+
+    def spawn(local):
+        import subprocess
+        code = (
+            "import os, sys\n"
+            f"p = r'{marker}'\n"
+            "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+            "open(p, 'w').write(str(n + 1))\n"
+            "sys.exit(0 if n >= 2 else 1)\n")
+        return subprocess.Popen([sys.executable, "-c", code])
+
+    rc, restarts = ElasticLaunch(spawn, 1, max_restarts=3,
+                                 poll_s=0.05).run()
+    assert rc == 0
+    assert restarts[0] == 2
+    assert marker.read_text() == "3"
+
+
+def test_elastic_launch_budget_exceeded(tmp_path):
+    def spawn(local):
+        import subprocess
+        return subprocess.Popen([sys.executable, "-c", "raise SystemExit(7)"])
+
+    rc, restarts = ElasticLaunch(spawn, 1, max_restarts=1,
+                                 poll_s=0.05).run()
+    assert rc == 7
+    assert restarts[0] == 1
+
+
+def test_launcher_elastic_flag(tmp_path):
+    """End-to-end through the CLI: --elastic_level 1 restarts a crashing
+    script (test_launch.py pattern)."""
+    import subprocess
+    marker = tmp_path / "n"
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, sys\n"
+        f"p = r'{marker}'\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        "sys.exit(0 if n >= 1 else 3)\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.fleet.launch",
+         "--nproc_per_node", "1", "--elastic_level", "1",
+         "--max_restarts", "2", str(script)],
+        capture_output=True, text=True, env=env,
+        cwd="/root/repo", timeout=120)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert marker.read_text() == "2"
+
+
+def test_elastic_gang_restart(tmp_path):
+    """Collective mode: one rank dying restarts the WHOLE gang (a lone
+    rank cannot rejoin a live jax.distributed job)."""
+    import subprocess
+
+    def spawn(local):
+        # rank 0 crashes on the first gang attempt, succeeds after
+        code = (
+            "import os, sys\n"
+            f"att = r'{tmp_path}/attempt'\n"
+            "n = int(open(att).read()) if os.path.exists(att) else 0\n"
+            f"if {local} == 0:\n"
+            "    open(att, 'w').write(str(n + 1))\n"
+            "    sys.exit(0 if n >= 1 else 5)\n"
+            "sys.exit(0)\n")
+        return subprocess.Popen([sys.executable, "-c", code])
+
+    rc, restarts = ElasticLaunch(spawn, 2, max_restarts=2,
+                                 poll_s=0.05).run()   # gang default: n>1
+    assert rc == 0
+    assert restarts[0] == 1       # one whole-gang restart
+    assert (tmp_path / "attempt").read_text() == "2"
