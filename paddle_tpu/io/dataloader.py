@@ -138,6 +138,14 @@ def _mp_worker(dataset, index_queue, data_queue, collate_fn, worker_id,
 class DataLoader:
     """reader.py:148 parity."""
 
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=True,
+                       use_multiprocess=False, drop_last=True):
+        """Legacy generator-fed loader (reader.py:425)."""
+        return _GeneratorLoader(feed_list, capacity, use_double_buffer,
+                                iterable, return_list, drop_last)
+
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn: Optional[Callable] = None,
@@ -386,3 +394,93 @@ class DataLoader:
                     raise err_holder[0]
                 return
             yield item
+
+
+class _GeneratorLoader:
+    """Legacy reader.py:425 ``DataLoader.from_generator`` object: batches
+    come from a user generator instead of a Dataset; supports the three
+    setter flavors and iterates Tensor trees (iterable mode)."""
+
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=True, drop_last=True):
+        self._feed_list = feed_list
+        self._capacity = max(int(capacity), 1)
+        self._double_buffer = use_double_buffer
+        self._iterable = iterable
+        self._return_list = return_list
+        self._drop_last = bool(drop_last)
+        self._gen_fn = None
+
+    # -- setters (reader.py set_* triple) ------------------------------------
+    def set_batch_generator(self, generator, places=None):
+        self._gen_fn = generator
+        return self
+
+    def set_sample_list_generator(self, generator, places=None):
+        def batched():
+            for sample_list in generator():
+                yield default_collate_fn(sample_list)
+        self._gen_fn = batched
+        return self
+
+    def set_sample_generator(self, generator, batch_size, drop_last=None,
+                             places=None):
+        keep_tail = not (self._drop_last if drop_last is None
+                         else drop_last)
+
+        def batched():
+            buf = []
+            for sample in generator():
+                buf.append(sample if isinstance(sample, (tuple, list))
+                           else (sample,))
+                if len(buf) == batch_size:
+                    yield default_collate_fn(buf)
+                    buf = []
+            if buf and keep_tail:
+                yield default_collate_fn(buf)
+        self._gen_fn = batched
+        return self
+
+    def _tensor_batches(self):
+        import jax
+        for batch in self._gen_fn():
+            if isinstance(batch, (tuple, list)):
+                batch = tuple(batch)
+            elif not isinstance(batch, dict):
+                batch = (batch,)
+            yield _to_tensor_tree(batch, jax.device_put)
+
+    def __iter__(self):
+        if self._gen_fn is None:
+            raise RuntimeError("call set_batch_generator / "
+                               "set_sample_generator first")
+        if not self._double_buffer:
+            yield from self._tensor_batches()
+            return
+        # prefetch thread overlaps generator+H2D with consumption (the
+        # buffered_reader double buffer, same pattern as DataLoader)
+        buf = queue_mod.Queue(maxsize=self._capacity)
+        stop = object()
+        err = []
+
+        def producer():
+            try:
+                for item in self._tensor_batches():
+                    buf.put(item)
+            except Exception as e:
+                err.append(e)
+            finally:
+                buf.put(stop)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = buf.get()
+            if item is stop:
+                if err:
+                    raise err[0]
+                return
+            yield item
+
+    def __call__(self):
+        return iter(self)

@@ -15,7 +15,7 @@ from .compiler import (  # noqa: F401
 )
 from .io import (  # noqa: F401
     save_persistables, load_persistables, save_params, load_params,
-    save_inference_model, load_inference_model,
+    save_inference_model, load_inference_model, save_vars, load_vars,
 )
 from . import nn  # noqa: F401
 

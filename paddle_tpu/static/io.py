@@ -65,21 +65,53 @@ def _program_from_dict(d) -> Program:
     return p
 
 
-def save_persistables(executor=None, dirname=None, main_program=None,
-                      filename=None):
-    """io.py:620 parity: dump every persistable var's scope value."""
+def save_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """io.py:238 parity: dump a chosen subset of vars (by list or
+    predicate) from the scope."""
     program = main_program or default_main_program()
     scope = global_scope()
     os.makedirs(dirname, exist_ok=True)
+    if vars is None:
+        vars = [v for v in program.list_vars()
+                if predicate is None or predicate(v)]
     blob = {}
-    for v in program.list_vars():
-        if v.persistable:
-            val = scope.find_var(v.name)
-            if val is not None:
-                blob[v.name] = np.asarray(val)
-    path = os.path.join(dirname, filename or "__persistables__")
+    for v in vars:
+        name = v.name if hasattr(v, "name") else str(v)
+        val = scope.find_var(name)
+        if val is not None:
+            blob[name] = np.asarray(val)
+    path = os.path.join(dirname, filename or "__vars__")
     with open(path, "wb") as f:
         pickle.dump(blob, f, protocol=4)
+
+
+def load_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Restore only the requested subset (vars list / predicate), like the
+    reference load_vars — a full-blob restore would clobber vars the
+    caller changed since saving."""
+    scope = global_scope()
+    path = os.path.join(dirname, filename or "__vars__")
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    wanted = None
+    if vars is not None:
+        wanted = {v.name if hasattr(v, "name") else str(v) for v in vars}
+    elif predicate is not None:
+        program = main_program or default_main_program()
+        wanted = {v.name for v in program.list_vars() if predicate(v)}
+    for name, val in blob.items():
+        if wanted is None or name in wanted:
+            scope.set_var(name, jnp.asarray(val))
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """io.py:620 parity: dump every persistable var's scope value."""
+    save_vars(executor, dirname, main_program,
+              predicate=lambda v: v.persistable,
+              filename=filename or "__persistables__")
 
 
 save_params = save_persistables
@@ -87,12 +119,8 @@ save_params = save_persistables
 
 def load_persistables(executor=None, dirname=None, main_program=None,
                       filename=None):
-    scope = global_scope()
-    path = os.path.join(dirname, filename or "__persistables__")
-    with open(path, "rb") as f:
-        blob = pickle.load(f)
-    for name, val in blob.items():
-        scope.set_var(name, jnp.asarray(val))
+    load_vars(executor, dirname, main_program,
+              filename=filename or "__persistables__")
 
 
 load_params = load_persistables

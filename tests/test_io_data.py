@@ -149,3 +149,60 @@ def test_collate_nested_dict():
     out = default_collate_fn(batch)
     assert out["a"].shape == (2, 2)
     assert out["b"].tolist() == [1, 2]
+
+
+def test_dataloader_from_generator():
+    """Legacy reader.py:425 generator-fed loader (three setter flavors)."""
+    from paddle_tpu.io import DataLoader
+    loader = DataLoader.from_generator(capacity=8)
+
+    def gen():
+        for i in range(3):
+            yield np.full((4, 2), i, "float32"), np.full((4,), i, "int64")
+
+    loader.set_batch_generator(gen)
+    out = [(float(x.numpy()[0, 0]), int(y.numpy()[0])) for x, y in loader]
+    assert out == [(0.0, 0), (1.0, 1), (2.0, 2)]
+
+    loader2 = DataLoader.from_generator()
+
+    def sgen():
+        for i in range(7):
+            yield np.full((2,), i, "float32"), np.int64(i)
+
+    loader2.set_sample_generator(sgen, batch_size=3, drop_last=True)
+    shapes = [list(x.shape) for x, y in loader2]
+    assert shapes == [[3, 2], [3, 2]]
+
+    loader3 = DataLoader.from_generator()
+
+    def slgen():
+        for i in range(2):
+            yield [(np.full((2,), i, "float32"),) for _ in range(4)]
+
+    loader3.set_sample_list_generator(slgen)
+    batches = [x[0] for x in loader3]
+    assert [list(b.shape) for b in batches] == [[4, 2], [4, 2]]
+
+
+def test_static_save_load_vars(tmp_path):
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3], "float32")
+            out = static.nn.fc(x, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        params = [v for v in main.list_vars() if v.persistable]
+        static.save_vars(exe, str(tmp_path), main, vars=params)
+        import numpy as _np
+        ref = _np.asarray(static.global_scope().find_var(params[0].name))
+        static.global_scope().set_var(params[0].name,
+                                      _np.zeros_like(ref))
+        static.load_vars(exe, str(tmp_path), main, vars=params)
+        got = _np.asarray(static.global_scope().find_var(params[0].name))
+        assert _np.allclose(got, ref)
+    finally:
+        paddle.disable_static()
