@@ -98,6 +98,50 @@ def _unflatten_batch(spec, arrays):
     return arrays[spec]
 
 
+def _double_buffered(make_iter, maxsize=2):
+    """Producer-thread double buffer shared by DataLoader.__iter__ and the
+    generator-fed loader (buffered_reader.cc parity). maxsize stays SMALL:
+    queued items are device-resident, so a large queue would buffer whole
+    epochs in HBM. Consumer breaking early sets the shutdown flag so the
+    producer never blocks forever on a full queue."""
+    buf = queue_mod.Queue(maxsize=maxsize)
+    stop = object()
+    err = []
+    shutdown = threading.Event()
+
+    def producer():
+        try:
+            for item in make_iter():
+                while not shutdown.is_set():
+                    try:
+                        buf.put(item, timeout=0.1)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if shutdown.is_set():
+                    return
+        except Exception as e:
+            err.append(e)
+        finally:
+            try:
+                buf.put(stop, timeout=1.0)
+            except queue_mod.Full:
+                pass
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = buf.get()
+            if item is stop:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        shutdown.set()
+
+
 def _mp_worker(dataset, index_queue, data_queue, collate_fn, worker_id,
                num_workers, ring_name=None):
     _worker_info.info = WorkerInfo(worker_id, num_workers, dataset)
@@ -371,29 +415,14 @@ class DataLoader:
             for batch in gen:
                 yield _to_tensor_tree(batch, self._device_put)
             return
+
         # async H2D double-buffer (buffered_reader.cc parity)
-        buf = queue_mod.Queue(maxsize=self.prefetch_factor)
-        stop = object()
-        err_holder = []
+        def tensor_batches():
+            for batch in gen:
+                yield _to_tensor_tree(batch, self._device_put)
 
-        def producer():
-            try:
-                for batch in gen:
-                    buf.put(_to_tensor_tree(batch, self._device_put))
-            except Exception as e:
-                err_holder.append(e)
-            finally:
-                buf.put(stop)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = buf.get()
-            if item is stop:
-                if err_holder:
-                    raise err_holder[0]
-                return
-            yield item
+        yield from _double_buffered(tensor_batches,
+                                    maxsize=self.prefetch_factor)
 
 
 class _GeneratorLoader:
@@ -403,10 +432,13 @@ class _GeneratorLoader:
 
     def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
                  iterable=True, return_list=True, drop_last=True):
+        if not iterable:
+            raise NotImplementedError(
+                "from_generator(iterable=False) (start()/reset() feeding "
+                "protocol) is not supported — iterate the loader instead")
         self._feed_list = feed_list
         self._capacity = max(int(capacity), 1)
         self._double_buffer = use_double_buffer
-        self._iterable = iterable
         self._return_list = return_list
         self._drop_last = bool(drop_last)
         self._gen_fn = None
@@ -442,13 +474,13 @@ class _GeneratorLoader:
         return self
 
     def _tensor_batches(self):
-        import jax
+        # DataLoader._device_put: dp-mesh batches scatter across chips
         for batch in self._gen_fn():
             if isinstance(batch, (tuple, list)):
                 batch = tuple(batch)
             elif not isinstance(batch, dict):
                 batch = (batch,)
-            yield _to_tensor_tree(batch, jax.device_put)
+            yield _to_tensor_tree(batch, DataLoader._device_put)
 
     def __iter__(self):
         if self._gen_fn is None:
@@ -457,30 +489,9 @@ class _GeneratorLoader:
         if not self._double_buffer:
             yield from self._tensor_batches()
             return
-        # prefetch thread overlaps generator+H2D with consumption (the
-        # buffered_reader double buffer, same pattern as DataLoader)
-        buf = queue_mod.Queue(maxsize=self._capacity)
-        stop = object()
-        err = []
-
-        def producer():
-            try:
-                for item in self._tensor_batches():
-                    buf.put(item)
-            except Exception as e:
-                err.append(e)
-            finally:
-                buf.put(stop)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = buf.get()
-            if item is stop:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        # device-queue depth stays SMALL (queued items live in HBM);
+        # ``capacity`` is the reference's host-queue knob, not this one
+        yield from _double_buffered(self._tensor_batches, maxsize=2)
 
     def __call__(self):
         return iter(self)
