@@ -238,14 +238,19 @@ def bench_wide_deep(on_tpu):
     # Criteo-scale jobs batch in the tens of thousands anyway
     batch, iters = (32768, 8) if on_tpu else (64, 3)
     model = WideDeep()
-    trainer = WideDeepTrainer(model)
+    # a_sync communicator mode: sparse pushes drain on a background
+    # thread, overlapping the next step's pull+compute (communicator.h
+    # AsyncCommunicator parity)
+    trainer = WideDeepTrainer(model, async_push=True)
     ids, dense, labels = synthetic_ctr_batch(batch)
     trainer.step(ids, dense, labels)  # compile + warmup
+    trainer.flush()
 
     t0 = time.perf_counter()
     loss = None
     for _ in range(iters):
         loss = trainer.step(ids, dense, labels)  # returns a host float
+    trainer.flush()
     dt = time.perf_counter() - t0
     assert np.isfinite(loss)
     v = batch * iters / dt

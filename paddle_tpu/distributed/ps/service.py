@@ -203,19 +203,27 @@ class LocalPsEndpoint:
     process for tests (test_dist_base local mode)."""
 
     def __init__(self):
+        import threading
         self._tables: Dict[int, object] = {}
+        # async-communicator mode pushes from a drain thread while the
+        # trainer pulls: serialize table access so a pull can never see a
+        # torn (half-applied) row update
+        self._lock = threading.RLock()
 
     def create_table(self, table_id: int, kind: str = "sparse", **config):
-        if table_id not in self._tables:
-            self._tables[table_id] = (SparseTable(**config)
-                                      if kind == "sparse"
-                                      else DenseTable(**config))
+        with self._lock:
+            if table_id not in self._tables:
+                self._tables[table_id] = (SparseTable(**config)
+                                          if kind == "sparse"
+                                          else DenseTable(**config))
 
     def pull_sparse(self, table_id, ids):
-        return self._tables[table_id].pull(np.asarray(ids))
+        with self._lock:
+            return self._tables[table_id].pull(np.asarray(ids))
 
     def push_sparse(self, table_id, ids, grads):
-        self._tables[table_id].push(np.asarray(ids), np.asarray(grads))
+        with self._lock:
+            self._tables[table_id].push(np.asarray(ids), np.asarray(grads))
 
     def pull_dense(self, table_id):
         return self._tables[table_id].pull()

@@ -73,11 +73,50 @@ class WideDeepTrainer:
     dispatch, which is the difference between latency-bound and
     compute-bound on a remote chip."""
 
-    def __init__(self, model: WideDeep, lr: float = 1e-3):
+    def __init__(self, model: WideDeep, lr: float = 1e-3,
+                 async_push: bool = False):
         import jax
         from ..framework import functional as F
         self.model = model
         self.lr = float(lr)
+        # a_sync communicator parity (communicator.h AsyncCommunicator):
+        # sparse pushes (incl. the D2H grad read) drain on a background
+        # thread, overlapping the next step's pull+compute; embeddings may
+        # be read one step stale, and a failed push surfaces on the NEXT
+        # step()/flush() — inherent to async mode, as in the reference.
+        self._async_push = bool(async_push)
+        self._push_queue = None
+        self._push_thread = None
+        self._push_err = []
+        if self._async_push:
+            import queue as queue_mod
+            import threading
+            self._push_queue = queue_mod.Queue(maxsize=4)
+            # the closure captures only the queue + error list (NOT self):
+            # the trainer must stay collectable; close() retires the thread
+            q, errs = self._push_queue, self._push_err
+
+            def drain():
+                while True:
+                    item = q.get()
+                    try:
+                        if item is None:
+                            return
+                        # one item = one step's pushes for BOTH tables, so
+                        # a step's sparse updates apply atomically wrt
+                        # flush boundaries; D2H happens here, off the
+                        # trainer thread
+                        for emb, uniq, grads_dev, n in item:
+                            emb.client.push_sparse(
+                                emb.table_id, uniq,
+                                np.asarray(grads_dev)[:n])
+                    except Exception as e:
+                        errs.append(e)
+                    finally:
+                        q.task_done()
+
+            self._push_thread = threading.Thread(target=drain, daemon=True)
+            self._push_thread.start()
 
         core = _DenseCore(model)
         apply, params, buffers = F.functionalize(core, training=True)
@@ -117,7 +156,38 @@ class WideDeepTrainer:
 
         self._fused = jax.jit(fused)
 
+    def _raise_push_errors(self):
+        if self._push_err:
+            errs = list(self._push_err)
+            del self._push_err[:]
+            raise errs[0]
+
+    def _push_both(self, we, de, uniq, gw, gd):
+        n = len(uniq)
+        if self._async_push:
+            self._push_queue.put(((we, uniq, gw, n), (de, uniq, gd, n)))
+        else:
+            we.client.push_sparse(we.table_id, uniq, np.asarray(gw)[:n])
+            de.client.push_sparse(de.table_id, uniq, np.asarray(gd)[:n])
+
+    def close(self):
+        """Retire the drain thread (idempotent)."""
+        if self._push_thread is not None:
+            self._push_queue.put(None)
+            self._push_thread.join(timeout=5)
+            self._push_thread = None
+
+    def __del__(self):  # pragma: no cover — best-effort cleanup
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def step(self, sparse_ids, dense_x, labels) -> float:
+        if self._async_push:
+            # surface background push failures BEFORE advancing dense
+            # state for this batch
+            self._raise_push_errors()
         ids = np.asarray(sparse_ids)
         we, de = self.model.wide_emb, self.model.deep_emb
         # one unique/inverse shared by both tables (same id space)
@@ -128,15 +198,18 @@ class WideDeepTrainer:
         self._params, self._adam, loss, gw, gd = self._fused(
             self._params, self._adam, w_rows, d_rows, inv_dev, inv_dev,
             jnp.asarray(dense_x), jnp.asarray(labels))
-        we.client.push_sparse(we.table_id, uniq,
-                              np.asarray(gw)[:len(uniq)])
-        de.client.push_sparse(de.table_id, uniq,
-                              np.asarray(gd)[:len(uniq)])
+        self._push_both(we, de, uniq, gw, gd)
         # keep the eager model in sync: rebinding _value to the updated
         # device arrays is a pointer swap (no transfer), so eval /
         # state_dict always see the trained weights
         self.sync_params()
         return float(loss)
+
+    def flush(self):
+        """Drain pending async pushes (barrier before eval/save)."""
+        if self._push_queue is not None:
+            self._push_queue.join()
+        self._raise_push_errors()
 
     def sync_params(self):
         """Point the eager model's dense params at the jit-updated device

@@ -264,3 +264,21 @@ def test_pipeline_state_dict_prefixed():
         assert any(k.startswith("head.") for k in sd)
         assert any(k.startswith("trunk.1.") for k in sd)
         m.set_state_dict(sd)  # round-trips
+
+
+def test_wide_deep_async_push_converges():
+    """a_sync communicator mode: background sparse pushes must still
+    train (embeddings at most one step stale) and flush() barriers."""
+    from paddle_tpu.rec.wide_deep import (WideDeep, WideDeepTrainer,
+                                          synthetic_ctr_batch)
+    paddle.seed(7)
+    model = WideDeep(emb_dim=8, hidden=(32,))
+    tr = WideDeepTrainer(model, lr=1e-2, async_push=True)
+    losses = []
+    for i in range(12):
+        ids, dense, labels = synthetic_ctr_batch(256, seed=i)
+        losses.append(tr.step(ids, dense, labels))
+    tr.flush()
+    assert losses[-1] < losses[0], losses
+    # after flush the tables reflect every push: a second flush is a no-op
+    tr.flush()
