@@ -194,8 +194,12 @@ def repeat_interleave(x, repeats, axis=None, name=None):
     return Tensor(jnp.repeat(unwrap(x), unwrap(repeats), axis=axis))
 
 
+_builtin_slice = slice    # the ``slice`` op below shadows the builtin
+
+
 def _slice_fn(x, spec=()):
-    idx = tuple(slice(*s) if isinstance(s, tuple) else s for s in spec)
+    idx = tuple(_builtin_slice(*s) if isinstance(s, tuple) else s
+                for s in spec)
     return x[idx]
 
 

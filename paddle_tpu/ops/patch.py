@@ -106,19 +106,49 @@ def _setitem_fn(x, v, *dynamic, spec=()):
 _setitem = Primitive("setitem", _setitem_fn)
 
 
+def _old_version(s):
+    """Snapshot the pre-mutation version of a tensor for in-place ops: the
+    recorded op must consume the OLD node, not the tensor object that will
+    be re-pointed at the new node (which would make the graph cyclic).
+    In-place mutation of a grad-requiring leaf would silently strand its
+    gradient on the snapshot — refuse it, like the reference's inplace
+    version-check."""
+    from ..framework.tensor import Tensor
+    from ..framework import core
+    if (core.grad_enabled() and s.is_leaf and not s.stop_gradient):
+        raise RuntimeError(
+            "in-place operation on a leaf Tensor that requires grad is "
+            "not allowed (wrap in paddle.no_grad() for raw updates)")
+    old = Tensor(s._value, stop_gradient=s.stop_gradient)
+    old._node = s._node
+    old._out_index = s._out_index
+    old.is_leaf = s.is_leaf
+    return old
+
+
+def _adopt(s, out):
+    """Point s at the freshly computed version (in-place surface)."""
+    s._value = out._value
+    s._node = out._node
+    s._out_index = out._out_index
+    if out._node is not None:
+        s.stop_gradient = False
+        s.is_leaf = False
+    return s
+
+
 def _tensor_setitem(self, idx, value):
     spec, dynamic = _encode_index(idx, self.ndim)
     v = unwrap(value)
     if not hasattr(v, "dtype"):
         v = jnp.asarray(v, self.dtype)
-    out = _setitem(self, v, *dynamic, spec=spec)
+    from ..framework import core
+    if core.grad_enabled() and self._node is not None:
+        out = _setitem(_old_version(self), v, *dynamic, spec=spec)
+    else:
+        out = _setitem(self, v, *dynamic, spec=spec)
     # functional update with in-place surface semantics (paddle __setitem__)
-    self._value = out._value
-    self._node = out._node
-    self._out_index = out._out_index
-    if out._node is not None:
-        self.stop_gradient = False
-        self.is_leaf = False
+    _adopt(self, out)
 
 
 def apply_patches(T=None, eager=True):
@@ -190,7 +220,38 @@ def apply_patches(T=None, eager=True):
     if eager:
         T.fill_ = lambda s, v: s.set_value(jnp.full_like(s._value, float(v)))
         T.zero_ = lambda s: s.set_value(jnp.zeros_like(s._value))
+        # in-place arithmetic (math_op_patch add_/subtract_/scale_ family):
+        # functional update with in-place surface semantics — the recorded
+        # op consumes the OLD version and the tensor adopts the new node,
+        # so the mutation stays on the tape without a graph cycle
+        def _inplace(compute):
+            def run(s, *args, **kwargs):
+                from ..framework import core
+                src = _old_version(s) if (core.grad_enabled() and
+                                          s._node is not None) else s
+                return _adopt(s, compute(src, *args, **kwargs))
+            return run
+
+        T.add_ = _inplace(lambda s, o: s + _coerce(o, s))
+        T.subtract_ = _inplace(lambda s, o: s - _coerce(o, s))
+        T.multiply_ = _inplace(lambda s, o: s * _coerce(o, s))
+        T.scale_ = _inplace(
+            lambda s, scale=1.0, bias=0.0, bias_after_scale=True:
+            m.scale(s, scale=scale, bias=bias,
+                    bias_after_scale=bias_after_scale))
+        T.clip_ = _inplace(lambda s, min=None, max=None: m.clip(s, min, max))
     T.norm = _method_norm
+    # misc method parity (varbase_patch_methods)
+    T.ndimension = lambda s: len(s.shape)
+    T.rank = lambda s: len(s.shape)
+    T.element_size = lambda s: jnp.dtype(s.dtype).itemsize
+    T.contiguous = lambda s: s                 # XLA arrays are always dense
+    T.is_contiguous = lambda s: True
+    T.slice = lambda s, axes, starts, ends: manipulation.slice(
+        s, axes, starts, ends)
+    if eager:
+        T.gradient = lambda s: (None if s.grad is None
+                                else s.grad.numpy())
 
 
 def _method(fn):

@@ -142,3 +142,49 @@ def test_functional_reexports():
     for name in ("grid_sample", "affine_grid", "temporal_shift",
                  "diag_embed", "assign", "gather_tree"):
         assert hasattr(F, name), name
+
+
+def test_slice_family():
+    """paddle.slice / strided_slice / crop (slice_op.cc family) — the
+    builtin-shadowing regression test."""
+    t = paddle.to_tensor(np.arange(12).reshape(3, 4))
+    assert paddle.slice(t, [0, 1], [0, 1], [2, 3]).numpy().tolist() == \
+        [[1, 2], [5, 6]]
+    assert paddle.strided_slice(t, [1], [0], [4], [2]).numpy().tolist() == \
+        [[0, 2], [4, 6], [8, 10]]
+    assert paddle.crop(t, [2, 2], [1, 1]).numpy().tolist() == \
+        [[5, 6], [9, 10]]
+
+
+def test_tensor_method_longtail():
+    t = paddle.ones([2, 3])
+    assert t.ndimension() == 2 and t.rank() == 2 and t.element_size() == 4
+    assert t.contiguous() is t and t.is_contiguous()
+    t.add_(paddle.ones([2, 3]))
+    assert float(t.numpy()[0, 0]) == 2.0
+    t.scale_(2.0, 1.0)
+    assert float(t.numpy()[0, 0]) == 5.0
+    t.clip_(0.0, 4.0)
+    assert float(t.numpy()[0, 0]) == 4.0
+    assert list(t.slice([0], [0], [1]).shape) == [1, 3]
+    x = paddle.to_tensor(np.ones((2,), "float32"), stop_gradient=False)
+    (x * x).sum().backward()
+    assert np.allclose(x.gradient(), 2.0)
+
+
+def test_inplace_ops_stay_on_tape():
+    """In-place mutation of a NON-leaf must record (no graph cycle):
+    d/dx of (x*x)*3 = 6x."""
+    x = paddle.to_tensor(np.array(2.0, "float32"), stop_gradient=False)
+    y = x * x
+    y.multiply_(paddle.to_tensor(np.array(3.0, "float32")))
+    y.backward()
+    assert abs(float(x.grad.numpy()) - 12.0) < 1e-5
+
+
+def test_setitem_on_nonleaf_differentiable():
+    a = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+    b = a * 2
+    b[0] = 5.0
+    paddle.sum(b).backward()
+    assert np.allclose(a.grad.numpy(), [0.0, 2.0, 2.0])
