@@ -26,14 +26,21 @@ _float0 = jax.dtypes.float0
 
 class GradNode:
     """One recorded op application: knows how to map out-cotangents to in-cotangents."""
-    __slots__ = ("name", "grad_fn", "primals", "inputs", "out_avals", "out_ct",
-                 "visited_tag")
+    __slots__ = ("name", "grad_fn", "primals", "inputs", "input_edges",
+                 "out_avals", "out_ct", "visited_tag")
 
     def __init__(self, name, grad_fn, primals, inputs, out_avals):
         self.name = name
         self.grad_fn = grad_fn        # (cts_tuple, *primals) -> tuple of input cts
         self.primals = primals        # tuple of jax arrays (residual-free: replayed)
         self.inputs = inputs          # tuple of Tensor refs aligned with primals
+        # graph edges captured at RECORD time: an in-place op re-pointing a
+        # consumed Tensor's _node later must not reroute this op's backward
+        # (the version-counter problem; basic_engine resolves edges eagerly
+        # too)
+        self.input_edges = tuple(
+            (t._node, t._out_index) if isinstance(t, Tensor) else (None, None)
+            for t in inputs)
         self.out_avals = out_avals    # list[(shape, dtype)] per output
         self.out_ct = None
         self.visited_tag = 0
@@ -64,6 +71,7 @@ class GradNode:
     def release(self):
         self.primals = None
         self.inputs = None
+        self.input_edges = None
         self.out_ct = None
         self.grad_fn = None
 
@@ -141,8 +149,7 @@ def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None,
     while stack:
         n = stack.pop()
         order.append(n)
-        for t in n.inputs:
-            p = t._node if isinstance(t, Tensor) else None
+        for (p, _) in n.input_edges:
             if p is None:
                 continue
             deps[id(p)] = deps.get(id(p), 0) + 1
@@ -158,14 +165,13 @@ def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None,
         processed.append(n)
         cts = n.materialize_cts()
         in_cts = n.grad_fn(cts, *n.primals)
-        for t, ct in zip(n.inputs, in_cts):
+        for t, (p, out_idx), ct in zip(n.inputs, n.input_edges, in_cts):
             if not isinstance(t, Tensor):
                 continue
             if ct.dtype == _float0:
                 continue
-            p = t._node
             if p is not None:
-                p.seed(t._out_index, ct)
+                p.seed(out_idx, ct)
                 if t._retain_grads and not t.stop_gradient:
                     _accumulate_into_tensor(t, ct)
                 deps[id(p)] -= 1
@@ -280,8 +286,7 @@ def _backward_recorded(root: Tensor, seed: Tensor, wanted, table,
     node.visited_tag = tag
     while stack:
         n = stack.pop()
-        for t in n.inputs:
-            p = t._node if isinstance(t, Tensor) else None
+        for (p, _) in n.input_edges:
             if p is None:
                 continue
             deps[id(p)] = deps.get(id(p), 0) + 1
@@ -299,12 +304,11 @@ def _backward_recorded(root: Tensor, seed: Tensor, wanted, table,
         n.out_ct = out_cts.get(id(n))        # borrowed by _recorded_grad_apply
         in_cts = _recorded_grad_apply(n)
         n.out_ct = None
-        for t, ct in zip(n.inputs, in_cts):
+        for t, (p, out_idx), ct in zip(n.inputs, n.input_edges, in_cts):
             if not isinstance(t, Tensor):
                 continue
             if ct._value.dtype == _float0:
                 continue
-            p = t._node
             if id(t) in wanted:
                 cur = table.get(id(t))
                 table[id(t)] = ct if cur is None else cur + ct
@@ -312,7 +316,7 @@ def _backward_recorded(root: Tensor, seed: Tensor, wanted, table,
                 slot = out_cts.get(id(p))
                 if slot is None:
                     slot = out_cts[id(p)] = [None] * len(p.out_avals)
-                _seed_recorded(slot, t._out_index, p.out_avals[t._out_index], ct)
+                _seed_recorded(slot, out_idx, p.out_avals[out_idx], ct)
                 deps[id(p)] -= 1
                 if deps[id(p)] == 0:
                     queue.append(p)

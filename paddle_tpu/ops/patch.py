@@ -107,18 +107,14 @@ _setitem = Primitive("setitem", _setitem_fn)
 
 
 def _old_version(s):
-    """Snapshot the pre-mutation version of a tensor for in-place ops: the
-    recorded op must consume the OLD node, not the tensor object that will
-    be re-pointed at the new node (which would make the graph cyclic).
-    In-place mutation of a grad-requiring leaf would silently strand its
-    gradient on the snapshot — refuse it, like the reference's inplace
-    version-check."""
+    """Snapshot the pre-mutation version of a non-leaf tensor for in-place
+    ops: the recorded op must consume the OLD (node, out_index) edge, not
+    the tensor object that is about to be re-pointed at the new node —
+    GradNode captures edges at record time, so earlier consumers keep the
+    pre-mutation version and this op sees it too. Leaves need no snapshot:
+    their edge is (None, ·) and gradient accumulation targets the tensor
+    object itself."""
     from ..framework.tensor import Tensor
-    from ..framework import core
-    if (core.grad_enabled() and s.is_leaf and not s.stop_gradient):
-        raise RuntimeError(
-            "in-place operation on a leaf Tensor that requires grad is "
-            "not allowed (wrap in paddle.no_grad() for raw updates)")
     old = Tensor(s._value, stop_gradient=s.stop_gradient)
     old._node = s._node
     old._out_index = s._out_index

@@ -203,7 +203,167 @@ def _sequence_expand_impl(x, reps, max_rep=1):
 
 _seq_expand = Primitive("sequence_expand", _sequence_expand_impl)
 
+
+
+# -- round-2 long tail ---------------------------------------------------------
+
+def _sequence_concat_fn(*args):
+    """sequence_concat_op.cc: per-row concatenation of ragged sequences.
+    args = x1, len1, x2, len2, ... -> (out [B, sumT, ...], out_lengths).
+    Rows are repacked so each output row is row_i(x1)+row_i(x2)+..."""
+    xs = args[0::2]
+    lens = args[1::2]
+    B = xs[0].shape[0]
+    T_out = sum(x.shape[1] for x in xs)
+    feat = xs[0].shape[2:]
+    out = jnp.zeros((B, T_out) + feat, xs[0].dtype)
+    total = jnp.zeros((B,), lens[0].dtype)
+    # scatter each segment at its running offset via masked index math
+    pos_out = jnp.arange(T_out)[None, :]                 # [1, T_out]
+    for x, l in zip(xs, lens):
+        T = x.shape[1]
+        start = total[:, None]                           # [B, 1]
+        src_idx = jnp.clip(pos_out - start, 0, T - 1)
+        gathered = jnp.take_along_axis(
+            x, src_idx.reshape((B, T_out) + (1,) * len(feat)), axis=1)
+        valid = (pos_out >= start) & (pos_out < start + l[:, None])
+        out = jnp.where(valid.reshape((B, T_out) + (1,) * len(feat)),
+                        gathered, out)
+        total = total + l
+    return out, total
+
+
+_sequence_concat = Primitive("sequence_concat", _sequence_concat_fn,
+                             multi_output=True)
+
+
+def sequence_concat(xs, lengths_list, name=None):
+    """Concat ragged rows: returns (packed [B, sum(maxT), ...], lengths)."""
+    flat = []
+    for x, l in zip(xs, lengths_list):
+        flat += [x, unwrap(l).astype(jnp.int32)]
+    return _sequence_concat(*flat)
+
+
+def _sequence_expand_as_fn(x, y_lengths, T=1):
+    rep = jnp.repeat(x[:, None], T, axis=1)
+    m = _mask(y_lengths, T).reshape((x.shape[0], T) + (1,) * (x.ndim - 1))
+    return jnp.where(m, rep, 0)
+
+
+_sequence_expand_as = Primitive("sequence_expand_as",
+                                _sequence_expand_as_fn)
+
+
+def sequence_expand_as(x, y, y_lengths, name=None):
+    """sequence_expand_as_op.cc: expand each row of x to match y's row
+    lengths — dense form broadcasts x over y's time axis, masked by
+    y_lengths."""
+    yl = unwrap(y_lengths).astype(jnp.int32)
+    return _sequence_expand_as(x, yl, T=int(unwrap(y).shape[1]))
+
+
+def _sequence_enumerate_fn(x, lengths, win_size=2, pad_value=0):
+    """sequence_enumerate_op.cc: sliding windows of ids per row,
+    padded with pad_value past each row's length. x [B, T] int ->
+    [B, T, win_size]."""
+    B, T = x.shape
+    idx = jnp.arange(T)[None, :, None] + jnp.arange(win_size)[None, None, :]
+    idx = jnp.broadcast_to(idx, (B, T, win_size))
+    valid_src = idx < lengths[:, None, None]
+    g = jnp.take_along_axis(
+        x, jnp.clip(idx, 0, T - 1).reshape(B, -1), axis=1).reshape(
+        B, T, win_size)
+    out = jnp.where(valid_src, g, jnp.asarray(pad_value, x.dtype))
+    # positions beyond the row's length are all pad
+    row_valid = (jnp.arange(T)[None, :, None] < lengths[:, None, None])
+    return jnp.where(row_valid, out, jnp.asarray(pad_value, x.dtype))
+
+
+_sequence_enumerate = Primitive("sequence_enumerate",
+                                _sequence_enumerate_fn,
+                                differentiable=False)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, lengths=None,
+                       name=None):
+    x = unwrap(input)
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    else:
+        lengths = unwrap(lengths).astype(jnp.int32)
+    return _sequence_enumerate(x, lengths, win_size=int(win_size),
+                               pad_value=int(pad_value))
+
+
+def _sequence_reshape_fn(x, lengths, new_dim=1):
+    """sequence_reshape_op.cc: refold each row's (len*dim) payload to
+    new_dim-wide rows; dense form reshapes the whole [B, T, D] block and
+    rescales lengths."""
+    B, T, D = x.shape
+    assert (T * D) % new_dim == 0
+    out = x.reshape(B, (T * D) // new_dim, new_dim)
+    new_len = (lengths * D) // new_dim
+    return out, new_len
+
+
+_sequence_reshape = Primitive("sequence_reshape", _sequence_reshape_fn,
+                              multi_output=True)
+
+
+def sequence_reshape(input, new_dim, lengths=None, name=None):
+    B, T = unwrap(input).shape[:2]
+    if lengths is None:
+        lengths = jnp.full((B,), T, jnp.int32)
+    else:
+        lengths = unwrap(lengths).astype(jnp.int32)
+    return _sequence_reshape(input, lengths, new_dim=int(new_dim))
+
+
+def _sequence_conv_fn(x, w, lengths, context_length=3, context_start=-1):
+    """sequence_conv_op.cc: per-row temporal context window matmul — the
+    im2col over time (context_start offset) followed by one MXU matmul,
+    with out-of-row taps zeroed."""
+    B, T, D = x.shape
+    taps = []
+    for k in range(context_length):
+        off = context_start + k
+        idx = jnp.arange(T) + off
+        valid = (idx >= 0) & (idx < lengths[:, None])
+        g = jnp.take(x, jnp.clip(idx, 0, T - 1), axis=1)
+        taps.append(jnp.where(valid[..., None], g, 0))
+    col = jnp.concatenate(taps, axis=-1)            # [B, T, ctx*D]
+    out = col @ w                                   # [B, T, out_dim]
+    m = _mask(lengths, T)[..., None]
+    return jnp.where(m, out, 0)
+
+
+_sequence_conv = Primitive("sequence_conv", _sequence_conv_fn)
+
+
+def sequence_conv(input, weight, lengths=None, context_length=3,
+                  context_start=None, padding=True, name=None):
+    """Temporal context conv over ragged rows. weight
+    [context_length*D, out_dim]."""
+    if not padding:
+        raise NotImplementedError(
+            "sequence_conv(padding=False) (trainable PaddingData) is not "
+            "supported; out-of-row taps are zero-padded")
+    x = unwrap(input)
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    else:
+        lengths = unwrap(lengths).astype(jnp.int32)
+    if context_start is None:
+        context_start = -((context_length - 1) // 2)
+    return _sequence_conv(input, weight, lengths,
+                          context_length=int(context_length),
+                          context_start=int(context_start))
+
+
 __all__ = ["sequence_pool", "sequence_softmax", "sequence_mask",
            "sequence_reverse", "sequence_pad", "sequence_unpad",
            "sequence_first_step", "sequence_last_step", "sequence_erase",
-           "sequence_slice", "sequence_expand"]
+           "sequence_slice", "sequence_expand", "sequence_concat",
+           "sequence_expand_as", "sequence_enumerate", "sequence_reshape",
+           "sequence_conv"]

@@ -188,3 +188,43 @@ def test_setitem_on_nonleaf_differentiable():
     b[0] = 5.0
     paddle.sum(b).backward()
     assert np.allclose(a.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+def test_sequence_longtail_ops():
+    """sequence_concat/enumerate/reshape/conv/expand_as (sequence_ops/)."""
+    x1 = paddle.to_tensor(np.array([[[1.], [2.]], [[3.], [0.]]], "float32"))
+    x2 = paddle.to_tensor(np.array([[[9.], [0.]], [[8.], [7.]]], "float32"))
+    out, lens = paddle.sequence_concat(
+        [x1, x2], [paddle.to_tensor(np.array([2, 1])),
+                   paddle.to_tensor(np.array([1, 2]))])
+    assert lens.numpy().tolist() == [3, 3]
+    assert out.numpy()[0, :3, 0].tolist() == [1, 2, 9]
+    assert out.numpy()[1, :3, 0].tolist() == [3, 8, 7]
+
+    e = paddle.sequence_enumerate(
+        paddle.to_tensor(np.array([[1, 2, 3, 0]])), 2, 0,
+        paddle.to_tensor(np.array([3])))
+    assert e.numpy()[0].tolist() == [[1, 2], [2, 3], [3, 0], [0, 0]]
+
+    r, rl = paddle.sequence_reshape(
+        paddle.to_tensor(np.arange(12, dtype="float32").reshape(1, 3, 4)),
+        2, paddle.to_tensor(np.array([2])))
+    assert list(r.shape) == [1, 6, 2] and rl.numpy().tolist() == [4]
+
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 5, 3).astype("float32"),
+                         stop_gradient=False)
+    w = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(9, 4).astype("float32"),
+                         stop_gradient=False)
+    sc = paddle.sequence_conv(x, w, paddle.to_tensor(np.array([5, 3])),
+                              context_length=3)
+    paddle.sum(sc).backward()
+    assert np.isfinite(w.grad.numpy()).all()
+    assert np.allclose(sc.numpy()[1, 3:], 0)     # masked past row length
+
+    ea = paddle.sequence_expand_as(
+        paddle.to_tensor(np.array([[1.], [2.]], "float32")),
+        paddle.to_tensor(np.zeros((2, 3, 1), "float32")),
+        paddle.to_tensor(np.array([3, 2])))
+    assert ea.numpy()[:, :, 0].tolist() == [[1, 1, 1], [2, 2, 0]]

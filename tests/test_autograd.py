@@ -113,3 +113,21 @@ class TestDiamond:
         c = a * b  # = 15 x^2 -> dc/dx = 30x = 60
         c.backward()
         np.testing.assert_allclose(x.grad.numpy(), [60.0], rtol=1e-6)
+
+
+def test_inplace_does_not_reroute_other_consumers():
+    """Record-time edge capture: mutating y in place after z consumed it
+    must not change z's backward (the version-counter problem)."""
+    x = paddle.to_tensor(np.array(1.0, "float32"), stop_gradient=False)
+    y = x * 2
+    z = y * 3
+    y.multiply_(paddle.to_tensor(np.array(5.0, "float32")))
+    z.backward()
+    assert abs(float(x.grad.numpy()) - 6.0) < 1e-6
+
+
+def test_inplace_on_grad_leaf_accumulates():
+    x = paddle.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+    x.add_(paddle.ones([2]))
+    paddle.sum(x).backward()
+    assert x.grad is not None and np.allclose(x.grad.numpy(), 1.0)
