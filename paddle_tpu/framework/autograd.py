@@ -39,7 +39,8 @@ class GradNode:
         # (the version-counter problem; basic_engine resolves edges eagerly
         # too)
         self.input_edges = tuple(
-            (t._node, t._out_index) if isinstance(t, Tensor) else (None, None)
+            (t._node, t._out_index, t._version) if isinstance(t, Tensor)
+            else (None, None, 0)
             for t in inputs)
         self.out_avals = out_avals    # list[(shape, dtype)] per output
         self.out_ct = None
@@ -149,7 +150,7 @@ def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None,
     while stack:
         n = stack.pop()
         order.append(n)
-        for (p, _) in n.input_edges:
+        for (p, _, _) in n.input_edges:
             if p is None:
                 continue
             deps[id(p)] = deps.get(id(p), 0) + 1
@@ -165,19 +166,29 @@ def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None,
         processed.append(n)
         cts = n.materialize_cts()
         in_cts = n.grad_fn(cts, *n.primals)
-        for t, (p, out_idx), ct in zip(n.inputs, n.input_edges, in_cts):
+        for t, (p, out_idx, ver), ct in zip(n.inputs, n.input_edges,
+                                            in_cts):
             if not isinstance(t, Tensor):
                 continue
-            if ct.dtype == _float0:
-                continue
+            zero_ct = ct.dtype == _float0
             if p is not None:
-                p.seed(out_idx, ct)
-                if t._retain_grads and not t.stop_gradient:
-                    _accumulate_into_tensor(t, ct)
+                # deps bookkeeping runs even for float0 cotangents (int
+                # outputs): skipping it would starve the parent node and
+                # silently drop its OTHER edges' real gradients
+                if not zero_ct:
+                    p.seed(out_idx, ct)
+                    if t._retain_grads and not t.stop_gradient:
+                        _accumulate_into_tensor(t, ct)
                 deps[id(p)] -= 1
                 if deps[id(p)] == 0:
                     queue.append(p)
-            elif not t.stop_gradient:
+            elif not zero_ct and not t.stop_gradient:
+                if t._version != ver:
+                    raise RuntimeError(
+                        f"leaf Tensor {t.name} was modified by an in-place "
+                        f"operation after being consumed by {n.name}; "
+                        f"gradients would apply to a stale version "
+                        f"(version {ver} vs {t._version})")
                 _accumulate_into_tensor(t, ct)
         if not retain_graph:
             n.release()
@@ -286,7 +297,7 @@ def _backward_recorded(root: Tensor, seed: Tensor, wanted, table,
     node.visited_tag = tag
     while stack:
         n = stack.pop()
-        for (p, _) in n.input_edges:
+        for (p, _, _) in n.input_edges:
             if p is None:
                 continue
             deps[id(p)] = deps.get(id(p), 0) + 1
@@ -304,19 +315,20 @@ def _backward_recorded(root: Tensor, seed: Tensor, wanted, table,
         n.out_ct = out_cts.get(id(n))        # borrowed by _recorded_grad_apply
         in_cts = _recorded_grad_apply(n)
         n.out_ct = None
-        for t, (p, out_idx), ct in zip(n.inputs, n.input_edges, in_cts):
+        for t, (p, out_idx, _), ct in zip(n.inputs, n.input_edges,
+                                          in_cts):
             if not isinstance(t, Tensor):
                 continue
-            if ct._value.dtype == _float0:
-                continue
-            if id(t) in wanted:
+            zero_ct = ct._value.dtype == _float0
+            if not zero_ct and id(t) in wanted:
                 cur = table.get(id(t))
                 table[id(t)] = ct if cur is None else cur + ct
             if p is not None:
-                slot = out_cts.get(id(p))
-                if slot is None:
-                    slot = out_cts[id(p)] = [None] * len(p.out_avals)
-                _seed_recorded(slot, out_idx, p.out_avals[out_idx], ct)
+                if not zero_ct:
+                    slot = out_cts.get(id(p))
+                    if slot is None:
+                        slot = out_cts[id(p)] = [None] * len(p.out_avals)
+                    _seed_recorded(slot, out_idx, p.out_avals[out_idx], ct)
                 deps[id(p)] -= 1
                 if deps[id(p)] == 0:
                     queue.append(p)

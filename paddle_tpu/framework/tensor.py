@@ -30,7 +30,7 @@ def _auto_name(prefix="tmp"):
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "persistable", "name", "grad",
                  "_node", "_out_index", "_retain_grads", "_hooks", "is_leaf",
-                 "_bwd_done", "__weakref__")
+                 "_bwd_done", "_version", "__weakref__")
 
     def __init__(self, value, stop_gradient=True, name=None, persistable=False):
         if isinstance(value, Tensor):
@@ -45,6 +45,7 @@ class Tensor:
         self._node = None
         self._out_index = 0
         self._retain_grads = False
+        self._version = 0      # bumped by in-place mutation (version check)
         self._hooks = []
         self.is_leaf = True
         self._bwd_done = False

@@ -123,11 +123,20 @@ def _old_version(s):
 
 
 def _adopt(s, out):
-    """Point s at the freshly computed version (in-place surface)."""
+    """Point s at the freshly computed version (in-place surface). The
+    version bump makes a later backward through PRE-mutation consumers of
+    a leaf raise instead of applying stale gradients (inplace version
+    check parity). The mutating op ITSELF legitimately consumed the old
+    value, so its own edge is re-stamped to the new version."""
     s._value = out._value
     s._node = out._node
     s._out_index = out._out_index
+    s._version += 1
     if out._node is not None:
+        node = out._node
+        node.input_edges = tuple(
+            (p, oi, s._version) if t is s else (p, oi, v)
+            for t, (p, oi, v) in zip(node.inputs, node.input_edges))
         s.stop_gradient = False
         s.is_leaf = False
     return s

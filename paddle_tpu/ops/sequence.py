@@ -301,7 +301,6 @@ def _sequence_reshape_fn(x, lengths, new_dim=1):
     new_dim-wide rows; dense form reshapes the whole [B, T, D] block and
     rescales lengths."""
     B, T, D = x.shape
-    assert (T * D) % new_dim == 0
     out = x.reshape(B, (T * D) // new_dim, new_dim)
     new_len = (lengths * D) // new_dim
     return out, new_len
@@ -312,12 +311,27 @@ _sequence_reshape = Primitive("sequence_reshape", _sequence_reshape_fn,
 
 
 def sequence_reshape(input, new_dim, lengths=None, name=None):
-    B, T = unwrap(input).shape[:2]
+    import numpy as np
+    from ..framework.enforce import InvalidArgumentError
+    B, T, D = unwrap(input).shape
+    new_dim = int(new_dim)
+    if (T * D) % new_dim != 0:
+        raise InvalidArgumentError(
+            f"T*D={T * D} not divisible by new_dim={new_dim}",
+            op="sequence_reshape")
     if lengths is None:
         lengths = jnp.full((B,), T, jnp.int32)
     else:
         lengths = unwrap(lengths).astype(jnp.int32)
-    return _sequence_reshape(input, lengths, new_dim=int(new_dim))
+        lv = np.asarray(lengths)
+        # per-ROW payloads must refold exactly (the reference enforces
+        # this); only checkable when lengths are concrete (eager)
+        if lv.size and not isinstance(lengths, jax.core.Tracer) and \
+                np.any((lv * D) % new_dim != 0):
+            raise InvalidArgumentError(
+                f"row payloads (lengths*{D}) not divisible by "
+                f"new_dim={new_dim}", op="sequence_reshape")
+    return _sequence_reshape(input, lengths, new_dim=new_dim)
 
 
 def _sequence_conv_fn(x, w, lengths, context_length=3, context_start=-1):
@@ -355,7 +369,8 @@ def sequence_conv(input, weight, lengths=None, context_length=3,
     else:
         lengths = unwrap(lengths).astype(jnp.int32)
     if context_start is None:
-        context_start = -((context_length - 1) // 2)
+        # reference default: padding_start = -int(context_length / 2)
+        context_start = -int(context_length // 2)
     return _sequence_conv(input, weight, lengths,
                           context_length=int(context_length),
                           context_start=int(context_start))

@@ -228,3 +228,36 @@ def test_sequence_longtail_ops():
         paddle.to_tensor(np.zeros((2, 3, 1), "float32")),
         paddle.to_tensor(np.array([3, 2])))
     assert ea.numpy()[:, :, 0].tolist() == [[1, 1, 1], [2, 2, 0]]
+
+
+def test_crypto_roundtrip(tmp_path):
+    """WITH_CRYPTO parity (framework/io/crypto): encrypted checkpoint
+    roundtrips; wrong key / tampering fails loudly."""
+    from paddle_tpu.framework.crypto import CipherUtils, AESCipher
+    import paddle_tpu.nn as nn
+    key = CipherUtils.gen_key_to_file(256, str(tmp_path / "k"))
+    assert CipherUtils.read_key_from_file(str(tmp_path / "k")) == key
+
+    paddle.seed(8)
+    net = nn.Linear(4, 2)
+    plain = str(tmp_path / "m.pdparams")
+    paddle.save(net.state_dict(), plain)
+    cipher = AESCipher(key)
+    enc = str(tmp_path / "m.enc")
+    cipher.encrypt_file(plain, enc)
+    # decrypt and load
+    dec = str(tmp_path / "m.dec")
+    cipher.decrypt_file(enc, dec)
+    state = paddle.load(dec)
+    net2 = nn.Linear(4, 2)
+    net2.set_state_dict(state)
+    assert np.allclose(net2.weight.numpy(), net.weight.numpy())
+
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        AESCipher(CipherUtils.gen_key(256)).decrypt_from_file(enc)
+    blob = bytearray(open(enc, "rb").read())
+    blob[-1] ^= 0xFF
+    open(str(tmp_path / "tampered"), "wb").write(bytes(blob))
+    with _pytest.raises(Exception):
+        cipher.decrypt_from_file(str(tmp_path / "tampered"))

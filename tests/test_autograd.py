@@ -131,3 +131,25 @@ def test_inplace_on_grad_leaf_accumulates():
     x.add_(paddle.ones([2]))
     paddle.sum(x).backward()
     assert x.grad is not None and np.allclose(x.grad.numpy(), 1.0)
+
+
+def test_float0_cotangent_does_not_starve_deps():
+    """An int-dtype branch (float0 cotangent) must still release the
+    producer node's dependency so the real branch's gradient flows."""
+    x = paddle.to_tensor(np.array(1.0, "float32"), stop_gradient=False)
+    z = x * 2
+    i = z.astype("int32")
+    (z.sum() + i.astype("float32").sum()).backward()
+    assert x.grad is not None
+    assert abs(float(x.grad.numpy()) - 2.0) < 1e-6
+
+
+def test_consume_then_mutate_leaf_raises():
+    """Version check: in-place mutation AFTER a consumer recorded the leaf
+    must fail backward instead of applying stale gradients."""
+    import pytest as _pytest
+    x = paddle.to_tensor(np.array(2.0, "float32"), stop_gradient=False)
+    y = x * x
+    x.multiply_(paddle.to_tensor(np.array(3.0, "float32")))
+    with _pytest.raises(RuntimeError, match="in-place"):
+        (y.sum() + x.sum()).backward()
