@@ -315,12 +315,17 @@ def _backward_recorded(root: Tensor, seed: Tensor, wanted, table,
         n.out_ct = out_cts.get(id(n))        # borrowed by _recorded_grad_apply
         in_cts = _recorded_grad_apply(n)
         n.out_ct = None
-        for t, (p, out_idx, _), ct in zip(n.inputs, n.input_edges,
-                                          in_cts):
+        for t, (p, out_idx, ver), ct in zip(n.inputs, n.input_edges,
+                                            in_cts):
             if not isinstance(t, Tensor):
                 continue
             zero_ct = ct._value.dtype == _float0
             if not zero_ct and id(t) in wanted:
+                if p is None and t._version != ver:
+                    raise RuntimeError(
+                        f"leaf Tensor {t.name} was modified by an in-place "
+                        f"operation after being consumed by {n.name} "
+                        f"(version {ver} vs {t._version})")
                 cur = table.get(id(t))
                 table[id(t)] = ct if cur is None else cur + ct
             if p is not None:

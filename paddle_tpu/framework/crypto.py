@@ -28,9 +28,12 @@ class CipherUtils:
     @staticmethod
     def gen_key_to_file(length_bits: int, path: str) -> bytes:
         key = CipherUtils.gen_key(length_bits)
-        with open(path, "wb") as f:
-            f.write(key)
-        os.chmod(path, 0o600)
+        # created 0600 atomically: no world-readable window before chmod
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        try:
+            os.write(fd, key)
+        finally:
+            os.close(fd)
         return key
 
     @staticmethod

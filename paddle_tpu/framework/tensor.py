@@ -180,7 +180,8 @@ class Tensor:
             raise ValueError(
                 f"set_value shape mismatch {value.shape} vs {self._value.shape}")
         self._value = value
-        return self
+        self._version += 1    # off-tape mutation: backward through a
+        return self           # pre-mutation consumer must raise
 
     def get_tensor(self):
         return self

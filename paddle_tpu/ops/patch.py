@@ -133,10 +133,23 @@ def _adopt(s, out):
     s._out_index = out._out_index
     s._version += 1
     if out._node is not None:
-        node = out._node
-        node.input_edges = tuple(
-            (p, oi, s._version) if t is s else (p, oi, v)
-            for t, (p, oi, v) in zip(node.inputs, node.input_edges))
+        # the mutation is part of s's own recorded lineage: every upstream
+        # edge referencing s consumed a version whose value was captured
+        # in primals, so re-stamp them all (chained x.add_(); x.add_()
+        # must not false-positive the version check)
+        seen = set()
+        stack = [out._node]
+        while stack:
+            node = stack.pop()
+            if id(node) in seen or node.inputs is None:
+                continue
+            seen.add(id(node))
+            node.input_edges = tuple(
+                (p, oi, s._version) if t is s else (p, oi, v)
+                for t, (p, oi, v) in zip(node.inputs, node.input_edges))
+            for (p, _, _) in node.input_edges:
+                if p is not None:
+                    stack.append(p)
         s.stop_gradient = False
         s.is_leaf = False
     return s

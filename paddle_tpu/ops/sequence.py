@@ -323,14 +323,14 @@ def sequence_reshape(input, new_dim, lengths=None, name=None):
         lengths = jnp.full((B,), T, jnp.int32)
     else:
         lengths = unwrap(lengths).astype(jnp.int32)
-        lv = np.asarray(lengths)
         # per-ROW payloads must refold exactly (the reference enforces
         # this); only checkable when lengths are concrete (eager)
-        if lv.size and not isinstance(lengths, jax.core.Tracer) and \
-                np.any((lv * D) % new_dim != 0):
-            raise InvalidArgumentError(
-                f"row payloads (lengths*{D}) not divisible by "
-                f"new_dim={new_dim}", op="sequence_reshape")
+        if not isinstance(lengths, jax.core.Tracer):
+            lv = np.asarray(lengths)
+            if lv.size and np.any((lv * D) % new_dim != 0):
+                raise InvalidArgumentError(
+                    f"row payloads (lengths*{D}) not divisible by "
+                    f"new_dim={new_dim}", op="sequence_reshape")
     return _sequence_reshape(input, lengths, new_dim=new_dim)
 
 

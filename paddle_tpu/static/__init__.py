@@ -44,3 +44,10 @@ def data(name, shape, dtype="float32", lod_level=0):
     return prog.global_block().create_var(
         name=name, shape=shape, dtype=dtype, stop_gradient=True,
         is_data=True)
+from .api_extra import (  # noqa: F401,E402
+    cpu_places, cuda_places, xpu_places, tpu_places, name_scope,
+    create_global_var, create_parameter, Print, py_func,
+    serialize_program, deserialize_program, serialize_persistables,
+    deserialize_persistables, save_to_file, load_from_file, save, load,
+    get_program_state, load_program_state, set_program_state,
+)

@@ -153,3 +153,20 @@ def test_consume_then_mutate_leaf_raises():
     x.multiply_(paddle.to_tensor(np.array(3.0, "float32")))
     with _pytest.raises(RuntimeError, match="in-place"):
         (y.sum() + x.sum()).backward()
+
+
+def test_chained_leaf_inplace_no_false_positive():
+    x = paddle.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+    x.add_(paddle.ones([2]))
+    x.add_(paddle.ones([2]))
+    paddle.sum(x).backward()
+    assert np.allclose(x.grad.numpy(), 1.0)
+
+
+def test_set_value_mutation_caught_by_version_check():
+    import pytest as _pytest
+    x = paddle.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+    y = x * x
+    x.zero_()
+    with _pytest.raises(RuntimeError, match="in-place"):
+        paddle.sum(y).backward()

@@ -414,3 +414,42 @@ def test_infer_from_dataset():
         assert res[out.name].shape == (5, 4, 2)
     finally:
         paddle.disable_static()
+
+
+def test_static_serialize_save_load_state(tmp_path):
+    """static serialize/deserialize + save/load + program-state family."""
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3], "float32")
+            out = static.nn.fc(x, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        p2 = static.deserialize_program(
+            static.serialize_program(program=main))
+        assert len(p2.global_block().ops) == len(main.global_block().ops)
+        state = static.get_program_state(main)
+        static.save(main, str(tmp_path / "m"))
+        static.set_program_state(
+            main, {k: np.zeros_like(v) for k, v in state.items()})
+        static.load(main, str(tmp_path / "m"))
+        state2 = static.get_program_state(main)
+        for k in state:
+            assert np.allclose(state[k], state2[k])
+        assert static.cuda_places() == []       # TPU build
+        with static.name_scope("b1"):
+            pass
+    finally:
+        paddle.disable_static()
+
+
+def test_static_py_func_and_print(capsys):
+    import paddle_tpu.static as static
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    out = static.py_func(lambda a: a * 3, x, x)
+    assert np.allclose(out.numpy(), 3.0)
+    y = static.Print(x, message="dbg: ")
+    assert np.allclose(y.numpy(), 1.0)
+    assert "dbg:" in capsys.readouterr().out
