@@ -101,6 +101,12 @@ class _Server(threading.Thread):
                 self._kv[args[0]] = str(cur).encode()
                 self._cv.notify_all()
             _send_msg(conn, b"ok", str(cur).encode())
+        elif cmd == b"delprefix":
+            with self._cv:
+                dead = [k for k in self._kv if k.startswith(args[0])]
+                for k in dead:
+                    del self._kv[k]
+            _send_msg(conn, b"ok", str(len(dead)).encode())
         elif cmd == b"wait":
             key, timeout = args[0], float(args[1])
             deadline = time.time() + timeout
@@ -180,6 +186,36 @@ class TCPStore:
             (v,) = self._reply()
         return int(v)
 
+    def delete_prefix(self, prefix: str) -> int:
+        """Delete every key starting with ``prefix``; returns the count."""
+        with self._lock:
+            _send_msg(self._sock, b"delprefix", prefix.encode())
+            (n,) = self._reply()
+        return int(n)
+
+    def reset_barrier(self, name: str = ""):
+        """Clear barrier count/release keys across ALL generations (all
+        barriers when ``name`` is empty). An elastic launcher whose store
+        outlives workers calls this between gang restarts so a
+        half-arrived (abandoned) barrier can't skew the counters."""
+        self.delete_prefix(f"__barrier/{name}/" if name else "__barrier/")
+
+    def bump_restart_generation(self) -> int:
+        """Advance the store-resident restart generation that scopes every
+        barrier key. The restarting supervisor calls this ONCE before
+        respawning a gang; all hosts' workers then agree on the new
+        generation regardless of how many times each host restarted
+        locally (the per-host PADDLE_RESTART_GENERATION env is only the
+        fallback when this key has never been bumped)."""
+        return self.add("__restart_generation", 1)
+
+    def _restart_generation(self) -> str:
+        v = self.get("__restart_generation", wait=False)
+        if v is not None:
+            return v.decode()
+        import os
+        return os.environ.get("PADDLE_RESTART_GENERATION", "0")
+
     def wait(self, key: str, timeout: float = None) -> bool:
         t = timeout or self._timeout
         with self._lock:
@@ -197,13 +233,34 @@ class TCPStore:
 
     def barrier(self, name: str, world_size: int, timeout: float = None):
         """All ranks add 1 to the barrier key, then wait for the release
-        key the last arriver sets (Gloo barrier-on-store parity)."""
-        n = self.add(f"__barrier/{name}/count", 1)
-        release = f"__barrier/{name}/release"
-        if n >= world_size:
+        key the last arriver sets (Gloo barrier-on-store parity).
+
+        Reuse safety is two-layered:
+
+        * a *restart generation* prefixes every key — the store-resident
+          value bumped by :meth:`bump_restart_generation` (shared across
+          hosts), falling back to ``PADDLE_RESTART_GENERATION`` (set per
+          host by the elastic launcher) — so a half-arrived barrier
+          abandoned by a crashed gang can never skew the restarted gang's
+          counters;
+        * within a generation the counter is never reset, so a reused
+          barrier name lands in a fresh *arrival window*: arrival ``n``
+          belongs to window ``(n-1)//world_size`` and waits on that
+          window's release key — a stale release from a previous complete
+          use never releases it early.
+
+        A launcher owning a store that outlives workers can also clear
+        state explicitly via :meth:`reset_barrier`.
+        """
+        rg = self._restart_generation()
+        n = self.add(f"__barrier/{name}/g{rg}/count", 1)
+        gen = (n - 1) // world_size
+        arrived = n - gen * world_size
+        release = f"__barrier/{name}/g{rg}/release/{gen}"
+        if arrived >= world_size:
             self.set(release, b"1")
         if not self.wait(release, timeout or self._timeout):
-            raise TimeoutError(f"barrier {name!r} timed out ({n}/"
+            raise TimeoutError(f"barrier {name!r} timed out ({arrived}/"
                                f"{world_size} arrived)")
 
     def close(self):

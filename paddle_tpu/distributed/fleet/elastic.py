@@ -78,12 +78,22 @@ class ElasticLaunch:
     max_restarts=0)."""
 
     def __init__(self, spawn_fn, nprocs, max_restarts=3, poll_s=0.5,
-                 gang=None):
+                 gang=None, on_restart=None):
         self._spawn = spawn_fn     # spawn_fn(local_rank) -> Popen
         self._n = nprocs
         self._max_restarts = max_restarts
         self._poll_s = poll_s
         self._gang = (nprocs > 1) if gang is None else gang
+        # called between gang restarts; a launcher owning a store that
+        # outlives the workers should clear rendezvous state here, e.g.
+        # lambda: store.delete_prefix("__barrier/")
+        self._on_restart = on_restart
+        # restart generation, exported to children (spawn_fn closures read
+        # it via this attribute or the PADDLE_RESTART_GENERATION env the
+        # launcher sets): TCPStore.barrier scopes its keys by it so a
+        # half-arrived barrier abandoned by a crashed gang can't skew the
+        # restarted gang's rendezvous
+        self.generation = 0
 
     def run(self):
         if self._gang:
@@ -117,6 +127,17 @@ class ElasticLaunch:
             if restarts >= self._max_restarts:
                 return rc, {i: restarts for i in range(self._n)}
             restarts += 1
+            self.generation = restarts
+            if self._on_restart is not None:
+                try:
+                    self._on_restart()
+                except Exception as e:
+                    # a failed reset likely means the respawned gang will
+                    # hang at rendezvous — say so instead of hiding it
+                    import sys
+                    print(f"[elastic] on_restart hook failed: {e!r}; "
+                          f"the restarted gang may hang at its barrier",
+                          file=sys.stderr)
 
     def _run_independent(self):
         import signal

@@ -68,6 +68,7 @@ def launch_collective(args):
                                     args.started_port)
     log_fps = []
     base_rank = args.host_rank * args.nproc_per_node
+    supervisor = []   # filled when elastic supervision is active
 
     def spawn(local):
         rank = base_rank + local
@@ -78,6 +79,10 @@ def launch_collective(args):
             "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
             "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
             "FLAGS_selected_tpus": str(local),
+            # gang-restart generation: scopes TCPStore barrier keys so an
+            # abandoned half-arrived barrier can't skew the new gang
+            "PADDLE_RESTART_GENERATION": str(
+                supervisor[0].generation if supervisor else 0),
         })
         cmd = [sys.executable, "-u", args.training_script] + \
             args.training_script_args
@@ -96,9 +101,12 @@ def launch_collective(args):
         if args.elastic_level >= 1:
             # bounded-restart supervision (fleet/elastic parity)
             from .elastic import ElasticLaunch
-            rc, restarts = ElasticLaunch(
-                spawn, args.nproc_per_node,
-                max_restarts=args.max_restarts).run()
+            # collective jobs are always gangs, even at 1 proc per host:
+            # a lone restarted rank cannot rejoin collectives mid-flight
+            el = ElasticLaunch(spawn, args.nproc_per_node,
+                               max_restarts=args.max_restarts, gang=True)
+            supervisor.append(el)
+            rc, restarts = el.run()
             if any(restarts.values()):
                 print(f"[launch] restarts per rank: {restarts}",
                       file=sys.stderr)

@@ -17,6 +17,8 @@ from collections import deque
 from typing import Optional
 
 import jax
+import weakref
+
 import jax.numpy as jnp
 
 from .tensor import Tensor
@@ -27,7 +29,7 @@ _float0 = jax.dtypes.float0
 class GradNode:
     """One recorded op application: knows how to map out-cotangents to in-cotangents."""
     __slots__ = ("name", "grad_fn", "primals", "inputs", "input_edges",
-                 "out_avals", "out_ct", "visited_tag")
+                 "out_avals", "out_ct", "visited_tag", "__weakref__")
 
     def __init__(self, name, grad_fn, primals, inputs, out_avals):
         self.name = name
@@ -42,6 +44,22 @@ class GradNode:
             (t._node, t._out_index, t._version) if isinstance(t, Tensor)
             else (None, None, 0)
             for t in inputs)
+        # consumer back-edges, LEAF edges only: backward's in-place version
+        # check reads the edge version solely on (None, ·) edges, so only
+        # nodes holding a leaf edge to a tensor can ever need a re-stamp
+        # by an in-place op (_adopt).  Dead refs are compacted amortized
+        # (cap doubles on live size) so long runs don't leak weakrefs.
+        ref = weakref.ref(self)
+        for t in inputs:
+            if isinstance(t, Tensor) and t._node is None:
+                lst = t._consumers
+                if lst is None:
+                    lst = t._consumers = []
+                lst.append(ref)
+                if len(lst) >= t._consumers_cap:
+                    live = [r for r in lst if r() is not None]
+                    t._consumers = live
+                    t._consumers_cap = max(2 * len(live), 16)
         self.out_avals = out_avals    # list[(shape, dtype)] per output
         self.out_ct = None
         self.visited_tag = 0
@@ -183,7 +201,10 @@ def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None,
                 if deps[id(p)] == 0:
                     queue.append(p)
             elif not zero_ct and not t.stop_gradient:
-                if t._version != ver:
+                # ver None = edge exempted by _adopt: the op is part of the
+                # tensor's own in-place lineage (its primals captured the
+                # value it consumed, so replay is always valid)
+                if ver is not None and t._version != ver:
                     raise RuntimeError(
                         f"leaf Tensor {t.name} was modified by an in-place "
                         f"operation after being consumed by {n.name}; "
@@ -321,7 +342,7 @@ def _backward_recorded(root: Tensor, seed: Tensor, wanted, table,
                 continue
             zero_ct = ct._value.dtype == _float0
             if not zero_ct and id(t) in wanted:
-                if p is None and t._version != ver:
+                if p is None and ver is not None and t._version != ver:
                     raise RuntimeError(
                         f"leaf Tensor {t.name} was modified by an in-place "
                         f"operation after being consumed by {n.name} "

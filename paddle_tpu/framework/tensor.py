@@ -30,7 +30,8 @@ def _auto_name(prefix="tmp"):
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "persistable", "name", "grad",
                  "_node", "_out_index", "_retain_grads", "_hooks", "is_leaf",
-                 "_bwd_done", "_version", "__weakref__")
+                 "_bwd_done", "_version", "_consumers", "_consumers_cap",
+                 "__weakref__")
 
     def __init__(self, value, stop_gradient=True, name=None, persistable=False):
         if isinstance(value, Tensor):
@@ -46,6 +47,8 @@ class Tensor:
         self._out_index = 0
         self._retain_grads = False
         self._version = 0      # bumped by in-place mutation (version check)
+        self._consumers = None  # weakrefs to GradNodes holding a LEAF edge
+        self._consumers_cap = 16  # amortized dead-ref compaction threshold
         self._hooks = []
         self.is_leaf = True
         self._bwd_done = False

@@ -194,8 +194,15 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn: Optional[Callable] = None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=True, timeout=120, worker_init_fn=None):
+                 use_shared_memory=True, timeout=120, worker_init_fn=None,
+                 worker_start_method=None):
         self.dataset = dataset
+        # explicit override of the fork/spawn probe below; also settable
+        # process-wide via PT_DATALOADER_START_METHOD=fork|spawn|forkserver
+        import os as _os
+        self.worker_start_method = (
+            worker_start_method
+            or _os.environ.get("PT_DATALOADER_START_METHOD") or None)
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
@@ -271,21 +278,33 @@ class DataLoader:
 
         # heuristic probe (first/middle/last sample): a mixed dataset that
         # yields Tensors only at unprobed indices would still fork — such
-        # datasets should pass num_workers=0 or return numpy
-        needs_jax = False
-        if not self._iterable_mode and len(self.dataset) > 0:
-            n = len(self.dataset)
-            for i in {0, n // 2, n - 1}:
-                try:
-                    if _has_tensor(self.dataset[i]):
-                        needs_jax = True
-                        break
-                except Exception:
-                    pass
-        try:
-            ctx = mp.get_context("spawn" if needs_jax else "fork")
-        except ValueError:
-            ctx = mp.get_context("spawn")
+        # datasets should pass num_workers=0, return numpy, or set
+        # worker_start_method='spawn' / PT_DATALOADER_START_METHOD=spawn
+        if self.worker_start_method:
+            # an explicit override must be honored or rejected, never
+            # silently replaced
+            if self.worker_start_method not in mp.get_all_start_methods():
+                raise ValueError(
+                    f"worker_start_method {self.worker_start_method!r} is "
+                    f"not available on this platform; choose from "
+                    f"{mp.get_all_start_methods()}")
+            ctx = mp.get_context(self.worker_start_method)
+        else:
+            needs_jax = False
+            if not self._iterable_mode and len(self.dataset) > 0:
+                n = len(self.dataset)
+                for i in {0, n // 2, n - 1}:
+                    try:
+                        if _has_tensor(self.dataset[i]):
+                            needs_jax = True
+                            break
+                    except Exception:
+                        pass
+            method = "spawn" if needs_jax else "fork"
+            try:
+                ctx = mp.get_context(method)
+            except ValueError:
+                ctx = mp.get_context("spawn")
         index_queue = ctx.Queue()
         data_queue = ctx.Queue()
         ring = None

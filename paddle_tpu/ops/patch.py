@@ -128,28 +128,56 @@ def _adopt(s, out):
     a leaf raise instead of applying stale gradients (inplace version
     check parity). The mutating op ITSELF legitimately consumed the old
     value, so its own edge is re-stamped to the new version."""
+    boundary = s._node   # pre-mutation lineage tip (delta-walk wall below)
     s._value = out._value
     s._node = out._node
     s._out_index = out._out_index
     s._version += 1
     if out._node is not None:
-        # the mutation is part of s's own recorded lineage: every upstream
-        # edge referencing s consumed a version whose value was captured
-        # in primals, so re-stamp them all (chained x.add_(); x.add_()
-        # must not false-positive the version check)
-        seen = set()
-        stack = [out._node]
-        while stack:
-            node = stack.pop()
-            if id(node) in seen or node.inputs is None:
-                continue
-            seen.add(id(node))
-            node.input_edges = tuple(
-                (p, oi, s._version) if t is s else (p, oi, v)
-                for t, (p, oi, v) in zip(node.inputs, node.input_edges))
-            for (p, _, _) in node.input_edges:
-                if p is not None:
-                    stack.append(p)
+        # Backward's version check reads edge versions only on LEAF
+        # (None, ·) edges, so the only edges ever needing a re-stamp are
+        # leaf edges to s held by nodes inside the mutation's own lineage
+        # — i.e. former mutating ops of s (their primals captured the
+        # consumed value, so replay is always valid; chained x.add_();
+        # x.add_() must not false-positive).  Those edges are stamped with
+        # a permanent None exemption, ONCE, so they never re-qualify.
+        # Unrelated pre-mutation consumers keep the stale version and the
+        # leaf check still fires for them.
+        targets = set()
+        if s._consumers:
+            live = []
+            for ref in s._consumers:
+                c = ref()
+                if c is not None and c.inputs is not None:
+                    live.append(ref)
+                    if any(t is s and p is None and v is not None
+                           for t, (p, oi, v) in
+                           zip(c.inputs, c.input_edges)):
+                        targets.add(id(c))
+            s._consumers = live or None
+        if targets:
+            # delta walk: ancestors of the previous tip were searched (for
+            # these same still-unresolved targets) by earlier adoptions,
+            # so stop at the boundary node — each region of the graph is
+            # visited at most once across a chain of in-place ops
+            seen = set()
+            stack = [out._node]
+            while stack and targets:
+                node = stack.pop()
+                if id(node) in seen or node is boundary or \
+                        node.inputs is None:
+                    continue
+                seen.add(id(node))
+                if id(node) in targets:
+                    targets.discard(id(node))
+                    node.input_edges = tuple(
+                        (p, oi, None) if (t is s and p is None)
+                        else (p, oi, v)
+                        for t, (p, oi, v) in
+                        zip(node.inputs, node.input_edges))
+                for (p, _, _) in node.input_edges:
+                    if p is not None:
+                        stack.append(p)
         s.stop_gradient = False
         s.is_leaf = False
     return s
