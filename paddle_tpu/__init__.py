@@ -44,7 +44,8 @@ import importlib as _importlib
 _SUBPACKAGES = ["nn", "optimizer", "static", "io", "metric", "amp", "jit",
                 "distributed", "vision", "text", "autograd", "hapi",
                 "incubate", "inference", "profiler", "device",
-                "quantization", "utils", "distribution", "onnx"]
+                "quantization", "utils", "distribution", "onnx",
+                "tensor", "regularizer", "compat", "sysconfig", "version"]
 for _name in _SUBPACKAGES:
     try:
         globals()[_name] = _importlib.import_module(f".{_name}", __name__)
@@ -166,3 +167,45 @@ try:
     from .hapi import callbacks  # noqa: F401
 except ImportError:  # pragma: no cover — partial builds degrade softly
     callbacks = None
+
+
+# -- fluid-era aliases (python/paddle/__init__.py DEFINE_ALIAS block) ---------
+
+VarBase = Tensor                    # paddle.framework.VarBase as Tensor
+from .batch import batch  # noqa: F401,E402
+from .version import full_version, commit  # noqa: F401,E402
+
+
+def enable_dygraph(place=None):
+    """fluid.dygraph.base.enable_dygraph parity (= paddle.disable_static)."""
+    disable_static()
+
+
+def disable_dygraph():
+    """fluid.dygraph.base.disable_dygraph parity (= paddle.enable_static)."""
+    enable_static()
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """fluid.layers.crop_tensor parity (crop_tensor_op.cc; exported
+    top-level as paddle.crop in the reference). None shape keeps x's
+    shape; None offsets means all-zero offsets."""
+    from .ops.manipulation import crop as _crop
+    if shape is None:
+        shape = list(x.shape)
+    if offsets is None:
+        offsets = [0] * len(list(shape))
+    return _crop(x, shape, offsets)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """fluid.data parity: declare a static-graph input Variable."""
+    from . import static as _static
+    return _static.data(name, shape, dtype or "float32", lod_level)
+
+
+from .tensor import (  # noqa: F401,E402
+    elementwise_add, elementwise_sub, elementwise_mul, elementwise_div,
+    elementwise_floordiv, elementwise_mod, elementwise_pow, elementwise_max,
+    elementwise_min, has_inf, has_nan, fill_constant,
+)

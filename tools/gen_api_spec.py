@@ -1,0 +1,77 @@
+"""Generate API.spec: a frozen signature inventory of the public surface.
+
+Reference parity: paddle/fluid/API.spec + tools/check_api_compatible.py —
+the reference pins every public API's signature so accidental breaks fail CI.
+Run ``python tools/gen_api_spec.py > API.spec`` to (re)freeze deliberately;
+tests/test_api_spec.py diffs the live surface against the committed file.
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+NAMESPACES = [
+    ("paddle_tpu", None),
+    ("paddle_tpu.nn", None),
+    ("paddle_tpu.nn.functional", None),
+    ("paddle_tpu.nn.initializer", None),
+    ("paddle_tpu.tensor", None),
+    ("paddle_tpu.optimizer", None),
+    ("paddle_tpu.optimizer.lr", None),
+    ("paddle_tpu.static", None),
+    ("paddle_tpu.static.nn", None),
+    ("paddle_tpu.io", None),
+    ("paddle_tpu.metric", None),
+    ("paddle_tpu.amp", None),
+    ("paddle_tpu.jit", None),
+    ("paddle_tpu.distributed", None),
+    ("paddle_tpu.distributed.fleet", None),
+    ("paddle_tpu.vision.models", None),
+    ("paddle_tpu.text.models", None),
+    ("paddle_tpu.inference", None),
+    ("paddle_tpu.regularizer", None),
+    ("paddle_tpu.incubate", None),
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(*)"
+
+
+def iter_spec():
+    import importlib
+    for modname, _ in NAMESPACES:
+        try:
+            mod = importlib.import_module(modname)
+        except ImportError:
+            continue
+        for name in sorted(dir(mod)):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            if inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                if getattr(obj, "__module__", "").startswith(
+                        ("paddle_tpu",)):
+                    yield f"{modname}.{name} class{_sig(obj)}"
+            elif callable(obj):
+                mod_of = getattr(obj, "__module__", "") or ""
+                if mod_of.startswith("paddle_tpu") or mod_of == modname:
+                    yield f"{modname}.{name} {_sig(obj)}"
+
+
+def main():
+    for line in iter_spec():
+        sys.stdout.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
