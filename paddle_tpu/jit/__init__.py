@@ -181,6 +181,8 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         return StaticFunction(fn, input_spec)
     if function is not None:
         if hasattr(function, "forward"):  # a Layer: wrap its forward
+            if isinstance(function.forward, StaticFunction):
+                return function          # already converted: idempotent
             function.forward = StaticFunction(function.forward.__func__,
                                               input_spec, layer=function)
             return function

@@ -2,9 +2,10 @@
 
 Reference parity: python/paddle/fluid/dygraph/dygraph_to_static/ —
 ast_transformer.py (DygraphToStaticAst, the 15-transformer pipeline),
-ifelse_transformer.py, loop_transformer.py, logical_transformer.py, and
-convert_operators.py (convert_ifelse / convert_while_loop /
-convert_logical_and...).
+ifelse_transformer.py, loop_transformer.py (for→while lowering),
+break_continue_transformer.py (escape flags), return_transformer.py
+(early-return flags), logical_transformer.py, and convert_operators.py
+(convert_ifelse / convert_while_loop / convert_logical_and...).
 
 TPU-shape: the reference rewrites Python control flow into
 cond_op/while_op graph ops; here the same AST rewrite targets the
@@ -49,6 +50,48 @@ def _is_tensorish(v):
 
 # -- runtime converters (convert_operators.py parity) ---------------------------
 
+def _reconcile_branch_outputs(branches, init, set_args):
+    """Both arms of a traced cond must produce the same pytree. Names first
+    bound inside one arm start as None (create_undefined_var); where one arm
+    yields None and the other an array, substitute zeros so the conditional
+    carries a well-typed value — the reference's RETURN_NO_VALUE scheme. The
+    value is only observed when the matching flag says the arm ran.
+    Returns wrapped branch fns, or the originals when reconciliation is
+    unnecessary/impossible."""
+    if not _builtin_any(unwrap(v) is None for v in init):
+        # reconciliation is only ever needed for branch-first-bound names,
+        # which always start as None — skip the double trace otherwise
+        return branches
+    try:
+        avals = []
+        for run in branches:
+            avals.append(jax.eval_shape(run))
+            set_args(init)          # clear eval_shape tracers from the frame
+    except Exception:
+        return branches
+    a, b = avals
+    if len(a) != len(b):
+        return branches
+    need = [(x is None) != (y is None) for x, y in zip(a, b)]
+    if not _builtin_any(need):
+        return branches
+    merged = [x if x is not None else y for x, y in zip(a, b)]
+
+    def wrap(run):
+        def go():
+            out = run()
+            return tuple(
+                jnp.zeros(m.shape, m.dtype) if v is None and n else v
+                for v, m, n in zip(out, merged, need))
+        return go
+
+    return [wrap(r) for r in branches]
+
+
+_builtin_any = any
+_builtin_all = all
+
+
 def convert_ifelse(pred, true_fn, false_fn, get_args, set_args):
     """convert_operators.py convert_ifelse: run both branches under
     lax.cond when pred is a traced Tensor; plain Python branch otherwise."""
@@ -67,7 +110,9 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args):
                 return tuple(unwrap(v) for v in get_args())
             return run
 
-        out = _cf.cond(pred, _branch(true_fn), _branch(false_fn))
+        tb, fb = _reconcile_branch_outputs(
+            [_branch(true_fn), _branch(false_fn)], init, set_args)
+        out = _cf.cond(pred, tb, fb)
         out = out if isinstance(out, (tuple, list)) else (out,)
         set_args(tuple(out))
         return
@@ -98,10 +143,68 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args):
             body_fn()
             return tuple(jnp.asarray(unwrap(v)) for v in get_args())
 
+        if _builtin_any(v is None for v in init):
+            # a carry first bound inside the body (lowered for-loop target,
+            # __pt_rv of an in-loop return, escape flags) starts as None;
+            # discover the body's output aval by probing and seed typed
+            # zeros — sound because the body writes such a carry before any
+            # read. The probe is a small fixpoint: placeholder dtypes are
+            # cycled and refined from the observed body output, since a
+            # wrong placeholder dtype makes the body's own cond branches
+            # disagree before we can see the real aval.
+            fill = {i: None for i, v in enumerate(init) if v is None}
+
+            def mk_probe():
+                return tuple(
+                    (jnp.zeros(fill[i].shape, fill[i].dtype)
+                     if fill.get(i) is not None
+                     else jnp.zeros((), dt)) if i in fill else jnp.asarray(v)
+                    for i, v in enumerate(init))
+
+            avals = None
+            last_err = None
+            for dt in (jnp.float32, jnp.int32, jnp.bool_):
+                for _refine in range(3):
+                    try:
+                        avals = jax.eval_shape(b, mk_probe())
+                    except Exception as e:
+                        last_err = e
+                        avals = None
+                        break
+                    stable = _builtin_all(
+                        fill[i] is not None
+                        and (fill[i].shape, fill[i].dtype)
+                        == (avals[i].shape, avals[i].dtype)
+                        for i in fill) if fill else True
+                    for i in fill:
+                        fill[i] = avals[i]
+                    if stable:
+                        break
+                if avals is not None:
+                    break
+                fill = {i: None for i in fill}
+            if avals is None:
+                raise Dy2StaticError(
+                    "could not type a loop variable that is first assigned "
+                    "inside a Tensor-dependent loop; initialize it before "
+                    f"the loop ({last_err})") from last_err
+            set_args(init)      # clear probe tracers from the frame
+            init = tuple(jnp.zeros(a.shape, a.dtype) if v is None else v
+                         for v, a in zip(init, avals))
         out = jax.lax.while_loop(c, b, init)
         set_args(tuple(out))
         return
-    while bool(unwrap(cond_fn())):
+    while True:
+        try:
+            go = bool(unwrap(cond_fn()))
+        except jax.errors.TracerBoolConversionError as e:
+            raise Dy2StaticError(
+                "the loop condition became tensor-dependent only after the "
+                "loop started (e.g. a Tensor `break` inside a Python-bound "
+                "loop); make the loop bound a Tensor (paddle.arange / "
+                "paddle.to_tensor) so the whole loop is traced") from e
+        if not go:
+            break
         body_fn()
 
 
@@ -128,12 +231,87 @@ def convert_logical_not(x):
     return not x
 
 
+# -- iteration helpers (loop_transformer.py parity) -----------------------------
+
+class _RangeProxy:
+    """range() whose bounds may be traced Tensors: indexable arithmetic
+    stand-in so a for-over-range with a Tensor bound lowers to
+    lax.while_loop instead of crashing in range().__init__."""
+
+    def __init__(self, start, stop=None, step=None):
+        if stop is None:
+            start, stop = 0, start
+        if step is None:
+            step = 1
+        self.start, self.stop, self.step = start, stop, step
+
+    def length(self):
+        s0, s1, st = (unwrap(self.start), unwrap(self.stop),
+                      unwrap(self.step))
+        n = (s1 - s0 + st - jnp.sign(st)) // st
+        return jnp.maximum(n, 0)
+
+    def getitem(self, i):
+        return self.start + unwrap(i) * self.step
+
+
+def convert_range(*args):
+    vals = [unwrap(a) for a in args]
+    if _builtin_any(isinstance(v, jax.core.Tracer) for v in vals):
+        return _RangeProxy(*vals)
+    return range(*(int(v) for v in vals))
+
+
+def convert_indexable(x):
+    """Normalize a for-loop iterable into something len()- and []-able."""
+    if isinstance(x, (_RangeProxy, range, list, tuple)):
+        return x
+    if _is_tensorish(x):
+        return x
+    return list(x)
+
+
+def convert_len(x):
+    if isinstance(x, _RangeProxy):
+        return x.length()
+    if _is_tensorish(x):
+        u = unwrap(x)
+        if u.ndim == 0:
+            raise Dy2StaticError("cannot iterate over a 0-d Tensor")
+        return u.shape[0]
+    return len(x)
+
+
+def convert_getitem(x, i):
+    if isinstance(x, _RangeProxy):
+        return x.getitem(i)
+    iv = unwrap(i)
+    if isinstance(x, range):
+        if isinstance(iv, jax.core.Tracer):
+            return x.start + iv * x.step
+        return x[int(iv)]
+    if _is_tensorish(x):
+        return x[i]
+    if isinstance(iv, jax.core.Tracer):
+        try:
+            return jnp.asarray(x)[iv]
+        except Exception as e:
+            raise Dy2StaticError(
+                "a Python list/tuple cannot be indexed by a traced loop "
+                "counter; convert it to a Tensor first") from e
+    return x[int(iv)]
+
+
 _JST = {
     "_jst_ifelse": convert_ifelse,
     "_jst_while": convert_while_loop,
     "_jst_and": convert_logical_and,
     "_jst_or": convert_logical_or,
     "_jst_not": convert_logical_not,
+    "_jst_range": convert_range,
+    "_jst_indexable": convert_indexable,
+    "_jst_len": convert_len,
+    "_jst_getitem": convert_getitem,
 }
 
 
@@ -198,6 +376,255 @@ def _has_escape(nodes):
     for n in nodes:
         walk(n, False)
     return found
+
+
+RET_FLAG = "__pt_ret"
+RET_VAL = "__pt_rv"
+
+
+def _assigns_name(nodes, name):
+    """True if any statement in ``nodes`` (excluding nested def/class
+    scopes) binds ``name``."""
+    todo = list(nodes)
+    while todo:
+        n = todo.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store) \
+                and n.id == name:
+            return True
+        todo.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _not_flags_test(flags):
+    src = " and ".join(f"(not {f})" for f in flags)
+    return ast.parse(src, mode="eval").body
+
+
+def _guard_stmts(stmts, flags):
+    """break_continue_transformer.py guard scheme: after any statement that
+    may set one of ``flags``, wrap the remainder of the list in
+    ``if not flag...:`` so setting a flag skips the rest. Recurses into
+    every compound statement with linear bodies (if/with/try) so a flag set
+    inside one also skips that block's own remainder."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.If):
+            s = ast.If(test=s.test, body=_guard_stmts(s.body, flags),
+                       orelse=_guard_stmts(s.orelse, flags))
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            s = type(s)(items=s.items, body=_guard_stmts(s.body, flags))
+        elif isinstance(s, ast.Try):
+            s = ast.Try(
+                body=_guard_stmts(s.body, flags),
+                handlers=[ast.ExceptHandler(
+                    type=h.type, name=h.name,
+                    body=_guard_stmts(h.body, flags)) for h in s.handlers],
+                orelse=_guard_stmts(s.orelse, flags),
+                finalbody=_guard_stmts(s.finalbody, flags))
+        out.append(s)
+        if _builtin_any(_assigns_name([s], f) for f in flags) \
+                and idx + 1 < len(stmts):
+            rest = _guard_stmts(stmts[idx + 1:], flags)
+            out.append(ast.If(test=_not_flags_test(flags), body=rest,
+                              orelse=[]))
+            break
+    return out
+
+
+class _ForToWhile(ast.NodeTransformer):
+    """loop_transformer.py parity: lower ``for`` to an indexed ``while`` so
+    the while machinery (and lax.while_loop for traced bounds) applies. The
+    counter increments BEFORE the body so a later ``continue`` transform
+    cannot skip it."""
+
+    def __init__(self):
+        self._n = 0
+        self.count = 0
+        self._entered = False
+
+    def visit_FunctionDef(self, node):
+        # transform the outermost def only; nested defs keep their own
+        # semantics
+        if self._entered:
+            return node
+        self._entered = True
+        self.generic_visit(node)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node      # for-else keeps Python semantics
+        self._n += 1
+        self.count += 1
+        u = self._n
+        it, i, n = f"__pt_it_{u}", f"__pt_i_{u}", f"__pt_n_{u}"
+        iter_expr = node.iter
+        if (isinstance(iter_expr, ast.Call)
+                and isinstance(iter_expr.func, ast.Name)
+                and iter_expr.func.id == "range"):
+            iter_expr = ast.Call(
+                func=ast.Name(id="_jst_range", ctx=ast.Load()),
+                args=iter_expr.args, keywords=iter_expr.keywords)
+        pre = ast.parse(f"{it} = _jst_indexable(None)\n"
+                        f"{n} = _jst_len({it})\n"
+                        f"{i} = 0").body
+        pre[0].value.args = [iter_expr]
+        tgt = ast.Assign(
+            targets=[node.target],
+            value=ast.parse(f"_jst_getitem({it}, {i})", mode="eval").body)
+        inc = ast.parse(f"{i} = {i} + 1").body[0]
+        test = ast.parse(f"{i} < {n}", mode="eval").body
+        return pre + [ast.While(test=test, body=[tgt, inc] + node.body,
+                                orelse=[])]
+
+
+class _ReturnTransformer(ast.NodeTransformer):
+    """return_transformer.py parity: every ``return X`` becomes
+    ``__pt_rv = X; __pt_ret = True`` (+ ``break`` inside a loop); the
+    function tail returns ``__pt_rv``. Guarding + loop-condition
+    augmentation happen in _guard_stmts/_LoopEscapeTransformer."""
+
+    def __init__(self):
+        self.count = 0
+        self._depth = 0
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _visit_list(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            out.extend(r if isinstance(r, list) else [r])
+        return out
+
+    def _visit_loop(self, node):
+        # break/continue are only legal in the loop BODY — the orelse runs
+        # at the enclosing depth, so a return there must not emit a break
+        self._depth += 1
+        node.body = self._visit_list(node.body)
+        self._depth -= 1
+        node.orelse = self._visit_list(node.orelse)
+        return node
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_Return(self, node):
+        self.count += 1
+        stmts = []
+        if node.value is not None:
+            asg = ast.parse(f"{RET_VAL} = 0").body[0]
+            asg.value = node.value
+            stmts.append(asg)
+        else:
+            stmts.append(ast.parse(f"{RET_VAL} = None").body[0])
+        stmts.append(ast.parse(f"{RET_FLAG} = True").body[0])
+        if self._depth > 0:
+            stmts.append(ast.Break())
+        return stmts
+
+    def run(self, fdef):
+        """Transform unless the only return is a single tail statement."""
+        rets = []
+        todo = list(fdef.body)
+        while todo:
+            n = todo.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(n, ast.Return):
+                rets.append(n)
+            todo.extend(ast.iter_child_nodes(n))
+        if not rets or (len(rets) == 1 and fdef.body
+                        and fdef.body[-1] is rets[0]):
+            return False
+        fdef.body = [self.visit(s) if not isinstance(s, list) else s
+                     for s in fdef.body]
+        # visit() may return lists; flatten
+        flat = []
+        for s in fdef.body:
+            flat.extend(s if isinstance(s, list) else [s])
+        fdef.body = flat
+        return True
+
+
+class _LoopEscapeTransformer(ast.NodeTransformer):
+    """break_continue_transformer.py parity: rewrite a loop's own
+    break/continue into flag assignments, guard trailing statements, and
+    fold the flags (plus the function-level return flag when the body sets
+    it) into the loop condition."""
+
+    class _Replacer(ast.NodeTransformer):
+        def __init__(self, brk, cont):
+            self.brk, self.cont = brk, cont
+            self.found_brk = self.found_cont = False
+
+        def _stop(self, node):
+            return node
+
+        visit_While = _stop
+        visit_For = _stop
+        visit_FunctionDef = _stop
+        visit_AsyncFunctionDef = _stop
+        visit_ClassDef = _stop
+
+        def visit_Break(self, node):
+            self.found_brk = True
+            return ast.parse(f"{self.brk} = True").body[0]
+
+        def visit_Continue(self, node):
+            self.found_cont = True
+            return ast.parse(f"{self.cont} = True").body[0]
+
+    def __init__(self):
+        self._n = 0
+        self.count = 0
+        self._entered = False
+
+    def visit_FunctionDef(self, node):
+        if self._entered:
+            return node
+        self._entered = True
+        self.generic_visit(node)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_While(self, node):
+        self.generic_visit(node)     # inner loops first
+        self._n += 1
+        u = self._n
+        brk, cont = f"__pt_brk_{u}", f"__pt_cont_{u}"
+        rep = self._Replacer(brk, cont)
+        body = [rep.visit(s) for s in node.body]
+        has_ret = _assigns_name(body, RET_FLAG)
+        if not rep.found_brk and not rep.found_cont and not has_ret:
+            return node
+        self.count += 1
+        cond_flags = ([brk] if rep.found_brk else []) \
+            + ([RET_FLAG] if has_ret else [])
+        guard_flags = cond_flags + ([cont] if rep.found_cont else [])
+        body = _guard_stmts(body, guard_flags)
+        if rep.found_cont:
+            body = [ast.parse(f"{cont} = False").body[0]] + body
+        test = node.test
+        if cond_flags:
+            test = ast.BoolOp(op=ast.And(),
+                              values=[_not_flags_test(cond_flags),
+                                      node.test])
+        pre = []
+        if rep.found_brk:
+            pre.append(ast.parse(f"{brk} = False").body[0])
+        return pre + [ast.While(test=test, body=body, orelse=[])]
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -339,9 +766,21 @@ def ast_transform(func):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
     fdef.decorator_list = []
+    # transformer pipeline (ast_transformer.py order): for→while, returns,
+    # break/continue escapes, then if/while → converter calls
+    ft = _ForToWhile()
+    tree = ft.visit(tree)
+    rt = _ReturnTransformer()
+    did_ret = rt.run(fdef)
+    et = _LoopEscapeTransformer()
+    tree = et.visit(tree)
+    if did_ret:
+        fdef.body = (ast.parse(f"{RET_VAL} = None\n{RET_FLAG} = False").body
+                     + _guard_stmts(fdef.body, [RET_FLAG])
+                     + [ast.parse(f"return {RET_VAL}").body[0]])
     t = _ControlFlowTransformer()
     new_tree = t.visit(tree)
-    if t._n == 0:
+    if t._n == 0 and ft.count == 0 and et.count == 0 and not did_ret:
         return raw               # nothing to rewrite
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, filename=f"<dy2static {raw.__name__}>",

@@ -106,18 +106,20 @@ def test_python_condition_untouched():
     assert float(f(x, False).numpy()[0]) == 0.0
 
 
-def _early_return(x):
+def _early_return_vec(x):
     if paddle.sum(x) > 0:
         return x * 2
     return x
 
 
-def test_early_return_left_as_python_raises_under_trace():
-    # branches with `return` keep Python semantics; a tensor condition
-    # then surfaces jax's tracer-bool error instead of silently freezing
-    f = to_static(_early_return)
-    with pytest.raises(Exception):
-        f(paddle.to_tensor(np.array([1.0], "float32")))
+def test_early_return_now_transforms():
+    # round 2 left returns as Python semantics (this test asserted a raise);
+    # the return transformer now carries them through lax.cond
+    f = to_static(_early_return_vec)
+    assert float(f(paddle.to_tensor(np.array([1.0], "float32")))
+                 .numpy()[0]) == 2.0
+    assert float(f(paddle.to_tensor(np.array([-1.0], "float32")))
+                 .numpy()[0]) == -1.0
 
 
 def _nested(x):
@@ -179,3 +181,225 @@ def test_branch_defined_var_works():
     f = to_static(_uninit)
     out = f(paddle.to_tensor(np.array([2.0], "float32")))
     assert float(out.numpy()[0]) == 4.0
+
+
+# -- for / break / continue / return transforms (loop_transformer.py,
+# break_continue_transformer.py, return_transformer.py parity) ---------------
+
+def _for_range_tensor(x, n):
+    s = paddle.zeros([])
+    for i in range(n):
+        s = s + x * i.astype("float32")
+    return s
+
+
+def test_for_over_tensor_range_compiles_to_while():
+    f = to_static(_for_range_tensor)
+    x = paddle.to_tensor(np.array(2.0, "float32"))
+    assert float(f(x, paddle.to_tensor(np.array(4))).numpy()) == 12.0
+    # data-dependent trip count through the SAME compiled program
+    assert float(f(x, paddle.to_tensor(np.array(3))).numpy()) == 6.0
+    assert len(f._cache) == 1
+
+
+def _for_static_range(x):
+    s = paddle.zeros([])
+    for i in range(3):
+        s = s + x * i
+    return s
+
+
+def test_for_over_python_range():
+    f = to_static(_for_static_range)
+    assert float(f(paddle.to_tensor(np.array(2.0, "float32"))).numpy()) == 6.0
+
+
+def _for_tensor_rows(x):
+    s = paddle.zeros([3])
+    for row in x:
+        s = s + row
+    return s
+
+
+def test_for_over_tensor_rows():
+    f = to_static(_for_tensor_rows)
+    assert np.allclose(f(paddle.ones([4, 3])).numpy(), [4, 4, 4])
+
+
+def _early_return(x):
+    if paddle.sum(x) > 0:
+        return x * 2
+    return x * 3
+
+
+def test_early_return_traced_pred():
+    f = to_static(_early_return)
+    pos = paddle.to_tensor(np.array(1.0, "float32"))
+    neg = paddle.to_tensor(np.array(-1.0, "float32"))
+    assert float(f(pos).numpy()) == 2.0
+    assert float(f(neg).numpy()) == -3.0
+    assert len(f._cache) == 1
+
+
+def _tensor_break(x):
+    i = paddle.zeros([], dtype="int32")
+    s = paddle.zeros([])
+    while i < 100:
+        s = s + x
+        if s > 5:
+            break
+        i = i + 1
+    return s
+
+
+def test_tensor_break_in_tensor_while():
+    f = to_static(_tensor_break)
+    assert float(f(paddle.to_tensor(np.array(2.0, "float32"))).numpy()) == 6.0
+
+
+def _tensor_continue(x, n):
+    s = paddle.zeros([])
+    for i in range(n):
+        if paddle.mod(i, paddle.to_tensor(np.array(2))) == 0:
+            continue
+        s = s + x * i.astype("float32")
+    return s
+
+
+def test_tensor_continue_in_for():
+    f = to_static(_tensor_continue)
+    out = f(paddle.to_tensor(np.array(1.0, "float32")),
+            paddle.to_tensor(np.array(6)))
+    assert float(out.numpy()) == 9.0      # 1 + 3 + 5
+
+
+def _return_inside_loop(x):
+    i = paddle.zeros([], dtype="int32")
+    while i < 100:
+        if x * i.astype("float32") > 4:
+            return i
+        i = i + 1
+    return i
+
+
+def test_return_inside_tensor_loop():
+    f = to_static(_return_inside_loop)
+    assert int(f(paddle.to_tensor(np.array(1.5, "float32"))).numpy()) == 3
+
+
+def _py_bound_tensor_break(x):
+    s = paddle.zeros([])
+    for i in range(100):
+        s = s + x
+        if s > 5:
+            break
+    return s
+
+
+def test_tensor_break_in_python_loop_raises():
+    """A Tensor break cannot retroactively convert a Python-bound loop:
+    must raise loudly (never silently trace wrong)."""
+    f = to_static(_py_bound_tensor_break)
+    with pytest.raises(Exception) as ei:
+        f(paddle.to_tensor(np.array(2.0, "float32")))
+    assert "tensor-dependent" in str(ei.value) or \
+        "Dy2Static" in type(ei.value).__name__
+
+
+def _break_continue_mixed(x, n):
+    """break + continue + nested if in one loop."""
+    s = paddle.zeros([])
+    for i in range(n):
+        f = i.astype("float32")
+        if paddle.mod(i, paddle.to_tensor(np.array(2))) == 0:
+            continue
+        s = s + x * f
+        if s > 10:
+            break
+    return s
+
+
+def test_break_continue_mixed_matches_python():
+    f = to_static(_break_continue_mixed)
+    # python semantics: i=1 s=2, i=3 s=8, i=5 s=18 -> break
+    out = f(paddle.to_tensor(np.array(2.0, "float32")),
+            paddle.to_tensor(np.array(10)))
+    assert float(out.numpy()) == 18.0
+
+
+class _LoopNet(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = paddle.nn.Linear(4, 4)
+
+    def forward(self, x, steps):
+        h = x
+        for _ in range(steps):
+            h = paddle.tanh(self.lin(h))
+        return h
+
+
+def test_layer_for_loop_dygraph_equals_static():
+    paddle.seed(7)
+    net = _LoopNet()
+    xs = paddle.to_tensor(np.random.RandomState(0)
+                          .randn(2, 4).astype("float32"))
+    dy = net(xs, 3).numpy()
+    st = to_static(net)(xs, 3).numpy()
+    assert np.allclose(dy, st, atol=1e-5)
+
+
+class _Ctx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def _return_under_with(x):
+    with _Ctx():
+        if paddle.sum(x) > 0:
+            return x * 2
+        return x * 3
+
+
+def test_return_inside_with_guarded():
+    f = to_static(_return_under_with)
+    assert float(f(paddle.to_tensor(np.array(1.0, "float32")))
+                 .numpy()) == 2.0
+    assert float(f(paddle.to_tensor(np.array(-1.0, "float32")))
+                 .numpy()) == -3.0
+
+
+def _return_under_try(x):
+    try:
+        if paddle.sum(x) > 0:
+            return x * 2
+        return x * 3
+    finally:
+        pass
+
+
+def test_return_inside_try_guarded():
+    f = to_static(_return_under_try)
+    assert float(f(paddle.to_tensor(np.array(1.0, "float32")))
+                 .numpy()) == 2.0
+
+
+def _for_else_return(x):
+    for _ in range(3):
+        x = x + 1
+    else:
+        return x * 2
+    return x
+
+
+def test_for_else_return_transforms_cleanly():
+    # the return in the orelse must NOT emit a loop break (SyntaxError would
+    # silently disable the whole transform)
+    from paddle_tpu.jit.dy2static import ast_transform
+    g = ast_transform(_for_else_return)
+    assert g is not None
+    assert float(g(paddle.to_tensor(np.array(1.0, "float32")))
+                 .numpy()) == 8.0
