@@ -18,6 +18,7 @@ by nested program rewriting.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ...framework.tensor import Tensor
 from ...parallel import mesh as mesh_mod
@@ -31,12 +32,44 @@ class DistributedOptimizer:
     """Strategy-carrying optimizer wrapper (the composed meta-optimizer)."""
 
     def __init__(self, optimizer, strategy: DistributedStrategy):
-        self._inner = optimizer
+        self._inner = self._apply_optimizer_swaps(optimizer, strategy)
         self.user_defined_strategy = strategy
+
+    @staticmethod
+    def _apply_optimizer_swaps(optimizer, strategy):
+        """strategy.lamb/lars swap the inner optimizer (the reference's
+        LambOptimizer/LarsOptimizer meta-optimizers replace the user's
+        momentum/adam the same way)."""
+        from ...optimizer.optimizer import Lamb, LarsMomentum
+        if strategy is None:
+            return optimizer
+        params = getattr(optimizer, "_parameters", None)
+        # carry the user's LR schedule object (not a float snapshot) and
+        # grad clip through the swap
+        lr = getattr(optimizer, "_lr", None)
+        clip = getattr(optimizer, "_grad_clip", None)
+        if getattr(strategy, "lamb", False) and \
+                not isinstance(optimizer, Lamb):
+            cfg = strategy.lamb_configs
+            return Lamb(learning_rate=lr,
+                        lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+                        parameters=params, grad_clip=clip)
+        if getattr(strategy, "lars", False) and \
+                not isinstance(optimizer, LarsMomentum):
+            cfg = strategy.lars_configs
+            return LarsMomentum(
+                learning_rate=lr,
+                momentum=getattr(optimizer, "_momentum", 0.9),
+                lars_coeff=cfg.get("lars_coeff", 0.001),
+                lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                parameters=params, grad_clip=clip)
+        return optimizer
 
     # strategy → engine options ---------------------------------------------
     def train_step_options(self):
+        from .ledger import check_strategy
         s = self.user_defined_strategy
+        check_strategy(s)        # unsupported flags raise, never sit inert
         opts = {}
         if s.recompute:
             opts["remat"] = True
@@ -52,6 +85,15 @@ class DistributedOptimizer:
                 opts["compute_dtype"] = jnp.bfloat16
             else:
                 opts["compute_dtype"] = jnp.float16
+        if s.localsgd:
+            opts["localsgd_k"] = int(s.localsgd_configs.get("k_steps", 1))
+            opts["localsgd_begin"] = int(
+                s.localsgd_configs.get("begin_step", 1))
+        if s.a_sync:
+            raise NotImplementedError(
+                "DistributedStrategy.a_sync is the parameter-server async "
+                "mode; it configures the ps/ trainer (rec.WideDeepTrainer "
+                "async_push), not the collective TrainStep path")
         return opts
 
     def build_train_step(self, layer, loss_fn=None, **overrides):
@@ -207,13 +249,26 @@ class Fleet:
             time.sleep(0.05)
 
     def init_worker(self):
-        """Connect this trainer to the pserver(s).  Returns the PS client
-        (single-endpoint for now; multi-server table sharding is a host-side
-        concern, not a chip one)."""
-        from ..ps import PsClient, LocalPsEndpoint
+        """Connect this trainer to the pserver(s): sparse rows shard across
+        ALL endpoints by id-hash (distribute_transpiler.py:256 key-block
+        semantics via ShardedPsClient) and the worker starts heartbeating so
+        a dead trainer gets evicted from barriers
+        (heart_beat_monitor.h:51)."""
+        from ..ps import PsClient, LocalPsEndpoint, ShardedPsClient
         eps = (self._role_maker.get_pserver_endpoints()
                if self._role_maker else [])
-        self._ps_client = PsClient(eps[0]) if eps else LocalPsEndpoint()
+        if not eps:
+            self._ps_client = LocalPsEndpoint()
+        elif len(eps) == 1:
+            self._ps_client = PsClient(eps[0])
+        else:
+            self._ps_client = ShardedPsClient(eps)
+        if eps and self._role_maker is not None:
+            try:
+                self._ps_client.start_heartbeat(
+                    self._role_maker.worker_index())
+            except Exception:
+                pass        # heartbeat is liveness sugar, not a hard dep
         return self._ps_client
 
     def stop_worker(self):
@@ -233,12 +288,61 @@ class _UtilBase:
     def barrier(self, comm_world="worker"):
         self._fleet.barrier_worker()
 
+    def _comm_members(self, comm_world):
+        """(my_index, world_size) within the named comm world
+        (role_maker _all_comm_world parity: worker / server / all)."""
+        rm = self._fleet._role_maker
+        wn, sn = rm.worker_num(), max(rm.server_num(), 0)
+        if comm_world == "worker":
+            return (rm.worker_index() if rm.is_worker() else None), wn
+        if comm_world == "server":
+            return (rm.server_index() if rm.is_server() else None), sn
+        me = rm.worker_index() if rm.is_worker() \
+            else wn + rm.server_index()
+        return me, wn + sn
+
     def all_reduce(self, input, mode="sum", comm_world="worker"):
+        rm = self._fleet._role_maker
+        if not self._fleet._is_collective and rm is not None:
+            me, world = self._comm_members(comm_world)
+            if world > 1 and me is not None:
+                # PS / non-collective mode: the mesh is per-process, so
+                # reduce across PROCESSES through the store
+                # (gloo_wrapper.h AllReduce)
+                return self._store_all_reduce(
+                    np.asarray(input.numpy() if isinstance(input, Tensor)
+                               else input), mode, comm_world, me, world)
         from ..collective import all_reduce as _ar, ReduceOp
         op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
               "min": ReduceOp.MIN}[mode]
         t = input if isinstance(input, Tensor) else Tensor(jnp.asarray(input))
         return _ar(t, op=op).numpy()
+
+    def _store_all_reduce(self, arr, mode, comm_world, me, world):
+        import pickle
+        rm = self._fleet._role_maker
+        store = rm._ensure_store()
+        # generation-scoped keys: after an elastic gang restart the store
+        # survives in the launcher, and stale contributions from the dead
+        # gang must never be read as current ones. The sequence counter
+        # lives on the Fleet singleton (this _UtilBase is a throwaway per
+        # `.util` access) and is scoped per comm_world so worker-only and
+        # all-reduces never share a prefix.
+        gen = store._restart_generation()
+        seqs = self._fleet.__dict__.setdefault("_util_ar_seqs", {})
+        seq = seqs.get(comm_world, 0)
+        seqs[comm_world] = seq + 1
+        pre = f"__utilar/{gen}/{comm_world}/{seq}"
+        store.set(f"{pre}/{me}", pickle.dumps(arr))
+        store.barrier(pre, world)
+        parts = [pickle.loads(store.get(f"{pre}/{r}"))
+                 for r in range(world)]
+        fn = {"sum": np.sum, "max": np.max, "min": np.min}[mode]
+        out = fn(np.stack(parts), axis=0)
+        store.barrier(f"{pre}/done", world)
+        if me == 0:
+            store.delete_prefix(pre + "/")
+        return out
 
 
 fleet = Fleet()

@@ -62,7 +62,8 @@ class TrainStep:
     def __init__(self, layer, optimizer, loss_fn=None, *, mesh=None,
                  remat: bool = False, zero: int = 0, accumulate_steps: int = 1,
                  donate: bool = True, seed: int = 0,
-                 batch_spec=None, compute_dtype=None):
+                 batch_spec=None, compute_dtype=None,
+                 localsgd_k: int = 0, localsgd_begin: int = 1):
         self.layer = layer
         self.optimizer = optimizer
         self.loss_fn = _wrap_loss(loss_fn) if loss_fn is not None else None
@@ -73,12 +74,26 @@ class TrainStep:
         self.seed = seed
         self.batch_spec = batch_spec
         self.compute_dtype = compute_dtype
+        # LocalSGD (meta_optimizers/localsgd_optimizer.py parity): each dp
+        # rank trains its OWN parameter copy for k steps, then copies are
+        # averaged. TPU-shape: params/opt-state carry a leading dp-sharded
+        # axis and the step vmaps over it — per-rank updates stay local
+        # (zero collectives) until the periodic mean. localsgd_begin is the
+        # warmup boundary: before it, every step syncs (adaptive ramp-in).
+        self.localsgd_k = int(localsgd_k)
+        self.localsgd_begin = int(localsgd_begin)
+        if self.localsgd_k > 1 and (zero or accumulate_steps > 1):
+            raise ValueError("localsgd composes with neither sharding (zero) "
+                             "nor gradient_merge in this engine")
         self._state = None
         self._compiled = None
         self._donate = donate
 
         from .pipeline import PipelineModule
         self._pipe = layer if isinstance(layer, PipelineModule) else None
+        if self.localsgd_k > 1 and self._pipe is not None:
+            raise ValueError("localsgd is a data-parallel strategy; it does "
+                             "not compose with pipeline parallelism")
         if self._pipe is not None:
             # microbatching IS the gradient accumulation in a pipeline:
             # strategy accumulate_steps sets the GPipe microbatch count
@@ -139,11 +154,52 @@ class TrainStep:
                 out[sname][pname] = NamedSharding(self.mesh, spec)
         return out
 
+    def _localsgd_degree(self):
+        return self.mesh.shape.get(DP_AXIS, 1) if self.localsgd_k > 1 else 0
+
     def init_state(self):
         if self._pipe is not None:
             params, buffers = self._pipe.flat_state()
         else:
             params, buffers = F.layer_state(self.layer)
+        D = self._localsgd_degree()
+        if D > 1:
+            # per-rank copies: leading dp-sharded axis on params, buffers
+            # and optimizer state; one copy per device, same memory as
+            # replicated storage
+            pshard = self._param_sharding_tree(params)
+            rank_shard = {n: NamedSharding(self.mesh, P(DP_AXIS, *s.spec))
+                          for n, s in pshard.items()}
+            base = dict(params)
+            opt_base = self.optimizer.functional_state(base)
+            # accumulators matching the param shape inherit its rank spec;
+            # scalar/odd-shaped ones just shard the leading rank axis
+            oshard = {s: {n: (rank_shard[n] if v.shape == base[n].shape
+                              else NamedSharding(self.mesh, P(DP_AXIS)))
+                          for n, v in acc.items()}
+                      for s, acc in opt_base.items()}
+            buf_shard = NamedSharding(self.mesh, P(DP_AXIS))
+            rep_n = lambda v: jnp.broadcast_to(v, (D,) + v.shape)
+            params = {n: jax.device_put(rep_n(v), rank_shard[n])
+                      for n, v in base.items()}
+            buffers = {n: jax.device_put(rep_n(v), buf_shard)
+                       for n, v in buffers.items()}
+            opt_state = {s: {n: jax.device_put(rep_n(v), oshard[s][n])
+                             for n, v in acc.items()}
+                         for s, acc in opt_base.items()}
+            rep = NamedSharding(self.mesh, P())
+            self._state = {
+                "params": params, "buffers": buffers, "opt": opt_state,
+                "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
+            }
+            self._shardings = {
+                "params": rank_shard,
+                "buffers": {n: buf_shard for n in buffers},
+                "opt": oshard,
+                "step": rep,
+            }
+            self._grad_shardings = None
+            return self._state
         pshard = self._param_sharding_tree(params)
         if self.zero >= 3:
             # ZeRO-3: parameters themselves are stored dp-sharded; GSPMD
@@ -263,7 +319,67 @@ class TrainStep:
             loss = self.loss_fn(out, label)
         return loss.astype(jnp.float32).mean(), new_buffers
 
+    def _build_localsgd_step(self):
+        """LocalSGD step: vmap the (grad + update) over the per-rank leading
+        axis — each dp rank advances its own replica from its own batch
+        shard; every localsgd_k-th step (and every step before
+        localsgd_begin) the replicas are averaged
+        (localsgd_optimizer.py:440's allreduce-of-params, here one mean
+        over the dp-sharded axis)."""
+        loss_of = self._loss_of
+        if self.remat:
+            loss_of = jax.checkpoint(loss_of, static_argnums=())
+        D = self._localsgd_degree()
+        k = self.localsgd_k
+        begin = self.localsgd_begin
+
+        def step(state, inputs, label, lr):
+            new_step = state["step"] + 1
+            base_key = jax.random.fold_in(jax.random.key(self.seed), new_step)
+
+            def per_rank(p, b, o, mb_in, mb_lb, ridx):
+                key = jax.random.fold_in(base_key, ridx)
+                (loss, nb), g = jax.value_and_grad(loss_of, has_aux=True)(
+                    p, b, mb_in, mb_lb, key)
+                np_, no = self.optimizer.functional_apply(p, g, o, new_step,
+                                                          lr)
+                return loss, np_, nb, no
+
+            def split(x):
+                if x is None:
+                    return None
+                return x.reshape((D, x.shape[0] // D) + x.shape[1:])
+
+            mb_in = tuple(split(x) for x in inputs)
+            mb_lb = None if label is None else split(label)
+            loss, new_params, new_buffers, new_opt = jax.vmap(
+                per_rank, in_axes=(0, 0, 0, 0, 0, 0))(
+                state["params"], state["buffers"], state["opt"],
+                mb_in, mb_lb, jnp.arange(D))
+
+            do_sync = jnp.logical_or(new_step < begin, new_step % k == 0)
+
+            def avg(tree):
+                return jax.tree_util.tree_map(
+                    lambda v: jnp.broadcast_to(
+                        jnp.mean(v, axis=0, keepdims=True,
+                                 dtype=v.dtype if jnp.issubdtype(
+                                     v.dtype, jnp.floating) else None),
+                        v.shape) if jnp.issubdtype(v.dtype, jnp.floating)
+                    else v,
+                    tree)
+
+            new_params, new_buffers = jax.lax.cond(
+                do_sync, lambda t: (avg(t[0]), avg(t[1])), lambda t: t,
+                (new_params, new_buffers))
+            return {"params": new_params, "buffers": new_buffers,
+                    "opt": new_opt, "step": new_step}, loss.mean()
+
+        return step
+
     def _build_step(self):
+        if self._localsgd_degree() > 1:
+            return self._build_localsgd_step()
         if self._pipe is not None:
             # remat happens per trunk block inside build_body
             loss_of = self._pipe_loss_of
@@ -352,6 +468,11 @@ class TrainStep:
 
         dp = self.mesh.shape.get(DP_AXIS, 1)
         lead_ndim = inputs[0].ndim
+        if self._localsgd_degree() > 1 and inputs[0].shape[0] % dp != 0:
+            raise ValueError(
+                f"localsgd needs the batch ({inputs[0].shape[0]}) divisible "
+                f"by the dp degree ({dp}): each rank trains its own replica "
+                "on its own shard, so there is no replicate fallback")
 
         def put(x):
             if x is None:
@@ -377,13 +498,23 @@ class TrainStep:
     def sync_to_layer(self):
         """Write compiled-state params/buffers back into the eager Layer and
         optimizer accumulators (for save/eval interop)."""
+        params, buffers, opt = (self.state["params"], self.state["buffers"],
+                                self.state["opt"])
+        if self._localsgd_degree() > 1:
+            # collapse per-rank replicas: mean is exact right after a sync
+            # step and the consensus answer between syncs
+            fold = lambda v: (jnp.mean(v, axis=0)
+                              if jnp.issubdtype(v.dtype, jnp.floating)
+                              else v[0])
+            params = {n: fold(v) for n, v in params.items()}
+            buffers = {n: fold(v) for n, v in buffers.items()}
+            opt = {s: {n: fold(v) for n, v in acc.items()}
+                   for s, acc in opt.items()}
         if self._pipe is not None:
-            self._pipe.load_flat_state(self.state["params"],
-                                       self.state["buffers"])
+            self._pipe.load_flat_state(params, buffers)
         else:
-            F.load_layer_state(self.layer, self.state["params"],
-                               self.state["buffers"])
-        self.optimizer.adopt_functional_state(self.state["opt"])
+            F.load_layer_state(self.layer, params, buffers)
+        self.optimizer.adopt_functional_state(opt)
         self.optimizer._step_count = int(self.state["step"])
 
 
