@@ -18,4 +18,6 @@ from .spawn import spawn  # noqa: F401
 from . import fleet  # noqa: F401
 from ..parallel import init_mesh, get_mesh  # noqa: F401
 
-QueueDataset = None  # PS-era datasets arrive with the ps package
+from .dataset import (  # noqa: F401
+    DatasetBase, InMemoryDataset, QueueDataset,
+)

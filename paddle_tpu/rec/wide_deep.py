@@ -261,3 +261,50 @@ def synthetic_ctr_batch(batch: int, num_slots: int = 26, dense_dim: int = 13,
     label = (logit + rng.standard_normal(batch) >
              0).astype(np.float32)[:, None]
     return ids.astype(np.int64), dense, label
+
+def write_ctr_files(dirname, n_examples, n_files=4, num_slots: int = 26,
+                    dense_dim: int = 13, vocab: int = 1_000_000, seed=0):
+    """Write synthetic CTR data as MultiSlot text files (data_feed.proto
+    format): 26 single-id sparse slots, one dense slot, one label slot.
+    Returns the filelist."""
+    import os
+    os.makedirs(dirname, exist_ok=True)
+    per = n_examples // n_files
+    files = []
+    for fi in range(n_files):
+        ids, dense, label = synthetic_ctr_batch(per, num_slots, dense_dim,
+                                                vocab, seed=seed + fi)
+        path = os.path.join(dirname, f"ctr_{fi:03d}.txt")
+        with open(path, "w") as f:
+            for r in range(per):
+                parts = [f"1 {ids[r, s]}" for s in range(num_slots)]
+                parts.append(f"{dense_dim} " +
+                             " ".join(f"{v:.5f}" for v in dense[r]))
+                parts.append(f"1 {int(label[r, 0])}")
+                f.write(" ".join(parts) + "\n")
+        files.append(path)
+    return files
+
+
+def ctr_dataset(filelist, batch_size, num_slots: int = 26,
+                dense_dim: int = 13, kind="InMemoryDataset"):
+    """An InMemoryDataset/QueueDataset over CTR MultiSlot files, slot
+    schema matching write_ctr_files."""
+    from ..distributed.dataset import InMemoryDataset, QueueDataset
+    ds = (InMemoryDataset if kind == "InMemoryDataset" else QueueDataset)()
+    ds.init(batch_size=batch_size, thread_num=4)
+    slots = [{"name": f"C{s}", "type": "uint64"} for s in range(num_slots)]
+    slots.append({"name": "dense", "type": "float", "is_dense": True,
+                  "shape": (dense_dim,)})
+    slots.append({"name": "label", "type": "uint64"})
+    ds.set_slots(slots)
+    ds.set_filelist(list(filelist))
+    return ds
+
+
+def batch_from_feed(feed, num_slots: int = 26):
+    """Compose a dataset feed dict into (ids, dense, label) trainer arrays."""
+    ids = np.concatenate([feed[f"C{s}"] for s in range(num_slots)], axis=1)
+    dense = feed["dense"].astype(np.float32)
+    label = feed["label"].astype(np.float32)
+    return ids.astype(np.int64), dense, label
