@@ -17,6 +17,10 @@ Multi-process:
 from .table import SparseTable, DenseTable  # noqa: F401
 from .service import PsServer, PsClient, LocalPsEndpoint  # noqa: F401
 from .embedding import DistributedEmbedding  # noqa: F401
+from .sharded import (  # noqa: F401
+    ShardedPsClient, Communicator, GeoCommunicator,
+)
 
 __all__ = ["SparseTable", "DenseTable", "PsServer", "PsClient",
-           "LocalPsEndpoint", "DistributedEmbedding"]
+           "LocalPsEndpoint", "DistributedEmbedding", "ShardedPsClient",
+           "Communicator", "GeoCommunicator"]

@@ -58,7 +58,8 @@ class PsServer:
     reference's per-shard mutexes collapse to one — host python, not the
     hot path)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_timeout: float = 10.0):
         self._tables: Dict[int, object] = {}
         self._lock = threading.RLock()  # _handle -> create_table re-enters
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -70,6 +71,14 @@ class PsServer:
         self._threads = []
         self._barrier_count = 0
         self._barrier_waiters = []
+        # worker liveness (heart_beat_monitor.h:51): workers that stop
+        # beating past the timeout are evicted — barriers no longer wait
+        # for them, so one dead trainer cannot hang the job
+        self._hb_timeout = heartbeat_timeout
+        self._hb_last: Dict[int, float] = {}
+        self._hb_dead: set = set()
+        self._barrier_cv = threading.Condition()
+        self._barrier_arrived: Dict[str, set] = {}
 
     def create_table(self, table_id: int, kind: str = "sparse", **kw):
         with self._lock:
@@ -106,8 +115,65 @@ class PsServer:
         finally:
             conn.close()
 
+    # -- worker liveness ------------------------------------------------------
+    def _alive_workers(self, expected):
+        import time
+        now = time.monotonic()
+        alive = set()
+        for w in range(expected):
+            if w in self._hb_dead:
+                continue
+            last = self._hb_last.get(w)
+            if last is None or now - last <= self._hb_timeout:
+                alive.add(w)
+            else:
+                self._hb_dead.add(w)       # evict (HeartBeatMonitor::Run)
+        return alive
+
+    def _barrier(self, name, worker_id, expected, timeout):
+        """Block until every LIVE worker arrives (barrier_table semantics
+        with heart_beat_monitor eviction). State is refcounted: when the
+        last waiter leaves a completed barrier its entry is dropped, so a
+        restarted worker reusing the same name sequence gets a FRESH
+        barrier instead of sailing through on stale arrivals."""
+        import time
+        deadline = time.monotonic() + timeout
+        with self._barrier_cv:
+            st = self._barrier_arrived.setdefault(
+                name, {"arrived": set(), "inside": 0})
+            st["arrived"].add(worker_id)
+            st["inside"] += 1
+            self._barrier_cv.notify_all()
+            try:
+                while True:
+                    alive = self._alive_workers(expected)
+                    if alive - st["arrived"] == set():
+                        self._barrier_cv.notify_all()
+                        return {"ok": True, "alive": sorted(alive)}
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return {"ok": False, "error": "barrier timeout",
+                                "waiting_for": sorted(alive - st["arrived"])}
+                    self._barrier_cv.wait(min(left, 0.25))
+            finally:
+                st["inside"] -= 1
+                if st["inside"] == 0:
+                    self._barrier_arrived.pop(name, None)
+
     def _handle(self, msg):
         op = msg["op"]
+        if op == "heartbeat":
+            import time
+            wid = int(msg["worker_id"])
+            self._hb_last[wid] = time.monotonic()
+            # a worker that resumes beating (long GC / compile pause)
+            # rejoins — eviction is not a death sentence
+            self._hb_dead.discard(wid)
+            return {"ok": True}
+        if op == "barrier":
+            return self._barrier(msg.get("name", ""), int(msg["worker_id"]),
+                                 int(msg["expected"]),
+                                 float(msg.get("timeout", 60.0)))
         with self._lock:
             if op == "create_table":
                 self.create_table(msg["table_id"], msg.get("kind", "sparse"),
@@ -153,17 +219,67 @@ class PsClient:
     here because pushes batch per train step already)."""
 
     def __init__(self, endpoint: str):
+        self._endpoint = endpoint
         host, port = endpoint.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)), timeout=60)
         self._lock = threading.Lock()
+        self._hb_thread = None
+        self._hb_stop = threading.Event()
+        self._barrier_seq = 0
 
     def _call(self, **msg):
         with self._lock:
             _send_msg(self._sock, msg)
             out = _recv_msg(self._sock)
         if out is None or not out.get("ok"):
-            raise RuntimeError(f"PS call failed: {msg.get('op')}")
+            raise RuntimeError(f"PS call failed: {msg.get('op')}: "
+                               f"{(out or {}).get('error', 'conn closed')}")
         return out
+
+    def _call_fresh(self, timeout=90.0, **msg):
+        """Blocking ops (barrier) and side-channel ops (heartbeat) use their
+        own connection so the pull/push socket never stalls behind them."""
+        host, port = self._endpoint.rsplit(":", 1)
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as s:
+            _send_msg(s, msg)
+            out = _recv_msg(s)
+        if out is None or not out.get("ok"):
+            raise RuntimeError(f"PS call failed: {msg.get('op')}: "
+                               f"{(out or {}).get('error', 'conn closed')}")
+        return out
+
+    # -- liveness (heart_beat_monitor.h worker side) -------------------------
+    def start_heartbeat(self, worker_id: int, interval: float = 1.0):
+        if self._hb_thread is not None:
+            return
+        self._hb_stop = threading.Event()   # restartable after stop_heartbeat
+
+        def beat():
+            while not self._hb_stop.wait(interval):
+                try:
+                    self._call_fresh(op="heartbeat", worker_id=worker_id,
+                                     timeout=10.0)
+                except Exception:
+                    return          # server gone: trainer notices on RPC
+        self._call_fresh(op="heartbeat", worker_id=worker_id, timeout=10.0)
+        self._hb_thread = threading.Thread(target=beat, daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self):
+        self._hb_stop.set()
+        self._hb_thread = None
+
+    def barrier(self, worker_id: int, expected: int, name: str = None,
+                timeout: float = 60.0):
+        """Job-wide barrier that only waits for LIVE workers; returns the
+        list of workers it synchronized with."""
+        self._barrier_seq += 1
+        name = name or f"b{self._barrier_seq}"
+        out = self._call_fresh(op="barrier", worker_id=worker_id,
+                               expected=expected, name=name,
+                               timeout=timeout + 5.0)
+        return out["alive"]
 
     def create_table(self, table_id: int, kind: str = "sparse", **config):
         self._call(op="create_table", table_id=table_id, kind=kind,

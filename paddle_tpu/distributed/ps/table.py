@@ -66,7 +66,16 @@ class SparseTable:
         self._step += 1
         ids = np.asarray(ids).ravel()
         grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
-        if self.opt == "sgd":
+        if self.opt == "sum":
+            # raw additive apply (SparseGeoTable: geo-mode deltas arrive
+            # pre-scaled, the server just accumulates)
+            for rid, g in zip(ids, grads):
+                rid = int(rid)
+                row = self._rows.get(rid)
+                if row is None:
+                    row = self._rows[rid] = self._new_row()
+                row -= g
+        elif self.opt == "sgd":
             for rid, g in zip(ids, grads):
                 rid = int(rid)
                 row = self._rows.get(rid)
