@@ -27,13 +27,55 @@ def _auto_name(prefix="tmp"):
     return f"{prefix}_{_name_counter[0]}"
 
 
+class LoDArray(np.ndarray):
+    """numpy carrier for LoD offsets: survives pickling through DataLoader
+    worker queues without touching jax in forked children; converting to a
+    Tensor lifts ``.lod`` onto the tensor (lod_tensor.h parity)."""
+
+    lod = None
+
+    @classmethod
+    def wrap(cls, arr, lod):
+        out = np.asarray(arr).view(cls)
+        out.lod = [list(int(o) for o in level) for level in lod]
+        return out
+
+    def __reduce__(self):
+        base = super().__reduce__()
+        return (base[0], base[1], base[2] + (self.lod,))
+
+    def __setstate__(self, state):
+        self.lod = state[-1]
+        super().__setstate__(state[:-1])
+
+
+def pad_ragged_rows(rows):
+    """Rows of shape (L_i, ...) → LoDArray (B, max L, ...) with level-1
+    offsets. The one shared pad-and-offset implementation behind
+    create_lod_tensor and DataLoader ragged collate."""
+    rows = [np.asarray(r) for r in rows]
+    lens = [r.shape[0] for r in rows]
+    m = max(lens) if lens else 0
+    feat = rows[0].shape[1:] if rows else ()
+    pad = np.zeros((len(rows), m) + feat, rows[0].dtype if rows else np.float32)
+    for i, r in enumerate(rows):
+        pad[i, :r.shape[0]] = r
+    offs = [0]
+    for L in lens:
+        offs.append(offs[-1] + L)
+    return LoDArray.wrap(pad, [offs])
+
+
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "persistable", "name", "grad",
                  "_node", "_out_index", "_retain_grads", "_hooks", "is_leaf",
                  "_bwd_done", "_version", "_consumers", "_consumers_cap",
-                 "__weakref__")
+                 "_lod", "__weakref__")
 
     def __init__(self, value, stop_gradient=True, name=None, persistable=False):
+        # capture LoD BEFORE coercion: jnp.asarray strips LoDArray attrs
+        lod = getattr(value, "lod", None) \
+            if not isinstance(value, jax.Array) else None
         if isinstance(value, Tensor):
             value = value._value
         if not isinstance(value, jax.Array):
@@ -52,6 +94,48 @@ class Tensor:
         self._hooks = []
         self.is_leaf = True
         self._bwd_done = False
+        # LoD carrier (lod_tensor.h): [[offsets...], ...]; lifted from a
+        # LoDArray (ragged DataLoader batch) when one is converted
+        self._lod = [list(level) for level in lod] if lod else None
+
+    # -- LoD (lod_tensor.h parity: raggedness rides ON the tensor) -----------
+    @property
+    def lod(self):
+        """Level-of-detail offsets, e.g. [[0, 2, 5]] for rows of len 2, 3.
+        None for dense tensors. The TPU data layout is padded
+        [batch, max_len, ...]; sequence primitives read the offsets when no
+        explicit lengths are passed (sequence_ops/ + lod_tensor.h)."""
+        return self._lod
+
+    def set_lod(self, lod):
+        self._lod = [list(int(o) for o in level) for level in lod] \
+            if lod else None
+
+    def recursive_sequence_lengths(self):
+        """Offsets → per-sequence lengths per level (LoDTensor API)."""
+        if self._lod is None:
+            return []
+        return [[level[i + 1] - level[i] for i in range(len(level) - 1)]
+                for level in self._lod]
+
+    def has_valid_recursive_sequence_lengths(self):
+        if self._lod is None:
+            return True
+        for level in self._lod:
+            if not level or level[0] != 0 or \
+                    any(level[i] > level[i + 1]
+                        for i in range(len(level) - 1)):
+                return False
+        return True
+
+    def seq_lengths(self):
+        """Finest-level lengths as an array, or None (the form the masked
+        dense sequence ops consume)."""
+        if self._lod is None:
+            return None
+        level = self._lod[-1]
+        return jnp.asarray([level[i + 1] - level[i]
+                            for i in range(len(level) - 1)], jnp.int32)
 
     # -- structural ----------------------------------------------------------
     @property
@@ -268,7 +352,11 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
         arr = arr.astype(convert_dtype(dtype))
     elif arr.dtype == np.float64:
         arr = arr.astype(get_default_dtype())
-    return Tensor(jnp.asarray(arr), stop_gradient=stop_gradient)
+    out = Tensor(jnp.asarray(arr), stop_gradient=stop_gradient)
+    lod = getattr(data, "lod", None)     # LoDArray: raggedness survives
+    if lod:
+        out.set_lod(lod)
+    return out
 
 
 def unwrap(x):

@@ -42,7 +42,10 @@ def get_worker_info():
 
 
 def default_collate_fn(batch):
-    """Stack samples: list of tuples -> tuple of stacked arrays."""
+    """Stack samples: list of tuples -> tuple of stacked arrays. Samples
+    ragged in their FIRST dim pad to the batch max and return a LoD
+    Tensor carrying the offsets (lod_tensor.h: raggedness rides on the
+    tensor through batching)."""
     sample = batch[0]
     if isinstance(sample, (tuple, list)):
         return tuple(default_collate_fn([b[i] for b in batch])
@@ -50,8 +53,16 @@ def default_collate_fn(batch):
     if isinstance(sample, dict):
         return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
     if isinstance(sample, Tensor):
-        return np.stack([s.numpy() for s in batch])
+        return default_collate_fn([s.numpy() for s in batch])
     if isinstance(sample, np.ndarray):
+        shapes = {b.shape for b in batch}
+        if len(shapes) > 1 and sample.ndim >= 1 and \
+                len({b.shape[1:] for b in batch}) == 1:
+            # first-dim-ragged batch: pad + carry LoD offsets. LoDArray is
+            # plain numpy (pickles through worker queues, no jax in forked
+            # children); Tensor.__init__ lifts .lod on conversion.
+            from ..framework.tensor import pad_ragged_rows
+            return pad_ragged_rows(batch)
         return np.stack(batch)
     if isinstance(sample, (int, np.integer)):
         return np.asarray(batch, dtype=np.int64)
@@ -67,7 +78,72 @@ def _to_tensor_tree(obj, device_put):
         return [_to_tensor_tree(o, device_put) for o in obj]
     if isinstance(obj, dict):
         return {k: _to_tensor_tree(v, device_put) for k, v in obj.items()}
-    return Tensor(device_put(obj))
+    t = Tensor(device_put(obj))
+    lod = getattr(obj, "lod", None)       # LoDArray ragged batch carrier
+    if lod:
+        t.set_lod(lod)
+    return t
+
+
+class _Collator:
+    """default_collate_fn with DETERMINISTIC ragged-leaf memory: a tree
+    leaf known to be variable-length (from probing the dataset at loader
+    init, or from any earlier ragged batch) gets full-length LoD even on a
+    batch whose samples coincidentally share one length — otherwise a
+    lengths-free sequence op would crash data-dependently mid-epoch on
+    the first uniform batch."""
+
+    def __init__(self):
+        self._ragged = set()
+
+    def seed_from(self, dataset, probes=5):
+        try:
+            n = len(dataset)
+        except TypeError:
+            return
+        if n == 0:
+            return
+        idxs = sorted({0, n // 4, n // 2, (3 * n) // 4, n - 1})[:probes]
+        try:
+            samples = [dataset[i] for i in idxs]
+        except Exception:
+            return
+        self._mark_ragged(samples, ())
+
+    def _mark_ragged(self, samples, path):
+        s0 = samples[0]
+        if isinstance(s0, (tuple, list)):
+            for i in range(len(s0)):
+                self._mark_ragged([s[i] for s in samples], path + (i,))
+        elif isinstance(s0, dict):
+            for k in s0:
+                self._mark_ragged([s[k] for s in samples], path + (k,))
+        elif isinstance(s0, np.ndarray) and s0.ndim >= 1:
+            if len({np.asarray(s).shape[0] for s in samples}) > 1:
+                self._ragged.add(path)
+
+    def __call__(self, batch):
+        return self._walk(batch, ())
+
+    def _walk(self, batch, path):
+        from ..framework.tensor import LoDArray, pad_ragged_rows
+        sample = batch[0]
+        if isinstance(sample, (tuple, list)):
+            return tuple(self._walk([b[i] for b in batch], path + (i,))
+                         for i in range(len(sample)))
+        if isinstance(sample, dict):
+            return {k: self._walk([b[k] for b in batch], path + (k,))
+                    for k in sample}
+        out = default_collate_fn(batch)
+        if isinstance(out, LoDArray):
+            self._ragged.add(path)
+        elif path in self._ragged and isinstance(out, np.ndarray) \
+                and out.ndim >= 2:
+            # uniform batch at a known-ragged leaf: explicit full lengths
+            T = out.shape[1]
+            return LoDArray.wrap(out, [[i * T for i in
+                                        range(out.shape[0] + 1)]])
+        return out
 
 
 def _flatten_batch(obj):
@@ -83,6 +159,9 @@ def _flatten_batch(obj):
         if isinstance(o, dict):
             return {k: walk(v) for k, v in o.items()}
         arrays.append(np.asarray(o))
+        lod = getattr(o, "lod", None)     # ragged-batch offsets ride the spec
+        if lod:
+            return ("lod", len(arrays) - 1, lod)
         return len(arrays) - 1
 
     return walk(obj), arrays
@@ -91,6 +170,9 @@ def _flatten_batch(obj):
 def _unflatten_batch(spec, arrays):
     if isinstance(spec, tuple) and spec and spec[0] == "t":
         return tuple(_unflatten_batch(s, arrays) for s in spec[1:])
+    if isinstance(spec, tuple) and spec and spec[0] == "lod":
+        from ..framework.tensor import LoDArray
+        return LoDArray.wrap(arrays[spec[1]], spec[2])
     if isinstance(spec, list) and spec and spec[0] == "l":
         return [_unflatten_batch(s, arrays) for s in spec[1:]]
     if isinstance(spec, dict):
@@ -204,7 +286,13 @@ class DataLoader:
             worker_start_method
             or _os.environ.get("PT_DATALOADER_START_METHOD") or None)
         self.return_list = return_list
-        self.collate_fn = collate_fn or default_collate_fn
+        if collate_fn is not None:
+            self.collate_fn = collate_fn
+        else:
+            c = _Collator()
+            if not isinstance(dataset, IterableDataset):
+                c.seed_from(dataset)
+            self.collate_fn = c
         self.num_workers = int(num_workers)
         self.use_shared_memory = bool(use_shared_memory)
         self.prefetch_factor = max(int(prefetch_factor), 1)

@@ -58,6 +58,7 @@ def _sequence_pool_fn(x, lengths, pool_type="SUM"):
 
 def _sequence_softmax_fn(x, lengths):
     m = _mask(lengths, x.shape[1])
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))   # broadcast over features
     logits = jnp.where(m, x.astype(jnp.float32), -jnp.inf)
     out = jax.nn.softmax(logits, axis=1)
     return jnp.where(m, out, 0.0).astype(x.dtype)
@@ -140,12 +141,63 @@ _seq_erase = Primitive("sequence_erase", _sequence_erase_fn,
 _seq_slice = Primitive("sequence_slice", _sequence_slice_fn)
 
 
-def sequence_pool(x, lengths, pool_type="SUM", name=None):
-    return _seq_pool(x, lengths, pool_type=str(pool_type).upper())
+def _lengths_of(x, lengths):
+    """Explicit lengths win; otherwise read the LoD riding on the tensor
+    (lod_tensor.h: raggedness is a tensor attribute, not a side argument)."""
+    if lengths is not None:
+        return lengths
+    if isinstance(x, Tensor) and x.lod is not None:
+        return x.seq_lengths()
+    raise ValueError(
+        "sequence op needs per-row lengths: pass `lengths` or feed a "
+        "LoD tensor (create_lod_tensor / DataLoader ragged batching)")
 
 
-def sequence_softmax(x, lengths, name=None):
-    return _seq_softmax(x, lengths)
+def _carry_lod(out, x):
+    if isinstance(out, Tensor) and isinstance(x, Tensor) \
+            and x.lod is not None:
+        out.set_lod(x.lod)
+    return out
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """fluid.create_lod_tensor parity (lod_tensor.py): concatenated rows +
+    per-level lengths → a LoD tensor. TPU layout: padded
+    [batch, max_len, ...] with the offsets stored on ``.lod`` (the dense
+    form sequence_pad_op converts to; here it is native)."""
+    import numpy as np
+    if isinstance(data, Tensor):
+        data = np.asarray(unwrap(data))
+    elif isinstance(data, (list, tuple)) and data \
+            and isinstance(data[0], (list, tuple, np.ndarray)):
+        data = np.concatenate([np.asarray(r).reshape(len(r), -1)
+                               for r in data], axis=0)
+    else:
+        data = np.asarray(data)
+    from ..framework.tensor import pad_ragged_rows
+    lens = list(recursive_seq_lens[-1])
+    rows, off = [], 0
+    for L in lens:
+        rows.append(data[off:off + int(L)])
+        off += int(L)
+    t = Tensor(jnp.asarray(np.asarray(pad_ragged_rows(rows))))
+    lod = []
+    for level in recursive_seq_lens:
+        offs = [0]
+        for L in level:
+            offs.append(offs[-1] + int(L))
+        lod.append(offs)
+    t.set_lod(lod)
+    return t
+
+
+def sequence_pool(x, lengths=None, pool_type="SUM", name=None):
+    return _seq_pool(x, _lengths_of(x, lengths),
+                     pool_type=str(pool_type).upper())
+
+
+def sequence_softmax(x, lengths=None, name=None):
+    return _carry_lod(_seq_softmax(x, _lengths_of(x, lengths)), x)
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
@@ -155,24 +207,24 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
     return _seq_mask(lengths, maxlen=int(maxlen), out_dtype=str(dtype))
 
 
-def sequence_reverse(x, lengths, name=None):
-    return _seq_reverse(x, lengths)
+def sequence_reverse(x, lengths=None, name=None):
+    return _carry_lod(_seq_reverse(x, _lengths_of(x, lengths)), x)
 
 
 def sequence_pad(x, lengths, pad_value=0.0, name=None):
     return _seq_pad(x, lengths, pad_value=float(pad_value))
 
 
-def sequence_unpad(x, lengths, name=None):
-    return _seq_unpad(x, lengths)
+def sequence_unpad(x, lengths=None, name=None):
+    return _seq_unpad(x, _lengths_of(x, lengths))
 
 
-def sequence_first_step(x, lengths, name=None):
-    return _seq_first(x, lengths)
+def sequence_first_step(x, lengths=None, name=None):
+    return _seq_first(x, _lengths_of(x, lengths))
 
 
-def sequence_last_step(x, lengths, name=None):
-    return _seq_last(x, lengths)
+def sequence_last_step(x, lengths=None, name=None):
+    return _seq_last(x, _lengths_of(x, lengths))
 
 
 def sequence_erase(x, lengths, tokens, name=None):
@@ -188,10 +240,22 @@ def sequence_slice(x, offset, length, max_len=None, name=None):
 
 def sequence_expand(x, y_lengths, name=None):
     """sequence_expand_op.cc (ref_level 0 dense form): row i of x tiled
-    y_lengths[i] times into a [B, max_rep, ...] padded tensor."""
+    y_lengths[i] times into a [B, max_rep, ...] padded tensor. ``y_lengths``
+    may also be a LoD tensor y — its lod supplies the repeats (the
+    reference's x-expanded-by-y's-lod form)."""
     import numpy as np
+    if isinstance(y_lengths, Tensor) and y_lengths.ndim >= 2:
+        if y_lengths.lod is None:
+            raise ValueError("sequence_expand(x, y): y must carry LoD")
+        y_lengths = y_lengths.seq_lengths()
     max_rep = int(np.asarray(unwrap(y_lengths)).max())
-    return _seq_expand(x, y_lengths, max_rep=max_rep)
+    out = _seq_expand(x, y_lengths, max_rep=max_rep)
+    offs = [0]
+    for L in np.asarray(unwrap(y_lengths)).tolist():
+        offs.append(offs[-1] + int(L))
+    if isinstance(out, Tensor):
+        out.set_lod([offs])
+    return out
 
 
 def _sequence_expand_impl(x, reps, max_rep=1):
@@ -381,4 +445,4 @@ __all__ = ["sequence_pool", "sequence_softmax", "sequence_mask",
            "sequence_first_step", "sequence_last_step", "sequence_erase",
            "sequence_slice", "sequence_expand", "sequence_concat",
            "sequence_expand_as", "sequence_enumerate", "sequence_reshape",
-           "sequence_conv"]
+           "sequence_conv", "create_lod_tensor"]

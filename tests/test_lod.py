@@ -1,0 +1,176 @@
+"""LoD carrier: raggedness rides ON the tensor through sequence ops and
+DataLoader batching.
+
+Reference strategy parity: test_lod_tensor.py + sequence-op OpTests fed
+LoD inputs (lod_tensor.h, sequence_ops/) — ops read the tensor's lod, not
+a side lengths argument.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import LoDArray
+
+
+def _ragged():
+    # rows: [1,2] and [3,4,5] (concatenated-rows form, dim 1)
+    data = np.asarray([[1.], [2.], [3.], [4.], [5.]], "float32")
+    return paddle.create_lod_tensor(data, [[2, 3]])
+
+
+def test_create_lod_tensor_and_introspection():
+    t = _ragged()
+    assert t.lod == [[0, 2, 5]]
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+    assert t.has_valid_recursive_sequence_lengths()
+    assert list(t.shape) == [2, 3, 1]          # padded [B, maxlen, 1]
+    assert np.allclose(t.numpy()[0, :2, 0], [1, 2])
+    assert np.allclose(t.numpy()[1, :, 0], [3, 4, 5])
+
+
+def test_sequence_pool_reads_lod():
+    """The VERDICT-r2 gate: a ragged batch with NO explicit lengths."""
+    t = _ragged()
+    s = paddle.sequence_pool(t, pool_type="SUM")
+    assert np.allclose(s.numpy()[:, 0], [3.0, 12.0])     # 1+2, 3+4+5
+    m = paddle.sequence_pool(t, pool_type="AVERAGE")
+    assert np.allclose(m.numpy()[:, 0], [1.5, 4.0])
+    mx = paddle.sequence_pool(t, pool_type="MAX")
+    assert np.allclose(mx.numpy()[:, 0], [2.0, 5.0])
+    last = paddle.sequence_last_step(t)
+    assert np.allclose(last.numpy()[:, 0], [2.0, 5.0])
+
+
+def test_sequence_expand_by_lod_tensor():
+    x = paddle.to_tensor(np.asarray([[10.], [20.]], "float32"))
+    y = _ragged()                               # lengths 2, 3
+    out = paddle.sequence_expand(x, y)
+    # row 0 tiled twice, row 1 three times, padded to 3
+    assert np.allclose(out.numpy()[0, :2, 0], [10, 10])
+    assert np.allclose(out.numpy()[1, :, 0], [20, 20, 20])
+    assert out.lod == [[0, 2, 5]]               # output carries y's lod
+
+
+def test_lod_propagates_through_softmax_reverse():
+    t = _ragged()
+    sm = paddle.sequence_softmax(t)
+    assert sm.lod == t.lod
+    assert abs(float(sm.numpy()[0, :2, 0].sum()) - 1.0) < 1e-5
+    rv = paddle.sequence_reverse(t)
+    assert rv.lod == t.lod
+    assert np.allclose(rv.numpy()[0, :2, 0], [2, 1])
+    assert np.allclose(rv.numpy()[1, :, 0], [5, 4, 3])
+
+
+def test_sequence_op_without_lengths_or_lod_raises():
+    dense = paddle.to_tensor(np.ones((2, 3, 1), "float32"))
+    with pytest.raises(ValueError):
+        paddle.sequence_pool(dense)
+
+
+def test_lodarray_pickles_with_lod():
+    import pickle
+    arr = LoDArray.wrap(np.ones((2, 3)), [[0, 1, 3]])
+    rt = pickle.loads(pickle.dumps(arr))
+    assert isinstance(rt, LoDArray) and rt.lod == [[0, 1, 3]]
+    t = paddle.to_tensor(rt)
+    assert t.lod == [[0, 1, 3]]
+
+
+def test_dataloader_ragged_batching_carries_lod():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    class Ragged(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return (np.arange(i + 1, dtype="float32").reshape(i + 1, 1),
+                    np.int64(i % 2))
+
+    dl = DataLoader(Ragged(), batch_size=4, shuffle=False)
+    feats, labels = next(iter(dl))
+    t = feats if hasattr(feats, "lod") else paddle.to_tensor(feats)
+    lod = t.lod if hasattr(t, "lod") else None
+    assert lod == [[0, 1, 3, 6, 10]]
+    t = paddle.to_tensor(np.asarray(t.numpy() if hasattr(t, "numpy")
+                                    else t))
+    # feed straight into a sequence op via the lifted lod
+    lt = paddle.to_tensor(feats) if not isinstance(feats, paddle.Tensor) \
+        else feats
+    pooled = paddle.sequence_pool(lt, pool_type="SUM")
+    assert np.allclose(pooled.numpy()[:, 0], [0, 1, 3, 6])
+
+
+def test_industrial_dataset_ragged_slot_matches_lod_form():
+    """The .lens convention of the MultiSlot path and the lod form agree."""
+    lens = np.asarray([2, 3])
+    padded = np.zeros((2, 3), "int64")
+    padded[0, :2] = [7, 8]
+    padded[1, :] = [1, 2, 3]
+    t = paddle.to_tensor(padded.astype("float32")[..., None])
+    t.set_lod([[0, 2, 5]])
+    via_lod = paddle.sequence_pool(t, pool_type="SUM")
+    via_lens = paddle.sequence_pool(
+        paddle.to_tensor(padded.astype("float32")[..., None]),
+        lengths=paddle.to_tensor(lens))
+    assert np.allclose(via_lod.numpy(), via_lens.numpy())
+
+
+def test_dataloader_ragged_multiprocess_workers():
+    """LoD survives the worker→parent shm/queue transport (spec-encoded)."""
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    class Ragged(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return (np.arange(i + 1, dtype="float32").reshape(i + 1, 1),
+                    np.int64(i % 2))
+
+    dl = DataLoader(Ragged(), batch_size=4, shuffle=False, num_workers=2)
+    feats, _ = next(iter(dl))
+    assert feats.lod == [[0, 1, 3, 6, 10]]
+    pooled = paddle.sequence_pool(feats, pool_type="SUM")
+    assert np.allclose(pooled.numpy()[:, 0], [0, 1, 3, 6])
+
+
+def test_uniform_batch_at_ragged_leaf_still_carries_lod():
+    """Deterministic ragged detection: a coincidentally-uniform batch from
+    a variable-length dataset must still carry (full-length) LoD, or a
+    lengths-free sequence op would crash shuffle-order-dependently."""
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    class MostlyUniform(Dataset):
+        """Batches of 2: first batch uniform (lens 3,3), second ragged."""
+        lens = [3, 3, 2, 5]
+
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return np.ones((self.lens[i], 2), "float32") * i
+
+    dl = DataLoader(MostlyUniform(), batch_size=2, shuffle=False)
+    batches = list(dl)
+    first = batches[0]
+    assert first.lod == [[0, 3, 6]], first.lod     # full-length lod
+    assert batches[1].lod == [[0, 2, 7]]
+    # both feed a lengths-free sequence op
+    assert np.allclose(paddle.sequence_pool(first, pool_type="SUM")
+                       .numpy()[:, 0], [0.0, 3.0])
+
+
+def test_communicator_rejects_geo_mode():
+    from paddle_tpu.distributed.ps import LocalPsEndpoint, Communicator
+    with pytest.raises(ValueError):
+        Communicator(LocalPsEndpoint(), mode="geo")
+
+
+def test_tensor_init_lifts_lod_directly():
+    t = paddle.Tensor(LoDArray.wrap(np.ones((2, 3, 1)), [[0, 1, 3]]))
+    assert t.lod == [[0, 1, 3]]
