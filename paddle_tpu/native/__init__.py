@@ -23,7 +23,7 @@ _LOCK = threading.Lock()
 _LIBS = {}
 
 
-def _build(src_name: str, lib_base: str):
+def _build(src_name: str, lib_base: str, extra_cflags=(), extra_ldflags=()):
     src = os.path.join(_HERE, src_name)
     with open(src, "rb") as f:
         tag = hashlib.sha256(f.read()).hexdigest()[:12]
@@ -34,8 +34,9 @@ def _build(src_name: str, lib_base: str):
         # pid-unique temp: concurrent builders (two processes on a cold
         # cache) must not interleave writes into one .tmp
         tmp = f"{out}.tmp.{os.getpid()}"
-        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
-               "-o", tmp, "-lpthread", "-lrt"]
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+               + list(extra_cflags) + [src, "-o", tmp]
+               + list(extra_ldflags) + ["-lpthread", "-lrt"])
         subprocess.run(cmd, check=True, capture_output=True)
         os.replace(tmp, out)
     return out
@@ -53,3 +54,21 @@ def load(name: str = "ringbuffer"):
             lib = None
         _LIBS[name] = lib
         return lib
+
+
+def build_capi():
+    """Build the C inference ABI (capi.cpp — embeds CPython, so it needs
+    the interpreter's include/link flags). Returns the .so path; raises
+    when no toolchain. Consumers link this and call
+    pd_predictor_create/run_f32/destroy (inference/capi parity)."""
+    import sysconfig
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_python_version()
+    with _LOCK:
+        return _build(
+            "capi.cpp", "libpt_capi",
+            extra_cflags=[f"-I{inc}"],
+            extra_ldflags=[f"-L{libdir}", f"-Wl,-rpath,{libdir}",
+                           f"-lpython{ver}", "-ldl", "-lutil"])

@@ -1,0 +1,140 @@
+"""C inference ABI: a real C program links libpt_capi.so and classifies.
+
+Reference strategy parity: paddle/fluid/inference/capi/ + its C tests
+(inference/tests/api) — save a model, load it from C, run, check outputs.
+"""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _save_model(tmp_path):
+    """Train-free tiny classifier saved via static save_inference_model."""
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            out = static.nn.fc(x, 3, activation="softmax")
+        exe = static.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "model")
+        static.io.save_inference_model(d, ["x"], [out], exe,
+                                       main_program=main)
+        return d
+    finally:
+        paddle.disable_static()
+
+
+def _env():
+    """Subprocess env: paddle_tpu + site-packages reachable, the axon
+    sitecustomize EXCLUDED so JAX_PLATFORMS=cpu is honored (the plugin's
+    sitecustomize would pin the tunnel backend before any user code)."""
+    env = dict(os.environ)
+    py_paths = [REPO] + [p for p in sys.path
+                         if "site-packages" in p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join(py_paths)
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_capi_from_ctypes(tmp_path):
+    """Sanity: drive the ABI through ctypes in-process-style (subprocess to
+    keep this test's jax on CPU and isolated)."""
+    from paddle_tpu.native import build_capi
+    so = build_capi()
+    model = _save_model(tmp_path)
+    script = tmp_path / "drive.py"
+    script.write_text(f"""
+import ctypes, numpy as np
+lib = ctypes.CDLL({so!r})
+lib.pd_predictor_create.restype = ctypes.c_void_p
+lib.pd_predictor_create.argtypes = [ctypes.c_char_p]
+lib.pd_predictor_run_f32.restype = ctypes.c_longlong
+lib.pd_predictor_run_f32.argtypes = [
+    ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+    ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+    ctypes.POINTER(ctypes.c_float), ctypes.c_longlong]
+lib.pd_predictor_destroy.argtypes = [ctypes.c_void_p]
+lib.pd_last_error.restype = ctypes.c_char_p
+h = lib.pd_predictor_create({model!r}.encode())
+assert h, lib.pd_last_error()
+x = np.asarray(np.random.RandomState(0).randn(2, 4), np.float32)
+shape = (ctypes.c_longlong * 2)(2, 4)
+out = (ctypes.c_float * 6)()
+n = lib.pd_predictor_run_f32(h, x.ctypes.data_as(
+    ctypes.POINTER(ctypes.c_float)), shape, 2, out, 6)
+assert n == 6, (n, lib.pd_last_error())
+probs = np.ctypeslib.as_array(out).reshape(2, 3)
+assert np.allclose(probs.sum(1), 1.0, atol=1e-4), probs
+lib.pd_predictor_destroy(h)
+print("CTYPES-ABI-OK")
+""")
+    p = subprocess.run([sys.executable, str(script)], env=_env(),
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "CTYPES-ABI-OK" in p.stdout
+
+
+C_DEMO = r"""
+#include <stdio.h>
+#include <stdlib.h>
+
+/* the public ABI (capi.cpp) */
+extern void* pd_predictor_create(const char* model_path);
+extern long long pd_predictor_run_f32(void* h, const float* in,
+                                      const long long* shape, int ndim,
+                                      float* out, long long out_cap);
+extern void pd_predictor_destroy(void* h);
+extern const char* pd_last_error(void);
+
+int main(int argc, char** argv) {
+    void* pred = pd_predictor_create(argv[1]);
+    if (!pred) { fprintf(stderr, "create: %s\n", pd_last_error()); return 1; }
+    float x[8];
+    for (int i = 0; i < 8; ++i) x[i] = (float)(i % 3) * 0.5f - 0.5f;
+    long long shape[2] = {2, 4};
+    float out[6];
+    long long n = pd_predictor_run_f32(pred, x, shape, 2, out, 6);
+    if (n != 6) { fprintf(stderr, "run: %s\n", pd_last_error()); return 2; }
+    float s0 = out[0] + out[1] + out[2];
+    float s1 = out[3] + out[4] + out[5];
+    if (s0 < 0.99f || s0 > 1.01f || s1 < 0.99f || s1 > 1.01f) {
+        fprintf(stderr, "not a softmax: %f %f\n", s0, s1);
+        return 3;
+    }
+    /* argmax = the "classification" */
+    int cls = 0;
+    for (int i = 1; i < 3; ++i) if (out[i] > out[cls]) cls = i;
+    printf("C-DEMO-OK class=%d\n", cls);
+    pd_predictor_destroy(pred);
+    return 0;
+}
+"""
+
+
+def test_capi_from_c_program(tmp_path):
+    """The full story: compile a C program, link the ABI, classify."""
+    from paddle_tpu.native import build_capi
+    so = build_capi()
+    model = _save_model(tmp_path)
+    csrc = tmp_path / "demo.c"
+    csrc.write_text(C_DEMO)
+    exe = str(tmp_path / "demo")
+    subprocess.run(
+        ["gcc", str(csrc), "-o", exe, so, f"-Wl,-rpath,{os.path.dirname(so)}"],
+        check=True, capture_output=True)
+    p = subprocess.run([exe, model], env=_env(), capture_output=True,
+                       text=True, timeout=300)
+    assert p.returncode == 0, (p.stdout, p.stderr[-2000:])
+    assert "C-DEMO-OK" in p.stdout
