@@ -115,10 +115,13 @@ def bench_resnet50(on_tpu):
     from paddle_tpu.parallel import init_mesh, TrainStep
     from paddle_tpu.vision.models import resnet50, resnet18
 
+    # channels-last + batch 256: the MXU consumes NHWC conv operands
+    # directly and the larger batch amortizes the low-channel early stages
+    # (PERF.md "conv path"); input converts once at the model boundary
     if on_tpu:
-        model, batch, hw, iters = resnet50(), 64, 224, 10
+        model, batch, hw, iters = resnet50(data_format="NHWC"), 256, 224, 10
     else:
-        model, batch, hw, iters = resnet18(), 4, 32, 2
+        model, batch, hw, iters = resnet18(data_format="NHWC"), 4, 32, 2
 
     mesh = init_mesh({"dp": -1})
     opt = paddle.optimizer.Momentum(parameters=model.parameters(),
@@ -129,7 +132,7 @@ def bench_resnet50(on_tpu):
     rng = np.random.RandomState(0)
     # stage inputs on device outside the timed loop: per-step H2D of a
     # 224px batch over the tunnel would otherwise dominate the step
-    x = jnp.asarray(rng.randn(batch, 3, hw, hw).astype("float32"))
+    x = jnp.asarray(rng.randn(batch, hw, hw, 3).astype("float32"))
     y = jnp.asarray(rng.randint(0, 1000, (batch,)))
     float(step((x,), y))  # compile + warmup
 
@@ -231,8 +234,10 @@ def bench_transformer_big(on_tpu):
 # -- 5. Wide&Deep CTR over PS sparse tables ----------------------------------
 
 def bench_wide_deep(on_tpu):
+    import tempfile
     from paddle_tpu.rec.wide_deep import (WideDeep, WideDeepTrainer,
-                                          synthetic_ctr_batch)
+                                          write_ctr_files, ctr_dataset,
+                                          batch_from_feed)
 
     # CTR-realistic large batch: the sync PS loop is tunnel-RTT bound, and
     # Criteo-scale jobs batch in the tens of thousands anyway
@@ -242,7 +247,17 @@ def bench_wide_deep(on_tpu):
     # thread, overlapping the next step's pull+compute (communicator.h
     # AsyncCommunicator parity)
     trainer = WideDeepTrainer(model, async_push=True)
-    ids, dense, labels = synthetic_ctr_batch(batch)
+    # the industrial data path: MultiSlot files → InMemoryDataset →
+    # local_shuffle → feed dicts (data_set.h DatasetImpl flow); parsing
+    # happens host-side outside the timed loop, as the reference's
+    # load_into_memory does
+    with tempfile.TemporaryDirectory() as d:
+        files = write_ctr_files(d, batch, n_files=4)
+        ds = ctr_dataset(files, batch_size=batch)
+        ds.load_into_memory()
+        ds.local_shuffle()
+        feed = next(iter(ds))
+    ids, dense, labels = batch_from_feed(feed)
     trainer.step(ids, dense, labels)  # compile + warmup
     trainer.flush()
 

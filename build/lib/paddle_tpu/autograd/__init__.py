@@ -1,0 +1,81 @@
+"""paddle.autograd parity: backward/grad entry points + hooks.
+
+Reference parity: python/paddle/autograd/ (backward, grad via
+PartialGradEngine — imperative/partial_grad_engine.cc) and PyLayer.
+"""
+from __future__ import annotations
+
+from ..framework.core import no_grad_guard as no_grad  # noqa: F401
+from ..framework.core import set_grad_enabled, enable_grad_guard as enable_grad  # noqa: F401
+from ..framework import grad  # noqa: F401
+from ..framework.autograd import run_backward
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        run_backward(t, g, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """paddle.autograd.PyLayer parity: custom forward/backward pairs.
+
+    TPU note: backward runs eagerly on tape traversal; for a compiled custom
+    gradient inside jitted paths use jax.custom_vjp in a primitive instead.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..framework.tensor import Tensor
+        from ..framework.autograd import GradNode
+        from ..framework import core
+        ctx = PyLayerContext()
+        with core.no_grad_guard():
+            out = cls.forward(ctx, *args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = core.grad_enabled() and any(
+            not a.stop_gradient for a in tensor_args)
+        results = tuple(Tensor(o._value if isinstance(o, Tensor) else o,
+                               stop_gradient=not needs_grad) for o in outs)
+        if needs_grad:
+            def grad_fn(cts, *primals):
+                with core.no_grad_guard():
+                    gs = cls.backward(ctx, *[Tensor(c) for c in cts])
+                gs = gs if isinstance(gs, (tuple, list)) else (gs,)
+                return tuple(g._value if isinstance(g, Tensor) else g
+                             for g in gs)
+            node = GradNode(
+                cls.__name__, grad_fn,
+                tuple(a._value for a in tensor_args),
+                tuple(tensor_args),
+                [(list(r._value.shape), r._value.dtype) for r in results])
+            for i, r in enumerate(results):
+                r._node = node
+                r._out_index = i
+                r.is_leaf = False
+        return results[0] if len(results) == 1 else results

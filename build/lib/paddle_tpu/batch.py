@@ -1,0 +1,28 @@
+"""Minibatch reader combinator.
+
+Reference parity: python/paddle/batch.py (paddle.batch / fluid.io.batch):
+wraps a sample-level reader generator into a batch-level one.
+"""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Return a reader yielding lists of ``batch_size`` samples from
+    ``reader``; the final short batch is kept unless ``drop_last``."""
+    if batch_size <= 0:
+        raise ValueError("batch_size should be a positive integer, "
+                         f"got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

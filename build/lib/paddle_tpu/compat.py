@@ -1,0 +1,79 @@
+"""Python 2/3 compatibility helpers.
+
+Reference parity: python/paddle/compat.py (to_text/to_bytes/round/
+floor_division/get_exception_message). Python-3-only build, so the helpers
+are thin, but scripts written against the reference keep working.
+"""
+from __future__ import annotations
+
+import builtins
+import math
+
+__all__ = ["long_type", "to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+int_type = int
+long_type = int
+
+
+def _convert(obj, conv, inplace):
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            for i in range(len(obj)):
+                obj[i] = _convert(obj[i], conv, inplace)
+            return obj
+        return [_convert(o, conv, False) for o in obj]
+    if isinstance(obj, set):
+        converted = {_convert(o, conv, False) for o in obj}
+        if inplace:
+            obj.clear()
+            obj.update(converted)
+            return obj
+        return converted
+    if isinstance(obj, dict):
+        converted = {_convert(k, conv, False): _convert(v, conv, False)
+                     for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(converted)
+            return obj
+        return converted
+    return conv(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Decode bytes (recursively through list/set/dict) into str."""
+    def conv(o):
+        if isinstance(o, bytes):
+            return o.decode(encoding)
+        return o
+    return _convert(obj, conv, inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Encode str (recursively through list/set/dict) into bytes."""
+    def conv(o):
+        if isinstance(o, str):
+            return o.encode(encoding)
+        return o
+    return _convert(obj, conv, inplace)
+
+
+def round(x, d=0):
+    """Python-2-style round: halfway cases away from zero."""
+    if x is None:
+        return None
+    p = 10 ** d
+    if x >= 0:
+        return float(math.floor((x * p) + 0.5)) / p
+    return float(math.ceil((x * p) - 0.5)) / p
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

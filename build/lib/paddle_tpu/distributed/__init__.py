@@ -1,0 +1,23 @@
+"""paddle.distributed facade over the TPU SPMD engine (paddle_tpu.parallel).
+
+Reference parity: python/paddle/distributed/ — collective funcs
+(collective.py:157), init_parallel_env (parallel.py:57), fleet package,
+launch CLI (fleet/launch.py:321), spawn (spawn.py:276).  The NCCL ring world
+is replaced by a jax.sharding.Mesh; ring_id ≙ mesh axis / replica group.
+"""
+from .parallel_env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, all_reduce, all_gather, reduce, broadcast, scatter,
+    reduce_scatter, alltoall, send, recv, send_recv, shift, barrier,
+    new_group, get_group, wait, split,
+)
+from .parallel import DataParallel  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import fleet  # noqa: F401
+from ..parallel import init_mesh, get_mesh  # noqa: F401
+
+from .dataset import (  # noqa: F401
+    DatasetBase, InMemoryDataset, QueueDataset,
+)

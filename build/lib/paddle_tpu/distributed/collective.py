@@ -1,0 +1,371 @@
+"""Collective communication ops.
+
+Reference parity: python/paddle/distributed/collective.py (all_reduce :157,
+broadcast, all_gather, scatter, barrier) and the C++ collective op family
+(paddle/fluid/operators/collective/: c_allreduce_op.h:157 ncclAllReduce,
+c_broadcast, c_allgather, c_reducescatter, send_v2/recv_v2, alltoall).
+
+TPU-native semantics: a collective is *communication inside a compiled SPMD
+program*.  Inside a traced region whose mesh axis is bound (shard_map /
+pjit-manual), these functions lower straight to XLA collectives on ICI
+(lax.psum / all_gather / ppermute / all_to_all) — the ring_id of the
+reference becomes the mesh axis name carried by the Group.  Called eagerly
+in a single-process world they are the identity (world_size==1 per process),
+matching the reference's behavior for nranks==1
+(collective.py:190 returns early).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.tensor import Tensor
+from ..parallel.mesh import get_mesh, DP_AXIS
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communicator: set of ranks + the mesh axis its collectives ride.
+
+    ≙ ring_id → NCCLComm of collective_helper.h:63; here the "comm" is just
+    the axis name resolved inside the compiled program.
+    """
+
+    def __init__(self, ranks: Optional[List[int]] = None, axis: str = DP_AXIS,
+                 gid: int = 0):
+        self.ranks = ranks
+        self.axis = axis
+        self.id = gid
+
+    @property
+    def nranks(self):
+        if self.ranks is not None:
+            return len(self.ranks)
+        return get_mesh().shape.get(self.axis, 1)
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if self.ranks else rank
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis}, ranks={self.ranks})"
+
+
+_default_group = Group(axis=DP_AXIS, gid=0)
+_groups = {0: _default_group}
+_next_gid = [1]
+
+
+def new_group(ranks=None, backend=None, axis: str = None):
+    """c_comm_init / paddle.distributed.new_group parity: register a
+    communicator.  ``axis`` names the mesh axis the group's collectives use
+    (defaults to dp)."""
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(ranks=list(ranks) if ranks else None,
+              axis=axis or DP_AXIS, gid=gid)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    return _groups.get(gid, _default_group)
+
+
+def _axis_bound(axis: str) -> bool:
+    """True if we're inside a traced region with this named axis bound."""
+    try:
+        lax.axis_index(axis)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _rewrap(x, out):
+    if isinstance(x, Tensor):
+        x._value = out
+        return x
+    return Tensor(out)
+
+
+def _is_subgroup(g: Group) -> bool:
+    """True if g.ranks is a proper subset of its mesh axis."""
+    if g.ranks is None:
+        return False
+    axis_size = get_mesh().shape.get(g.axis, 1)
+    return len(g.ranks) < axis_size
+
+
+def _member_mask(g: Group):
+    """Bool scalar (traced): is this rank a member of the group?"""
+    idx = lax.axis_index(g.axis)
+    return jnp.isin(idx, jnp.asarray(g.ranks, jnp.int32))
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    """c_allreduce_{sum,max,min,prod} (collective/c_allreduce_op.h).
+
+    Subgroups (new_group(ranks=...) covering a proper subset of the axis)
+    are honored by masking non-members with the reduction identity before
+    the axis-wide collective — members get the ring-scoped result the
+    reference's per-ring c_allreduce computes; values on non-member ranks
+    are undefined there and here come out as the subgroup result.
+    """
+    g = group or _default_group
+    x = _unwrap(tensor)
+    if _axis_bound(g.axis):
+        sub = _is_subgroup(g)
+        if sub:
+            member = _member_mask(g)
+            if op in (ReduceOp.MAX, ReduceOp.MIN):
+                # reduction identities in the tensor's OWN dtype (float
+                # ±inf / integer iinfo bounds) — no promotion through
+                # float32, which would corrupt int values above 2^24
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    lo, hi = -jnp.inf, jnp.inf
+                else:
+                    info = jnp.iinfo(x.dtype)
+                    lo, hi = info.min, info.max
+                lo = jnp.asarray(lo, x.dtype)
+                hi = jnp.asarray(hi, x.dtype)
+        if op == ReduceOp.SUM:
+            out = lax.psum(jnp.where(member, x, 0) if sub else x, g.axis)
+        elif op == ReduceOp.MAX:
+            out = lax.pmax(jnp.where(member, x, lo) if sub else x, g.axis)
+        elif op == ReduceOp.MIN:
+            out = lax.pmin(jnp.where(member, x, hi) if sub else x, g.axis)
+        elif op == ReduceOp.AVG:
+            if sub:
+                out = lax.psum(jnp.where(member, x, 0), g.axis) / len(g.ranks)
+            else:
+                out = lax.pmean(x, g.axis)
+        elif op == ReduceOp.PROD:
+            # no native product-reduce in XLA collectives; gather then
+            # multiply (log/exp would NaN on non-positive inputs)
+            xg = jnp.where(member, x, jnp.ones_like(x)) if sub else x
+            out = jnp.prod(lax.all_gather(xg, g.axis), axis=0)
+        else:
+            raise ValueError(f"unknown ReduceOp {op}")
+    else:
+        out = x  # single-rank world: identity (collective.py:190 parity)
+    return _rewrap(tensor, out)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """c_reduce_*: allreduce then keep on dst (XLA has no rooted reduce;
+    GSPMD would DCE the unused replicas)."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """c_allgather (collective/c_allgather_op.cc): concat along dim 0."""
+    g = group or _default_group
+    x = _unwrap(tensor)
+    if _axis_bound(g.axis):
+        if _is_subgroup(g):
+            raise NotImplementedError(
+                "all_gather over a proper subgroup of a mesh axis is not "
+                "supported; create the group over a dedicated mesh axis "
+                "(new_group(axis=...)) so the collective is ring-scoped")
+        gathered = lax.all_gather(x, g.axis)  # [n, ...]
+        n = gathered.shape[0]
+        if isinstance(tensor_list, list):
+            tensor_list.extend(Tensor(gathered[i]) for i in range(n))
+        return Tensor(gathered.reshape((-1,) + x.shape[1:]))
+    if isinstance(tensor_list, list):
+        tensor_list.append(Tensor(x))
+    return Tensor(x)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """c_reducescatter: psum_scatter along dim 0."""
+    g = group or _default_group
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        x = jnp.concatenate([_unwrap(t) for t in src], axis=0)
+    else:
+        x = _unwrap(src)
+    if _axis_bound(g.axis):
+        if _is_subgroup(g):
+            raise NotImplementedError(
+                "reduce_scatter over a proper subgroup of a mesh axis is not "
+                "supported; use a dedicated mesh axis for the group")
+        out = lax.psum_scatter(x, g.axis, scatter_dimension=0, tiled=True)
+    else:
+        out = x
+    return _rewrap(tensor, out)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """c_broadcast: inside SPMD all replicas already hold src's value after
+    the compiler inserts the collective; expressed as select + psum so the
+    data provably originates from ``src``."""
+    g = group or _default_group
+    x = _unwrap(tensor)
+    if _axis_bound(g.axis):
+        idx = lax.axis_index(g.axis)
+        # src is the GLOBAL rank (= axis index), for full-axis groups and
+        # subgroups alike; only the src rank contributes to the psum, so a
+        # subgroup broadcast is naturally ring-scoped.
+        masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+        out = lax.psum(masked, g.axis)
+    else:
+        out = x
+    return _rewrap(tensor, out)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """c_scatter: src rank's list is distributed; SPMD form = dynamic slice
+    of the (replicated) stacked input by axis index."""
+    g = group or _default_group
+    if tensor_list:
+        stacked = jnp.stack([_unwrap(t) for t in tensor_list])
+    else:
+        stacked = _unwrap(tensor)[None]
+    if _axis_bound(g.axis):
+        idx = lax.axis_index(g.axis)
+        out = lax.dynamic_index_in_dim(stacked, idx, keepdims=False)
+    else:
+        out = stacked[0]
+    return _rewrap(tensor, out)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """AllToAll (Ulysses-style sequence exchange rides this)."""
+    g = group or _default_group
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = jnp.stack([_unwrap(t) for t in in_tensor_list])
+    else:
+        x = _unwrap(in_tensor_list)
+    if _axis_bound(g.axis):
+        out = lax.all_to_all(x, g.axis, split_axis=0, concat_axis=0,
+                             tiled=False)
+    else:
+        out = x
+    outs = [Tensor(out[i]) for i in range(out.shape[0])]
+    if isinstance(out_tensor_list, list):
+        out_tensor_list.extend(outs)
+    return outs
+
+
+def send_recv(tensor, src, dst, group=None):
+    """Matched point-to-point pair as ONE collective-permute: the value held
+    by ``src`` lands on ``dst`` (others receive zeros).  This is the XLA form
+    of a send_v2/recv_v2 pair — both sides of the exchange must be in the
+    same compiled program."""
+    g = group or _default_group
+    x = _unwrap(tensor)
+    if _axis_bound(g.axis):
+        return Tensor(lax.ppermute(x, g.axis, [(src, dst)]))
+    return Tensor(x)
+
+
+def shift(tensor, offset=1, group=None):
+    """Uniform ring shift by ``offset`` (rank i → rank i+offset): the SPMD
+    translation of the pipeline boundary pattern where every stage sends to
+    the next and receives from the previous (optimizer.py:4178's
+    send_v2/recv_v2 insertion).  Used by parallel.pipeline."""
+    g = group or _default_group
+    x = _unwrap(tensor)
+    if _axis_bound(g.axis):
+        n = g.nranks
+        perm = [(i, (i + offset) % n) for i in range(n)]
+        return Tensor(lax.ppermute(x, g.axis, perm))
+    return Tensor(x)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """send_v2 parity. XLA has no one-sided send: inside a traced SPMD region
+    a send must be matched with its recv as one collective-permute — call
+    ``send_recv(t, src, dst)`` or ``shift(t, offset)`` instead.  Eagerly in a
+    1-rank world this is the identity (reference returns early for
+    nranks==1)."""
+    g = group or _default_group
+    if _axis_bound(g.axis):
+        raise RuntimeError(
+            "one-sided send() cannot be expressed inside a compiled SPMD "
+            "program; use paddle_tpu.distributed.send_recv(tensor, src, dst) "
+            "or shift(tensor, offset) which fuse the send/recv pair into one "
+            "collective-permute")
+    return Tensor(_unwrap(tensor))
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    """recv_v2 parity — see send()."""
+    g = group or _default_group
+    if _axis_bound(g.axis):
+        raise RuntimeError(
+            "one-sided recv() cannot be expressed inside a compiled SPMD "
+            "program; use paddle_tpu.distributed.send_recv(tensor, src, dst) "
+            "or shift(tensor, offset)")
+    return _rewrap(tensor, _unwrap(tensor))
+
+
+def barrier(group=None):
+    """operators/collective/barrier_op: a 1-element psum everyone waits on."""
+    g = group or _default_group
+    if _axis_bound(g.axis):
+        lax.psum(jnp.ones(()), g.axis)
+        return
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """c_sync_{calc,comm}_stream: XLA programs are ordered; eager arrays are
+    awaited explicitly."""
+    x = _unwrap(tensor)
+    if not isinstance(x, jax.core.Tracer):
+        jax.block_until_ready(x)
+    return tensor
+
+
+# -- model (tensor) parallel API --------------------------------------------
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split (collective.py:566): build a row/column-
+    sharded linear or vocab-sharded embedding.
+
+    TPU-native: rather than manually slicing weights per rank and inserting
+    allreduce ops (_parallel_linear collective.py:492), we create the full
+    layer and annotate its weight with a PartitionSpec over the mp axis —
+    GSPMD partitions the matmul and places the reduction on ICI.
+    """
+    from .. import nn
+    from ..parallel.api import shard_parameter
+    from jax.sharding import PartitionSpec as P
+
+    if operation == "linear":
+        in_f, out_f = size
+        layer = nn.Linear(in_f, out_f, weight_attr=weight_attr,
+                          bias_attr=bias_attr)
+        if axis == 0:  # row parallel: shard in_features
+            shard_parameter(layer.weight, P("mp", None))
+        else:          # column parallel: shard out_features
+            shard_parameter(layer.weight, P(None, "mp"))
+            if layer.bias is not None:
+                shard_parameter(layer.bias, P("mp"))
+        return layer(x) if isinstance(x, Tensor) else layer
+    elif operation == "embedding":
+        vocab, emb = size
+        layer = nn.Embedding(vocab, emb, weight_attr=weight_attr)
+        shard_parameter(layer.weight, P("mp", None))  # vocab-sharded
+        return layer(x) if isinstance(x, Tensor) else layer
+    raise ValueError(f"unsupported split operation {operation!r}")

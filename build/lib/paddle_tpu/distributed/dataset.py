@@ -1,0 +1,335 @@
+"""Industrial file-based datasets: InMemoryDataset / QueueDataset.
+
+Reference parity: paddle/fluid/framework/data_set.h:43 (DatasetImpl,
+GlobalShuffle :205), data_feed.h:305 (InMemoryDataFeed/MultiSlotDataFeed),
+data_feed.proto (MultiSlotDesc: slot name/type/is_dense/shape), and the
+Python wrappers python/paddle/distributed/fleet/dataset/dataset.py
+(DatasetBase/InMemoryDataset/QueueDataset) + fluid DatasetFactory.
+
+The MultiSlot text format, per line, slot-by-slot in declared order:
+``<n> v1 ... vn`` — n values for that slot (uint64 ids for sparse slots,
+floats for dense ones).
+
+TPU-shape: the parsed records batch into feed dicts that feed
+``Executor.train_from_dataset`` (the lax.scan epoch) and the PS trainer —
+host-side Python/numpy does the parsing (the reference's parsing threads
+are C++ for Python-2-era speed; numpy vectorized parsing holds the same
+role here), while the chip consumes one pre-stacked epoch.
+
+Global shuffle exchanges records across workers through the fleet TCP
+store (gloo_wrapper.h rendezvous parity): every worker buckets its records
+by ``hash(record) % world``, publishes each outgoing bucket, barriers, and
+collects its inbound buckets.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+class _Slot:
+    __slots__ = ("name", "dtype", "is_dense", "shape")
+
+    def __init__(self, name, dtype="uint64", is_dense=False, shape=(1,)):
+        self.name = name
+        self.dtype = dtype
+        self.is_dense = is_dense
+        self.shape = tuple(shape)
+
+
+class DatasetBase:
+    """dataset.py DatasetBase parity: slot/file/batch configuration."""
+
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.filelist: List[str] = []
+        self.pipe_command = "cat"
+        self.use_var_names: List[str] = []
+        self._slots: List[_Slot] = []
+        self.queue_num = None
+        self.drop_last = False
+
+    # -- 2.0 style ----------------------------------------------------------
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command="cat",
+             input_type=0, fs_name="", fs_ugi="", download_cmd="cat",
+             queue_num=None, **kwargs):
+        self.set_batch_size(batch_size)
+        self.set_thread(thread_num)
+        if use_var:
+            self.set_use_var(use_var)
+        self.set_pipe_command(pipe_command)
+        self.queue_num = queue_num
+        return self
+
+    # -- fluid setters ------------------------------------------------------
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = max(1, int(thread_num))
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_pipe_command(self, pipe_command):
+        self.pipe_command = pipe_command
+
+    def set_use_var(self, var_list):
+        """Declare the slots from static Variables (name/dtype/shape/
+        lod_level) or plain names (sparse uint64 slots)."""
+        self.use_var_names = []
+        self._slots = []
+        for v in var_list:
+            if isinstance(v, str):
+                self.use_var_names.append(v)
+                self._slots.append(_Slot(v))
+                continue
+            name = v.name
+            dtype = str(getattr(v, "dtype", "int64") or "int64")
+            lod = getattr(v, "lod_level", 0)
+            dense = (lod == 0 and "float" in dtype)
+            shape = [d for d in (getattr(v, "shape", None) or [1])
+                     if d not in (None, -1)]
+            self.use_var_names.append(name)
+            self._slots.append(_Slot(
+                name, "float" if "float" in dtype else "uint64",
+                is_dense=dense, shape=shape or (1,)))
+        return self
+
+    def set_slots(self, slots):
+        """Explicit slot config: [{'name','type','is_dense','shape'}, ...]
+        (data_feed.proto MultiSlotDesc analogue)."""
+        self._slots = [_Slot(s["name"], s.get("type", "uint64"),
+                             s.get("is_dense", False),
+                             s.get("shape", (1,))) for s in slots]
+        self.use_var_names = [s.name for s in self._slots]
+        return self
+
+    # -- parsing ------------------------------------------------------------
+    def _read_lines(self, path):
+        if self.pipe_command and self.pipe_command != "cat":
+            # pipe_command parity: each file streams through the user's
+            # preprocessor (data_feed.h pipe reader)
+            proc = subprocess.Popen(
+                f"{self.pipe_command} < {path}", shell=True,
+                stdout=subprocess.PIPE, text=True)
+            for line in proc.stdout:
+                yield line
+            proc.wait()
+        else:
+            with open(path) as f:
+                yield from f
+
+    def _parse_file(self, path):
+        """One MultiSlot text file -> list of records
+        (record = tuple of np arrays, one per slot in declared order)."""
+        if not self._slots:
+            raise ValueError("no slots declared: call set_use_var / "
+                             "set_slots before loading")
+        records = []
+        for line in self._read_lines(path):
+            toks = line.split()
+            if not toks:
+                continue
+            pos = 0
+            rec = []
+            for slot in self._slots:
+                n = int(toks[pos])
+                pos += 1
+                vals = toks[pos:pos + n]
+                pos += n
+                if slot.dtype == "float":
+                    rec.append(np.asarray(vals, np.float32))
+                else:
+                    rec.append(np.asarray(vals, np.int64))
+            records.append(tuple(rec))
+        return records
+
+    def _parse_all(self, filelist):
+        """Multi-threaded parse (data_set.cc CreateReaders thread pool)."""
+        if len(filelist) <= 1 or self.thread_num <= 1:
+            out = []
+            for p in filelist:
+                out.extend(self._parse_file(p))
+            return out
+        results = [None] * len(filelist)
+
+        def work(i, p):
+            results[i] = self._parse_file(p)
+
+        threads = []
+        for i, p in enumerate(filelist):
+            t = threading.Thread(target=work, args=(i, p), daemon=True)
+            t.start()
+            threads.append(t)
+            while len([x for x in threads if x.is_alive()]) >= self.thread_num:
+                threads[0].join(0.01)
+                threads = [x for x in threads if x.is_alive()]
+        for t in threads:
+            t.join()
+        out = []
+        for r in results:
+            out.extend(r or [])
+        return out
+
+    # -- batching -----------------------------------------------------------
+    def _batches_from(self, records):
+        """Yield feed dicts {slot_name: ndarray}. Sparse slots with equal
+        per-record counts stack densely; ragged ones pad and add a
+        ``<name>.lens`` entry (the lengths-based LoD carrier)."""
+        B = self.batch_size
+        for i in range(0, len(records), B):
+            chunk = records[i:i + B]
+            if len(chunk) < B and self.drop_last:
+                continue
+            feed = {}
+            for si, slot in enumerate(self._slots):
+                cols = [r[si] for r in chunk]
+                lens = [len(c) for c in cols]
+                if slot.is_dense or len(set(lens)) == 1:
+                    feed[slot.name] = np.stack(cols)
+                else:
+                    m = max(lens)
+                    pad = np.zeros((len(chunk), m), cols[0].dtype)
+                    for j, c in enumerate(cols):
+                        pad[j, :len(c)] = c
+                    feed[slot.name] = pad
+                    feed[slot.name + ".lens"] = np.asarray(lens, np.int64)
+            yield feed
+
+
+class InMemoryDataset(DatasetBase):
+    """data_set.h DatasetImpl<InMemoryDataFeed> parity: load, shuffle
+    (locally or across the fleet), iterate."""
+
+    def __init__(self):
+        super().__init__()
+        self._records: List[tuple] = []
+        self._loaded = False
+        self._preload_thread: Optional[threading.Thread] = None
+        self._seed = 0
+
+    # -- loading ------------------------------------------------------------
+    def load_into_memory(self):
+        self._records = self._parse_all(self.filelist)
+        self._loaded = True
+
+    def preload_into_memory(self, thread_num=None):
+        if thread_num:
+            self.set_thread(thread_num)
+        self._preload_thread = threading.Thread(
+            target=self.load_into_memory, daemon=True)
+        self._preload_thread.start()
+
+    def wait_preload_done(self):
+        if self._preload_thread is not None:
+            self._preload_thread.join()
+            self._preload_thread = None
+
+    def release_memory(self):
+        self._records = []
+        self._loaded = False
+
+    def get_memory_data_size(self, fleet=None):
+        n = len(self._records)
+        if fleet is not None:
+            return int(fleet.util.all_reduce(np.asarray(n), "sum"))
+        return n
+
+    def get_shuffle_data_size(self, fleet=None):
+        return self.get_memory_data_size(fleet)
+
+    # -- shuffling ----------------------------------------------------------
+    def set_shuffle_seed(self, seed):
+        self._seed = int(seed)
+
+    def local_shuffle(self):
+        rng = np.random.RandomState(self._seed or None)
+        rng.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """DatasetImpl::GlobalShuffle (:205): redistribute records across
+        all workers by record hash, through the fleet TCP store."""
+        self.local_shuffle()
+        if fleet is None:
+            return
+        # accept the fleet module facade or a Fleet instance
+        if not hasattr(fleet, "_role_maker") and hasattr(fleet, "_fleet"):
+            fleet = fleet._fleet
+        rm = fleet._role_maker
+        world = fleet.worker_num()
+        me = fleet.worker_index()
+        if world <= 1:
+            return
+        store = rm._ensure_store()
+        # per-worker stream: identical seeds across workers would correlate
+        # the destination pattern and skew the redistribution
+        rng = np.random.RandomState(self._seed + 12345 + me * 9973)
+        dest = rng.randint(0, world, size=len(self._records))
+        buckets = [[] for _ in range(world)]
+        for r, d in zip(self._records, dest):
+            buckets[d].append(r)
+        gen = getattr(self, "_shuffle_gen", 0)
+        self._shuffle_gen = gen + 1
+        for d in range(world):
+            store.set(f"__gshuf/{gen}/{me}/{d}",
+                      pickle.dumps(buckets[d],
+                                   protocol=pickle.HIGHEST_PROTOCOL))
+        store.barrier(f"__gshuf/{gen}", world)
+        mine = []
+        for src in range(world):
+            blob = store.get(f"__gshuf/{gen}/{src}/{me}")
+            mine.extend(pickle.loads(blob))
+        rng2 = np.random.RandomState(self._seed + 777 + me)
+        rng2.shuffle(mine)
+        self._records = mine
+        store.barrier(f"__gshuf_done/{gen}", world)
+        if me == 0:
+            store.delete_prefix(f"__gshuf/{gen}/")
+
+    # -- iteration ----------------------------------------------------------
+    def __iter__(self):
+        if not self._loaded:
+            raise RuntimeError("call load_into_memory() first")
+        return self._batches_from(self._records)
+
+    def __len__(self):
+        B = self.batch_size
+        n = len(self._records)
+        return n // B if self.drop_last else (n + B - 1) // B
+
+
+class QueueDataset(DatasetBase):
+    """data_set.h DatasetImpl<MultiSlotDataFeed> parity: streaming reads,
+    no memory residency, no shuffle (the reference raises the same way)."""
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset streams from files; local_shuffle is only "
+            "supported by InMemoryDataset (data_set.cc parity)")
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        raise NotImplementedError(
+            "QueueDataset streams from files; global_shuffle is only "
+            "supported by InMemoryDataset (data_set.cc parity)")
+
+    def __iter__(self):
+        def gen():
+            buf = []
+            for path in self.filelist:
+                buf.extend(self._parse_file(path))
+                while len(buf) >= self.batch_size:
+                    yield next(iter(self._batches_from(
+                        buf[:self.batch_size])))
+                    buf = buf[self.batch_size:]
+            if buf and not self.drop_last:
+                yield next(iter(self._batches_from(buf)))
+        return gen()

@@ -1,0 +1,36 @@
+"""paddle.distributed.fleet package facade.
+
+Reference parity: python/paddle/distributed/fleet/__init__.py — module-level
+functions delegate to the Fleet singleton (fleet_base.py:63).
+"""
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, UserDefinedRoleMaker, Role,
+)
+from .fleet_base import Fleet, DistributedOptimizer, fleet as _fleet  # noqa: F401
+
+init = _fleet.init
+is_first_worker = _fleet.is_first_worker
+worker_index = _fleet.worker_index
+worker_num = _fleet.worker_num
+is_worker = _fleet.is_worker
+worker_endpoints = _fleet.worker_endpoints
+server_num = _fleet.server_num
+server_index = _fleet.server_index
+server_endpoints = _fleet.server_endpoints
+is_server = _fleet.is_server
+barrier_worker = _fleet.barrier_worker
+distributed_optimizer = _fleet.distributed_optimizer
+distributed_model = _fleet.distributed_model
+minimize = _fleet.minimize
+save_persistables = _fleet.save_persistables
+init_server = _fleet.init_server
+run_server = _fleet.run_server
+init_worker = _fleet.init_worker
+stop_worker = _fleet.stop_worker
+
+
+def __getattr__(name):
+    if name == "util":
+        return _fleet.util
+    raise AttributeError(name)

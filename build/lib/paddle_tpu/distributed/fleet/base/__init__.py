@@ -1,0 +1,2 @@
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401

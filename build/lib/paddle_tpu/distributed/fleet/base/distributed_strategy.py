@@ -1,0 +1,119 @@
+"""DistributedStrategy: the strategy switchboard.
+
+Reference parity: python/paddle/distributed/fleet/base/distributed_strategy.py:101
+wrapping paddle/fluid/framework/distributed_strategy.proto (RecomputeConfig
+:25, ShardingConfig :27, AMPConfig :33, GradientMergeConfig :55, Lars/Lamb
+:66-77, pipeline/a_sync fields).  Kept as a plain serializable object — the
+proto indirection buys nothing on TPU — but field names match the reference
+so user scripts port unchanged.
+
+Strategy → engine mapping (applied by fleet.distributed_optimizer /
+TrainStep):
+  amp             → bf16 compute_dtype (fp16+loss-scaling optional)
+  recompute       → jax.checkpoint over the step (remat=True)
+  sharding        → ZeRO-sharded optimizer state layouts (zero=stage)
+  pipeline        → pp mesh axis + microbatch schedule
+  gradient_merge  → accumulate_steps in the compiled step
+  tensor_parallel → mp mesh axis degree
+  lamb/lars       → optimizer swap
+  hierarchical_allreduce → ICI/DCN two-level mesh (multi-slice)
+"""
+from __future__ import annotations
+
+import copy
+import json
+
+
+_DEFAULTS = {
+    "amp": False,
+    "amp_configs": {
+        "init_loss_scaling": 32768.0, "incr_every_n_steps": 1000,
+        "decr_every_n_nan_or_inf": 2, "incr_ratio": 2.0, "decr_ratio": 0.5,
+        "use_dynamic_loss_scaling": True, "custom_white_list": [],
+        "custom_black_list": [], "use_pure_bf16": True,
+    },
+    "recompute": False,
+    "recompute_configs": {"checkpoints": []},
+    "sharding": False,
+    "sharding_configs": {"fuse_broadcast_MB": 32.0, "hybrid_dp": False,
+                         "sharding_degree": 1, "stage": 1},
+    "pipeline": False,
+    "pipeline_configs": {"micro_batch": 1, "accumulate_steps": 1,
+                         "schedule_mode": "1F1B", "pp_degree": 1},
+    "tensor_parallel": False,
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1},
+    "sequence_parallel": False,
+    "sequence_parallel_configs": {"sp_degree": 1},
+    "gradient_merge": False,
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "lamb": False,
+    "lamb_configs": {"lamb_weight_decay": 0.01,
+                     "exclude_from_weight_decay": []},
+    "lars": False,
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                     "epsilon": 0.0, "exclude_from_weight_decay": []},
+    "localsgd": False,
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "dgc": False,
+    "dgc_configs": {"rampup_begin_step": 0},
+    "a_sync": False,
+    "a_sync_configs": {"k_steps": -1, "max_merge_var_num": 1,
+                       "send_queue_size": 16, "independent_recv_thread": False,
+                       "thread_pool_size": 1, "send_wait_times": 1,
+                       "runtime_split_send_recv": False, "launch_barrier": True,
+                       "heter_worker_device_guard": "cpu"},
+    "hierarchical_allreduce": False,
+    "hierarchical_allreduce_inter_nranks": 8,
+    "fuse_all_reduce_ops": True,
+    "fuse_grad_size_in_MB": 32,
+    "nccl_comm_num": 1,
+    "sync_nccl_allreduce": True,
+    "cudnn_exhaustive_search": False,
+    "cudnn_batchnorm_spatial_persistent": False,
+    "conv_workspace_size_limit": 512,
+    "sync_batch_norm": False,
+    "fp16_allreduce": False,
+    "find_unused_parameters": False,
+    "last_comm_group_size_MB": 1,
+}
+
+_CONFIG_FIELDS = {k for k in _DEFAULTS if k.endswith("_configs")
+                  or k.endswith("configs")}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.__dict__["_fields"] = copy.deepcopy(_DEFAULTS)
+
+    def __getattr__(self, name):
+        fields = self.__dict__["_fields"]
+        if name in fields:
+            return fields[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        fields = self.__dict__["_fields"]
+        if name not in fields:
+            raise AttributeError(
+                f"DistributedStrategy has no field {name!r}")
+        if name in _CONFIG_FIELDS and isinstance(value, dict):
+            fields[name].update(value)
+        else:
+            fields[name] = value
+
+    # -- (de)serialization (proto text parity: save_to_prototxt :126) --------
+    def save_to_prototxt(self, output):
+        with open(output, "w") as f:
+            json.dump(self._fields, f, indent=2)
+
+    def load_from_prototxt(self, pb_file):
+        with open(pb_file) as f:
+            self.__dict__["_fields"].update(json.load(f))
+
+    def to_dict(self):
+        return copy.deepcopy(self._fields)
+
+    def __repr__(self):
+        on = [k for k, v in self._fields.items()
+              if isinstance(v, bool) and v]
+        return f"DistributedStrategy(enabled={on})"

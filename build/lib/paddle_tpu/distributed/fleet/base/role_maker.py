@@ -1,0 +1,144 @@
+"""RoleMaker: cluster topology from environment.
+
+Reference parity: python/paddle/distributed/fleet/base/role_maker.py —
+PaddleCloudRoleMaker (:528) parses PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS (+ PS env), UserDefinedRoleMaker (:875).  The Gloo
+rendezvous embedded there (:33) is unnecessary on TPU: PJRT discovers the
+slice topology; multi-host barriers ride jax.distributed.
+"""
+from __future__ import annotations
+
+import os
+from enum import Enum
+
+
+class Role(Enum):
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self.worker_index() == 0
+
+    def worker_index(self):
+        raise NotImplementedError
+
+    def worker_num(self):
+        raise NotImplementedError
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """role_maker.py:528 parity; trusts env (so tests fake any topology)."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._worker_index = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._worker_num = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = eps.split(",") if eps else ["127.0.0.1:0"]
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT",
+                                           self._worker_endpoints[0])
+        pserver = os.getenv("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = pserver.split(",") if pserver else []
+        training_role = os.getenv("TRAINING_ROLE", "TRAINER")
+        self._role = Role.SERVER if training_role == "PSERVER" else Role.WORKER
+
+    def worker_index(self):
+        return self._worker_index
+
+    def worker_num(self):
+        return self._worker_num
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def server_index(self):
+        return int(os.getenv("PADDLE_PORT_INDEX", "0"))
+
+    def _get_trainer_id(self):
+        return self._worker_index
+
+    def _is_collective(self):
+        return self._is_collective
+
+    # -- rendezvous / barrier (Gloo-store parity, role_maker.py:33) ----------
+    def _store_endpoint(self):
+        ep = os.getenv("PADDLE_STORE_ENDPOINT")
+        if ep:
+            host, port = ep.rsplit(":", 1)
+            return host, int(port)
+        # default: rank 0's trainer endpoint host, side-channel port
+        host = self._worker_endpoints[0].rsplit(":", 1)[0] or "127.0.0.1"
+        port = int(os.getenv("PADDLE_STORE_PORT", "61001"))
+        return host, port
+
+    def _ensure_store(self, timeout=120.0):
+        if getattr(self, "_store", None) is None:
+            from .tcp_store import TCPStore
+            host, port = self._store_endpoint()
+            self._store = TCPStore(
+                "127.0.0.1" if self.is_first_worker() else host, port,
+                world_size=self._worker_num,
+                is_master=self.is_first_worker(), timeout=timeout)
+        return self._store
+
+    def rendezvous(self, timeout=120.0):
+        """Exchange endpoints through the store and wait for the full
+        cluster: returns the ordered endpoint list once every rank has
+        registered."""
+        store = self._ensure_store(timeout)
+        store.set(f"__ep/{self._worker_index}",
+                  self._current_endpoint.encode())
+        eps = []
+        for r in range(self._worker_num):
+            if not store.wait(f"__ep/{r}", timeout):
+                raise TimeoutError(
+                    f"rendezvous: rank {r} never registered within "
+                    f"{timeout}s")
+            eps.append(store.get(f"__ep/{r}", wait=False).decode())
+        self._worker_endpoints = eps
+        return eps
+
+    def barrier(self, comm_world="worker", timeout=None):
+        """Cluster-wide barrier over the store (_barrier parity)."""
+        if self._worker_num <= 1:
+            return
+        if not hasattr(self, "_barrier_seq"):
+            self._barrier_seq = {}
+        seq = self._barrier_seq.get(comm_world, 0)
+        self._barrier_seq[comm_world] = seq + 1
+        self._ensure_store().barrier(f"{comm_world}/{seq}",
+                                     self._worker_num, timeout)
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """role_maker.py:875 parity: explicit topology."""
+
+    def __init__(self, is_collective=False, current_id=0, role=Role.WORKER,
+                 worker_num=1, worker_endpoints=None, server_endpoints=None,
+                 **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._worker_index = current_id
+        self._worker_num = worker_num
+        self._worker_endpoints = worker_endpoints or ["127.0.0.1:0"]
+        self._server_endpoints = server_endpoints or []
+        self._role = role

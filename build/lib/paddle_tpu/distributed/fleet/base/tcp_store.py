@@ -1,0 +1,272 @@
+"""TCP key-value store: multi-host rendezvous + barrier.
+
+Reference parity: the Gloo rendezvous embedded in
+python/paddle/distributed/fleet/base/role_maker.py:33 (Gloo HTTP/file
+store init + barrier) and the c10d-style TCP store the launcher relies on.
+PJRT handles in-slice topology on TPU, but cross-host job bring-up still
+needs an out-of-band store: rank 0 serves a tiny length-prefixed
+set/get/wait/add protocol; other ranks connect. Barriers are implemented
+with an atomic add + wait-for-count key, matching the reference's
+barrier-on-store semantics.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+
+def _send_msg(sock, *parts: bytes):
+    payload = struct.pack("<I", len(parts))
+    for p in parts:
+        payload += struct.pack("<I", len(p)) + p
+    sock.sendall(payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    parts = []
+    for _ in range(n):
+        (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
+        parts.append(_recv_exact(sock, ln))
+    return parts
+
+
+class _Server(threading.Thread):
+    def __init__(self, port):
+        super().__init__(daemon=True)
+        self._kv = {}
+        self._cv = threading.Condition()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", port))
+        self.port = self._srv.getsockname()[1]
+        self._srv.listen(64)
+        self._stop = False
+
+    def run(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                cmd, *args = _recv_msg(conn)
+                try:
+                    self._handle(conn, cmd, args)
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as e:
+                    # malformed request (e.g. add on a non-int value):
+                    # reply with a diagnostic instead of killing the
+                    # connection thread and leaving the client hanging
+                    _send_msg(conn, b"err", repr(e).encode())
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _handle(self, conn, cmd, args):
+        # every reply leads with b"ok"/b"err" so clients can distinguish
+        # payloads from error diagnostics unambiguously
+        if cmd == b"set":
+            with self._cv:
+                self._kv[args[0]] = args[1]
+                self._cv.notify_all()
+            _send_msg(conn, b"ok")
+        elif cmd == b"get":
+            with self._cv:
+                v = self._kv.get(args[0])
+            _send_msg(conn, b"ok", v if v is not None else b"",
+                      b"1" if v is not None else b"0")
+        elif cmd == b"add":
+            with self._cv:
+                cur = int(self._kv.get(args[0], b"0")) + int(args[1])
+                self._kv[args[0]] = str(cur).encode()
+                self._cv.notify_all()
+            _send_msg(conn, b"ok", str(cur).encode())
+        elif cmd == b"delprefix":
+            with self._cv:
+                dead = [k for k in self._kv if k.startswith(args[0])]
+                for k in dead:
+                    del self._kv[k]
+            _send_msg(conn, b"ok", str(len(dead)).encode())
+        elif cmd == b"wait":
+            key, timeout = args[0], float(args[1])
+            deadline = time.time() + timeout
+            with self._cv:
+                while key not in self._kv:
+                    left = deadline - time.time()
+                    if left <= 0 or not self._cv.wait(left):
+                        break
+                ok = key in self._kv
+            _send_msg(conn, b"ok", b"1" if ok else b"0")
+        else:
+            _send_msg(conn, b"err", b"unknown command")
+
+    def shutdown(self):
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    """c10d-style store. Rank 0 passes is_master=True and serves."""
+
+    def __init__(self, host, port, world_size=1, is_master=False,
+                 timeout=120.0):
+        self._timeout = timeout
+        self._server = None
+        if is_master:
+            self._server = _Server(port)
+            self._server.start()
+            port = self._server.port
+        self.host, self.port = host, port
+        deadline = time.time() + timeout
+        last = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=timeout)
+                break
+            except OSError as e:
+                last = e
+                if time.time() > deadline:
+                    raise ConnectionError(
+                        f"store at {host}:{port} unreachable: {last}")
+                time.sleep(0.05)
+        self._lock = threading.Lock()
+
+    def _reply(self):
+        parts = _recv_msg(self._sock)
+        if parts and parts[0] == b"err":
+            raise RuntimeError(f"store error: "
+                               f"{parts[1].decode() if len(parts) > 1 else '?'}")
+        if not parts or parts[0] != b"ok":
+            raise ConnectionError("store protocol desync")
+        return parts[1:]
+
+    def set(self, key: str, value: bytes):
+        with self._lock:
+            _send_msg(self._sock, b"set", key.encode(),
+                      value if isinstance(value, bytes) else
+                      str(value).encode())
+            self._reply()
+
+    def get(self, key: str, wait=True):
+        if wait and not self.wait(key, self._timeout):
+            raise TimeoutError(f"store key {key!r} never set")
+        with self._lock:
+            _send_msg(self._sock, b"get", key.encode())
+            v, present = self._reply()
+        return v if present == b"1" else None
+
+    def add(self, key: str, amount: int = 1) -> int:
+        with self._lock:
+            _send_msg(self._sock, b"add", key.encode(),
+                      str(amount).encode())
+            (v,) = self._reply()
+        return int(v)
+
+    def delete_prefix(self, prefix: str) -> int:
+        """Delete every key starting with ``prefix``; returns the count."""
+        with self._lock:
+            _send_msg(self._sock, b"delprefix", prefix.encode())
+            (n,) = self._reply()
+        return int(n)
+
+    def reset_barrier(self, name: str = ""):
+        """Clear barrier count/release keys across ALL generations (all
+        barriers when ``name`` is empty). An elastic launcher whose store
+        outlives workers calls this between gang restarts so a
+        half-arrived (abandoned) barrier can't skew the counters."""
+        self.delete_prefix(f"__barrier/{name}/" if name else "__barrier/")
+
+    def bump_restart_generation(self) -> int:
+        """Advance the store-resident restart generation that scopes every
+        barrier key. The restarting supervisor calls this ONCE before
+        respawning a gang; all hosts' workers then agree on the new
+        generation regardless of how many times each host restarted
+        locally (the per-host PADDLE_RESTART_GENERATION env is only the
+        fallback when this key has never been bumped)."""
+        return self.add("__restart_generation", 1)
+
+    def _restart_generation(self) -> str:
+        v = self.get("__restart_generation", wait=False)
+        if v is not None:
+            return v.decode()
+        import os
+        return os.environ.get("PADDLE_RESTART_GENERATION", "0")
+
+    def wait(self, key: str, timeout: float = None) -> bool:
+        t = timeout or self._timeout
+        with self._lock:
+            # the server's wait deadline starts when it RECEIVES the
+            # request; the socket recv timeout must outlive it or the late
+            # '0' reply desyncs the connection protocol
+            self._sock.settimeout(t + 30.0)
+            try:
+                _send_msg(self._sock, b"wait", key.encode(),
+                          str(t).encode())
+                (ok,) = self._reply()
+            finally:
+                self._sock.settimeout(self._timeout)
+        return ok == b"1"
+
+    def barrier(self, name: str, world_size: int, timeout: float = None):
+        """All ranks add 1 to the barrier key, then wait for the release
+        key the last arriver sets (Gloo barrier-on-store parity).
+
+        Reuse safety is two-layered:
+
+        * a *restart generation* prefixes every key — the store-resident
+          value bumped by :meth:`bump_restart_generation` (shared across
+          hosts), falling back to ``PADDLE_RESTART_GENERATION`` (set per
+          host by the elastic launcher) — so a half-arrived barrier
+          abandoned by a crashed gang can never skew the restarted gang's
+          counters;
+        * within a generation the counter is never reset, so a reused
+          barrier name lands in a fresh *arrival window*: arrival ``n``
+          belongs to window ``(n-1)//world_size`` and waits on that
+          window's release key — a stale release from a previous complete
+          use never releases it early.
+
+        A launcher owning a store that outlives workers can also clear
+        state explicitly via :meth:`reset_barrier`.
+        """
+        rg = self._restart_generation()
+        n = self.add(f"__barrier/{name}/g{rg}/count", 1)
+        gen = (n - 1) // world_size
+        arrived = n - gen * world_size
+        release = f"__barrier/{name}/g{rg}/release/{gen}"
+        if arrived >= world_size:
+            self.set(release, b"1")
+        if not self.wait(release, timeout or self._timeout):
+            raise TimeoutError(f"barrier {name!r} timed out ({arrived}/"
+                               f"{world_size} arrived)")
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._server is not None:
+            self._server.shutdown()

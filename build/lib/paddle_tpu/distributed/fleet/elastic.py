@@ -1,0 +1,172 @@
+"""Elastic training: heartbeats + bounded restart of failed ranks.
+
+Reference parity: python/paddle/distributed/fleet/elastic/ (ElasticManager
+watching etcd heartbeats, launch_utils' watch loop) and the heartbeat the
+PS HeterPS workers send. Here the out-of-band channel is the fleet
+TCPStore: each rank publishes ``__hb/<rank>`` timestamps from a daemon
+thread; a monitor (the launcher) flags ranks whose heartbeat goes stale,
+and the elastic launch loop restarts dead local processes up to
+``max_restarts`` times before tearing the job down (fail-fast is
+max_restarts=0).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+class HeartbeatReporter:
+    """Worker side: publish liveness every ``interval`` seconds."""
+
+    def __init__(self, store, rank, interval=5.0):
+        self._store = store
+        self._rank = rank
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self._store.set(f"__hb/{self._rank}",
+                                    repr(time.time()).encode())
+                except Exception:
+                    pass      # monitor notices staleness; don't crash work
+                self._stop.wait(self._interval)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+class HeartbeatMonitor:
+    """Launcher side: which ranks are stale?"""
+
+    def __init__(self, store, world_size, stale_after=15.0):
+        self._store = store
+        self._world = world_size
+        self._stale_after = stale_after
+
+    def stale_ranks(self):
+        now = time.time()
+        out = []
+        for r in range(self._world):
+            v = self._store.get(f"__hb/{r}", wait=False)
+            if v is None or now - float(v) > self._stale_after:
+                out.append(r)
+        return out
+
+
+class ElasticLaunch:
+    """Bounded-restart supervision of local worker processes
+    (fleet/elastic ElasticManager semantics, local scope). Two modes:
+
+    * ``gang=True`` (collective jobs, nprocs > 1 default): ANY nonzero
+      exit terminates and respawns the WHOLE gang — a lone restarted rank
+      cannot rejoin a live jax.distributed job whose coordinator already
+      started, so partial restart would hang the survivors. Matches the
+      reference elastic manager's all-or-nothing scale event.
+    * ``gang=False`` (independent workers, e.g. PS trainers): only the
+      dead rank restarts.
+    Exceeding ``max_restarts`` tears the job down (fail-fast is
+    max_restarts=0)."""
+
+    def __init__(self, spawn_fn, nprocs, max_restarts=3, poll_s=0.5,
+                 gang=None, on_restart=None):
+        self._spawn = spawn_fn     # spawn_fn(local_rank) -> Popen
+        self._n = nprocs
+        self._max_restarts = max_restarts
+        self._poll_s = poll_s
+        self._gang = (nprocs > 1) if gang is None else gang
+        # called between gang restarts; a launcher owning a store that
+        # outlives the workers should clear rendezvous state here, e.g.
+        # lambda: store.delete_prefix("__barrier/")
+        self._on_restart = on_restart
+        # restart generation, exported to children (spawn_fn closures read
+        # it via this attribute or the PADDLE_RESTART_GENERATION env the
+        # launcher sets): TCPStore.barrier scopes its keys by it so a
+        # half-arrived barrier abandoned by a crashed gang can't skew the
+        # restarted gang's rendezvous
+        self.generation = 0
+
+    def run(self):
+        if self._gang:
+            return self._run_gang()
+        return self._run_independent()
+
+    def _run_gang(self):
+        import signal
+        restarts = 0
+        while True:
+            procs = [self._spawn(i) for i in range(self._n)]
+            rc = 0
+            while procs:
+                time.sleep(self._poll_s)
+                for p in list(procs):
+                    ret = p.poll()
+                    if ret is None:
+                        continue
+                    procs.remove(p)
+                    if ret != 0:
+                        rc = ret
+                        for q in procs:
+                            if q.poll() is None:
+                                q.send_signal(signal.SIGTERM)
+                        for q in procs:
+                            q.wait()
+                        procs = []
+                        break
+            if rc == 0:
+                return 0, {i: restarts for i in range(self._n)}
+            if restarts >= self._max_restarts:
+                return rc, {i: restarts for i in range(self._n)}
+            restarts += 1
+            self.generation = restarts
+            if self._on_restart is not None:
+                try:
+                    self._on_restart()
+                except Exception as e:
+                    # a failed reset likely means the respawned gang will
+                    # hang at rendezvous — say so instead of hiding it
+                    import sys
+                    print(f"[elastic] on_restart hook failed: {e!r}; "
+                          f"the restarted gang may hang at its barrier",
+                          file=sys.stderr)
+
+    def _run_independent(self):
+        import signal
+        procs = {i: self._spawn(i) for i in range(self._n)}
+        restarts = {i: 0 for i in range(self._n)}
+        done = set()
+        try:
+            while len(done) < self._n:
+                time.sleep(self._poll_s)
+                for i, p in list(procs.items()):
+                    if i in done:
+                        continue
+                    ret = p.poll()
+                    if ret is None:
+                        continue
+                    if ret == 0:
+                        done.add(i)
+                        continue
+                    if restarts[i] < self._max_restarts:
+                        restarts[i] += 1
+                        procs[i] = self._spawn(i)
+                    else:
+                        for j, q in procs.items():
+                            if j not in done and q.poll() is None:
+                                q.send_signal(signal.SIGTERM)
+                        return ret, restarts
+            return 0, restarts
+        except KeyboardInterrupt:
+            for q in procs.values():
+                if q.poll() is None:
+                    q.terminate()
+            raise

@@ -1,0 +1,311 @@
+"""Fleet facade: the distributed-training front door.
+
+Reference parity: python/paddle/distributed/fleet/base/fleet_base.py —
+``Fleet`` singleton (:63) with init (:130), distributed_optimizer (:593),
+distributed_model (:638), minimize (:988); the meta-optimizer factory
+(:1068-1105) that ranks and composes strategy wrappers.
+
+TPU-native: strategies do not rewrite op programs.  ``distributed_optimizer``
+returns a DistributedOptimizer that carries the DistributedStrategy; when a
+step is compiled (directly, via hapi, or via fleet.minimize) the strategy
+lowers onto the SPMD engine:
+  sharding→zero, recompute→remat, gradient_merge→accumulate_steps,
+  amp→bf16 compute dtype, tensor_parallel/pipeline→mesh axes.
+The whole meta-optimizer ranking machinery collapses into this single
+translation, because composition happens inside ONE jitted step rather than
+by nested program rewriting.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...parallel import mesh as mesh_mod
+from ...parallel.train_step import TrainStep
+from ..parallel_env import init_parallel_env, ParallelEnv
+from .base.distributed_strategy import DistributedStrategy
+from .base.role_maker import PaddleCloudRoleMaker, RoleMakerBase
+
+
+class DistributedOptimizer:
+    """Strategy-carrying optimizer wrapper (the composed meta-optimizer)."""
+
+    def __init__(self, optimizer, strategy: DistributedStrategy):
+        self._inner = self._apply_optimizer_swaps(optimizer, strategy)
+        self.user_defined_strategy = strategy
+
+    @staticmethod
+    def _apply_optimizer_swaps(optimizer, strategy):
+        """strategy.lamb/lars swap the inner optimizer (the reference's
+        LambOptimizer/LarsOptimizer meta-optimizers replace the user's
+        momentum/adam the same way)."""
+        from ...optimizer.optimizer import Lamb, LarsMomentum
+        if strategy is None:
+            return optimizer
+        params = getattr(optimizer, "_parameters", None)
+        # carry the user's LR schedule object (not a float snapshot) and
+        # grad clip through the swap
+        lr = getattr(optimizer, "_lr", None)
+        clip = getattr(optimizer, "_grad_clip", None)
+        if getattr(strategy, "lamb", False) and \
+                not isinstance(optimizer, Lamb):
+            cfg = strategy.lamb_configs
+            return Lamb(learning_rate=lr,
+                        lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+                        parameters=params, grad_clip=clip)
+        if getattr(strategy, "lars", False) and \
+                not isinstance(optimizer, LarsMomentum):
+            cfg = strategy.lars_configs
+            return LarsMomentum(
+                learning_rate=lr,
+                momentum=getattr(optimizer, "_momentum", 0.9),
+                lars_coeff=cfg.get("lars_coeff", 0.001),
+                lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                parameters=params, grad_clip=clip)
+        return optimizer
+
+    # strategy → engine options ---------------------------------------------
+    def train_step_options(self):
+        from .ledger import check_strategy
+        s = self.user_defined_strategy
+        check_strategy(s)        # unsupported flags raise, never sit inert
+        opts = {}
+        if s.recompute:
+            opts["remat"] = True
+        if s.sharding:
+            opts["zero"] = int(s.sharding_configs.get("stage", 1))
+        if s.gradient_merge:
+            opts["accumulate_steps"] = int(s.gradient_merge_configs["k_steps"])
+        if s.pipeline:
+            opts.setdefault("accumulate_steps",
+                            int(s.pipeline_configs.get("accumulate_steps", 1)))
+        if s.amp:
+            if s.amp_configs.get("use_pure_bf16", True):
+                opts["compute_dtype"] = jnp.bfloat16
+            else:
+                opts["compute_dtype"] = jnp.float16
+        if s.localsgd:
+            opts["localsgd_k"] = int(s.localsgd_configs.get("k_steps", 1))
+            opts["localsgd_begin"] = int(
+                s.localsgd_configs.get("begin_step", 1))
+        if s.a_sync:
+            raise NotImplementedError(
+                "DistributedStrategy.a_sync is the parameter-server async "
+                "mode; it configures the ps/ trainer (rec.WideDeepTrainer "
+                "async_push), not the collective TrainStep path")
+        return opts
+
+    def build_train_step(self, layer, loss_fn=None, **overrides):
+        opts = self.train_step_options()
+        opts.update(overrides)
+        return TrainStep(layer, self._inner, loss_fn, **opts)
+
+    # optimizer protocol passthrough ----------------------------------------
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program, parameter_list,
+                                    no_grad_set)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner.set_state_dict(state)
+
+
+class Fleet:
+    """fleet_base.py:63 parity."""
+
+    def __init__(self):
+        self._role_maker: RoleMakerBase = None
+        self._user_defined_strategy: DistributedStrategy = None
+        self._is_collective = False
+        self._runtime_handle = None
+
+    # -- init ----------------------------------------------------------------
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        self._is_collective = is_collective
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._user_defined_strategy = strategy or DistributedStrategy()
+        if is_collective:
+            # mesh axes from strategy degrees
+            s = self._user_defined_strategy
+            axes = {}
+            if s.tensor_parallel:
+                axes[mesh_mod.MP_AXIS] = int(
+                    s.tensor_parallel_configs["tensor_parallel_degree"])
+            if s.pipeline:
+                axes[mesh_mod.PP_AXIS] = int(
+                    s.pipeline_configs.get("pp_degree", 1))
+            if s.sequence_parallel:
+                axes[mesh_mod.SP_AXIS] = int(
+                    s.sequence_parallel_configs.get("sp_degree", 1))
+            axes[mesh_mod.DP_AXIS] = -1
+            init_parallel_env(mesh_axes=axes)
+        return self
+
+    # -- topology queries ----------------------------------------------------
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return self._role_maker.server_num()
+
+    def server_index(self):
+        return self._role_maker.server_index()
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker.get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    # -- training ------------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        return DistributedOptimizer(
+            optimizer, self._user_defined_strategy or DistributedStrategy())
+
+    def distributed_model(self, model):
+        from ..parallel import DataParallel
+        return DataParallel(model)
+
+    def minimize(self, loss=None, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        raise RuntimeError(
+            "fleet.minimize on a bare loss requires static mode; in the TPU "
+            "build use optimizer.build_train_step(layer, loss_fn) or hapi "
+            "Model.prepare(fleet_optimizer) for the compiled SPMD path")
+
+    # -- checkpoint ----------------------------------------------------------
+    def save_persistables(self, executor=None, dirname=None, main_program=None,
+                          mode=0):
+        """fleet_base parity: persist trainable state. ``main_program`` may be
+        a Layer (dygraph) or anything with state_dict(); rank 0 writes."""
+        import os
+        from ...framework.io_state import save
+        if not dirname:
+            raise ValueError("save_persistables requires dirname")
+        if not self.is_first_worker():
+            return
+        os.makedirs(dirname, exist_ok=True)
+        target = main_program if main_program is not None else executor
+        if target is None or not hasattr(target, "state_dict"):
+            raise NotImplementedError(
+                "fleet.save_persistables needs a Layer/Model with "
+                "state_dict() (static Program persistables arrive with "
+                "paddle_tpu.static)")
+        save(target.state_dict(), os.path.join(dirname, "model.pdparams"))
+
+    # -- parameter-server mode (fleet_base.py init_server/run_server/
+    #    init_worker; served by the ps/ stack — server.h:50 analogue) --------
+    def init_server(self, *args, **kwargs):
+        from ..ps import PsServer
+        ep = None
+        if self._role_maker is not None:
+            eps = self._role_maker.get_pserver_endpoints()
+            if eps:
+                ep = eps[self._role_maker.server_index() % len(eps)]
+        host, port = (ep.rsplit(":", 1) if ep else ("127.0.0.1", "0"))
+        self._ps_server = PsServer(host=host, port=int(port))
+        return self._ps_server
+
+    def run_server(self):
+        """Serve until stop (listen_and_serv_op's blocking loop)."""
+        import time
+        srv = self._ps_server
+        srv.start()
+        while srv._running:
+            time.sleep(0.05)
+
+    def init_worker(self):
+        """Connect this trainer to the pserver(s).  Returns the PS client
+        (single-endpoint for now; multi-server table sharding is a host-side
+        concern, not a chip one)."""
+        from ..ps import PsClient, LocalPsEndpoint
+        eps = (self._role_maker.get_pserver_endpoints()
+               if self._role_maker else [])
+        self._ps_client = PsClient(eps[0]) if eps else LocalPsEndpoint()
+        return self._ps_client
+
+    def stop_worker(self):
+        client = getattr(self, "_ps_client", None)
+        if client is not None:
+            client.close()
+
+    @property
+    def util(self):
+        return _UtilBase(self)
+
+
+class _UtilBase:
+    def __init__(self, fleet):
+        self._fleet = fleet
+
+    def barrier(self, comm_world="worker"):
+        self._fleet.barrier_worker()
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        rm = self._fleet._role_maker
+        if not self._fleet._is_collective and rm is not None \
+                and rm.worker_num() > 1:
+            # PS / non-collective mode: the mesh is per-process, so reduce
+            # across PROCESSES through the store (gloo_wrapper.h AllReduce)
+            return self._store_all_reduce(np.asarray(
+                input.numpy() if isinstance(input, Tensor) else input), mode)
+        from ..collective import all_reduce as _ar, ReduceOp
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        t = input if isinstance(input, Tensor) else Tensor(jnp.asarray(input))
+        return _ar(t, op=op).numpy()
+
+    def _store_all_reduce(self, arr, mode):
+        import pickle
+        rm = self._fleet._role_maker
+        store = rm._ensure_store()
+        me, world = rm.worker_index(), rm.worker_num()
+        seq = getattr(self, "_ar_seq", 0)
+        self._ar_seq = seq + 1
+        store.set(f"__utilar/{seq}/{me}", pickle.dumps(arr))
+        store.barrier(f"__utilar/{seq}", world)
+        parts = [pickle.loads(store.get(f"__utilar/{seq}/{r}"))
+                 for r in range(world)]
+        fn = {"sum": np.sum, "max": np.max, "min": np.min}[mode]
+        out = fn(np.stack(parts), axis=0)
+        store.barrier(f"__utilar_done/{seq}", world)
+        if me == 0:
+            store.delete_prefix(f"__utilar/{seq}/")
+        return out
+
+
+fleet = Fleet()

@@ -1,0 +1,142 @@
+"""Launch CLI: ``python -m paddle_tpu.distributed.fleet.launch train.py``.
+
+Reference parity: python/paddle/distributed/fleet/launch.py:321 —
+launch_collective (:198) spawns one process per GPU with PADDLE_TRAINER_ID /
+endpoints env and watches children (launch_utils.py:451,517).
+
+TPU-native: the process unit is a *host*, not a chip (PJRT owns all local
+chips).  On a single host this launcher therefore spawns ONE training
+process by default; --nproc_per_node>1 exists for CPU-simulated cluster
+tests, mirroring how the reference's own test suite fakes topology
+(SURVEY.md §4.3).  Fail-fast watching matches launch_utils.py:517: any child
+death tears the job down.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_ports(n):
+    ports, socks = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.fleet.launch")
+    p.add_argument("--ips", default="127.0.0.1",
+                   help="comma-separated host ips")
+    p.add_argument("--host_rank", type=int,
+                   default=int(os.getenv("PADDLE_HOST_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 on TPU: PJRT owns all chips)")
+    p.add_argument("--started_port", type=int, default=None)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help="0 = fail-fast (default); 1 = restart dead local "
+                        "ranks up to --max_restarts (fleet/elastic parity)")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def get_cluster(ips, nproc_per_node, started_port=None):
+    """launch.py:257 parity: (endpoints, world_size)."""
+    hosts = ips.split(",")
+    nranks = len(hosts) * nproc_per_node
+    ports = ([started_port + i for i in range(nproc_per_node)]
+             if started_port else _free_ports(nproc_per_node))
+    endpoints = [f"{h}:{p}" for h in hosts for p in ports]
+    return endpoints, nranks
+
+
+def launch_collective(args):
+    endpoints, nranks = get_cluster(args.ips, args.nproc_per_node,
+                                    args.started_port)
+    log_fps = []
+    base_rank = args.host_rank * args.nproc_per_node
+    supervisor = []   # filled when elastic supervision is active
+
+    def spawn(local):
+        rank = base_rank + local
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nranks),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "FLAGS_selected_tpus": str(local),
+            # gang-restart generation: scopes TCPStore barrier keys so an
+            # abandoned half-arrived barrier can't skew the new gang
+            "PADDLE_RESTART_GENERATION": str(
+                supervisor[0].generation if supervisor else 0),
+        })
+        cmd = [sys.executable, "-u", args.training_script] + \
+            args.training_script_args
+        out = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            # append only under elastic supervision (restart logs belong
+            # together); plain runs truncate like the reference launcher
+            mode = "a" if args.elastic_level >= 1 else "w"
+            out = open(os.path.join(args.log_dir, f"workerlog.{local}"),
+                       mode)
+            log_fps.append(out)
+        return subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
+
+    try:
+        if args.elastic_level >= 1:
+            # bounded-restart supervision (fleet/elastic parity)
+            from .elastic import ElasticLaunch
+            # collective jobs are always gangs, even at 1 proc per host:
+            # a lone restarted rank cannot rejoin collectives mid-flight
+            el = ElasticLaunch(spawn, args.nproc_per_node,
+                               max_restarts=args.max_restarts, gang=True)
+            supervisor.append(el)
+            rc, restarts = el.run()
+            if any(restarts.values()):
+                print(f"[launch] restarts per rank: {restarts}",
+                      file=sys.stderr)
+            return rc
+        # watch_local_trainers (launch_utils.py:517) parity: fail-fast
+        procs = [spawn(local) for local in range(args.nproc_per_node)]
+        rc = 0
+        while procs:
+            for p in list(procs):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                procs.remove(p)
+                if ret != 0:
+                    rc = ret
+                    for q in procs:
+                        q.send_signal(signal.SIGTERM)
+                    procs = []
+                    break
+            time.sleep(0.5)
+        return rc
+    finally:
+        for f in log_fps:
+            f.close()
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    sys.exit(launch_collective(args))
+
+
+if __name__ == "__main__":
+    launch()

@@ -1,0 +1,16 @@
+"""fleet.meta_parallel facade.
+
+Reference parity: the fleet meta-parallel layer family
+(python/paddle/distributed/fleet/meta_parallel/ in later reference versions;
+in this snapshot the pipeline program split lives in PipelineOptimizer,
+python/paddle/fluid/optimizer.py:3702 + device_guard section programs).
+
+TPU-native: ``PipelineLayer`` is the SPMD PipelineModule — embed/trunk/head
+decomposition compiled as one pjit program with the trunk stacked over the
+``pp`` mesh axis (see paddle_tpu/parallel/pipeline.py).
+"""
+from ...parallel.pipeline import PipelineModule
+
+PipelineLayer = PipelineModule
+
+__all__ = ["PipelineLayer", "PipelineModule"]

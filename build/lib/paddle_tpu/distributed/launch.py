@@ -1,0 +1,6 @@
+"""``python -m paddle_tpu.distributed.launch`` — legacy entry mapping to the
+fleet launcher (reference: python/paddle/distributed/launch.py)."""
+from .fleet.launch import launch
+
+if __name__ == "__main__":
+    launch()

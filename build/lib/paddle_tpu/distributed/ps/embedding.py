@@ -1,0 +1,79 @@
+"""DistributedEmbedding: host-table embedding with device-side compute.
+
+Reference parity: the sparse-table lookup path — lookup_sparse_table ops +
+parameter_prefetch (operators/distributed/parameter_prefetch.cc pulls rows
+for the batch's ids from pservers) and parameter_send's sparse push of
+SelectedRows grads.
+
+TPU-first (SURVEY §7 phase 8 / HeterPS): per step,
+  1. host: unique the batch ids, PULL only those rows from the table,
+  2. device: one gather ( + the rest of the dense model) on chip,
+  3. backward: the pulled row-block is a leaf Tensor, so the tape leaves a
+     dense [U, D] grad on it (U = unique ids in batch — small),
+  4. host: PUSH (ids, row grads) — the server applies its per-row rule.
+So the chip only ever sees O(batch) rows of the (unbounded) table.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ... import nn
+
+
+class DistributedEmbedding(Layer):
+    """Embedding whose weights live in a PS table (local or remote client)."""
+
+    def __init__(self, client, table_id: int, dim: int,
+                 optimizer: str = "adagrad", lr: float = 0.05,
+                 init_scale: float = 0.01):
+        super().__init__()
+        self.client = client
+        self.table_id = int(table_id)
+        self.dim = int(dim)
+        client.create_table(self.table_id, "sparse", dim=dim,
+                            optimizer=optimizer, lr=lr,
+                            init_scale=init_scale)
+        self._pending: List[Tuple[np.ndarray, Tensor]] = []
+
+    def pull_padded_rows(self, uniq):
+        """Host pull + power-of-two padding. A stable [U_pad, D] shape
+        means the downstream XLA programs are compiled once, not per
+        distinct unique-id count (recompile-per-batch would dominate).
+        Shared by the eager forward and the fused PS trainers."""
+        rows = self.client.pull_sparse(self.table_id, uniq)       # host
+        n = len(uniq)
+        n_pad = max(8, 1 << (n - 1).bit_length())
+        if n_pad != n:
+            rows = np.concatenate(
+                [rows, np.zeros((n_pad - n, self.dim), np.float32)])
+        return rows
+
+    def forward(self, ids):
+        from ...nn import functional as F
+        ids_arr = ids._value if isinstance(ids, Tensor) else np.asarray(ids)
+        ids_np = np.asarray(ids_arr)
+        uniq, inv = np.unique(ids_np, return_inverse=True)
+        rows = self.pull_padded_rows(uniq)
+        w_rows = Tensor(jnp.asarray(rows), stop_gradient=False)   # leaf
+        w_rows.name = f"dist_emb_{self.table_id}_rows"
+        if self.training:
+            self._pending.append((uniq, w_rows))
+        inv_t = Tensor(jnp.asarray(inv.reshape(ids_np.shape), jnp.int32))
+        return F.embedding(inv_t, w_rows)                          # device
+
+    def flush_grads(self):
+        """Push accumulated row grads to the table (the per-step
+        parameter_send).  Call after backward, before/at optimizer.step."""
+        for uniq, w_rows in self._pending:
+            if w_rows.grad is not None:
+                grads = np.asarray(w_rows.grad._value)[:len(uniq)]
+                self.client.push_sparse(self.table_id, uniq, grads)
+        self._pending.clear()
+
+    def table_size(self):
+        return self.client.table_size(self.table_id)

@@ -1,0 +1,238 @@
+"""Parameter-server RPC service: pull/push over TCP.
+
+Reference parity: the brpc/grpc PS service —
+paddle/fluid/distributed/service/server.h:50 (PSServer hosting tables),
+operators/distributed/ RPCServer/RPCClient + parameter_send/parameter_recv
+(sparse-table pull/push messages), listen_and_serv_op.cc's serving loop.
+
+TPU-first framing: chips never block on this path — workers batch pull/push
+of HOST-side sparse tables around the dense on-chip step, so the RPC is a
+host-to-host side channel (DCN), exactly the HeterPS split.  Wire format is
+length-prefixed pickles over a socket; one thread per connection.  This is
+deliberately minimal but REAL: multiple worker processes can share one table
+server (tested via subprocess in tests/test_ps.py).
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .table import SparseTable, DenseTable
+
+_LEN = struct.Struct("!Q")
+
+
+def _send_msg(sock: socket.socket, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = _recv_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    body = _recv_exact(sock, n)
+    return None if body is None else pickle.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class PsServer:
+    """Hosts tables; serves pull/push/barrier (server.h:50 + listen_and_serv).
+
+    Thread-per-connection; table mutations are serialized by a lock (the
+    reference's per-shard mutexes collapse to one — host python, not the
+    hot path)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._tables: Dict[int, object] = {}
+        self._lock = threading.RLock()  # _handle -> create_table re-enters
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.endpoint = "%s:%d" % self._sock.getsockname()[:2]
+        self._running = False
+        self._threads = []
+        self._barrier_count = 0
+        self._barrier_waiters = []
+
+    def create_table(self, table_id: int, kind: str = "sparse", **kw):
+        with self._lock:
+            if table_id not in self._tables:
+                self._tables[table_id] = (SparseTable(**kw) if kind == "sparse"
+                                          else DenseTable(**kw))
+        return self._tables[table_id]
+
+    # -- serving loop ---------------------------------------------------------
+    def start(self):
+        self._running = True
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                if msg is None:
+                    break
+                reply = self._handle(msg)
+                _send_msg(conn, reply)
+        finally:
+            conn.close()
+
+    def _handle(self, msg):
+        op = msg["op"]
+        with self._lock:
+            if op == "create_table":
+                self.create_table(msg["table_id"], msg.get("kind", "sparse"),
+                                  **msg.get("config", {}))
+                return {"ok": True}
+            table = self._tables.get(msg.get("table_id"))
+            if op == "pull_sparse":
+                return {"ok": True, "values": table.pull(msg["ids"])}
+            if op == "push_sparse":
+                table.push(msg["ids"], msg["grads"])
+                return {"ok": True}
+            if op == "pull_dense":
+                return {"ok": True, "values": table.pull()}
+            if op == "push_dense":
+                table.push(msg["grads"])
+                return {"ok": True}
+            if op == "table_size":
+                return {"ok": True, "size": len(table)}
+            if op == "stop":
+                # release the bound port immediately (the accept loop wakes
+                # on the OSError) so a later init_server on this fixed
+                # endpoint doesn't hit EADDRINUSE; the live conn still gets
+                # the reply below
+                self._running = False
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                return {"ok": True}
+        raise ValueError(f"unknown PS op {op}")
+
+    def stop(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PsClient:
+    """Worker-side stub (RPCClient + Communicator's synchronous send path —
+    the async aggregation threads of communicator.h:195 are unnecessary
+    here because pushes batch per train step already)."""
+
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=60)
+        self._lock = threading.Lock()
+
+    def _call(self, **msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            out = _recv_msg(self._sock)
+        if out is None or not out.get("ok"):
+            raise RuntimeError(f"PS call failed: {msg.get('op')}")
+        return out
+
+    def create_table(self, table_id: int, kind: str = "sparse", **config):
+        self._call(op="create_table", table_id=table_id, kind=kind,
+                   config=config)
+
+    def pull_sparse(self, table_id: int, ids) -> np.ndarray:
+        return self._call(op="pull_sparse", table_id=table_id,
+                          ids=np.asarray(ids))["values"]
+
+    def push_sparse(self, table_id: int, ids, grads):
+        self._call(op="push_sparse", table_id=table_id,
+                   ids=np.asarray(ids), grads=np.asarray(grads))
+
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        return self._call(op="pull_dense", table_id=table_id)["values"]
+
+    def push_dense(self, table_id: int, grads):
+        self._call(op="push_dense", table_id=table_id,
+                   grads=np.asarray(grads))
+
+    def table_size(self, table_id: int) -> int:
+        return self._call(op="table_size", table_id=table_id)["size"]
+
+    def stop_server(self):
+        try:
+            self._call(op="stop")
+        except Exception:
+            pass
+
+    def close(self):
+        self._sock.close()
+
+
+class LocalPsEndpoint:
+    """In-process 'client' over a table dict — single-trainer fast path (no
+    sockets), same interface as PsClient.  ≙ running trainer+pserver in one
+    process for tests (test_dist_base local mode)."""
+
+    def __init__(self):
+        import threading
+        self._tables: Dict[int, object] = {}
+        # async-communicator mode pushes from a drain thread while the
+        # trainer pulls: serialize table access so a pull can never see a
+        # torn (half-applied) row update
+        self._lock = threading.RLock()
+
+    def create_table(self, table_id: int, kind: str = "sparse", **config):
+        with self._lock:
+            if table_id not in self._tables:
+                self._tables[table_id] = (SparseTable(**config)
+                                          if kind == "sparse"
+                                          else DenseTable(**config))
+
+    def pull_sparse(self, table_id, ids):
+        with self._lock:
+            return self._tables[table_id].pull(np.asarray(ids))
+
+    def push_sparse(self, table_id, ids, grads):
+        with self._lock:
+            self._tables[table_id].push(np.asarray(ids), np.asarray(grads))
+
+    def pull_dense(self, table_id):
+        return self._tables[table_id].pull()
+
+    def push_dense(self, table_id, grads):
+        self._tables[table_id].push(np.asarray(grads))
+
+    def table_size(self, table_id):
+        return len(self._tables[table_id])
+
+    def close(self):
+        pass

@@ -1,0 +1,138 @@
+"""Host-resident parameter-server tables.
+
+Reference parity: the PS table stack —
+paddle/fluid/distributed/table/table.h:32 (Table with pull/push sparse+dense
+and an Accessor), operators/distributed/large_scale_kv.h (SSD-able sparse
+embedding storage with lazy row init), and the per-row optimizers the
+accessors apply on push (sgd/adagrad/adam rules server-side).
+
+TPU-first: the dense compute (gather, MLP, loss, dense grads) runs on chip;
+these tables keep the 100B-parameter-scale sparse embeddings in HOST memory
+(the SURVEY §7 phase-8 / HeterPS pattern: "dense on TPU, sparse tables on
+hosts").  Rows are created lazily on first pull (large_scale_kv.h's
+init-on-miss), and push applies the configured rule row-wise in numpy.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class SparseTable:
+    """id → embedding-row store with a server-side per-row optimizer.
+
+    ≙ CommonSparseTable (distributed/table/common_sparse_table.h) +
+    large_scale_kv.h ValueBlock: hash storage, lazy init, rule on push.
+    """
+
+    def __init__(self, dim: int, optimizer: str = "sgd", lr: float = 0.01,
+                 initializer: str = "uniform", init_scale: float = 0.01,
+                 beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8,
+                 seed: int = 0):
+        self.dim = int(dim)
+        self.opt = optimizer
+        self.lr = float(lr)
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self._rows: Dict[int, np.ndarray] = {}
+        self._state: Dict[int, tuple] = {}
+        self._step = 0
+        self._rng = np.random.RandomState(seed)
+        self._init = initializer
+        self._scale = init_scale
+
+    def _new_row(self) -> np.ndarray:
+        if self._init == "zeros":
+            return np.zeros(self.dim, np.float32)
+        return self._rng.uniform(-self._scale, self._scale,
+                                 self.dim).astype(np.float32)
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        """[n] ids → [n, dim] rows (rows created on first touch)."""
+        out = np.empty((len(ids), self.dim), np.float32)
+        rows = self._rows
+        for i, raw in enumerate(np.asarray(ids).ravel()):
+            rid = int(raw)
+            r = rows.get(rid)
+            if r is None:
+                r = rows[rid] = self._new_row()
+            out[i] = r
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        """Apply the server-side rule to the pushed rows (sum-merged grads).
+
+        ≙ the accessor update on push_sparse (table.h:32 Push)."""
+        self._step += 1
+        ids = np.asarray(ids).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        if self.opt == "sgd":
+            for rid, g in zip(ids, grads):
+                rid = int(rid)
+                row = self._rows.get(rid)
+                if row is not None:
+                    row -= self.lr * g
+        elif self.opt == "adagrad":
+            for rid, g in zip(ids, grads):
+                rid = int(rid)
+                row = self._rows.get(rid)
+                if row is None:
+                    continue
+                acc = self._state.get(rid)
+                acc = acc[0] if acc else np.zeros(self.dim, np.float32)
+                acc += g * g
+                row -= self.lr * g / (np.sqrt(acc) + self.eps)
+                self._state[rid] = (acc,)
+        elif self.opt == "adam":
+            t = self._step
+            bc1 = 1 - self.beta1 ** t
+            bc2 = 1 - self.beta2 ** t
+            for rid, g in zip(ids, grads):
+                rid = int(rid)
+                row = self._rows.get(rid)
+                if row is None:
+                    continue
+                st = self._state.get(rid)
+                m, v = st if st else (np.zeros(self.dim, np.float32),
+                                      np.zeros(self.dim, np.float32))
+                m = self.beta1 * m + (1 - self.beta1) * g
+                v = self.beta2 * v + (1 - self.beta2) * g * g
+                row -= self.lr * (m / bc1) / (np.sqrt(v / bc2) + self.eps)
+                self._state[rid] = (m, v)
+        else:
+            raise ValueError(f"unknown sparse optimizer {self.opt}")
+
+    # -- introspection / checkpoint ------------------------------------------
+    def __len__(self):
+        return len(self._rows)
+
+    def state_dict(self):
+        return {"dim": self.dim, "opt": self.opt, "lr": self.lr,
+                "step": self._step,
+                "rows": {k: v.copy() for k, v in self._rows.items()},
+                "state": {k: tuple(s.copy() for s in v)
+                          for k, v in self._state.items()}}
+
+    def load_state_dict(self, sd):
+        self.dim = sd["dim"]
+        self._step = sd["step"]
+        self._rows = {int(k): np.asarray(v, np.float32)
+                      for k, v in sd["rows"].items()}
+        self._state = {int(k): tuple(np.asarray(s, np.float32) for s in v)
+                       for k, v in sd["state"].items()}
+
+
+class DenseTable:
+    """Flat dense parameter block with SGD-on-push (≙ common_dense_table)."""
+
+    def __init__(self, shape, lr: float = 0.01, seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.value = (rng.standard_normal(shape) *
+                      0.01).astype(np.float32)
+        self.lr = float(lr)
+
+    def pull(self) -> np.ndarray:
+        return self.value.copy()
+
+    def push(self, grad: np.ndarray):
+        self.value -= self.lr * np.asarray(grad, np.float32)

@@ -1,0 +1,58 @@
+"""paddle.distributed.spawn parity (python/paddle/distributed/spawn.py:276).
+
+On TPU the unit of spawning is one process per *host* (all local chips belong
+to one PJRT client), so nprocs>1 on a single host is only meaningful for
+CPU-simulated clusters (tests) — matching how the reference's own distributed
+tests run multi-process on localhost (SURVEY.md §4.3).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+from typing import Callable
+
+
+def _free_ports(n):
+    ports, socks = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _worker(func, rank, nprocs, endpoints, args):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+    os.environ["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
+    func(*args)
+
+
+def spawn(func: Callable, args=(), nprocs=1, join=True, daemon=False,
+          **options):
+    if nprocs == 1:
+        func(*args)
+        return None
+    ports = _free_ports(nprocs)
+    endpoints = [f"127.0.0.1:{p}" for p in ports]
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, endpoints, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(
+                    f"spawned rank failed with exit code {p.exitcode}")
+    return procs

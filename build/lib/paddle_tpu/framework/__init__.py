@@ -1,0 +1,27 @@
+"""Framework core: tensor, autograd, primitives, device, dtype, flags, rng."""
+from . import core
+from .core import (  # noqa: F401
+    in_dygraph_mode, in_static_mode, enable_static, disable_static,
+    no_grad_guard, set_grad_enabled,
+)
+from .dtype import (  # noqa: F401
+    bfloat16, float16, float32, float64, int8, int16, int32, int64, uint8,
+    bool_, complex64, convert_dtype, set_default_dtype, get_default_dtype,
+)
+from .flags import set_flags, get_flags, define_flag, flag  # noqa: F401
+from .place import (  # noqa: F401
+    Place, CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
+    set_device, get_device, current_place, device_count,
+    is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_tpu,
+)
+from .random import seed, get_rng_state, set_rng_state, default_generator  # noqa: F401
+from .tensor import Tensor, Parameter, to_tensor, unwrap, wrap  # noqa: F401
+from .autograd import grad, run_backward  # noqa: F401
+from .primitive import Primitive, primitive, get_primitive, all_primitives  # noqa: F401
+from . import enforce  # noqa: F401
+from .enforce import (  # noqa: F401
+    EnforceNotMet, InvalidArgumentError, NotFoundError, OutOfRangeError,
+    AlreadyExistsError, ResourceExhaustedError, PreconditionNotMetError,
+    PermissionDeniedError, ExecutionTimeoutError, UnimplementedError,
+    UnavailableError, FatalError, ExternalError,
+)

@@ -1,0 +1,426 @@
+"""Define-by-run autograd engine (tape).
+
+Reference parity: paddle/fluid/imperative/basic_engine.cc -- ``Init`` (:39)
+seeds the root cotangent, ``PrepareDeps`` (:154) BFS-counts grad-node
+dependencies, ``Execute`` (:191) runs a ready-queue of grad nodes with
+``GradientAccumulator`` summing multi-consumer grads. Double grad
+(partial_grad_engine.cc) is exposed via :func:`grad`.
+
+TPU-first: each tape node's backward is a *cached jitted XLA computation*
+(built once per op+shape via jax.vjp), so eager backward dispatches compiled
+kernels instead of interpreting -- the analogue of PreparedOp kernel caching
+(prepared_operator.cc).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+import jax
+import weakref
+
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+_float0 = jax.dtypes.float0
+
+
+class GradNode:
+    """One recorded op application: knows how to map out-cotangents to in-cotangents."""
+    __slots__ = ("name", "grad_fn", "primals", "inputs", "input_edges",
+                 "out_avals", "out_ct", "visited_tag", "__weakref__")
+
+    def __init__(self, name, grad_fn, primals, inputs, out_avals):
+        self.name = name
+        self.grad_fn = grad_fn        # (cts_tuple, *primals) -> tuple of input cts
+        self.primals = primals        # tuple of jax arrays (residual-free: replayed)
+        self.inputs = inputs          # tuple of Tensor refs aligned with primals
+        # graph edges captured at RECORD time: an in-place op re-pointing a
+        # consumed Tensor's _node later must not reroute this op's backward
+        # (the version-counter problem; basic_engine resolves edges eagerly
+        # too)
+        self.input_edges = tuple(
+            (t._node, t._out_index, t._version) if isinstance(t, Tensor)
+            else (None, None, 0)
+            for t in inputs)
+        # consumer back-edges, LEAF edges only: backward's in-place version
+        # check reads the edge version solely on (None, ·) edges, so only
+        # nodes holding a leaf edge to a tensor can ever need a re-stamp
+        # by an in-place op (_adopt).  Dead refs are compacted amortized
+        # (cap doubles on live size) so long runs don't leak weakrefs.
+        ref = weakref.ref(self)
+        for t in inputs:
+            if isinstance(t, Tensor) and t._node is None:
+                lst = t._consumers
+                if lst is None:
+                    lst = t._consumers = []
+                lst.append(ref)
+                if len(lst) >= t._consumers_cap:
+                    live = [r for r in lst if r() is not None]
+                    t._consumers = live
+                    t._consumers_cap = max(2 * len(live), 16)
+        self.out_avals = out_avals    # list[(shape, dtype)] per output
+        self.out_ct = None
+        self.visited_tag = 0
+
+    def seed(self, index, ct):
+        if self.out_ct is None:
+            self.out_ct = [None] * len(self.out_avals)
+        # dtype coercion: AMP casts at op dispatch are not part of any
+        # recorded vjp, so a downstream node may hand back a cotangent in a
+        # different precision than this node's output (fp32 ct for a bf16
+        # out); align to the recorded output dtype
+        dtype = self.out_avals[index][1]
+        if hasattr(ct, "dtype") and ct.dtype != dtype and \
+                ct.dtype != _float0:
+            ct = ct.astype(dtype)
+        cur = self.out_ct[index]
+        self.out_ct[index] = ct if cur is None else cur + ct
+
+    def materialize_cts(self):
+        cts = []
+        for i, (shape, dtype) in enumerate(self.out_avals):
+            ct = None if self.out_ct is None else self.out_ct[i]
+            if ct is None:
+                ct = jnp.zeros(shape, dtype)
+            cts.append(ct)
+        return tuple(cts)
+
+    def release(self):
+        self.primals = None
+        self.inputs = None
+        self.input_edges = None
+        self.out_ct = None
+        self.grad_fn = None
+
+
+_tag_counter = [0]
+
+
+def _accumulate_into_tensor(t: Tensor, ct):
+    from .selected_rows import SelectedRows
+    if isinstance(ct, SelectedRows):
+        # sparse accumulation (GradientAccumulator's SelectedRows branch,
+        # imperative/gradient_accumulator.cc): sparse+sparse concatenates,
+        # sparse+dense densifies.  Grad hooks see the SelectedRows itself
+        # (a hook may return a replacement — SelectedRows or dense).
+        for hook in t._hooks:
+            out = hook(ct)
+            if out is not None:
+                ct = out
+        if not isinstance(ct, SelectedRows):
+            ct = ct._value if isinstance(ct, Tensor) else ct
+            t.grad = Tensor(ct, stop_gradient=True) if t.grad is None \
+                else Tensor(t.grad._value + ct, stop_gradient=True)
+            return
+        if t.grad is None:
+            t.grad = ct
+        elif isinstance(t.grad, SelectedRows):
+            t.grad = t.grad + ct
+        else:
+            t.grad = Tensor(t.grad._value + ct.to_dense(),
+                            stop_gradient=True, name=t.name + "@GRAD")
+        return
+    if isinstance(t.grad, SelectedRows):
+        t.grad = Tensor(t.grad.to_dense() + ct, stop_gradient=True,
+                        name=t.name + "@GRAD")
+        return
+    if ct.dtype == _float0:
+        return
+    for hook in t._hooks:
+        out = hook(Tensor(ct, stop_gradient=True))
+        if out is not None:
+            ct = out._value if isinstance(out, Tensor) else out
+    if t.grad is None:
+        t.grad = Tensor(ct, stop_gradient=True, name=t.name + "@GRAD")
+    else:
+        t.grad = Tensor(t.grad._value + ct, stop_gradient=True,
+                        name=t.name + "@GRAD")
+
+
+def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None,
+                 retain_graph: bool = False):
+    """basic_engine.cc:39 Init + :191 Execute."""
+    if root.stop_gradient:
+        raise RuntimeError(
+            f"Tensor {root.name} has stop_gradient=True; cannot backward")
+    if grad_tensor is None:
+        if root.size != 1:
+            raise RuntimeError("grad_tensor must be given for non-scalar backward "
+                               "(loss must be a scalar)")
+        seed_ct = jnp.ones(root._value.shape, root._value.dtype)
+    else:
+        seed_ct = grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    node = root._node
+    if node is None:
+        _accumulate_into_tensor(root, seed_ct)
+        return
+
+    # PrepareDeps (basic_engine.cc:154): count consumer edges per reachable node
+    _tag_counter[0] += 1
+    tag = _tag_counter[0]
+    deps = {}
+    stack = [node]
+    node.visited_tag = tag
+    order = []
+    while stack:
+        n = stack.pop()
+        order.append(n)
+        for (p, _, _) in n.input_edges:
+            if p is None:
+                continue
+            deps[id(p)] = deps.get(id(p), 0) + 1
+            if p.visited_tag != tag:
+                p.visited_tag = tag
+                stack.append(p)
+
+    node.seed(root._out_index, seed_ct)
+    queue = deque([node])
+    processed = []
+    while queue:
+        n = queue.popleft()
+        processed.append(n)
+        cts = n.materialize_cts()
+        in_cts = n.grad_fn(cts, *n.primals)
+        for t, (p, out_idx, ver), ct in zip(n.inputs, n.input_edges,
+                                            in_cts):
+            if not isinstance(t, Tensor):
+                continue
+            zero_ct = ct.dtype == _float0
+            if p is not None:
+                # deps bookkeeping runs even for float0 cotangents (int
+                # outputs): skipping it would starve the parent node and
+                # silently drop its OTHER edges' real gradients
+                if not zero_ct:
+                    p.seed(out_idx, ct)
+                    if t._retain_grads and not t.stop_gradient:
+                        _accumulate_into_tensor(t, ct)
+                deps[id(p)] -= 1
+                if deps[id(p)] == 0:
+                    queue.append(p)
+            elif not zero_ct and not t.stop_gradient:
+                # ver None = edge exempted by _adopt: the op is part of the
+                # tensor's own in-place lineage (its primals captured the
+                # value it consumed, so replay is always valid)
+                if ver is not None and t._version != ver:
+                    raise RuntimeError(
+                        f"leaf Tensor {t.name} was modified by an in-place "
+                        f"operation after being consumed by {n.name}; "
+                        f"gradients would apply to a stale version "
+                        f"(version {ver} vs {t._version})")
+                _accumulate_into_tensor(t, ct)
+        if not retain_graph:
+            n.release()
+    if not retain_graph:
+        root._node = None
+    root._bwd_done = True
+
+
+# ---------------------------------------------------------------------------
+# Double grad (create_graph=True): a *recording* backward pass.  Instead of
+# running each node's jitted grad_fn on raw arrays, the backward computation
+# itself is applied through the tape — cotangents are Tensors, each node
+# application records a new GradNode whose grad_fn is jax.vjp of the first
+# backward.  The returned gradients therefore carry a live autograd graph and
+# can be differentiated again (PartialGradEngine / partial_grad_engine.cc
+# ``create_graph`` parity).  Known limitation: AMP autocast inside the first
+# forward is replayed at the original input dtypes, so mixing auto_cast with
+# double grad is unsupported.
+# ---------------------------------------------------------------------------
+
+_second_order_cache: dict = {}
+
+
+def _recorded_grad_apply(n: GradNode):
+    """Apply node n's grad_fn with Tensor cotangents, recording the result."""
+    import numpy as np
+    n_cts = len(n.out_avals)
+
+    cts = []
+    for i, (shape, dtype) in enumerate(n.out_avals):
+        ct = None if n.out_ct is None else n.out_ct[i]
+        if ct is None:
+            ct = Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
+        cts.append(ct)
+
+    args = list(cts)
+    for i, t in enumerate(n.inputs):
+        args.append(t if isinstance(t, Tensor) else n.primals[i])
+
+    grad_fn = n.grad_fn
+    key = (id(grad_fn), n_cts)
+    hit = _second_order_cache.get(key)
+    if hit is None:
+        def flat(*a, _g=grad_fn, _n=n_cts):
+            return _g(tuple(a[:_n]), *a[_n:])
+        # the strong ref to grad_fn pins its id so the cache key can't alias
+        # a recycled id after the node releases its own reference
+        _second_order_cache[key] = (flat, grad_fn)
+    else:
+        flat = hit[0]
+
+    arrs = tuple(a._value if isinstance(a, Tensor) else a for a in args)
+    outs = flat(*arrs)
+
+    from . import core
+    needs = core.grad_enabled() and any(
+        isinstance(a, Tensor) and not a.stop_gradient for a in args)
+    tensors = []
+    rec_idx = []           # output slots that participate in the new node
+    for i, o in enumerate(outs):
+        sg = (not needs) or o.dtype == _float0
+        tensors.append(Tensor(o, stop_gradient=sg))
+        if not sg:
+            rec_idx.append(i)
+    if needs and rec_idx:
+        node = GradNode(
+            n.name + "_grad", None, arrs,
+            tuple(a if isinstance(a, Tensor) else None for a in args),
+            [(np.shape(o), o.dtype) for o in outs])
+
+        def bwd(cts2, *primals, _flat=flat):
+            _, vjp = jax.vjp(_flat, *primals)
+            return vjp(cts2)
+        node.grad_fn = bwd
+        for i in rec_idx:
+            t = tensors[i]
+            t._node = node
+            t._out_index = i
+            t.is_leaf = False
+    return tensors
+
+
+def _seed_recorded(out_ct, index, aval, ct):
+    """Tensor-valued GradNode.seed: accumulate via recorded add/cast ops."""
+    dtype = aval[1]
+    if ct._value.dtype != dtype and ct._value.dtype != _float0:
+        ct = ct.astype(dtype) if hasattr(ct, "astype") else ct
+    cur = out_ct[index]
+    out_ct[index] = ct if cur is None else cur + ct
+
+
+def _backward_recorded(root: Tensor, seed: Tensor, wanted, table,
+                       retain_graph: bool):
+    """run_backward twin where cotangents are Tensors on a live tape."""
+    node = root._node
+    if node is None:
+        if id(root) in wanted:
+            cur = table.get(id(root))
+            table[id(root)] = seed if cur is None else cur + seed
+        return
+
+    _tag_counter[0] += 1
+    tag = _tag_counter[0]
+    deps = {}
+    stack = [node]
+    node.visited_tag = tag
+    while stack:
+        n = stack.pop()
+        for (p, _, _) in n.input_edges:
+            if p is None:
+                continue
+            deps[id(p)] = deps.get(id(p), 0) + 1
+            if p.visited_tag != tag:
+                p.visited_tag = tag
+                stack.append(p)
+
+    # Tensor-valued cotangent accumulation lives in a side dict so the
+    # original nodes' out_ct slots stay array-typed for later plain backward
+    out_cts = {id(node): [None] * len(node.out_avals)}
+    _seed_recorded(out_cts[id(node)], root._out_index, node.out_avals[root._out_index], seed)
+    queue = deque([node])
+    while queue:
+        n = queue.popleft()
+        n.out_ct = out_cts.get(id(n))        # borrowed by _recorded_grad_apply
+        in_cts = _recorded_grad_apply(n)
+        n.out_ct = None
+        for t, (p, out_idx, ver), ct in zip(n.inputs, n.input_edges,
+                                            in_cts):
+            if not isinstance(t, Tensor):
+                continue
+            zero_ct = ct._value.dtype == _float0
+            if not zero_ct and id(t) in wanted:
+                if p is None and ver is not None and t._version != ver:
+                    raise RuntimeError(
+                        f"leaf Tensor {t.name} was modified by an in-place "
+                        f"operation after being consumed by {n.name} "
+                        f"(version {ver} vs {t._version})")
+                cur = table.get(id(t))
+                table[id(t)] = ct if cur is None else cur + ct
+            if p is not None:
+                if not zero_ct:
+                    slot = out_cts.get(id(p))
+                    if slot is None:
+                        slot = out_cts[id(p)] = [None] * len(p.out_avals)
+                    _seed_recorded(slot, out_idx, p.out_avals[out_idx], ct)
+                deps[id(p)] -= 1
+                if deps[id(p)] == 0:
+                    queue.append(p)
+        if not retain_graph:
+            n.release()
+    if not retain_graph:
+        root._node = None
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad parity (partial_grad_engine.cc).
+
+    Returns grads of ``outputs`` w.r.t. ``inputs`` without touching ``.grad``
+    slots. With ``create_graph=True`` the backward pass itself is recorded on
+    the tape (each grad op's VJP derived by jax.vjp of the first backward), so
+    the returned gradients can be differentiated again — double/higher-order
+    grad.
+    """
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and len(grad_outputs) != len(outputs):
+        raise ValueError(
+            f"grad_outputs has {len(grad_outputs)} entries but outputs has "
+            f"{len(outputs)}; they must match (use None entries for "
+            "default ones-like seeds)")
+    if create_graph:
+        retain = True if retain_graph is None else bool(retain_graph)
+        table: dict = {}
+        wanted = {id(t) for t in inputs}
+        gos = grad_outputs or [None] * len(outputs)
+        for o, go in zip(outputs, gos):
+            if go is None:
+                seed = Tensor(jnp.ones(o._value.shape, o._value.dtype),
+                              stop_gradient=True)
+            elif isinstance(go, Tensor):
+                seed = go
+            else:
+                seed = Tensor(jnp.asarray(go), stop_gradient=True)
+            _backward_recorded(o, seed, wanted, table, retain)
+        results = []
+        for t in inputs:
+            g = table.get(id(t))
+            if g is None and not allow_unused:
+                raise RuntimeError(f"input {t.name} unused in graph "
+                                   "(pass allow_unused=True to permit)")
+            results.append(g)
+        return results
+    # run a private backward that records into a side table
+    saved = [(t, t.grad, t._retain_grads, t.stop_gradient) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t._retain_grads = True
+        t.stop_gradient = False
+    try:
+        for o, go in zip(outputs, grad_outputs or [None] * len(outputs)):
+            run_backward(o, go, retain_graph=bool(retain_graph))
+        results = []
+        for t in inputs:
+            if t.grad is None and not allow_unused:
+                raise RuntimeError(f"input {t.name} unused in graph "
+                                   "(pass allow_unused=True to permit)")
+            results.append(t.grad)
+        return results
+    finally:
+        for t, g, r, sg in saved:
+            t.grad = g
+            t._retain_grads = r
+            t.stop_gradient = sg

@@ -1,0 +1,107 @@
+"""Process-wide mode state (eager/static, grad on/off).
+
+Reference parity: python/paddle/fluid/framework.py:182 (in_dygraph_mode and the
+_dygraph_tracer global) plus paddle/fluid/imperative/tracer.cc has_grad flag.
+The TPU build keeps only what matters: a grad-recording switch for the eager
+tape and a static-graph-mode switch consulted by dual-mode APIs.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.static_mode = False
+        self.amp_state = None  # set by paddle_tpu.amp.auto_cast
+
+
+_state = _State()
+
+
+def grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+def in_dygraph_mode() -> bool:
+    return not _state.static_mode
+
+
+def in_static_mode() -> bool:
+    return _state.static_mode
+
+
+def amp_state():
+    return _state.amp_state
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    prev = _state.grad_enabled
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad_guard():
+    prev = _state.grad_enabled
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    prev = _state.grad_enabled
+    _state.grad_enabled = bool(mode)
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def static_mode_guard():
+    prev = _state.static_mode
+    _state.static_mode = True
+    try:
+        yield
+    finally:
+        _state.static_mode = prev
+
+
+@contextlib.contextmanager
+def dygraph_mode_guard():
+    """Temporarily force eager dispatch (used when a recorded macro op
+    replays user callables over tracer-backed Tensors at compile time)."""
+    prev = _state.static_mode
+    _state.static_mode = False
+    try:
+        yield
+    finally:
+        _state.static_mode = prev
+
+
+@contextlib.contextmanager
+def amp_guard_state(state):
+    prev = _state.amp_state
+    _state.amp_state = state
+    try:
+        yield
+    finally:
+        _state.amp_state = prev
+
+
+def enable_static():
+    _state.static_mode = True
+
+
+def disable_static():
+    _state.static_mode = False

@@ -1,0 +1,94 @@
+"""Model encryption: AES-GCM cipher for saved artifacts.
+
+Reference parity: paddle/fluid/framework/io/crypto/ (AESCipher over
+a GCM mode, CipherUtils::GenKey/GenKeyToFile/ReadKeyFromFile) +
+pybind/crypto.cc — the WITH_CRYPTO build feature that encrypts
+save_combine output so checkpoints/inference models at rest are opaque.
+
+Here the cipher wraps any saved file (state_dict pickles, jit.save
+artifacts, inference model dirs): encrypt_to_file/decrypt_from_file work
+on bytes, so every persistence path can opt in without format changes.
+"""
+from __future__ import annotations
+
+import os
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+
+class CipherUtils:
+    """CipherUtils (crypto/cipher_utils.h) parity."""
+
+    @staticmethod
+    def gen_key(length_bits: int = 256) -> bytes:
+        if length_bits not in (128, 192, 256):
+            raise ValueError("AES key length must be 128/192/256 bits")
+        return AESGCM.generate_key(bit_length=length_bits)
+
+    @staticmethod
+    def gen_key_to_file(length_bits: int, path: str) -> bytes:
+        key = CipherUtils.gen_key(length_bits)
+        # created 0600 atomically: no world-readable window before chmod
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        try:
+            os.write(fd, key)
+        finally:
+            os.close(fd)
+        return key
+
+    @staticmethod
+    def read_key_from_file(path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+
+class AESCipher:
+    """AES-GCM cipher (crypto/aes_cipher.h parity): authenticated — a
+    tampered or wrong-key artifact fails loudly at decrypt."""
+
+    _MAGIC = b"PTPUENC1"
+    _NONCE_LEN = 12
+
+    def __init__(self, key: bytes):
+        self._aes = AESGCM(key)
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        nonce = os.urandom(self._NONCE_LEN)
+        ct = self._aes.encrypt(nonce, plaintext, self._MAGIC)
+        return self._MAGIC + nonce + ct
+
+    def decrypt(self, blob: bytes) -> bytes:
+        if not blob.startswith(self._MAGIC):
+            raise ValueError("not a paddle_tpu-encrypted artifact")
+        nonce = blob[len(self._MAGIC):len(self._MAGIC) + self._NONCE_LEN]
+        ct = blob[len(self._MAGIC) + self._NONCE_LEN:]
+        return self._aes.decrypt(nonce, ct, self._MAGIC)
+
+    # -- file helpers (CipherUtils-style surface) ----------------------------
+    def encrypt_to_file(self, plaintext: bytes, path: str):
+        with open(path, "wb") as f:
+            f.write(self.encrypt(plaintext))
+
+    def decrypt_from_file(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return self.decrypt(f.read())
+
+    def encrypt_file(self, src: str, dst: str = None):
+        """Encrypt an existing saved artifact in place (or to dst)."""
+        with open(src, "rb") as f:
+            data = f.read()
+        self.encrypt_to_file(data, dst or src)
+
+    def decrypt_file(self, src: str, dst: str = None):
+        data = self.decrypt_from_file(src)
+        with open(dst or src, "wb") as f:
+            f.write(data)
+
+
+class CipherFactory:
+    """CipherFactory::CreateCipher parity (config-file selection collapses
+    to the one supported cipher)."""
+
+    @staticmethod
+    def create_cipher(config_file: str = None) -> "type[AESCipher]":
+        return AESCipher

@@ -1,0 +1,118 @@
+"""Dtype system.
+
+Reference parity: paddle/fluid/framework/framework.proto:91 (VarType.Type dtype
+enum) and python/paddle/fluid/data_feeder.py dtype conversion. TPU-first: the
+canonical storage is a jax/numpy dtype; bfloat16 is first-class (MXU native),
+float64 is discouraged (TPU emulates it) but supported for CPU tests.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (numpy dtype instances; bfloat16 via ml_dtypes through jnp)
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+
+_ALIASES = {
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float16": float16, "fp16": float16, "half": float16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int": int32,
+    "int64": int64, "long": int64, "uint8": uint8,
+    "bool": bool_, "complex64": complex64,
+}
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def _canonical(dt):
+    """TPU-first canonicalization: without the x64 flag, 64-bit types store as
+    32-bit (XLA:TPU has no fast int64/float64 path). Mirrors jax's own x32
+    default so Paddle's int64-heavy API surface stays quiet and fast."""
+    import jax
+    if jax.config.jax_enable_x64:
+        return dt
+    table = {jnp.dtype(jnp.int64): jnp.dtype(jnp.int32),
+             jnp.dtype(jnp.uint64): jnp.dtype(jnp.uint32),
+             jnp.dtype(jnp.float64): jnp.dtype(jnp.float32)}
+    return table.get(dt, dt)
+
+
+def convert_dtype(dtype):
+    """Normalize a user-provided dtype (str | np.dtype | jnp dtype | None)."""
+    if dtype is None:
+        return None
+    import jax
+    try:
+        if jax.dtypes.issubdtype(dtype, jax.dtypes.extended):
+            return dtype  # PRNG key dtypes etc.: pass through unchanged
+    except TypeError:
+        pass
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key.startswith("paddle."):
+            key = key.split(".", 1)[1]
+        if key not in _ALIASES:
+            raise TypeError(f"unsupported dtype {dtype!r}")
+        return _canonical(jnp.dtype(_ALIASES[key]))
+    return _canonical(jnp.dtype(dtype))
+
+
+def dtype_name(dtype) -> str:
+    name = jnp.dtype(dtype).name
+    return name
+
+
+def set_default_dtype(dtype):
+    """paddle.set_default_dtype parity (python/paddle/framework/framework.py)."""
+    dtype = convert_dtype(dtype)
+    if dtype not in (jnp.dtype(float16), jnp.dtype(bfloat16), jnp.dtype(float32),
+                     jnp.dtype(float64)):
+        raise TypeError("default dtype must be a floating dtype")
+    _DEFAULT_DTYPE[0] = dtype
+    return dtype
+
+
+def get_default_dtype():
+    return jnp.dtype(_DEFAULT_DTYPE[0])
+
+
+def index_dtype():
+    """Canonical integer dtype for indices (int64 API surface, int32 storage
+    on TPU unless x64 is enabled)."""
+    import jax
+    return jnp.dtype(jnp.int64) if jax.config.jax_enable_x64 else jnp.dtype(jnp.int32)
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer) or jnp.dtype(dtype) == jnp.bool_
+
+
+def promote(*dtypes):
+    return jnp.result_type(*dtypes)
+
+
+def np_cast(value, dtype=None):
+    arr = np.asarray(value)
+    if dtype is not None:
+        arr = arr.astype(convert_dtype(dtype))
+    elif arr.dtype == np.float64:
+        arr = arr.astype(get_default_dtype())
+    elif arr.dtype == np.int64 and arr.dtype != np.dtype("int64"):
+        pass
+    return arr

@@ -1,0 +1,168 @@
+"""Rich error layer: PADDLE_ENFORCE parity.
+
+Reference parity: paddle/fluid/platform/enforce.h (PADDLE_ENFORCE_* macro
+family + EnforceNotMet) and paddle/fluid/platform/errors.h (the error-code
+taxonomy: InvalidArgument, NotFound, OutOfRange, AlreadyExists,
+ResourceExhausted, PreconditionNotMet, PermissionDenied, ExecutionTimeout,
+Unimplemented, Unavailable, Fatal, External).
+
+TPU-shape: the reference's macros capture __FILE__/__LINE__ and build a
+C++ stack summary; here each error type is an exception class carrying the
+error-code name, and ``op_context`` wraps op dispatch so any failure inside
+a primitive (shape mismatch, XLA compile error) resurfaces with the
+operator name and argument summary attached — the OperatorWithKernel
+try/catch at operator.cc:1093.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+
+class EnforceNotMet(RuntimeError):
+    """Base enforce failure (enforce.h EnforceNotMet)."""
+
+    code = "LEGACY"
+
+    def __init__(self, msg, op=None):
+        self.op = op
+        if op:
+            msg = f"(op: {op}) {msg}"
+        super().__init__(f"[{self.code}] {msg}")
+
+
+class InvalidArgumentError(EnforceNotMet):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceNotMet):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceNotMet):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(EnforceNotMet):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(EnforceNotMet):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(EnforceNotMet):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(EnforceNotMet):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceNotMet):
+    code = "UNAVAILABLE"
+
+
+class FatalError(EnforceNotMet):
+    code = "FATAL"
+
+
+class ExternalError(EnforceNotMet):
+    code = "EXTERNAL"
+
+
+# -- enforce checks (PADDLE_ENFORCE_* macros) ---------------------------------
+
+def enforce(cond, msg="enforce failed", exc=InvalidArgumentError, op=None):
+    """PADDLE_ENFORCE(cond, ...)."""
+    if not cond:
+        raise exc(msg, op=op)
+
+
+def enforce_not_none(value, name="value", op=None):
+    """PADDLE_ENFORCE_NOT_NULL."""
+    if value is None:
+        raise NotFoundError(f"{name} should not be None", op=op)
+    return value
+
+
+def enforce_eq(a, b, msg=None, op=None):
+    """PADDLE_ENFORCE_EQ."""
+    if a != b:
+        raise InvalidArgumentError(
+            msg or f"expected {a!r} == {b!r}", op=op)
+
+
+def enforce_ne(a, b, msg=None, op=None):
+    if a == b:
+        raise InvalidArgumentError(
+            msg or f"expected {a!r} != {b!r}", op=op)
+
+
+def enforce_gt(a, b, msg=None, op=None):
+    if not a > b:
+        raise InvalidArgumentError(msg or f"expected {a!r} > {b!r}", op=op)
+
+
+def enforce_ge(a, b, msg=None, op=None):
+    if not a >= b:
+        raise InvalidArgumentError(msg or f"expected {a!r} >= {b!r}", op=op)
+
+
+def enforce_lt(a, b, msg=None, op=None):
+    if not a < b:
+        raise InvalidArgumentError(msg or f"expected {a!r} < {b!r}", op=op)
+
+
+def enforce_le(a, b, msg=None, op=None):
+    if not a <= b:
+        raise InvalidArgumentError(msg or f"expected {a!r} <= {b!r}", op=op)
+
+
+def enforce_shape_match(got, expected, name="tensor", op=None):
+    """Shape check with a reference-style actionable message."""
+    if tuple(got) != tuple(expected):
+        raise InvalidArgumentError(
+            f"{name} shape mismatch: got {list(got)}, expected "
+            f"{list(expected)}", op=op)
+
+
+# -- op dispatch wrapping ------------------------------------------------------
+
+def _summarize(args):
+    parts = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None:
+            parts.append(f"{dtype}[{','.join(map(str, shape))}]")
+        else:
+            parts.append(repr(a)[:40])
+    return ", ".join(parts)
+
+
+@contextlib.contextmanager
+def op_context(op_name, args=()):
+    """Attach operator context to any error escaping an op's kernel —
+    the OperatorWithKernel::RunImpl try/catch (operator.cc:1093) that turns
+    a bare kernel failure into an EnforceNotMet with op provenance."""
+    try:
+        yield
+    except EnforceNotMet:
+        raise
+    except (TypeError, ValueError, IndexError, ZeroDivisionError) as e:
+        raise InvalidArgumentError(
+            f"{e} [operands: {_summarize(args)}]", op=op_name) from e
+    except NotImplementedError as e:
+        raise UnimplementedError(str(e), op=op_name) from e
+    except RuntimeError as e:
+        raise ExternalError(
+            f"{e} [operands: {_summarize(args)}]", op=op_name) from e

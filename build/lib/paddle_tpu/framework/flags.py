@@ -1,0 +1,110 @@
+"""Global flag registry.
+
+Reference parity: paddle/fluid/platform/flags.cc (27 DEFINE_* gflags),
+pybind/global_value_getter_setter.cc:325 (REGISTER_PUBLIC_GLOBAL_VAR) and the
+Python bridge paddle.set_flags/get_flags (python/paddle/fluid/framework.py:5743).
+
+TPU-first: one Python-side registry; every flag can be seeded from the
+environment (``FLAGS_xxx=...``) at import, exactly like InitGflags
+(platform/init.h:34) parses env on startup. Subsystems read flags lazily so
+set_flags takes effect between steps.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+_REGISTRY: Dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "doc", "validator", "writable")
+
+    def __init__(self, name, default, doc="", validator=None, writable=True):
+        self.name = name
+        self.default = default
+        self.doc = doc
+        self.validator = validator
+        self.writable = writable
+        self.value = self._from_env(default)
+
+    def _from_env(self, default):
+        raw = os.environ.get("FLAGS_" + self.name)
+        if raw is None:
+            return default
+        if isinstance(default, bool):
+            return raw.lower() in ("1", "true", "yes", "on")
+        if isinstance(default, int):
+            return int(raw)
+        if isinstance(default, float):
+            return float(raw)
+        return raw
+
+
+def define_flag(name: str, default: Any, doc: str = "",
+                validator: Optional[Callable[[Any], bool]] = None,
+                writable: bool = True) -> None:
+    if name not in _REGISTRY:
+        _REGISTRY[name] = _Flag(name, default, doc, validator, writable)
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """paddle.set_flags parity (framework.py:5743)."""
+    for name, value in flags.items():
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {name!r}")
+        flag = _REGISTRY[key]
+        if not flag.writable:
+            raise ValueError(f"flag {name!r} is not public-writable")
+        if flag.validator is not None and not flag.validator(value):
+            raise ValueError(f"invalid value {value!r} for flag {name!r}")
+        flag.value = value
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    """paddle.get_flags parity (framework.py:5766)."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {name!r}")
+        out[name] = _REGISTRY[key].value
+    return out
+
+
+def flag(name: str) -> Any:
+    return _REGISTRY[name].value
+
+
+def all_flags() -> Dict[str, Any]:
+    return {f"FLAGS_{k}": v.value for k, v in _REGISTRY.items()}
+
+
+# ---- Core flags (subset of platform/flags.cc relevant on TPU) ----------------
+define_flag("check_nan_inf", False,
+            "Sweep op outputs for NaN/Inf each eager op (flags.cc:45 parity; "
+            "TPU impl uses jnp.isfinite reductions).")
+define_flag("benchmark", False,
+            "Synchronize after every eager op and record timings "
+            "(operator.cc:1163 parity; TPU impl: block_until_ready per op).")
+define_flag("eager_delete_tensor_gb", 0.0,
+            "GC threshold parity (flags.cc); no-op on TPU (XLA owns buffers).")
+define_flag("use_pallas_kernels", True,
+            "Lower hot fused ops (attention, layernorm) through Pallas TPU "
+            "kernels when running on TPU; fall back to jnp otherwise.")
+define_flag("allocator_strategy", "auto_growth",
+            "allocator_strategy parity (allocator_strategy.h:21); informational "
+            "on TPU -- PJRT owns HBM via BFC.")
+define_flag("cudnn_deterministic", False,
+            "Determinism flag parity (flags.cc:98); on TPU compiled programs "
+            "are deterministic by default.")
+define_flag("fraction_of_gpu_memory_to_use", 0.92,
+            "Memory-fraction parity; forwarded informationally.")
+define_flag("paddle_num_threads", 1, "Host-side intra-op threads parity.")
+define_flag("static_executor_mode", "fused",
+            "'fused' compiles a whole Program into one XLA computation "
+            "(idiomatic TPU); 'op_by_op' interprets per-op for debugging "
+            "(executor.cc:473 hot-loop parity).")

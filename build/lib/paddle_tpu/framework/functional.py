@@ -1,0 +1,156 @@
+"""Functional bridge: eager Layer -> (pytree state, pure apply fn).
+
+This is the TPU-idiomatic replacement for the reference's dual execution
+engines. Where Paddle either interprets a ProgramDesc op-by-op
+(paddle/fluid/framework/executor.cc:473) or traces dygraph ops one at a time
+(imperative/tracer.cc:131), the TPU build turns a whole model invocation into
+ONE pure jax function of an explicit parameter pytree, so jax.jit/pjit compile
+it into a single fused XLA computation and jax.grad/jax.checkpoint/shard_map
+compose with it.
+
+Everything performance-critical rides this bridge: the compiled train step
+(parallel/train_step.py), @to_static (jit/), the static Executor (static/),
+and hapi Model.fit.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import core
+from .tensor import Tensor
+from . import random as random_mod
+
+
+def layer_state(layer) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """Extract (params, buffers) as flat {qualified_name: jax.Array} dicts.
+
+    Canonicalizes each Parameter's ``name`` to its qualified path so the
+    eager optimizer accumulators (keyed by p.name) and the functional state
+    (keyed by these dict keys) agree — switching between eager and compiled
+    training must not orphan optimizer state.
+    """
+    params = {}
+    for n, p in layer.named_parameters():
+        p.name = n
+        params[n] = p._value
+    buffers = {n: b._value for n, b in layer.named_buffers() if b is not None}
+    return params, buffers
+
+
+def load_layer_state(layer, params: Dict[str, Any], buffers: Dict[str, Any] = None):
+    """Write arrays back into the live Layer (inverse of layer_state)."""
+    pmap = dict(layer.named_parameters())
+    for n, v in params.items():
+        if n in pmap:
+            pmap[n]._value = v if isinstance(v, jax.Array) else jnp.asarray(v)
+    if buffers:
+        bmap = dict(layer.named_buffers())
+        for n, v in buffers.items():
+            if n in bmap and bmap[n] is not None:
+                bmap[n]._value = v if isinstance(v, jax.Array) else jnp.asarray(v)
+
+
+@contextlib.contextmanager
+def _bound_state(layer, params, buffers):
+    """Temporarily swap the given arrays into the Layer's Tensors.
+
+    Safe under jax tracing: Tensor._value may hold a tracer for the duration
+    of the trace; originals are restored afterwards.
+    """
+    pmap = dict(layer.named_parameters())
+    bmap = dict(layer.named_buffers())
+    saved_p = {n: t._value for n, t in pmap.items()}
+    saved_b = {n: t._value for n, t in bmap.items() if t is not None}
+    try:
+        for n, v in params.items():
+            if n in pmap:
+                pmap[n]._value = v
+        if buffers:
+            for n, v in buffers.items():
+                if n in bmap and bmap[n] is not None:
+                    bmap[n]._value = v
+        yield
+    finally:
+        for n, v in saved_p.items():
+            pmap[n]._value = v
+        for n, v in saved_b.items():
+            bmap[n]._value = v
+
+
+def _unwrap(out):
+    if isinstance(out, Tensor):
+        return out._value
+    if isinstance(out, (list, tuple)):
+        return type(out)(_unwrap(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _unwrap(v) for k, v in out.items()}
+    return out
+
+
+def _wrap_inputs(args):
+    wrapped = []
+    for a in args:
+        if isinstance(a, jax.Array) or hasattr(a, "shape"):
+            wrapped.append(Tensor(a))
+        else:
+            wrapped.append(a)
+    return tuple(wrapped)
+
+
+def functional_call(layer, params, buffers, args, kwargs=None, *,
+                    training=False, rng_key=None, mutable_buffers=False):
+    """Run ``layer(*args, **kwargs)`` as a pure function of ``params``.
+
+    Inputs/outputs are raw jax arrays (pytrees thereof). No tape is recorded --
+    gradients of the result come from jax.grad over this function, which is
+    the TPU analogue of append_backward (python/paddle/fluid/backward.py:1288):
+    backward is derived from the whole traced computation, not per-op.
+
+    If ``mutable_buffers`` the (possibly updated) buffer dict is returned as a
+    second output (batch-norm running stats under jit).
+    """
+    kwargs = kwargs or {}
+    prev_training = layer.training
+    if training:
+        layer.train()
+    else:
+        layer.eval()
+    gen = random_mod.default_generator
+    pushed = False
+    if rng_key is not None:
+        gen.push_traced_key(rng_key)
+        pushed = True
+    try:
+        with core.no_grad_guard(), _bound_state(layer, params, buffers):
+            out = layer(*_wrap_inputs(args), **kwargs)
+            result = _unwrap(out)
+            if mutable_buffers:
+                new_buffers = {n: b._value for n, b in layer.named_buffers()
+                               if b is not None}
+                return result, new_buffers
+            return result
+    finally:
+        if pushed:
+            gen.pop_traced_key()
+        if prev_training:
+            layer.train()
+        else:
+            layer.eval()
+
+
+def functionalize(layer, *, training=False, with_buffers=None):
+    """Return ``(apply, params, buffers)`` where ``apply(params, buffers,
+    *inputs, rng_key=None)`` is a pure, jittable function."""
+    params, buffers = layer_state(layer)
+    if with_buffers is None:
+        with_buffers = training  # buffers mutate (BN stats) only in training
+
+    def apply(p, b, *inputs, rng_key=None):
+        return functional_call(layer, p, b, inputs, training=training,
+                               rng_key=rng_key, mutable_buffers=with_buffers)
+
+    return apply, params, buffers

@@ -1,0 +1,67 @@
+"""paddle.save / paddle.load: state-dict serialization.
+
+Reference parity: python/paddle/fluid/dygraph/checkpoint.py:56 (save_dygraph)
+/ :128 (load_dygraph) and the paddle.save/paddle.load 2.x entry points
+(python/paddle/framework/io.py).  Format: pickle of a nested dict whose
+leaves are numpy arrays (+ a small header), interoperable across hosts; the
+reference's per-var save/load ops (operators/save_op.cc) are host-side IO and
+gain nothing from being graph ops on TPU.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .tensor import Tensor
+
+_MAGIC = "paddle_tpu.checkpoint.v1"
+
+
+def _to_saveable(obj: Any):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": obj.numpy(), "name": obj.name,
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    if hasattr(obj, "dtype") and hasattr(obj, "shape") and \
+            not isinstance(obj, np.ndarray):
+        return {"__tensor__": True, "data": np.asarray(obj), "name": None,
+                "stop_gradient": True}
+    return obj
+
+
+def _from_saveable(obj: Any, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True))
+            if obj.get("name"):
+                t.name = obj["name"]
+            return t
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = {"magic": _MAGIC, "obj": _to_saveable(obj)}
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs):
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if isinstance(payload, dict) and payload.get("magic") == _MAGIC:
+        return _from_saveable(payload["obj"], return_numpy)
+    return _from_saveable(payload, return_numpy)

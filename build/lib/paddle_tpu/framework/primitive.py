@@ -1,0 +1,223 @@
+"""Primitive op machinery: registry, jitted dispatch, cached VJPs.
+
+Reference parity: this is the TPU replacement for the whole
+OperatorWithKernel::RunImpl pipeline (paddle/fluid/framework/operator.cc:1093)
+plus the op registry (op_registry.h:256) and the dygraph PreparedOp cache
+(imperative/prepared_operator.cc). Where Paddle dispatches a hand-written
+CUDA/Eigen kernel per OpKernelType, here every primitive is a pure jax function
+lowered by XLA:TPU; "kernel choice" collapses to one jit cache keyed by
+(op, static attrs) with shape/dtype specialization handled by jax.jit itself.
+
+Backward: instead of registering a grad op per forward op (GradOpMaker), each
+primitive's VJP is derived by jax.vjp and jitted once per (op, attrs, shapes).
+Ops that need custom gradients (e.g. Pallas kernels) use jax.custom_vjp inside
+their ``fn`` -- the tape machinery is agnostic.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from . import core
+from .flags import flag
+from .autograd import GradNode
+from .tensor import Tensor
+
+_PRIMS: Dict[str, "Primitive"] = {}
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    import numpy as np
+    if isinstance(v, np.dtype):
+        return str(v)
+    return v
+
+
+def _attrs_key(attrs):
+    return tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+
+
+class Primitive:
+    """A registered op: pure jax fn (*arrays, **static_attrs) -> array|tuple."""
+
+    def __init__(self, name: str, fn: Callable, multi_output: bool = False,
+                 differentiable: bool = True):
+        self.name = name
+        self.fn = fn
+        self.multi_output = multi_output
+        self.differentiable = differentiable
+        self._fwd_cache: Dict = {}
+        self._bwd_cache: Dict = {}
+        _PRIMS[name] = self
+
+    # -- compiled callables --------------------------------------------------
+    def _fwd(self, key, attrs):
+        f = self._fwd_cache.get(key)
+        if f is None:
+            base = functools.partial(self.fn, **attrs) if attrs else self.fn
+            f = jax.jit(base)
+            self._fwd_cache[key] = f
+        return f
+
+    def _bwd(self, key, attrs):
+        f = self._bwd_cache.get(key)
+        if f is None:
+            base = functools.partial(self.fn, **attrs) if attrs else self.fn
+            multi = self.multi_output
+
+            def backward(cts, *primals):
+                _, vjp = jax.vjp(base, *primals)
+                return vjp(cts if multi else cts[0])
+
+            f = jax.jit(backward)
+            self._bwd_cache[key] = f
+        return f
+
+    # -- static-graph recording ----------------------------------------------
+    def _append_static(self, args, attrs):
+        """In static mode, ops are RECORDED into the current Program block
+        instead of executed — the TPU replacement for Block.append_op +
+        InferShape at append time (python/paddle/fluid/framework.py:1970).
+        The Executor later replays the whole block as one XLA computation."""
+        from ..static.program import current_block, Variable
+        block = current_block()
+        inputs = []
+        for a in args:
+            if isinstance(a, Variable):
+                inputs.append(a)
+            elif isinstance(a, Tensor) and (a.persistable or
+                                            type(a).__name__ == "Parameter"):
+                # an eager Parameter used inside a static program (the 2.0
+                # dual-mode Layer story): register it as a persistable var
+                # seeded into the global scope, so paddle.nn layers build
+                # static graphs directly
+                from ..static.executor import global_scope
+                if block.has_var(a.name):
+                    inputs.append(block.var(a.name))
+                else:
+                    v = block.create_var(
+                        name=a.name, shape=list(a._value.shape),
+                        dtype=a._value.dtype, persistable=True,
+                        stop_gradient=a.stop_gradient,
+                        trainable=getattr(a, "trainable",
+                                          not a.stop_gradient))
+                    block.program._parameters.append(a.name)
+                    global_scope().set_var(a.name, a._value)
+                    inputs.append(v)
+            else:
+                # literal operand -> inline constant op
+                val = a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                cv = block.create_var(shape=list(val.shape), dtype=val.dtype)
+                block.ops.append(_ConstOp(block, cv.name, val))
+                inputs.append(cv)
+        stop = not (core.grad_enabled() and any(
+            isinstance(a, Variable) and not a.stop_gradient for a in args))
+        return block.append_op(self.name, inputs, attrs,
+                               out_stop_gradient=stop)
+
+    # -- eager application ---------------------------------------------------
+    def __call__(self, *args, **attrs):
+        if core.in_static_mode():
+            from ..static.program import Variable
+            if any(isinstance(a, Variable) or
+                   (isinstance(a, Tensor) and
+                    (a.persistable or type(a).__name__ == "Parameter"))
+                   for a in args):
+                return self._append_static(args, attrs)
+        arrs = tuple(a._value if isinstance(a, Tensor) else a for a in args)
+
+        # AMP autocast at dispatch (imperative/amp_auto_cast.cc via
+        # tracer.cc:158 parity): white-listed ops compute in bf16/fp16,
+        # black-listed ops are promoted back to fp32
+        amp = core.amp_state()
+        if amp is not None:
+            policy = amp.cast_policy(self.name)
+            if policy == "low":
+                arrs = tuple(
+                    a.astype(amp.dtype) if hasattr(a, "dtype")
+                    and a.dtype == jnp.float32 else a for a in arrs)
+            elif policy == "high":
+                arrs = tuple(
+                    a.astype(jnp.float32) if hasattr(a, "dtype")
+                    and a.dtype in (jnp.bfloat16, jnp.float16) else a
+                    for a in arrs)
+
+        key = _attrs_key(attrs)
+        try:
+            out = self._fwd(key, attrs)(*arrs)
+        except Exception as e:   # re-raise with op provenance (enforce.py)
+            from .enforce import EnforceNotMet, op_context
+            if isinstance(e, EnforceNotMet):
+                raise
+            with op_context(self.name, arrs):
+                raise
+
+        if flag("benchmark"):
+            jax.block_until_ready(out)
+        if flag("check_nan_inf"):
+            _check_finite(self.name, out)
+
+        needs_grad = self.differentiable and core.grad_enabled() and any(
+            isinstance(a, Tensor) and not a.stop_gradient for a in args)
+
+        outs = out if self.multi_output else (out,)
+        tensors = tuple(Tensor(o, stop_gradient=not needs_grad) for o in outs)
+
+        if needs_grad:
+            node = GradNode(
+                self.name, self._bwd(key, attrs), arrs,
+                tuple(a if isinstance(a, Tensor) else None for a in args),
+                [(o.shape, o.dtype) for o in outs])
+            for i, t in enumerate(tensors):
+                t._node = node
+                t._out_index = i
+                t.is_leaf = False
+        return tensors if self.multi_output else tensors[0]
+
+    # raw (no tape, no wrap): used by static executor / jit tracer
+    def raw(self, *arrs, **attrs):
+        return self._fwd(_attrs_key(attrs), attrs)(*arrs)
+
+
+def _ConstOp(block, out_name, value):
+    """Inline literal in a static program (fill_constant-with-value parity)."""
+    from ..static.program import Operator
+
+    def fn():
+        return (value,)
+    return Operator(block, prim="@const", inputs=[], outputs=[out_name],
+                    attrs={}, fn=fn, type_name="const")
+
+
+def _check_finite(name, out):
+    """FLAGS_check_nan_inf parity (details/nan_inf_utils_detail.cc:301)."""
+    leaves = jax.tree_util.tree_leaves(out)
+    for leaf in leaves:
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            if not bool(jnp.all(jnp.isfinite(leaf))):
+                raise FloatingPointError(
+                    f"Operator {name} output contains NaN/Inf "
+                    f"(FLAGS_check_nan_inf)")
+
+
+def primitive(name: str, multi_output: bool = False, differentiable: bool = True):
+    """Decorator: register a pure jax function as a framework primitive."""
+    def deco(fn):
+        return Primitive(name, fn, multi_output=multi_output,
+                         differentiable=differentiable)
+    return deco
+
+
+def get_primitive(name: str) -> Primitive:
+    return _PRIMS[name]
+
+
+def all_primitives():
+    return dict(_PRIMS)

@@ -1,0 +1,75 @@
+"""Seeded RNG generator.
+
+Reference parity: paddle/fluid/framework/generator.h:39-62 (per-device seeded
+mt19937 Generator) and paddle.seed. TPU-first: the generator owns a JAX PRNG
+key and hands out split subkeys. Under a jit trace (to_static / Executor
+compile) random ops must NOT burn host entropy per call -- the tracer pushes a
+*traced* key onto the stack so randomness is functionalized into the compiled
+program (fresh per step via a counter input).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Global RNG: eager ops draw fresh subkeys; manual_seed restores determinism."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._seed = seed
+        self._count = 0
+        # stack of traced keys pushed by jit tracers (innermost wins)
+        self._traced: list = []
+
+    def manual_seed(self, seed: int):
+        with self._lock:
+            self._seed = int(seed)
+            self._count = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """A fresh PRNG key. Inside a trace, fold a counter into the traced key."""
+        if self._traced:
+            base, holder = self._traced[-1]
+            holder[0] += 1
+            return jax.random.fold_in(base, holder[0])
+        with self._lock:
+            self._count += 1
+            c = self._count
+        return jax.random.fold_in(jax.random.key(self._seed), c)
+
+    def push_traced_key(self, key):
+        self._traced.append((key, [0]))
+
+    def pop_traced_key(self):
+        self._traced.pop()
+
+    def state(self):
+        return {"seed": self._seed, "count": self._count}
+
+    def set_state(self, state):
+        self._seed = state["seed"]
+        self._count = state["count"]
+
+
+default_generator = Generator(seed=np.random.SeedSequence().entropy % (2 ** 31))
+
+
+def seed(value: int) -> Generator:
+    """paddle.seed parity (python/paddle/framework/random.py)."""
+    return default_generator.manual_seed(value)
+
+
+def get_rng_state():
+    return default_generator.state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)

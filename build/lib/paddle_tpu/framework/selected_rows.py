@@ -1,0 +1,138 @@
+"""SelectedRows: sparse row-set gradients (embeddings).
+
+Reference parity: paddle/fluid/framework/selected_rows.h — a {rows, value,
+height} triple where ``rows`` may contain duplicates and ``value`` holds one
+slice per entry; the sum semantics live in the consumers
+(GradientAccumulator / sgd_op's sparse branch).
+
+TPU-first: XLA has no sparse tensors, so a SelectedRows is just (int rows,
+dense [n, D] values) living in HBM; ``merged()`` canonicalizes duplicates
+with a device-side segment-sum over host-uniqued ids (SURVEY §7 phase 8 —
+the TPU shape of sparse embedding grads), and sparse optimizer rules apply
+row-wise scatter updates.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    """Sparse gradient: ``values[i]`` belongs to row ``rows[i]`` of a
+    ``[height, D]`` dense parameter. Rows may repeat (sum semantics)."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.values = values if isinstance(values, jax.Array) \
+            else jnp.asarray(values)
+        self.height = int(height)
+
+    # -- minimal Tensor-ish surface (so generic grad plumbing passes) --------
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def _value(self):
+        return self.values
+
+    @_value.setter
+    def _value(self, new):
+        # generic grad plumbing (GradScaler.unscale_ etc.) rewrites
+        # p.grad._value in place; for a sparse grad that means the values
+        self.values = new
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype), self.height)
+
+    # -- accumulation semantics ----------------------------------------------
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.height)
+        # dense + sparse -> dense (GradientAccumulator's mixed-sum branch)
+        return self.to_dense() + other
+
+    __radd__ = __add__
+
+    def merged(self):
+        """(unique_rows, summed_values): host-unique ids + one device
+        segment-sum (duplicate-row canonicalization of
+        selected_rows_functor.cc MergeAdd)."""
+        rows_np = np.asarray(self.rows)
+        uniq, inv = np.unique(rows_np, return_inverse=True)
+        summed = jax.ops.segment_sum(self.values, jnp.asarray(inv, jnp.int32),
+                                     num_segments=len(uniq))
+        return jnp.asarray(uniq, jnp.int32), summed
+
+    def to_dense(self):
+        dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                          self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def numel(self):
+        return int(np.prod(self.values.shape))
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, n={self.rows.shape[0]}, "
+                f"dim={tuple(self.values.shape[1:])})")
+
+
+def sparse_lookup(weight, ids, padding_idx=None):
+    """Embedding gather whose weight-gradient is a SelectedRows.
+
+    ≙ lookup_table_v2 with is_sparse=True
+    (paddle/fluid/operators/lookup_table_v2_op.cc grad → SelectedRows):
+    forward is a dense device gather; backward hands the tape a
+    SelectedRows(ids, cotangent-slices) instead of a full dense vocab-sized
+    gradient.
+    """
+    from .tensor import Tensor
+    from .autograd import GradNode
+    from . import core
+
+    w = weight._value
+    idv = ids._value if isinstance(ids, Tensor) else jnp.asarray(ids)
+    out_val = _lookup_fwd(w, idv, -1 if padding_idx is None else padding_idx)
+
+    needs_grad = core.grad_enabled() and not weight.stop_gradient
+    out = Tensor(out_val, stop_gradient=not needs_grad)
+    if not needs_grad:
+        return out
+
+    height = int(w.shape[0])
+    pad = padding_idx
+
+    def grad_fn(cts, w_primal, ids_primal):
+        ct = cts[0]
+        flat_ids = ids_primal.reshape(-1)
+        vals = ct.reshape((-1,) + ct.shape[ids_primal.ndim:])
+        if pad is not None:
+            keep = flat_ids != pad
+            vals = jnp.where(keep[:, None], vals, 0)
+        return (SelectedRows(flat_ids, vals, height),
+                np.zeros(ids_primal.shape, jax.dtypes.float0))
+
+    node = GradNode("lookup_table_sparse_grad", grad_fn,
+                    primals=(w, idv),
+                    inputs=(weight, ids if isinstance(ids, Tensor)
+                            else Tensor(idv)),
+                    out_avals=[(out_val.shape, out_val.dtype)])
+    out._node = node
+    out._out_index = 0
+    out.is_leaf = False
+    return out
+
+
+@jax.jit
+def _lookup_fwd(w, ids, padding_idx):
+    out = jnp.take(w, jnp.clip(ids, 0, w.shape[0] - 1), axis=0)
+    return jnp.where((ids == padding_idx)[..., None], 0, out)

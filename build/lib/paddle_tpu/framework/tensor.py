@@ -1,0 +1,280 @@
+"""Eager Tensor (the VarBase equivalent) over a jax.Array.
+
+Reference parity: paddle/fluid/imperative/layer.h:65 (VarBase) +
+python/paddle/fluid/dygraph/varbase_patch_methods.py (backward at :135) and
+math_op_patch.py. TPU-first: the buffer is a PJRT-owned jax.Array, so device
+placement, async dispatch and donation are XLA's problem; the Tensor adds
+Paddle semantics -- ``stop_gradient`` (default True), ``.grad`` accumulation,
+``persistable``, name -- and the tape hook for the autograd engine.
+
+Operator methods (``__add__``, ``reshape``...) are patched on by
+``paddle_tpu.ops`` at import, mirroring math_op_patch.py's monkey-patching.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import core
+from .dtype import convert_dtype, get_default_dtype
+
+_name_counter = [0]
+
+
+def _auto_name(prefix="tmp"):
+    _name_counter[0] += 1
+    return f"{prefix}_{_name_counter[0]}"
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "persistable", "name", "grad",
+                 "_node", "_out_index", "_retain_grads", "_hooks", "is_leaf",
+                 "_bwd_done", "_version", "_consumers", "_consumers_cap",
+                 "__weakref__")
+
+    def __init__(self, value, stop_gradient=True, name=None, persistable=False):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.name = name or _auto_name()
+        self.grad = None
+        self._node = None
+        self._out_index = 0
+        self._retain_grads = False
+        self._version = 0      # bumped by in-place mutation (version check)
+        self._consumers = None  # weakrefs to GradNodes holding a LEAF edge
+        self._consumers_cap = 16  # amortized dead-ref compaction threshold
+        self._hooks = []
+        self.is_leaf = True
+        self._bwd_done = False
+
+    # -- structural ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        from . import place as place_mod
+        return place_mod.current_place()
+
+    @property
+    def T(self):
+        from .. import ops
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        grad_str = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_str},\n       {np.array2string(self.numpy(), prefix='       ')})")
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("truth value of multi-element Tensor is ambiguous")
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __hash__(self):
+        return id(self)
+
+    def __dlpack__(self, **kw):
+        return self._value.__dlpack__(**kw)
+
+    # -- autograd ------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        """varbase_patch_methods.py:135 -> BasicEngine parity."""
+        from .autograd import run_backward
+        run_backward(self, grad_tensor, retain_graph)
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        self.is_leaf = True
+        return self
+
+    def clone(self):
+        from .. import ops
+        return ops.assign(self)
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook):
+        """Grad hook parity (imperative VariableWrapper hooks)."""
+        self._hooks.append(hook)
+
+        class _Removable:
+            def remove(_self):
+                if hook in self._hooks:
+                    self._hooks.remove(hook)
+        return _Removable()
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    # -- in-place-ish value plumbing (Paddle exposes set_value on params) -----
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=self._value.dtype)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch {value.shape} vs {self._value.shape}")
+        self._value = value
+        self._version += 1    # off-tape mutation: backward through a
+        return self           # pre-mutation consumer must raise
+
+    def get_tensor(self):
+        return self
+
+    def value(self):
+        return self
+
+    def block_until_ready(self):
+        jax.block_until_ready(self._value)
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def to(self, *args, **kwargs):
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a.lower() in ("cpu", "tpu", "gpu"):
+                continue
+            dtype = a
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._value), stop_gradient=self.stop_gradient)
+
+    def cuda(self):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def astype(self, dtype):
+        from .. import ops
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    # requires-grad compatibility helpers
+    @property
+    def requires_grad(self):
+        return not self.stop_gradient
+
+    @requires_grad.setter
+    def requires_grad(self, v):
+        self.stop_gradient = not v
+
+
+class Parameter(Tensor):
+    """framework.py:5311 (ParamBase) parity: trainable persistable tensor."""
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "_partition_spec")
+
+    def __init__(self, value, name=None, trainable=True, regularizer=None,
+                 need_clip=True):
+        super().__init__(value, stop_gradient=not trainable, name=name or _auto_name("param"),
+                         persistable=True)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        value = data._value
+        if dtype is not None:
+            value = value.astype(convert_dtype(dtype))
+        return Tensor(value, stop_gradient=stop_gradient)
+    if isinstance(data, jax.Array):
+        arr = data if dtype is None else data.astype(convert_dtype(dtype))
+        return Tensor(arr, stop_gradient=stop_gradient)
+    arr = np.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(convert_dtype(dtype))
+    elif arr.dtype == np.float64:
+        arr = arr.astype(get_default_dtype())
+    return Tensor(jnp.asarray(arr), stop_gradient=stop_gradient)
+
+
+def unwrap(x):
+    """Tensor|array|scalar -> jax-compatible value."""
+    return x._value if isinstance(x, Tensor) else x
+
+
+def wrap(value, stop_gradient=True):
+    return Tensor(value, stop_gradient=stop_gradient)

@@ -1,0 +1,7 @@
+"""paddle.hapi parity: high-level Model API + callbacks."""
+from .model import Model  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
+    VisualDL,
+)
+from .summary import summary  # noqa: F401

@@ -1,0 +1,126 @@
+"""Dynamic FLOPs counter.
+
+Reference parity: python/paddle/hapi/dynamic_flops.py — forward-hook based
+multiply-add counting per layer type, summed over one forward pass of a
+zero batch of ``input_size``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+def _numel(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _count_conv(layer, inputs, output):
+    # kernel muls * output positions (+ bias adds)
+    w = layer.weight
+    out_numel = _numel(output.shape)
+    kernel_ops = _numel(w.shape[1:])           # in_ch/groups * kh * kw
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    return out_numel * (kernel_ops + bias_ops)
+
+
+def _count_conv_transpose(layer, inputs, output):
+    # transposed conv weight is [in_ch, out_ch/groups, kh, kw]: per output
+    # element the muls are in_ch/groups * kh * kw
+    w = layer.weight
+    out_numel = _numel(output.shape)
+    groups = getattr(layer, "_groups", 1)
+    kernel_ops = (w.shape[0] // groups) * _numel(w.shape[2:])
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    return out_numel * (kernel_ops + bias_ops)
+
+
+def _count_linear(layer, inputs, output):
+    in_f = layer.weight.shape[0]
+    out_numel = _numel(output.shape)
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    return out_numel * (in_f + bias_ops)
+
+
+def _count_norm(layer, inputs, output):
+    return 2 * _numel(inputs[0].shape)
+
+
+def _count_act(layer, inputs, output):
+    return _numel(output.shape)
+
+
+def _count_pool(layer, inputs, output):
+    return _numel(output.shape)
+
+
+_COUNTERS = {
+    "Conv1D": _count_conv, "Conv2D": _count_conv, "Conv3D": _count_conv,
+    "Conv1DTranspose": _count_conv_transpose,
+    "Conv2DTranspose": _count_conv_transpose,
+    "Conv3DTranspose": _count_conv_transpose,
+    "Linear": _count_linear,
+    "BatchNorm": _count_norm, "BatchNorm1D": _count_norm,
+    "BatchNorm2D": _count_norm, "BatchNorm3D": _count_norm,
+    "LayerNorm": _count_norm, "GroupNorm": _count_norm,
+    "InstanceNorm2D": _count_norm, "SyncBatchNorm": _count_norm,
+    "ReLU": _count_act, "ReLU6": _count_act, "GELU": _count_act,
+    "Sigmoid": _count_act, "Tanh": _count_act, "Softmax": _count_act,
+    "LeakyReLU": _count_act, "SiLU": _count_act, "Hardswish": _count_act,
+    "AvgPool2D": _count_pool, "MaxPool2D": _count_pool,
+    "AdaptiveAvgPool2D": _count_pool, "AdaptiveMaxPool2D": _count_pool,
+}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count multiply-add FLOPs of one forward pass (dynamic_flops.py:24).
+
+    input_size: full input shape including batch, e.g. [1, 3, 224, 224].
+    custom_ops: {LayerClass: fn(layer, inputs, output) -> flops}.
+    """
+    custom = {}
+    for cls, fn in (custom_ops or {}).items():
+        custom[cls.__name__ if isinstance(cls, type) else str(cls)] = fn
+
+    rows = []
+    total = [0]
+    hooks = []
+
+    def make_hook(name, tname, counter):
+        def hook(layer, inputs, output):
+            out = output[0] if isinstance(output, (tuple, list)) else output
+            n = int(counter(layer, inputs, out))
+            total[0] += n
+            if print_detail:
+                rows.append((name, tname, n))
+            return None
+        return hook
+
+    for name, sub in net.named_sublayers():
+        tname = type(sub).__name__
+        counter = custom.get(tname) or _COUNTERS.get(tname)
+        if counter is not None:
+            hooks.append(sub.register_forward_post_hook(
+                make_hook(name, tname, counter)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        x = Tensor(np.zeros(list(input_size), dtype="float32"))
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    if print_detail:
+        width = max((len(n) for n, _, _ in rows), default=10) + 2
+        print(f"{'layer':<{width}}{'type':<20}{'FLOPs':>14}")
+        for name, tname, n in rows:
+            print(f"{name:<{width}}{tname:<20}{n:>14,}")
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]

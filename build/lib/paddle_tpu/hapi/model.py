@@ -1,0 +1,261 @@
+"""paddle.Model: the Keras-like high-level API.
+
+Reference parity: python/paddle/hapi/model.py — Model (:809) with
+prepare/fit/evaluate/predict/save/load (:1041,:1242,:1297,:1513) and dual
+static/dygraph adapters (:263,:641).
+
+TPU-first: there is ONE adapter — the compiled sharded TrainStep
+(parallel/train_step.py). fit() compiles the whole train step (forward +
+loss + backward + optimizer) once and streams DataLoader batches into it;
+evaluate/predict ride the jitted EvalStep. The reference's per-mode
+train_batch/eval_batch surface is kept.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..framework.io_state import save as _save, load as _load
+from ..metric import Metric
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._eval_step = None
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        self._amp = amp_configs
+        self._train_step = None
+        self._eval_step = None
+        return self
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            from ..parallel.train_step import TrainStep
+            import jax.numpy as jnp
+            opts = {}
+            opt = self._optimizer
+            if hasattr(opt, "build_train_step"):  # fleet DistributedOptimizer
+                self._train_step = opt.build_train_step(
+                    self.network, self._loss)
+                return self._train_step
+            if self._amp:
+                level = self._amp if isinstance(self._amp, str) else "O1"
+                if level in ("O1", "O2"):
+                    opts["compute_dtype"] = jnp.bfloat16
+            self._train_step = TrainStep(self.network, opt, self._loss,
+                                         **opts)
+        return self._train_step
+
+    def _ensure_eval_step(self):
+        if self._eval_step is None:
+            from ..parallel.train_step import EvalStep
+            self._eval_step = EvalStep(self.network, loss_fn=self._loss)
+        return self._eval_step
+
+    # -- batch-level API -----------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        step = self._ensure_train_step()
+        loss = step(inputs, labels)
+        return [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        self._sync_from_train()
+        return self._eval_batch_nosync(inputs, labels)
+
+    def _eval_batch_nosync(self, inputs, labels=None):
+        step = self._ensure_eval_step()
+        res = step(inputs, labels)
+        if self._loss is not None:
+            out, loss = res  # EvalStep with loss_fn returns (out, loss)
+        else:
+            out, loss = res, None
+        metrics = []
+        for m in self._metrics:
+            first = out[0] if isinstance(out, (tuple, list)) else out
+            m.update(m.compute(first, labels)) if _unary_update(m) \
+                else m.update(first, labels)
+            metrics.append(m.accumulate())
+        return ([float(loss)] if loss is not None else []) + metrics
+
+    def predict_batch(self, inputs):
+        self._sync_from_train()
+        return self._predict_batch_nosync(inputs)
+
+    def _predict_batch_nosync(self, inputs):
+        step = self._ensure_eval_step()  # reuse the jitted forward
+        out = step(inputs)
+        if self._loss is not None:  # EvalStep with loss_fn returns (out, loss)
+            out = out[0]
+        return out
+
+    def _sync_from_train(self):
+        if self._train_step is not None and self._train_step._state is not None:
+            self._train_step.sync_to_layer()
+
+    # -- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cbks = CallbackList([ProgBarLogger(log_freq, verbose=verbose)]
+                            + (callbacks or []))
+        cbks.set_model(self)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks.set_params({"epochs": epochs, "steps": steps, "verbose": verbose})
+        self.stop_training = False
+
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step_i, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step_i)
+                inputs, labels = _split_batch(batch)
+                loss = self.train_batch(inputs, labels)
+                logs = {"loss": loss[0]}
+                cbks.on_train_batch_end(step_i, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _as_logs=True)
+                logs.update(eval_logs)
+                cbks.on_eval_end(eval_logs)
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training or (num_iters is not None
+                                      and it >= num_iters):
+                break
+        cbks.on_train_end(logs)
+        if save_dir:
+            self.save(os.path.join(save_dir, "final"))
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None,
+                 _as_logs=False):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(eval_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(eval_data, Dataset) else eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        self._sync_from_train()  # once, not per batch
+        for batch in loader:
+            inputs, labels = _split_batch(batch)
+            vals = self._eval_batch_nosync(inputs, labels)
+            if self._loss is not None and vals:
+                losses.append(vals[0])
+        logs = {}
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[f"eval_{m.name()}"] = m.accumulate()
+        if verbose:
+            print(" - ".join(f"{k}: {v}" for k, v in logs.items()))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(test_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(test_data, Dataset) else test_data
+        self._sync_from_train()  # once, not per batch
+        per_output = None
+        for batch in loader:
+            inputs, _ = _split_batch(batch)
+            out = self._predict_batch_nosync(inputs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            if per_output is None:
+                per_output = [[] for _ in outs]
+            for slot, o in zip(per_output, outs):
+                slot.append(o.numpy())
+        per_output = per_output or [[]]
+        if stack_outputs:
+            return [np.concatenate(slot) for slot in per_output]
+        return per_output
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        self._sync_from_train()
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+        self._train_step = None
+        self._eval_step = None
+
+    # -- misc ----------------------------------------------------------------
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        return summary(self.network, input_size, dtype)
+
+
+def _split_batch(batch):
+    if isinstance(batch, (tuple, list)):
+        if len(batch) == 1:
+            return batch[0], None
+        if len(batch) == 2:
+            return batch[0], batch[1]
+        return tuple(batch[:-1]), batch[-1]
+    return batch, None
+
+
+def _unary_update(m):
+    """Accuracy.update takes the precomputed `correct` tensor; other metrics
+    take (pred, label) — match hapi's compute/update split."""
+    return isinstance(m, __import__(
+        "paddle_tpu.metric", fromlist=["Accuracy"]).Accuracy)

@@ -1,0 +1,29 @@
+"""Model summary (python/paddle/hapi/model_summary.py parity)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def summary(net, input_size=None, dtypes=None):
+    rows = []
+    total_params = 0
+    trainable_params = 0
+    for name, layer in net.named_sublayers(include_self=False):
+        n_params = 0
+        for _, p in layer.named_parameters(include_sublayers=False):
+            n_params += p.size
+            total_params += p.size
+            if getattr(p, "trainable", True):
+                trainable_params += p.size
+        rows.append((name, type(layer).__name__, n_params))
+    width = max([len(r[0]) for r in rows], default=10) + 2
+    lines = [f"{'Layer':<{width}}{'Type':<24}{'Params':>12}",
+             "-" * (width + 36)]
+    for name, tname, n in rows:
+        lines.append(f"{name:<{width}}{tname:<24}{n:>12,}")
+    lines.append("-" * (width + 36))
+    lines.append(f"Total params: {total_params:,}")
+    lines.append(f"Trainable params: {trainable_params:,}")
+    print("\n".join(lines))
+    return {"total_params": total_params,
+            "trainable_params": trainable_params}

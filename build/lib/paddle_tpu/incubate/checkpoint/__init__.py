@@ -1,0 +1,1 @@
+from .auto_checkpoint import train_epoch_range, ExeTrainStatus  # noqa: F401

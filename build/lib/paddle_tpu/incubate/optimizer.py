@@ -1,0 +1,198 @@
+"""Meta-optimizers: EMA, ModelAverage, Lookahead.
+
+Reference parity: python/paddle/fluid/optimizer.py —
+ExponentialMovingAverage (:3450), ModelAverage (:3141),
+LookaheadOptimizer (:4839). The reference builds these as static-graph
+program rewrites; here they are dygraph-first state managers over
+parameter trees (the fleet meta-optimizer wrappers route to the same
+classes). DGC (deep gradient compression) is intentionally absent: it
+compresses NCCL allreduce traffic, which on TPU rides ICI inside the
+one-jit TrainStep — there is no Python-visible gradient wire to compress.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+def _params_of(obj):
+    if obj is None:
+        raise ValueError(
+            "parameters is required in dygraph mode: pass a Layer or a "
+            "parameter list (the reference's parameters=None means 'all "
+            "program parameters', which only exists in static graphs)")
+    if hasattr(obj, "parameters"):
+        return [p for p in obj.parameters() if not p.stop_gradient]
+    return list(obj)
+
+
+class ExponentialMovingAverage:
+    """EMA_t = decay*EMA_{t-1} + (1-decay)*theta_t, with bias correction
+    EMA_t / (1 - decay^t) applied to the model (optimizer.py:3450)."""
+
+    def __init__(self, parameters_or_layer, decay=0.999, thres_steps=None,
+                 name=None):
+        self._params = _params_of(parameters_or_layer)
+        self._decay = float(decay)
+        self._t = 0
+        self._ema = [np.zeros_like(np.asarray(p.numpy()))
+                     for p in self._params]
+        self._backup = None
+
+    def update(self):
+        """Accumulate after each optimizer step."""
+        self._t += 1
+        d = self._decay
+        for ema, p in zip(self._ema, self._params):
+            ema *= d
+            ema += (1.0 - d) * np.asarray(p.numpy())
+
+    def apply(self, need_restore=True):
+        """Swap model params for bias-corrected EMAs. Usable as a context
+        manager: ``with ema.apply(): evaluate()``."""
+        corr = 1.0 - self._decay ** max(self._t, 1)
+        self._backup = [np.asarray(p.numpy()).copy() for p in self._params]
+        for p, ema in zip(self._params, self._ema):
+            p.set_value((ema / corr).astype(np.asarray(ema).dtype))
+        outer = self
+
+        class _Ctx:
+            def __enter__(self):
+                return outer
+
+            def __exit__(self, *a):
+                if need_restore:
+                    outer.restore()
+
+        return _Ctx()
+
+    def restore(self):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p.set_value(b)
+        self._backup = None
+
+    def state_dict(self):
+        return {"t": self._t, "ema": [e.copy() for e in self._ema]}
+
+    def set_state_dict(self, state):
+        self._t = state["t"]
+        self._ema = [np.asarray(e) for e in state["ema"]]
+
+
+class ModelAverage:
+    """Sliding-window parameter average (optimizer.py:3141) with the
+    reference's O(1)-memory accumulator scheme (average_accumulates_op.h):
+    three running sums per param — sum_1 (current partial), sum_2
+    (precision spill every kMaxNumAccumulates), sum_3 (previous window) —
+    never a per-step snapshot ring. ``apply()`` swaps in the window mean;
+    ``restore()`` swaps back."""
+
+    _K_MAX_ACC = 16384       # kMaxNumAccumulates
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = _params_of(parameters)
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        zeros = lambda p: np.zeros_like(np.asarray(p.numpy()),
+                                        dtype=np.float64)
+        self._sum_1 = [zeros(p) for p in self._params]
+        self._sum_2 = [zeros(p) for p in self._params]
+        self._sum_3 = [zeros(p) for p in self._params]
+        self._num_updates = 0
+        self._num_accumulates = 0
+        self._old_num_accumulates = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current params (call after optimizer.step());
+        mirrors average_accumulates_op.h:86-109."""
+        self._num_updates += 1
+        self._num_accumulates += 1
+        for s1, p in zip(self._sum_1, self._params):
+            s1 += np.asarray(p.numpy())
+        if self._num_updates % self._K_MAX_ACC == 0:
+            for s1, s2 in zip(self._sum_1, self._sum_2):
+                s2 += s1
+                s1[...] = 0.0
+        if (self._num_accumulates >= self._min_w and
+                self._num_accumulates >= min(
+                    self._max_w, self._num_updates * self._rate)):
+            for s1, s2, s3 in zip(self._sum_1, self._sum_2, self._sum_3):
+                s3[...] = s1 + s2
+                s1[...] = 0.0
+                s2[...] = 0.0
+            self._old_num_accumulates = self._num_accumulates
+            self._num_accumulates = 0
+
+    def apply(self, executor=None, need_restore=True):
+        total = self._num_accumulates + self._old_num_accumulates
+        total = max(total, 1)
+        self._backup = [np.asarray(p.numpy()).copy() for p in self._params]
+        for p, s1, s2, s3, b in zip(self._params, self._sum_1, self._sum_2,
+                                    self._sum_3, self._backup):
+            avg = (s1 + s2 + s3) / total
+            p.set_value(avg.astype(b.dtype))
+        outer = self
+
+        class _Ctx:
+            def __enter__(self):
+                return outer
+
+            def __exit__(self, *a):
+                if need_restore:
+                    outer.restore()
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p.set_value(b)
+        self._backup = None
+
+
+class LookaheadOptimizer:
+    """Lookahead (optimizer.py:4839): inner optimizer updates fast params
+    every step; every k steps slow += alpha*(fast-slow) and fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = None
+
+    def _params(self):
+        return [p for p in (self.inner_optimizer._parameters or [])
+                if not p.stop_gradient]
+
+    def step(self):
+        if self._slow is None:
+            self._slow = [np.asarray(p.numpy()).copy()
+                          for p in self._params()]
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for slow, p in zip(self._slow, self._params()):
+                fast = np.asarray(p.numpy())
+                slow += self.alpha * (fast - slow)
+                p.set_value(slow.astype(fast.dtype))
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, startup_program=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()

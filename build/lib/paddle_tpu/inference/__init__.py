@@ -1,0 +1,168 @@
+"""paddle.inference: deployment API.
+
+Reference parity: paddle/fluid/inference/api/analysis_predictor.h:82
+(AnalysisPredictor with AnalysisConfig, ZeroCopyRun :165) bound to Python via
+pybind/inference_api.cc.
+
+TPU-first: "analysis + IR optimization" is the XLA pipeline — the predictor
+loads a saved program (static.io format or jit.save StableHLO) and jit-caches
+one executable per input signature; zero-copy IO ≙ donated device arrays.
+The TensorRT/Lite subgraph engines have no TPU meaning; their slot is the
+PJRT executable cache itself.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+class Config:
+    """AnalysisConfig parity."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file and os.path.isdir(prog_file):
+            self._model_dir = prog_file
+            self._prog_file = None
+            self._params_file = None
+        else:
+            self._model_dir = None
+            self._prog_file = prog_file
+            self._params_file = params_file
+        self._use_tpu = True
+        self._memory_optim = True
+        self._glog_info = False
+
+    def set_model(self, prog_file, params_file=None):
+        self.__init__(prog_file, params_file)
+
+    def model_dir(self):
+        return self._model_dir
+
+    def prog_file(self):
+        return self._prog_file
+
+    def params_file(self):
+        return self._params_file
+
+    def enable_use_gpu(self, *a, **k):
+        pass  # device choice is PJRT's
+
+    def enable_xpu(self, *a, **k):
+        pass
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def switch_use_feed_fetch_ops(self, flag):
+        pass
+
+
+class PredictorTensor:
+    """ZeroCopyTensor parity: named IO slot."""
+
+    def __init__(self, predictor, name, is_input):
+        self._p = predictor
+        self.name = name
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        self._p._feeds[self.name] = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._p._results[self.name])
+
+    def reshape(self, shape):
+        pass
+
+    def shape(self):
+        if self._is_input:
+            return list(self._p._feeds[self.name].shape)
+        return list(np.asarray(self._p._results[self.name]).shape)
+
+
+class Predictor:
+    """AnalysisPredictor parity over the static Executor's compiled replay."""
+
+    def __init__(self, config: Config):
+        from ..static.io import load_inference_model
+        from ..static.executor import Executor
+        d = config.model_dir() or config.prog_file()
+        if d is None:
+            raise ValueError("Config needs a model dir (save_inference_model"
+                             " output or jit.save prefix dir)")
+        self._translated = None
+        prefix = self._jit_prefix(d)
+        if prefix is not None:
+            # jit.save'd model (StableHLO + params): dynamic dims exported
+            # as symbolic shapes, so any batch size runs without recompile
+            from .. import jit as _jit
+            self._translated = _jit.load(prefix)
+            self._feed_names = [f"x{i}" for i in range(
+                self._translated.num_inputs)]
+            self._fetch_names = [f"out{i}" for i in range(
+                self._translated.num_outputs)]
+        else:
+            self._program, self._feed_names, self._fetch_vars = \
+                load_inference_model(d)
+            self._fetch_names = [v.name for v in self._fetch_vars]
+            self._exe = Executor()
+        self._feeds: Dict[str, np.ndarray] = {}
+        self._results: Dict[str, np.ndarray] = {}
+
+    @staticmethod
+    def _jit_prefix(d):
+        import glob
+        if d.endswith(".pdmodel"):
+            return d[:-len(".pdmodel")]
+        if os.path.isfile(d + ".pdmodel"):
+            return d
+        if os.path.isdir(d) and not os.path.exists(
+                os.path.join(d, "__model__")):
+            pdm = sorted(glob.glob(os.path.join(d, "*.pdmodel")))
+            if pdm:
+                return pdm[0][:-len(".pdmodel")]
+        return None
+
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name):
+        return PredictorTensor(self, name, True)
+
+    def get_output_handle(self, name):
+        return PredictorTensor(self, name, False)
+
+    def run(self, inputs=None):
+        """ZeroCopyRun parity; also accepts positional arrays like the 2.x
+        predictor.run(list)."""
+        if inputs is not None:
+            for name, arr in zip(self._feed_names, inputs):
+                self._feeds[name] = np.asarray(
+                    arr.numpy() if isinstance(arr, Tensor) else arr)
+        if self._translated is not None:
+            out = self._translated(
+                *[self._feeds[n] for n in self._feed_names])
+            outs = [np.asarray(o.numpy()) for o in
+                    (out if isinstance(out, (list, tuple)) else [out])]
+        else:
+            outs = self._exe.run(self._program, feed=dict(self._feeds),
+                                 fetch_list=self._fetch_names)
+        self._results = dict(zip(self._fetch_names, outs))
+        return [self._results[n] for n in self._fetch_names]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,27 @@
+"""Thin marshalling layer for the C inference ABI (native/capi.cpp).
+
+Reference parity: paddle/fluid/inference/capi/ — a C-callable surface over
+the predictor so C/Go/R programs can serve a saved model. The TPU build's
+predictor is Python-over-PJRT, so the C shim embeds CPython and calls the
+two functions here with only (str, bytes, tuple) types — no Python API
+surface leaks into the C side beyond these.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import Config, create_predictor
+
+
+def create(model_path):
+    """C: pd_predictor_create."""
+    return create_predictor(Config(model_path))
+
+
+def run_f32(pred, data, shape):
+    """C: pd_predictor_run_f32 — one float32 input, first float32 output.
+    Returns (out_bytes, out_shape_tuple)."""
+    arr = np.frombuffer(data, np.float32).reshape(shape)
+    outs = pred.run([arr])
+    out = np.ascontiguousarray(np.asarray(outs[0], np.float32))
+    return out.tobytes(), tuple(int(d) for d in out.shape)

@@ -1,0 +1,16 @@
+"""paddle.io parity package: datasets, samplers, DataLoader.
+
+Reference parity: python/paddle/io/__init__.py re-exporting
+fluid/dataloader/* and reader.py (SURVEY.md §2.4 DataLoader row).
+"""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    ConcatDataset, Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler,
+)
+from .dataloader import (  # noqa: F401
+    DataLoader, get_worker_info, WorkerInfo, default_collate_fn,
+)

@@ -1,0 +1,170 @@
+"""Shared-memory batch transport over the native ring buffer.
+
+Reference parity: the DataLoader use_shared_memory=True path —
+python/paddle/fluid/dataloader/worker.py `_convert_to_tensor` +
+core._array_to_share_memory_tensor over
+paddle/fluid/memory/allocation/mmap_allocator.cc. Workers serialize
+numpy batches into one framed shm message (raw buffer memcpy in C++, no
+pickle of the bulk data); the main process reconstructs zero-copy numpy
+views over the popped bytes.
+
+Falls back cleanly: ``available()`` is False when the native toolchain is
+missing and DataLoader keeps using multiprocessing queues.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+from ..native import load as _load_native
+
+
+def _lib():
+    lib = _load_native("ringbuffer")
+    if lib is None:
+        return None
+    if not getattr(lib, "_pt_sigs_set", False):
+        lib.ptring_create.restype = ctypes.c_void_p
+        lib.ptring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.ptring_open.restype = ctypes.c_void_p
+        lib.ptring_open.argtypes = [ctypes.c_char_p]
+        lib.ptring_push.restype = ctypes.c_int
+        lib.ptring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64]
+        lib.ptring_pop_len.restype = ctypes.c_int64
+        lib.ptring_pop_len.argtypes = [ctypes.c_void_p]
+        lib.ptring_pop.restype = ctypes.c_int64
+        lib.ptring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_uint64]
+        lib.ptring_close.argtypes = [ctypes.c_void_p]
+        lib.ptring_free.argtypes = [ctypes.c_void_p]
+        lib.ptring_unlink.argtypes = [ctypes.c_char_p]
+        lib.ptring_used.restype = ctypes.c_uint64
+        lib.ptring_used.argtypes = [ctypes.c_void_p]
+        lib._pt_sigs_set = True
+    return lib
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+class ShmRing:
+    """One shm ring: multiple producers (workers), single consumer."""
+
+    def __init__(self, name=None, capacity=64 << 20, create=True):
+        self._lib = _lib()
+        if self._lib is None:
+            raise RuntimeError("native ring buffer unavailable")
+        if name is None:
+            import uuid
+            name = f"/pt_ring_{os.getpid()}_{uuid.uuid4().hex[:12]}"
+        self.name = name
+        if create:
+            self._h = self._lib.ptring_create(self.name.encode(),
+                                              capacity)
+        else:
+            self._h = self._lib.ptring_open(self.name.encode())
+        if not self._h:
+            raise RuntimeError(f"shm ring {'create' if create else 'open'} "
+                               f"failed for {self.name}")
+        self._owner = create
+
+    # -- raw framed messages -------------------------------------------------
+    def push_bytes(self, payload: bytes):
+        rc = self._lib.ptring_push(self._h, payload, len(payload))
+        if rc == -2:
+            raise ValueError("message larger than ring capacity")
+        if rc == -1:
+            raise EOFError("ring closed")
+
+    def pop_bytes(self):
+        n = self._lib.ptring_pop_len(self._h)
+        if n < 0:
+            return None                      # closed + drained
+        buf = bytearray(n)
+        got = self._lib.ptring_pop(
+            self._h, (ctypes.c_char * n).from_buffer(buf) if n else None, n)
+        if got == -1:
+            return None
+        assert got == n, (got, n)
+        return bytes(buf)
+
+    # -- numpy batch framing -------------------------------------------------
+    @staticmethod
+    def pack_arrays(seq: int, err: str, arrays) -> bytes:
+        """[u64 seq][u32 errlen][err][u32 n]{dtype,ndim,shape,u64 nbytes,
+        raw}*n — raw buffers are contiguous memcpy, no pickle."""
+        parts = [struct.pack("<QI", seq, len(err.encode())),
+                 err.encode(), struct.pack("<I", len(arrays))]
+        for a in arrays:
+            # NB: ascontiguousarray would promote 0-d to 1-d
+            a = np.asarray(a, order="C")
+            ds = a.dtype.str.encode()
+            parts.append(struct.pack("<I", len(ds)))
+            parts.append(ds)
+            parts.append(struct.pack("<I", a.ndim))
+            parts.append(struct.pack(f"<{a.ndim}Q", *a.shape)
+                         if a.ndim else b"")
+            parts.append(struct.pack("<Q", a.nbytes))
+            parts.append(a.tobytes())
+        return b"".join(parts)
+
+    @staticmethod
+    def unpack_arrays(blob: bytes):
+        off = 0
+        seq, errlen = struct.unpack_from("<QI", blob, off)
+        off += 12
+        err = blob[off:off + errlen].decode() if errlen else ""
+        off += errlen
+        (n,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        arrays = []
+        for _ in range(n):
+            (dl,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            dtype = np.dtype(blob[off:off + dl].decode())
+            off += dl
+            (ndim,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            shape = struct.unpack_from(f"<{ndim}Q", blob, off) if ndim \
+                else ()
+            off += 8 * ndim
+            (nbytes,) = struct.unpack_from("<Q", blob, off)
+            off += 8
+            a = np.frombuffer(blob, dtype=dtype, count=nbytes //
+                              max(dtype.itemsize, 1), offset=off)
+            # copy: (a) writable like the queue path (frombuffer views of
+            # bytes are read-only), (b) doesn't pin the whole blob alive
+            arrays.append(a.reshape(shape).copy())
+            off += nbytes
+        return seq, err, arrays
+
+    def push_batch(self, seq, arrays, err=""):
+        self.push_bytes(self.pack_arrays(seq, err, arrays))
+
+    def pop_batch(self):
+        blob = self.pop_bytes()
+        if blob is None:
+            return None
+        return self.unpack_arrays(blob)
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self):
+        if self._h:
+            self._lib.ptring_close(self._h)
+
+    def free(self):
+        if self._h:
+            self._lib.ptring_free(self._h)
+            if self._owner:
+                self._lib.ptring_unlink(self.name.encode())
+            self._h = None
+
+    def used(self):
+        if not self._h:
+            return 0
+        return int(self._lib.ptring_used(self._h))

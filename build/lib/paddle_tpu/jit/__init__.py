@@ -1,0 +1,317 @@
+"""paddle.jit: dynamic-to-static compilation + model export.
+
+Reference parity: python/paddle/fluid/dygraph/jit.py:160 (@declarative /
+@to_static) → ProgramTranslator (dygraph_to_static/program_translator.py:753)
+with per-input-signature ConcreteProgram cache (:579), executed by
+PartialProgramLayer via run_program_op (partial_program.py:108); jit.save /
+jit.load + TranslatedLayer (dygraph/io.py).
+
+TPU-first: jax tracing is the translator, fronted by a slim AST pass
+(dy2static.py) that rewrites Python if/while over Tensors into
+lax.cond/lax.while_loop converter calls — so data-dependent control flow
+compiles into real XLA control flow instead of freezing at trace time.
+A @to_static function becomes, per input signature, a dynamically
+registered framework primitive whose forward is the traced whole-function
+XLA computation and whose backward is its derived VJP — so it composes
+with the eager tape exactly like any single op (the run_program_op
+analogue, but compiled).
+
+jit.save exports serialized StableHLO (jax.export) + params; jit.load wraps
+it in a TranslatedLayer. The export is hardware-portable (any PJRT backend).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..framework import core
+from ..framework.tensor import Tensor
+from ..framework import functional as F
+from ..framework import random as random_mod
+from ..framework.primitive import Primitive
+
+
+def _sig_of(args):
+    sig = []
+    for a in args:
+        if isinstance(a, Tensor):
+            sig.append(("t", tuple(a._value.shape), str(a._value.dtype)))
+        elif hasattr(a, "shape"):
+            sig.append(("a", tuple(a.shape), str(getattr(a, "dtype", "?"))))
+        else:
+            # include the type: baked constants must not alias across
+            # 1 / True / 1.0 (equal under ==, different programs)
+            sig.append(("c", type(a).__name__, a))
+    return tuple(sig)
+
+
+class StaticFunction:
+    """@to_static wrapper (dygraph/jit.py:160 + ConcreteProgram cache)."""
+
+    _COUNTER = [0]
+
+    def __init__(self, function, input_spec=None, layer=None):
+        self._function = function
+        self._input_spec = input_spec
+        self._layer = layer
+        self._cache = {}
+        functools.update_wrapper(self, function)
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return StaticFunction(self._function.__get__(instance, owner),
+                              self._input_spec, layer=instance)
+
+    def _ast_converted(self):
+        """AST-rewrite Python if/while into lax control flow before tracing
+        (dy2static.py; ast_transformer.py parity). Falls back to the
+        original function when the source can't be transformed — then
+        data-dependent branching surfaces as jax's tracer-bool error
+        instead of being silently frozen."""
+        if not hasattr(self, "_ast_fn"):
+            from .dy2static import ast_transform
+            fn = self._function
+            raw = getattr(fn, "__func__", fn)
+            bound = getattr(fn, "__self__", None)
+            if bound is None and self._layer is not None:
+                # instance-wrapped form (to_static(layer) stores the raw
+                # unbound forward): bind the layer as self
+                bound = self._layer
+            try:
+                new = ast_transform(fn)
+            except Exception:
+                new = None
+            out = new if (new is not None and new is not raw) else raw
+            self._ast_fn = out.__get__(bound) if bound is not None else out
+        return self._ast_fn
+
+    # -- concrete program construction --------------------------------------
+    def _concrete(self, args, kwargs):
+        layer = self._layer or getattr(self._function, "__self__", None)
+        if layer is not None and not hasattr(layer, "named_parameters"):
+            layer = None
+        param_names = [n for n, _ in layer.named_parameters()] if layer \
+            else []
+        fn = self._ast_converted()
+        # non-Tensor positional args are STATIC constants (the signature
+        # cache keys on their values): a Python bool/int steering control
+        # flow must not become a traced array
+        def _dynamic(a):
+            return isinstance(a, Tensor) or (hasattr(a, "shape") and
+                                             hasattr(a, "dtype"))
+
+        t_idx = [i for i, a in enumerate(args) if _dynamic(a)]
+        const_args = {i: a for i, a in enumerate(args) if not _dynamic(a)}
+        n_args = len(t_idx)
+        # Tensor-valued kwargs become dynamic inputs (NOT closed over: a
+        # later call with a different Tensor must not reuse stale values)
+        tkw_names = sorted(k for k, v in kwargs.items()
+                           if isinstance(v, Tensor))
+        const_kw = {k: v for k, v in kwargs.items() if k not in tkw_names}
+
+        def pure(*arrs):
+            arg_arrs = arrs[:n_args]
+            tkw_arrs = arrs[n_args:n_args + len(tkw_names)]
+            param_arrs = arrs[n_args + len(tkw_names):-1]
+            key = arrs[-1]
+            full_args = list(const_args.get(i) for i in range(len(args)))
+            for i, a in zip(t_idx, arg_arrs):
+                full_args[i] = Tensor(a)
+            kw = dict(const_kw)
+            kw.update({k: Tensor(a) for k, a in zip(tkw_names, tkw_arrs)})
+            gen = random_mod.default_generator
+            gen.push_traced_key(key)
+            try:
+                if layer is not None:
+                    params = dict(zip(param_names, param_arrs))
+                    with F._bound_state(layer, params, None):
+                        out = fn(*full_args, **kw)
+                else:
+                    out = fn(*full_args, **kw)
+            finally:
+                gen.pop_traced_key()
+            flat = out if isinstance(out, (tuple, list)) else (out,)
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in flat)
+
+        self._COUNTER[0] += 1
+        name = f"@to_static_{getattr(fn, '__name__', 'fn')}_{self._COUNTER[0]}"
+        prim = Primitive(name, pure, multi_output=True)
+        return prim, param_names, layer, tkw_names, t_idx
+
+    def __call__(self, *args, **kwargs):
+        tkw = {k: v for k, v in kwargs.items() if isinstance(v, Tensor)}
+        const_kw = tuple(sorted((k, v) for k, v in kwargs.items()
+                                if k not in tkw))
+        sig = (_sig_of(args), const_kw,
+               tuple((k, _sig_of([v])) for k, v in sorted(tkw.items())))
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._concrete(args, kwargs)
+            self._cache[sig] = entry
+        prim, param_names, layer, tkw_names, t_idx = entry
+        params = dict(layer.named_parameters()) if layer else {}
+        key = random_mod.default_generator.next_key()
+        ins = ([args[i] for i in t_idx] + [kwargs[k] for k in tkw_names]
+               + [params[n] for n in param_names] + [key])
+        out = prim(*ins)
+        if isinstance(out, tuple) and len(out) == 1:
+            return out[0]
+        return out
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._function)
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None):
+    """@paddle.jit.to_static parity."""
+    def deco(fn):
+        return StaticFunction(fn, input_spec)
+    if function is not None:
+        if hasattr(function, "forward"):  # a Layer: wrap its forward
+            if isinstance(function.forward, StaticFunction):
+                return function          # already converted: idempotent
+            function.forward = StaticFunction(function.forward.__func__,
+                                              input_spec, layer=function)
+            return function
+        return deco(function)
+    return deco
+
+
+declarative = to_static
+
+
+# -- save / load -------------------------------------------------------------
+
+class TranslatedLayer:
+    """dygraph/io.py TranslatedLayer parity: a loaded, compiled program."""
+
+    def __init__(self, exported, params):
+        self._exported = exported
+        self._params = params
+        self.training = False
+
+    @property
+    def num_inputs(self):
+        return len(self._exported.in_avals) - len(self._params)
+
+    @property
+    def num_outputs(self):
+        return len(self._exported.out_avals)
+
+    def __call__(self, *args):
+        arrs = [a._value if isinstance(a, Tensor) else np.asarray(a)
+                for a in args]
+        out = self._exported.call(*arrs, *self._params)
+        if isinstance(out, (list, tuple)):
+            outs = [Tensor(o) for o in out]
+            return outs[0] if len(outs) == 1 else outs
+        return Tensor(out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only")
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save parity: serialize compiled forward + params.
+
+    Format: <path>.pdmodel = serialized StableHLO (jax.export),
+    <path>.pdiparams = pickled numpy params.
+    """
+    from jax import export as jax_export
+    from ..static import InputSpec
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes to export)")
+    specs = []
+    sym_count = [0]
+
+    def to_struct(shape, dtype):
+        if any(d is None or (isinstance(d, int) and d < 0) for d in shape):
+            # dynamic dims export as symbolic dimensions so the loaded
+            # model accepts any batch size (shape polymorphism)
+            dims = []
+            for d in shape:
+                if d is None or d < 0:
+                    sym_count[0] += 1
+                    dims.append(f"b{sym_count[0]}")
+                else:
+                    dims.append(str(d))
+            sym = jax_export.symbolic_shape(", ".join(dims))
+            return jax.ShapeDtypeStruct(sym, dtype)
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            from ..framework.dtype import convert_dtype
+            specs.append(to_struct(s.shape, convert_dtype(s.dtype)))
+        else:
+            specs.append(to_struct(list(s.shape), s.dtype))
+
+    apply, params, buffers = F.functionalize(layer, training=False)
+    names = list(params)
+
+    def fwd(*arrs):
+        n = len(specs)
+        p = dict(zip(names, arrs[n:]))
+        return apply(p, buffers, *arrs[:n])
+
+    param_vals = [params[n] for n in names]
+    exported = jax_export.export(jax.jit(fwd))(
+        *specs, *[jax.ShapeDtypeStruct(v.shape, v.dtype)
+                  for v in param_vals])
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump([np.asarray(v) for v in param_vals], f, protocol=4)
+
+
+def load(path, **configs):
+    """paddle.jit.load parity -> TranslatedLayer."""
+    from jax import export as jax_export
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path + ".pdiparams", "rb") as f:
+        params = pickle.load(f)
+    return TranslatedLayer(exported, [np.asarray(p) for p in params])
+
+
+def not_to_static(fn):
+    return fn
+
+
+class ProgramTranslator:
+    """program_translator.py:753 parity (global enable switch)."""
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static):
+        ProgramTranslator.enable_to_static = bool(enable_to_static)
+
+
+def enable_to_static(flag=True):
+    ProgramTranslator.get_instance().enable(flag)

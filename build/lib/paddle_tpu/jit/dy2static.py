@@ -1,0 +1,824 @@
+"""AST-based dygraph-to-static conversion.
+
+Reference parity: python/paddle/fluid/dygraph/dygraph_to_static/ —
+ast_transformer.py (DygraphToStaticAst, the 15-transformer pipeline),
+ifelse_transformer.py, loop_transformer.py (for→while lowering),
+break_continue_transformer.py (escape flags), return_transformer.py
+(early-return flags), logical_transformer.py, and convert_operators.py
+(convert_ifelse / convert_while_loop / convert_logical_and...).
+
+TPU-shape: the reference rewrites Python control flow into
+cond_op/while_op graph ops; here the same AST rewrite targets the
+framework's ``ops.control_flow.cond`` / ``while_loop``, which lower to
+``lax.cond`` / ``lax.while_loop`` under the jax trace — so a @to_static
+function with data-dependent Python ``if``/``while`` compiles into real
+XLA control flow instead of being silently frozen at trace time (the
+round-1 gap).
+
+Mechanics: branches/bodies become nested functions that mutate the
+enclosing frame via ``nonlocal`` (the reference's get_args/set_args
+scheme); the runtime converters snapshot + restore those locals around
+each traced branch so both arms see the pre-branch state.
+"""
+from __future__ import annotations
+
+import ast
+import copy
+import functools
+import inspect
+import textwrap
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, unwrap
+from ..ops import control_flow as _cf
+
+
+class Dy2StaticError(RuntimeError):
+    pass
+
+
+def _is_traced(v):
+    x = unwrap(v)
+    return isinstance(x, jax.core.Tracer)
+
+
+def _is_tensorish(v):
+    return isinstance(v, Tensor) or isinstance(unwrap(v), jax.Array) \
+        or _is_traced(v)
+
+
+# -- runtime converters (convert_operators.py parity) ---------------------------
+
+def _reconcile_branch_outputs(branches, init, set_args):
+    """Both arms of a traced cond must produce the same pytree. Names first
+    bound inside one arm start as None (create_undefined_var); where one arm
+    yields None and the other an array, substitute zeros so the conditional
+    carries a well-typed value — the reference's RETURN_NO_VALUE scheme. The
+    value is only observed when the matching flag says the arm ran.
+    Returns wrapped branch fns, or the originals when reconciliation is
+    unnecessary/impossible."""
+    if not _builtin_any(unwrap(v) is None for v in init):
+        # reconciliation is only ever needed for branch-first-bound names,
+        # which always start as None — skip the double trace otherwise
+        return branches
+    try:
+        avals = []
+        for run in branches:
+            avals.append(jax.eval_shape(run))
+            set_args(init)          # clear eval_shape tracers from the frame
+    except Exception:
+        return branches
+    a, b = avals
+    if len(a) != len(b):
+        return branches
+    need = [(x is None) != (y is None) for x, y in zip(a, b)]
+    if not _builtin_any(need):
+        return branches
+    merged = [x if x is not None else y for x, y in zip(a, b)]
+
+    def wrap(run):
+        def go():
+            out = run()
+            return tuple(
+                jnp.zeros(m.shape, m.dtype) if v is None and n else v
+                for v, m, n in zip(out, merged, need))
+        return go
+
+    return [wrap(r) for r in branches]
+
+
+_builtin_any = any
+_builtin_all = all
+
+
+def convert_ifelse(pred, true_fn, false_fn, get_args, set_args):
+    """convert_operators.py convert_ifelse: run both branches under
+    lax.cond when pred is a traced Tensor; plain Python branch otherwise."""
+    if _is_traced(pred):
+        try:
+            init = get_args()
+        except (NameError, UnboundLocalError) as e:
+            raise Dy2StaticError(
+                "variables assigned inside a Tensor-dependent `if` must be "
+                f"initialized before it ({e})") from e
+
+        def _branch(fn):
+            def run():
+                set_args(init)
+                fn()
+                return tuple(unwrap(v) for v in get_args())
+            return run
+
+        tb, fb = _reconcile_branch_outputs(
+            [_branch(true_fn), _branch(false_fn)], init, set_args)
+        out = _cf.cond(pred, tb, fb)
+        out = out if isinstance(out, (tuple, list)) else (out,)
+        set_args(tuple(out))
+        return
+    if bool(unwrap(pred)):
+        true_fn()
+    else:
+        false_fn()
+
+
+def convert_while_loop(cond_fn, body_fn, get_args, set_args):
+    """convert_operators.py convert_while_loop: lax.while_loop when the
+    condition is traced; Python while otherwise."""
+    first = cond_fn()
+    if _is_traced(first):
+        try:
+            init = tuple(unwrap(v) for v in get_args())
+        except (NameError, UnboundLocalError) as e:
+            raise Dy2StaticError(
+                "loop variables of a Tensor-dependent `while` must be "
+                f"initialized before it ({e})") from e
+
+        def c(vals):
+            set_args(vals)
+            return jnp.reshape(unwrap(cond_fn()), ()).astype(bool)
+
+        def b(vals):
+            set_args(vals)
+            body_fn()
+            return tuple(jnp.asarray(unwrap(v)) for v in get_args())
+
+        if _builtin_any(v is None for v in init):
+            # a carry first bound inside the body (lowered for-loop target,
+            # __pt_rv of an in-loop return, escape flags) starts as None;
+            # discover the body's output aval by probing and seed typed
+            # zeros — sound because the body writes such a carry before any
+            # read. The probe is a small fixpoint: placeholder dtypes are
+            # cycled and refined from the observed body output, since a
+            # wrong placeholder dtype makes the body's own cond branches
+            # disagree before we can see the real aval.
+            fill = {i: None for i, v in enumerate(init) if v is None}
+
+            def mk_probe():
+                return tuple(
+                    (jnp.zeros(fill[i].shape, fill[i].dtype)
+                     if fill.get(i) is not None
+                     else jnp.zeros((), dt)) if i in fill else jnp.asarray(v)
+                    for i, v in enumerate(init))
+
+            avals = None
+            last_err = None
+            for dt in (jnp.float32, jnp.int32, jnp.bool_):
+                for _refine in range(3):
+                    try:
+                        avals = jax.eval_shape(b, mk_probe())
+                    except Exception as e:
+                        last_err = e
+                        avals = None
+                        break
+                    stable = _builtin_all(
+                        fill[i] is not None
+                        and (fill[i].shape, fill[i].dtype)
+                        == (avals[i].shape, avals[i].dtype)
+                        for i in fill) if fill else True
+                    for i in fill:
+                        fill[i] = avals[i]
+                    if stable:
+                        break
+                if avals is not None:
+                    break
+                fill = {i: None for i in fill}
+            if avals is None:
+                raise Dy2StaticError(
+                    "could not type a loop variable that is first assigned "
+                    "inside a Tensor-dependent loop; initialize it before "
+                    f"the loop ({last_err})") from last_err
+            set_args(init)      # clear probe tracers from the frame
+            init = tuple(jnp.zeros(a.shape, a.dtype) if v is None else v
+                         for v, a in zip(init, avals))
+        out = jax.lax.while_loop(c, b, init)
+        set_args(tuple(out))
+        return
+    while True:
+        try:
+            go = bool(unwrap(cond_fn()))
+        except jax.errors.TracerBoolConversionError as e:
+            raise Dy2StaticError(
+                "the loop condition became tensor-dependent only after the "
+                "loop started (e.g. a Tensor `break` inside a Python-bound "
+                "loop); make the loop bound a Tensor (paddle.arange / "
+                "paddle.to_tensor) so the whole loop is traced") from e
+        if not go:
+            break
+        body_fn()
+
+
+def convert_logical_and(x_fn, y_fn):
+    x = x_fn()
+    if _is_tensorish(x):
+        from ..ops import logical_and
+        return logical_and(x, y_fn())
+    return x and y_fn()
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    if _is_tensorish(x):
+        from ..ops import logical_or
+        return logical_or(x, y_fn())
+    return x or y_fn()
+
+
+def convert_logical_not(x):
+    if _is_tensorish(x):
+        from ..ops import logical_not
+        return logical_not(x)
+    return not x
+
+
+# -- iteration helpers (loop_transformer.py parity) -----------------------------
+
+class _RangeProxy:
+    """range() whose bounds may be traced Tensors: indexable arithmetic
+    stand-in so a for-over-range with a Tensor bound lowers to
+    lax.while_loop instead of crashing in range().__init__."""
+
+    def __init__(self, start, stop=None, step=None):
+        if stop is None:
+            start, stop = 0, start
+        if step is None:
+            step = 1
+        self.start, self.stop, self.step = start, stop, step
+
+    def length(self):
+        s0, s1, st = (unwrap(self.start), unwrap(self.stop),
+                      unwrap(self.step))
+        n = (s1 - s0 + st - jnp.sign(st)) // st
+        return jnp.maximum(n, 0)
+
+    def getitem(self, i):
+        return self.start + unwrap(i) * self.step
+
+
+def convert_range(*args):
+    vals = [unwrap(a) for a in args]
+    if _builtin_any(isinstance(v, jax.core.Tracer) for v in vals):
+        return _RangeProxy(*vals)
+    return range(*(int(v) for v in vals))
+
+
+def convert_indexable(x):
+    """Pass a for-loop iterable through unchanged; the lowered code asks
+    convert_is_indexed() which protocol to use."""
+    return x
+
+
+def convert_is_indexed(x):
+    """True when the iterable supports the indexed-while lowering (len +
+    getitem); generators/streams return False and keep the original Python
+    ``for`` (lazy, never materialized — a DataLoader or itertools.count
+    must not be list()'d)."""
+    if isinstance(x, (_RangeProxy, range, list, tuple)):
+        return True
+    if _is_tensorish(x):
+        return True
+    return hasattr(x, "__len__") and hasattr(x, "__getitem__")
+
+
+def convert_len(x):
+    if isinstance(x, _RangeProxy):
+        return x.length()
+    if _is_tensorish(x):
+        u = unwrap(x)
+        if u.ndim == 0:
+            raise Dy2StaticError("cannot iterate over a 0-d Tensor")
+        return u.shape[0]
+    return len(x)
+
+
+def convert_getitem(x, i):
+    if isinstance(x, _RangeProxy):
+        return x.getitem(i)
+    iv = unwrap(i)
+    if isinstance(x, range):
+        if isinstance(iv, jax.core.Tracer):
+            return x.start + iv * x.step
+        return x[int(iv)]
+    if _is_tensorish(x):
+        return x[i]
+    if isinstance(iv, jax.core.Tracer):
+        try:
+            return jnp.asarray(x)[iv]
+        except Exception as e:
+            raise Dy2StaticError(
+                "a Python list/tuple cannot be indexed by a traced loop "
+                "counter; convert it to a Tensor first") from e
+    return x[int(iv)]
+
+
+_JST = {
+    "_jst_ifelse": convert_ifelse,
+    "_jst_while": convert_while_loop,
+    "_jst_and": convert_logical_and,
+    "_jst_or": convert_logical_or,
+    "_jst_not": convert_logical_not,
+    "_jst_range": convert_range,
+    "_jst_indexable": convert_indexable,
+    "_jst_is_indexed": convert_is_indexed,
+    "_jst_len": convert_len,
+    "_jst_getitem": convert_getitem,
+}
+
+
+# -- AST transformer ------------------------------------------------------------
+
+def _assigned_names(nodes):
+    """Names bound (Store ctx) in a statement list, excluding nested
+    function/class scopes."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        # function/class defs neither descend (new scope) nor count as
+        # branch outputs: a def is not a lax.cond-carriable value (and the
+        # transformer's own __pt_* helpers must never become loop vars)
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_ClassDef(self, node):
+            pass
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Store):
+                names.append(node.id)
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    out = []
+    for n in names:
+        if n not in out:
+            out.append(n)
+    return out
+
+
+def _has_escape(nodes):
+    """True if the statement list contains a return, or a break/continue
+    that would escape the branch (break/continue inside a nested loop
+    belong to that loop and are fine)."""
+    found = False
+
+    def walk(n, in_loop):
+        nonlocal found
+        if found:
+            return
+        if isinstance(n, ast.Return):
+            found = True
+            return
+        if isinstance(n, (ast.Break, ast.Continue)) and not in_loop:
+            found = True
+            return
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            return
+        nested = in_loop or isinstance(n, (ast.For, ast.AsyncFor,
+                                           ast.While))
+        for c in ast.iter_child_nodes(n):
+            walk(c, nested)
+
+    for n in nodes:
+        walk(n, False)
+    return found
+
+
+RET_FLAG = "__pt_ret"
+RET_VAL = "__pt_rv"
+
+
+def _assigns_name(nodes, name):
+    """True if any statement in ``nodes`` (excluding nested def/class
+    scopes) binds ``name``."""
+    todo = list(nodes)
+    while todo:
+        n = todo.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store) \
+                and n.id == name:
+            return True
+        todo.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _not_flags_test(flags):
+    src = " and ".join(f"(not {f})" for f in flags)
+    return ast.parse(src, mode="eval").body
+
+
+def _guard_stmts(stmts, flags):
+    """break_continue_transformer.py guard scheme: after any statement that
+    may set one of ``flags``, wrap the remainder of the list in
+    ``if not flag...:`` so setting a flag skips the rest. Recurses into
+    every compound statement with linear bodies (if/with/try) so a flag set
+    inside one also skips that block's own remainder."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.If):
+            s = ast.If(test=s.test, body=_guard_stmts(s.body, flags),
+                       orelse=_guard_stmts(s.orelse, flags))
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            s = type(s)(items=s.items, body=_guard_stmts(s.body, flags))
+        elif isinstance(s, ast.Try):
+            s = ast.Try(
+                body=_guard_stmts(s.body, flags),
+                handlers=[ast.ExceptHandler(
+                    type=h.type, name=h.name,
+                    body=_guard_stmts(h.body, flags)) for h in s.handlers],
+                orelse=_guard_stmts(s.orelse, flags),
+                finalbody=_guard_stmts(s.finalbody, flags))
+        out.append(s)
+        if _builtin_any(_assigns_name([s], f) for f in flags) \
+                and idx + 1 < len(stmts):
+            rest = _guard_stmts(stmts[idx + 1:], flags)
+            out.append(ast.If(test=_not_flags_test(flags), body=rest,
+                              orelse=[]))
+            break
+    return out
+
+
+class _ForToWhile(ast.NodeTransformer):
+    """loop_transformer.py parity: lower ``for`` to an indexed ``while`` so
+    the while machinery (and lax.while_loop for traced bounds) applies. The
+    counter increments BEFORE the body so a later ``continue`` transform
+    cannot skip it."""
+
+    def __init__(self):
+        self._n = 0
+        self.count = 0
+        self._entered = False
+
+    def visit_FunctionDef(self, node):
+        # transform the outermost def only; nested defs keep their own
+        # semantics
+        if self._entered:
+            return node
+        self._entered = True
+        self.generic_visit(node)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node      # for-else keeps Python semantics
+        self._n += 1
+        self.count += 1
+        u = self._n
+        it, i, n = f"__pt_it_{u}", f"__pt_i_{u}", f"__pt_n_{u}"
+        iter_expr = node.iter
+        if (isinstance(iter_expr, ast.Call)
+                and isinstance(iter_expr.func, ast.Name)
+                and iter_expr.func.id == "range"):
+            iter_expr = ast.Call(
+                func=ast.Name(id="_jst_range", ctx=ast.Load()),
+                args=iter_expr.args, keywords=iter_expr.keywords)
+        pre = ast.parse(f"{it} = _jst_indexable(None)").body
+        pre[0].value.args = [iter_expr]
+        tgt = ast.Assign(
+            targets=[node.target],
+            value=ast.parse(f"_jst_getitem({it}, {i})", mode="eval").body)
+        inc = ast.parse(f"{i} = {i} + 1").body[0]
+        test = ast.parse(f"{i} < {n}", mode="eval").body
+        indexed = ast.parse(f"{n} = _jst_len({it})\n{i} = 0").body + [
+            ast.While(test=test,
+                      body=[tgt, inc] + copy.deepcopy(node.body),
+                      orelse=[])]
+        # lazy iterables (generators, DataLoaders) keep the original Python
+        # for — never materialized; runtime picks the protocol
+        lazy = [ast.For(target=copy.deepcopy(node.target),
+                        iter=ast.Name(id=it, ctx=ast.Load()),
+                        body=node.body, orelse=[])]
+        dispatch = ast.If(
+            test=ast.parse(f"_jst_is_indexed({it})", mode="eval").body,
+            body=indexed, orelse=lazy)
+        return pre + [dispatch]
+
+
+class _ReturnTransformer(ast.NodeTransformer):
+    """return_transformer.py parity: every ``return X`` becomes
+    ``__pt_rv = X; __pt_ret = True`` (+ ``break`` inside a loop); the
+    function tail returns ``__pt_rv``. Guarding + loop-condition
+    augmentation happen in _guard_stmts/_LoopEscapeTransformer."""
+
+    def __init__(self):
+        self.count = 0
+        self._depth = 0
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _visit_list(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            out.extend(r if isinstance(r, list) else [r])
+        return out
+
+    def _visit_loop(self, node):
+        # break/continue are only legal in the loop BODY — the orelse runs
+        # at the enclosing depth, so a return there must not emit a break
+        self._depth += 1
+        node.body = self._visit_list(node.body)
+        self._depth -= 1
+        node.orelse = self._visit_list(node.orelse)
+        return node
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_Return(self, node):
+        self.count += 1
+        stmts = []
+        if node.value is not None:
+            asg = ast.parse(f"{RET_VAL} = 0").body[0]
+            asg.value = node.value
+            stmts.append(asg)
+        else:
+            stmts.append(ast.parse(f"{RET_VAL} = None").body[0])
+        stmts.append(ast.parse(f"{RET_FLAG} = True").body[0])
+        if self._depth > 0:
+            stmts.append(ast.Break())
+        return stmts
+
+    def run(self, fdef):
+        """Transform unless the only return is a single tail statement."""
+        rets = []
+        todo = list(fdef.body)
+        while todo:
+            n = todo.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(n, ast.Return):
+                rets.append(n)
+            todo.extend(ast.iter_child_nodes(n))
+        if not rets or (len(rets) == 1 and fdef.body
+                        and fdef.body[-1] is rets[0]):
+            return False
+        fdef.body = [self.visit(s) if not isinstance(s, list) else s
+                     for s in fdef.body]
+        # visit() may return lists; flatten
+        flat = []
+        for s in fdef.body:
+            flat.extend(s if isinstance(s, list) else [s])
+        fdef.body = flat
+        return True
+
+
+class _LoopEscapeTransformer(ast.NodeTransformer):
+    """break_continue_transformer.py parity: rewrite a loop's own
+    break/continue into flag assignments, guard trailing statements, and
+    fold the flags (plus the function-level return flag when the body sets
+    it) into the loop condition."""
+
+    class _Replacer(ast.NodeTransformer):
+        def __init__(self, brk, cont):
+            self.brk, self.cont = brk, cont
+            self.found_brk = self.found_cont = False
+
+        def _stop(self, node):
+            return node
+
+        visit_While = _stop
+        visit_For = _stop
+        visit_FunctionDef = _stop
+        visit_AsyncFunctionDef = _stop
+        visit_ClassDef = _stop
+
+        def visit_Break(self, node):
+            self.found_brk = True
+            return ast.parse(f"{self.brk} = True").body[0]
+
+        def visit_Continue(self, node):
+            self.found_cont = True
+            return ast.parse(f"{self.cont} = True").body[0]
+
+    def __init__(self):
+        self._n = 0
+        self.count = 0
+        self._entered = False
+
+    def visit_FunctionDef(self, node):
+        if self._entered:
+            return node
+        self._entered = True
+        self.generic_visit(node)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_While(self, node):
+        self.generic_visit(node)     # inner loops first
+        self._n += 1
+        u = self._n
+        brk, cont = f"__pt_brk_{u}", f"__pt_cont_{u}"
+        rep = self._Replacer(brk, cont)
+        body = [rep.visit(s) for s in node.body]
+        has_ret = _assigns_name(body, RET_FLAG)
+        if not rep.found_brk and not rep.found_cont and not has_ret:
+            return node
+        self.count += 1
+        cond_flags = ([brk] if rep.found_brk else []) \
+            + ([RET_FLAG] if has_ret else [])
+        guard_flags = cond_flags + ([cont] if rep.found_cont else [])
+        body = _guard_stmts(body, guard_flags)
+        if rep.found_cont:
+            body = [ast.parse(f"{cont} = False").body[0]] + body
+        test = node.test
+        if cond_flags:
+            test = ast.BoolOp(op=ast.And(),
+                              values=[_not_flags_test(cond_flags),
+                                      node.test])
+        pre = []
+        if rep.found_brk:
+            pre.append(ast.parse(f"{brk} = False").body[0])
+        out = pre + [ast.While(test=test, body=body, orelse=[])]
+        if node.orelse:
+            # while-else runs iff the loop exited without break/return;
+            # with the flag scheme that is exactly "no flag set"
+            if cond_flags:
+                out.append(ast.If(test=_not_flags_test(cond_flags),
+                                  body=list(node.orelse), orelse=[]))
+            else:       # only continues: the else always runs
+                out.extend(node.orelse)
+        return out
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrite if/while into converter calls (ifelse_transformer.py /
+    loop_transformer.py)."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- helpers (build nodes from parsed templates so every field the
+    # running Python version requires — e.g. 3.12's type_params — is set)
+    def _fn_def(self, name, body, nonlocals):
+        f = ast.parse(f"def {name}():\n    pass").body[0]
+        stmts = []
+        if nonlocals:
+            stmts.append(ast.Nonlocal(names=list(nonlocals)))
+        stmts.extend(body)
+        f.body = stmts or [ast.Pass()]
+        return f
+
+    def _getter(self, name, names):
+        tup = ", ".join(names)
+        src = f"def {name}():\n    return ({tup}{',' if names else ''})"
+        return ast.parse(src).body[0]
+
+    def _setter(self, name, names):
+        if names:
+            tup = ", ".join(names)
+            src = (f"def {name}(__pt_vals):\n"
+                   f"    nonlocal {tup}\n"
+                   f"    ({tup},) = __pt_vals")
+        else:
+            src = f"def {name}(__pt_vals):\n    pass"
+        return ast.parse(src).body[0]
+
+    @staticmethod
+    def _initializers(names):
+        """Guarantee an enclosing-scope binding for every branch-assigned
+        name (ifelse_transformer's create_undefined_var): names already
+        bound keep their value; names first bound inside the branch start
+        as None."""
+        stmts = []
+        for n in names:
+            src = (f"try:\n    {n}\n"
+                   f"except (NameError, UnboundLocalError):\n"
+                   f"    {n} = None")
+            stmts.extend(ast.parse(src).body)
+        return stmts
+
+    # -- boolean operators in conditions --------------------------------------
+    @staticmethod
+    def _lambda_of(expr):
+        lam = ast.parse("lambda: 0", mode="eval").body
+        lam.body = expr
+        return lam
+
+    def _convert_bool_ops(self, node):
+        if isinstance(node, ast.BoolOp):
+            fn = "_jst_and" if isinstance(node.op, ast.And) else "_jst_or"
+            out = self._convert_bool_ops(node.values[-1])
+            for v in reversed(node.values[:-1]):
+                out = ast.Call(
+                    func=ast.Name(id=fn, ctx=ast.Load()),
+                    args=[self._lambda_of(self._convert_bool_ops(v)),
+                          self._lambda_of(out)],
+                    keywords=[])
+            return out
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return ast.Call(func=ast.Name(id="_jst_not", ctx=ast.Load()),
+                            args=[self._convert_bool_ops(node.operand)],
+                            keywords=[])
+        return node
+
+    # -- if ------------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node     # early return/break: keep Python semantics
+        uid = self._uid()
+        names = _assigned_names(node.body + node.orelse)
+        test = self._convert_bool_ops(node.test)
+        true_fn = self._fn_def(f"__pt_true_{uid}", node.body, names)
+        false_fn = self._fn_def(f"__pt_false_{uid}", node.orelse, names)
+        getter = self._getter(f"__pt_get_{uid}", names)
+        setter = self._setter(f"__pt_set_{uid}", names)
+        call = ast.Expr(value=ast.Call(
+            func=ast.Name(id="_jst_ifelse", ctx=ast.Load()),
+            args=[test,
+                  ast.Name(id=f"__pt_true_{uid}", ctx=ast.Load()),
+                  ast.Name(id=f"__pt_false_{uid}", ctx=ast.Load()),
+                  ast.Name(id=f"__pt_get_{uid}", ctx=ast.Load()),
+                  ast.Name(id=f"__pt_set_{uid}", ctx=ast.Load())],
+            keywords=[]))
+        return self._initializers(names) + \
+            [true_fn, false_fn, getter, setter, call]
+
+    # -- while ----------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or node.orelse:
+            return node
+        uid = self._uid()
+        names = _assigned_names(node.body)
+        test = self._convert_bool_ops(node.test)
+        cond_fn = ast.parse(f"def __pt_cond_{uid}():\n    return 0").body[0]
+        cond_fn.body[0].value = test
+        body_fn = self._fn_def(f"__pt_body_{uid}", node.body, names)
+        getter = self._getter(f"__pt_get_{uid}", names)
+        setter = self._setter(f"__pt_set_{uid}", names)
+        call = ast.Expr(value=ast.Call(
+            func=ast.Name(id="_jst_while", ctx=ast.Load()),
+            args=[ast.Name(id=f"__pt_cond_{uid}", ctx=ast.Load()),
+                  ast.Name(id=f"__pt_body_{uid}", ctx=ast.Load()),
+                  ast.Name(id=f"__pt_get_{uid}", ctx=ast.Load()),
+                  ast.Name(id=f"__pt_set_{uid}", ctx=ast.Load())],
+            keywords=[]))
+        return self._initializers(names) + \
+            [cond_fn, body_fn, getter, setter, call]
+
+
+def ast_transform(func):
+    """Rewrite ``func``'s if/while into converter calls. Returns the new
+    function, or None when the source is unavailable/untransformable
+    (lambdas, closures, C extensions) — callers fall back to plain tracing
+    (program_translator.py's to-static fallback)."""
+    raw = getattr(func, "__func__", func)
+    if raw.__closure__:          # can't rebuild closure cells faithfully
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []
+    # transformer pipeline (ast_transformer.py order): for→while, returns,
+    # break/continue escapes, then if/while → converter calls
+    ft = _ForToWhile()
+    tree = ft.visit(tree)
+    rt = _ReturnTransformer()
+    did_ret = rt.run(fdef)
+    et = _LoopEscapeTransformer()
+    tree = et.visit(tree)
+    if did_ret:
+        fdef.body = (ast.parse(f"{RET_VAL} = None\n{RET_FLAG} = False").body
+                     + _guard_stmts(fdef.body, [RET_FLAG])
+                     + [ast.parse(f"return {RET_VAL}").body[0]])
+    t = _ControlFlowTransformer()
+    new_tree = t.visit(tree)
+    if t._n == 0 and ft.count == 0 and et.count == 0 and not did_ret:
+        return raw               # nothing to rewrite
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, filename=f"<dy2static {raw.__name__}>",
+                   mode="exec")
+    globs = dict(raw.__globals__)
+    globs.update(_JST)
+    ns = {}
+    exec(code, globs, ns)
+    new = ns[fdef.name]
+    functools.update_wrapper(new, raw)
+    new.__pt_dy2static__ = True
+    return new

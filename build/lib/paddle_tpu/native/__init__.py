@@ -1,0 +1,83 @@
+"""Native (C++) runtime components, consumed via ctypes.
+
+Reference parity: the C++ runtime underneath the reference's Python API —
+here only the pieces that still matter on TPU, where PJRT/XLA own the
+device runtime: the shared-memory DataLoader transport
+(mmap_allocator.cc parity, ringbuffer.cpp).
+
+Build model: compiled on first use with g++ (this image has no pybind11 —
+the ABI is plain C + ctypes). The .so is cached next to the source keyed
+by a source hash; callers must treat ``load()`` as optional and fall back
+to pure-Python paths when no toolchain is present.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIBS = {}
+
+
+def _build(src_name: str, lib_base: str):
+    src = os.path.join(_HERE, src_name)
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:12]
+    out_dir = os.path.join(_HERE, "_build")
+    os.makedirs(out_dir, exist_ok=True)
+    out = os.path.join(out_dir, f"{lib_base}-{tag}.so")
+    if not os.path.exists(out):
+        # pid-unique temp: concurrent builders (two processes on a cold
+        # cache) must not interleave writes into one .tmp
+        tmp = f"{out}.tmp.{os.getpid()}"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
+               "-o", tmp, "-lpthread", "-lrt"]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, out)
+    return out
+
+
+def load(name: str = "ringbuffer"):
+    """Load (building if needed) a native library; None when unavailable."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        try:
+            path = _build(f"{name}.cpp", f"libpt_{name}")
+            lib = ctypes.CDLL(path)
+        except Exception:
+            lib = None
+        _LIBS[name] = lib
+        return lib
+
+
+def build_capi():
+    """Build the C inference ABI (capi.cpp — embeds CPython, so it needs
+    the interpreter's include/link flags from python3-config). Returns the
+    .so path; raises when no toolchain. Consumers link this and call
+    pd_predictor_create/run_f32/destroy (inference/capi parity)."""
+    import sysconfig
+    src = os.path.join(_HERE, "capi.cpp")
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:12]
+    out_dir = os.path.join(_HERE, "_build")
+    os.makedirs(out_dir, exist_ok=True)
+    out = os.path.join(out_dir, f"libpt_capi-{tag}.so")
+    if os.path.exists(out):
+        return out
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_python_version()
+    tmp = f"{out}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           f"-I{inc}", src, "-o", tmp,
+           f"-L{libdir}", f"-Wl,-rpath,{libdir}", f"-lpython{ver}",
+           "-lpthread", "-ldl", "-lutil"]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, out)
+    return out

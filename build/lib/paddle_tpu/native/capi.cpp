@@ -1,0 +1,129 @@
+// C inference ABI over the paddle_tpu predictor.
+//
+// Reference parity: paddle/fluid/inference/capi/ (pd_config.cc/pd_predictor.cc)
+// — a plain-C surface so C/Go/R programs can load a saved model and run it.
+// The TPU build's predictor executes through PJRT from Python, so this shim
+// embeds CPython and marshals through inference/capi_bridge.py; the caller
+// links ONLY this C ABI (no Python headers needed on the consumer side —
+// see tests/test_capi.py's demo program).
+//
+// Environment contract: PYTHONPATH must reach paddle_tpu and its deps
+// (the embedding inherits the process env, like any CPython).
+//
+// Build (native/__init__.py build_capi):
+//   g++ -O2 -shared -fPIC capi.cpp $(python3-config --includes) \
+//       $(python3-config --ldflags --embed) -o libpt_capi.so
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+
+namespace {
+
+std::string g_err;
+PyObject* g_bridge = nullptr;
+
+void set_err_from_python() {
+    PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &value, &tb);
+    if (value != nullptr) {
+        PyObject* s = PyObject_Str(value);
+        if (s != nullptr) {
+            const char* c = PyUnicode_AsUTF8(s);
+            g_err = c ? c : "unknown python error";
+            Py_DECREF(s);
+        }
+    } else {
+        g_err = "unknown python error";
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+}
+
+bool ensure_init() {
+    if (g_bridge != nullptr) return true;
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        // release the GIL so pd_* entry points can take it from any thread
+        PyEval_SaveThread();
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* mod = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+    if (mod == nullptr) {
+        set_err_from_python();
+        PyGILState_Release(g);
+        return false;
+    }
+    g_bridge = mod;  // keep the reference for process lifetime
+    PyGILState_Release(g);
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* pd_last_error() { return g_err.c_str(); }
+
+// Load a saved model (save_inference_model dir or jit.save prefix).
+// Returns an opaque handle, or null (see pd_last_error()).
+void* pd_predictor_create(const char* model_path) {
+    if (!ensure_init()) return nullptr;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* pred = PyObject_CallMethod(g_bridge, "create", "s", model_path);
+    if (pred == nullptr) set_err_from_python();
+    PyGILState_Release(g);
+    return pred;
+}
+
+// One float32 input (shape[ndim]) -> first float32 output, copied into
+// out (capacity out_cap elements). Returns the output element count
+// (which may exceed out_cap — call again with a larger buffer), or -1.
+long long pd_predictor_run_f32(void* handle, const float* in,
+                               const long long* shape, int ndim,
+                               float* out, long long out_cap) {
+    if (handle == nullptr) { g_err = "null predictor"; return -1; }
+    long long n = 1;
+    for (int i = 0; i < ndim; ++i) n *= shape[i];
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* data = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(in), n * sizeof(float));
+    PyObject* shp = PyTuple_New(ndim);
+    for (int i = 0; i < ndim; ++i)
+        PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+    PyObject* res = PyObject_CallMethod(g_bridge, "run_f32", "OOO",
+                                        static_cast<PyObject*>(handle),
+                                        data, shp);
+    Py_DECREF(data);
+    Py_DECREF(shp);
+    long long count = -1;
+    if (res == nullptr) {
+        set_err_from_python();
+    } else {
+        PyObject* obytes = PyTuple_GetItem(res, 0);   // borrowed
+        char* buf = nullptr;
+        Py_ssize_t blen = 0;
+        if (PyBytes_AsStringAndSize(obytes, &buf, &blen) == 0) {
+            count = blen / static_cast<long long>(sizeof(float));
+            long long ncopy = count < out_cap ? count : out_cap;
+            if (out != nullptr && ncopy > 0)
+                std::memcpy(out, buf, ncopy * sizeof(float));
+        } else {
+            set_err_from_python();
+        }
+        Py_DECREF(res);
+    }
+    PyGILState_Release(g);
+    return count;
+}
+
+void pd_predictor_destroy(void* handle) {
+    if (handle == nullptr) return;
+    PyGILState_STATE g = PyGILState_Ensure();
+    Py_DECREF(static_cast<PyObject*>(handle));
+    PyGILState_Release(g);
+}
+
+}  // extern "C"

@@ -1,0 +1,262 @@
+// Shared-memory ring buffer for DataLoader worker->main batch transfer.
+//
+// Reference parity: paddle/fluid/memory/allocation/mmap_allocator.cc (the
+// MemoryMapWriterAllocation/MemoryMapReaderAllocation pair backing the
+// reference DataLoader's use_shared_memory=True path) plus the
+// _shared_memory queue logic in python/paddle/fluid/dataloader/worker.py.
+// Where the reference allocates one named mmap file per tensor and ships
+// the name through a multiprocessing queue, this is a single POSIX shm
+// ring with a process-shared mutex/condvar pair: workers (multiple
+// producers) frame [u64 len][payload] messages into the ring; the main
+// process (single consumer) pops them — no per-batch file churn, no
+// pickle on the bulk payload.
+//
+// Exposed as a plain C ABI (consumed via ctypes — this image has no
+// pybind11): ptring_create / ptring_open / ptring_push / ptring_pop_len /
+// ptring_pop / ptring_close / ptring_free / ptring_unlink.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct RingHdr {
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t capacity;  // bytes in the data region
+  uint64_t head;      // read offset
+  uint64_t tail;      // write offset
+  uint64_t used;      // bytes occupied
+  int32_t closed;
+  int32_t _pad;
+};
+
+struct Ring {
+  RingHdr* hdr;
+  uint8_t* data;
+  uint64_t map_len;
+  int owner;
+  char name[256];
+};
+
+// Robust lock: when a lock-holding process died (EOWNERDEAD), mark the
+// mutex consistent and poison the ring — a frame may be half-written, so
+// the only safe continuation is "closed" (the Python side then raises its
+// dead-worker error instead of hanging).
+int ring_poison(RingHdr* h) {
+  pthread_mutex_consistent(&h->mu);
+  h->closed = 1;
+  pthread_cond_broadcast(&h->not_empty);
+  pthread_cond_broadcast(&h->not_full);
+  return 0;
+}
+
+int ring_lock(RingHdr* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) ring_poison(h);
+  return rc;
+}
+
+// cond_wait on a robust mutex can itself return EOWNERDEAD (the holder
+// died while we slept) — recover exactly like ring_lock does
+int ring_wait(RingHdr* h, pthread_cond_t* c) {
+  int rc = pthread_cond_wait(c, &h->mu);
+  if (rc == EOWNERDEAD) ring_poison(h);
+  return rc;
+}
+
+void ring_copy_in(RingHdr* h, uint8_t* data, const uint8_t* src,
+                  uint64_t len) {
+  uint64_t t = h->tail;
+  uint64_t first = len < h->capacity - t ? len : h->capacity - t;
+  memcpy(data + t, src, first);
+  if (len > first) memcpy(data, src + first, len - first);
+  h->tail = (t + len) % h->capacity;
+}
+
+void ring_copy_out(RingHdr* h, const uint8_t* data, uint8_t* dst,
+                   uint64_t len) {
+  uint64_t hd = h->head;
+  uint64_t first = len < h->capacity - hd ? len : h->capacity - hd;
+  memcpy(dst, data + hd, first);
+  if (len > first) memcpy(dst + first, data, len - first);
+  h->head = (hd + len) % h->capacity;
+}
+
+// peek a u64 length at head without advancing
+uint64_t ring_peek_u64(RingHdr* h, const uint8_t* data) {
+  uint8_t buf[8];
+  uint64_t hd = h->head;
+  uint64_t first = 8 < h->capacity - hd ? 8 : h->capacity - hd;
+  memcpy(buf, data + hd, first);
+  if (8 > first) memcpy(buf + first, data, 8 - first);
+  uint64_t v;
+  memcpy(&v, buf, 8);
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (main process). Returns NULL on failure.
+void* ptring_create(const char* name, uint64_t capacity) {
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t map_len = sizeof(RingHdr) + capacity;
+  if (ftruncate(fd, (off_t)map_len) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  RingHdr* h = (RingHdr*)mem;
+  memset(h, 0, sizeof(RingHdr));
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  // robust: a worker terminated while holding the lock must not hang the
+  // main process — lock() below recovers via EOWNERDEAD + consistent()
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->not_empty, &ca);
+  pthread_cond_init(&h->not_full, &ca);
+  h->capacity = capacity;
+  Ring* r = new Ring();
+  r->hdr = h;
+  r->data = (uint8_t*)mem + sizeof(RingHdr);
+  r->map_len = map_len;
+  r->owner = 1;
+  strncpy(r->name, name, sizeof(r->name) - 1);
+  return r;
+}
+
+// Attach (worker process).
+void* ptring_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Ring* r = new Ring();
+  r->hdr = (RingHdr*)mem;
+  r->data = (uint8_t*)mem + sizeof(RingHdr);
+  r->map_len = (uint64_t)st.st_size;
+  r->owner = 0;
+  strncpy(r->name, name, sizeof(r->name) - 1);
+  return r;
+}
+
+// Blocking push of one [len][payload] message. 0 ok, -1 closed, -2 too big.
+int ptring_push(void* ring, const void* buf, uint64_t len) {
+  Ring* r = (Ring*)ring;
+  RingHdr* h = r->hdr;
+  if (len + 8 > h->capacity) return -2;
+  if (ring_lock(h) == ENOTRECOVERABLE) return -1;
+  while (h->capacity - h->used < len + 8 && !h->closed)
+    if (ring_wait(h, &h->not_full) == ENOTRECOVERABLE) return -1;
+  if (h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint64_t n = len;
+  ring_copy_in(h, r->data, (const uint8_t*)&n, 8);
+  ring_copy_in(h, r->data, (const uint8_t*)buf, len);
+  h->used += len + 8;
+  pthread_cond_signal(&h->not_empty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Length of the next message (blocking). -1 when closed and drained.
+int64_t ptring_pop_len(void* ring) {
+  Ring* r = (Ring*)ring;
+  RingHdr* h = r->hdr;
+  if (ring_lock(h) == ENOTRECOVERABLE) return -1;
+  while (h->used == 0 && !h->closed)
+    if (ring_wait(h, &h->not_empty) == ENOTRECOVERABLE) return -1;
+  if (h->used == 0 && h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  int64_t len = (int64_t)ring_peek_u64(h, r->data);
+  pthread_mutex_unlock(&h->mu);
+  return len;
+}
+
+// Pop next message into out (single consumer). Returns payload length,
+// -1 closed+drained, -3 maxlen too small.
+int64_t ptring_pop(void* ring, void* out, uint64_t maxlen) {
+  Ring* r = (Ring*)ring;
+  RingHdr* h = r->hdr;
+  if (ring_lock(h) == ENOTRECOVERABLE) return -1;
+  while (h->used == 0 && !h->closed)
+    if (ring_wait(h, &h->not_empty) == ENOTRECOVERABLE) return -1;
+  if (h->used == 0 && h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint64_t len = ring_peek_u64(h, r->data);
+  if (len > maxlen) {
+    pthread_mutex_unlock(&h->mu);
+    return -3;
+  }
+  // advance past the length word, then the payload
+  h->head = (h->head + 8) % h->capacity;
+  ring_copy_out(h, r->data, (uint8_t*)out, len);
+  h->used -= len + 8;
+  pthread_cond_broadcast(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+  return (int64_t)len;
+}
+
+void ptring_close(void* ring) {
+  Ring* r = (Ring*)ring;
+  int rc = ring_lock(r->hdr);
+  r->hdr->closed = 1;
+  pthread_cond_broadcast(&r->hdr->not_empty);
+  pthread_cond_broadcast(&r->hdr->not_full);
+  if (rc != ENOTRECOVERABLE) pthread_mutex_unlock(&r->hdr->mu);
+}
+
+void ptring_free(void* ring) {
+  Ring* r = (Ring*)ring;
+  munmap((void*)r->hdr, r->map_len);
+  delete r;
+}
+
+void ptring_unlink(const char* name) { shm_unlink(name); }
+
+uint64_t ptring_capacity(void* ring) { return ((Ring*)ring)->hdr->capacity; }
+uint64_t ptring_used(void* ring) {
+  Ring* r = (Ring*)ring;
+  ring_lock(r->hdr);
+  uint64_t u = r->hdr->used;
+  pthread_mutex_unlock(&r->hdr->mu);
+  return u;
+}
+
+}  // extern "C"

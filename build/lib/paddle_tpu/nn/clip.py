@@ -1,0 +1,139 @@
+"""Gradient clipping.
+
+Reference parity: python/paddle/fluid/clip.py (ClipGradByValue :121,
+ClipGradByNorm :218, ClipGradByGlobalNorm :341). Operates on
+(param, grad) lists like the reference's _dygraph_clip, one fused XLA
+expression for the global norm.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+def _rewrap(g, new_vals):
+    """Preserve sparse-ness: a clipped SelectedRows stays a SelectedRows
+    (clip.py's merge_selected_rows + scale path in the reference)."""
+    from ..framework.selected_rows import SelectedRows
+    if isinstance(g, SelectedRows):
+        return SelectedRows(g.rows, new_vals, g.height)
+    return Tensor(new_vals)
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+    def _dygraph_clip(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, _rewrap(g, jnp.clip(g._value, self.min,
+                                               self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            gv = g._value
+            norm = jnp.sqrt(jnp.sum(jnp.square(gv.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, _rewrap(g, (gv * scale).astype(gv.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _dygraph_clip(self, params_grads):
+        sq = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                continue
+            sq.append(jnp.sum(jnp.square(g._value.astype(jnp.float32))))
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12),
+                            1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, _rewrap(g, (g._value * scale)
+                                   .astype(g._value.dtype))))
+        return out
+
+
+def functional_clip(clip, params, grads, skip=None):
+    """Apply a ClipGrad* policy to a {name: array} grads dict inside a trace
+    (used by Optimizer.functional_apply in the compiled train step).
+
+    ``skip``: names with need_clip=False — left untouched and excluded from
+    the global norm, matching the eager _dygraph_clip paths.
+    """
+    skip = skip or set()
+    if isinstance(clip, ClipGradByValue):
+        return {k: (g if k in skip else jnp.clip(g, clip.min, clip.max))
+                for k, g in grads.items()}
+    if isinstance(clip, ClipGradByNorm):
+        out = {}
+        for k, g in grads.items():
+            if k in skip:
+                out[k] = g
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(clip.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out[k] = (g * scale).astype(g.dtype)
+        return out
+    if isinstance(clip, ClipGradByGlobalNorm):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for k, g in grads.items() if k not in skip]
+        if not sq:
+            return grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = jnp.minimum(clip.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        return {k: (g if k in skip else (g * scale).astype(g.dtype))
+                for k, g in grads.items()}
+    raise TypeError(f"unsupported grad clip {type(clip).__name__}")
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._value))
+                                   for p in params]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p.grad._value.astype(jnp.float32)) ** norm_type)
+             for p in params])) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+    for p in params:
+        p.grad._value = (p.grad._value * scale).astype(p.grad._value.dtype)
+    return Tensor(total)

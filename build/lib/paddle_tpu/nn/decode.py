@@ -1,0 +1,104 @@
+"""RNN decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference parity: python/paddle/fluid/layers/rnn.py — BeamSearchDecoder
+(:1028) and dynamic_decode (:1403) over beam_search_op/beam_search_decode_op.
+
+TPU-shape: the per-step beam selection is the fixed-shape
+ops.decode.beam_search_step (one top-k over beam*vocab); the driver is a
+Python loop of jitted steps in eager mode (the static path traces the same
+loop through @to_static). Cell states are tiled to [B*beam, ...] and
+gathered by parent index each step.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, unwrap
+from ..ops.decode import _beam_search_step_fn, _beam_search_decode_fn
+
+
+class BeamSearchDecoder:
+    """rnn.py:1028 parity: wraps an RNN cell for beam decoding.
+
+    cell(inputs, states) -> (outputs, new_states); ``embedding_fn`` maps
+    token ids to cell inputs; ``output_fn`` maps cell outputs to logits.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] (rnn.py:1174)."""
+        v = unwrap(x)
+        tiled = jnp.repeat(v, beam_size, axis=0)
+        return Tensor(tiled)
+
+    def initialize(self, initial_cell_states, batch_size):
+        B, W = batch_size, self.beam_size
+        ids = jnp.full((B, W), self.start_token, jnp.int64)
+        # only beam 0 live at t=0 (matching the reference's -inf init)
+        scores = jnp.where(jnp.arange(W)[None, :] == 0, 0.0, -1e9)
+        scores = jnp.broadcast_to(scores, (B, W)).astype(jnp.float32)
+        states = [self.tile_beam_merge_with_batch(s, W)
+                  for s in initial_cell_states]
+        return ids, scores, states
+
+    def step(self, ids, scores, states):
+        B, W = ids.shape
+        tok = Tensor(ids.reshape(B * W))
+        inp = self.embedding_fn(tok) if self.embedding_fn is not None \
+            else tok
+        # plain RNN cells take a single state, not a 1-list
+        cell_states = states[0] if isinstance(states, list) and \
+            len(states) == 1 else states
+        out, new_states = self.cell(inp, cell_states)
+        logits = self.output_fn(out) if self.output_fn is not None else out
+        V = unwrap(logits).shape[-1]
+        import jax
+        logp = jax.nn.log_softmax(
+            unwrap(logits).reshape(B, W, V), axis=-1)
+        new_ids, new_scores, parents = _beam_search_step_fn(
+            ids, scores, logp, beam_size=W, end_id=self.end_token,
+            is_accumulated=True)
+        # gather cell states along the selected parents
+        flat_parent = (jnp.arange(B)[:, None] * W + parents).reshape(-1)
+        if isinstance(new_states, (tuple, list)):
+            new_states = [Tensor(unwrap(s)[flat_parent])
+                          for s in new_states]
+        else:
+            new_states = Tensor(unwrap(new_states)[flat_parent])
+        return new_ids, new_scores, parents, new_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, batch_size=None,
+                   output_time_major=False, **kwargs):
+    """rnn.py:1403 parity: run the decoder to max_step_num (or all beams
+    finished), then backtrace. Returns (ids [B, W, T], scores [B, W])."""
+    if batch_size is None:
+        if not inits:
+            raise ValueError("need batch_size or initial states")
+        batch_size = unwrap(inits[0]).shape[0]
+    ids, scores, states = decoder.initialize(inits or [], batch_size)
+    all_ids, all_parents, all_scores = [], [], []
+    for _ in range(max_step_num):
+        ids, scores, parents, states = decoder.step(ids, scores, states)
+        all_ids.append(ids)
+        all_parents.append(parents)
+        all_scores.append(scores)
+        if bool(jnp.all(ids == decoder.end_token)):
+            break
+    sent, sc = _beam_search_decode_fn(
+        jnp.stack(all_ids), jnp.stack(all_parents), jnp.stack(all_scores),
+        end_id=decoder.end_token)
+    out = jnp.transpose(sent, (1, 2, 0))          # [B, W, T]
+    if output_time_major:
+        out = jnp.transpose(out, (2, 0, 1))
+    return Tensor(out), Tensor(sc)

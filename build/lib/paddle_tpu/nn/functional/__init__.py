@@ -1,0 +1,38 @@
+"""paddle.nn.functional parity surface."""
+from .activation import *  # noqa: F401,F403
+from .common import (  # noqa: F401
+    linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,
+    pad, interpolate, upsample, pixel_shuffle, unfold, cosine_similarity,
+    bilinear, label_smooth, sequence_mask,
+)
+from .conv import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+    conv3d_transpose,
+)
+from .pooling import (  # noqa: F401
+    avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d, max_pool2d, max_pool3d,
+    adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
+    adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
+    max_unpool1d, max_unpool2d, max_unpool3d,
+)
+from .norm import (  # noqa: F401
+    batch_norm, layer_norm, instance_norm, group_norm, local_response_norm,
+    normalize,
+)
+from .loss import (  # noqa: F401
+    cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
+    binary_cross_entropy, binary_cross_entropy_with_logits,
+    sigmoid_cross_entropy_with_logits, kl_div, smooth_l1_loss, huber_loss,
+    log_loss, margin_ranking_loss, hinge_loss, sigmoid_focal_loss,
+    cosine_embedding_loss, ctc_loss, square_error_cost, triplet_margin_loss,
+    dice_loss, npair_loss, hsigmoid_loss,
+)
+from .attention import scaled_dot_product_attention  # noqa: F401
+# re-exports the 2.x functional namespace also carries (the kernels live
+# in ops/)
+from ...ops.vision import (  # noqa: F401
+    grid_sample, affine_grid, temporal_shift,
+)
+from ...ops.math_ext import diag_embed  # noqa: F401
+from ...ops.math import assign  # noqa: F401
+from ...ops.decode import gather_tree  # noqa: F401

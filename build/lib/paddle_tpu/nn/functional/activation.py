@@ -1,0 +1,240 @@
+"""Activation functionals.
+
+Reference parity: paddle/fluid/operators/activation_op.cc (relu, gelu, ...)
+and python/paddle/nn/functional/activation.py. All are single fused XLA
+expressions (VPU-friendly; XLA fuses them into surrounding matmuls).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.primitive import Primitive
+from ...framework.tensor import Tensor, unwrap
+
+_relu = Primitive("relu", jax.nn.relu)
+_relu6 = Primitive("relu6", jax.nn.relu6)
+_sigmoid = Primitive("sigmoid", jax.nn.sigmoid)
+_tanh_p = Primitive("tanh_act", jnp.tanh)
+_elu_p = Primitive("elu", lambda x, alpha=1.0: jax.nn.elu(x, alpha))
+_selu_p = Primitive("selu", lambda x, scale=1.0507009873554805,
+                    alpha=1.6732632423543772:
+                    scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)))
+_leaky = Primitive("leaky_relu", lambda x, negative_slope=0.01:
+                   jax.nn.leaky_relu(x, negative_slope))
+_gelu_p = Primitive("gelu", lambda x, approximate=False:
+                    jax.nn.gelu(x, approximate=approximate))
+_silu = Primitive("silu", jax.nn.silu)
+_mish = Primitive("mish", jax.nn.mish)
+_softplus_p = Primitive("softplus", lambda x, beta=1.0, threshold=20.0:
+                        jnp.where(x * beta > threshold, x,
+                                  jnp.log1p(jnp.exp(beta * x)) / beta))
+_softsign = Primitive("softsign", jax.nn.soft_sign)
+_hsig = Primitive("hard_sigmoid", lambda x, slope=1.0 / 6, offset=0.5:
+                  jnp.clip(slope * x + offset, 0.0, 1.0))
+_hswish = Primitive("hard_swish", lambda x:
+                    x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+_htanh = Primitive("hard_tanh", lambda x, mn=-1.0, mx=1.0: jnp.clip(x, mn, mx))
+_hshrink = Primitive("hard_shrink", lambda x, threshold=0.5:
+                     jnp.where(jnp.abs(x) > threshold, x, 0.0))
+_sshrink = Primitive("softshrink", lambda x, threshold=0.5:
+                     jnp.where(x > threshold, x - threshold,
+                               jnp.where(x < -threshold, x + threshold, 0.0)))
+_tshrink = Primitive("tanh_shrink", lambda x: x - jnp.tanh(x))
+_thresh = Primitive("thresholded_relu", lambda x, threshold=1.0:
+                    jnp.where(x > threshold, x, 0.0))
+_softmax_p = Primitive("softmax", lambda x, axis=-1: jax.nn.softmax(x, axis=axis))
+_log_softmax_p = Primitive("log_softmax",
+                           lambda x, axis=-1: jax.nn.log_softmax(x, axis=axis))
+_logsigmoid = Primitive("logsigmoid", jax.nn.log_sigmoid)
+_swish = Primitive("swish", jax.nn.silu)
+_celu_p = Primitive("celu", lambda x, alpha=1.0: jax.nn.celu(x, alpha))
+_prelu_p = Primitive("prelu", lambda x, w: jnp.where(x > 0, x, w * x))
+_rrelu_p = Primitive("rrelu_eval", lambda x, lower=0.125, upper=1.0 / 3:
+                     jnp.where(x >= 0, x, x * (lower + upper) / 2))
+_glu_p = Primitive("glu", lambda x, axis=-1: (
+    lambda a, b: a * jax.nn.sigmoid(b))(*jnp.split(x, 2, axis=axis)))
+
+
+def relu(x, name=None):
+    return _relu(x)
+
+
+def relu_(x):
+    out = _relu(x)
+    x._value, x._node, x._out_index = out._value, out._node, out._out_index
+    return x
+
+
+def relu6(x, name=None):
+    return _relu6(x)
+
+
+def sigmoid(x, name=None):
+    return _sigmoid(x)
+
+
+def tanh(x, name=None):
+    return _tanh_p(x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _elu_p(x, alpha=float(alpha))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _selu_p(x, scale=float(scale), alpha=float(alpha))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _leaky(x, negative_slope=float(negative_slope))
+
+
+def gelu(x, approximate=False, name=None):
+    return _gelu_p(x, approximate=bool(approximate))
+
+
+def silu(x, name=None):
+    return _silu(x)
+
+
+def swish(x, name=None):
+    return _swish(x)
+
+
+def mish(x, name=None):
+    return _mish(x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _softplus_p(x, beta=float(beta), threshold=float(threshold))
+
+
+def softsign(x, name=None):
+    return _softsign(x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _hsig(x, slope=float(slope), offset=float(offset))
+
+
+def hardswish(x, name=None):
+    return _hswish(x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _htanh(x, mn=float(min), mx=float(max))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _hshrink(x, threshold=float(threshold))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _sshrink(x, threshold=float(threshold))
+
+
+def tanhshrink(x, name=None):
+    return _tshrink(x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _thresh(x, threshold=float(threshold))
+
+
+def log_sigmoid(x, name=None):
+    return _logsigmoid(x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return _celu_p(x, alpha=float(alpha))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    w = weight
+    if isinstance(weight, Tensor) and weight.size > 1:
+        # per-channel: broadcast over channel dim
+        nd = x.ndim
+        shape = [1] * nd
+        ch_axis = 1 if data_format == "NCHW" else nd - 1
+        shape[ch_axis] = weight.size
+        from ...ops import reshape
+        w = reshape(weight, shape)
+    return _prelu_p(x, w)
+
+
+_rrelu_train = Primitive("rrelu_train", lambda v, aa: jnp.where(v >= 0, v, v * aa))
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=False, name=None):
+    if training:
+        from ...framework.random import default_generator
+        key = default_generator.next_key()
+        xv = unwrap(x)
+        a = jax.random.uniform(key, jnp.shape(xv), jnp.float32, lower, upper)
+        return _rrelu_train(x, a.astype(xv.dtype))
+    return _rrelu_p(x, lower=float(lower), upper=float(upper))
+
+
+def maxout(x, groups, axis=1, name=None):
+    xv = unwrap(x)
+    shape = list(jnp.shape(xv))
+    c = shape[axis]
+    p = _maxout_prim(groups, axis)
+    return p(x)
+
+
+_maxout_cache = {}
+
+
+def _maxout_prim(groups, axis):
+    key = (groups, axis)
+    if key not in _maxout_cache:
+        def fn(x, _g=groups, _a=axis):
+            shape = list(x.shape)
+            c = shape[_a]
+            new = shape[:_a] + [_g, c // _g] + shape[_a + 1:]
+            return jnp.max(jnp.reshape(x, new), axis=_a)
+        _maxout_cache[key] = Primitive(f"maxout[{groups},{axis}]", fn)
+    return _maxout_cache[key]
+
+
+def glu(x, axis=-1, name=None):
+    return _glu_p(x, axis=int(axis))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...ops import cast
+        x = cast(x, dtype)
+    return _softmax_p(x, axis=int(axis))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...ops import cast
+        x = cast(x, dtype)
+    return _log_softmax_p(x, axis=int(axis))
+
+
+def _gumbel_fn(v, g, temperature=1.0, axis=-1, hard=False):
+    y = jax.nn.softmax((v + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        hard_y = jnp.put_along_axis(jnp.zeros_like(y), idx,
+                                    jnp.ones_like(idx, y.dtype), axis=axis,
+                                    inplace=False)
+        y = jax.lax.stop_gradient(hard_y - y) + y
+    return y
+
+
+_gumbel_p = Primitive("gumbel_softmax", _gumbel_fn)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import default_generator
+    xv = unwrap(x)
+    g = jax.random.gumbel(default_generator.next_key(), jnp.shape(xv),
+                          jnp.float32).astype(xv.dtype)
+    return _gumbel_p(x, g, temperature=float(temperature), axis=int(axis),
+                     hard=bool(hard))

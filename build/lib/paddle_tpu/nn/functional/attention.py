@@ -1,0 +1,109 @@
+"""Attention functionals.
+
+Reference parity: the reference era predates fused attention ops (it has only
+softmax/matmul composition inside nn/layer/transformer.py); we expose a
+first-class ``scaled_dot_product_attention`` because it is THE hot op on TPU.
+Default path is a single fused XLA expression (bf16 matmuls on the MXU with
+f32 softmax accumulation); when FLAGS_use_pallas_kernels is set and we're on
+TPU, the Pallas flash-attention kernel (paddle_tpu/ops/pallas/) takes over.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.flags import flag
+from ...framework.primitive import Primitive
+from ...framework.tensor import Tensor, unwrap
+
+
+def _sdpa_fn(q, k, v, scale=None, causal=False):
+    # q,k,v: (B, N, S, H) -- batch, heads, seq, head_dim
+    hd = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bnsh,bnth->bnst", q, k,
+                        preferred_element_type=jnp.float32) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,bnth->bnsh", probs, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _sdpa_mask_fn(q, k, v, mask, scale=None, causal=False):
+    hd = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bnsh,bnth->bnst", q, k,
+                        preferred_element_type=jnp.float32) * s
+    logits = logits + mask.astype(logits.dtype)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, jnp.asarray(-1e30, logits.dtype))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,bnth->bnsh", probs, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+_sdpa = Primitive("scaled_dot_product_attention", _sdpa_fn)
+_sdpa_mask = Primitive("scaled_dot_product_attention_mask", _sdpa_mask_fn)
+
+
+def _use_pallas(q, k, mask=None, causal=False):
+    if not flag("use_pallas_kernels"):
+        return False
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return False
+    if platform not in ("tpu", "axon"):
+        return False
+    # the flash kernel's bias input is non-differentiable; a trainable mask
+    # (learned relative-position bias) must take the XLA path
+    if isinstance(mask, Tensor) and not mask.stop_gradient:
+        return False
+    from ...ops.pallas import supports
+    from ...ops.pallas.flash_attention import MIN_SEQ_FOR_FLASH
+    kshape = unwrap(k).shape
+    # short sequences are dispatch/bandwidth-bound: the one-expression XLA
+    # path wins there (measured crossover at Sk=1024 on v5e)
+    if len(kshape) != 4 or kshape[-2] < MIN_SEQ_FOR_FLASH:
+        return False
+    mk = unwrap(mask).shape if mask is not None else None
+    return supports(unwrap(q).shape, kshape, mk, causal=causal)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Inputs (B, S, N, H) per paddle-incubate convention; internally uses
+    (B, N, S, H)."""
+    from ...ops import transpose
+    q = transpose(query, [0, 2, 1, 3])
+    k = transpose(key, [0, 2, 1, 3])
+    v = transpose(value, [0, 2, 1, 3])
+    if _use_pallas(q, k, attn_mask, causal=bool(is_causal)):
+        from ...ops.pallas import flash_attention
+        out = flash_attention(q, k, v, bias=attn_mask, causal=is_causal)
+    elif attn_mask is not None:
+        out = _sdpa_mask(q, k, v, attn_mask, causal=bool(is_causal))
+    else:
+        out = _sdpa(q, k, v, causal=bool(is_causal))
+    if dropout_p and training:
+        from .common import dropout
+        out = dropout(out, dropout_p, training=training)
+    return transpose(out, [0, 2, 1, 3])
+
+
+def attention_bnsh(q, k, v, attn_mask=None, is_causal=False):
+    """(B, N, S, H) layout fast path used by our MultiHeadAttention layer."""
+    if _use_pallas(q, k, attn_mask, causal=bool(is_causal)):
+        from ...ops.pallas import flash_attention
+        return flash_attention(q, k, v, bias=attn_mask, causal=is_causal)
+    if attn_mask is not None:
+        return _sdpa_mask(q, k, v, attn_mask, causal=bool(is_causal))
+    return _sdpa(q, k, v, causal=bool(is_causal))

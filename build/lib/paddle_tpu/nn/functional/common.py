@@ -1,0 +1,309 @@
+"""Common functionals: linear, dropout, embedding, interpolate, etc.
+
+Reference parity: python/paddle/nn/functional/common.py + input.py
+(embedding/one_hot), mul_op/matmul for linear, dropout_op.cc,
+lookup_table_v2_op.cc (embedding; SelectedRows sparse grad becomes XLA
+scatter-add through the take VJP -- idiomatic TPU replacement),
+interpolate_op.cc, pixel_shuffle_op.cc, unfold_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.primitive import Primitive
+from ...framework.random import default_generator
+from ...framework.tensor import Tensor, unwrap
+from ...ops.manipulation import pad as _pad_op  # re-export surface
+
+pad = _pad_op
+
+_linear_b = Primitive(
+    "linear",
+    lambda x, w, b: (jnp.matmul(
+        x, w, preferred_element_type=jnp.float32
+        if jnp.result_type(x, w) == jnp.bfloat16 else None)
+        .astype(jnp.result_type(x, w)) + b))
+_linear_nb = Primitive(
+    "linear_nobias",
+    lambda x, w: jnp.matmul(
+        x, w, preferred_element_type=jnp.float32
+        if jnp.result_type(x, w) == jnp.bfloat16 else None)
+    .astype(jnp.result_type(x, w)))
+
+
+def linear(x, weight, bias=None, name=None):
+    """paddle weight layout: [in_features, out_features]."""
+    if bias is not None:
+        return _linear_b(x, weight, bias)
+    return _linear_nb(x, weight)
+
+
+def _dropout_fn(x, key, p=0.5, mode="upscale_in_train", axis=None):
+    if p == 0.0:
+        return x
+    if axis is None:
+        shape = x.shape
+    else:
+        shape = tuple(x.shape[i] if i in axis else 1 for i in range(x.ndim))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, jnp.zeros((), x.dtype)).astype(x.dtype)
+    return jnp.where(mask, x, jnp.zeros((), x.dtype)).astype(x.dtype)
+
+
+_dropout_p = Primitive("dropout", _dropout_fn)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            from ...ops.math import scale as _scale
+            return _scale(x, scale=1.0 - p)
+        return x
+    key = default_generator.next_key()
+    ax = tuple(int(a) for a in axis) if axis is not None else None
+    if isinstance(ax, tuple) and len(ax) == 0:
+        ax = None
+    return _dropout_p(x, key, p=float(p), mode=mode, axis=ax)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale_ = 1.0507009873554805
+    alpha_p = -alpha * scale_
+    keep = 1.0 - p
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    key = default_generator.next_key()
+    p_prim = _alpha_dropout_p
+    return p_prim(x, key, p=float(p), a=float(a), b=float(b),
+                  alpha_p=float(alpha_p))
+
+
+def _alpha_dropout_fn(x, key, p=0.5, a=1.0, b=0.0, alpha_p=-1.7580993408473766):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return (a * jnp.where(mask, x, jnp.asarray(alpha_p, x.dtype)) + b).astype(x.dtype)
+
+
+_alpha_dropout_p = Primitive("alpha_dropout", _alpha_dropout_fn)
+
+_embedding_p = Primitive("lookup_table_v2",
+                         lambda w, ids, padding_idx=None:
+                         _embedding_fn(w, ids, padding_idx))
+
+
+def _embedding_fn(w, ids, padding_idx):
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = jnp.where(mask, out, jnp.zeros((), out.dtype))
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """lookup_table_v2 parity.  With ``sparse=True`` in eager mode the
+    weight gradient is a SelectedRows (rows = the looked-up ids) instead of
+    a dense vocab-sized buffer — the reference's is_sparse grad path
+    (lookup_table_v2_op.cc); sparse optimizers then update only those rows.
+    Inside traced/static code the dense scatter-add path is used (XLA has no
+    sparse tensors)."""
+    pi = None if padding_idx is None else int(padding_idx)
+    if pi is not None and pi < 0:
+        pi = int(unwrap(weight).shape[0]) + pi
+    if sparse:
+        import jax as _jax
+        from ...framework import core as _core
+        from ...framework.tensor import Tensor as _T
+        concrete = isinstance(weight, _T) and \
+            not isinstance(unwrap(weight), _jax.core.Tracer)
+        if not _core.in_static_mode() and concrete:
+            from ...framework.selected_rows import sparse_lookup
+            return sparse_lookup(weight, x, padding_idx=pi)
+    return _embedding_p(weight, x, padding_idx=pi)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.manipulation import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+def _interp_fn(x, size=(2, 2), mode="nearest", align_corners=False,
+               channel_last=False):
+    # NCHW -> resize spatial dims
+    if channel_last:
+        spatial_start = 1
+    else:
+        spatial_start = 2
+    nsp = len(size)
+    new_shape = list(x.shape)
+    for i, s in enumerate(size):
+        new_shape[spatial_start + i] = s
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if align_corners and method != "nearest":
+        # jax.image.resize has no align_corners; emulate with explicit grid
+        idx = []
+        for i, s in enumerate(size):
+            isz = x.shape[spatial_start + i]
+            pos = jnp.linspace(0, isz - 1, s)
+            idx.append(pos)
+        out = x
+        for i, pos in enumerate(idx):
+            ax = spatial_start + i
+            lo = jnp.floor(pos).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, x.shape[ax] - 1)
+            w = (pos - lo).astype(x.dtype)
+            lo_v = jnp.take(out, lo, axis=ax)
+            hi_v = jnp.take(out, hi, axis=ax)
+            bshape = [1] * out.ndim
+            bshape[ax] = -1
+            w = w.reshape(bshape)
+            out = lo_v * (1 - w) + hi_v * w
+        return out
+    return jax.image.resize(x, tuple(new_shape), method=method).astype(x.dtype)
+
+
+_interp_p = Primitive("interpolate", _interp_fn)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+    nd = x.ndim if isinstance(x, Tensor) else jnp.ndim(unwrap(x))
+    nsp = nd - 2
+    shape = x.shape if isinstance(x, Tensor) else list(jnp.shape(unwrap(x)))
+    spatial = shape[1:1 + nsp] if channel_last else shape[2:2 + nsp]
+    if size is not None:
+        if isinstance(size, (int, np.integer)):
+            size = [int(size)] * nsp
+        size = tuple(int(unwrap(s)) for s in size)
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * nsp
+        size = tuple(int(s * f) for s, f in zip(spatial, scale_factor))
+    return _interp_p(x, size=size, mode=mode, align_corners=bool(align_corners),
+                     channel_last=channel_last)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def _pixel_shuffle_fn(x, factor=2):
+    n, c, h, w = x.shape
+    r = factor
+    x = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return jnp.reshape(x, (n, c // (r * r), h * r, w * r))
+
+
+_pixel_shuffle_p = Primitive("pixel_shuffle", _pixel_shuffle_fn)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _pixel_shuffle_p(x, factor=int(upscale_factor))
+
+
+def _unfold_fn(x, k=(3, 3), stride=(1, 1), padding=(0, 0), dilation=(1, 1)):
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (padding[0], padding[0]),
+                    (padding[1], padding[1])))
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=stride, padding="VALID",
+        rhs_dilation=dilation, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # -> (N, C*kh*kw, oh, ow) -> (N, C*kh*kw, L)
+    return jnp.reshape(patches, (n, patches.shape[1], -1))
+
+
+_unfold_p = Primitive("unfold", _unfold_fn)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _norm_tuple
+    return _unfold_p(x, k=_norm_tuple(kernel_sizes, 2),
+                     stride=_norm_tuple(strides, 2),
+                     padding=_norm_tuple(paddings, 2),
+                     dilation=_norm_tuple(dilations, 2))
+
+
+_cos_sim = Primitive("cosine_similarity",
+                     lambda x1, x2, axis=1, eps=1e-8:
+                     jnp.sum(x1 * x2, axis=axis) /
+                     jnp.maximum(jnp.linalg.norm(x1, axis=axis) *
+                                 jnp.linalg.norm(x2, axis=axis), eps))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    return _cos_sim(x1, x2, axis=int(axis), eps=float(eps))
+
+
+_bilinear_p = Primitive(
+    "bilinear",
+    lambda x1, x2, w, b=None: _bilinear_fn(x1, x2, w, b))
+
+
+def _bilinear_fn(x1, x2, w, b):
+    # w: (out, in1, in2)
+    out = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+    if b is not None:
+        out = out + b
+    return out
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    if bias is not None:
+        return _bilinear_p(x1, x2, weight, bias)
+    return _bilinear_nb(x1, x2, weight)
+
+
+_bilinear_nb = Primitive("bilinear_nobias",
+                         lambda x1, x2, w: _bilinear_fn(x1, x2, w, None))
+
+_label_smooth_p = Primitive(
+    "label_smooth",
+    lambda label, epsilon=0.1: (1 - epsilon) * label + epsilon / label.shape[-1])
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        p = _label_smooth_prior
+        return p(label, prior_dist, epsilon=float(epsilon))
+    return _label_smooth_p(label, epsilon=float(epsilon))
+
+
+_label_smooth_prior = Primitive(
+    "label_smooth_prior",
+    lambda label, prior, epsilon=0.1: (1 - epsilon) * label + epsilon * prior)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: PS-era API, round 2+")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    xv = unwrap(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(xv).max())
+    from ...framework.dtype import convert_dtype
+    rng = jnp.arange(maxlen)
+    return Tensor((rng[None, :] < xv[:, None]).astype(convert_dtype(dtype)))

@@ -1,0 +1,213 @@
+"""Convolution functionals.
+
+Reference parity: paddle/fluid/operators/conv_op.cc, conv_transpose_op.cc and
+python/paddle/nn/functional/conv.py. TPU-first: everything lowers to
+lax.conv_general_dilated, which XLA tiles directly onto the MXU; the cuDNN
+algorithm-search machinery of the reference (conv_cudnn_helper.h) has no
+equivalent because XLA picks the layout/tiling.
+
+Weight layout follows Paddle: OIHW (out, in/groups, kh, kw); data NCHW or NHWC.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.primitive import Primitive
+from ...framework.tensor import Tensor, unwrap
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _norm_padding(padding, n):
+    """Return lax padding spec: 'SAME'/'VALID' or [(lo,hi)]*n."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return tuple((int(padding), int(padding)) for _ in range(n))
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return tuple((int(p), int(p)) for p in padding)
+    if len(padding) == 2 * n:
+        return tuple((int(padding[2 * i]), int(padding[2 * i + 1]))
+                     for i in range(n))
+    # nested [[lo,hi],...]
+    return tuple((int(p[0]), int(p[1])) for p in padding)
+
+
+def _dims(ndim_spatial, channel_last):
+    if ndim_spatial == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if ndim_spatial == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv_fn(x, w, b=None, stride=(1, 1), padding="VALID", dilation=(1, 1),
+             groups=1, channel_last=False, nsp=2):
+    lhs_spec, rhs_spec, out_spec = _dims(nsp, channel_last)
+    if channel_last:
+        # paddle weights stay OIHW; transpose once for the NHWC conv form
+        perm = tuple(range(2, 2 + nsp)) + (1, 0)
+        w = jnp.transpose(w, perm)
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        (lhs_spec, rhs_spec, out_spec))
+    # NB: no preferred_element_type=f32 here — it makes the VJP's
+    # transpose-rhs conv see (bf16 activations, f32 cotangent) and the
+    # dtype rule rejects that; XLA:TPU already accumulates bf16 convs in
+    # f32 on the MXU, so bf16-in/bf16-out loses nothing
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    out = out.astype(x.dtype)
+    if b is not None:
+        bshape = (1, -1) + (1,) * nsp if not channel_last else (1,) * (1 + nsp) + (-1,)
+        out = out + jnp.reshape(b, bshape)
+    return out
+
+
+_conv_p = Primitive("conv2d", _conv_fn)
+
+
+def _conv_impl(x, weight, bias, stride, padding, dilation, groups, data_format,
+               nsp):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _norm_tuple(stride, nsp)
+    dilation = _norm_tuple(dilation, nsp)
+    pad = _norm_padding(padding, nsp)
+    args = [x, weight] + ([bias] if bias is not None else [])
+    if bias is not None:
+        return _conv_p(x, weight, bias, stride=stride, padding=pad,
+                       dilation=dilation, groups=int(groups),
+                       channel_last=channel_last, nsp=nsp)
+    return _conv_nb_p(x, weight, stride=stride, padding=pad, dilation=dilation,
+                      groups=int(groups), channel_last=channel_last, nsp=nsp)
+
+
+_conv_nb_p = Primitive("conv2d_nobias",
+                       lambda x, w, **kw: _conv_fn(x, w, None, **kw))
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC",) else "NCW"
+    return _conv_impl(x, weight, bias, stride, padding, dilation, groups, df, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_impl(x, weight, bias, stride, padding, dilation, groups,
+                      data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_impl(x, weight, bias, stride, padding, dilation, groups,
+                      data_format, 3)
+
+
+def _conv_transpose_fn(x, w, b=None, stride=(1, 1), padding=(0, 0),
+                       output_padding=(0, 0), dilation=(1, 1), groups=1,
+                       channel_last=False, nsp=2):
+    lhs_spec, rhs_spec, out_spec = _dims(nsp, channel_last)
+    if channel_last:
+        perm = tuple(range(2, 2 + nsp)) + (1, 0)
+        wt = jnp.transpose(w, perm)  # spatial..., I, O with paddle w = (in, out/g, k)
+        wt = jnp.swapaxes(wt, -1, -2)
+    else:
+        # paddle conv_transpose weight layout: (in, out/groups, kh, kw) = IOHW
+        wt = jnp.swapaxes(w, 0, 1)  # -> (out/g, in, kh, kw)
+        if groups > 1:
+            # regroup: (g*out_g, in_g, ...) expected by transposed conv below
+            pass
+    # implement via gradient of forward conv: conv_transpose == lhs-dilated conv
+    pads = tuple((d * (k - 1) - p[0], d * (k - 1) - p[1] + op)
+                 for p, op, k, d in zip(padding, output_padding,
+                                        wt.shape[2:2 + nsp] if not channel_last
+                                        else wt.shape[:nsp], dilation))
+    if channel_last:
+        wt2 = jnp.flip(wt, axis=tuple(range(nsp)))
+        dn = jax.lax.conv_dimension_numbers(
+            x.shape, wt2.shape, (lhs_spec, rhs_spec, out_spec))
+        out = jax.lax.conv_general_dilated(
+            x, wt2, window_strides=(1,) * nsp, padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+    else:
+        wt2 = jnp.flip(wt, axis=tuple(range(2, 2 + nsp)))
+        if groups > 1:
+            # (out/g, in, k): split input-channel dim across groups
+            o_g, i_all = wt2.shape[0], wt2.shape[1]
+            wt2 = jnp.reshape(wt2, (o_g, groups, i_all // groups) + wt2.shape[2:])
+            wt2 = jnp.transpose(wt2, (1, 0) + tuple(range(2, wt2.ndim)))
+            wt2 = jnp.reshape(wt2, (groups * o_g,) + wt2.shape[2:])
+        dn = jax.lax.conv_dimension_numbers(
+            x.shape, wt2.shape, (lhs_spec, rhs_spec, out_spec))
+        out = jax.lax.conv_general_dilated(
+            x, wt2, window_strides=(1,) * nsp, padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+    out = out.astype(x.dtype)
+    if b is not None:
+        bshape = (1, -1) + (1,) * nsp if not channel_last else (1,) * (1 + nsp) + (-1,)
+        out = out + jnp.reshape(b, bshape)
+    return out
+
+
+_convt_p = Primitive("conv2d_transpose", _conv_transpose_fn)
+_convt_nb_p = Primitive("conv2d_transpose_nobias",
+                        lambda x, w, **kw: _conv_transpose_fn(x, w, None, **kw))
+
+
+def _conv_transpose_impl(x, weight, bias, stride, padding, output_padding,
+                         dilation, groups, data_format, nsp):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _norm_tuple(stride, nsp)
+    dilation = _norm_tuple(dilation, nsp)
+    output_padding = _norm_tuple(output_padding, nsp)
+    pad = _norm_padding(padding, nsp)
+    if isinstance(pad, str):
+        if pad == "VALID":
+            pad = tuple((0, 0) for _ in range(nsp))
+        else:
+            raise ValueError("SAME padding unsupported for conv_transpose; "
+                             "give explicit pads (paddle parity)")
+    kw = dict(stride=stride, padding=pad, output_padding=output_padding,
+              dilation=dilation, groups=int(groups),
+              channel_last=channel_last, nsp=nsp)
+    if bias is not None:
+        return _convt_p(x, weight, bias, **kw)
+    return _convt_nb_p(x, weight, **kw)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose_impl(x, weight, bias, stride, padding,
+                                output_padding, dilation, groups, df, 1)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW", name=None):
+    return _conv_transpose_impl(x, weight, bias, stride, padding,
+                                output_padding, dilation, groups,
+                                data_format, 2)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_impl(x, weight, bias, stride, padding,
+                                output_padding, dilation, groups,
+                                data_format, 3)

@@ -1,0 +1,497 @@
+"""Loss functionals.
+
+Reference parity: softmax_with_cross_entropy_op.cc, cross_entropy_op.cc,
+bce_loss_op.cc, kldiv_loss_op.cc, smooth_l1_loss_op.cc, huber_loss_op.cc,
+log_loss_op.cc and python/paddle/nn/functional/loss.py. All losses compose
+log_softmax/gather primitives so XLA fuses the whole loss into the backward
+matmul epilogue.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.primitive import Primitive
+from ...framework.tensor import Tensor, unwrap
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def _softmax_ce_hard_fn(logits, label, axis=-1, ignore_index=-100,
+                        reduction="mean", use_softmax=True):
+    lse = logits.astype(jnp.float32)
+    if use_softmax:
+        logp = jax.nn.log_softmax(lse, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(lse, 1e-30))
+    lab = label
+    squeeze_last = False
+    if lab.ndim == logp.ndim:
+        lab = jnp.squeeze(lab, axis=axis)
+        squeeze_last = True
+    valid = lab != ignore_index
+    safe_lab = jnp.where(valid, lab, 0)
+    picked = jnp.take_along_axis(logp, safe_lab[..., None].astype(jnp.int32),
+                                 axis=axis if axis == -1 else axis)
+    nll = -jnp.squeeze(picked, axis=axis)
+    nll = jnp.where(valid, nll, 0.0)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return jnp.sum(nll) / denom
+    if reduction == "sum":
+        return jnp.sum(nll)
+    if squeeze_last:
+        nll = nll[..., None]
+    return nll
+
+
+def _softmax_ce_soft_fn(logits, label, axis=-1, reduction="mean",
+                        use_softmax=True):
+    lse = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(lse, axis=axis) if use_softmax \
+        else jnp.log(jnp.maximum(lse, 1e-30))
+    loss = -jnp.sum(label.astype(jnp.float32) * logp, axis=axis)
+    return _reduce(loss, reduction)
+
+
+_ce_hard = Primitive("softmax_with_cross_entropy", _softmax_ce_hard_fn)
+_ce_soft = Primitive("softmax_with_cross_entropy_soft", _softmax_ce_soft_fn)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    if weight is not None:
+        # weighted path: compose eagerly (rare)
+        from .activation import log_softmax
+        from ...ops import take_along_axis, unsqueeze, squeeze
+        logp = log_softmax(input, axis=axis)
+        lab = label if label.ndim == input.ndim else unsqueeze(label, [-1])
+        picked = take_along_axis(logp, lab, axis=axis)
+        w = take_along_axis(weight, squeeze(lab, [-1]).reshape([-1]), 0)
+        w = w.reshape(squeeze(lab, [-1]).shape)
+        nll = -squeeze(picked, [-1]) * w
+        if reduction == "mean":
+            return nll.sum() / w.sum()
+        if reduction == "sum":
+            return nll.sum()
+        return nll
+    if soft_label:
+        return _ce_soft(input, label, axis=int(axis), reduction=reduction,
+                        use_softmax=bool(use_softmax))
+    return _ce_hard(input, label, axis=int(axis),
+                    ignore_index=int(ignore_index), reduction=reduction,
+                    use_softmax=bool(use_softmax))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return cross_entropy(input, label, weight=weight,
+                         ignore_index=ignore_index, reduction=reduction,
+                         use_softmax=False, soft_label=False)
+
+
+_mse = Primitive("mse_loss", lambda x, y, reduction="mean":
+                 _reduce(jnp.square(x - y), reduction))
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _mse(input, label, reduction=reduction)
+
+
+def square_error_cost(input, label):
+    return _mse(input, label, reduction="none")
+
+
+_l1 = Primitive("l1_loss", lambda x, y, reduction="mean":
+                _reduce(jnp.abs(x - y), reduction))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _l1(input, label, reduction=reduction)
+
+
+def _bce_fn(x, y, reduction="mean"):
+    eps = 1e-12
+    loss = -(y * jnp.log(jnp.maximum(x, eps)) +
+             (1 - y) * jnp.log(jnp.maximum(1 - x, eps)))
+    return _reduce(loss, reduction)
+
+
+_bce = Primitive("bce_loss", _bce_fn)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    if weight is not None:
+        loss = _bce(input, label, reduction="none")
+        from ...ops import multiply
+        loss = multiply(loss, weight)
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+    return _bce(input, label, reduction=reduction)
+
+
+def _bce_logits_fn(x, y, reduction="mean", pos_weight=None):
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    # numerically stable: max(x,0) - x*y + log(1+exp(-|x|))
+    loss = jnp.maximum(xf, 0) - xf * yf + jnp.log1p(jnp.exp(-jnp.abs(xf)))
+    return _reduce(loss, reduction)
+
+
+_bce_logits = Primitive("sigmoid_cross_entropy_with_logits", _bce_logits_fn)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    if weight is None and pos_weight is None:
+        return _bce_logits(logit, label, reduction=reduction)
+    from .activation import sigmoid
+    from ...ops import multiply, log, clip
+    out = _bce_logits(logit, label, reduction="none")
+    if pos_weight is not None:
+        # l = -[pw*y*log(s) + (1-y)log(1-s)]: scale the positive term
+        logp = _bce_logits(logit, label, reduction="none")
+        out = multiply(label, pos_weight - 1) * _pos_term(logit) + logp
+    if weight is not None:
+        out = multiply(out, weight)
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+_pos_term_p = Primitive("bce_pos_term", lambda x: jnp.maximum(-x, 0) +
+                        jnp.log1p(jnp.exp(-jnp.abs(x))))
+
+
+def _pos_term(logit):
+    return _pos_term_p(logit)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      normalize=False):
+    out = _bce_logits(x, label, reduction="none")
+    return out
+
+
+_kl = Primitive("kldiv_loss", lambda x, y, reduction="mean":
+                _kl_fn(x, y, reduction))
+
+
+def _kl_fn(x, y, reduction):
+    loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - x)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    return _kl(input, label, reduction=reduction)
+
+
+def _smooth_l1_fn(x, y, delta=1.0, reduction="mean"):
+    d = x - y
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+_smooth_l1 = Primitive("smooth_l1_loss", _smooth_l1_fn)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _smooth_l1(input, label, delta=float(delta), reduction=reduction)
+
+
+def _huber_fn(x, y, delta=1.0):
+    d = x - y
+    ad = jnp.abs(d)
+    return jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+
+
+_huber = Primitive("huber_loss", _huber_fn)
+
+
+def huber_loss(input, label, delta=1.0):
+    return _huber(input, label, delta=float(delta))
+
+
+_log_loss = Primitive("log_loss", lambda x, y, eps=1e-4:
+                      -y * jnp.log(x + eps) - (1 - y) * jnp.log(1 - x + eps))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _log_loss(input, label, eps=float(epsilon))
+
+
+def _margin_ranking_fn(x, y, label, margin=0.0, reduction="mean"):
+    loss = jnp.maximum(0, -label * (x - y) + margin)
+    return _reduce(loss, reduction)
+
+
+_margin_ranking = Primitive("margin_ranking_loss", _margin_ranking_fn)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return _margin_ranking(input, other, label, margin=float(margin),
+                           reduction=reduction)
+
+
+def _hinge_fn(logit, label):
+    return jnp.maximum(0, 1 - logit * (2 * label - 1))
+
+
+_hinge = Primitive("hinge_loss", _hinge_fn)
+
+
+def hinge_loss(input, label, name=None):
+    return _hinge(input, label)
+
+
+def _focal_fn(logit, label, normalizer, alpha=0.25, gamma=2.0,
+              reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * ((1 - p_t) ** gamma) * ce / normalizer
+    return _reduce(loss, reduction)
+
+
+_focal = Primitive("sigmoid_focal_loss", _focal_fn)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    norm = normalizer if normalizer is not None else Tensor(jnp.ones(()))
+    return _focal(logit, label, norm, alpha=float(alpha), gamma=float(gamma),
+                  reduction=reduction)
+
+
+def _cosine_embedding_fn(x1, x2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(x1 * x2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+    loss = jnp.where(label > 0, 1 - cos, jnp.maximum(0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+_cos_emb = Primitive("cosine_embedding_loss", _cosine_embedding_fn)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    return _cos_emb(input1, input2, label, margin=float(margin),
+                    reduction=reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """warpctc_op.cc parity via pure-XLA forward algorithm (lax.scan over T)."""
+    lp = unwrap(log_probs).astype(jnp.float32)  # (T, B, C), log-probs expected
+    lab = unwrap(labels)
+    in_len = unwrap(input_lengths)
+    lab_len = unwrap(label_lengths)
+    p = _ctc_prim
+    out = p(log_probs, labels, input_lengths, label_lengths, blank=int(blank))
+    if reduction == "mean":
+        from ...ops import mean as _m
+        return _m(out / lab_len.astype(jnp.float32))
+    if reduction == "sum":
+        from ...ops import sum as _s
+        return _s(out)
+    return out
+
+
+def _ctc_fn(log_probs, labels, input_lengths, label_lengths, blank=0):
+    # forward algorithm in log space; (T,B,C) logits already log-softmaxed
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    NEG = jnp.asarray(-1e30, jnp.float32)
+    lp = log_probs.astype(jnp.float32)
+    ext = jnp.full((B, S), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    same_as_prevprev = jnp.concatenate(
+        [jnp.zeros((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, lp_t):
+        a_shift1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], 1)
+        a_shift2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], 1)
+        a_shift2 = jnp.where(same_as_prevprev, NEG, a_shift2)
+        merged = jnp.logaddexp(alpha, jnp.logaddexp(a_shift1, a_shift2))
+        emit = jnp.take_along_axis(lp_t, ext.astype(jnp.int32), axis=1)
+        return merged + emit, None
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+    first_lab = jnp.take_along_axis(lp[0], ext[:, 1:2].astype(jnp.int32), 1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(first_lab)
+    alphas, _ = jax.lax.scan(step, alpha0, lp[1:])
+    all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T,B,S)
+    t_idx = (input_lengths.astype(jnp.int32) - 1)
+    final = all_alphas[t_idx, jnp.arange(B)]  # (B, S)
+    s_last = 2 * label_lengths.astype(jnp.int32)
+    a_end = jnp.take_along_axis(final, s_last[:, None], 1)[:, 0]
+    a_end2 = jnp.take_along_axis(final, jnp.maximum(s_last - 1, 0)[:, None],
+                                 1)[:, 0]
+    return -jnp.logaddexp(a_end, a_end2)
+
+
+_ctc_prim = Primitive("warpctc", _ctc_fn)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    raise NotImplementedError("npair_loss: round 2+")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    return _triplet(input, positive, negative, margin=float(margin),
+                    p=float(p), eps=float(epsilon), reduction=reduction)
+
+
+def _triplet_fn(a, pos, neg, margin=1.0, p=2.0, eps=1e-6, reduction="mean"):
+    dp = jnp.sum(jnp.abs(a - pos) ** p + eps, axis=-1) ** (1 / p)
+    dn = jnp.sum(jnp.abs(a - neg) ** p + eps, axis=-1) ** (1 / p)
+    loss = jnp.maximum(dp - dn + margin, 0)
+    return _reduce(loss, reduction)
+
+
+_triplet = Primitive("triplet_margin_loss", _triplet_fn)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss for segmentation (fluid/layers/nn.py:7069): label one-hot
+    over the last dim; score per sample reduced over all non-batch dims."""
+    from ... import ops
+    from .common import one_hot
+    lab = label
+    if len(lab.shape) == len(input.shape) and lab.shape[-1] == 1:
+        lab = ops.squeeze(lab, axis=[-1])
+    lab1h = one_hot(lab, input.shape[-1])
+    reduce_dim = list(range(1, len(input.shape)))
+    inse = ops.sum(input * lab1h, axis=reduce_dim)
+    denom = ops.sum(input, axis=reduce_dim) + ops.sum(lab1h,
+                                                      axis=reduce_dim)
+    score = 1 - inse * 2 / (denom + epsilon)
+    return ops.mean(score)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric loss (fluid/layers/loss.py:1653): soft-label CE over
+    the anchor/positive similarity matrix + Beta*l2 embedding penalty."""
+    from ... import ops
+    beta = 0.25
+    b = labels.shape[0]
+    lab = ops.reshape(labels, [b, 1]).astype("float32")
+    same = ops.equal(lab, ops.transpose(lab, [1, 0])).astype("float32")
+    same = same / ops.sum(same, axis=1, keepdim=True)
+    l2loss = ops.mean(ops.sum(anchor * anchor, axis=1)) + \
+        ops.mean(ops.sum(positive * positive, axis=1))
+    l2loss = l2loss * beta * float(l2_reg)
+    sim = ops.matmul(anchor, positive, transpose_y=True)
+    ce = softmax_with_cross_entropy(sim, same, soft_label=True)
+    return l2loss + ops.mean(ops.sum(same * ce, axis=0))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (hierarchical_sigmoid_op.cc). Default
+    tree: complete binary tree over ``num_classes`` leaves — internal node
+    ids follow the heap layout the reference's default path uses; custom
+    trees come in via path_table/path_code.
+
+    input [B, D]; label [B] int; weight [num_classes-1, D];
+    bias [num_classes-1] or None. Returns [B, 1].
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    from ... import ops
+    from ...framework.tensor import Tensor, unwrap
+
+    B, D = input.shape
+    if path_table is None:
+        table_dev, code_dev = _hsigmoid_default_tree(int(num_classes))
+    else:
+        table_dev = jnp.asarray(np.asarray(unwrap(path_table), np.int32))
+        code_dev = jnp.asarray(np.asarray(unwrap(path_code), np.int32))
+
+    lab = unwrap(label).astype(jnp.int32).reshape(-1)
+    t = Tensor(table_dev[lab])                           # [B, depth]
+    c = Tensor(code_dev[lab])                            # [B, depth]
+    w_rows = ops.gather(weight, ops.reshape(t, [-1]))    # [B*depth, D]
+    w_rows = ops.reshape(w_rows, [B, -1, D])
+    logits = ops.sum(w_rows * ops.reshape(input, [B, 1, D]), axis=2)
+    if bias is not None:
+        logits = logits + ops.reshape(
+            ops.gather(bias, ops.reshape(t, [-1])), [B, -1])
+    # sign from the code bit; padded steps (code -1) contribute zero
+    cv = c.astype("float32")
+    valid = ops.cast(c != -1, "float32")
+    sign = 2.0 * cv - 1.0
+    # log(1 + exp(-sign*logit)), numerically stable
+    z = -sign * logits
+    per_node = ops.maximum(z, z * 0) + ops.log1p(ops.exp(-ops.abs(z)))
+    loss = ops.sum(per_node * valid, axis=1, keepdim=True)
+    return loss
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=64)
+def _hsigmoid_default_tree(num_classes):
+    """Complete-binary-tree path table/codes for the default hsigmoid tree
+    (cached: pure function of num_classes, built once and kept on device).
+    Leaf l sits at heap position num_classes-1+l; internal node i's row in
+    `weight` is i."""
+    import numpy as np
+    import jax.numpy as jnp
+    depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+    tables, codes = [], []
+    for leaf in range(num_classes):
+        pos = num_classes - 1 + leaf
+        t, c = [], []
+        while pos > 0:
+            parent = (pos - 1) // 2
+            t.append(parent)
+            c.append(pos % 2)       # 1 if left child else 0
+            pos = parent
+        t = t[::-1][:depth]
+        c = c[::-1][:depth]
+        while len(t) < depth:       # pad short paths, masked out in loss
+            t.append(0)
+            c.append(-1)
+        tables.append(t)
+        codes.append(c)
+    return (jnp.asarray(np.asarray(tables, np.int32)),
+            jnp.asarray(np.asarray(codes, np.int32)))

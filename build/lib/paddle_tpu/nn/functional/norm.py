@@ -1,0 +1,206 @@
+"""Normalization functionals.
+
+Reference parity: batch_norm_op.cc, layer_norm_op.cc, instance_norm_op.cc,
+group_norm_op.cc and python/paddle/nn/functional/norm.py. TPU-first: all are
+single fused reduction+scale expressions; batch_norm in training mode returns
+(out, new_mean, new_var) functionally -- the Layer writes the running stats
+back (and paddle_tpu.jit captures those writes when tracing).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.primitive import Primitive
+from ...framework.tensor import Tensor, unwrap
+
+
+def _bn_axes(ndim, data_format):
+    ch = 1 if data_format.startswith("NC") else ndim - 1
+    reduce_axes = tuple(i for i in range(ndim) if i != ch)
+    return ch, reduce_axes
+
+
+def _bn_train_fn(x, gamma, beta, rmean, rvar, momentum=0.9, eps=1e-5,
+                 data_format="NCHW"):
+    ch, axes = _bn_axes(x.ndim, data_format)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    shape = [1] * x.ndim
+    shape[ch] = x.shape[ch]
+    inv = jax.lax.rsqrt(var + eps)
+    out = (xf - mean.reshape(shape)) * inv.reshape(shape)
+    out = out * gamma.astype(jnp.float32).reshape(shape) + \
+        beta.astype(jnp.float32).reshape(shape)
+    new_rmean = momentum * rmean + (1 - momentum) * mean.astype(rmean.dtype)
+    new_rvar = momentum * rvar + (1 - momentum) * var.astype(rvar.dtype)
+    return out.astype(x.dtype), new_rmean, new_rvar
+
+
+def _bn_eval_fn(x, gamma, beta, rmean, rvar, eps=1e-5, data_format="NCHW"):
+    ch, _ = _bn_axes(x.ndim, data_format)
+    shape = [1] * x.ndim
+    shape[ch] = x.shape[ch]
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(rvar.astype(jnp.float32) + eps)
+    out = (xf - rmean.astype(jnp.float32).reshape(shape)) * inv.reshape(shape)
+    out = out * gamma.astype(jnp.float32).reshape(shape) + \
+        beta.astype(jnp.float32).reshape(shape)
+    return out.astype(x.dtype)
+
+
+_bn_train = Primitive("batch_norm_train", _bn_train_fn, multi_output=True)
+_bn_eval = Primitive("batch_norm_eval", _bn_eval_fn)
+
+
+def batch_norm(x, running_mean, running_var, weight, bias, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    if use_global_stats:
+        training = False
+    if training:
+        out, nm, nv = _bn_train(x, weight, bias, running_mean, running_var,
+                                momentum=float(momentum), eps=float(epsilon),
+                                data_format=data_format)
+        # functional-state write-back: Layer buffers mutate eagerly; jit
+        # tracing captures the set_value (see jit/state tracking)
+        if isinstance(running_mean, Tensor):
+            running_mean.set_value(nm._value)
+            running_var.set_value(nv._value)
+        return out
+    return _bn_eval(x, weight, bias, running_mean, running_var,
+                    eps=float(epsilon), data_format=data_format)
+
+
+def _ln_fn(x, gamma=None, beta=None, eps=1e-5, begin_axis=-1):
+    axes = tuple(range(begin_axis, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if gamma is not None:
+        out = out * gamma.astype(jnp.float32)
+    if beta is not None:
+        out = out + beta.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+_ln = Primitive("layer_norm", _ln_fn)
+_ln_nogb = Primitive("layer_norm_nogb",
+                     lambda x, eps=1e-5, begin_axis=-1:
+                     _ln_fn(x, None, None, eps, begin_axis))
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        n_axes = 1
+    else:
+        n_axes = len(list(normalized_shape))
+    begin = (x.ndim if isinstance(x, Tensor) else jnp.ndim(unwrap(x))) - n_axes
+    if weight is not None and bias is not None:
+        return _ln(x, weight, bias, eps=float(epsilon), begin_axis=begin)
+    if weight is None and bias is None:
+        return _ln_nogb(x, eps=float(epsilon), begin_axis=begin)
+    # one of the two
+    from ...ops import zeros, ones
+    if weight is None:
+        shape = [unwrap(x).shape[i] for i in range(begin, unwrap(x).ndim)]
+        weight = ones(shape, dtype=str(unwrap(x).dtype))
+    if bias is None:
+        shape = [unwrap(x).shape[i] for i in range(begin, unwrap(x).ndim)]
+        bias = zeros(shape, dtype=str(unwrap(x).dtype))
+    return _ln(x, weight, bias, eps=float(epsilon), begin_axis=begin)
+
+
+def _in_fn(x, gamma=None, beta=None, eps=1e-5):
+    # instance norm over spatial dims, per (N, C)
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if gamma is not None:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = out * gamma.astype(jnp.float32).reshape(shape)
+        out = out + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(x.dtype)
+
+
+_in_p = Primitive("instance_norm", _in_fn)
+_in_nogb = Primitive("instance_norm_nogb",
+                     lambda x, eps=1e-5: _in_fn(x, None, None, eps))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    if weight is not None:
+        return _in_p(x, weight, bias, eps=float(eps))
+    return _in_nogb(x, eps=float(eps))
+
+
+def _gn_fn(x, gamma=None, beta=None, groups=1, eps=1e-5):
+    n, c = x.shape[0], x.shape[1]
+    xf = x.astype(jnp.float32)
+    grouped = jnp.reshape(xf, (n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, grouped.ndim))
+    mean = jnp.mean(grouped, axis=axes, keepdims=True)
+    var = jnp.var(grouped, axis=axes, keepdims=True)
+    out = (grouped - mean) * jax.lax.rsqrt(var + eps)
+    out = jnp.reshape(out, x.shape)
+    if gamma is not None:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = out * gamma.astype(jnp.float32).reshape(shape)
+        out = out + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(x.dtype)
+
+
+_gn_p = Primitive("group_norm", _gn_fn)
+_gn_nogb = Primitive("group_norm_nogb",
+                     lambda x, groups=1, eps=1e-5: _gn_fn(x, None, None,
+                                                          groups, eps))
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    if weight is not None:
+        return _gn_p(x, weight, bias, groups=int(num_groups),
+                     eps=float(epsilon))
+    return _gn_nogb(x, groups=int(num_groups), eps=float(epsilon))
+
+
+def _l2norm_fn(x, axis=1, eps=1e-12, p=2.0):
+    norm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(norm, eps)
+
+
+_l2norm = Primitive("normalize", _l2norm_fn)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _l2norm(x, axis=int(axis), eps=float(epsilon), p=float(p))
+
+
+def _lrn_fn(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    # local response norm across channels (NCHW)
+    sq = jnp.square(x)
+    half = size // 2
+    pads = [(0, 0)] * x.ndim
+    pads[1] = (half, size - 1 - half)
+    sq = jnp.pad(sq, pads)
+    win = [1] * x.ndim
+    win[1] = size
+    acc = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(win),
+                                (1,) * x.ndim, "VALID")
+    return x / jnp.power(k + alpha * acc, beta)
+
+
+_lrn = Primitive("local_response_norm", _lrn_fn)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return _lrn(x, size=int(size), alpha=float(alpha), beta=float(beta),
+                k=float(k))

@@ -1,0 +1,284 @@
+"""Pooling functionals.
+
+Reference parity: paddle/fluid/operators/pool_op.cc and
+python/paddle/nn/functional/pooling.py. Lowered to lax.reduce_window (XLA
+pooling primitive). Paddle's ``exclusive=True`` average (divide by the number
+of valid elements, not window size) is implemented by reduce-window-summing a
+ones mask.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.primitive import Primitive
+from ...framework.tensor import Tensor, unwrap
+from .conv import _norm_tuple, _norm_padding
+
+
+def _window(nsp, channel_last, kernel, stride):
+    if channel_last:
+        return (1,) + kernel + (1,), (1,) + stride + (1,)
+    return (1, 1) + kernel, (1, 1) + stride
+
+
+def _pad_spec(pad, nsp, channel_last):
+    if isinstance(pad, str):
+        return pad
+    if channel_last:
+        return ((0, 0),) + tuple(pad) + ((0, 0),)
+    return ((0, 0), (0, 0)) + tuple(pad)
+
+
+def _max_pool_fn(x, kernel=(2, 2), stride=(2, 2), padding="VALID",
+                 channel_last=False, nsp=2):
+    win, strd = _window(nsp, channel_last, kernel, stride)
+    pad = _pad_spec(padding, nsp, channel_last)
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return jax.lax.reduce_window(x, init, jax.lax.max, win, strd, pad)
+
+
+def _avg_pool_fn(x, kernel=(2, 2), stride=(2, 2), padding="VALID",
+                 channel_last=False, nsp=2, exclusive=True):
+    win, strd = _window(nsp, channel_last, kernel, stride)
+    pad = _pad_spec(padding, nsp, channel_last)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, win, strd, pad)
+    if exclusive and pad != "VALID":
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, win, strd, pad)
+        return summed / counts
+    return summed / float(np.prod(kernel))
+
+
+_max_pool_p = Primitive("max_pool", _max_pool_fn)
+_avg_pool_p = Primitive("avg_pool", _avg_pool_fn)
+
+
+def _pool(kind, x, kernel_size, stride, padding, nsp, data_format, exclusive=True,
+          ceil_mode=False):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    kernel = _norm_tuple(kernel_size, nsp)
+    stride = _norm_tuple(stride if stride is not None else kernel_size, nsp)
+    pad = _norm_padding(padding, nsp)
+    if kind == "max":
+        return _max_pool_p(x, kernel=kernel, stride=stride, padding=pad,
+                           channel_last=channel_last, nsp=nsp)
+    return _avg_pool_p(x, kernel=kernel, stride=stride, padding=pad,
+                       channel_last=channel_last, nsp=nsp, exclusive=exclusive)
+
+
+def _max_pool_mask_fn(x, kernel=(2, 2), stride=(2, 2), padding=((0, 0),),
+                      nsp=2):
+    """Max pool + argmax indices (max_pool2d_with_index_op.cc). NC-first
+    only. Indices are flat offsets into the input's spatial volume — the
+    layout unpool_op.cc consumes. TPU-shape: one patches-extraction
+    (conv_general_dilated_patches) + argmax, no serial window walk."""
+    N, C = x.shape[:2]
+    spatial = x.shape[2:]
+    pad = padding
+    neg = jnp.asarray(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                      else jnp.iinfo(x.dtype).min, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0)) + tuple(pad), constant_values=neg)
+    out_sp = tuple((xp.shape[2 + d] - kernel[d]) // stride[d] + 1
+                   for d in range(nsp))
+    # exact patch extraction by strided slicing (one slice per kernel tap;
+    # no conv/matmul, so no precision loss under bf16 matmul defaults)
+    taps = []
+    for loc in np.ndindex(*kernel):
+        idx = (slice(None), slice(None)) + tuple(
+            slice(loc[d], loc[d] + stride[d] * out_sp[d], stride[d])
+            for d in range(nsp))
+        taps.append(xp[idx])
+    patches = jnp.stack(taps, axis=2)                    # [N, C, K, *out_sp]
+    pooled = jnp.max(patches, axis=2)
+    local = jnp.argmax(patches, axis=2)                  # [N, C, *out_sp]
+    # local index (row-major within the window) -> global flat spatial index
+    flat = jnp.zeros(local.shape, dtype=jnp.int32)
+    strides_sp = []
+    acc = 1
+    for s in reversed(spatial):
+        strides_sp.insert(0, acc)
+        acc *= s
+    # per spatial dim: window origin at each output position + local coord
+    for d, (k, st, sp_stride) in enumerate(zip(kernel, stride, strides_sp)):
+        origin = (jnp.arange(out_sp[d]) * st -
+                  (0 if isinstance(pad, str) else pad[d][0]))
+        shape = [1] * local.ndim
+        shape[2 + d] = out_sp[d]
+        origin = origin.reshape(shape)
+        inner = int(np.prod(kernel[d + 1:]))
+        coord = (local // inner) % k
+        flat = flat + (origin + coord) * sp_stride
+    return pooled, flat
+
+
+_max_pool_mask_p = Primitive("max_pool_with_index", _max_pool_mask_fn,
+                             multi_output=True)
+
+
+def _pool_with_mask(x, kernel_size, stride, padding, nsp):
+    kernel = _norm_tuple(kernel_size, nsp)
+    strd = _norm_tuple(stride if stride is not None else kernel_size, nsp)
+    pad = _norm_padding(padding, nsp)
+    if isinstance(pad, str):
+        raise ValueError("return_mask needs explicit int padding")
+    return _max_pool_mask_p(x, kernel=kernel, stride=strd, padding=pad,
+                            nsp=nsp)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        if data_format != "NCL":
+            raise ValueError("return_mask requires NCL")
+        return _pool_with_mask(x, kernel_size, stride, padding, 1)
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool("max", x, kernel_size, stride, padding, 1, df)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if data_format != "NCHW":
+            raise ValueError("return_mask requires NCHW")
+        return _pool_with_mask(x, kernel_size, stride, padding, 2)
+    return _pool("max", x, kernel_size, stride, padding, 2, data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        if data_format != "NCDHW":
+            raise ValueError("return_mask requires NCDHW")
+        return _pool_with_mask(x, kernel_size, stride, padding, 3)
+    return _pool("max", x, kernel_size, stride, padding, 3, data_format)
+
+
+def _max_unpool_fn(x, indices, out_spatial=(4, 4)):
+    """unpool_op.cc: scatter pooled values back to their argmax positions;
+    everything else zero. indices are flat offsets into out_spatial."""
+    N, C = x.shape[:2]
+    vol = int(np.prod(out_spatial))
+    vals = x.reshape(N * C, -1)
+    idx = indices.reshape(N * C, -1)
+    out = jnp.zeros((N * C, vol), x.dtype)
+    rows = jnp.arange(N * C)[:, None]
+    out = out.at[rows, idx].set(vals)
+    return out.reshape((N, C) + tuple(out_spatial))
+
+
+_max_unpool_p = Primitive("max_unpool", _max_unpool_fn)
+
+
+def _unpool(x, indices, kernel_size, stride, padding, output_size, nsp):
+    kernel = _norm_tuple(kernel_size, nsp)
+    strd = _norm_tuple(stride if stride is not None else kernel_size, nsp)
+    padt = _norm_tuple(padding, nsp)
+    xs = x.shape[2:] if hasattr(x, "shape") else unwrap(x).shape[2:]
+    if output_size is None:
+        out_sp = tuple((xs[i] - 1) * strd[i] - 2 * padt[i] + kernel[i]
+                       for i in range(nsp))
+    else:
+        out_sp = tuple(output_size)[-nsp:]
+    return _max_unpool_p(x, unwrap(indices), out_spatial=out_sp)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d(return_mask=True) (unpool_op.cc)."""
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 3)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool("avg", x, kernel_size, stride, padding, 1, df, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool("avg", x, kernel_size, stride, padding, 2, data_format,
+                 exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool("avg", x, kernel_size, stride, padding, 3, data_format,
+                 exclusive)
+
+
+def _adaptive_pool_fn(x, out_size=(1, 1), kind="avg", channel_last=False,
+                      nsp=2):
+    spatial_axes = tuple(range(1, 1 + nsp)) if channel_last \
+        else tuple(range(2, 2 + nsp))
+    # adaptive pooling with uniform bins when divisible; general case uses
+    # mean over index buckets
+    for ax, osz in zip(spatial_axes, out_size):
+        isz = x.shape[ax]
+        if isz % osz == 0:
+            k = isz // osz
+            shape = list(x.shape)
+            shape[ax] = osz
+            shape.insert(ax + 1, k)
+            x = jnp.reshape(x, shape)
+            x = jnp.max(x, axis=ax + 1) if kind == "max" else jnp.mean(x, axis=ax + 1)
+        else:
+            # bucketed gather: start/end per output position (static python loop)
+            segs = []
+            for o in range(osz):
+                s = (o * isz) // osz
+                e = -(-((o + 1) * isz) // osz)
+                sl = [slice(None)] * x.ndim
+                sl[ax] = slice(s, e)
+                seg = x[tuple(sl)]
+                seg = jnp.max(seg, axis=ax, keepdims=True) if kind == "max" \
+                    else jnp.mean(seg, axis=ax, keepdims=True)
+                segs.append(seg)
+            x = jnp.concatenate(segs, axis=ax)
+    return x
+
+
+_adaptive_p = Primitive("adaptive_pool", _adaptive_pool_fn)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_p(x, out_size=_norm_tuple(output_size, 1), kind="avg",
+                       channel_last=False, nsp=1)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_p(x, out_size=_norm_tuple(output_size, 2), kind="avg",
+                       channel_last=data_format == "NHWC", nsp=2)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_p(x, out_size=_norm_tuple(output_size, 3), kind="avg",
+                       channel_last=data_format == "NDHWC", nsp=3)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_p(x, out_size=_norm_tuple(output_size, 1), kind="max",
+                       channel_last=False, nsp=1)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_p(x, out_size=_norm_tuple(output_size, 2), kind="max",
+                       channel_last=False, nsp=2)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_p(x, out_size=_norm_tuple(output_size, 3), kind="max",
+                       channel_last=False, nsp=3)

@@ -1,0 +1,203 @@
+"""Weight initializers.
+
+Reference parity: python/paddle/fluid/initializer.py (ConstantInitializer,
+UniformInitializer, NormalInitializer, TruncatedNormal, Xavier, MSRA/Kaiming,
+NumpyArrayInitializer) surfaced as paddle.nn.initializer.*. TPU-first: an
+initializer is a pure function (shape, dtype, key) -> jax array, so it can run
+inside jit (e.g. sharded init of a distributed model without materializing on
+one host).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.dtype import convert_dtype, get_default_dtype
+from ...framework.random import default_generator
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) < 2:
+        return (shape[0] if shape else 1,) * 2
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    # paddle Linear weights are [in, out] (transposed vs torch): for 2-D use
+    # rows=fan_in, cols=fan_out which matches fluid XavierInitializer
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None, key=None):
+        raise NotImplementedError
+
+    def _key(self, key):
+        return key if key is not None else default_generator.next_key()
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None, key=None):
+        return jnp.full(tuple(shape), self.value,
+                        convert_dtype(dtype) or get_default_dtype())
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None, key=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        return jax.random.uniform(self._key(key), tuple(shape), jnp.float32,
+                                  self.low, self.high).astype(dt)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None, key=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        out = jax.random.normal(self._key(key), tuple(shape), jnp.float32)
+        return (out * self.std + self.mean).astype(dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None, key=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        out = jax.random.truncated_normal(self._key(key), -2.0, 2.0,
+                                          tuple(shape), jnp.float32)
+        return (out * self.std + self.mean).astype(dt)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None, key=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(self._key(key), tuple(shape), jnp.float32,
+                                  -limit, limit).astype(dt)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None, key=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (jax.random.normal(self._key(key), tuple(shape), jnp.float32)
+                * std).astype(dt)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype=None, key=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(self._key(key), tuple(shape), jnp.float32,
+                                  -limit, limit).astype(dt)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype=None, key=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / math.sqrt(fi)
+        return (jax.random.normal(self._key(key), tuple(shape), jnp.float32)
+                * std).astype(dt)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, shape, dtype=None, key=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        arr = self.value.reshape(tuple(shape))
+        return jnp.asarray(arr, dt)
+
+
+class Bilinear(Initializer):
+    """Bilinear upsample kernel init (fluid BilinearInitializer)."""
+
+    def __call__(self, shape, dtype=None, key=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        c_out, c_in, kh, kw = shape
+        f = math.ceil(kw / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:kh, :kw]
+        filt = (1 - np.abs(og[0] / f - c)) * (1 - np.abs(og[1] / f - c))
+        w = np.zeros(shape, dtype=np.float32)
+        for i in range(c_out):
+            w[i, min(i, c_in - 1)] = filt
+        return jnp.asarray(w, dt)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None, key=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        return (jax.nn.initializers.orthogonal(self.gain)(
+            self._key(key), tuple(shape), jnp.float32)).astype(dt)
+
+
+class Dirac(Initializer):
+    def __call__(self, shape, dtype=None, key=None):
+        dt = convert_dtype(dtype) or get_default_dtype()
+        w = np.zeros(tuple(shape), np.float32)
+        c = min(shape[0], shape[1])
+        centers = [s // 2 for s in shape[2:]]
+        for i in range(c):
+            w[(i, i, *centers)] = 1.0
+        return jnp.asarray(w, dt)
+
+
+# fluid-era aliases
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign
+
+
+def calculate_gain(nonlinearity, param=None):
+    table = {"sigmoid": 1.0, "linear": 1.0, "conv2d": 1.0,
+             "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+             "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+             "selu": 3.0 / 4.0}
+    return table.get(nonlinearity, 1.0)

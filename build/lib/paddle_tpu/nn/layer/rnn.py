@@ -1,0 +1,408 @@
+"""Recurrent layers.
+
+Reference parity: python/paddle/nn/layer/rnn.py (SimpleRNNCell, LSTMCell,
+GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU) and the cudnn rnn_op. TPU-first:
+the time loop is jax.lax.scan over a single fused cell step (XLA unrolls the
+matmuls onto the MXU; no cuDNN descriptor machinery). Weights follow paddle
+layout: weight_ih (hidden, input) row-major gate stacking [i,f,c,o] for LSTM
+and [r,z,c] for GRU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.primitive import Primitive
+from ...framework.tensor import Tensor, unwrap
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops import full
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and isinstance(shape[0], (list, tuple)):
+            return tuple(full([batch, *s], init_value, dtype or "float32")
+                         for s in shape)
+        return full([batch, *shape], init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        pre_h = states
+        i2h = F.linear(inputs, self.weight_ih.T, self.bias_ih)
+        h2h = F.linear(pre_h, self.weight_hh.T, self.bias_hh)
+        h = getattr(F, self.activation)(i2h + h2h)
+        return h, h
+
+
+def _lstm_cell_fn(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    new_c = f * c + i * g
+    new_h = o * jnp.tanh(new_c)
+    return new_h, new_c
+
+
+_lstm_cell_p = Primitive("lstm_cell", _lstm_cell_fn, multi_output=True)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        new_h, new_c = _lstm_cell_p(inputs, h, c, self.weight_ih,
+                                    self.weight_hh, self.bias_ih, self.bias_hh)
+        return new_h, (new_h, new_c)
+
+
+def _gru_cell_fn(x, h, w_ih, w_hh, b_ih, b_hh):
+    gi = x @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    return (1 - z) * n + z * h
+
+
+_gru_cell_p = Primitive("gru_cell", _gru_cell_fn)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        new_h = _gru_cell_p(inputs, states, self.weight_ih, self.weight_hh,
+                            self.bias_ih, self.bias_hh)
+        return new_h, new_h
+
+
+# ---- scanned multi-layer RNNs ------------------------------------------------
+
+def _lstm_scan_fn(x, h0, c0, *weights, num_layers=1, time_major=False,
+                  directions=1):
+    """x: (B,T,I) or (T,B,I); weights flat per (layer,direction):
+    [w_ih, w_hh, b_ih, b_hh] * L * D. Returns (out, h_n, c_n)."""
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)  # (T,B,I)
+    per = 4
+    h_states, c_states = [], []
+    layer_in = x
+    for layer in range(num_layers):
+        outs = []
+        for d in range(directions):
+            idx = (layer * directions + d) * per
+            w_ih, w_hh, b_ih, b_hh = weights[idx:idx + per]
+            hc0 = (h0[layer * directions + d], c0[layer * directions + d])
+            seq = layer_in if d == 0 else jnp.flip(layer_in, axis=0)
+
+            def step(carry, xt):
+                h, c = carry
+                nh, nc = _lstm_cell_fn(xt, h, c, w_ih, w_hh, b_ih, b_hh)
+                return (nh, nc), nh
+
+            (h_n, c_n), ys = jax.lax.scan(step, hc0, seq)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs.append(ys)
+            h_states.append(h_n)
+            c_states.append(c_n)
+        layer_in = outs[0] if directions == 1 else jnp.concatenate(outs, -1)
+    out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+    return out, jnp.stack(h_states), jnp.stack(c_states)
+
+
+_lstm_scan_p = Primitive("cudnn_lstm", _lstm_scan_fn, multi_output=True)
+
+
+def _gru_scan_fn(x, h0, *weights, num_layers=1, time_major=False,
+                 directions=1):
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+    per = 4
+    h_states = []
+    layer_in = x
+    for layer in range(num_layers):
+        outs = []
+        for d in range(directions):
+            idx = (layer * directions + d) * per
+            w_ih, w_hh, b_ih, b_hh = weights[idx:idx + per]
+            hh0 = h0[layer * directions + d]
+            seq = layer_in if d == 0 else jnp.flip(layer_in, axis=0)
+
+            def step(h, xt):
+                nh = _gru_cell_fn(xt, h, w_ih, w_hh, b_ih, b_hh)
+                return nh, nh
+
+            h_n, ys = jax.lax.scan(step, hh0, seq)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs.append(ys)
+            h_states.append(h_n)
+        layer_in = outs[0] if directions == 1 else jnp.concatenate(outs, -1)
+    out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+    return out, jnp.stack(h_states)
+
+
+_gru_scan_p = Primitive("cudnn_gru", _gru_scan_fn, multi_output=True)
+
+
+def _rnn_scan_fn(x, h0, *weights, num_layers=1, time_major=False,
+                 directions=1, activation="tanh"):
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    per = 4
+    h_states = []
+    layer_in = x
+    for layer in range(num_layers):
+        outs = []
+        for d in range(directions):
+            idx = (layer * directions + d) * per
+            w_ih, w_hh, b_ih, b_hh = weights[idx:idx + per]
+            hh0 = h0[layer * directions + d]
+            seq = layer_in if d == 0 else jnp.flip(layer_in, axis=0)
+
+            def step(h, xt):
+                nh = act(xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+                return nh, nh
+
+            h_n, ys = jax.lax.scan(step, hh0, seq)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs.append(ys)
+            h_states.append(h_n)
+        layer_in = outs[0] if directions == 1 else jnp.concatenate(outs, -1)
+    out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+    return out, jnp.stack(h_states)
+
+
+_rnn_scan_p = Primitive("simple_rnn", _rnn_scan_fn, multi_output=True)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_sz = input_size if layer == 0 else hidden_size * self.bidirect
+                suffix = f"_reverse" if d == 1 else ""
+                w_ih = self.create_parameter([gate_mult * hidden_size, in_sz],
+                                             weight_ih_attr,
+                                             default_initializer=u)
+                w_hh = self.create_parameter(
+                    [gate_mult * hidden_size, hidden_size], weight_hh_attr,
+                    default_initializer=u)
+                b_ih = self.create_parameter([gate_mult * hidden_size],
+                                             bias_ih_attr, is_bias=True,
+                                             default_initializer=u)
+                b_hh = self.create_parameter([gate_mult * hidden_size],
+                                             bias_hh_attr, is_bias=True,
+                                             default_initializer=u)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", w_ih)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", w_hh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", b_ih)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", b_hh)
+                self._all_weights += [w_ih, w_hh, b_ih, b_hh]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import zeros
+        batch_idx = 1 if self.time_major else 0
+        batch = inputs.shape[batch_idx]
+        n_state = self.num_layers * self.bidirect
+        if initial_states is None:
+            h0 = zeros([n_state, batch, self.hidden_size],
+                       dtype=str(inputs.dtype))
+            c0 = zeros([n_state, batch, self.hidden_size],
+                       dtype=str(inputs.dtype))
+        else:
+            if self.mode == "LSTM":
+                h0, c0 = initial_states
+            else:
+                h0, c0 = initial_states, None
+        kw = dict(num_layers=self.num_layers, time_major=self.time_major,
+                  directions=self.bidirect)
+        if self.mode == "LSTM":
+            out, h_n, c_n = _lstm_scan_p(inputs, h0, c0, *self._all_weights,
+                                         **kw)
+            return out, (h_n, c_n)
+        if self.mode == "GRU":
+            out, h_n = _gru_scan_p(inputs, h0, *self._all_weights, **kw)
+            return out, h_n
+        out, h_n = _rnn_scan_p(inputs, h0, *self._all_weights,
+                               activation=self.activation, **kw)
+        return out, h_n
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class RNN(Layer):
+    """Generic cell runner (python/paddle/nn/layer/rnn.py RNN class): scans a
+    user cell over time. Uses a python loop under eager; jit traces it into
+    the compiled step."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import stack, flip
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        states = initial_states
+        if states is None:
+            states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=1 if self.time_major else 0)
+        outs = []
+        idxs = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in idxs:
+            xt = inputs[:, t] if not self.time_major else inputs[t]
+            y, states = self.cell(xt, states)
+            outs.append(y)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = stack(outs, axis=time_axis)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import concat
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

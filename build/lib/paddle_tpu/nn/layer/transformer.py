@@ -1,0 +1,358 @@
+"""Transformer layers.
+
+Reference parity: python/paddle/nn/layer/transformer.py (MultiHeadAttention,
+TransformerEncoderLayer/Encoder, TransformerDecoderLayer/Decoder,
+Transformer). TPU-first: attention runs through
+functional.attention.attention_bnsh -- one fused XLA expression (or the Pallas
+flash kernel on TPU), bf16 matmuls with f32 softmax; the cache API
+(gen_cache/StaticCache) is kept for decoding parity.
+"""
+from __future__ import annotations
+
+import collections
+
+from ...framework.tensor import Tensor
+from ...ops import concat, reshape, transpose
+from .. import functional as F
+from ..functional.attention import attention_bnsh
+from .common import Dropout, Linear
+from .layers import Layer
+from .norm import LayerNorm
+
+
+class MultiHeadAttention(Layer):
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
+                 vdim=None, need_weights=False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.kdim = kdim or embed_dim
+        self.vdim = vdim or embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim
+        self.dropout = dropout
+        self.need_weights = need_weights
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def _split_heads(self, x):
+        b, s = x.shape[0], x.shape[1]
+        x = reshape(x, [b, s, self.num_heads, self.head_dim])
+        return transpose(x, [0, 2, 1, 3])  # B N S H
+
+    def _merge_heads(self, x):
+        b, n, s, h = x.shape
+        x = transpose(x, [0, 2, 1, 3])
+        return reshape(x, [b, s, n * h])
+
+    def gen_cache(self, key, value=None, type=None):
+        if type == MultiHeadAttention.StaticCache:
+            k = self._split_heads(self.k_proj(key))
+            v = self._split_heads(self.v_proj(value if value is not None else key))
+            return self.StaticCache(k, v)
+        from ...ops import zeros
+        b = key.shape[0]
+        k = zeros([b, self.num_heads, 0, self.head_dim], dtype=str(key.dtype))
+        v = zeros([b, self.num_heads, 0, self.head_dim], dtype=str(key.dtype))
+        return self.Cache(k, v)
+
+    def _fused_qkv(self, x):
+        """Self-attention QKV as ONE (E, 3E) matmul: three 768^2 GEMMs
+        underfeed the MXU at BERT shapes; the fused form is the
+        operators/fused/ play (fused_attention's qkv_weight) done at trace
+        time — the concat of the three weight Tensors is fused away by XLA
+        and autograd splits the gradient back onto q/k/v_proj params."""
+        from ...ops import matmul
+        w = concat([self.q_proj.weight, self.k_proj.weight,
+                    self.v_proj.weight], axis=1)
+        out = matmul(x, w)
+        if self.q_proj.bias is not None:
+            out = out + concat([self.q_proj.bias, self.k_proj.bias,
+                                self.v_proj.bias], axis=0)
+        e = self.embed_dim
+        return out[:, :, :e], out[:, :, e:2 * e], out[:, :, 2 * e:]
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        import os
+        # measured on v5e (BERT-base b64 s128): fused 1040 seq/s vs three
+        # GEMMs 1092 — XLA already schedules the three projections well and
+        # the trace-time weight concat only adds traffic; keep the fused
+        # path opt-in for future shapes where it may invert
+        fuse_qkv = (key is None and value is None and cache is None
+                    and self.kdim == self.embed_dim
+                    and self.vdim == self.embed_dim
+                    and bool(os.environ.get("PADDLE_TPU_FUSED_QKV")))
+        key = query if key is None else key
+        value = key if value is None else value
+        if fuse_qkv:
+            qf, kf, vf = self._fused_qkv(query)
+            q = self._split_heads(qf)
+            k = self._split_heads(kf)
+            v = self._split_heads(vf)
+        else:
+            q = self._split_heads(self.q_proj(query))
+        if isinstance(cache, self.StaticCache):
+            k, v = cache.k, cache.v
+        elif not fuse_qkv:
+            k = self._split_heads(self.k_proj(key))
+            v = self._split_heads(self.v_proj(value))
+            if isinstance(cache, self.Cache):
+                k = concat([cache.k, k], axis=2)
+                v = concat([cache.v, v], axis=2)
+                cache = self.Cache(k, v)
+        out = attention_bnsh(q, k, v, attn_mask=attn_mask)
+        if self.dropout:
+            out = F.dropout(out, self.dropout, training=self.training)
+        out = self.out_proj(self._merge_heads(out))
+        if cache is not None and not isinstance(cache, self.StaticCache):
+            return out, cache
+        return out
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
+                                            weight_attr=weight_attr,
+                                            bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.dropout = Dropout(act_dropout)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.activation = getattr(F, activation)
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        if cache is None:
+            src = self.self_attn(src, src, src, src_mask)
+        else:
+            src, cache = self.self_attn(src, src, src, src_mask, cache)
+        src = residual + self.dropout1(src)
+        if not self.normalize_before:
+            src = self.norm1(src)
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        src = self.linear2(self.dropout(self.activation(self.linear1(src))))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src if cache is None else (src, cache)
+
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src)
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer, num_layers, norm=None):
+        super().__init__()
+        from .container import LayerList
+        import copy
+        self.layers = LayerList(
+            [encoder_layer if i == 0 else _clone_layer(encoder_layer)
+             for i in range(num_layers)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None, cache=None):
+        output = src
+        new_caches = []
+        for i, mod in enumerate(self.layers):
+            if cache is None:
+                output = mod(output, src_mask)
+            else:
+                output, new_cache = mod(output, src_mask, cache[i])
+                new_caches.append(new_cache)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
+
+
+class TransformerDecoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
+                                            weight_attr=weight_attr,
+                                            bias_attr=bias_attr)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
+                                             weight_attr=weight_attr,
+                                             bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.dropout = Dropout(act_dropout)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.activation = getattr(F, activation)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm1(tgt)
+        if cache is None:
+            tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
+            incremental_cache = None
+        else:
+            tgt, incremental_cache = self.self_attn(tgt, tgt, tgt, tgt_mask,
+                                                    cache[0])
+        tgt = residual + self.dropout1(tgt)
+        if not self.normalize_before:
+            tgt = self.norm1(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm2(tgt)
+        if cache is None:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+            static_cache = None
+        else:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask, cache[1])
+            static_cache = cache[1]
+        tgt = residual + self.dropout2(tgt)
+        if not self.normalize_before:
+            tgt = self.norm2(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm3(tgt)
+        tgt = self.linear2(self.dropout(self.activation(self.linear1(tgt))))
+        tgt = residual + self.dropout3(tgt)
+        if not self.normalize_before:
+            tgt = self.norm3(tgt)
+        if cache is None:
+            return tgt
+        return tgt, (incremental_cache, static_cache)
+
+    def gen_cache(self, memory):
+        incremental = self.self_attn.gen_cache(memory)
+        static = self.cross_attn.gen_cache(memory, memory,
+                                           MultiHeadAttention.StaticCache)
+        return incremental, static
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer, num_layers, norm=None):
+        super().__init__()
+        from .container import LayerList
+        self.layers = LayerList(
+            [decoder_layer if i == 0 else _clone_layer(decoder_layer)
+             for i in range(num_layers)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        output = tgt
+        new_caches = []
+        for i, mod in enumerate(self.layers):
+            if cache is None:
+                output = mod(output, memory, tgt_mask, memory_mask)
+            else:
+                output, new_cache = mod(output, memory, tgt_mask, memory_mask,
+                                        cache[i])
+                new_caches.append(new_cache)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, memory, do_zip=False):
+        cache = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            cache = list(zip(*cache))
+        return cache
+
+
+def _clone_layer(layer):
+    """Fresh re-init clone (paddle deep-copies; we rebuild with new params)."""
+    import copy
+    new = copy.copy(layer)
+    new.__init__(**_ctor_args(layer))
+    return new
+
+
+def _ctor_args(layer):
+    if isinstance(layer, TransformerEncoderLayer):
+        return dict(d_model=layer.self_attn.embed_dim,
+                    nhead=layer.self_attn.num_heads,
+                    dim_feedforward=layer.linear1.out_features,
+                    dropout=layer.dropout1.p,
+                    activation=layer.activation.__name__,
+                    attn_dropout=layer.self_attn.dropout,
+                    act_dropout=layer.dropout.p,
+                    normalize_before=layer.normalize_before)
+    if isinstance(layer, TransformerDecoderLayer):
+        return dict(d_model=layer.self_attn.embed_dim,
+                    nhead=layer.self_attn.num_heads,
+                    dim_feedforward=layer.linear1.out_features,
+                    dropout=layer.dropout1.p,
+                    activation=layer.activation.__name__,
+                    attn_dropout=layer.self_attn.dropout,
+                    act_dropout=layer.dropout.p,
+                    normalize_before=layer.normalize_before)
+    raise TypeError(type(layer))
+
+
+class Transformer(Layer):
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            enc_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before)
+            enc_norm = LayerNorm(d_model) if normalize_before else None
+            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers,
+                                              enc_norm)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            dec_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before)
+            dec_norm = LayerNorm(d_model) if normalize_before else None
+            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers,
+                                              dec_norm)
+        self.d_model = d_model
+        self.nhead = nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask)
+        return self.decoder(tgt, memory, tgt_mask, memory_mask)
+
+    def generate_square_subsequent_mask(self, length):
+        import jax.numpy as jnp
+        mask = jnp.where(jnp.tril(jnp.ones((length, length), bool)), 0.0,
+                         -1e30).astype(jnp.float32)
+        return Tensor(mask)

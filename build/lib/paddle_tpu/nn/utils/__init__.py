@@ -1,0 +1,91 @@
+"""nn.utils: weight norm + parameter vector helpers.
+
+Reference parity: python/paddle/nn/utils/weight_norm_hook.py —
+weight_norm/remove_weight_norm reparameterize ``weight`` as
+g * v / ||v||_dim via a forward-pre-hook.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, Parameter, unwrap
+
+
+def _norm_except(v, dim):
+    """L2 norm over all axes except ``dim`` (weight_norm_hook.py norm)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def __call__(self, layer, inputs):
+        # recompute the effective weight each forward THROUGH the tape so
+        # gradients flow to g and v
+        from ... import ops
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        if self.dim is None:
+            n = ops.sqrt(ops.sum(v * v))
+        else:
+            axes = [i for i in range(len(v.shape)) if i != self.dim]
+            n = ops.sqrt(ops.sum(v * v, axis=axes, keepdim=True))
+        object.__setattr__(layer, self.name, v * (g / n))
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize layer.<name> = g * v/||v|| (weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    wv = unwrap(w)
+    g0 = np.asarray(_norm_except(wv, dim))
+    v0 = np.asarray(wv)
+    # drop the original parameter; register v and g
+    layer._parameters.pop(name, None)
+    setattr(layer, name + "_v", Parameter(jnp.asarray(v0)))
+    setattr(layer, name + "_g", Parameter(jnp.asarray(g0)))
+    hook = _WeightNormHook(name, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = handle
+    layer._weight_norm_hook = hook
+    hook(layer, None)     # materialize layer.<name> immediately
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a plain parameter (weight_norm_hook.py)."""
+    hook = getattr(layer, "_weight_norm_hook", None)
+    handle = getattr(layer, "_weight_norm_handle", None)
+    if handle is not None:
+        handle.remove()
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    vv, gv = unwrap(v), unwrap(g)
+    w = vv * (gv / jnp.maximum(_norm_except(vv, hook.dim if hook else 0),
+                               1e-12))
+    layer._parameters.pop(name + "_g", None)
+    layer._parameters.pop(name + "_v", None)
+    delattr(layer, name + "_g")
+    delattr(layer, name + "_v")
+    setattr(layer, name, Parameter(w))
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate([unwrap(p).reshape(-1)
+                                   for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    v = unwrap(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p.set_value(v[off:off + n].reshape(p.shape))
+        off += n

@@ -1,0 +1,24 @@
+"""paddle.onnx parity shim.
+
+Reference parity: python/paddle/onnx/export.py delegates to the external
+paddle2onnx package. This TPU build's portable export format is StableHLO
+via ``paddle.jit.save`` (hardware-neutral, loadable on any PJRT backend);
+``onnx.export`` performs that export and says so, rather than silently
+producing a file other tools can't read.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Exports via jit.save (StableHLO + params). Raises with guidance if
+    a true ONNX protobuf is required — paddle2onnx does not exist for this
+    runtime; StableHLO is the interchange format here."""
+    if configs.pop("require_onnx", False):
+        raise NotImplementedError(
+            "true ONNX protobuf export is not available in the TPU build; "
+            "use paddle.jit.save (StableHLO) — portable across PJRT "
+            "backends — or run paddle2onnx against a reference-paddle "
+            "checkpoint")
+    from . import jit
+    jit.save(layer, path, input_spec=input_spec, **configs)
+    return path + ".pdmodel"

@@ -1,0 +1,37 @@
+"""Op library: the TPU-native operator surface.
+
+Reference parity: the union of paddle/fluid/operators registrations surfaced
+through python/paddle/tensor/*. Importing this package patches Tensor methods
+(math_op_patch.py parity).
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import (  # noqa: F401
+    norm, cholesky, inverse, det, slogdet, matrix_power, svd, eig, eigh,
+    eigvals, eigvalsh, qr, lstsq, solve, triangular_solve, matrix_rank, pinv,
+    cond, multi_dot, cross, bincount,
+)
+# NB: control_flow.cond is deliberately NOT star-exported — the public
+# ``cond`` stays linalg's matrix condition number (reference has no top-level
+# paddle.cond; control-flow cond lives at static.nn.cond / ops.control_flow.cond)
+from .control_flow import (  # noqa: F401
+    while_loop, case, switch_case,
+    create_array, array_write, array_read, array_length,
+)
+from .math_ext import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403
+from .decode import (  # noqa: F401
+    gather_tree, beam_search_step, beam_search_decode, beam_search,
+    linear_chain_crf, crf_decoding, viterbi_decode, edit_distance,
+)
+from .linalg import cov, corrcoef  # noqa: F401
+from . import (  # noqa: F401
+    creation, math, manipulation, linalg, control_flow, math_ext, sequence,
+    detection, vision, decode,
+)
+from .patch import apply_patches as _apply_patches
+
+_apply_patches()

@@ -1,0 +1,348 @@
+"""Control-flow ops: while_loop / cond / case / switch_case.
+
+Reference parity: the control-flow op family —
+paddle/fluid/operators/controlflow/while_op.cc (sub-block body re-run until
+the condition var flips), conditional_block_op.cc (guarded sub-block),
+python/paddle/fluid/layers/control_flow.py (While :1038, while_loop :1104,
+cond :2243, case :2862, switch_case :3035).
+
+TPU-first, three execution regimes from ONE api:
+  * eager (concrete Tensors): plain Python execution — the dygraph
+    semantics; every iteration's ops land on the tape so backward works.
+  * traced (inside jit / to_static / TrainStep): lowers to lax.while_loop /
+    lax.cond — compiled, data-dependent control flow in one XLA program
+    (what while_op's CPU-side loop over a sub-block can never be). Note
+    XLA's while is not reverse-differentiable; use lax.scan-style bounded
+    loops (or eager mode) when you need grads through a loop.
+  * static Program recording: appends ONE macro op whose compiled form
+    replays the user callables over tracer-backed Tensors inside
+    lax.while_loop/lax.cond — the whole loop body fuses into the block's
+    single XLA computation.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import core
+from ..framework.tensor import Tensor
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_traced(vals) -> bool:
+    return any(isinstance(v, jax.core.Tracer) for v in jax.tree_util.
+               tree_leaves([_unwrap(v) for v in vals]))
+
+
+def _wrap_tree(arrs):
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a, stop_gradient=True), arrs)
+
+
+def _unwrap_tree(t):
+    return jax.tree_util.tree_map(
+        _unwrap, t, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _tensor_fn_to_array_fn(fn):
+    """Lift a Tensor-level callable to arrays (for lax lowering): arrays in,
+    eager-dispatch the user's ops over tracer-backed Tensors, arrays out."""
+    def run(*arrs):
+        with core.dygraph_mode_guard(), core.no_grad_guard():
+            out = fn(*_wrap_tree(list(arrs)))
+        return _unwrap_tree(out)
+    return run
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test: bool = False, name: str = None) -> List:
+    """paddle.static.nn.while_loop parity (control_flow.py:1104).
+
+    cond(*vars) -> scalar bool; body(*vars) -> new vars (same structure).
+    """
+    if not loop_vars:
+        raise ValueError("loop_vars of while_loop may not be empty")
+    loop_vars = list(loop_vars)
+
+    if core.in_static_mode():
+        return _record_while(cond, body, loop_vars)
+
+    if _is_traced(loop_vars):
+        cfn = _tensor_fn_to_array_fn(cond)
+        bfn = _tensor_fn_to_array_fn(body)
+        arrs = tuple(_unwrap(v) for v in loop_vars)
+        out = lax.while_loop(
+            lambda vs: jnp.reshape(cfn(*vs), ()),
+            lambda vs: tuple(jnp.asarray(x) for x in _as_tuple(bfn(*vs))),
+            arrs)
+        return [Tensor(o) for o in out]
+
+    # eager: dygraph semantics (every iteration on the tape)
+    while bool(_unwrap(cond(*loop_vars))):
+        out = body(*loop_vars)
+        loop_vars = list(out) if isinstance(out, (tuple, list)) else [out]
+    return loop_vars
+
+
+def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
+         name: str = None):
+    """paddle.static.nn.cond parity (control_flow.py:2243): both branches
+    must return the same structure."""
+    if core.in_static_mode():
+        return _record_cond(pred, true_fn, false_fn)
+
+    pv = _unwrap(pred)
+    if isinstance(pv, jax.core.Tracer):
+        tfn = _tensor_fn_to_array_fn(lambda: true_fn())
+        ffn = _tensor_fn_to_array_fn(lambda: false_fn())
+        out = lax.cond(jnp.reshape(pv, ()).astype(bool),
+                       lambda: _as_tuple(tfn()), lambda: _as_tuple(ffn()))
+        return _rewrap_structure(out)
+
+    return true_fn() if bool(pv) else false_fn()
+
+
+def case(pred_fn_pairs, default: Callable = None, name: str = None):
+    """fluid.layers.case parity (:2862): first true pred wins."""
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        default = pairs.pop()[1] if not callable(pairs[-1]) \
+            else (lambda: (_ for _ in ()).throw(
+                ValueError("case needs a default fn")))
+
+    def build(i):
+        if i >= len(pairs):
+            return default
+        p, fn = pairs[i]
+        return lambda: cond(p, fn, build(i + 1))
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None,
+                name: str = None):
+    """fluid.layers.switch_case parity (:3035)."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    iv = _unwrap(branch_index)
+
+    if core.in_static_mode() or isinstance(iv, jax.core.Tracer):
+        keys = jnp.asarray([k for k, _ in items], jnp.int32)
+        fns = [f for _, f in items]
+        if default is None:
+            default = fns[-1]
+        # map branch_index -> position in fns (default when no key matches)
+        def dispatch(idx_arr):
+            pos = jnp.argmax(keys == idx_arr.astype(jnp.int32))
+            matched = jnp.any(keys == idx_arr.astype(jnp.int32))
+            branch = jnp.where(matched, pos, len(fns))
+            return branch
+
+        if core.in_static_mode():
+            from ..static.program import Variable
+            # record through cond-chain (simple, serializable-enough)
+            def build(i):
+                if i >= len(items):
+                    return default
+                k, fn = items[i]
+                return lambda: cond(branch_index == k, fn, build(i + 1))
+            return build(0)()
+        afns = [(lambda f: lambda: _as_tuple(
+            _tensor_fn_to_array_fn(lambda: f())()))(f) for f in fns]
+        afns.append(lambda: _as_tuple(
+            _tensor_fn_to_array_fn(lambda: default())()))
+        out = lax.switch(dispatch(jnp.reshape(iv, ())), afns)
+        return _rewrap_structure(out)
+
+    key = int(iv)
+    for k, f in items:
+        if k == key:
+            return f()
+    if default is not None:
+        return default()
+    return items[-1][1]()
+
+
+# -- helpers -----------------------------------------------------------------
+
+def _as_tuple(x):
+    return tuple(x) if isinstance(x, (tuple, list)) else (x,)
+
+
+def _rewrap_structure(out):
+    ts = [Tensor(o) for o in out]
+    return ts[0] if len(ts) == 1 else ts
+
+
+# -- static-graph recording ---------------------------------------------------
+#
+# The user callables are traced into a SUB-BLOCK (ops appended to the current
+# block are captured and removed — the while_op.cc / conditional_block_op.cc
+# sub-block), the free Variables they close over become extra macro-op
+# inputs, and the macro's compiled form replays the captured ops inside
+# lax.while_loop / lax.cond, fusing the whole construct into the Executor's
+# single XLA computation.
+
+def _trace_sub(fn, args):
+    """Record fn(*args) under static mode, capturing the ops it appends.
+
+    Returns (ops, out_vars, free_names): free_names are Variables referenced
+    but neither produced inside nor passed as args (closure captures)."""
+    from ..static.program import current_block, Variable
+
+    block = current_block()
+    start = len(block.ops)
+    result = fn(*args)
+    ops = block.ops[start:]
+    del block.ops[start:]
+
+    out_vars = list(result) if isinstance(result, (tuple, list)) else [result]
+    for v in out_vars:
+        if not isinstance(v, Variable):
+            raise TypeError("static control-flow callables must return "
+                            f"Variables, got {type(v).__name__}")
+    arg_names = {v.name for v in args if isinstance(v, Variable)}
+    produced, free = set(), []
+    for op in ops:
+        for n in op.input_names:
+            if n not in produced and n not in arg_names and n not in free:
+                free.append(n)
+        produced.update(op.output_names)
+    return ops, out_vars, free
+
+
+def _replay(ops):
+    def run(env):
+        for op in ops:
+            ins = [env[n] for n in op.input_names]
+            outs = op.run_fn()(*ins)
+            env.update(zip(op.output_names, outs))
+        return env
+    return run
+
+
+def _record_while(cond, body, loop_vars):
+    from ..static.program import current_block, Operator, Variable
+
+    block = current_block()
+    for v in loop_vars:
+        if not isinstance(v, Variable):
+            raise TypeError("while_loop loop_vars must be Variables in "
+                            "static mode")
+    cond_ops, cond_outs, cond_free = _trace_sub(cond, loop_vars)
+    body_ops, body_outs, body_free = _trace_sub(body, loop_vars)
+    if len(body_outs) != len(loop_vars):
+        raise ValueError(f"body returns {len(body_outs)} vars, expected "
+                         f"{len(loop_vars)}")
+    free = cond_free + [n for n in body_free if n not in cond_free]
+    names = [v.name for v in loop_vars]
+    cond_name = cond_outs[0].name
+    body_names = [v.name for v in body_outs]
+    run_cond, run_body = _replay(cond_ops), _replay(body_ops)
+    outs = [block.create_var(shape=v.shape, dtype=v.dtype)
+            for v in body_outs]
+
+    def macro_fn(*arrs):
+        k = len(names)
+        closure = dict(zip(free, arrs[k:]))
+
+        def c(vs):
+            env = dict(closure)
+            env.update(zip(names, vs))
+            return jnp.reshape(run_cond(env)[cond_name], ()).astype(bool)
+
+        def b(vs):
+            env = dict(closure)
+            env.update(zip(names, vs))
+            env = run_body(env)
+            return tuple(env[n] for n in body_names)
+
+        return lax.while_loop(c, b, tuple(arrs[:k]))
+
+    op = Operator(block, prim="@while", inputs=names + free,
+                  outputs=[o.name for o in outs], attrs={}, fn=macro_fn,
+                  type_name="while")
+    block.ops.append(op)
+    block.program._version += 1
+    for o in outs:
+        o.op = op
+    return outs
+
+
+def _record_cond(pred, true_fn, false_fn):
+    from ..static.program import current_block, Operator, Variable
+
+    block = current_block()
+    if not isinstance(pred, Variable):
+        raise TypeError("cond pred must be a Variable in static mode")
+    t_ops, t_outs, t_free = _trace_sub(lambda: true_fn(), ())
+    f_ops, f_outs, f_free = _trace_sub(lambda: false_fn(), ())
+    if len(t_outs) != len(f_outs):
+        raise ValueError("cond branches must return the same structure")
+    free = t_free + [n for n in f_free if n not in t_free]
+    t_names = [v.name for v in t_outs]
+    f_names = [v.name for v in f_outs]
+    run_t, run_f = _replay(t_ops), _replay(f_ops)
+    outs = [block.create_var(shape=v.shape, dtype=v.dtype) for v in t_outs]
+
+    def macro_fn(p, *arrs):
+        closure = dict(zip(free, arrs))
+        return lax.cond(
+            jnp.reshape(p, ()).astype(bool),
+            lambda: tuple(run_t(dict(closure))[n] for n in t_names),
+            lambda: tuple(run_f(dict(closure))[n] for n in f_names))
+
+    op = Operator(block, prim="@cond", inputs=[pred.name] + free,
+                  outputs=[o.name for o in outs], attrs={}, fn=macro_fn,
+                  type_name="conditional_block")
+    block.ops.append(op)
+    block.program._version += 1
+    for o in outs:
+        o.op = op
+    return outs[0] if len(outs) == 1 else outs
+
+
+# -- TensorArray DSL (fluid/layers/control_flow.py array ops) -----------------
+
+class TensorArray(list):
+    """LoDTensorArray stand-in: a Python list of Tensors in eager mode; the
+    static path records writes/reads as ops over the same object
+    (lod_tensor_array / array_write_op, array_read_op)."""
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """fluid.layers.create_array parity."""
+    arr = TensorArray()
+    if initialized_list:
+        arr.extend(initialized_list)
+    return arr
+
+
+def array_write(x, i, array=None):
+    """array_write_op: array[i] = x (grows the array as needed)."""
+    if array is None:
+        array = create_array()
+    idx = int(_unwrap(i))
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    """array_read_op: array[i]."""
+    return array[int(_unwrap(i))]
+
+
+def array_length(array):
+    """lod_array_length_op."""
+    from ..framework.tensor import Tensor
+    return Tensor(jnp.asarray(len(array), jnp.int64))

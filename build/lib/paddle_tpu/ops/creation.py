@@ -1,0 +1,222 @@
+"""Tensor creation ops.
+
+Reference parity: fill_constant / gaussian_random / uniform_random / range /
+eye / linspace operators (paddle/fluid/operators/fill_constant_op.cc,
+gaussian_random_op.cc, uniform_random_op.cc) and the Python creation API
+(python/paddle/tensor/creation.py, python/paddle/tensor/random.py).
+No gradients flow through creation, so these bypass the tape.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import convert_dtype, get_default_dtype, index_dtype as _idt
+from ..framework.random import default_generator
+from ..framework.tensor import Tensor, to_tensor, unwrap
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        shape = [shape]
+    return tuple(int(unwrap(s) if not isinstance(s, (int, np.integer)) else s)
+                 for s in shape)
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else get_default_dtype()
+    return convert_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = unwrap(fill_value)
+    if dtype is None:
+        return Tensor(jnp.full(_shape(shape), fill_value))
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(unwrap(x), dtype=_dt(dtype, jnp.asarray(unwrap(x)).dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(unwrap(x), dtype=_dt(dtype, jnp.asarray(unwrap(x)).dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(unwrap(x), unwrap(fill_value),
+                                dtype=_dt(dtype, jnp.asarray(unwrap(x)).dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        py = (start, end, step)
+        dtype = "int64" if all(isinstance(v, (int, np.integer)) for v in py) \
+            else get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(num),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(num),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = unwrap(x)
+    if jnp.ndim(x) == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0:
+            mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+            out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+        return Tensor(out)
+    return Tensor(jnp.diag(x, k=offset))
+
+
+def diagflat(x, offset=0, name=None):
+    return Tensor(jnp.diagflat(unwrap(x), k=offset))
+
+
+def meshgrid(*args, **kwargs):
+    arrays = [unwrap(a) for a in (args[0] if len(args) == 1 and
+                                  isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(m) for m in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+def tril(x, diagonal=0, name=None):
+    return Tensor(jnp.tril(unwrap(x), k=diagonal))
+
+
+def triu(x, diagonal=0, name=None):
+    return Tensor(jnp.triu(unwrap(x), k=diagonal))
+
+
+def clone(x, name=None):
+    from .math import assign
+    return assign(x)
+
+
+# ---- random ------------------------------------------------------------------
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else default_generator.next_key()
+    dt = _dt(dtype)
+    return Tensor(jax.random.uniform(key, _shape(shape), dt,
+                                     jnp.asarray(min, dt), jnp.asarray(max, dt)))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    key = default_generator.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = unwrap(mean), unwrap(std)
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        key = default_generator.next_key()
+        return Tensor(jax.random.normal(key, shp, get_default_dtype()) * s + m)
+    key = default_generator.next_key()
+    out = jax.random.normal(key, _shape(shape or [1]), get_default_dtype())
+    return Tensor(out * std + mean)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = default_generator.next_key()
+    return Tensor(jax.random.randint(key, _shape(shape), int(low), int(high),
+                                     _dt(dtype, _idt())))
+
+
+def randperm(n, dtype="int64", name=None):
+    key = default_generator.next_key()
+    return Tensor(jax.random.permutation(key, int(n)).astype(_dt(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = unwrap(x)
+    key = default_generator.next_key()
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(*x.shape[:-1], num_samples))
+    else:
+        keys = jax.random.split(key, 1)[0]
+        g = jax.random.gumbel(keys, x.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(_idt()))
+
+
+def bernoulli(x, name=None):
+    x = unwrap(x)
+    key = default_generator.next_key()
+    return Tensor(jax.random.bernoulli(key, x).astype(x.dtype))
+
+
+def poisson(x, name=None):
+    """poisson_op parity: elementwise Poisson(lambda=x) samples."""
+    x = unwrap(x)
+    key = default_generator.next_key()
+    return Tensor(jax.random.poisson(key, x).astype(x.dtype))
+
+
+def standard_gamma(x, name=None):
+    """standard_gamma parity: elementwise Gamma(alpha=x, 1) samples."""
+    x = unwrap(x)
+    key = default_generator.next_key()
+    return Tensor(jax.random.gamma(key, x).astype(x.dtype))
+
+
+def binomial(count, prob, name=None):
+    """binomial parity: Binomial(count, prob) samples."""
+    c = unwrap(count)
+    p = unwrap(prob)
+    key = default_generator.next_key()
+    return Tensor(jax.random.binomial(key, c, p).astype(_idt()))
+
+
+def assign_value(shape, dtype, values):
+    return Tensor(jnp.asarray(np.array(values).reshape(shape),
+                              dtype=convert_dtype(dtype)))

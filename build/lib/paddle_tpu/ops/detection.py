@@ -1,0 +1,1062 @@
+"""Detection ops: boxes, anchors, ROI pooling, NMS, YOLO decoding.
+
+Reference parity: paddle/fluid/operators/detection/ — yolo_box_op.cc,
+roi_align_op.cc, roi_pool_op (fluid/operators/roi_pool_op.cc),
+prior_box_op.cc, anchor_generator_op.cc, box_coder_op.cc,
+iou_similarity_op.cc, box_clip_op.cc, multiclass_nms_op.cc and the
+python/paddle/fluid/layers/detection.py DSL.
+
+TPU-first: everything is a fixed-shape vectorized expression.  NMS — the
+classically "dynamic" op — runs as a fixed-iteration suppression matrix
+(scores sorted once, O(N^2) IoU mask, sequential argmax via lax.scan over a
+static box budget), returning a keep-mask + indices instead of a
+dynamically-sized list; callers slice by the returned count.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.primitive import Primitive
+from ..framework.tensor import Tensor, unwrap
+
+
+# -- IoU / box utilities ------------------------------------------------------
+
+def _iou_matrix(a, b):
+    """[N,4] x [M,4] (xyxy) -> [N,M] IoU (iou_similarity_op.h)."""
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+_iou_similarity = Primitive("iou_similarity", _iou_matrix)
+
+
+def iou_similarity(x, y, name=None):
+    return _iou_similarity(x, y)
+
+
+def _box_clip_fn(boxes, im_h=1.0, im_w=1.0):
+    return jnp.stack([
+        jnp.clip(boxes[..., 0], 0, im_w), jnp.clip(boxes[..., 1], 0, im_h),
+        jnp.clip(boxes[..., 2], 0, im_w), jnp.clip(boxes[..., 3], 0, im_h),
+    ], axis=-1)
+
+
+_box_clip = Primitive("box_clip", _box_clip_fn)
+
+
+def box_clip(boxes, im_shape, name=None):
+    import numpy as np
+    hw = np.asarray(unwrap(im_shape)).reshape(-1)
+    return _box_clip(boxes, im_h=float(hw[0]), im_w=float(hw[1]))
+
+
+def _box_coder_fn(prior, prior_var, target, code_type="encode_center_size",
+                  box_normalized=True):
+    """box_coder_op.cc: encode target vs prior anchors (or decode deltas)."""
+    pw = prior[:, 2] - prior[:, 0] + (0.0 if box_normalized else 1.0)
+    ph = prior[:, 3] - prior[:, 1] + (0.0 if box_normalized else 1.0)
+    px = prior[:, 0] + pw * 0.5
+    py = prior[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + (0.0 if box_normalized else 1.0)
+        th = target[:, 3] - target[:, 1] + (0.0 if box_normalized else 1.0)
+        tx = target[:, 0] + tw * 0.5
+        ty = target[:, 1] + th * 0.5
+        out = jnp.stack([(tx - px) / pw, (ty - py) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=-1)
+        return out / prior_var
+    # decode: target holds deltas
+    d = target * prior_var
+    cx = d[:, 0] * pw + px
+    cy = d[:, 1] * ph + py
+    w = jnp.exp(d[:, 2]) * pw
+    h = jnp.exp(d[:, 3]) * ph
+    sub = 0.0 if box_normalized else 1.0
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - sub, cy + h * 0.5 - sub], axis=-1)
+
+
+_box_coder = Primitive("box_coder", _box_coder_fn)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None):
+    return _box_coder(prior_box, prior_box_var, target_box,
+                      code_type=code_type, box_normalized=bool(box_normalized))
+
+
+# -- anchors ------------------------------------------------------------------
+
+def _prior_box_fn(feat_h, feat_w, im_h, im_w, min_sizes=(), max_sizes=(),
+                  aspect_ratios=(1.0,), step_h=0.0, step_w=0.0, offset=0.5,
+                  clip=False, flip=True):
+    """prior_box_op.cc: SSD priors per feature-map cell."""
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if abs(ar - 1.0) > 1e-6:
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    sh = step_h or im_h / feat_h
+    sw = step_w or im_w / feat_w
+    cy = (jnp.arange(feat_h) + offset) * sh
+    cx = (jnp.arange(feat_w) + offset) * sw
+    boxes = []
+    # prior_box_op.h pairs min_sizes[i] with max_sizes[i] (not a cross
+    # product): per min size, the AR variants then one sqrt(min*max) square
+    for i, ms in enumerate(min_sizes):
+        for ar in ars:
+            w, h = ms * (ar ** 0.5), ms / (ar ** 0.5)
+            boxes.append((w, h))
+        if i < len(max_sizes):
+            s = (ms * max_sizes[i]) ** 0.5
+            boxes.append((s, s))
+    wh = jnp.asarray(boxes, jnp.float32)  # [A, 2]
+    grid_y, grid_x = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([grid_x, grid_y], -1)[:, :, None, :]  # [H,W,1,2]
+    half = wh[None, None] * 0.5
+    out = jnp.concatenate([centers - half, centers + half], -1)  # [H,W,A,4]
+    out = out / jnp.asarray([im_w, im_h, im_w, im_h], jnp.float32)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+_prior_box = Primitive("prior_box", _prior_box_fn, differentiable=False)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              steps=(0.0, 0.0), offset=0.5, clip=False, flip=True, name=None):
+    ih, iw = unwrap(image).shape[-2:]
+    fh, fw = unwrap(input).shape[-2:]
+    return _prior_box(feat_h=int(fh), feat_w=int(fw), im_h=float(ih),
+                      im_w=float(iw), min_sizes=tuple(min_sizes),
+                      max_sizes=tuple(max_sizes or ()),
+                      aspect_ratios=tuple(aspect_ratios),
+                      step_h=float(steps[1]), step_w=float(steps[0]),
+                      offset=float(offset), clip=bool(clip), flip=bool(flip))
+
+
+def _anchor_generator_fn(feat_h, feat_w, anchor_sizes=(64.0,),
+                         aspect_ratios=(1.0,), stride=(16.0, 16.0),
+                         offset=0.5):
+    """anchor_generator_op.cc (RPN anchors, absolute pixels)."""
+    boxes = []
+    for s in anchor_sizes:
+        for ar in aspect_ratios:
+            area = float(s) * float(s)
+            w = (area / ar) ** 0.5
+            h = w * ar
+            boxes.append((w, h))
+    wh = jnp.asarray(boxes, jnp.float32)
+    cx = (jnp.arange(feat_w) + offset) * stride[0]
+    cy = (jnp.arange(feat_h) + offset) * stride[1]
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([gx, gy], -1)[:, :, None, :]
+    half = wh[None, None] * 0.5
+    return jnp.concatenate([centers - half, centers + half], -1)
+
+
+_anchor_generator = Primitive("anchor_generator", _anchor_generator_fn,
+                              differentiable=False)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, stride,
+                     offset=0.5, name=None):
+    fh, fw = unwrap(input).shape[-2:]
+    return _anchor_generator(feat_h=int(fh), feat_w=int(fw),
+                             anchor_sizes=tuple(float(s) for s in anchor_sizes),
+                             aspect_ratios=tuple(float(a) for a in aspect_ratios),
+                             stride=tuple(float(s) for s in stride),
+                             offset=float(offset))
+
+
+# -- ROI ops ------------------------------------------------------------------
+
+def _roi_align_fn(x, rois, roi_batch_idx, pooled_h=1, pooled_w=1,
+                  spatial_scale=1.0, sampling_ratio=-1, aligned=False):
+    """roi_align_op.cc: bilinear-sampled average pooling per ROI.
+
+    x: [N,C,H,W]; rois: [R,4] xyxy; roi_batch_idx: [R] image index."""
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    off = 0.5 if aligned else 0.0
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    x1 = rois[:, 0] * spatial_scale - off
+    y1 = rois[:, 1] * spatial_scale - off
+    x2 = rois[:, 2] * spatial_scale - off
+    y2 = rois[:, 3] * spatial_scale - off
+    rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+    rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+    bin_w = rw / pooled_w
+    bin_h = rh / pooled_h
+
+    # sample grid: [R, ph, pw, sr, sr, 2]
+    py = jnp.arange(pooled_h)
+    px = jnp.arange(pooled_w)
+    sy = (jnp.arange(sr) + 0.5) / sr
+    sx = (jnp.arange(sr) + 0.5) / sr
+    yy = y1[:, None, None] + (py[None, :, None] + sy[None, None, :]) * \
+        bin_h[:, None, None]                      # [R, ph, sr]
+    xx = x1[:, None, None] + (px[None, :, None] + sx[None, None, :]) * \
+        bin_w[:, None, None]                      # [R, pw, sr]
+
+    def bilinear(img, ys, xs):
+        # img [C,H,W]; ys [ph,sr]; xs [pw,sr] -> [C,ph,pw]
+        y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+        y1i = jnp.clip(y0 + 1, 0, H - 1)
+        x1i = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(ys, 0, H - 1) - y0
+        wx = jnp.clip(xs, 0, W - 1) - x0
+        y0 = y0.astype(jnp.int32)
+        y1i = y1i.astype(jnp.int32)
+        x0 = x0.astype(jnp.int32)
+        x1i = x1i.astype(jnp.int32)
+
+        v00 = img[:, y0[:, :, None, None], x0[None, None, :, :]]
+        v01 = img[:, y0[:, :, None, None], x1i[None, None, :, :]]
+        v10 = img[:, y1i[:, :, None, None], x0[None, None, :, :]]
+        v11 = img[:, y1i[:, :, None, None], x1i[None, None, :, :]]
+        wy_ = wy[:, :, None, None]
+        wx_ = wx[None, None, :, :]
+        val = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_ +
+               v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)  # [C,ph,sr,pw,sr]
+        return val.mean(axis=(2, 4))
+
+    def per_roi(r):
+        img = x[roi_batch_idx[r]]
+        return bilinear(img, yy[r], xx[r])
+
+    return jax.vmap(per_roi)(jnp.arange(R))  # [R, C, ph, pw]
+
+
+def _roi_pool_fn(x, rois, roi_batch_idx, pooled_h=1, pooled_w=1,
+                 spatial_scale=1.0):
+    """roi_pool_op.cc: max pooling over quantized ROI bins."""
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    x1 = jnp.round(rois[:, 0] * spatial_scale)
+    y1 = jnp.round(rois[:, 1] * spatial_scale)
+    x2 = jnp.round(rois[:, 2] * spatial_scale)
+    y2 = jnp.round(rois[:, 3] * spatial_scale)
+    rw = jnp.maximum(x2 - x1 + 1, 1.0)
+    rh = jnp.maximum(y2 - y1 + 1, 1.0)
+
+    hs = jnp.arange(H, dtype=jnp.float32)
+    ws = jnp.arange(W, dtype=jnp.float32)
+
+    def per_roi(r):
+        img = x[roi_batch_idx[r]]  # [C,H,W]
+        bh = rh[r] / pooled_h
+        bw = rw[r] / pooled_w
+
+        def bin_val(py, px):
+            hstart = jnp.floor(py * bh) + y1[r]
+            hend = jnp.ceil((py + 1) * bh) + y1[r]
+            wstart = jnp.floor(px * bw) + x1[r]
+            wend = jnp.ceil((px + 1) * bw) + x1[r]
+            mh = (hs >= hstart) & (hs < hend)
+            mw = (ws >= wstart) & (ws < wend)
+            m = mh[:, None] & mw[None, :]
+            empty = ~jnp.any(m)
+            v = jnp.max(jnp.where(m[None], img, -jnp.inf), axis=(1, 2))
+            return jnp.where(empty, 0.0, v)
+
+        py = jnp.arange(pooled_h)
+        px = jnp.arange(pooled_w)
+        vals = jax.vmap(lambda a: jax.vmap(lambda b: bin_val(a, b))(px))(py)
+        return jnp.transpose(vals, (2, 0, 1))  # [C, ph, pw]
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+_roi_align = Primitive("roi_align", _roi_align_fn)
+_roi_pool = Primitive("roi_pool", _roi_pool_fn)
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    ph, pw = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    bidx = _batch_index(boxes, boxes_num, unwrap(x).shape[0])
+    return _roi_align(x, boxes, bidx, pooled_h=int(ph), pooled_w=int(pw),
+                      spatial_scale=float(spatial_scale),
+                      sampling_ratio=int(sampling_ratio),
+                      aligned=bool(aligned))
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+             name=None):
+    ph, pw = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    bidx = _batch_index(boxes, boxes_num, unwrap(x).shape[0])
+    return _roi_pool(x, boxes, bidx, pooled_h=int(ph), pooled_w=int(pw),
+                     spatial_scale=float(spatial_scale))
+
+
+def _batch_index(boxes, boxes_num, n_images):
+    import numpy as np
+    R = unwrap(boxes).shape[0]
+    if boxes_num is None:
+        return jnp.zeros((R,), jnp.int32)
+    counts = np.asarray(unwrap(boxes_num)).ravel()
+    return jnp.asarray(np.repeat(np.arange(len(counts)), counts)
+                       .astype(np.int32))
+
+
+# -- YOLO ---------------------------------------------------------------------
+
+def _yolo_box_fn(x, img_size, anchors=(), class_num=1, conf_thresh=0.01,
+                 downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """yolo_box_op.cc: decode a YOLOv3 head to boxes+scores.
+
+    x: [N, A*(5+C), H, W]; returns (boxes [N, A*H*W, 4],
+    scores [N, A*H*W, C])."""
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    C = class_num
+    x = x.reshape(N, A, 5 + C, H, W)
+    grid_x = jnp.arange(W, dtype=jnp.float32)
+    grid_y = jnp.arange(H, dtype=jnp.float32)
+    anchors_wh = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+
+    sx = jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+    sy = jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+    bx = (grid_x[None, None, None, :] + sx) / W
+    by = (grid_y[None, None, :, None] + sy) / H
+    bw = jnp.exp(x[:, :, 2]) * anchors_wh[None, :, 0, None, None] / \
+        (W * downsample_ratio)
+    bh = jnp.exp(x[:, :, 3]) * anchors_wh[None, :, 1, None, None] / \
+        (H * downsample_ratio)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    probs = jnp.where(conf[:, :, None] < conf_thresh, 0.0, probs)
+
+    im_h = img_size[:, 0].astype(jnp.float32)
+    im_w = img_size[:, 1].astype(jnp.float32)
+    x1 = (bx - bw / 2) * im_w[:, None, None, None]
+    y1 = (by - bh / 2) * im_h[:, None, None, None]
+    x2 = (bx + bw / 2) * im_w[:, None, None, None]
+    y2 = (by + bh / 2) * im_h[:, None, None, None]
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, im_w[:, None, None, None] - 1)
+        y1 = jnp.clip(y1, 0, im_h[:, None, None, None] - 1)
+        x2 = jnp.clip(x2, 0, im_w[:, None, None, None] - 1)
+        y2 = jnp.clip(y2, 0, im_h[:, None, None, None] - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+    scores = jnp.moveaxis(probs, 2, -1).reshape(N, -1, C)
+    return boxes, scores
+
+
+_yolo_box = Primitive("yolo_box", _yolo_box_fn, multi_output=True)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0, name=None):
+    return _yolo_box(x, img_size, anchors=tuple(int(a) for a in anchors),
+                     class_num=int(class_num), conf_thresh=float(conf_thresh),
+                     downsample_ratio=int(downsample_ratio),
+                     clip_bbox=bool(clip_bbox), scale_x_y=float(scale_x_y))
+
+
+# -- NMS ----------------------------------------------------------------------
+
+def _nms_fn(boxes, scores, iou_threshold=0.3, top_k=-1):
+    """Fixed-shape greedy NMS: returns (keep_idx [N] score-ordered with
+    suppressed slots = -1, num_kept scalar).  multiclass_nms_op.cc's
+    dynamic output list becomes (indices, count) — the TPU idiom."""
+    N = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    iou = _iou_matrix(b, b)
+
+    def body(keep_mask, i):
+        # i is suppressed if any higher-scored KEPT box overlaps too much
+        prior = (jnp.arange(N) < i) & keep_mask
+        sup = jnp.any(prior & (iou[i] > iou_threshold))
+        keep_mask = keep_mask.at[i].set(~sup)
+        return keep_mask, None
+
+    keep0 = jnp.ones((N,), bool)
+    keep_mask, _ = lax.scan(body, keep0, jnp.arange(N))
+    if top_k > 0:
+        ranks = jnp.cumsum(keep_mask) - 1
+        keep_mask = keep_mask & (ranks < top_k)
+    kept_sorted = jnp.where(keep_mask, order, -1)
+    return kept_sorted, jnp.sum(keep_mask.astype(jnp.int32))
+
+
+_nms = Primitive("nms", _nms_fn, multi_output=True, differentiable=False)
+
+
+def nms(boxes, scores=None, iou_threshold=0.3, top_k=-1, name=None):
+    import numpy as np
+    if scores is None:
+        scores = Tensor(jnp.arange(unwrap(boxes).shape[0], 0, -1,
+                                   dtype=jnp.float32))
+    idx, n = _nms(boxes, scores, iou_threshold=float(iou_threshold),
+                  top_k=int(top_k))
+    # paddle's nms returns the kept indices; compact on host (eager op)
+    iv = np.asarray(unwrap(idx))
+    return Tensor(jnp.asarray(iv[iv >= 0][: int(n)]))
+
+
+def bipartite_match(dist_matrix, name=None):
+    """bipartite_match_op.cc greedy max matching (host-side; not a hot op)."""
+    import numpy as np
+    d = np.asarray(unwrap(dist_matrix)).copy()
+    R, C = d.shape
+    match_idx = -np.ones(C, np.int64)
+    match_dist = np.zeros(C, np.float32)
+    for _ in range(min(R, C)):
+        r, c = np.unravel_index(np.argmax(d), d.shape)
+        if d[r, c] <= 0:
+            break
+        match_idx[c] = r
+        match_dist[c] = d[r, c]
+        d[r, :] = -1
+        d[:, c] = -1
+    return Tensor(jnp.asarray(match_idx)), Tensor(jnp.asarray(match_dist))
+
+
+# -- matrix NMS ----------------------------------------------------------------
+
+def _matrix_nms_fn(boxes, scores, score_threshold=0.05, post_threshold=0.0,
+                   nms_top_k=400, keep_top_k=100, use_gaussian=False,
+                   gaussian_sigma=2.0, background_label=-1):
+    """matrix_nms_op.cc: decay-based parallel NMS (SOLOv2). Unlike greedy
+    NMS this is already a fixed-shape tensor program — the one NMS variant
+    whose reference algorithm IS the TPU algorithm. scores [C, N],
+    boxes [N, 4]. Returns (out [keep, 6] = (class, score, box), index
+    [keep], count)."""
+    C, N = scores.shape
+    if background_label >= 0:
+        scores = scores.at[background_label].set(0.0)
+    flat_scores = scores.reshape(-1)
+    flat_scores = jnp.where(flat_scores > score_threshold, flat_scores, 0.0)
+    k = min(nms_top_k if nms_top_k > 0 else C * N, C * N)
+    top_s, top_i = lax.top_k(flat_scores, k)
+    cls = (top_i // N).astype(jnp.int32)
+    box_i = top_i % N
+    b = boxes[box_i]
+    iou = _iou_matrix(b, b)                                  # [k, k]
+    same_cls = cls[:, None] == cls[None, :]
+    higher = jnp.arange(k)[:, None] > jnp.arange(k)[None, :]  # j scored higher
+    ious = jnp.where(same_cls & higher, iou, 0.0)
+    max_iou = jnp.max(ious, axis=1)                          # per-candidate
+    # decay_j = min over higher-scored i of f(iou_ij)/f(max_iou_i)
+    if use_gaussian:
+        # decay_score<T, true>: exp((max_iou^2 - iou^2) * sigma)
+        decay = jnp.exp((max_iou[None, :] ** 2 - ious ** 2) * gaussian_sigma)
+    else:
+        decay = (1.0 - ious) / (1.0 - max_iou[None, :])
+    decay = jnp.where(same_cls & higher, decay, 1.0)
+    decay = jnp.min(decay, axis=1)
+    new_scores = top_s * decay
+    new_scores = jnp.where(new_scores >= post_threshold, new_scores, 0.0)
+    kk = min(keep_top_k if keep_top_k > 0 else k, k)
+    fin_s, fin_i = lax.top_k(new_scores, kk)
+    out = jnp.concatenate([cls[fin_i, None].astype(b.dtype),
+                           fin_s[:, None], b[fin_i]], axis=1)
+    return out, box_i[fin_i], jnp.sum((fin_s > 0).astype(jnp.int32))
+
+
+_matrix_nms = Primitive("matrix_nms", _matrix_nms_fn, multi_output=True,
+                        differentiable=False)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Batched matrix NMS. bboxes [B, N, 4], scores [B, C, N]."""
+    bv, sv = unwrap(bboxes), unwrap(scores)
+    outs, idxs, nums = [], [], []
+    for i in range(bv.shape[0]):
+        o, ix, n = _matrix_nms(
+            Tensor(bv[i]), Tensor(sv[i]),
+            score_threshold=float(score_threshold),
+            post_threshold=float(post_threshold), nms_top_k=int(nms_top_k),
+            keep_top_k=int(keep_top_k), use_gaussian=bool(use_gaussian),
+            gaussian_sigma=float(gaussian_sigma),
+            background_label=int(background_label))
+        outs.append(unwrap(o))
+        idxs.append(unwrap(ix))
+        nums.append(unwrap(n))
+    out = Tensor(jnp.concatenate(outs))
+    nums_t = Tensor(jnp.stack(nums))
+    if return_index:
+        return (out, Tensor(jnp.concatenate(idxs)), nums_t) \
+            if return_rois_num else (out, Tensor(jnp.concatenate(idxs)))
+    return (out, nums_t) if return_rois_num else out
+
+
+# -- multiclass NMS ------------------------------------------------------------
+
+def _multiclass_nms_fn(boxes, scores, score_threshold=0.05, nms_top_k=400,
+                       keep_top_k=100, iou_threshold=0.3,
+                       background_label=-1):
+    """multiclass_nms_op.cc for one image: per-class greedy NMS then global
+    keep_top_k. boxes [N, 4], scores [C, N]. Fixed-shape output
+    [keep_top_k, 6] with count; empty slots are -1."""
+    C, N = scores.shape
+
+    def per_class(c):
+        s = jnp.where(scores[c] > score_threshold, scores[c], 0.0)
+        keep_idx, _ = _nms_fn(boxes, s, iou_threshold=iou_threshold,
+                              top_k=nms_top_k)
+        kept = keep_idx >= 0
+        safe = jnp.maximum(keep_idx, 0)
+        cls_scores = jnp.where(kept & (s[safe] > 0), s[safe], 0.0)
+        return cls_scores, safe
+
+    cs, si = jax.vmap(per_class)(jnp.arange(C))            # [C, N]
+    if background_label >= 0:
+        cs = cs.at[background_label].set(0.0)
+    flat = cs.reshape(-1)
+    k = min(keep_top_k if keep_top_k > 0 else C * N, C * N)
+    top_s, top_i = lax.top_k(flat, k)
+    cls = (top_i // N).astype(boxes.dtype)
+    bidx = si.reshape(-1)[top_i]
+    out = jnp.concatenate([cls[:, None], top_s[:, None], boxes[bidx]],
+                          axis=1)
+    valid = top_s > 0
+    out = jnp.where(valid[:, None], out, -1.0)
+    return out, jnp.where(valid, bidx, -1), \
+        jnp.sum(valid.astype(jnp.int32))
+
+
+_multiclass_nms = Primitive("multiclass_nms", _multiclass_nms_fn,
+                            multi_output=True, differentiable=False)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=-1, return_index=False,
+                   return_rois_num=True, name=None):
+    """Batched multiclass NMS. bboxes [B, N, 4], scores [B, C, N]."""
+    bv, sv = unwrap(bboxes), unwrap(scores)
+    outs, idxs, nums = [], [], []
+    for i in range(bv.shape[0]):
+        o, ix, n = _multiclass_nms(
+            Tensor(bv[i]), Tensor(sv[i]),
+            score_threshold=float(score_threshold),
+            nms_top_k=int(nms_top_k), keep_top_k=int(keep_top_k),
+            iou_threshold=float(nms_threshold),
+            background_label=int(background_label))
+        outs.append(unwrap(o))
+        idxs.append(unwrap(ix))
+        nums.append(unwrap(n))
+    out = Tensor(jnp.concatenate(outs))
+    nums_t = Tensor(jnp.stack(nums))
+    if return_index:
+        return (out, Tensor(jnp.concatenate(idxs)), nums_t) \
+            if return_rois_num else (out, Tensor(jnp.concatenate(idxs)))
+    return (out, nums_t) if return_rois_num else out
+
+
+# -- RPN proposals -------------------------------------------------------------
+
+def _generate_proposals_fn(scores, deltas, anchors, variances, im_h, im_w,
+                           pre_nms_top_n=6000, post_nms_top_n=1000,
+                           nms_thresh=0.5, min_size=0.1):
+    """generate_proposals_op.cc for one image, fixed-shape. scores [A*H*W],
+    deltas [A*H*W, 4], anchors [A*H*W, 4] (xyxy), variances same shape.
+    Returns (rois [post, 4], roi_probs [post], count)."""
+    n = scores.shape[0]
+    k = min(pre_nms_top_n, n)
+    top_s, top_i = lax.top_k(scores, k)
+    a = anchors[top_i]
+    v = variances[top_i]
+    d = deltas[top_i]
+    # decode (box_coder decode_center_size with variances)
+    aw = a[:, 2] - a[:, 0] + 1.0
+    ah = a[:, 3] - a[:, 1] + 1.0
+    acx = a[:, 0] + aw * 0.5
+    acy = a[:, 1] + ah * 0.5
+    cx = v[:, 0] * d[:, 0] * aw + acx
+    cy = v[:, 1] * d[:, 1] * ah + acy
+    w = jnp.exp(jnp.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+    h = jnp.exp(jnp.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+    boxes = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                       cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], axis=1)
+    # clip to image
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_w - 1),
+                       jnp.clip(boxes[:, 1], 0, im_h - 1),
+                       jnp.clip(boxes[:, 2], 0, im_w - 1),
+                       jnp.clip(boxes[:, 3], 0, im_h - 1)], axis=1)
+    # filter small boxes by zeroing their scores
+    bw = boxes[:, 2] - boxes[:, 0] + 1.0
+    bh = boxes[:, 3] - boxes[:, 1] + 1.0
+    ok = (bw >= min_size) & (bh >= min_size)
+    s = jnp.where(ok, top_s, 0.0)
+    keep_idx, cnt = _nms_fn(boxes, s, iou_threshold=nms_thresh,
+                            top_k=post_nms_top_n)
+    kept = keep_idx >= 0
+    safe = jnp.maximum(keep_idx, 0)
+    # compact: suppressed slots are -1 holes in score order; top_k over the
+    # masked scores pulls the kept ones to the front (order-preserving,
+    # since s is already sorted descending)
+    masked = jnp.where(kept, s[safe], -jnp.inf)
+    top_keep, pos = lax.top_k(masked, min(post_nms_top_n, masked.shape[0]))
+    sel = safe[pos]
+    valid = jnp.isfinite(top_keep) & (top_keep > 0)
+    rois = jnp.where(valid[:, None], boxes[sel], 0.0)
+    probs = jnp.where(valid, top_keep, 0.0)
+    return rois, probs, jnp.sum(valid.astype(jnp.int32))
+
+
+_generate_proposals = Primitive("generate_proposals", _generate_proposals_fn,
+                                multi_output=True, differentiable=False)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation (generate_proposals_op.cc / v2).
+
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; img_size [N, 2] (h, w);
+    anchors [H, W, A, 4]; variances [H, W, A, 4].
+    """
+    sv, dv = unwrap(scores), unwrap(bbox_deltas)
+    av, vv = unwrap(anchors), unwrap(variances)
+    im = unwrap(img_size)
+    N, A, H, W = sv.shape
+    rois, probs, nums = [], [], []
+    a_flat = av.reshape(-1, 4)
+    v_flat = vv.reshape(-1, 4)
+    for i in range(N):
+        s_i = jnp.transpose(sv[i], (1, 2, 0)).reshape(-1)        # HWA
+        d_i = jnp.transpose(dv[i].reshape(A, 4, H, W),
+                            (2, 3, 0, 1)).reshape(-1, 4)
+        r, p, c = _generate_proposals(
+            Tensor(s_i), Tensor(d_i), Tensor(a_flat), Tensor(v_flat),
+            Tensor(im[i, 0]), Tensor(im[i, 1]),
+            pre_nms_top_n=int(pre_nms_top_n),
+            post_nms_top_n=int(post_nms_top_n),
+            nms_thresh=float(nms_thresh), min_size=float(min_size))
+        rois.append(unwrap(r))
+        probs.append(unwrap(p))
+        nums.append(unwrap(c))
+    out = (Tensor(jnp.concatenate(rois)), Tensor(jnp.concatenate(probs)))
+    if return_rois_num:
+        return out + (Tensor(jnp.stack(nums)),)
+    return out
+
+
+# -- FPN distribution ----------------------------------------------------------
+
+def _fpn_level_fn(rois, min_level=2, max_level=5, refer_level=4,
+                  refer_scale=224):
+    scale = jnp.sqrt(jnp.clip((rois[:, 2] - rois[:, 0] + 1.0) *
+                              (rois[:, 3] - rois[:, 1] + 1.0), 1e-6))
+    lvl = jnp.floor(refer_level + jnp.log2(scale / refer_scale + 1e-8))
+    return jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+
+
+_fpn_level = Primitive("distribute_fpn_proposals", _fpn_level_fn,
+                       differentiable=False)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """distribute_fpn_proposals_op.cc: route each RoI to its FPN level by
+    scale. Returns (multi_rois list, restore_index [, rois_num list]).
+    Level membership is computed on device; the per-level compaction is a
+    host step (eager op, matching the reference's CPU-only kernel)."""
+    import numpy as np
+    rv = unwrap(fpn_rois)
+    lvl = np.asarray(unwrap(_fpn_level(fpn_rois, min_level=int(min_level),
+                                       max_level=int(max_level),
+                                       refer_level=int(refer_level),
+                                       refer_scale=int(refer_scale))))
+    multi_rois, multi_num, order = [], [], []
+    for l in range(int(min_level), int(max_level) + 1):
+        idx = np.nonzero(lvl == l)[0]
+        multi_rois.append(Tensor(jnp.asarray(np.asarray(rv)[idx])))
+        multi_num.append(Tensor(jnp.asarray([len(idx)], dtype=jnp.int32)))
+        order.extend(idx.tolist())
+    restore = np.empty(len(order), np.int64)
+    restore[np.asarray(order, np.int64)] = np.arange(len(order))
+    restore_t = Tensor(jnp.asarray(restore[:, None]))
+    if rois_num is not None:
+        return multi_rois, restore_t, multi_num
+    return multi_rois, restore_t
+
+
+# -- position-sensitive ROI pooling -------------------------------------------
+
+def _psroi_pool_fn(x, rois, roi_batch_idx, output_channels=1, pooled_h=1,
+                   pooled_w=1, spatial_scale=1.0):
+    """psroi_pool_op.cc: input [N, out_c*ph*pw, H, W]; bin (i, j) of output
+    channel c averages input channel c*ph*pw + i*pw + j over the bin's
+    region. Bin averaging uses a fixed 2x2 sample grid per bin (the
+    roi_align idiom) instead of the reference's variable-size exact bins —
+    the TPU-friendly static-shape equivalent."""
+    R = rois.shape[0]
+    H, W = x.shape[2], x.shape[3]
+    ph, pw, oc = pooled_h, pooled_w, output_channels
+
+    def one_roi(r, bidx):
+        x0, y0, x1, y1 = r * spatial_scale
+        rw = jnp.maximum(x1 - x0, 0.1)
+        rh = jnp.maximum(y1 - y0, 0.1)
+        bin_w, bin_h = rw / pw, rh / ph
+        # 2x2 samples per bin
+        sy = y0 + (jnp.arange(ph)[:, None] +
+                   jnp.array([0.25, 0.75])[None, :]) * bin_h   # [ph, 2]
+        sx = x0 + (jnp.arange(pw)[:, None] +
+                   jnp.array([0.25, 0.75])[None, :]) * bin_w   # [pw, 2]
+        yy = jnp.clip(sy, 0, H - 1).reshape(-1)                # [ph*2]
+        xx = jnp.clip(sx, 0, W - 1).reshape(-1)                # [pw*2]
+        img = x[bidx]                                          # [C, H, W]
+        y_lo = jnp.floor(yy).astype(jnp.int32)
+        x_lo = jnp.floor(xx).astype(jnp.int32)
+        y_hi = jnp.minimum(y_lo + 1, H - 1)
+        x_hi = jnp.minimum(x_lo + 1, W - 1)
+        wy = yy - y_lo
+        wx = xx - x_lo
+        # bilinear at the sample grid (outer product over y-samples,
+        # x-samples): v [C, ph*2, pw*2]
+        v = (img[:, y_lo][:, :, x_lo] * ((1 - wy)[:, None] * (1 - wx)[None, :]) +
+             img[:, y_hi][:, :, x_lo] * (wy[:, None] * (1 - wx)[None, :]) +
+             img[:, y_lo][:, :, x_hi] * ((1 - wy)[:, None] * wx[None, :]) +
+             img[:, y_hi][:, :, x_hi] * (wy[:, None] * wx[None, :]))
+        v = v.reshape(oc, ph, pw, ph, 2, pw, 2)
+        # bin (i, j) of channel c reads plane c*ph*pw + i*pw + j
+        v = jnp.mean(v, axis=(4, 6))                           # [oc,ph,pw,ph,pw]
+        ii = jnp.arange(ph)[:, None]
+        jj = jnp.arange(pw)[None, :]
+        out = v[:, ii, jj, ii, jj]                             # [oc, ph, pw]
+        return out
+
+    return jax.vmap(one_roi)(rois, roi_batch_idx)
+
+
+_psroi_pool = Primitive("psroi_pool", _psroi_pool_fn)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive ROI pooling [R, out_c, ph, pw] with the
+    paddle.vision.ops.psroi_pool signature: output_channels is derived as
+    C // (ph * pw)."""
+    if isinstance(output_size, int):
+        ph = pw = output_size
+    else:
+        ph, pw = output_size
+    C = unwrap(x).shape[1]
+    if C % (ph * pw) != 0:
+        from ..framework.enforce import InvalidArgumentError
+        raise InvalidArgumentError(
+            f"input channels {C} must be divisible by output_size^2 "
+            f"({ph}*{pw})", op="psroi_pool")
+    bidx = _batch_index(boxes, boxes_num, unwrap(x).shape[0])
+    return _psroi_pool(x, unwrap(boxes), bidx,
+                       output_channels=int(C // (ph * pw)),
+                       pooled_h=int(ph), pooled_w=int(pw),
+                       spatial_scale=float(spatial_scale))
+
+
+# -- deformable convolution ----------------------------------------------------
+
+def _deform_conv2d_fn(x, offset, mask, weight, stride=(1, 1), padding=(0, 0),
+                      dilation=(1, 1), deformable_groups=1, groups=1):
+    """deformable_conv_op.cc (v2 with Mask; v1 = mask of ones). TPU-shape:
+    instead of the reference's modulated im2col CUDA kernel
+    (deformable_conv_func.h), build the sampled-column tensor with one
+    batched bilinear gather over all (output-position, kernel-tap) pairs,
+    then a single MXU matmul against the flattened filter."""
+    N, C, H, W = x.shape
+    Co, Cg, kh, kw = weight.shape
+    _, _, Ho, Wo = offset.shape[0], offset.shape[1], \
+        (H + 2 * padding[0] - dilation[0] * (kh - 1) - 1) // stride[0] + 1, \
+        (W + 2 * padding[1] - dilation[1] * (kw - 1) - 1) // stride[1] + 1
+    dg = deformable_groups
+    K = kh * kw
+    # base sampling grid: [Ho, Wo, kh, kw]
+    oy = jnp.arange(Ho) * stride[0] - padding[0]
+    ox = jnp.arange(Wo) * stride[1] - padding[1]
+    ky = jnp.arange(kh) * dilation[0]
+    kx = jnp.arange(kw) * dilation[1]
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]
+    # offsets: [N, 2*dg*K, Ho, Wo] with interleaved (y, x) per tap
+    off = offset.reshape(N, dg, K, 2, Ho, Wo)
+    off_y = jnp.transpose(off[:, :, :, 0], (0, 3, 4, 1, 2)) \
+        .reshape(N, Ho, Wo, dg, kh, kw)
+    off_x = jnp.transpose(off[:, :, :, 1], (0, 3, 4, 1, 2)) \
+        .reshape(N, Ho, Wo, dg, kh, kw)
+    sy = base_y[None, :, :, None, :, :] + off_y                # [N,Ho,Wo,dg,kh,kw]
+    sx = base_x[None, :, :, None, :, :] + off_x
+    if mask is None:
+        m = jnp.ones((N, Ho, Wo, dg, kh, kw), x.dtype)
+    else:
+        m = jnp.transpose(mask.reshape(N, dg, K, Ho, Wo),
+                          (0, 3, 4, 1, 2)).reshape(N, Ho, Wo, dg, kh, kw)
+
+    in_range = ((sy > -1.0) & (sy < H) & (sx > -1.0) & (sx < W))
+    y0 = jnp.floor(sy)
+    x0 = jnp.floor(sx)
+    wy = sy - y0
+    wx = sx - x0
+    # per-corner validity: out-of-bounds taps contribute ZERO (the
+    # reference im2col zero-pads outside the image, deformable_conv_func.h)
+    # — clip-replicating would skew every border sample
+    vy0 = (y0 >= 0) & (y0 <= H - 1)
+    vy1 = (y0 + 1 >= 0) & (y0 + 1 <= H - 1)
+    vx0 = (x0 >= 0) & (x0 <= W - 1)
+    vx1 = (x0 + 1 >= 0) & (x0 + 1 <= W - 1)
+    w00 = (1 - wy) * (1 - wx) * (vy0 & vx0)
+    w10 = wy * (1 - wx) * (vy1 & vx0)
+    w01 = (1 - wy) * wx * (vy0 & vx1)
+    w11 = wy * wx * (vy1 & vx1)
+    y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
+    x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
+    y1i = jnp.clip(y0i + 1, 0, H - 1)
+    x1i = jnp.clip(x0i + 1, 0, W - 1)
+
+    cpg = C // dg                                              # channels per dg
+
+    def per_image(img, y0i, x0i, y1i, x1i, w00, w10, w01, w11, m, in_range):
+        # img [C, H, W]; index tensors [Ho, Wo, dg, kh, kw]
+        imgd = img.reshape(dg, cpg, H, W)
+
+        def per_dg(sub, y0i, x0i, y1i, x1i, w00, w10, w01, w11, m, ok):
+            # sub [cpg, H, W]; indices [Ho, Wo, kh, kw]
+            flat = sub.reshape(cpg, H * W)
+
+            def g(yi, xi):
+                return flat[:, (yi * W + xi).reshape(-1)] \
+                    .reshape((cpg,) + yi.shape)
+
+            v = (g(y0i, x0i) * w00[None] + g(y1i, x0i) * w10[None] +
+                 g(y0i, x1i) * w01[None] + g(y1i, x1i) * w11[None])
+            return v * (m * ok)[None]
+
+        vals = jax.vmap(per_dg, in_axes=(0,) + (2,) * 10, out_axes=3)(
+            imgd, y0i, x0i, y1i, x1i, w00, w10, w01, w11, m,
+            in_range.astype(img.dtype))
+        # vals [cpg, Ho, Wo, dg, kh, kw] -> [C*kh*kw, Ho*Wo]
+        cols = jnp.transpose(vals, (3, 0, 4, 5, 1, 2)) \
+            .reshape(C * kh * kw, Ho * Wo)
+        return cols
+
+    cols = jax.vmap(per_image)(x, y0i, x0i, y1i, x1i, w00, w10, w01, w11,
+                               m, in_range)                    # [N, CK, HoWo]
+    wmat = weight.reshape(groups, Co // groups, Cg * kh * kw)
+    colsg = cols.reshape(N, groups, Cg * kh * kw, Ho * Wo)
+    out = jnp.einsum("gof,ngfp->ngop", wmat, colsg)
+    return out.reshape(N, Co, Ho, Wo)
+
+
+_deform_conv2d = Primitive("deformable_conv", _deform_conv2d_fn)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (deformable_conv_v1_op.cc /
+    deformable_conv_op.cc; v2 when ``mask`` is given)."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    out = _deform_conv2d(x, offset, mask, weight, stride=st, padding=pd,
+                         dilation=dl,
+                         deformable_groups=int(deformable_groups),
+                         groups=int(groups))
+    if bias is not None:
+        out = out + (bias if isinstance(bias, Tensor)
+                     else Tensor(unwrap(bias))).reshape([1, -1, 1, 1])
+    return out
+
+
+# -- density prior box ---------------------------------------------------------
+
+def _density_prior_box_fn(feat_h, feat_w, im_h, im_w, densities=(),
+                          fixed_sizes=(), fixed_ratios=(),
+                          variances=(0.1, 0.1, 0.2, 0.2), step_w=0.0,
+                          step_h=0.0, offset=0.5, clip=False):
+    """density_prior_box_op.cc: dense sub-grid of shifted priors per
+    (density, fixed_size, fixed_ratio)."""
+    sw = step_w if step_w > 0 else im_w / feat_w
+    sh = step_h if step_h > 0 else im_h / feat_h
+    cx = (jnp.arange(feat_w) + offset) * sw
+    cy = (jnp.arange(feat_h) + offset) * sh
+    boxes = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * (ratio ** 0.5)
+            bh = size / (ratio ** 0.5)
+            step = size / density
+            for di in range(density):
+                for dj in range(density):
+                    shift_x = -size / 2.0 + step / 2.0 + dj * step
+                    shift_y = -size / 2.0 + step / 2.0 + di * step
+                    x0 = (cx[None, :] + shift_x - bw / 2.0) / im_w
+                    y0 = (cy[:, None] + shift_y - bh / 2.0) / im_h
+                    x1 = (cx[None, :] + shift_x + bw / 2.0) / im_w
+                    y1 = (cy[:, None] + shift_y + bh / 2.0) / im_h
+                    boxes.append(jnp.stack(jnp.broadcast_arrays(
+                        jnp.broadcast_to(x0, (feat_h, feat_w)),
+                        jnp.broadcast_to(y0, (feat_h, feat_w)),
+                        jnp.broadcast_to(x1, (feat_h, feat_w)),
+                        jnp.broadcast_to(y1, (feat_h, feat_w))), axis=-1))
+    out = jnp.stack(boxes, axis=2)                     # [H, W, P, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), out.shape)
+    return out, var
+
+
+_density_prior_box = Primitive("density_prior_box", _density_prior_box_fn,
+                               multi_output=True, differentiable=False)
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    fh, fw = unwrap(input).shape[2], unwrap(input).shape[3]
+    ih, iw = unwrap(image).shape[2], unwrap(image).shape[3]
+    b, v = _density_prior_box(
+        feat_h=int(fh), feat_w=int(fw), im_h=float(ih), im_w=float(iw),
+        densities=tuple(densities), fixed_sizes=tuple(fixed_sizes),
+        fixed_ratios=tuple(fixed_ratios), variances=tuple(variance),
+        step_w=float(steps[0]), step_h=float(steps[1]),
+        offset=float(offset), clip=bool(clip))
+    if flatten_to_2d:
+        b = b.reshape([-1, 4])
+        v = v.reshape([-1, 4])
+    return b, v
+
+
+# -- polygon box transform -----------------------------------------------------
+
+def _polygon_box_transform_fn(x):
+    """polygon_box_transform_op.cc: quad geometry maps (EAST-style) from
+    offset encoding to absolute coords: even channels use 4*w - v, odd use
+    4*h - v. x [N, geo_c, H, W]."""
+    N, C, H, W = x.shape
+    ww = jnp.arange(W, dtype=x.dtype)[None, None, None, :] * 4.0
+    hh = jnp.arange(H, dtype=x.dtype)[None, None, :, None] * 4.0
+    even = jnp.arange(C) % 2 == 0
+    base = jnp.where(even[None, :, None, None], ww, hh)
+    return base - x
+
+
+_polygon_box_transform = Primitive("polygon_box_transform",
+                                   _polygon_box_transform_fn)
+
+
+def polygon_box_transform(input, name=None):
+    return _polygon_box_transform(input)
+
+
+# -- target assign -------------------------------------------------------------
+
+def _target_assign_fn(x, match_indices, neg_mask=None, mismatch_value=0.0):
+    """target_assign_op.h: out[i,j] = x[match[i,j], j] when matched, else
+    mismatch_value; weight 1 for matched (and for negatives when a neg
+    mask is given). x [M, P, K], match_indices [N, P] int32."""
+    M, P, K = x.shape
+    N = match_indices.shape[0]
+    safe = jnp.maximum(match_indices, 0)                   # [N, P]
+    gathered = x[safe, jnp.arange(P)[None, :]]             # [N, P, K]
+    matched = (match_indices >= 0)[..., None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch_value, x.dtype))
+    w = matched.astype(x.dtype)
+    if neg_mask is not None:
+        w = jnp.maximum(w, neg_mask[..., None].astype(x.dtype))
+    return out, w
+
+
+_target_assign = Primitive("target_assign", _target_assign_fn,
+                           multi_output=True, differentiable=False)
+
+
+def target_assign(x, match_indices, negative_indices=None,
+                  mismatch_value=0.0, name=None):
+    neg = None if negative_indices is None else unwrap(negative_indices)
+    return _target_assign(x, unwrap(match_indices).astype(jnp.int32), neg,
+                          mismatch_value=float(mismatch_value))
+
+
+# -- box decoder and assign ----------------------------------------------------
+
+def _box_decoder_and_assign_fn(prior_box, prior_box_var, target_box,
+                               box_score, box_clip=4.135):
+    """box_decoder_and_assign_op.h: per-class decode + argmax-class assign.
+    prior_box [R,4]; prior_box_var [4]; target_box [R, C*4];
+    box_score [R, C]."""
+    R = prior_box.shape[0]
+    C = box_score.shape[1]
+    pw = prior_box[:, 2] - prior_box[:, 0] + 1.0
+    ph = prior_box[:, 3] - prior_box[:, 1] + 1.0
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    t = target_box.reshape(R, C, 4)
+    dw = jnp.minimum(prior_box_var[2] * t[..., 2], box_clip)
+    dh = jnp.minimum(prior_box_var[3] * t[..., 3], box_clip)
+    cx = prior_box_var[0] * t[..., 0] * pw[:, None] + pcx[:, None]
+    cy = prior_box_var[1] * t[..., 1] * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                         cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], axis=-1)
+    # assign: best non-background class (j > 0)
+    score_nobg = box_score.at[:, 0].set(-jnp.inf) if C > 1 else box_score
+    best = jnp.argmax(score_nobg, axis=1)                   # [R]
+    assigned = decoded[jnp.arange(R), best]
+    return decoded.reshape(R, C * 4), assigned
+
+
+_box_decoder_and_assign = Primitive("box_decoder_and_assign",
+                                    _box_decoder_and_assign_fn,
+                                    multi_output=True,
+                                    differentiable=False)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=4.135, name=None):
+    return _box_decoder_and_assign(prior_box, unwrap(prior_box_var),
+                                   target_box, box_score,
+                                   box_clip=float(box_clip))
+
+
+# -- collect FPN proposals -----------------------------------------------------
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """collect_fpn_proposals_op.cc: merge per-level RoIs and keep the
+    global top-scoring post_nms_top_n (single image; levels are variable
+    length, so the merge is a host-side concat + one device top_k)."""
+    rois = jnp.concatenate([unwrap(r) for r in multi_rois], axis=0)
+    scores = jnp.concatenate([unwrap(s).reshape(-1)
+                              for s in multi_scores], axis=0)
+    k = min(int(post_nms_top_n), scores.shape[0])
+    top_s, top_i = lax.top_k(scores, k)
+    return Tensor(rois[top_i]), Tensor(top_s)
+
+
+__all__ = ["iou_similarity", "box_clip", "box_coder", "prior_box",
+           "anchor_generator", "roi_align", "roi_pool", "yolo_box", "nms",
+           "bipartite_match", "matrix_nms", "multiclass_nms",
+           "generate_proposals", "distribute_fpn_proposals", "psroi_pool",
+           "deform_conv2d", "density_prior_box", "polygon_box_transform",
+           "target_assign", "box_decoder_and_assign",
+           "collect_fpn_proposals"]

@@ -1,0 +1,211 @@
+"""Linear algebra ops.
+
+Reference parity: norm_op.cc, p_norm_op.cc, cholesky_op.cc, matrix ops in
+python/paddle/tensor/linalg.py. Decompositions run through
+jax.scipy/jax.numpy.linalg (XLA custom calls on TPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.primitive import Primitive
+from ..framework.tensor import Tensor, unwrap
+
+
+def _pnorm_fn(x, p=2.0, axis=None, keepdim=False):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+_pnorm = Primitive("p_norm", _pnorm_fn)
+_fro = Primitive("frobenius_norm", lambda x, axis=None, keepdim=False:
+                 jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim)))
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    elif axis is not None:
+        axis = (int(axis),)
+    if p == "fro":
+        return _fro(x, axis=axis, keepdim=keepdim)
+    return _pnorm(x, p=float(p), axis=axis, keepdim=keepdim)
+
+
+_chol = Primitive("cholesky", lambda x, upper=False:
+                  jnp.linalg.cholesky(x) if not upper
+                  else jnp.swapaxes(jnp.linalg.cholesky(x), -1, -2))
+
+
+def cholesky(x, upper=False, name=None):
+    return _chol(x, upper=bool(upper))
+
+
+_inv = Primitive("inverse", jnp.linalg.inv)
+
+
+def inverse(x, name=None):
+    return _inv(x)
+
+
+_det = Primitive("determinant", jnp.linalg.det)
+
+
+def det(x, name=None):
+    return _det(x)
+
+
+_slogdet = Primitive("slogdet", lambda x: tuple(jnp.linalg.slogdet(x)),
+                     multi_output=True)
+
+
+def slogdet(x, name=None):
+    s, la = _slogdet(x)
+    from .manipulation import stack
+    return stack([s, la])
+
+
+_matpow = Primitive("matrix_power", lambda x, n=1: jnp.linalg.matrix_power(x, n))
+
+
+def matrix_power(x, n, name=None):
+    return _matpow(x, n=int(n))
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(unwrap(x), full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
+
+
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(unwrap(x))
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    w, v = jnp.linalg.eigh(unwrap(x), UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.linalg.eigvals(unwrap(x)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return Tensor(jnp.linalg.eigvalsh(unwrap(x), UPLO=UPLO))
+
+
+def qr(x, mode="reduced", name=None):
+    q, r = jnp.linalg.qr(unwrap(x), mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(unwrap(x), unwrap(y), rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+_solve = Primitive("solve", jnp.linalg.solve)
+
+
+def solve(x, y, name=None):
+    return _solve(x, y)
+
+
+_tri_solve = Primitive("triangular_solve",
+                       lambda x, y, upper=True, transpose=False, unitriangular=False:
+                       jax.scipy.linalg.solve_triangular(
+                           x, y, lower=not upper, trans=1 if transpose else 0,
+                           unit_diagonal=unitriangular))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return _tri_solve(x, y, upper=upper, transpose=transpose,
+                      unitriangular=unitriangular)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(unwrap(x), tol=tol))
+
+
+_pinv = Primitive("pinv", lambda x, rcond=1e-15: jnp.linalg.pinv(x, rcond=rcond))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _pinv(x, rcond=float(rcond))
+
+
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(unwrap(x), p=p))
+
+
+_multi_dot = Primitive("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs))
+
+
+def multi_dot(xs, name=None):
+    return _multi_dot(*xs)
+
+
+_cross = Primitive("cross", lambda x, y, axis=-1: jnp.cross(x, y, axis=axis))
+
+
+def cross(x, y, axis=9, name=None):
+    if axis == 9:
+        shp = x.shape if isinstance(x, Tensor) else list(jnp.shape(unwrap(x)))
+        axis = next((i for i, s in enumerate(shp) if s == 3), -1)
+    return _cross(x, y, axis=int(axis))
+
+
+_bincount = Primitive("bincount", lambda x, length=0: jnp.bincount(x, length=length),
+                      differentiable=False)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    xv = unwrap(x)
+    import numpy as np
+    length = max(int(minlength), int(np.asarray(xv).max()) + 1 if xv.size else 0)
+    if weights is not None:
+        return Tensor(jnp.bincount(xv, weights=unwrap(weights), length=length))
+    return _bincount(x, length=length)
+
+
+_cov = Primitive("cov", lambda x, ddof=1: jnp.cov(x, ddof=ddof))
+_cov_w = Primitive(
+    "cov_weighted",
+    lambda x, fw, aw, ddof=1: jnp.cov(x, ddof=ddof, fweights=fw,
+                                      aweights=aw))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    """paddle.linalg.cov: covariance of rows (or columns) of a 2-D tensor."""
+    xt = x if isinstance(x, Tensor) else Tensor(unwrap(x))
+    if not rowvar and len(xt.shape) == 2:
+        from .manipulation import transpose
+        xt = transpose(xt, [1, 0])     # stays on the tape
+    if fweights is not None or aweights is not None:
+        n = xt.shape[-1]
+        fw = jnp.ones((n,), jnp.int32) if fweights is None \
+            else unwrap(fweights)
+        aw = jnp.ones((n,), jnp.float32) if aweights is None \
+            else unwrap(aweights)
+        return _cov_w(xt, fw, aw, ddof=1 if ddof else 0)
+    return _cov(xt, ddof=1 if ddof else 0)
+
+
+_corrcoef = Primitive("corrcoef", jnp.corrcoef)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    """paddle.linalg.corrcoef: normalised covariance (correlation matrix)."""
+    xt = x if isinstance(x, Tensor) else Tensor(unwrap(x))
+    if not rowvar and len(xt.shape) == 2:
+        from .manipulation import transpose
+        xt = transpose(xt, [1, 0])     # stays on the tape
+    return _corrcoef(xt)

@@ -1,0 +1,436 @@
+"""Shape/layout manipulation ops.
+
+Reference parity: reshape_op.cc, transpose_op.cc, concat_op.cc, split_op.cc,
+stack_op.cc, squeeze/unsqueeze, expand_v2, tile, slice_op.cc, gather/scatter,
+where_op, cast_op, pad3d, flip, roll, index_select and
+python/paddle/tensor/manipulation.py. All static shape parameters travel as
+jit-static attrs so XLA sees fixed shapes (TPU requirement); tensor-valued
+indices travel as array args.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import convert_dtype, index_dtype as _idt
+from ..framework.primitive import Primitive
+from ..framework.tensor import Tensor, unwrap
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        return tuple(int(x) for x in v.tolist())
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    return tuple(int(unwrap(x)) if not isinstance(x, (int, np.integer)) else int(x)
+                 for x in v)
+
+
+_cast_prims = {}
+
+
+def cast(x, dtype):
+    dt = convert_dtype(dtype)
+    key = str(dt)
+    if key not in _cast_prims:
+        _cast_prims[key] = Primitive(f"cast[{key}]", lambda v, _dt=dt: v.astype(_dt))
+    return _cast_prims[key](x)
+
+
+_reshape = Primitive("reshape2", lambda x, shape=(): jnp.reshape(x, shape))
+
+
+def reshape(x, shape, name=None):
+    shape = _ints(shape)
+    return _reshape(x, shape=shape)
+
+
+_transpose = Primitive("transpose2", lambda x, perm=(): jnp.transpose(x, perm))
+
+
+def transpose(x, perm, name=None):
+    return _transpose(x, perm=_ints(perm))
+
+
+def _concat_fn(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+_concat = Primitive("concat", _concat_fn)
+
+
+def concat(x, axis=0, name=None):
+    axis = int(unwrap(axis))
+    return _concat(*x, axis=axis)
+
+
+def _split_fn(x, num_or_indices=(), axis=0):
+    kind, val = num_or_indices
+    if kind == "num":
+        return tuple(jnp.split(x, val, axis=axis))
+    return tuple(jnp.split(x, list(np.cumsum(val))[:-1], axis=axis))
+
+
+_split = Primitive("split", _split_fn, multi_output=True)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis))
+    if isinstance(num_or_sections, int):
+        spec = ("num", num_or_sections)
+    else:
+        secs = list(_ints(num_or_sections))
+        dim = (x.shape if isinstance(x, Tensor) else list(jnp.shape(unwrap(x))))[axis]
+        n_unknown = sum(1 for s in secs if s < 0)
+        if n_unknown:
+            known = int(np.sum([s for s in secs if s >= 0]))
+            secs = [s if s >= 0 else dim - known for s in secs]
+        spec = ("secs", tuple(secs))
+    return list(_split(x, num_or_indices=spec, axis=axis))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def _stack_fn(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+_stack = Primitive("stack", _stack_fn)
+
+
+def stack(x, axis=0, name=None):
+    return _stack(*x, axis=int(axis))
+
+
+def _unstack_fn(x, axis=0, num=0):
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, num, axis=axis))
+
+
+_unstack = Primitive("unstack", _unstack_fn, multi_output=True)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+    return list(_unstack(x, axis=int(axis), num=int(n)))
+
+
+def unbind(x, axis=0, name=None):
+    return unstack(x, axis)
+
+
+_squeeze = Primitive("squeeze2", lambda x, axes=None: jnp.squeeze(x, axis=axes))
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return _squeeze(x, axes=None)
+    axes = _ints(axis)
+    shape = x.shape if isinstance(x, Tensor) else list(jnp.shape(unwrap(x)))
+    axes = tuple(a for a in axes if shape[a] == 1)
+    return _squeeze(x, axes=axes)
+
+
+_unsqueeze = Primitive("unsqueeze2", lambda x, axes=(): jnp.expand_dims(x, axes))
+
+
+def unsqueeze(x, axis, name=None):
+    return _unsqueeze(x, axes=_ints(axis))
+
+
+def _flatten_fn(x, start=0, stop=-1):
+    shape = x.shape
+    nd = len(shape)
+    stop = stop % nd
+    new = shape[:start] + (int(np.prod(shape[start:stop + 1]) or 1),) + shape[stop + 1:]
+    return jnp.reshape(x, new)
+
+
+_flatten = Primitive("flatten_contiguous_range", _flatten_fn)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _flatten(x, start=int(start_axis), stop=int(stop_axis))
+
+
+_expand = Primitive("expand_v2", lambda x, shape=(): jnp.broadcast_to(x, shape))
+
+
+def expand(x, shape, name=None):
+    shape = list(_ints(shape))
+    xshape = x.shape if isinstance(x, Tensor) else list(jnp.shape(unwrap(x)))
+    # paddle semantics: -1 means keep dim
+    offset = len(shape) - len(xshape)
+    for i, s in enumerate(shape):
+        if s == -1 and i >= offset:
+            shape[i] = xshape[i - offset]
+    return _expand(x, shape=tuple(shape))
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = [unwrap(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [expand(t, shape) for t in inputs]
+
+
+_tile = Primitive("tile", lambda x, reps=(): jnp.tile(x, reps))
+
+
+def tile(x, repeat_times, name=None):
+    return _tile(x, reps=_ints(repeat_times))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return Tensor(jnp.repeat(unwrap(x), unwrap(repeats), axis=axis))
+
+
+_builtin_slice = slice    # the ``slice`` op below shadows the builtin
+
+
+def _slice_fn(x, spec=()):
+    idx = tuple(_builtin_slice(*s) if isinstance(s, tuple) else s
+                for s in spec)
+    return x[idx]
+
+
+_slice = Primitive("slice", _slice_fn)
+
+
+def slice(x, axes, starts, ends, name=None):
+    axes, starts, ends = _ints(axes), _ints(starts), _ints(ends)
+    nd = x.ndim if isinstance(x, Tensor) else jnp.ndim(unwrap(x))
+    spec = [(None, None, None)] * nd
+    for a, s, e in zip(axes, starts, ends):
+        spec[a] = (s, e, None)
+    return _slice(x, spec=tuple(spec))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = map(_ints, (axes, starts, ends, strides))
+    nd = x.ndim if isinstance(x, Tensor) else jnp.ndim(unwrap(x))
+    spec = [(None, None, None)] * nd
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        spec[a] = (s, e, st)
+    return _slice(x, spec=tuple(spec))
+
+
+def crop(x, shape, offsets, name=None):
+    shape, offsets = _ints(shape), _ints(offsets)
+    return _slice(x, spec=tuple((o, o + s, None) for o, s in zip(offsets, shape)))
+
+
+_gather = Primitive("gather", lambda x, idx, axis=0: jnp.take(x, idx, axis=axis))
+
+
+def gather(x, index, axis=0, name=None):
+    return _gather(x, index, axis=int(unwrap(axis)))
+
+
+_gather_nd = Primitive("gather_nd", lambda x, idx: x[tuple(jnp.moveaxis(idx, -1, 0))])
+
+
+def gather_nd(x, index, name=None):
+    return _gather_nd(x, index)
+
+
+_take_along = Primitive("take_along_axis",
+                        lambda x, idx, axis=0: jnp.take_along_axis(x, idx, axis=axis))
+
+
+def take_along_axis(x, indices, axis, name=None):
+    return _take_along(x, indices, axis=int(axis))
+
+
+def _scatter_fn(x, idx, updates, overwrite=True):
+    if overwrite:
+        return x.at[idx].set(updates)
+    return x.at[idx].add(updates)
+
+
+_scatter = Primitive("scatter", _scatter_fn)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _scatter(x, index, updates, overwrite=bool(overwrite))
+
+
+def _scatter_nd_add_fn(x, idx, updates):
+    return x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(updates)
+
+
+_scatter_nd_add = Primitive("scatter_nd_add", _scatter_nd_add_fn)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _scatter_nd_add(x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=updates.dtype if isinstance(updates, Tensor) else None)
+    return _scatter_nd_add(z, index, updates)
+
+
+_put_along = Primitive("put_along_axis", lambda x, idx, v, axis=0, reduce="assign":
+                       jnp.put_along_axis(x, idx, v, axis=axis, inplace=False)
+                       if reduce == "assign"
+                       else x.at[...].set(x))
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
+    return _put_along(x, indices, values, axis=int(axis), reduce=reduce)
+
+
+_index_select = Primitive("index_select",
+                          lambda x, idx, axis=0: jnp.take(x, idx, axis=axis))
+
+
+def index_select(x, index, axis=0, name=None):
+    return _index_select(x, index, axis=int(axis))
+
+
+def index_sample(x, index):
+    return _take_along(x, index, axis=1)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only (host round-trip), like Paddle's CPU path
+    xv, mv = unwrap(x), unwrap(mask)
+    return Tensor(xv[np.asarray(mv)])
+
+
+_where = Primitive("where", lambda c, x, y: jnp.where(c, x, y))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return _where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    xv = np.asarray(unwrap(x))
+    idx = np.nonzero(xv)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1)))
+
+
+_flip = Primitive("flip", lambda x, axis=(): jnp.flip(x, axis=axis))
+
+
+def flip(x, axis, name=None):
+    return _flip(x, axis=_ints(axis))
+
+
+_roll = Primitive("roll", lambda x, shifts=(), axis=None: jnp.roll(x, shifts, axis=axis))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return _roll(x, shifts=_ints(shifts) if not isinstance(shifts, int) else (shifts,),
+                 axis=_ints(axis) if axis is not None else None)
+
+
+def _rot90_fn(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+_rot90 = Primitive("rot90", _rot90_fn)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _rot90(x, k=int(k), axes=_ints(axes))
+
+
+_pad_p = Primitive("pad", lambda x, pads=(), mode="constant", value=0.0:
+                   jnp.pad(x, pads, mode=mode, constant_values=value)
+                   if mode == "constant" else jnp.pad(x, pads, mode=mode))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """functional.pad parity (pad3d_op.cc). ``pad`` is flat [lo,hi] pairs over
+    trailing dims (paddle layout) or full ndim*2."""
+    pads = _ints(pad)
+    nd = x.ndim if isinstance(x, Tensor) else jnp.ndim(unwrap(x))
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if len(pads) == 2 * nd:
+        width = [(pads[2 * i], pads[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle convention: pads cover the LAST len(pads)//2 spatial dims,
+        # innermost-first, e.g. NCHW with pad=[l,r,t,b] -> W then H
+        npairs = len(pads) // 2
+        width = [(0, 0)] * nd
+        for i in range(npairs):
+            dim = nd - 1 - i
+            width[dim] = (pads[2 * i], pads[2 * i + 1])
+    return _pad_p(x, pads=tuple(width), mode=jmode, value=float(value))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    x = unwrap(input)
+    per = index_num // nshards
+    lo, hi = shard_id * per, (shard_id + 1) * per
+    ok = (x >= lo) & (x < hi)
+    return Tensor(jnp.where(ok, x - lo, ignore_value))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    xv = np.asarray(unwrap(x))
+    out = np.unique(xv, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(out, tuple):
+        return tuple(Tensor(jnp.asarray(o)) for o in out)
+    return Tensor(jnp.asarray(out))
+
+
+_as_real = Primitive("as_real", lambda x: jnp.stack([jnp.real(x), jnp.imag(x)], -1))
+
+
+def moveaxis(x, source, destination, name=None):
+    return Tensor(jnp.moveaxis(unwrap(x), source, destination))
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    nd = x.ndim
+    perm = list(range(nd))
+    perm[axis1], perm[axis2] = perm[axis2], perm[axis1]
+    return transpose(x, perm)
+
+
+def as_complex(x, name=None):
+    xv = unwrap(x)
+    return Tensor(jax.lax.complex(xv[..., 0], xv[..., 1]))
+
+
+def as_real(x, name=None):
+    return _as_real(x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1,
+                              dtype=_idt()))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+def one_hot(x, num_classes, name=None):
+    p = _one_hot
+    return p(x, num_classes=int(num_classes))
+
+
+_one_hot = Primitive("one_hot_v2", lambda x, num_classes=0:
+                     jax.nn.one_hot(x, num_classes), differentiable=False)

@@ -1,0 +1,571 @@
+"""Math ops: elementwise, matmul, reductions, comparisons.
+
+Reference parity: paddle/fluid/operators/elementwise/ (broadcast engine,
+elementwise_op_function.h), activation_op.cc, matmul_v2_op.cc,
+reduce_ops/, scale_op.cc, clip_op.cc, cumsum_op.cc, top_k_op.cc and the
+python/paddle/tensor/{math,logic,search}.py API surface. TPU-first: every op
+is one jnp/lax expression that XLA fuses; broadcasting is native; scalar
+parameters that vary step-to-step (scale/clip bounds) are passed as *array*
+arguments so jit caches stay warm.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import index_dtype as _idt
+from ..framework.primitive import primitive, Primitive
+from ..framework.tensor import Tensor, unwrap
+
+# ---- binary elementwise ------------------------------------------------------
+
+_add = Primitive("elementwise_add", lambda x, y: x + y)
+_sub = Primitive("elementwise_sub", lambda x, y: x - y)
+_mul = Primitive("elementwise_mul", lambda x, y: x * y)
+_div = Primitive("elementwise_div", lambda x, y: x / y)
+_pow = Primitive("elementwise_pow", lambda x, y: x ** y)
+_mod = Primitive("elementwise_mod", lambda x, y: jnp.mod(x, y), differentiable=False)
+_floordiv = Primitive("elementwise_floordiv", lambda x, y: jnp.floor_divide(x, y),
+                      differentiable=False)
+_max = Primitive("elementwise_max", jnp.maximum)
+_min = Primitive("elementwise_min", jnp.minimum)
+_atan2 = Primitive("atan2", jnp.arctan2)
+_hypot = Primitive("hypot", jnp.hypot)
+_fmax = Primitive("fmax", jnp.fmax)
+_fmin = Primitive("fmin", jnp.fmin)
+
+
+def add(x, y, name=None):
+    return _add(x, y)
+
+
+def subtract(x, y, name=None):
+    return _sub(x, y)
+
+
+def multiply(x, y, name=None):
+    return _mul(x, y)
+
+
+def divide(x, y, name=None):
+    return _div(x, y)
+
+
+def pow(x, y, name=None):
+    return _pow(x, y)
+
+
+def mod(x, y, name=None):
+    return _mod(x, y)
+
+
+remainder = mod
+
+
+def floor_divide(x, y, name=None):
+    return _floordiv(x, y)
+
+
+def maximum(x, y, name=None):
+    return _max(x, y)
+
+
+def minimum(x, y, name=None):
+    return _min(x, y)
+
+
+def atan2(x, y, name=None):
+    return _atan2(x, y)
+
+
+def hypot(x, y, name=None):
+    return _hypot(x, y)
+
+
+def fmax(x, y, name=None):
+    return _fmax(x, y)
+
+
+def fmin(x, y, name=None):
+    return _fmin(x, y)
+
+
+def floor_mod(x, y, name=None):
+    return _mod(x, y)
+
+
+# ---- unary elementwise -------------------------------------------------------
+
+def _unary(pname, jf, differentiable=True):
+    p = Primitive(pname, jf, differentiable=differentiable)
+
+    def f(x, name=None):
+        return p(x)
+    f.__name__ = pname
+    return f
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+abs = _unary("abs", jnp.abs)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor, differentiable=False)
+ceil = _unary("ceil", jnp.ceil, differentiable=False)
+round = _unary("round", jnp.round, differentiable=False)
+trunc = _unary("trunc", jnp.trunc, differentiable=False)
+sign = _unary("sign", jnp.sign, differentiable=False)
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+square = _unary("square", jnp.square)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+neg = _unary("neg", jnp.negative)
+logit = _unary("logit", jax.scipy.special.logit)
+i0 = _unary("i0", jax.scipy.special.i0)
+angle = _unary("angle", jnp.angle, differentiable=False)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+exponential_ = None  # in-place rng: intentionally absent (functional design)
+
+_assign = Primitive("assign", lambda x: x + 0 if jnp.issubdtype(jnp.result_type(x), jnp.inexact) else jnp.array(x, copy=True))
+
+
+def assign(x, output=None, name=None):
+    out = _assign(x) if isinstance(x, Tensor) else Tensor(jnp.asarray(unwrap(x)))
+    if output is not None:
+        output.set_value(out._value)
+        return output
+    return out
+
+
+_scale = Primitive("scale", lambda x, s, b, bias_after_scale=True:
+                   x * s + b if bias_after_scale else (x + b) * s)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    if isinstance(x, Tensor):
+        dt = x._value.dtype
+    elif hasattr(x, "dtype"):      # static Variable
+        dt = jnp.dtype(x.dtype)
+    else:
+        dt = jnp.asarray(x).dtype
+    s = jnp.asarray(unwrap(scale), dt)
+    b = jnp.asarray(unwrap(bias), dt)
+    out = _scale(x, s, b, bias_after_scale=bias_after_scale)
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+_clip = Primitive("clip", lambda x, lo, hi: jnp.clip(x, lo, hi))
+
+
+def clip(x, min=None, max=None, name=None):
+    x_arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    lo = jnp.asarray(unwrap(min) if min is not None else -jnp.inf, x_arr.dtype)
+    hi = jnp.asarray(unwrap(max) if max is not None else jnp.inf, x_arr.dtype)
+    return _clip(x, lo, hi)
+
+
+_lerp = Primitive("lerp", lambda x, y, w: x + w * (y - x))
+
+
+def lerp(x, y, weight, name=None):
+    w = unwrap(weight)
+    return _lerp(x, y, w)
+
+
+def increment(x, value=1.0, name=None):
+    out = _add(x, jnp.asarray(value, x.dtype if isinstance(x, Tensor) else None))
+    if isinstance(x, Tensor):
+        x.set_value(out._value)
+    return x
+
+
+_stanh = Primitive("stanh", lambda x, scale_a=0.67, scale_b=1.7159:
+                   scale_b * jnp.tanh(scale_a * x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _stanh(x, scale_a=scale_a, scale_b=scale_b)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return Tensor(jnp.nan_to_num(unwrap(x), nan=nan, posinf=posinf, neginf=neginf))
+
+
+# ---- matmul family -----------------------------------------------------------
+
+def _matmul_fn(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        if x.ndim < 2:
+            raise ValueError("transpose_x requires ndim>=2")
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    # keep the MXU fed: jnp.matmul handles batching; accumulate in f32 for bf16
+    prefer = jnp.float32 if jnp.result_type(x, y) == jnp.bfloat16 else None
+    return jnp.matmul(x, y, preferred_element_type=prefer).astype(
+        jnp.result_type(x, y))
+
+
+_matmul = Primitive("matmul_v2", _matmul_fn)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+def mm(x, y, name=None):
+    return _matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return _matmul(x, y)
+
+
+_dot = Primitive("dot", lambda x, y: jnp.sum(x * y, axis=-1))
+
+
+def dot(x, y, name=None):
+    return _dot(x, y)
+
+
+_addmm = Primitive("addmm", lambda inp, x, y, beta=1.0, alpha=1.0:
+                   beta * inp + alpha * jnp.matmul(x, y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _addmm(input, x, y, beta=beta, alpha=alpha)
+
+
+_outer = Primitive("outer", lambda x, y: jnp.outer(x, y))
+
+
+def outer(x, y, name=None):
+    return _outer(x, y)
+
+
+_inner = Primitive("inner", lambda x, y: jnp.inner(x, y))
+
+
+def inner(x, y, name=None):
+    return _inner(x, y)
+
+
+def t(x, name=None):
+    from .manipulation import transpose
+    if isinstance(x, Tensor) and x.ndim < 2:
+        return x
+    return transpose(x, [1, 0])
+
+
+_mv = Primitive("mv", lambda x, v: jnp.matmul(x, v))
+
+
+def mv(x, vec, name=None):
+    return _mv(x, vec)
+
+
+def einsum(equation, *operands):
+    return _einsum_prim(equation)(*operands)
+
+
+_EINSUM_CACHE = {}
+
+
+def _einsum_prim(eq):
+    if eq not in _EINSUM_CACHE:
+        _EINSUM_CACHE[eq] = Primitive(f"einsum[{eq}]",
+                                      lambda *ops: jnp.einsum(eq, *ops))
+    return _EINSUM_CACHE[eq]
+
+
+# ---- reductions --------------------------------------------------------------
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(v) for v in axis.tolist())
+    return (int(axis),)
+
+
+def _reduce(pname, jf, differentiable=True):
+    p = Primitive(pname, lambda x, axis=None, keepdim=False:
+                  jf(x, axis=axis, keepdims=keepdim), differentiable=differentiable)
+
+    def f(x, axis=None, keepdim=False, name=None):
+        return p(x, axis=_axes(axis), keepdim=bool(keepdim))
+    f.__name__ = pname
+    return f
+
+
+sum = _reduce("reduce_sum", jnp.sum)
+mean = _reduce("reduce_mean", jnp.mean)
+prod = _reduce("reduce_prod", jnp.prod)
+max = _reduce("reduce_max", jnp.max)
+min = _reduce("reduce_min", jnp.min)
+amax = _reduce("reduce_amax", jnp.max)
+amin = _reduce("reduce_amin", jnp.min)
+logsumexp = _reduce("logsumexp", jax.scipy.special.logsumexp)
+_all = _reduce("reduce_all", jnp.all, differentiable=False)
+_any = _reduce("reduce_any", jnp.any, differentiable=False)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _all(x, axis, keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _any(x, axis, keepdim)
+
+
+_nansum = Primitive("nansum", lambda x, axis=None, keepdim=False:
+                    jnp.nansum(x, axis=axis, keepdims=keepdim))
+
+
+def nansum(x, axis=None, keepdim=False, name=None):
+    return _nansum(x, axis=_axes(axis), keepdim=keepdim)
+
+
+_std = Primitive("std", lambda x, axis=None, unbiased=True, keepdim=False:
+                 jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _std(x, axis=_axes(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+_var = Primitive("var", lambda x, axis=None, unbiased=True, keepdim=False:
+                 jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _var(x, axis=_axes(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.median(unwrap(x), axis=axis, keepdims=keepdim))
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.quantile(unwrap(x), jnp.asarray(q), axis=axis,
+                               keepdims=keepdim))
+
+
+_cumsum = Primitive("cumsum", lambda x, axis=None: jnp.cumsum(x, axis=axis))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    out = _cumsum(x, axis=axis)
+    if dtype is not None:
+        from .manipulation import cast
+        out = cast(out, dtype)
+    return out
+
+
+_cumprod = Primitive("cumprod", lambda x, axis=None: jnp.cumprod(x, axis=axis))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = _cumprod(x, axis=dim)
+    if dtype is not None:
+        from .manipulation import cast
+        out = cast(out, dtype)
+    return out
+
+
+_cummax = Primitive("cummax", lambda x, axis: jax.lax.associative_scan(
+    jnp.maximum, x, axis=axis), differentiable=False)
+
+
+def cummax(x, axis=None, name=None):
+    return _cummax(x, axis=axis if axis is not None else 0)
+
+
+_kron = Primitive("kron", jnp.kron)
+
+
+def kron(x, y, name=None):
+    return _kron(x, y)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.count_nonzero(unwrap(x), axis=axis, keepdims=keepdim))
+
+
+_trace = Primitive("trace", lambda x, offset=0, axis1=0, axis2=1:
+                   jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# ---- comparisons / logic (non-differentiable) --------------------------------
+
+def _cmp(pname, jf):
+    p = Primitive(pname, jf, differentiable=False)
+
+    def f(x, y, name=None):
+        return p(x, y)
+    f.__name__ = pname
+    return f
+
+
+equal = _cmp("equal", lambda x, y: jnp.equal(x, y))
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+_logical_not = Primitive("logical_not", jnp.logical_not, differentiable=False)
+_bitwise_not = Primitive("bitwise_not", jnp.bitwise_not, differentiable=False)
+
+
+def logical_not(x, name=None):
+    return _logical_not(x)
+
+
+def bitwise_not(x, name=None):
+    return _bitwise_not(x)
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+_isnan = Primitive("isnan", jnp.isnan, differentiable=False)
+_isinf = Primitive("isinf", jnp.isinf, differentiable=False)
+_isfinite = Primitive("isfinite", jnp.isfinite, differentiable=False)
+
+
+def isnan(x, name=None):
+    return _isnan(x)
+
+
+def isinf(x, name=None):
+    return _isinf(x)
+
+
+def isfinite(x, name=None):
+    return _isfinite(x)
+
+
+# ---- search / sort -----------------------------------------------------------
+
+_argmax = Primitive("arg_max", lambda x, axis=None, keepdim=False:
+                    jnp.argmax(x, axis=axis, keepdims=keepdim).astype(_idt()),
+                    differentiable=False)
+_argmin = Primitive("arg_min", lambda x, axis=None, keepdim=False:
+                    jnp.argmin(x, axis=axis, keepdims=keepdim).astype(_idt()),
+                    differentiable=False)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmax(x, axis=axis, keepdim=keepdim)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmin(x, axis=axis, keepdim=keepdim)
+
+
+_argsort = Primitive("argsort", lambda x, axis=-1, descending=False:
+                     jnp.argsort(-x if descending else x, axis=axis).astype(_idt()),
+                     differentiable=False)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    return _argsort(x, axis=axis, descending=descending)
+
+
+_sort = Primitive("sort", lambda x, axis=-1, descending=False:
+                  -jnp.sort(-x, axis=axis) if descending else jnp.sort(x, axis=axis))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return _sort(x, axis=axis, descending=descending)
+
+
+def _topk_fn(x, k, axis=-1, largest=True):
+    if axis != -1 and axis != x.ndim - 1:
+        x = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(x if largest else -x, k)
+    if not largest:
+        vals = -vals
+    if axis != -1:
+        pass  # caller keeps last-axis semantics after moveaxis
+    return vals, idx.astype(_idt())
+
+
+_topk = Primitive("top_k_v2", _topk_fn, multi_output=True)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(unwrap(k))
+    vals, idx = _topk(x, k=k, axis=axis, largest=largest)
+    return vals, idx
+
+
+_mode = Primitive("mode", lambda x, axis=-1: (
+    jnp.take_along_axis(x, jnp.argsort(x, axis=axis), axis=axis)), differentiable=False)
+
+
+def masked_fill(x, mask, value, name=None):
+    from .manipulation import where
+    from .creation import full_like
+    return where(mask, full_like(x, unwrap(value)), x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    x = unwrap(input)
+    if min == 0 and max == 0:
+        lo, hi = float(jnp.min(x)), float(jnp.max(x))
+    else:
+        lo, hi = float(min), float(max)
+    h, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return Tensor(h)

@@ -1,0 +1,549 @@
+"""Op-surface long tail: math/reduction/search/manipulation extras.
+
+Reference parity: the remaining REGISTER_OPERATOR families under
+paddle/fluid/operators/ — cum_op (logcumsumexp/cummin), kthvalue,
+index_select-adjacent index_{add,fill,put}, diag_embed_op, unique ops,
+searchsorted, multiplex_op.cc, clip_by_norm_op.cc, squared_l2_norm_op.cc,
+accuracy_op.cc (metrics/), plus the python/paddle/tensor/ math surface the
+2.x API exposes over them.  Each op is one fused XLA expression.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.primitive import Primitive
+from ..framework.tensor import Tensor, unwrap
+
+
+def _unary(pname, jf, differentiable=True):
+    p = Primitive(pname, jf, differentiable=differentiable)
+
+    def f(x, name=None):
+        return p(x)
+    f.__name__ = pname
+    return f
+
+
+def _binary(pname, jf, differentiable=True):
+    p = Primitive(pname, jf, differentiable=differentiable)
+
+    def f(x, y, name=None):
+        return p(x, y)
+    f.__name__ = pname
+    return f
+
+
+# -- elementwise ---------------------------------------------------------------
+
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+heaviside = _binary("heaviside", jnp.heaviside)
+gcd = _binary("gcd", jnp.gcd, differentiable=False)
+lcm = _binary("lcm", jnp.lcm, differentiable=False)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter, differentiable=False)
+signbit = _unary("signbit", jnp.signbit, differentiable=False)
+sinc = _unary("sinc", jnp.sinc)
+exp2 = _unary("exp2", jnp.exp2)
+erfc = _unary("erfc", jax.scipy.special.erfc)
+ldexp = _binary("ldexp", jnp.ldexp)
+
+
+# -- reductions / scans --------------------------------------------------------
+
+_nanmean = Primitive("nanmean", lambda x, axis=None, keepdim=False:
+                     jnp.nanmean(x, axis=axis, keepdims=keepdim))
+_nanmedian = Primitive("nanmedian", lambda x, axis=None, keepdim=False:
+                       jnp.nanmedian(x, axis=axis, keepdims=keepdim))
+_logcumsumexp = Primitive(
+    "logcumsumexp",
+    lambda x, axis=-1: jax.lax.cumlogsumexp(x, axis=axis))
+
+
+def _cummin_fn(x, axis=-1):
+    vals = jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+    return vals
+
+
+_cummin = Primitive("cummin_vals", _cummin_fn)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return _nanmean(x, axis=ax, keepdim=bool(keepdim))
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return _nanmedian(x, axis=ax, keepdim=bool(keepdim))
+
+
+def logcumsumexp(x, axis=-1, name=None):
+    nd = len(x.shape) if isinstance(x, Tensor) else unwrap(x).ndim
+    return _logcumsumexp(x, axis=int(axis) % nd)
+
+
+def _cummin_idx_fn(x, axis=-1):
+    # index of the running minimum: first position where the scan value
+    # equals the element (ties -> earliest, matching cummax's convention)
+    ax = axis % x.ndim
+    n = x.shape[ax]
+    pos = jnp.arange(n).reshape([n if i == ax else 1 for i in range(x.ndim)])
+    running = jax.lax.associative_scan(jnp.minimum, x, axis=ax)
+    cand = jnp.where(x == running, pos, n)
+    return jax.lax.associative_scan(jnp.minimum, cand, axis=ax)
+
+
+_cummin_idx = Primitive("cummin_idx", _cummin_idx_fn, differentiable=False)
+
+
+def cummin(x, axis=-1, name=None):
+    """(values, indices) like the reference cummin op."""
+    return _cummin(x, axis=int(axis)), _cummin_idx(x, axis=int(axis))
+
+
+def _kthvalue_fn(x, k=1, axis=-1, keepdim=False):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis, stable=True)
+    v = jnp.take(vals, k - 1, axis=axis)
+    i = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i
+
+
+_kthvalue = Primitive("kthvalue", _kthvalue_fn, multi_output=True,
+                      differentiable=False)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    v, i = _kthvalue(x, k=int(k), axis=int(axis), keepdim=bool(keepdim))
+    return v, i
+
+
+_diff = Primitive("diff", lambda x, n=1, axis=-1: jnp.diff(x, n=n, axis=axis))
+
+
+def diff(x, n=1, axis=-1, name=None):
+    return _diff(x, n=int(n), axis=int(axis))
+
+
+_jtrap = jnp.trapezoid if hasattr(jnp, "trapezoid") else jnp.trapz
+_trapezoid = Primitive("trapezoid",
+                       lambda y, dx=1.0, axis=-1: _jtrap(y, dx=dx, axis=axis))
+_trapezoid_x = Primitive("trapezoid_x",
+                         lambda y, x, axis=-1: _jtrap(y, x, axis=axis))
+
+
+def trapezoid(y, x=None, dx=1.0, axis=-1, name=None):
+    if x is not None:
+        return _trapezoid_x(y, x, axis=int(axis))
+    return _trapezoid(y, dx=float(dx), axis=int(axis))
+
+
+_dist = Primitive("dist", lambda x, y, p=2.0:
+                  jnp.linalg.norm((x - y).reshape(-1).astype(jnp.float32),
+                                  ord=p))
+
+
+def dist(x, y, p=2.0, name=None):
+    return _dist(x, y, p=float(p))
+
+
+_squared_l2_norm = Primitive(
+    "squared_l2_norm",
+    lambda x: jnp.sum(jnp.square(x.astype(jnp.float32))))
+
+
+def squared_l2_norm(x, name=None):
+    return _squared_l2_norm(x)
+
+
+_clip_by_norm = Primitive(
+    "clip_by_norm",
+    lambda x, max_norm=1.0: x * jnp.minimum(
+        1.0, max_norm / jnp.maximum(
+            jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32)))), 1e-12)
+    ).astype(x.dtype))
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _clip_by_norm(x, max_norm=float(max_norm))
+
+
+# -- search --------------------------------------------------------------------
+
+_searchsorted = Primitive(
+    "searchsorted",
+    lambda sorted_seq, values, right=False: jnp.searchsorted(
+        sorted_seq, values, side="right" if right else "left"),
+    differentiable=False)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    out = _searchsorted(sorted_sequence, values, right=bool(right))
+    return out.astype("int32") if out_int32 else out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+# -- indexing ------------------------------------------------------------------
+
+def _index_apply(x, index, value, axis, kind):
+    x_m = jnp.moveaxis(x, axis, 0)
+    v_m = jnp.moveaxis(value, axis, 0) if value.ndim == x.ndim else value
+    if kind == "add":
+        out = x_m.at[index].add(v_m)
+    elif kind == "put":
+        out = x_m.at[index].set(v_m)
+    else:  # fill with scalar
+        out = x_m.at[index].set(v_m)
+    return jnp.moveaxis(out, 0, axis)
+
+
+_index_add = Primitive(
+    "index_add", lambda x, index, value, axis=0:
+    _index_apply(x, index, value, axis, "add"))
+_index_put_axis = Primitive(
+    "index_put_axis", lambda x, index, value, axis=0:
+    _index_apply(x, index, value, axis, "put"))
+_index_fill_p = Primitive(
+    "index_fill", lambda x, index, fill_value=0.0, axis=0:
+    jnp.moveaxis(jnp.moveaxis(x, axis, 0).at[index].set(
+        jnp.asarray(fill_value, x.dtype)), 0, axis))
+
+
+def index_add(x, index, axis, value, name=None):
+    return _index_add(x, index, value, axis=int(axis))
+
+
+def index_fill(x, index, axis, fill_value, name=None):
+    return _index_fill_p(x, index, fill_value=float(fill_value),
+                         axis=int(axis))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    """index_put with a tuple of index arrays (tensor indexing)."""
+    xv = unwrap(x)
+    idx = tuple(unwrap(i) for i in indices)
+    vv = unwrap(value)
+    out = xv.at[idx].add(vv) if accumulate else xv.at[idx].set(vv)
+    return Tensor(out)
+
+
+_multiplex = Primitive(
+    "multiplex",
+    lambda index, *ins: jnp.stack(ins, 0)[
+        index.reshape(-1).astype(jnp.int32),
+        jnp.arange(ins[0].shape[0])])
+
+
+def multiplex(inputs, index, name=None):
+    """multiplex_op.cc: per-row select among candidate tensors."""
+    return _multiplex(index, *inputs)
+
+
+# -- shape / structure ---------------------------------------------------------
+
+_diagonal = Primitive(
+    "diagonal", lambda x, offset=0, axis1=0, axis2=1:
+    jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _diagonal(x, offset=int(offset), axis1=int(axis1),
+                     axis2=int(axis2))
+
+
+def _diag_embed_fn(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    i = jnp.arange(x.shape[-1])
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    out = base.at[..., r, c].set(x)
+    nd = out.ndim
+    d1 = dim1 % nd
+    d2 = dim2 % nd
+    perm = [a for a in range(nd) if a not in (nd - 2, nd - 1)]
+    # insert the two matrix dims at the requested positions
+    order = []
+    src = iter(perm)
+    for a in range(nd):
+        if a == d1:
+            order.append(nd - 2)
+        elif a == d2:
+            order.append(nd - 1)
+        else:
+            order.append(next(src))
+    return jnp.transpose(out, order)
+
+
+_diag_embed = Primitive("diag_embed", _diag_embed_fn)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    return _diag_embed(x, offset=int(offset), dim1=int(dim1), dim2=int(dim2))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, name=None):
+    """unique_consecutive_op.cc (host; output size is data-dependent)."""
+    import numpy as np
+    xv = np.asarray(unwrap(x))
+    if axis is None:
+        flat = xv.reshape(-1)
+    else:
+        flat = np.moveaxis(xv, int(axis), 0)
+    keep = np.ones(len(flat), bool)
+    if len(flat) > 1:
+        diff = flat[1:] != flat[:-1]
+        keep[1:] = diff.reshape(len(flat) - 1, -1).any(axis=1) \
+            if diff.ndim > 1 else diff
+    out = flat[keep]
+    if axis is not None:
+        out = np.moveaxis(out, 0, int(axis))
+    rets = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        rets.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, len(flat)))
+        rets.append(Tensor(jnp.asarray(counts)))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    import numpy as np
+    xv = unwrap(x)
+    parts = np.array_split(np.asarray(xv), num_or_indices, axis=axis) \
+        if isinstance(num_or_indices, int) \
+        else np.split(np.asarray(xv), list(num_or_indices), axis=axis)
+    return [Tensor(jnp.asarray(p)) for p in parts]
+
+
+def unflatten(x, axis, shape, name=None):
+    xv = unwrap(x)
+    ax = axis % xv.ndim
+    new_shape = list(xv.shape[:ax]) + list(shape) + list(xv.shape[ax + 1:])
+    from .manipulation import reshape
+    return reshape(x, new_shape)
+
+
+def block_diag(inputs, name=None):
+    import numpy as np
+    mats = [np.atleast_2d(np.asarray(unwrap(m))) for m in inputs]
+    R = sum(m.shape[0] for m in mats)
+    C = sum(m.shape[1] for m in mats)
+    out = jnp.zeros((R, C), mats[0].dtype)
+    r = c = 0
+    for m in mats:
+        out = out.at[r:r + m.shape[0], c:c + m.shape[1]].set(jnp.asarray(m))
+        r += m.shape[0]
+        c += m.shape[1]
+    return Tensor(out)
+
+
+_complex_p = Primitive("complex", lambda re, im: jax.lax.complex(re, im))
+
+
+def complex(real, imag, name=None):
+    return _complex_p(real, imag)
+
+
+_tensordot = Primitive(
+    "tensordot", lambda x, y, axes=2: jnp.tensordot(x, y, axes=axes))
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(axes, (list, tuple)):
+        ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                   for a in axes)
+    return _tensordot(x, y, axes=ax)
+
+
+_vander = Primitive(
+    "vander", lambda x, n=None, increasing=False:
+    jnp.vander(x, N=n, increasing=increasing))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return _vander(x, n=None if n is None else int(n),
+                   increasing=bool(increasing))
+
+
+_renorm = Primitive(
+    "renorm", lambda x, p=2.0, axis=0, max_norm=1.0:
+    _renorm_impl(x, p, axis, max_norm))
+
+
+def _renorm_impl(x, p, axis, max_norm):
+    reduce_axes = tuple(a for a in range(x.ndim) if a != axis)
+    norms = jnp.sum(jnp.abs(x.astype(jnp.float32)) ** p,
+                    axis=reduce_axes, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return (x * scale).astype(x.dtype)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    return _renorm(x, p=float(p), axis=int(axis), max_norm=float(max_norm))
+
+
+# -- metrics / misc ------------------------------------------------------------
+
+def _accuracy_fn(pred_topk_idx, label, k=1):
+    hit = jnp.any(pred_topk_idx[:, :k] == label.reshape(-1, 1), axis=1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+_accuracy = Primitive("accuracy", _accuracy_fn, differentiable=False)
+
+
+def accuracy(input, label, k=1, name=None):
+    """accuracy_op.cc: fraction of rows whose top-k contains the label."""
+    from .math import topk
+    _, idx = topk(input, k=k)
+    return _accuracy(idx, label, k=int(k))
+
+
+def rank(x, name=None):
+    return Tensor(jnp.asarray(unwrap(x).ndim))
+
+
+# reference-named reduce aliases (fluid.layers.reduce_* DSL)
+def reduce_sum(x, dim=None, keep_dim=False, name=None):
+    from . import math as _m
+    return _m.sum(x, axis=dim, keepdim=keep_dim)
+
+
+def reduce_mean(x, dim=None, keep_dim=False, name=None):
+    from . import math as _m
+    return _m.mean(x, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(x, dim=None, keep_dim=False, name=None):
+    from . import math as _m
+    return _m.max(x, axis=dim, keepdim=keep_dim)
+
+
+def reduce_min(x, dim=None, keep_dim=False, name=None):
+    from . import math as _m
+    return _m.min(x, axis=dim, keepdim=keep_dim)
+
+
+# -- long-tail additions (round 2) --------------------------------------------
+
+polar = _binary("polar", lambda r, t: jax.lax.complex(r * jnp.cos(t),
+                                                      r * jnp.sin(t)))
+sgn = _unary("sgn", lambda x: jnp.where(
+    jnp.abs(x) == 0, jnp.zeros_like(x), x / jnp.abs(x))
+    if jnp.iscomplexobj(x) else jnp.sign(x))
+isposinf = _unary("isposinf", jnp.isposinf, differentiable=False)
+isneginf = _unary("isneginf", jnp.isneginf, differentiable=False)
+
+
+def _take_fn(x, idx, mode="raise"):
+    flat = x.reshape(-1)
+    if mode in ("raise", "clip"):
+        idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
+        return jnp.take(flat, idx, mode="clip")
+    return jnp.take(flat, idx, mode=mode)
+
+
+_take = Primitive("take", _take_fn)
+
+
+def take(x, index, mode="raise", name=None):
+    """take_op parity (paddle.take): flattened gather with clip/wrap modes.
+    ``raise`` degrades to clip under jit (no data-dependent errors on TPU)."""
+    return _take(x, unwrap(index), mode=mode)
+
+
+def reverse(x, axis, name=None):
+    """reverse_op.cc (fluid.layers.reverse): flip along the given axes."""
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+_nanquantile = Primitive(
+    "nanquantile", lambda x, q, axis=None, keepdim=False:
+    jnp.nanquantile(x, q, axis=axis, keepdims=keepdim))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return _nanquantile(x, q=q, axis=axis, keepdim=keepdim)
+
+
+def _histogramdd_fn(x, weights=None, bins=10, ranges=None, density=False):
+    h, edges = jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                               weights=weights)
+    return (h,) + tuple(edges)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """histogramdd (paddle.histogramdd). Returns (hist, [edges...]).
+    ``ranges`` uses paddle's flat [min0, max0, min1, max1, ...] layout."""
+    x = unwrap(x)
+    w = None if weights is None else unwrap(weights)
+    if ranges is not None:
+        r = [float(v) for v in ranges]
+        ranges = [(r[2 * i], r[2 * i + 1]) for i in range(len(r) // 2)]
+    h, *edges = _histogramdd_fn(x, w, bins=bins, ranges=ranges,
+                                density=density)
+    return Tensor(h), [Tensor(e) for e in edges]
+
+
+def _partial_slice(x, start_index, length):
+    # partial_concat_op.cc normalizes negative start by the column count
+    s = start_index if start_index >= 0 else start_index + x.shape[1]
+    return x[:, s:] if length < 0 else x[:, s:s + length]
+
+
+def _partial_concat_fn(*xs, start_index=0, length=-1):
+    return jnp.concatenate(
+        [_partial_slice(x, start_index, length) for x in xs], axis=1)
+
+
+_partial_concat = Primitive("partial_concat", _partial_concat_fn)
+
+
+def partial_concat(x, start_index=0, length=-1, name=None):
+    """partial_concat_op.cc: concat a [start:start+length] column slice of
+    each [B, D] input."""
+    return _partial_concat(*[unwrap(t) for t in x],
+                           start_index=int(start_index), length=int(length))
+
+
+def _partial_sum_fn(*xs, start_index=0, length=-1):
+    sl = [_partial_slice(x, start_index, length) for x in xs]
+    return sum(sl[1:], sl[0])
+
+
+_partial_sum = Primitive("partial_sum", _partial_sum_fn)
+
+
+def partial_sum(x, start_index=0, length=-1, name=None):
+    """partial_sum_op.cc: sum the same column slice of each input."""
+    return _partial_sum(*[unwrap(t) for t in x],
+                        start_index=int(start_index), length=int(length))
+
+
+__all__ = [
+    "logaddexp", "heaviside", "gcd", "lcm", "copysign", "nextafter",
+    "signbit", "sinc", "exp2", "erfc", "ldexp", "nanmean", "nanmedian",
+    "logcumsumexp", "cummin", "kthvalue", "diff", "trapezoid", "dist",
+    "squared_l2_norm", "clip_by_norm", "searchsorted", "bucketize",
+    "index_add", "index_fill", "index_put", "multiplex", "diagonal",
+    "diag_embed", "unique_consecutive", "tensor_split", "unflatten",
+    "block_diag", "complex", "tensordot", "vander", "renorm", "accuracy",
+    "rank", "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+    "polar", "sgn", "isposinf", "isneginf", "take", "reverse",
+    "nanquantile", "histogramdd", "partial_concat", "partial_sum",
+]

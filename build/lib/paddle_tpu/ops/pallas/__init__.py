@@ -1,0 +1,34 @@
+"""Pallas TPU kernels (the fused-op family of the reference,
+/root/reference/paddle/fluid/operators/fused/, rebuilt as on-chip kernels).
+
+Exports ``flash_attention`` working on framework Tensors (tape-autograd via
+the Primitive machinery; the kernel carries its own custom VJP) and the pure
+array-level ``flash_attention_fn`` for compiled train steps.
+"""
+from __future__ import annotations
+
+from ...framework.primitive import Primitive
+from .flash_attention import (DEFAULT_BLOCK, flash_attention_fn, supports)
+
+
+def _flash_nobias(q, k, v, *, causal=False, scale=None):
+    return flash_attention_fn(q, k, v, None, causal=causal, scale=scale)
+
+
+def _flash_bias(q, k, v, bias, *, causal=False, scale=None):
+    return flash_attention_fn(q, k, v, bias, causal=causal, scale=scale)
+
+
+_flash_prim = Primitive("flash_attention", _flash_nobias)
+_flash_bias_prim = Primitive("flash_attention_bias", _flash_bias)
+
+
+def flash_attention(q, k, v, bias=None, causal=False, scale=None):
+    """Flash attention on (B, N, S, H) Tensors; additive ``bias`` optional."""
+    if bias is None:
+        return _flash_prim(q, k, v, causal=bool(causal), scale=scale)
+    return _flash_bias_prim(q, k, v, bias, causal=bool(causal), scale=scale)
+
+
+__all__ = ["flash_attention", "flash_attention_fn", "supports",
+           "DEFAULT_BLOCK"]

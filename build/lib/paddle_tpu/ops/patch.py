@@ -1,0 +1,312 @@
+"""Monkey-patch operator methods onto Tensor.
+
+Reference parity: python/paddle/fluid/dygraph/math_op_patch.py and
+varbase_patch_methods.py -- Paddle itself patches arithmetic dunders and tensor
+methods onto VarBase at import; we do the same so framework/tensor.py stays
+free of op imports (no circular deps).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.primitive import Primitive
+from ..framework.tensor import Tensor, unwrap
+from . import creation, manipulation, math as m
+
+
+def _coerce(other, like):
+    if isinstance(other, Tensor):
+        return other
+    return other  # jnp weak-type promotion keeps paddle scalar semantics
+
+
+# ---- indexing ----------------------------------------------------------------
+
+_getitem_cache = {}
+
+
+def _encode_index(idx, nd):
+    """Encode a (possibly nested) index into a hashable static spec; tensor
+    indices are returned separately as dynamic args."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    spec, dynamic = [], []
+    for it in idx:
+        if isinstance(it, Tensor) or type(it).__name__ == "Variable":
+            if it.dtype == jnp.bool_:
+                spec.append(("mask",))
+            else:
+                spec.append(("arr",))
+            dynamic.append(unwrap(it) if isinstance(it, Tensor) else it)
+        elif isinstance(it, (np.ndarray, list)):
+            arr = jnp.asarray(np.asarray(it))
+            spec.append(("mask",) if arr.dtype == jnp.bool_ else ("arr",))
+            dynamic.append(arr)
+        elif isinstance(it, builtins_slice):
+            spec.append(("slice", it.start, it.stop, it.step))
+        elif it is None:
+            spec.append(("none",))
+        elif it is Ellipsis:
+            spec.append(("ellipsis",))
+        else:
+            spec.append(("int", int(it)))
+    return tuple(spec), dynamic
+
+
+builtins_slice = slice
+
+
+def _decode_index(spec, dynamic):
+    out, di = [], 0
+    for s in spec:
+        kind = s[0]
+        if kind in ("mask", "arr"):
+            out.append(dynamic[di]); di += 1
+        elif kind == "slice":
+            out.append(builtins_slice(s[1], s[2], s[3]))
+        elif kind == "none":
+            out.append(None)
+        elif kind == "ellipsis":
+            out.append(Ellipsis)
+        else:
+            out.append(s[1])
+    return tuple(out)
+
+
+def _getitem_fn(x, *dynamic, spec=()):
+    return x[_decode_index(spec, list(dynamic))]
+
+
+_getitem = Primitive("getitem", _getitem_fn)
+
+
+def _tensor_getitem(self, idx):
+    spec, dynamic = _encode_index(idx, self.ndim)
+    if any(s[0] == "mask" for s in spec):
+        if not isinstance(self, Tensor) or \
+                any(not isinstance(d, (Tensor, jnp.ndarray, np.ndarray))
+                    and hasattr(d, "shape") for d in dynamic):
+            raise TypeError(
+                "boolean-mask indexing has a data-dependent shape and "
+                "cannot be recorded in a static program; use "
+                "paddle.masked_select with a fixed-size fallback or index "
+                "eagerly")
+        # boolean masking has a data-dependent shape: eager numpy path
+        full = _decode_index(spec, dynamic)
+        return Tensor(jnp.asarray(np.asarray(self.numpy()[
+            tuple(np.asarray(d) if hasattr(d, "shape") else d for d in full)])))
+    return _getitem(self, *dynamic, spec=spec)
+
+
+def _setitem_fn(x, v, *dynamic, spec=()):
+    return x.at[_decode_index(spec, list(dynamic))].set(v)
+
+
+_setitem = Primitive("setitem", _setitem_fn)
+
+
+def _old_version(s):
+    """Snapshot the pre-mutation version of a non-leaf tensor for in-place
+    ops: the recorded op must consume the OLD (node, out_index) edge, not
+    the tensor object that is about to be re-pointed at the new node —
+    GradNode captures edges at record time, so earlier consumers keep the
+    pre-mutation version and this op sees it too. Leaves need no snapshot:
+    their edge is (None, ·) and gradient accumulation targets the tensor
+    object itself."""
+    from ..framework.tensor import Tensor
+    old = Tensor(s._value, stop_gradient=s.stop_gradient)
+    old._node = s._node
+    old._out_index = s._out_index
+    old.is_leaf = s.is_leaf
+    return old
+
+
+def _adopt(s, out):
+    """Point s at the freshly computed version (in-place surface). The
+    version bump makes a later backward through PRE-mutation consumers of
+    a leaf raise instead of applying stale gradients (inplace version
+    check parity). The mutating op ITSELF legitimately consumed the old
+    value, so its own edge is re-stamped to the new version."""
+    boundary = s._node   # pre-mutation lineage tip (delta-walk wall below)
+    s._value = out._value
+    s._node = out._node
+    s._out_index = out._out_index
+    s._version += 1
+    if out._node is not None:
+        # Backward's version check reads edge versions only on LEAF
+        # (None, ·) edges, so the only edges ever needing a re-stamp are
+        # leaf edges to s held by nodes inside the mutation's own lineage
+        # — i.e. former mutating ops of s (their primals captured the
+        # consumed value, so replay is always valid; chained x.add_();
+        # x.add_() must not false-positive).  Those edges are stamped with
+        # a permanent None exemption, ONCE, so they never re-qualify.
+        # Unrelated pre-mutation consumers keep the stale version and the
+        # leaf check still fires for them.
+        targets = set()
+        if s._consumers:
+            live = []
+            for ref in s._consumers:
+                c = ref()
+                if c is not None and c.inputs is not None:
+                    live.append(ref)
+                    if any(t is s and p is None and v is not None
+                           for t, (p, oi, v) in
+                           zip(c.inputs, c.input_edges)):
+                        targets.add(id(c))
+            s._consumers = live or None
+        if targets:
+            # delta walk: ancestors of the previous tip were searched (for
+            # these same still-unresolved targets) by earlier adoptions,
+            # so stop at the boundary node — each region of the graph is
+            # visited at most once across a chain of in-place ops
+            seen = set()
+            stack = [out._node]
+            while stack and targets:
+                node = stack.pop()
+                if id(node) in seen or node is boundary or \
+                        node.inputs is None:
+                    continue
+                seen.add(id(node))
+                if id(node) in targets:
+                    targets.discard(id(node))
+                    node.input_edges = tuple(
+                        (p, oi, None) if (t is s and p is None)
+                        else (p, oi, v)
+                        for t, (p, oi, v) in
+                        zip(node.inputs, node.input_edges))
+                for (p, _, _) in node.input_edges:
+                    if p is not None:
+                        stack.append(p)
+        s.stop_gradient = False
+        s.is_leaf = False
+    return s
+
+
+def _tensor_setitem(self, idx, value):
+    spec, dynamic = _encode_index(idx, self.ndim)
+    v = unwrap(value)
+    if not hasattr(v, "dtype"):
+        v = jnp.asarray(v, self.dtype)
+    from ..framework import core
+    if core.grad_enabled() and self._node is not None:
+        out = _setitem(_old_version(self), v, *dynamic, spec=spec)
+    else:
+        out = _setitem(self, v, *dynamic, spec=spec)
+    # functional update with in-place surface semantics (paddle __setitem__)
+    _adopt(self, out)
+
+
+def apply_patches(T=None, eager=True):
+    """Install operator methods. Called with the eager Tensor at import and
+    with the static Variable class by paddle_tpu.static (the math_op_patch
+    dual of framework.py's static Variable operator overloads)."""
+    if T is None:
+        T = Tensor
+    # arithmetic
+    T.__add__ = lambda s, o: m.add(s, _coerce(o, s))
+    T.__radd__ = lambda s, o: m.add(_coerce(o, s), s)
+    T.__sub__ = lambda s, o: m.subtract(s, _coerce(o, s))
+    T.__rsub__ = lambda s, o: m.subtract(_coerce(o, s), s)
+    T.__mul__ = lambda s, o: m.multiply(s, _coerce(o, s))
+    T.__rmul__ = lambda s, o: m.multiply(_coerce(o, s), s)
+    T.__truediv__ = lambda s, o: m.divide(s, _coerce(o, s))
+    T.__rtruediv__ = lambda s, o: m.divide(_coerce(o, s), s)
+    T.__floordiv__ = lambda s, o: m.floor_divide(s, _coerce(o, s))
+    T.__mod__ = lambda s, o: m.mod(s, _coerce(o, s))
+    T.__pow__ = lambda s, o: m.pow(s, _coerce(o, s))
+    T.__rpow__ = lambda s, o: m.pow(_coerce(o, s), s)
+    T.__neg__ = lambda s: m.neg(s)
+    T.__abs__ = lambda s: m.abs(s)
+    T.__matmul__ = lambda s, o: m.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: m.matmul(o, s)
+    # comparisons
+    T.__eq__ = lambda s, o: m.equal(s, _coerce(o, s))
+    T.__ne__ = lambda s, o: m.not_equal(s, _coerce(o, s))
+    T.__lt__ = lambda s, o: m.less_than(s, _coerce(o, s))
+    T.__le__ = lambda s, o: m.less_equal(s, _coerce(o, s))
+    T.__gt__ = lambda s, o: m.greater_than(s, _coerce(o, s))
+    T.__ge__ = lambda s, o: m.greater_equal(s, _coerce(o, s))
+    T.__invert__ = lambda s: m.logical_not(s)
+    T.__and__ = lambda s, o: m.logical_and(s, o) if s.dtype == jnp.bool_ else m.bitwise_and(s, o)
+    T.__or__ = lambda s, o: m.logical_or(s, o) if s.dtype == jnp.bool_ else m.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: m.logical_xor(s, o) if s.dtype == jnp.bool_ else m.bitwise_xor(s, o)
+    # indexing (in-place setitem is eager-only; static programs are SSA)
+    T.__getitem__ = _tensor_getitem
+    if eager:
+        T.__setitem__ = _tensor_setitem
+
+    # methods: math
+    for name in ["add", "subtract", "multiply", "divide", "pow", "mod",
+                 "maximum", "minimum", "matmul", "mm", "bmm", "dot", "exp",
+                 "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "abs",
+                 "sin", "cos", "tan", "tanh", "floor", "ceil", "round",
+                 "sign", "reciprocal", "square", "erf", "neg", "sum", "mean",
+                 "prod", "max", "min", "std", "var", "logsumexp", "all",
+                 "any", "cumsum", "cumprod", "argmax", "argmin", "argsort",
+                 "sort", "topk", "clip", "scale", "equal", "not_equal",
+                 "greater_than", "greater_equal", "less_than", "less_equal",
+                 "logical_and", "logical_or", "logical_not", "isnan", "isinf",
+                 "isfinite", "allclose", "equal_all", "trace", "kron",
+                 "lerp", "outer", "inner", "t", "nan_to_num", "atan", "asin",
+                 "acos", "sinh", "cosh", "expm1", "trunc", "frac", "angle"]:
+        setattr(T, name, _method(getattr(m, name)))
+    # methods: manipulation
+    for name in ["reshape", "transpose", "concat", "split", "chunk", "squeeze",
+                 "unsqueeze", "flatten", "expand", "expand_as", "broadcast_to",
+                 "tile", "gather", "gather_nd", "scatter", "scatter_nd_add",
+                 "index_select", "masked_select", "flip", "roll", "unbind",
+                 "unstack", "where", "take_along_axis", "put_along_axis",
+                 "moveaxis", "swapaxes", "unique", "repeat_interleave",
+                 "rot90", "index_sample"]:
+        setattr(T, name, _method(getattr(manipulation, name)))
+    T.cast = lambda s, dtype: manipulation.cast(s, dtype)
+    T.astype = lambda s, dtype: manipulation.cast(s, dtype)
+    T.masked_fill = _method(m.masked_fill)
+    if eager:
+        T.fill_ = lambda s, v: s.set_value(jnp.full_like(s._value, float(v)))
+        T.zero_ = lambda s: s.set_value(jnp.zeros_like(s._value))
+        # in-place arithmetic (math_op_patch add_/subtract_/scale_ family):
+        # functional update with in-place surface semantics — the recorded
+        # op consumes the OLD version and the tensor adopts the new node,
+        # so the mutation stays on the tape without a graph cycle
+        def _inplace(compute):
+            def run(s, *args, **kwargs):
+                from ..framework import core
+                src = _old_version(s) if (core.grad_enabled() and
+                                          s._node is not None) else s
+                return _adopt(s, compute(src, *args, **kwargs))
+            return run
+
+        T.add_ = _inplace(lambda s, o: s + _coerce(o, s))
+        T.subtract_ = _inplace(lambda s, o: s - _coerce(o, s))
+        T.multiply_ = _inplace(lambda s, o: s * _coerce(o, s))
+        T.scale_ = _inplace(
+            lambda s, scale=1.0, bias=0.0, bias_after_scale=True:
+            m.scale(s, scale=scale, bias=bias,
+                    bias_after_scale=bias_after_scale))
+        T.clip_ = _inplace(lambda s, min=None, max=None: m.clip(s, min, max))
+    T.norm = _method_norm
+    # misc method parity (varbase_patch_methods)
+    T.ndimension = lambda s: len(s.shape)
+    T.rank = lambda s: len(s.shape)
+    T.element_size = lambda s: jnp.dtype(s.dtype).itemsize
+    T.contiguous = lambda s: s                 # XLA arrays are always dense
+    T.is_contiguous = lambda s: True
+    T.slice = lambda s, axes, starts, ends: manipulation.slice(
+        s, axes, starts, ends)
+    if eager:
+        T.gradient = lambda s: (None if s.grad is None
+                                else s.grad.numpy())
+
+
+def _method(fn):
+    def bound(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+    bound.__name__ = fn.__name__
+    return bound
+
+
+def _method_norm(self, p=2, axis=None, keepdim=False, name=None):
+    from . import linalg
+    return linalg.norm(self, p=p, axis=axis, keepdim=keepdim)

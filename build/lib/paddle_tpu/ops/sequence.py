@@ -1,0 +1,384 @@
+"""Sequence ops: the LoD (level-of-detail) op family, lengths-based.
+
+Reference parity: paddle/fluid/operators/sequence_ops/ —
+sequence_pool_op.cc (SUM/MEAN/MAX/SQRT/FIRST/LAST over ragged rows),
+sequence_softmax_op.cc, sequence_expand_op.cc, sequence_reverse_op.h,
+sequence_mask_op.cc, sequence_pad_op.cc / sequence_unpad_op.cc,
+sequence_concat_op.cc, sequence_erase, sequence_slice.
+
+TPU-first ragged story: XLA needs static shapes, so LoD offsets become a
+dense ``[batch, max_len, ...]`` tensor + a ``lengths [batch]`` vector (the
+representation sequence_pad_op converts *to*; here it is the native one).
+Every op is a masked dense expression the compiler fuses — no per-row host
+loops.  ``lengths`` is always an array argument, so varying raggedness
+never recompiles.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.primitive import Primitive
+from ..framework.tensor import Tensor, unwrap
+
+
+def _mask(lengths, max_len):
+    # [B, T] validity mask from lengths
+    return (jnp.arange(max_len)[None, :] <
+            jnp.reshape(lengths, (-1, 1))).astype(jnp.bool_)
+
+
+def _sequence_pool_fn(x, lengths, pool_type="SUM"):
+    B, T = x.shape[0], x.shape[1]
+    m = _mask(lengths, T)
+    me = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    xf = x.astype(jnp.float32)
+    n = jnp.maximum(lengths.astype(jnp.float32), 1.0)
+    n = n.reshape((-1,) + (1,) * (x.ndim - 2))
+    if pool_type == "SUM":
+        out = jnp.sum(jnp.where(me, xf, 0), axis=1)
+    elif pool_type == "AVERAGE" or pool_type == "MEAN":
+        out = jnp.sum(jnp.where(me, xf, 0), axis=1) / n
+    elif pool_type == "SQRT":
+        out = jnp.sum(jnp.where(me, xf, 0), axis=1) / jnp.sqrt(n)
+    elif pool_type == "MAX":
+        out = jnp.max(jnp.where(me, xf, -jnp.inf), axis=1)
+        out = jnp.where(lengths.reshape(n.shape) > 0, out, 0.0)
+    elif pool_type == "FIRST":
+        out = xf[:, 0]
+    elif pool_type == "LAST":
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(
+            xf, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    else:
+        raise ValueError(f"unknown pool_type {pool_type}")
+    return out.astype(x.dtype)
+
+
+def _sequence_softmax_fn(x, lengths):
+    m = _mask(lengths, x.shape[1])
+    logits = jnp.where(m, x.astype(jnp.float32), -jnp.inf)
+    out = jax.nn.softmax(logits, axis=1)
+    return jnp.where(m, out, 0.0).astype(x.dtype)
+
+
+def _sequence_mask_fn(lengths, maxlen=None, out_dtype="int64"):
+    T = int(maxlen)
+    return (jnp.arange(T)[None, :] <
+            jnp.reshape(lengths, (-1, 1))).astype(out_dtype)
+
+
+def _sequence_reverse_fn(x, lengths):
+    T = x.shape[1]
+    idx = jnp.arange(T)[None, :]
+    L = jnp.reshape(lengths, (-1, 1))
+    rev = jnp.where(idx < L, L - 1 - idx, idx)  # valid prefix reversed
+    return jnp.take_along_axis(
+        x, rev.reshape(rev.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def _sequence_pad_fn(x, lengths, pad_value=0.0):
+    m = _mask(lengths, x.shape[1])
+    me = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    return jnp.where(me, x, jnp.asarray(pad_value, x.dtype))
+
+
+def _sequence_unpad_mask_fn(x, lengths):
+    # dense form of unpad: zero out the padding (true ragged flatten is a
+    # dynamic shape; consumers use (values, lengths) pairs)
+    return _sequence_pad_fn(x, lengths, 0.0)
+
+
+def _sequence_first_step_fn(x, lengths):
+    return _sequence_pool_fn(x, lengths, pool_type="FIRST")
+
+
+def _sequence_last_step_fn(x, lengths):
+    return _sequence_pool_fn(x, lengths, pool_type="LAST")
+
+
+def _sequence_erase_fn(x, lengths, tokens=()):
+    """Remove listed token ids: compacts each row left, returns (new_x,
+    new_lengths) with the same padded width (sequence_erase_op.cc)."""
+    B, T = x.shape
+    valid = _mask(lengths, T)
+    keep = valid
+    for t in tokens:
+        keep = keep & (x != t)
+    # stable left-compaction via argsort on (not keep)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    new_x = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1)
+    new_x = jnp.where(_mask(new_len, T), new_x, 0)
+    return new_x, new_len
+
+
+def _sequence_slice_fn(x, offset, length, max_len):
+    """Per-row slice [offset, offset+length) left-aligned into a
+    [B, max_len, ...] buffer (sequence_slice_op.h)."""
+    T = x.shape[1]
+    idx = jnp.arange(max_len)[None, :]
+    src = jnp.clip(idx + jnp.reshape(offset, (-1, 1)), 0, T - 1)
+    g = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    m = idx < jnp.reshape(length, (-1, 1))
+    return jnp.where(m.reshape(m.shape + (1,) * (x.ndim - 2)), g, 0)
+
+
+_seq_pool = Primitive("sequence_pool", _sequence_pool_fn)
+_seq_softmax = Primitive("sequence_softmax", _sequence_softmax_fn)
+_seq_mask = Primitive("sequence_mask", _sequence_mask_fn,
+                      differentiable=False)
+_seq_reverse = Primitive("sequence_reverse", _sequence_reverse_fn)
+_seq_pad = Primitive("sequence_pad", _sequence_pad_fn)
+_seq_unpad = Primitive("sequence_unpad", _sequence_unpad_mask_fn)
+_seq_first = Primitive("sequence_first_step", _sequence_first_step_fn)
+_seq_last = Primitive("sequence_last_step", _sequence_last_step_fn)
+_seq_erase = Primitive("sequence_erase", _sequence_erase_fn,
+                       multi_output=True, differentiable=False)
+_seq_slice = Primitive("sequence_slice", _sequence_slice_fn)
+
+
+def sequence_pool(x, lengths, pool_type="SUM", name=None):
+    return _seq_pool(x, lengths, pool_type=str(pool_type).upper())
+
+
+def sequence_softmax(x, lengths, name=None):
+    return _seq_softmax(x, lengths)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    if maxlen is None:
+        import numpy as np
+        maxlen = int(np.asarray(unwrap(lengths)).max())
+    return _seq_mask(lengths, maxlen=int(maxlen), out_dtype=str(dtype))
+
+
+def sequence_reverse(x, lengths, name=None):
+    return _seq_reverse(x, lengths)
+
+
+def sequence_pad(x, lengths, pad_value=0.0, name=None):
+    return _seq_pad(x, lengths, pad_value=float(pad_value))
+
+
+def sequence_unpad(x, lengths, name=None):
+    return _seq_unpad(x, lengths)
+
+
+def sequence_first_step(x, lengths, name=None):
+    return _seq_first(x, lengths)
+
+
+def sequence_last_step(x, lengths, name=None):
+    return _seq_last(x, lengths)
+
+
+def sequence_erase(x, lengths, tokens, name=None):
+    return _seq_erase(x, lengths, tokens=tuple(int(t) for t in tokens))
+
+
+def sequence_slice(x, offset, length, max_len=None, name=None):
+    """Output width is max_len when given, else the input's time dim."""
+    if max_len is None:
+        max_len = int(unwrap(x).shape[1])
+    return _seq_slice(x, offset, length, max_len=int(max_len))
+
+
+def sequence_expand(x, y_lengths, name=None):
+    """sequence_expand_op.cc (ref_level 0 dense form): row i of x tiled
+    y_lengths[i] times into a [B, max_rep, ...] padded tensor."""
+    import numpy as np
+    max_rep = int(np.asarray(unwrap(y_lengths)).max())
+    return _seq_expand(x, y_lengths, max_rep=max_rep)
+
+
+def _sequence_expand_impl(x, reps, max_rep=1):
+    B = x.shape[0]
+    tiled = jnp.repeat(x[:, None], max_rep, axis=1)
+    m = _mask(reps, max_rep)
+    return jnp.where(m.reshape(m.shape + (1,) * (x.ndim - 1)), tiled, 0)
+
+
+_seq_expand = Primitive("sequence_expand", _sequence_expand_impl)
+
+
+
+# -- round-2 long tail ---------------------------------------------------------
+
+def _sequence_concat_fn(*args):
+    """sequence_concat_op.cc: per-row concatenation of ragged sequences.
+    args = x1, len1, x2, len2, ... -> (out [B, sumT, ...], out_lengths).
+    Rows are repacked so each output row is row_i(x1)+row_i(x2)+..."""
+    xs = args[0::2]
+    lens = args[1::2]
+    B = xs[0].shape[0]
+    T_out = sum(x.shape[1] for x in xs)
+    feat = xs[0].shape[2:]
+    out = jnp.zeros((B, T_out) + feat, xs[0].dtype)
+    total = jnp.zeros((B,), lens[0].dtype)
+    # scatter each segment at its running offset via masked index math
+    pos_out = jnp.arange(T_out)[None, :]                 # [1, T_out]
+    for x, l in zip(xs, lens):
+        T = x.shape[1]
+        start = total[:, None]                           # [B, 1]
+        src_idx = jnp.clip(pos_out - start, 0, T - 1)
+        gathered = jnp.take_along_axis(
+            x, src_idx.reshape((B, T_out) + (1,) * len(feat)), axis=1)
+        valid = (pos_out >= start) & (pos_out < start + l[:, None])
+        out = jnp.where(valid.reshape((B, T_out) + (1,) * len(feat)),
+                        gathered, out)
+        total = total + l
+    return out, total
+
+
+_sequence_concat = Primitive("sequence_concat", _sequence_concat_fn,
+                             multi_output=True)
+
+
+def sequence_concat(xs, lengths_list, name=None):
+    """Concat ragged rows: returns (packed [B, sum(maxT), ...], lengths)."""
+    flat = []
+    for x, l in zip(xs, lengths_list):
+        flat += [x, unwrap(l).astype(jnp.int32)]
+    return _sequence_concat(*flat)
+
+
+def _sequence_expand_as_fn(x, y_lengths, T=1):
+    rep = jnp.repeat(x[:, None], T, axis=1)
+    m = _mask(y_lengths, T).reshape((x.shape[0], T) + (1,) * (x.ndim - 1))
+    return jnp.where(m, rep, 0)
+
+
+_sequence_expand_as = Primitive("sequence_expand_as",
+                                _sequence_expand_as_fn)
+
+
+def sequence_expand_as(x, y, y_lengths, name=None):
+    """sequence_expand_as_op.cc: expand each row of x to match y's row
+    lengths — dense form broadcasts x over y's time axis, masked by
+    y_lengths."""
+    yl = unwrap(y_lengths).astype(jnp.int32)
+    return _sequence_expand_as(x, yl, T=int(unwrap(y).shape[1]))
+
+
+def _sequence_enumerate_fn(x, lengths, win_size=2, pad_value=0):
+    """sequence_enumerate_op.cc: sliding windows of ids per row,
+    padded with pad_value past each row's length. x [B, T] int ->
+    [B, T, win_size]."""
+    B, T = x.shape
+    idx = jnp.arange(T)[None, :, None] + jnp.arange(win_size)[None, None, :]
+    idx = jnp.broadcast_to(idx, (B, T, win_size))
+    valid_src = idx < lengths[:, None, None]
+    g = jnp.take_along_axis(
+        x, jnp.clip(idx, 0, T - 1).reshape(B, -1), axis=1).reshape(
+        B, T, win_size)
+    out = jnp.where(valid_src, g, jnp.asarray(pad_value, x.dtype))
+    # positions beyond the row's length are all pad
+    row_valid = (jnp.arange(T)[None, :, None] < lengths[:, None, None])
+    return jnp.where(row_valid, out, jnp.asarray(pad_value, x.dtype))
+
+
+_sequence_enumerate = Primitive("sequence_enumerate",
+                                _sequence_enumerate_fn,
+                                differentiable=False)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, lengths=None,
+                       name=None):
+    x = unwrap(input)
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    else:
+        lengths = unwrap(lengths).astype(jnp.int32)
+    return _sequence_enumerate(x, lengths, win_size=int(win_size),
+                               pad_value=int(pad_value))
+
+
+def _sequence_reshape_fn(x, lengths, new_dim=1):
+    """sequence_reshape_op.cc: refold each row's (len*dim) payload to
+    new_dim-wide rows; dense form reshapes the whole [B, T, D] block and
+    rescales lengths."""
+    B, T, D = x.shape
+    out = x.reshape(B, (T * D) // new_dim, new_dim)
+    new_len = (lengths * D) // new_dim
+    return out, new_len
+
+
+_sequence_reshape = Primitive("sequence_reshape", _sequence_reshape_fn,
+                              multi_output=True)
+
+
+def sequence_reshape(input, new_dim, lengths=None, name=None):
+    import numpy as np
+    from ..framework.enforce import InvalidArgumentError
+    B, T, D = unwrap(input).shape
+    new_dim = int(new_dim)
+    if (T * D) % new_dim != 0:
+        raise InvalidArgumentError(
+            f"T*D={T * D} not divisible by new_dim={new_dim}",
+            op="sequence_reshape")
+    if lengths is None:
+        lengths = jnp.full((B,), T, jnp.int32)
+    else:
+        lengths = unwrap(lengths).astype(jnp.int32)
+        # per-ROW payloads must refold exactly (the reference enforces
+        # this); only checkable when lengths are concrete (eager)
+        if not isinstance(lengths, jax.core.Tracer):
+            lv = np.asarray(lengths)
+            if lv.size and np.any((lv * D) % new_dim != 0):
+                raise InvalidArgumentError(
+                    f"row payloads (lengths*{D}) not divisible by "
+                    f"new_dim={new_dim}", op="sequence_reshape")
+    return _sequence_reshape(input, lengths, new_dim=new_dim)
+
+
+def _sequence_conv_fn(x, w, lengths, context_length=3, context_start=-1):
+    """sequence_conv_op.cc: per-row temporal context window matmul — the
+    im2col over time (context_start offset) followed by one MXU matmul,
+    with out-of-row taps zeroed."""
+    B, T, D = x.shape
+    taps = []
+    for k in range(context_length):
+        off = context_start + k
+        idx = jnp.arange(T) + off
+        valid = (idx >= 0) & (idx < lengths[:, None])
+        g = jnp.take(x, jnp.clip(idx, 0, T - 1), axis=1)
+        taps.append(jnp.where(valid[..., None], g, 0))
+    col = jnp.concatenate(taps, axis=-1)            # [B, T, ctx*D]
+    out = col @ w                                   # [B, T, out_dim]
+    m = _mask(lengths, T)[..., None]
+    return jnp.where(m, out, 0)
+
+
+_sequence_conv = Primitive("sequence_conv", _sequence_conv_fn)
+
+
+def sequence_conv(input, weight, lengths=None, context_length=3,
+                  context_start=None, padding=True, name=None):
+    """Temporal context conv over ragged rows. weight
+    [context_length*D, out_dim]."""
+    if not padding:
+        raise NotImplementedError(
+            "sequence_conv(padding=False) (trainable PaddingData) is not "
+            "supported; out-of-row taps are zero-padded")
+    x = unwrap(input)
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    else:
+        lengths = unwrap(lengths).astype(jnp.int32)
+    if context_start is None:
+        # reference default: padding_start = -int(context_length / 2)
+        context_start = -int(context_length // 2)
+    return _sequence_conv(input, weight, lengths,
+                          context_length=int(context_length),
+                          context_start=int(context_start))
+
+
+__all__ = ["sequence_pool", "sequence_softmax", "sequence_mask",
+           "sequence_reverse", "sequence_pad", "sequence_unpad",
+           "sequence_first_step", "sequence_last_step", "sequence_erase",
+           "sequence_slice", "sequence_expand", "sequence_concat",
+           "sequence_expand_as", "sequence_enumerate", "sequence_reshape",
+           "sequence_conv"]

@@ -1,0 +1,198 @@
+"""Spatial/vision ops: grid sampling, affine grids, im2col/col2im, shuffles.
+
+Reference parity: grid_sampler_op.cc, affine_grid_op.cc,
+unfold_op (im2col — fold is its col2im inverse, math/im2col.cc),
+pixel_shuffle_op.cc (inverse added), space_to_depth_op.cc,
+shuffle_channel_op.cc, temporal_shift_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.primitive import Primitive
+from ..framework.tensor import Tensor, unwrap
+
+
+def _grid_sample_fn(x, grid, mode="bilinear", padding_mode="zeros",
+                    align_corners=True):
+    """grid_sampler_op.cc: sample x [N,C,H,W] at grid [N,Hg,Wg,2] in
+    [-1,1] normalized coords."""
+    N, C, H, W = x.shape
+
+    def unnorm(coord, size):
+        if align_corners:
+            return (coord + 1) * 0.5 * (size - 1)
+        return ((coord + 1) * size - 1) * 0.5
+
+    gx = unnorm(grid[..., 0].astype(jnp.float32), W)   # [N,Hg,Wg]
+    gy = unnorm(grid[..., 1].astype(jnp.float32), H)
+
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0, W - 1)
+        gy = jnp.clip(gy, 0, H - 1)
+    elif padding_mode == "reflection":
+        def reflect(v, size):
+            if align_corners:
+                span = 2 * (size - 1)
+                v = jnp.abs(jnp.mod(v, span))
+                return jnp.where(v > size - 1, span - v, v)
+            # borders at -0.5 and size-0.5: shift so borders land on 0 and
+            # size, fold the triangular wave, shift back
+            v = jnp.mod(v + 0.5, 2 * size)
+            v = jnp.where(v >= size, 2 * size - v, v) - 0.5
+            return jnp.clip(v, 0, size - 1)
+        gx = reflect(gx, W)
+        gy = reflect(gy, H)
+
+    def sample_at(yi, xi):
+        valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        # x [N,C,H,W]; yc/xc [N,Hg,Wg] -> [N,C,Hg,Wg]
+        v = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, yc, xc)
+        return jnp.where(valid[:, None], v, 0.0)
+
+    if mode == "nearest":
+        return sample_at(jnp.round(gy), jnp.round(gx)).astype(x.dtype)
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+    v00 = sample_at(y0, x0)
+    v01 = sample_at(y0, x0 + 1)
+    v10 = sample_at(y0 + 1, x0)
+    v11 = sample_at(y0 + 1, x0 + 1)
+    wx_ = wx[:, None]
+    wy_ = wy[:, None]
+    out = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_ +
+           v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+    return out.astype(x.dtype)
+
+
+def _affine_grid_fn(theta, out_h=1, out_w=1, align_corners=True):
+    """affine_grid_op.cc: [N,2,3] theta -> [N,H,W,2] sampling grid."""
+    N = theta.shape[0]
+    if align_corners:
+        ys = jnp.linspace(-1, 1, out_h)
+        xs = jnp.linspace(-1, 1, out_w)
+    else:
+        ys = (jnp.arange(out_h) * 2 + 1) / out_h - 1
+        xs = (jnp.arange(out_w) * 2 + 1) / out_w - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)            # [H,W,3]
+    return jnp.einsum("hwk,nck->nhwc", base, theta.astype(jnp.float32))
+
+
+def _fold_fn(x, output_h=1, output_w=1, kernel=(1, 1), strides=(1, 1),
+             paddings=(0, 0), dilations=(1, 1)):
+    """col2im (inverse of unfold; math/im2col.cc): x [N, C*kh*kw, L] ->
+    [N, C, H, W] with overlapping patches summed."""
+    N, CKK, L = x.shape
+    kh, kw = kernel
+    C = CKK // (kh * kw)
+    oh = (output_h + 2 * paddings[0] - dilations[0] * (kh - 1) - 1) \
+        // strides[0] + 1
+    ow = (output_w + 2 * paddings[1] - dilations[1] * (kw - 1) - 1) \
+        // strides[1] + 1
+    cols = x.reshape(N, C, kh, kw, oh, ow)
+    Hp = output_h + 2 * paddings[0]
+    Wp = output_w + 2 * paddings[1]
+    out = jnp.zeros((N, C, Hp, Wp), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dilations[0]
+            wj = j * dilations[1]
+            out = out.at[:, :, hi:hi + oh * strides[0]:strides[0],
+                         wj:wj + ow * strides[1]:strides[1]].add(
+                cols[:, :, i, j])
+    return out[:, :, paddings[0]:paddings[0] + output_h,
+               paddings[1]:paddings[1] + output_w]
+
+
+def _space_to_depth_fn(x, blocksize=2):
+    N, C, H, W = x.shape
+    b = blocksize
+    x = x.reshape(N, C, H // b, b, W // b, b)
+    return x.transpose(0, 3, 5, 1, 2, 4).reshape(N, C * b * b, H // b, W // b)
+
+
+def _pixel_unshuffle_fn(x, downscale_factor=2):
+    N, C, H, W = x.shape
+    r = downscale_factor
+    x = x.reshape(N, C, H // r, r, W // r, r)
+    return x.transpose(0, 1, 3, 5, 2, 4).reshape(N, C * r * r, H // r, W // r)
+
+
+def _channel_shuffle_fn(x, groups=1):
+    N, C, H, W = x.shape
+    x = x.reshape(N, groups, C // groups, H, W)
+    return x.transpose(0, 2, 1, 3, 4).reshape(N, C, H, W)
+
+
+def _temporal_shift_fn(x, seg_num=1, shift_ratio=0.25):
+    """temporal_shift_op.cc: shift a fraction of channels +/-1 along time."""
+    NT, C, H, W = x.shape
+    N = NT // seg_num
+    x = x.reshape(N, seg_num, C, H, W)
+    c1 = int(C * shift_ratio)
+    c2 = int(C * 2 * shift_ratio)
+    fwd = jnp.concatenate([x[:, 1:, :c1], jnp.zeros_like(x[:, :1, :c1])], 1)
+    bwd = jnp.concatenate([jnp.zeros_like(x[:, :1, c1:c2]),
+                           x[:, :-1, c1:c2]], 1)
+    keep = x[:, :, c2:]
+    return jnp.concatenate([fwd, bwd, keep], axis=2).reshape(NT, C, H, W)
+
+
+_grid_sample = Primitive("grid_sampler", _grid_sample_fn)
+_affine_grid = Primitive("affine_grid", _affine_grid_fn)
+_fold = Primitive("fold", _fold_fn)
+_space_to_depth = Primitive("space_to_depth", _space_to_depth_fn)
+_pixel_unshuffle = Primitive("pixel_unshuffle", _pixel_unshuffle_fn)
+_channel_shuffle = Primitive("channel_shuffle", _channel_shuffle_fn)
+_temporal_shift = Primitive("temporal_shift", _temporal_shift_fn)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    return _grid_sample(x, grid, mode=mode, padding_mode=padding_mode,
+                        align_corners=bool(align_corners))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    import numpy as np
+    s = [int(v) for v in np.asarray(unwrap(out_shape)).ravel()]
+    return _affine_grid(theta, out_h=s[-2], out_w=s[-1],
+                        align_corners=bool(align_corners))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    pair = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = pair(output_sizes)
+    return _fold(x, output_h=oh, output_w=ow, kernel=pair(kernel_sizes),
+                 strides=pair(strides), paddings=pair(paddings),
+                 dilations=pair(dilations))
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _space_to_depth(x, blocksize=int(blocksize))
+
+
+def pixel_unshuffle(x, downscale_factor, name=None):
+    return _pixel_unshuffle(x, downscale_factor=int(downscale_factor))
+
+
+def channel_shuffle(x, groups, name=None):
+    return _channel_shuffle(x, groups=int(groups))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _temporal_shift(x, seg_num=int(seg_num),
+                           shift_ratio=float(shift_ratio))
+
+
+__all__ = ["grid_sample", "affine_grid", "fold", "space_to_depth",
+           "pixel_unshuffle", "channel_shuffle", "temporal_shift"]

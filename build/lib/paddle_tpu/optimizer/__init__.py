@@ -1,0 +1,15 @@
+"""paddle.optimizer parity surface."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Lamb, LarsMomentum, RMSProp,
+    Adagrad, Adadelta, Adamax, L2Decay, L1Decay,
+)
+
+# fluid-era aliases (fluid/optimizer.py)
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdamOptimizer = Adam
+AdagradOptimizer = Adagrad
+RMSPropOptimizer = RMSProp
+LarsMomentumOptimizer = LarsMomentum
+LambOptimizer = Lamb

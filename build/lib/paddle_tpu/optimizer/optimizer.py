@@ -1,0 +1,965 @@
+"""Optimizers.
+
+Reference parity: python/paddle/fluid/optimizer.py (Optimizer base :58 --
+``minimize`` = backward + apply_gradients with clip -> regularization ->
+_append_optimize_op) and the kernels in paddle/fluid/operators/optimizers/
+(sgd_op, momentum_op, adam_op, adamw, lamb_op, lars_momentum_op, rmsprop_op,
+adagrad_op, adadelta_op, adamax_op).
+
+TPU-first: each update rule is ONE jitted XLA computation over the whole
+parameter group (donated buffers, so updates are in-place in HBM). The rule
+functions are also reused functionally by paddle_tpu.jit train steps and by
+the static-graph optimizer ops -- the same lowering serves all three
+execution modes, like the reference's shared optimizer kernels.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+
+class L2Decay:
+    """fluid regularizer.L2Decay parity."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, coeff=None):
+        return self.coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+# ---- sparse (SelectedRows) row-update rules ----------------------------------
+# sgd_op/adam_op SelectedRows branches: only the touched rows are read,
+# updated and scattered back — O(rows) instead of O(vocab) work per step.
+
+@jax.jit
+def _sgd_sparse_rule(p, rows, vals, lr):
+    return p.at[rows].add(-(lr * vals.astype(jnp.float32)).astype(p.dtype))
+
+
+@jax.jit
+def _adam_sparse_rule(p, m, v, rows, vals, lr, b1, b2, eps, t):
+    g = vals.astype(jnp.float32)
+    m_new = b1 * m[rows] + (1 - b1) * g
+    v_new = b2 * v[rows] + (1 - b2) * jnp.square(g)
+    step = lr * (m_new / (1 - b1 ** t)) / \
+        (jnp.sqrt(v_new / (1 - b2 ** t)) + eps)
+    return (p.at[rows].add(-step.astype(p.dtype)),
+            m.at[rows].set(m_new), v.at[rows].set(v_new))
+
+
+@jax.jit
+def _adamw_sparse_rule(p, m, v, rows, vals, lr, b1, b2, eps, t, wd):
+    g = vals.astype(jnp.float32)
+    p_rows = p[rows].astype(jnp.float32)
+    m_new = b1 * m[rows] + (1 - b1) * g
+    v_new = b2 * v[rows] + (1 - b2) * jnp.square(g)
+    step = lr * ((m_new / (1 - b1 ** t)) /
+                 (jnp.sqrt(v_new / (1 - b2 ** t)) + eps) + wd * p_rows)
+    return (p.at[rows].add(-step.astype(p.dtype)),
+            m.at[rows].set(m_new), v.at[rows].set(v_new))
+
+
+@jax.jit
+def _adagrad_sparse_rule(p, mom, rows, vals, lr, eps):
+    g = vals.astype(jnp.float32)
+    m_new = mom[rows] + jnp.square(g)
+    step = lr * g / (jnp.sqrt(m_new) + eps)
+    return (p.at[rows].add(-step.astype(p.dtype)),
+            mom.at[rows].set(m_new))
+
+
+# ---- functional update rules (jitted, donated) -------------------------------
+# Each takes (params_tree, grads_tree, state_trees..., scalars...) and returns
+# updated trees. Trees are dicts name->array so one XLA computation covers the
+# whole model (kernel-fusion across params; single dispatch per step).
+
+@jax.jit
+def _sgd_rule(params, grads, lr):
+    return jax.tree_util.tree_map(
+        lambda p, g: (p - lr * g.astype(p.dtype)).astype(p.dtype), params, grads)
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("use_nesterov",))
+def _momentum_rule(params, grads, velocity, lr, mu, use_nesterov=False):
+    def upd(p, g, v):
+        g = g.astype(jnp.float32)
+        v_new = mu * v + g
+        step = (g + mu * v_new) if use_nesterov else v_new
+        return (p.astype(jnp.float32) - lr * step).astype(p.dtype), v_new
+    flat = jax.tree_util.tree_map(upd, params, grads, velocity)
+    new_p = {k: v[0] for k, v in flat.items()}
+    new_v = {k: v[1] for k, v in flat.items()}
+    return new_p, new_v
+
+
+@jax.jit
+def _adam_rule(params, grads, m, v, lr, beta1, beta2, eps, t):
+    bc1 = 1 - beta1 ** t
+    bc2 = 1 - beta2 ** t
+
+    def upd(p, g, m_, v_):
+        g = g.astype(jnp.float32)
+        m_new = beta1 * m_ + (1 - beta1) * g
+        v_new = beta2 * v_ + (1 - beta2) * jnp.square(g)
+        step = lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+        return (p.astype(jnp.float32) - step).astype(p.dtype), m_new, v_new
+    flat = jax.tree_util.tree_map(upd, params, grads, m, v)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+@jax.jit
+def _adamw_rule(params, grads, m, v, lr, beta1, beta2, eps, t, wd):
+    bc1 = 1 - beta1 ** t
+    bc2 = 1 - beta2 ** t
+
+    def upd(p, g, m_, v_):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m_new = beta1 * m_ + (1 - beta1) * g
+        v_new = beta2 * v_ + (1 - beta2) * jnp.square(g)
+        step = lr * ((m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps) + wd * pf)
+        return (pf - step).astype(p.dtype), m_new, v_new
+    flat = jax.tree_util.tree_map(upd, params, grads, m, v)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+@jax.jit
+def _lamb_rule(params, grads, m, v, lr, beta1, beta2, eps, t, wd):
+    bc1 = 1 - beta1 ** t
+    bc2 = 1 - beta2 ** t
+
+    def upd(p, g, m_, v_):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m_new = beta1 * m_ + (1 - beta1) * g
+        v_new = beta2 * v_ + (1 - beta2) * jnp.square(g)
+        r = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps) + wd * pf
+        p_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return (pf - lr * trust * r).astype(p.dtype), m_new, v_new
+    flat = jax.tree_util.tree_map(upd, params, grads, m, v)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+@jax.jit
+def _lars_rule(params, grads, velocity, lr, mu, lars_coeff, wd, eps):
+    def upd(p, g, v):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        p_norm = jnp.linalg.norm(pf)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lars_coeff * p_norm / (g_norm + wd * p_norm + eps), 1.0)
+        v_new = mu * v + local_lr * lr * (g + wd * pf)
+        return (pf - v_new).astype(p.dtype), v_new
+    flat = jax.tree_util.tree_map(upd, params, grads, velocity)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()})
+
+
+@jax.jit
+def _rmsprop_rule(params, grads, mean_sq, moment, lr, rho, eps, momentum):
+    def upd(p, g, ms, mom):
+        g = g.astype(jnp.float32)
+        ms_new = rho * ms + (1 - rho) * jnp.square(g)
+        mom_new = momentum * mom + lr * g / jnp.sqrt(ms_new + eps)
+        return (p.astype(jnp.float32) - mom_new).astype(p.dtype), ms_new, mom_new
+    flat = jax.tree_util.tree_map(upd, params, grads, mean_sq, moment)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+@jax.jit
+def _rmsprop_centered_rule(params, grads, mean_sq, mean_grad, moment,
+                           lr, rho, eps, momentum):
+    """Centered variant (rmsprop_op.h centered path): variance estimate is
+    E[g^2] - E[g]^2."""
+    def upd(p, g, ms, mg, mom):
+        g = g.astype(jnp.float32)
+        ms_new = rho * ms + (1 - rho) * jnp.square(g)
+        mg_new = rho * mg + (1 - rho) * g
+        denom = jnp.sqrt(ms_new - jnp.square(mg_new) + eps)
+        mom_new = momentum * mom + lr * g / denom
+        return ((p.astype(jnp.float32) - mom_new).astype(p.dtype),
+                ms_new, mg_new, mom_new)
+    flat = jax.tree_util.tree_map(upd, params, grads, mean_sq, mean_grad,
+                                  moment)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()},
+            {k: x[3] for k, x in flat.items()})
+
+
+@jax.jit
+def _adagrad_rule(params, grads, moment, lr, eps):
+    def upd(p, g, m_):
+        g = g.astype(jnp.float32)
+        m_new = m_ + jnp.square(g)
+        return (p.astype(jnp.float32) - lr * g / (jnp.sqrt(m_new) + eps)
+                ).astype(p.dtype), m_new
+    flat = jax.tree_util.tree_map(upd, params, grads, moment)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()})
+
+
+@jax.jit
+def _adadelta_rule(params, grads, avg_sq_grad, avg_sq_update, lr, rho, eps):
+    def upd(p, g, asg, asu):
+        g = g.astype(jnp.float32)
+        asg_new = rho * asg + (1 - rho) * jnp.square(g)
+        update = g * jnp.sqrt(asu + eps) / jnp.sqrt(asg_new + eps)
+        asu_new = rho * asu + (1 - rho) * jnp.square(update)
+        return (p.astype(jnp.float32) - lr * update).astype(p.dtype), asg_new, asu_new
+    flat = jax.tree_util.tree_map(upd, params, grads, avg_sq_grad, avg_sq_update)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+@jax.jit
+def _adamax_rule(params, grads, m, u, lr, beta1, beta2, eps, t):
+    bc1 = 1 - beta1 ** t
+
+    def upd(p, g, m_, u_):
+        g = g.astype(jnp.float32)
+        m_new = beta1 * m_ + (1 - beta1) * g
+        u_new = jnp.maximum(beta2 * u_, jnp.abs(g))
+        return (p.astype(jnp.float32) - lr * (m_new / bc1) / (u_new + eps)
+                ).astype(p.dtype), m_new, u_new
+    flat = jax.tree_util.tree_map(upd, params, grads, m, u)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+class Optimizer:
+    """paddle.optimizer.Optimizer parity (dygraph path of fluid Optimizer)."""
+
+    _state_names: List[str] = []
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else None
+        if isinstance(weight_decay, (L2Decay,)):
+            self._weight_decay = weight_decay.coeff
+            self._decoupled = False
+        elif isinstance(weight_decay, L1Decay):
+            raise NotImplementedError("L1Decay weight decay: use L2 or AdamW")
+        else:
+            self._weight_decay = float(weight_decay) if weight_decay else 0.0
+            self._decoupled = False
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._step_count = 0
+        self.helper = None
+        # fp32 master weights for low-precision params (reference
+        # multi_precision path, operators/optimizers/adam_op.h master_param).
+        # None = auto: keep masters whenever a param is bf16/fp16 so that
+        # updates smaller than one low-precision ulp are never lost.
+        self._use_master_weights: Optional[bool] = None
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- stepping ------------------------------------------------------------
+    def _collect(self):
+        from ..framework.selected_rows import SelectedRows
+        params = [p for p in (self._parameters or []) if not p.stop_gradient
+                  and getattr(p, "trainable", True)]
+        pg = []
+        for p in params:
+            g = p.grad
+            if g is None:
+                continue
+            if isinstance(g, SelectedRows):
+                # canonicalize duplicates first so clip norms match the
+                # reference's merge_selected_rows-then-clip order
+                rows, vals = g.merged()
+                g = SelectedRows(rows, vals, g.height)
+            pg.append((p, g))
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)  # SelectedRows-aware (nn/clip._rewrap)
+        self._sparse_pg = [(p, g) for p, g in pg
+                           if isinstance(g, SelectedRows)]
+        return [(p, g) for p, g in pg if not isinstance(g, SelectedRows)]
+
+    def _ensure_state(self, names, pg, like_fp32=True):
+        for n in names:
+            if n not in self._accumulators:
+                self._accumulators[n] = {}
+            acc = self._accumulators[n]
+            for p, _ in pg:
+                if p.name not in acc:
+                    acc[p.name] = jnp.zeros(p._value.shape, jnp.float32)
+
+    def _needs_master(self, p):
+        if self._use_master_weights is False:
+            return False
+        dt = p._value.dtype
+        # only sub-fp32 floats (bf16/fp16) get fp32 masters; fp32/fp64
+        # params are already at full update precision
+        return jnp.issubdtype(dt, jnp.floating) and jnp.finfo(dt).bits < 32
+
+    def _trees(self, pg):
+        masters = self._accumulators.setdefault("@master", {})
+        params = {}
+        for p, _ in pg:
+            if self._needs_master(p):
+                if p.name not in masters:
+                    masters[p.name] = p._value.astype(jnp.float32)
+                params[p.name] = masters[p.name]
+            else:
+                params[p.name] = p._value
+        grads = {}
+        for p, g in pg:
+            gv = g._value
+            if self._weight_decay and not self._decoupled:
+                # coupled L2: grad += wd * param (fluid regularizer append)
+                gv = gv + self._weight_decay * params[p.name].astype(gv.dtype)
+            grads[p.name] = gv
+        return params, grads
+
+    def _writeback(self, pg, new_params):
+        masters = self._accumulators.get("@master", {})
+        for p, _ in pg:
+            new = new_params[p.name]
+            if p.name in masters:
+                masters[p.name] = new  # fp32 master updated first
+                p._value = new.astype(p._value.dtype)
+            else:
+                p._value = new
+
+    def step(self):
+        pg = self._collect()
+        sparse_pg = self._sparse_pg
+        if not pg and not sparse_pg:
+            return
+        self._step_count += 1
+        if pg:
+            self._apply(pg)
+        for p, g in sparse_pg:
+            rows, vals = g.merged()
+            self._apply_sparse(p, rows, vals)
+
+    def _apply(self, pg):
+        raise NotImplementedError
+
+    def _apply_sparse(self, p, rows, vals):
+        """Row-wise update for a SelectedRows gradient. Default: densify the
+        merged grad and run the dense rule on this one param (correct but
+        not memory-sparse); SGD/Adam/Adagrad override with true row-sliced
+        updates (sgd_op/adam_op SelectedRows branches, lazy_mode)."""
+        dense = jnp.zeros(p._value.shape, vals.dtype).at[rows].add(vals)
+        g = Tensor(dense, stop_gradient=True)
+        self._apply([(p, g)])
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """fluid Optimizer.minimize parity: in dygraph, backward has already
+        populated .grad (or we trigger it), then apply.  In static mode,
+        appends backward + update ops to the loss's program (optimizer.py:916
+        = backward :739 + apply_gradients :808)."""
+        from ..framework import core as _core
+        if _core.in_static_mode() and not isinstance(loss, Tensor):
+            return self._minimize_static(loss, parameters, no_grad_set)
+        if loss._node is not None or loss.grad is None:
+            if loss._node is not None:
+                loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in (self._parameters or [])]
+
+    def _minimize_static(self, loss, parameters=None, no_grad_set=None):
+        """Append @backward + one fused @optimize macro op. The update math
+        is the same functional_apply the compiled TrainStep uses, so static
+        programs get the optimizer fused into the XLA computation — the
+        analogue of sgd/adam ops inside the Program
+        (operators/optimizers/)."""
+        from ..static.program import Operator
+        from ..static.backward import append_backward
+        from ..static.executor import global_scope
+
+        block = loss.block
+        program = block.program
+        pgs = append_backward(loss, parameter_list=parameters,
+                              no_grad_set=no_grad_set)
+        param_names = [p.name for p, _ in pgs]
+        grad_names = [g.name for _, g in pgs]
+
+        # persistable accumulator vars, zero-seeded in the scope
+        scope = global_scope()
+        for sname in self._state_names:
+            for p, _ in pgs:
+                acc_name = f"{p.name}_{sname}_0"
+                if not block.has_var(acc_name):
+                    block.create_var(name=acc_name, shape=p.shape,
+                                     dtype="float32", persistable=True)
+                    scope.set_var(acc_name,
+                                  jnp.zeros([d for d in p.shape], jnp.float32))
+        step_name = f"@optimizer_step_{id(self)}"
+        if not block.has_var(step_name):
+            block.create_var(name=step_name, shape=[], dtype="int32",
+                             persistable=True)
+            scope.set_var(step_name, jnp.zeros((), jnp.int32))
+        # LR is a scope INPUT refreshed before every run, never a traced
+        # constant — so LRScheduler.step()/set_lr() take effect without
+        # recompiling (the eager TrainStep passes lr as an argument for the
+        # same reason)
+        lr_name = f"@optimizer_lr_{id(self)}"
+        if not block.has_var(lr_name):
+            block.create_var(name=lr_name, shape=[], dtype="float32",
+                             persistable=True)
+            scope.set_var(lr_name, jnp.float32(self.get_lr()))
+        program._pre_run_hooks.append(
+            lambda sc, opt=self, n=lr_name: sc.set_var(
+                n, jnp.float32(opt.get_lr())))
+
+        acc_names = [f"{p}_{s}_0" for s in self._state_names
+                     for p in param_names]
+        opt = self
+
+        def update_fn(*arrs):
+            k = len(param_names)
+            params = dict(zip(param_names, arrs[:k]))
+            grads = dict(zip(param_names, arrs[k:2 * k]))
+            state = {}
+            idx = 2 * k
+            for sname in opt._state_names:
+                state[sname] = dict(zip(param_names,
+                                        arrs[idx:idx + k]))
+                idx += k
+            step = arrs[idx] + 1
+            lr = arrs[idx + 1]
+            new_p, new_state = opt.functional_apply(params, grads, state,
+                                                    step, lr)
+            outs = [new_p[n] for n in param_names]
+            for sname in opt._state_names:
+                outs += [new_state[sname][n] for n in param_names]
+            outs.append(step)
+            return tuple(outs)
+
+        op = Operator(block, prim="@optimize",
+                      inputs=param_names + grad_names + acc_names
+                      + [step_name, lr_name],
+                      outputs=param_names + acc_names + [step_name],
+                      attrs={}, fn=update_fn,
+                      type_name=type(self).__name__.lower())
+        block.ops.append(op)
+        program._version += 1
+        return None, pgs
+
+    def clear_grad(self, set_to_zero=False):
+        for p in (self._parameters or []):
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- state ---------------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for name, acc in self._accumulators.items():
+            for pname, val in acc.items():
+                sd[f"{pname}_{name}"] = Tensor(val)
+        sd["@step"] = self._step_count
+        if isinstance(self._lr, LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        return sd
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("@step", 0))
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        for name, acc in self._accumulators.items():
+            for pname in list(acc):
+                key = f"{pname}_{name}"
+                if key in state:
+                    v = state[key]
+                    acc[pname] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+        # also lazily import unknown accumulators ("@master" and any
+        # extra-state accumulators like RMSProp's centered "mean_grad" are
+        # always importable, even into a fresh optimizer whose _state_names
+        # don't list them — dropping masters on restore would re-seed them
+        # from rounded bf16 params and lose all sub-ulp progress)
+        known = set(self._state_names) | set(self._accumulators) | \
+            {"@master", "mean_grad"}
+        for key, v in state.items():
+            if key in ("@step", "LR_Scheduler"):
+                continue
+            for name in known:
+                if key.endswith("_" + name):
+                    pname = key[: -(len(name) + 1)]
+                    self._accumulators.setdefault(name, {})[pname] = \
+                        v._value if isinstance(v, Tensor) else jnp.asarray(v)
+
+    set_dict = set_state_dict
+
+    # -- functional interface (compiled/pjit train step) ---------------------
+    # The TPU-idiomatic path (parallel/train_step.py) folds the optimizer
+    # update into the jitted step function, the analogue of Paddle running
+    # sgd/adam as graph ops (paddle/fluid/operators/optimizers/) inside the
+    # same Program as forward/backward.
+
+    def functional_state(self, params):
+        """Accumulator pytree for a {name: array} params dict: reuses any
+        existing eager accumulator values (so eager → compiled switching
+        keeps Adam moments etc.), zero-init otherwise."""
+        out = {}
+        for n in self._state_names:
+            acc = self._accumulators.get(n, {})
+            out[n] = {k: (jnp.asarray(acc[k], jnp.float32) if k in acc
+                          else jnp.zeros(v.shape, jnp.float32))
+                      for k, v in params.items()}
+        return out
+
+    def _no_clip_names(self):
+        return {p.name for p in (self._parameters or [])
+                if not getattr(p, "need_clip", True)}
+
+    def _functional_grads(self, params, grads):
+        """Coupled L2 + grad clip, applied inside the trace."""
+        if self._grad_clip is not None:
+            from ..nn.clip import functional_clip
+            grads = functional_clip(self._grad_clip, params, grads,
+                                    skip=self._no_clip_names())
+        if self._weight_decay and not self._decoupled:
+            grads = {k: g + self._weight_decay * params[k].astype(g.dtype)
+                     for k, g in grads.items()}
+        return grads
+
+    def functional_apply(self, params, grads, state, step, lr=None):
+        """Pure update: (params, grads, accum-state, step[, lr]) -> (params', state').
+
+        ``step`` and ``lr`` are traced scalars so LR schedules don't force
+        recompiles. Must be overridden per optimizer family.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} has no functional_apply")
+
+    def adopt_functional_state(self, state):
+        """Write a functional accumulator pytree back into eager accumulators.
+        Keys already match p.name because layer_state() canonicalizes
+        Parameter names to their qualified paths."""
+        for sname, acc in state.items():
+            self._accumulators[sname] = dict(acc)
+
+
+class SGD(Optimizer):
+    def _apply(self, pg):
+        params, grads = self._trees(pg)
+        new = _sgd_rule(params, grads, jnp.float32(self.get_lr()))
+        self._writeback(pg, new)
+
+    def _apply_sparse(self, p, rows, vals):
+        masters = self._accumulators.get("@master", {})
+        tgt = masters.get(p.name, p._value)
+        new = _sgd_sparse_rule(tgt, rows, vals, jnp.float32(self.get_lr()))
+        if p.name in masters:
+            masters[p.name] = new
+            p._value = new.astype(p._value.dtype)
+        else:
+            p._value = new
+
+    def functional_apply(self, params, grads, state, step, lr=None):
+        grads = self._functional_grads(params, grads)
+        lr = jnp.float32(self.get_lr()) if lr is None else lr
+        return _sgd_rule(params, grads, lr), state
+
+
+class Momentum(Optimizer):
+    _state_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _apply(self, pg):
+        self._ensure_state(["velocity"], pg)
+        params, grads = self._trees(pg)
+        vel = {p.name: self._accumulators["velocity"][p.name] for p, _ in pg}
+        new_p, new_v = _momentum_rule(params, grads, vel,
+                                      jnp.float32(self.get_lr()),
+                                      jnp.float32(self._momentum),
+                                      use_nesterov=self._nesterov)
+        self._writeback(pg, new_p)
+        self._accumulators["velocity"].update(new_v)
+
+    def functional_apply(self, params, grads, state, step, lr=None):
+        grads = self._functional_grads(params, grads)
+        lr = jnp.float32(self.get_lr()) if lr is None else lr
+        new_p, new_v = _momentum_rule(params, grads, state["velocity"], lr,
+                                      jnp.float32(self._momentum),
+                                      use_nesterov=self._nesterov)
+        return new_p, {"velocity": new_v}
+
+
+class Adam(Optimizer):
+    _state_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _apply(self, pg):
+        self._ensure_state(["moment1", "moment2"], pg)
+        params, grads = self._trees(pg)
+        m = {p.name: self._accumulators["moment1"][p.name] for p, _ in pg}
+        v = {p.name: self._accumulators["moment2"][p.name] for p, _ in pg}
+        new_p, new_m, new_v = _adam_rule(
+            params, grads, m, v, jnp.float32(self.get_lr()),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), jnp.float32(self._step_count))
+        self._writeback(pg, new_p)
+        self._accumulators["moment1"].update(new_m)
+        self._accumulators["moment2"].update(new_v)
+
+    def _apply_sparse(self, p, rows, vals):
+        """lazy-mode Adam (adam_op.h SelectedRows + lazy_mode): moments and
+        param update only on the touched rows."""
+        self._ensure_state(["moment1", "moment2"], [(p, None)])
+        m = self._accumulators["moment1"][p.name]
+        v = self._accumulators["moment2"][p.name]
+        masters = self._accumulators.get("@master", {})
+        tgt = masters.get(p.name, p._value)
+        new_p, new_m, new_v = _adam_sparse_rule(
+            tgt, m, v, rows, vals, jnp.float32(self.get_lr()),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), jnp.float32(self._step_count))
+        if p.name in masters:
+            masters[p.name] = new_p
+            p._value = new_p.astype(p._value.dtype)
+        else:
+            p._value = new_p
+        self._accumulators["moment1"][p.name] = new_m
+        self._accumulators["moment2"][p.name] = new_v
+
+    def functional_apply(self, params, grads, state, step, lr=None):
+        grads = self._functional_grads(params, grads)
+        lr = jnp.float32(self.get_lr()) if lr is None else lr
+        new_p, new_m, new_v = _adam_rule(
+            params, grads, state["moment1"], state["moment2"], lr,
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), jnp.float32(step))
+        return new_p, {"moment1": new_m, "moment2": new_v}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._wd = float(weight_decay) if not isinstance(weight_decay, L2Decay) \
+            else weight_decay.coeff
+        self._apply_decay_fn = apply_decay_param_fun
+
+    def _apply(self, pg):
+        self._ensure_state(["moment1", "moment2"], pg)
+        if self._apply_decay_fn is not None:
+            decay_pg = [(p, g) for p, g in pg if self._apply_decay_fn(p.name)]
+            nodecay_pg = [(p, g) for p, g in pg if not self._apply_decay_fn(p.name)]
+        else:
+            decay_pg, nodecay_pg = pg, []
+        for group, wd in ((decay_pg, self._wd), (nodecay_pg, 0.0)):
+            if not group:
+                continue
+            params, grads = self._trees(group)
+            m = {p.name: self._accumulators["moment1"][p.name] for p, _ in group}
+            v = {p.name: self._accumulators["moment2"][p.name] for p, _ in group}
+            new_p, new_m, new_v = _adamw_rule(
+                params, grads, m, v, jnp.float32(self.get_lr()),
+                jnp.float32(self._beta1), jnp.float32(self._beta2),
+                jnp.float32(self._eps), jnp.float32(self._step_count),
+                jnp.float32(wd))
+            self._writeback(group, new_p)
+            self._accumulators["moment1"].update(new_m)
+            self._accumulators["moment2"].update(new_v)
+
+    def _apply_sparse(self, p, rows, vals):
+        """lazy AdamW: decoupled decay applies only to the touched rows
+        (matching the dense _adamw_rule semantics row-wise)."""
+        wd = self._wd
+        if self._apply_decay_fn is not None and \
+                not self._apply_decay_fn(p.name):
+            wd = 0.0
+        self._ensure_state(["moment1", "moment2"], [(p, None)])
+        m = self._accumulators["moment1"][p.name]
+        v = self._accumulators["moment2"][p.name]
+        masters = self._accumulators.get("@master", {})
+        tgt = masters.get(p.name, p._value)
+        new_p, new_m, new_v = _adamw_sparse_rule(
+            tgt, m, v, rows, vals, jnp.float32(self.get_lr()),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), jnp.float32(self._step_count),
+            jnp.float32(wd))
+        if p.name in masters:
+            masters[p.name] = new_p
+            p._value = new_p.astype(p._value.dtype)
+        else:
+            p._value = new_p
+        self._accumulators["moment1"][p.name] = new_m
+        self._accumulators["moment2"][p.name] = new_v
+
+    def functional_apply(self, params, grads, state, step, lr=None):
+        grads = self._functional_grads(params, grads)
+        lr = jnp.float32(self.get_lr()) if lr is None else lr
+        decay_fn = self._apply_decay_fn or (lambda n: True)
+        new_p, new_m, new_v = dict(params), dict(state["moment1"]), dict(state["moment2"])
+        for names, wd in (
+                ([n for n in grads if decay_fn(n)], self._wd),
+                ([n for n in grads if not decay_fn(n)], 0.0)):
+            if not names:
+                continue
+            sub = lambda d: {n: d[n] for n in names}
+            p2, m2, v2 = _adamw_rule(
+                sub(params), sub(grads), sub(state["moment1"]),
+                sub(state["moment2"]), lr, jnp.float32(self._beta1),
+                jnp.float32(self._beta2), jnp.float32(self._eps),
+                jnp.float32(step), jnp.float32(wd))
+            new_p.update(p2); new_m.update(m2); new_v.update(v2)
+        return new_p, {"moment1": new_m, "moment2": new_v}
+
+
+class Lamb(Optimizer):
+    _state_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply(self, pg):
+        self._ensure_state(["moment1", "moment2"], pg)
+        if self._exclude_fn is not None:
+            decay_pg = [(p, g) for p, g in pg if not self._exclude_fn(p)]
+            nodecay_pg = [(p, g) for p, g in pg if self._exclude_fn(p)]
+        else:
+            decay_pg, nodecay_pg = pg, []
+        for group, wd in ((decay_pg, self._wd), (nodecay_pg, 0.0)):
+            if not group:
+                continue
+            params, grads = self._trees(group)
+            m = {p.name: self._accumulators["moment1"][p.name] for p, _ in group}
+            v = {p.name: self._accumulators["moment2"][p.name] for p, _ in group}
+            new_p, new_m, new_v = _lamb_rule(
+                params, grads, m, v, jnp.float32(self.get_lr()),
+                jnp.float32(self._beta1), jnp.float32(self._beta2),
+                jnp.float32(self._eps), jnp.float32(self._step_count),
+                jnp.float32(wd))
+            self._writeback(group, new_p)
+            self._accumulators["moment1"].update(new_m)
+            self._accumulators["moment2"].update(new_v)
+
+    def functional_apply(self, params, grads, state, step, lr=None):
+        grads = self._functional_grads(params, grads)
+        lr = jnp.float32(self.get_lr()) if lr is None else lr
+        # exclude_from_weight_decay_fn takes a Parameter; evaluate it on the
+        # live params (names are canonical after layer_state()).
+        excluded = set()
+        if self._exclude_fn is not None:
+            excluded = {p.name for p in (self._parameters or [])
+                        if self._exclude_fn(p)}
+        new_p, new_m, new_v = dict(params), dict(state["moment1"]), \
+            dict(state["moment2"])
+        for names, wd in (
+                ([n for n in grads if n not in excluded], self._wd),
+                ([n for n in grads if n in excluded], 0.0)):
+            if not names:
+                continue
+            sub = lambda d: {n: d[n] for n in names}
+            p2, m2, v2 = _lamb_rule(
+                sub(params), sub(grads), sub(state["moment1"]),
+                sub(state["moment2"]), lr, jnp.float32(self._beta1),
+                jnp.float32(self._beta2), jnp.float32(self._eps),
+                jnp.float32(step), jnp.float32(wd))
+            new_p.update(p2); new_m.update(m2); new_v.update(v2)
+        return new_p, {"moment1": new_m, "moment2": new_v}
+
+
+class LarsMomentum(Optimizer):
+    _state_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=1e-9, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+
+    def _apply(self, pg):
+        self._ensure_state(["velocity"], pg)
+        params, grads = self._trees(pg)
+        vel = {p.name: self._accumulators["velocity"][p.name] for p, _ in pg}
+        new_p, new_v = _lars_rule(params, grads, vel,
+                                  jnp.float32(self.get_lr()),
+                                  jnp.float32(self._momentum),
+                                  jnp.float32(self._lars_coeff),
+                                  jnp.float32(self._lars_wd),
+                                  jnp.float32(self._eps))
+        self._writeback(pg, new_p)
+        self._accumulators["velocity"].update(new_v)
+
+
+class RMSProp(Optimizer):
+    _state_names = ["mean_square", "moment"]
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps, self._momentum = rho, epsilon, momentum
+        self._centered = bool(centered)
+
+    def _apply(self, pg):
+        names = ["mean_square", "moment"] + (
+            ["mean_grad"] if self._centered else [])
+        self._ensure_state(names, pg)
+        params, grads = self._trees(pg)
+        ms = {p.name: self._accumulators["mean_square"][p.name] for p, _ in pg}
+        mom = {p.name: self._accumulators["moment"][p.name] for p, _ in pg}
+        if self._centered:
+            mg = {p.name: self._accumulators["mean_grad"][p.name]
+                  for p, _ in pg}
+            new_p, new_ms, new_mg, new_mom = _rmsprop_centered_rule(
+                params, grads, ms, mg, mom, jnp.float32(self.get_lr()),
+                jnp.float32(self._rho), jnp.float32(self._eps),
+                jnp.float32(self._momentum))
+            self._accumulators["mean_grad"].update(new_mg)
+        else:
+            new_p, new_ms, new_mom = _rmsprop_rule(
+                params, grads, ms, mom, jnp.float32(self.get_lr()),
+                jnp.float32(self._rho), jnp.float32(self._eps),
+                jnp.float32(self._momentum))
+        self._writeback(pg, new_p)
+        self._accumulators["mean_square"].update(new_ms)
+        self._accumulators["moment"].update(new_mom)
+
+
+class Adagrad(Optimizer):
+    _state_names = ["moment"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _apply(self, pg):
+        self._ensure_state(["moment"], pg)
+        params, grads = self._trees(pg)
+        mom = {p.name: self._accumulators["moment"][p.name] for p, _ in pg}
+        new_p, new_m = _adagrad_rule(params, grads, mom,
+                                     jnp.float32(self.get_lr()),
+                                     jnp.float32(self._eps))
+        self._writeback(pg, new_p)
+        self._accumulators["moment"].update(new_m)
+
+    def _apply_sparse(self, p, rows, vals):
+        self._ensure_state(["moment"], [(p, None)])
+        mom = self._accumulators["moment"][p.name]
+        masters = self._accumulators.get("@master", {})
+        tgt = masters.get(p.name, p._value)
+        new_p, new_m = _adagrad_sparse_rule(
+            tgt, mom, rows, vals, jnp.float32(self.get_lr()),
+            jnp.float32(self._eps))
+        if p.name in masters:
+            masters[p.name] = new_p
+            p._value = new_p.astype(p._value.dtype)
+        else:
+            p._value = new_p
+        self._accumulators["moment"][p.name] = new_m
+
+
+class Adadelta(Optimizer):
+    _state_names = ["avg_squared_grad", "avg_squared_update"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps, self._rho = epsilon, rho
+
+    def _apply(self, pg):
+        self._ensure_state(["avg_squared_grad", "avg_squared_update"], pg)
+        params, grads = self._trees(pg)
+        asg = {p.name: self._accumulators["avg_squared_grad"][p.name]
+               for p, _ in pg}
+        asu = {p.name: self._accumulators["avg_squared_update"][p.name]
+               for p, _ in pg}
+        new_p, new_asg, new_asu = _adadelta_rule(
+            params, grads, asg, asu, jnp.float32(self.get_lr()),
+            jnp.float32(self._rho), jnp.float32(self._eps))
+        self._writeback(pg, new_p)
+        self._accumulators["avg_squared_grad"].update(new_asg)
+        self._accumulators["avg_squared_update"].update(new_asu)
+
+
+class Adamax(Optimizer):
+    _state_names = ["moment", "inf_norm"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _apply(self, pg):
+        self._ensure_state(["moment", "inf_norm"], pg)
+        params, grads = self._trees(pg)
+        m = {p.name: self._accumulators["moment"][p.name] for p, _ in pg}
+        u = {p.name: self._accumulators["inf_norm"][p.name] for p, _ in pg}
+        new_p, new_m, new_u = _adamax_rule(
+            params, grads, m, u, jnp.float32(self.get_lr()),
+            jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), jnp.float32(self._step_count))
+        self._writeback(pg, new_p)
+        self._accumulators["moment"].update(new_m)
+        self._accumulators["inf_norm"].update(new_u)

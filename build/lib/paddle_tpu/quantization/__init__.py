@@ -1,0 +1,20 @@
+"""Quantization: QAT (fake-quant simulation) + PTQ (calibration / weight-only).
+
+Reference parity: python/paddle/fluid/contrib/slim/quantization/ (the slim
+quantization stack: imperative/qat.py, imperative/quant_nn.py,
+post_training_quantization.py) over the fake_quantize_op.cc /
+fake_dequantize_op.cc kernels.
+"""
+from .functional import (  # noqa: F401
+    fake_quantize_dequantize_abs_max,
+    fake_channel_wise_quantize_dequantize_abs_max,
+    fake_quantize_dequantize_moving_average_abs_max,
+    moving_average_abs_max_scale, quantize_weight_int8, dequantize_weight,
+)
+from .quant_layers import (  # noqa: F401
+    FakeQuantAbsMax, FakeQuantMovingAverage,
+    FakeChannelWiseQuantDequantAbsMax, MovingAverageAbsMaxScale,
+    QuantizedConv2D, QuantizedLinear,
+)
+from .qat import ImperativeQuantAware, ImperativeCalcOutScale  # noqa: F401
+from .ptq import PostTrainingQuantization, WeightQuantization  # noqa: F401

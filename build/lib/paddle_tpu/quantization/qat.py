@@ -1,0 +1,99 @@
+"""Imperative quantization-aware training (QAT).
+
+Reference parity: python/paddle/fluid/contrib/slim/quantization/imperative/
+qat.py — ImperativeQuantAware.quantize walks the dygraph model and swaps
+Conv2D/Linear for quantized counterparts; ImperativeCalcOutScale hooks
+output-scale collection onto activation layers for inference-time
+quantization.
+"""
+from __future__ import annotations
+
+from ..nn.layer import layers as L
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from .quant_layers import (QuantizedConv2D, QuantizedLinear,
+                           MovingAverageAbsMaxScale)
+
+
+class ImperativeQuantAware:
+    """Swap quantizable sublayers of a dygraph model for fake-quantized
+    versions (qat.py:54). After ``quantize(model)``, training proceeds
+    normally — the fake-quant ops carry straight-through gradients."""
+
+    _QUANTIZABLE = {Conv2D: QuantizedConv2D, Linear: QuantizedLinear}
+
+    def __init__(self, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_preprocess_layer=None, act_preprocess_layer=None,
+                 weight_quantize_layer=None, act_quantize_layer=None):
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+        self._moving_rate = moving_rate
+        self._types = set(quantizable_layer_type)
+        self._weight_qt = weight_quantize_type
+        self._act_qt = activation_quantize_type
+
+    def _wrap(self, layer):
+        for cls, qcls in self._QUANTIZABLE.items():
+            if isinstance(layer, cls) and cls.__name__ in self._types:
+                return qcls(layer, weight_bits=self._weight_bits,
+                            activation_bits=self._activation_bits,
+                            moving_rate=self._moving_rate,
+                            weight_quantize_type=self._weight_qt,
+                            activation_quantize_type=self._act_qt)
+        return None
+
+    def quantize(self, model):
+        """In-place: replace each quantizable sublayer (qat.py:241)."""
+        self._walk(model)
+        return model
+
+    def _walk(self, layer):
+        for name, child in list(layer._sub_layers.items()):
+            q = self._wrap(child)
+            if q is not None:
+                setattr(layer, name, q)
+            else:
+                self._walk(child)
+
+    def save_quantized_model(self, layer, path, input_spec=None, **config):
+        from .. import jit
+        layer.eval()
+        jit.save(layer, path, input_spec=input_spec, **config)
+
+
+class ImperativeCalcOutScale:
+    """Attach out-scale collectors after activation-producing layers
+    (qat.py:299). Collected scales live in each collector's ``scale``
+    buffer and are saved with state_dict."""
+
+    _OUT_SCALE_TYPES = ("ReLU", "ReLU6", "LeakyReLU", "Sigmoid", "Softmax",
+                        "Tanh", "Swish", "Conv2D", "Linear", "BatchNorm2D",
+                        "BatchNorm")
+
+    def __init__(self, moving_rate=0.9):
+        self._moving_rate = moving_rate
+
+    def calc_out_scale(self, model):
+        self._walk(model)
+        return model
+
+    def _walk(self, layer):
+        for name, child in list(layer._sub_layers.items()):
+            if type(child).__name__ in self._OUT_SCALE_TYPES:
+                setattr(layer, name, _OutScaleWrapper(
+                    child, self._moving_rate))
+            else:
+                self._walk(child)
+
+
+class _OutScaleWrapper(L.Layer):
+    def __init__(self, inner, moving_rate):
+        super().__init__()
+        self._inner = inner
+        self._out_scale = MovingAverageAbsMaxScale(moving_rate=moving_rate)
+
+    def forward(self, *args, **kwargs):
+        return self._out_scale(self._inner(*args, **kwargs))

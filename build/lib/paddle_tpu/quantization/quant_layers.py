@@ -1,0 +1,157 @@
+"""QAT layer wrappers (quant_nn parity).
+
+Reference parity: python/paddle/fluid/contrib/slim/quantization/imperative/
+quant_nn.py — FakeQuantAbsMax, FakeQuantMovingAverage,
+FakeChannelWiseQuantDequantAbsMax, MovingAverageAbsMaxScale,
+QuantizedConv2D, QuantizedLinear.
+
+The moving-average quantizers keep their (scale, accum, state) as layer
+buffers and update them from the functional ops' returned state — same
+observable behavior as the reference's in-place buffer writes, but the
+compute stays pure so the whole quantized forward jits into one XLA
+program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..nn import functional as F
+from . import functional as QF
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor abs-max fake quant (quant_nn.py:131)."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32"):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def forward(self, x):
+        out, _ = QF.fake_quantize_dequantize_abs_max(
+            x, bit_length=self._quant_bits)
+        return out
+
+
+class FakeChannelWiseQuantDequantAbsMax(Layer):
+    """Per-channel abs-max fake quant for weights (quant_nn.py:213)."""
+
+    def __init__(self, name=None, quant_bits=8, quant_axis=0,
+                 dtype="float32"):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._quant_axis = quant_axis
+
+    def forward(self, x):
+        out, _ = QF.fake_channel_wise_quantize_dequantize_abs_max(
+            x, bit_length=self._quant_bits, quant_axis=self._quant_axis)
+        return out
+
+
+class FakeQuantMovingAverage(Layer):
+    """Moving-average abs-max fake quant for activations (quant_nn.py:33)."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._quant_bits = quant_bits
+        self.register_buffer("scale", Tensor(np.array(1.0, "float32")))
+        self.register_buffer("accum", Tensor(np.array(1.0, "float32")))
+        self.register_buffer("state", Tensor(np.array(1.0, "float32")))
+
+    def forward(self, x):
+        out, scale, accum, state = \
+            QF.fake_quantize_dequantize_moving_average_abs_max(
+                x, self.scale, self.accum, self.state,
+                moving_rate=self._moving_rate, bit_length=self._quant_bits,
+                is_test=not self.training)
+        if self.training:
+            self.scale._value = scale._value
+            self.accum._value = accum._value
+            self.state._value = state._value
+        return out
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Out-scale collector (quant_nn.py:481): passthrough that tracks the
+    activation's moving-average abs-max in a ``scale`` buffer."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(np.array(1.0, "float32")))
+        self.register_buffer("accum", Tensor(np.array(1.0, "float32")))
+        self.register_buffer("state", Tensor(np.array(1.0, "float32")))
+
+    def forward(self, x):
+        scale, accum, state = QF.moving_average_abs_max_scale(
+            x, self.accum, self.state, moving_rate=self._moving_rate,
+            is_test=not self.training)
+        if self.training:
+            self.scale._value = scale._value
+            self.accum._value = accum._value
+            self.state._value = state._value
+        return x
+
+
+def _make_weight_quantizer(kind, bits, quant_axis):
+    if kind == "channel_wise_abs_max":
+        return FakeChannelWiseQuantDequantAbsMax(quant_bits=bits,
+                                                 quant_axis=quant_axis)
+    return FakeQuantAbsMax(quant_bits=bits)
+
+
+def _make_act_quantizer(kind, bits, moving_rate):
+    if kind == "moving_average_abs_max":
+        return FakeQuantMovingAverage(moving_rate=moving_rate,
+                                      quant_bits=bits)
+    return FakeQuantAbsMax(quant_bits=bits)
+
+
+class QuantizedLinear(Layer):
+    """Linear with fake-quantized weight + input (quant_nn.py:412)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        # paddle Linear weight is [in, out] -> output channel axis is 1
+        self._fake_quant_weight = _make_weight_quantizer(
+            weight_quantize_type, weight_bits, quant_axis=1)
+        self._fake_quant_input = _make_act_quantizer(
+            activation_quantize_type, activation_bits, moving_rate)
+
+    def forward(self, x):
+        qx = self._fake_quant_input(x)
+        qw = self._fake_quant_weight(self.weight)
+        return F.linear(qx, qw, self.bias)
+
+
+class QuantizedConv2D(Layer):
+    """Conv2D with fake-quantized weight + input (quant_nn.py:323)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._stride = layer._stride
+        self._padding = layer._padding
+        self._dilation = layer._dilation
+        self._groups = layer._groups
+        self._data_format = layer._data_format
+        self._fake_quant_weight = _make_weight_quantizer(
+            weight_quantize_type, weight_bits, quant_axis=0)
+        self._fake_quant_input = _make_act_quantizer(
+            activation_quantize_type, activation_bits, moving_rate)
+
+    def forward(self, x):
+        qx = self._fake_quant_input(x)
+        qw = self._fake_quant_weight(self.weight)
+        return F.conv2d(qx, qw, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)

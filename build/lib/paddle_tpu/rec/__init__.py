@@ -1,0 +1,4 @@
+"""Recommendation models (BASELINE workload 5: Wide&Deep CTR)."""
+from .wide_deep import WideDeep, WideDeepTrainer, synthetic_ctr_batch  # noqa: F401
+
+__all__ = ["WideDeep", "WideDeepTrainer", "synthetic_ctr_batch"]

@@ -1,0 +1,16 @@
+"""Weight-decay regularizers.
+
+Reference parity: python/paddle/regularizer.py (L1Decay/L2Decay) and
+python/paddle/fluid/regularizer.py (L1DecayRegularizer/L2DecayRegularizer).
+TPU-first: decay is applied inside the jitted optimizer update (see
+optimizer/optimizer.py), not as separate graph ops appended per-parameter.
+"""
+from __future__ import annotations
+
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+# fluid-era aliases
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -1,0 +1,53 @@
+"""paddle.static: static-graph mode.
+
+Reference parity: python/paddle/static/ re-exporting the fluid machinery
+(framework.py Program/Executor/backward, io.py, compiler.py). See the
+submodule docstrings for the TPU-native execution design.
+"""
+from .program import (  # noqa: F401
+    Program, Block, Operator, Variable, program_guard,
+    default_main_program, default_startup_program, reset_default_programs,
+)
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .backward import append_backward, gradients  # noqa: F401
+from .compiler import (  # noqa: F401
+    CompiledProgram, BuildStrategy, ExecutionStrategy,
+)
+from .io import (  # noqa: F401
+    save_persistables, load_persistables, save_params, load_params,
+    save_inference_model, load_inference_model, save_vars, load_vars,
+)
+from . import nn  # noqa: F401
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity (signature for jit.to_static)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+# operator methods on static Variables (math_op_patch dual — see ops/patch.py)
+from ..ops.patch import apply_patches as _apply_patches
+_apply_patches(Variable, eager=False)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data parity: declare a feed Variable in the default
+    main program."""
+    prog = default_main_program()
+    return prog.global_block().create_var(
+        name=name, shape=shape, dtype=dtype, stop_gradient=True,
+        is_data=True)
+from .api_extra import (  # noqa: F401,E402
+    cpu_places, cuda_places, xpu_places, tpu_places, name_scope,
+    create_global_var, create_parameter, Print, py_func,
+    serialize_program, deserialize_program, serialize_persistables,
+    deserialize_persistables, save_to_file, load_from_file, save, load,
+    get_program_state, load_program_state, set_program_state,
+)

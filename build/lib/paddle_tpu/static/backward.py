@@ -1,0 +1,105 @@
+"""Static autodiff: append_backward / gradients.
+
+Reference parity: python/paddle/fluid/backward.py — append_backward (:1288)
+walks ops in reverse generating grad-op descs from registered GradOpMakers,
+deduping accumulation (:424) and pruning no-grad vars (:529).
+
+TPU-first: backward is derived from the WHOLE recorded forward segment with
+jax.grad over its replay — one macro grad op computes every parameter
+gradient in a single traced computation (XLA then fuses/schedules it with
+forward; re-used forward values are CSE'd, recomputed ones are effectively
+rematerialized).  Per-op GradOpMakers are unnecessary because every recorded
+primitive is jax-differentiable.  ``checkpoints`` mirrors
+_append_backward_ops_with_checkpoints_ (backward.py:701) via jax.checkpoint
+over the replay.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+
+from .program import Operator, Variable, default_main_program
+
+
+def _segment_io(ops, block, param_names, loss_name):
+    """External inputs of the op segment: consumed but not produced and not
+    parameters (i.e. feed/data vars)."""
+    produced = set()
+    for op in ops:
+        produced.update(op.output_names)
+    ext = []
+    for op in ops:
+        for n in op.input_names:
+            if n not in produced and n not in param_names and n not in ext:
+                ext.append(n)
+    return ext
+
+
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
+                    checkpoints=None):
+    """Returns [(param_var, grad_var)] like backward.py:1288."""
+    block = loss.block
+    program = block.program
+    if parameter_list:
+        param_names = [p if isinstance(p, str) else p.name
+                       for p in parameter_list]
+    else:
+        param_names = [n for n in program._parameters
+                       if block.has_var(n) and block.var(n).trainable]
+    no_grad = {n if isinstance(n, str) else n.name
+               for n in (no_grad_set or set())}
+    param_names = [n for n in param_names if n not in no_grad]
+    if not param_names:
+        raise ValueError("append_backward: no trainable parameters found")
+
+    fwd_ops = list(block.ops)
+    ext_names = _segment_io(fwd_ops, block, set(param_names), loss.name)
+    loss_name = loss.name
+
+    def grad_fn(*arrs):
+        pvals = arrs[:len(param_names)]
+        evals = arrs[len(param_names):]
+        base_env = dict(zip(ext_names, evals))
+
+        def loss_of(pv):
+            env = dict(base_env)
+            env.update(zip(param_names, pv))
+            for op in fwd_ops:
+                ins = [env[n] for n in op.input_names]
+                outs = op.run_fn()(*ins)
+                for name, val in zip(op.output_names, outs):
+                    env[name] = val
+            out = env[loss_name]
+            return out.sum() if out.ndim else out
+
+        f = loss_of
+        if checkpoints:
+            f = jax.checkpoint(f)
+        grads = jax.grad(f)(tuple(pvals))
+        return tuple(grads)
+
+    # declare grad vars + the macro op writing them
+    grad_vars = []
+    for n in param_names:
+        pv = block.var(n)
+        gv = block.create_var(name=n + "@GRAD", shape=pv.shape,
+                              dtype=pv.dtype, stop_gradient=True)
+        grad_vars.append(gv)
+    op = Operator(block, prim="@backward",
+                  inputs=param_names + ext_names,
+                  outputs=[g.name for g in grad_vars],
+                  attrs={}, fn=grad_fn, type_name="backward")
+    block.ops.append(op)
+    program._version += 1
+    return [(block.var(n), g) for n, g in zip(param_names, grad_vars)]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """backward.py:1878 calc_gradient parity (first-order, static)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    loss = targets[0]
+    pgs = append_backward(loss, parameter_list=[v.name for v in inputs],
+                          no_grad_set=no_grad_set)
+    return [g for _, g in pgs]

@@ -1,0 +1,68 @@
+"""CompiledProgram / BuildStrategy / ExecutionStrategy.
+
+Reference parity: python/paddle/fluid/compiler.py:88 — with_data_parallel
+(:164) builds a C++ ParallelExecutor with a pass pipeline
+(build_strategy.cc:58).  TPU-native: "compiling with data parallelism" means
+the Executor shards the feed batch over the mesh dp axis and lets GSPMD
+replicate the (already whole-program-jitted) computation — the 103-pass IR
+pipeline and SSA graph executors are the XLA compiler's job.  The strategy
+objects keep their fields for API parity; most are advisory on TPU.
+"""
+from __future__ import annotations
+
+
+class BuildStrategy:
+    """details/build_strategy.h pybind parity (fields advisory on TPU —
+    fusion/memory passes are XLA's)."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    """ExecutionStrategy pybind parity."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+        self.use_thread_barrier = False
+
+
+class CompiledProgram:
+    """compiler.py:88 parity."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = ExecutionStrategy()
+        self._data_parallel = False
+        self._loss_name = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        self._data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        if exec_strategy is not None:
+            self._exec_strategy = exec_strategy
+        return self

@@ -1,0 +1,318 @@
+"""Scope + Executor: static-program execution.
+
+Reference parity: Scope ≙ paddle/fluid/framework/scope.h (name→Variable map);
+Executor.run ≙ python/paddle/fluid/executor.py:916 → C++ Executor::Run
+(executor.cc:179) whose hot loop interprets ops one-by-one (executor.cc:473).
+
+TPU-first: instead of op-by-op interpretation, ``run`` compiles the WHOLE
+block into one XLA computation (jax.jit of the sequential replay) cached by
+(program version, feed signature) — the analogue of the reference's program
+cache (executor.py:1277) but yielding a single fused device program, which is
+the idiomatic (and only fast) way to execute a graph on TPU.  Startup
+programs (initializers) run eagerly, matching their one-shot nature.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .program import Program, Variable, default_main_program
+
+
+class Scope:
+    """scope.h parity: name → array, with parent chain."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, jnp.ndarray] = {}
+        self._parent = parent
+        self._kids: List["Scope"] = []
+
+    def new_scope(self):
+        s = Scope(self)
+        self._kids.append(s)
+        return s
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    def find_var(self, name):
+        if name in self._vars:
+            return self._vars[name]
+        if self._parent is not None:
+            return self._parent.find_var(name)
+        return None
+
+    def set_var(self, name, value):
+        self._vars[name] = value
+
+    def var_names(self):
+        return list(self._vars)
+
+    def __contains__(self, name):
+        return self.find_var(name) is not None
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _global_scope
+        prev = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = prev
+    return guard()
+
+
+class Executor:
+    """executor.py:475 parity."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    # -- eager interpretation (startup programs / debugging) -----------------
+    def _run_eager(self, program: Program, scope: Scope):
+        env = {}
+        for op in program.global_block().ops:
+            ins = [self._lookup(n, env, scope, program) for n in op.input_names]
+            outs = op.run_fn()(*ins)
+            for name, val in zip(op.output_names, outs):
+                env[name] = val
+        self._writeback(program, env, scope)
+        return env
+
+    @staticmethod
+    def _lookup(name, env, scope, program):
+        if name in env:
+            return env[name]
+        v = scope.find_var(name)
+        if v is None:
+            raise RuntimeError(f"variable {name!r} has no value (not fed, "
+                               f"not initialized in scope)")
+        return v
+
+    @staticmethod
+    def _writeback(program, env, scope):
+        for b in program.blocks:
+            for name, var in b.vars.items():
+                if var.persistable and name in env:
+                    scope.set_var(name, env[name])
+
+    # -- compiled run --------------------------------------------------------
+    def _persistable_names(self, program):
+        names = []
+        for b in program.blocks:
+            for name, var in b.vars.items():
+                if var.persistable and name not in names:
+                    names.append(name)
+        return names
+
+    def _build_replay(self, program, feed_names, fetch_names, persist_names,
+                      written):
+        ops = program.global_block().ops
+
+        def replay(feed_vals, persist_vals):
+            env = dict(zip(feed_names, feed_vals))
+            env.update(zip(persist_names, persist_vals))
+            for op in ops:
+                ins = [env[n] for n in op.input_names]
+                outs = op.run_fn()(*ins)
+                for name, val in zip(op.output_names, outs):
+                    env[name] = val
+            fetches = tuple(env[n] for n in fetch_names)
+            updates = tuple(env[n] for n in written)
+            return fetches, updates
+
+        return replay
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        program = program or default_main_program()
+        compiled = getattr(program, "_compiled_program", None)
+        if compiled is None and type(program).__name__ == "CompiledProgram":
+            compiled = program
+            program = compiled._program
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        # startup / init programs: run once, eagerly
+        if any(op.prim == "@init" for op in program.global_block().ops):
+            self._run_eager(program, scope)
+            return []
+
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+        feed_items = sorted(feed.items())
+        feed_names = [k for k, _ in feed_items]
+        feed_vals = [v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                     for _, v in feed_items]
+
+        persist_names = self._persistable_names(program)
+        written = [n for n in persist_names
+                   if any(n in op.output_names
+                          for op in program.global_block().ops)]
+
+        # cache per (program, feed signature); the compiled replay returns
+        # the UNION of all fetch sets seen so far, so alternating fetch
+        # lists (loss-only vs loss+acc) share one compiled program instead
+        # of one per distinct fetch tuple. A new fetch name recompiles
+        # once, then the union is stable.
+        key = (program._uid, program._version,
+               tuple((n, v.shape, str(v.dtype))
+                     for n, v in zip(feed_names, feed_vals)))
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None or not set(fetch_names) <= set(entry[0]):
+            union = list(entry[0]) if entry else []
+            union += [n for n in fetch_names if n not in union]
+            replay = self._build_replay(program, feed_names, union,
+                                        persist_names, written)
+            jitted = jax.jit(replay)
+            entry = (union, jitted, persist_names, written)
+            self._cache[key] = entry
+            from ..utils.monitor import stat_add
+            stat_add("STAT_executor_compiles")
+        union, jitted, persist_names, written = entry
+        fetch_pos = [union.index(n) for n in fetch_names]
+
+        for hook in getattr(program, "_pre_run_hooks", []):
+            hook(scope)
+
+        persist_vals = []
+        for n in persist_names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"persistable {n!r} not initialized — run the startup "
+                    f"program first (exe.run(paddle.static.default_startup_"
+                    f"program()))")
+            persist_vals.append(v)
+
+        if compiled is not None and compiled._data_parallel:
+            from ..parallel.api import batch_sharding
+            from ..parallel.mesh import get_mesh
+            mesh = get_mesh()
+            feed_vals = [jax.device_put(v, batch_sharding(mesh, ndim=max(v.ndim, 1)))
+                         for v in feed_vals]
+
+        fetches, updates = jitted(feed_vals, persist_vals)
+        for n, val in zip(written, updates):
+            scope.set_var(n, val)
+        picked = [fetches[i] for i in fetch_pos]
+        if return_numpy:
+            return [np.asarray(f) for f in picked]
+        return [Tensor(f) for f in picked]
+
+    # -- dataset-driven training (Trainer/DeviceWorker runtime) -------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100, epochs=1):
+        """trainer.h:51 / device_worker.h parity: run the whole epoch as
+        ONE compiled program — no Python between steps.
+
+        The reference's DistMultiTrainer spins C++ DeviceWorkers that pull
+        from a DataFeed and run the op graph per minibatch, bypassing
+        Python. The TPU-shape of that: stack the epoch's batches on device
+        and ``lax.scan`` the program's replay over them inside a single
+        jit — Python is out of the loop entirely, which is the same
+        contract with a faster engine.
+
+        ``dataset``: an iterable of feed dicts {var_name: ndarray}, an
+        io.DataLoader yielding such dicts, or a dict of pre-stacked
+        arrays {var_name: [steps, ...]}.
+        Returns {fetch_name: [epochs*steps, ...] numpy} for fetch_list.
+        """
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        fetch_list = fetch_list or []
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+
+        # materialize the epoch feed stack [steps, ...] per var
+        if isinstance(dataset, dict):
+            stacks = {k: jnp.asarray(v) for k, v in dataset.items()}
+        else:
+            cols = {}
+            for feed in dataset:
+                for k, v in feed.items():
+                    cols.setdefault(k, []).append(np.asarray(
+                        v.numpy() if isinstance(v, Tensor) else v))
+            stacks = {k: jnp.asarray(np.stack(vs))
+                      for k, vs in cols.items()}
+        feed_names = sorted(stacks)
+        n_steps = next(iter(stacks.values())).shape[0]
+
+        persist_names = self._persistable_names(program)
+        written = [n for n in persist_names
+                   if any(n in op.output_names
+                          for op in program.global_block().ops)]
+        replay = self._build_replay(program, feed_names, fetch_names,
+                                    persist_names, written)
+        w_pos = [persist_names.index(n) for n in written]
+
+        def epoch_fn(persist_vals, feed_stacks):
+            def step(carry, feeds):
+                fetches, updates = replay(list(feeds), list(carry))
+                carry = list(carry)
+                for p, u in zip(w_pos, updates):
+                    carry[p] = u
+                return tuple(carry), fetches
+            return jax.lax.scan(step, tuple(persist_vals), feed_stacks)
+
+        jitted = jax.jit(epoch_fn)
+
+        persist_vals = []
+        for n in persist_names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"persistable {n!r} not initialized — run the startup "
+                    f"program first")
+            persist_vals.append(v)
+
+        feed_stacks = tuple(stacks[k] for k in feed_names)
+        all_fetches = {n: [] for n in fetch_names}
+        for ep in range(epochs):
+            persist_vals, fetches = jitted(tuple(persist_vals),
+                                           feed_stacks)
+            persist_vals = list(persist_vals)
+            for n, f in zip(fetch_names, fetches):
+                all_fetches[n].append(np.asarray(f))
+            if debug and fetch_names:
+                head = fetch_names[0]
+                _last = all_fetches[head][-1]
+                print(f"[train_from_dataset] epoch {ep}: {head} "
+                      f"mean={np.mean(_last):.6f}")
+        for n, val in zip(persist_names, persist_vals):
+            scope.set_var(n, val)
+        return {n: np.concatenate(v) if v else np.array([])
+                for n, v in all_fetches.items()}
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Inference twin of train_from_dataset (same scanned engine; the
+        program simply has no optimizer ops, so nothing is written back)."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period, epochs=1)
+
+    def close(self):
+        self._cache.clear()

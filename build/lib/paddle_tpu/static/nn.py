@@ -1,0 +1,232 @@
+"""paddle.static.nn: static-graph layer builders.
+
+Reference parity: python/paddle/fluid/layers/nn.py (the 36K-LoC layers DSL,
+SURVEY.md §2.4) — here each builder creates eager Parameters (registered into
+the program as persistables by the primitive recorder) and invokes the same
+nn.functional ops that dygraph uses, so the static DSL is a thin veneer
+rather than a parallel implementation.
+"""
+from __future__ import annotations
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.layers import ParamAttr
+from ..framework.tensor import Parameter
+from ..framework.dtype import convert_dtype
+
+
+def _make_param(shape, dtype, attr, default_init, name_hint):
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = attr.initializer or default_init
+    value = init(shape, convert_dtype(dtype) or "float32")
+    p = Parameter(value, name=attr.name)
+    return p
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """fluid.layers.fc parity."""
+    from .. import ops
+    in_dim = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_dim *= d
+    if len(x.shape) > num_flatten_dims + 1:
+        lead = [-1 if (d is None or d < 0) else d
+                for d in x.shape[:num_flatten_dims]]
+        x = ops.reshape(x, lead + [in_dim])
+    w = _make_param([in_dim, size], "float32", weight_attr,
+                    I.XavierUniform(), "fc_w")
+    b = _make_param([size], "float32", bias_attr, I.Constant(0.0), "fc_b")
+    out = F.linear(x, w, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    w = _make_param([num_filters, in_ch // groups] + list(ks), "float32",
+                    param_attr, I.XavierUniform(), "conv_w")
+    b = _make_param([num_filters], "float32", bias_attr, I.Constant(0.0),
+                    "conv_b")
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    w = _make_param(list(size), dtype, param_attr, I.XavierUniform(), "emb_w")
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None):
+    from .. import ops
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = _make_param([c], "float32", param_attr, I.Constant(1.0), "bn_s")
+    bias = _make_param([c], "float32", bias_attr, I.Constant(0.0), "bn_b")
+    mean = Parameter(jnp.zeros([c], jnp.float32))
+    var = Parameter(jnp.ones([c], jnp.float32))
+    mean.stop_gradient = True
+    var.stop_gradient = True
+    out = F.batch_norm(input, mean, var, weight=scale, bias=bias,
+                       training=not is_test, momentum=momentum,
+                       epsilon=epsilon, data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """fluid.layers.layer_norm parity (layer_norm_op.cc)."""
+    norm_shape = [int(d) for d in input.shape[begin_norm_axis:]]
+    w = _make_param(norm_shape, "float32", param_attr, I.Constant(1.0),
+                    "ln_s") if scale else None
+    b = _make_param(norm_shape, "float32", bias_attr, I.Constant(0.0),
+                    "ln_b") if shift else None
+    out = F.layer_norm(input, norm_shape, weight=w, bias=b, epsilon=epsilon)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def dropout(x, dropout_prob=0.5, is_test=False,
+            dropout_implementation="downgrade_in_infer", seed=None,
+            name=None):
+    """fluid.layers.dropout parity (dropout_op.cc)."""
+    mode = ("downscale_in_infer"
+            if dropout_implementation == "downgrade_in_infer"
+            else "upscale_in_train")
+    return F.dropout(x, p=dropout_prob, training=not is_test, mode=mode)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, data_format="NCHW", name=None):
+    """fluid.layers.pool2d parity (pool_op.cc)."""
+    if global_pooling:
+        sp = input.shape[2:] if data_format == "NCHW" else input.shape[1:3]
+        pool_size, pool_padding, pool_stride = list(sp), 0, 1
+    if pool_type == "max":
+        return F.max_pool2d(input, pool_size, pool_stride, pool_padding,
+                            ceil_mode=ceil_mode, data_format=data_format)
+    return F.avg_pool2d(input, pool_size, pool_stride, pool_padding,
+                        ceil_mode=ceil_mode, exclusive=exclusive,
+                        data_format=data_format)
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None):
+    """fluid.layers.conv2d_transpose parity (conv_transpose_op.cc)."""
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("conv2d_transpose needs filter_size or "
+                             "output_size")
+        # derive the kernel from the requested output (conv_transpose_op.cc
+        # InferShape inverted): k = out - (in-1)*stride + 2*pad
+        os_ = [output_size, output_size] if isinstance(output_size, int) \
+            else list(output_size)
+        st = [stride, stride] if isinstance(stride, int) else list(stride)
+        pd = [padding, padding] if isinstance(padding, int) else list(padding)
+        sp = input.shape[2:4] if data_format == "NCHW" else input.shape[1:3]
+        ks = [os_[i] - (sp[i] - 1) * st[i] + 2 * pd[i] for i in range(2)]
+    else:
+        ks = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size, filter_size]
+    w = _make_param([in_ch, num_filters // groups] + list(ks), "float32",
+                    param_attr, I.XavierUniform(), "convt_w")
+    b = _make_param([num_filters], "float32", bias_attr, I.Constant(0.0),
+                    "convt_b")
+    out = F.conv2d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    """fluid.layers.prelu parity (prelu_op.cc): alpha shared over all
+    elements / per channel / per element."""
+    from .. import ops
+    nd = len(x.shape)
+    if mode == "all":
+        shape, bshape = [1], [1] * nd
+    elif mode == "channel":
+        shape, bshape = [x.shape[1]], [1, x.shape[1]] + [1] * (nd - 2)
+    else:
+        shape = list(x.shape[1:])
+        bshape = [1] + shape
+    w = _make_param(shape, "float32", param_attr, I.Constant(0.25),
+                    "prelu_a")
+    alpha = ops.reshape(w, bshape)
+    zero = x * 0
+    return ops.maximum(x, zero) + alpha * ops.minimum(x, zero)
+
+
+def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
+         num_layers=1, dropout_prob=0.0, is_bidirec=False, is_test=False,
+         name=None, param_attr=None, bias_attr=None):
+    """fluid.layers.lstm parity (cudnn_lstm_op.cc) over the framework's
+    scan-based LSTM. input [B, T, D] (batch-first here; the recorder is
+    shape-driven). Returns (out, last_h, last_c)."""
+    from ..nn.layer.rnn import LSTM as _LSTM
+    D = input.shape[-1]
+    hidden_size = hidden_size or init_h.shape[-1]
+    rnn = _LSTM(D, hidden_size, num_layers=num_layers,
+                direction="bidirect" if is_bidirec else "forward")
+    out, (h, c) = rnn(input, (init_h, init_c))
+    return out, h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid", name=None):
+    """fluid.layers.gru_unit parity (gru_unit_op.cc): one GRU step.
+    ``size`` is 3*hidden_dim, matching the reference convention."""
+    from ..nn.layer.rnn import GRUCell
+    hidden_dim = size // 3
+    cell = GRUCell(input.shape[-1], hidden_dim)
+    h, _ = cell(input, hidden)
+    return h, h, h   # (hidden, reset_hidden_prev, gate) API shape parity
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """fluid.layers.spectral_norm parity (spectral_norm_op.cc): normalize
+    the weight by its largest singular value via power iteration."""
+    if power_iters < 1:
+        raise ValueError("spectral_norm needs power_iters >= 1 (no "
+                         "persisted u/v state to reuse)")
+    from .. import ops
+    import jax.numpy as jnp
+    from ..framework.tensor import Tensor, unwrap
+    w = weight
+    mat = ops.reshape(ops.transpose(
+        w, [dim] + [i for i in range(len(w.shape)) if i != dim]),
+        [w.shape[dim], -1])
+    u = Tensor(jnp.ones([mat.shape[0]], jnp.float32))
+    v = None
+    for _ in range(power_iters):
+        v = F.normalize(ops.matmul(u, mat), axis=0, epsilon=eps)
+        u = F.normalize(ops.matmul(mat, v), axis=0, epsilon=eps)
+    sigma = ops.sum(u * ops.matmul(mat, v))
+    return w / sigma
+
+
+# -- control flow (layers/control_flow.py parity) ----------------------------
+from ..ops.control_flow import while_loop, cond, case, switch_case  # noqa: F401,E402

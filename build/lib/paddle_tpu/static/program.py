@@ -1,0 +1,377 @@
+"""Static-graph IR: Program / Block / Operator / Variable.
+
+Reference parity: python/paddle/fluid/framework.py — Program (:4055),
+Block (:2569), Operator (:1970), Variable (:976), Parameter (:5205),
+default program singletons (:5450,:5479), program_guard (:5547); backed by
+the C++ ProgramDesc protobuf (paddle/fluid/framework/framework.proto:200).
+
+TPU-first: an Operator is a *named primitive application* — the primitive
+registry (framework/primitive.py) is the op registry, so a recorded program
+is a list of (prim_name, input names, attrs, output names) tuples: trivially
+serializable (save_inference_model) and replayable as ONE jax-traced function
+that XLA compiles whole (the Executor's batched-interpretation move,
+SURVEY.md §7 phase 3).  Shape/dtype inference (InferShape ≙ operator.cc:1126)
+is jax.eval_shape over ShapeDtypeStructs at append time — exactly when the
+reference runs InferShape for static graphs (framework.py:1970 appends call
+InferShape eagerly).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..framework.dtype import convert_dtype
+
+_var_counter = [0]
+
+
+def _unique(prefix):
+    _var_counter[0] += 1
+    return f"{prefix}_{_var_counter[0]}"
+
+
+class Variable:
+    """Symbolic tensor in a Block (framework.py:976 parity).
+
+    Holds only metadata (name/shape/dtype); values live in a Scope at run
+    time.  Operator-overload methods are patched on by ops/patch.py exactly
+    as for eager Tensors, so ``a + b`` appends an elementwise_add op.
+    """
+
+    def __init__(self, block, name=None, shape=None, dtype="float32",
+                 persistable=False, stop_gradient=True, is_data=False,
+                 trainable=False):
+        self.block = block
+        self.name = name or _unique("var")
+        self.shape = list(shape) if shape is not None else []
+        self.dtype = convert_dtype(dtype)
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.trainable = trainable
+        self.op = None  # producing Operator
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def dim(self):
+        return self.ndim
+
+    @property
+    def size(self):
+        return int(np.prod([d if d and d > 0 else 1 for d in self.shape]))
+
+    def numel(self):
+        return self.size
+
+    def astype(self, dtype):
+        from ..ops import cast
+        return cast(self, dtype)
+
+    def aval(self, dyn=1):
+        """ShapeDtypeStruct with dynamic dims (None/-1) replaced by ``dyn``.
+        InferShape probes with two values of ``dyn`` to discover which output
+        dims are dynamic (see Block.append_op)."""
+        shape = tuple(d if d is not None and d >= 0 else dyn
+                      for d in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+    def has_dynamic_dims(self):
+        return any(d is None or d < 0 for d in self.shape)
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={np.dtype(self.dtype).name})")
+
+
+class Operator:
+    """One program op (framework.py:1970 parity).
+
+    ``prim`` is the registered primitive name; ``fn`` may override for macro
+    ops (backward/optimizer fusions) that close over program structure and
+    are not in the registry (those are pruned on inference export).
+    """
+
+    def __init__(self, block, prim: str, inputs: List[str], outputs: List[str],
+                 attrs: Dict[str, Any], fn=None, type_name=None):
+        self.block = block
+        self.prim = prim
+        self.type = type_name or prim
+        self.input_names = list(inputs)
+        self.output_names = list(outputs)
+        self.attrs = dict(attrs)
+        self._fn = fn
+
+    def run_fn(self):
+        """array-level callable (*input arrays) -> tuple of output arrays."""
+        if self._fn is not None:
+            return self._fn
+        from ..framework.primitive import get_primitive
+        prim = get_primitive(self.prim)
+
+        def fn(*arrs):
+            out = prim.fn(*arrs, **self.attrs)
+            return out if isinstance(out, tuple) else (out,)
+        return fn
+
+    def serializable(self):
+        return self._fn is None
+
+    def __repr__(self):
+        return (f"{{Op {self.type}: ({', '.join(self.input_names)}) -> "
+                f"({', '.join(self.output_names)})}}")
+
+
+class Block:
+    """framework.py:2569 parity: ordered ops + var map."""
+
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    def var(self, name) -> Variable:
+        v = self.vars.get(name)
+        if v is None:
+            if self.parent_idx >= 0:
+                return self.program.block(self.parent_idx).var(name)
+            raise KeyError(f"variable {name!r} not found in block {self.idx}")
+        return v
+
+    def has_var(self, name):
+        try:
+            self.var(name)
+            return True
+        except KeyError:
+            return False
+
+    def create_var(self, name=None, shape=None, dtype="float32",
+                   persistable=False, stop_gradient=True, **kw) -> Variable:
+        v = Variable(self, name=name, shape=shape, dtype=dtype,
+                     persistable=persistable, stop_gradient=stop_gradient,
+                     **{k: kw[k] for k in ("is_data", "trainable") if k in kw})
+        self.vars[v.name] = v
+        return v
+
+    def create_parameter(self, name=None, shape=None, dtype="float32",
+                         initializer=None, trainable=True, **kw):
+        v = self.create_var(name=name or _unique("param"), shape=shape,
+                            dtype=dtype, persistable=True,
+                            stop_gradient=not trainable, trainable=trainable)
+        self.program._parameters.append(v.name)
+        if initializer is not None:
+            startup = self.program._startup or default_startup_program()
+            startup.global_block()._append_init_op(v, initializer)
+        return v
+
+    def _append_init_op(self, var, initializer):
+        """Record an initializer op (runs in the startup program, like the
+        reference's uniform_random/fill_constant startup ops)."""
+        if var.name not in self.vars:
+            self.vars[var.name] = var
+
+        def fn():
+            value = initializer(var.shape, var.dtype)
+            from ..framework.tensor import Tensor
+            return (value._value if isinstance(value, Tensor) else value,)
+        self.ops.append(Operator(self, prim="@init", inputs=[],
+                                 outputs=[var.name], attrs={}, fn=fn,
+                                 type_name="init"))
+
+    def append_op(self, prim: str, inputs: Sequence[Variable],
+                  attrs: Dict[str, Any], n_outputs=1, fn=None,
+                  type_name=None, out_stop_gradient=None):
+        """Append + InferShape (framework.py Operator ctor parity)."""
+        in_names = [v.name for v in inputs]
+        from ..framework.primitive import get_primitive
+        if fn is None:
+            prim_obj = get_primitive(prim)
+
+            def infer(dyn):
+                res = jax.eval_shape(lambda *a: prim_obj.fn(*a, **attrs),
+                                     *[v.aval(dyn) for v in inputs])
+                return res if isinstance(res, (tuple, list)) else (res,)
+
+            out_avals = infer(2)
+            # probe a second dynamic-dim value: output dims that follow the
+            # probe are dynamic and recorded as -1 (InferShape -1 propagation,
+            # operator.cc:1126 parity); actual shapes specialize at run time
+            dyn_shapes = None
+            if any(v.has_dynamic_dims() for v in inputs):
+                probe = infer(3)
+                dyn_shapes = [
+                    [-1 if a.shape[i] != b.shape[i] else a.shape[i]
+                     for i in range(len(a.shape))]
+                    for a, b in zip(out_avals, probe)]
+        else:
+            out_avals = None  # macro op declares its outputs itself
+            dyn_shapes = None
+        outs = []
+        if out_stop_gradient is None:
+            out_stop_gradient = all(v.stop_gradient for v in inputs)
+        for i in range(n_outputs if out_avals is None else len(out_avals)):
+            if out_avals is None:
+                shape, dtype = None, "float32"
+            else:
+                shape = (dyn_shapes[i] if dyn_shapes is not None
+                         else list(out_avals[i].shape))
+                dtype = out_avals[i].dtype
+            ov = self.create_var(
+                name=_unique(f"{prim}.out"), shape=shape, dtype=dtype,
+                stop_gradient=out_stop_gradient)
+            outs.append(ov)
+        op = Operator(self, prim=prim, inputs=in_names,
+                      outputs=[o.name for o in outs], attrs=attrs, fn=fn,
+                      type_name=type_name)
+        self.ops.append(op)
+        for o in outs:
+            o.op = op
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def all_parameters(self):
+        return [self.vars[n] for n in self.program._parameters
+                if n in self.vars]
+
+
+class Program:
+    """framework.py:4055 parity."""
+
+    _UID = [0]
+
+    def __init__(self):
+        # monotonically unique id for the Executor's compile cache: id() of
+        # a dead Program can be recycled by the allocator, a _uid cannot
+        Program._UID[0] += 1
+        self._uid = Program._UID[0]
+        self.blocks = [Block(self, 0)]
+        self._parameters: List[str] = []
+        self._version = 0
+        self._startup: Optional[Program] = None
+        self.random_seed = 0
+        self._feed_names: List[str] = []
+        self._fetch_names: List[str] = []
+        # callables(scope) run by the Executor before each run — used to
+        # refresh scope-held hyperparameters (e.g. the optimizer LR var) so
+        # schedules flow into the compiled program as inputs, never as baked
+        # constants
+        self._pre_run_hooks: List = []
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def block(self, idx) -> Block:
+        return self.blocks[idx]
+
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+    def current_block(self) -> Block:
+        return self.blocks[-1]
+
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program()
+        p.blocks = []
+        for b in self.blocks:
+            nb = Block(p, b.idx, b.parent_idx)
+            nb.vars = {}
+            for name, v in b.vars.items():
+                nv = Variable(nb, name=name, shape=v.shape, dtype=v.dtype,
+                              persistable=v.persistable,
+                              stop_gradient=v.stop_gradient,
+                              is_data=v.is_data, trainable=v.trainable)
+                nb.vars[name] = nv
+            nb.ops = [Operator(nb, op.prim, op.input_names, op.output_names,
+                               copy.deepcopy(op.attrs), fn=op._fn,
+                               type_name=op.type) for op in b.ops]
+            p.blocks.append(nb)
+        p._parameters = list(self._parameters)
+        p._startup = self._startup
+        p._pre_run_hooks = list(self._pre_run_hooks)
+        if for_test:
+            for b in p.blocks:
+                for op in b.ops:
+                    if "training" in op.attrs:
+                        op.attrs["training"] = False
+                    # dropout in eval: identity via rate 0
+                    if op.prim == "dropout":
+                        op.attrs["p"] = 0.0
+        return p
+
+    def _prune(self, feed_names, fetch_names):
+        """io.py save_inference_model pruning: keep ops reachable from
+        fetches, cut above feeds."""
+        needed = set(fetch_names)
+        kept = []
+        for op in reversed(self.global_block().ops):
+            if any(o in needed for o in op.output_names):
+                kept.append(op)
+                for i in op.input_names:
+                    needed.add(i)
+        kept.reverse()
+        pruned = self.clone()
+        pb = pruned.global_block()
+        pb.ops = [Operator(pb, op.prim, op.input_names, op.output_names,
+                           op.attrs, fn=op._fn, type_name=op.type)
+                  for op in kept]
+        pruned._feed_names = list(feed_names)
+        pruned._fetch_names = list(fetch_names)
+        return pruned
+
+    def __repr__(self):
+        lines = [f"Program(blocks={len(self.blocks)})"]
+        for op in self.global_block().ops:
+            lines.append("  " + repr(op))
+        return "\n".join(lines)
+
+
+# -- global state (framework.py:5450,5479,5547 parity) -----------------------
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def reset_default_programs():
+    global _main_program, _startup_program
+    _main_program = Program()
+    _startup_program = Program()
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev_m, prev_s = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    main_program._startup = _startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev_m, prev_s
+
+
+def current_block() -> Block:
+    return _main_program.current_block()

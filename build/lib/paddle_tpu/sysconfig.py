@@ -1,0 +1,22 @@
+"""Install-tree introspection.
+
+Reference parity: python/paddle/sysconfig.py (get_include/get_lib). The TPU
+build has no bundled C++ core library; get_lib points at the native/ ctypes
+extensions directory (built on demand by paddle_tpu.native).
+"""
+from __future__ import annotations
+
+import os.path
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory containing the framework's headers (native C sources double
+    as the public header surface for the ctypes ABI)."""
+    return os.path.join(os.path.dirname(__file__), "native")
+
+
+def get_lib():
+    """Directory containing the framework's shared libraries."""
+    return os.path.join(os.path.dirname(__file__), "native", "_build")

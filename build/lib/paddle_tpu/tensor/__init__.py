@@ -1,0 +1,80 @@
+"""paddle.tensor namespace.
+
+Reference parity: python/paddle/tensor/ (math.py, creation.py, linalg.py,
+logic.py, manipulation.py, random.py, search.py, stat.py, attribute.py).
+The TPU build keeps one implementation under paddle_tpu.ops and re-exports
+it here so ``paddle.tensor.xxx`` spellings resolve; the fluid-era
+``elementwise_*``/``has_inf``/``has_nan`` names live here too (reference
+python/paddle/tensor/math.py DEFINE_ALIAS block).
+"""
+from __future__ import annotations
+
+from ..ops import *  # noqa: F401,F403
+from ..ops import creation, linalg, manipulation, math, sequence  # noqa: F401
+from ..ops.creation import (  # noqa: F401
+    rand, randn, randint, randperm, uniform, normal,
+)
+
+from ..framework.tensor import Tensor  # noqa: F401
+
+
+def _axis_broadcast(y, x_ndim, y_ndim, axis):
+    """fluid elementwise axis semantics: align y's dims starting at `axis`
+    of x (elementwise_op_function.h GetMidDims)."""
+    if axis == -1 or axis is None:
+        return y
+    from ..ops import manipulation as M
+    tail = x_ndim - axis - y_ndim
+    if tail > 0:
+        shape = list(y.shape) + [1] * tail
+        return M.reshape(y, shape)
+    return y
+
+
+def _elementwise(opname, fn):
+    def op(x, y, axis=-1, act=None, name=None):
+        xnd = len(x.shape)
+        ynd = len(y.shape)
+        y = _axis_broadcast(y, xnd, ynd, axis)
+        out = fn(x, y)
+        if act is not None:
+            from ..nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+    op.__name__ = opname
+    op.__doc__ = (f"fluid.layers.{opname} parity: binary op with fluid "
+                  "axis-broadcast semantics (elementwise_op_function.h).")
+    return op
+
+
+from ..ops.math import (add as _add, subtract as _sub, multiply as _mul,
+                        divide as _div, floor_divide as _fdiv, mod as _mod,
+                        pow as _pow, maximum as _max, minimum as _min)
+
+elementwise_add = _elementwise("elementwise_add", _add)
+elementwise_sub = _elementwise("elementwise_sub", _sub)
+elementwise_mul = _elementwise("elementwise_mul", _mul)
+elementwise_div = _elementwise("elementwise_div", _div)
+elementwise_floordiv = _elementwise("elementwise_floordiv", _fdiv)
+elementwise_mod = _elementwise("elementwise_mod", _mod)
+elementwise_pow = _elementwise("elementwise_pow", _pow)
+elementwise_max = _elementwise("elementwise_max", _max)
+elementwise_min = _elementwise("elementwise_min", _min)
+
+
+def has_inf(x, name=None):
+    """True if any element of x is +/-Inf (tensor/search.py has_inf)."""
+    from ..ops.math import isinf as _isinf, any as _any
+    return _any(_isinf(x))
+
+
+def has_nan(x, name=None):
+    """True if any element of x is NaN (tensor/search.py has_nan)."""
+    from ..ops.math import isnan as _isnan, any as _any
+    return _any(_isnan(x))
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """fluid.layers.fill_constant parity (top-level DEFINE_ALIAS)."""
+    from ..ops.creation import full
+    return full(shape, value, dtype=dtype)

@@ -1,0 +1,24 @@
+"""paddle.text parity package: text models + datasets.
+
+Reference parity: python/paddle/text/ (RNN-era model zoo + datasets). The TPU
+build additionally ships the transformer-LM family (bert.py) because BERT-base
+pretraining is a headline benchmark workload (BASELINE.json config 3).
+"""
+from . import models  # noqa: F401
+from .models import (  # noqa: F401
+    BertModel, BertConfig, BertForPretraining, GPTModel, GPTConfig,
+)
+from ..ops.decode import viterbi_decode  # noqa: F401
+
+
+class ViterbiDecoder:
+    """paddle.text.ViterbiDecoder over the viterbi_decode op
+    (ops/decode.py; reference 2.x paddle.text.viterbi_decode)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

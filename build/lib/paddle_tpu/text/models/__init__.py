@@ -1,0 +1,2 @@
+from .bert import BertModel, BertConfig, BertForPretraining  # noqa: F401
+from .gpt import GPTModel, GPTConfig  # noqa: F401

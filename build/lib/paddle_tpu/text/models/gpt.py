@@ -1,0 +1,60 @@
+"""GPT-style decoder-only LM (causal transformer).
+
+Not present in the 2.0-rc reference model zoo, but the natural second
+transformer workload for the TPU framework (the scaling/pipeline strategies
+need a decoder-only config). Shares TP annotation logic with bert.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ... import nn
+from ...nn import functional as F
+from ...ops import manipulation as M
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    dropout: float = 0.1
+
+    @classmethod
+    def tiny(cls, vocab_size=128, hidden_size=32, layers=2, heads=2, seq=64):
+        return cls(vocab_size=vocab_size, hidden_size=hidden_size,
+                   num_layers=layers, num_heads=heads,
+                   intermediate_size=hidden_size * 4,
+                   max_position_embeddings=seq)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig = None, **kwargs):
+        super().__init__()
+        cfg = cfg or GPTConfig(**kwargs)
+        self.config = cfg
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
+            dropout=cfg.dropout, activation="gelu", normalize_before=True)
+        self.encoder = nn.TransformerEncoder(layer, cfg.num_layers,
+                                             norm=nn.LayerNorm(cfg.hidden_size))
+
+    def forward(self, input_ids, labels=None):
+        from ... import ops
+        b, s = input_ids.shape
+        pos = M.unsqueeze(ops.arange(s, dtype="int64"), 0)
+        h = self.drop(self.wte(input_ids) + self.wpe(pos))
+        causal = ops.triu(ops.full([s, s], -1e4, dtype="float32"), diagonal=1)
+        h = self.encoder(h, M.unsqueeze(causal, [0, 1]))
+        logits = ops.matmul(h, self.wte.weight, transpose_y=True)
+        if labels is None:
+            return logits
+        return F.cross_entropy(
+            logits[:, :-1].reshape([-1, self.config.vocab_size]),
+            labels[:, 1:].reshape([-1]))

@@ -1,0 +1,5 @@
+"""Utility subsystems: stats/monitor registry + scalar logging."""
+from . import monitor  # noqa: F401
+from .monitor import (  # noqa: F401
+    stat_add, stat_sub, stat_set, stat_get, all_stats, LogWriter,
+)

@@ -1,0 +1,105 @@
+"""Stats/monitor registry + scalar logging (observability).
+
+Reference parity: paddle/fluid/platform/monitor.h — StatRegistry<int64_t>
+with the STAT_INT_ADD/SUB/SET macro family (gauges like
+STAT_gpu0_mem_size) — plus a minimal VisualDL-style LogWriter for scalar
+curves (the reference ecosystem's VisualDL writes protobuf event files;
+here scalars land in JSONL, one file per run, trivially parseable and
+plottable — no daemon, no proto dependency).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict
+
+_lock = threading.Lock()
+_stats: Dict[str, int] = {}
+
+
+def stat_add(name: str, value: int = 1) -> int:
+    """STAT_INT_ADD parity."""
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + int(value)
+        return _stats[name]
+
+
+def stat_sub(name: str, value: int = 1) -> int:
+    """STAT_INT_SUB parity."""
+    return stat_add(name, -int(value))
+
+
+def stat_set(name: str, value: int) -> int:
+    with _lock:
+        _stats[name] = int(value)
+        return _stats[name]
+
+
+def stat_get(name: str) -> int:
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def all_stats() -> Dict[str, int]:
+    """StatRegistry::publish parity: snapshot of every registered stat."""
+    with _lock:
+        return dict(_stats)
+
+
+class LogWriter:
+    """Minimal VisualDL LogWriter: scalars/metadata to JSONL.
+
+    with LogWriter(logdir="runs/exp1") as w:
+        w.add_scalar("train/loss", loss_value, step)
+    """
+
+    def __init__(self, logdir: str, filename_suffix: str = ""):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        fname = f"events.{int(time.time())}.{os.getpid()}" \
+                f"{filename_suffix}.jsonl"
+        self._path = os.path.join(logdir, fname)
+        self._f = open(self._path, "a", buffering=1)
+        self._lock = threading.Lock()
+
+    def add_scalar(self, tag: str, value, step: int = 0,
+                   walltime: float = None):
+        rec = {"tag": tag, "value": float(value), "step": int(step),
+               "wall": walltime if walltime is not None else time.time()}
+        with self._lock:
+            self._f.write(json.dumps(rec) + "\n")
+
+    def add_hparams(self, hparams: dict, metrics: dict = None):
+        rec = {"hparams": {k: repr(v) for k, v in hparams.items()},
+               "metrics": {k: float(v) for k, v in (metrics or {}).items()}}
+        with self._lock:
+            self._f.write(json.dumps(rec) + "\n")
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    @staticmethod
+    def read_scalars(logdir: str):
+        """Load all scalar records from a log dir -> {tag: [(step, value)]}."""
+        out = {}
+        for fn in sorted(os.listdir(logdir)):
+            if not fn.endswith(".jsonl"):
+                continue
+            with open(os.path.join(logdir, fn)) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if "tag" in rec:
+                        out.setdefault(rec["tag"], []).append(
+                            (rec["step"], rec["value"]))
+        return out

@@ -1,0 +1,3 @@
+"""paddle.vision parity: model zoo, transforms, datasets, detection ops."""
+from . import models, transforms, datasets, ops  # noqa: F401
+from .models import *  # noqa: F401,F403

@@ -1,0 +1,118 @@
+"""paddle.vision.datasets parity.
+
+Reference: python/paddle/vision/datasets/ (MNIST, Cifar, Flowers, ...).
+This container is zero-egress: datasets load from local files when present
+(PADDLE_TPU_DATA_HOME or explicit paths) and otherwise generate deterministic
+synthetic data with the right shapes/classes so training pipelines and tests
+run anywhere — downloads never happen implicitly.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME",
+                           os.path.expanduser("~/.cache/paddle_tpu/datasets"))
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files; synthetic fallback (28x28, 10 classes)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None,
+                 synthetic_size=1024):
+        self.mode = mode
+        self.transform = transform
+        images = labels = None
+        base = os.path.join(DATA_HOME, "mnist")
+        prefix = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(
+            base, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            base, f"{prefix}-labels-idx1-ubyte.gz")
+        if os.path.exists(image_path) and os.path.exists(label_path):
+            images, labels = self._load_idx(image_path, label_path)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            labels = rng.randint(0, 10, synthetic_size).astype("int64")
+            images = (rng.rand(synthetic_size, 28, 28) * 255).astype("uint8")
+        self.images, self.labels = images, labels
+
+    @staticmethod
+    def _load_idx(image_path, label_path):
+        op = gzip.open if image_path.endswith(".gz") else open
+        with op(image_path, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                n, rows, cols)
+        with op(label_path, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8).astype("int64")
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype("float32")[None] / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(self.images[idx])
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from local pickled batches; synthetic fallback."""
+
+    _DIR = "cifar-10-batches-py"
+    _TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
+    _TEST_FILES = ["test_batch"]
+    _LABEL_KEY = b"labels"
+    num_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, synthetic_size=1024):
+        self.transform = transform
+        path = data_file or os.path.join(DATA_HOME, self._DIR)
+        if os.path.isdir(path):
+            import pickle
+            xs, ys = [], []
+            names = self._TRAIN_FILES if mode == "train" else self._TEST_FILES
+            for nm in names:
+                with open(os.path.join(path, nm), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xs.append(d[b"data"])
+                ys.extend(d[self._LABEL_KEY])
+            self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
+            self.labels = np.asarray(ys, dtype="int64")
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.labels = rng.randint(0, self.num_classes,
+                                      synthetic_size).astype("int64")
+            self.images = (rng.rand(synthetic_size, 3, 32, 32) * 255) \
+                .astype("uint8")
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype("float32") / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(self.images[idx])
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    _DIR = "cifar-100-python"
+    _TRAIN_FILES = ["train"]
+    _TEST_FILES = ["test"]
+    _LABEL_KEY = b"fine_labels"
+    num_classes = 100

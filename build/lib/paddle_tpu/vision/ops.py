@@ -1,0 +1,46 @@
+"""paddle.vision.ops parity: detection/vision operators namespace.
+
+Reference parity: python/paddle/vision/ops.py (yolo_box, deform_conv2d,
+DeformConv2D, roi_align/roi_pool, psroi_pool, nms and the proposal ops
+whose kernels live under paddle/fluid/operators/detection/). The
+implementations are the TPU-native fixed-shape ops in
+``paddle_tpu/ops/detection.py``; this module is only the public namespace.
+"""
+from ..ops.detection import (  # noqa: F401
+    yolo_box, roi_align, roi_pool, psroi_pool, nms, box_coder,
+    prior_box, anchor_generator, matrix_nms, multiclass_nms,
+    generate_proposals, distribute_fpn_proposals, deform_conv2d,
+    density_prior_box,
+)
+from ..ops.vision import grid_sample  # noqa: F401
+from ..nn.layer.layers import Layer
+from ..framework import core as _core
+
+
+class DeformConv2D(Layer):
+    """Deformable convolution layer (python/paddle/vision/ops.py
+    DeformConv2D over deformable_conv_op.cc)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, bias=self.bias, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups, groups=self._groups,
+            mask=mask)

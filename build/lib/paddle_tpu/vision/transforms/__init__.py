@@ -1,0 +1,162 @@
+"""paddle.vision.transforms parity (numpy host-side preprocessing).
+
+Reference: python/paddle/vision/transforms/ — Compose + functional image ops.
+Host-side numpy keeps the TPU input pipeline simple; heavy augmentation
+belongs in the DataLoader workers.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if img.dtype == np.uint8:
+            img = img.astype("float32") / 255.0
+        else:
+            img = img.astype("float32")
+        if self.data_format == "CHW":
+            img = np.transpose(img, (2, 0, 1))
+        return img
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = np.asarray(mean, dtype="float32")
+        self.std = np.asarray(std, dtype="float32")
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img, dtype="float32")
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)[: img.shape[0]]
+            s = self.std.reshape(-1, 1, 1)[: img.shape[0]]
+        else:
+            m = self.mean[: img.shape[-1]]
+            s = self.std[: img.shape[-1]]
+        return (img - m) / s
+
+
+class Resize(BaseTransform):
+    """Nearest/bilinear resize via numpy (no PIL dependency)."""
+
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3) and \
+            img.shape[0] < img.shape[-1]
+        h_axis = 1 if chw else 0
+        oh, ow = self.size
+        ih, iw = img.shape[h_axis], img.shape[h_axis + 1]
+        ys = np.clip((np.arange(oh) + 0.5) * ih / oh - 0.5, 0, ih - 1)
+        xs = np.clip((np.arange(ow) + 0.5) * iw / ow - 0.5, 0, iw - 1)
+        if self.interpolation == "nearest":
+            yi = np.round(ys).astype(int)
+            xi = np.round(xs).astype(int)
+            return (img[:, yi][:, :, xi] if chw else img[yi][:, xi])
+        y0 = np.floor(ys).astype(int)
+        x0 = np.floor(xs).astype(int)
+        y1 = np.minimum(y0 + 1, ih - 1)
+        x1 = np.minimum(x0 + 1, iw - 1)
+        wy = (ys - y0)[:, None]
+        wx = (xs - x0)[None, :]
+        def gather(a, yi, xi):
+            return a[:, yi][:, :, xi] if chw else a[yi][:, xi]
+        if chw:
+            wy, wx = wy[None], wx[None]
+        elif img.ndim == 3:
+            wy, wx = wy[..., None], wx[..., None]
+        out = (gather(img, y0, x0) * (1 - wy) * (1 - wx)
+               + gather(img, y1, x0) * wy * (1 - wx)
+               + gather(img, y0, x1) * (1 - wy) * wx
+               + gather(img, y1, x1) * wy * wx)
+        return out.astype(img.dtype if img.dtype != np.uint8 else "float32")
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return np.ascontiguousarray(img[..., ::-1] if img.ndim == 3
+                                        and img.shape[0] in (1, 3)
+                                        else img[:, ::-1])
+        return img
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3) and \
+            img.shape[0] < img.shape[-1]
+        if self.padding:
+            pad = [(0, 0)] * img.ndim
+            ax = 1 if chw else 0
+            pad[ax] = pad[ax + 1] = (self.padding, self.padding)
+            img = np.pad(img, pad)
+        h_axis = 1 if chw else 0
+        ih, iw = img.shape[h_axis], img.shape[h_axis + 1]
+        th, tw = self.size
+        i = np.random.randint(0, ih - th + 1)
+        j = np.random.randint(0, iw - tw + 1)
+        return img[:, i:i + th, j:j + tw] if chw else img[i:i + th, j:j + tw]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3) and \
+            img.shape[0] < img.shape[-1]
+        h_axis = 1 if chw else 0
+        ih, iw = img.shape[h_axis], img.shape[h_axis + 1]
+        th, tw = self.size
+        i, j = (ih - th) // 2, (iw - tw) // 2
+        return img[:, i:i + th, j:j + tw] if chw else img[i:i + th, j:j + tw]
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(np.asarray(img), self.order)

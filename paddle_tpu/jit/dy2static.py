@@ -262,13 +262,56 @@ def convert_range(*args):
     return range(*(int(v) for v in vals))
 
 
+class _LazySeq:
+    """Pull-on-demand adapter giving a lazy iterable (generator, stream,
+    DataLoader) positional getitem without materializing it: element i is
+    buffered only once the loop asks for it, so an infinite generator with
+    a break never hangs and consumed prefixes bound memory."""
+
+    def __init__(self, it):
+        self._it = iter(it)
+        self._buf = []
+        self._done = False
+
+    def has(self, i):
+        i = int(i)
+        while len(self._buf) <= i and not self._done:
+            try:
+                self._buf.append(next(self._it))
+            except StopIteration:
+                self._done = True
+        return i < len(self._buf)
+
+    def get(self, i):
+        self.has(i)
+        return self._buf[int(i)]
+
+
 def convert_indexable(x):
-    """Normalize a for-loop iterable into something len()- and []-able."""
+    """Normalize a for-loop iterable for the indexed-while lowering.
+    Positionally-indexable things (and mappings, whose KEY list is sized
+    and cheap) pass through; lazy iterables wrap in _LazySeq — never
+    list()'d up front."""
+    import collections.abc
     if isinstance(x, (_RangeProxy, range, list, tuple)):
         return x
     if _is_tensorish(x):
         return x
-    return list(x)
+    if isinstance(x, collections.abc.Mapping):
+        return list(x)               # iterate by key, like Python
+    if hasattr(x, "__len__") and hasattr(x, "__getitem__"):
+        return x
+    return _LazySeq(x)
+
+
+def convert_more(x, i):
+    """Loop-continuation test for the lowered for: is there an i-th
+    element? Traced-length iterables return a traced bool (lax.while_loop
+    path); _LazySeq pulls and answers in Python."""
+    if isinstance(x, _LazySeq):
+        return x.has(i)
+    n = convert_len(x)
+    return unwrap(i) < n
 
 
 def convert_len(x):
@@ -283,6 +326,8 @@ def convert_len(x):
 
 
 def convert_getitem(x, i):
+    if isinstance(x, _LazySeq):
+        return x.get(i)
     if isinstance(x, _RangeProxy):
         return x.getitem(i)
     iv = unwrap(i)
@@ -310,6 +355,7 @@ _JST = {
     "_jst_not": convert_logical_not,
     "_jst_range": convert_range,
     "_jst_indexable": convert_indexable,
+    "_jst_more": convert_more,
     "_jst_len": convert_len,
     "_jst_getitem": convert_getitem,
 }
@@ -463,7 +509,7 @@ class _ForToWhile(ast.NodeTransformer):
         self._n += 1
         self.count += 1
         u = self._n
-        it, i, n = f"__pt_it_{u}", f"__pt_i_{u}", f"__pt_n_{u}"
+        it, i = f"__pt_it_{u}", f"__pt_i_{u}"
         iter_expr = node.iter
         if (isinstance(iter_expr, ast.Call)
                 and isinstance(iter_expr.func, ast.Name)
@@ -471,15 +517,18 @@ class _ForToWhile(ast.NodeTransformer):
             iter_expr = ast.Call(
                 func=ast.Name(id="_jst_range", ctx=ast.Load()),
                 args=iter_expr.args, keywords=iter_expr.keywords)
-        pre = ast.parse(f"{it} = _jst_indexable(None)\n"
-                        f"{n} = _jst_len({it})\n"
-                        f"{i} = 0").body
+        # single-body lowering: the continuation test _jst_more() speaks
+        # both protocols (positional len for indexed/traced iterables,
+        # buffered pull for lazy ones), so the body is emitted ONCE — a
+        # dual indexed/lazy dispatch would copy it 2^depth times for
+        # nested loops
+        pre = ast.parse(f"{it} = _jst_indexable(None)\n{i} = 0").body
         pre[0].value.args = [iter_expr]
         tgt = ast.Assign(
             targets=[node.target],
             value=ast.parse(f"_jst_getitem({it}, {i})", mode="eval").body)
         inc = ast.parse(f"{i} = {i} + 1").body[0]
-        test = ast.parse(f"{i} < {n}", mode="eval").body
+        test = ast.parse(f"_jst_more({it}, {i})", mode="eval").body
         return pre + [ast.While(test=test, body=[tgt, inc] + node.body,
                                 orelse=[])]
 
@@ -624,15 +673,37 @@ class _LoopEscapeTransformer(ast.NodeTransformer):
         pre = []
         if rep.found_brk:
             pre.append(ast.parse(f"{brk} = False").body[0])
-        return pre + [ast.While(test=test, body=body, orelse=[])]
+        out = pre + [ast.While(test=test, body=body, orelse=[])]
+        if node.orelse:
+            # while-else runs iff the loop exited without break/return;
+            # with the flag scheme that is exactly "no flag set"
+            if cond_flags:
+                out.append(ast.If(test=_not_flags_test(cond_flags),
+                                  body=list(node.orelse), orelse=[]))
+            else:       # only continues: the else always runs
+                out.extend(node.orelse)
+        return out
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
     """Rewrite if/while into converter calls (ifelse_transformer.py /
-    loop_transformer.py)."""
+    loop_transformer.py). Outermost def only — converting control flow
+    inside a nested def is wrong for generators (a while body containing
+    ``yield`` hoisted into a converter body_fn would become a generator
+    function that never executes)."""
 
     def __init__(self):
         self._n = 0
+        self._entered = False
+
+    def visit_FunctionDef(self, node):
+        if self._entered:
+            return node
+        self._entered = True
+        self.generic_visit(node)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
 
     def _uid(self):
         self._n += 1

@@ -403,3 +403,83 @@ def test_for_else_return_transforms_cleanly():
     assert g is not None
     assert float(g(paddle.to_tensor(np.array(1.0, "float32")))
                  .numpy()) == 8.0
+
+
+def _while_else_break(x, trip):
+    i = 0
+    while i < 3:
+        if i == trip:
+            break
+        i += 1
+    else:
+        x = x * 10
+    return x
+
+
+def test_while_else_preserved_with_break():
+    from paddle_tpu.jit.dy2static import ast_transform
+    g = ast_transform(_while_else_break)
+    x = paddle.to_tensor(np.array(1.0, "float32"))
+    # break taken -> else skipped
+    assert float(g(x, 1).numpy()) == 1.0
+    # no break -> else runs
+    assert float(g(x, 99).numpy()) == 10.0
+
+
+def _gen_loop(x):
+    def gen():
+        for i in range(1000000000):      # effectively infinite if listed
+            yield i
+    s = x
+    for v in gen():
+        s = s + 1
+        if v >= 2:
+            break
+    return s
+
+
+def test_generator_iterable_stays_lazy():
+    """A generator iterable must NOT be materialized by the for-lowering
+    (a DataLoader or itertools.count would hang)."""
+    from paddle_tpu.jit.dy2static import ast_transform
+    g = ast_transform(_gen_loop)
+    out = g(paddle.to_tensor(np.array(0.0, "float32")))
+    assert float(out.numpy()) == 3.0
+
+
+def _dict_loop(x, d):
+    s = x
+    for k in d:
+        s = s + d[k]
+    return s
+
+
+def test_for_over_dict_iterates_keys():
+    """Mappings iterate by key: must NOT take the indexed-while lowering
+    (dict[0] is not dict-iteration)."""
+    from paddle_tpu.jit.dy2static import ast_transform
+    g = ast_transform(_dict_loop)
+    out = g(paddle.to_tensor(np.array(0.0, "float32")),
+            {"a": 1.0, "b": 2.0})
+    assert float(out.numpy()) == 3.0
+
+
+def _gen_with_while(x):
+    def gen():
+        i = 0
+        while i < 5:
+            yield i
+            i += 1
+    s = x
+    for v in gen():
+        s = s + v
+    return s
+
+
+def test_generator_with_while_body_not_converted():
+    """A nested generator's while must keep Python semantics — converting
+    it would make the body a generator function that never runs."""
+    from paddle_tpu.jit.dy2static import ast_transform
+    g = ast_transform(_gen_with_while)
+    out = g(paddle.to_tensor(np.array(0.0, "float32")))
+    assert float(out.numpy()) == 10.0
