@@ -164,9 +164,15 @@ def bench_bert(on_tpu):
 
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
-    labels = jnp.asarray(np.where(rng.rand(batch, seq) < 0.15,
-                                  np.asarray(ids), -100))
-    args = (ids, None, None, labels)
+    # standard BERT pretraining: fixed max_predictions_per_seq masked
+    # positions per sequence; the head gathers them before the 30k-vocab
+    # projection (reference masked_positions semantics)
+    n_pred = max(2, int(seq * 0.15))
+    pos = np.stack([rng.choice(seq, size=n_pred, replace=False)
+                    for _ in range(batch)]).astype("int64")
+    labels = jnp.asarray(np.take_along_axis(np.asarray(ids), pos, 1))
+    positions = jnp.asarray(pos)
+    args = (ids, None, None, labels, None, positions)
     float(step(args))  # compile + warmup
 
     dt = _timed(lambda: step(args), iters, float)
@@ -207,8 +213,11 @@ def bench_transformer_big(on_tpu):
                              labels.reshape([-1]))
 
     if on_tpu:
+        # WMT-realistic token batch (~4k tokens/step; the reference trains
+        # transformer-big at 25k+ tokens/batch) — 16x64=1k tokens cannot
+        # feed the MXU between dispatches
         vocab, dm, nh, nl, ffn, batch, seq, iters = \
-            32768, 1024, 16, 6, 4096, 16, 64, 10
+            32768, 1024, 16, 6, 4096, 64, 64, 10
     else:
         vocab, dm, nh, nl, ffn, batch, seq, iters = 128, 64, 4, 2, 128, 2, 16, 2
 

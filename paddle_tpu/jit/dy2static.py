@@ -264,27 +264,34 @@ def convert_range(*args):
 
 class _LazySeq:
     """Pull-on-demand adapter giving a lazy iterable (generator, stream,
-    DataLoader) positional getitem without materializing it: element i is
-    buffered only once the loop asks for it, so an infinite generator with
-    a break never hangs and consumed prefixes bound memory."""
+    DataLoader) positional getitem without materializing it. The lowered
+    loop accesses indices monotonically, so consumed elements are evicted
+    (base-offset window): an infinite generator with a break never hangs
+    and a long epoch holds O(1) elements, not the whole stream."""
 
     def __init__(self, it):
         self._it = iter(it)
         self._buf = []
+        self._base = 0
         self._done = False
 
     def has(self, i):
         i = int(i)
-        while len(self._buf) <= i and not self._done:
+        if i > self._base:
+            # monotonic consumption: everything before i is dead
+            drop = min(i - self._base, len(self._buf))
+            del self._buf[:drop]
+            self._base += drop
+        while self._base + len(self._buf) <= i and not self._done:
             try:
                 self._buf.append(next(self._it))
             except StopIteration:
                 self._done = True
-        return i < len(self._buf)
+        return i - self._base < len(self._buf)
 
     def get(self, i):
         self.has(i)
-        return self._buf[int(i)]
+        return self._buf[int(i) - self._base]
 
 
 def convert_indexable(x):
@@ -685,21 +692,33 @@ class _LoopEscapeTransformer(ast.NodeTransformer):
         return out
 
 
+def _is_generator_def(node):
+    """Yield/YieldFrom in THIS def's own scope (not in defs nested inside)."""
+    todo = list(node.body)
+    while todo:
+        n = todo.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, (ast.Yield, ast.YieldFrom)):
+            return True
+        todo.extend(ast.iter_child_nodes(n))
+    return False
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     """Rewrite if/while into converter calls (ifelse_transformer.py /
-    loop_transformer.py). Outermost def only — converting control flow
-    inside a nested def is wrong for generators (a while body containing
-    ``yield`` hoisted into a converter body_fn would become a generator
-    function that never executes)."""
+    loop_transformer.py). Generator defs are skipped — hoisting a while
+    body containing ``yield`` into a converter body_fn would make it a
+    generator function that never executes; ordinary nested closures DO
+    get converted (they trace like any code when called)."""
 
     def __init__(self):
         self._n = 0
-        self._entered = False
 
     def visit_FunctionDef(self, node):
-        if self._entered:
+        if _is_generator_def(node):
             return node
-        self._entered = True
         self.generic_visit(node)
         return node
 

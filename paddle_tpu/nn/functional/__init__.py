@@ -3,7 +3,7 @@ from .activation import *  # noqa: F401,F403
 from .common import (  # noqa: F401
     linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,
     pad, interpolate, upsample, pixel_shuffle, unfold, cosine_similarity,
-    bilinear, label_smooth, sequence_mask,
+    bilinear, label_smooth, sequence_mask, class_center_sample,
 )
 from .conv import (  # noqa: F401
     conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,

@@ -297,7 +297,32 @@ _label_smooth_prior = Primitive(
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError("class_center_sample: PS-era API, round 2+")
+    """PartialFC class-center sampling (class_center_sample_op.cu): keep
+    every positive class present in the batch, fill up to ``num_samples``
+    with uniformly drawn negatives, and remap labels into the sampled
+    index space. Host-side numpy by design — the output SIZE is
+    data-dependent (XLA-hostile) and the op is a data-prep step feeding
+    the sharded-FC matmul, not the hot path."""
+    lab = np.asarray(unwrap(label)).ravel()
+    pos = np.unique(lab)
+    if pos.size >= num_samples:
+        sampled = np.sort(pos)
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes, dtype=lab.dtype),
+                                pos, assume_unique=True)
+        # draw through the framework generator: reproducible under
+        # paddle.seed AND advancing per call, so each step resamples fresh
+        # negatives (PartialFC resamples per batch)
+        key = default_generator.next_key()
+        seed32 = int(np.asarray(
+            jax.random.randint(key, (), 0, 2 ** 31 - 1)))
+        rng = np.random.RandomState(seed32)
+        chosen = rng.choice(neg_pool, size=num_samples - pos.size,
+                            replace=False)
+        sampled = np.sort(np.concatenate([pos, chosen]))
+    remapped = np.searchsorted(sampled, lab)
+    return (Tensor(jnp.asarray(remapped.astype(np.int64))),
+            Tensor(jnp.asarray(sampled.astype(np.int64))))
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):

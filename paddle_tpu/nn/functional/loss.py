@@ -368,10 +368,6 @@ def _ctc_fn(log_probs, labels, input_lengths, label_lengths, blank=0):
 _ctc_prim = Primitive("warpctc", _ctc_fn)
 
 
-def npair_loss(anchor, positive, labels, l2_reg=0.002):
-    raise NotImplementedError("npair_loss: round 2+")
-
-
 def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
                         epsilon=1e-6, swap=False, reduction="mean", name=None):
     return _triplet(input, positive, negative, margin=float(margin),

@@ -127,8 +127,19 @@ class BertPretrainingHeads(nn.Layer):
             [cfg.vocab_size], is_bias=True)
         self.seq_relationship = nn.Linear(cfg.hidden_size, 2)
 
-    def forward(self, sequence_output, pooled_output):
+    def forward(self, sequence_output, pooled_output, masked_positions=None):
         from ... import ops
+        if masked_positions is not None:
+            # gather ONLY the masked rows before the vocab projection (the
+            # reference head's masked_positions gather): with ~15% masking
+            # this cuts the 30k-vocab matmul + fp32 CE to the prediction
+            # set. The gather is a one-hot MATMUL, not take_along_axis —
+            # its backward is then also a matmul on the MXU instead of a
+            # serialized TPU scatter.
+            sel = F.one_hot(masked_positions,
+                            sequence_output.shape[1]).astype(
+                sequence_output.dtype)                    # [B, P, S]
+            sequence_output = ops.matmul(sel, sequence_output)
         h = self.layer_norm(self.activation(self.transform(sequence_output)))
         logits = ops.matmul(h, self.decoder_weight, transpose_y=True) \
             + self.decoder_bias
@@ -150,10 +161,11 @@ class BertForPretraining(nn.Layer):
             cfg, self.bert.embeddings.word_embeddings.weight)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
-                masked_lm_labels=None, next_sentence_label=None):
+                masked_lm_labels=None, next_sentence_label=None,
+                masked_positions=None):
         seq, pooled = self.bert(input_ids, token_type_ids,
                                 attention_mask=attention_mask)
-        logits, nsp = self.cls(seq, pooled)
+        logits, nsp = self.cls(seq, pooled, masked_positions)
         if masked_lm_labels is None:
             return logits, nsp
         mlm_loss = F.cross_entropy(

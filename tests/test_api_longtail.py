@@ -261,3 +261,23 @@ def test_crypto_roundtrip(tmp_path):
     open(str(tmp_path / "tampered"), "wb").write(bytes(blob))
     with _pytest.raises(Exception):
         cipher.decrypt_from_file(str(tmp_path / "tampered"))
+
+
+def test_class_center_sample():
+    """PartialFC sampling (class_center_sample_op): positives always kept,
+    negatives fill to num_samples, labels remapped into sampled space."""
+    paddle.seed(0)
+    label = paddle.to_tensor(np.array([2, 7, 2, 11], "int64"))
+    remapped, sampled = F.class_center_sample(label, num_classes=20,
+                                              num_samples=8)
+    s = sampled.numpy()
+    assert len(s) == 8 and len(np.unique(s)) == 8
+    for c in (2, 7, 11):
+        assert c in s                        # positives kept
+    r = remapped.numpy()
+    assert np.array_equal(s[r], [2, 7, 2, 11])   # remap round-trips
+    # more positives than num_samples: all positives kept
+    lab2 = paddle.to_tensor(np.arange(10, dtype="int64"))
+    rm2, s2 = F.class_center_sample(lab2, num_classes=20, num_samples=4)
+    assert len(s2.numpy()) == 10
+    assert np.array_equal(s2.numpy()[rm2.numpy()], np.arange(10))

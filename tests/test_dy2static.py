@@ -483,3 +483,28 @@ def test_generator_with_while_body_not_converted():
     g = ast_transform(_gen_with_while)
     out = g(paddle.to_tensor(np.array(0.0, "float32")))
     assert float(out.numpy()) == 10.0
+
+
+def _closure_with_tensor_while(x):
+    def helper(v):
+        i = paddle.zeros([], dtype="int32")
+        while i < v.astype("int32"):
+            i = i + 1
+        return i
+    return helper(x) * 2
+
+
+def test_nested_closure_control_flow_still_converts():
+    """Non-generator nested defs keep getting their tensor control flow
+    converted (only generator defs are skipped)."""
+    f = to_static(_closure_with_tensor_while)
+    out = f(paddle.to_tensor(np.array(3.0, "float32")))
+    assert int(out.numpy()) == 6
+
+
+def test_lazyseq_evicts_consumed_prefix():
+    from paddle_tpu.jit.dy2static import _LazySeq
+    s = _LazySeq(iter(range(1000)))
+    for i in range(1000):
+        assert s.get(i) == i
+        assert len(s._buf) <= 2      # O(1) window, not the whole stream
