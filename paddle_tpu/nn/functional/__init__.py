@@ -25,7 +25,9 @@ from .loss import (  # noqa: F401
     sigmoid_cross_entropy_with_logits, kl_div, smooth_l1_loss, huber_loss,
     log_loss, margin_ranking_loss, hinge_loss, sigmoid_focal_loss,
     cosine_embedding_loss, ctc_loss, square_error_cost, triplet_margin_loss,
-    dice_loss, npair_loss, hsigmoid_loss,
+    dice_loss, npair_loss, hsigmoid_loss, rank_loss, margin_rank_loss,
+    bpr_loss, center_loss, modified_huber_loss,
+    teacher_student_sigmoid_loss,
 )
 from .attention import scaled_dot_product_attention  # noqa: F401
 # re-exports the 2.x functional namespace also carries (the kernels live

@@ -491,3 +491,116 @@ def _hsigmoid_default_tree(num_classes):
         codes.append(c)
     return (jnp.asarray(np.asarray(tables, np.int32)),
             jnp.asarray(np.asarray(codes, np.int32)))
+
+
+# -- fluid-era loss long tail (op-coverage ledger round 3) ---------------------
+
+def _rank_loss_fn(label, left, right):
+    """rank_loss_op.cc (RankNet): C = log(1+e^o) - t*o, o = left - right."""
+    o = left - right
+    return jnp.log1p(jnp.exp(-jnp.abs(o))) + jnp.maximum(o, 0) - label * o
+
+
+_rank_loss = Primitive("rank_loss", _rank_loss_fn)
+
+
+def rank_loss(label, left, right, name=None):
+    return _rank_loss(label, left, right)
+
+
+def _margin_rank_loss_fn(label, left, right, margin=0.1):
+    """margin_rank_loss_op.cc: max(0, -label*(left-right) + margin)."""
+    return jnp.maximum(0.0, -label * (left - right) + margin)
+
+
+_margin_rank = Primitive("margin_rank_loss", _margin_rank_loss_fn)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    return _margin_rank(label, left, right, margin=float(margin))
+
+
+def _bpr_loss_fn(x, label):
+    """bpr_loss_op.cc (Bayesian Personalized Ranking): mean over negatives
+    of -log(sigmoid(score_pos - score_neg))."""
+    B, C = x.shape
+    pos = jnp.take_along_axis(x, label.reshape(-1, 1), 1)        # [B,1]
+    diff = pos - x                                               # [B,C]
+    lsm = jnp.log1p(jnp.exp(-diff))
+    mask = jnp.ones((B, C)).at[jnp.arange(B), label.reshape(-1)].set(0.0)
+    return jnp.sum(lsm * mask, axis=1, keepdims=True) / (C - 1)
+
+
+_bpr = Primitive("bpr_loss", _bpr_loss_fn)
+
+
+def bpr_loss(input, label, name=None):
+    return _bpr(input, label)
+
+
+def _center_loss_fn(x, label, centers, alpha=0.1, update=True):
+    """center_loss_op.cc: 0.5*||x - c_y||^2 per sample; centers move toward
+    their class mean by alpha (returned as the new centers buffer)."""
+    c = centers[label]
+    diff = x - c
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    if not update:
+        return loss, centers
+    cnt = jnp.zeros((centers.shape[0],), x.dtype).at[label].add(1.0)
+    delta = jnp.zeros_like(centers).at[label].add(diff)
+    delta = delta / (cnt[:, None] + 1.0)
+    return loss, centers + alpha * delta
+
+
+_center = Primitive("center_loss", _center_loss_fn, multi_output=True)
+
+
+def center_loss(input, label, num_classes=None, alpha=0.1, centers=None,
+                update_center=True, name=None):
+    if centers is None:
+        raise ValueError("center_loss needs the centers buffer "
+                         "(create_parameter([num_classes, feat_dim]))")
+    return _center(input, label, centers, alpha=float(alpha),
+                   update=bool(update_center))
+
+
+def _mod_huber_fn(x, label):
+    """modified_huber_loss_op.cc: y in {0,1} -> s=2y-1; quadratic inside
+    [-1,1), linear hinge-like outside."""
+    s = 2.0 * label - 1.0
+    z = x * s
+    quad = jnp.square(jnp.maximum(1.0 - z, 0.0))
+    return jnp.where(z < -1.0, -4.0 * z, quad)
+
+
+_mod_huber = Primitive("modified_huber_loss", _mod_huber_fn)
+
+
+def modified_huber_loss(input, label, name=None):
+    return _mod_huber(input, label)
+
+
+def _tss_fn(x, label, soft_max_up_bound=15.0, soft_max_lower_bound=-15.0):
+    """teacher_student_sigmoid_loss_op.cc: CTR distillation loss —
+    teacher score folded into the sigmoid CE target."""
+    z = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+    # label < -1: teacher+student; -1<=label<0: sigmoid CE with y=0;
+    # 0<label<1: teacher score; label>=1: y=1 (reference piecewise form)
+    log1pez = jnp.log1p(jnp.exp(z))
+    loss_neg = log1pez                            # y = 0
+    loss_pos = log1pez - z                        # y = 1
+    teacher = label - jnp.floor(label)
+    loss_teach = log1pez - z * teacher
+    return jnp.where(label < -1.0, loss_pos + loss_teach,
+                     jnp.where(label < 0.0, loss_neg,
+                               jnp.where(label < 1.0, loss_teach,
+                                         loss_pos)))
+
+
+_tss = Primitive("teacher_student_sigmoid_loss", _tss_fn)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0, name=None):
+    return _tss(input, label, soft_max_up_bound=float(soft_max_up_bound),
+                soft_max_lower_bound=float(soft_max_lower_bound))

@@ -535,6 +535,216 @@ def partial_sum(x, start_index=0, length=-1, name=None):
                         start_index=int(start_index), length=int(length))
 
 
+
+
+# -- fluid-era op long tail (op-coverage ledger round 3) -----------------------
+
+def _add_pos_enc_fn(x, alpha=1.0, beta=1.0):
+    """add_position_encoding_op.cc: x*alpha + sinusoid(position)*beta."""
+    B, T, C = x.shape
+    half = (C + 1) // 2
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) /
+                    jnp.maximum(half, 1))
+    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return x * alpha + enc[None, :, :C].astype(x.dtype) * beta
+
+
+_add_pos_enc = Primitive("add_position_encoding", _add_pos_enc_fn)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    return _add_pos_enc(input, alpha=float(alpha), beta=float(beta))
+
+
+def bilinear_tensor_product(x, y, weight, bias=None, name=None):
+    """bilinear_tensor_product_op.cc — same math as nn.functional.bilinear
+    (out[:, k] = x W_k y^T + b), so it delegates (one primitive, one VJP
+    cache)."""
+    from ..nn.functional.common import bilinear as _bilinear
+    return _bilinear(x, y, weight, bias)
+
+
+def _conv_shift_fn(x, y):
+    """conv_shift_op.cc: circular correlation, out[i,j] = sum_k
+    x[i, (j + k - m//2) mod n] * y[i, k]."""
+    n, m = x.shape[1], y.shape[1]
+    j = jnp.arange(n)[:, None]
+    k = jnp.arange(m)[None, :]
+    idx = (j + k - m // 2) % n                  # [n, m]
+    gathered = x[:, idx]                        # [B, n, m]
+    return jnp.einsum("bnm,bm->bn", gathered, y)
+
+
+_conv_shift = Primitive("conv_shift", _conv_shift_fn)
+
+
+def conv_shift(x, y, name=None):
+    return _conv_shift(x, y)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64", name=None):
+    """sampling_id_op.cc: sample one category per row of a probability
+    matrix (multinomial with num_samples=1, squeezed). seed!=0 pins the
+    draw (op-level seed semantics); the result honors ``dtype``."""
+    if seed:
+        from ..framework.random import default_generator
+        st = default_generator.state()
+        default_generator.manual_seed(int(seed))
+        try:
+            out = sampling_id(x, min, max, 0, dtype, name)
+        finally:
+            default_generator.set_state(st)
+        return out
+    from .creation import multinomial
+    from .manipulation import squeeze, cast
+    out = squeeze(multinomial(x, num_samples=1), axis=[-1])
+    return cast(out, dtype)
+
+
+def _segment_fn(x, ids, pool_type="SUM", num_segments=0):
+    seg = {"SUM": jax.ops.segment_sum,
+           "MEAN": None, "MAX": jax.ops.segment_max,
+           "MIN": jax.ops.segment_min}[pool_type]
+    cnt = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), ids,
+                              num_segments)
+    present = (cnt > 0).reshape((-1,) + (1,) * (x.ndim - 1))
+    if pool_type == "MEAN":
+        s = jax.ops.segment_sum(x, ids, num_segments)
+        return s / jnp.maximum(cnt, 1.0).reshape((-1,) + (1,) * (x.ndim - 1))
+    out = seg(x, ids, num_segments)
+    # empty segments fill 0 (segment_pool_op.cc), not the +/-inf identity
+    return jnp.where(present, out, 0.0).astype(x.dtype)
+
+
+_segment = Primitive("segment_pool", _segment_fn)
+
+
+def segment_pool(x, segment_ids, pool_type="SUM", name=None):
+    """segment_pool_op.cc over sorted segment ids."""
+    import numpy as _np
+    ns = int(_np.asarray(unwrap(segment_ids)).max()) + 1
+    return _segment(x, unwrap(segment_ids), pool_type=str(pool_type).upper(),
+                    num_segments=ns)
+
+
+def _row_conv_fn(x, w):
+    """row_conv_op.cc: lookahead causal conv — out[b,t] = sum_{k<ctx}
+    x[b,t+k] * w[k] (zero past the end)."""
+    B, T, C = x.shape
+    ctx = w.shape[0]
+    xp = jnp.pad(x, ((0, 0), (0, ctx - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for k in range(ctx):                    # ctx is small and static
+        out = out + xp[:, k:k + T, :] * w[k]
+    return out
+
+
+_row_conv = Primitive("row_conv", _row_conv_fn)
+
+
+def row_conv(input, weight, name=None):
+    return _row_conv(input, weight)
+
+
+def _cvm_fn(x, use_cvm=True):
+    """cvm_op.cc: CTR show/click head — log-transform the 2 leading cvm
+    features (show, clk) or drop them."""
+    show = jnp.log(x[:, 0:1] + 1.0)
+    clk = jnp.log(x[:, 1:2] + 1.0) - show
+    if use_cvm:
+        return jnp.concatenate([show, clk, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+_cvm = Primitive("cvm", _cvm_fn)
+
+
+def cvm(input, cvm_tensor=None, use_cvm=True, name=None):
+    return _cvm(input, use_cvm=bool(use_cvm))
+
+
+def _mean_iou_fn(pred, label, num_classes=2):
+    p = pred.reshape(-1)
+    l = label.reshape(-1)
+    idx = l * num_classes + p
+    cm = jnp.bincount(idx, length=num_classes * num_classes).reshape(
+        num_classes, num_classes).astype(jnp.float32)
+    inter = jnp.diag(cm)
+    union = cm.sum(0) + cm.sum(1) - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1.0), 0.0)
+    valid = (union > 0).astype(jnp.float32)
+    return jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+_mean_iou = Primitive("mean_iou", _mean_iou_fn, differentiable=False)
+
+
+def mean_iou(input, label, num_classes, name=None):
+    """mean_iou_op.cc: mean intersection-over-union over present classes."""
+    return _mean_iou(input, label, num_classes=int(num_classes))
+
+
+def _l1norm_fn(x):
+    return jnp.sum(jnp.abs(x))
+
+
+_l1_norm = Primitive("l1_norm", _l1norm_fn)
+
+
+def l1_norm(x, name=None):
+    return _l1_norm(x)
+
+
+def _sq_l2_dist_fn(x, y):
+    d = x - y
+    return jnp.sum(d * d, axis=-1)
+
+
+_sq_l2 = Primitive("squared_l2_distance", _sq_l2_dist_fn)
+
+
+def squared_l2_distance(x, y, name=None):
+    return _sq_l2(x, y)
+
+
+def _im2sequence_fn(x, kernel=(3, 3), stride=(1, 1), padding=(0, 0, 0, 0)):
+    """im2sequence_op.cc: sliding windows -> rows [B*oh*ow, C*kh*kw]."""
+    pads = ((0, 0), (0, 0), (padding[0], padding[2]),
+            (padding[1], padding[3]))
+    xp = jnp.pad(x, pads)
+    p = jax.lax.conv_general_dilated_patches(
+        xp, kernel, stride, "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    B, CK, OH, OW = p.shape
+    return p.transpose(0, 2, 3, 1).reshape(B * OH * OW, CK)
+
+
+_im2seq = Primitive("im2sequence", _im2sequence_fn)
+
+
+def im2sequence(input, filter_size=3, stride=1, padding=0, name=None):
+    k = (filter_size,) * 2 if isinstance(filter_size, int) else tuple(filter_size)
+    s = (stride,) * 2 if isinstance(stride, int) else tuple(stride)
+    pd = (padding,) * 4 if isinstance(padding, int) else tuple(padding)
+    return _im2seq(input, kernel=k, stride=s, padding=pd)
+
+
+def _affine_channel_fn(x, scale, bias, channel_last=False):
+    """affine_channel_op.cc: per-channel x*scale + bias."""
+    shape = (1,) * (x.ndim - 1) + (-1,) if channel_last \
+        else (1, -1) + (1,) * (x.ndim - 2)
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+_affine_channel = Primitive("affine_channel", _affine_channel_fn)
+
+
+def affine_channel(x, scale, bias, data_format="NCHW", name=None):
+    return _affine_channel(x, scale, bias,
+                           channel_last=not data_format.startswith("NC"))
+
+
 __all__ = [
     "logaddexp", "heaviside", "gcd", "lcm", "copysign", "nextafter",
     "signbit", "sinc", "exp2", "erfc", "ldexp", "nanmean", "nanmedian",
@@ -546,4 +756,7 @@ __all__ = [
     "rank", "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
     "polar", "sgn", "isposinf", "isneginf", "take", "reverse",
     "nanquantile", "histogramdd", "partial_concat", "partial_sum",
+    "add_position_encoding", "bilinear_tensor_product", "conv_shift",
+    "sampling_id", "segment_pool", "row_conv", "cvm", "mean_iou",
+    "l1_norm", "squared_l2_distance", "im2sequence", "affine_channel",
 ]
