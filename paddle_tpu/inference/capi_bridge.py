@@ -25,3 +25,45 @@ def run_f32(pred, data, shape):
     outs = pred.run([arr])
     out = np.ascontiguousarray(np.asarray(outs[0], np.float32))
     return out.tobytes(), tuple(int(d) for d in out.shape)
+
+
+def train_create(model_prefix, feed_names, fetch_name):
+    """C: pd_trainer_create — the reference's C++ train demo
+    (paddle/fluid/train/demo/demo_trainer.cc): load a TRAIN program saved
+    by static.save (optimizer ops included) plus its persistables, ready
+    to step without Python on the consumer side."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    was_dygraph = paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        prog = static.deserialize_program(
+            open(model_prefix + ".pdmodel", "rb").read())
+        exe = static.Executor()
+        static.load(prog, model_prefix, exe)
+    finally:
+        if was_dygraph:
+            paddle.disable_static()
+    return {"program": prog, "exe": exe,
+            "feeds": [n for n in feed_names.split(",") if n],
+            "fetch": fetch_name}
+
+
+def train_step(trainer, x_bytes, x_shape, label_bytes, label_shape):
+    """C: pd_trainer_step_f32 — one train step (fwd+bwd+update through the
+    compiled replay); returns the fetched loss as a float."""
+    import paddle_tpu as paddle
+
+    x = np.frombuffer(x_bytes, np.float32).reshape(x_shape)
+    label = np.frombuffer(label_bytes, np.int64).reshape(label_shape)
+    feeds = dict(zip(trainer["feeds"], (x, label)))
+    was_dygraph = paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        outs = trainer["exe"].run(trainer["program"], feed=feeds,
+                                  fetch_list=[trainer["fetch"]])
+    finally:
+        if was_dygraph:
+            paddle.disable_static()
+    return float(np.asarray(outs[0]).reshape(-1)[0])

@@ -126,4 +126,67 @@ void pd_predictor_destroy(void* handle) {
     PyGILState_Release(g);
 }
 
+// -- Python-free TRAINING entry (train/demo/demo_trainer.cc parity) ---------
+
+// Load a train program saved by paddle.static.save: model_prefix.pdmodel +
+// persistables. feeds_csv names the feed variables in call order (e.g.
+// "img,label"); fetch names the loss to return from each step.
+void* pd_trainer_create(const char* model_prefix, const char* feeds_csv,
+                        const char* fetch) {
+    if (!ensure_init()) return nullptr;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* t = PyObject_CallMethod(g_bridge, "train_create", "sss",
+                                      model_prefix, feeds_csv, fetch);
+    if (t == nullptr) set_err_from_python();
+    PyGILState_Release(g);
+    return t;
+}
+
+// One train step: float32 features + int64 labels in, fetched loss out.
+// Returns 0, or -1 (see pd_last_error()).
+int pd_trainer_step_f32(void* handle, const float* x,
+                        const long long* x_shape, int x_ndim,
+                        const long long* label, const long long* l_shape,
+                        int l_ndim, float* loss_out) {
+    if (handle == nullptr) { g_err = "null trainer"; return -1; }
+    long long nx = 1, nl = 1;
+    for (int i = 0; i < x_ndim; ++i) nx *= x_shape[i];
+    for (int i = 0; i < l_ndim; ++i) nl *= l_shape[i];
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* xb = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(x), nx * sizeof(float));
+    PyObject* lb = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(label), nl * sizeof(long long));
+    PyObject* xs = PyTuple_New(x_ndim);
+    for (int i = 0; i < x_ndim; ++i)
+        PyTuple_SET_ITEM(xs, i, PyLong_FromLongLong(x_shape[i]));
+    PyObject* ls = PyTuple_New(l_ndim);
+    for (int i = 0; i < l_ndim; ++i)
+        PyTuple_SET_ITEM(ls, i, PyLong_FromLongLong(l_shape[i]));
+    PyObject* res = PyObject_CallMethod(
+        g_bridge, "train_step", "OOOOO", static_cast<PyObject*>(handle),
+        xb, xs, lb, ls);
+    Py_DECREF(xb);
+    Py_DECREF(lb);
+    Py_DECREF(xs);
+    Py_DECREF(ls);
+    int rc = -1;
+    if (res == nullptr) {
+        set_err_from_python();
+    } else {
+        double v = PyFloat_AsDouble(res);
+        if (PyErr_Occurred()) {
+            set_err_from_python();
+        } else {
+            if (loss_out != nullptr) *loss_out = static_cast<float>(v);
+            rc = 0;
+        }
+        Py_DECREF(res);
+    }
+    PyGILState_Release(g);
+    return rc;
+}
+
+void pd_trainer_destroy(void* handle) { pd_predictor_destroy(handle); }
+
 }  // extern "C"

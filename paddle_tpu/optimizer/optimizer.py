@@ -43,6 +43,43 @@ class L1Decay:
 # sgd_op/adam_op SelectedRows branches: only the touched rows are read,
 # updated and scattered back — O(rows) instead of O(vocab) work per step.
 
+def make_update_fn(opt, param_names):
+    """Array-level update closure of the @optimize macro op; rebuilt from
+    the op's attrs on program deserialization (io.py)."""
+    def update_fn(*arrs):
+        k = len(param_names)
+        params = dict(zip(param_names, arrs[:k]))
+        grads = dict(zip(param_names, arrs[k:2 * k]))
+        state = {}
+        idx = 2 * k
+        for sname in opt._state_names:
+            state[sname] = dict(zip(param_names, arrs[idx:idx + k]))
+            idx += k
+        step = arrs[idx] + 1
+        lr = arrs[idx + 1]
+        new_p, new_state = opt.functional_apply(params, grads, state,
+                                                step, lr)
+        outs = [new_p[n] for n in param_names]
+        for sname in opt._state_names:
+            outs += [new_state[sname][n] for n in param_names]
+        outs.append(step)
+        return tuple(outs)
+    return update_fn
+
+
+def rebuild_optimizer(class_name, config):
+    """Reconstruct an optimizer for a deserialized @optimize op: plain
+    instance + the saved scalar hyperparams (functional_apply reads only
+    those plus the class rule)."""
+    import sys
+    cls = getattr(sys.modules[__name__], class_name)
+    opt = cls.__new__(cls)
+    Optimizer.__init__(opt, learning_rate=config.get("_lr", 0.001))
+    for k, v in config.items():
+        setattr(opt, k, v)
+    return opt
+
+
 @jax.jit
 def _sgd_sparse_rule(p, rows, vals, lr):
     return p.at[rows].add(-(lr * vals.astype(jnp.float32)).astype(p.dtype))
@@ -452,37 +489,35 @@ class Optimizer:
 
         acc_names = [f"{p}_{s}_0" for s in self._state_names
                      for p in param_names]
-        opt = self
 
-        def update_fn(*arrs):
-            k = len(param_names)
-            params = dict(zip(param_names, arrs[:k]))
-            grads = dict(zip(param_names, arrs[k:2 * k]))
-            state = {}
-            idx = 2 * k
-            for sname in opt._state_names:
-                state[sname] = dict(zip(param_names,
-                                        arrs[idx:idx + k]))
-                idx += k
-            step = arrs[idx] + 1
-            lr = arrs[idx + 1]
-            new_p, new_state = opt.functional_apply(params, grads, state,
-                                                    step, lr)
-            outs = [new_p[n] for n in param_names]
-            for sname in opt._state_names:
-                outs += [new_state[sname][n] for n in param_names]
-            outs.append(step)
-            return tuple(outs)
-
+        # the attrs carry everything needed to REBUILD this op after
+        # deserialization (io.py macro builders): optimizer class + scalar
+        # hyperparams + the param list — so whole TRAIN programs save/load
+        # (train/demo demo_trainer.cc's consumption format)
         op = Operator(block, prim="@optimize",
                       inputs=param_names + grad_names + acc_names
                       + [step_name, lr_name],
                       outputs=param_names + acc_names + [step_name],
-                      attrs={}, fn=update_fn,
+                      attrs={"optimizer": type(self).__name__,
+                             "config": self._export_config(),
+                             "param_names": list(param_names),
+                             "state_names": list(self._state_names)},
+                      fn=make_update_fn(self, param_names),
                       type_name=type(self).__name__.lower())
         block.ops.append(op)
         program._version += 1
         return None, pgs
+
+    def _export_config(self):
+        """Scalar hyperparams sufficient for rebuild_optimizer: everything
+        functional_apply reads. LR schedules export their current value
+        (a loaded trainer runs at the saved LR)."""
+        cfg = {}
+        for k, v in self.__dict__.items():
+            if isinstance(v, (int, float, bool, str)) and not k.startswith("__"):
+                cfg[k] = v
+        cfg["_lr"] = float(self.get_lr())
+        return cfg
 
     def clear_grad(self, set_to_zero=False):
         for p in (self._parameters or []):

@@ -56,7 +56,36 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
     fwd_ops = list(block.ops)
     ext_names = _segment_io(fwd_ops, block, set(param_names), loss.name)
     loss_name = loss.name
+    grad_fn = make_backward_fn(fwd_ops, param_names, ext_names, loss_name,
+                               bool(checkpoints))
 
+    # declare grad vars + the macro op writing them. The attrs carry the
+    # full recipe (which forward ops, which params, the loss), so a saved
+    # TRAIN program deserializes and rebuilds this fn (io.py macro
+    # builders) — the reference's serialized grad-op descs, one op here.
+    grad_vars = []
+    for n in param_names:
+        pv = block.var(n)
+        gv = block.create_var(name=n + "@GRAD", shape=pv.shape,
+                              dtype=pv.dtype, stop_gradient=True)
+        grad_vars.append(gv)
+    op = Operator(block, prim="@backward",
+                  inputs=param_names + ext_names,
+                  outputs=[g.name for g in grad_vars],
+                  attrs={"param_names": list(param_names),
+                         "ext_names": list(ext_names),
+                         "loss_name": loss_name,
+                         "checkpoints": bool(checkpoints),
+                         "n_fwd_ops": len(fwd_ops)},
+                  fn=grad_fn, type_name="backward")
+    block.ops.append(op)
+    program._version += 1
+    return [(block.var(n), g) for n, g in zip(param_names, grad_vars)]
+
+
+def make_backward_fn(fwd_ops, param_names, ext_names, loss_name,
+                     checkpoints=False):
+    """The macro grad fn: jax.grad over the forward segment's replay."""
     def grad_fn(*arrs):
         pvals = arrs[:len(param_names)]
         evals = arrs[len(param_names):]
@@ -79,20 +108,7 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
         grads = jax.grad(f)(tuple(pvals))
         return tuple(grads)
 
-    # declare grad vars + the macro op writing them
-    grad_vars = []
-    for n in param_names:
-        pv = block.var(n)
-        gv = block.create_var(name=n + "@GRAD", shape=pv.shape,
-                              dtype=pv.dtype, stop_gradient=True)
-        grad_vars.append(gv)
-    op = Operator(block, prim="@backward",
-                  inputs=param_names + ext_names,
-                  outputs=[g.name for g in grad_vars],
-                  attrs={}, fn=grad_fn, type_name="backward")
-    block.ops.append(op)
-    program._version += 1
-    return [(block.var(n), g) for n, g in zip(param_names, grad_vars)]
+    return grad_fn
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

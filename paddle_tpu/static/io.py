@@ -25,10 +25,13 @@ from .executor import global_scope
 _PROG_MAGIC = "paddle_tpu.program.v1"
 
 
+_REBUILDABLE_MACROS = ("@backward", "@optimize")
+
+
 def _program_to_dict(program: Program):
     ops = []
     for op in program.global_block().ops:
-        if not op.serializable():
+        if not op.serializable() and op.prim not in _REBUILDABLE_MACROS:
             raise ValueError(
                 f"op {op.type} is a macro op; prune to the inference "
                 f"subgraph before serializing (save_inference_model does)")
@@ -55,9 +58,23 @@ def _program_from_dict(d) -> Program:
                      stop_gradient=meta["stop_gradient"],
                      is_data=meta["is_data"], trainable=meta["trainable"])
     for o in d["ops"]:
+        fn = None
+        attrs = o["attrs"]
+        if o["prim"] == "@backward":
+            # rebuild the macro grad fn from the ops appended so far
+            from .backward import make_backward_fn
+            fn = make_backward_fn(
+                list(b.ops[:attrs["n_fwd_ops"]]), attrs["param_names"],
+                attrs["ext_names"], attrs["loss_name"],
+                attrs.get("checkpoints", False))
+        elif o["prim"] == "@optimize":
+            from ..optimizer.optimizer import (rebuild_optimizer,
+                                               make_update_fn)
+            opt = rebuild_optimizer(attrs["optimizer"], attrs["config"])
+            fn = make_update_fn(opt, attrs["param_names"])
         op = Operator(b, prim=o["prim"], inputs=o["inputs"],
-                      outputs=o["outputs"], attrs=o["attrs"],
-                      type_name=o["type"])
+                      outputs=o["outputs"], attrs=attrs,
+                      type_name=o["type"], fn=fn)
         b.ops.append(op)
     p._parameters = list(d["parameters"])
     p._feed_names = d.get("feed_names", [])

@@ -138,3 +138,92 @@ def test_capi_from_c_program(tmp_path):
                        text=True, timeout=300)
     assert p.returncode == 0, (p.stdout, p.stderr[-2000:])
     assert "C-DEMO-OK" in p.stdout
+
+
+C_TRAIN_DEMO = r"""
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* pd_trainer_create(const char* prefix, const char* feeds_csv,
+                               const char* fetch);
+extern int pd_trainer_step_f32(void* h, const float* x,
+                               const long long* xs, int xn,
+                               const long long* l, const long long* ls,
+                               int ln, float* loss);
+extern void pd_trainer_destroy(void* h);
+extern const char* pd_last_error(void);
+
+int main(int argc, char** argv) {
+    void* tr = pd_trainer_create(argv[1], "x,y", argv[2]);
+    if (!tr) { fprintf(stderr, "create: %s\n", pd_last_error()); return 1; }
+    /* linearly separable toy data */
+    float x[64 * 4];
+    long long y[64];
+    for (int i = 0; i < 64; ++i) {
+        float s = 0;
+        for (int j = 0; j < 4; ++j) {
+            x[i * 4 + j] = (float)((i * 7 + j * 13) % 11 - 5) / 5.0f;
+            s += x[i * 4 + j];
+        }
+        y[i] = s > 0 ? 1 : 0;
+    }
+    long long xs[2] = {64, 4};
+    long long ls[1] = {64};
+    float first = 0, loss = 0;
+    for (int step = 0; step < 30; ++step) {
+        if (pd_trainer_step_f32(tr, x, xs, 2, y, ls, 1, &loss) != 0) {
+            fprintf(stderr, "step: %s\n", pd_last_error());
+            return 2;
+        }
+        if (step == 0) first = loss;
+    }
+    if (!(loss < first)) {
+        fprintf(stderr, "no descent: %f -> %f\n", first, loss);
+        return 3;
+    }
+    printf("C-TRAIN-OK %f -> %f\n", first, loss);
+    pd_trainer_destroy(tr);
+    return 0;
+}
+"""
+
+
+def _save_train_model(tmp_path):
+    """A trainable program (fc + CE + SGD) saved with static.save."""
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            y = static.data("y", [None], "int64")
+            h = static.nn.fc(x, 16, activation="relu")
+            logits = static.nn.fc(h, 2)
+            loss = paddle.nn.functional.cross_entropy(logits, y)
+            paddle.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        prefix = str(tmp_path / "train_model")
+        static.save(main, prefix)
+        return prefix, loss.name
+    finally:
+        paddle.disable_static()
+
+
+def test_python_free_training_from_c(tmp_path):
+    """demo_trainer.cc parity: a C program trains a saved program to
+    descent with no Python on the consumer side."""
+    from paddle_tpu.native import build_capi
+    so = build_capi()
+    prefix, loss_name = _save_train_model(tmp_path)
+    csrc = tmp_path / "train_demo.c"
+    csrc.write_text(C_TRAIN_DEMO)
+    exe = str(tmp_path / "train_demo")
+    subprocess.run(
+        ["gcc", str(csrc), "-o", exe, so,
+         f"-Wl,-rpath,{os.path.dirname(so)}"],
+        check=True, capture_output=True)
+    p = subprocess.run([exe, prefix, loss_name], env=_env(),
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, (p.stdout, p.stderr[-2000:])
+    assert "C-TRAIN-OK" in p.stdout

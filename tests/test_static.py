@@ -453,3 +453,45 @@ def test_static_py_func_and_print(capsys):
     y = static.Print(x, message="dbg: ")
     assert np.allclose(y.numpy(), 1.0)
     assert "dbg:" in capsys.readouterr().out
+
+
+def test_train_program_save_load_roundtrip(tmp_path):
+    """Whole TRAIN programs (backward + optimizer macro ops) serialize and
+    deserialize; the loaded program keeps training and descends
+    (io.py save/load :1760/:1832 parity for train programs)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            y = static.data("y", [None], "int64")
+            h = static.nn.fc(x, 16, activation="relu")
+            logits = static.nn.fc(h, 2)
+            loss = paddle.nn.functional.cross_entropy(logits, y)
+            paddle.optimizer.Momentum(learning_rate=0.3,
+                                      momentum=0.9).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        X = rs.randn(64, 4).astype("float32")
+        Y = (X.sum(1) > 0).astype("int64")
+        l0 = exe.run(main, feed={"x": X, "y": Y},
+                     fetch_list=[loss.name])[0]
+        prefix = str(tmp_path / "trainprog")
+        static.save(main, prefix)
+
+        prog2 = static.deserialize_program(
+            open(prefix + ".pdmodel", "rb").read())
+        exe2 = static.Executor()
+        static.load(prog2, prefix, exe2)
+        losses = [float(np.asarray(exe2.run(
+            prog2, feed={"x": X, "y": Y}, fetch_list=[loss.name])[0]))
+            for _ in range(10)]
+        assert losses[-1] < losses[0], losses
+        # first loaded loss continues from the saved state, not from init
+        assert abs(losses[0] - float(np.asarray(l0))) < 1.0
+    finally:
+        paddle.disable_static()
