@@ -68,15 +68,24 @@ def make_update_fn(opt, param_names):
 
 
 def rebuild_optimizer(class_name, config):
-    """Reconstruct an optimizer for a deserialized @optimize op: plain
-    instance + the saved scalar hyperparams (functional_apply reads only
-    those plus the class rule)."""
+    """Reconstruct an optimizer for a deserialized @optimize op: the real
+    subclass constructor (so non-scalar attrs like AdamW's decay fn
+    initialize), then the saved scalar hyperparams and grad clip."""
     import sys
     cls = getattr(sys.modules[__name__], class_name)
-    opt = cls.__new__(cls)
-    Optimizer.__init__(opt, learning_rate=config.get("_lr", 0.001))
+    opt = cls(learning_rate=config.get("_lr", 0.001))
     for k, v in config.items():
+        if k == "_grad_clip_spec":
+            continue
         setattr(opt, k, v)
+    clip_spec = config.get("_grad_clip_spec")
+    if clip_spec:
+        from ..nn import clip as clip_mod
+        ccls = getattr(clip_mod, clip_spec["class"])
+        c = ccls.__new__(ccls)
+        for k, v in clip_spec["args"].items():
+            setattr(c, k, v)
+        opt._grad_clip = c
     return opt
 
 
@@ -509,14 +518,21 @@ class Optimizer:
         return None, pgs
 
     def _export_config(self):
-        """Scalar hyperparams sufficient for rebuild_optimizer: everything
-        functional_apply reads. LR schedules export their current value
-        (a loaded trainer runs at the saved LR)."""
+        """Hyperparams sufficient for rebuild_optimizer: every scalar the
+        update rule reads, plus the grad clip (its classes are scalar
+        bags). LR schedules export their current value (a loaded trainer
+        runs at the saved LR)."""
         cfg = {}
         for k, v in self.__dict__.items():
             if isinstance(v, (int, float, bool, str)) and not k.startswith("__"):
                 cfg[k] = v
         cfg["_lr"] = float(self.get_lr())
+        if self._grad_clip is not None:
+            cfg["_grad_clip_spec"] = {
+                "class": type(self._grad_clip).__name__,
+                "args": {k: v for k, v in vars(self._grad_clip).items()
+                         if isinstance(v, (int, float, bool, str))},
+            }
         return cfg
 
     def clear_grad(self, set_to_zero=False):

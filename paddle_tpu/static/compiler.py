@@ -47,12 +47,52 @@ class ExecutionStrategy:
         self.use_thread_barrier = False
 
 
+# every BuildStrategy field accounted for (the strategy-honesty rule of
+# fleet/ledger.py applied to build_strategy.cc's pass pipeline): "n/a"
+# fields are legitimately subsumed by XLA and may take any value; "raises"
+# fields would change numerics/topology and are rejected when set to a
+# non-default value instead of silently ignored.
+BUILD_LEDGER = {
+    "reduce_strategy": ("n/a", "GSPMD chooses reduction placement"),
+    "gradient_scale_strategy": ("raises", "custom grad scaling must go "
+                                          "through the optimizer/GradScaler"),
+    "fuse_all_reduce_ops": ("n/a", "XLA all-reduce combiner"),
+    "fuse_elewise_add_act_ops": ("n/a", "XLA elementwise fusion"),
+    "fuse_bn_act_ops": ("n/a", "XLA fusion"),
+    "enable_inplace": ("n/a", "buffer donation"),
+    "memory_optimize": ("n/a", "XLA buffer assignment"),
+    "sync_batch_norm": ("raises", "use nn.SyncBatchNorm layers; a program "
+                                  "rewrite pass is not provided"),
+    "num_trainers": ("n/a", "cluster size comes from the launch env"),
+    "trainer_id": ("n/a", "rank comes from the launch env"),
+}
+
+_BUILD_DEFAULTS = None
+
+
+def check_build_strategy(bs):
+    """Raise for non-default values of 'raises'-classified fields."""
+    global _BUILD_DEFAULTS
+    if _BUILD_DEFAULTS is None:
+        _BUILD_DEFAULTS = vars(BuildStrategy())
+    for field, (kind, note) in BUILD_LEDGER.items():
+        if kind != "raises":
+            continue
+        val = getattr(bs, field, None)
+        if val is not None and val != _BUILD_DEFAULTS.get(field):
+            raise NotImplementedError(
+                f"BuildStrategy.{field} is not supported by the TPU "
+                f"engine: {note}")
+    return True
+
+
 class CompiledProgram:
     """compiler.py:88 parity."""
 
     def __init__(self, program_or_graph, build_strategy=None):
         self._program = program_or_graph
         self._build_strategy = build_strategy or BuildStrategy()
+        check_build_strategy(self._build_strategy)
         self._exec_strategy = ExecutionStrategy()
         self._data_parallel = False
         self._loss_name = None
@@ -62,6 +102,7 @@ class CompiledProgram:
         self._data_parallel = True
         self._loss_name = loss_name
         if build_strategy is not None:
+            check_build_strategy(build_strategy)
             self._build_strategy = build_strategy
         if exec_strategy is not None:
             self._exec_strategy = exec_strategy

@@ -24,6 +24,11 @@ from .executor import global_scope
 
 _PROG_MAGIC = "paddle_tpu.program.v1"
 
+# NOTE on macro ops: @backward and @optimize close over Python state but
+# their attrs carry the full rebuild recipe, so TRAIN programs serialize
+# (deserialization reconstructs the closures below). Other fn-bearing ops
+# must still be pruned to the inference subgraph first.
+
 
 _REBUILDABLE_MACROS = ("@backward", "@optimize")
 
