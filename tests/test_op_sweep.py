@@ -211,3 +211,42 @@ def test_bf16_forward(name):
     if mask.any() and got[mask].dtype.kind == "f":
         np.testing.assert_allclose(got[mask], want[mask], rtol=0.06,
                                    atol=0.06)
+
+
+# -- round-3 op long tail: numeric-grad coverage ------------------------------
+
+LONGTAIL_GRAD = {
+    "add_position_encoding": (paddle.add_position_encoding,
+                              lambda: [_x(2, 4, 8)]),
+    "conv_shift": (paddle.conv_shift, lambda: [_x(3, 6), _x(3, 3)]),
+    "row_conv": (paddle.row_conv, lambda: [_x(2, 5, 4), _x(3, 4)]),
+    "squared_l2_distance": (paddle.squared_l2_distance,
+                            lambda: [_x(3, 4), _x(3, 4)]),
+    "l1_norm": (paddle.l1_norm, lambda: [_x(3, 4, margin=0.3)]),
+    "bilinear_tensor_product": (
+        lambda a, b, w: paddle.bilinear_tensor_product(a, b, w),
+        lambda: [_x(3, 4), _x(3, 5), _x(2, 4, 5)]),
+    "affine_channel": (
+        lambda x, s, b: paddle.affine_channel(x, s, b),
+        lambda: [_x(2, 3, 4, 4), _x(3), _x(3)]),
+    "cvm": (lambda x: paddle.cvm(x), lambda: [_pos(3, 6)]),
+    "rank_loss": (F.rank_loss,
+                  lambda: [R.randint(0, 2, (4, 1)).astype("float32"),
+                           _x(4, 1), _x(4, 1)]),
+    "modified_huber_loss": (
+        F.modified_huber_loss,
+        lambda: [_x(4, 1, margin=0.3),
+                 R.randint(0, 2, (4, 1)).astype("float32")]),
+    "segment_pool_sum": (
+        lambda x: paddle.segment_pool(
+            x, paddle.to_tensor(np.array([0, 0, 1, 1])), "SUM"),
+        lambda: [_x(4, 3)]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(LONGTAIL_GRAD))
+def test_longtail_grad_matches_numeric(name):
+    fn, build = LONGTAIL_GRAD[name]
+    args = build()
+    wrt = 1 if name in ("rank_loss",) else 0
+    check_grad(fn, args, wrt=wrt)
