@@ -278,12 +278,80 @@ class Fleet:
 
     @property
     def util(self):
-        return _UtilBase(self)
+        u = self.__dict__.get("_util")
+        if u is None:
+            u = self.__dict__["_util"] = _UtilBase(self)
+        return u
+
+
+def _store_gather_bytes(fleet_obj, store, comm_world, tag, payload, me,
+                        world):
+    """The one store-exchange protocol behind util all_reduce/all_gather:
+    generation-scoped prefix + per-comm_world sequence, publish, barrier,
+    read all ranks, done-barrier, rank-0 cleanup. Cleanup also removes the
+    PREVIOUS call's barrier bookkeeping (everyone is provably past it),
+    so per-step use does not grow the store unboundedly."""
+    gen = store._restart_generation()
+    seqs = fleet_obj.__dict__.setdefault(f"_util_{tag}_seqs", {})
+    seq = seqs.get(comm_world, 0)
+    seqs[comm_world] = seq + 1
+    pre = f"__util{tag}/{gen}/{comm_world}/{seq}"
+    store.set(f"{pre}/{me}", payload)
+    store.barrier(pre, world)
+    parts = [store.get(f"{pre}/{r}") for r in range(world)]
+    store.barrier(f"{pre}/done", world)
+    if me == 0:
+        store.delete_prefix(pre + "/")
+        if seq > 0:
+            prev = f"__util{tag}/{gen}/{comm_world}/{seq - 1}"
+            store.delete_prefix(f"__barrier/{prev}")
+    return parts
 
 
 class _UtilBase:
+    """util_factory.py UtilBase parity: cross-process collectives over the
+    store, file sharding, FS client slot. State (FS client, sequence
+    counters) lives on the Fleet singleton — Fleet.util returns a cached
+    instance, but the counters predate that and stay put."""
+
     def __init__(self, fleet):
         self._fleet = fleet
+        self._fs = None
+
+    def _set_file_system(self, fs_client):
+        self._fs = fs_client
+
+    def get_file_shard(self, files):
+        """util_factory.py:206: contiguous block split of a file list
+        across workers (remainder spread over the first ranks)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file names")
+        rm = self._fleet._role_maker
+        world = rm.worker_num() if rm else 1
+        me = rm.worker_index() if rm else 0
+        per, rem = divmod(len(files), world)
+        begin = per * me + min(me, rem)
+        return files[begin:begin + per + (1 if me < rem else 0)]
+
+    def print_on_rank(self, message, rank_id):
+        rm = self._fleet._role_maker
+        if (rm.worker_index() if rm else 0) == rank_id:
+            print(message)
+
+    def all_gather(self, input, comm_world="worker"):
+        """Gather one python scalar/array per member, ordered by rank
+        (util_factory.py:150). Degrades to [input] before fleet.init()."""
+        import pickle
+        rm = self._fleet._role_maker
+        if rm is None:
+            return [input]
+        me, world = self._comm_members(comm_world)
+        if world <= 1 or me is None:
+            return [input]
+        parts = _store_gather_bytes(self._fleet, rm._ensure_store(),
+                                    comm_world, "ag", pickle.dumps(input),
+                                    me, world)
+        return [pickle.loads(b) for b in parts]
 
     def barrier(self, comm_world="worker"):
         self._fleet.barrier_worker()
@@ -321,28 +389,11 @@ class _UtilBase:
     def _store_all_reduce(self, arr, mode, comm_world, me, world):
         import pickle
         rm = self._fleet._role_maker
-        store = rm._ensure_store()
-        # generation-scoped keys: after an elastic gang restart the store
-        # survives in the launcher, and stale contributions from the dead
-        # gang must never be read as current ones. The sequence counter
-        # lives on the Fleet singleton (this _UtilBase is a throwaway per
-        # `.util` access) and is scoped per comm_world so worker-only and
-        # all-reduces never share a prefix.
-        gen = store._restart_generation()
-        seqs = self._fleet.__dict__.setdefault("_util_ar_seqs", {})
-        seq = seqs.get(comm_world, 0)
-        seqs[comm_world] = seq + 1
-        pre = f"__utilar/{gen}/{comm_world}/{seq}"
-        store.set(f"{pre}/{me}", pickle.dumps(arr))
-        store.barrier(pre, world)
-        parts = [pickle.loads(store.get(f"{pre}/{r}"))
-                 for r in range(world)]
+        parts = _store_gather_bytes(self._fleet, rm._ensure_store(),
+                                    comm_world, "ar", pickle.dumps(arr),
+                                    me, world)
         fn = {"sum": np.sum, "max": np.max, "min": np.min}[mode]
-        out = fn(np.stack(parts), axis=0)
-        store.barrier(f"{pre}/done", world)
-        if me == 0:
-            store.delete_prefix(pre + "/")
-        return out
+        return fn(np.stack([pickle.loads(b) for b in parts]), axis=0)
 
 
 fleet = Fleet()

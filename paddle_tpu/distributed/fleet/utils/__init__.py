@@ -1,0 +1,4 @@
+"""fleet.utils: filesystem clients + helpers (fleet/utils/ parity)."""
+from .fs import (  # noqa: F401
+    FS, LocalFS, HDFSClient, FSFileExistsError, FSFileNotExistsError,
+)

@@ -130,3 +130,49 @@ def test_two_process_matches_single_process(tmp_path):
     assert np.allclose(dist, ref, atol=1e-4), (dist, ref)
     # and training actually descends
     assert dist[-1] < dist[0]
+
+
+_GATHER_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, __REPO__)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.distributed.fleet as fleet
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    fleet.init(is_collective=False)
+    out = fleet.util.all_gather(rank * 10 + 1)
+    out2 = fleet.util.all_gather(np.full((2,), rank))
+    print("GATHER", rank, out, int(out2[0][0]), int(out2[1][0]))
+""")
+
+
+def test_util_all_gather_two_processes(tmp_path):
+    """util.all_gather returns rank-ordered values on every member."""
+    import socket
+    script = tmp_path / "g.py"
+    script.write_text(_GATHER_WORKER.replace("__REPO__", repr(REPO)))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = []
+    for rank in (0, 1):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TRAINER_ENDPOINTS": "127.0.0.1:62201,127.0.0.1:62202",
+            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:6220{rank+1}",
+            "PADDLE_STORE_ENDPOINT": f"127.0.0.1:{port}",
+        })
+        procs.append(subprocess.Popen([sys.executable, str(script)],
+                                      env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err[-1500:]
+        line = [l for l in out.splitlines() if l.startswith("GATHER")][0]
+        assert "[1, 11]" in line and line.endswith("0 1"), line

@@ -255,3 +255,39 @@ def test_subgroup_int_max_exact(world):
                                           op=dist.ReduceOp.MAX)._value,
                 world, in_specs=P("dp"), out_specs=P("dp"))(x)
     np.testing.assert_array_equal(np.asarray(out), np.full(8, big))
+
+
+def test_fleet_utils_fs_localfs(tmp_path):
+    """fleet/utils/fs.py LocalFS parity: the checkpoint FS surface."""
+    from paddle_tpu.distributed.fleet.utils import LocalFS, FSFileExistsError
+    import pytest as _pytest
+    fs = LocalFS()
+    d = str(tmp_path / "ckpt")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = str(tmp_path / "ckpt" / "epoch0")
+    fs.touch(f)
+    assert fs.is_file(f)
+    with _pytest.raises(FSFileExistsError):
+        fs.touch(f, exist_ok=False)
+    dirs, files = fs.ls_dir(d)
+    assert files == ["epoch0"] and dirs == []
+    fs.mv(f, f + ".bak")
+    assert fs.is_file(f + ".bak") and not fs.is_exist(f)
+    assert not fs.need_upload_download()
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_fleet_util_get_file_shard(monkeypatch):
+    """util_factory.py:206 semantics: contiguous blocks, remainder first."""
+    import paddle_tpu.distributed.fleet as fleet
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    fleet.init(is_collective=False)
+    files = [f"f{i}" for i in range(5)]
+    shard = fleet.util.get_file_shard(files)
+    # rank 1 of 2: rank 0 takes 3 (2+remainder), rank 1 takes 2
+    assert shard == ["f3", "f4"], shard
+    with __import__("pytest").raises(TypeError):
+        fleet.util.get_file_shard("not-a-list")
