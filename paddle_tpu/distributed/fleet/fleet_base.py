@@ -198,6 +198,13 @@ class Fleet:
 
     def distributed_model(self, model):
         from ..parallel import DataParallel
+        s = self._user_defined_strategy
+        if s is not None and getattr(s, "sync_batch_norm", False):
+            # the reference's sync_batch_norm pass rewrites program BN ops;
+            # the layer-world equivalent is the SyncBatchNorm converter
+            # (global batch stats via GSPMD's cross-dp reduction)
+            from ...nn import SyncBatchNorm
+            model = SyncBatchNorm.convert_sync_batchnorm(model)
         return DataParallel(model)
 
     def minimize(self, loss=None, startup_program=None, parameter_list=None,

@@ -53,11 +53,10 @@ LEDGER = {
     "cudnn_exhaustive_search": ("n/a", "no cuDNN; XLA picks conv tilings"),
     "cudnn_batchnorm_spatial_persistent": ("n/a", "no cuDNN"),
     "conv_workspace_size_limit": ("n/a", "no cuDNN workspace on TPU"),
-    "sync_batch_norm": ("raises", "cross-replica BN stats need a "
-                                  "mesh-aware BN layer (nn.SyncBatchNorm "
-                                  "over dp axis) — not wired into the "
-                                  "strategy path yet; use larger per-chip "
-                                  "batch or GroupNorm"),
+    "sync_batch_norm": ("engine", "fleet.distributed_model converts BN "
+                                  "layers via SyncBatchNorm."
+                                  "convert_sync_batchnorm (global stats "
+                                  "through GSPMD's cross-dp reduction)"),
     "find_unused_parameters": ("n/a", "jax.grad prunes unused params "
                                       "structurally; no reducer hooks to "
                                       "miss"),

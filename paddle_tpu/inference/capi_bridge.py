@@ -67,3 +67,31 @@ def train_step(trainer, x_bytes, x_shape, label_bytes, label_shape):
         if was_dygraph:
             paddle.disable_static()
     return float(np.asarray(outs[0]).reshape(-1)[0])
+
+
+def set_input(pred, name, data, shape, dtype):
+    """C: pd_predictor_set_input_* — stage one named feed
+    (PD_SetZeroCopyInput parity)."""
+    arr = np.frombuffer(data, dtype).reshape(shape)
+    pred.get_input_handle(name).copy_from_cpu(arr)
+
+
+def run_staged(pred):
+    """C: pd_predictor_run2 — run on the staged feeds; returns the output
+    count."""
+    pred.run()
+    return len(pred.get_output_names())
+
+
+def get_output_f32(pred, idx):
+    """C: pd_predictor_get_output_f32 — output #idx as float32 bytes."""
+    name = pred.get_output_names()[idx]
+    out = pred.get_output_handle(name).copy_to_cpu()
+    out = np.ascontiguousarray(np.asarray(out, np.float32))
+    return out.tobytes(), tuple(int(d) for d in out.shape)
+
+
+def io_names(pred):
+    """C: pd_predictor_io_names — 'in1,in2|out1,out2'."""
+    return ",".join(pred.get_input_names()) + "|" + \
+        ",".join(pred.get_output_names())

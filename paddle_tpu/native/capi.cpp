@@ -126,6 +126,126 @@ void pd_predictor_destroy(void* handle) {
     PyGILState_Release(g);
 }
 
+// -- multi-input serving (capi PD_SetZeroCopyInput/GetZeroCopyOutput style) --
+
+namespace {
+
+int set_input_impl(void* handle, const char* name, const void* data,
+                   long long nbytes, const long long* shape, int ndim,
+                   const char* dtype) {
+    if (handle == nullptr) { g_err = "null predictor"; return -1; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* bytes = PyBytes_FromStringAndSize(
+        static_cast<const char*>(data), nbytes);
+    PyObject* shp = PyTuple_New(ndim);
+    for (int i = 0; i < ndim; ++i)
+        PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+    PyObject* res = PyObject_CallMethod(
+        g_bridge, "set_input", "OsOOs", static_cast<PyObject*>(handle),
+        name, bytes, shp, dtype);
+    Py_DECREF(bytes);
+    Py_DECREF(shp);
+    int rc = -1;
+    if (res == nullptr) set_err_from_python(); else { rc = 0; Py_DECREF(res); }
+    PyGILState_Release(g);
+    return rc;
+}
+
+}  // namespace
+
+extern "C" int pd_predictor_set_input_f32(void* h, const char* name,
+                                          const float* data,
+                                          const long long* shape, int ndim) {
+    long long n = 1;
+    for (int i = 0; i < ndim; ++i) n *= shape[i];
+    return set_input_impl(h, name, data, n * sizeof(float), shape, ndim,
+                          "float32");
+}
+
+extern "C" int pd_predictor_set_input_i64(void* h, const char* name,
+                                          const long long* data,
+                                          const long long* shape, int ndim) {
+    long long n = 1;
+    for (int i = 0; i < ndim; ++i) n *= shape[i];
+    return set_input_impl(h, name, data, n * sizeof(long long), shape, ndim,
+                          "int64");
+}
+
+// Run on staged inputs; returns the output count or -1.
+extern "C" int pd_predictor_run2(void* handle) {
+    if (handle == nullptr) { g_err = "null predictor"; return -1; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* res = PyObject_CallMethod(g_bridge, "run_staged", "O",
+                                        static_cast<PyObject*>(handle));
+    int rc = -1;
+    if (res == nullptr) {
+        set_err_from_python();
+    } else {
+        rc = static_cast<int>(PyLong_AsLong(res));
+        Py_DECREF(res);
+    }
+    PyGILState_Release(g);
+    return rc;
+}
+
+// Copy output #idx (float32) into out; returns element count (may exceed
+// out_cap — call again with a larger buffer) or -1.
+extern "C" long long pd_predictor_get_output_f32(void* handle, int idx,
+                                                 float* out,
+                                                 long long out_cap) {
+    if (handle == nullptr) { g_err = "null predictor"; return -1; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* res = PyObject_CallMethod(g_bridge, "get_output_f32", "Oi",
+                                        static_cast<PyObject*>(handle), idx);
+    long long count = -1;
+    if (res == nullptr) {
+        set_err_from_python();
+    } else {
+        char* buf = nullptr;
+        Py_ssize_t blen = 0;
+        if (PyBytes_AsStringAndSize(PyTuple_GetItem(res, 0), &buf,
+                                    &blen) == 0) {
+            count = blen / static_cast<long long>(sizeof(float));
+            long long ncopy = count < out_cap ? count : out_cap;
+            if (out != nullptr && ncopy > 0)
+                std::memcpy(out, buf, ncopy * sizeof(float));
+        } else {
+            set_err_from_python();
+        }
+        Py_DECREF(res);
+    }
+    PyGILState_Release(g);
+    return count;
+}
+
+// "in1,in2|out1,out2" into buf; returns needed length or -1.
+extern "C" long long pd_predictor_io_names(void* handle, char* buf,
+                                           long long cap) {
+    if (handle == nullptr) { g_err = "null predictor"; return -1; }
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* res = PyObject_CallMethod(g_bridge, "io_names", "O",
+                                        static_cast<PyObject*>(handle));
+    long long need = -1;
+    if (res == nullptr) {
+        set_err_from_python();
+    } else {
+        const char* s = PyUnicode_AsUTF8(res);
+        if (s != nullptr) {
+            need = static_cast<long long>(strlen(s)) + 1;
+            if (buf != nullptr && cap > 0) {
+                long long ncopy = need < cap ? need : cap;
+                std::memcpy(buf, s, ncopy);
+                buf[ncopy - 1] = '\0';
+            }
+        } else {
+            set_err_from_python();
+        }
+        Py_DECREF(res);
+    }
+    PyGILState_Release(g);
+    return need;
+}
+
 // -- Python-free TRAINING entry (train/demo/demo_trainer.cc parity) ---------
 
 // Load a train program saved by paddle.static.save: model_prefix.pdmodel +

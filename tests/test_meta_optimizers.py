@@ -199,3 +199,19 @@ def test_localsgd_trainstep_descends_and_syncs():
     assert np.allclose(v, v[0:1], atol=1e-6)
     step.sync_to_layer()
     assert net[0].weight.numpy().shape == (8, 16)
+
+
+def test_sync_batch_norm_strategy_converts_layers():
+    """strategy.sync_batch_norm acts: distributed_model swaps BN layers to
+    SyncBatchNorm (sync_batch_norm pass parity at the layer level)."""
+    from paddle_tpu.distributed.fleet.fleet_base import Fleet
+    from paddle_tpu.distributed.fleet.base.distributed_strategy import (
+        DistributedStrategy)
+    s = DistributedStrategy()
+    s.sync_batch_norm = True
+    f = Fleet()
+    f._user_defined_strategy = s
+    net = nn.Sequential(nn.Conv2D(3, 8, 3), nn.BatchNorm2D(8), nn.ReLU())
+    dp = f.distributed_model(net)
+    kinds = [type(m).__name__ for m in dp._layers.sublayers()]
+    assert "SyncBatchNorm" in kinds and "BatchNorm2D" not in kinds, kinds
