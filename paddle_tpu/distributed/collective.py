@@ -82,13 +82,18 @@ def get_group(gid: int = 0) -> Group:
 
 
 def _axis_bound(axis: str) -> bool:
-    """True if we're inside a traced region with this named axis bound."""
+    """True if we're inside a traced region with this named axis bound.
+
+    Only the unbound-axis signal (NameError from ``lax.axis_index``; jax also
+    uses KeyError for unknown axis names in some resolution paths) routes to
+    the eager no-op branch.  Any other exception under a bound axis is a real
+    failure and must propagate — a bare ``except Exception`` here would turn
+    collectives into silent identities inside traced regions.
+    """
     try:
         lax.axis_index(axis)
         return True
-    except NameError:
-        return False
-    except Exception:
+    except (NameError, KeyError):
         return False
 
 

