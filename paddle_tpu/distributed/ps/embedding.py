@@ -30,14 +30,14 @@ class DistributedEmbedding(Layer):
 
     def __init__(self, client, table_id: int, dim: int,
                  optimizer: str = "adagrad", lr: float = 0.05,
-                 init_scale: float = 0.01):
+                 init_scale: float = 0.01, **table_kw):
         super().__init__()
         self.client = client
         self.table_id = int(table_id)
         self.dim = int(dim)
         client.create_table(self.table_id, "sparse", dim=dim,
                             optimizer=optimizer, lr=lr,
-                            init_scale=init_scale)
+                            init_scale=init_scale, **table_kw)
         self._pending: List[Tuple[np.ndarray, Tensor]] = []
 
     def pull_padded_rows(self, uniq):

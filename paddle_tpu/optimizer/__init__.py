@@ -3,6 +3,7 @@ from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Lamb, LarsMomentum, RMSProp,
     Adagrad, Adadelta, Adamax, L2Decay, L1Decay,
+    Ftrl, ProximalGD, ProximalAdagrad, DecayedAdagrad, Dpsgd,
 )
 
 # fluid-era aliases (fluid/optimizer.py)
@@ -13,3 +14,8 @@ AdagradOptimizer = Adagrad
 RMSPropOptimizer = RMSProp
 LarsMomentumOptimizer = LarsMomentum
 LambOptimizer = Lamb
+FtrlOptimizer = Ftrl
+ProximalGDOptimizer = ProximalGD
+ProximalAdagradOptimizer = ProximalAdagrad
+DecayedAdagradOptimizer = DecayedAdagrad
+DpsgdOptimizer = Dpsgd

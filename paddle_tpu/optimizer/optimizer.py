@@ -1014,3 +1014,183 @@ class Adamax(Optimizer):
         self._writeback(pg, new_p)
         self._accumulators["moment"].update(new_m)
         self._accumulators["inf_norm"].update(new_u)
+
+
+# -- CTR-era optimizer family (VERDICT r3 missing #1) ------------------------
+# ftrl_op.h / proximal_gd_op.h / proximal_adagrad_op.h / decayed_adagrad_op.h
+# / dpsgd_op.h kernel math as jitted functional rules.  The general
+# ``new_acc ** -lr_power`` form subsumes the reference's -0.5 fast path
+# (identical values), and the proximal shrink formula with l1 == 0 reduces
+# exactly to the reference's else-branch, so each rule is one expression.
+
+@jax.jit
+def _ftrl_rule(params, grads, squared, linear, lr, l1, l2, lr_power):
+    def upd(p, g, sq, lin):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        new_acc = sq + jnp.square(g)
+        sigma = (new_acc ** -lr_power - sq ** -lr_power) / lr
+        lin_new = lin + g - sigma * p32
+        x = jnp.sign(lin_new) * l1 - lin_new
+        y = 2.0 * l2 + new_acc ** -lr_power / lr
+        p_new = jnp.where(jnp.abs(lin_new) > l1, x / y, 0.0)
+        return p_new.astype(p.dtype), new_acc, lin_new
+    flat = jax.tree_util.tree_map(upd, params, grads, squared, linear)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()},
+            {k: x[2] for k, x in flat.items()})
+
+
+@jax.jit
+def _proximal_gd_rule(params, grads, lr, l1, l2):
+    def upd(p, g):
+        prox = p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+        out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) /
+               (1.0 + lr * l2))
+        return out.astype(p.dtype)
+    return jax.tree_util.tree_map(upd, params, grads)
+
+
+@jax.jit
+def _proximal_adagrad_rule(params, grads, moment, lr, l1, l2):
+    def upd(p, g, m_):
+        g = g.astype(jnp.float32)
+        m_new = m_ + jnp.square(g)
+        # eps guard (deviation from proximal_adagrad_op.h:51, which divides
+        # by bare sqrt and NaNs on zero-grad/zero-moment elements)
+        lr_eff = lr / (jnp.sqrt(m_new) + 1e-8)
+        prox = p.astype(jnp.float32) - lr_eff * g
+        out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_eff * l1, 0.0)
+               / (1.0 + lr_eff * l2))
+        return out.astype(p.dtype), m_new
+    flat = jax.tree_util.tree_map(upd, params, grads, moment)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()})
+
+
+@jax.jit
+def _decayed_adagrad_rule(params, grads, moment, lr, decay, eps):
+    def upd(p, g, m_):
+        g = g.astype(jnp.float32)
+        m_new = decay * m_ + (1.0 - decay) * jnp.square(g)
+        return (p.astype(jnp.float32) - lr * g / (jnp.sqrt(m_new) + eps)
+                ).astype(p.dtype), m_new
+    flat = jax.tree_util.tree_map(upd, params, grads, moment)
+    return ({k: x[0] for k, x in flat.items()},
+            {k: x[1] for k, x in flat.items()})
+
+
+@jax.jit
+def _dpsgd_rule(params, grads, noises, lr, clip, batch_size):
+    def upd(p, g, noise):
+        g = g.astype(jnp.float32)
+        norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        scale = jnp.maximum(norm / clip, 1.0)
+        return (p.astype(jnp.float32) -
+                lr * (g / scale + noise / batch_size)).astype(p.dtype)
+    return jax.tree_util.tree_map(upd, params, grads, noises)
+
+
+class Ftrl(Optimizer):
+    """FTRL-Proximal (fluid.optimizer.FtrlOptimizer; ftrl_op.h kernel)."""
+    _state_names = ["squared", "linear"]
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._l1, self._l2, self._lr_power = float(l1), float(l2), float(lr_power)
+
+    def _apply(self, pg):
+        self._ensure_state(["squared", "linear"], pg)
+        params, grads = self._trees(pg)
+        sq = {p.name: self._accumulators["squared"][p.name] for p, _ in pg}
+        lin = {p.name: self._accumulators["linear"][p.name] for p, _ in pg}
+        new_p, new_sq, new_lin = _ftrl_rule(
+            params, grads, sq, lin, jnp.float32(self.get_lr()),
+            jnp.float32(self._l1), jnp.float32(self._l2),
+            jnp.float32(self._lr_power))
+        self._writeback(pg, new_p)
+        self._accumulators["squared"].update(new_sq)
+        self._accumulators["linear"].update(new_lin)
+
+
+class ProximalGD(Optimizer):
+    """fluid.optimizer.ProximalGDOptimizer (proximal_gd_op.h:47)."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._l1, self._l2 = float(l1), float(l2)
+
+    def _apply(self, pg):
+        params, grads = self._trees(pg)
+        new_p = _proximal_gd_rule(params, grads, jnp.float32(self.get_lr()),
+                                  jnp.float32(self._l1),
+                                  jnp.float32(self._l2))
+        self._writeback(pg, new_p)
+
+
+class ProximalAdagrad(Optimizer):
+    """fluid.optimizer.ProximalAdagradOptimizer (proximal_adagrad_op.h:50)."""
+    _state_names = ["moment"]
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._l1, self._l2 = float(l1), float(l2)
+
+    def _apply(self, pg):
+        self._ensure_state(["moment"], pg)
+        params, grads = self._trees(pg)
+        mom = {p.name: self._accumulators["moment"][p.name] for p, _ in pg}
+        new_p, new_m = _proximal_adagrad_rule(
+            params, grads, mom, jnp.float32(self.get_lr()),
+            jnp.float32(self._l1), jnp.float32(self._l2))
+        self._writeback(pg, new_p)
+        self._accumulators["moment"].update(new_m)
+
+
+class DecayedAdagrad(Optimizer):
+    """fluid.optimizer.DecayedAdagradOptimizer (decayed_adagrad_op.h:63)."""
+    _state_names = ["moment"]
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._decay, self._eps = float(decay), float(epsilon)
+
+    def _apply(self, pg):
+        self._ensure_state(["moment"], pg)
+        params, grads = self._trees(pg)
+        mom = {p.name: self._accumulators["moment"][p.name] for p, _ in pg}
+        new_p, new_m = _decayed_adagrad_rule(
+            params, grads, mom, jnp.float32(self.get_lr()),
+            jnp.float32(self._decay), jnp.float32(self._eps))
+        self._writeback(pg, new_p)
+        self._accumulators["moment"].update(new_m)
+
+
+class Dpsgd(Optimizer):
+    """fluid.optimizer.DpsgdOptimizer (dpsgd_op.h:68) — the CCS16 DP-SGD
+    rule: clip each gradient tensor's l2 norm, add one shared gaussian
+    noise sample per tensor (the reference draws a single scalar per op)."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, weight_decay=None,
+                 grad_clip=None, seed=0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._clip, self._bs, self._sigma = float(clip), float(batch_size), \
+            float(sigma)
+        import numpy as _np
+        self._noise_rng = _np.random.RandomState(seed)
+
+    def _apply(self, pg):
+        params, grads = self._trees(pg)
+        noises = {p.name: jnp.float32(
+            self._noise_rng.normal(0.0, self._sigma)) for p, _ in pg}
+        new_p = _dpsgd_rule(params, grads, noises,
+                            jnp.float32(self.get_lr()),
+                            jnp.float32(self._clip), jnp.float32(self._bs))
+        self._writeback(pg, new_p)

@@ -25,17 +25,18 @@ from ..distributed.ps import DistributedEmbedding, LocalPsEndpoint
 class WideDeep(nn.Layer):
     def __init__(self, client=None, emb_dim: int = 16, num_slots: int = 26,
                  dense_dim: int = 13, hidden=(400, 400, 400),
-                 sparse_lr: float = 0.05):
+                 sparse_lr: float = 0.05, sparse_optimizer: str = "adagrad",
+                 **table_kw):
         super().__init__()
         client = client or LocalPsEndpoint()
         self.client = client
         self.num_slots = num_slots
         self.wide_emb = DistributedEmbedding(client, table_id=0, dim=1,
-                                             optimizer="adagrad",
-                                             lr=sparse_lr)
+                                             optimizer=sparse_optimizer,
+                                             lr=sparse_lr, **table_kw)
         self.deep_emb = DistributedEmbedding(client, table_id=1, dim=emb_dim,
-                                             optimizer="adagrad",
-                                             lr=sparse_lr)
+                                             optimizer=sparse_optimizer,
+                                             lr=sparse_lr, **table_kw)
         layers = []
         in_dim = num_slots * emb_dim + dense_dim
         for h in hidden:

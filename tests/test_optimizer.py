@@ -28,6 +28,11 @@ def make_problem(seed=0):
     (opt.Adadelta, dict(learning_rate=1.0)),
     (opt.Adamax, dict(learning_rate=0.02)),
     (opt.LarsMomentum, dict(learning_rate=5.0)),  # lars_coeff=1e-3 scales lr down
+    (opt.Ftrl, dict(learning_rate=0.5, l1=0.001, l2=0.001)),
+    (opt.ProximalGD, dict(learning_rate=0.1, l1=0.0001, l2=0.001)),
+    (opt.ProximalAdagrad, dict(learning_rate=0.1, l1=0.0001, l2=0.001)),
+    (opt.DecayedAdagrad, dict(learning_rate=0.05)),
+    (opt.Dpsgd, dict(learning_rate=0.05, clip=100.0, sigma=0.0)),
 ])
 def test_optimizer_converges(cls, kw):
     m, x, y = make_problem()
@@ -170,3 +175,40 @@ def test_rmsprop_centered():
     expect = 1.0 - 0.1 * g / np.sqrt(ms - mg ** 2 + 1e-6)
     np.testing.assert_allclose(np.asarray(p._value), expect, rtol=1e-5)
     assert "mean_grad" in opt._accumulators
+
+
+def test_ftrl_dense_matches_table_rule():
+    """The dense Ftrl optimizer and the PS SparseTable 'ftrl' accessor run
+    the same ftrl_op.h math: drive both with identical grads and compare."""
+    from paddle_tpu.distributed.ps import SparseTable
+    lr, l1, l2 = 0.1, 0.01, 0.005
+    p0 = np.array([[0.0, 0.0, 0.0, 0.0]], np.float32)
+    w = paddle.to_tensor(p0.copy(), stop_gradient=False)
+    w.name = "w"
+    o = opt.Ftrl(learning_rate=lr, l1=l1, l2=l2, parameters=[w])
+    t = SparseTable(dim=4, optimizer="ftrl", lr=lr, l1=l1, l2=l2,
+                    initializer="zeros")
+    ids = np.array([0])
+    t.pull(ids)
+    rng = np.random.RandomState(11)
+    for _ in range(6):
+        g = rng.standard_normal((1, 4)).astype(np.float32)
+        w.grad = paddle.to_tensor(g)
+        o.step()
+        t.push(ids, g)
+    np.testing.assert_allclose(w.numpy(), t.pull(ids), rtol=1e-4, atol=1e-6)
+
+
+def test_dpsgd_noise_perturbs_updates():
+    m, x, y = make_problem()
+    o = opt.Dpsgd(learning_rate=0.05, clip=1e9, sigma=0.5, batch_size=1.0,
+                  parameters=m.parameters(), seed=3)
+    loss_fn = nn.MSELoss()
+    loss = loss_fn(m(x), y)
+    loss.backward()
+    before = {p.name: p.numpy().copy() for p in m.parameters()}
+    o.step()
+    o.clear_grad()
+    moved = any(not np.allclose(before[p.name], p.numpy())
+                for p in m.parameters())
+    assert moved

@@ -430,3 +430,192 @@ def test_two_servers_two_workers_with_crash(tmp_path):
     finally:
         s0.stop()
         s1.stop()
+
+
+# -- CTR optimizer family (ftrl_op.h / proximal_*_op.h / decayed_adagrad /
+# dpsgd) vs straight per-element numpy oracles ------------------------------
+
+def _ftrl_oracle(p, sq, lin, g, lr, l1, l2, lrp):
+    """Scalar transcription of ftrl_op.h SparseFTRLFunctor."""
+    new_acc = sq + g * g
+    if lrp == -0.5:
+        sigma = (np.sqrt(new_acc) - np.sqrt(sq)) / lr
+        y = 2 * l2 + np.sqrt(new_acc) / lr
+    else:
+        sigma = (new_acc ** -lrp - sq ** -lrp) / lr
+        y = 2 * l2 + new_acc ** -lrp / lr
+    lin = lin + g - sigma * p
+    x = np.sign(lin) * l1 - lin
+    p = np.where(np.abs(lin) > l1, x / y, 0.0)
+    return p, new_acc, lin
+
+
+def test_ftrl_table_matches_oracle():
+    lr, l1, l2 = 0.1, 0.05, 0.02
+    t = SparseTable(dim=3, optimizer="ftrl", lr=lr, l1=l1, l2=l2,
+                    initializer="zeros")
+    ids = np.array([3, 7, 11])
+    t.pull(ids)
+    p = np.zeros((3, 3)); sq = np.zeros((3, 3)); lin = np.zeros((3, 3))
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        g = rng.standard_normal((3, 3)).astype(np.float32)
+        t.push(ids, g)
+        p, sq, lin = _ftrl_oracle(p, sq, lin, g, lr, l1, l2, -0.5)
+    np.testing.assert_allclose(t.pull(ids), p, rtol=1e-5, atol=1e-6)
+
+
+def test_ftrl_lr_power_general_branch():
+    lr, l1, l2, lrp = 0.1, 0.01, 0.0, -0.3
+    t = SparseTable(dim=2, optimizer="ftrl", lr=lr, l1=l1, l2=l2,
+                    lr_power=lrp, initializer="zeros")
+    ids = np.array([1, 2])
+    t.pull(ids)
+    p = np.zeros((2, 2)); sq = np.zeros((2, 2)); lin = np.zeros((2, 2))
+    rng = np.random.RandomState(1)
+    for _ in range(3):
+        g = rng.standard_normal((2, 2)).astype(np.float32)
+        t.push(ids, g)
+        p, sq, lin = _ftrl_oracle(p, sq, lin, g, lr, l1, l2, lrp)
+    np.testing.assert_allclose(t.pull(ids), p, rtol=1e-4, atol=1e-6)
+
+
+def test_ftrl_l1_drives_exact_zeros():
+    """The canonical FTRL property: rows whose accumulated signal stays
+    under l1 are EXACTLY zero (sparse CTR models rely on this)."""
+    t = SparseTable(dim=4, optimizer="ftrl", lr=0.5, l1=10.0, l2=0.0,
+                    initializer="zeros")
+    ids = np.array([1])
+    t.pull(ids)
+    t.push(ids, np.full((1, 4), 0.01, np.float32))
+    np.testing.assert_array_equal(t.pull(ids), np.zeros((1, 4)))
+
+
+def test_proximal_gd_matches_oracle():
+    lr, l1, l2 = 0.2, 0.05, 0.1
+    t = SparseTable(dim=2, optimizer="proximal_gd", lr=lr, l1=l1, l2=l2,
+                    initializer="uniform", init_scale=0.5, seed=3)
+    ids = np.array([5])
+    p = t.pull(ids).copy()
+    g = np.array([[0.3, -0.7]], np.float32)
+    t.push(ids, g)
+    prox = p - lr * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - lr * l1, 0) / (1 + lr * l2)
+    np.testing.assert_allclose(t.pull(ids), want, rtol=1e-5)
+
+
+def test_proximal_adagrad_matches_oracle():
+    lr, l1, l2 = 0.2, 0.05, 0.1
+    t = SparseTable(dim=2, optimizer="proximal_adagrad", lr=lr, l1=l1, l2=l2,
+                    initializer="uniform", init_scale=0.5, seed=4)
+    ids = np.array([5])
+    p = t.pull(ids).copy()
+    m = np.zeros((1, 2))
+    rng = np.random.RandomState(2)
+    for _ in range(3):
+        g = rng.standard_normal((1, 2)).astype(np.float32)
+        t.push(ids, g)
+        m = m + g * g
+        lr_eff = lr / (np.sqrt(m) + 1e-8)
+        prox = p - lr_eff * g
+        p = (np.sign(prox) * np.maximum(np.abs(prox) - lr_eff * l1, 0) /
+             (1 + lr_eff * l2))
+    np.testing.assert_allclose(t.pull(ids), p, rtol=1e-5)
+
+
+def test_decayed_adagrad_matches_oracle():
+    lr, decay, eps = 0.1, 0.9, 1e-6
+    t = SparseTable(dim=2, optimizer="decayed_adagrad", lr=lr, decay=decay,
+                    eps=eps, initializer="zeros")
+    ids = np.array([9])
+    t.pull(ids)
+    p = np.zeros((1, 2)); m = np.zeros((1, 2))
+    rng = np.random.RandomState(5)
+    for _ in range(4):
+        g = rng.standard_normal((1, 2)).astype(np.float32)
+        t.push(ids, g)
+        m = decay * m + (1 - decay) * g * g
+        p = p - lr * g / (np.sqrt(m) + eps)
+    np.testing.assert_allclose(t.pull(ids), p, rtol=1e-5)
+
+
+def test_dpsgd_clips_per_row_norm():
+    """sigma=0 makes dpsgd deterministic: each ROW is clipped to the l2 ball
+    independently (dpsgd_op.h:80 rule at per-row-accessor granularity), so
+    the update cannot depend on which other ids share the push call."""
+    lr, clip = 0.5, 1.0
+    t = SparseTable(dim=2, optimizer="dpsgd", lr=lr, clip=clip, sigma=0.0,
+                    initializer="zeros")
+    ids = np.array([1, 2])
+    t.pull(ids)
+    g = np.array([[3.0, 0.0], [0.0, 4.0]], np.float32)   # row norms 3, 4
+    t.push(ids, g)
+    np.testing.assert_allclose(
+        t.pull(ids), [[-lr, 0.0], [0.0, -lr]], rtol=1e-6)
+    # shard invariance: same grads via separate pushes == one push
+    t2 = SparseTable(dim=2, optimizer="dpsgd", lr=lr, clip=clip, sigma=0.0,
+                     initializer="zeros")
+    t2.pull(ids)
+    t2.push(ids[:1], g[:1])
+    t2.push(ids[1:], g[1:])
+    np.testing.assert_allclose(t2.pull(ids), t.pull(ids), rtol=1e-6)
+
+
+def test_export_import_rows_roundtrip():
+    """export_rows/import_rows: the raw pull-with-state / writeback pair the
+    accelerator row cache uses (values land verbatim, no rule applied)."""
+    t = SparseTable(dim=3, optimizer="adagrad", lr=0.1, initializer="uniform",
+                    seed=9)
+    ids = np.arange(5)
+    t.pull(ids)
+    t.push(ids, np.ones((5, 3), np.float32))
+    rows, state = t.export_rows(ids)
+    assert set(state) == {"acc"}
+    t2 = SparseTable(dim=3, optimizer="adagrad", lr=0.1, initializer="zeros")
+    t2.import_rows(ids, rows, state)
+    r2, s2 = t2.export_rows(ids)
+    np.testing.assert_allclose(r2, rows)
+    np.testing.assert_allclose(s2["acc"], state["acc"])
+    # post-writeback pushes continue from the imported accumulator state
+    t.push(ids, np.ones((5, 3), np.float32))
+    t2.push(ids, np.ones((5, 3), np.float32))
+    np.testing.assert_allclose(t2.pull(ids), t.pull(ids), rtol=1e-6)
+
+
+def test_push_merges_duplicate_ids():
+    """Duplicate ids in one push are sum-merged before the rule runs
+    (merge SelectedRows semantics)."""
+    t = SparseTable(dim=2, optimizer="sgd", lr=1.0, initializer="zeros")
+    t.pull(np.array([7]))
+    t.push(np.array([7, 7]), np.array([[1.0, 0.0], [2.0, 1.0]], np.float32))
+    np.testing.assert_allclose(t.pull(np.array([7])), [[-3.0, -1.0]])
+
+
+def test_ftrl_trains_ctr_model():
+    """FTRL end-to-end through DistributedEmbedding on the wide part of a
+    CTR model: loss descends and some rows are exactly sparse."""
+    from paddle_tpu.rec.wide_deep import WideDeep, WideDeepTrainer, \
+        synthetic_ctr_batch
+    model = WideDeep(sparse_optimizer="ftrl", sparse_lr=0.05)
+    tr = WideDeepTrainer(model)
+    ids, dense, label = synthetic_ctr_batch(256, vocab=10_000, seed=7)
+    losses = [tr.step(ids, dense, label) for _ in range(8)]
+    tr.flush()
+    assert losses[-1] < losses[0]
+
+
+def test_pull_duplicate_new_ids_share_one_slot():
+    """Regression: repeated unseen ids in one pull must land in ONE slot."""
+    t = SparseTable(dim=2, optimizer="sgd", initializer="uniform", seed=1)
+    rows = t.pull(np.array([5, 5, 9]))
+    np.testing.assert_array_equal(rows[0], rows[1])
+    assert len(t) == 2
+
+
+def test_arena_growth_is_bounded():
+    """Regression: pulls that each add one id must not double capacity."""
+    t = SparseTable(dim=2, optimizer="sgd", initializer="zeros")
+    for i in range(40):
+        t.pull(np.array([i]))
+    assert len(t) == 40
+    assert len(t._arena) <= 2048
