@@ -20,7 +20,8 @@ from .embedding import DistributedEmbedding  # noqa: F401
 from .sharded import (  # noqa: F401
     ShardedPsClient, Communicator, GeoCommunicator,
 )
+from .device_cache import DeviceEmbeddingCache  # noqa: F401
 
 __all__ = ["SparseTable", "DenseTable", "PsServer", "PsClient",
            "LocalPsEndpoint", "DistributedEmbedding", "ShardedPsClient",
-           "Communicator", "GeoCommunicator"]
+           "Communicator", "GeoCommunicator", "DeviceEmbeddingCache"]

@@ -1,0 +1,369 @@
+"""Accelerator-resident hot-row cache over a host PS table.
+
+Reference parity: the HeterPS / PSGPU pipeline —
+paddle/fluid/framework/fleet/ps_gpu_wrapper.h (BuildGPUPS keeps the pass's
+hot sparse rows in GPU HBM), framework/trainer.h:281 PSGPUTrainer, and
+framework/device_worker.h HeterBoxWorker: dense + hot sparse on the
+accelerator, the full table on host/pserver, writeback at pass end.
+
+TPU-first redesign: instead of a per-pass build, this is a steady-state
+software cache.  Rows AND their optimizer state live in device HBM arenas
+([capacity+1, dim]; the last slot is a scratch row that absorbs padding
+writes).  Per step the host resolves batch ids to slots (LRU, numpy-
+vectorized), ships ONLY the miss block, and the train step — one jitted
+XLA program — scatters misses in, gathers, computes, and applies the
+sparse optimizer rule on-chip.  Steady state with a hot working set moves
+zero row bytes over the wire; evictions gather the displaced rows once and
+write them back to the host table raw (import_rows), exactly PSGPU's
+EndPass writeback.
+
+Slot bookkeeping is factored into :class:`SlotDirectory` so several tables
+over the SAME id space (Wide&Deep's wide + deep tables) resolve ids→slots
+once and share one LRU — each table then only moves its own rows.
+
+The on-device rules mirror SparseTable._apply_rule (table.py) — sgd /
+adagrad / ftrl share state layout with the host table, so rows migrate
+between cache and table mid-training without losing accumulator state.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .table import _STATE_SPEC
+
+# rules the cache can run on-chip; state names match _STATE_SPEC
+DEVICE_RULES = ("sgd", "adagrad", "ftrl")
+
+
+def _pad_to_bucket(n: int, bucket: int = 1024) -> int:
+    """Round up to a bucket multiple: stable XLA shapes across steps with
+    ≤bucket wasted rows (vs power-of-two padding's up-to-2× inflation)."""
+    return max(bucket, ((n + bucket - 1) // bucket) * bucket)
+
+
+def pad_adaptive(n: int) -> int:
+    """Eighth-octave padding for shapes that feed LARGE jitted programs:
+    grain = 2^(⌈log2 n⌉-3) ≤ n/4, so at most 8 distinct compiled shapes
+    per doubling of n and ≤25% padding waste — the compromise between
+    power-of-two (1 shape/octave, up to 2× waste) and fine buckets (tiny
+    waste, recompile storm when n drifts)."""
+    if n <= 8:
+        return 8
+    grain = 1 << max(3, n.bit_length() - 3)
+    return ((n + grain - 1) // grain) * grain
+
+
+def apply_rule_device(opt: str, rows, state, grads, *, lr, eps=1e-8,
+                      l1=0.0, l2=0.0, lr_power=-0.5):
+    """Vectorized on-chip sparse-optimizer update: ([U,D] rows, state dict,
+    [U,D] grads) → (new_rows, new_state).  Traced inside the train step."""
+    g = grads.astype(jnp.float32)
+    p = rows.astype(jnp.float32)
+    if opt == "sgd":
+        return p - lr * g, state
+    if opt == "adagrad":
+        acc = state["acc"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc) + eps), {"acc": acc}
+    if opt == "ftrl":
+        sq = state["sq"]
+        new_acc = sq + jnp.square(g)
+        sigma = (new_acc ** -lr_power - sq ** -lr_power) / lr
+        lin = state["lin"] + g - sigma * p
+        x = jnp.sign(lin) * l1 - lin
+        y = 2.0 * l2 + new_acc ** -lr_power / lr
+        new_p = jnp.where(jnp.abs(lin) > l1, x / y, 0.0)
+        return new_p, {"sq": new_acc, "lin": lin}
+    raise ValueError(f"device cache cannot run rule {opt!r}; "
+                     f"supported: {DEVICE_RULES}")
+
+
+class Resolution(NamedTuple):
+    """One step's id→slot resolution (shared across co-located tables)."""
+    uniq: np.ndarray          # [U] int64 ids
+    slots: np.ndarray         # [U] int64 cache slots
+    miss_idx: np.ndarray      # indices into uniq that were misses
+    victim_slots: np.ndarray  # slots being reused this step ([0] if none)
+    victim_ids: np.ndarray    # the ids formerly in those slots (≥0 only)
+
+
+class SlotDirectory:
+    """Host-side LRU id→slot map for a device cache of ``capacity`` rows.
+
+    Tables over the same id space share ONE directory (resolve once per
+    step); each table moves its own rows for the resolved miss/victim sets.
+    """
+
+    def __init__(self, capacity: int):
+        self.cap = int(capacity)
+        self._slot_of: Dict[int, int] = {}
+        self._slot_id = np.full(self.cap, -1, np.int64)
+        self._last_use = np.zeros(self.cap, np.int64)
+        self._n_used = 0
+        self._tick = 0
+        self._rng_evict = np.random.RandomState(0)   # sampled-LRU candidates
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def resolve(self, uniq: np.ndarray) -> Resolution:
+        """Assign every unique id a slot; evict the coldest non-batch slots
+        when full.  Call ONCE per step, before any cache fill.
+
+        If the step later fails before the miss rows reach the device
+        arena, call :meth:`rollback` with the returned Resolution — the
+        miss ids re-miss on retry instead of hitting never-filled slots.
+        """
+        self._tick += 1
+        uniq = np.asarray(uniq, np.int64).ravel()
+        get = self._slot_of.get
+        slots = np.fromiter((get(i, -1) for i in uniq.tolist()),
+                            np.int64, len(uniq))
+        miss_i = np.nonzero(slots < 0)[0]
+        n_miss = len(miss_i)
+        self.hits += len(uniq) - n_miss
+        self.misses += n_miss
+        # stamp hits NOW: anything at the current tick is batch-protected,
+        # which lets eviction test protection in O(1) per candidate
+        self._last_use[slots[slots >= 0]] = self._tick
+        victims = np.empty(0, np.int64)
+        victim_ids = np.empty(0, np.int64)
+        if n_miss:
+            new_slots, victims, victim_ids = self._allocate(n_miss)
+            for i, s in zip(miss_i.tolist(), new_slots.tolist()):
+                self._slot_of[int(uniq[i])] = s
+                self._slot_id[s] = uniq[i]
+            slots[miss_i] = new_slots
+        return Resolution(uniq, slots, miss_i, victims, victim_ids)
+
+    def rollback(self, res: Resolution):
+        """Undo a resolution whose miss rows never reached the arenas.
+
+        MUST be called before any arena scatter for this resolution (the
+        trainer fills every table, then scatters, so a fill failure leaves
+        all arenas untouched).  Miss ids are forgotten (they re-miss and
+        re-pull on retry) and the evicted victims are RE-INSTATED: their
+        arena rows are still intact, so tables whose writeback had not run
+        yet lose nothing — and for tables already written back, the cache
+        copy is identical to the host copy, consistent either way."""
+        for i in res.miss_idx.tolist():
+            rid = int(res.uniq[i])
+            s = self._slot_of.pop(rid, None)
+            if s is not None:
+                self._slot_id[s] = -1
+                self._last_use[s] = 0
+        for s, rid in zip(res.victim_slots.tolist(),
+                          res.victim_ids.tolist()):
+            self._slot_of[int(rid)] = s
+            self._slot_id[s] = rid
+            self._last_use[s] = self._tick - 1   # unprotected, still warm
+        # reclaim fresh slots handed to the rolled-back misses: fresh
+        # allocations are the arena tail, so retries reuse them instead of
+        # burning new slots on every failed attempt
+        while self._n_used > 0 and self._slot_id[self._n_used - 1] < 0:
+            self._n_used -= 1
+
+    def _allocate(self, k: int):
+        free = self.cap - self._n_used
+        take = min(k, free)
+        out = np.empty(k, np.int64)
+        victims = np.empty(0, np.int64)
+        victim_ids = np.empty(0, np.int64)
+        if take:
+            # fresh slots are handed out sequentially: the never-used region
+            # is exactly [_n_used, cap)
+            out[:take] = np.arange(self._n_used, self._n_used + take)
+            self._n_used += take
+            # stamp immediately: protected from this call's own eviction
+            self._last_use[out[:take]] = self._tick
+        if take < k:
+            reused = self._pick_victims(k - take)
+            ids_of = self._slot_id[reused].copy()
+            out[take:] = reused
+            self._last_use[reused] = self._tick
+            # writeback pair: only slots that still hold a live id (a slot
+            # rolled back or evicted earlier keeps id -1, no writeback)
+            ok = ids_of >= 0
+            victims, victim_ids = reused[ok], ids_of[ok]
+            for rid in victim_ids.tolist():
+                del self._slot_of[int(rid)]
+            self._slot_id[reused] = -1
+            self.evictions += int(ok.sum())
+        return out, victims, victim_ids
+
+    def _pick_victims(self, k: int) -> np.ndarray:
+        """k distinct unprotected slots (``_last_use < tick``), coldest
+        first.  Sampled eviction: steady-state misses must not pay an
+        O(capacity) scan per step (the full arena is 2^20 slots; a batch
+        evicts dozens), so try a bounded random sample first — the
+        sampled-LRU policy of production caches — and fall back to the
+        exact full scan only when the sample can't cover k."""
+        tick = self._tick
+        sample_n = max(4 * k, 4096)
+        if sample_n < self.cap:
+            cand = np.unique(self._rng_evict.randint(0, self.cap, sample_n))
+            cand = cand[self._last_use[cand] < tick]
+            if len(cand) >= k:
+                order = np.argpartition(self._last_use[cand], k - 1)[:k]
+                return cand[order].astype(np.int64)
+        cand = np.nonzero(self._last_use < tick)[0]
+        if len(cand) < k:
+            raise RuntimeError(
+                f"device-cache capacity {self.cap} cannot hold one batch's "
+                f"unique ids ({self.cap - len(cand) + k} needed); raise "
+                f"capacity above the per-batch unique-id count")
+        order = np.argpartition(self._last_use[cand], k - 1)[:k]
+        return cand[order].astype(np.int64)
+
+    def items(self):
+        """(ids [n], slots [n]) of everything currently cached."""
+        n = len(self._slot_of)
+        ids = np.fromiter(self._slot_of.keys(), np.int64, n)
+        slots = np.fromiter(self._slot_of.values(), np.int64, n)
+        return ids, slots
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+class DeviceEmbeddingCache:
+    """Per-table device arenas + host-table data movement over a (possibly
+    shared) SlotDirectory.  The device arrays are OWNED BY THE CALLER's
+    train step (pass them in, get updated ones back, donate for in-place
+    HBM reuse); this class fills misses and writes evictions back."""
+
+    def __init__(self, client, table_id: int, dim: int,
+                 capacity: int = 1 << 20, optimizer: str = "adagrad",
+                 lr: float = 0.05, eps: float = 1e-8, l1: float = 0.0,
+                 l2: float = 0.0, lr_power: float = -0.5,
+                 miss_bucket: int = 1024,
+                 directory: Optional[SlotDirectory] = None):
+        if optimizer not in DEVICE_RULES:
+            raise ValueError(
+                f"device cache rule {optimizer!r} not in {DEVICE_RULES}")
+        self.client = client
+        self.table_id = int(table_id)
+        self.dim = int(dim)
+        self.directory = directory if directory is not None \
+            else SlotDirectory(capacity)
+        self.cap = self.directory.cap
+        self.opt = optimizer
+        self.hyper = dict(lr=lr, eps=eps, l1=l1, l2=l2, lr_power=lr_power)
+        self.miss_bucket = int(miss_bucket)
+        self._state_names = _STATE_SPEC[optimizer]
+        # idempotent: no-op when the embedding layer already created it
+        client.create_table(self.table_id, "sparse", dim=dim,
+                            optimizer=optimizer, lr=lr, eps=eps, l1=l1,
+                            l2=l2, lr_power=lr_power)
+
+    # -- device arenas -------------------------------------------------------
+    def init_arenas(self):
+        """Fresh device arenas: [cap+1, dim] rows + per-rule state (+1 is
+        the scratch slot that absorbs padded scatter/gather traffic)."""
+        rows = jnp.zeros((self.cap + 1, self.dim), jnp.float32)
+        state = {k: jnp.zeros((self.cap + 1, self.dim), jnp.float32)
+                 for k in self._state_names}
+        return {"rows": rows, "state": state}
+
+    # -- per-step data movement ---------------------------------------------
+    def fill(self, res: Resolution, arenas):
+        """Move this table's rows for an already-resolved step: write the
+        victim rows back to the host table, pull the miss block.
+
+        Returns (miss_slots [M_pad] int32, miss_rows [M_pad, D] f32,
+        miss_state dict) or (None, None, None) when the step had no misses.
+        Padded entries of miss_slots point at the scratch slot (index cap).
+        """
+        if len(res.victim_slots):
+            self._writeback(res.victim_slots, res.victim_ids, arenas)
+        n_miss = len(res.miss_idx)
+        if not n_miss:
+            return None, None, None
+        rows, state = self.client.export_rows(self.table_id,
+                                              res.uniq[res.miss_idx])
+        m_pad = _pad_to_bucket(n_miss, self.miss_bucket)
+        miss_rows = np.zeros((m_pad, self.dim), np.float32)
+        miss_rows[:n_miss] = rows
+        miss_state = {}
+        for k in self._state_names:
+            buf = np.zeros((m_pad, self.dim), np.float32)
+            buf[:n_miss] = state[k]
+            miss_state[k] = buf
+        miss_slots = np.full(m_pad, self.cap, np.int64)     # scratch
+        miss_slots[:n_miss] = res.slots[res.miss_idx]
+        return miss_slots.astype(np.int32), miss_rows, miss_state
+
+    def prepare(self, uniq: np.ndarray, arenas=None):
+        """Single-table convenience: resolve + fill in one call.
+        Returns (slots [U] int32, miss_slots, miss_rows, miss_state)."""
+        res = self.directory.resolve(uniq)
+        if len(res.victim_slots) and arenas is None:
+            raise RuntimeError(
+                "cache full: prepare() needs the current device arenas to "
+                "write evicted rows back")
+        miss_slots, miss_rows, miss_state = self.fill(res, arenas)
+        return res.slots.astype(np.int32), miss_slots, miss_rows, miss_state
+
+    def _writeback(self, victim_slots, victim_ids, arenas):
+        if not len(victim_ids):
+            return
+        # one device gather + D2H for rows and state, then raw writeback
+        vic = jnp.asarray(victim_slots)
+        rows_back = np.asarray(arenas["rows"][vic])
+        state_back = {k: np.asarray(arenas["state"][k][vic])
+                      for k in self._state_names}
+        self.client.import_rows(self.table_id, victim_ids, rows_back,
+                                state_back)
+
+    def read_rows(self, uniq: np.ndarray, arenas) -> np.ndarray:
+        """Non-mutating read of CURRENT values: cached ids gather from the
+        device arena, cold ids pull from the host table.  No LRU update,
+        no slot allocation — the eval/serving read path while a trainer
+        owns the cache."""
+        uniq = np.asarray(uniq, np.int64).ravel()
+        get = self.directory._slot_of.get
+        slots = np.fromiter((get(i, -1) for i in uniq.tolist()),
+                            np.int64, len(uniq))
+        out = np.empty((len(uniq), self.dim), np.float32)
+        hit = slots >= 0
+        if hit.any():
+            out[hit] = np.asarray(arenas["rows"][jnp.asarray(slots[hit])])
+        cold = ~hit
+        if cold.any():
+            out[cold] = self.client.pull_sparse(self.table_id, uniq[cold])
+        return out
+
+    # -- barriers ------------------------------------------------------------
+    def writeback_all(self, arenas):
+        """Flush every cached row (+state) to the host table — PSGPU's
+        EndPass.  Call before eval/save/shutdown."""
+        ids, slots = self.directory.items()
+        if not len(ids):
+            return
+        sl = jnp.asarray(slots)
+        rows = np.asarray(arenas["rows"][sl])
+        state = {k: np.asarray(arenas["state"][k][sl])
+                 for k in self._state_names}
+        self.client.import_rows(self.table_id, ids, rows, state)
+
+    # directory passthroughs (back-compat for stats consumers)
+    @property
+    def hit_rate(self):
+        return self.directory.hit_rate
+
+    @property
+    def hits(self):
+        return self.directory.hits
+
+    @property
+    def misses(self):
+        return self.directory.misses
+
+    @property
+    def evictions(self):
+        return self.directory.evictions

@@ -35,19 +35,24 @@ class DistributedEmbedding(Layer):
         self.client = client
         self.table_id = int(table_id)
         self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.table_kw = dict(table_kw)
         client.create_table(self.table_id, "sparse", dim=dim,
                             optimizer=optimizer, lr=lr,
                             init_scale=init_scale, **table_kw)
         self._pending: List[Tuple[np.ndarray, Tensor]] = []
 
     def pull_padded_rows(self, uniq):
-        """Host pull + power-of-two padding. A stable [U_pad, D] shape
-        means the downstream XLA programs are compiled once, not per
-        distinct unique-id count (recompile-per-batch would dominate).
-        Shared by the eager forward and the fused PS trainers."""
+        """Host pull + quarter-octave padding (device_cache.pad_adaptive).
+        The [U_pad, D] shape feeds the fused train-step jit, so the grain
+        balances recompile count (≤8 shapes per doubling of U) against
+        wire-padding waste (≤25%, vs power-of-two's up-to-2×).  Shared by
+        the eager forward and the fused PS trainers."""
+        from .device_cache import pad_adaptive
         rows = self.client.pull_sparse(self.table_id, uniq)       # host
         n = len(uniq)
-        n_pad = max(8, 1 << (n - 1).bit_length())
+        n_pad = pad_adaptive(n)
         if n_pad != n:
             rows = np.concatenate(
                 [rows, np.zeros((n_pad - n, self.dim), np.float32)])
@@ -58,11 +63,30 @@ class DistributedEmbedding(Layer):
         ids_arr = ids._value if isinstance(ids, Tensor) else np.asarray(ids)
         ids_np = np.asarray(ids_arr)
         uniq, inv = np.unique(ids_np, return_inverse=True)
-        rows = self.pull_padded_rows(uniq)
-        w_rows = Tensor(jnp.asarray(rows), stop_gradient=False)   # leaf
-        w_rows.name = f"dist_emb_{self.table_id}_rows"
-        if self.training:
-            self._pending.append((uniq, w_rows))
+        reader = getattr(self, "_cache_read", None)
+        if reader is not None:
+            # a trainer-owned device cache holds the authoritative rows
+            # (host table stale until flush).  Eval reads through it; an
+            # eager TRAINING forward would fork the parameter state between
+            # the cache and the push path, so refuse loudly.
+            if self.training:
+                raise RuntimeError(
+                    "DistributedEmbedding is bound to a trainer's device "
+                    "cache; train through the trainer, or call .eval() "
+                    "for read-through inference")
+            from .device_cache import pad_adaptive
+            rows = reader(uniq)
+            n, n_pad = len(uniq), pad_adaptive(len(uniq))
+            if n_pad != n:
+                rows = np.concatenate(
+                    [rows, np.zeros((n_pad - n, self.dim), np.float32)])
+            w_rows = Tensor(jnp.asarray(rows), stop_gradient=True)
+        else:
+            rows = self.pull_padded_rows(uniq)
+            w_rows = Tensor(jnp.asarray(rows), stop_gradient=False)  # leaf
+            w_rows.name = f"dist_emb_{self.table_id}_rows"
+            if self.training:
+                self._pending.append((uniq, w_rows))
         inv_t = Tensor(jnp.asarray(inv.reshape(ids_np.shape), jnp.int32))
         return F.embedding(inv_t, w_rows)                          # device
 

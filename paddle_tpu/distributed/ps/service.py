@@ -23,6 +23,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from .table import SparseTable, DenseTable
+from .codec import encode_rows, decode_rows
 
 _LEN = struct.Struct("!Q")
 
@@ -181,9 +182,25 @@ class PsServer:
                 return {"ok": True}
             table = self._tables.get(msg.get("table_id"))
             if op == "pull_sparse":
-                return {"ok": True, "values": table.pull(msg["ids"])}
+                # codec'd reply when the client asks (DCN row compression)
+                vals = table.pull(msg["ids"])
+                return {"ok": True,
+                        "values": encode_rows(vals, msg.get("codec", "none"))}
             if op == "push_sparse":
-                table.push(msg["ids"], msg["grads"])
+                table.push(msg["ids"], decode_rows(msg["grads"]))
+                return {"ok": True}
+            if op == "export_rows":
+                # ALWAYS full precision: exported rows+state become the
+                # cache's master copy (lossy codecs are for gradient pushes
+                # and read-only pulls; quantizing an adagrad accumulator to
+                # 0 would blow the on-chip update to lr*g*1e8)
+                rows, state = table.export_rows(msg["ids"])
+                return {"ok": True, "rows": rows, "state": state}
+            if op == "import_rows":
+                table.import_rows(
+                    msg["ids"], decode_rows(msg["rows"]),
+                    {k: decode_rows(v)
+                     for k, v in (msg.get("state") or {}).items()})
                 return {"ok": True}
             if op == "pull_dense":
                 return {"ok": True, "values": table.pull()}
@@ -218,8 +235,12 @@ class PsClient:
     the async aggregation threads of communicator.h:195 are unnecessary
     here because pushes batch per train step already)."""
 
-    def __init__(self, endpoint: str):
+    def __init__(self, endpoint: str, compress: str = "none"):
+        from .codec import MODES
+        if compress not in MODES:
+            raise ValueError(f"compress must be one of {MODES}")
         self._endpoint = endpoint
+        self._codec = compress
         host, port = endpoint.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)), timeout=60)
         self._lock = threading.Lock()
@@ -286,12 +307,33 @@ class PsClient:
                    config=config)
 
     def pull_sparse(self, table_id: int, ids) -> np.ndarray:
-        return self._call(op="pull_sparse", table_id=table_id,
-                          ids=np.asarray(ids))["values"]
+        return decode_rows(self._call(op="pull_sparse", table_id=table_id,
+                                      ids=np.asarray(ids),
+                                      codec=self._codec)["values"])
 
     def push_sparse(self, table_id: int, ids, grads):
-        self._call(op="push_sparse", table_id=table_id,
-                   ids=np.asarray(ids), grads=np.asarray(grads))
+        ids = np.asarray(ids)
+        if ids.size == 0:
+            return
+        self._call(op="push_sparse", table_id=table_id, ids=ids,
+                   grads=encode_rows(np.asarray(grads, np.float32)
+                                     .reshape(ids.size, -1), self._codec))
+
+    def export_rows(self, table_id: int, ids):
+        """(rows, state) pull-with-state for accelerator row caches.
+        Always full precision — see the server-side rationale."""
+        out = self._call(op="export_rows", table_id=table_id,
+                         ids=np.asarray(ids))
+        return np.asarray(out["rows"]), {k: np.asarray(v)
+                                         for k, v in out["state"].items()}
+
+    def import_rows(self, table_id: int, ids, rows, state=None):
+        """Raw writeback of optimized rows (+ state) — cache eviction.
+        Always full precision: these are the master values, not deltas."""
+        self._call(op="import_rows", table_id=table_id, ids=np.asarray(ids),
+                   rows=np.asarray(rows, np.float32),
+                   state={k: np.asarray(v, np.float32)
+                          for k, v in (state or {}).items()})
 
     def pull_dense(self, table_id: int) -> np.ndarray:
         return self._call(op="pull_dense", table_id=table_id)["values"]
@@ -340,6 +382,14 @@ class LocalPsEndpoint:
     def push_sparse(self, table_id, ids, grads):
         with self._lock:
             self._tables[table_id].push(np.asarray(ids), np.asarray(grads))
+
+    def export_rows(self, table_id, ids):
+        with self._lock:
+            return self._tables[table_id].export_rows(np.asarray(ids))
+
+    def import_rows(self, table_id, ids, rows, state=None):
+        with self._lock:
+            self._tables[table_id].import_rows(np.asarray(ids), rows, state)
 
     def pull_dense(self, table_id):
         return self._tables[table_id].pull()

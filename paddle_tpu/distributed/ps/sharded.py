@@ -29,10 +29,10 @@ class ShardedPsClient:
     """Fan-out client over N PsServers with id-hash routing; same interface
     as PsClient so trainers are shard-agnostic."""
 
-    def __init__(self, endpoints: List[str]):
+    def __init__(self, endpoints: List[str], compress: str = "none"):
         if not endpoints:
             raise ValueError("ShardedPsClient needs at least one endpoint")
-        self._clients = [PsClient(ep) for ep in endpoints]
+        self._clients = [PsClient(ep, compress=compress) for ep in endpoints]
         self.n = len(self._clients)
         self._dims: Dict[int, int] = {}
 
@@ -123,6 +123,57 @@ class ShardedPsClient:
             return go
 
         self._run_sharded([push_one(s) for s in range(self.n)])
+
+    def export_rows(self, table_id: int, ids):
+        """Shard-routed pull-with-state (accelerator row-cache fill)."""
+        ids = np.asarray(ids).reshape(-1)
+        if ids.size == 0:
+            return (np.zeros((0, self._dims.get(table_id, 0)), np.float32),
+                    {})
+        shard = (ids % self.n).astype(np.int64)
+        rows_parts: Dict[int, tuple] = {}
+
+        def export_one(s):
+            def go():
+                sel = np.nonzero(shard == s)[0]
+                if sel.size:
+                    rows_parts[s] = (sel, self._clients[s].export_rows(
+                        table_id, ids[sel] // self.n))
+            return go
+
+        self._run_sharded([export_one(s) for s in range(self.n)])
+        rows = None
+        state: Dict[str, np.ndarray] = {}
+        for s, (sel, (r, st)) in rows_parts.items():
+            if rows is None:
+                # size from the first returned part (a fresh client attached
+                # to running pservers has no _dims entry)
+                rows = np.empty((ids.size,) + r.shape[1:], np.float32)
+            rows[sel] = r
+            for k, v in st.items():
+                if k not in state:
+                    state[k] = np.empty((ids.size,) + v.shape[1:],
+                                        np.float32)
+                state[k][sel] = v
+        return rows, state
+
+    def import_rows(self, table_id: int, ids, rows, state=None):
+        """Shard-routed raw writeback (cache eviction)."""
+        ids = np.asarray(ids).reshape(-1)
+        rows = np.asarray(rows, np.float32)
+        shard = (ids % self.n).astype(np.int64)
+        state = state or {}
+
+        def import_one(s):
+            def go():
+                sel = np.nonzero(shard == s)[0]
+                if sel.size:
+                    self._clients[s].import_rows(
+                        table_id, ids[sel] // self.n, rows[sel],
+                        {k: np.asarray(v)[sel] for k, v in state.items()})
+            return go
+
+        self._run_sharded([import_one(s) for s in range(self.n)])
 
     def pull_dense(self, table_id: int) -> np.ndarray:
         return self._clients[table_id % self.n].pull_dense(table_id)

@@ -50,7 +50,8 @@ class SparseTable:
 
     def __init__(self, dim: int, optimizer: str = "sgd", lr: float = 0.01,
                  initializer: str = "uniform", init_scale: float = 0.01,
-                 beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 eps: Optional[float] = None,
                  l1: float = 0.0, l2: float = 0.0, lr_power: float = -0.5,
                  decay: float = 0.95, clip: float = 10.0, sigma: float = 1.0,
                  batch_size: float = 16.0, seed: int = 0):
@@ -59,9 +60,11 @@ class SparseTable:
         self.dim = int(dim)
         self.opt = optimizer
         self.lr = float(lr)
-        if optimizer == "decayed_adagrad" and eps == 1e-8:
-            eps = 1e-6   # match the dense DecayedAdagrad / reference default
-        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        if eps is None:
+            # per-rule defaults matching the dense optimizer classes
+            # (DecayedAdagrad epsilon=1e-6; the adam/adagrad family 1e-8)
+            eps = 1e-6 if optimizer == "decayed_adagrad" else 1e-8
+        self.beta1, self.beta2, self.eps = beta1, beta2, float(eps)
         self.l1, self.l2, self.lr_power = float(l1), float(l2), float(lr_power)
         self.decay = float(decay)
         self.clip, self.sigma, self.batch_size = (float(clip), float(sigma),

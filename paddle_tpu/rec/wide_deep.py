@@ -64,20 +64,42 @@ class WideDeep(nn.Layer):
 
 
 class WideDeepTrainer:
-    """pull → ONE-JIT dense fwd/bwd/Adam → push (the PS train loop that
-    the reference's Communicator+DeviceWorker pair runs, communicator.h:195).
+    """The PS CTR train loop at two service levels:
 
-    The whole dense side — wide sum, MLP, BCE loss, backward, Adam update,
-    and the gradients w.r.t. the pulled embedding rows — is a single
-    compiled XLA program per step: three host↔device transfers total
-    (pulled rows in, row grads out, loss out) instead of per-op eager
-    dispatch, which is the difference between latency-bound and
-    compute-bound on a remote chip."""
+    **device-cache mode** (default when the sparse rule runs on-chip and the
+    client supports export/import_rows): the HeterPS/PSGPU design
+    (framework/fleet/ps_gpu_wrapper.h, trainer.h:281 PSGPUTrainer) — hot
+    embedding rows and their optimizer state live in device HBM arenas
+    (DeviceEmbeddingCache); per step the host ships only batch INDICES plus
+    the miss block, and one jitted XLA program gathers rows, runs dense
+    fwd/bwd/Adam, and applies the sparse rule on-chip.  Steady state moves
+    zero row bytes over the wire, and ``step_async`` keeps the device queue
+    full (host prepares batch N+1 while the chip runs batch N).
+
+    **pull/push mode** (fallback; ``device_cache=False`` or a table rule
+    the chip can't run): pull → ONE-JIT dense fwd/bwd/Adam → push, the
+    Communicator+DeviceWorker loop (communicator.h:195) with three
+    host↔device transfers per step.
+
+    Cache-mode contracts:
+    - Host tables hold stale rows until ``flush()`` (PSGPU EndPass
+      semantics); eager ``model(...)`` eval stays correct anyway — the
+      embeddings read THROUGH the cache while one is bound.
+    - ``feature_wire_dtype`` ("bfloat16" default) is the H2D dtype for
+      dense features.  bf16 halves the hot-path wire bytes and is
+      standard for normalized CTR features; pass "float32" to keep
+      bit-identical numerics with pull/push mode.  Labels always travel
+      f32."""
 
     def __init__(self, model: WideDeep, lr: float = 1e-3,
-                 async_push: bool = False):
+                 async_push: bool = False, device_cache: bool = None,
+                 cache_capacity: int = 1 << 20,
+                 feature_wire_dtype="bfloat16"):
         import jax
         from ..framework import functional as F
+        from ..distributed.ps.device_cache import (
+            DeviceEmbeddingCache, SlotDirectory, DEVICE_RULES,
+            apply_rule_device, pad_adaptive)
         self.model = model
         self.lr = float(lr)
         # a_sync communicator parity (communicator.h AsyncCommunicator):
@@ -131,20 +153,13 @@ class WideDeepTrainer:
         b1, b2, eps = 0.9, 0.999, 1e-8
         lr_ = self.lr
 
-        def fused(params, adam, wide_rows, deep_rows, wide_inv, deep_inv,
-                  dense_x, labels):
-            def loss_of(p, wr, dr):
-                out = apply(p, buffers, wr, dr, wide_inv, deep_inv,
-                            dense_x)
-                x = out[0] if isinstance(out, tuple) else out
-                # BCE-with-logits, numerically stable
-                l = jnp.maximum(x, 0) - x * labels + \
-                    jnp.log1p(jnp.exp(-jnp.abs(x)))
-                return jnp.mean(l)
+        def bce_mean(x, labels):
+            # BCE-with-logits, numerically stable
+            l = jnp.maximum(x, 0) - x * labels + \
+                jnp.log1p(jnp.exp(-jnp.abs(x)))
+            return jnp.mean(l)
 
-            (loss), grads = jax.value_and_grad(loss_of, argnums=(0, 1, 2))(
-                params, wide_rows, deep_rows)
-            gp, gw, gd = grads
+        def adam_update(params, adam, gp):
             t = adam["t"] + 1
             tf = t.astype(jnp.float32)
             corr = jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
@@ -153,9 +168,106 @@ class WideDeepTrainer:
                      for k in gp}
             new_p = {k: params[k] - lr_ * corr * new_m[k] /
                      (jnp.sqrt(new_v[k]) + eps) for k in gp}
-            return new_p, {"m": new_m, "v": new_v, "t": t}, loss, gw, gd
+            return new_p, {"m": new_m, "v": new_v, "t": t}
+
+        def fused(params, adam, wide_rows, deep_rows, wide_inv, deep_inv,
+                  dense_x, labels):
+            def loss_of(p, wr, dr):
+                out = apply(p, buffers, wr, dr, wide_inv, deep_inv,
+                            dense_x)
+                x = out[0] if isinstance(out, tuple) else out
+                return bce_mean(x, labels)
+
+            (loss), grads = jax.value_and_grad(loss_of, argnums=(0, 1, 2))(
+                params, wide_rows, deep_rows)
+            gp, gw, gd = grads
+            new_p, new_adam = adam_update(params, adam, gp)
+            return new_p, new_adam, loss, gw, gd
 
         self._fused = jax.jit(fused)
+
+        # -- device-cache mode (HeterPS/PSGPU) -------------------------------
+        we, de = model.wide_emb, model.deep_emb
+        can_cache = (we.optimizer in DEVICE_RULES and
+                     hasattr(model.client, "export_rows"))
+        if device_cache is None:
+            # async_push explicitly asks for the a_sync pull/push contract
+            # (host tables at most one step stale) — honor it over the cache
+            device_cache = can_cache and not self._async_push
+        elif device_cache and not can_cache:
+            raise ValueError(
+                f"device_cache: rule {we.optimizer!r} must be in "
+                f"{DEVICE_RULES} and the client needs export/import_rows")
+        elif device_cache and self._async_push:
+            raise ValueError(
+                "device_cache and async_push are mutually exclusive: the "
+                "cache applies sparse updates on-chip (no pushes to drain) "
+                "and host tables stay stale until flush()")
+        self._use_cache = bool(device_cache)
+        if self._use_cache:
+            self._pad_adaptive = pad_adaptive
+            self._feature_wire_dtype = (
+                jnp.bfloat16 if str(feature_wire_dtype) in
+                ("bfloat16", "bf16") else np.float32)
+            # ONE slot directory: both tables share the id space, so ids
+            # resolve to slots once per step
+            self._slot_dir = SlotDirectory(cache_capacity)
+
+            def mk_cache(emb):
+                kw = {k: v for k, v in emb.table_kw.items()
+                      if k in ("eps", "l1", "l2", "lr_power")}
+                return DeviceEmbeddingCache(
+                    model.client, emb.table_id, emb.dim,
+                    optimizer=emb.optimizer, lr=emb.lr,
+                    directory=self._slot_dir, **kw)
+            self._w_cache, self._d_cache = mk_cache(we), mk_cache(de)
+            self._w_ar = self._w_cache.init_arenas()
+            self._d_ar = self._d_cache.init_arenas()
+            # eager eval reads THROUGH the cache (host tables are stale
+            # until flush — PSGPU EndPass semantics)
+            we._cache_read = lambda u: self._w_cache.read_rows(u, self._w_ar)
+            de._cache_read = lambda u: self._d_cache.read_rows(u, self._d_ar)
+
+            def scatter_miss(ar, slots, rows, state):
+                return {"rows": ar["rows"].at[slots].set(rows),
+                        "state": {k: ar["state"][k].at[slots].set(state[k])
+                                  for k in ar["state"]}}
+            self._scatter = jax.jit(scatter_miss, donate_argnums=(0,))
+
+            opt_name = we.optimizer
+            hy_w, hy_d = self._w_cache.hyper, self._d_cache.hyper
+
+            def rule_and_scatter(ar, slots, rows, grads, hyper):
+                st = {k: ar["state"][k][slots] for k in ar["state"]}
+                new_rows, new_st = apply_rule_device(
+                    opt_name, rows, st, grads, **hyper)
+                return {"rows": ar["rows"].at[slots].set(new_rows),
+                        "state": {k: ar["state"][k].at[slots].set(new_st[k])
+                                  for k in ar["state"]}}
+
+            def fused_cached(params, adam, w_ar, d_ar, slots_w, slots_d,
+                             inv, dense_x, labels):
+                inv32 = inv.astype(jnp.int32)
+                dense32 = dense_x.astype(jnp.float32)
+                lab32 = labels.astype(jnp.float32)
+                w_rows = w_ar["rows"][slots_w]
+                d_rows = d_ar["rows"][slots_d]
+
+                def loss_of(p, wr, dr):
+                    out = apply(p, buffers, wr, dr, inv32, inv32, dense32)
+                    x = out[0] if isinstance(out, tuple) else out
+                    return bce_mean(x, lab32)
+
+                (loss), grads = jax.value_and_grad(
+                    loss_of, argnums=(0, 1, 2))(params, w_rows, d_rows)
+                gp, gw, gd = grads
+                new_p, new_adam = adam_update(params, adam, gp)
+                w_ar = rule_and_scatter(w_ar, slots_w, w_rows, gw, hy_w)
+                d_ar = rule_and_scatter(d_ar, slots_d, d_rows, gd, hy_d)
+                return new_p, new_adam, w_ar, d_ar, loss
+
+            self._fused_cached = jax.jit(fused_cached,
+                                         donate_argnums=(0, 1, 2, 3))
 
     def _raise_push_errors(self):
         if self._push_err:
@@ -185,6 +297,63 @@ class WideDeepTrainer:
             pass
 
     def step(self, sparse_ids, dense_x, labels) -> float:
+        return float(self.step_async(sparse_ids, dense_x, labels))
+
+    def step_async(self, sparse_ids, dense_x, labels):
+        """One train step WITHOUT fencing on the loss: returns the device
+        scalar so the host can prepare batch N+1 while the chip runs batch
+        N (jax async dispatch is the pipeline).  Fence with float(loss) or
+        flush()."""
+        if self._use_cache:
+            return self._step_cached(sparse_ids, dense_x, labels)
+        return self._step_pullpush(sparse_ids, dense_x, labels)
+
+    def _step_cached(self, sparse_ids, dense_x, labels):
+        ids = np.asarray(sparse_ids)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        # ONE id→slot resolution for both tables, then per-table row moves.
+        # A failure before the miss rows land in BOTH arenas rolls the
+        # resolution back, so a retried step re-misses instead of hitting
+        # never-filled slots (the victims fill() already wrote back stay in
+        # the host table — consistent either way).
+        res = self._slot_dir.resolve(uniq)
+        try:
+            mw_slots, mw_rows, mw_state = self._w_cache.fill(res, self._w_ar)
+            md_slots, md_rows, md_state = self._d_cache.fill(res, self._d_ar)
+        except Exception:
+            # rollback is only valid pre-scatter (arenas untouched); a
+            # fill failure is cleanly retryable
+            self._slot_dir.rollback(res)
+            raise
+        if mw_slots is not None:
+            self._w_ar = self._scatter(
+                self._w_ar, jnp.asarray(mw_slots), jnp.asarray(mw_rows),
+                {k: jnp.asarray(v) for k, v in mw_state.items()})
+        if md_slots is not None:
+            self._d_ar = self._scatter(
+                self._d_ar, jnp.asarray(md_slots), jnp.asarray(md_rows),
+                {k: jnp.asarray(v) for k, v in md_state.items()})
+        # eighth-octave-pad the slot vector (≤8 compiled shapes per
+        # doubling of U); padding points at the scratch slot
+        u = len(uniq)
+        u_pad = self._pad_adaptive(u)
+        slots_p = np.full(u_pad, self._slot_dir.cap, np.int32)
+        slots_p[:u] = res.slots
+        # wire compression: indices uint16 when they fit, features bf16
+        inv_w = inv.reshape(ids.shape)
+        inv_w = inv_w.astype(np.uint16 if u_pad <= 65536 else np.int32)
+        dense_w = np.asarray(dense_x, self._feature_wire_dtype)
+        lab_w = np.asarray(labels, np.float32)
+        slots_dev = jnp.asarray(slots_p)
+        self._params, self._adam, self._w_ar, self._d_ar, loss = \
+            self._fused_cached(self._params, self._adam, self._w_ar,
+                               self._d_ar, slots_dev, slots_dev,
+                               jnp.asarray(inv_w), jnp.asarray(dense_w),
+                               jnp.asarray(lab_w))
+        self.sync_params()
+        return loss
+
+    def _step_pullpush(self, sparse_ids, dense_x, labels):
         if self._async_push:
             # surface background push failures BEFORE advancing dense
             # state for this batch
@@ -204,10 +373,15 @@ class WideDeepTrainer:
         # device arrays is a pointer swap (no transfer), so eval /
         # state_dict always see the trained weights
         self.sync_params()
-        return float(loss)
+        return loss
 
     def flush(self):
-        """Drain pending async pushes (barrier before eval/save)."""
+        """Barrier before eval/save: drain pending async pushes, or in
+        device-cache mode write every cached row back to the host table
+        (PSGPU EndPass)."""
+        if self._use_cache:
+            self._w_cache.writeback_all(self._w_ar)
+            self._d_cache.writeback_all(self._d_ar)
         if self._push_queue is not None:
             self._push_queue.join()
         self._raise_push_errors()

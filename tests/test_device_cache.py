@@ -1,0 +1,351 @@
+"""Device hot-row cache (HeterPS/PSGPU parity) + PS wire codecs.
+
+Covers: SlotDirectory LRU resolution (shared across tables), eviction
+writeback exactness (tiny-cache vs huge-cache bitwise-equal trajectories),
+the undersized-capacity error, codec roundtrips incl. NaN/Inf edges, and
+the cached Wide&Deep trainer against a real subprocess PsServer.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import (
+    SparseTable, LocalPsEndpoint, DeviceEmbeddingCache)
+from paddle_tpu.distributed.ps.device_cache import SlotDirectory
+from paddle_tpu.distributed.ps.codec import encode_rows, decode_rows
+from paddle_tpu.rec.wide_deep import (WideDeep, WideDeepTrainer,
+                                      synthetic_ctr_batch)
+
+
+# -- SlotDirectory -----------------------------------------------------------
+
+def test_slot_directory_hits_and_misses():
+    d = SlotDirectory(capacity=16)
+    r1 = d.resolve(np.array([5, 9, 11]))
+    assert len(r1.miss_idx) == 3 and d.misses == 3
+    r2 = d.resolve(np.array([5, 9, 20]))
+    assert len(r2.miss_idx) == 1 and d.hits == 2
+    # same id resolves to the same slot across steps
+    assert r2.slots[0] == r1.slots[0] and r2.slots[1] == r1.slots[1]
+
+
+def test_slot_directory_eviction_protects_current_batch():
+    d = SlotDirectory(capacity=4)
+    d.resolve(np.array([1, 2, 3, 4]))
+    r = d.resolve(np.array([1, 5]))          # must evict a NON-batch id
+    assert 1 not in r.victim_ids
+    assert len(r.victim_ids) == 1
+    # the evicted id re-misses later; the kept id still hits
+    r3 = d.resolve(np.array([int(r.victim_ids[0]), 1]))
+    assert len(r3.miss_idx) == 1
+
+
+def test_slot_directory_raises_when_batch_exceeds_capacity():
+    d = SlotDirectory(capacity=4)
+    d.resolve(np.array([1, 2, 3, 4]))
+    with pytest.raises(RuntimeError, match="capacity"):
+        d.resolve(np.array([10, 11, 12, 13, 14]))
+
+
+def test_victims_align_with_ids_after_prior_evictions():
+    """A slot whose id was evicted earlier holds -1; re-using it must not
+    misalign the (victim_slots, victim_ids) writeback pair."""
+    d = SlotDirectory(capacity=3)
+    d.resolve(np.array([1, 2, 3]))
+    r1 = d.resolve(np.array([4]))            # evicts one of 1/2/3
+    assert len(r1.victim_ids) == 1
+    for r in (d.resolve(np.array([5])), d.resolve(np.array([6]))):
+        assert len(r.victim_slots) == len(r.victim_ids)
+        assert (r.victim_ids >= 0).all()
+
+
+# -- cache fill / writeback over a host table --------------------------------
+
+def _drive_cache(cap, steps=6, opt="adagrad"):
+    client = LocalPsEndpoint()
+    cache = DeviceEmbeddingCache(client, table_id=0, dim=4, capacity=cap,
+                                 optimizer=opt, lr=0.1)
+    arenas = cache.init_arenas()
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.ps.device_cache import apply_rule_device
+    rng = np.random.RandomState(0)
+    for step in range(steps):
+        ids = rng.choice(200, size=30, replace=False)
+        uniq = np.unique(ids)
+        slots, m_slots, m_rows, m_state = cache.prepare(uniq, arenas)
+        if m_slots is not None:
+            arenas = {"rows": arenas["rows"].at[jnp.asarray(m_slots)].set(
+                          jnp.asarray(m_rows)),
+                      "state": {k: arenas["state"][k].at[
+                          jnp.asarray(m_slots)].set(jnp.asarray(v))
+                          for k, v in m_state.items()}}
+        sl = jnp.asarray(slots.astype(np.int32))
+        rows = arenas["rows"][sl]
+        st = {k: arenas["state"][k][sl] for k in arenas["state"]}
+        g = jnp.asarray(rng.standard_normal((len(uniq), 4)),
+                        jnp.float32)
+        new_rows, new_st = apply_rule_device(opt, rows, st, g,
+                                             **cache.hyper)
+        arenas = {"rows": arenas["rows"].at[sl].set(new_rows),
+                  "state": {k: arenas["state"][k].at[sl].set(new_st[k])
+                            for k in arenas["state"]}}
+    cache.writeback_all(arenas)
+    final = client.pull_sparse(0, np.arange(200))
+    return final, cache
+
+
+def test_cache_eviction_roundtrip_is_exact():
+    """Tiny cache (forced evictions) and huge cache produce IDENTICAL final
+    table contents: eviction writeback + re-pull loses nothing."""
+    a, ca = _drive_cache(cap=48)
+    b, cb = _drive_cache(cap=4096)
+    assert ca.evictions > 0 and cb.evictions == 0
+    np.testing.assert_array_equal(a, b)
+
+
+def test_cache_ftrl_rule_matches_host_table():
+    """Rows trained on-device under ftrl then written back equal rows
+    trained host-side by SparseTable with the same grads."""
+    a, _ = _drive_cache(cap=4096, opt="ftrl")
+    t = SparseTable(dim=4, optimizer="ftrl", lr=0.1, initializer="uniform",
+                    seed=0)
+    rng = np.random.RandomState(0)
+    for step in range(6):
+        ids = rng.choice(200, size=30, replace=False)
+        uniq = np.unique(ids)
+        t.pull(uniq)
+        g = rng.standard_normal((len(uniq), 4)).astype(np.float32)
+        t.push(uniq, g)
+    b = t.pull(np.arange(200))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+# -- trainer integration ------------------------------------------------------
+
+def test_cached_trainer_matches_uncached_bitwise():
+    def run(cap):
+        paddle.seed(42)
+        m = WideDeep(hidden=(32,), emb_dim=4)
+        t = WideDeepTrainer(m, device_cache=True, cache_capacity=cap)
+        out = []
+        for seed in range(6):
+            ids, dense, label = synthetic_ctr_batch(
+                128, vocab=200_000, seed=seed)
+            out.append(t.step(ids, dense, label))
+        t.flush()
+        return out, t
+
+    a, ta = run(2048)        # cross-step evictions
+    b, tb = run(1 << 18)     # everything cached
+    assert ta._d_cache.evictions > 0
+    np.testing.assert_array_equal(a, b)
+
+
+def test_cached_trainer_flush_syncs_host_table():
+    paddle.seed(0)
+    m = WideDeep(hidden=(16,), emb_dim=4)
+    t = WideDeepTrainer(m)
+    assert t._use_cache
+    ids, dense, label = synthetic_ctr_batch(64, vocab=5_000, seed=0)
+    t.step(ids, dense, label)
+    uniq = np.unique(ids)
+    before = m.client.pull_sparse(1, uniq).copy()
+    t.step(ids, dense, label)
+    t.flush()
+    after = m.client.pull_sparse(1, uniq)
+    assert not np.allclose(before, after)
+
+
+def test_async_push_keeps_pullpush_contract():
+    paddle.seed(0)
+    m = WideDeep(hidden=(16,), emb_dim=4)
+    t = WideDeepTrainer(m, async_push=True)
+    assert not t._use_cache          # a_sync asked for pull/push semantics
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        WideDeepTrainer(WideDeep(), async_push=True, device_cache=True)
+
+
+# -- codecs -------------------------------------------------------------------
+
+def test_codec_bf16_roundtrip_and_edges():
+    x = np.array([[1.5, -2.25, np.nan, np.inf, -np.inf, 0.0, -0.0,
+                   1e-40, -1e30]], np.float32)
+    d = decode_rows(encode_rows(x, "bf16"))
+    assert np.isnan(d[0, 2])
+    assert d[0, 3] == np.inf and d[0, 4] == -np.inf
+    assert d[0, 0] == 1.5 and d[0, 1] == -2.25
+    # negative NaN must stay NaN (uint32 carry-wrap regression)
+    neg_nan = np.frombuffer(np.uint32(0xFFFFFFFF).tobytes(),
+                            np.float32).reshape(1, 1)
+    assert np.isnan(decode_rows(encode_rows(neg_nan, "bf16"))[0, 0])
+    r = np.random.RandomState(0).standard_normal((500, 8)).astype(np.float32)
+    rt = decode_rows(encode_rows(r, "bf16"))
+    rel = np.abs(rt - r) / np.maximum(np.abs(r), 1e-9)
+    assert rel.max() < 1 / 128
+
+
+def test_codec_int8_roundtrip():
+    r = np.random.RandomState(1).standard_normal((100, 16)).astype(np.float32)
+    rt = decode_rows(encode_rows(r, "int8"))
+    # per-row error bounded by scale/2 = maxabs/254
+    err = np.abs(rt - r)
+    bound = np.abs(r).max(axis=1, keepdims=True) / 254 + 1e-8
+    assert (err <= bound).all()
+    z = decode_rows(encode_rows(np.zeros((3, 4), np.float32), "int8"))
+    assert (z == 0).all()
+
+
+def test_rpc_compressed_pull_push(tmp_path):
+    """bf16-compressed worker↔pserver hop trains to the same place
+    (approximately) as uncompressed."""
+    from paddle_tpu.distributed.ps import PsServer, PsClient
+    s = PsServer(port=0).start()
+    try:
+        c = PsClient(s.endpoint, compress="bf16")
+        c.create_table(0, "sparse", dim=4, optimizer="sgd", lr=1.0,
+                       initializer="zeros")
+        ids = np.arange(10)
+        c.pull_sparse(0, ids)
+        c.push_sparse(0, ids, np.full((10, 4), 0.5, np.float32))
+        rows = c.pull_sparse(0, ids)
+        np.testing.assert_allclose(rows, -0.5, rtol=1e-2)
+        # export/import must be exact despite the client codec
+        rows2, state = c.export_rows(0, ids)
+        np.testing.assert_array_equal(rows2, rows)
+        c.import_rows(0, ids, rows2 * 2.0, state)
+        np.testing.assert_allclose(c.pull_sparse(0, ids), -1.0, rtol=1e-2)
+    finally:
+        s.stop()
+
+
+def test_resolution_rollback_re_misses():
+    """A failed fill must not leave miss ids mapped to never-filled slots."""
+    d = SlotDirectory(capacity=8)
+    d.resolve(np.array([1, 2]))
+    res = d.resolve(np.array([3, 4]))
+    d.rollback(res)
+    r = d.resolve(np.array([3, 4, 1]))
+    assert len(r.miss_idx) == 2        # 3, 4 re-miss; 1 still hits
+    assert d.resolve(np.array([3])).miss_idx.size == 0
+
+
+def test_failed_fill_rolls_back_trainer_step(monkeypatch):
+    """export_rows dying mid-step leaves the cache retryable, not
+    poisoned: the retry re-pulls and trains on real rows."""
+    paddle.seed(3)
+    m = WideDeep(hidden=(16,), emb_dim=4)
+    t = WideDeepTrainer(m)
+    ids, dense, label = synthetic_ctr_batch(64, vocab=5_000, seed=0)
+    t.step(ids, dense, label)
+    ids2, dense2, label2 = synthetic_ctr_batch(64, vocab=5_000, seed=1)
+    real_export = m.client.export_rows
+    calls = {"n": 0}
+
+    def flaky(table_id, ids_):
+        calls["n"] += 1
+        if calls["n"] == 2:            # the DEEP table's fill dies
+            raise RuntimeError("transient pserver failure")
+        return real_export(table_id, ids_)
+
+    monkeypatch.setattr(m.client, "export_rows", flaky)
+    with pytest.raises(RuntimeError, match="transient"):
+        t.step(ids2, dense2, label2)
+    monkeypatch.setattr(m.client, "export_rows", real_export)
+    loss = t.step(ids2, dense2, label2)     # retry succeeds
+    assert np.isfinite(loss)
+    # the retried step re-pulled: those ids were re-missed, not fake-hit
+    t.flush()
+    rows = m.client.pull_sparse(1, np.unique(ids2))
+    assert np.isfinite(rows).all()
+
+
+def test_sparse_table_explicit_eps_honored():
+    t = SparseTable(dim=2, optimizer="decayed_adagrad", eps=1e-8)
+    assert t.eps == 1e-8
+    t2 = SparseTable(dim=2, optimizer="decayed_adagrad")
+    assert t2.eps == 1e-6
+    t3 = SparseTable(dim=2, optimizer="adagrad")
+    assert t3.eps == 1e-8
+
+
+def test_ps_client_empty_push_is_noop():
+    from paddle_tpu.distributed.ps import PsServer, PsClient
+    s = PsServer(port=0).start()
+    try:
+        c = PsClient(s.endpoint)
+        c.create_table(0, "sparse", dim=4, optimizer="sgd")
+        c.push_sparse(0, np.array([], np.int64),
+                      np.zeros((0, 4), np.float32))
+        assert c.table_size(0) == 0
+    finally:
+        s.stop()
+
+
+def test_rollback_reinstates_victims():
+    """A failed evicting step must not lose the victims of tables whose
+    writeback had not run: rollback re-instates them in the cache (arena
+    rows are untouched pre-scatter), so nothing reverts to stale values."""
+    d = SlotDirectory(capacity=4)
+    d.resolve(np.array([1, 2, 3, 4]))
+    res = d.resolve(np.array([9]))           # evicts one victim
+    assert len(res.victim_ids) == 1
+    vid = int(res.victim_ids[0])
+    d.rollback(res)
+    r = d.resolve(np.array([vid]))           # the victim is STILL cached
+    assert r.miss_idx.size == 0
+    r9 = d.resolve(np.array([9]))            # the rolled-back id re-misses
+    assert r9.miss_idx.size == 1
+
+
+def test_pad_adaptive_shape_economy():
+    from paddle_tpu.distributed.ps.device_cache import pad_adaptive
+    assert pad_adaptive(3) == 8
+    assert pad_adaptive(1000) == 1024
+    assert pad_adaptive(37253) == 40960      # grain 8192
+    # at most 8 distinct padded shapes per octave, <=25% waste
+    import math
+    for lo in (1 << 12, 1 << 14):
+        shapes = {pad_adaptive(n) for n in range(lo, 2 * lo, 64)}
+        assert len(shapes) <= 9
+        for n in range(lo, 2 * lo, 97):
+            assert n <= pad_adaptive(n) <= math.ceil(n * 1.25)
+
+
+def test_eval_reads_through_cache_without_flush():
+    """model(...) eval mid-training must see the TRAINED rows even though
+    the host table is stale until flush (read-through contract)."""
+    paddle.seed(7)
+    m = WideDeep(hidden=(16,), emb_dim=4)
+    t = WideDeepTrainer(m)
+    ids, dense, label = synthetic_ctr_batch(64, vocab=5_000, seed=0)
+    for _ in range(4):
+        t.step(ids, dense, label)
+    # NO flush: host table rows are still initial
+    m.eval()
+    out_cached = m(ids, dense).numpy()
+    t.flush()                 # now the host table has the trained rows
+    for emb in (m.wide_emb, m.deep_emb):
+        emb._cache_read = None  # force host-table reads
+    out_host = m(ids, dense).numpy()
+    np.testing.assert_allclose(out_cached, out_host, rtol=1e-4, atol=1e-5)
+    m.train()
+
+
+def test_training_forward_refuses_while_cache_bound():
+    paddle.seed(7)
+    m = WideDeep(hidden=(16,), emb_dim=4)
+    WideDeepTrainer(m)
+    ids, dense, _ = synthetic_ctr_batch(8, vocab=1_000, seed=0)
+    m.train()
+    with pytest.raises(RuntimeError, match="device *cache"):
+        m(ids, dense)
+
+
+def test_rollback_reclaims_fresh_slots():
+    d = SlotDirectory(capacity=64)
+    d.resolve(np.array([1, 2]))
+    used_before = d._n_used
+    for _ in range(5):                       # repeated failed attempts
+        res = d.resolve(np.array([10, 11, 12]))
+        d.rollback(res)
+    assert d._n_used == used_before
