@@ -300,13 +300,19 @@ class DeviceEmbeddingCache:
 
     def prepare(self, uniq: np.ndarray, arenas=None):
         """Single-table convenience: resolve + fill in one call.
-        Returns (slots [U] int32, miss_slots, miss_rows, miss_state)."""
+        Returns (slots [U] int32, miss_slots, miss_rows, miss_state).
+        On any failure the resolution is rolled back, so the directory
+        never maps ids to never-filled slots."""
         res = self.directory.resolve(uniq)
-        if len(res.victim_slots) and arenas is None:
-            raise RuntimeError(
-                "cache full: prepare() needs the current device arenas to "
-                "write evicted rows back")
-        miss_slots, miss_rows, miss_state = self.fill(res, arenas)
+        try:
+            if len(res.victim_slots) and arenas is None:
+                raise RuntimeError(
+                    "cache full: prepare() needs the current device arenas "
+                    "to write evicted rows back")
+            miss_slots, miss_rows, miss_state = self.fill(res, arenas)
+        except Exception:
+            self.directory.rollback(res)
+            raise
         return res.slots.astype(np.int32), miss_slots, miss_rows, miss_state
 
     def _writeback(self, victim_slots, victim_ids, arenas):

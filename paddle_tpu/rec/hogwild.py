@@ -1,0 +1,138 @@
+"""Hogwild-style multi-threaded PS training.
+
+Reference parity: paddle/fluid/framework/device_worker.h:237 HogwildWorker
+(TrainFiles: each thread owns a DataFeed and runs the op graph against
+SHARED parameters with no synchronization) and trainer.h:51 MultiTrainer
+spinning one worker per thread, pushing sparse grads to the pservers
+asynchronously.
+
+TPU-first reframe: the reference's Hogwild exists to saturate CPU cores on
+sparse CTR models.  With one accelerator the compute stream is already a
+single queue, so the win moves to the HOST side: N worker threads each run
+unique/pull/push (RPC + numpy latency) concurrently, keeping the chip's
+queue full while any one thread blocks on the parameter server.  Dense
+parameters are shared Hogwild-style: each worker computes gradients
+against a lock-free snapshot and applies them to the CURRENT shared state
+(stale-gradient async SGD — the same convergence contract as the
+reference's unsynchronized writes, at whole-tensor granularity); sparse
+grads push to the shared PS client, whose tables apply them under the
+server's per-table serialization.
+"""
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+from typing import Iterable, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .wide_deep import (WideDeep, _DenseCore, bce_with_logits_mean,
+                        make_adam_update)
+
+
+class HogwildTrainer:
+    """N host threads over one WideDeep model + shared PS client.
+
+    ``trainer.train(batches, num_threads=4)`` consumes an iterable of
+    (sparse_ids, dense_x, labels) batches from a shared queue — the
+    DataFeed of HogwildWorker::TrainFiles — and returns per-batch losses
+    in completion order.
+    """
+
+    def __init__(self, model: WideDeep, lr: float = 1e-3):
+        from ..framework import functional as F
+        self.model = model
+        self.lr = float(lr)
+        core = _DenseCore(model)
+        apply, params, buffers = F.functionalize(core, training=True)
+        self._params = params
+        self._adam = {
+            "m": {k: jnp.zeros_like(v) for k, v in params.items()},
+            "v": {k: jnp.zeros_like(v) for k, v in params.items()},
+            "t": jnp.zeros((), jnp.int32),
+        }
+        self._apply_lock = threading.Lock()
+
+        def grads_fn(params, wide_rows, deep_rows, inv, dense_x, labels):
+            def loss_of(p, wr, dr):
+                out = apply(p, buffers, wr, dr, inv, inv, dense_x)
+                x = out[0] if isinstance(out, tuple) else out
+                return bce_with_logits_mean(x, labels)
+            (loss), grads = jax.value_and_grad(loss_of, argnums=(0, 1, 2))(
+                params, wide_rows, deep_rows)
+            return loss, grads
+
+        self._grads = jax.jit(grads_fn)
+        self._adam_apply = jax.jit(make_adam_update(self.lr))
+
+    # -- one worker step ------------------------------------------------------
+    def _worker_step(self, ids, dense_x, labels) -> float:
+        we, de = self.model.wide_emb, self.model.deep_emb
+        ids = np.asarray(ids)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        w_rows = jnp.asarray(we.pull_padded_rows(uniq))
+        d_rows = jnp.asarray(de.pull_padded_rows(uniq))
+        inv_dev = jnp.asarray(inv.reshape(ids.shape), jnp.int32)
+        # lock-free snapshot: stale by however many applies raced past us
+        snapshot = self._params
+        loss, (gp, gw, gd) = self._grads(
+            snapshot, w_rows, d_rows, inv_dev,
+            jnp.asarray(dense_x), jnp.asarray(labels))
+        n = len(uniq)
+        we.client.push_sparse(we.table_id, uniq, np.asarray(gw)[:n])
+        de.client.push_sparse(de.table_id, uniq, np.asarray(gd)[:n])
+        # apply the (possibly stale) dense grads to the CURRENT shared
+        # state; the lock only guards the pointer swap — dispatch is async
+        with self._apply_lock:
+            self._params, self._adam = self._adam_apply(
+                self._params, self._adam, gp)
+        return float(loss)
+
+    # -- the multi-thread drive (MultiTrainer::Run) ---------------------------
+    def train(self, batches: Iterable, num_threads: int = 2,
+              queue_size: int = 16) -> List[float]:
+        """Run every batch through ``num_threads`` Hogwild workers; returns
+        losses in completion order.  Exceptions from any worker re-raise
+        after all threads retire."""
+        q: "queue_mod.Queue" = queue_mod.Queue(maxsize=queue_size)
+        losses: List[float] = []
+        errs: List[BaseException] = []
+        loss_lock = threading.Lock()
+
+        def worker():
+            while True:
+                item = q.get()
+                try:
+                    if item is None:
+                        return
+                    l = self._worker_step(*item)
+                    with loss_lock:
+                        losses.append(l)
+                except BaseException as e:    # noqa: BLE001 — surfaced below
+                    errs.append(e)
+                finally:
+                    q.task_done()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(int(num_threads))]
+        for t in threads:
+            t.start()
+        for b in batches:
+            q.put(tuple(b))
+        for _ in threads:
+            q.put(None)
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        return losses
+
+    def sync_params(self):
+        """Point the eager model's dense params at the shared trained state
+        (pointer swap, no copy) — call before eval/save."""
+        core = _DenseCore(self.model)
+        for name, p in core.named_parameters():
+            if name in self._params:
+                p._value = self._params[name]

@@ -63,6 +63,28 @@ class WideDeep(nn.Layer):
         self.deep_emb.flush_grads()
 
 
+def bce_with_logits_mean(x, labels):
+    """Numerically stable mean BCE-with-logits (shared by the CTR
+    trainers)."""
+    l = jnp.maximum(x, 0) - x * labels + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return jnp.mean(l)
+
+
+def make_adam_update(lr, b1=0.9, b2=0.999, eps=1e-8):
+    """Functional Adam over a {name: array} tree with bias correction —
+    the dense-side update both CTR trainers jit into their step."""
+    def adam_update(params, adam, gp):
+        t = adam["t"] + 1
+        tf = t.astype(jnp.float32)
+        corr = jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+        new_m = {k: b1 * adam["m"][k] + (1 - b1) * gp[k] for k in gp}
+        new_v = {k: b2 * adam["v"][k] + (1 - b2) * gp[k] ** 2 for k in gp}
+        new_p = {k: params[k] - lr * corr * new_m[k] /
+                 (jnp.sqrt(new_v[k]) + eps) for k in gp}
+        return new_p, {"m": new_m, "v": new_v, "t": t}
+    return adam_update
+
+
 class WideDeepTrainer:
     """The PS CTR train loop at two service levels:
 
@@ -150,25 +172,8 @@ class WideDeepTrainer:
             "v": {k: jnp.zeros_like(v) for k, v in params.items()},
             "t": jnp.zeros((), jnp.int32),
         }
-        b1, b2, eps = 0.9, 0.999, 1e-8
-        lr_ = self.lr
-
-        def bce_mean(x, labels):
-            # BCE-with-logits, numerically stable
-            l = jnp.maximum(x, 0) - x * labels + \
-                jnp.log1p(jnp.exp(-jnp.abs(x)))
-            return jnp.mean(l)
-
-        def adam_update(params, adam, gp):
-            t = adam["t"] + 1
-            tf = t.astype(jnp.float32)
-            corr = jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
-            new_m = {k: b1 * adam["m"][k] + (1 - b1) * gp[k] for k in gp}
-            new_v = {k: b2 * adam["v"][k] + (1 - b2) * gp[k] ** 2
-                     for k in gp}
-            new_p = {k: params[k] - lr_ * corr * new_m[k] /
-                     (jnp.sqrt(new_v[k]) + eps) for k in gp}
-            return new_p, {"m": new_m, "v": new_v, "t": t}
+        bce_mean = bce_with_logits_mean
+        adam_update = make_adam_update(self.lr)
 
         def fused(params, adam, wide_rows, deep_rows, wide_inv, deep_inv,
                   dense_x, labels):

@@ -122,7 +122,7 @@ def test_cache_ftrl_rule_matches_host_table():
 
 # -- trainer integration ------------------------------------------------------
 
-def test_cached_trainer_matches_uncached_bitwise():
+def test_cached_trainer_eviction_equivalence():
     def run(cap):
         paddle.seed(42)
         m = WideDeep(hidden=(32,), emb_dim=4)
@@ -139,6 +139,30 @@ def test_cached_trainer_matches_uncached_bitwise():
     b, tb = run(1 << 18)     # everything cached
     assert ta._d_cache.evictions > 0
     np.testing.assert_array_equal(a, b)
+
+
+def test_cached_trainer_matches_pullpush_mode():
+    """The on-chip sparse rule + cached dataflow must track the host-side
+    pull/push path: same init, same batches, f32 wire -> near-identical
+    loss trajectories (fp rounding differs only by XLA-vs-numpy op order)."""
+    def run(cached):
+        paddle.seed(17)
+        m = WideDeep(hidden=(32,), emb_dim=4)
+        t = WideDeepTrainer(m, device_cache=cached,
+                            feature_wire_dtype="float32")
+        out = []
+        for seed in range(6):
+            ids, dense, label = synthetic_ctr_batch(
+                128, vocab=50_000, seed=seed)
+            out.append(t.step(ids, dense, label))
+        t.flush()
+        uniq = np.unique(synthetic_ctr_batch(128, vocab=50_000, seed=0)[0])
+        return np.array(out), m.client.pull_sparse(1, uniq)
+
+    la, ra = run(True)
+    lb, rb = run(False)
+    np.testing.assert_allclose(la, lb, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(ra, rb, rtol=2e-3, atol=2e-5)
 
 
 def test_cached_trainer_flush_syncs_host_table():

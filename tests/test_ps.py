@@ -619,3 +619,54 @@ def test_arena_growth_is_bounded():
         t.pull(np.array([i]))
     assert len(t) == 40
     assert len(t._arena) <= 2048
+
+
+# -- Hogwild multi-thread PS training (device_worker.h:237) ------------------
+
+def _auc(scores, labels):
+    order = np.argsort(scores)
+    ranks = np.empty(len(scores)); ranks[order] = np.arange(1, len(scores)+1)
+    pos = labels > 0.5
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    return (ranks[pos].sum() - n_pos*(n_pos+1)/2) / (n_pos*n_neg)
+
+
+def test_hogwild_two_threads_matches_single_thread_auc():
+    """HogwildWorker parity: 2 async threads over a shared PS client reach
+    the same AUC (±small slack) as 1 thread on the same batches."""
+    from paddle_tpu.rec import HogwildTrainer
+    from paddle_tpu.rec.wide_deep import WideDeep, synthetic_ctr_batch
+
+    def run(n_threads):
+        paddle.seed(11)
+        m = WideDeep(hidden=(32,), emb_dim=4)
+        tr = HogwildTrainer(m, lr=5e-3)
+        batches = [synthetic_ctr_batch(256, vocab=20_000, seed=s)
+                   for s in range(12)]
+        losses = []
+        for _ in range(3):               # 3 passes over the 12 batches
+            losses += tr.train(batches, num_threads=n_threads)
+        assert len(losses) == 36
+        tr.sync_params()
+        m.eval()
+        ids, dense, label = synthetic_ctr_batch(512, vocab=20_000, seed=99)
+        scores = m(ids, dense).numpy().ravel()
+        return _auc(scores, label.ravel()), losses
+
+    auc1, l1 = run(1)
+    auc2, l2 = run(2)
+    assert auc1 > 0.6 and auc2 > 0.6
+    assert abs(auc1 - auc2) < 0.08, (auc1, auc2)
+
+
+def test_hogwild_worker_error_surfaces():
+    from paddle_tpu.rec import HogwildTrainer
+    from paddle_tpu.rec.wide_deep import WideDeep, synthetic_ctr_batch
+    paddle.seed(0)
+    m = WideDeep(hidden=(16,), emb_dim=4)
+    tr = HogwildTrainer(m)
+    ids, dense, label = synthetic_ctr_batch(32, vocab=1_000, seed=0)
+    with pytest.raises(Exception):
+        tr.train([(ids, dense[:, :2], label)], num_threads=2)  # bad shape
