@@ -96,6 +96,8 @@ class HogwildTrainer:
         """Run every batch through ``num_threads`` Hogwild workers; returns
         losses in completion order.  Exceptions from any worker re-raise
         after all threads retire."""
+        if int(num_threads) < 1:
+            raise ValueError(f"num_threads must be >= 1, got {num_threads}")
         q: "queue_mod.Queue" = queue_mod.Queue(maxsize=queue_size)
         losses: List[float] = []
         errs: List[BaseException] = []
@@ -132,7 +134,6 @@ class HogwildTrainer:
     def sync_params(self):
         """Point the eager model's dense params at the shared trained state
         (pointer swap, no copy) — call before eval/save."""
-        core = _DenseCore(self.model)
-        for name, p in core.named_parameters():
-            if name in self._params:
-                p._value = self._params[name]
+        from .wide_deep import dense_param_map
+        for name, p in dense_param_map(self.model, self._params):
+            p._value = self._params[name]

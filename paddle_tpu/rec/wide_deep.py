@@ -395,11 +395,17 @@ class WideDeepTrainer:
         """Point the eager model's dense params at the jit-updated device
         arrays (free — same buffers, no copy)."""
         if not hasattr(self, "_name_map"):
-            core = _DenseCore(self.model)
-            self._name_map = [(n, p) for n, p in core.named_parameters()
-                              if n in self._params]
+            self._name_map = dense_param_map(self.model, self._params)
         for name, p in self._name_map:
             p._value = self._params[name]
+
+
+def dense_param_map(model: "WideDeep", params):
+    """(name, Parameter) pairs of the model's dense core that appear in a
+    functional params tree — the pointer-swap map both CTR trainers use to
+    keep the eager model in sync."""
+    core = _DenseCore(model)
+    return [(n, p) for n, p in core.named_parameters() if n in params]
 
 
 class _DenseCore(nn.Layer):

@@ -221,22 +221,28 @@ class Executor:
     # -- dataset-driven training (Trainer/DeviceWorker runtime) -------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100, epochs=1):
-        """trainer.h:51 / device_worker.h parity: run the whole epoch as
-        ONE compiled program — no Python between steps.
+                           fetch_info=None, print_period=100, epochs=1,
+                           chunk_steps=256):
+        """trainer.h:51 / device_worker.h parity: stream the dataset
+        through a compiled scan — no Python between steps, bounded HBM.
 
         The reference's DistMultiTrainer spins C++ DeviceWorkers that pull
-        from a DataFeed and run the op graph per minibatch, bypassing
-        Python. The TPU-shape of that: stack the epoch's batches on device
-        and ``lax.scan`` the program's replay over them inside a single
-        jit — Python is out of the loop entirely, which is the same
-        contract with a faster engine.
+        minibatches from a DataFeed CHANNEL (data_feed.h:305) and run the
+        op graph per batch.  The TPU-shape of that channel: host-stack the
+        feeds in chunks of ``chunk_steps``, double-buffer each chunk onto
+        the device while the previous chunk's ``lax.scan`` runs, and carry
+        the persistables across chunks inside one jitted scan per chunk
+        shape.  Peak device memory holds ~2 chunks + parameters instead of
+        the whole epoch; the tail chunk pads to an adaptive bucket with a
+        per-step validity mask (masked steps keep the carry), so one
+        compiled program serves every full chunk.
 
         ``dataset``: an iterable of feed dicts {var_name: ndarray}, an
         io.DataLoader yielding such dicts, or a dict of pre-stacked
         arrays {var_name: [steps, ...]}.
         Returns {fetch_name: [epochs*steps, ...] numpy} for fetch_list.
         """
+        import itertools
         program = program or default_main_program()
         scope = scope or global_scope()
         if dataset is None:
@@ -244,20 +250,54 @@ class Executor:
         fetch_list = fetch_list or []
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in fetch_list]
+        chunk_steps = max(1, int(chunk_steps))
 
-        # materialize the epoch feed stack [steps, ...] per var
+        # -- the DataFeed channel: a re-iterable source of host chunks ----
         if isinstance(dataset, dict):
-            stacks = {k: jnp.asarray(v) for k, v in dataset.items()}
+            if not dataset:
+                raise ValueError("train_from_dataset: empty dataset")
+            host = {k: np.asarray(v) for k, v in dataset.items()}
+            n_total = len(next(iter(host.values())))
+
+            def raw_chunks():
+                for s in range(0, n_total, chunk_steps):
+                    yield {k: v[s:s + chunk_steps] for k, v in host.items()}
         else:
-            cols = {}
-            for feed in dataset:
-                for k, v in feed.items():
-                    cols.setdefault(k, []).append(np.asarray(
-                        v.numpy() if isinstance(v, Tensor) else v))
-            stacks = {k: jnp.asarray(np.stack(vs))
-                      for k, vs in cols.items()}
-        feed_names = sorted(stacks)
-        n_steps = next(iter(stacks.values())).shape[0]
+            if iter(dataset) is dataset:
+                # one-shot iterator: materialize HOST-side once (epochs may
+                # re-read); device memory stays chunk-bounded regardless
+                dataset = list(dataset)
+
+            def raw_chunks():
+                buf, count = {}, 0
+                for feed in dataset:
+                    for k, v in feed.items():
+                        buf.setdefault(k, []).append(np.asarray(
+                            v.numpy() if isinstance(v, Tensor) else v))
+                    count += 1
+                    if count == chunk_steps:
+                        yield {k: np.stack(vs) for k, vs in buf.items()}
+                        buf, count = {}, 0
+                if count:
+                    yield {k: np.stack(vs) for k, vs in buf.items()}
+
+        # epoch 0 fills a host-side chunk cache; later epochs replay it
+        # instead of re-stacking every feed (the chunks ARE the host copy)
+        _chunk_cache: list = []
+
+        def chunk_iter():
+            if _chunk_cache:
+                yield from _chunk_cache
+                return
+            for ch in raw_chunks():
+                _chunk_cache.append(ch)
+                yield ch
+
+        head_it = chunk_iter()
+        first = next(head_it, None)
+        if first is None:
+            raise ValueError("train_from_dataset: empty dataset")
+        feed_names = sorted(first)
 
         persist_names = self._persistable_names(program)
         written = [n for n in persist_names
@@ -267,16 +307,44 @@ class Executor:
                                     persist_names, written)
         w_pos = [persist_names.index(n) for n in written]
 
-        def epoch_fn(persist_vals, feed_stacks):
-            def step(carry, feeds):
+        def epoch_fn(persist_vals, feed_stacks, mask):
+            def step(carry, xs):
+                feeds, m = xs[:-1], xs[-1]
                 fetches, updates = replay(list(feeds), list(carry))
                 carry = list(carry)
                 for p, u in zip(w_pos, updates):
-                    carry[p] = u
+                    # masked tail steps keep the carry (padding must not
+                    # apply optimizer updates)
+                    carry[p] = jnp.where(m, u, carry[p])
                 return tuple(carry), fetches
-            return jax.lax.scan(step, tuple(persist_vals), feed_stacks)
+            return jax.lax.scan(step, tuple(persist_vals),
+                                (*feed_stacks, mask))
 
         jitted = jax.jit(epoch_fn)
+
+        def upload(chunk):
+            """Pad to a stable bucket, ship to device (async H2D)."""
+            from ..distributed.ps.device_cache import pad_adaptive
+            n = len(chunk[feed_names[0]])
+            # tail buckets never exceed the full-chunk shape (the documented
+            # device budget), and near-full tails reuse the full compile
+            k = (chunk_steps if n == chunk_steps
+                 else min(pad_adaptive(n), chunk_steps))
+            mask = np.zeros(k, bool)
+            mask[:n] = True
+            feeds = []
+            nbytes = 0
+            for name in feed_names:
+                v = chunk[name]
+                if len(v) < k:
+                    v = np.concatenate(
+                        [v, np.zeros((k - len(v),) + v.shape[1:],
+                                     v.dtype)])
+                nbytes += v.nbytes
+                feeds.append(jax.device_put(v))
+            self._train_stats["max_chunk_bytes"] = max(
+                self._train_stats["max_chunk_bytes"], nbytes)
+            return tuple(feeds), jax.device_put(mask), n
 
         persist_vals = []
         for n in persist_names:
@@ -286,15 +354,23 @@ class Executor:
                     f"persistable {n!r} not initialized — run the startup "
                     f"program first")
             persist_vals.append(v)
+        persist_vals = tuple(persist_vals)
 
-        feed_stacks = tuple(stacks[k] for k in feed_names)
+        self._train_stats = {"chunks": 0, "max_chunk_bytes": 0}
         all_fetches = {n: [] for n in fetch_names}
         for ep in range(epochs):
-            persist_vals, fetches = jitted(tuple(persist_vals),
-                                           feed_stacks)
-            persist_vals = list(persist_vals)
-            for n, f in zip(fetch_names, fetches):
-                all_fetches[n].append(np.asarray(f))
+            chunks = (itertools.chain([first], head_it) if ep == 0
+                      else chunk_iter())
+            pending = upload(next(chunks))
+            while pending is not None:
+                feeds, mask, n_valid = pending
+                nxt = next(chunks, None)
+                persist_vals, fetches = jitted(persist_vals, feeds, mask)
+                # double buffer: ship chunk i+1 while chunk i scans
+                pending = upload(nxt) if nxt is not None else None
+                self._train_stats["chunks"] += 1
+                for n, f in zip(fetch_names, fetches):
+                    all_fetches[n].append(np.asarray(f)[:n_valid])
             if debug and fetch_names:
                 head = fetch_names[0]
                 _last = all_fetches[head][-1]
