@@ -35,9 +35,21 @@ class Config:
         self._use_tpu = True
         self._memory_optim = True
         self._glog_info = False
+        self._optim_cache_dir = None
+
+    def set_optim_cache_dir(self, path):
+        """AnalysisConfig::SetOptimCacheDir parity: compiled PJRT
+        executables persist here, so a serving restart deserializes them
+        instead of recompiling (the TensorRT engine-cache slot)."""
+        self._optim_cache_dir = path
+
+    def optim_cache_dir(self):
+        return self._optim_cache_dir
 
     def set_model(self, prog_file, params_file=None):
+        cache_dir = self._optim_cache_dir
         self.__init__(prog_file, params_file)
+        self._optim_cache_dir = cache_dir
 
     def model_dir(self):
         return self._model_dir
@@ -116,8 +128,25 @@ class Predictor:
                 load_inference_model(d)
             self._fetch_names = [v.name for v in self._fetch_vars]
             self._exe = Executor()
+            if config.optim_cache_dir():
+                self._exe.set_aot_cache_dir(config.optim_cache_dir())
         self._feeds: Dict[str, np.ndarray] = {}
         self._results: Dict[str, np.ndarray] = {}
+
+    def clone(self):
+        """AnalysisPredictor::Clone parity (analysis_predictor.h:214):
+        a predictor sharing this one's WEIGHTS and compiled executables,
+        with its own IO buffers — one clone per serving thread.  Weights
+        are shared by construction: the clone aliases the same loaded
+        program/TranslatedLayer and the same Executor (whose compiled
+        replay closes over the scope's parameter buffers); device arrays
+        are immutable, so concurrent run() calls race only on their own
+        per-clone feed/result dicts."""
+        import copy
+        c = copy.copy(self)           # aliases program/executor/weights
+        c._feeds = {}                 # own IO buffers per serving thread
+        c._results = {}
+        return c
 
     @staticmethod
     def _jit_prefix(d):

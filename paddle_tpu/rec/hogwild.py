@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from .wide_deep import (WideDeep, _DenseCore, bce_with_logits_mean,
-                        make_adam_update)
+                        dense_param_map, make_adam_update)
 
 
 class HogwildTrainer:
@@ -134,6 +134,5 @@ class HogwildTrainer:
     def sync_params(self):
         """Point the eager model's dense params at the shared trained state
         (pointer swap, no copy) — call before eval/save."""
-        from .wide_deep import dense_param_map
         for name, p in dense_param_map(self.model, self._params):
             p._value = self._params[name]

@@ -84,6 +84,89 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
+        self._aot_dir = None
+
+    # -- AOT executable cache (inference/api SetOptimCacheDir parity) --------
+    def set_aot_cache_dir(self, path):
+        """Persist compiled PJRT executables under ``path`` so a process
+        restart replays them instead of recompiling — the TPU seat of the
+        reference's optimization-cache dir (analysis_config SetOptimCacheDir)
+        and TensorRT engine serialization."""
+        import os
+        os.makedirs(path, exist_ok=True)
+        self._aot_dir = path
+
+    @staticmethod
+    def _aot_digest(program, feed_names, feed_vals, union, persist_names,
+                    persist_vals):
+        """Restart-stable executable key: program structure + IO signature
+        (program._uid is per-process, useless across restarts)."""
+        import hashlib
+        h = hashlib.sha1()
+
+        def attr_bytes(v):
+            # arrays hash by VALUE (repr elides large arrays, and any
+            # truncation lets distinct programs collide onto a stale
+            # executable); everything else hashes its full repr
+            if hasattr(v, "dtype") and hasattr(v, "shape"):
+                a = np.asarray(v)
+                return f"{a.shape}:{a.dtype}:".encode() + a.tobytes()
+            return repr(v).encode()
+
+        for op in program.global_block().ops:
+            h.update(repr((op.prim, tuple(op.input_names),
+                           tuple(op.output_names))).encode())
+            for k in sorted(op.attrs or {}):
+                h.update(k.encode())
+                h.update(attr_bytes(op.attrs[k]))
+        for n, v in zip(feed_names, feed_vals):
+            h.update(f"{n}:{v.shape}:{v.dtype}".encode())
+        for n, v in zip(persist_names, persist_vals):
+            h.update(f"{n}:{getattr(v, 'shape', ())}:"
+                     f"{getattr(v, 'dtype', '')}".encode())
+        h.update(repr(tuple(union)).encode())
+        return h.hexdigest()
+
+    def _aot_load(self, digest):
+        import os
+        import pickle
+        path = os.path.join(self._aot_dir, digest + ".pjrt")
+        if not os.path.exists(path):
+            return None
+        try:
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load)
+            with open(path, "rb") as f:
+                blob, in_tree, out_tree, n_dev = pickle.load(f)
+            # pin execution to the same device count the executable was
+            # built for (deserialize defaults to ALL client devices)
+            return deserialize_and_load(
+                blob, in_tree, out_tree,
+                execution_devices=jax.devices()[:n_dev])
+        except Exception:
+            # different runtime/PJRT/machine: fall back to a fresh compile
+            return None
+
+    def _aot_save(self, digest, compiled):
+        import os
+        import pickle
+        from jax.experimental.serialize_executable import serialize
+        path = os.path.join(self._aot_dir, digest + ".pjrt")
+        try:
+            import tempfile
+            blob, in_tree, out_tree = serialize(compiled)
+            n_dev = len(compiled._executable.xla_executable
+                        .local_devices()) \
+                if hasattr(compiled, "_executable") else 1
+            # unique tmp per writer: concurrent cold-starting processes
+            # sharing one cache dir must not interleave into one file
+            fd, tmp = tempfile.mkstemp(dir=self._aot_dir,
+                                       suffix=".pjrt.tmp")
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump((blob, in_tree, out_tree, n_dev), f)
+            os.replace(tmp, path)
+        except Exception:
+            pass   # cache is best-effort; serving continues uncached
 
     # -- eager interpretation (startup programs / debugging) -----------------
     def _run_eager(self, program: Program, scope: Scope):
@@ -182,11 +265,30 @@ class Executor:
             union += [n for n in fetch_names if n not in union]
             replay = self._build_replay(program, feed_names, union,
                                         persist_names, written)
-            jitted = jax.jit(replay)
+            jitted = None
+            if self._aot_dir is not None and compiled is None:
+                # AOT executable cache: lowering needs the persist values,
+                # so gather them here (run() re-gathers below — cheap dict
+                # reads)
+                pv = [scope.find_var(n) for n in persist_names]
+                if all(v is not None for v in pv):
+                    digest = self._aot_digest(program, feed_names,
+                                              feed_vals, union,
+                                              persist_names, pv)
+                    jitted = self._aot_load(digest)
+                    if jitted is None:
+                        compiled_exe = jax.jit(replay).lower(
+                            feed_vals, pv).compile()
+                        self._aot_save(digest, compiled_exe)
+                        jitted = compiled_exe
+                        from ..utils.monitor import stat_add
+                        stat_add("STAT_executor_compiles")
+            if jitted is None:
+                jitted = jax.jit(replay)
+                from ..utils.monitor import stat_add
+                stat_add("STAT_executor_compiles")
             entry = (union, jitted, persist_names, written)
             self._cache[key] = entry
-            from ..utils.monitor import stat_add
-            stat_add("STAT_executor_compiles")
         union, jitted, persist_names, written = entry
         fetch_pos = [union.index(n) for n in fetch_names]
 

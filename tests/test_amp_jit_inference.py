@@ -280,3 +280,86 @@ def test_executor_fetch_union_shares_compile(tmp_path):
         np.testing.assert_allclose(r2[1], r1[0], rtol=1e-6)
     finally:
         paddle.disable_static()
+
+
+def _save_tiny_model(tmp_path):
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8], "float32")
+            out = static.nn.fc(x, 3)
+        exe = static.Executor()
+        exe.run(startup)
+        xd = np.random.RandomState(0).randn(4, 8).astype("float32")
+        ref = exe.run(main, feed={"x": xd}, fetch_list=[out])[0]
+        static.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                    main_program=main)
+    finally:
+        paddle.disable_static()
+    return xd, ref
+
+
+def test_predictor_clone_four_threads(tmp_path):
+    """AnalysisPredictor::Clone parity (analysis_predictor.h:214): clones
+    share weights + executables; each serving thread runs its own clone
+    concurrently and gets the primary's exact outputs."""
+    import threading
+    xd, ref = _save_tiny_model(tmp_path)
+    from paddle_tpu import inference
+    primary = inference.create_predictor(inference.Config(str(tmp_path)))
+    primary.run([xd])   # compile once on the primary
+    clones = [primary.clone() for _ in range(4)]
+    # weight sharing: same executor/program objects, not copies
+    for c in clones:
+        assert c._exe is primary._exe and c._program is primary._program
+    outs, errs = [None] * 4, []
+
+    def serve(i):
+        try:
+            rng = np.random.RandomState(i)
+            mine = xd + 0  # same shape; per-thread buffer
+            for _ in range(10):
+                outs[i] = clones[i].run([mine])[0]
+        except Exception as e:     # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=serve, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    for o in outs:
+        np.testing.assert_allclose(o, ref, rtol=1e-5)
+    # per-clone IO isolation: feeding a clone does not disturb the primary
+    np.testing.assert_allclose(primary.run([xd])[0], ref, rtol=1e-5)
+
+
+def test_predictor_aot_cache_skips_recompile(tmp_path):
+    """SetOptimCacheDir parity: a second predictor over the same cache dir
+    deserializes the PJRT executable instead of recompiling (asserted via
+    the STAT_executor_compiles monitor gauge)."""
+    from paddle_tpu.utils.monitor import stat_get
+    xd, ref = _save_tiny_model(tmp_path / "model")
+    cache = str(tmp_path / "aot")
+    from paddle_tpu import inference
+
+    def serve_once():
+        config = inference.Config(str(tmp_path / "model"))
+        config.set_optim_cache_dir(cache)
+        p = inference.create_predictor(config)
+        return p.run([xd])[0]
+
+    c0 = stat_get("STAT_executor_compiles")
+    out1 = serve_once()               # cold: compiles + serializes
+    c1 = stat_get("STAT_executor_compiles")
+    assert c1 == c0 + 1
+    import os
+    assert any(f.endswith(".pjrt") for f in os.listdir(cache))
+    out2 = serve_once()               # warm: deserializes, NO new compile
+    c2 = stat_get("STAT_executor_compiles")
+    assert c2 == c1, "AOT cache hit must not recompile"
+    np.testing.assert_allclose(out1, ref, rtol=1e-5)
+    np.testing.assert_allclose(out2, ref, rtol=1e-5)
