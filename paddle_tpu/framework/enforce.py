@@ -149,6 +149,18 @@ def _summarize(args):
     return ", ".join(parts)
 
 
+# error types that carry their own precise diagnostics and must escape
+# op_context unwrapped (e.g. dy2static's guided conversion errors)
+_PASSTHROUGH = []
+
+
+def register_passthrough(cls):
+    """Exempt an error class from op-context wrapping."""
+    if cls not in _PASSTHROUGH:
+        _PASSTHROUGH.append(cls)
+    return cls
+
+
 @contextlib.contextmanager
 def op_context(op_name, args=()):
     """Attach operator context to any error escaping an op's kernel —
@@ -157,6 +169,8 @@ def op_context(op_name, args=()):
     try:
         yield
     except EnforceNotMet:
+        raise
+    except tuple(_PASSTHROUGH):
         raise
     except (TypeError, ValueError, IndexError, ZeroDivisionError) as e:
         raise InvalidArgumentError(

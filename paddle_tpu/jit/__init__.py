@@ -85,7 +85,10 @@ class StaticFunction:
                 bound = self._layer
             try:
                 new = ast_transform(fn)
-            except Exception:
+            except Exception as e:
+                from .dy2static import Dy2StaticError
+                if isinstance(e, Dy2StaticError):
+                    raise   # deliberate diagnostic, not a fallback case
                 new = None
             out = new if (new is not None and new is not raw) else raw
             self._ast_fn = out.__get__(bound) if bound is not None else out

@@ -38,6 +38,12 @@ class Dy2StaticError(RuntimeError):
     pass
 
 
+# dy2static errors are precise user-facing diagnostics; op-provenance
+# wrapping (enforce.op_context) must not bury them in ExternalError
+from ..framework.enforce import register_passthrough  # noqa: E402
+register_passthrough(Dy2StaticError)
+
+
 def _is_traced(v):
     x = unwrap(v)
     return isinstance(x, jax.core.Tracer)
@@ -354,6 +360,104 @@ def convert_getitem(x, i):
     return x[int(iv)]
 
 
+@functools.lru_cache(maxsize=1)
+def _host_callbacks_supported() -> bool:
+    """Whether the default backend can run host callbacks inside compiled
+    programs (the axon TPU PJRT plugin cannot: 'does not support host
+    send/recv callbacks'). Probed once with a tiny jitted program."""
+    try:
+        def probe(x):
+            jax.debug.callback(lambda: None)
+            return x + 1
+        # block: the UNIMPLEMENTED error surfaces at execution, not trace
+        jax.block_until_ready(jax.jit(probe)(jnp.zeros(())))
+        return True
+    except Exception:
+        return False
+
+
+def convert_assert(cond, msg=None):
+    """assert_transformer.py parity.  A traced condition becomes an
+    IN-GRAPH check — a host callback that raises when the runtime value is
+    falsy (the reference lowers to assert_op.cc, which prints and aborts);
+    eager conditions keep Python assert semantics.  The message expression
+    is evaluated eagerly either way (it was already rewritten into the
+    converter call).
+
+    Backends without host-callback support (the axon TPU plugin) cannot
+    check at runtime: the assert is skipped with a one-time warning —
+    honest disclosure beats a program that cannot compile."""
+    import numpy as np
+    c = unwrap(cond) if _is_tensorish(cond) else cond
+    if _is_traced(cond):
+        if not _host_callbacks_supported():
+            import warnings
+            warnings.warn(
+                "@to_static assert on a traced value cannot be checked at "
+                "runtime on this backend (no host-callback support); the "
+                "assert is skipped", RuntimeWarning, stacklevel=2)
+            return
+
+        def _chk(v):
+            if not bool(np.all(v)):
+                raise AssertionError(
+                    msg if msg is not None
+                    else "Assert failed inside @to_static graph")
+        jax.debug.callback(_chk, c)
+        return
+    if not bool(np.all(np.asarray(c))):
+        if msg is not None:
+            raise AssertionError(msg)
+        raise AssertionError()
+
+
+def convert_print(*args, sep=" ", end="\n", **kw):
+    """print_transformer.py parity: printing a traced intermediate prints
+    the RUNTIME value when the program executes (a host callback running
+    builtin print, so sep/end/file/flush keep their semantics); all-eager
+    prints stay builtin print.  Backends without host-callback support
+    print the abstract value at trace time instead (the reference's
+    static-mode print shows the Variable desc)."""
+    if any(_is_traced(a) for a in args):
+        vals = [unwrap(a) if _is_tensorish(a) else a for a in args]
+        if not _host_callbacks_supported():
+            shown = [f"Tensor(shape={list(v.shape)}, dtype={v.dtype})"
+                     if isinstance(v, jax.core.Tracer) else v
+                     for v in vals]
+            print(*shown, sep=sep, end=end, **kw)
+            return
+        # only array-valued positions travel through the callback;
+        # static values (strings, ints) ride the closure
+        arr_idx = [i for i, v in enumerate(vals)
+                   if isinstance(v, (jax.Array, jax.core.Tracer))]
+
+        def show(*arrs):
+            out = list(vals)
+            for i, a in zip(arr_idx, arrs):
+                out[i] = a
+            print(*out, sep=sep, end=end, **kw)
+
+        jax.debug.callback(show, *[vals[i] for i in arr_idx])
+    else:
+        print(*args, sep=sep, end=end, **kw)
+
+
+def _make_cast(py_type, dtype):
+    def convert_cast(x):
+        """cast_transformer.py parity: int/float/bool on a tensor becomes
+        a dtype cast instead of a trace-time concretization error."""
+        if _is_tensorish(x):
+            from .. import ops
+            return ops.cast(x, dtype)
+        return py_type(x)
+    return convert_cast
+
+
+convert_int = _make_cast(int, "int64")
+convert_float = _make_cast(float, "float32")
+convert_bool = _make_cast(bool, "bool")
+
+
 _JST = {
     "_jst_ifelse": convert_ifelse,
     "_jst_while": convert_while_loop,
@@ -365,6 +469,11 @@ _JST = {
     "_jst_more": convert_more,
     "_jst_len": convert_len,
     "_jst_getitem": convert_getitem,
+    "_jst_assert": convert_assert,
+    "_jst_print": convert_print,
+    "_jst_int": convert_int,
+    "_jst_float": convert_float,
+    "_jst_bool": convert_bool,
 }
 
 
@@ -839,11 +948,67 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             [cond_fn, body_fn, getter, setter, call]
 
 
+class _AssertPrintCastTransformer(ast.NodeTransformer):
+    """The assert/print/cast leg of the reference pipeline
+    (assert_transformer.py, print_transformer.py, cast_transformer.py):
+    ``assert`` → convert_assert, ``print(...)`` → convert_print,
+    ``int/float/bool(x)`` → dtype casts when x is a tensor."""
+
+    _CASTS = ("int", "float", "bool")
+
+    def __init__(self):
+        self.count = 0
+
+    def visit_FunctionDef(self, node):
+        if _is_generator_def(node):
+            return node
+        self.generic_visit(node)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        self.count += 1
+        args = [node.test] + ([node.msg] if node.msg is not None else [])
+        return ast.copy_location(ast.Expr(value=ast.Call(
+            func=ast.Name(id="_jst_assert", ctx=ast.Load()),
+            args=args, keywords=[])), node)
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name):
+            if node.func.id == "print" and not any(
+                    kw.arg is None for kw in node.keywords):
+                self.count += 1
+                return ast.copy_location(ast.Call(
+                    func=ast.Name(id="_jst_print", ctx=ast.Load()),
+                    args=node.args, keywords=node.keywords), node)
+            if (node.func.id in self._CASTS and len(node.args) == 1
+                    and not node.keywords):
+                self.count += 1
+                return ast.copy_location(ast.Call(
+                    func=ast.Name(id=f"_jst_{node.func.id}",
+                                  ctx=ast.Load()),
+                    args=node.args, keywords=[]), node)
+        return node
+
+
+def _src_location(raw):
+    code = getattr(raw, "__code__", None)
+    if code is None:
+        return "<unknown>", 0
+    return code.co_filename, code.co_firstlineno
+
+
 def ast_transform(func):
     """Rewrite ``func``'s if/while into converter calls. Returns the new
     function, or None when the source is unavailable/untransformable
     (lambdas, closures, C extensions) — callers fall back to plain tracing
-    (program_translator.py's to-static fallback)."""
+    (program_translator.py's to-static fallback).  Unsupported syntax that
+    can NEVER convert (generators) raises Dy2StaticError with the original
+    source location — the reference's error-report path
+    (dygraph_to_static/error.py)."""
     raw = getattr(func, "__func__", func)
     if raw.__closure__:          # can't rebuild closure cells faithfully
         return None
@@ -855,9 +1020,24 @@ def ast_transform(func):
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
+    if _is_generator_def(fdef):
+        fname, line = _src_location(raw)
+        raise Dy2StaticError(
+            f"@to_static cannot convert generator function "
+            f"'{raw.__name__}' ({fname}:{line}): `yield` has no graph "
+            f"form — iterate eagerly outside the compiled program")
     fdef.decorator_list = []
-    # transformer pipeline (ast_transformer.py order): for→while, returns,
-    # break/continue escapes, then if/while → converter calls
+    # transformer pipeline (ast_transformer.py order): assert/print/cast,
+    # for→while, returns, break/continue escapes, then if/while →
+    # converter calls
+    pc = _AssertPrintCastTransformer()
+    tree = pc.visit(tree)
+    if pc.count:
+        # probe host-callback support NOW, outside any trace (probing
+        # inside convert_assert/print would inline the probe's callback
+        # into the user's traced program); lru_cache serves the verdict
+        # at trace time
+        _host_callbacks_supported()
     ft = _ForToWhile()
     tree = ft.visit(tree)
     rt = _ReturnTransformer()
@@ -870,16 +1050,55 @@ def ast_transform(func):
                      + [ast.parse(f"return {RET_VAL}").body[0]])
     t = _ControlFlowTransformer()
     new_tree = t.visit(tree)
-    if t._n == 0 and ft.count == 0 and et.count == 0 and not did_ret:
-        return raw               # nothing to rewrite
+    fname, first = _src_location(raw)
+    if (t._n == 0 and ft.count == 0 and et.count == 0 and not did_ret
+            and pc.count == 0):
+        # nothing to rewrite — still attach the runtime diagnostic guard so
+        # unconvertible dynamic control flow reports guidance, not a bare
+        # tracer error
+        return _guard_diagnostics(raw, raw, fname, first)
     ast.fix_missing_locations(new_tree)
-    code = compile(new_tree, filename=f"<dy2static {raw.__name__}>",
-                   mode="exec")
+    # error-report mapping: compile against the ORIGINAL file with linenos
+    # shifted to the function's real position, so tracebacks out of the
+    # transformed code point into the user's source
+    try:
+        ast.increment_lineno(new_tree, first - 1)
+        code = compile(new_tree, filename=fname, mode="exec")
+    except Exception:
+        code = compile(new_tree, filename=f"<dy2static {raw.__name__}>",
+                       mode="exec")
     globs = dict(raw.__globals__)
     globs.update(_JST)
     ns = {}
     exec(code, globs, ns)
     new = ns[fdef.name]
     functools.update_wrapper(new, raw)
-    new.__pt_dy2static__ = True
-    return new
+    return _guard_diagnostics(new, raw, fname, first)
+
+
+def _guard_diagnostics(new, raw, fname, first):
+    """Wrap a (possibly transformed) function so unconvertible dynamic
+    control flow surfaces as a guided Dy2StaticError with the original
+    source location — the reference's error-report layer
+    (dygraph_to_static/error.py)."""
+
+    @functools.wraps(new)
+    def guarded(*a, **k):
+        try:
+            return new(*a, **k)
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError) as e:
+            # a kept-Python construct concretized a tracer (bool() or
+            # numpy() on a data-dependent value outside convertible flow)
+            raise Dy2StaticError(
+                f"unsupported data-dependent operation in '{raw.__name__}' "
+                f"({fname}:{first}): a traced value was concretized — by a "
+                f"construct that kept Python semantics (loop with "
+                f"break/else feeding a traced condition, truth-testing "
+                f"outside a convertible if/while) or by a host conversion "
+                f"(.numpy(), np.asarray, item()). Rewrite with plain "
+                f"if/while (no early escapes into the condition), keep "
+                f"host conversions outside @to_static, or make the value "
+                f"static. Underlying error: {type(e).__name__}.") from e
+    guarded.__pt_dy2static__ = True
+    return guarded
