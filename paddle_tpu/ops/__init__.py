@@ -28,6 +28,10 @@ from .decode import (  # noqa: F401
     linear_chain_crf, crf_decoding, viterbi_decode, edit_distance,
 )
 from .linalg import cov, corrcoef  # noqa: F401
+from .industrial import (  # noqa: F401
+    batch_fc, fsp_matrix, shuffle_batch, hash_bucket, spp,
+    positive_negative_pair, tdm_child, nce_loss,
+)
 from . import (  # noqa: F401
     creation, math, manipulation, linalg, control_flow, math_ext, sequence,
     detection, vision, decode,

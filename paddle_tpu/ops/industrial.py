@@ -1,0 +1,201 @@
+"""Industrial / research long-tail operators.
+
+Reference parity: the CTR-industrial and research ops the reference keeps
+in operators/ behind no flag but outside the 2.0 API surface —
+batch_fc_op.h (per-slot batched FC), fsp_op.h (FSP distillation matrix),
+shuffle_batch_op.cc, hash_op.h (multi-hash bucketing), spp_op.h (spatial
+pyramid pooling), positive_negative_pair_op.h (ranking pair metric),
+tdm_child_op.h (TDM tree child lookup), nce_op.h (noise-contrastive
+estimation).
+
+TPU-first: each op is a small jnp composition (vectorized, no LoD loops);
+hashing deviates from the reference's XXH64 (a bit-mix with the same
+bucketing contract — hash values are an implementation detail nobody
+checkpoints).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, unwrap
+
+
+def _arr(x):
+    return unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _is_host(t: Tensor) -> bool:
+    """True when the tensor's value is concretely readable (not traced)."""
+    return not isinstance(unwrap(t), jax.core.Tracer)
+
+
+def batch_fc(input, w, bias=None):
+    """batch_fc_op.h: per-slot batched FC.
+    input [S, B, In] · w [S, In, Out] (+ bias [S, Out]) → [S, B, Out]."""
+    x, wt = _arr(input), _arr(w)
+    out = jnp.einsum("sbi,sio->sbo", x, wt)
+    if bias is not None:
+        out = out + _arr(bias)[:, None, :]
+    return Tensor(out)
+
+
+def fsp_matrix(x, y):
+    """fsp_op.h: flow-of-solution-procedure matrix for distillation.
+    x [B, C1, H, W], y [B, C2, H, W] → [B, C1, C2] = x·yᵀ / (H·W)."""
+    xa, ya = _arr(x), _arr(y)
+    h, w = xa.shape[2], xa.shape[3]
+    return Tensor(jnp.einsum("bchw,bdhw->bcd", xa, ya) / (h * w))
+
+
+def _fresh_key(seed):
+    """Explicit seed → deterministic key; None → the framework generator's
+    NEXT key (advances per call, like the reference's Seed/SeedOut chain —
+    a fixed default key would repeat the 'randomness' every step)."""
+    if seed is not None:
+        return jax.random.PRNGKey(int(seed))
+    from ..framework.random import default_generator
+    return default_generator.next_key()
+
+
+def shuffle_batch(x, seed=None):
+    """shuffle_batch_op.cc: shuffle rows (all dims but the last collapse
+    to the shuffled axis).  Returns (shuffled, shuffle_idx) — the index
+    tensor the reference emits for the backward re-ordering.  ``seed=None``
+    draws from the framework generator, re-shuffling on every call."""
+    xa = _arr(x)
+    lead = int(np.prod(xa.shape[:-1]))
+    key = _fresh_key(seed)
+    idx = jax.random.permutation(key, lead)
+    flat = xa.reshape(lead, xa.shape[-1])
+    return Tensor(flat[idx].reshape(xa.shape)), Tensor(idx)
+
+
+def hash_bucket(x, num_hash: int = 1, mod_by: int = 1 << 20):
+    """hash_op.h: each input row hashes ``num_hash`` times (seeded 0..n-1)
+    into [0, mod_by) — the CTR multi-hash embedding trick.  Deviation from
+    the reference: a splitmix-style integer mix instead of XXH64; the
+    contract (deterministic, seed-distinct, well-spread buckets) holds.
+    x [N, D] int → [N, num_hash, 1] int64-ish."""
+    # hash the FULL 64-bit id as two 32-bit halves (truncating to the low
+    # word would collide every pair of ids equal mod 2^32 under ALL seeds).
+    # The split happens HOST-side in numpy: with jax x64 disabled a device
+    # array cannot hold the high word at all.
+    if isinstance(x, Tensor) and _is_host(x) or isinstance(
+            x, (np.ndarray, list, tuple)):
+        raw_np = np.asarray(x.numpy() if isinstance(x, Tensor) else x,
+                            np.int64)
+        lo = jnp.asarray((raw_np & 0xFFFFFFFF).astype(np.uint32))
+        hi = jnp.asarray(((raw_np >> 32) & 0xFFFFFFFF).astype(np.uint32))
+    else:
+        raw = _arr(x)          # traced/device: 32-bit ids only (x64 off)
+        lo = (raw & 0xFFFFFFFF).astype(jnp.uint32)
+        hi = jnp.zeros_like(lo)
+
+    def mix(v, salt):
+        h = v ^ jnp.uint32((salt * 0x9E3779B9) & 0xFFFFFFFF)
+        h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+        h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+        return h ^ (h >> 16)
+
+    outs = []
+    for s in range(int(num_hash)):
+        acc = jnp.uint32(s + 1)
+        for d in range(lo.shape[-1]):
+            acc = mix(lo[..., d] ^ acc, s + 1)
+            acc = mix(hi[..., d] ^ acc, s + 1)
+        outs.append((acc % jnp.uint32(mod_by)).astype(jnp.int32))
+    return Tensor(jnp.stack(outs, axis=-1)[..., None])
+
+
+def spp(x, pyramid_height: int = 3, pool_type: str = "max"):
+    """spp_op.h: spatial pyramid pooling — concat of adaptive pools at
+    1,2,4,…,2^(h-1) bins.  x [N, C, H, W] → [N, C·Σ bins²]."""
+    from ..nn.functional import adaptive_max_pool2d, adaptive_avg_pool2d
+    if pool_type not in ("max", "avg"):
+        raise ValueError(f"spp pool_type must be 'max' or 'avg', "
+                         f"got {pool_type!r}")
+    fn = adaptive_max_pool2d if pool_type == "max" else adaptive_avg_pool2d
+    parts = []
+    n = _arr(x).shape[0]
+    for level in range(int(pyramid_height)):
+        bins = 2 ** level
+        p = fn(x, output_size=bins)
+        parts.append(_arr(p).reshape(n, -1))
+    return Tensor(jnp.concatenate(parts, axis=1))
+
+
+def positive_negative_pair(score, label, query_id, weight=None, column=-1):
+    """positive_negative_pair_op.h: within each query, count document
+    pairs ordered correctly (positive), inverted (negative), or tied
+    (neutral) by score vs label — the PN-pair ranking metric.  Host-side
+    metric (the reference computes on CPU); returns three 1-element
+    Tensors."""
+    s = np.asarray(score.numpy() if isinstance(score, Tensor) else score)
+    l = np.asarray(label.numpy() if isinstance(label, Tensor)
+                   else label).ravel()
+    q = np.asarray(query_id.numpy() if isinstance(query_id, Tensor)
+                   else query_id).ravel()
+    w = (np.ones(len(l), np.float64) if weight is None
+         else np.asarray(weight.numpy() if isinstance(weight, Tensor)
+                         else weight).ravel())
+    if s.ndim > 1:
+        s = s[:, column]
+    pos = neg = neu = 0.0
+    for qid in np.unique(q):
+        sel = q == qid
+        ss, ll, ww = s[sel], l[sel], w[sel]
+        for i in range(len(ss)):
+            for j in range(i + 1, len(ss)):
+                if ll[i] == ll[j]:
+                    continue
+                pw = (ww[i] + ww[j]) * 0.5
+                if ss[i] == ss[j]:
+                    neu += pw
+                elif (ss[i] - ss[j]) * (ll[i] - ll[j]) > 0:
+                    pos += pw
+                else:
+                    neg += pw
+    mk = lambda v: Tensor(jnp.asarray([v], jnp.float32))  # noqa: E731
+    return mk(pos), mk(neg), mk(neu)
+
+
+def tdm_child(x, tree_info, child_nums: int):
+    """tdm_child_op.h: gather each node's children from the TDM tree table.
+    tree_info rows are [item_id, layer_id, ancestor_id, child_0, …]; a
+    node with no children (or node 0) yields zeros.  Returns
+    (child [N, child_nums], leaf_mask [N, child_nums]) where mask=1 marks
+    children that are items (leaf nodes, item_id != 0)."""
+    xa = _arr(x).astype(jnp.int32).reshape(-1)
+    info = _arr(tree_info).astype(jnp.int32)
+    children = info[xa, 3:3 + child_nums]                    # [N, C]
+    has_child = ((xa != 0) & (info[xa, 3] != 0))[:, None]
+    children = jnp.where(has_child, children, 0)
+    is_item = (info[children, 0] != 0).astype(jnp.int32)
+    mask = jnp.where(has_child, is_item, 0)
+    return Tensor(children), Tensor(mask)
+
+
+def nce_loss(input, label, weight, bias=None, num_neg_samples: int = 10,
+             num_total_classes: int = None, seed: int = None):
+    """nce_op.h: noise-contrastive estimation with a uniform sampler.
+    input [B, D], label [B], weight [V, D], bias [V] →  per-example loss
+    [B, 1]: −log σ(s_true − log q) − Σ_neg log(1 − σ(s_neg − log q)),
+    q = num_neg/V (uniform sampler probability mass per draw).
+    ``seed=None`` draws FRESH negatives from the framework generator each
+    call — a fixed default seed would pin the negative set and degenerate
+    training."""
+    x = _arr(input)
+    lab = _arr(label).astype(jnp.int32).reshape(-1)
+    wt = _arr(weight)
+    v = int(num_total_classes or wt.shape[0])
+    b = _arr(bias) if bias is not None else jnp.zeros((v,), x.dtype)
+    key = _fresh_key(seed)
+    neg = jax.random.randint(key, (x.shape[0], int(num_neg_samples)), 0, v)
+    log_q = jnp.log(jnp.asarray(num_neg_samples / v, x.dtype))
+    s_true = jnp.einsum("bd,bd->b", x, wt[lab]) + b[lab] - log_q
+    s_neg = jnp.einsum("bd,bnd->bn", x, wt[neg]) + b[neg] - log_q
+    loss = (jax.nn.softplus(-s_true) +
+            jax.nn.softplus(s_neg).sum(axis=1))
+    return Tensor(loss[:, None])
