@@ -21,12 +21,11 @@ def _bn_axes(ndim, data_format):
     return ch, reduce_axes
 
 
-def _bn_train_fn(x, gamma, beta, rmean, rvar, momentum=0.9, eps=1e-5,
-                 data_format="NCHW"):
-    ch, axes = _bn_axes(x.ndim, data_format)
-    xf = x.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=axes)
-    var = jnp.var(xf, axis=axes)
+def _bn_apply(x, xf, gamma, beta, rmean, rvar, mean, var, momentum, eps,
+              ch):
+    """Shared normalize+affine+running-update tail of the train-mode BN
+    primitives (the only difference between plain and sync BN is where
+    mean/var came from)."""
     shape = [1] * x.ndim
     shape[ch] = x.shape[ch]
     inv = jax.lax.rsqrt(var + eps)
@@ -36,6 +35,16 @@ def _bn_train_fn(x, gamma, beta, rmean, rvar, momentum=0.9, eps=1e-5,
     new_rmean = momentum * rmean + (1 - momentum) * mean.astype(rmean.dtype)
     new_rvar = momentum * rvar + (1 - momentum) * var.astype(rvar.dtype)
     return out.astype(x.dtype), new_rmean, new_rvar
+
+
+def _bn_train_fn(x, gamma, beta, rmean, rvar, momentum=0.9, eps=1e-5,
+                 data_format="NCHW"):
+    ch, axes = _bn_axes(x.ndim, data_format)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    return _bn_apply(x, xf, gamma, beta, rmean, rvar, mean, var, momentum,
+                     eps, ch)
 
 
 def _bn_eval_fn(x, gamma, beta, rmean, rvar, eps=1e-5, data_format="NCHW"):
@@ -50,8 +59,32 @@ def _bn_eval_fn(x, gamma, beta, rmean, rvar, eps=1e-5, data_format="NCHW"):
     return out.astype(x.dtype)
 
 
+def _sync_bn_train_fn(x, gamma, beta, rmean, rvar, momentum=0.9, eps=1e-5,
+                      data_format="NCHW"):
+    """sync_batch_norm_op.cu parity: batch statistics are GLOBAL across
+    the dp replicas.  Under GSPMD (pjit whole-array semantics) the plain
+    mean already reduces over the logical global batch, so this equals
+    _bn_train_fn; under a MANUAL dp axis (shard_map) the local moments
+    are explicitly pmean'd — the reference's ncclAllReduce of
+    sum/sum-of-squares."""
+    ch, axes = _bn_axes(x.ndim, data_format)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    meansq = jnp.mean(xf * xf, axis=axes)
+    from ...distributed.collective import _axis_bound
+    from ...parallel.mesh import DP_AXIS
+    if _axis_bound(DP_AXIS):
+        mean = jax.lax.pmean(mean, DP_AXIS)
+        meansq = jax.lax.pmean(meansq, DP_AXIS)
+    var = meansq - mean * mean
+    return _bn_apply(x, xf, gamma, beta, rmean, rvar, mean, var, momentum,
+                     eps, ch)
+
+
 _bn_train = Primitive("batch_norm_train", _bn_train_fn, multi_output=True)
 _bn_eval = Primitive("batch_norm_eval", _bn_eval_fn)
+_sync_bn_train = Primitive("sync_batch_norm_train", _sync_bn_train_fn,
+                           multi_output=True)
 
 
 def batch_norm(x, running_mean, running_var, weight, bias, training=False,
@@ -64,10 +97,25 @@ def batch_norm(x, running_mean, running_var, weight, bias, training=False,
                                 momentum=float(momentum), eps=float(epsilon),
                                 data_format=data_format)
         # functional-state write-back: Layer buffers mutate eagerly; jit
-        # tracing captures the set_value (see jit/state tracking)
-        if isinstance(running_mean, Tensor):
+        # tracing captures the set_value (see jit/state tracking).
+        if isinstance(running_mean, Tensor) and isinstance(nm, Tensor):
             running_mean.set_value(nm._value)
             running_var.set_value(nv._value)
+        elif not isinstance(nm, Tensor):
+            # static-graph recording: alias the op's stat outputs to the
+            # persistable running-stat NAMES so the executor's persistable
+            # write-back updates them (the reference's in-place
+            # MeanOut/VarianceOut of batch_norm_op.cc)
+            mname = getattr(running_mean, "name", None)
+            vname = getattr(running_var, "name", None)
+            if mname and vname:
+                from ...static.program import current_block
+                for op in reversed(current_block().ops):
+                    if op.prim in ("batch_norm_train",
+                                   "sync_batch_norm_train"):
+                        op.output_names[1] = mname
+                        op.output_names[2] = vname
+                        break
         return out
     return _bn_eval(x, weight, bias, running_mean, running_var,
                     eps=float(epsilon), data_format=data_format)

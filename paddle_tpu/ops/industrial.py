@@ -89,8 +89,10 @@ def hash_bucket(x, num_hash: int = 1, mod_by: int = 1 << 20):
         lo = jnp.asarray((raw_np & 0xFFFFFFFF).astype(np.uint32))
         hi = jnp.asarray(((raw_np >> 32) & 0xFFFFFFFF).astype(np.uint32))
     else:
-        raw = _arr(x)          # traced/device: 32-bit ids only (x64 off)
-        lo = (raw & 0xFFFFFFFF).astype(jnp.uint32)
+        # traced/device: 32-bit ids only (x64 off); two's-complement
+        # reinterpretation — masking with the 0xFFFFFFFF literal would
+        # overflow int32 argument parsing
+        lo = _arr(x).astype(jnp.int32).view(jnp.uint32)
         hi = jnp.zeros_like(lo)
 
     def mix(v, salt):
@@ -146,17 +148,19 @@ def positive_negative_pair(score, label, query_id, weight=None, column=-1):
     for qid in np.unique(q):
         sel = q == qid
         ss, ll, ww = s[sel], l[sel], w[sel]
-        for i in range(len(ss)):
-            for j in range(i + 1, len(ss)):
-                if ll[i] == ll[j]:
-                    continue
-                pw = (ww[i] + ww[j]) * 0.5
-                if ss[i] == ss[j]:
-                    neu += pw
-                elif (ss[i] - ss[j]) * (ll[i] - ll[j]) > 0:
-                    pos += pw
-                else:
-                    neg += pw
+        # vectorized pair enumeration (upper triangle, label-distinct)
+        n = len(ss)
+        iu, ju = np.triu_indices(n, k=1)
+        diff = ll[iu] != ll[ju]
+        if not diff.any():
+            continue
+        iu, ju = iu[diff], ju[diff]
+        pw = (ww[iu] + ww[ju]) * 0.5
+        tied = ss[iu] == ss[ju]
+        correct = (ss[iu] - ss[ju]) * (ll[iu] - ll[ju]) > 0
+        neu += pw[tied].sum()
+        pos += pw[~tied & correct].sum()
+        neg += pw[~tied & ~correct].sum()
     mk = lambda v: Tensor(jnp.asarray([v], jnp.float32))  # noqa: E731
     return mk(pos), mk(neg), mk(neu)
 

@@ -61,8 +61,12 @@ BUILD_LEDGER = {
     "fuse_bn_act_ops": ("n/a", "XLA fusion"),
     "enable_inplace": ("n/a", "buffer donation"),
     "memory_optimize": ("n/a", "XLA buffer assignment"),
-    "sync_batch_norm": ("raises", "use nn.SyncBatchNorm layers; a program "
-                                  "rewrite pass is not provided"),
+    "sync_batch_norm": ("engine", "program rewrite: batch_norm_train ops "
+                                  "swap to sync_batch_norm_train (global "
+                                  "batch stats; explicit pmean under a "
+                                  "manual dp axis, identical under GSPMD "
+                                  "whole-array semantics) — "
+                                  "apply_sync_batch_norm_pass"),
     "num_trainers": ("n/a", "cluster size comes from the launch env"),
     "trainer_id": ("n/a", "rank comes from the launch env"),
 }
@@ -86,6 +90,27 @@ def check_build_strategy(bs):
     return True
 
 
+def apply_sync_batch_norm_pass(program) -> int:
+    """The build-strategy sync_batch_norm pass as a Program rewrite
+    (reference wiring: framework/details/build_strategy.cc appends
+    sync_batch_norm_pass, which swaps batch_norm ops for sync_batch_norm).
+    Here each recorded ``batch_norm_train`` op re-points at the
+    ``sync_batch_norm_train`` primitive — global batch statistics (an
+    explicit dp-axis pmean under shard_map; identical math under GSPMD
+    whole-array semantics).  Eval-mode ops are untouched: running stats
+    are already replica-identical.  Returns the rewrite count."""
+    n = 0
+    for block in program.blocks:
+        for op in block.ops:
+            if op.prim == "batch_norm_train":
+                op.prim = "sync_batch_norm_train"
+                op.type = "sync_batch_norm"
+                n += 1
+    if n:
+        program._version += 1       # invalidate compiled-replay caches
+    return n
+
+
 class CompiledProgram:
     """compiler.py:88 parity."""
 
@@ -96,6 +121,13 @@ class CompiledProgram:
         self._exec_strategy = ExecutionStrategy()
         self._data_parallel = False
         self._loss_name = None
+        self._maybe_sync_bn()
+
+    def _maybe_sync_bn(self):
+        if (getattr(self._build_strategy, "sync_batch_norm", False)
+                and self._program is not None
+                and hasattr(self._program, "blocks")):
+            apply_sync_batch_norm_pass(self._program)
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, places=None):
@@ -104,6 +136,7 @@ class CompiledProgram:
         if build_strategy is not None:
             check_build_strategy(build_strategy)
             self._build_strategy = build_strategy
+            self._maybe_sync_bn()
         if exec_strategy is not None:
             self._exec_strategy = exec_strategy
         return self
