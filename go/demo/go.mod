@@ -1,0 +1,3 @@
+module paddle_tpu_demo
+
+go 1.20

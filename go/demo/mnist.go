@@ -1,0 +1,102 @@
+// MNIST classification over the paddle_tpu C inference ABI.
+//
+// Reference parity: go/demo/mobilenet.go (the cgo serving demo) reshaped
+// for the TPU framework's C ABI (paddle_tpu/native/capi.cpp): create a
+// predictor from a save_inference_model directory, feed one 1x1x28x28
+// image, print the argmax class.
+//
+// Build (the test drives this):
+//   CGO_LDFLAGS="-L<libdir> -lpt_capi" go build -o mnist ./go/demo
+//   LD_LIBRARY_PATH=<libdir> ./mnist <model_dir> [image.f32]
+//
+// The optional image file is 784 raw little-endian float32s; without it a
+// deterministic synthetic image is used.
+package main
+
+/*
+#include <stdlib.h>
+void* pd_predictor_create(const char* model_path);
+long long pd_predictor_run_f32(void* h, const float* in,
+                               const long long* shape, int ndim,
+                               float* out, long long out_cap);
+void pd_predictor_destroy(void* h);
+const char* pd_last_error(void);
+*/
+import "C"
+
+import (
+	"encoding/binary"
+	"fmt"
+	"math"
+	"os"
+	"unsafe"
+)
+
+func lastError() string { return C.GoString(C.pd_last_error()) }
+
+func loadImage(path string) ([]float32, error) {
+	raw, err := os.ReadFile(path)
+	if err != nil {
+		return nil, err
+	}
+	if len(raw) != 784*4 {
+		return nil, fmt.Errorf("image must be 784 float32s, got %d bytes", len(raw))
+	}
+	img := make([]float32, 784)
+	for i := range img {
+		bits := binary.LittleEndian.Uint32(raw[i*4:])
+		img[i] = math.Float32frombits(bits)
+	}
+	return img, nil
+}
+
+func main() {
+	if len(os.Args) < 2 {
+		fmt.Fprintln(os.Stderr, "usage: mnist <model_dir> [image.f32]")
+		os.Exit(1)
+	}
+	model := C.CString(os.Args[1])
+	defer C.free(unsafe.Pointer(model))
+
+	pred := C.pd_predictor_create(model)
+	if pred == nil {
+		fmt.Fprintln(os.Stderr, "create:", lastError())
+		os.Exit(1)
+	}
+	defer C.pd_predictor_destroy(pred)
+
+	img := make([]float32, 784)
+	if len(os.Args) > 2 {
+		loaded, err := loadImage(os.Args[2])
+		if err != nil {
+			fmt.Fprintln(os.Stderr, "image:", err)
+			os.Exit(1)
+		}
+		img = loaded
+	} else {
+		for i := range img { // deterministic synthetic digit-ish blob
+			r, c := i/28, i%28
+			d := float64((r-14)*(r-14) + (c-14)*(c-14))
+			img[i] = float32(math.Exp(-d / 40.0))
+		}
+	}
+
+	shape := []C.longlong{1, 1, 28, 28}
+	out := make([]C.float, 10)
+	n := C.pd_predictor_run_f32(pred,
+		(*C.float)(unsafe.Pointer(&img[0])),
+		(*C.longlong)(unsafe.Pointer(&shape[0])), 4,
+		(*C.float)(unsafe.Pointer(&out[0])), 10)
+	if n != 10 {
+		fmt.Fprintln(os.Stderr, "run:", lastError())
+		os.Exit(2)
+	}
+
+	cls, best := 0, out[0]
+	for i, v := range out {
+		if v > best {
+			cls, best = i, v
+		}
+	}
+	fmt.Printf("GO-DEMO-OK class=%d score=%f\n", cls, float32(best))
+}

@@ -76,7 +76,10 @@ def _sync_bn_train_fn(x, gamma, beta, rmean, rvar, momentum=0.9, eps=1e-5,
     if _axis_bound(DP_AXIS):
         mean = jax.lax.pmean(mean, DP_AXIS)
         meansq = jax.lax.pmean(meansq, DP_AXIS)
-    var = meansq - mean * mean
+    # E[x²]−E[x]² cancels catastrophically in fp32 for large-offset data
+    # (negative "variance" → NaN rsqrt); clamp AFTER the pmean so the
+    # cross-replica combination stays exact
+    var = jnp.maximum(meansq - mean * mean, 0.0)
     return _bn_apply(x, xf, gamma, beta, rmean, rvar, mean, var, momentum,
                      eps, ch)
 
@@ -89,13 +92,14 @@ _sync_bn_train = Primitive("sync_batch_norm_train", _sync_bn_train_fn,
 
 def batch_norm(x, running_mean, running_var, weight, bias, training=False,
                momentum=0.9, epsilon=1e-5, data_format="NCHW",
-               use_global_stats=None, name=None):
+               use_global_stats=None, sync=False, name=None):
     if use_global_stats:
         training = False
     if training:
-        out, nm, nv = _bn_train(x, weight, bias, running_mean, running_var,
-                                momentum=float(momentum), eps=float(epsilon),
-                                data_format=data_format)
+        prim = _sync_bn_train if sync else _bn_train
+        out, nm, nv = prim(x, weight, bias, running_mean, running_var,
+                           momentum=float(momentum), eps=float(epsilon),
+                           data_format=data_format)
         # functional-state write-back: Layer buffers mutate eagerly; jit
         # tracing captures the set_value (see jit/state tracking).
         if isinstance(running_mean, Tensor) and isinstance(nm, Tensor):
@@ -108,14 +112,10 @@ def batch_norm(x, running_mean, running_var, weight, bias, training=False,
             # MeanOut/VarianceOut of batch_norm_op.cc)
             mname = getattr(running_mean, "name", None)
             vname = getattr(running_var, "name", None)
-            if mname and vname:
-                from ...static.program import current_block
-                for op in reversed(current_block().ops):
-                    if op.prim in ("batch_norm_train",
-                                   "sync_batch_norm_train"):
-                        op.output_names[1] = mname
-                        op.output_names[2] = vname
-                        break
+            bn_op = getattr(nm, "op", None)       # the recording Operator
+            if mname and vname and bn_op is not None:
+                bn_op.output_names[1] = mname
+                bn_op.output_names[2] = vname
         return out
     return _bn_eval(x, weight, bias, running_mean, running_var,
                     eps=float(epsilon), data_format=data_format)

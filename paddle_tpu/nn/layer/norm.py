@@ -36,12 +36,15 @@ class _BatchNormBase(Layer):
         self.register_buffer("_mean", Tensor(jnp.zeros(num_features)))
         self.register_buffer("_variance", Tensor(jnp.ones(num_features)))
 
+    _sync = False          # SyncBatchNorm dispatches the sync primitive
+
     def forward(self, x):
         return F.batch_norm(x, self._mean, self._variance, self.weight,
                             self.bias, training=self.training,
                             momentum=self._momentum, epsilon=self._epsilon,
                             data_format=self._data_format,
-                            use_global_stats=self._use_global_stats)
+                            use_global_stats=self._use_global_stats,
+                            sync=self._sync)
 
     def extra_repr(self):
         return f"num_features={self._num_features}, momentum={self._momentum}"
@@ -89,10 +92,13 @@ class BatchNorm3D(_BatchNormBase):
 
 
 class SyncBatchNorm(_BatchNormBase):
-    """Under pjit/shard_map the batch-mean reduction is global across the dp
-    mesh axis (GSPMD inserts the all-reduce) -- exact SyncBatchNorm semantics
-    with zero extra code. Reference: sync_batch_norm_op.cu + fleet
-    sync_batch_norm pass."""
+    """Global-batch statistics across the dp replicas.  Under GSPMD
+    (pjit whole-array semantics) plain batch statistics are already global;
+    under a MANUAL dp axis (shard_map) the layer dispatches the
+    sync_batch_norm_train primitive, whose moments pmean over the axis.
+    Reference: sync_batch_norm_op.cu + fleet sync_batch_norm pass."""
+
+    _sync = True
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):

@@ -1,0 +1,32 @@
+# MNIST inference from R over paddle_tpu.
+#
+# Reference parity: r/example/mobilenet.r — the reference's R story is
+# reticulate over the Python inference API, and that is exactly what works
+# here: import paddle_tpu, build an inference Config/Predictor, run.
+#
+#   Rscript mnist.R <model_dir>
+#
+# Requires: install.packages("reticulate"); a Python with paddle_tpu on
+# PYTHONPATH (the repo root).
+
+library(reticulate)
+
+args <- commandArgs(trailingOnly = TRUE)
+if (length(args) < 1) stop("usage: Rscript mnist.R <model_dir>")
+
+inference <- import("paddle_tpu.inference")
+np <- import("numpy")
+
+config <- inference$Config(args[[1]])
+predictor <- inference$create_predictor(config)
+
+img <- np$asarray(matrix(runif(784), nrow = 1), dtype = "float32")
+img <- np$reshape(img, c(1L, 1L, 28L, 28L))
+
+input_name <- predictor$get_input_names()[[1]]
+h <- predictor$get_input_handle(input_name)
+h$copy_from_cpu(img)
+predictor$run()
+out <- predictor$get_output_handle(predictor$get_output_names()[[1]])
+probs <- out$copy_to_cpu()
+cat(sprintf("R-DEMO-OK class=%d\n", which.max(probs) - 1))

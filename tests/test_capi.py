@@ -227,3 +227,73 @@ def test_python_free_training_from_c(tmp_path):
                        capture_output=True, text=True, timeout=300)
     assert p.returncode == 0, (p.stdout, p.stderr[-2000:])
     assert "C-TRAIN-OK" in p.stdout
+
+
+def _save_mnist_model(tmp_path):
+    """[None,1,28,28] -> 10-way softmax, saved for the language demos."""
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 1, 28, 28], "float32")
+            from paddle_tpu import ops
+            flat = ops.reshape(x, [-1, 784])
+            h = static.nn.fc(flat, 64, activation="relu")
+            out = static.nn.fc(h, 10, activation="softmax")
+        exe = static.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "mnist_model")
+        static.io.save_inference_model(d, ["x"], [out], exe,
+                                       main_program=main)
+        return d
+    finally:
+        paddle.disable_static()
+
+
+def test_go_demo_over_c_abi(tmp_path):
+    """go/demo/mnist.go (reference go/demo/mobilenet.go parity): a cgo
+    program over libpt_capi.so classifies one image.  Skips without a Go
+    toolchain."""
+    import shutil
+    go = shutil.which("go")
+    if go is None:
+        pytest.skip("no go toolchain in this image")
+    from paddle_tpu.native import build_capi
+    so = build_capi()
+    libdir = os.path.dirname(so)
+    model = _save_mnist_model(tmp_path)
+    env = _env()
+    env["CGO_LDFLAGS"] = f"-L{libdir} -lpt_capi"
+    env["LD_LIBRARY_PATH"] = (libdir + os.pathsep +
+                              env.get("LD_LIBRARY_PATH", ""))
+    env.setdefault("GOCACHE", str(tmp_path / "gocache"))
+    binp = str(tmp_path / "mnist_go")
+    b = subprocess.run([go, "build", "-o", binp, "."],
+                       cwd=os.path.join(REPO, "go", "demo"), env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert b.returncode == 0, b.stderr[-2000:]
+    r = subprocess.run([binp, model], env=env, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "GO-DEMO-OK class=" in r.stdout
+
+
+def test_r_demo_over_python_api(tmp_path):
+    """r/example/mnist.R (reference r/example parity: reticulate over the
+    Python API).  Skips without Rscript + reticulate."""
+    import shutil
+    rscript = shutil.which("Rscript")
+    if rscript is None:
+        pytest.skip("no R toolchain in this image")
+    probe = subprocess.run(
+        [rscript, "-e", "quit(status=!requireNamespace('reticulate'))"],
+        capture_output=True, timeout=120)
+    if probe.returncode != 0:
+        pytest.skip("R present but reticulate missing")
+    model = _save_mnist_model(tmp_path)
+    r = subprocess.run(
+        [rscript, os.path.join(REPO, "r", "example", "mnist.R"), model],
+        env=_env(), capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "R-DEMO-OK" in r.stdout

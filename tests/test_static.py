@@ -725,8 +725,7 @@ def test_sync_batch_norm_stats_are_global_on_mesh():
 
     def run(fn):
         def body(xs):
-            out, m, v = fn(xs, gamma, beta, rm, rv, data_format="NHWC"
-                           if False else "NCHW")
+            out, m, v = fn(xs, gamma, beta, rm, rv, data_format="NCHW")
             return out, m, v
         return shard_map(body, mesh=mesh, in_specs=P("dp"),
                          out_specs=(P("dp"), P("dp"), P("dp")))(x)
@@ -741,3 +740,21 @@ def test_sync_batch_norm_stats_are_global_on_mesh():
                                rtol=1e-4, atol=1e-5)
     m_local = np.asarray(m_local).reshape(8, 4)
     assert not np.allclose(m_local[0], m_local[1])   # shard-local differs
+
+
+def test_sync_batch_norm_layer_uses_sync_primitive():
+    """nn.SyncBatchNorm must dispatch the sync primitive (shard-global
+    stats under a manual axis), and the sync variance must clamp the
+    E[x²]−E[x]² cancellation (large-offset fp32 data must not NaN)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.functional.norm import _sync_bn_train_fn
+    m = nn.SyncBatchNorm(4)
+    assert m._sync is True
+    x = paddle.to_tensor(
+        (np.random.RandomState(0).randn(64, 4).astype("float32") * 0.01
+         + 3000.0))
+    out = m(x)
+    assert np.isfinite(out.numpy()).all()
+    # converted layers inherit the sync dispatch
+    conv = nn.SyncBatchNorm.convert_sync_batchnorm(nn.BatchNorm1D(4))
+    assert isinstance(conv, nn.SyncBatchNorm) and conv._sync
