@@ -21,6 +21,14 @@ def _bn_axes(ndim, data_format):
     return ch, reduce_axes
 
 
+def _running_update(rmean, rvar, mean, var, momentum):
+    """THE running-stat convention (one source of truth for every BN
+    path): momentum·old + (1−momentum)·batch-stat."""
+    new_rmean = momentum * rmean + (1 - momentum) * mean.astype(rmean.dtype)
+    new_rvar = momentum * rvar + (1 - momentum) * var.astype(rvar.dtype)
+    return new_rmean, new_rvar
+
+
 def _bn_apply(x, xf, gamma, beta, rmean, rvar, mean, var, momentum, eps,
               ch):
     """Shared normalize+affine+running-update tail of the train-mode BN
@@ -32,14 +40,30 @@ def _bn_apply(x, xf, gamma, beta, rmean, rvar, mean, var, momentum, eps,
     out = (xf - mean.reshape(shape)) * inv.reshape(shape)
     out = out * gamma.astype(jnp.float32).reshape(shape) + \
         beta.astype(jnp.float32).reshape(shape)
-    new_rmean = momentum * rmean + (1 - momentum) * mean.astype(rmean.dtype)
-    new_rvar = momentum * rvar + (1 - momentum) * var.astype(rvar.dtype)
+    new_rmean, new_rvar = _running_update(rmean, rvar, mean, var, momentum)
     return out.astype(x.dtype), new_rmean, new_rvar
 
 
 def _bn_train_fn(x, gamma, beta, rmean, rvar, momentum=0.9, eps=1e-5,
                  data_format="NCHW"):
     ch, axes = _bn_axes(x.ndim, data_format)
+    if ch == x.ndim - 1:
+        # channels-last: the fused Pallas epilogue applies when opted in
+        # (measured parity with XLA on the bench chip — see
+        # ops/pallas/fused_bn.py's gating note)
+        from ...ops.pallas import fused_bn
+        if (fused_bn.enabled() and (x.size // x.shape[-1]) % 8 == 0
+                and jax.device_count() == 1):
+            # single-device only: pallas_call has no GSPMD partition rule,
+            # so under multi-device pjit it would replicate the activation
+            # (and under shard_map compute per-shard moments)
+            x2d = x.reshape(-1, x.shape[-1])
+            y, mean, var = fused_bn.fused_bn_act(
+                x2d, gamma.astype(jnp.float32), beta.astype(jnp.float32),
+                float(eps), False)
+            new_rmean, new_rvar = _running_update(rmean, rvar, mean, var,
+                                                  momentum)
+            return y.reshape(x.shape), new_rmean, new_rvar
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes)
     var = jnp.var(xf, axis=axes)

@@ -1,0 +1,127 @@
+"""Fused BN(+ReLU) Pallas kernels — interpret-mode value/grad checks vs an
+XLA reference (tests/test_pallas_flash.py style), VERDICT r4 item 2.
+
+The kernel ships opt-in (PADDLE_TPU_PALLAS_BN) because the round-4 chip
+measurements put XLA's epilogue at the streaming floor already — see
+ops/pallas/fused_bn.py's gating note and PERF.md's roofline correction.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.fused_bn import fused_bn_act, enabled
+
+
+def _ref(x2d, gamma, beta, eps=1e-5, relu=True):
+    xf = x2d.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=0)
+    var = jnp.var(xf, axis=0)
+    inv = jax.lax.rsqrt(var + eps)
+    y = (xf - mean) * inv * gamma + beta
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x2d.dtype), mean, var
+
+
+@pytest.mark.parametrize("relu", [True, False])
+def test_fused_bn_forward_matches_xla(relu):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(512, 128), jnp.float32)
+    gamma = jnp.asarray(rng.rand(128) + 0.5, jnp.float32)
+    beta = jnp.asarray(rng.randn(128) * 0.1, jnp.float32)
+    y, m, v = fused_bn_act(x, gamma, beta, 1e-5, relu)
+    yr, mr, vr = _ref(x, gamma, beta, 1e-5, relu)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(mr), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(vr), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_fused_bn_large_offset_no_nan():
+    """The E[x²]−E[x]² clamp: large-offset fp32 data must stay finite."""
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(256, 128) * 0.01 + 3000.0, jnp.float32)
+    gamma = jnp.ones((128,), jnp.float32)
+    beta = jnp.zeros((128,), jnp.float32)
+    y, _, v = fused_bn_act(x, gamma, beta, 1e-5, True)
+    assert np.isfinite(np.asarray(y)).all()
+    assert (np.asarray(v) >= 0).all()
+
+
+@pytest.mark.parametrize("relu", [True, False])
+def test_fused_bn_grads_match_xla(relu):
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(256, 128), jnp.float32)
+    gamma = jnp.asarray(rng.rand(128) + 0.5, jnp.float32)
+    beta = jnp.asarray(rng.randn(128) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.randn(256, 128), jnp.float32)   # cotangent weights
+
+    def loss_pallas(x, g, b):
+        y, _, _ = fused_bn_act(x, g, b, 1e-5, relu)
+        return jnp.sum(y * w)
+
+    def loss_ref(x, g, b):
+        y, _, _ = _ref(x, g, b, 1e-5, relu)
+        return jnp.sum(y * w)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b_, name in zip(gp, gr, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-4, err_msg=name)
+
+
+def test_fused_bn_bf16_path():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(512, 128), jnp.bfloat16)
+    gamma = jnp.ones((128,), jnp.float32)
+    beta = jnp.zeros((128,), jnp.float32)
+    y, m, v = fused_bn_act(x, gamma, beta, 1e-5, True)
+    assert y.dtype == jnp.bfloat16
+    yr, _, _ = _ref(x, gamma, beta, 1e-5, True)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(yr, np.float32), rtol=5e-2,
+                               atol=5e-2)
+
+
+def test_gate_defaults_off(monkeypatch):
+    """Measured-crossover honesty: XLA runs the epilogue at the streaming
+    floor on the bench chip, so the pallas path must be opt-in."""
+    monkeypatch.delenv("PADDLE_TPU_PALLAS_BN", raising=False)
+    assert enabled() is False
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_BN", "0")
+    assert enabled() is False
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_BN", "1")
+    assert enabled() is True
+
+
+def test_unpaddable_m_raises():
+    x = jnp.zeros((13, 128), jnp.float32)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        fused_bn_act(x, jnp.ones(128), jnp.zeros(128), 1e-5, True)
+
+
+def test_stats_cotangents_flow():
+    """Gradients THROUGH the returned mean/var must match XLA (a loss
+    regularizing batch statistics gets the same dx either way)."""
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(64, 128), jnp.float32)
+    gamma = jnp.ones((128,), jnp.float32)
+    beta = jnp.zeros((128,), jnp.float32)
+
+    def loss_pallas(x):
+        _, m, v = fused_bn_act(x, gamma, beta, 1e-5, False)
+        return jnp.sum(m * m) + jnp.sum(v)
+
+    def loss_ref(x):
+        _, m, v = _ref(x, gamma, beta, 1e-5, False)
+        return jnp.sum(m * m) + jnp.sum(v)
+
+    gp = jax.grad(loss_pallas)(x)
+    gr = jax.grad(loss_ref)(x)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gr), rtol=1e-4,
+                               atol=1e-6)
