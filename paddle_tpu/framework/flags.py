@@ -95,6 +95,12 @@ define_flag("eager_delete_tensor_gb", 0.0,
 define_flag("use_pallas_kernels", True,
             "Lower hot fused ops (attention, layernorm) through Pallas TPU "
             "kernels when running on TPU; fall back to jnp otherwise.")
+define_flag("use_pallas_fused_bn", False,
+            "Route channels-last train-mode batch_norm through the Pallas "
+            "fused-BN kernels (ops/pallas/fused_bn.py). OFF by default: "
+            "measured SLOWER end-to-end than XLA's own epilogue fusion on "
+            "the v5e bench chip (974 vs 1971 img/s ResNet-50) -- see "
+            "PERF.md's round-4 roofline correction.")
 define_flag("allocator_strategy", "auto_growth",
             "allocator_strategy parity (allocator_strategy.h:21); informational "
             "on TPU -- PJRT owns HBM via BFC.")

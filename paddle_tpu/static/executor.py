@@ -358,7 +358,11 @@ class Executor:
         if isinstance(dataset, dict):
             if not dataset:
                 raise ValueError("train_from_dataset: empty dataset")
-            host = {k: np.asarray(v) for k, v in dataset.items()}
+            # values already on DEVICE stay there: chunk by device-side
+            # slicing (pulling them to host and re-uploading per epoch
+            # would cost two full-epoch tunnel transfers for nothing)
+            host = {k: (v if isinstance(v, jax.Array) else np.asarray(v))
+                    for k, v in dataset.items()}
             n_total = len(next(iter(host.values())))
 
             def raw_chunks():
@@ -439,10 +443,12 @@ class Executor:
             for name in feed_names:
                 v = chunk[name]
                 if len(v) < k:
-                    v = np.concatenate(
-                        [v, np.zeros((k - len(v),) + v.shape[1:],
+                    xp = jnp if isinstance(v, jax.Array) else np
+                    v = xp.concatenate(
+                        [v, xp.zeros((k - len(v),) + v.shape[1:],
                                      v.dtype)])
                 nbytes += v.nbytes
+                # device_put is a no-op for arrays already on device
                 feeds.append(jax.device_put(v))
             self._train_stats["max_chunk_bytes"] = max(
                 self._train_stats["max_chunk_bytes"], nbytes)

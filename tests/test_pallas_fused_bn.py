@@ -125,3 +125,27 @@ def test_stats_cotangents_flow():
     gr = jax.grad(loss_ref)(x)
     np.testing.assert_allclose(np.asarray(gp), np.asarray(gr), rtol=1e-4,
                                atol=1e-6)
+
+
+def test_mixed_dtype_params_grad():
+    """dbeta's cotangent must carry beta's dtype (custom_vjp contract)."""
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(64, 128), jnp.float32)
+    gamma = jnp.ones((128,), jnp.float32)
+    beta = jnp.zeros((128,), jnp.bfloat16)
+
+    def loss(x, g, b):
+        y, _, _ = fused_bn_act(x, g, b, 1e-5, True)
+        return jnp.sum(y.astype(jnp.float32))
+
+    dx, dg, db = jax.grad(loss, argnums=(0, 1, 2))(x, gamma, beta)
+    assert db.dtype == jnp.bfloat16 and dg.dtype == jnp.float32
+
+
+def test_flag_registry_gate():
+    import paddle_tpu as paddle
+    paddle.set_flags({"FLAGS_use_pallas_fused_bn": True})
+    try:
+        assert enabled() is True
+    finally:
+        paddle.set_flags({"FLAGS_use_pallas_fused_bn": False})
