@@ -43,7 +43,7 @@ from .layer.transformer import (  # noqa: F401
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
 from .layer.rnn import (  # noqa: F401
-    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
+    RNNCellBase, SimpleRNNCell, LSTMCell, LSTMPCell, GRUCell, RNN, BiRNN, SimpleRNN,
     LSTM, GRU,
 )
 from .layer.loss import HSigmoidLoss  # noqa: F401

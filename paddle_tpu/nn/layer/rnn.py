@@ -119,6 +119,67 @@ class LSTMCell(RNNCellBase):
         return new_h, (new_h, new_c)
 
 
+def _lstmp_cell_fn(x, h, c, w_ih, w_hh, w_ph, b_ih, b_hh):
+    gates = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    new_c = f * c + i * g
+    h_raw = o * jnp.tanh(new_c)
+    new_h = h_raw @ w_ph.T          # recurrent projection
+    return new_h, new_c
+
+
+_lstmp_cell_p = Primitive("lstmp_cell", _lstmp_cell_fn, multi_output=True)
+
+
+class LSTMPCell(RNNCellBase):
+    """LSTM cell with recurrent projection — the lstmp op
+    (operators/lstmp_op.h, the Sak et al. LSTMP recipe): the cell state
+    keeps ``hidden_size`` width while the recurrent/output state is the
+    PROJECTED ``proj_size`` vector h_t = W_proj·(o⊙tanh(c_t)).  Drive a
+    sequence with ``nn.RNN(LSTMPCell(...))``."""
+
+    def __init__(self, input_size, hidden_size, proj_size,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 weight_ph_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, proj_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.weight_ph = self.create_parameter([proj_size, hidden_size],
+                                               weight_ph_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.proj_size = proj_size
+
+    @property
+    def state_shape(self):
+        return ((self.proj_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        new_h, new_c = _lstmp_cell_p(inputs, h, c, self.weight_ih,
+                                     self.weight_hh, self.weight_ph,
+                                     self.bias_ih, self.bias_hh)
+        return new_h, (new_h, new_c)
+
+
 def _gru_cell_fn(x, h, w_ih, w_hh, b_ih, b_hh):
     gi = x @ w_ih.T + b_ih
     gh = h @ w_hh.T + b_hh

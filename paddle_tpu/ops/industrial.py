@@ -181,6 +181,86 @@ def tdm_child(x, tree_info, child_nums: int):
     return Tensor(children), Tensor(mask)
 
 
+def tdm_sampler(x, travel, layer, neg_samples_num_list, layer_offset_lod,
+                output_positive: bool = True, seed: int = None):
+    """tdm_sampler_op.h: per-layer positive + negative sampling along each
+    leaf's tree path (the TDM training-pair generator).
+
+    ``x`` [N] item ids index rows of ``travel`` [n_items, n_layers] (the
+    path node at each layer; 0 = padding); ``layer`` is the flat node-id
+    array with ``layer_offset_lod`` giving each layer's [start, end)
+    range.  Negatives draw uniformly WITHOUT replacement from the layer,
+    never equal to the positive (the reference's rejection loop).  Returns
+    (out [N, L], labels [N, L], mask [N, L]) with L = Σ(neg_i +
+    output_positive); padding layers emit zeros with mask 0.  Host-side —
+    it is a data-prep op in the reference too (CPU-only kernel)."""
+    rng = np.random.RandomState(seed if seed is not None
+                                else np.random.randint(1 << 31))
+    ids = np.asarray(x.numpy() if isinstance(x, Tensor) else x,
+                     np.int64).ravel()
+    trav = np.asarray(travel.numpy() if isinstance(travel, Tensor)
+                      else travel, np.int64)
+    lay = np.asarray(layer.numpy() if isinstance(layer, Tensor)
+                     else layer, np.int64).ravel()
+    offs = list(layer_offset_lod)
+    negs = list(neg_samples_num_list)
+    pos = 1 if output_positive else 0
+    L = sum(n + pos for n in negs)
+    out = np.zeros((len(ids), L), np.int64)
+    lab = np.zeros((len(ids), L), np.int64)
+    mask = np.ones((len(ids), L), np.int64)
+    for i, item in enumerate(ids.tolist()):
+        off = 0
+        for li, n_neg in enumerate(negs):
+            nodes = lay[offs[li]:offs[li + 1]]
+            positive = int(trav[item, li])
+            width = n_neg + pos
+            if positive == 0:                     # padding layer
+                out[i, off:off + width] = 0
+                lab[i, off:off + width] = 0
+                mask[i, off:off + width] = 0
+                off += width
+                continue
+            if pos:
+                out[i, off] = positive
+                lab[i, off] = 1
+                off += 1
+            cand = nodes[nodes != positive]
+            if n_neg > len(cand):
+                raise ValueError(
+                    f"tdm_sampler: layer {li} has {len(nodes)} nodes — "
+                    f"cannot draw {n_neg} negatives distinct from the "
+                    f"positive; lower neg_samples_num_list[{li}]")
+            pick = rng.choice(len(cand), size=n_neg, replace=False)
+            out[i, off:off + n_neg] = cand[pick]
+            lab[i, off:off + n_neg] = 0
+            off += n_neg
+    return (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(lab)),
+            Tensor(jnp.asarray(mask)))
+
+
+def _nce_fn(x, lab, wt, b, key_raw, num_neg_samples=10,
+            num_total_classes=0):
+    # the key travels as RAW int32 data (static Variables cannot carry
+    # typed PRNG-key avals); rebuild the typed key here
+    key = jax.random.wrap_key_data(
+        jax.lax.bitcast_convert_type(key_raw, jnp.uint32))
+    lab = lab.astype(jnp.int32).reshape(-1)
+    v = int(num_total_classes) or wt.shape[0]
+    neg = jax.random.randint(key, (x.shape[0], int(num_neg_samples)), 0, v)
+    log_q = jnp.log(jnp.asarray(num_neg_samples / v, x.dtype))
+    s_true = jnp.einsum("bd,bd->b", x, wt[lab]) + b[lab] - log_q
+    s_neg = jnp.einsum("bd,bnd->bn", x, wt[neg]) + b[neg] - log_q
+    loss = (jax.nn.softplus(-s_true) +
+            jax.nn.softplus(s_neg).sum(axis=1))
+    return loss[:, None]
+
+
+from ..framework.primitive import Primitive  # noqa: E402
+
+_nce_p = Primitive("nce", _nce_fn)
+
+
 def nce_loss(input, label, weight, bias=None, num_neg_samples: int = 10,
              num_total_classes: int = None, seed: int = None):
     """nce_op.h: noise-contrastive estimation with a uniform sampler.
@@ -189,17 +269,42 @@ def nce_loss(input, label, weight, bias=None, num_neg_samples: int = 10,
     q = num_neg/V (uniform sampler probability mass per draw).
     ``seed=None`` draws FRESH negatives from the framework generator each
     call — a fixed default seed would pin the negative set and degenerate
-    training."""
-    x = _arr(input)
-    lab = _arr(label).astype(jnp.int32).reshape(-1)
-    wt = _arr(weight)
-    v = int(num_total_classes or wt.shape[0])
-    b = _arr(bias) if bias is not None else jnp.zeros((v,), x.dtype)
-    key = _fresh_key(seed)
-    neg = jax.random.randint(key, (x.shape[0], int(num_neg_samples)), 0, v)
-    log_q = jnp.log(jnp.asarray(num_neg_samples / v, x.dtype))
-    s_true = jnp.einsum("bd,bd->b", x, wt[lab]) + b[lab] - log_q
-    s_neg = jnp.einsum("bd,bnd->bn", x, wt[neg]) + b[neg] - log_q
-    loss = (jax.nn.softplus(-s_true) +
-            jax.nn.softplus(s_neg).sum(axis=1))
-    return Tensor(loss[:, None])
+    training.  Registered as a primitive, so it records into static
+    programs; there the key rides a persistable refreshed by a pre-run
+    hook (the Executor's lr-feed pattern), so every exe.run resamples."""
+    from ..framework import core
+    v = num_total_classes or (
+        weight.shape[0] if hasattr(weight, "shape") else None)
+    if bias is None:
+        bias = jnp.zeros((int(v),), jnp.float32)
+    if core.in_static_mode() and seed is None:
+        key = _static_fresh_key_var("nce")
+    else:
+        key = _key_raw(_fresh_key(seed))
+    return _nce_p(input, label, weight, bias, key,
+                  num_neg_samples=int(num_neg_samples),
+                  num_total_classes=int(v))
+
+
+def _static_fresh_key_var(tag: str):
+    """A persistable key Variable re-drawn from the framework generator by
+    a pre-run hook, so recorded sampling ops get FRESH randomness on every
+    Executor.run instead of a baked-in constant key."""
+    from ..framework.random import default_generator
+    from ..static.program import current_block
+    from ..static.executor import global_scope
+    block = current_block()
+    name = f"@{tag}_key_{len(block.ops)}"
+    k0 = _key_raw(_fresh_key(None))
+    var = block.create_var(name=name, shape=list(k0.shape),
+                           dtype="int32", persistable=True)
+    global_scope().set_var(name, k0)
+    block.program._pre_run_hooks.append(
+        lambda sc, n=name: sc.set_var(
+            n, _key_raw(default_generator.next_key())))
+    return var
+
+
+def _key_raw(key):
+    """Typed PRNG key -> raw int32 data (Variable-representable)."""
+    return jax.lax.bitcast_convert_type(jax.random.key_data(key), jnp.int32)

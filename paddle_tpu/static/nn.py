@@ -230,3 +230,108 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
 
 # -- control flow (layers/control_flow.py parity) ----------------------------
 from ..ops.control_flow import while_loop, cond, case, switch_case  # noqa: F401,E402
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    """fluid.layers.group_norm parity (group_norm_op.cc)."""
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    w = _make_param([c], "float32", param_attr, I.Constant(1.0), "gn_s")
+    b = _make_param([c], "float32", bias_attr, I.Constant(0.0), "gn_b")
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b,
+                       data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    """fluid.layers.instance_norm parity (instance_norm_op.cc)."""
+    c = input.shape[1]
+    w = _make_param([c], "float32", param_attr, I.Constant(1.0), "in_s")
+    b = _make_param([c], "float32", bias_attr, I.Constant(0.0), "in_b")
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None):
+    """fluid.layers.conv3d parity (conv3d_op)."""
+    in_ch = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 3
+    w = _make_param([num_filters, in_ch // groups] + list(ks), "float32",
+                    param_attr, I.XavierUniform(), "conv3d_w")
+    b = _make_param([num_filters], "float32", bias_attr, I.Constant(0.0),
+                    "conv3d_b")
+    out = F.conv3d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    """fluid.layers.bilinear_tensor_product parity
+    (bilinear_tensor_product_op.cc): out_k = x·W_k·yᵀ + b."""
+    from .. import ops
+    w = _make_param([size, x.shape[-1], y.shape[-1]], "float32", param_attr,
+                    I.XavierUniform(), "blt_w")
+    b = _make_param([size], "float32", bias_attr, I.Constant(0.0), "blt_b")
+    out = ops.bilinear_tensor_product(x, y, w, b)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """fluid.layers.row_conv parity (row_conv_op.cc): lookahead conv."""
+    from .. import ops
+    w = _make_param([future_context_size + 1, input.shape[-1]], "float32",
+                    param_attr, I.XavierUniform(), "rowconv_w")
+    out = ops.row_conv(input, w)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, param_attr=None,
+                  bias_attr=None, act=None, name=None):
+    """fluid.layers.sequence_conv parity (sequence_conv_op.cc) over the
+    masked-dense sequence carrier."""
+    from .. import ops
+    w = _make_param([filter_size * input.shape[-1], num_filters], "float32",
+                    param_attr, I.XavierUniform(), "seqconv_w")
+    out = ops.sequence_conv(input, w, context_length=filter_size)
+    if bias_attr is not False:
+        b = _make_param([num_filters], "float32", bias_attr,
+                        I.Constant(0.0), "seqconv_b")
+        out = out + b
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def nce(input, label, num_total_classes, num_neg_samples=10,
+        param_attr=None, bias_attr=None, name=None, sampler="uniform",
+        seed=None):
+    """fluid.layers.nce parity (nce_op.h, uniform sampler): builds the
+    class weight/bias params and returns the per-example NCE loss."""
+    from .. import ops
+    if sampler != "uniform":
+        raise NotImplementedError(
+            f"static.nn.nce sampler={sampler!r}: only the uniform sampler "
+            f"is built (log_uniform/custom_dist need their own q "
+            f"corrections)")
+    w = _make_param([num_total_classes, input.shape[-1]], "float32",
+                    param_attr, I.XavierUniform(), "nce_w")
+    b = _make_param([num_total_classes], "float32", bias_attr,
+                    I.Constant(0.0), "nce_b")
+    return ops.nce_loss(input, label, w, b,
+                        num_neg_samples=num_neg_samples,
+                        num_total_classes=num_total_classes,
+                        seed=seed)

@@ -223,3 +223,115 @@ def test_industrial_rng_and_hash_contracts():
     import pytest as _pytest
     with _pytest.raises(ValueError, match="pool_type"):
         I.spp(rng.randn(1, 1, 4, 4).astype("float32"), pool_type="sum")
+
+
+def test_lstmp_cell():
+    """lstmp_op.h parity: projection narrows the recurrent state; a
+    sequence driven through nn.RNN(LSTMPCell) matches a manual unroll."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(4)
+    cell = nn.LSTMPCell(input_size=6, hidden_size=10, proj_size=3)
+    rnn = nn.RNN(cell)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 5, 6).astype("float32"))
+    out, (h, c) = rnn(x)
+    assert list(out.shape) == [2, 5, 3]       # projected width
+    assert list(h.shape) == [2, 3] and list(c.shape) == [2, 10]
+    # manual unroll equivalence
+    Wih, Whh = cell.weight_ih.numpy(), cell.weight_hh.numpy()
+    Wph = cell.weight_ph.numpy()
+    b = cell.bias_ih.numpy() + cell.bias_hh.numpy()
+    hh = np.zeros((2, 3), np.float32); ccv = np.zeros((2, 10), np.float32)
+    xs = x.numpy()
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for t in range(5):
+        gates = xs[:, t] @ Wih.T + hh @ Whh.T + b
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        ccv = sig(f) * ccv + sig(i) * np.tanh(g)
+        hh = (sig(o) * np.tanh(ccv)) @ Wph.T
+    np.testing.assert_allclose(out.numpy()[:, -1], hh, rtol=1e-4, atol=1e-5)
+    # gradients flow through the projection
+    loss = (out * out).sum()
+    loss.backward()
+    assert cell.weight_ph.grad is not None
+
+
+def test_tdm_sampler():
+    import numpy as np
+    from paddle_tpu.ops import industrial as I
+    # 2 layers: layer0 nodes [1,2], layer1 nodes [3,4,5,6]
+    layer = np.array([1, 2, 3, 4, 5, 6], np.int64)
+    offs = [0, 2, 6]
+    # item paths: item 0 -> [1, 3]; item 1 -> [2, 5]; item 2 padded layer1
+    travel = np.array([[1, 3], [2, 5], [1, 0]], np.int64)
+    out, lab, mask = I.tdm_sampler(np.array([0, 1, 2]), travel, layer,
+                                   neg_samples_num_list=[1, 2],
+                                   layer_offset_lod=offs, seed=0)
+    out, lab, mask = out.numpy(), lab.numpy(), mask.numpy()
+    assert out.shape == (3, 5)                 # (1+1) + (1+2)
+    # row 0: positive 1 then a negative != 1 from layer0; positive 3 then
+    # two distinct negatives != 3 from layer1
+    assert out[0, 0] == 1 and lab[0, 0] == 1
+    assert out[0, 1] in (2,) and lab[0, 1] == 0
+    assert out[0, 2] == 3 and lab[0, 2] == 1
+    assert set(out[0, 3:]) <= {4, 5, 6} and len(set(out[0, 3:])) == 2
+    # padded layer -> zeros, mask 0
+    assert (out[2, 2:] == 0).all() and (mask[2, 2:] == 0).all()
+    assert (mask[:2] == 1).all()
+
+
+def test_static_nce_resamples_per_run():
+    """Static NCE must draw FRESH negatives on every Executor.run (the
+    key rides a pre-run-hook-refreshed persistable, not a baked constant)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            xa = static.data("xa", [4, 8], "float32")
+            lbl = static.data("lbl", [4], "int64")
+            loss = static.nn.nce(xa, lbl, num_total_classes=5000,
+                                 num_neg_samples=5)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feeds = {"xa": rng.randn(4, 8).astype("float32"),
+                 "lbl": rng.randint(0, 5000, (4,)).astype("int64")}
+        a = exe.run(main, feed=feeds, fetch_list=[loss])[0]
+        b = exe.run(main, feed=feeds, fetch_list=[loss])[0]
+        assert not np.allclose(a, b), "negatives pinned across runs"
+    finally:
+        paddle.disable_static()
+
+
+def test_tdm_sampler_rejects_oversampling():
+    import numpy as np
+    import pytest as _pytest
+    from paddle_tpu.ops import industrial as I
+    layer = np.array([1, 2], np.int64)
+    travel = np.array([[1]], np.int64)
+    with _pytest.raises(ValueError, match="layer 0"):
+        I.tdm_sampler(np.array([0]), travel, layer,
+                      neg_samples_num_list=[2], layer_offset_lod=[0, 2])
+
+
+def test_static_nce_rejects_unknown_sampler():
+    import pytest as _pytest
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            xa = static.data("xa", [4, 8], "float32")
+            lbl = static.data("lbl", [4], "int64")
+            with _pytest.raises(NotImplementedError, match="sampler"):
+                static.nn.nce(xa, lbl, num_total_classes=50,
+                              sampler="log_uniform")
+    finally:
+        paddle.disable_static()
