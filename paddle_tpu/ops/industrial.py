@@ -19,6 +19,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..framework.primitive import Primitive
 from ..framework.tensor import Tensor, unwrap
 
 
@@ -181,6 +182,58 @@ def tdm_child(x, tree_info, child_nums: int):
     return Tensor(children), Tensor(mask)
 
 
+def _attention_lstm_fn(x, lengths, c0, h0, attn_w, attn_b, scalar,
+                       scalar_b, lstm_w, lstm_b):
+    """attention_lstm_op.cc math over masked-dense sequences.
+
+    Per step t: scores = relu(scalar·relu([x, tile(c)]·attn_w + attn_b)
+    + scalar_b) softmaxed over the valid positions; context = Σ att·x;
+    gates = [context, h]·lstm_w + lstm_b (i, f, c̃, o); standard LSTM
+    update.  x [B, T, M]; returns hidden states [B, T, D] (positions past
+    each length zeroed)."""
+    B, T, M = x.shape
+    D = c0.shape[-1]
+    mask = (jnp.arange(T)[None, :] < lengths[:, None])           # [B, T]
+    w_x, w_c = attn_w[:M], attn_w[M:]                            # [(M|D),1]
+    sx = jnp.einsum("btm,mo->bto", x, w_x)[..., 0]               # [B, T]
+
+    def step(carry, t):
+        h, c = carry
+        s = sx + (c @ w_c)[..., 0][:, None] + attn_b.reshape(())
+        s = jnp.maximum(s, 0.0)
+        s = jnp.maximum(s * scalar.reshape(()) + scalar_b.reshape(()), 0.0)
+        s = jnp.where(mask, s, -jnp.inf)
+        att = jax.nn.softmax(s, axis=1)                          # [B, T]
+        ctx = jnp.einsum("bt,btm->bm", att, x)                   # [B, M]
+        gates = jnp.concatenate([ctx, h], axis=-1) @ lstm_w + lstm_b
+        i, f, cc, o = jnp.split(gates, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(cc)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        # steps past a sequence's length freeze its carry, so the final
+        # (h, c) is the state at its LAST VALID step
+        alive = (t < lengths)[:, None]
+        h_new = jnp.where(alive, h_new, h)
+        c_new = jnp.where(alive, c_new, c)
+        return (h_new, c_new), h_new
+
+    (h_fin, c_fin), hs = jax.lax.scan(step, (h0, c0), jnp.arange(T))
+    out = jnp.transpose(hs, (1, 0, 2)) * mask[..., None]         # [B, T, D]
+    return out, h_fin, c_fin
+
+
+_attention_lstm_p = Primitive("attention_lstm", _attention_lstm_fn,
+                              multi_output=True)
+
+
+def attention_lstm(x, lengths, c0, h0, attn_w, attn_b, attn_scalar,
+                   attn_scalar_b, lstm_w, lstm_b):
+    """attention_lstm_op.cc parity: per-step attention over the sequence
+    conditioned on the previous cell state, feeding a standard LSTM.
+    Masked-dense carrier (x [B, T, M] + lengths) instead of LoD."""
+    return _attention_lstm_p(x, lengths, c0, h0, attn_w, attn_b,
+                             attn_scalar, attn_scalar_b, lstm_w, lstm_b)
+
+
 def tdm_sampler(x, travel, layer, neg_samples_num_list, layer_offset_lod,
                 output_positive: bool = True, seed: int = None):
     """tdm_sampler_op.h: per-layer positive + negative sampling along each
@@ -194,8 +247,11 @@ def tdm_sampler(x, travel, layer, neg_samples_num_list, layer_offset_lod,
     (out [N, L], labels [N, L], mask [N, L]) with L = Σ(neg_i +
     output_positive); padding layers emit zeros with mask 0.  Host-side —
     it is a data-prep op in the reference too (CPU-only kernel)."""
-    rng = np.random.RandomState(seed if seed is not None
-                                else np.random.randint(1 << 31))
+    if seed is None:
+        # derive from the framework generator so paddle.seed() pins TDM
+        # sampling like every other sampling op here
+        seed = int(jax.random.randint(_fresh_key(None), (), 0, (1 << 31) - 1))
+    rng = np.random.RandomState(seed)
     ids = np.asarray(x.numpy() if isinstance(x, Tensor) else x,
                      np.int64).ravel()
     trav = np.asarray(travel.numpy() if isinstance(travel, Tensor)
@@ -256,8 +312,6 @@ def _nce_fn(x, lab, wt, b, key_raw, num_neg_samples=10,
     return loss[:, None]
 
 
-from ..framework.primitive import Primitive  # noqa: E402
-
 _nce_p = Primitive("nce", _nce_fn)
 
 
@@ -273,8 +327,12 @@ def nce_loss(input, label, weight, bias=None, num_neg_samples: int = 10,
     programs; there the key rides a persistable refreshed by a pre-run
     hook (the Executor's lr-feed pattern), so every exe.run resamples."""
     from ..framework import core
-    v = num_total_classes or (
-        weight.shape[0] if hasattr(weight, "shape") else None)
+    if num_total_classes:
+        v = int(num_total_classes)
+    elif hasattr(weight, "shape"):
+        v = int(weight.shape[0])
+    else:
+        v = len(weight)
     if bias is None:
         bias = jnp.zeros((int(v),), jnp.float32)
     if core.in_static_mode() and seed is None:

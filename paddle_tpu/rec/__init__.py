@@ -1,6 +1,6 @@
 """Recommendation models (BASELINE workload 5: Wide&Deep CTR)."""
 from .wide_deep import WideDeep, WideDeepTrainer, synthetic_ctr_batch  # noqa: F401
-from .hogwild import HogwildTrainer  # noqa: F401
+from .hogwild import HogwildTrainer, PSGPUTrainer  # noqa: F401
 
 __all__ = ["WideDeep", "WideDeepTrainer", "HogwildTrainer",
-           "synthetic_ctr_batch"]
+           "PSGPUTrainer", "synthetic_ctr_batch"]

@@ -136,3 +136,25 @@ class HogwildTrainer:
         (pointer swap, no copy) — call before eval/save."""
         for name, p in dense_param_map(self.model, self._params):
             p._value = self._params[name]
+
+
+class PSGPUTrainer:
+    """trainer.h:281 PSGPUTrainer parity, by construction: the device-cache
+    WideDeepTrainer IS the PSGPU architecture — BuildGPUPS ≙ the cache fill
+    (export_rows → device arenas), the on-accelerator sparse optimizer ≙
+    apply_rule_device inside the fused step, EndPass ≙ writeback_all.
+    This named wrapper forces cache mode on and exposes the reference's
+    end_pass() verb."""
+
+    def __init__(self, model, lr: float = 1e-3,
+                 cache_capacity: int = 1 << 20, **kw):
+        from .wide_deep import WideDeepTrainer
+        self._inner = WideDeepTrainer(model, lr=lr, device_cache=True,
+                                      cache_capacity=cache_capacity, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def end_pass(self):
+        """PSGPUWrapper::EndPass — flush every cached row to the tables."""
+        self._inner.flush()

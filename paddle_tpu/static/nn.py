@@ -232,12 +232,28 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
 from ..ops.control_flow import while_loop, cond, case, switch_case  # noqa: F401,E402
 
 
+def _fill_affine_pair(w, b, c):
+    """param_attr=False with a live bias (or vice versa) still needs BOTH
+    affine operands — the functionals dispatch to the no-affine primitive
+    whenever weight is None, which would silently drop the other half."""
+    from ..framework.tensor import Parameter
+    import jax.numpy as jnp
+    if w is None and b is not None:
+        w = Parameter(jnp.ones([c], jnp.float32))
+        w.stop_gradient = True
+    if b is None and w is not None:
+        b = Parameter(jnp.zeros([c], jnp.float32))
+        b.stop_gradient = True
+    return w, b
+
+
 def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
                act=None, data_layout="NCHW", name=None):
     """fluid.layers.group_norm parity (group_norm_op.cc)."""
     c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
     w = _make_param([c], "float32", param_attr, I.Constant(1.0), "gn_s")
     b = _make_param([c], "float32", bias_attr, I.Constant(0.0), "gn_b")
+    w, b = _fill_affine_pair(w, b, c)
     out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b,
                        data_format=data_layout)
     if act:
@@ -251,6 +267,7 @@ def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
     c = input.shape[1]
     w = _make_param([c], "float32", param_attr, I.Constant(1.0), "in_s")
     b = _make_param([c], "float32", bias_attr, I.Constant(0.0), "in_b")
+    w, b = _fill_affine_pair(w, b, c)
     return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
 
 

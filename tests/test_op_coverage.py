@@ -335,3 +335,51 @@ def test_static_nce_rejects_unknown_sampler():
                               sampler="log_uniform")
     finally:
         paddle.disable_static()
+
+
+def test_attention_lstm_matches_numpy_unroll():
+    """attention_lstm_op.cc parity: cell-conditioned attention feeding a
+    standard LSTM, vs a literal numpy transcription."""
+    import numpy as np
+    from paddle_tpu.ops import industrial as I
+
+    rng = np.random.RandomState(0)
+    B, T, M, D = 2, 5, 4, 3
+    x = rng.randn(B, T, M).astype("float32")
+    lengths = np.array([5, 3])
+    c0 = rng.randn(B, D).astype("float32") * 0.1
+    h0 = np.zeros((B, D), np.float32)
+    attn_w = rng.randn(M + D, 1).astype("float32")
+    attn_b = np.float32(0.1)
+    scal = np.float32(1.5)
+    scal_b = np.float32(-0.05)
+    lstm_w = rng.randn(M + D, 4 * D).astype("float32") * 0.3
+    lstm_b = rng.randn(4 * D).astype("float32") * 0.1
+
+    out, h_f, c_f = I.attention_lstm(x, lengths, c0, h0, attn_w,
+                                     attn_b, scal, scal_b, lstm_w, lstm_b)
+    out = out.numpy()
+
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for b in range(B):
+        h = h0[b].copy(); c = c0[b].copy()
+        L = lengths[b]
+        for t in range(L):
+            s = np.concatenate(
+                [x[b], np.tile(c, (T, 1))], axis=1) @ attn_w
+            s = np.maximum(s[:, 0] + attn_b, 0)
+            s = np.maximum(s * scal + scal_b, 0)
+            s[L:] = -np.inf
+            e = np.exp(s - s[:L].max()); e[L:] = 0
+            att = e / e.sum()
+            ctx = att @ x[b]
+            gates = np.concatenate([ctx, h]) @ lstm_w + lstm_b
+            i, f, cc, o = np.split(gates, 4)
+            c = sig(f) * c + sig(i) * np.tanh(cc)
+            h = sig(o) * np.tanh(c)
+            np.testing.assert_allclose(out[b, t], h, rtol=2e-4, atol=1e-5,
+                                       err_msg=f"b={b} t={t}")
+        # past the length: outputs zero, final state frozen at step L-1
+        assert (out[b, L:] == 0).all()
+        np.testing.assert_allclose(h_f.numpy()[b], h, rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(c_f.numpy()[b], c, rtol=2e-4, atol=1e-5)

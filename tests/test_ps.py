@@ -670,3 +670,19 @@ def test_hogwild_worker_error_surfaces():
     ids, dense, label = synthetic_ctr_batch(32, vocab=1_000, seed=0)
     with pytest.raises(Exception):
         tr.train([(ids, dense[:, :2], label)], num_threads=2)  # bad shape
+
+
+def test_psgpu_trainer_alias():
+    """trainer.h:281 PSGPUTrainer: forced device-cache mode + end_pass."""
+    from paddle_tpu.rec import PSGPUTrainer, WideDeep
+    from paddle_tpu.rec.wide_deep import synthetic_ctr_batch
+    paddle.seed(0)
+    m = WideDeep(hidden=(16,), emb_dim=4)
+    t = PSGPUTrainer(m)
+    assert t._use_cache            # delegated attribute
+    ids, dense, label = synthetic_ctr_batch(64, vocab=5_000, seed=0)
+    losses = [t.step(ids, dense, label) for _ in range(5)]
+    t.end_pass()
+    assert losses[-1] < losses[0]
+    rows = m.client.pull_sparse(1, np.unique(ids))
+    assert np.abs(rows).sum() > 0  # EndPass wrote the cache back

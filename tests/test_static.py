@@ -807,3 +807,30 @@ def test_static_nn_dsl_round4_builders():
             assert np.isfinite(v).all()
     finally:
         paddle.disable_static()
+
+
+def test_static_norm_builders_partial_affine():
+    """param_attr=False / bias_attr=False halves must not crash or drop
+    the live half (review regression)."""
+    import paddle_tpu.static as static
+    from paddle_tpu.nn.layer.layers import ParamAttr
+    from paddle_tpu.nn import initializer as I
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            img = static.data("img", [2, 4, 4, 4], "float32")
+            a = static.nn.group_norm(img, groups=2, bias_attr=False)
+            b = static.nn.group_norm(
+                img, groups=2, param_attr=False,
+                bias_attr=ParamAttr(initializer=I.Constant(5.0)))
+            cvar = static.nn.instance_norm(img, bias_attr=False)
+        exe = static.Executor()
+        exe.run(startup)
+        feeds = {"img": np.random.RandomState(0)
+                 .randn(2, 4, 4, 4).astype("float32")}
+        va, vb, vc = exe.run(main, feed=feeds, fetch_list=[a, b, cvar])
+        assert np.isfinite(va).all() and np.isfinite(vc).all()
+        assert abs(vb.mean() - 5.0) < 0.2       # the bias is APPLIED
+    finally:
+        paddle.disable_static()
