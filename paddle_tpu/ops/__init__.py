@@ -31,7 +31,7 @@ from .linalg import cov, corrcoef  # noqa: F401
 from .industrial import (  # noqa: F401
     batch_fc, fsp_matrix, shuffle_batch, hash_bucket, spp,
     positive_negative_pair, tdm_child, tdm_sampler, nce_loss,
-    attention_lstm,
+    attention_lstm, filter_by_instag,
 )
 from . import (  # noqa: F401
     creation, math, manipulation, linalg, control_flow, math_ext, sequence,

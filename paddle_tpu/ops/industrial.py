@@ -166,6 +166,38 @@ def positive_negative_pair(score, label, query_id, weight=None, column=-1):
     return mk(pos), mk(neg), mk(neu)
 
 
+def filter_by_instag(ins, ins_tag, filter_tag, out_val_if_empty: int = 0,
+                     pad_value: int = -1):
+    """filter_by_instag_op.h: keep the rows whose tag set intersects the
+    filter tags — the industrial sample router (e.g. train one tower on a
+    sub-population of a mixed batch).
+
+    ``ins`` [N, ...] rows; ``ins_tag`` [N, K] per-row tags padded with
+    ``pad_value``; ``filter_tag`` [M].  Returns (out [kept, ...],
+    loss_weight [kept, 1], index_map [kept, 2] of (out_row, src_row)).
+    When nothing matches, emits ONE row filled with ``out_val_if_empty``
+    and loss weight 0 (the reference's empty-output contract).  Host-side:
+    the output size is data-dependent (CPU-only kernel in the reference
+    too)."""
+    x = np.asarray(ins.numpy() if isinstance(ins, Tensor) else ins)
+    tags = np.asarray(ins_tag.numpy() if isinstance(ins_tag, Tensor)
+                      else ins_tag)
+    want = np.asarray(filter_tag.numpy() if isinstance(filter_tag, Tensor)
+                      else filter_tag).ravel()
+    keep = (np.isin(tags, want) & (tags != pad_value)).any(axis=1)
+    idx = np.nonzero(keep)[0]
+    if len(idx) == 0:
+        out = np.full((1,) + x.shape[1:], out_val_if_empty, x.dtype)
+        lw = np.zeros((1, 1), np.float32)
+        imap = np.zeros((1, 2), np.int64)
+    else:
+        out = x[idx]
+        lw = np.ones((len(idx), 1), np.float32)
+        imap = np.stack([np.arange(len(idx)), idx], axis=1).astype(np.int64)
+    return (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(lw)),
+            Tensor(jnp.asarray(imap)))
+
+
 def tdm_child(x, tree_info, child_nums: int):
     """tdm_child_op.h: gather each node's children from the TDM tree table.
     tree_info rows are [item_id, layer_id, ancestor_id, child_0, …]; a

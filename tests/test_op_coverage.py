@@ -383,3 +383,22 @@ def test_attention_lstm_matches_numpy_unroll():
         assert (out[b, L:] == 0).all()
         np.testing.assert_allclose(h_f.numpy()[b], h, rtol=2e-4, atol=1e-5)
         np.testing.assert_allclose(c_f.numpy()[b], c, rtol=2e-4, atol=1e-5)
+
+
+def test_filter_by_instag():
+    import numpy as np
+    from paddle_tpu.ops import industrial as I
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    tags = np.array([[1, -1], [2, 3], [4, -1], [3, 1]], np.int64)
+    out, lw, imap = I.filter_by_instag(x, tags, np.array([3]))
+    np.testing.assert_array_equal(out.numpy(), x[[1, 3]])
+    np.testing.assert_array_equal(lw.numpy(), [[1.0], [1.0]])
+    np.testing.assert_array_equal(imap.numpy(), [[0, 1], [1, 3]])
+    # nothing matches -> one dummy row, zero loss weight
+    out2, lw2, _ = I.filter_by_instag(x, tags, np.array([99]),
+                                      out_val_if_empty=7)
+    assert out2.numpy().shape == (1, 3) and (out2.numpy() == 7).all()
+    assert lw2.numpy().item() == 0.0
+    # pad_value must never match, even if listed in the filter
+    out3, _, _ = I.filter_by_instag(x, tags, np.array([-1]))
+    assert (out3.numpy() == 0).all()        # dummy (no real match)
