@@ -25,3 +25,9 @@ from .enforce import (  # noqa: F401
     PermissionDeniedError, ExecutionTimeoutError, UnimplementedError,
     UnavailableError, FatalError, ExternalError,
 )
+
+# register the static-randomness primitive at import so deserialized
+# programs containing key_advance ops resolve it in any fresh process
+from .random import register_key_advance as _rka  # noqa: E402
+_rka()
+del _rka

@@ -73,3 +73,73 @@ def get_rng_state():
 
 def set_rng_state(state):
     default_generator.set_state(state)
+
+
+# -- static-program randomness ---------------------------------------------
+# A key recorded into a Program would otherwise be a baked CONSTANT (same
+# dropout mask / same negatives on every Executor.run and on every step of
+# a train_from_dataset scan).  static_advancing_key records a SELF-
+# ADVANCING key instead: a persistable holds raw int32 key data, and a
+# recorded key_advance op folds it forward and writes back to the SAME
+# var name — the executor carries it as a written persistable, so the key
+# advances per run AND per scanned step.
+
+def ensure_key(k):
+    """Typed PRNG key passthrough; raw int32 key data (the static-program
+    carrier — Variables cannot hold typed key avals) is rewrapped."""
+    import jax
+    import jax.numpy as jnp
+    if jnp.issubdtype(k.dtype, jax.dtypes.prng_key):
+        return k
+    return jax.random.wrap_key_data(
+        jax.lax.bitcast_convert_type(k, jnp.uint32))
+
+
+def key_raw(key):
+    """Typed PRNG key -> raw int32 data (Variable-representable)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.lax.bitcast_convert_type(jax.random.key_data(key), jnp.int32)
+
+
+def _advance_key_fn(raw):
+    import jax
+    return key_raw(jax.random.fold_in(ensure_key(raw), 1))
+
+
+_advance_p = None
+
+
+def register_key_advance():
+    """Create the key_advance primitive (idempotent).  Called at package
+    import so DESERIALIZED programs containing the op resolve it in a
+    fresh process, not only after static_advancing_key ran there."""
+    global _advance_p
+    if _advance_p is None:
+        from .primitive import Primitive
+        _advance_p = Primitive("key_advance", _advance_key_fn)
+    return _advance_p
+
+
+def static_advancing_key(tag: str = "rng"):
+    """Record a self-advancing key into the current Program; returns the
+    key Variable (raw int32 data — consumers rewrap via ensure_key)."""
+    from ..static.program import current_block
+    from ..static.executor import global_scope
+    advance = register_key_advance()
+    block = current_block()
+    name = f"@{tag}_key_{len(block.ops)}"
+    raw0 = key_raw(default_generator.next_key())
+    var = block.create_var(name=name, shape=list(raw0.shape),
+                           dtype="int32", persistable=True)
+    global_scope().set_var(name, raw0)
+    # fresh scopes / deserialized programs are seeded by the Executor
+    # (_collect_persistables treats key_advance inputs as self-seeding)
+    out = advance(var)
+    # self-aliasing write: the op's output takes the persistable's name,
+    # making it a WRITTEN persistable (scan-carried, scope-written-back);
+    # drop the auto-declared output var so no orphan metadata rides along
+    auto_name = out.name
+    out.op.output_names[0] = name
+    block.vars.pop(auto_name, None)
+    return block.var(name)

@@ -41,6 +41,8 @@ def linear(x, weight, bias=None, name=None):
 
 
 def _dropout_fn(x, key, p=0.5, mode="upscale_in_train", axis=None):
+    from ...framework.random import ensure_key
+    key = ensure_key(key)      # static programs carry raw int32 key data
     if p == 0.0:
         return x
     if axis is None:
@@ -64,7 +66,14 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             from ...ops.math import scale as _scale
             return _scale(x, scale=1.0 - p)
         return x
-    key = default_generator.next_key()
+    from ...framework import core as _core
+    if _core.in_static_mode():
+        # a plain next_key() would bake into the Program as a constant —
+        # identical masks on every run and every scanned step
+        from ...framework.random import static_advancing_key
+        key = static_advancing_key("dropout")
+    else:
+        key = default_generator.next_key()
     ax = tuple(int(a) for a in axis) if axis is not None else None
     if isinstance(ax, tuple) and len(ax) == 0:
         ax = None

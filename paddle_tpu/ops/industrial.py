@@ -327,12 +327,12 @@ def tdm_sampler(x, travel, layer, neg_samples_num_list, layer_offset_lod,
             Tensor(jnp.asarray(mask)))
 
 
-def _nce_fn(x, lab, wt, b, key_raw, num_neg_samples=10,
+def _nce_fn(x, lab, wt, b, key_data, num_neg_samples=10,
             num_total_classes=0):
     # the key travels as RAW int32 data (static Variables cannot carry
     # typed PRNG-key avals); rebuild the typed key here
-    key = jax.random.wrap_key_data(
-        jax.lax.bitcast_convert_type(key_raw, jnp.uint32))
+    from ..framework.random import ensure_key
+    key = ensure_key(key_data)
     lab = lab.astype(jnp.int32).reshape(-1)
     v = int(num_total_classes) or wt.shape[0]
     neg = jax.random.randint(key, (x.shape[0], int(num_neg_samples)), 0, v)
@@ -368,33 +368,14 @@ def nce_loss(input, label, weight, bias=None, num_neg_samples: int = 10,
     if bias is None:
         bias = jnp.zeros((int(v),), jnp.float32)
     if core.in_static_mode() and seed is None:
-        key = _static_fresh_key_var("nce")
+        from ..framework.random import static_advancing_key
+        key = static_advancing_key("nce")   # advances per run AND per scan step
     else:
-        key = _key_raw(_fresh_key(seed))
+        from ..framework.random import key_raw
+        key = key_raw(_fresh_key(seed))
     return _nce_p(input, label, weight, bias, key,
                   num_neg_samples=int(num_neg_samples),
                   num_total_classes=int(v))
 
 
-def _static_fresh_key_var(tag: str):
-    """A persistable key Variable re-drawn from the framework generator by
-    a pre-run hook, so recorded sampling ops get FRESH randomness on every
-    Executor.run instead of a baked-in constant key."""
-    from ..framework.random import default_generator
-    from ..static.program import current_block
-    from ..static.executor import global_scope
-    block = current_block()
-    name = f"@{tag}_key_{len(block.ops)}"
-    k0 = _key_raw(_fresh_key(None))
-    var = block.create_var(name=name, shape=list(k0.shape),
-                           dtype="int32", persistable=True)
-    global_scope().set_var(name, k0)
-    block.program._pre_run_hooks.append(
-        lambda sc, n=name: sc.set_var(
-            n, _key_raw(default_generator.next_key())))
-    return var
 
-
-def _key_raw(key):
-    """Typed PRNG key -> raw int32 data (Variable-representable)."""
-    return jax.lax.bitcast_convert_type(jax.random.key_data(key), jnp.int32)

@@ -23,16 +23,17 @@ from .program import Operator, Variable, default_main_program
 
 
 def _segment_io(ops, block, param_names, loss_name):
-    """External inputs of the op segment: consumed but not produced and not
-    parameters (i.e. feed/data vars)."""
+    """External inputs of the op segment: names READ BEFORE any op in the
+    segment wrote them (order-aware — a self-aliasing read-then-write op
+    like the advancing RNG key or the BN running-stat update consumes its
+    own name externally first) and not parameters."""
     produced = set()
-    for op in ops:
-        produced.update(op.output_names)
     ext = []
     for op in ops:
         for n in op.input_names:
             if n not in produced and n not in param_names and n not in ext:
                 ext.append(n)
+        produced.update(op.output_names)
     return ext
 
 
@@ -96,6 +97,14 @@ def make_backward_fn(fwd_ops, param_names, ext_names, loss_name,
             env.update(zip(param_names, pv))
             for op in fwd_ops:
                 ins = [env[n] for n in op.input_names]
+                if op.prim == "key_advance":
+                    # the gradient replay must see the SAME randomness the
+                    # forward pass used: by @backward's execution the env
+                    # already holds the post-advance key, so advancing
+                    # again here would differentiate a different dropout
+                    # mask / negative set than the fetched loss
+                    env[op.output_names[0]] = ins[0]
+                    continue
                 outs = op.run_fn()(*ins)
                 for name, val in zip(op.output_names, outs):
                     env[name] = val

@@ -78,6 +78,32 @@ def scope_guard(scope):
     return guard()
 
 
+
+def _collect_persistables(program, scope, persist_names):
+    """Resolve persistable values, seeding RNG-key vars (key_advance
+    inputs) from the framework generator when a scope never saw them — a
+    deserialized program or a fresh Scope carries no record-time seeding,
+    and a missing KEY is not a user error the way a missing weight is."""
+    rng_keys = {op.input_names[0]
+                for op in program.global_block().ops
+                if op.prim == "key_advance"}
+    vals = []
+    for n in persist_names:
+        v = scope.find_var(n)
+        if v is None:
+            if n in rng_keys:
+                from ..framework.random import key_raw, default_generator
+                v = key_raw(default_generator.next_key())
+                scope.set_var(n, v)
+            else:
+                raise RuntimeError(
+                    f"persistable {n!r} not initialized — run the startup "
+                    f"program first (exe.run(paddle.static."
+                    f"default_startup_program()))")
+        vals.append(v)
+    return vals
+
+
 class Executor:
     """executor.py:475 parity."""
 
@@ -295,15 +321,8 @@ class Executor:
         for hook in getattr(program, "_pre_run_hooks", []):
             hook(scope)
 
-        persist_vals = []
-        for n in persist_names:
-            v = scope.find_var(n)
-            if v is None:
-                raise RuntimeError(
-                    f"persistable {n!r} not initialized — run the startup "
-                    f"program first (exe.run(paddle.static.default_startup_"
-                    f"program()))")
-            persist_vals.append(v)
+        persist_vals = _collect_persistables(program, scope,
+                                             persist_names)
 
         if compiled is not None and compiled._data_parallel:
             from ..parallel.api import batch_sharding
@@ -454,15 +473,8 @@ class Executor:
                 self._train_stats["max_chunk_bytes"], nbytes)
             return tuple(feeds), jax.device_put(mask), n
 
-        persist_vals = []
-        for n in persist_names:
-            v = scope.find_var(n)
-            if v is None:
-                raise RuntimeError(
-                    f"persistable {n!r} not initialized — run the startup "
-                    f"program first")
-            persist_vals.append(v)
-        persist_vals = tuple(persist_vals)
+        persist_vals = tuple(_collect_persistables(program, scope,
+                                                   persist_names))
 
         self._train_stats = {"chunks": 0, "max_chunk_bytes": 0}
         all_fetches = {n: [] for n in fetch_names}
