@@ -31,7 +31,8 @@ from .linalg import cov, corrcoef  # noqa: F401
 from .industrial import (  # noqa: F401
     batch_fc, fsp_matrix, shuffle_batch, hash_bucket, spp,
     positive_negative_pair, tdm_child, tdm_sampler, nce_loss,
-    attention_lstm, filter_by_instag,
+    attention_lstm, filter_by_instag, match_matrix_tensor,
+    sequence_topk_avg_pooling, var_conv_2d,
 )
 from . import (  # noqa: F401
     creation, math, manipulation, linalg, control_flow, math_ext, sequence,

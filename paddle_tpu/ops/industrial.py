@@ -379,3 +379,95 @@ def nce_loss(input, label, weight, bias=None, num_neg_samples: int = 10,
 
 
 
+
+
+def _match_matrix_fn(x, y, w, x_len, y_len):
+    out = jnp.einsum("bxd,dte,bye->btxy", x.astype(jnp.float32),
+                     w.astype(jnp.float32), y.astype(jnp.float32))
+    tx, ty = x.shape[1], y.shape[1]
+    mx = jnp.arange(tx)[None, :] < x_len[:, None]        # [B, Tx]
+    my = jnp.arange(ty)[None, :] < y_len[:, None]        # [B, Ty]
+    return out * (mx[:, None, :, None] & my[:, None, None, :])
+
+
+_match_matrix_p = Primitive("match_matrix_tensor", _match_matrix_fn)
+
+
+def match_matrix_tensor(x, y, w, x_len, y_len):
+    """match_matrix_tensor_op.h: the text-matching bilinear match matrix
+    out[b,t,i,j] = xᵢ·W_t·yⱼ over a left/right sequence pair.  Masked
+    dense: x [B, Tx, D], y [B, Ty, D], w [D, dim_t, D], per-example
+    lengths → [B, dim_t, Tx, Ty] with invalid cells zeroed."""
+    return _match_matrix_p(x, y, w, x_len, y_len)
+
+
+def _topk_avg_pool_fn(x, row_len, col_len, topks=(1,)):
+    # x [B, C, Tx, Ty]: per (b, c, row), average the top-k valid columns
+    b, c, tx, ty = x.shape
+    valid = jnp.arange(ty)[None, None, None, :] < \
+        col_len[:, None, None, None]                     # [B,1,1,Ty]
+    neg = jnp.asarray(-jnp.inf, jnp.float32)
+    xs = jnp.where(valid, x.astype(jnp.float32), neg)
+    xs = jnp.sort(xs, axis=-1)[..., ::-1]                # desc
+    csum = jnp.cumsum(jnp.where(jnp.isfinite(xs), xs, 0.0), axis=-1)
+    outs = []
+    for k in topks:
+        # average over min(k, n_valid) entries (reference divides by the
+        # ACTUAL count when the row has fewer than k valid columns)
+        kk = jnp.minimum(jnp.asarray(int(k)), col_len)[:, None, None]
+        idx = jnp.clip(kk - 1, 0, ty - 1)
+        top_sum = jnp.take_along_axis(
+            csum, jnp.broadcast_to(idx[..., None], (b, c, tx, 1)), axis=-1
+        )[..., 0]
+        outs.append(jnp.where(kk > 0, top_sum / jnp.maximum(kk, 1), 0.0))
+    out = jnp.stack(outs, axis=-1)                       # [B, C, Tx, K]
+    out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, tx, c * len(topks))
+    rows = jnp.arange(tx)[None, :] < row_len[:, None]
+    return out * rows[..., None]
+
+
+_topk_avg_p = Primitive("sequence_topk_avg_pooling", _topk_avg_pool_fn)
+
+
+def sequence_topk_avg_pooling(x, row_len, col_len, topks, channel_num=None):
+    """sequence_topk_avg_pooling_op.h: per match-matrix row, average the
+    top-k valid columns for each k in ``topks`` (fewer-than-k rows divide
+    by the actual count).  Masked dense: x [B, C, Tx, Ty] + row/col
+    lengths → [B, Tx, C·len(topks)]."""
+    c = _arr(x).shape[1]
+    if channel_num is not None and int(channel_num) != c:
+        raise ValueError(
+            f"sequence_topk_avg_pooling: channel_num={channel_num} does "
+            f"not match the input's channel axis ({c})")
+    return _topk_avg_p(x, row_len, col_len,
+                       topks=tuple(int(k) for k in topks))
+
+
+def var_conv_2d(x, w, row_len, col_len, stride=1, padding="SAME"):
+    """var_conv_2d_op.h: convolution over variable-size 2D feature maps
+    (each example's valid region differs).  Masked dense: zero the invalid
+    region, run ONE static conv, re-mask — the valid-output formula
+    ceil(len/stride) is the SAME-padding grid, so other paddings are
+    rejected rather than silently mislabeling zero-contaminated borders
+    as valid.  x [B, C, H, W], w [O, C, Kh, Kw]."""
+    from ..nn import functional as F
+    if padding != "SAME":
+        raise NotImplementedError(
+            "var_conv_2d supports padding='SAME' only (the masked-dense "
+            "valid-region arithmetic is the SAME grid)")
+    xa = _arr(x)
+    h, wd = xa.shape[2], xa.shape[3]
+    mh = jnp.arange(h)[None, :] < _arr(row_len)[:, None]
+    mw = jnp.arange(wd)[None, :] < _arr(col_len)[:, None]
+    mask = (mh[:, None, :, None] & mw[:, None, None, :])
+    masked = Tensor(xa * mask)
+    out = F.conv2d(masked, w, stride=stride, padding=padding)
+    oa = _arr(out)
+    oh, ow = oa.shape[2], oa.shape[3]
+    # valid output region shrinks per-axis with the SAME-padding grid
+    sh, sw = (stride, stride) if isinstance(stride, int) else         (stride[0], stride[1])
+    rl = (_arr(row_len) + sh - 1) // sh
+    cl = (_arr(col_len) + sw - 1) // sw
+    mh2 = jnp.arange(oh)[None, :] < rl[:, None]
+    mw2 = jnp.arange(ow)[None, :] < cl[:, None]
+    return Tensor(oa * (mh2[:, None, :, None] & mw2[:, None, None, :]))

@@ -402,3 +402,64 @@ def test_filter_by_instag():
     # pad_value must never match, even if listed in the filter
     out3, _, _ = I.filter_by_instag(x, tags, np.array([-1]))
     assert (out3.numpy() == 0).all()        # dummy (no real match)
+
+
+def test_text_matching_trio():
+    """match_matrix_tensor -> sequence_topk_avg_pooling -> var_conv_2d:
+    the pyramid text-matching pipeline over masked-dense pairs, each op
+    vs a numpy oracle."""
+    import numpy as np
+    from paddle_tpu.ops import industrial as I
+
+    rng = np.random.RandomState(0)
+    B, Tx, Ty, D, DT = 2, 4, 5, 3, 2
+    x = rng.randn(B, Tx, D).astype("float32")
+    y = rng.randn(B, Ty, D).astype("float32")
+    w = rng.randn(D, DT, D).astype("float32")
+    xl = np.array([4, 2]); yl = np.array([5, 3])
+    mm = I.match_matrix_tensor(x, y, w, xl, yl)
+    mm_np = np.asarray(mm.numpy() if hasattr(mm, "numpy") else mm)
+    # oracle cell
+    want = x[0, 1] @ w[:, 1, :] @ y[0, 3]
+    np.testing.assert_allclose(mm_np[0, 1, 1, 3], want, rtol=1e-4)
+    # masking: example 1 valid block is [2, 3]
+    assert (mm_np[1, :, 2:, :] == 0).all() and (mm_np[1, :, :, 3:] == 0).all()
+
+    # topk avg over columns
+    out = I.sequence_topk_avg_pooling(mm_np, xl, yl, topks=[1, 3])
+    o = np.asarray(out.numpy() if hasattr(out, "numpy") else out)
+    assert o.shape == (B, Tx, DT * 2)
+    row = mm_np[0, 0, 2, :5]
+    np.testing.assert_allclose(o[0, 2, 0], np.sort(row)[::-1][:1].mean(),
+                               rtol=1e-4)
+    np.testing.assert_allclose(o[0, 2, 1], np.sort(row)[::-1][:3].mean(),
+                               rtol=1e-4)
+    # short example: k=3 > valid 3 cols -> averages over 3; rows >= len zero
+    assert (o[1, 2:] == 0).all()
+
+    # var_conv_2d: masked conv keeps the invalid region zero
+    cw = rng.randn(4, DT, 3, 3).astype("float32")
+    vc = I.var_conv_2d(mm_np, cw, xl, yl, stride=1, padding="SAME")
+    v = vc.numpy()
+    assert v.shape == (B, 4, Tx, Ty)
+    assert (v[1, :, 2:, :] == 0).all() and (v[1, :, :, 3:] == 0).all()
+    assert np.isfinite(v).all() and np.abs(v[0]).sum() > 0
+
+
+def test_var_conv_2d_contracts():
+    import numpy as np
+    import pytest as _pytest
+    from paddle_tpu.ops import industrial as I
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 6, 8).astype("float32")
+    w = rng.randn(3, 2, 3, 3).astype("float32")
+    # per-axis strides mask per-axis
+    v = I.var_conv_2d(x, w, np.array([6]), np.array([4]), stride=(2, 1))
+    assert v.numpy().shape[2:] == (3, 8)
+    assert np.abs(v.numpy()[0, :, :, 2:4]).sum() > 0    # cols 2-3 valid
+    assert (v.numpy()[0, :, :, 4:] == 0).all()
+    with _pytest.raises(NotImplementedError, match="SAME"):
+        I.var_conv_2d(x, w, np.array([3]), np.array([4]), padding="VALID")
+    with _pytest.raises(ValueError, match="channel_num"):
+        I.sequence_topk_avg_pooling(x, np.array([6]), np.array([8]),
+                                    topks=[1], channel_num=7)
