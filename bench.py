@@ -261,7 +261,10 @@ def bench_wide_deep(on_tpu):
     # device-cache mode (HeterPS/PSGPU): hot rows + optimizer state live in
     # device HBM; the host ships only indices + misses, and the sparse rule
     # runs on-chip inside the one jitted step
-    trainer = WideDeepTrainer(model)
+    # bf16 feature wire: halves H2D bytes on the RTT-bound hot path (the
+    # bench opts in explicitly; the trainer default is f32 for bit-exact
+    # parity with pull/push mode)
+    trainer = WideDeepTrainer(model, feature_wire_dtype="bfloat16")
     # the industrial data path: MultiSlot files → InMemoryDataset →
     # local_shuffle → feed dicts (data_set.h DatasetImpl flow); parsing
     # happens host-side outside the timed loop, as the reference's

@@ -118,7 +118,13 @@ class StaticFunction:
                            if isinstance(v, Tensor))
         const_kw = {k: v for k, v in kwargs.items() if k not in tkw_names}
 
+        # assert-fallback channel (backends without host callbacks): flags
+        # recorded during tracing become EXTRA outputs; __call__ checks
+        # them host-side and raises (see dy2static.convert_assert)
+        holder = {"n_asserts": 0, "assert_msgs": []}
+
         def pure(*arrs):
+            from .dy2static import push_assert_frame, pop_assert_frame
             arg_arrs = arrs[:n_args]
             tkw_arrs = arrs[n_args:n_args + len(tkw_names)]
             param_arrs = arrs[n_args + len(tkw_names):-1]
@@ -130,6 +136,7 @@ class StaticFunction:
             kw.update({k: Tensor(a) for k, a in zip(tkw_names, tkw_arrs)})
             gen = random_mod.default_generator
             gen.push_traced_key(key)
+            push_assert_frame()
             try:
                 if layer is not None:
                     params = dict(zip(param_names, param_arrs))
@@ -138,15 +145,21 @@ class StaticFunction:
                 else:
                     out = fn(*full_args, **kw)
             finally:
+                frame = pop_assert_frame()
                 gen.pop_traced_key()
             flat = out if isinstance(out, (tuple, list)) else (out,)
-            return tuple(o._value if isinstance(o, Tensor) else o
+            outs = tuple(o._value if isinstance(o, Tensor) else o
                          for o in flat)
+            if frame:
+                holder["n_asserts"] = len(frame)
+                holder["assert_msgs"] = [m for _, m in frame]
+                outs = outs + tuple(f for f, _ in frame)
+            return outs
 
         self._COUNTER[0] += 1
         name = f"@to_static_{getattr(fn, '__name__', 'fn')}_{self._COUNTER[0]}"
         prim = Primitive(name, pure, multi_output=True)
-        return prim, param_names, layer, tkw_names, t_idx
+        return prim, param_names, layer, tkw_names, t_idx, holder
 
     def __call__(self, *args, **kwargs):
         tkw = {k: v for k, v in kwargs.items() if isinstance(v, Tensor)}
@@ -158,12 +171,37 @@ class StaticFunction:
         if entry is None:
             entry = self._concrete(args, kwargs)
             self._cache[sig] = entry
-        prim, param_names, layer, tkw_names, t_idx = entry
+        prim, param_names, layer, tkw_names, t_idx, holder = entry
         params = dict(layer.named_parameters()) if layer else {}
         key = random_mod.default_generator.next_key()
         ins = ([args[i] for i in t_idx] + [kwargs[k] for k in tkw_names]
                + [params[n] for n in param_names] + [key])
         out = prim(*ins)
+        n_asserts = holder["n_asserts"]
+        if n_asserts:
+            import jax as _jax
+            out_t = out if isinstance(out, tuple) else (out,)
+            flags = out_t[len(out_t) - n_asserts:]
+            out = out_t[:len(out_t) - n_asserts]
+            for f, msg in zip(flags, holder["assert_msgs"]):
+                fv = f._value if isinstance(f, Tensor) else f
+                if isinstance(fv, _jax.core.Tracer):
+                    # nested @to_static: we are inside an OUTER trace and
+                    # the flag is abstract — propagate it into the outer
+                    # frame so the outermost call checks it host-side
+                    from .dy2static import _record_assert_flag
+                    if not _record_assert_flag(fv, msg):
+                        import warnings
+                        warnings.warn(
+                            "@to_static assert flag crossed a trace "
+                            "boundary with no outer fetch frame; the "
+                            "assert is skipped", RuntimeWarning,
+                            stacklevel=2)
+                    continue
+                if not bool(np.asarray(fv)):
+                    raise AssertionError(
+                        msg if msg is not None
+                        else "Assert failed inside @to_static graph")
         if isinstance(out, tuple) and len(out) == 1:
             return out[0]
         return out

@@ -360,20 +360,66 @@ def convert_getitem(x, i):
     return x[int(iv)]
 
 
-@functools.lru_cache(maxsize=1)
+_cb_verdict = []   # memo: [bool] once probed OUTSIDE any trace
+
+
 def _host_callbacks_supported() -> bool:
     """Whether the default backend can run host callbacks inside compiled
     programs (the axon TPU PJRT plugin cannot: 'does not support host
-    send/recv callbacks'). Probed once with a tiny jitted program."""
+    send/recv callbacks'). Probed once with a tiny jitted program.
+
+    Trace guard: the first probe can fire INSIDE a trace (a nested
+    @to_static function is first called while its caller is being traced,
+    so ast_transform's pre-warm runs lazily then).  Inside a trace the
+    probe's jit would be STAGED into the enclosing jaxpr instead of
+    executed — no exception at trace time → a false 'supported' verdict
+    AND the probe's own callback inlined into the user's program, which a
+    callback-less backend then rejects at runtime.  So inside a trace:
+    answer a conservative False (the fetched-flag fallback is correct on
+    every backend) WITHOUT caching; the verdict is only memoized when
+    probed cleanly."""
+    if _cb_verdict:
+        return _cb_verdict[0]
+    try:
+        from jax._src import core as _src_core
+        if not _src_core.trace_state_clean():
+            return False   # uncached: re-probe next time outside a trace
+    except Exception:
+        pass
     try:
         def probe(x):
             jax.debug.callback(lambda: None)
             return x + 1
         # block: the UNIMPLEMENTED error surfaces at execution, not trace
         jax.block_until_ready(jax.jit(probe)(jnp.zeros(())))
-        return True
+        _cb_verdict.append(True)
     except Exception:
+        _cb_verdict.append(False)
+    return _cb_verdict[0]
+
+
+_assert_frames = []   # trace-local stacks of (flag, msg) collected per trace
+
+
+def push_assert_frame():
+    """Open a collection frame for fallback assert flags (StaticFunction
+    traces its body inside one; see jit/__init__.py _concrete.pure)."""
+    _assert_frames.append([])
+
+
+def pop_assert_frame():
+    return _assert_frames.pop()
+
+
+def _record_assert_flag(cond, msg) -> bool:
+    """Fallback for backends without host callbacks: materialize the
+    condition as an extra (fetchable) program output; the StaticFunction
+    wrapper checks it host-side after execution and raises.  Returns False
+    when no frame is open (a bare jit outside @to_static)."""
+    if not _assert_frames:
         return False
+    _assert_frames[-1].append((jnp.all(cond), msg))
+    return True
 
 
 def convert_assert(cond, msg=None):
@@ -384,18 +430,24 @@ def convert_assert(cond, msg=None):
     is evaluated eagerly either way (it was already rewritten into the
     converter call).
 
-    Backends without host-callback support (the axon TPU plugin) cannot
-    check at runtime: the assert is skipped with a one-time warning —
-    honest disclosure beats a program that cannot compile."""
+    Backends without host-callback support (the axon TPU plugin, the
+    framework's primary target) fall back to a FETCHED flag: the condition
+    rides out of the compiled program as an extra output and the
+    StaticFunction wrapper raises host-side after the run — asserts still
+    fail where the framework runs for real, one step later than a host
+    callback would."""
     import numpy as np
     c = unwrap(cond) if _is_tensorish(cond) else cond
     if _is_traced(cond):
         if not _host_callbacks_supported():
+            if _record_assert_flag(c, msg):
+                return
             import warnings
             warnings.warn(
                 "@to_static assert on a traced value cannot be checked at "
-                "runtime on this backend (no host-callback support); the "
-                "assert is skipped", RuntimeWarning, stacklevel=2)
+                "runtime on this backend (no host-callback support) and no "
+                "fetch frame is open; the assert is skipped",
+                RuntimeWarning, stacklevel=2)
             return
 
         def _chk(v):

@@ -32,22 +32,36 @@ def _is_host(t: Tensor) -> bool:
     return not isinstance(unwrap(t), jax.core.Tracer)
 
 
+def _batch_fc_fn(x, w, bias):
+    out = jnp.einsum("sbi,sio->sbo", x, w)
+    return out + bias[:, None, :]
+
+
+_batch_fc_p = Primitive("batch_fc", _batch_fc_fn)
+
+
 def batch_fc(input, w, bias=None):
-    """batch_fc_op.h: per-slot batched FC.
+    """batch_fc_op.h: per-slot batched FC (grad kernel: batch_fc_grad).
     input [S, B, In] · w [S, In, Out] (+ bias [S, Out]) → [S, B, Out]."""
-    x, wt = _arr(input), _arr(w)
-    out = jnp.einsum("sbi,sio->sbo", x, wt)
-    if bias is not None:
-        out = out + _arr(bias)[:, None, :]
-    return Tensor(out)
+    if bias is None:
+        wa = _arr(w)
+        bias = jnp.zeros((wa.shape[0], wa.shape[2]), wa.dtype)
+    return _batch_fc_p(input, w, bias)
+
+
+def _fsp_fn(x, y):
+    h, w = x.shape[2], x.shape[3]
+    return jnp.einsum("bchw,bdhw->bcd", x, y) / (h * w)
+
+
+_fsp_p = Primitive("fsp", _fsp_fn)
 
 
 def fsp_matrix(x, y):
-    """fsp_op.h: flow-of-solution-procedure matrix for distillation.
+    """fsp_op.h: flow-of-solution-procedure matrix for distillation
+    (grad kernel: fsp_grad — FSP losses backprop into BOTH feature maps).
     x [B, C1, H, W], y [B, C2, H, W] → [B, C1, C2] = x·yᵀ / (H·W)."""
-    xa, ya = _arr(x), _arr(y)
-    h, w = xa.shape[2], xa.shape[3]
-    return Tensor(jnp.einsum("bchw,bdhw->bcd", xa, ya) / (h * w))
+    return _fsp_p(x, y)
 
 
 def _fresh_key(seed):
@@ -60,17 +74,24 @@ def _fresh_key(seed):
     return default_generator.next_key()
 
 
+def _shuffle_gather_fn(x, idx):
+    flat = x.reshape(idx.shape[0], -1)   # lead = all dims but the last
+    return flat[idx].reshape(x.shape)
+
+
+_shuffle_gather_p = Primitive("shuffle_batch", _shuffle_gather_fn)
+
+
 def shuffle_batch(x, seed=None):
     """shuffle_batch_op.cc: shuffle rows (all dims but the last collapse
     to the shuffled axis).  Returns (shuffled, shuffle_idx) — the index
-    tensor the reference emits for the backward re-ordering.  ``seed=None``
-    draws from the framework generator, re-shuffling on every call."""
-    xa = _arr(x)
-    lead = int(np.prod(xa.shape[:-1]))
-    key = _fresh_key(seed)
-    idx = jax.random.permutation(key, lead)
-    flat = xa.reshape(lead, xa.shape[-1])
-    return Tensor(flat[idx].reshape(xa.shape)), Tensor(idx)
+    tensor the reference emits for the backward re-ordering; here the
+    backward is the vjp of the gather (a scatter through the permutation,
+    shuffle_batch_grad parity).  ``seed=None`` draws from the framework
+    generator, re-shuffling on every call."""
+    lead = int(np.prod(_arr(x).shape[:-1]))
+    idx = jax.random.permutation(_fresh_key(seed), lead)
+    return _shuffle_gather_p(x, idx), Tensor(idx)
 
 
 def hash_bucket(x, num_hash: int = 1, mod_by: int = 1 << 20):
@@ -92,8 +113,21 @@ def hash_bucket(x, num_hash: int = 1, mod_by: int = 1 << 20):
     else:
         # traced/device: 32-bit ids only (x64 off); two's-complement
         # reinterpretation — masking with the 0xFFFFFFFF literal would
-        # overflow int32 argument parsing
-        lo = _arr(x).astype(jnp.int32).view(jnp.uint32)
+        # overflow int32 argument parsing.  A 64-bit dtype reaching this
+        # branch (x64 enabled) WOULD lose its high word to the int32 cast
+        # below — warn, because that is the exact collision class the host
+        # branch guards against.  (32-bit ids have no high word; ids
+        # truncated earlier at device transfer already warned there.)
+        xa = _arr(x)
+        if jnp.dtype(xa.dtype).itemsize >= 8:
+            import warnings
+            warnings.warn(
+                "hash_bucket: traced 64-bit ids hash only the low 32 bits "
+                "(the device mix runs on uint32); ids differing only above "
+                "bit 31 will collide. Pass the raw ids host-side "
+                "(numpy/list or host Tensor) to hash the full 64 bits.",
+                RuntimeWarning, stacklevel=2)
+        lo = xa.astype(jnp.int32).view(jnp.uint32)
         hi = jnp.zeros_like(lo)
 
     def mix(v, salt):
@@ -112,21 +146,27 @@ def hash_bucket(x, num_hash: int = 1, mod_by: int = 1 << 20):
     return Tensor(jnp.stack(outs, axis=-1)[..., None])
 
 
+def _spp_fn(x, pyramid_height=3, pool_type="max"):
+    from ..nn.functional.pooling import _adaptive_pool_fn
+    parts = []
+    for level in range(int(pyramid_height)):
+        bins = 2 ** level
+        p = _adaptive_pool_fn(x, out_size=(bins, bins), kind=pool_type)
+        parts.append(p.reshape(x.shape[0], -1))
+    return jnp.concatenate(parts, axis=1)
+
+
+_spp_p = Primitive("spp", _spp_fn)
+
+
 def spp(x, pyramid_height: int = 3, pool_type: str = "max"):
     """spp_op.h: spatial pyramid pooling — concat of adaptive pools at
-    1,2,4,…,2^(h-1) bins.  x [N, C, H, W] → [N, C·Σ bins²]."""
-    from ..nn.functional import adaptive_max_pool2d, adaptive_avg_pool2d
+    1,2,4,…,2^(h-1) bins (grad kernel: spp_grad via the pool vjps).
+    x [N, C, H, W] → [N, C·Σ bins²]."""
     if pool_type not in ("max", "avg"):
         raise ValueError(f"spp pool_type must be 'max' or 'avg', "
                          f"got {pool_type!r}")
-    fn = adaptive_max_pool2d if pool_type == "max" else adaptive_avg_pool2d
-    parts = []
-    n = _arr(x).shape[0]
-    for level in range(int(pyramid_height)):
-        bins = 2 ** level
-        p = fn(x, output_size=bins)
-        parts.append(_arr(p).reshape(n, -1))
-    return Tensor(jnp.concatenate(parts, axis=1))
+    return _spp_p(x, pyramid_height=int(pyramid_height), pool_type=pool_type)
 
 
 def positive_negative_pair(score, label, query_id, weight=None, column=-1):
@@ -443,31 +483,39 @@ def sequence_topk_avg_pooling(x, row_len, col_len, topks, channel_num=None):
                        topks=tuple(int(k) for k in topks))
 
 
+def _var_conv_2d_fn(x, w, row_len, col_len, stride=(1, 1)):
+    from ..nn.functional.conv import _conv_fn
+    h, wd = x.shape[2], x.shape[3]
+    mh = jnp.arange(h)[None, :] < row_len[:, None]
+    mw = jnp.arange(wd)[None, :] < col_len[:, None]
+    masked = x * (mh[:, None, :, None] & mw[:, None, None, :])
+    out = _conv_fn(masked, w, None, stride=stride, padding="SAME")
+    oh, ow = out.shape[2], out.shape[3]
+    # valid output region shrinks per-axis with the SAME-padding grid
+    sh, sw = stride
+    rl = (row_len + sh - 1) // sh
+    cl = (col_len + sw - 1) // sw
+    mh2 = jnp.arange(oh)[None, :] < rl[:, None]
+    mw2 = jnp.arange(ow)[None, :] < cl[:, None]
+    return out * (mh2[:, None, :, None] & mw2[:, None, None, :])
+
+
+_var_conv_2d_p = Primitive("var_conv_2d", _var_conv_2d_fn)
+
+
 def var_conv_2d(x, w, row_len, col_len, stride=1, padding="SAME"):
     """var_conv_2d_op.h: convolution over variable-size 2D feature maps
-    (each example's valid region differs).  Masked dense: zero the invalid
-    region, run ONE static conv, re-mask — the valid-output formula
-    ceil(len/stride) is the SAME-padding grid, so other paddings are
-    rejected rather than silently mislabeling zero-contaminated borders
-    as valid.  x [B, C, H, W], w [O, C, Kh, Kw]."""
-    from ..nn import functional as F
+    (each example's valid region differs; grad kernel: var_conv_2d_grad
+    via the masked-conv vjp).  Masked dense: zero the invalid region, run
+    ONE static conv, re-mask — the valid-output formula ceil(len/stride)
+    is the SAME-padding grid, so other paddings are rejected rather than
+    silently mislabeling zero-contaminated borders as valid.
+    x [B, C, H, W], w [O, C, Kh, Kw]."""
     if padding != "SAME":
         raise NotImplementedError(
             "var_conv_2d supports padding='SAME' only (the masked-dense "
             "valid-region arithmetic is the SAME grid)")
-    xa = _arr(x)
-    h, wd = xa.shape[2], xa.shape[3]
-    mh = jnp.arange(h)[None, :] < _arr(row_len)[:, None]
-    mw = jnp.arange(wd)[None, :] < _arr(col_len)[:, None]
-    mask = (mh[:, None, :, None] & mw[:, None, None, :])
-    masked = Tensor(xa * mask)
-    out = F.conv2d(masked, w, stride=stride, padding=padding)
-    oa = _arr(out)
-    oh, ow = oa.shape[2], oa.shape[3]
-    # valid output region shrinks per-axis with the SAME-padding grid
-    sh, sw = (stride, stride) if isinstance(stride, int) else         (stride[0], stride[1])
-    rl = (_arr(row_len) + sh - 1) // sh
-    cl = (_arr(col_len) + sw - 1) // sw
-    mh2 = jnp.arange(oh)[None, :] < rl[:, None]
-    mw2 = jnp.arange(ow)[None, :] < cl[:, None]
-    return Tensor(oa * (mh2[:, None, :, None] & mw2[:, None, None, :]))
+    sh, sw = (stride, stride) if isinstance(stride, int) else \
+        (stride[0], stride[1])
+    return _var_conv_2d_p(x, w, row_len, col_len,
+                          stride=(int(sh), int(sw)))

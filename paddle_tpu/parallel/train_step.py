@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..framework.tensor import Tensor
@@ -38,6 +39,20 @@ def _as_array(x):
     if isinstance(x, Tensor):
         return x._value
     return jnp.asarray(x)
+
+
+def _global_put(v, sharding):
+    """device_put that also works when ``sharding`` spans processes (the
+    multi-host SPMD path: jax.distributed has formed a global mesh, as the
+    reference's c_comm_init builds cross-node NCCL rings,
+    operators/collective/c_comm_init_op.cc:123).  Host data is the SPMD
+    contract — identical on every process — so each process materializes its
+    addressable shards; single-device jax arrays are pulled to host first."""
+    if jax.process_count() > 1 and isinstance(v, jax.Array):
+        if not v.is_fully_addressable:
+            return jax.device_put(v, sharding)  # global→global reshard
+        v = np.asarray(v)
+    return jax.device_put(v, sharding)
 
 
 def _wrap_loss(loss_fn):
@@ -180,17 +195,17 @@ class TrainStep:
                       for s, acc in opt_base.items()}
             buf_shard = NamedSharding(self.mesh, P(DP_AXIS))
             rep_n = lambda v: jnp.broadcast_to(v, (D,) + v.shape)
-            params = {n: jax.device_put(rep_n(v), rank_shard[n])
+            params = {n: _global_put(rep_n(v), rank_shard[n])
                       for n, v in base.items()}
-            buffers = {n: jax.device_put(rep_n(v), buf_shard)
+            buffers = {n: _global_put(rep_n(v), buf_shard)
                        for n, v in buffers.items()}
-            opt_state = {s: {n: jax.device_put(rep_n(v), oshard[s][n])
+            opt_state = {s: {n: _global_put(rep_n(v), oshard[s][n])
                              for n, v in acc.items()}
                          for s, acc in opt_base.items()}
             rep = NamedSharding(self.mesh, P())
             self._state = {
                 "params": params, "buffers": buffers, "opt": opt_state,
-                "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
+                "step": _global_put(np.zeros((), np.int32), rep),
             }
             self._shardings = {
                 "params": rank_shard,
@@ -219,17 +234,17 @@ class TrainStep:
                 for n in params}
         else:
             self._grad_shardings = None
-        params = {n: jax.device_put(v, pshard[n]) for n, v in params.items()}
+        params = {n: _global_put(v, pshard[n]) for n, v in params.items()}
         rep = NamedSharding(self.mesh, P())
-        buffers = {n: jax.device_put(v, rep) for n, v in buffers.items()}
+        buffers = {n: _global_put(v, rep) for n, v in buffers.items()}
         opt_state = self.optimizer.functional_state(params)
         oshard = self._opt_sharding(pshard, opt_state)
-        opt_state = {s: {n: jax.device_put(v, oshard[s][n])
+        opt_state = {s: {n: _global_put(v, oshard[s][n])
                          for n, v in acc.items()}
                      for s, acc in opt_state.items()}
         self._state = {
             "params": params, "buffers": buffers, "opt": opt_state,
-            "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
+            "step": _global_put(np.zeros((), np.int32), rep),
         }
         self._shardings = {"params": pshard, "buffers": {n: rep for n in buffers},
                           "opt": oshard, "step": rep}
@@ -474,23 +489,52 @@ class TrainStep:
                 f"by the dp degree ({dp}): each rank trains its own replica "
                 "on its own shard, so there is no replicate fallback")
 
+        nproc = jax.process_count()
+        local_dp = max(1, dp // nproc) if nproc > 1 else dp
+
         def put(x):
             if x is None:
                 return None
+            # multi-host SPMD: a global array (e.g. built by the caller with
+            # make_array_from_process_local_data) passes straight through
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                return x
             # explicit batch_spec only applies to arrays of the lead rank;
             # lower-rank labels get their own rank-matched sharding
             if self.batch_spec is not None and x.ndim == lead_ndim:
-                return jax.device_put(x, self.batch_spec)
-            if x.ndim >= 1 and dp > 1 and x.shape[0] % dp == 0:
-                return jax.device_put(x, batch_sharding(self.mesh,
-                                                        ndim=x.ndim))
-            # batch not divisible by dp: replicate rather than fail
-            return jax.device_put(x, NamedSharding(self.mesh, P()))
+                sh = self.batch_spec
+            elif x.ndim >= 1 and dp > 1 and x.shape[0] % local_dp == 0:
+                sh = batch_sharding(self.mesh, ndim=x.ndim)
+            elif nproc > 1:
+                # replication across processes assumes IDENTICAL host data
+                # on every rank — but each rank feeds its OWN shard here,
+                # so 'replicating' would commit different values per rank
+                # and silently diverge the SPMD state. Fail loudly.
+                raise ValueError(
+                    f"multi-process feed: local batch dim {x.shape[0]} is "
+                    f"not divisible by the local dp degree {local_dp} "
+                    f"(dp={dp} over {nproc} processes); per-rank shards "
+                    "cannot be replicated — pad the batch or build the "
+                    "global array yourself with "
+                    "jax.make_array_from_process_local_data")
+            else:
+                # batch not divisible by dp: replicate rather than fail
+                return _global_put(x, NamedSharding(self.mesh, P()))
+            if nproc > 1:
+                # each process feeds its LOCAL batch shard; assemble the
+                # global dp-sharded array (the multi-host DataLoader contract
+                # — reference: each trainer reads its own file split,
+                # fleet/data_generator + dist-train doc)
+                return jax.make_array_from_process_local_data(
+                    sh, np.asarray(x))
+            return jax.device_put(x, sh)
 
         inputs = tuple(put(x) for x in inputs)
         label = put(label)
         fn = self.compile()
-        lr = jnp.float32(self.optimizer.get_lr())
+        # host scalar (not a committed device array) so the jit treats it as
+        # process-replicated under a multi-host mesh
+        lr = np.float32(self.optimizer.get_lr())
         self._state, loss = fn(self.state, inputs, label, lr)
         self.optimizer._step_count += 1
         return Tensor(loss)

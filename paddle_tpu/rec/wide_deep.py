@@ -107,16 +107,16 @@ class WideDeepTrainer:
     - Host tables hold stale rows until ``flush()`` (PSGPU EndPass
       semantics); eager ``model(...)`` eval stays correct anyway — the
       embeddings read THROUGH the cache while one is bound.
-    - ``feature_wire_dtype`` ("bfloat16" default) is the H2D dtype for
-      dense features.  bf16 halves the hot-path wire bytes and is
-      standard for normalized CTR features; pass "float32" to keep
-      bit-identical numerics with pull/push mode.  Labels always travel
-      f32."""
+    - ``feature_wire_dtype`` ("float32" default — bit-identical numerics
+      with pull/push mode) is the H2D dtype for dense features.  Pass
+      "bfloat16" to halve the hot-path wire bytes (standard for
+      normalized CTR features; bench.py opts in explicitly).  Labels
+      always travel f32."""
 
     def __init__(self, model: WideDeep, lr: float = 1e-3,
                  async_push: bool = False, device_cache: bool = None,
                  cache_capacity: int = 1 << 20,
-                 feature_wire_dtype="bfloat16"):
+                 feature_wire_dtype="float32"):
         import jax
         from ..framework import functional as F
         from ..distributed.ps.device_cache import (

@@ -627,3 +627,49 @@ def test_bare_assert_failure_message():
     with pytest.raises(AssertionError) as ei:
         g(3)
     assert "None" not in str(ei.value)
+
+
+def test_assert_fallback_without_host_callbacks(monkeypatch):
+    """ADVICE r4: on callback-less backends (the axon TPU plugin) the
+    assert condition rides out of the compiled program as a fetched flag
+    and still raises host-side — instead of warn-and-skip."""
+    from paddle_tpu.jit import dy2static as d
+    monkeypatch.setattr(d, "_host_callbacks_supported", lambda: False)
+
+    @to_static
+    def f(x):
+        assert paddle.sum(x) > 0, "sum must be positive"
+        return x * 2
+
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    np.testing.assert_allclose(f(x).numpy(), 2 * np.ones(3))
+    with pytest.raises(AssertionError, match="sum must be positive"):
+        f(paddle.to_tensor(-np.ones(3, np.float32)))
+
+    # gradients still flow through the value outputs with flags attached
+    @to_static
+    def g(x):
+        assert paddle.sum(x) < 100
+        return (x * 3).sum()
+
+    t = paddle.to_tensor(np.ones(3, np.float32))
+    t.stop_gradient = False
+    g(t).backward()
+    np.testing.assert_allclose(t.grad.numpy(), 3 * np.ones(3))
+
+    # nested @to_static: the inner flag is traced inside the outer trace;
+    # it must propagate to the OUTER frame and still fire host-side
+    @to_static
+    def inner(x):
+        assert paddle.sum(x) > 0, "inner positive"
+        return x + 1
+
+    @to_static
+    def outer(x):
+        return inner(x) * 2
+
+    np.testing.assert_allclose(
+        outer(paddle.to_tensor(np.ones(3, np.float32))).numpy(),
+        4 * np.ones(3))
+    with pytest.raises(AssertionError, match="inner positive"):
+        outer(paddle.to_tensor(-np.ones(3, np.float32)))

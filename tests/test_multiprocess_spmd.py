@@ -1,0 +1,32 @@
+"""The pod story: multi-process SPMD over ONE global mesh.
+
+Two OS processes, each owning 4 virtual CPU devices, rendezvous through
+``jax.distributed`` (init_parallel_env, distributed/parallel_env.py) and form
+a single global 8-device dp×mp mesh; each process feeds only its OWN batch
+shard (jax.make_array_from_process_local_data inside TrainStep.put) and runs
+the same zero=1 + tensor-parallel compiled step.  The loss trajectory must
+EQUAL the single-process 8-device run — the same gate the reference applies
+to its collective mode (c_gen_nccl_id TCP rendezvous + c_comm_init,
+paddle/fluid/operators/collective/c_comm_init_op.cc:123-161;
+fleet_base.py:988), where multi-node NCCL must reproduce single-node math.
+"""
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def test_two_process_global_mesh_matches_single_process():
+    import __graft_entry__ as g
+
+    dist, ctrl = g.run_multiprocess_spmd(8)
+    # training descends on the global mesh
+    assert dist[-1] < dist[0], dist
+    assert all(np.isfinite(dist)), dist
+    # 2-process × 4-device == 1-process × 8-device: identical SPMD program,
+    # identical math (the reference's dist==local numerics assertion,
+    # test_dist_base.py:652, on the collective path)
+    np.testing.assert_allclose(dist, ctrl, atol=1e-4)
