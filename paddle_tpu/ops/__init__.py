@@ -34,6 +34,11 @@ from .industrial import (  # noqa: F401
     attention_lstm, filter_by_instag, match_matrix_tensor,
     sequence_topk_avg_pooling, var_conv_2d,
 )
+from .longtail import (  # noqa: F401
+    rank_attention, pyramid_hash, tree_conv, correlation, prroi_pool,
+    similarity_focus, deformable_psroi_pooling, roi_perspective_transform,
+    bilateral_slice, multi_gru,
+)
 from . import (  # noqa: F401
     creation, math, manipulation, linalg, control_flow, math_ext, sequence,
     detection, vision, decode,

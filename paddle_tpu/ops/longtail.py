@@ -1,0 +1,779 @@
+"""Long-tail research/industrial operators — the last ten ledger rows.
+
+Reference parity (each op cites its kernel):
+  rank_attention            operators/rank_attention_op.cc + rank_attention.cu.h
+  pyramid_hash              operators/pyramid_hash_op.cc
+  tree_conv                 operators/tree_conv_op.h + math/tree2col.cc
+  correlation               operators/correlation_op.cu
+  prroi_pool                operators/prroi_pool_op.h
+  similarity_focus          operators/similarity_focus_op.h
+  deformable_psroi_pooling  operators/deformable_psroi_pooling_op.h
+  roi_perspective_transform operators/detection/roi_perspective_transform_op.cc
+  bilateral_slice           operators/bilateral_slice_op.cu
+  multi_gru                 operators/fused/multi_gru_op.cc
+
+TPU-first shape: graph/set-structured preprocessing (tree DFS, n-gram
+enumeration, greedy selection) runs host-side in numpy — the reference runs
+these on CPU too — while every FLOP-bearing stage is a jnp Primitive so XLA
+tiles it onto the MXU and jax.vjp derives the grad kernels the reference
+hand-writes (rank_attention_grad, tree_conv_grad, prroi_pool_grad, ...).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.primitive import Primitive
+from ..framework.tensor import Tensor, unwrap
+
+
+def _arr(x):
+    return unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _host(x, dtype=None):
+    a = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return a.astype(dtype) if dtype is not None else a
+
+
+# ---------------------------------------------------------------------------
+# rank_attention (CTR)
+# ---------------------------------------------------------------------------
+
+def _rank_attention_fn(x, rank_offset, param, max_rank=3):
+    N, D = x.shape
+    ro = rank_offset.astype(jnp.int32)
+    lower = ro[:, 0] - 1                         # [N] this instance's rank
+    faster = ro[:, 1::2] - 1                     # [N, K] related ranks
+    index = ro[:, 2::2]                          # [N, K] related row ids
+    valid = (lower[:, None] >= 0) & (faster >= 0)
+    xg = x[jnp.clip(index, 0, N - 1)]            # [N, K, D]
+    xg = jnp.where(valid[..., None], xg, 0.0)
+    pidx = jnp.clip(lower[:, None] * max_rank + faster,
+                    0, max_rank * max_rank - 1)  # [N, K]
+    p3 = param.reshape(max_rank * max_rank, D, -1)
+    pg = p3[pidx]                                # [N, K, D, C]
+    pg = jnp.where(valid[..., None, None], pg, 0.0)
+    return jnp.einsum("nkd,nkdc->nc", xg, pg)
+
+
+_rank_attention_p = Primitive("rank_attention", _rank_attention_fn)
+
+
+def rank_attention(x, rank_offset, rank_param, max_rank: int = 3,
+                   max_size: int = 0):
+    """rank_attention_op.cc: per-instance rank-gated attention over related
+    instances.  ``x`` [N, D]; ``rank_offset`` [N, 2K+1] int — column 0 the
+    instance's own rank (1-based, 0 = none), then (rank, row-index) pairs
+    for K related instances; ``rank_param`` [max_rank²·D, C] organized by
+    (own_rank, other_rank) blocks.  out[n] = Σ_k x[idx(n,k)] ·
+    P[rank(n), rank_k] (invalid slots contribute zero) — the expand-input /
+    expand-param + batched-GEMM of rank_attention.cu.h collapsed into one
+    einsum.  ``max_size`` (a CUDA memory pre-allocation hint) has no TPU
+    meaning and is accepted for signature parity."""
+    return _rank_attention_p(x, rank_offset, rank_param,
+                             max_rank=int(max_rank))
+
+
+# ---------------------------------------------------------------------------
+# pyramid_hash (industrial search)
+# ---------------------------------------------------------------------------
+
+def _mix32(vals, salt):
+    """Deterministic 32-bit mix over an int sequence (the framework's
+    hashing deviation — the reference uses XXH32, pyramid_hash_op.cc:229;
+    hash values are an implementation detail nobody checkpoints)."""
+    h = np.uint32(0x811C9DC5) ^ np.uint32((salt * 0x9E3779B9) & 0xFFFFFFFF)
+    with np.errstate(over="ignore"):
+        for v in vals:
+            h = np.uint32((int(h) ^ (int(v) & 0xFFFFFFFF) ^
+                           ((int(v) >> 32) & 0xFFFFFFFF)) & 0xFFFFFFFF)
+            h = np.uint32((int(h) * 0x85EBCA6B) & 0xFFFFFFFF)
+            h = np.uint32((int(h) >> 13) ^ int(h))
+    return int(h)
+
+
+def _pyramid_gather_fn(w, idx):
+    flat = w.reshape(-1)
+    return flat[idx.reshape(-1)].reshape(idx.shape[0], -1)
+
+
+_pyramid_gather_p = Primitive("pyramid_hash", _pyramid_gather_fn)
+
+
+def pyramid_hash(x, w, offsets=None, *, num_emb, space_len, rand_len,
+                 pyramid_layer: int = 2, drop_out_percent: float = 0.0,
+                 is_training: bool = False, seed: int = 0,
+                 white_list=None, black_list=None):
+    """pyramid_hash_op.cc: enumerate every n-gram of lengths 2..pyramid_layer
+    per sequence, filter (white/black lists ≙ the reference's bloom
+    filters, here exact sets — a superset of the filter contract), hash
+    each kept n-gram ``num_emb/rand_len`` times and assemble its embedding
+    from ``rand_len``-wide slices of ``w`` (flat [space_len+rand_len]).
+
+    ``x``: list of int sequences, or a flat array with LoD ``offsets``.
+    Returns (out [M, num_emb], drop_pos [Σngrams], new_offsets) — M is
+    data-dependent, so enumeration runs host-side (a CPU-only kernel in
+    the reference too); the embedding assembly is a differentiable device
+    gather, so grads flow to ``w`` (pyramid_hash_grad parity)."""
+    if offsets is not None:
+        flat = _host(x, np.int64).ravel()
+        offs = list(offsets)
+        seqs = [flat[offs[i]:offs[i + 1]] for i in range(len(offs) - 1)]
+    else:
+        seqs = [_host(s, np.int64).ravel() for s in x]
+    wset = None if white_list is None else \
+        {tuple(map(int, t)) for t in white_list}
+    bset = set() if black_list is None else \
+        {tuple(map(int, t)) for t in black_list}
+    if num_emb % rand_len != 0:
+        raise ValueError(f"num_emb ({num_emb}) must be a multiple of "
+                         f"rand_len ({rand_len})")
+    chunks = num_emb // rand_len
+    rng = np.random.RandomState(seed)
+
+    pos_rows, drop_pos, new_offsets = [], [], [0]
+    for seq in seqs:
+        kept = 0
+        L = len(seq)
+        if L >= 2:
+            for ilayer in range(1, pyramid_layer):
+                if ilayer >= L:
+                    break
+                for start in range(L - ilayer):
+                    term = tuple(map(int, seq[start:start + ilayer + 1]))
+                    use = ((wset is None or term in wset)
+                           and term not in bset)
+                    if use and is_training and drop_out_percent > 0:
+                        use = rng.rand() >= drop_out_percent
+                    drop_pos.append(1 if use else 0)
+                    if use:
+                        pos_rows.append([
+                            _mix32(term, c * rand_len) % space_len
+                            for c in range(chunks)])
+                        kept += 1
+        new_offsets.append(new_offsets[-1] + kept)
+
+    if not pos_rows:
+        out = Tensor(jnp.zeros((0, num_emb), jnp.float32))
+        return out, Tensor(jnp.asarray(drop_pos, jnp.int32)), new_offsets
+    pos = np.asarray(pos_rows, np.int32)                      # [M, chunks]
+    idx = pos[:, :, None] + np.arange(rand_len)[None, None, :]
+    out = _pyramid_gather_p(w, jnp.asarray(idx.reshape(len(pos), num_emb)))
+    return out, Tensor(jnp.asarray(drop_pos, jnp.int32)), new_offsets
+
+
+# ---------------------------------------------------------------------------
+# tree_conv (TBCNN)
+# ---------------------------------------------------------------------------
+
+def _tree_patch_coef(edges: np.ndarray, n: int, max_depth: int) -> np.ndarray:
+    """Continuous-binary-tree coefficients (math/tree2col.cc): for every
+    root u, DFS its subtree to depth < max_depth; each visited node v (at
+    1-based child index within pclen siblings, depth d) contributes
+    [eta_l, eta_r, eta_t] where eta_t=(D-d)/D, eta_l=(1-eta_t)·pos,
+    eta_r=(1-eta_t)·(1-pos).  Returns coef [n, n, 3] with row u-1 holding
+    root u's patch."""
+    tr = [[] for _ in range(n + 2)]
+    for u, v in edges:
+        u, v = int(u), int(v)
+        if u == 0 or v == 0:
+            break                    # 0,0 terminates the edge list
+        tr[u].append(v)
+    coef = np.zeros((n, n, 3), np.float32)
+    D = float(max_depth)
+
+    def eta(index, pclen, depth):
+        et = (D - depth) / D
+        pos = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+        el = (1.0 - et) * pos
+        er = (1.0 - et) * (1.0 - el)   # note: 1 - FULL eta_l (tree2col.h:49)
+        return el, er, et
+
+    for root in range(1, n + 1):
+        stack = [(root, 0)]
+        visited = {root}
+        el, er, et = eta(1, 1, 0)
+        coef[root - 1, root - 1] += (el, er, et)
+        while stack:
+            node, depth = stack[-1]
+            advanced = False
+            for i, v in enumerate(tr[node]):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, depth + 1))
+                    el, er, et = eta(i + 1, len(tr[node]), depth + 1)
+                    coef[root - 1, v - 1] += (el, er, et)
+                    advanced = True
+            if not advanced:
+                stack.pop()
+    return coef
+
+
+def _tree_conv_fn(nodes, coef, filt):
+    # nodes [B,n,F], coef [B,n,n,3], filt [F,3,O,M]
+    patch = jnp.einsum("buvj,bvf->bufj", coef, nodes)        # [B,n,F,3]
+    B, n = patch.shape[0], patch.shape[1]
+    w2 = filt.reshape(filt.shape[0] * 3, -1)                 # [F·3, O·M]
+    out = patch.reshape(B, n, -1) @ w2                       # [B,n,O·M]
+    return out.reshape(B, n, filt.shape[2], filt.shape[3])
+
+
+_tree_conv_p = Primitive("tree_conv", _tree_conv_fn)
+
+
+def tree_conv(nodes_vector, edge_set, filter, max_depth: int = 2):
+    """tree_conv_op.h: tree-based convolution (TBCNN).  ``nodes_vector``
+    [B, n, F] node features; ``edge_set`` [B, E, 2] int 1-based parent→child
+    edges (0,0-terminated); ``filter`` [F, 3, O, M].  Patch coefficients
+    (the eta triangle weights of tree2col.cc) are built host-side from the
+    graph structure; the patch·filter contraction is one einsum+matmul, so
+    grads flow to features and filter (tree_conv_grad parity)."""
+    feats = _arr(nodes_vector)
+    edges = _host(edge_set, np.int64)
+    n = feats.shape[1]
+    coef = np.stack([_tree_patch_coef(e, n, int(max_depth)) for e in edges])
+    return _tree_conv_p(nodes_vector, jnp.asarray(coef), filter)
+
+
+# ---------------------------------------------------------------------------
+# correlation (FlowNet cost volume)
+# ---------------------------------------------------------------------------
+
+def _correlation_fn(x1, x2, pad_size=0, kernel_size=1, max_displacement=1,
+                    stride1=1, stride2=1):
+    B, C, H, W = x1.shape
+    krad = (kernel_size - 1) // 2
+    drad = max_displacement // stride2
+    G = krad + max_displacement        # guard so every shift stays in-range
+    pads = [(0, 0), (0, 0), (pad_size + G,) * 2, (pad_size + G,) * 2]
+    p1 = jnp.pad(x1, pads)
+    p2 = jnp.pad(x2, pads)
+    A_h = H + 2 * pad_size - 2 * (krad + max_displacement)
+    A_w = W + 2 * pad_size - 2 * (krad + max_displacement)
+    out_h = -(-A_h // stride1)
+    out_w = -(-A_w // stride1)
+    Lh = A_h + 2 * krad                # rows touched by the window sweep
+    Lw = A_w + 2 * krad
+    s0 = max_displacement - krad + G   # first window row in padded coords
+
+    outs = []
+    for tj in range(-drad, drad + 1):
+        for ti in range(-drad, drad + 1):
+            sh = p2[:, :, s0 + tj * stride2: s0 + tj * stride2 + Lh,
+                    s0 + ti * stride2: s0 + ti * stride2 + Lw]
+            prod = (p1[:, :, s0:s0 + Lh, s0:s0 + Lw] * sh).sum(axis=1)
+            win = jax.lax.reduce_window(
+                prod, 0.0, jax.lax.add,
+                (1, kernel_size, kernel_size), (1, stride1, stride1),
+                "valid")
+            outs.append(win[:, :out_h, :out_w])
+    out = jnp.stack(outs, axis=1)      # [B, D², out_h, out_w]
+    return out / (kernel_size * kernel_size * C)
+
+
+_correlation_p = Primitive("correlation", _correlation_fn)
+
+
+def correlation(x1, x2, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply: int = 1):
+    """correlation_op.cu: FlowNet cost volume.  out[b, (tj,ti), y, x] =
+    mean over channels and the kernel window of x1[·, y', x'] ·
+    x2[·, y'+tj·stride2, x'+ti·stride2] on zero-padded inputs; output
+    channel grid is (2·max_displacement/stride2+1)².  Only the multiply
+    correlation type exists in the reference kernel (correlation_op.cu:128);
+    pass corr_type_multiply=1."""
+    if int(corr_type_multiply) != 1:
+        raise NotImplementedError(
+            "correlation: only corr_type_multiply=1 exists in the "
+            "reference kernel (correlation_op.cu)")
+    return _correlation_p(x1, x2, pad_size=int(pad_size),
+                          kernel_size=int(kernel_size),
+                          max_displacement=int(max_displacement),
+                          stride1=int(stride1), stride2=int(stride2))
+
+
+# ---------------------------------------------------------------------------
+# prroi_pool (precise ROI pooling)
+# ---------------------------------------------------------------------------
+
+def _hat_integral(u):
+    """F(u) = ∫_{-∞}^{u} max(0, 1-|s|) ds — closed form of the bilinear
+    hat; coefficient of grid point g over window [a,b] is F(b-g)-F(a-g)
+    (the analytic MatCalculation of prroi_pool_op.h:32)."""
+    u = jnp.clip(u, -1.0, 1.0)
+    neg = 0.5 * (u + 1.0) ** 2
+    pos = 0.5 + u - 0.5 * u ** 2
+    return jnp.where(u <= 0, neg, pos)
+
+
+def _prroi_fn(x, rois, batch_ids, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0):
+    N, C, H, W = x.shape
+    ph, pw = pooled_height, pooled_width
+
+    def one(roi, bid):
+        sw, sh, ew, eh = (roi * spatial_scale)
+        rw = jnp.maximum(ew - sw, 0.0)
+        rh = jnp.maximum(eh - sh, 0.0)
+        bh, bw = rh / ph, rw / pw
+        win = jnp.maximum(bh * bw, 0.0)
+        ys = sh + jnp.arange(ph) * bh                     # [ph]
+        xs = sw + jnp.arange(pw) * bw                     # [pw]
+        gy = jnp.arange(H)[None, :]
+        gx = jnp.arange(W)[None, :]
+        cy = _hat_integral(ys[:, None] + bh - gy) - \
+            _hat_integral(ys[:, None] - gy)               # [ph, H]
+        cx = _hat_integral(xs[:, None] + bw - gx) - \
+            _hat_integral(xs[:, None] - gx)               # [pw, W]
+        img = x[bid]                                      # [C, H, W]
+        s = jnp.einsum("ph,qw,chw->cpq", cy, cx, img)
+        return jnp.where(win > 0, s / jnp.maximum(win, 1e-12), 0.0)
+
+    return jax.vmap(one)(rois.astype(jnp.float32),
+                         batch_ids.astype(jnp.int32))
+
+
+_prroi_p = Primitive("prroi_pool", _prroi_fn)
+
+
+def prroi_pool(x, rois, pooled_height, pooled_width, spatial_scale=1.0,
+               batch_roi=None):
+    """prroi_pool_op.h: Precise RoI pooling — each bin is the EXACT
+    integral of the bilinearly-interpolated feature over the bin window
+    divided by the bin area (no sampling-point approximation).  The
+    per-pixel hat-integral coefficients are closed-form, so one einsum per
+    roi replaces the MatCalculation accumulation and jax.vjp yields both
+    the feature and the roi-coordinate gradients (prroi_pool_grad).
+    ``rois`` [R, 4] (x1, y1, x2, y2); ``batch_roi`` [R] image index per
+    roi (defaults to all-zeros)."""
+    r = _arr(rois)
+    bids = jnp.zeros((r.shape[0],), jnp.int32) if batch_roi is None \
+        else _arr(batch_roi)
+    return _prroi_p(x, rois, bids, pooled_height=int(pooled_height),
+                    pooled_width=int(pooled_width),
+                    spatial_scale=float(spatial_scale))
+
+
+# ---------------------------------------------------------------------------
+# similarity_focus
+# ---------------------------------------------------------------------------
+
+def similarity_focus(x, axis: int, indexes):
+    """similarity_focus_op.h: build a 0/1 focus mask of x's shape.  For
+    each batch item and each index along ``axis``, greedily walk that
+    slice's cells in descending value order, selecting cells whose
+    remaining two coordinates are both unused (rows/cols marked used as
+    selected) until min(dim_a, dim_b) cells are picked; selected
+    positions light up across the WHOLE ``axis`` dimension.  Host-side:
+    the sort + greedy tagging is sequential (a CPU-only kernel in the
+    reference, with no grad op — the mask is non-differentiable)."""
+    xa = _host(x, np.float64)
+    if xa.ndim != 4:
+        raise ValueError("similarity_focus expects a 4-D input")
+    if axis not in (1, 2, 3):
+        raise ValueError("axis must be 1, 2 or 3")
+    out = np.zeros_like(xa, np.float32)
+    other = [a for a in (1, 2, 3) if a != axis]
+    B = xa.shape[0]
+    for b in range(B):
+        for index in indexes:
+            sl = np.take(xa[b], int(index), axis=axis - 1)   # [da, db]
+            da, db = sl.shape
+            order = np.argsort(-sl, axis=None, kind="stable")
+            used_a = np.zeros(da, bool)
+            used_b = np.zeros(db, bool)
+            picked = 0
+            for flat in order:
+                ia, ib = divmod(int(flat), db)
+                if used_a[ia] or used_b[ib]:
+                    continue
+                used_a[ia] = used_b[ib] = True
+                picked += 1
+                idx = [slice(None)] * 3
+                idx[other[0] - 1] = ia
+                idx[other[1] - 1] = ib
+                out[b][tuple(idx)] = 1.0
+                if picked == min(da, db):
+                    break
+    return Tensor(jnp.asarray(out))
+
+
+# ---------------------------------------------------------------------------
+# deformable_psroi_pooling (DCN)
+# ---------------------------------------------------------------------------
+
+def _def_psroi_fn(x, rois, batch_ids, trans, no_trans=True,
+                  spatial_scale=1.0, output_dim=1, group_height=1,
+                  group_width=1, pooled_height=1, pooled_width=1,
+                  part_height=1, part_width=1, sample_per_part=1,
+                  trans_std=0.0):
+    N, C, H, W = x.shape
+    O, PH, PW, S = output_dim, pooled_height, pooled_width, sample_per_part
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    ceach = O // num_classes
+
+    phs = jnp.arange(PH)
+    pws = jnp.arange(PW)
+    # per-bin part cell and group channel (static arithmetic)
+    part_h = jnp.floor(phs.astype(jnp.float32) / PH * part_height
+                       ).astype(jnp.int32)                      # [PH]
+    part_w = jnp.floor(pws.astype(jnp.float32) / PW * part_width
+                       ).astype(jnp.int32)                      # [PW]
+    gh = jnp.clip((phs * group_height) // PH, 0, group_height - 1)
+    gw = jnp.clip((pws * group_width) // PW, 0, group_width - 1)
+    ctop = jnp.arange(O)
+    chan = (ctop[:, None, None] * group_height + gh[None, :, None]) \
+        * group_width + gw[None, None, :]                       # [O,PH,PW]
+    class_id = ctop // ceach                                    # [O]
+
+    def one(roi, bid, tr):
+        r = jnp.round(roi)
+        sw = r[0] * spatial_scale - 0.5
+        sh = r[1] * spatial_scale - 0.5
+        ew = (r[2] + 1.0) * spatial_scale - 0.5
+        eh = (r[3] + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(ew - sw, 0.1)
+        rh = jnp.maximum(eh - sh, 0.1)
+        bh, bw = rh / PH, rw / PW
+        sbh, sbw = bh / S, bw / S
+        if no_trans:
+            tx = jnp.zeros((1, PH, PW))
+            ty = jnp.zeros((1, PH, PW))
+        else:
+            # trans [2·num_classes, part_h, part_w] → per (class, bin)
+            t = tr.reshape(num_classes, 2, part_height, part_width)
+            tx = t[:, 0][:, part_h][:, :, part_w] * trans_std
+            ty = t[:, 1][:, part_h][:, :, part_w] * trans_std
+        wstart = pws[None, None, :] * bw + sw + tx * rw         # [ncls,PH,PW]
+        hstart = phs[None, :, None] * bh + sh + ty * rh
+        iw = jnp.arange(S) * sbw
+        ih = jnp.arange(S) * sbh
+        ws = wstart[..., None, None] + iw[None, None, None, None, :]
+        hs = hstart[..., None, None] + ih[None, None, None, :, None]
+        valid = (ws >= -0.5) & (ws <= W - 0.5) & \
+                (hs >= -0.5) & (hs <= H - 0.5)                  # [ncls,PH,PW,S,S]
+        wc = jnp.clip(ws, 0.0, W - 1.0)
+        hc = jnp.clip(hs, 0.0, H - 1.0)
+        w0 = jnp.floor(wc).astype(jnp.int32)
+        h0 = jnp.floor(hc).astype(jnp.int32)
+        w1 = jnp.minimum(w0 + 1, W - 1)
+        h1 = jnp.minimum(h0 + 1, H - 1)
+        aw = wc - w0
+        ah = hc - h0
+        img = x[bid]                                            # [C,H,W]
+        # broadcast class-indexed coords to every output channel
+        ci = class_id
+        samp_h0 = h0[ci]; samp_h1 = h1[ci]                      # [O,PH,PW,S,S]
+        samp_w0 = w0[ci]; samp_w1 = w1[ci]
+        a_w = aw[ci]; a_h = ah[ci]; v = valid[ci]
+        ch = chan[..., None, None]                              # [O,PH,PW,1,1]
+        ch = jnp.broadcast_to(ch, samp_h0.shape)
+        g = lambda hh, ww: img[ch, hh, ww]
+        val = (g(samp_h0, samp_w0) * (1 - a_h) * (1 - a_w)
+               + g(samp_h0, samp_w1) * (1 - a_h) * a_w
+               + g(samp_h1, samp_w0) * a_h * (1 - a_w)
+               + g(samp_h1, samp_w1) * a_h * a_w)
+        val = jnp.where(v, val, 0.0)
+        cnt = v.sum(axis=(-1, -2))
+        return jnp.where(cnt > 0, val.sum(axis=(-1, -2)) /
+                         jnp.maximum(cnt, 1), 0.0)              # [O,PH,PW]
+
+    tr_in = trans if not no_trans else jnp.zeros((rois.shape[0], 2,
+                                                  part_height, part_width))
+    return jax.vmap(one)(rois.astype(jnp.float32),
+                         batch_ids.astype(jnp.int32), tr_in)
+
+
+_def_psroi_p = Primitive("deformable_psroi_pooling", _def_psroi_fn)
+
+
+def deformable_psroi_pooling(x, rois, trans=None, no_trans=None,
+                             spatial_scale=1.0, output_dim=None,
+                             group_size=1, pooled_size=1, part_size=None,
+                             sample_per_part=1, trans_std=0.1,
+                             batch_roi=None):
+    """deformable_psroi_pooling_op.h: position-sensitive ROI pooling with
+    learned per-part offsets (the DCN head).  ``x`` [N, C, H, W] with
+    C = output_dim·group_h·group_w; ``rois`` [R, 4]; ``trans``
+    [R, 2·num_classes, part_h, part_w] offsets (None ≙ no_trans).  Each
+    bin averages sample_per_part² bilinear samples of its group channel,
+    shifted by trans·trans_std·roi_size; out-of-image samples are
+    dropped from the average (top_count semantics)."""
+    gs = (group_size, group_size) if isinstance(group_size, int) \
+        else tuple(group_size)
+    ps = (pooled_size, pooled_size) if isinstance(pooled_size, int) \
+        else tuple(pooled_size)
+    if part_size is None:
+        part = ps
+    else:
+        part = (part_size, part_size) if isinstance(part_size, int) \
+            else tuple(part_size)
+    if no_trans is None:
+        no_trans = trans is None
+    r = _arr(rois)
+    bids = jnp.zeros((r.shape[0],), jnp.int32) if batch_roi is None \
+        else _arr(batch_roi)
+    if output_dim is None:
+        output_dim = _arr(x).shape[1] // (gs[0] * gs[1])
+    args = [x, rois, bids]
+    if trans is not None:
+        args.append(trans)
+    else:
+        args.append(jnp.zeros((r.shape[0], 2, part[0], part[1]),
+                              jnp.float32))
+    return _def_psroi_p(*args, no_trans=bool(no_trans),
+                        spatial_scale=float(spatial_scale),
+                        output_dim=int(output_dim),
+                        group_height=int(gs[0]), group_width=int(gs[1]),
+                        pooled_height=int(ps[0]), pooled_width=int(ps[1]),
+                        part_height=int(part[0]), part_width=int(part[1]),
+                        sample_per_part=int(sample_per_part),
+                        trans_std=float(trans_std))
+
+
+# ---------------------------------------------------------------------------
+# roi_perspective_transform (OCR detection)
+# ---------------------------------------------------------------------------
+
+_EPS = 1e-4
+
+
+def _perspective_matrix(rx, ry, th, tw):
+    """get_transform_matrix (roi_perspective_transform_op.cc:110): the
+    homography mapping output-grid coords to the quad, with the
+    reference's estimated/normalized width-height renormalization."""
+    len1 = jnp.sqrt((rx[0] - rx[1]) ** 2 + (ry[0] - ry[1]) ** 2)
+    len2 = jnp.sqrt((rx[1] - rx[2]) ** 2 + (ry[1] - ry[2]) ** 2)
+    len3 = jnp.sqrt((rx[2] - rx[3]) ** 2 + (ry[2] - ry[3]) ** 2)
+    len4 = jnp.sqrt((rx[3] - rx[0]) ** 2 + (ry[3] - ry[0]) ** 2)
+    est_h = (len2 + len4) / 2.0
+    est_w = (len1 + len3) / 2.0
+    nh = max(2, th)
+    nw_f = jnp.round(est_w * (nh - 1) / jnp.maximum(est_h, _EPS)) + 1
+    nw = jnp.clip(nw_f, 2, tw)
+    dx1 = rx[1] - rx[2]
+    dx2 = rx[3] - rx[2]
+    dx3 = rx[0] - rx[1] + rx[2] - rx[3]
+    dy1 = ry[1] - ry[2]
+    dy2 = ry[3] - ry[2]
+    dy3 = ry[0] - ry[1] + ry[2] - ry[3]
+    den = dx1 * dy2 - dx2 * dy1 + 1e-5
+    m6 = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+    m7 = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+    m8 = jnp.asarray(1.0)
+    m3 = (ry[1] - ry[0] + m6 * (nw - 1) * ry[1]) / (nw - 1)
+    m4 = (ry[3] - ry[0] + m7 * (nh - 1) * ry[3]) / (nh - 1)
+    m5 = ry[0]
+    m0 = (rx[1] - rx[0] + m6 * (nw - 1) * rx[1]) / (nw - 1)
+    m1 = (rx[3] - rx[0] + m7 * (nh - 1) * rx[3]) / (nh - 1)
+    m2 = rx[0]
+    return jnp.stack([m0, m1, m2, m3, m4, m5, m6, m7, m8])
+
+
+def _in_quad(px, py, rx, ry):
+    """Point-in-quadrilateral with the reference's epsilon edge rules +
+    crossing count (roi_perspective_transform_op.cc:46)."""
+    on_edge = jnp.zeros(px.shape, bool)
+    n_cross = jnp.zeros(px.shape, jnp.int32)
+    for i in range(4):
+        xs, ys = rx[i], ry[i]
+        xe, ye = rx[(i + 1) % 4], ry[(i + 1) % 4]
+        horiz = jnp.abs(ys - ye) < _EPS
+        on_h = horiz & (jnp.abs(py - ys) < _EPS) & (jnp.abs(py - ye) < _EPS) \
+            & (px >= jnp.minimum(xs, xe) - _EPS) \
+            & (px <= jnp.maximum(xs, xe) + _EPS)
+        ix = (py - ys) * (xe - xs) / jnp.where(horiz, 1.0, ye - ys) + xs
+        in_y = (py >= jnp.minimum(ys, ye) - _EPS) & \
+               (py <= jnp.maximum(ys, ye) + _EPS)
+        on_v = (~horiz) & (jnp.abs(ix - px) < _EPS) & in_y
+        on_edge |= on_h | on_v
+        crossing_y = (py > jnp.minimum(ys, ye) + _EPS) & \
+                     (py <= jnp.maximum(ys, ye) + _EPS)
+        n_cross += jnp.where((~horiz) & crossing_y & (ix > px + _EPS),
+                             1, 0)
+    return on_edge | (n_cross % 2 == 1)
+
+
+def _roi_perspective_fn(x, rois, batch_ids, transformed_height=1,
+                        transformed_width=1, spatial_scale=1.0):
+    N, C, H, W = x.shape
+    th, tw = transformed_height, transformed_width
+
+    def one(roi, bid):
+        rx = roi[0::2] * spatial_scale
+        ry = roi[1::2] * spatial_scale
+        m = _perspective_matrix(rx, ry, th, tw)
+        ow = jnp.arange(tw)[None, :].astype(jnp.float32)
+        oh = jnp.arange(th)[:, None].astype(jnp.float32)
+        den = m[6] * ow + m[7] * oh + m[8]
+        in_w = (m[0] * ow + m[1] * oh + m[2]) / den           # [th, tw]
+        in_h = (m[3] * ow + m[4] * oh + m[5]) / den
+        inside_q = _in_quad(in_w, in_h, rx, ry)
+        in_bounds = (in_w > -0.5 + _EPS) & (in_w < W - 0.5 - _EPS) & \
+                    (in_h > -0.5 + _EPS) & (in_h < H - 0.5 - _EPS)
+        mask = inside_q & in_bounds
+        wc = jnp.clip(in_w, 0.0, W - 1.0)
+        hc = jnp.clip(in_h, 0.0, H - 1.0)
+        w0 = jnp.floor(wc).astype(jnp.int32)
+        h0 = jnp.floor(hc).astype(jnp.int32)
+        w1 = jnp.minimum(w0 + 1, W - 1)
+        h1 = jnp.minimum(h0 + 1, H - 1)
+        aw = wc - w0
+        ah = hc - h0
+        img = x[bid]                                          # [C,H,W]
+        val = (img[:, h0, w0] * (1 - ah) * (1 - aw)
+               + img[:, h0, w1] * (1 - ah) * aw
+               + img[:, h1, w0] * ah * (1 - aw)
+               + img[:, h1, w1] * ah * aw)                    # [C,th,tw]
+        out = jnp.where(mask[None], val, 0.0)
+        return out, mask.astype(jnp.int32)[None], m
+
+    return jax.vmap(one)(rois.astype(jnp.float32),
+                         batch_ids.astype(jnp.int32))
+
+
+_roi_perspective_p = Primitive("roi_perspective_transform",
+                               _roi_perspective_fn, multi_output=True)
+
+
+def roi_perspective_transform(x, rois, transformed_height, transformed_width,
+                              spatial_scale=1.0, batch_roi=None):
+    """roi_perspective_transform_op.cc: crop each quadrilateral ROI
+    (``rois`` [R, 8] = 4 corner (x, y) pairs) through its perspective
+    homography into a [transformed_height, transformed_width] patch with
+    bilinear sampling; pixels mapping outside the quad or the feature
+    bounds are zero.  Returns (out [R, C, th, tw], mask [R, 1, th, tw],
+    transform_matrix [R, 9])."""
+    r = _arr(rois)
+    bids = jnp.zeros((r.shape[0],), jnp.int32) if batch_roi is None \
+        else _arr(batch_roi)
+    return _roi_perspective_p(x, rois, bids,
+                              transformed_height=int(transformed_height),
+                              transformed_width=int(transformed_width),
+                              spatial_scale=float(spatial_scale))
+
+
+# ---------------------------------------------------------------------------
+# bilateral_slice (HDRnet)
+# ---------------------------------------------------------------------------
+
+def _bilateral_slice_fn(grid, guide, inp, has_offset=False):
+    B, Cg, gd, gh, gw = grid.shape
+    _, C, H, W = inp.shape
+    cs = C + 1 if has_offset else C
+    out_c = Cg // cs
+
+    gx = (jnp.arange(W) + 0.5) * gw / W                       # [W]
+    gy = (jnp.arange(H) + 0.5) * gh / H                       # [H]
+    gz = guide * gd                                           # [B,H,W]
+
+    def corners(v, size):
+        f = jnp.floor(v - 0.5)
+        w0 = jnp.maximum(1.0 - jnp.abs(f + 0.5 - v), 0.0)
+        i0 = jnp.clip(f.astype(jnp.int32), 0, size - 1)
+        w1 = jnp.maximum(1.0 - jnp.abs(f + 1.5 - v), 0.0)
+        i1 = jnp.clip(f.astype(jnp.int32) + 1, 0, size - 1)
+        return (i0, w0), (i1, w1)
+
+    xc = corners(gx, gw)
+    yc = corners(gy, gh)
+    zc = corners(gz, gd)
+    coeff = jnp.zeros((B, Cg, H, W), grid.dtype)
+    for zi, zwt in zc:        # zi [B,H,W]
+        for yi, ywt in yc:    # yi [H]
+            for xi, xwt in xc:
+                # advanced indexing: zi [B,H,W] broadcasts with yi/xi grids
+                yi_b = jnp.broadcast_to(yi[:, None], (H, W))
+                xi_b = jnp.broadcast_to(xi[None, :], (H, W))
+                samp = grid[jnp.arange(B)[:, None, None, None],
+                            jnp.arange(Cg)[None, :, None, None],
+                            zi[:, None], yi_b[None, None], xi_b[None, None]]
+                wt = (zwt[:, None] * ywt[None, None, :, None]
+                      * xwt[None, None, None, :])             # [B,1,H,W]
+                coeff = coeff + samp * wt
+    c4 = coeff.reshape(B, out_c, cs, H, W)
+    out = jnp.einsum("bocHW,bcHW->boHW", c4[:, :, :C], inp)
+    if has_offset:
+        out = out + c4[:, :, C]
+    return out
+
+
+_bilateral_slice_p = Primitive("bilateral_slice", _bilateral_slice_fn)
+
+
+def bilateral_slice(x, guide, grid, has_offset: bool = False):
+    """bilateral_slice_op.cu (python arg order:
+    contrib/layers/nn.py:1491 bilateral_slice(x, guide, grid, has_offset)):
+    HDRnet slicing — per output pixel, hat-weighted trilinear sample of
+    the bilateral ``grid`` [B, coeff_ch, gd, gh, gw] at (x·gw/W, y·gh/H,
+    guide·gd), applying the sliced per-pixel affine coefficients to ``x``
+    [B, C, H, W] (coeff_ch = (C+1)·out_c with offset, C·out_c without).
+    One gather per corner + einsum; grads flow to grid, guide and input
+    (bilateral_slice_grad parity)."""
+    return _bilateral_slice_p(grid, guide, x, has_offset=bool(has_offset))
+
+
+# ---------------------------------------------------------------------------
+# multi_gru
+# ---------------------------------------------------------------------------
+
+def multi_gru(x, weight_x, weight_h, bias=None, layers: int = 1,
+              origin_mode: bool = False, lengths=None):
+    """fused/multi_gru_op.cc: stacked BIDIRECTIONAL GRU — 2·layers weight
+    pairs (forward/backward per layer, multi_gru_op.cc:61), each layer
+    consuming the previous layer's fwd‖bwd concat.  The reference op is a
+    oneDNN x86 inference fusion; on TPU the same capability is this
+    composition — XLA fuses the scan body itself, so the fusion axis has
+    no separate kernel.  ``x`` [B, T, I]; weight_x[i] [I_i, 3H],
+    weight_h[i] [H, 3H], bias[i] [3H]; gate order (u, r, c) as
+    fusion_gru; origin_mode picks h' = u·h + (1-u)·c.  Returns
+    [B, T, 2H] of the last layer."""
+    def cell(xg, h, wh, origin):
+        H_ = h.shape[-1]
+        hg = h @ wh[:, :2 * H_]
+        u = jax.nn.sigmoid(xg[:, :H_] + hg[:, :H_])
+        r = jax.nn.sigmoid(xg[:, H_:2 * H_] + hg[:, H_:])
+        c = jnp.tanh(xg[:, 2 * H_:] + (r * h) @ wh[:, 2 * H_:])
+        return u * h + (1 - u) * c if origin else (1 - u) * h + u * c
+
+    xa = _arr(x).astype(jnp.float32)
+    B, T, _ = xa.shape
+    mask = None
+    if lengths is not None:
+        mask = jnp.arange(T)[None, :] < _arr(lengths)[:, None]   # [B,T]
+
+    out = xa
+    for layer in range(int(layers)):
+        dirs = []
+        for d in range(2):
+            i = 2 * layer + d
+            wx = _arr(weight_x[i]).astype(jnp.float32)
+            wh = _arr(weight_h[i]).astype(jnp.float32)
+            b = None if bias is None else _arr(bias[i]).astype(jnp.float32)
+            xs = out if d == 0 else out[:, ::-1]
+            m = mask if d == 0 else (None if mask is None
+                                     else mask[:, ::-1])
+            xg = xs @ wx + (0 if b is None else b)               # [B,T,3H]
+            Hsz = wh.shape[0]
+
+            if m is None:
+                def step(h, g):
+                    h_new = cell(g, h, wh, origin_mode)
+                    return h_new, h_new
+                seq = jnp.swapaxes(xg, 0, 1)
+            else:
+                def step(h, t):
+                    g, mt = t
+                    h_new = cell(g, h, wh, origin_mode)
+                    h_new = jnp.where(mt[:, None], h_new, h)
+                    return h_new, h_new
+                seq = (jnp.swapaxes(xg, 0, 1), jnp.swapaxes(m, 0, 1))
+            _, hs = jax.lax.scan(step, jnp.zeros((B, Hsz)), seq)
+            hs = jnp.swapaxes(hs, 0, 1)                          # [B,T,H]
+            dirs.append(hs if d == 0 else hs[:, ::-1])
+        out = jnp.concatenate(dirs, axis=-1)
+        if mask is not None:
+            out = out * mask[..., None]
+    return Tensor(out)

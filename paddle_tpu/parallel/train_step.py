@@ -490,7 +490,16 @@ class TrainStep:
                 "on its own shard, so there is no replicate fallback")
 
         nproc = jax.process_count()
-        local_dp = max(1, dp // nproc) if nproc > 1 else dp
+        if nproc > 1 and dp > 1 and dp % nproc != 0:
+            # per-process local shards can only tile the dp axis when every
+            # process owns the same whole number of dp slots; otherwise the
+            # shard boundaries straddle process device halves
+            raise ValueError(
+                f"multi-process feed: dp degree {dp} must be divisible by "
+                f"the process count {nproc} (each process feeds whole dp "
+                "slots); reshape the mesh or build the global arrays "
+                "yourself with jax.make_array_from_process_local_data")
+        local_dp = dp // nproc if (nproc > 1 and dp > 1) else dp
 
         def put(x):
             if x is None:
@@ -505,20 +514,21 @@ class TrainStep:
                 sh = self.batch_spec
             elif x.ndim >= 1 and dp > 1 and x.shape[0] % local_dp == 0:
                 sh = batch_sharding(self.mesh, ndim=x.ndim)
-            elif nproc > 1:
+            elif nproc > 1 and dp > 1:
                 # replication across processes assumes IDENTICAL host data
-                # on every rank — but each rank feeds its OWN shard here,
-                # so 'replicating' would commit different values per rank
-                # and silently diverge the SPMD state. Fail loudly.
+                # on every rank — but with a live dp axis each rank feeds
+                # its OWN shard, so 'replicating' would commit different
+                # values per rank and silently diverge the SPMD state.
                 raise ValueError(
                     f"multi-process feed: local batch dim {x.shape[0]} is "
-                    f"not divisible by the local dp degree {local_dp} "
-                    f"(dp={dp} over {nproc} processes); per-rank shards "
-                    "cannot be replicated — pad the batch or build the "
-                    "global array yourself with "
+                    f"not divisible by this process's dp slots ({local_dp}"
+                    f"; dp={dp} over {nproc} processes) — pad the batch or "
+                    "build the global array yourself with "
                     "jax.make_array_from_process_local_data")
             else:
-                # batch not divisible by dp: replicate rather than fail
+                # no dp axis (or single-process indivisible batch):
+                # replicate. Multi-process contract: with dp==1 every rank
+                # must feed the SAME full batch (there is no shard to own).
                 return _global_put(x, NamedSharding(self.mesh, P()))
             if nproc > 1:
                 # each process feeds its LOCAL batch shard; assemble the

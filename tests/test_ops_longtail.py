@@ -1,0 +1,529 @@
+"""Oracle tests for the final ten ledger ops (ops/longtail.py).
+
+Each oracle is an independent numpy transcription of the reference
+kernel's loop semantics (file cited per test), not a re-run of the
+implementation; differentiable ops also get numeric-gradient checks
+(op_test.check_grad — the reference's check_grad strategy,
+python/paddle/fluid/tests/unittests/op_test.py:1329).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import longtail as L
+from op_test import check_grad
+
+
+def test_rank_attention_oracle():
+    """rank_attention.cu.h: expand-input x expand-param batched GEMM."""
+    rng = np.random.RandomState(0)
+    N, D, C, K = 5, 3, 4, 2
+    x = rng.randn(N, D).astype("f4")
+    p = rng.randn(K * K * D, C).astype("f4")
+    ro = np.zeros((N, 2 * K + 1), np.int32)
+    for i in range(N):
+        ro[i, 0] = rng.randint(0, K + 1)           # own rank (0 = none)
+        for k in range(K):
+            ro[i, 2 * k + 1] = rng.randint(0, K + 1)
+            ro[i, 2 * k + 2] = rng.randint(0, N)
+
+    want = np.zeros((N, C), "f4")
+    p3 = p.reshape(K * K, D, C)
+    for i in range(N):
+        lower = ro[i, 0] - 1
+        for k in range(K):
+            faster = ro[i, 2 * k + 1] - 1
+            if lower < 0 or faster < 0:
+                continue
+            row = ro[i, 2 * k + 2]
+            want[i] += x[row] @ p3[lower * K + faster]
+
+    got = L.rank_attention(paddle.to_tensor(x), paddle.to_tensor(ro),
+                           paddle.to_tensor(p), max_rank=K).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # grad flows into rank_param (rank_attention_grad's one output)
+    check_grad(lambda pp: L.rank_attention(
+        paddle.to_tensor(x), paddle.to_tensor(ro), pp, max_rank=K), [p])
+
+
+def test_pyramid_hash_contract():
+    """pyramid_hash_op.cc: n-gram enumeration, filtering, chunked
+    embedding assembly from w slices."""
+    rng = np.random.RandomState(0)
+    space, rand_len, num_emb = 50, 4, 8
+    w = rng.randn(space + rand_len).astype("f4")
+    seqs = [[1, 2, 3, 4], [7, 8], [9]]
+    out, drop, offs = L.pyramid_hash(
+        seqs, paddle.to_tensor(w), num_emb=num_emb, space_len=space,
+        rand_len=rand_len, pyramid_layer=3)
+    # seq0: bigrams 3 + trigrams 2 = 5; seq1: 1 bigram; seq2: none (w<2)
+    assert offs == [0, 5, 6, 6]
+    assert drop.numpy().tolist() == [1] * 6
+    o = out.numpy()
+    assert o.shape == (6, num_emb)
+    # every chunk is a contiguous w slice
+    flat = w
+    for m in range(6):
+        for c in range(num_emb // rand_len):
+            chunk = o[m, c * rand_len:(c + 1) * rand_len]
+            found = any(np.allclose(chunk, flat[p:p + rand_len])
+                        for p in range(space))
+            assert found, (m, c)
+    # determinism
+    out2, _, _ = L.pyramid_hash(
+        seqs, paddle.to_tensor(w), num_emb=num_emb, space_len=space,
+        rand_len=rand_len, pyramid_layer=3)
+    np.testing.assert_array_equal(o, out2.numpy())
+
+    # white list keeps only listed terms; black list removes
+    outw, dropw, offsw = L.pyramid_hash(
+        seqs, paddle.to_tensor(w), num_emb=num_emb, space_len=space,
+        rand_len=rand_len, pyramid_layer=3, white_list=[(1, 2), (2, 3, 4)])
+    assert offsw == [0, 2, 2, 2] and dropw.numpy().sum() == 2
+    outb, dropb, _ = L.pyramid_hash(
+        seqs, paddle.to_tensor(w), num_emb=num_emb, space_len=space,
+        rand_len=rand_len, pyramid_layer=3, black_list=[(7, 8)])
+    assert dropb.numpy().tolist()[-1] == 0
+
+    # training dropout is seed-deterministic and marks drop_pos
+    outd, dropd, _ = L.pyramid_hash(
+        seqs, paddle.to_tensor(w), num_emb=num_emb, space_len=space,
+        rand_len=rand_len, pyramid_layer=3, drop_out_percent=0.99,
+        is_training=True, seed=3)
+    assert dropd.numpy().sum() < 6
+
+    # gradient reaches w through the gather
+    t = paddle.to_tensor(w)
+    t.stop_gradient = False
+    o3, _, _ = L.pyramid_hash(seqs, t, num_emb=num_emb, space_len=space,
+                              rand_len=rand_len, pyramid_layer=3)
+    o3.sum().backward()
+    assert np.abs(t.grad.numpy()).sum() > 0
+
+
+def _tree_oracle(edges, feats, filt, max_depth):
+    """Independent transcription of tree2col.cc construct_patch + the
+    patch·filter matmul."""
+    n = feats.shape[0]
+    tr = [[] for _ in range(n + 1)]
+    for u, v in edges:
+        if u == 0 or v == 0:
+            break
+        tr[int(u)].append(int(v))
+    F = feats.shape[1]
+    O, M = filt.shape[2], filt.shape[3]
+    out = np.zeros((n, O, M), "f4")
+    W2 = filt.reshape(F * 3, O * M)
+    D = float(max_depth)
+    for root in range(1, n + 1):
+        # DFS matching the reference stack walk
+        patch = [(root, 1, 1, 0)]
+        visited = {root}
+        stack = [(root, 0)]
+        while stack:
+            node, depth = stack[-1]
+            end = True
+            for i, v in enumerate(tr[node]):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, depth + 1))
+                    patch.append((v, i + 1, len(tr[node]), depth + 1))
+                    end = False
+            if end:
+                stack.pop()
+        row = np.zeros((F, 3), "f4")
+        for (v, idx, pclen, depth) in patch:
+            et = (D - depth) / D
+            pos = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+            el = (1.0 - et) * pos
+            er = (1.0 - et) * (1.0 - el)
+            row += np.outer(feats[v - 1], [el, er, et])
+        out[root - 1] = (row.reshape(-1) @ W2).reshape(O, M)
+    return out
+
+
+def test_tree_conv_oracle():
+    rng = np.random.RandomState(1)
+    n, F, O, M = 6, 3, 4, 2
+    feats = rng.randn(1, n, F).astype("f4")
+    edges = np.array([[[1, 2], [1, 3], [2, 4], [2, 5], [3, 6], [0, 0]]],
+                     np.int32)
+    filt = rng.randn(F, 3, O, M).astype("f4")
+    for depth in (2, 3):
+        got = L.tree_conv(paddle.to_tensor(feats), paddle.to_tensor(edges),
+                          paddle.to_tensor(filt), max_depth=depth).numpy()
+        want = _tree_oracle(edges[0], feats[0], filt, depth)
+        np.testing.assert_allclose(got[0], want, rtol=1e-4, atol=1e-5)
+
+    # grads reach features and filter (tree_conv_grad parity)
+    check_grad(lambda f: L.tree_conv(f, paddle.to_tensor(edges),
+                                     paddle.to_tensor(filt), max_depth=2),
+               [feats])
+    check_grad(lambda w: L.tree_conv(paddle.to_tensor(feats),
+                                     paddle.to_tensor(edges), w,
+                                     max_depth=2), [filt])
+
+
+def _correlation_oracle(x1, x2, pad, ksize, maxd, s1, s2):
+    """correlation_op.cu:86 loop transcription."""
+    B, C, H, W = x1.shape
+    krad = (ksize - 1) // 2
+    drad = maxd // s2
+    D = 2 * drad + 1
+    ph, pw = H + 2 * pad, W + 2 * pad
+    p1 = np.zeros((B, C, ph + 2 * maxd, pw + 2 * maxd), "f8")
+    p2 = np.zeros_like(p1)
+    p1[:, :, pad + maxd:pad + maxd + H, pad + maxd:pad + maxd + W] = x1
+    p2[:, :, pad + maxd:pad + maxd + H, pad + maxd:pad + maxd + W] = x2
+    out_h = int(np.ceil((ph - 2 * (krad + maxd)) / s1))
+    out_w = int(np.ceil((pw - 2 * (krad + maxd)) / s1))
+    out = np.zeros((B, D * D, out_h, out_w), "f8")
+    for b in range(B):
+        for y in range(out_h):
+            for x in range(out_w):
+                h1 = y * s1 + maxd + maxd   # +maxd guard offset
+                w1 = x * s1 + maxd + maxd
+                t = 0
+                for tj in range(-drad, drad + 1):
+                    for ti in range(-drad, drad + 1):
+                        acc = 0.0
+                        for j in range(-krad, krad + 1):
+                            for i in range(-krad, krad + 1):
+                                a = p1[b, :, h1 + j, w1 + i]
+                                bb = p2[b, :, h1 + tj * s2 + j,
+                                        w1 + ti * s2 + i]
+                                acc += float((a * bb).sum())
+                        out[b, t, y, x] = acc / (ksize * ksize * C)
+                        t += 1
+    return out
+
+
+def test_correlation_oracle():
+    rng = np.random.RandomState(2)
+    x1 = rng.randn(1, 3, 7, 7).astype("f4")
+    x2 = rng.randn(1, 3, 7, 7).astype("f4")
+    for (pad, k, maxd, s1, s2) in [(1, 1, 1, 1, 1), (2, 3, 2, 2, 1),
+                                   (2, 1, 2, 1, 2)]:
+        got = L.correlation(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                            pad_size=pad, kernel_size=k,
+                            max_displacement=maxd, stride1=s1,
+                            stride2=s2).numpy()
+        want = _correlation_oracle(x1, x2, pad, k, maxd, s1, s2)
+        assert got.shape == want.shape, (got.shape, want.shape)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    check_grad(lambda a: L.correlation(
+        a, paddle.to_tensor(x2), pad_size=1, kernel_size=1,
+        max_displacement=1, stride1=1, stride2=1), [x1])
+
+
+def test_prroi_pool_integral():
+    """prroi_pool_op.h: bin value = exact integral of the bilinear
+    interpolant / bin area — validated against dense numeric
+    integration."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 2, 6, 6).astype("f4")
+    rois = np.array([[0.7, 1.2, 4.3, 5.1]], "f4")
+    ph = pw = 2
+    got = L.prroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                       ph, pw, 1.0).numpy()
+
+    def bilin(c, h, w):
+        if h < 0 or h > 5 or w < 0 or w > 5:
+            pass  # hat extends ±1 beyond grid; value 0 outside handled below
+        h0, w0 = int(np.floor(h)), int(np.floor(w))
+        v = 0.0
+        for hh in (h0, h0 + 1):
+            for ww in (w0, w0 + 1):
+                if 0 <= hh < 6 and 0 <= ww < 6:
+                    v += x[0, c, hh, ww] * max(0, 1 - abs(h - hh)) * \
+                        max(0, 1 - abs(w - ww))
+        return v
+
+    S = 80
+    for c in range(2):
+        for py in range(ph):
+            for px in range(pw):
+                y0 = 1.2 + py * (5.1 - 1.2) / ph
+                y1 = 1.2 + (py + 1) * (5.1 - 1.2) / ph
+                x0 = 0.7 + px * (4.3 - 0.7) / pw
+                x1 = 0.7 + (px + 1) * (4.3 - 0.7) / pw
+                ys = np.linspace(y0, y1, S, endpoint=False) + \
+                    (y1 - y0) / (2 * S)
+                xs = np.linspace(x0, x1, S, endpoint=False) + \
+                    (x1 - x0) / (2 * S)
+                acc = np.mean([[bilin(c, yy, xx) for xx in xs]
+                               for yy in ys])
+                np.testing.assert_allclose(got[0, c, py, px], acc,
+                                           rtol=5e-3, atol=5e-3)
+    check_grad(lambda a: L.prroi_pool(a, paddle.to_tensor(rois), 2, 2,
+                                      1.0), [x])
+    # roi-coordinate gradient exists too (PrRoI's defining feature)
+    t = paddle.to_tensor(rois)
+    t.stop_gradient = False
+    L.prroi_pool(paddle.to_tensor(x), t, 2, 2, 1.0).sum().backward()
+    assert np.abs(t.grad.numpy()).sum() > 0
+
+
+def test_similarity_focus_oracle():
+    """similarity_focus_op.h: greedy row/col-exclusive top selection."""
+    x = np.zeros((1, 2, 3, 3), "f4")
+    x[0, 0] = [[9, 1, 2], [1, 8, 3], [2, 3, 7]]       # diagonal max
+    x[0, 1] = [[0, 0, 0], [0, 0, 0], [0, 0, 0]]
+    out = L.similarity_focus(paddle.to_tensor(x), axis=1,
+                             indexes=[0]).numpy()
+    want = np.zeros_like(x)
+    want[0, :, 0, 0] = 1
+    want[0, :, 1, 1] = 1
+    want[0, :, 2, 2] = 1
+    np.testing.assert_array_equal(out, want)
+    # conflict case: second-best in same row is skipped
+    x2 = np.zeros((1, 1, 2, 3), "f4")
+    x2[0, 0] = [[9, 8, 1], [2, 3, 4]]
+    out2 = L.similarity_focus(paddle.to_tensor(x2), axis=1,
+                              indexes=[0]).numpy()
+    want2 = np.zeros_like(x2)
+    want2[0, 0, 0, 0] = 1      # 9 picked
+    want2[0, 0, 1, 2] = 1      # 8 blocked (row 0 used); 4 next valid
+    np.testing.assert_array_equal(out2, want2)
+
+
+def _def_psroi_oracle(x, rois, trans, no_trans, scale, out_dim, gsize,
+                      psize, part, spp, tstd):
+    """deformable_psroi_pooling_op.h CPU kernel transcription."""
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    ncls = 1 if no_trans else trans.shape[1] // 2
+    ceach = out_dim // ncls
+    out = np.zeros((R, out_dim, psize, psize), "f8")
+    for n in range(R):
+        rsw = round(rois[n, 0]) * scale - 0.5
+        rsh = round(rois[n, 1]) * scale - 0.5
+        rew = (round(rois[n, 2]) + 1.0) * scale - 0.5
+        reh = (round(rois[n, 3]) + 1.0) * scale - 0.5
+        rw = max(rew - rsw, 0.1)
+        rh = max(reh - rsh, 0.1)
+        bh, bw = rh / psize, rw / psize
+        sbh, sbw = bh / spp, bw / spp
+        for ctop in range(out_dim):
+            cls = ctop // ceach
+            for phi in range(psize):
+                for pwi in range(psize):
+                    p_h = int(np.floor(float(phi) / psize * part))
+                    p_w = int(np.floor(float(pwi) / psize * part))
+                    tx = 0.0 if no_trans else \
+                        trans[n, cls * 2, p_h, p_w] * tstd
+                    ty = 0.0 if no_trans else \
+                        trans[n, cls * 2 + 1, p_h, p_w] * tstd
+                    ws = pwi * bw + rsw + tx * rw
+                    hs = phi * bh + rsh + ty * rh
+                    gw_ = min(max(pwi * gsize // psize, 0), gsize - 1)
+                    gh_ = min(max(phi * gsize // psize, 0), gsize - 1)
+                    c = (ctop * gsize + gh_) * gsize + gw_
+                    acc, cnt = 0.0, 0
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            w_ = ws + iw * sbw
+                            h_ = hs + ih * sbh
+                            if w_ < -0.5 or w_ > W - 0.5 or \
+                               h_ < -0.5 or h_ > H - 0.5:
+                                continue
+                            w_ = min(max(w_, 0.0), W - 1.0)
+                            h_ = min(max(h_, 0.0), H - 1.0)
+                            h0, w0 = int(np.floor(h_)), int(np.floor(w_))
+                            h1, w1 = min(h0 + 1, H - 1), min(w0 + 1, W - 1)
+                            ah, aw = h_ - h0, w_ - w0
+                            v = (x[0, c, h0, w0] * (1 - ah) * (1 - aw)
+                                 + x[0, c, h0, w1] * (1 - ah) * aw
+                                 + x[0, c, h1, w0] * ah * (1 - aw)
+                                 + x[0, c, h1, w1] * ah * aw)
+                            acc += v
+                            cnt += 1
+                    out[n, ctop, phi, pwi] = 0.0 if cnt == 0 else acc / cnt
+    return out
+
+
+def test_deformable_psroi_oracle():
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 8, 6, 6).astype("f4")     # out_dim 2, group 2x2
+    rois = np.array([[1.0, 1.0, 4.0, 4.0], [0.0, 0.0, 5.0, 3.0]], "f4")
+    trans = (0.5 * rng.randn(2, 2, 2, 2)).astype("f4")
+    got = L.deformable_psroi_pooling(
+        paddle.to_tensor(x), paddle.to_tensor(rois),
+        paddle.to_tensor(trans), spatial_scale=1.0, output_dim=2,
+        group_size=2, pooled_size=2, part_size=2, sample_per_part=3,
+        trans_std=0.1).numpy()
+    want = _def_psroi_oracle(x, rois, trans, False, 1.0, 2, 2, 2, 2, 3, 0.1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # no_trans path + grads to input and offsets
+    got2 = L.deformable_psroi_pooling(
+        paddle.to_tensor(x), paddle.to_tensor(rois), None,
+        spatial_scale=1.0, output_dim=2, group_size=2, pooled_size=2,
+        sample_per_part=3).numpy()
+    want2 = _def_psroi_oracle(x, rois, None, True, 1.0, 2, 2, 2, 2, 3, 0.1)
+    np.testing.assert_allclose(got2, want2, rtol=1e-4, atol=1e-5)
+    check_grad(lambda a: L.deformable_psroi_pooling(
+        a, paddle.to_tensor(rois), paddle.to_tensor(trans),
+        spatial_scale=1.0, output_dim=2, group_size=2, pooled_size=2,
+        part_size=2, sample_per_part=3, trans_std=0.1), [x], atol=5e-3)
+
+
+def test_roi_perspective_transform_rect():
+    """Axis-aligned rectangle quad: the homography degenerates to a
+    scale+shift, so sampled values equal direct bilinear interpolation
+    at the mapped coords (roi_perspective_transform_op.cc:294)."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 2, 8, 8).astype("f4")
+    # corners clockwise from top-left: (1,1) (5,1) (5,4) (1,4)
+    q = np.array([[1.0, 1.0, 5.0, 1.0, 5.0, 4.0, 1.0, 4.0]], "f4")
+    th, tw = 4, 5
+    out, mask, tm = L.roi_perspective_transform(
+        paddle.to_tensor(x), paddle.to_tensor(q), th, tw, 1.0)
+    out, mask = out.numpy(), mask.numpy()
+    # matrix maps (0,0)->(1,1) and spans the quad over the normalized grid
+    m = tm.numpy()[0]
+    assert abs(m[2] - 1.0) < 1e-4 and abs(m[5] - 1.0) < 1e-4
+    # interior pixels: value == bilinear sample, mask == 1
+    nh, nw = th, tw       # normalized == transformed for this aspect
+    for oh in range(th):
+        for ow in range(tw):
+            in_w = (m[0] * ow + m[1] * oh + m[2]) / \
+                (m[6] * ow + m[7] * oh + m[8])
+            in_h = (m[3] * ow + m[4] * oh + m[5]) / \
+                (m[6] * ow + m[7] * oh + m[8])
+            inside = 1.0 - 1e-4 <= in_w <= 5.0 + 1e-4 and \
+                1.0 - 1e-4 <= in_h <= 4.0 + 1e-4
+            if not inside:
+                assert mask[0, 0, oh, ow] == 0
+                continue
+            assert mask[0, 0, oh, ow] == 1, (oh, ow)
+            h0, w0 = int(np.floor(in_h)), int(np.floor(in_w))
+            h1, w1 = min(h0 + 1, 7), min(w0 + 1, 7)
+            ah, aw = in_h - h0, in_w - w0
+            for c in range(2):
+                want = (x[0, c, h0, w0] * (1 - ah) * (1 - aw)
+                        + x[0, c, h0, w1] * (1 - ah) * aw
+                        + x[0, c, h1, w0] * ah * (1 - aw)
+                        + x[0, c, h1, w1] * ah * aw)
+                np.testing.assert_allclose(out[0, c, oh, ow], want,
+                                           rtol=1e-4, atol=1e-5)
+    # grad to features through the sampler
+    check_grad(lambda a: L.roi_perspective_transform(
+        a, paddle.to_tensor(q), th, tw, 1.0)[0], [x])
+
+
+def _bilateral_oracle(grid, guide, inp, has_offset):
+    """bilateral_slice_op.cu:53 transcription."""
+    B, Cg, gd, gh, gw = grid.shape
+    _, C, H, W = inp.shape
+    cs = C + 1 if has_offset else C
+    out_c = Cg // cs
+    out = np.zeros((B, out_c, H, W), "f8")
+    for b in range(B):
+        for oc in range(out_c):
+            for y in range(H):
+                for x_ in range(W):
+                    gx = (x_ + 0.5) * gw / W
+                    gy = (y + 0.5) * gh / H
+                    gz = guide[b, y, x_] * gd
+                    fx = int(np.floor(gx - 0.5))
+                    fy = int(np.floor(gy - 0.5))
+                    fz = int(np.floor(gz - 0.5))
+                    val = 0.0
+                    for ic in range(cs):
+                        cf = 0.0
+                        for xx in (fx, fx + 1):
+                            xi = min(max(xx, 0), gw - 1)
+                            wx = max(1 - abs(xx + 0.5 - gx), 0)
+                            for yy in (fy, fy + 1):
+                                yi = min(max(yy, 0), gh - 1)
+                                wy = max(1 - abs(yy + 0.5 - gy), 0)
+                                for zz in (fz, fz + 1):
+                                    zi = min(max(zz, 0), gd - 1)
+                                    wz = max(1 - abs(zz + 0.5 - gz), 0)
+                                    cf += grid[b, cs * oc + ic, zi, yi, xi] \
+                                        * wx * wy * wz
+                        val += cf * (inp[b, ic, y, x_] if ic < C else 1.0)
+                    out[b, oc, y, x_] = val
+    return out
+
+
+def test_bilateral_slice_oracle():
+    rng = np.random.RandomState(6)
+    grid = rng.randn(1, 8, 3, 4, 4).astype("f4")   # out_c=2, cs=4 (C=3+off)
+    guide = rng.rand(1, 4, 5).astype("f4")
+    inp = rng.randn(1, 3, 4, 5).astype("f4")
+    got = L.bilateral_slice(paddle.to_tensor(inp), paddle.to_tensor(guide),
+                            paddle.to_tensor(grid), has_offset=True).numpy()
+    want = _bilateral_oracle(grid, guide, inp, True)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    grid2 = rng.randn(1, 6, 3, 4, 4).astype("f4")  # no offset: cs=3
+    got2 = L.bilateral_slice(paddle.to_tensor(inp), paddle.to_tensor(guide),
+                             paddle.to_tensor(grid2),
+                             has_offset=False).numpy()
+    want2 = _bilateral_oracle(grid2, guide, inp, False)
+    np.testing.assert_allclose(got2, want2, rtol=1e-4, atol=1e-5)
+    check_grad(lambda g: L.bilateral_slice(
+        paddle.to_tensor(inp), paddle.to_tensor(guide), g,
+        has_offset=True), [grid])
+    check_grad(lambda i: L.bilateral_slice(
+        i, paddle.to_tensor(guide), paddle.to_tensor(grid),
+        has_offset=True), [inp])
+
+
+def _gru_oracle(x, lens, wx, wh, b, layers, origin):
+    """fusion_gru math per stacked bidirectional layer
+    (fused/multi_gru_op.cc: 2·layers weight sets, fwd‖bwd concat)."""
+    B, T, _ = x.shape
+    out = x.astype("f8")
+    for layer in range(layers):
+        dirs = []
+        for d in range(2):
+            i = 2 * layer + d
+            H = wh[i].shape[0]
+            hs = np.zeros((B, T, H), "f8")
+            for bi in range(B):
+                h = np.zeros(H, "f8")
+                rng_t = range(T) if d == 0 else range(T - 1, -1, -1)
+                for t in rng_t:
+                    if t >= lens[bi]:
+                        hs[bi, t] = h if d == 0 else 0
+                        continue
+                    g = out[bi, t] @ wx[i] + b[i]
+                    hg = h @ wh[i][:, :2 * H]
+                    u = 1 / (1 + np.exp(-(g[:H] + hg[:H])))
+                    r = 1 / (1 + np.exp(-(g[H:2 * H] + hg[H:])))
+                    c = np.tanh(g[2 * H:] + (r * h) @ wh[i][:, 2 * H:])
+                    h = u * h + (1 - u) * c if origin else \
+                        (1 - u) * h + u * c
+                    hs[bi, t] = h
+            dirs.append(hs)
+        out = np.concatenate(dirs, -1)
+        for bi in range(B):
+            out[bi, lens[bi]:] = 0
+    return out
+
+
+def test_multi_gru_oracle():
+    rng = np.random.RandomState(7)
+    B, T, I, H, layers = 2, 5, 3, 4, 2
+    x = rng.randn(B, T, I).astype("f4")
+    lens = np.array([5, 3])
+    sizes = [I, I, 2 * H, 2 * H]
+    wx = [rng.randn(sizes[i], 3 * H).astype("f4") for i in range(4)]
+    wh = [rng.randn(H, 3 * H).astype("f4") for i in range(4)]
+    b = [rng.randn(3 * H).astype("f4") for _ in range(4)]
+    for origin in (False, True):
+        got = L.multi_gru(paddle.to_tensor(x), wx, wh, b, layers=layers,
+                          origin_mode=origin, lengths=lens).numpy()
+        want = _gru_oracle(x, lens, wx, wh, b, layers, origin)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_ledger_has_zero_absent():
+    """VERDICT r4 #3: 'COMPLETE means zero absent'."""
+    from paddle_tpu.ops.coverage import OP_LEDGER
+    absent = [k for k, (cls, _) in OP_LEDGER.items() if cls == "absent"]
+    assert absent == [], absent
