@@ -490,16 +490,8 @@ class TrainStep:
                 "on its own shard, so there is no replicate fallback")
 
         nproc = jax.process_count()
-        if nproc > 1 and dp > 1 and dp % nproc != 0:
-            # per-process local shards can only tile the dp axis when every
-            # process owns the same whole number of dp slots; otherwise the
-            # shard boundaries straddle process device halves
-            raise ValueError(
-                f"multi-process feed: dp degree {dp} must be divisible by "
-                f"the process count {nproc} (each process feeds whole dp "
-                "slots); reshape the mesh or build the global arrays "
-                "yourself with jax.make_array_from_process_local_data")
-        local_dp = dp // nproc if (nproc > 1 and dp > 1) else dp
+        local_dp = dp // nproc if (nproc > 1 and dp > 1 and
+                                   dp % nproc == 0) else dp
 
         def put(x):
             if x is None:
@@ -508,6 +500,17 @@ class TrainStep:
             # make_array_from_process_local_data) passes straight through
             if isinstance(x, jax.Array) and not x.is_fully_addressable:
                 return x
+            if nproc > 1 and dp > 1 and dp % nproc != 0:
+                # host-fed local shards can only tile the dp axis when every
+                # process owns the same whole number of dp slots; otherwise
+                # the shard boundaries straddle process device halves.
+                # (Caller-built global arrays took the passthrough above.)
+                raise ValueError(
+                    f"multi-process feed: dp degree {dp} must be divisible "
+                    f"by the process count {nproc} (each process feeds "
+                    "whole dp slots); reshape the mesh or build the global "
+                    "arrays yourself with "
+                    "jax.make_array_from_process_local_data")
             # explicit batch_spec only applies to arrays of the lead rank;
             # lower-rank labels get their own rank-matched sharding
             if self.batch_spec is not None and x.ndim == lead_ndim:

@@ -4,9 +4,12 @@ Reference parity: python/paddle/text/ (RNN-era model zoo + datasets). The TPU
 build additionally ships the transformer-LM family (bert.py) because BERT-base
 pretraining is a headline benchmark workload (BASELINE.json config 3).
 """
-from . import models  # noqa: F401
+from . import models, datasets  # noqa: F401
 from .models import (  # noqa: F401
     BertModel, BertConfig, BertForPretraining, GPTModel, GPTConfig,
+)
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
 )
 from ..ops.decode import viterbi_decode  # noqa: F401
 

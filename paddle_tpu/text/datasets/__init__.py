@@ -1,0 +1,673 @@
+"""paddle.text.datasets parity: the reference's 7 text dataset loaders.
+
+Reference: python/paddle/text/datasets/{conll05,imdb,imikolov,movielens,
+uci_housing,wmt14,wmt16}.py.  Zero-egress container policy (same as
+vision/datasets): each loader parses the REFERENCE'S record format when a
+local ``data_file`` is supplied (the formats the reference downloads —
+tarballs of tokenized text, ``::``-separated .dat files, space-separated
+housing rows), and otherwise generates deterministic synthetic records with
+the right structure so pipelines and tests run without network.
+"""
+from __future__ import annotations
+
+import collections
+import gzip
+import os
+import re
+import string
+import tarfile
+import zipfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "MovieInfo", "UserInfo"]
+
+
+def _to_text(b):
+    return b.decode("utf-8", "ignore") if isinstance(b, bytes) else b
+
+
+# ---------------------------------------------------------------------------
+# UCIHousing
+# ---------------------------------------------------------------------------
+
+class UCIHousing(Dataset):
+    """uci_housing.py: 13 normalized features + 1 target per row, 80/20
+    train/test split.  ``data_file`` is the space-separated housing.data
+    format; synthetic fallback keeps the 14-column contract."""
+
+    FEATURE_NUM = 14
+
+    def __init__(self, data_file=None, mode="train", download=True,
+                 synthetic_size=120):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        if data_file is not None and os.path.exists(data_file):
+            data = np.fromfile(data_file, sep=" ")
+            data = data.reshape(len(data) // self.FEATURE_NUM,
+                                self.FEATURE_NUM)
+        else:
+            rng = np.random.RandomState(42)
+            data = rng.rand(synthetic_size, self.FEATURE_NUM) * 10
+        maxs, mins = data.max(axis=0), data.min(axis=0)
+        avgs = data.mean(axis=0)
+        for i in range(self.FEATURE_NUM - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / \
+                max(maxs[i] - mins[i], 1e-6)
+        offset = int(len(data) * 0.8)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (row[:-1].astype("float32"), row[-1:].astype("float32"))
+
+    def __len__(self):
+        return len(self.data)
+
+
+# ---------------------------------------------------------------------------
+# Imdb
+# ---------------------------------------------------------------------------
+
+class Imdb(Dataset):
+    """imdb.py: aclImdb tarball of train/test pos/neg docs; word dict built
+    from corpus frequency (> cutoff), docs mapped to ids, label 0 = pos,
+    1 = neg (the reference's ordering)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True, synthetic_size=64):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        if data_file is not None and os.path.exists(data_file):
+            docs_by_split = self._tokenize_tar(data_file)
+            self.word_idx = self._build_dict(
+                [d for split in docs_by_split.values()
+                 for lab in split.values() for d in lab], cutoff)
+            unk = self.word_idx["<unk>"]
+            self.docs, self.labels = [], []
+            for label_name, label in (("pos", 0), ("neg", 1)):
+                for doc in docs_by_split[self.mode][label_name]:
+                    self.docs.append([self.word_idx.get(w, unk)
+                                      for w in doc])
+                    self.labels.append(label)
+        else:
+            rng = np.random.RandomState(0 if self.mode == "train" else 1)
+            vocab = 512
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+            self.word_idx["<unk>"] = vocab
+            self.docs = [list(rng.randint(0, vocab,
+                                          rng.randint(5, 40)))
+                         for _ in range(synthetic_size)]
+            self.labels = list(rng.randint(0, 2, synthetic_size))
+
+    @staticmethod
+    def _tokenize_tar(path):
+        pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        out = {"train": {"pos": [], "neg": []},
+               "test": {"pos": [], "neg": []}}
+        trans = str.maketrans("", "", string.punctuation)
+        with tarfile.open(path) as tf:
+            for m in tf:
+                g = pat.match(m.name)
+                if not g:
+                    continue
+                text = _to_text(tf.extractfile(m).read()).rstrip("\n\r")
+                out[g.group(1)][g.group(2)].append(
+                    text.translate(trans).lower().split())
+        return out
+
+    @staticmethod
+    def _build_dict(docs, cutoff):
+        freq = collections.defaultdict(int)
+        for doc in docs:
+            for w in doc:
+                freq[w] += 1
+        kept = sorted([kv for kv in freq.items() if kv[1] > cutoff],
+                      key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def __getitem__(self, idx):
+        return (np.array(self.docs[idx]), np.array([self.labels[idx]]))
+
+    def __len__(self):
+        return len(self.docs)
+
+
+# ---------------------------------------------------------------------------
+# Imikolov (PTB)
+# ---------------------------------------------------------------------------
+
+class Imikolov(Dataset):
+    """imikolov.py: PTB language-model corpus; 'NGRAM' mode yields
+    window_size-grams, 'SEQ' mode yields (<s>+sent, sent+<e>) pairs."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True,
+                 synthetic_size=64):
+        assert data_type.upper() in ("NGRAM", "SEQ"), data_type
+        assert mode.lower() in ("train", "test"), mode
+        self.data_type = data_type.upper()
+        self.mode = mode.lower()
+        self.window_size = window_size
+
+        if data_file is not None and os.path.exists(data_file):
+            train_lines, test_lines = self._read_tar(data_file)
+            self.word_idx = self._build_dict(train_lines + test_lines,
+                                             min_word_freq)
+            lines = train_lines if self.mode == "train" else test_lines
+        else:
+            rng = np.random.RandomState(0 if self.mode == "train" else 1)
+            vocab = [f"w{i}" for i in range(64)]
+            lines = [" ".join(rng.choice(vocab, rng.randint(4, 12)))
+                     for _ in range(synthetic_size)]
+            self.word_idx = {w: i for i, w in enumerate(vocab)}
+            self.word_idx["<s>"] = len(self.word_idx)
+            self.word_idx["<e>"] = len(self.word_idx)
+            self.word_idx["<unk>"] = len(self.word_idx)
+        self._load(lines)
+
+    @staticmethod
+    def _read_tar(path):
+        with tarfile.open(path) as tf:
+            tr = tf.extractfile("./simple-examples/data/ptb.train.txt")
+            va = tf.extractfile("./simple-examples/data/ptb.valid.txt")
+            return ([_to_text(l) for l in tr.readlines()],
+                    [_to_text(l) for l in va.readlines()])
+
+    @staticmethod
+    def _build_dict(lines, min_word_freq):
+        freq = collections.defaultdict(int)
+        for l in lines:
+            for w in l.strip().split():
+                freq[w] += 1
+            freq["<s>"] += 1
+            freq["<e>"] += 1
+        freq.pop("<unk>", None)
+        kept = sorted([kv for kv in freq.items() if kv[1] > min_word_freq],
+                      key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self, lines):
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for l in lines:
+            if self.data_type == "NGRAM":
+                assert self.window_size > -1, "Invalid gram length"
+                toks = ["<s>"] + l.strip().split() + ["<e>"]
+                if len(toks) >= self.window_size:
+                    ids = [self.word_idx.get(w, unk) for w in toks]
+                    for i in range(self.window_size, len(ids) + 1):
+                        self.data.append(tuple(ids[i - self.window_size:i]))
+            else:
+                toks = l.strip().split()
+                ids = [self.word_idx.get(w, unk) for w in toks]
+                src = [self.word_idx["<s>"]] + ids
+                trg = ids + [self.word_idx["<e>"]]
+                if self.window_size > 0 and len(src) > self.window_size:
+                    continue
+                self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+# ---------------------------------------------------------------------------
+# Movielens
+# ---------------------------------------------------------------------------
+
+class MovieInfo:
+    """movielens.py:37 — id, categories, title of a movie."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+
+class UserInfo:
+    """movielens.py:62 — id, gender (M=0), bucketed age, job."""
+
+    AGES = [1, 18, 25, 35, 45, 50, 56]
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = self.AGES.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), gender({'M' if self.is_male else 'F'}), "
+                f"age({self.AGES[self.age]}), job({self.job_id})>")
+
+
+class Movielens(Dataset):
+    """movielens.py: ml-1m zip (movies.dat/users.dat/ratings.dat,
+    ``::``-separated); each record = user value + movie value + [[rating]],
+    rating rescaled to r*2-5."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True, synthetic_size=64):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.test_ratio = test_ratio
+        # private RandomState: the reference reseeds global np.random
+        # (movielens.py), which would silently correlate every other
+        # consumer of global numpy randomness in the process
+        self._rng = np.random.RandomState(rand_seed)
+        if data_file is not None and os.path.exists(data_file):
+            self._load_zip(data_file)
+        else:
+            # mode-distinct seed so the synthetic 'test' split is not the
+            # training set
+            self._synthesize(synthetic_size,
+                             rand_seed + (0 if self.mode == "train" else 1))
+
+    def _load_zip(self, path):
+        pat = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(path) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = _to_text(line).strip().split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    m = pat.match(title)
+                    title = m.group(1) if m else title
+                    self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+                    title_words.update(w.lower() for w in title.split())
+            self.movie_title_dict = {w: i for i, w in
+                                     enumerate(sorted(title_words))}
+            self.categories_dict = {c: i for i, c in
+                                    enumerate(sorted(categories))}
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job = \
+                        _to_text(line).strip().split("::")[:4]
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age,
+                                                        job)
+            self.data = []
+            is_test = self.mode == "test"
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (self._rng.random_sample() < self.test_ratio) != is_test:
+                        continue
+                    uid, mid, rating = \
+                        _to_text(line).strip().split("::")[:3]
+                    mov = self.movie_info[int(mid)]
+                    usr = self.user_info[int(uid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[float(rating) * 2 - 5.0]])
+
+    def _synthesize(self, n, seed):
+        rng = np.random.RandomState(seed)
+        cats = ["Action", "Comedy", "Drama"]
+        self.categories_dict = {c: i for i, c in enumerate(cats)}
+        self.movie_title_dict = {f"t{i}": i for i in range(32)}
+        self.movie_info = {
+            i: MovieInfo(i, [cats[i % 3]], f"t{i % 32}")
+            for i in range(1, 20)}
+        self.user_info = {
+            i: UserInfo(i, "M" if i % 2 else "F",
+                        UserInfo.AGES[i % 7], i % 10)
+            for i in range(1, 10)}
+        self.data = []
+        for _ in range(n):
+            usr = self.user_info[int(rng.randint(1, 10))]
+            mov = self.movie_info[int(rng.randint(1, 20))]
+            rating = float(rng.randint(1, 6)) * 2 - 5.0
+            self.data.append(usr.value()
+                             + mov.value(self.categories_dict,
+                                         self.movie_title_dict)
+                             + [[rating]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+# ---------------------------------------------------------------------------
+# Conll05st (SRL)
+# ---------------------------------------------------------------------------
+
+class Conll05st(Dataset):
+    """conll05.py: WSJ test split of CoNLL-2005 SRL.  Parses the
+    words/props column format (one token per line, blank line ends a
+    sentence; props column 0 = verbs, later columns = per-predicate
+    bracketed role spans) into (sentence, predicate, BIO labels) triples;
+    __getitem__ emits the 9-feature SRL record (words, 5 context windows,
+    predicate, mark, labels) exactly as the reference."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=True, synthetic_size=32):
+        del mode  # reference serves the same WSJ test split for both
+        if data_file is not None and os.path.exists(data_file):
+            words_lines, props_lines = self._read_tar(data_file)
+            self._parse(words_lines, props_lines)
+        else:
+            self._synthesize(synthetic_size)
+        self.word_dict = self._dict_or_build(word_dict_file,
+                                             self._corpus_words())
+        self.predicate_dict = self._dict_or_build(
+            verb_dict_file, sorted(set(self.predicates)))
+        self.label_dict = self._dict_or_build(
+            target_dict_file, self._label_names())
+
+    @staticmethod
+    def _read_tar(path):
+        with tarfile.open(path) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as w, \
+                    gzip.GzipFile(fileobj=pf) as p:
+                return ([_to_text(l) for l in w.readlines()],
+                        [_to_text(l) for l in p.readlines()])
+
+    def _parse(self, words_lines, props_lines):
+        self.sentences, self.predicates, self.labels = [], [], []
+        sentence, one_seg = [], []
+        for word, label in zip(words_lines, props_lines):
+            word = word.strip()
+            cols = label.strip().split()
+            if not cols:                      # sentence boundary
+                self._emit(sentence, one_seg)
+                sentence, one_seg = [], []
+                continue
+            sentence.append(word)
+            one_seg.append(cols)
+        self._emit(sentence, one_seg)
+
+    def _emit(self, sentence, one_seg):
+        if not one_seg:
+            return
+        ncols = len(one_seg[0])
+        columns = [[row[i] for row in one_seg] for i in range(ncols)]
+        verbs = [v for v in columns[0] if v != "-"]
+        for i, col in enumerate(columns[1:]):
+            lbl_seq = []
+            cur_tag, in_br = "O", False
+            for tok in col:
+                if tok == "*" and not in_br:
+                    lbl_seq.append("O")
+                elif tok == "*" and in_br:
+                    lbl_seq.append("I-" + cur_tag)
+                elif tok == "*)":
+                    lbl_seq.append("I-" + cur_tag)
+                    in_br = False
+                elif "(" in tok and ")" in tok:
+                    cur_tag = tok[1:tok.find("*")]
+                    lbl_seq.append("B-" + cur_tag)
+                    in_br = False
+                elif "(" in tok:
+                    cur_tag = tok[1:tok.find("*")]
+                    lbl_seq.append("B-" + cur_tag)
+                    in_br = True
+                else:
+                    raise ValueError(f"unexpected props token {tok!r}")
+            if i >= len(verbs) or "B-V" not in lbl_seq:
+                continue
+            self.sentences.append(list(sentence))
+            self.predicates.append(verbs[i])
+            self.labels.append(lbl_seq)
+
+    def _synthesize(self, n):
+        rng = np.random.RandomState(0)
+        vocab = [f"w{i}" for i in range(40)]
+        verbs = ["run", "eat", "see"]
+        self.sentences, self.predicates, self.labels = [], [], []
+        for _ in range(n):
+            ln = int(rng.randint(4, 9))
+            sent = list(rng.choice(vocab, ln))
+            vi = int(rng.randint(0, ln))
+            verb = verbs[int(rng.randint(0, 3))]
+            sent[vi] = verb
+            lbl = ["O"] * ln
+            lbl[vi] = "B-V"
+            if vi + 1 < ln:
+                lbl[vi + 1] = "B-A1"
+            self.sentences.append(sent)
+            self.predicates.append(verb)
+            self.labels.append(lbl)
+
+    def _corpus_words(self):
+        seen = []
+        for s in self.sentences:
+            seen.extend(w.lower() for w in s)
+        seen.extend(["bos", "eos"])
+        return sorted(set(seen))
+
+    def _label_names(self):
+        names = set()
+        for lbl in self.labels:
+            names.update(lbl)
+        return sorted(names)
+
+    @staticmethod
+    def _dict_or_build(path, fallback_items):
+        if path is not None and os.path.exists(path):
+            with open(path) as f:
+                return {l.strip(): i for i, l in enumerate(f)
+                        if l.strip()}
+        return {w: i for i, w in enumerate(fallback_items)}
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def __getitem__(self, idx):
+        sentence = [w.lower() for w in self.sentences[idx]]
+        predicate = self.predicates[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        unk = self.word_dict.get("<unk>", 0)
+        vi = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, name, pad in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                               (0, "0", None), (1, "p1", "eos"),
+                               (2, "p2", "eos")):
+            j = vi + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = pad
+        word_idx = [self.word_dict.get(w, unk) for w in sentence]
+        mk = lambda w: [self.word_dict.get(w, unk)] * n  # noqa: E731
+        pred_idx = [self.predicate_dict.get(predicate, 0)] * n
+        label_idx = [self.label_dict.get(l, 0) for l in labels]
+        return (np.array(word_idx), np.array(mk(ctx["n2"])),
+                np.array(mk(ctx["n1"])), np.array(mk(ctx["0"])),
+                np.array(mk(ctx["p1"])), np.array(mk(ctx["p2"])),
+                np.array(pred_idx), np.array(mark), np.array(label_idx))
+
+    def __len__(self):
+        return len(self.sentences)
+
+
+# ---------------------------------------------------------------------------
+# WMT14 / WMT16
+# ---------------------------------------------------------------------------
+
+class _WMTBase(Dataset):
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    @staticmethod
+    def _synth_pairs(n, seed):
+        rng = np.random.RandomState(seed)
+        return [(" ".join(f"s{j}" for j in
+                          rng.randint(0, 30, rng.randint(3, 9))),
+                 " ".join(f"t{j}" for j in
+                          rng.randint(0, 30, rng.randint(3, 9))))
+                for _ in range(n)]
+
+
+class WMT14(_WMTBase):
+    """wmt14.py: tarball with {src,trg}.dict (one word per line, rank =
+    id; rows 0-2 are <s>, <e>, <unk>) and train/test files of
+    tab-separated sentence pairs.  Records: (<s>+src+<e> ids, <s>+trg
+    ids, trg+<e> ids)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True, synthetic_size=48):
+        assert mode.lower() in ("train", "test", "gen"), mode
+        self.mode = mode.lower()
+        if data_file is not None and os.path.exists(data_file):
+            self._load_tar(data_file, dict_size)
+        else:
+            self._load_synth(synthetic_size)
+
+    def _load_tar(self, path, dict_size):
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if size >= 0 and i >= size:
+                    break
+                out[_to_text(line).strip()] = i
+            return out
+
+        with tarfile.open(path) as tf:
+            names = [m.name for m in tf if m.name.endswith("src.dict")]
+            self.src_dict = to_dict(tf.extractfile(names[0]), dict_size)
+            names = [m.name for m in tf if m.name.endswith("trg.dict")]
+            self.trg_dict = to_dict(tf.extractfile(names[0]), dict_size)
+            suffix = f"{self.mode}/{self.mode}"
+            names = [m.name for m in tf if m.name.endswith(suffix)]
+            pairs = []
+            for name in names:
+                for line in tf.extractfile(name):
+                    parts = _to_text(line).strip().split("\t")
+                    if len(parts) == 2:
+                        pairs.append((parts[0], parts[1]))
+        self._encode(pairs)
+
+    def _load_synth(self, n):
+        words = [f"s{i}" for i in range(30)] + [f"t{i}" for i in range(30)]
+        base = {"<s>": 0, "<e>": 1, "<unk>": 2}
+        self.src_dict = dict(base, **{w: i + 3 for i, w in
+                                      enumerate(words[:30])})
+        self.trg_dict = dict(base, **{w: i + 3 for i, w in
+                                      enumerate(words[30:])})
+        self._encode(self._synth_pairs(n, 0 if self.mode == "train" else 1))
+
+    def _encode(self, pairs):
+        s_unk = self.src_dict.get("<unk>", 2)
+        t_unk = self.trg_dict.get("<unk>", 2)
+        start, end = 0, 1
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for src, trg in pairs:
+            si = [start] + [self.src_dict.get(w, s_unk)
+                            for w in src.split()] + [end]
+            ti = [self.trg_dict.get(w, t_unk) for w in trg.split()]
+            self.src_ids.append(si)
+            self.trg_ids.append([start] + ti)
+            self.trg_ids_next.append(ti + [end])
+
+
+class WMT16(_WMTBase):
+    """wmt16.py: tarball with wmt16/{train,test,val} files of
+    tab-separated en/de pairs; dictionaries built from corpus frequency
+    to {src,trg}_dict_size with <s>/<e>/<unk> reserved.  ``lang`` picks
+    the source column."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True,
+                 synthetic_size=48):
+        assert mode.lower() in ("train", "test", "val"), mode
+        assert src_dict_size > 0 and trg_dict_size > 0, \
+            "dict_size should be set as positive number"
+        self.mode = mode.lower()
+        self.lang = lang
+        if data_file is not None and os.path.exists(data_file):
+            self._load_tar(data_file, src_dict_size, trg_dict_size)
+        else:
+            self._load_synth(synthetic_size, src_dict_size, trg_dict_size)
+
+    def _build_dict(self, lines, col, size):
+        freq = collections.defaultdict(int)
+        for l in lines:
+            parts = l.strip().split("\t")
+            if len(parts) == 2:
+                for w in parts[col].split():
+                    freq[w] += 1
+        kept = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        d = {"<s>": 0, "<e>": 1, "<unk>": 2}
+        for w, _ in kept[:max(size - 3, 0)]:
+            d[w] = len(d)
+        return d
+
+    def _load_tar(self, path, src_size, trg_size):
+        with tarfile.open(path) as tf:
+            lines = [_to_text(l) for l in
+                     tf.extractfile(f"wmt16/{self.mode}").readlines()]
+            train_lines = [_to_text(l) for l in
+                           tf.extractfile("wmt16/train").readlines()] \
+                if self.mode != "train" else lines
+        src_col = 0 if self.lang == "en" else 1
+        self.src_dict = self._build_dict(train_lines, src_col, src_size)
+        self.trg_dict = self._build_dict(train_lines, 1 - src_col,
+                                         trg_size)
+        self._encode(lines, src_col)
+
+    def _load_synth(self, n, src_size, trg_size):
+        pairs = self._synth_pairs(n, 0 if self.mode == "train" else 1)
+        lines = [f"{s}\t{t}" for s, t in pairs]
+        src_col = 0 if self.lang == "en" else 1
+        self.src_dict = self._build_dict(lines, src_col, src_size)
+        self.trg_dict = self._build_dict(lines, 1 - src_col, trg_size)
+        self._encode(lines, src_col)
+
+    def _encode(self, lines, src_col):
+        start, end = self.src_dict["<s>"], self.src_dict["<e>"]
+        unk = self.src_dict["<unk>"]
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for l in lines:
+            parts = l.strip().split("\t")
+            if len(parts) != 2:
+                continue
+            si = [start] + [self.src_dict.get(w, unk)
+                            for w in parts[src_col].split()] + [end]
+            ti = [self.trg_dict.get(w, unk)
+                  for w in parts[1 - src_col].split()]
+            self.src_ids.append(si)
+            self.trg_ids.append([start] + ti)
+            self.trg_ids_next.append(ti + [end])
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d

@@ -116,3 +116,223 @@ class Cifar100(Cifar10):
     _TEST_FILES = ["test"]
     _LABEL_KEY = b"fine_labels"
     num_classes = 100
+
+
+class Flowers(Dataset):
+    """vision/datasets/flowers.py: 102-category flowers.  Real-file mode
+    reads the reference's artifacts — a jpg tarball (jpg/image_%05d.jpg),
+    imagelabels.mat and setid.mat (scipy.io; 'trnid'/'valid'/'tstid'
+    index vectors, 1-based) — and yields (image [3,H,W] float, label [1]
+    int64).  Synthetic fallback keeps shapes and the 1..102 label range."""
+
+    _FLAGS = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None,
+                 synthetic_size=64):
+        assert mode.lower() in self._FLAGS, mode
+        self.mode = mode.lower()
+        self.transform = transform
+        self._tar = None
+        if data_file is not None and os.path.exists(data_file) and \
+                label_file is not None and os.path.exists(label_file):
+            import tarfile
+            import scipy.io as scio
+            self.labels = scio.loadmat(label_file)["labels"][0]
+            if setid_file is None or not os.path.exists(setid_file):
+                # silently serving ALL images to every mode would let eval
+                # run on the training split with no sign anything is wrong
+                raise ValueError(
+                    "Flowers: data_file/label_file are set but setid_file "
+                    f"is {'missing' if setid_file else 'not given'} — the "
+                    "train/valid/test split indexes live in setid.mat; "
+                    "pass its path")
+            self.indexes = scio.loadmat(setid_file)[
+                self._FLAGS[self.mode]][0]
+            self._tar = tarfile.open(data_file)
+            self._name2mem = {m.name: m for m in self._tar.getmembers()}
+        else:
+            rng = np.random.RandomState(0 if self.mode == "train" else 1)
+            self.labels = rng.randint(1, 103, synthetic_size + 1)
+            self.indexes = np.arange(1, synthetic_size + 1)
+            self._images = (rng.rand(synthetic_size, 3, 32, 32) * 255) \
+                .astype("uint8")
+
+    def __getitem__(self, idx):
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]]).astype("int64")
+        if self._tar is not None:
+            import io as _io
+            from PIL import Image
+            raw = self._tar.extractfile(
+                self._name2mem["jpg/image_%05d.jpg" % index]).read()
+            img = np.asarray(Image.open(_io.BytesIO(raw)))
+            img = img.transpose(2, 0, 1).astype("float32") / 127.5 - 1.0
+        else:
+            img = self._images[idx].astype("float32") / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """vision/datasets/voc2012.py: segmentation pairs.  Real-file mode
+    reads the VOCdevkit tarball — ImageSets/Segmentation/{train,val,
+    trainval}.txt name lists, JPEGImages/{}.jpg inputs,
+    SegmentationClass/{}.png masks — yielding (image [3,H,W],
+    mask [H,W]).  Synthetic fallback: 21-class random masks."""
+
+    _LIST = {"train": "train", "valid": "val", "test": "val",
+             "trainval": "trainval"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=32):
+        assert mode.lower() in self._LIST, mode
+        self.mode = mode.lower()
+        self.transform = transform
+        self._tar = None
+        if data_file is not None and os.path.exists(data_file):
+            import tarfile
+            self._tar = tarfile.open(data_file)
+            names = self._tar.extractfile(
+                "VOCdevkit/VOC2012/ImageSets/Segmentation/%s.txt"
+                % self._LIST[self.mode]).read().decode().split()
+            self._names = names
+        else:
+            rng = np.random.RandomState(0 if self.mode == "train" else 1)
+            self._names = [f"synth_{i}" for i in range(synthetic_size)]
+            self._images = (rng.rand(synthetic_size, 3, 32, 32) * 255) \
+                .astype("uint8")
+            self._masks = rng.randint(0, 21, (synthetic_size, 32, 32)) \
+                .astype("int64")
+
+    def __getitem__(self, idx):
+        if self._tar is not None:
+            import io as _io
+            from PIL import Image
+            name = self._names[idx]
+            raw = self._tar.extractfile(
+                "VOCdevkit/VOC2012/JPEGImages/%s.jpg" % name).read()
+            img = np.asarray(Image.open(_io.BytesIO(raw)))
+            img = img.transpose(2, 0, 1).astype("float32") / 127.5 - 1.0
+            raw = self._tar.extractfile(
+                "VOCdevkit/VOC2012/SegmentationClass/%s.png" % name).read()
+            mask = np.asarray(Image.open(_io.BytesIO(raw))).astype("int64")
+        else:
+            img = self._images[idx].astype("float32") / 127.5 - 1.0
+            mask = self._masks[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self._names)
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+def _default_loader(path):
+    """npy loads headless; images via PIL when present (folder.py
+    default_loader parity with a zero-dependency array path)."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    with open(path, "rb") as f:
+        return np.asarray(Image.open(f).convert("RGB"))
+
+
+def make_dataset(directory, class_to_idx, extensions, is_valid_file=None):
+    """folder.py:39 parity: walk sorted class dirs collecting
+    (path, class_idx) samples."""
+    samples = []
+    directory = os.path.expanduser(directory)
+    if extensions is not None:
+        def is_valid_file(p):       # noqa: F811
+            return p.lower().endswith(tuple(extensions))
+    for target in sorted(class_to_idx):
+        d = os.path.join(directory, target)
+        if not os.path.isdir(d):
+            continue
+        for root, _, fnames in sorted(os.walk(d, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root, fname)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[target]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """folder.py:62: generic root/class_x/*.ext tree → (sample,
+    class_index) dataset; classes sorted alphabetically."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        class_to_idx = {c: i for i, c in enumerate(classes)}
+        samples = make_dataset(root, class_to_idx, extensions,
+                               is_valid_file)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of: {root}\nSupported "
+                f"extensions are: {','.join(extensions or [])}")
+        self.loader = _default_loader if loader is None else loader
+        self.extensions = extensions
+        self.classes = classes
+        self.class_to_idx = class_to_idx
+        self.samples = samples
+        self.targets = [s[1] for s in samples]
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """folder.py:216: flat (possibly nested) image dir → [sample] records
+    (no labels)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if extensions is not None:
+            def is_valid_file(p):   # noqa: F811
+                return p.lower().endswith(tuple(extensions))
+        samples = []
+        for r, _, fnames in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(r, fname)
+                if is_valid_file(path):
+                    samples.append(path)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of: {root}\nSupported "
+                f"extensions are: {','.join(extensions or [])}")
+        self.loader = _default_loader if loader is None else loader
+        self.samples = samples
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
