@@ -1,0 +1,110 @@
+"""Bounded traced tensor array — the list-append lowering for @to_static.
+
+Reference parity: dygraph_to_static/list_transformer.py rewrites Python
+list creation/append under traced control flow into LoDTensorArray ops
+(create_array / array_write, operators/controlflow/).  The LoDTensorArray
+grows dynamically; XLA programs cannot, so the TPU lowering is a FIXED
+capacity buffer + live size counter (the same static-budget pattern as the
+detection NMS ops) carried through lax.while_loop/cond as a pytree.
+Appends beyond capacity overwrite the last slot — raise the budget with
+``paddle.jit.set_tensor_array_capacity`` when a loop legitimately collects
+more.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_TA_CAPACITY = [256]
+
+
+def set_tensor_array_capacity(n: int) -> None:
+    """Static element budget for lists converted under @to_static."""
+    _TA_CAPACITY[0] = int(n)
+
+
+def get_tensor_array_capacity() -> int:
+    return _TA_CAPACITY[0]
+
+
+class BoundedTensorArray:
+    """Functional fixed-capacity list of uniformly-shaped tensors."""
+
+    def __init__(self, buffer, size):
+        self.buffer = buffer      # [capacity, *elem_shape]
+        self.size = size          # scalar int32 (possibly traced)
+
+    @classmethod
+    def empty_like_elem(cls, elem, capacity=None):
+        cap = capacity or get_tensor_array_capacity()
+        buf = jnp.zeros((cap,) + tuple(elem.shape), elem.dtype)
+        return cls(buf, jnp.asarray(0, jnp.int32))
+
+    @classmethod
+    def from_list(cls, items, capacity=None):
+        cap = capacity or get_tensor_array_capacity()
+        stacked = jnp.stack(items)
+        if stacked.shape[0] > cap:
+            raise ValueError(
+                f"list of {stacked.shape[0]} elements exceeds the tensor "
+                f"array capacity {cap}; raise it with "
+                "paddle.jit.set_tensor_array_capacity")
+        pad = jnp.zeros((cap - stacked.shape[0],) + stacked.shape[1:],
+                        stacked.dtype)
+        return cls(jnp.concatenate([stacked, pad], axis=0),
+                   jnp.asarray(stacked.shape[0], jnp.int32))
+
+    @property
+    def capacity(self):
+        return self.buffer.shape[0]
+
+    def append(self, x):
+        x = jnp.asarray(x, self.buffer.dtype)
+        idx = jnp.clip(self.size, 0, self.capacity - 1)
+        buf = jax.lax.dynamic_update_index_in_dim(self.buffer, x, idx,
+                                                  axis=0)
+        # size saturates at capacity: appends past the budget overwrite
+        # the last slot (documented), and length() stays truthful about
+        # how many elements the buffer actually holds
+        return BoundedTensorArray(
+            buf, jnp.minimum(self.size + 1, self.capacity))
+
+    def __getitem__(self, i):
+        if hasattr(i, "_value"):      # framework Tensor index
+            i = i._value
+        i = jnp.asarray(i, jnp.int32)
+        # Python list semantics: negative indexes count from the LIVE size
+        i = jnp.where(i < 0, self.size + i, i)
+        out = jax.lax.dynamic_index_in_dim(self.buffer, i, axis=0,
+                                           keepdims=False)
+        from .tensor import Tensor
+        return Tensor(out)
+
+    def length(self):
+        return self.size
+
+    def stack(self):
+        """Full [capacity, ...] buffer; valid prefix is [:length()]."""
+        return self.buffer
+
+    def concat(self):
+        """Elements joined along their leading dim (list-concat
+        semantics); valid prefix is [:length()*elem_dim0]."""
+        b = self.buffer
+        return b.reshape((b.shape[0] * b.shape[1],) + b.shape[2:]) \
+            if b.ndim > 1 else b
+
+
+class EmptyListCarry:
+    """Sentinel for an empty Python list entering a traced region before
+    its element type is known; the first append materializes the typed
+    BoundedTensorArray (the aval-probe fixpoint in convert_while_loop
+    discovers the type, exactly like None-initialized carries)."""
+
+
+jax.tree_util.register_pytree_node(
+    BoundedTensorArray,
+    lambda ta: ((ta.buffer, ta.size), None),
+    lambda _, leaves: BoundedTensorArray(*leaves))
+jax.tree_util.register_pytree_node(
+    EmptyListCarry, lambda s: ((), None), lambda _, leaves: EmptyListCarry())

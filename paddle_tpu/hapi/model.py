@@ -114,6 +114,11 @@ class Model:
     def _sync_from_train(self):
         if self._train_step is not None and self._train_step._state is not None:
             self._train_step.sync_to_layer()
+            if self._eval_step is not None:
+                # the eager layer just changed under the EvalStep's
+                # device-resident snapshot — drop it so eval sees the
+                # freshly trained weights
+                self._eval_step.invalidate()
 
     # -- loops ---------------------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,

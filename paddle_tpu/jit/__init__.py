@@ -233,6 +233,11 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
 declarative = to_static
 
+# list-append lowering budget (dy2static BoundedTensorArray;
+# list_transformer.py parity — see framework/tensor_array.py)
+from ..framework.tensor_array import (  # noqa: E402,F401
+    set_tensor_array_capacity, get_tensor_array_capacity)
+
 
 # -- save / load -------------------------------------------------------------
 

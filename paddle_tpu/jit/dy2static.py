@@ -56,6 +56,50 @@ def _is_tensorish(v):
 
 # -- runtime converters (convert_operators.py parity) ---------------------------
 
+def _prep_list_carries(init):
+    """Promote Python lists entering a traced region to their
+    LoDTensorArray lowering (list_transformer.py parity): empty → an
+    EmptyListCarry sentinel typed later by the aval probe; non-empty
+    uniformly-shaped → BoundedTensorArray.  Non-tensor lists pass through
+    (they keep plain-Python semantics, same as before)."""
+    from ..framework.tensor_array import (BoundedTensorArray,
+                                          EmptyListCarry)
+    out = []
+    for v in init:
+        u = unwrap(v)
+        if isinstance(u, list):
+            if not u:
+                out.append(EmptyListCarry())
+                continue
+            try:
+                items = [jnp.asarray(unwrap(e)) for e in u]
+                if _builtin_all(i.shape == items[0].shape and
+                                i.dtype == items[0].dtype for i in items):
+                    out.append(BoundedTensorArray.from_list(items))
+                    continue
+            except (TypeError, ValueError):
+                pass
+        out.append(v)
+    return tuple(out)
+
+
+def _as_carry(v):
+    """Loop/cond carry leafing: tensor arrays ride as pytrees, everything
+    else as an array."""
+    from ..framework.tensor_array import (BoundedTensorArray,
+                                          EmptyListCarry)
+    u = unwrap(v)
+    if isinstance(u, (BoundedTensorArray, EmptyListCarry)):
+        return u
+    return jnp.asarray(u)
+
+
+def _is_list_carry(v):
+    from ..framework.tensor_array import (BoundedTensorArray,
+                                          EmptyListCarry)
+    return isinstance(unwrap(v), (BoundedTensorArray, EmptyListCarry))
+
+
 def _reconcile_branch_outputs(branches, init, set_args):
     """Both arms of a traced cond must produce the same pytree. Names first
     bound inside one arm start as None (create_undefined_var); where one arm
@@ -64,9 +108,13 @@ def _reconcile_branch_outputs(branches, init, set_args):
     value is only observed when the matching flag says the arm ran.
     Returns wrapped branch fns, or the originals when reconciliation is
     unnecessary/impossible."""
-    if not _builtin_any(unwrap(v) is None for v in init):
-        # reconciliation is only ever needed for branch-first-bound names,
-        # which always start as None — skip the double trace otherwise
+    from ..framework.tensor_array import BoundedTensorArray, EmptyListCarry
+    if not _builtin_any(unwrap(v) is None or
+                        isinstance(unwrap(v), EmptyListCarry)
+                        for v in init):
+        # reconciliation is only ever needed for branch-first-bound names
+        # (start as None) or still-untyped empty lists — skip the double
+        # trace otherwise
         return branches
     try:
         avals = []
@@ -78,16 +126,29 @@ def _reconcile_branch_outputs(branches, init, set_args):
     a, b = avals
     if len(a) != len(b):
         return branches
-    need = [(x is None) != (y is None) for x, y in zip(a, b)]
+
+    def _holey(x):
+        return x is None or isinstance(x, EmptyListCarry)
+
+    need = [_holey(x) != _holey(y) for x, y in zip(a, b)]
     if not _builtin_any(need):
         return branches
-    merged = [x if x is not None else y for x, y in zip(a, b)]
+    merged = [x if not _holey(x) else y for x, y in zip(a, b)]
+
+    def _fill_hole(m):
+        if isinstance(m, BoundedTensorArray):
+            # one arm appended, the other didn't: the no-append arm yields
+            # the same-typed EMPTY array
+            return BoundedTensorArray(
+                jnp.zeros(m.buffer.shape, m.buffer.dtype),
+                jnp.asarray(0, jnp.int32))
+        return jnp.zeros(m.shape, m.dtype)
 
     def wrap(run):
         def go():
             out = run()
             return tuple(
-                jnp.zeros(m.shape, m.dtype) if v is None and n else v
+                _fill_hole(m) if _holey(v) and n else v
                 for v, m, n in zip(out, merged, need))
         return go
 
@@ -103,7 +164,7 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args):
     lax.cond when pred is a traced Tensor; plain Python branch otherwise."""
     if _is_traced(pred):
         try:
-            init = get_args()
+            init = _prep_list_carries(get_args())
         except (NameError, UnboundLocalError) as e:
             raise Dy2StaticError(
                 "variables assigned inside a Tensor-dependent `if` must be "
@@ -133,8 +194,11 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args):
     condition is traced; Python while otherwise."""
     first = cond_fn()
     if _is_traced(first):
+        from ..framework.tensor_array import (BoundedTensorArray,
+                                              EmptyListCarry)
         try:
-            init = tuple(unwrap(v) for v in get_args())
+            init = _prep_list_carries(
+                tuple(unwrap(v) for v in get_args()))
         except (NameError, UnboundLocalError) as e:
             raise Dy2StaticError(
                 "loop variables of a Tensor-dependent `while` must be "
@@ -147,9 +211,10 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args):
         def b(vals):
             set_args(vals)
             body_fn()
-            return tuple(jnp.asarray(unwrap(v)) for v in get_args())
+            return tuple(_as_carry(v) for v in get_args())
 
-        if _builtin_any(v is None for v in init):
+        if _builtin_any(v is None or isinstance(v, EmptyListCarry)
+                        for v in init):
             # a carry first bound inside the body (lowered for-loop target,
             # __pt_rv of an in-loop return, escape flags) starts as None;
             # discover the body's output aval by probing and seed typed
@@ -164,7 +229,8 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args):
                 return tuple(
                     (jnp.zeros(fill[i].shape, fill[i].dtype)
                      if fill.get(i) is not None
-                     else jnp.zeros((), dt)) if i in fill else jnp.asarray(v)
+                     else jnp.zeros((), dt)) if i in fill
+                    else _as_carry(v)
                     for i, v in enumerate(init))
 
             avals = None
@@ -195,8 +261,20 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args):
                     "inside a Tensor-dependent loop; initialize it before "
                     f"the loop ({last_err})") from last_err
             set_args(init)      # clear probe tracers from the frame
-            init = tuple(jnp.zeros(a.shape, a.dtype) if v is None else v
-                         for v, a in zip(init, avals))
+
+            def _seed(v, a):
+                if v is None:
+                    return jnp.zeros(a.shape, a.dtype)
+                if isinstance(v, EmptyListCarry) and \
+                        isinstance(a, BoundedTensorArray):
+                    # the body appended to this empty list: seed the typed
+                    # empty BoundedTensorArray the probe discovered
+                    return BoundedTensorArray(
+                        jnp.zeros(a.buffer.shape, a.buffer.dtype),
+                        jnp.asarray(0, jnp.int32))
+                return v
+
+            init = tuple(_seed(v, a) for v, a in zip(init, avals))
         out = jax.lax.while_loop(c, b, init)
         set_args(tuple(out))
         return
@@ -327,7 +405,30 @@ def convert_more(x, i):
     return unwrap(i) < n
 
 
+def convert_list_append(l, x):
+    """list_transformer.py parity: ``l.append(x)`` rebinds functionally.
+    Plain Python lists keep eager append semantics (dygraph parity);
+    lists promoted into the BoundedTensorArray carry grow their traced
+    size; an untyped EmptyListCarry materializes on first append."""
+    from ..framework.tensor_array import (BoundedTensorArray,
+                                          EmptyListCarry)
+    if isinstance(l, BoundedTensorArray):
+        return l.append(jnp.asarray(unwrap(x)))
+    if isinstance(l, EmptyListCarry):
+        xa = jnp.asarray(unwrap(x))
+        return BoundedTensorArray.empty_like_elem(xa).append(xa)
+    l.append(x)
+    return l
+
+
 def convert_len(x):
+    from ..framework.tensor_array import (BoundedTensorArray,
+                                          EmptyListCarry)
+    if isinstance(x, BoundedTensorArray):
+        from ..framework.tensor import Tensor
+        return Tensor(x.size)
+    if isinstance(x, EmptyListCarry):
+        return 0
     if isinstance(x, _RangeProxy):
         return x.length()
     if _is_tensorish(x):
@@ -339,6 +440,9 @@ def convert_len(x):
 
 
 def convert_getitem(x, i):
+    from ..framework.tensor_array import BoundedTensorArray
+    if isinstance(x, BoundedTensorArray):
+        return x[unwrap(i)]           # -> Tensor (dynamic index)
     if isinstance(x, _LazySeq):
         return x.get(i)
     if isinstance(x, _RangeProxy):
@@ -513,6 +617,7 @@ convert_bool = _make_cast(bool, "bool")
 _JST = {
     "_jst_ifelse": convert_ifelse,
     "_jst_while": convert_while_loop,
+    "_jst_append": convert_list_append,
     "_jst_and": convert_logical_and,
     "_jst_or": convert_logical_or,
     "_jst_not": convert_logical_not,
@@ -1000,6 +1105,47 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             [cond_fn, body_fn, getter, setter, call]
 
 
+class _ListAppendTransformer(ast.NodeTransformer):
+    """list_transformer.py parity: a bare ``name.append(x)`` statement
+    becomes ``name = _jst_append(name, x)`` so appends into traced loop
+    carries rebind functionally (plain lists keep eager semantics inside
+    the converter)."""
+
+    def __init__(self):
+        self.count = 0
+
+    def visit_Expr(self, node):
+        self.generic_visit(node)
+        call = node.value
+        if (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "append"
+                and isinstance(call.func.value, ast.Name)
+                and len(call.args) == 1 and not call.keywords):
+            self.count += 1
+            name = call.func.value.id
+            return ast.copy_location(ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Name(id="_jst_append", ctx=ast.Load()),
+                    args=[ast.Name(id=name, ctx=ast.Load()),
+                          call.args[0]],
+                    keywords=[])), node)
+        return node
+
+    def visit_Call(self, node):
+        # len(x) → convert_len: a list promoted to a BoundedTensorArray
+        # reports its TRACED live size; plain containers keep builtin len
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name) and node.func.id == "len"
+                and len(node.args) == 1 and not node.keywords):
+            self.count += 1
+            return ast.copy_location(ast.Call(
+                func=ast.Name(id="_jst_len", ctx=ast.Load()),
+                args=node.args, keywords=[]), node)
+        return node
+
+
 class _AssertPrintCastTransformer(ast.NodeTransformer):
     """The assert/print/cast leg of the reference pipeline
     (assert_transformer.py, print_transformer.py, cast_transformer.py):
@@ -1084,6 +1230,8 @@ def ast_transform(func):
     # converter calls
     pc = _AssertPrintCastTransformer()
     tree = pc.visit(tree)
+    la = _ListAppendTransformer()
+    tree = la.visit(tree)
     if pc.count:
         # probe host-callback support NOW, outside any trace (probing
         # inside convert_assert/print would inline the probe's callback
@@ -1104,7 +1252,7 @@ def ast_transform(func):
     new_tree = t.visit(tree)
     fname, first = _src_location(raw)
     if (t._n == 0 and ft.count == 0 and et.count == 0 and not did_ret
-            and pc.count == 0):
+            and pc.count == 0 and la.count == 0):
         # nothing to rewrite — still attach the runtime diagnostic guard so
         # unconvertible dynamic control flow reports guidance, not a bare
         # tracer error

@@ -178,7 +178,10 @@ def _as_tuple(x):
 
 
 def _rewrap_structure(out):
-    ts = [Tensor(o) for o in out]
+    from ..framework.tensor_array import (BoundedTensorArray,
+                                          EmptyListCarry)
+    ts = [o if isinstance(o, (BoundedTensorArray, EmptyListCarry))
+          else Tensor(o) for o in out]
     return ts[0] if len(ts) == 1 else ts
 
 

@@ -61,6 +61,13 @@ _concat = Primitive("concat", _concat_fn)
 
 
 def concat(x, axis=0, name=None):
+    from ..framework.tensor_array import BoundedTensorArray
+    if isinstance(x, BoundedTensorArray):
+        if int(unwrap(axis)) != 0:
+            raise ValueError("concat over a BoundedTensorArray supports "
+                             "axis=0 only")
+        from ..framework.tensor import Tensor
+        return Tensor(x.concat())
     axis = int(unwrap(axis))
     return _concat(*x, axis=axis)
 
@@ -102,6 +109,15 @@ _stack = Primitive("stack", _stack_fn)
 
 
 def stack(x, axis=0, name=None):
+    from ..framework.tensor_array import BoundedTensorArray
+    if isinstance(x, BoundedTensorArray):
+        # dy2static list lowering: the buffer IS the stacked array
+        # ([capacity, ...]; valid prefix = [:x.length()])
+        if int(axis) != 0:
+            raise ValueError("stack over a BoundedTensorArray supports "
+                             "axis=0 only")
+        from ..framework.tensor import Tensor
+        return Tensor(x.stack())
     return _stack(*x, axis=int(axis))
 
 

@@ -7,6 +7,7 @@ free of op imports (no circular deps).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -43,6 +44,10 @@ def _encode_index(idx, nd):
             arr = jnp.asarray(np.asarray(it))
             spec.append(("mask",) if arr.dtype == jnp.bool_ else ("arr",))
             dynamic.append(arr)
+        elif isinstance(it, (jax.Array, jax.core.Tracer)):
+            # raw traced index (e.g. a dy2static loop carry): dynamic arg
+            spec.append(("mask",) if it.dtype == jnp.bool_ else ("arr",))
+            dynamic.append(it)
         elif isinstance(it, builtins_slice):
             spec.append(("slice", it.start, it.stop, it.step))
         elif it is None:

@@ -111,6 +111,10 @@ class Executor:
         self.place = place
         self._cache = {}
         self._aot_dir = None
+        # train_from_dataset replays, keyed per (program, feeds, fetches):
+        # re-jitting the epoch scan every call would pay a full XLA
+        # recompile per epoch (jit caching lives on the jitted callable)
+        self._epoch_fn_cache = {}
 
     # -- AOT executable cache (inference/api SetOptimCacheDir parity) --------
     def set_aot_cache_dir(self, path):
@@ -425,27 +429,38 @@ class Executor:
         feed_names = sorted(first)
 
         persist_names = self._persistable_names(program)
-        written = [n for n in persist_names
-                   if any(n in op.output_names
-                          for op in program.global_block().ops)]
-        replay = self._build_replay(program, feed_names, fetch_names,
-                                    persist_names, written)
-        w_pos = [persist_names.index(n) for n in written]
+        # one jitted scan per (program, feed/fetch set): later calls (and
+        # later EPOCHS through them) hit jax.jit's executable cache instead
+        # of retracing + recompiling the epoch program every time
+        ck = (id(program), len(program.global_block().ops),
+              tuple(feed_names), tuple(fetch_names), tuple(persist_names))
+        cached = self._epoch_fn_cache.get(ck)
+        if cached is None:
+            written = [n for n in persist_names
+                       if any(n in op.output_names
+                              for op in program.global_block().ops)]
+            replay = self._build_replay(program, feed_names, fetch_names,
+                                        persist_names, written)
+            w_pos = [persist_names.index(n) for n in written]
 
-        def epoch_fn(persist_vals, feed_stacks, mask):
-            def step(carry, xs):
-                feeds, m = xs[:-1], xs[-1]
-                fetches, updates = replay(list(feeds), list(carry))
-                carry = list(carry)
-                for p, u in zip(w_pos, updates):
-                    # masked tail steps keep the carry (padding must not
-                    # apply optimizer updates)
-                    carry[p] = jnp.where(m, u, carry[p])
-                return tuple(carry), fetches
-            return jax.lax.scan(step, tuple(persist_vals),
-                                (*feed_stacks, mask))
+            def epoch_fn(persist_vals, feed_stacks, mask):
+                def step(carry, xs):
+                    feeds, m = xs[:-1], xs[-1]
+                    fetches, updates = replay(list(feeds), list(carry))
+                    carry = list(carry)
+                    for p, u in zip(w_pos, updates):
+                        # masked tail steps keep the carry (padding must
+                        # not apply optimizer updates)
+                        carry[p] = jnp.where(m, u, carry[p])
+                    return tuple(carry), fetches
+                return jax.lax.scan(step, tuple(persist_vals),
+                                    (*feed_stacks, mask))
 
-        jitted = jax.jit(epoch_fn)
+            # pin the program: id()-keyed caches must not alias a
+            # garbage-collected program's address
+            cached = (jax.jit(epoch_fn), program)
+            self._epoch_fn_cache[ck] = cached
+        jitted = cached[0]
 
         def upload(chunk):
             """Pad to a stable bucket, ship to device (async H2D)."""

@@ -134,8 +134,14 @@ class Flowers(Dataset):
         self.mode = mode.lower()
         self.transform = transform
         self._tar = None
-        if data_file is not None and os.path.exists(data_file) and \
-                label_file is not None and os.path.exists(label_file):
+        if data_file is not None and os.path.exists(data_file):
+            if label_file is None or not os.path.exists(label_file):
+                # a real data_file with a missing/mistyped label_file must
+                # not silently degrade to synthetic noise
+                raise ValueError(
+                    "Flowers: data_file is set but label_file is "
+                    f"{'missing' if label_file else 'not given'} — the "
+                    "labels live in imagelabels.mat; pass its path")
             import tarfile
             import scipy.io as scio
             self.labels = scio.loadmat(label_file)["labels"][0]

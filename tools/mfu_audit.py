@@ -1,0 +1,266 @@
+"""Measured-MFU audit for the bench workloads (VERDICT r4 weak #1/next #2).
+
+For each compiled train step: FLOPs/step and bytes/step from XLA's own
+``compile().cost_analysis()`` (the op-level accounting the reference does in
+operators/benchmark/op_tester.cc), and per-step time from an IN-GRAPH
+K-step ``lax.fori_loop`` dispatched once — two K values, delta method, so
+tunnel RTT and fence cost cancel exactly (PERF.md round-4 methodology:
+block_until_ready does not fence the tunnel; a scalar fetch does).
+
+Bounds (measured on this chip, PERF.md round-5 corrected table — the
+round-4 67 TFLOP/s / 200-290 GB/s figures were un-chained-loop
+artifacts):
+  compute: 171.7 TFLOP/s (8192^3 bf16 matmul, chained in-graph delta-of-K)
+  memory:  ~630 GB/s streaming copy R+W (same methodology)
+
+NB: bytes/step from cost_analysis is PRE-FUSION algorithmic traffic
+(every HLO op's operands counted as HBM accesses) — an upper bound, not
+achieved HBM traffic; the memory fraction is indicative only.
+
+Usage: PYTHONPATH=/root/repo python tools/mfu_audit.py [workload ...]
+Prints one JSON line per workload: flops/step, bytes/step, ms/step,
+achieved TFLOP/s + GB/s, fraction of each bound, and which bound binds.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+PEAK_TFLOPS = 171.7
+BW_HI_GBS = 630.0
+
+K_SMALL, K_LARGE = 3, 9
+
+
+def _cost(compiled):
+    c = compiled.cost_analysis()
+    if isinstance(c, (list, tuple)):
+        c = c[0]
+    return float(c.get("flops", 0.0)), float(c.get("bytes accessed", 0.0))
+
+
+def _loop_time(body, state, args, k_small=K_SMALL, k_large=K_LARGE,
+               reps=3):
+    """Per-step seconds via the delta of two in-graph loop lengths."""
+    import jax
+    import jax.numpy as jnp
+
+    def loop(st, k):
+        # accumulate the LOSS through the carry: iteration i+1's loss needs
+        # iteration i's updated params, so XLA cannot dead-code-eliminate
+        # any step but the last one's optimizer update — and that constant
+        # cancels in the K_large-K_small delta. (Returning only the step
+        # counter lets XLA DCE the whole training computation: measured
+        # 6.6 ms/step for a 47 ms BERT step before this fix.)
+        def one(_, carry):
+            s, acc = carry
+            ns, loss = body(s, *args)
+            return ns, acc + loss.astype(jnp.float32)
+        _, acc = jax.lax.fori_loop(0, k, one, (st, jnp.float32(0.0)))
+        return acc
+
+    times = {}
+    for k in (k_small, k_large):
+        f = jax.jit(loop, static_argnums=(1,))
+        float(f(state, k))          # compile + warm
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(f(state, k))      # one dispatch, scalar fence
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        times[k] = best
+    return (times[k_large] - times[k_small]) / (k_large - k_small)
+
+
+def _emit(name, flops, bytes_, sec, units_per_step, unit):
+    tf = flops / sec / 1e12
+    gbs = bytes_ / sec / 1e9
+    frac_c = tf / PEAK_TFLOPS
+    frac_m = gbs / BW_HI_GBS
+    print(json.dumps({
+        "workload": name,
+        "flops_per_step": flops, "bytes_per_step": bytes_,
+        "ms_per_step": round(sec * 1e3, 3),
+        "throughput": round(units_per_step / sec, 1), "unit": unit,
+        "achieved_tflops": round(tf, 2), "achieved_gbs": round(gbs, 1),
+        "frac_of_peak_tflops": round(frac_c, 3),
+        "frac_of_peak_gbs": round(frac_m, 3),
+        "binding_bound": "compute" if frac_c >= frac_m else "memory",
+    }), flush=True)
+
+
+def audit_resnet50():
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.parallel import init_mesh, TrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    batch, hw = 256, 224
+    mesh = init_mesh({"dp": -1})
+    model = resnet50(data_format="NHWC")
+    opt = paddle.optimizer.Momentum(parameters=model.parameters(),
+                                    learning_rate=0.1, momentum=0.9)
+    step = TrainStep(model, opt, loss_fn=paddle.nn.CrossEntropyLoss(),
+                     mesh=mesh, compute_dtype=jnp.bfloat16, donate=False)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, hw, hw, 3).astype("float32"))
+    y = jnp.asarray(rng.randint(0, 1000, (batch,)))
+    float(step((x,), y))          # build state + compile the plain step
+    import jax
+    body = step._build_step()
+    lowered = jax.jit(body).lower(step.state, (x,), y, np.float32(0.1))
+    flops, bytes_ = _cost(lowered.compile())
+    sec = _loop_time(body, step.state, ((x,), y, np.float32(0.1)))
+    _emit("resnet50_dygraph", flops, bytes_, sec, batch, "img/s")
+
+
+def audit_bert():
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.parallel import init_mesh, TrainStep
+    from paddle_tpu.text.models.bert import BertConfig, BertForPretraining
+
+    cfg, batch, seq = BertConfig.base(), 64, 128
+    mesh = init_mesh({"dp": -1})
+    model = BertForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-4, weight_decay=0.01)
+    step = TrainStep(model, opt, mesh=mesh, compute_dtype=jnp.bfloat16,
+                     donate=False)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    n_pred = max(2, int(seq * 0.15))
+    pos = np.stack([rng.choice(seq, size=n_pred, replace=False)
+                    for _ in range(batch)]).astype("int64")
+    labels = jnp.asarray(np.take_along_axis(np.asarray(ids), pos, 1))
+    positions = jnp.asarray(pos)
+    args = (ids, None, None, labels, None, positions)
+    float(step(args))
+    body = step._build_step()
+    inputs = tuple(None if a is None else jnp.asarray(a) for a in args)
+    lowered = __import__("jax").jit(body).lower(
+        step.state, inputs, None, np.float32(1e-4))
+    flops, bytes_ = _cost(lowered.compile())
+    sec = _loop_time(body, step.state, (inputs, None, np.float32(1e-4)))
+    _emit("bert_base_pretrain", flops, bytes_, sec, batch, "seq/s")
+
+
+def audit_transformer_big():
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.parallel import init_mesh, TrainStep
+    from bench import bench_transformer_big  # noqa: F401  (same model class)
+    import paddle_tpu.nn as nn
+
+    vocab, dm, nh, nl, ffn, batch, seq = 32768, 1024, 16, 6, 4096, 64, 64
+
+    class Seq2SeqLM(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(vocab, dm)
+            self.pos = nn.Embedding(seq, dm)
+            self.core = nn.Transformer(
+                d_model=dm, nhead=nh, num_encoder_layers=nl,
+                num_decoder_layers=nl, dim_feedforward=ffn, dropout=0.0)
+            self.proj = nn.Linear(dm, vocab)
+            self.loss = nn.CrossEntropyLoss()
+
+        def forward(self, src, tgt, labels):
+            p = paddle.arange(src.shape[1])
+            s = self.embed(src) + self.pos(p)
+            t = self.embed(tgt) + self.pos(p)
+            h = self.core(s, t)
+            logits = self.proj(h)
+            return self.loss(logits.reshape([-1, logits.shape[-1]]),
+                             labels.reshape([-1]))
+
+    mesh = init_mesh({"dp": -1})
+    model = Seq2SeqLM()
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=1e-4)
+    step = TrainStep(model, opt, mesh=mesh, compute_dtype=jnp.bfloat16,
+                     donate=False)
+    rng = np.random.RandomState(0)
+    src = jnp.asarray(rng.randint(0, vocab, (batch, seq)))
+    tgt = jnp.asarray(rng.randint(0, vocab, (batch, seq)))
+    lbl = jnp.asarray(rng.randint(0, vocab, (batch, seq)))
+    float(step((src, tgt, lbl)))
+    body = step._build_step()
+    lowered = __import__("jax").jit(body).lower(
+        step.state, (src, tgt, lbl), None, np.float32(1e-4))
+    flops, bytes_ = _cost(lowered.compile())
+    sec = _loop_time(body, step.state, ((src, tgt, lbl), None,
+                                        np.float32(1e-4)))
+    _emit("transformer_big", flops, bytes_, sec, batch * seq, "tok/s")
+
+
+def audit_lenet():
+    """LeNet's scanned epoch is ONE dispatch; FLOPs from cost_analysis of
+    the same scanned program, per-step time from epoch time / steps."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+
+    batch, steps = 128, 200
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            img = static.data("img", [None, 1, 28, 28], "float32")
+            label = static.data("label", [None], "int64")
+            h = static.nn.conv2d(img, 6, 5, padding=2, act="relu")
+            h = paddle.nn.functional.max_pool2d(h, 2, 2)
+            h = static.nn.conv2d(h, 16, 5, act="relu")
+            h = paddle.nn.functional.max_pool2d(h, 2, 2)
+            h = paddle.flatten(h, start_axis=1)
+            h = static.nn.fc(h, 120, activation="relu")
+            h = static.nn.fc(h, 84, activation="relu")
+            logits = static.nn.fc(h, 10)
+            loss = paddle.nn.functional.cross_entropy(logits, label)
+            paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        stacks = {"img": jnp.asarray(rng.randn(steps, batch, 1, 28, 28)
+                                     .astype("float32")),
+                  "label": jnp.asarray(rng.randint(0, 10, (steps, batch))
+                                       .astype("int64"))}
+        exe.train_from_dataset(main, dataset=stacks, fetch_list=[loss])
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = exe.train_from_dataset(main, dataset=stacks,
+                                         fetch_list=[loss])
+            float(np.asarray(out[loss.name]).sum())
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        # per-image fwd+bwd FLOPs, hand count (XLA's scanned program is not
+        # exposed by the executor API): conv1 5x5 pad2 (28^2*6*25*1),
+        # conv2 5x5 (10^2*16*25*6), fc 400*120+120*84+84*10; *2 MACs,
+        # *3 fwd+dX+dW
+        fwd = 2 * (28 * 28 * 6 * 25 * 1 + 10 * 10 * 16 * 25 * 6
+                   + 400 * 120 + 120 * 84 + 84 * 10)
+        flops = 3 * fwd * batch
+        sec = best / steps
+        _emit("mnist_lenet_static", float(flops), 0.0, sec, batch, "img/s")
+    finally:
+        paddle.disable_static()
+
+
+AUDITS = {
+    "resnet50_dygraph": audit_resnet50,
+    "bert_base_pretrain": audit_bert,
+    "transformer_big": audit_transformer_big,
+    "mnist_lenet_static": audit_lenet,
+}
+
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or list(AUDITS)
+    for n in names:
+        print(f"[mfu] {n} ...", file=sys.stderr, flush=True)
+        AUDITS[n]()
