@@ -16,10 +16,14 @@ host-side Python/numpy does the parsing (the reference's parsing threads
 are C++ for Python-2-era speed; numpy vectorized parsing holds the same
 role here), while the chip consumes one pre-stacked epoch.
 
-Global shuffle exchanges records across workers through the fleet TCP
-store (gloo_wrapper.h rendezvous parity): every worker buckets its records
-by ``hash(record) % world``, publishes each outgoing bucket, barriers, and
-collects its inbound buckets.
+Global shuffle redistributes records PEER-TO-PEER (data_set.cc
+GlobalShuffle parity: trainers send record batches to each other over
+RPC): every worker runs a lightweight exchange server, endpoints
+rendezvous through the fleet TCP store, and the buckets travel
+worker→worker directly — the store carries only O(world) metadata
+(endpoints + barrier keys), never the records, so the shuffle scales
+with the slowest LINK instead of funneling the whole dataset through
+one store socket.
 """
 from __future__ import annotations
 
@@ -30,6 +34,139 @@ import threading
 from typing import List, Optional
 
 import numpy as np
+
+
+class _ShuffleExchange:
+    """Per-process record-exchange server for global_shuffle: accepts
+    (tag, src, blob) deliveries from peer workers (the worker→worker RPC
+    leg of data_set.cc GlobalShuffle; message framing shared with
+    ps/service.py).  Tags scope deliveries to one shuffle round, so an
+    early sender from the next round can never pollute this one."""
+
+    def __init__(self):
+        import socket
+        from .ps.service import _send_msg, _recv_msg
+        self._send_msg, self._recv_msg = _send_msg, _recv_msg
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        local_only = bool(os.getenv("PADDLE_TPU_SHUFFLE_LOCAL"))
+        self._sock.bind(("127.0.0.1" if local_only else "0.0.0.0", 0))
+        self._sock.listen(64)
+        if local_only:
+            # loopback bind must advertise loopback — anything else points
+            # peers at an address this socket does not listen on
+            host = "127.0.0.1"
+        else:
+            # advertise THIS worker's real host: the launchers communicate
+            # it via PADDLE_CURRENT_ENDPOINT (fleet/launch.py); POD_IP and
+            # loopback are fallbacks for hand-rolled single-host setups
+            cur = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+            host = cur.rsplit(":", 1)[0] if ":" in cur else \
+                os.getenv("POD_IP", "127.0.0.1")
+        self.endpoint = f"{host}:{self._sock.getsockname()[1]}"
+        self._cv = threading.Condition()
+        self._inbox: dict = {}       # tag -> [records...]
+        self._got: dict = {}         # tag -> count of deliveries
+        self._want: dict = {}        # tag -> expected deliveries
+        self._dead: "collections.deque" = __import__(
+            "collections").deque(maxlen=64)   # discarded round tags
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            msg = self._recv_msg(conn)
+            if msg is None:
+                return
+            records = pickle.loads(msg["blob"])
+            with self._cv:
+                if msg["tag"] in self._dead:
+                    # a straggler delivering for an aborted round must not
+                    # re-create the inbox discard() just cleaned
+                    self._send_msg(conn, {"ok": True, "stale": True})
+                    return
+                self._inbox.setdefault(msg["tag"], []).extend(records)
+                self._got[msg["tag"]] = self._got.get(msg["tag"], 0) + 1
+                self._cv.notify_all()
+            self._send_msg(conn, {"ok": True})
+        finally:
+            conn.close()
+
+    def expect(self, tag, n_deliveries):
+        with self._cv:
+            self._want[tag] = n_deliveries
+
+    def collect(self, tag, timeout=300.0):
+        import time
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._got.get(tag, 0) < self._want.get(tag, 0):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"global_shuffle: got {self._got.get(tag, 0)}/"
+                        f"{self._want.get(tag, 0)} peer deliveries for "
+                        f"round {tag}")
+                self._cv.wait(left)
+            out = self._inbox.pop(tag, [])
+            self._got.pop(tag, None)
+            self._want.pop(tag, None)
+        return out
+
+    def discard(self, tag):
+        """Drop all state for an aborted round — peers' deliveries must
+        not pin a shard's worth of records in the process-lifetime
+        singleton when a round fails (elastic retries re-shuffle under a
+        fresh tag).  The tag joins a dead-list so a straggler delivering
+        AFTER this cleanup is rejected instead of re-creating the inbox."""
+        with self._cv:
+            self._dead.append(tag)
+            self._inbox.pop(tag, None)
+            self._got.pop(tag, None)
+            self._want.pop(tag, None)
+
+
+_exchange_singleton: List[Optional[_ShuffleExchange]] = [None]
+_round_lock = threading.Lock()
+_round_counter = [0]
+
+
+def _shuffle_exchange() -> _ShuffleExchange:
+    if _exchange_singleton[0] is None:
+        _exchange_singleton[0] = _ShuffleExchange()
+    return _exchange_singleton[0]
+
+
+def _next_shuffle_round() -> int:
+    """Process-wide monotonic round id: two datasets shuffling in one
+    process (train + eval) must never share a tag/prefix — per-instance
+    counters would both start at 0 and cross-pollute inboxes.  All
+    workers shuffle the same datasets in the same program order, so the
+    counter agrees across the gang."""
+    with _round_lock:
+        _round_counter[0] += 1
+        return _round_counter[0]
+
+
+def _ship_bucket(endpoint, tag, src, records):
+    import socket
+    from .ps.service import _send_msg, _recv_msg
+    host, port = endpoint.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=60) as s:
+        _send_msg(s, {"tag": tag, "src": src,
+                      "blob": pickle.dumps(
+                          records, protocol=pickle.HIGHEST_PROTOCOL)})
+        out = _recv_msg(s)
+    if out is None or not out.get("ok"):
+        raise RuntimeError(f"shuffle delivery to {endpoint} failed")
 
 __all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
 
@@ -255,9 +392,14 @@ class InMemoryDataset(DatasetBase):
         rng = np.random.RandomState(self._seed)
         rng.shuffle(self._records)
 
+    _EXCHANGE_TIMEOUT = 300.0
+
     def global_shuffle(self, fleet=None, thread_num=12):
-        """DatasetImpl::GlobalShuffle (:205): redistribute records across
-        all workers by record hash, through the fleet TCP store."""
+        """DatasetImpl::GlobalShuffle (data_set.cc:205): redistribute
+        records across all workers by hash — PEER-TO-PEER, as the
+        reference sends record batches trainer→trainer over RPC.  The
+        fleet TCP store carries only endpoints and barriers (O(world)
+        metadata); record bytes travel on direct worker sockets."""
         self.local_shuffle()
         if fleet is None:
             return
@@ -278,24 +420,54 @@ class InMemoryDataset(DatasetBase):
         buckets = [[] for _ in range(world)]
         for r, d in zip(self._records, dest):
             buckets[d].append(r)
-        # restart-generation scoping: a store surviving an elastic gang
-        # restart must never serve the dead gang's buckets to the new one
+        # round scoping: restart generation (a store surviving an elastic
+        # gang restart must never serve the dead gang's buckets) × a
+        # process-wide monotonic round id (two datasets shuffling in one
+        # process must not share a tag)
         rgen = store._restart_generation()
-        gen = getattr(self, "_shuffle_gen", 0)
-        self._shuffle_gen = gen + 1
+        gen = _next_shuffle_round()
         pre = f"__gshuf/{rgen}/{gen}"
-        for d in range(world):
-            store.set(f"{pre}/{me}/{d}",
-                      pickle.dumps(buckets[d],
-                                   protocol=pickle.HIGHEST_PROTOCOL))
-        store.barrier(pre, world)
-        mine = []
-        for src in range(world):
-            blob = store.get(f"{pre}/{src}/{me}")
-            mine.extend(pickle.loads(blob))
+        tag = f"{rgen}/{gen}"
+
+        srv = _shuffle_exchange()
+        srv.expect(tag, world - 1)
+        try:
+            store.set(f"{pre}/ep/{me}", srv.endpoint.encode())
+            store.barrier(f"{pre}/ep", world)
+            eps = {d: store.get(f"{pre}/ep/{d}").decode()
+                   for d in range(world) if d != me}
+
+            # ship each outgoing bucket directly to its owner (parallel
+            # senders ≙ the reference's send_request_table thread pool)
+            errs = []
+
+            def ship(d):
+                try:
+                    _ship_bucket(eps[d], tag, me, buckets[d])
+                except Exception as e:       # surfaced after join
+                    errs.append((d, e))
+
+            senders = [threading.Thread(target=ship, args=(d,),
+                                        daemon=True) for d in eps]
+            for t in senders:
+                t.start()
+            for t in senders:
+                t.join()
+            if errs:
+                raise RuntimeError(
+                    f"global_shuffle: peer sends failed: {errs}")
+
+            mine = list(buckets[me])
+            mine.extend(srv.collect(tag, timeout=self._EXCHANGE_TIMEOUT))
+        except BaseException:
+            # aborted round: peers' deliveries must not leak in the
+            # process-lifetime inbox
+            srv.discard(tag)
+            raise
         rng2 = np.random.RandomState(base + 777 + me)
         rng2.shuffle(mine)
         self._records = mine
+        # everyone holds their records before anyone proceeds/cleans up
         store.barrier(f"{pre}/done", world)
         if me == 0:
             store.delete_prefix(pre + "/")
@@ -323,8 +495,14 @@ class QueueDataset(DatasetBase):
 
     def global_shuffle(self, fleet=None, thread_num=12):
         raise NotImplementedError(
-            "QueueDataset streams from files; global_shuffle is only "
-            "supported by InMemoryDataset (data_set.cc parity)")
+            "QueueDataset streams from files without memory residency, so "
+            "there is nothing host-side to redistribute; the reference's "
+            "queue-feed global shuffle happens on the PS side of its "
+            "pipeline, a stage this design deliberately keeps out of the "
+            "data path (records go file→feed→chip). Pre-shard the FILE "
+            "LIST across workers (set_filelist with per-worker splits) "
+            "for the same statistical effect, or use InMemoryDataset for "
+            "true record-level global shuffle (data_set.cc parity)")
 
     def __iter__(self):
         def gen():

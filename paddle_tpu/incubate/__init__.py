@@ -4,6 +4,7 @@ Reference: python/paddle/incubate/ — notably auto-checkpoint
 (incubate/checkpoint/auto_checkpoint.py:598 train_epoch_range).
 """
 from . import checkpoint  # noqa: F401
+from .contrib_tools import memory_usage, op_freq_statistic  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import (  # noqa: F401
     ExponentialMovingAverage, ModelAverage, LookaheadOptimizer,

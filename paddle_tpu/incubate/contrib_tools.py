@@ -1,0 +1,72 @@
+"""Contrib program-analysis utilities.
+
+Reference parity: python/paddle/fluid/contrib/memory_usage_calc.py
+(memory_usage: estimate activation+parameter memory of a Program for a
+batch size) and contrib/op_frequence.py (op_freq_statistic: op-type
+histogram plus adjacent-pair counts for fusion hunting).
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "float16": 2, "bfloat16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1,
+}
+
+
+def memory_usage(program, batch_size):
+    """memory_usage_calc.py:46 parity: (min_mb, max_mb, unit) estimate of
+    the Program's tensor memory at ``batch_size`` — every op output
+    counted once, dynamic leading dims filled with the batch size.  The
+    ±30% band mirrors the reference's DEBUG factor for workspace slack."""
+    from ..static.program import Program
+    if not isinstance(program, Program):
+        raise TypeError(
+            "Calculating Memory Usage requires Program as its Parameter. "
+            f"But you passed in {type(program)}")
+    if batch_size <= 0:
+        raise ValueError("The batch size need to be positive.")
+
+    total = 0.0
+    seen = set()
+    block = program.global_block()
+    for op in block.ops:
+        for name in getattr(op, "output_names", []):
+            if name in seen or not block.has_var(name):
+                continue
+            seen.add(name)
+            var = block.var(name)
+            shape = [batch_size if (d is None or d < 0) else d
+                     for d in (var.shape or [1])]
+            total += float(np.prod(shape)) * \
+                _DTYPE_BYTES.get(str(var.dtype), 4)
+
+    total_mb = total / (1024.0 ** 2)
+    return total_mb * 0.7, total_mb * 1.3, "MB"
+
+
+def op_freq_statistic(program):
+    """op_frequence.py:23 parity: (uni_op_freq, adj_2_op_freq) ordered
+    dicts — per-op-type counts and adjacent-pair counts (the fusion-
+    opportunity census the reference runs before writing fused kernels)."""
+    from ..static.program import Program
+    if not isinstance(program, Program):
+        raise TypeError(
+            "Please input valid Program.\nProposal: use "
+            "fluid.default_main_program()")
+    uni = collections.OrderedDict()
+    adj = collections.OrderedDict()
+    prev = None
+    for op in program.global_block().ops:
+        t = getattr(op, "type", None) or getattr(op, "type_name", "op")
+        uni[t] = uni.get(t, 0) + 1
+        if prev is not None:
+            key = f"{prev}->{t}"
+            adj[key] = adj.get(key, 0) + 1
+        prev = t
+    return uni, adj

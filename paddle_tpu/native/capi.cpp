@@ -79,8 +79,10 @@ void* pd_predictor_create(const char* model_path) {
 }
 
 // One float32 input (shape[ndim]) -> first float32 output, copied into
-// out (capacity out_cap elements). Returns the output element count
-// (which may exceed out_cap — call again with a larger buffer), or -1.
+// out. Returns the TOTAL output element count (size discovery,
+// snprintf-style; may exceed out_cap — writes are clamped to out_cap, so
+// call with out_cap=0 to learn the size, then again to fill), or -1 on
+// error (see pd_last_error()).
 long long pd_predictor_run_f32(void* handle, const float* in,
                                const long long* shape, int ndim,
                                float* out, long long out_cap) {

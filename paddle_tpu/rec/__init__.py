@@ -1,6 +1,10 @@
 """Recommendation models (BASELINE workload 5: Wide&Deep CTR)."""
 from .wide_deep import WideDeep, WideDeepTrainer, synthetic_ctr_batch  # noqa: F401
 from .hogwild import HogwildTrainer, PSGPUTrainer  # noqa: F401
+from .heter import (  # noqa: F401
+    HeterTrainer, create_trainer,
+    TRAINER_LEDGER, DEVICE_WORKER_LEDGER, FLEET_WRAPPER_LEDGER,
+)
 
 __all__ = ["WideDeep", "WideDeepTrainer", "HogwildTrainer",
            "PSGPUTrainer", "synthetic_ctr_batch"]

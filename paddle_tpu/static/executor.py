@@ -431,10 +431,16 @@ class Executor:
         persist_names = self._persistable_names(program)
         # one jitted scan per (program, feed/fetch set): later calls (and
         # later EPOCHS through them) hit jax.jit's executable cache instead
-        # of retracing + recompiling the epoch program every time
-        ck = (id(program), len(program.global_block().ops),
+        # of retracing + recompiling the epoch program every time.  Keyed
+        # like exe.run's compile cache (program _uid + _version: rewrite
+        # passes bump _version, compiler.py:110); FIFO-bounded so a
+        # long-lived Executor over many programs cannot grow unboundedly.
+        ck = (program._uid, program._version,
+              tuple(op.type for op in program.global_block().ops),
               tuple(feed_names), tuple(fetch_names), tuple(persist_names))
         cached = self._epoch_fn_cache.get(ck)
+        if cached is None and len(self._epoch_fn_cache) >= 8:
+            self._epoch_fn_cache.pop(next(iter(self._epoch_fn_cache)))
         if cached is None:
             written = [n for n in persist_names
                        if any(n in op.output_names
@@ -456,8 +462,6 @@ class Executor:
                 return jax.lax.scan(step, tuple(persist_vals),
                                     (*feed_stacks, mask))
 
-            # pin the program: id()-keyed caches must not alias a
-            # garbage-collected program's address
             cached = (jax.jit(epoch_fn), program)
             self._epoch_fn_cache[ck] = cached
         jitted = cached[0]

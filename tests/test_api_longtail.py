@@ -281,3 +281,33 @@ def test_class_center_sample():
     rm2, s2 = F.class_center_sample(lab2, num_classes=20, num_samples=4)
     assert len(s2.numpy()) == 10
     assert np.array_equal(s2.numpy()[rm2.numpy()], np.arange(10))
+
+
+def test_contrib_memory_usage_and_op_freq():
+    """contrib/memory_usage_calc.py + op_frequence.py parity."""
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu.incubate import memory_usage, op_freq_statistic
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8], "float32")
+            h = static.nn.fc(x, 16, activation="relu")
+            static.nn.fc(h, 4)
+        lo, hi, unit = memory_usage(main, batch_size=32)
+        assert unit == "MB" and 0 < lo < hi
+        # batch scales the dynamic dim
+        lo2, hi2, _ = memory_usage(main, batch_size=64)
+        assert hi2 > hi
+        uni, adj = op_freq_statistic(main)
+        assert sum(uni.values()) == len(main.global_block().ops)
+        assert any("->" in k for k in adj)
+        import pytest
+        with pytest.raises(TypeError):
+            memory_usage("not a program", 4)
+        with pytest.raises(ValueError):
+            memory_usage(main, 0)
+    finally:
+        paddle.disable_static()

@@ -297,3 +297,92 @@ def test_r_demo_over_python_api(tmp_path):
         env=_env(), capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "R-DEMO-OK" in r.stdout
+
+
+GO_SEQUENCE_C = r"""
+/* Replays EXACTLY the call sequence go/demo/mnist.go makes (same symbols,
+ * shapes, buffer sizes, and error paths) so the contract the cgo demo
+ * compiles against is pinned by compiled C even without a go toolchain
+ * (VERDICT r4 #9). Any drift in these signatures breaks this harness the
+ * same way it would break the demo. */
+#include <math.h>
+#include <stdio.h>
+#include <string.h>
+
+extern void* pd_predictor_create(const char* model_path);
+extern long long pd_predictor_run_f32(void* h, const float* in,
+                                      const long long* shape, int ndim,
+                                      float* out, long long out_cap);
+extern void pd_predictor_destroy(void* h);
+extern const char* pd_last_error(void);
+
+int main(int argc, char** argv) {
+    /* error path first: create must fail with a non-empty pd_last_error
+     * (the demo's os.Exit(1) branch) */
+    void* bad = pd_predictor_create("/nonexistent/model/path");
+    if (bad != NULL) { fprintf(stderr, "bad create succeeded\n"); return 10; }
+    if (strlen(pd_last_error()) == 0) {
+        fprintf(stderr, "empty pd_last_error after failed create\n");
+        return 11;
+    }
+
+    void* pred = pd_predictor_create(argv[1]);
+    if (!pred) { fprintf(stderr, "create: %s\n", pd_last_error()); return 1; }
+
+    /* the demo's synthetic digit: exp(-dist/40) blob */
+    float img[28 * 28];
+    for (int y = 0; y < 28; ++y)
+        for (int x = 0; x < 28; ++x) {
+            float d = (float)((x - 14) * (x - 14) + (y - 14) * (y - 14));
+            img[y * 28 + x] = (float)exp(-d / 40.0);
+        }
+    long long shape[4] = {1, 1, 28, 28};
+    float out[10];
+
+    /* out_cap contract (snprintf-style): the return value is the TOTAL
+     * element count (size discovery), but writes are clamped to out_cap —
+     * slots past the cap must stay untouched, never overflowed */
+    for (int i = 0; i < 10; ++i) out[i] = -12345.0f;
+    long long n = pd_predictor_run_f32(pred, img, shape, 4, out, 3);
+    if (n != 10) { fprintf(stderr, "size discovery broke: %lld\n", n);
+                   return 12; }
+    for (int i = 3; i < 10; ++i)
+        if (out[i] != -12345.0f) {
+            fprintf(stderr, "wrote past out_cap at %d\n", i); return 13;
+        }
+
+    n = pd_predictor_run_f32(pred, img, shape, 4, out, 10);
+    if (n != 10) { fprintf(stderr, "run: %s\n", pd_last_error()); return 2; }
+    int cls = 0; float best = out[0];
+    for (int i = 1; i < 10; ++i) if (out[i] > best) { cls = i; best = out[i]; }
+
+    /* second run on the same handle (the demo loops in serving use) */
+    if (pd_predictor_run_f32(pred, img, shape, 4, out, 10) != 10) {
+        fprintf(stderr, "rerun: %s\n", pd_last_error()); return 3;
+    }
+    pd_predictor_destroy(pred);
+    printf("GO-SEQ-OK class=%d score=%f\n", cls, best);
+    return 0;
+}
+"""
+
+
+def test_go_abi_sequence_pinned_in_c(tmp_path):
+    """VERDICT r4 #9: the exact Go-demo call sequence — symbols, shapes,
+    out_cap contract, pd_last_error on both failure paths — exercised by
+    compiled C, so the cgo contract is covered even with the go toolchain
+    absent from the image."""
+    from paddle_tpu.native import build_capi
+    so = build_capi()
+    model = _save_mnist_model(tmp_path)
+    csrc = tmp_path / "go_seq.c"
+    csrc.write_text(GO_SEQUENCE_C)
+    exe = str(tmp_path / "go_seq")
+    subprocess.run(
+        ["gcc", str(csrc), "-o", exe, so, "-lm",
+         f"-Wl,-rpath,{os.path.dirname(so)}"],
+        check=True, capture_output=True)
+    p = subprocess.run([exe, model], env=_env(), capture_output=True,
+                       text=True, timeout=300)
+    assert p.returncode == 0, (p.stdout, p.stderr[-2000:])
+    assert "GO-SEQ-OK class=" in p.stdout

@@ -1,0 +1,109 @@
+"""HeterXpuTrainer equivalent + trainer/worker/wrapper ledgers
+(VERDICT r4 #6 and #10).
+
+The trainer test mirrors the Hogwild gate (test_ps.py): the 3-stage heter
+pipeline must reach the same AUC region as single-threaded training on
+the same batches.  The ledger tests enforce ops/coverage.py discipline:
+every REGISTER_TRAINER_CLASS / REGISTER_DEVICE_WORKER_CLASS name and
+every framework/fleet/*.h wrapper is classified, and every 'api' target
+resolves.
+"""
+import importlib
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.rec import (HeterTrainer, create_trainer, TRAINER_LEDGER,
+                            DEVICE_WORKER_LEDGER, FLEET_WRAPPER_LEDGER)
+from paddle_tpu.rec.wide_deep import WideDeep, synthetic_ctr_batch
+
+
+def _auc(scores, labels):
+    order = np.argsort(scores)
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(1, len(scores) + 1)
+    pos = labels > 0.5
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def test_heter_trainer_converges_and_overlaps():
+    """3-stage pipeline (cpu pull → device dense → sparse push) trains to
+    the same AUC region as the sequential baseline."""
+    paddle.seed(11)
+    m = WideDeep(hidden=(32,), emb_dim=4)
+    tr = HeterTrainer(m, lr=5e-3)
+    batches = [synthetic_ctr_batch(256, vocab=20_000, seed=s)
+               for s in range(12)]
+    losses = []
+    for _ in range(3):
+        losses += tr.train(batches, num_cpu_workers=2)
+    assert len(losses) == 36
+    assert all(np.isfinite(l) for l in losses)
+    tr.end_pass()
+    tr.sync_params()
+    m.eval()
+    ids, dense, label = synthetic_ctr_batch(512, vocab=20_000, seed=99)
+    scores = m(ids, dense).numpy().ravel()
+    auc = _auc(scores, label.ravel())
+    assert auc > 0.6, auc
+
+
+def test_heter_trainer_error_surfaces():
+    m = WideDeep(hidden=(16,), emb_dim=4)
+    tr = HeterTrainer(m)
+    bad = [(np.zeros((4, 26), np.int64), np.zeros((4, 999), np.float32),
+            np.zeros((4, 1), np.float32))]       # wrong dense width
+    import pytest
+    with pytest.raises(Exception):
+        tr.train(bad, num_cpu_workers=2)
+
+
+# reference factory registrations (trainer_factory.cc:64-75,
+# device_worker_factory.cc:64-80, framework/fleet/*.h)
+_REF_TRAINERS = {"MultiTrainer", "DistMultiTrainer", "HeterXpuTrainer",
+                 "HeterBoxTrainer", "PSGPUTrainer", "PipelineTrainer"}
+_REF_WORKERS = {"HogwildWorker", "DownpourWorker", "DownpourWorkerOpt",
+                "HeterCpuWorker", "HeterBoxWorker", "PSGPUWorker",
+                "SectionWorker"}
+_REF_WRAPPERS = {"fleet_wrapper", "gloo_wrapper", "ps_gpu_wrapper",
+                 "heter_wrapper", "box_wrapper", "heter_context",
+                 "nccl_wrapper"}
+
+
+def _check_ledger(ledger, expected):
+    assert set(ledger) == expected, (
+        set(ledger) ^ expected, "ledger must classify exactly the "
+        "reference registry")
+    for name, (cls, target) in ledger.items():
+        assert cls in ("api", "engine", "subsumed", "n/a"), (name, cls)
+        assert len(target) > 20, (name, "reason must be substantive")
+        if cls == "api":
+            mod, attr = target.split(" ")[0].rsplit(".", 1)
+            obj = getattr(importlib.import_module(mod), attr)
+            assert obj is not None
+
+
+def test_trainer_ledger_total():
+    _check_ledger(TRAINER_LEDGER, _REF_TRAINERS)
+
+
+def test_device_worker_ledger_total():
+    _check_ledger(DEVICE_WORKER_LEDGER, _REF_WORKERS)
+
+
+def test_fleet_wrapper_ledger_total():
+    _check_ledger(FLEET_WRAPPER_LEDGER, _REF_WRAPPERS)
+
+
+def test_create_trainer_factory():
+    assert create_trainer("HeterXpuTrainer") is HeterTrainer
+    from paddle_tpu.rec import PSGPUTrainer
+    assert create_trainer("PSGPUTrainer") is PSGPUTrainer
+    import pytest
+    with pytest.raises(KeyError):
+        create_trainer("NoSuchTrainer")
+    with pytest.raises(TypeError):
+        create_trainer("MultiTrainer")   # engine mode, not a class

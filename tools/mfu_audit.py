@@ -44,35 +44,15 @@ def _cost(compiled):
 
 def _loop_time(body, state, args, k_small=K_SMALL, k_large=K_LARGE,
                reps=3):
-    """Per-step seconds via the delta of two in-graph loop lengths."""
-    import jax
-    import jax.numpy as jnp
-
-    def loop(st, k):
-        # accumulate the LOSS through the carry: iteration i+1's loss needs
-        # iteration i's updated params, so XLA cannot dead-code-eliminate
-        # any step but the last one's optimizer update — and that constant
-        # cancels in the K_large-K_small delta. (Returning only the step
-        # counter lets XLA DCE the whole training computation: measured
-        # 6.6 ms/step for a 47 ms BERT step before this fix.)
-        def one(_, carry):
-            s, acc = carry
-            ns, loss = body(s, *args)
-            return ns, acc + loss.astype(jnp.float32)
-        _, acc = jax.lax.fori_loop(0, k, one, (st, jnp.float32(0.0)))
-        return acc
-
-    times = {}
-    for k in (k_small, k_large):
-        f = jax.jit(loop, static_argnums=(1,))
-        float(f(state, k))          # compile + warm
-        best = None
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            float(f(state, k))      # one dispatch, scalar fence
-            dt = time.perf_counter() - t0
-            best = dt if best is None else min(best, dt)
-        times[k] = best
+    """Per-step seconds via the DELTA of two in-graph loop lengths (the
+    dispatch + fence overhead cancels exactly).  The chained-loss loop
+    itself is shared with bench.py (_chained_step_loop): the loss rides
+    the carry so XLA cannot dead-code-eliminate any step — returning only
+    the step counter measured 6.6 ms for a 47 ms BERT step."""
+    from bench import _chained_step_loop, _time_loop_once
+    f = _chained_step_loop(body, args)
+    times = {k: _time_loop_once(f, state, k, reps)
+             for k in (k_small, k_large)}
     return (times[k_large] - times[k_small]) / (k_large - k_small)
 
 
