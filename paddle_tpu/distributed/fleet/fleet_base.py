@@ -63,6 +63,25 @@ class DistributedOptimizer:
                 lars_coeff=cfg.get("lars_coeff", 0.001),
                 lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
                 parameters=params, grad_clip=clip)
+        if getattr(strategy, "dgc", False):
+            # the reference's DGCOptimizer REPLACES Momentum with
+            # DGCMomentumOptimizer — the momentum moves INSIDE the
+            # compressor. Equivalent here: swap Momentum → plain SGD and
+            # carry its coefficient into dgc_momentum
+            # (train_step_options reads it back); keeping Momentum outside
+            # would compound momentum twice.
+            from ...optimizer.optimizer import SGD, Momentum
+            if isinstance(optimizer, Momentum):
+                strategy.dgc_configs = dict(
+                    strategy.dgc_configs or {},
+                    _momentum=float(optimizer._momentum))
+                return SGD(learning_rate=lr, parameters=params,
+                           grad_clip=clip)
+            if not isinstance(optimizer, SGD):
+                raise NotImplementedError(
+                    "strategy.dgc requires a Momentum (or SGD) inner "
+                    "optimizer — the reference's DGCOptimizer only "
+                    "applies to Momentum (dgc_optimizer.py)")
         return optimizer
 
     # strategy → engine options ---------------------------------------------
@@ -89,6 +108,17 @@ class DistributedOptimizer:
             opts["localsgd_k"] = int(s.localsgd_configs.get("k_steps", 1))
             opts["localsgd_begin"] = int(
                 s.localsgd_configs.get("begin_step", 1))
+        if s.dgc:
+            cfg = s.dgc_configs or {}
+            # reference dgc_configs: rampup_begin_step + sparsity list
+            # (the engine applies the final sparsity after rampup);
+            # _momentum carries the swapped-out Momentum's coefficient
+            sp = cfg.get("sparsity", [0.999])
+            opts["dgc_sparsity"] = float(sp[-1] if isinstance(
+                sp, (list, tuple)) else sp)
+            opts["dgc_rampup_begin"] = int(
+                cfg.get("rampup_begin_step", 1))
+            opts["dgc_momentum"] = float(cfg.get("_momentum", 0.9))
         if s.a_sync:
             raise NotImplementedError(
                 "DistributedStrategy.a_sync is the parameter-server async "

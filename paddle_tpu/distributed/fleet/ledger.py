@@ -32,11 +32,13 @@ LEDGER = {
     "lars": ("engine", "optimizer swapped to Lars at distributed_optimizer"),
     "a_sync": ("engine", "PS-mode async communicator (ps/ package; the "
                          "collective TrainStep path rejects it)"),
-    "dgc": ("raises", "deep gradient compression: sparse top-k allreduce "
-                      "is host-hostile on TPU; ICI bandwidth makes dense "
-                      "bf16 allreduce faster than compression at every "
-                      "scale measured — use fp16_allreduce-equivalent "
-                      "bf16 grads (on by default) instead"),
+    "dgc": ("engine", "deep gradient compression as an engine mode "
+                      "(TrainStep dgc_sparsity/dgc_rampup_begin): per-rank "
+                      "momentum correction + residual top-k before the "
+                      "cross-rank mean; rampup phase IS dense Momentum. "
+                      "NB: on-chip ICI makes dense bf16 allreduce faster "
+                      "at every scale measured — dgc is for DCN-bound "
+                      "multi-host jobs"),
     "fp16_allreduce": ("n/a", "grads already travel in bf16 when amp is on; "
                               "XLA fuses the cast into the reduce"),
     "fuse_all_reduce_ops": ("n/a", "XLA's all-reduce combiner fuses "

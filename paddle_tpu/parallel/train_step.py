@@ -78,7 +78,9 @@ class TrainStep:
                  remat: bool = False, zero: int = 0, accumulate_steps: int = 1,
                  donate: bool = True, seed: int = 0,
                  batch_spec=None, compute_dtype=None,
-                 localsgd_k: int = 0, localsgd_begin: int = 1):
+                 localsgd_k: int = 0, localsgd_begin: int = 1,
+                 dgc_sparsity: float = 0.0, dgc_momentum: float = 0.9,
+                 dgc_rampup_begin: int = 1):
         self.layer = layer
         self.optimizer = optimizer
         self.loss_fn = _wrap_loss(loss_fn) if loss_fn is not None else None
@@ -100,6 +102,29 @@ class TrainStep:
         if self.localsgd_k > 1 and (zero or accumulate_steps > 1):
             raise ValueError("localsgd composes with neither sharding (zero) "
                              "nor gradient_merge in this engine")
+        # DGC (meta_optimizers/dgc_optimizer.py / operators/dgc_op.h
+        # parity as an ENGINE mode): per-dp-rank momentum correction +
+        # residual accumulation + sampled top-k sparsification BEFORE the
+        # cross-rank mean — the wire-compression algorithm expressed as a
+        # vmap over per-rank gradient shards.  The momentum lives INSIDE
+        # the compression (DGCMomentumOptimizer), so pair it with a plain
+        # SGD outer optimizer; with sparsity→0 the mode reduces exactly
+        # to dense Momentum(dgc_momentum).
+        self.dgc_sparsity = float(dgc_sparsity)
+        self.dgc_momentum = float(dgc_momentum)
+        self.dgc_rampup_begin = int(dgc_rampup_begin)
+        if self.dgc_sparsity > 0 and (zero or accumulate_steps > 1
+                                      or self.localsgd_k > 1):
+            raise ValueError("dgc composes with neither sharding (zero), "
+                             "gradient_merge, nor localsgd in this engine")
+        if not (0.0 <= self.dgc_sparsity < 1.0):
+            raise ValueError("dgc_sparsity must be in [0, 1)")
+        if self.dgc_sparsity > 0 and getattr(optimizer, "_momentum", 0):
+            raise ValueError(
+                "dgc carries its own momentum correction (dgc_momentum); "
+                "a Momentum outer optimizer would compound momentum twice "
+                "— use plain SGD (fleet's strategy.dgc performs this swap "
+                "and carries the coefficient automatically)")
         self._state = None
         self._compiled = None
         self._donate = donate
@@ -109,6 +134,9 @@ class TrainStep:
         if self.localsgd_k > 1 and self._pipe is not None:
             raise ValueError("localsgd is a data-parallel strategy; it does "
                              "not compose with pipeline parallelism")
+        if self.dgc_sparsity > 0 and self._pipe is not None:
+            raise ValueError("dgc is a data-parallel strategy; it does not "
+                             "compose with pipeline parallelism")
         if self._pipe is not None:
             # microbatching IS the gradient accumulation in a pipeline:
             # strategy accumulate_steps sets the GPipe microbatch count
@@ -248,6 +276,18 @@ class TrainStep:
         }
         self._shardings = {"params": pshard, "buffers": {n: rep for n in buffers},
                           "opt": oshard, "step": rep}
+        if self.dgc_sparsity > 0:
+            # per-rank momentum-correction (u) and residual (v) buffers,
+            # one slice per dp rank (dgc_op.h U/V state)
+            D = max(1, self.mesh.shape.get(DP_AXIS, 1))
+            ushard = {n: NamedSharding(self.mesh, P(DP_AXIS, *pshard[n].spec))
+                      for n in params}
+            for tag in ("dgc_u", "dgc_v"):
+                self._state[tag] = {
+                    n: _global_put(np.zeros((D,) + tuple(v.shape),
+                                            np.float32), ushard[n])
+                    for n, v in params.items()}
+                self._shardings[tag] = ushard
         return self._state
 
     @property
@@ -392,7 +432,96 @@ class TrainStep:
 
         return step
 
+    def _build_dgc_step(self):
+        """DGC engine step (dgc_op.h + dgc_optimizer.py): the batch splits
+        into dp shards; each rank's gradient passes momentum correction
+        (u = m·u + g), residual accumulation (v += u), and sampled-top-k
+        sparsification; the cross-rank mean runs on the SPARSE tensors and
+        u/v keep the unsent mass (+ the sent mass is cleared from both).
+        Before dgc_rampup_begin the step transmits v densely (and clears
+        it), which makes the mode EXACTLY dense Momentum(dgc_momentum) —
+        the rampup contract the reference's DGCMomentumOptimizer keeps."""
+        loss_of = self._loss_of
+        if self.remat:
+            loss_of = jax.checkpoint(loss_of, static_argnums=())
+        D = max(1, self.mesh.shape.get(DP_AXIS, 1))
+        m = self.dgc_momentum
+        sparsity = self.dgc_sparsity
+        rampup = self.dgc_rampup_begin
+
+        def sparsify(v):
+            """Per-rank sampled threshold (the reference estimates the
+            top-k cut from a gradient sample, dgc_op.h k-select)."""
+            flat = jnp.abs(v.reshape(D, -1))
+            n = flat.shape[1]
+            stride = max(1, n // 4096)
+            samp = flat[:, ::stride]
+            thr = jnp.quantile(samp, sparsity, axis=1)      # [D]
+            shape = (D,) + (1,) * (v.ndim - 1)
+            return (jnp.abs(v) >= thr.reshape(shape)).astype(v.dtype)
+
+        def step(state, inputs, label, lr):
+            new_step = state["step"] + 1
+            base_key = jax.random.fold_in(jax.random.key(self.seed),
+                                          new_step)
+
+            def split(x):
+                if x is None:
+                    return None
+                return x.reshape((D, x.shape[0] // D) + x.shape[1:])
+
+            def per_rank(mb_in, mb_lb, ridx):
+                key = jax.random.fold_in(base_key, ridx)
+                (loss, nb), g = jax.value_and_grad(
+                    loss_of, has_aux=True)(state["params"],
+                                           state["buffers"], mb_in,
+                                           mb_lb, key)
+                return loss, g, nb
+
+            mb_in = tuple(split(x) for x in inputs)
+            mb_lb = None if label is None else split(label)
+            loss, grads, new_buffers = jax.vmap(
+                per_rank, in_axes=(0, 0, 0))(mb_in, mb_lb, jnp.arange(D))
+            # replicated buffers: consensus = mean of the rank copies
+            new_buffers = {
+                n: (jnp.mean(v, axis=0)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v[0])
+                for n, v in new_buffers.items()}
+
+            def compress(g, u, v):
+                u_m = m * u + g.astype(jnp.float32)
+                dense = new_step < rampup
+                # rampup: plain Momentum — persistent velocity, nothing
+                # masked (DGCMomentumOptimizer 'behaves as normal Momentum
+                # before rampup_begin_step')
+                # dgc: residual accumulation + top-k masking; sent
+                # coordinates clear BOTH u (momentum factor masking) and v
+                v_s = v + u_m
+                mask = sparsify(v_s)
+                pick = lambda a, b: jnp.where(dense, a, b)  # noqa: E731
+                send = pick(u_m, v_s * mask)
+                new_u = pick(u_m, u_m * (1.0 - mask))
+                new_v = pick(v, v_s * (1.0 - mask))
+                return send, new_u, new_v
+
+            send, new_u, new_v = {}, {}, {}
+            for n, g in grads.items():
+                s, nu, nv = compress(g, state["dgc_u"][n],
+                                     state["dgc_v"][n])
+                send[n] = jnp.mean(s, axis=0)        # cross-rank reduce
+                new_u[n], new_v[n] = nu, nv
+
+            new_params, new_opt = self.optimizer.functional_apply(
+                state["params"], send, state["opt"], new_step, lr)
+            return {"params": new_params, "buffers": new_buffers,
+                    "opt": new_opt, "step": new_step,
+                    "dgc_u": new_u, "dgc_v": new_v}, loss.mean()
+
+        return step
+
     def _build_step(self):
+        if self.dgc_sparsity > 0:
+            return self._build_dgc_step()
         if self._localsgd_degree() > 1:
             return self._build_localsgd_step()
         if self._pipe is not None:
@@ -460,12 +589,7 @@ class TrainStep:
             return self._compiled
         self.state  # materialize
         step = self._build_step()
-        state_shardings = {
-            "params": self._shardings["params"],
-            "buffers": self._shardings["buffers"],
-            "opt": self._shardings["opt"],
-            "step": self._shardings["step"],
-        }
+        state_shardings = dict(self._shardings)
         self._compiled = jax.jit(
             step,
             in_shardings=(state_shardings, None, None, None),
@@ -483,11 +607,12 @@ class TrainStep:
 
         dp = self.mesh.shape.get(DP_AXIS, 1)
         lead_ndim = inputs[0].ndim
-        if self._localsgd_degree() > 1 and inputs[0].shape[0] % dp != 0:
+        if (self._localsgd_degree() > 1 or self.dgc_sparsity > 0) and \
+                inputs[0].shape[0] % max(1, dp) != 0:
             raise ValueError(
-                f"localsgd needs the batch ({inputs[0].shape[0]}) divisible "
-                f"by the dp degree ({dp}): each rank trains its own replica "
-                "on its own shard, so there is no replicate fallback")
+                f"localsgd/dgc need the batch ({inputs[0].shape[0]}) "
+                f"divisible by the dp degree ({dp}): each rank computes "
+                "over its own shard, so there is no replicate fallback")
 
         nproc = jax.process_count()
         local_dp = dp // nproc if (nproc > 1 and dp > 1 and

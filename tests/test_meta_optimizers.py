@@ -215,3 +215,119 @@ def test_sync_batch_norm_strategy_converts_layers():
     dp = f.distributed_model(net)
     kinds = [type(m).__name__ for m in dp._layers.sublayers()]
     assert "SyncBatchNorm" in kinds and "BatchNorm2D" not in kinds, kinds
+
+
+def test_dgc_rampup_is_exactly_dense_momentum():
+    """DGC engine mode (VERDICT's one 'no' row closed): before
+    rampup_begin the step IS plain Momentum — same trajectory to float
+    tolerance as a dense Momentum TrainStep."""
+    import numpy as np
+    from paddle_tpu.parallel import init_mesh, TrainStep
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 6).astype("float32")
+    Y = rng.randn(32, 1).astype("float32")
+
+    def run(dgc):
+        paddle.seed(5)
+        mesh = init_mesh({"dp": -1})
+        m = nn.Sequential(nn.Linear(6, 16), nn.Tanh(), nn.Linear(16, 1))
+        if dgc:
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=m.parameters())
+            step = TrainStep(m, opt, loss_fn=nn.MSELoss(), mesh=mesh,
+                             dgc_sparsity=0.9, dgc_momentum=0.9,
+                             dgc_rampup_begin=10**6)
+        else:
+            opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                            momentum=0.9,
+                                            parameters=m.parameters())
+            step = TrainStep(m, opt, loss_fn=nn.MSELoss(), mesh=mesh)
+        return [float(step((X,), Y)) for _ in range(5)]
+
+    dgc_losses = run(True)
+    dense_losses = run(False)
+    import numpy as np
+    np.testing.assert_allclose(dgc_losses, dense_losses, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_dgc_sparse_phase_descends_and_holds_residuals():
+    import numpy as np
+    from paddle_tpu.parallel import init_mesh, TrainStep
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(32, 6).astype("float32")
+    Y = rng.randn(32, 1).astype("float32")
+    paddle.seed(5)
+    mesh = init_mesh({"dp": -1})
+    m = nn.Sequential(nn.Linear(6, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=m.parameters())
+    step = TrainStep(m, opt, loss_fn=nn.MSELoss(), mesh=mesh,
+                     dgc_sparsity=0.9, dgc_rampup_begin=1)
+    losses = [float(step((X,), Y)) for _ in range(10)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+    # unsent mass is HELD in the residual buffers, per rank
+    v_mass = sum(float(np.abs(np.asarray(v)).sum())
+                 for v in step.state["dgc_v"].values())
+    assert v_mass > 0
+    # composition guards
+    import pytest
+    with pytest.raises(ValueError):
+        TrainStep(m, opt, loss_fn=nn.MSELoss(), mesh=mesh,
+                  dgc_sparsity=0.9, zero=1)
+    with pytest.raises(ValueError):
+        TrainStep(m, opt, loss_fn=nn.MSELoss(), mesh=mesh,
+                  dgc_sparsity=1.0)
+
+
+def test_dgc_strategy_wiring():
+    from paddle_tpu.distributed.fleet.base.distributed_strategy import (
+        DistributedStrategy)
+    from paddle_tpu.distributed.fleet.fleet_base import DistributedOptimizer
+    s = DistributedStrategy()
+    s.dgc = True
+    s.dgc_configs = {"rampup_begin_step": 3, "sparsity": [0.75, 0.999]}
+    paddle.seed(0)
+    m = nn.Linear(2, 2)
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=m.parameters())
+    dopt = DistributedOptimizer(inner, s)
+    o = dopt.train_step_options()
+    assert o.get("dgc_sparsity") == 0.999
+    assert o.get("dgc_rampup_begin") == 3
+
+
+def test_dgc_momentum_swap_no_double_momentum():
+    """Review regression: fleet's strategy.dgc swaps a Momentum inner to
+    SGD and carries its coefficient into dgc_momentum (the reference's
+    DGCMomentumOptimizer replacement); direct TrainStep use with a
+    Momentum outer raises."""
+    import pytest
+    from paddle_tpu.distributed.fleet.base.distributed_strategy import (
+        DistributedStrategy)
+    from paddle_tpu.distributed.fleet.fleet_base import DistributedOptimizer
+    from paddle_tpu.optimizer.optimizer import SGD, Momentum
+    from paddle_tpu.parallel import init_mesh, TrainStep
+
+    s = DistributedStrategy()
+    s.dgc = True
+    paddle.seed(0)
+    m = nn.Linear(2, 2)
+    inner = Momentum(learning_rate=0.1, momentum=0.95,
+                     parameters=m.parameters())
+    dopt = DistributedOptimizer(inner, s)
+    assert isinstance(dopt._inner, SGD)
+    o = dopt.train_step_options()
+    assert o.get("dgc_momentum") == 0.95
+
+    with pytest.raises(NotImplementedError):
+        DistributedOptimizer(paddle.optimizer.Adam(
+            parameters=nn.Linear(2, 2).parameters()), s)
+
+    mesh = init_mesh({"dp": -1})
+    with pytest.raises(ValueError, match="compound momentum"):
+        TrainStep(m, Momentum(learning_rate=0.1,
+                              parameters=m.parameters()),
+                  loss_fn=nn.MSELoss(), mesh=mesh, dgc_sparsity=0.9)
