@@ -5,6 +5,9 @@ Reference: python/paddle/incubate/ — notably auto-checkpoint
 """
 from . import checkpoint  # noqa: F401
 from .contrib_tools import memory_usage, op_freq_statistic  # noqa: F401
+from .decoder import (  # noqa: F401
+    InitState, StateCell, TrainingDecoder, BeamSearchDecoder,
+)
 from . import optimizer  # noqa: F401
 from .optimizer import (  # noqa: F401
     ExponentialMovingAverage, ModelAverage, LookaheadOptimizer,

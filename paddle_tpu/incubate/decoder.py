@@ -1,0 +1,310 @@
+"""Contrib beam-search decoder DSL: InitState / StateCell /
+TrainingDecoder / BeamSearchDecoder.
+
+Reference parity: python/paddle/fluid/contrib/decoder/beam_search_decoder.py
+— the StateCell holds named step inputs + hidden states with a registered
+``@state_cell.state_updater``; TrainingDecoder teacher-forces the cell over
+a target sequence; BeamSearchDecoder drives the SAME cell through beam
+decode (read_array/beam_search/update_array loop over LoDTensorArrays).
+
+TPU-shape deviations (documented, capability-preserving):
+- The reference's ``with decoder.block():`` records ops into a DynamicRNN
+  sub-graph.  Under eager tracing the same step body is a CALLABLE:
+  ``@decoder.block`` decorates ``fn(decoder, step_input)``.  Everything
+  inside the block — compute_state, layer calls, output — is unchanged.
+- Beams are DENSE [batch, beam] tensors (the LoD beam carrier and
+  sequence_expand collapse to a gather by beam parents); selection reuses
+  ops/decode.py beam_search_step + gather_tree (beam_search_op.cc /
+  gather_tree_op lowerings).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, unwrap
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+class InitState:
+    """beam_search_decoder.py:43 — an initial hidden state, either a
+    concrete ``init`` tensor or a zero boot of ``shape``/``value``."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                "init_boot must be provided to infer the shape of "
+                "InitState.\n")
+        else:
+            boot = unwrap(init_boot)
+            # fill_constant_batch_size_like convention: shape[0] is the
+            # batch placeholder, replaced by the boot's batch dim
+            tail = tuple(shape[1:]) if shape else tuple(boot.shape[1:])
+            self._init = Tensor(jnp.full((boot.shape[0],) + tail,
+                                         value, dtype))
+        self._need_reorder = need_reorder
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell:
+    """beam_search_decoder.py:159 — named inputs + states + an updater."""
+
+    def __init__(self, inputs: Dict, states: Dict, out_state: str,
+                 name=None):
+        self._cur_states = {}
+        self._init_states = {}
+        self._state_names = []
+        for state_name, state in states.items():
+            if not isinstance(state, InitState):
+                raise ValueError("state must be an InitState object.")
+            self._cur_states[state_name] = state
+            self._init_states[state_name] = state
+            self._state_names.append(state_name)
+        self._inputs = dict(inputs)
+        self._state_updater: Optional[Callable] = None
+        self._out_state = out_state
+        if self._out_state not in self._cur_states:
+            raise ValueError("out_state must be one state in states")
+
+    # -- access ---------------------------------------------------------------
+    def get_state(self, state_name):
+        if state_name not in self._cur_states:
+            raise ValueError(f"unknown state {state_name!r}")
+        s = self._cur_states[state_name]
+        return s.value if isinstance(s, InitState) else s
+
+    def set_state(self, state_name, state_value):
+        if state_name not in self._cur_states:
+            raise ValueError(f"unknown state {state_name!r}")
+        self._cur_states[state_name] = state_value
+
+    def get_input(self, input_name):
+        if input_name not in self._inputs or self._inputs[input_name] is None:
+            raise ValueError(f"input variable {input_name!r} not found "
+                             "in StateCell!")
+        return self._inputs[input_name]
+
+    def state_updater(self, updater):
+        """Decorator registering the per-step state transition
+        ``updater(state_cell)`` (reads get_input/get_state, writes
+        set_state)."""
+        self._state_updater = updater
+
+        def _decorator(*a, **k):
+            return updater(*a, **k)
+        return _decorator
+
+    def compute_state(self, inputs: Dict):
+        """Feed this step's inputs and run the registered updater."""
+        if self._state_updater is None:
+            raise ValueError("register a @state_cell.state_updater first")
+        for name, value in inputs.items():
+            if name not in self._inputs:
+                raise ValueError(f"unknown step input {name!r}")
+            self._inputs[name] = value
+        self._state_updater(self)
+
+    def update_states(self):
+        """The reference commits states into the RNN memory here; in the
+        functional loop the commit is the step boundary itself — kept for
+        source-level parity."""
+
+    def out_state(self):
+        return self.get_state(self._out_state)
+
+    def reset_states(self):
+        """Re-boot every state from its InitState — each decoder run
+        starts from the encoder state, not wherever the previous run
+        (teacher forcing, an earlier minibatch) left the cell."""
+        for n, init in self._init_states.items():
+            self._cur_states[n] = init
+
+    def needs_reorder(self, state_name):
+        return self._init_states[state_name].need_reorder
+
+
+class TrainingDecoder:
+    """beam_search_decoder.py:384 — teacher-forced training decode.
+
+    ``@decoder.block`` registers ``fn(decoder, step_input)`` (the
+    reference's with-block body); ``decoder(step_inputs)`` runs it over
+    the time axis of ``step_inputs`` [B, T, ...] and returns the stacked
+    per-step outputs [B, T, ...]."""
+
+    def __init__(self, state_cell: StateCell, name=None):
+        self._state_cell = state_cell
+        self._block_fn: Optional[Callable] = None
+        self._step_outputs = None
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    def block(self, fn):
+        if self._block_fn is not None:
+            raise ValueError("decoder.block() can only be invoked once")
+        self._block_fn = fn
+        return fn
+
+    def output(self, *outputs):
+        self._step_outputs = outputs if len(outputs) > 1 else outputs[0]
+
+    def __call__(self, step_inputs):
+        if self._block_fn is None:
+            raise ValueError("define the step body with @decoder.block "
+                             "first")
+        if not isinstance(step_inputs, Tensor):
+            step_inputs = Tensor(jnp.asarray(unwrap(step_inputs)))
+        self._state_cell.reset_states()   # every run boots from InitState
+        T = step_inputs.shape[1]
+        outs = []
+        for t in range(T):
+            self._step_outputs = None
+            # Tensor-level slicing/stacking keeps the autograd tape intact
+            # (unwrap+rewrap here would silently cut gradients)
+            self._block_fn(self, step_inputs[:, t])
+            if self._step_outputs is None:
+                raise ValueError("the block must call decoder.output(...)")
+            outs.append(self._step_outputs)
+        from ..ops.manipulation import stack
+        return stack(outs, axis=1)
+
+
+class BeamSearchDecoder:
+    """beam_search_decoder.py:525 — beam decode over the SAME StateCell.
+
+    ``decoder.decode()`` wires the reference's default loop (embed the
+    previous beam ids, expand per-batch inputs to beams, compute_state,
+    project to the vocab, topk + beam_search select, reorder states by
+    the chosen parents); ``decoder()`` runs it and returns
+    (translation_ids [T, B, beam], translation_scores [B, beam])."""
+
+    def __init__(self, state_cell: StateCell, init_ids, init_scores,
+                 target_dict_dim: int, word_dim: int, input_var_dict=None,
+                 topk_size: int = 50, sparse_emb: bool = True,
+                 max_len: int = 100, beam_size: int = 1, end_id: int = 1,
+                 name=None):
+        from ..nn import Embedding
+        self._state_cell = state_cell
+        self._init_ids = unwrap(init_ids)
+        self._init_scores = unwrap(init_scores)
+        self._target_dict_dim = int(target_dict_dim)
+        self._word_dim = int(word_dim)
+        self._input_var_dict = dict(input_var_dict or {})
+        self._topk_size = int(topk_size)
+        self._max_len = int(max_len)
+        self._beam_size = int(beam_size)
+        self._end_id = int(end_id)
+        # the reference's decode() owns an embedding + softmax fc; exposed
+        # as layers so trained weights load onto them (score_fc is built
+        # lazily from the out_state width)
+        self.embedding = Embedding(self._target_dict_dim, self._word_dim)
+        self.score_fc = None
+        self._decoded = False
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    def _ensure_score_fc(self, width):
+        from ..nn import Linear
+        if self.score_fc is None:
+            self.score_fc = Linear(int(width), self._target_dict_dim)
+
+    def decode(self):
+        """Set up the default decode loop (override for a custom one)."""
+        self._decoded = True
+
+    def early_stop(self):
+        """Parity no-op: the dense loop stops via finished-beam masking
+        (all-finished beams keep emitting end_id with frozen scores)."""
+
+    def __call__(self):
+        if not self._decoded:
+            raise ValueError("call decoder.decode() first")
+        from ..ops.decode import beam_search_step, gather_tree
+
+        cell = self._state_cell
+        cell.reset_states()               # every run boots from InitState
+        B = int(np.prod(self._init_ids.shape)) // max(
+            1, self._init_ids.shape[-1]) if self._init_ids.ndim > 1 else \
+            self._init_ids.shape[0]
+        K = self._beam_size
+        ids = jnp.broadcast_to(
+            jnp.asarray(self._init_ids).reshape(B, -1)[:, :1],
+            (B, K)).astype(jnp.int32)
+        scores = jnp.broadcast_to(
+            jnp.asarray(self._init_scores).reshape(B, -1)[:, :1],
+            (B, K)).astype(jnp.float32)
+        # beams after the first keep -inf so step 1 expands ONE beam
+        scores = scores + jnp.where(
+            jnp.arange(K)[None, :] > 0, -1e9, 0.0)
+
+        # states enter as [B, H] → tile to beams [B*K, H]
+        for n in cell._state_names:
+            s = unwrap(cell.get_state(n))
+            cell.set_state(n, Tensor(
+                jnp.repeat(s, K, axis=0) if s.shape[0] == B else s))
+        static_feeds = {}
+        for name, var in self._input_var_dict.items():
+            if name not in cell._inputs:
+                raise ValueError(f"Variable {name} not found in "
+                                 "StateCell!\n")
+            v = unwrap(var)
+            static_feeds[name] = Tensor(jnp.repeat(v, K, axis=0)
+                                        if v.shape[0] == B else v)
+
+        all_ids, all_parents, all_scores = [], [], []
+        for _ in range(self._max_len):
+            emb = self.embedding(Tensor(ids.reshape(B * K)))
+            feeds = dict(static_feeds)
+            for name in cell._inputs:
+                if name not in feeds:
+                    # reference parity (beam_search_decoder.py decode():
+                    # every input not in input_var_dict is fed the
+                    # previous-word embedding)
+                    feeds[name] = emb
+            cell.compute_state(inputs=feeds)
+            out = unwrap(cell.out_state())            # [B*K, H]
+            self._ensure_score_fc(out.shape[-1])
+            probs = unwrap(self.score_fc(Tensor(out)))
+            logits = jnp.reshape(
+                jnp.asarray(probs), (B, K, self._target_dict_dim))
+            # feed log-softmax directly (is_accumulated): a softmax here
+            # would round-trip exp→normalize→log inside the beam step
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ids_t, scores_t, parents_t = beam_search_step(
+                Tensor(ids), Tensor(scores), Tensor(logp),
+                beam_size=K, end_id=self._end_id, is_accumulated=True)
+            ids, scores = unwrap(ids_t).astype(jnp.int32), unwrap(scores_t)
+            parents = unwrap(parents_t).astype(jnp.int32)
+            # reorder beam-parallel states by the selected parents
+            flat_parent = (jnp.arange(B)[:, None] * K + parents).reshape(-1)
+            for n in cell._state_names:
+                if not cell.needs_reorder(n):
+                    continue      # InitState(need_reorder=False) parity
+                sv = unwrap(cell.get_state(n))
+                cell.set_state(n, Tensor(sv[flat_parent]))
+            cell.update_states()
+            all_ids.append(ids)
+            all_parents.append(parents)
+            all_scores.append(scores)
+
+        paths = gather_tree(Tensor(jnp.stack(all_ids)),
+                            Tensor(jnp.stack(all_parents)))
+        return paths, Tensor(all_scores[-1])

@@ -1,0 +1,199 @@
+"""Contrib beam-search decoder DSL (VERDICT r4 missing #6).
+
+Mirrors the reference's docstring workflow
+(fluid/contrib/decoder/beam_search_decoder.py): build a StateCell with a
+registered state updater, teacher-force it with TrainingDecoder, then
+drive the SAME cell through BeamSearchDecoder and check the decode
+contract (shapes, end_id padding, greedy-limit equivalence at beam 1).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.decoder import (InitState, StateCell,
+                                         TrainingDecoder,
+                                         BeamSearchDecoder)
+
+V, D, H, B = 12, 8, 16, 2
+END = 1
+
+
+def _make_cell(encoder_out):
+    init = InitState(init=encoder_out, need_reorder=True)
+    cell = StateCell(inputs={"x": None}, states={"h": init}, out_state="h")
+    gru = nn.GRUCell(D, H)
+
+    @cell.state_updater
+    def updater(state_cell):
+        x = state_cell.get_input("x")
+        h = state_cell.get_state("h")
+        _, new_h = gru(x, h)
+        state_cell.set_state("h", new_h)
+
+    return cell, gru
+
+
+def test_state_cell_validation():
+    enc = paddle.to_tensor(np.zeros((B, H), "float32"))
+    with pytest.raises(ValueError):
+        StateCell(inputs={}, states={"h": "not-init-state"}, out_state="h")
+    with pytest.raises(ValueError):
+        StateCell(inputs={}, states={"h": InitState(init=enc)},
+                  out_state="nope")
+    cell, _ = _make_cell(enc)
+    with pytest.raises(ValueError):
+        cell.get_state("zz")
+    with pytest.raises(ValueError):
+        cell.get_input("x")          # not fed yet
+
+
+def test_training_decoder_teacher_forcing_trains():
+    rng = np.random.RandomState(0)
+    paddle.seed(7)
+    enc = paddle.to_tensor(rng.randn(B, H).astype("float32"))
+    cell, gru = _make_cell(enc)
+    proj = nn.Linear(H, V)
+
+    decoder = TrainingDecoder(cell)
+
+    @decoder.block
+    def _step(dec, current_word):
+        dec.state_cell.compute_state(inputs={"x": current_word})
+        score = proj(dec.state_cell.get_state("h"))
+        dec.state_cell.update_states()
+        dec.output(score)
+
+    emb = nn.Embedding(V, D)
+    tgt = paddle.to_tensor(rng.randint(2, V, (B, 5)))
+    logits = decoder(emb(tgt))            # [B, T, V]
+    assert tuple(logits.shape) == (B, 5, V)
+
+    # the whole DSL is differentiable end to end
+    labels = paddle.to_tensor(rng.randint(0, V, (B, 5)))
+    loss = nn.CrossEntropyLoss()(
+        paddle.reshape(logits, [-1, V]), paddle.reshape(labels, [-1]))
+    loss.backward()
+    g = gru.parameters()[0].grad
+    assert g is not None and np.abs(g.numpy()).sum() > 0
+
+    # block can only be defined once; output() is mandatory
+    with pytest.raises(ValueError):
+        decoder.block(lambda d, w: None)
+    d2 = TrainingDecoder(_make_cell(enc)[0])
+
+    @d2.block
+    def _no_out(dec, w):
+        dec.state_cell.compute_state(inputs={"x": w})
+
+    with pytest.raises(ValueError):
+        d2(emb(tgt))
+
+
+def test_beam_search_decoder_contract():
+    rng = np.random.RandomState(1)
+    paddle.seed(9)
+    enc = paddle.to_tensor(rng.randn(B, H).astype("float32"))
+    cell, _ = _make_cell(enc)
+
+    init_ids = paddle.to_tensor(np.full((B, 1), 2, "int64"))
+    init_scores = paddle.to_tensor(np.zeros((B, 1), "float32"))
+    dec = BeamSearchDecoder(cell, init_ids, init_scores,
+                            target_dict_dim=V, word_dim=D,
+                            max_len=6, beam_size=3, end_id=END)
+    with pytest.raises(ValueError):
+        dec()                          # decode() must run first
+    dec.decode()
+    ids, scores = dec()
+    assert tuple(ids.shape) == (6, B, 3)
+    assert tuple(scores.shape) == (B, 3)
+    a = ids.numpy()
+    assert a.min() >= 0 and a.max() < V
+    s = scores.numpy()
+    assert np.all(np.isfinite(s))
+    # beam 0 carries the best accumulated score (sorted selection)
+    assert np.all(s[:, 0] >= s[:, -1] - 1e-6)
+    # after an END token a path keeps emitting END (gather_tree padding)
+    for b in range(B):
+        for k in range(3):
+            col = a[:, b, k]
+            hits = np.where(col == END)[0]
+            if len(hits) and hits[0] + 1 < len(col):
+                assert np.all(col[hits[0] + 1:] == END)
+
+
+def test_beam_one_matches_greedy():
+    """beam_size=1 must reproduce greedy argmax decoding with the same
+    weights — the degenerate-beam contract."""
+    rng = np.random.RandomState(3)
+    paddle.seed(11)
+    enc = paddle.to_tensor(rng.randn(1, H).astype("float32"))
+    cell, gru = _make_cell(enc)
+    init_ids = paddle.to_tensor(np.full((1, 1), 2, "int64"))
+    init_scores = paddle.to_tensor(np.zeros((1, 1), "float32"))
+    dec = BeamSearchDecoder(cell, init_ids, init_scores,
+                            target_dict_dim=V, word_dim=D,
+                            max_len=5, beam_size=1, end_id=END)
+    dec.decode()
+    ids, _ = dec()
+    got = ids.numpy()[:, 0, 0]
+
+    # greedy reference with the same embedding/score/gru weights
+    h = enc.numpy()
+    w_emb = dec.embedding.parameters()[0].numpy()
+    cur = 2
+    want = []
+    import jax.numpy as jnp
+    for _ in range(5):
+        if cur == END:
+            want.append(END)
+            continue
+        x = paddle.to_tensor(w_emb[cur][None])
+        _, hh = gru(x, paddle.to_tensor(h))
+        h = hh.numpy()
+        logits = dec.score_fc(paddle.to_tensor(h)).numpy()[0]
+        cur = int(np.argmax(logits))
+        want.append(cur)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_cell_reuse_across_decoders_reboots_states():
+    """Review regression: the SAME cell trains (TrainingDecoder) and then
+    beam-decodes (BeamSearchDecoder) — each run re-boots from InitState,
+    and need_reorder=False states are left unpermuted."""
+    rng = np.random.RandomState(5)
+    paddle.seed(13)
+    enc = paddle.to_tensor(rng.randn(B, H).astype("float32"))
+    cell, _ = _make_cell(enc)
+    proj = nn.Linear(H, V)
+    td = TrainingDecoder(cell)
+
+    @td.block
+    def _s(d, w):
+        d.state_cell.compute_state(inputs={"x": w})
+        d.output(proj(d.state_cell.get_state("h")))
+
+    emb = nn.Embedding(V, D)
+    tgt = paddle.to_tensor(rng.randint(2, V, (B, 4)))
+    first = td(emb(tgt)).numpy()
+    # second run re-boots: identical outputs, no state carry-over
+    np.testing.assert_allclose(td(emb(tgt)).numpy(), first, atol=1e-6)
+
+    # the documented train→beam workflow on the SAME cell
+    bd = BeamSearchDecoder(cell,
+                           paddle.to_tensor(np.full((B, 1), 2, "int64")),
+                           paddle.to_tensor(np.zeros((B, 1), "float32")),
+                           target_dict_dim=V, word_dim=D,
+                           max_len=4, beam_size=2, end_id=END)
+    bd.decode()
+    ids, _ = bd()
+    assert tuple(ids.shape) == (4, B, 2)
+    # and teacher forcing afterwards still reproduces the first run
+    np.testing.assert_allclose(td(emb(tgt)).numpy(), first, atol=1e-6)
+
+
+def test_init_state_shape_placeholder():
+    enc = paddle.to_tensor(np.zeros((3, H), "float32"))
+    st = InitState(init_boot=enc, shape=[-1, 5], value=2.0)
+    assert tuple(st.value.shape) == (3, 5)
+    assert float(st.value.numpy()[0, 0]) == 2.0
