@@ -2,6 +2,6 @@
 from .model import Model  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
-    VisualDL,
+    ReduceLROnPlateau, VisualDL,
 )
 from .summary import summary  # noqa: F401

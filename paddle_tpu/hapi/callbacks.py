@@ -211,3 +211,86 @@ class VisualDL(Callback):
         if self._writer is not None:
             self._writer.close()
             self._writer = None   # a later fit() reopens a fresh file
+
+
+class ReduceLROnPlateau(Callback):
+    """hapi/callbacks.py ReduceLROnPlateau parity: monitor an eval metric;
+    after ``patience`` epochs without improvement multiply the optimizer's
+    (float) learning rate by ``factor``, then hold for ``cooldown``
+    epochs.  'auto' mode infers direction from the monitor name ('acc' →
+    max)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau does not support a "
+                             "factor >= 1.0.")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = mode
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._reset()
+
+    def _reset(self):
+        import warnings
+        if self.mode not in ("auto", "min", "max"):
+            warnings.warn(f"Learning rate reduction mode {self.mode} is "
+                          "unknown, fallback to auto mode.")
+            self.mode = "auto"
+        if self.mode == "min" or (self.mode == "auto"
+                                  and "acc" not in self.monitor):
+            self.better = lambda a, b: a < b - self.min_delta
+            self.best = float("inf")
+        else:
+            self.better = lambda a, b: a > b + self.min_delta
+            self.best = -float("inf")
+        self.cooldown_counter = 0
+        self.wait = 0
+
+    def on_train_begin(self, logs=None):
+        self._reset()
+
+    def on_eval_end(self, logs=None):
+        import warnings
+        logs = logs or {}
+        cur = logs.get(self.monitor, logs.get(f"eval_{self.monitor}"))
+        if cur is None:
+            warnings.warn("Monitor of ReduceLROnPlateau should be loss "
+                          "or metric name.")
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                if not isinstance(getattr(opt, "_learning_rate", None),
+                                  (int, float)):
+                    # reference behavior: an LRScheduler owns the lr —
+                    # warn and leave it alone instead of aborting fit()
+                    warnings.warn(
+                        "Expected learning_rate be float, but got "
+                        f"{type(getattr(opt, '_learning_rate', None))}.")
+                    return
+                old = float(opt.get_lr())
+                new = max(old * self.factor, self.min_lr)
+                if old - new > 1e-12:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"Epoch: reducing learning rate from {old} "
+                              f"to {new}.")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0

@@ -160,3 +160,264 @@ class Transpose(BaseTransform):
 
     def _apply_image(self, img):
         return np.transpose(np.asarray(img), self.order)
+
+
+def _hwc_view(img):
+    """(array, chw_flag): normalize access to HWC coordinates."""
+    img = np.asarray(img)
+    chw = img.ndim == 3 and img.shape[0] in (1, 3) and \
+        img.shape[0] < img.shape[-1]
+    return img, chw
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        img, chw = _hwc_view(img)
+        if np.random.rand() < self.prob:
+            ax = 1 if chw else 0
+            return np.ascontiguousarray(np.flip(img, axis=ax))
+        return img
+
+
+class Pad(BaseTransform):
+    """transforms.Pad parity: constant/edge/reflect padding of the
+    spatial dims; padding int, (pad_x, pad_y) or (l, t, r, b)."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        elif len(padding) != 4:
+            raise ValueError(
+                "padding must be an int, a 2-tuple (pad_x, pad_y) or a "
+                f"4-tuple (l, t, r, b); got {padding!r}")
+        self.padding = tuple(int(p) for p in padding)   # l, t, r, b
+        self.fill = fill
+        if padding_mode not in ("constant", "edge", "reflect",
+                                "symmetric"):
+            raise ValueError(f"unknown padding_mode {padding_mode!r}")
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        img, chw = _hwc_view(img)
+        l, t, r, b = self.padding
+        pad = [(0, 0)] * img.ndim
+        ax = 1 if chw else 0
+        pad[ax] = (t, b)
+        pad[ax + 1] = (l, r)
+        if self.padding_mode == "constant":
+            return np.pad(img, pad, constant_values=self.fill)
+        return np.pad(img, pad, mode=self.padding_mode)
+
+
+class Grayscale(BaseTransform):
+    """ITU-R 601-2 luma (the reference's to_grayscale)."""
+
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = int(num_output_channels)
+
+    def _apply_image(self, img):
+        img, chw = _hwc_view(img)
+        w = np.asarray([0.299, 0.587, 0.114], "float32")
+        if chw:
+            g = np.tensordot(w, img.astype("float32"), axes=([0], [0]))
+            g = g[None]
+            reps = (self.num_output_channels, 1, 1)
+        else:
+            g = img.astype("float32") @ w
+            g = g[..., None]
+            reps = (1, 1, self.num_output_channels)
+        out = np.tile(g, reps)
+        return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class BrightnessTransform(BaseTransform):
+    """value v: factor drawn from [max(0, 1-v), 1+v] (reference jitter)."""
+
+    def __init__(self, value):
+        if value < 0:
+            raise ValueError("brightness value should be non-negative")
+        self.value = float(value)
+
+    def _factor(self):
+        return np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        out = img.astype("float32") * self._factor()
+        if img.dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out
+
+
+class ContrastTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        img, chw = _hwc_view(img)
+        f = self._factor()
+        # pivot on the GRAYSCALE mean (adjust_contrast reference: the
+        # ITU-R 601-2 luma), not the flat RGB mean
+        w = np.asarray([0.299, 0.587, 0.114], "float32")
+        x = img.astype("float32")
+        gray_mean = (np.tensordot(w, x, axes=([0], [0])).mean() if chw
+                     else (x @ w).mean() if x.ndim == 3 and
+                     x.shape[-1] == 3 else x.mean())
+        out = x * f + gray_mean * (1 - f)
+        if img.dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out
+
+
+class SaturationTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        img, chw = _hwc_view(img)
+        f = self._factor()
+        w = np.asarray([0.299, 0.587, 0.114], "float32")
+        gray = (np.tensordot(w, img.astype("float32"), axes=([0], [0]))[None]
+                if chw else (img.astype("float32") @ w)[..., None])
+        out = img.astype("float32") * f + gray * (1 - f)
+        if img.dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out
+
+
+class HueTransform(BaseTransform):
+    """Hue shift by a fraction of the color wheel in [-value, value],
+    value <= 0.5 (reference contract); HSV round-trip in numpy."""
+
+    def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        img, chw = _hwc_view(img)
+        shift = np.random.uniform(-self.value, self.value)
+        x = img.astype("float32")
+        if img.dtype == np.uint8:
+            x = x / 255.0
+        if chw:
+            x = np.transpose(x, (1, 2, 0))
+        r, g, b = x[..., 0], x[..., 1], x[..., 2]
+        mx, mn = x.max(-1), x.min(-1)
+        d = mx - mn + 1e-12
+        h = np.where(mx == r, ((g - b) / d) % 6,
+                     np.where(mx == g, (b - r) / d + 2, (r - g) / d + 4))
+        h = (h / 6.0 + shift) % 1.0
+        s = np.where(mx > 0, d / (mx + 1e-12), 0.0)
+        v = mx
+        # hsv -> rgb
+        i = np.floor(h * 6).astype(int) % 6
+        f = h * 6 - np.floor(h * 6)
+        p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+        choices = [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+                   np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+                   np.stack([t, p, v], -1), np.stack([v, p, q], -1)]
+        out = np.select([i[..., None] == k for k in range(6)], choices)
+        if chw:
+            out = np.transpose(out, (2, 0, 1))
+        if img.dtype == np.uint8:
+            return np.clip(out * 255.0, 0, 255).astype(np.uint8)
+        return out.astype("float32")
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        for idx in np.random.permutation(len(self.ts)):
+            img = self.ts[int(idx)](img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    """Rotate by a random angle in [-degrees, degrees] (nearest sample,
+    constant fill — the reference's PIL rotate collapsed to numpy)."""
+
+    def __init__(self, degrees, fill=0):
+        if isinstance(degrees, numbers.Number):
+            if degrees < 0:
+                raise ValueError("degrees should be non-negative")
+            degrees = (-degrees, degrees)
+        self.degrees = tuple(float(d) for d in degrees)
+        self.fill = fill
+
+    def _apply_image(self, img):
+        img, chw = _hwc_view(img)
+        ang = np.deg2rad(np.random.uniform(*self.degrees))
+        ax = 1 if chw else 0
+        H, W = img.shape[ax], img.shape[ax + 1]
+        cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+        yy, xx = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+        # inverse map: output pixel <- input coordinate
+        c, s = np.cos(ang), np.sin(ang)
+        sy = c * (yy - cy) - s * (xx - cx) + cy
+        sx = s * (yy - cy) + c * (xx - cx) + cx
+        yi = np.round(sy).astype(int)
+        xi = np.round(sx).astype(int)
+        valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yi, xi = np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)
+        if chw:
+            out = img[:, yi, xi]
+            out = np.where(valid[None], out, self.fill)
+        else:
+            out = img[yi, xi]
+            mask = valid[..., None] if img.ndim == 3 else valid
+            out = np.where(mask, out, self.fill)
+        return out.astype(img.dtype)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Crop a random area/aspect patch, resize to ``size`` (the Inception
+    augmentation; reference scale/ratio defaults)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        img, chw = _hwc_view(img)
+        ax = 1 if chw else 0
+        H, W = img.shape[ax], img.shape[ax + 1]
+        area = H * W
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            logr = np.random.uniform(np.log(self.ratio[0]),
+                                     np.log(self.ratio[1]))
+            ar = np.exp(logr)
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < w <= W and 0 < h <= H:
+                i = np.random.randint(0, H - h + 1)
+                j = np.random.randint(0, W - w + 1)
+                patch = img[:, i:i + h, j:j + w] if chw \
+                    else img[i:i + h, j:j + w]
+                return self._restore_dtype(self._resize(patch), img.dtype)
+        # fallback: center crop of the feasible aspect (reference parity)
+        return self._restore_dtype(
+            self._resize(CenterCrop(min(H, W))(img)), img.dtype)
+
+    @staticmethod
+    def _restore_dtype(out, dtype):
+        # uint8 in -> uint8 out (reference parity): a silent float32 in
+        # the 0-255 range would make a downstream ToTensor skip its /255
+        if dtype == np.uint8:
+            return np.clip(np.asarray(out), 0, 255).astype(np.uint8)
+        return out

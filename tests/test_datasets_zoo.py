@@ -304,3 +304,73 @@ def test_dataset_folder_and_hapi_fit(tmp_path):
     (empty / "cls").mkdir(parents=True)
     with pytest.raises(RuntimeError, match="Found 0 files"):
         DatasetFolder(str(empty))
+
+
+def test_transforms_parity_surface():
+    """vision.transforms parity batch: flips/pad/gray/jitter/rotation/
+    random-resized-crop semantics on known inputs."""
+    from paddle_tpu.vision import transforms as T
+    rng = np.random.RandomState(0)
+    img = (rng.rand(12, 10, 3) * 255).astype("uint8")
+
+    flipped = T.RandomVerticalFlip(1.0)(img)
+    np.testing.assert_array_equal(flipped, img[::-1])
+
+    padded = T.Pad((1, 2, 3, 4))(img)      # l, t, r, b
+    assert padded.shape == (12 + 2 + 4, 10 + 1 + 3, 3)
+    np.testing.assert_array_equal(padded[2:14, 1:11], img)
+
+    g = T.Grayscale(1)(img)
+    assert g.shape == (12, 10, 1)
+    w = np.array([0.299, 0.587, 0.114])
+    np.testing.assert_allclose(
+        g[..., 0].astype(float), (img.astype(float) @ w).clip(0, 255),
+        atol=1.0)
+    g3 = T.Grayscale(3)(img)
+    assert g3.shape == img.shape
+    np.testing.assert_array_equal(g3[..., 0], g3[..., 2])
+
+    np.random.seed(3)
+    b = T.BrightnessTransform(0.0)(img)    # zero jitter = identity
+    np.testing.assert_array_equal(b, img)
+
+    # hue shift preserves value channel (max of rgb) up to rounding
+    h = T.HueTransform(0.5)(img)
+    np.testing.assert_allclose(h.max(-1).astype(int),
+                               img.max(-1).astype(int), atol=2)
+
+    np.random.seed(5)
+    r = T.RandomRotation(0)(img)           # zero angle = identity
+    np.testing.assert_array_equal(r, img)
+
+    rrc = T.RandomResizedCrop(6)(img)
+    assert np.asarray(rrc).shape[:2] == (6, 6)
+
+    # CHW layout flows through the same ops
+    chw = np.transpose(img, (2, 0, 1))
+    assert T.Pad(1)(chw).shape == (3, 14, 12)
+    assert T.Grayscale(1)(chw).shape == (1, 12, 10)
+    import pytest
+    with pytest.raises(ValueError):
+        T.HueTransform(0.7)
+    with pytest.raises(ValueError):
+        T.Pad(1, padding_mode="bogus")
+
+
+def test_transforms_review_regressions():
+    from paddle_tpu.vision import transforms as T
+    import pytest
+    img = (np.random.RandomState(1).rand(10, 10, 3) * 255).astype("uint8")
+    # uint8 survives RandomResizedCrop (ToTensor's /255 stays correct)
+    assert T.RandomResizedCrop(6)(img).dtype == np.uint8
+    # contrast pivots on the luma mean
+    blue = np.zeros((4, 4, 3), np.uint8)
+    blue[..., 2] = 200
+    np.random.seed(0)
+    t = T.ContrastTransform(0.0)
+    t._factor = lambda: 0.0           # pure pivot
+    out = t(blue)
+    luma = 0.114 * 200
+    assert abs(float(out[0, 0, 0]) - luma) <= 1.0
+    with pytest.raises(ValueError):
+        T.Pad((1, 2, 3))

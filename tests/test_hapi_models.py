@@ -193,3 +193,63 @@ def test_bert_tiny_trains_via_model():
     for _ in range(10):
         l = float(step((ids, None, None, labels)))
     assert l < l0
+
+
+def test_reduce_lr_on_plateau_callback():
+    """callbacks.ReduceLROnPlateau parity: lr shrinks by factor after
+    `patience` stagnant evals, respects cooldown and min_lr."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+    import pytest
+
+    with pytest.raises(ValueError):
+        ReduceLROnPlateau(factor=1.5)
+
+    class FakeOpt:
+        def __init__(self):
+            self.lr = 0.1
+
+        @property
+        def _learning_rate(self):
+            return self.lr
+
+        def get_lr(self):
+            return self.lr
+
+        def set_lr(self, v):
+            self.lr = v
+
+    class FakeModel:
+        pass
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           cooldown=1, min_lr=0.02, verbose=0)
+    m = FakeModel()
+    m._optimizer = FakeOpt()
+    cb.model = m
+    cb.on_train_begin()
+    cb.on_eval_end({"eval_loss": 1.0})      # best
+    cb.on_eval_end({"eval_loss": 1.0})      # wait 1
+    assert m._optimizer.lr == 0.1
+    cb.on_eval_end({"eval_loss": 1.0})      # wait 2 -> reduce
+    assert abs(m._optimizer.lr - 0.05) < 1e-9
+    cb.on_eval_end({"eval_loss": 1.0})      # cooldown tick
+    cb.on_eval_end({"eval_loss": 1.0})      # wait 1
+    cb.on_eval_end({"eval_loss": 1.0})      # wait 2 -> reduce, clamped
+    assert abs(m._optimizer.lr - 0.025) < 1e-9
+    cb.on_eval_end({"eval_loss": 1.0})
+    cb.on_eval_end({"eval_loss": 1.0})
+    cb.on_eval_end({"eval_loss": 1.0})
+    assert m._optimizer.lr >= 0.02          # min_lr floor
+
+    # improvement resets the wait
+    cb2 = ReduceLROnPlateau(monitor="acc", mode="auto", factor=0.5,
+                            patience=2, verbose=0)
+    m2 = FakeModel(); m2._optimizer = FakeOpt()
+    cb2.model = m2
+    cb2.on_train_begin()
+    cb2.on_eval_end({"eval_acc": 0.5})
+    cb2.on_eval_end({"eval_acc": 0.6})      # improving (max mode)
+    cb2.on_eval_end({"eval_acc": 0.7})
+    assert m2._optimizer.lr == 0.1
