@@ -168,6 +168,9 @@ try:
 except ImportError:  # pragma: no cover — partial builds degrade softly
     callbacks = None
 
+from . import reader  # noqa: F401,E402  (legacy reader combinators)
+from . import dataset  # noqa: F401,E402  (legacy reader-creator API)
+
 
 # -- fluid-era aliases (python/paddle/__init__.py DEFINE_ALIAS block) ---------
 

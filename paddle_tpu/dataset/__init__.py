@@ -1,0 +1,17 @@
+"""paddle.dataset parity: the legacy reader-creator API.
+
+Reference: python/paddle/dataset/ — per-corpus modules exposing
+``train()``/``test()`` reader creators (zero-arg callables yielding
+sample tuples).  Each delegates to the 2.0 dataset classes
+(vision/datasets, text/datasets), which parse the reference record
+formats from local files and fall back to deterministic synthetic data
+(zero-egress container policy); ``fetch()`` is therefore a no-op hook.
+"""
+from . import (  # noqa: F401
+    mnist, cifar, imdb, imikolov, movielens, uci_housing, wmt14, wmt16,
+    conll05, flowers, voc2012, common,
+)
+
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "movielens",
+           "uci_housing", "wmt14", "wmt16", "conll05", "flowers",
+           "voc2012", "common"]

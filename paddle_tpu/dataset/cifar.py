@@ -1,0 +1,42 @@
+"""dataset/cifar.py parity: train10/test10/train100/test100 readers
+yielding (image[3072] f32 in [0,1]-ish, label int)."""
+from .common import _reader_from
+
+__all__ = ["train10", "test10", "train100", "test100", "fetch"]
+
+
+def _ds(cls, mode):
+    base = cls(mode=mode)
+
+    class Flat:
+        def __len__(self):
+            return len(base)
+
+        def __getitem__(self, i):
+            img, label = base[i]
+            return img.reshape(-1).astype("float32"), int(label)
+    return Flat()
+
+
+def train10():
+    from ..vision.datasets import Cifar10
+    return _reader_from(_ds(Cifar10, "train"))
+
+
+def test10():
+    from ..vision.datasets import Cifar10
+    return _reader_from(_ds(Cifar10, "test"))
+
+
+def train100():
+    from ..vision.datasets import Cifar100
+    return _reader_from(_ds(Cifar100, "train"))
+
+
+def test100():
+    from ..vision.datasets import Cifar100
+    return _reader_from(_ds(Cifar100, "test"))
+
+
+def fetch():
+    """No-op (zero-egress)."""

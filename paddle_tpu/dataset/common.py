@@ -1,0 +1,37 @@
+"""dataset/common.py parity: the shared cache-home + md5/download hooks.
+
+Zero-egress container: ``download`` refuses (datasets read local files or
+synthesize); DATA_HOME matches the vision/text loaders' cache root.
+"""
+import hashlib
+import os
+
+DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME",
+                           os.path.expanduser("~/.cache/paddle_tpu/datasets"))
+
+__all__ = ["DATA_HOME", "md5file", "download"]
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    raise RuntimeError(
+        "paddle_tpu datasets never download implicitly (zero-egress "
+        f"container); place the file for {module_name!r} under DATA_HOME "
+        f"({DATA_HOME}) or pass an explicit data_file path to the 2.0 "
+        "dataset class")
+
+
+def _reader_from(dataset):
+    """Adapt a 2.0 map-style Dataset to a legacy reader creator."""
+    def reader():
+        for i in range(len(dataset)):
+            item = dataset[i]
+            yield tuple(item) if isinstance(item, (tuple, list)) else (item,)
+    return reader

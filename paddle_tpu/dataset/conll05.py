@@ -1,0 +1,28 @@
+"""dataset/conll05.py parity: the SRL test reader + dict accessors."""
+__all__ = ["get_dict", "test", "fetch"]
+
+_CACHE = {}
+
+
+def _ds():
+    if "ds" not in _CACHE:
+        from ..text.datasets import Conll05st
+        _CACHE["ds"] = Conll05st()
+    return _CACHE["ds"]
+
+
+def get_dict():
+    return _ds().get_dict()
+
+
+def test():
+    ds = _ds()
+
+    def reader():
+        for i in range(len(ds)):
+            yield tuple(ds[i])
+    return reader
+
+
+def fetch():
+    """No-op (zero-egress)."""

@@ -1,0 +1,32 @@
+"""dataset/flowers.py parity: train/valid/test image readers with the
+reference's mapper/xmap plumbing."""
+from .common import _reader_from
+
+__all__ = ["train", "valid", "test", "fetch"]
+
+
+def _reader(mode, mapper, buffered_size, use_xmap):
+    from ..vision.datasets import Flowers
+    base = _reader_from(Flowers(mode=mode))
+    if mapper is None:
+        return base
+    from ..reader import xmap_readers, map_readers
+    if use_xmap:
+        return xmap_readers(mapper, base, 4, buffered_size, order=True)
+    return map_readers(lambda sample: mapper(sample), base)
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("train", mapper, buffered_size, use_xmap)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("valid", mapper, buffered_size, use_xmap)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("test", mapper, buffered_size, use_xmap)
+
+
+def fetch():
+    """No-op (zero-egress)."""

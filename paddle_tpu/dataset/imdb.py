@@ -1,0 +1,49 @@
+"""dataset/imdb.py parity: word_dict() builds the vocabulary;
+train(word_idx)/test(word_idx) yield (doc ids, label) ENCODED WITH THE
+SUPPLIED DICT (the reference encodes raw text against word_idx; here the
+2.0 dataset's internal encoding is re-mapped through it)."""
+__all__ = ["train", "test", "word_dict", "fetch"]
+
+_CACHE = {}
+
+
+def _ds(mode, data_file=None, cutoff=150):
+    key = (mode, data_file, cutoff)
+    if key not in _CACHE:
+        from ..text.datasets import Imdb
+        _CACHE[key] = Imdb(data_file=data_file, mode=mode, cutoff=cutoff)
+    return _CACHE[key]
+
+
+def word_dict(data_file=None, cutoff=150):
+    return _ds("train", data_file, cutoff).word_idx
+
+
+def _reader(mode, word_idx, data_file, cutoff):
+    ds = _ds(mode, data_file, cutoff)
+
+    def encode(doc):
+        if word_idx is None or word_idx == ds.word_idx:
+            return list(doc)
+        # re-map the dataset's internal ids through the caller's dict
+        inv = {i: w for w, i in ds.word_idx.items()}
+        unk = word_idx.get("<unk>", len(word_idx) - 1)
+        return [word_idx.get(inv.get(int(i), "<unk>"), unk) for i in doc]
+
+    def reader():
+        for i in range(len(ds)):
+            doc, label = ds[i]
+            yield encode(doc), int(label[0])
+    return reader
+
+
+def train(word_idx=None, data_file=None, cutoff=150):
+    return _reader("train", word_idx, data_file, cutoff)
+
+
+def test(word_idx=None, data_file=None, cutoff=150):
+    return _reader("test", word_idx, data_file, cutoff)
+
+
+def fetch():
+    """No-op (zero-egress)."""

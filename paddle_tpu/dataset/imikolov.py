@@ -1,0 +1,51 @@
+"""dataset/imikolov.py parity: build_dict(min_word_freq) + train/test
+(word_idx, n) N-gram readers; a supplied word_idx re-encodes the ids."""
+__all__ = ["build_dict", "train", "test", "fetch"]
+
+_CACHE = {}
+
+
+def _ds(mode, n, data_type="NGRAM", min_word_freq=1):
+    key = (mode, n, data_type, min_word_freq)
+    if key not in _CACHE:
+        from ..text.datasets import Imikolov
+        _CACHE[key] = Imikolov(data_type=data_type, window_size=n,
+                               mode=mode, min_word_freq=min_word_freq)
+    return _CACHE[key]
+
+
+def build_dict(min_word_freq=50):
+    return _ds("train", 2, min_word_freq=min_word_freq).word_idx
+
+
+def _reader(mode, word_idx, n, data_type):
+    ds = _ds(mode, n, data_type)
+
+    def encode(ids):
+        if word_idx is None or word_idx == ds.word_idx:
+            return tuple(ids)
+        inv = {i: w for w, i in ds.word_idx.items()}
+        unk = word_idx.get("<unk>", len(word_idx) - 1)
+        return tuple(word_idx.get(inv.get(int(i), "<unk>"), unk)
+                     for i in ids)
+
+    def reader():
+        for i in range(len(ds)):
+            item = ds[i]
+            if data_type == "NGRAM":
+                yield encode(item)
+            else:
+                yield tuple(encode(part) for part in item)
+    return reader
+
+
+def train(word_idx=None, n=2, data_type="NGRAM"):
+    return _reader("train", word_idx, n, data_type)
+
+
+def test(word_idx=None, n=2, data_type="NGRAM"):
+    return _reader("test", word_idx, n, data_type)
+
+
+def fetch():
+    """No-op (zero-egress)."""

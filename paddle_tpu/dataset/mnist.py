@@ -1,0 +1,31 @@
+"""dataset/mnist.py parity: train()/test() yield (image[784] f32 in
+[-1,1], label int) — the reference's flattened record contract."""
+from .common import _reader_from
+
+__all__ = ["train", "test", "fetch"]
+
+
+def _ds(mode):
+    from ..vision.datasets import MNIST
+    base = MNIST(mode=mode)
+
+    class Flat:
+        def __len__(self):
+            return len(base)
+
+        def __getitem__(self, i):
+            img, label = base[i]
+            return img.reshape(-1).astype("float32"), int(label)
+    return Flat()
+
+
+def train():
+    return _reader_from(_ds("train"))
+
+
+def test():
+    return _reader_from(_ds("test"))
+
+
+def fetch():
+    """No-op (zero-egress; see common.download)."""

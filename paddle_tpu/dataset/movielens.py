@@ -1,0 +1,57 @@
+"""dataset/movielens.py parity: train/test record readers + metadata
+accessors (max ids, categories/title dicts)."""
+__all__ = ["train", "test", "get_movie_title_dict", "movie_categories",
+           "max_movie_id", "max_user_id", "max_job_id", "age_table",
+           "fetch"]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_CACHE = {}
+
+
+def _ds(mode):
+    if mode not in _CACHE:
+        from ..text.datasets import Movielens
+        _CACHE[mode] = Movielens(mode=mode)
+    return _CACHE[mode]
+
+
+def _reader(mode):
+    ds = _ds(mode)
+
+    def reader():
+        for i in range(len(ds)):
+            yield tuple(ds[i])
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")
+
+
+def get_movie_title_dict():
+    return _ds("train").movie_title_dict
+
+
+def movie_categories():
+    return _ds("train").categories_dict
+
+
+def max_movie_id():
+    return max(_ds("train").movie_info)
+
+
+def max_user_id():
+    return max(_ds("train").user_info)
+
+
+def max_job_id():
+    return max(u.job_id for u in _ds("train").user_info.values())
+
+
+def fetch():
+    """No-op (zero-egress)."""

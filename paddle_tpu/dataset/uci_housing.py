@@ -1,0 +1,19 @@
+"""dataset/uci_housing.py parity: train()/test() yield
+(features[13] f32, target[1] f32)."""
+from .common import _reader_from
+
+__all__ = ["train", "test", "fetch"]
+
+
+def train(data_file=None):
+    from ..text.datasets import UCIHousing
+    return _reader_from(UCIHousing(data_file=data_file, mode="train"))
+
+
+def test(data_file=None):
+    from ..text.datasets import UCIHousing
+    return _reader_from(UCIHousing(data_file=data_file, mode="test"))
+
+
+def fetch():
+    """No-op (zero-egress)."""

@@ -1,0 +1,25 @@
+"""dataset/voc2012.py parity: segmentation (image, mask) readers."""
+from .common import _reader_from
+
+__all__ = ["train", "val", "test", "fetch"]
+
+
+def _reader(mode):
+    from ..vision.datasets import VOC2012
+    return _reader_from(VOC2012(mode=mode))
+
+
+def train():
+    return _reader("train")
+
+
+def val():
+    return _reader("valid")
+
+
+def test():
+    return _reader("test")
+
+
+def fetch():
+    """No-op (zero-egress)."""

@@ -1,0 +1,26 @@
+"""dataset/wmt14.py parity: train/test readers of
+(src_ids, trg_ids, trg_ids_next)."""
+__all__ = ["train", "test", "fetch"]
+
+
+def _reader(mode, dict_size):
+    from ..text.datasets import WMT14
+    ds = WMT14(mode=mode, dict_size=dict_size)
+
+    def reader():
+        for i in range(len(ds)):
+            s, t, tn = ds[i]
+            yield list(s), list(t), list(tn)
+    return reader
+
+
+def train(dict_size=30000):
+    return _reader("train", dict_size)
+
+
+def test(dict_size=30000):
+    return _reader("test", dict_size)
+
+
+def fetch():
+    """No-op (zero-egress)."""

@@ -1,0 +1,27 @@
+"""dataset/wmt16.py parity: train/test readers of
+(src_ids, trg_ids, trg_ids_next)."""
+__all__ = ["train", "test", "fetch"]
+
+
+def _reader(mode, dict_size):
+    from ..text.datasets import WMT16
+    ds = WMT16(mode=mode, src_dict_size=dict_size,
+               trg_dict_size=dict_size)
+
+    def reader():
+        for i in range(len(ds)):
+            s, t, tn = ds[i]
+            yield list(s), list(t), list(tn)
+    return reader
+
+
+def train(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
+    return _reader("train", src_dict_size)
+
+
+def test(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
+    return _reader("test", src_dict_size)
+
+
+def fetch():
+    """No-op (zero-egress)."""

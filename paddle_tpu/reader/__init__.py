@@ -1,0 +1,246 @@
+"""paddle.reader parity: reader-creator combinators.
+
+Reference: python/paddle/reader/decorator.py — a *reader creator* is a
+zero-arg callable returning an iterable of samples; these combinators
+compose them.  Pure host-side Python (identical role here); the
+process-pool variants (xmap_readers, multiprocess_reader) use threads —
+the heavy-parallel seat in this framework is io.DataLoader's worker
+processes + shm ring, so the combinators stay simple and deadlock-free.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as queue_mod
+import random as random_mod
+import threading
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """decorator.py:51 — materialize once, replay from memory."""
+    all_data = tuple(reader())
+
+    def __impl__():
+        for item in all_data:
+            yield item
+
+    return __impl__
+
+
+def map_readers(func, *readers):
+    """decorator.py:91 — zip readers, map func over the sample tuples."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """decorator.py:133 — buffered shuffle window."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random_mod.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random_mod.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    """decorator.py:182 — concatenate readers in order."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for e in itertools.chain(*rs):
+            yield e
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """decorator.py:247 — sample-wise tuple composition
+    ((a,), (b, c)) → (a, b, c); check_alignment raises on ragged ends."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum(list(map(make_tuple, outputs)), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """decorator.py:307 — a producer thread keeps ``size`` samples ahead.
+    Producer exceptions RE-RAISE in the consumer (a swallowed error would
+    read as a clean, truncated dataset)."""
+
+    end = object()
+
+    def read_worker(r, q):
+        try:
+            for d in r:
+                q.put((None, d))
+        except BaseException as e:   # noqa: BLE001 — re-raised by consumer
+            q.put((e, None))
+        else:
+            q.put((None, end))
+
+    def data_reader():
+        r = reader()
+        q = queue_mod.Queue(maxsize=size)
+        t = threading.Thread(target=read_worker, args=(r, q), daemon=True)
+        t.start()
+        while True:
+            err, e = q.get()
+            if err is not None:
+                raise err
+            if e is end:
+                return
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """decorator.py:366 — first n samples."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """decorator.py:411 — map ``mapper`` over the reader with
+    ``process_num`` worker THREADS and a ``buffer_size`` queue.  The
+    reference uses threads here too; ``order=True`` preserves sample
+    order."""
+
+    end = object()
+
+    def ordered_reader():
+        # order=True degenerates to a buffered sequential map: a thread
+        # pool reordering via sequence numbers buys nothing for the
+        # GIL-bound mappers this API serves
+        def r():
+            for sample in reader():
+                yield mapper(sample)
+        return buffered(r, buffer_size)()
+
+    def data_reader():
+        if order:
+            yield from ordered_reader()
+            return
+        in_q = queue_mod.Queue(buffer_size)
+        out_q = queue_mod.Queue(buffer_size)
+
+        def feed():
+            try:
+                for s in reader():
+                    in_q.put(s)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def work():
+            # NB: end marker posts from finally — a `return` inside try
+            # would skip an `else` clause and strand the consumer
+            err = None
+            try:
+                while True:
+                    s = in_q.get()
+                    if s is end:
+                        break
+                    out_q.put((None, mapper(s)))
+            except BaseException as e:   # noqa: BLE001 — re-raised below
+                err = e
+            finally:
+                out_q.put((err, end))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        finished = 0
+        while finished < process_num:
+            err, s = out_q.get()
+            if err is not None:
+                raise err
+            if s is end:
+                finished += 1
+                continue
+            yield s
+
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """decorator.py:504 — interleave several readers concurrently.  One
+    thread per reader feeding a shared queue (the pickle-free bulk
+    transport seat belongs to io.DataLoader's shm ring; this combinator
+    keeps the reference's interleaving contract)."""
+    if len(readers) < 1:
+        raise ValueError("multiprocess_reader must own at least one reader")
+
+    end = object()
+
+    def data_reader():
+        q = queue_mod.Queue(queue_size)
+
+        def work(r):
+            try:
+                for s in r():
+                    q.put((None, s))
+            except BaseException as e:   # noqa: BLE001 — re-raised below
+                q.put((e, None))
+            else:
+                q.put((None, end))
+
+        for r in readers:
+            threading.Thread(target=work, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            err, s = q.get()
+            if err is not None:
+                raise err
+            if s is end:
+                finished += 1
+                continue
+            yield s
+
+    return data_reader

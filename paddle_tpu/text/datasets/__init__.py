@@ -148,7 +148,7 @@ class Imikolov(Dataset):
 
     def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
                  mode="train", min_word_freq=50, download=True,
-                 synthetic_size=64):
+                 synthetic_size=128):
         assert data_type.upper() in ("NGRAM", "SEQ"), data_type
         assert mode.lower() in ("train", "test"), mode
         self.data_type = data_type.upper()
@@ -162,13 +162,15 @@ class Imikolov(Dataset):
             lines = train_lines if self.mode == "train" else test_lines
         else:
             rng = np.random.RandomState(0 if self.mode == "train" else 1)
-            vocab = [f"w{i}" for i in range(64)]
+            # vocab/line counts sized so typical words clear the DEFAULT
+            # min_word_freq=50 (128 lines x ~8 words / 16 vocab ≈ 64
+            # appearances) — the filtered dict stays usable out of the box
+            vocab = [f"w{i}" for i in range(16)]
             lines = [" ".join(rng.choice(vocab, rng.randint(4, 12)))
                      for _ in range(synthetic_size)]
-            self.word_idx = {w: i for i, w in enumerate(vocab)}
-            self.word_idx["<s>"] = len(self.word_idx)
-            self.word_idx["<e>"] = len(self.word_idx)
-            self.word_idx["<unk>"] = len(self.word_idx)
+            # same frequency-filtered dict build as the real-file path, so
+            # min_word_freq is honored either way
+            self.word_idx = self._build_dict(lines, min_word_freq)
         self._load(lines)
 
     @staticmethod
@@ -208,8 +210,9 @@ class Imikolov(Dataset):
             else:
                 toks = l.strip().split()
                 ids = [self.word_idx.get(w, unk) for w in toks]
-                src = [self.word_idx["<s>"]] + ids
-                trg = ids + [self.word_idx["<e>"]]
+                unk2 = self.word_idx["<unk>"]
+                src = [self.word_idx.get("<s>", unk2)] + ids
+                trg = ids + [self.word_idx.get("<e>", unk2)]
                 if self.window_size > 0 and len(src) > self.window_size:
                     continue
                 self.data.append((src, trg))

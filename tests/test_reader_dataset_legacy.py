@@ -1,0 +1,148 @@
+"""Legacy paddle.reader combinators + paddle.dataset reader-creator API
+(python/paddle/reader/decorator.py, python/paddle/dataset/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader as R
+
+
+def _counter(n):
+    def r():
+        for i in range(n):
+            yield i
+    return r
+
+
+def test_reader_combinators():
+    assert list(R.cache(_counter(4))()) == [0, 1, 2, 3]
+    assert list(R.firstn(_counter(10), 3)()) == [0, 1, 2]
+    assert list(R.chain(_counter(2), _counter(2))()) == [0, 1, 0, 1]
+    assert list(R.map_readers(lambda a, b: a + b, _counter(3),
+                              _counter(3))()) == [0, 2, 4]
+    got = sorted(R.shuffle(_counter(10), 4)())
+    assert got == list(range(10))
+    assert list(R.buffered(_counter(5), 2)()) == [0, 1, 2, 3, 4]
+
+    # compose: tuple flattening + alignment check
+    def pairs():
+        for i in range(3):
+            yield (i, i * 10)
+    assert list(R.compose(_counter(3), pairs)()) == [
+        (0, 0, 0), (1, 1, 10), (2, 2, 20)]
+    with pytest.raises(R.ComposeNotAligned):
+        list(R.compose(_counter(2), _counter(3))())
+    # unaligned tolerated when check_alignment=False (zip semantics)
+    assert len(list(R.compose(_counter(2), _counter(3),
+                              check_alignment=False)())) == 2
+
+    # xmap: unordered covers all samples; ordered preserves order
+    got = sorted(R.xmap_readers(lambda x: x * 2, _counter(20), 3, 4)())
+    assert got == [2 * i for i in range(20)]
+    assert list(R.xmap_readers(lambda x: x + 1, _counter(6), 2, 3,
+                               order=True)()) == [1, 2, 3, 4, 5, 6]
+
+    got = sorted(R.multiprocess_reader([_counter(5), _counter(5)])())
+    assert got == sorted(list(range(5)) * 2)
+    with pytest.raises(ValueError):
+        R.multiprocess_reader([])
+
+
+def test_legacy_dataset_readers():
+    # mnist: flattened 784 float + int label
+    img, label = next(paddle.dataset.mnist.train()())
+    assert img.shape == (784,) and isinstance(label, int)
+    # cifar
+    img, label = next(paddle.dataset.cifar.train10()())
+    assert img.shape == (3072,)
+    img, _ = next(paddle.dataset.cifar.test100()())
+    assert img.shape == (3072,)
+    # uci_housing
+    x, y = next(paddle.dataset.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    # imdb: ids + label, dict available
+    wd = paddle.dataset.imdb.word_dict()
+    assert "<unk>" in wd
+    doc, label = next(paddle.dataset.imdb.train(wd)())
+    assert isinstance(doc, list) and label in (0, 1)
+    # imikolov n-grams
+    gram = next(paddle.dataset.imikolov.train(None, 3)())
+    assert len(gram) == 3
+    # movielens record + metadata
+    rec = next(paddle.dataset.movielens.train()())
+    assert len(rec) == 8
+    assert paddle.dataset.movielens.max_user_id() >= 1
+    assert paddle.dataset.movielens.age_table[0] == 1
+    # wmt: triple of id lists
+    s, t, tn = next(paddle.dataset.wmt14.train(50)())
+    assert s[0] == 0 and t[0] == 0 and tn[-1] == 1
+    s, t, tn = next(paddle.dataset.wmt16.train(50)())
+    assert s[0] == 0
+    # conll05: 9-slot record + dicts
+    rec = next(paddle.dataset.conll05.test()())
+    assert len(rec) == 9
+    wd, vd, ld = paddle.dataset.conll05.get_dict()
+    assert len(wd) and len(vd) and len(ld)
+    # flowers/voc
+    img, label = next(paddle.dataset.flowers.train()())
+    assert np.asarray(img).ndim == 3
+    img, mask = next(paddle.dataset.voc2012.train()())
+    assert np.asarray(mask).ndim == 2
+    # zero-egress download refusal
+    with pytest.raises(RuntimeError, match="zero-egress"):
+        paddle.dataset.common.download("http://x", "mnist", "00")
+
+
+def test_legacy_reader_feeds_training():
+    """The legacy path end to end: reader combinators -> paddle.batch ->
+    a train loop (the fluid-era idiom)."""
+    train_reader = paddle.batch(
+        R.shuffle(paddle.dataset.uci_housing.train(), 32), batch_size=16)
+    net = paddle.nn.Linear(13, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    lossfn = paddle.nn.MSELoss()
+    losses = []
+    for _ in range(3):
+        for batch in train_reader():
+            x = paddle.to_tensor(np.stack([b[0] for b in batch]))
+            y = paddle.to_tensor(np.stack([b[1] for b in batch]))
+            loss = lossfn(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_reader_errors_propagate_and_dicts_honored():
+    """Review regressions: producer/mapper exceptions re-raise instead of
+    truncating; imdb/imikolov honor the supplied word dict; flowers
+    applies its mapper."""
+    def boom():
+        yield 1
+        raise IOError("disk gone")
+
+    with pytest.raises(IOError):
+        list(R.buffered(boom, 2)())
+    with pytest.raises(ZeroDivisionError):
+        list(R.xmap_readers(lambda x: 1 // 0, _counter(4), 2, 2)())
+    with pytest.raises(IOError):
+        list(R.multiprocess_reader([boom])())
+
+    # a custom dict re-encodes imdb ids
+    wd = paddle.dataset.imdb.word_dict()
+    custom = {w: i + 100 for i, w in enumerate(list(wd)[:5])}
+    custom["<unk>"] = 999
+    doc, _ = next(paddle.dataset.imdb.train(custom)())
+    assert all(d >= 100 for d in doc)
+    # imikolov build_dict honors min_word_freq (high cutoff shrinks it)
+    small = paddle.dataset.imikolov.build_dict(min_word_freq=10**9)
+    assert set(small) == {"<unk>"}
+    # flowers mapper applies
+    out = next(paddle.dataset.flowers.train(
+        mapper=lambda s: ("mapped", s[1]), use_xmap=False)())
+    assert out[0] == "mapped"
+    out = next(paddle.dataset.flowers.train(
+        mapper=lambda s: ("xmapped", s[1]), buffered_size=4)())
+    assert out[0] == "xmapped"
