@@ -45,7 +45,8 @@ _SUBPACKAGES = ["nn", "optimizer", "static", "io", "metric", "amp", "jit",
                 "distributed", "vision", "text", "autograd", "hapi",
                 "incubate", "inference", "profiler", "device",
                 "quantization", "utils", "distribution", "onnx",
-                "tensor", "regularizer", "compat", "sysconfig", "version"]
+                "tensor", "regularizer", "compat", "sysconfig", "version",
+                "fluid"]
 for _name in _SUBPACKAGES:
     try:
         globals()[_name] = _importlib.import_module(f".{_name}", __name__)

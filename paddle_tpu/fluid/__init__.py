@@ -1,0 +1,106 @@
+"""``paddle.fluid`` compatibility namespace.
+
+Reference parity: python/paddle/fluid/ — the 1.x-era API surface fluid
+user code imports (``import paddle.fluid as fluid``).  Every name aliases
+the modern seat of the same capability (static Program/Executor, the 2.0
+layers/optimizers, the dygraph guard), so fluid-era scripts run against
+the TPU engine without a rewrite.  New code should import the 2.0
+surfaces directly.
+"""
+from __future__ import annotations
+
+# -- core static-graph objects (fluid/framework.py, fluid/executor.py) -------
+from ..static import (  # noqa: F401
+    Program, Variable, program_guard, default_main_program,
+    default_startup_program, Executor, Scope, global_scope, scope_guard,
+    CompiledProgram, BuildStrategy, ExecutionStrategy,
+    save_inference_model, load_inference_model,
+)
+from ..static import data  # noqa: F401
+from ..framework import core  # noqa: F401
+
+# -- fluid.layers: the graph-building DSL (fluid/layers/) ---------------------
+from ..static import nn as layers  # noqa: F401
+
+# -- fluid.dygraph (fluid/dygraph/) -------------------------------------------
+from .. import jit as dygraph_jit  # noqa: F401
+
+
+class _DygraphNS:
+    """fluid.dygraph namespace: guard() + to_variable + the jit entries."""
+
+    @staticmethod
+    def guard(place=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            from ..framework import core as _core
+            with _core.dygraph_mode_guard():
+                yield
+        return _guard()
+
+    @staticmethod
+    def to_variable(value, name=None, zero_copy=None):
+        from .. import to_tensor
+        return to_tensor(value)
+
+    from ..jit import TranslatedLayer  # noqa: F401
+
+
+dygraph = _DygraphNS()
+
+# -- fluid.optimizer (fluid/optimizer.py: *Optimizer spellings) ---------------
+from .. import optimizer as _opt  # noqa: E402
+
+SGDOptimizer = _opt.SGD
+MomentumOptimizer = _opt.Momentum
+AdamOptimizer = _opt.Adam
+AdamaxOptimizer = _opt.Adamax
+AdagradOptimizer = _opt.Adagrad
+AdadeltaOptimizer = _opt.Adadelta
+RMSPropOptimizer = _opt.RMSProp
+LambOptimizer = _opt.Lamb
+optimizer = _opt
+
+# -- fluid.initializer / fluid.regularizer / fluid.clip -----------------------
+from ..nn import initializer  # noqa: F401
+from .. import regularizer  # noqa: F401
+from ..nn.clip import (  # noqa: F401
+    ClipGradByValue as GradientClipByValue,
+    ClipGradByNorm as GradientClipByNorm,
+    ClipGradByGlobalNorm as GradientClipByGlobalNorm,
+)
+
+# -- fluid.io (fluid/io.py) ---------------------------------------------------
+from ..static import io  # noqa: F401
+
+# -- misc fluid toplevel ------------------------------------------------------
+from ..framework import CPUPlace, CUDAPlace  # noqa: F401
+
+
+def CUDAPinnedPlace():  # noqa: N802 — fluid spelling
+    return CPUPlace()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+from ..framework.tensor import Tensor as LoDTensor  # noqa: E402,F401
+from .. import create_lod_tensor  # noqa: E402,F401
+
+
+def enable_dygraph(place=None):
+    from .. import disable_static
+    disable_static()
+
+
+def disable_dygraph():
+    from .. import enable_static
+    enable_static()
+
+
+def in_dygraph_mode():
+    from ..framework import core as _core
+    return not _core.in_static_mode()

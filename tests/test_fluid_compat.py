@@ -1,0 +1,58 @@
+"""paddle.fluid compatibility namespace: a 1.x-era script runs unchanged
+(python/paddle/fluid/ surface aliased onto the modern seats)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def test_fluid_static_training_script():
+    """The canonical fluid recipe: program_guard + layers.fc +
+    SGDOptimizer.minimize + Executor feed/fetch."""
+    paddle.enable_static()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [None, 4], "float32")
+            y = fluid.data("y", [None, 1], "float32")
+            h = fluid.layers.fc(x, 8, activation="relu")
+            pred = fluid.layers.fc(h, 1)
+            loss = paddle.nn.functional.mse_loss(pred, y)
+            fluid.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 4).astype("float32")
+        W = rng.randn(4, 1).astype("float32")
+        Y = X @ W
+        first = last = None
+        for _ in range(15):
+            out = exe.run(main, feed={"x": X, "y": Y},
+                          fetch_list=[loss])
+            last = float(np.asarray(out[0]))
+            first = last if first is None else first
+        assert last < first * 0.5, (first, last)
+    finally:
+        paddle.disable_static()
+
+
+def test_fluid_dygraph_guard_and_to_variable():
+    with fluid.dygraph.guard():
+        v = fluid.dygraph.to_variable(np.ones((2, 2), "float32"))
+        out = (v * 3).numpy()
+    np.testing.assert_allclose(out, 3 * np.ones((2, 2)))
+    assert fluid.in_dygraph_mode()
+    assert not fluid.is_compiled_with_cuda()
+
+
+def test_fluid_optimizer_and_clip_aliases():
+    m = paddle.nn.Linear(3, 1)
+    opt = fluid.AdamOptimizer(
+        learning_rate=0.01, parameters=m.parameters(),
+        grad_clip=fluid.GradientClipByGlobalNorm(1.0))
+    x = paddle.to_tensor(np.ones((4, 3), "float32"))
+    loss = m(x).sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert fluid.LoDTensor is paddle.Tensor
