@@ -50,9 +50,14 @@ def is_compiled_with_npu():
 
 def synchronize(device=None):
     """cudaDeviceSynchronize parity: drain pending async work. Note: on a
-    remote-tunneled TPU a D2H fetch is the only true fence."""
+    remote-tunneled TPU a D2H fetch is the only true fence.  The fence is
+    a profiler span (``device::synchronize``) — the Profiler uses it to
+    close record windows, and its duration is the step's outstanding
+    device time."""
     import jax.numpy as jnp
-    jnp.zeros(()).block_until_ready()
+    from ..profiler import span as _span
+    with _span("device::synchronize"):
+        jnp.zeros(()).block_until_ready()
 
 
 class cuda:

@@ -41,7 +41,14 @@ class _ShuffleExchange:
     (tag, src, blob) deliveries from peer workers (the worker→worker RPC
     leg of data_set.cc GlobalShuffle; message framing shared with
     ps/service.py).  Tags scope deliveries to one shuffle round, so an
-    early sender from the next round can never pollute this one."""
+    early sender from the next round can never pollute this one.
+
+    Hardening: the socket binds to THIS worker's interface (the
+    PADDLE_CURRENT_ENDPOINT host) rather than 0.0.0.0, and every
+    delivery must carry an HMAC-SHA256 over the blob keyed by the
+    per-round secret distributed through the fleet store rendezvous —
+    a blob is never unpickled before its MAC verifies, so a stranger on
+    the network cannot inject records (or pickles) into the shuffle."""
 
     def __init__(self):
         import socket
@@ -50,8 +57,6 @@ class _ShuffleExchange:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         local_only = bool(os.getenv("PADDLE_TPU_SHUFFLE_LOCAL"))
-        self._sock.bind(("127.0.0.1" if local_only else "0.0.0.0", 0))
-        self._sock.listen(64)
         if local_only:
             # loopback bind must advertise loopback — anything else points
             # peers at an address this socket does not listen on
@@ -63,11 +68,21 @@ class _ShuffleExchange:
             cur = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
             host = cur.rsplit(":", 1)[0] if ":" in cur else \
                 os.getenv("POD_IP", "127.0.0.1")
+        try:
+            # bind the advertised interface only — not every interface
+            self._sock.bind((host, 0))
+        except OSError:
+            # the advertised name may not resolve to a local interface
+            # (NAT / container port-maps): fall back to wildcard but keep
+            # advertising the routable name
+            self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(64)
         self.endpoint = f"{host}:{self._sock.getsockname()[1]}"
         self._cv = threading.Condition()
         self._inbox: dict = {}       # tag -> [records...]
         self._got: dict = {}         # tag -> count of deliveries
         self._want: dict = {}        # tag -> expected deliveries
+        self._keys: dict = {}        # tag -> round HMAC key (bytes)
         self._dead: "collections.deque" = __import__(
             "collections").deque(maxlen=64)   # discarded round tags
         threading.Thread(target=self._accept, daemon=True).start()
@@ -82,15 +97,33 @@ class _ShuffleExchange:
                              daemon=True).start()
 
     def _serve(self, conn):
+        import hmac as _hmac
+        import hashlib
         try:
             msg = self._recv_msg(conn)
             if msg is None:
                 return
+            with self._cv:
+                if msg.get("tag") in self._dead:
+                    # a straggler delivering for an aborted round must not
+                    # re-create the inbox discard() just cleaned
+                    self._send_msg(conn, {"ok": True, "stale": True})
+                    return
+                key = self._keys.get(msg.get("tag"))
+            if key is None:
+                # expect() always precedes endpoint publication, so a
+                # legitimate peer can never beat the key registration
+                self._send_msg(conn, {"ok": False, "err": "unknown round"})
+                return
+            want = _hmac.new(key, msg.get("blob", b""),
+                             hashlib.sha256).digest()
+            if not _hmac.compare_digest(want, msg.get("mac", b"")):
+                self._send_msg(conn, {"ok": False, "err": "bad mac"})
+                return
+            # only now is the blob trusted enough to unpickle
             records = pickle.loads(msg["blob"])
             with self._cv:
                 if msg["tag"] in self._dead:
-                    # a straggler delivering for an aborted round must not
-                    # re-create the inbox discard() just cleaned
                     self._send_msg(conn, {"ok": True, "stale": True})
                     return
                 self._inbox.setdefault(msg["tag"], []).extend(records)
@@ -100,9 +133,10 @@ class _ShuffleExchange:
         finally:
             conn.close()
 
-    def expect(self, tag, n_deliveries):
+    def expect(self, tag, n_deliveries, key):
         with self._cv:
             self._want[tag] = n_deliveries
+            self._keys[tag] = key
 
     def collect(self, tag, timeout=300.0):
         import time
@@ -119,6 +153,7 @@ class _ShuffleExchange:
             out = self._inbox.pop(tag, [])
             self._got.pop(tag, None)
             self._want.pop(tag, None)
+            self._keys.pop(tag, None)
         return out
 
     def discard(self, tag):
@@ -132,6 +167,7 @@ class _ShuffleExchange:
             self._inbox.pop(tag, None)
             self._got.pop(tag, None)
             self._want.pop(tag, None)
+            self._keys.pop(tag, None)
 
 
 _exchange_singleton: List[Optional[_ShuffleExchange]] = [None]
@@ -156,17 +192,21 @@ def _next_shuffle_round() -> int:
         return _round_counter[0]
 
 
-def _ship_bucket(endpoint, tag, src, records):
+def _ship_bucket(endpoint, tag, src, records, key):
+    import hmac as _hmac
+    import hashlib
     import socket
     from .ps.service import _send_msg, _recv_msg
     host, port = endpoint.rsplit(":", 1)
+    blob = pickle.dumps(records, protocol=pickle.HIGHEST_PROTOCOL)
+    mac = _hmac.new(key, blob, hashlib.sha256).digest()
     with socket.create_connection((host, int(port)), timeout=60) as s:
-        _send_msg(s, {"tag": tag, "src": src,
-                      "blob": pickle.dumps(
-                          records, protocol=pickle.HIGHEST_PROTOCOL)})
+        _send_msg(s, {"tag": tag, "src": src, "blob": blob, "mac": mac})
         out = _recv_msg(s)
     if out is None or not out.get("ok"):
-        raise RuntimeError(f"shuffle delivery to {endpoint} failed")
+        raise RuntimeError(
+            f"shuffle delivery to {endpoint} failed"
+            f"{': ' + out['err'] if out and 'err' in out else ''}")
 
 __all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
 
@@ -430,8 +470,17 @@ class InMemoryDataset(DatasetBase):
         tag = f"{rgen}/{gen}"
 
         srv = _shuffle_exchange()
-        srv.expect(tag, world - 1)
         try:
+            # per-round delivery key, derived at the fleet-store
+            # rendezvous: worker 0 mints it, everyone reads it through
+            # the store before publishing an endpoint — so every
+            # delivery a worker can receive is HMAC-checkable, and the
+            # store itself still carries only O(world) metadata
+            if me == 0:
+                import secrets as _secrets
+                store.set(f"{pre}/key", _secrets.token_hex(16).encode())
+            round_key = store.get(f"{pre}/key")
+            srv.expect(tag, world - 1, round_key)
             store.set(f"{pre}/ep/{me}", srv.endpoint.encode())
             store.barrier(f"{pre}/ep", world)
             eps = {d: store.get(f"{pre}/ep/{d}").decode()
@@ -443,7 +492,7 @@ class InMemoryDataset(DatasetBase):
 
             def ship(d):
                 try:
-                    _ship_bucket(eps[d], tag, me, buckets[d])
+                    _ship_bucket(eps[d], tag, me, buckets[d], round_key)
                 except Exception as e:       # surfaced after join
                     errs.append((d, e))
 

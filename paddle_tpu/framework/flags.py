@@ -114,3 +114,19 @@ define_flag("static_executor_mode", "fused",
             "'fused' compiles a whole Program into one XLA computation "
             "(idiomatic TPU); 'op_by_op' interprets per-op for debugging "
             "(executor.cc:473 hot-loop parity).")
+define_flag("enable_profiler",
+            os.environ.get("PADDLE_TPU_PROFILE", "").lower()
+            in ("1", "true", "yes", "on"),
+            "Emit host-side RecordEvent spans from the instrumented "
+            "runtime paths (static executor, @to_static dispatch, "
+            "TrainStep, device.synchronize) even outside an active "
+            "profiler.Profiler record window. Seeded by FLAGS_enable_"
+            "profiler or PADDLE_TPU_PROFILE; a Profiler's record phase "
+            "turns the spans on regardless of this flag.")
+define_flag("jit_ledger_dir",
+            os.environ.get("PADDLE_TPU_JIT_LEDGER_DIR", ""),
+            "When non-empty, recompile-ledger events (profiler.ledger) "
+            "additionally stream as JSONL via utils.monitor.LogWriter "
+            "into this directory. The in-memory event ring and the "
+            "jit_compile_count/jit_cache_hit/jit_compile_ms_total stats "
+            "are always maintained.")

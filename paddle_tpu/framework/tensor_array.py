@@ -6,9 +6,11 @@ list creation/append under traced control flow into LoDTensorArray ops
 grows dynamically; XLA programs cannot, so the TPU lowering is a FIXED
 capacity buffer + live size counter (the same static-budget pattern as the
 detection NMS ops) carried through lax.while_loop/cond as a pytree.
-Appends beyond capacity overwrite the last slot — raise the budget with
-``paddle.jit.set_tensor_array_capacity`` when a loop legitimately collects
-more.
+Appends beyond capacity set the ``ovf`` flag, which dy2static routes
+through the fetched-assert channel so the overflow RAISES host-side after
+the run (instead of silently overwriting the last slot) — raise the budget
+with ``paddle.jit.set_tensor_array_capacity`` when a loop legitimately
+collects more.
 """
 from __future__ import annotations
 
@@ -30,9 +32,14 @@ def get_tensor_array_capacity() -> int:
 class BoundedTensorArray:
     """Functional fixed-capacity list of uniformly-shaped tensors."""
 
-    def __init__(self, buffer, size):
+    def __init__(self, buffer, size, ovf=None):
         self.buffer = buffer      # [capacity, *elem_shape]
         self.size = size          # scalar int32 (possibly traced)
+        # overflow flag: set when an append lands on a full buffer; rides
+        # the pytree so loop/cond carries keep it, and dy2static routes it
+        # through the fetched-assert channel so overflow raises host-side
+        # instead of silently overwriting the last slot
+        self.ovf = jnp.asarray(False) if ovf is None else ovf
 
     @classmethod
     def empty_like_elem(cls, elem, capacity=None):
@@ -63,11 +70,13 @@ class BoundedTensorArray:
         idx = jnp.clip(self.size, 0, self.capacity - 1)
         buf = jax.lax.dynamic_update_index_in_dim(self.buffer, x, idx,
                                                   axis=0)
-        # size saturates at capacity: appends past the budget overwrite
-        # the last slot (documented), and length() stays truthful about
-        # how many elements the buffer actually holds
+        # size saturates at capacity (length() stays truthful about how
+        # many elements the buffer holds); the overflow flag records that
+        # an append exceeded the budget so it raises host-side instead of
+        # passing as a silent last-slot overwrite
+        ovf = jnp.logical_or(self.ovf, self.size >= self.capacity)
         return BoundedTensorArray(
-            buf, jnp.minimum(self.size + 1, self.capacity))
+            buf, jnp.minimum(self.size + 1, self.capacity), ovf)
 
     def __getitem__(self, i):
         if hasattr(i, "_value"):      # framework Tensor index
@@ -104,7 +113,7 @@ class EmptyListCarry:
 
 jax.tree_util.register_pytree_node(
     BoundedTensorArray,
-    lambda ta: ((ta.buffer, ta.size), None),
+    lambda ta: ((ta.buffer, ta.size, ta.ovf), None),
     lambda _, leaves: BoundedTensorArray(*leaves))
 jax.tree_util.register_pytree_node(
     EmptyListCarry, lambda s: ((), None), lambda _, leaves: EmptyListCarry())

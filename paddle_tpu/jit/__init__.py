@@ -24,6 +24,7 @@ from __future__ import annotations
 import functools
 import os
 import pickle
+import time
 from typing import Optional
 
 import jax
@@ -34,6 +35,8 @@ from ..framework.tensor import Tensor
 from ..framework import functional as F
 from ..framework import random as random_mod
 from ..framework.primitive import Primitive
+from ..profiler import ledger as _ledger
+from ..profiler import span as _span
 
 
 def _sig_of(args):
@@ -168,7 +171,9 @@ class StaticFunction:
         sig = (_sig_of(args), const_kw,
                tuple((k, _sig_of([v])) for k, v in sorted(tkw.items())))
         entry = self._cache.get(sig)
-        if entry is None:
+        fresh = entry is None
+        if fresh:
+            t0 = time.perf_counter()
             entry = self._concrete(args, kwargs)
             self._cache[sig] = entry
         prim, param_names, layer, tkw_names, t_idx, holder = entry
@@ -176,7 +181,19 @@ class StaticFunction:
         key = random_mod.default_generator.next_key()
         ins = ([args[i] for i in t_idx] + [kwargs[k] for k in tkw_names]
                + [params[n] for n in param_names] + [key])
-        out = prim(*ins)
+        site = f"jit:{getattr(self._function, '__qualname__', 'fn')}"
+        if fresh:
+            # the trace + XLA compile happen inside this first dispatch;
+            # ledger the wall time and the signature diff (the "why did
+            # this recompile" record)
+            with _span("jit::trace_compile"):
+                out = prim(*ins)
+            _ledger.record_compile(site, "jit", sig,
+                                   (time.perf_counter() - t0) * 1e3)
+        else:
+            _ledger.record_cache_hit(site)
+            with _span("jit::execute"):
+                out = prim(*ins)
         n_asserts = holder["n_asserts"]
         if n_asserts:
             import jax as _jax

@@ -177,10 +177,15 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args):
                 return tuple(unwrap(v) for v in get_args())
             return run
 
-        tb, fb = _reconcile_branch_outputs(
-            [_branch(true_fn), _branch(false_fn)], init, set_args)
-        out = _cf.cond(pred, tb, fb)
+        _converter_depth[0] += 1
+        try:
+            tb, fb = _reconcile_branch_outputs(
+                [_branch(true_fn), _branch(false_fn)], init, set_args)
+            out = _cf.cond(pred, tb, fb)
+        finally:
+            _converter_depth[0] -= 1
         out = out if isinstance(out, (tuple, list)) else (out,)
+        _check_ta_overflow(out)
         set_args(tuple(out))
         return
     if bool(unwrap(pred)):
@@ -194,8 +199,6 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args):
     condition is traced; Python while otherwise."""
     first = cond_fn()
     if _is_traced(first):
-        from ..framework.tensor_array import (BoundedTensorArray,
-                                              EmptyListCarry)
         try:
             init = _prep_list_carries(
                 tuple(unwrap(v) for v in get_args()))
@@ -213,69 +216,12 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args):
             body_fn()
             return tuple(_as_carry(v) for v in get_args())
 
-        if _builtin_any(v is None or isinstance(v, EmptyListCarry)
-                        for v in init):
-            # a carry first bound inside the body (lowered for-loop target,
-            # __pt_rv of an in-loop return, escape flags) starts as None;
-            # discover the body's output aval by probing and seed typed
-            # zeros — sound because the body writes such a carry before any
-            # read. The probe is a small fixpoint: placeholder dtypes are
-            # cycled and refined from the observed body output, since a
-            # wrong placeholder dtype makes the body's own cond branches
-            # disagree before we can see the real aval.
-            fill = {i: None for i, v in enumerate(init) if v is None}
-
-            def mk_probe():
-                return tuple(
-                    (jnp.zeros(fill[i].shape, fill[i].dtype)
-                     if fill.get(i) is not None
-                     else jnp.zeros((), dt)) if i in fill
-                    else _as_carry(v)
-                    for i, v in enumerate(init))
-
-            avals = None
-            last_err = None
-            for dt in (jnp.float32, jnp.int32, jnp.bool_):
-                for _refine in range(3):
-                    try:
-                        avals = jax.eval_shape(b, mk_probe())
-                    except Exception as e:
-                        last_err = e
-                        avals = None
-                        break
-                    stable = _builtin_all(
-                        fill[i] is not None
-                        and (fill[i].shape, fill[i].dtype)
-                        == (avals[i].shape, avals[i].dtype)
-                        for i in fill) if fill else True
-                    for i in fill:
-                        fill[i] = avals[i]
-                    if stable:
-                        break
-                if avals is not None:
-                    break
-                fill = {i: None for i in fill}
-            if avals is None:
-                raise Dy2StaticError(
-                    "could not type a loop variable that is first assigned "
-                    "inside a Tensor-dependent loop; initialize it before "
-                    f"the loop ({last_err})") from last_err
-            set_args(init)      # clear probe tracers from the frame
-
-            def _seed(v, a):
-                if v is None:
-                    return jnp.zeros(a.shape, a.dtype)
-                if isinstance(v, EmptyListCarry) and \
-                        isinstance(a, BoundedTensorArray):
-                    # the body appended to this empty list: seed the typed
-                    # empty BoundedTensorArray the probe discovered
-                    return BoundedTensorArray(
-                        jnp.zeros(a.buffer.shape, a.buffer.dtype),
-                        jnp.asarray(0, jnp.int32))
-                return v
-
-            init = tuple(_seed(v, a) for v, a in zip(init, avals))
-        out = jax.lax.while_loop(c, b, init)
+        _converter_depth[0] += 1
+        try:
+            out = _traced_while(c, b, init, set_args)
+        finally:
+            _converter_depth[0] -= 1
+        _check_ta_overflow(out)
         set_args(tuple(out))
         return
     while True:
@@ -290,6 +236,78 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args):
         if not go:
             break
         body_fn()
+
+
+def _traced_while(c, b, init, set_args):
+    """Type the carry (probing body-bound names) and run lax.while_loop —
+    the traced leg of convert_while_loop, split out so the converter can
+    scope the overflow-depth bookkeeping around every body trace (probes
+    included)."""
+    from ..framework.tensor_array import (BoundedTensorArray,
+                                          EmptyListCarry)
+    if _builtin_any(v is None or isinstance(v, EmptyListCarry)
+                    for v in init):
+        # a carry first bound inside the body (lowered for-loop target,
+        # __pt_rv of an in-loop return, escape flags) starts as None;
+        # discover the body's output aval by probing and seed typed
+        # zeros — sound because the body writes such a carry before any
+        # read. The probe is a small fixpoint: placeholder dtypes are
+        # cycled and refined from the observed body output, since a
+        # wrong placeholder dtype makes the body's own cond branches
+        # disagree before we can see the real aval.
+        fill = {i: None for i, v in enumerate(init) if v is None}
+
+        def mk_probe():
+            return tuple(
+                (jnp.zeros(fill[i].shape, fill[i].dtype)
+                 if fill.get(i) is not None
+                 else jnp.zeros((), dt)) if i in fill
+                else _as_carry(v)
+                for i, v in enumerate(init))
+
+        avals = None
+        last_err = None
+        for dt in (jnp.float32, jnp.int32, jnp.bool_):
+            for _refine in range(3):
+                try:
+                    avals = jax.eval_shape(b, mk_probe())
+                except Exception as e:
+                    last_err = e
+                    avals = None
+                    break
+                stable = _builtin_all(
+                    fill[i] is not None
+                    and (fill[i].shape, fill[i].dtype)
+                    == (avals[i].shape, avals[i].dtype)
+                    for i in fill) if fill else True
+                for i in fill:
+                    fill[i] = avals[i]
+                if stable:
+                    break
+            if avals is not None:
+                break
+            fill = {i: None for i in fill}
+        if avals is None:
+            raise Dy2StaticError(
+                "could not type a loop variable that is first assigned "
+                "inside a Tensor-dependent loop; initialize it before "
+                f"the loop ({last_err})") from last_err
+        set_args(init)      # clear probe tracers from the frame
+
+        def _seed(v, a):
+            if v is None:
+                return jnp.zeros(a.shape, a.dtype)
+            if isinstance(v, EmptyListCarry) and \
+                    isinstance(a, BoundedTensorArray):
+                # the body appended to this empty list: seed the typed
+                # empty BoundedTensorArray the probe discovered
+                return BoundedTensorArray(
+                    jnp.zeros(a.buffer.shape, a.buffer.dtype),
+                    jnp.asarray(0, jnp.int32))
+            return v
+
+        init = tuple(_seed(v, a) for v, a in zip(init, avals))
+    return jax.lax.while_loop(c, b, init)
 
 
 def convert_logical_and(x_fn, y_fn):
@@ -413,7 +431,13 @@ def convert_list_append(l, x):
     from ..framework.tensor_array import (BoundedTensorArray,
                                           EmptyListCarry)
     if isinstance(l, BoundedTensorArray):
-        return l.append(jnp.asarray(unwrap(x)))
+        out = l.append(jnp.asarray(unwrap(x)))
+        # a concrete overflow flag (straight-line appends) raises right
+        # here at trace time; a traced one is checked at the loop/cond
+        # exit (_check_ta_overflow)
+        if not isinstance(out.ovf, jax.core.Tracer) and bool(out.ovf):
+            raise Dy2StaticError(_ta_overflow_msg(out.capacity))
+        return out
     if isinstance(l, EmptyListCarry):
         xa = jnp.asarray(unwrap(x))
         return BoundedTensorArray.empty_like_elem(xa).append(xa)
@@ -503,15 +527,19 @@ def _host_callbacks_supported() -> bool:
 
 
 _assert_frames = []   # trace-local stacks of (flag, msg) collected per trace
+_frame_depths = []    # converter nesting depth at each frame's open
+_converter_depth = [0]   # live traced-converter (loop/cond) nesting
 
 
 def push_assert_frame():
     """Open a collection frame for fallback assert flags (StaticFunction
     traces its body inside one; see jit/__init__.py _concrete.pure)."""
     _assert_frames.append([])
+    _frame_depths.append(_converter_depth[0])
 
 
 def pop_assert_frame():
+    _frame_depths.pop()
     return _assert_frames.pop()
 
 
@@ -524,6 +552,34 @@ def _record_assert_flag(cond, msg) -> bool:
         return False
     _assert_frames[-1].append((jnp.all(cond), msg))
     return True
+
+
+def _ta_overflow_msg(cap):
+    return (f"list append exceeded the tensor array capacity ({cap}); "
+            f"raise it with paddle.jit.set_tensor_array_capacity")
+
+
+def _check_ta_overflow(vals):
+    """Route BoundedTensorArray capacity overflow through the fetched-
+    assert channel so it raises host-side instead of passing as a silent
+    last-slot overwrite.  A concrete flag raises at trace time; a traced
+    flag (an append inside a loop/cond body) is recorded where the carry
+    re-enters the frame's own trace level — recording at a deeper level
+    would leak an inner-trace tracer into the fetch frame, so nested
+    converters skip here and the flag rides the enclosing carry to the
+    next exit (depth bookkeeping: _converter_depth vs _frame_depths)."""
+    from ..framework.tensor_array import BoundedTensorArray
+    for v in vals:
+        u = unwrap(v)
+        if not isinstance(u, BoundedTensorArray):
+            continue
+        ovf = u.ovf
+        if isinstance(ovf, jax.core.Tracer):
+            if _assert_frames and _converter_depth[0] == _frame_depths[-1]:
+                _record_assert_flag(jnp.logical_not(ovf),
+                                    _ta_overflow_msg(u.capacity))
+        elif bool(ovf):
+            raise Dy2StaticError(_ta_overflow_msg(u.capacity))
 
 
 def convert_assert(cond, msg=None):

@@ -80,18 +80,65 @@ def rank_attention(x, rank_offset, rank_param, max_rank: int = 3,
 # pyramid_hash (industrial search)
 # ---------------------------------------------------------------------------
 
-def _mix32(vals, salt):
-    """Deterministic 32-bit mix over an int sequence (the framework's
-    hashing deviation — the reference uses XXH32, pyramid_hash_op.cc:229;
-    hash values are an implementation detail nobody checkpoints)."""
-    h = np.uint32(0x811C9DC5) ^ np.uint32((salt * 0x9E3779B9) & 0xFFFFFFFF)
-    with np.errstate(over="ignore"):
-        for v in vals:
-            h = np.uint32((int(h) ^ (int(v) & 0xFFFFFFFF) ^
-                           ((int(v) >> 32) & 0xFFFFFFFF)) & 0xFFFFFFFF)
-            h = np.uint32((int(h) * 0x85EBCA6B) & 0xFFFFFFFF)
-            h = np.uint32((int(h) >> 13) ^ int(h))
-    return int(h)
+_XXH_P1, _XXH_P2, _XXH_P3 = 2654435761, 2246822519, 3266489917
+_XXH_P4, _XXH_P5 = 668265263, 374761393
+_M32 = 0xFFFFFFFF
+
+
+def _rotl32(x, r):
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def xxh32(data: bytes, seed: int = 0) -> int:
+    """Real XXH32 (pure Python, host-side) — the exact hash
+    pyramid_hash_op.cc:229 uses, so row assignments match the reference
+    and reference-trained pyramid_hash checkpoints stay portable."""
+    n = len(data)
+    i = 0
+    if n >= 16:
+        v1 = (seed + _XXH_P1 + _XXH_P2) & _M32
+        v2 = (seed + _XXH_P2) & _M32
+        v3 = seed & _M32
+        v4 = (seed - _XXH_P1) & _M32
+        while i + 16 <= n:
+            for j, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[i + 4 * j:i + 4 * j + 4],
+                                      "little")
+                v = (_rotl32((v + lane * _XXH_P2) & _M32, 13)
+                     * _XXH_P1) & _M32
+                if j == 0:
+                    v1 = v
+                elif j == 1:
+                    v2 = v
+                elif j == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 16
+        h = (_rotl32(v1, 1) + _rotl32(v2, 7) + _rotl32(v3, 12)
+             + _rotl32(v4, 18)) & _M32
+    else:
+        h = (seed + _XXH_P5) & _M32
+    h = (h + n) & _M32
+    while i + 4 <= n:
+        lane = int.from_bytes(data[i:i + 4], "little")
+        h = (_rotl32((h + lane * _XXH_P3) & _M32, 17) * _XXH_P4) & _M32
+        i += 4
+    while i < n:
+        h = (_rotl32((h + data[i] * _XXH_P5) & _M32, 11) * _XXH_P1) & _M32
+        i += 1
+    h ^= h >> 15
+    h = (h * _XXH_P2) & _M32
+    h ^= h >> 13
+    h = (h * _XXH_P3) & _M32
+    h ^= h >> 16
+    return h
+
+
+def _term_hash(term, salt):
+    """Hash one n-gram: XXH32 over the little-endian int64 id bytes,
+    seeded per embedding chunk (pyramid_hash_op.cc hash loop parity)."""
+    return xxh32(np.asarray(term, "<i8").tobytes(), seed=salt)
 
 
 def _pyramid_gather_fn(w, idx):
@@ -150,7 +197,7 @@ def pyramid_hash(x, w, offsets=None, *, num_emb, space_len, rand_len,
                     drop_pos.append(1 if use else 0)
                     if use:
                         pos_rows.append([
-                            _mix32(term, c * rand_len) % space_len
+                            _term_hash(term, c * rand_len) % space_len
                             for c in range(chunks)])
                         kept += 1
         new_offsets.append(new_offsets[-1] + kept)

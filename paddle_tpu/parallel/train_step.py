@@ -22,6 +22,8 @@ Options map to reference strategies:
 """
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,6 +31,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..framework.tensor import Tensor
 from ..framework import functional as F
+from ..profiler import RecordEvent, ledger as _ledger
+from ..profiler import profiling_enabled as _prof_on
+from ..profiler import span as _span
 from .mesh import get_mesh, DP_AXIS
 from .api import named_shardings, batch_sharding
 
@@ -128,6 +133,7 @@ class TrainStep:
         self._state = None
         self._compiled = None
         self._donate = donate
+        self._seen_sigs = set()     # input signatures already compiled
 
         from .pipeline import PipelineModule
         self._pipe = layer if isinstance(layer, PipelineModule) else None
@@ -607,16 +613,25 @@ class TrainStep:
 
         dp = self.mesh.shape.get(DP_AXIS, 1)
         lead_ndim = inputs[0].ndim
-        if (self._localsgd_degree() > 1 or self.dgc_sparsity > 0) and \
-                inputs[0].shape[0] % max(1, dp) != 0:
-            raise ValueError(
-                f"localsgd/dgc need the batch ({inputs[0].shape[0]}) "
-                f"divisible by the dp degree ({dp}): each rank computes "
-                "over its own shard, so there is no replicate fallback")
-
         nproc = jax.process_count()
         local_dp = dp // nproc if (nproc > 1 and dp > 1 and
                                    dp % nproc == 0) else dp
+        if self._localsgd_degree() > 1 or self.dgc_sparsity > 0:
+            # each rank computes over its own shard, so there is no
+            # replicate fallback; a caller-built global array carries the
+            # GLOBAL batch while a host-fed array carries this process's
+            # local slice — validate each against the dp slots it covers
+            x0 = inputs[0]
+            is_global = isinstance(x0, jax.Array) and \
+                not x0.is_fully_addressable
+            need = dp if is_global else max(1, local_dp)
+            if x0.shape[0] % need != 0:
+                raise ValueError(
+                    f"localsgd/dgc need the "
+                    f"{'global' if is_global else 'per-process'} batch "
+                    f"({x0.shape[0]}) divisible by the "
+                    f"{'dp degree' if is_global else 'local dp slots'} "
+                    f"({need}; dp={dp} over {nproc} processes)")
 
         def put(x):
             if x is None:
@@ -663,17 +678,44 @@ class TrainStep:
                 # global dp-sharded array (the multi-host DataLoader contract
                 # — reference: each trainer reads its own file split,
                 # fleet/data_generator + dist-train doc)
-                return jax.make_array_from_process_local_data(
-                    sh, np.asarray(x))
+                with _span("train_step::collective_assemble"):
+                    return jax.make_array_from_process_local_data(
+                        sh, np.asarray(x))
             return jax.device_put(x, sh)
 
-        inputs = tuple(put(x) for x in inputs)
-        label = put(label)
+        prof = _prof_on()
+        with _span("train_step::data_feed"):
+            inputs = tuple(put(x) for x in inputs)
+            label = put(label)
         fn = self.compile()
         # host scalar (not a committed device array) so the jit treats it as
         # process-replicated under a multi-host mesh
         lr = np.float32(self.optimizer.get_lr())
-        self._state, loss = fn(self.state, inputs, label, lr)
+        # retrace detection: jax.jit silently recompiles on a new input
+        # signature — ledger it like any other cache miss
+        sig = (tuple(None if x is None
+                     else (tuple(x.shape), str(x.dtype)) for x in inputs),
+               None if label is None
+               else (tuple(label.shape), str(label.dtype)))
+        fresh = sig not in self._seen_sigs
+        site = f"train_step:{type(self.layer).__name__}:{id(self):#x}"
+        if fresh:
+            self._seen_sigs.add(sig)
+            t0 = time.perf_counter()
+            with _span("train_step::compile"):
+                self._state, loss = fn(self.state, inputs, label, lr)
+            _ledger.record_compile(site, "train_step", sig,
+                                   (time.perf_counter() - t0) * 1e3)
+        else:
+            _ledger.record_cache_hit(site)
+            if prof:
+                # fence on the loss so the span is device time, not the
+                # async dispatch
+                with RecordEvent("train_step::device_execute"):
+                    self._state, loss = fn(self.state, inputs, label, lr)
+                    jax.block_until_ready(loss)
+            else:
+                self._state, loss = fn(self.state, inputs, label, lr)
         self.optimizer._step_count += 1
         return Tensor(loss)
 

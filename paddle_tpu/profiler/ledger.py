@@ -1,0 +1,128 @@
+"""Recompile ledger — the "why did this recompile" answer.
+
+Every XLA compile the framework triggers (a @to_static input-signature
+miss, a static Executor program-cache miss, a TrainStep retrace on new
+input shapes) is recorded with its wall time, its cache key, and a
+structured diff against the previous key at the same site — the diff is
+the answer to "why did this recompile": which argument changed shape,
+which program version bumped, which feed dtype flipped.
+
+Surfaced three ways:
+  * StatRegistry gauges (monitor.h parity): ``jit_compile_count``,
+    ``jit_cache_hit``, ``jit_compile_ms_total``.
+  * an in-memory ring queryable via :func:`compile_events` (bounded, so
+    a long-serving process never grows).
+  * structured JSONL through ``utils.monitor.LogWriter`` when a ledger
+    dir is configured (:func:`set_ledger_dir`, flag ``jit_ledger_dir``,
+    env ``PADDLE_TPU_JIT_LEDGER_DIR``).
+
+Always on: compiles are rare and cache-hit accounting is one locked
+integer add, so nothing here is gated on FLAGS_enable_profiler.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ..framework import flags as _flags
+from ..utils.monitor import stat_add
+
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=512)
+_last_key: dict = {}
+_dir_override = [None]
+_writer = [None, None]          # [dir the writer was opened for, LogWriter]
+
+
+def set_ledger_dir(path: Optional[str]) -> None:
+    """Route ledger events to JSONL under ``path`` (None reverts to the
+    ``jit_ledger_dir`` flag / env)."""
+    with _lock:
+        _dir_override[0] = path
+
+
+def _get_writer():
+    """Lazily (re)open the JSONL writer for the configured dir; must be
+    called with _lock held."""
+    d = _dir_override[0]
+    if d is None:
+        d = _flags.flag("jit_ledger_dir") or None
+    if d != _writer[0]:
+        if _writer[1] is not None:
+            try:
+                _writer[1].close()
+            except Exception:
+                pass
+        from ..utils.monitor import LogWriter
+        _writer[0] = d
+        _writer[1] = LogWriter(logdir=d, filename_suffix=".ledger") \
+            if d else None
+    return _writer[1]
+
+
+def _leaves(key, path=""):
+    """Flatten a nested cache key into (path, repr) leaves so the diff
+    points at the exact entry that changed."""
+    if isinstance(key, (tuple, list)) and any(
+            isinstance(e, (tuple, list, dict)) for e in key):
+        for i, e in enumerate(key):
+            yield from _leaves(e, f"{path}[{i}]")
+        return
+    yield (path or "·", repr(key))
+
+
+def key_diff(prev, cur):
+    """Human-readable diff between two cache keys (the recompile cause)."""
+    if prev is None:
+        return ["first compile at this site"]
+    p, c = dict(_leaves(prev)), dict(_leaves(cur))
+    out = []
+    for k in sorted(set(p) | set(c)):
+        pv, cv = p.get(k, "<absent>"), c.get(k, "<absent>")
+        if pv != cv:
+            out.append(f"{k}: {pv} -> {cv}")
+    return out or ["key unchanged (cache entry evicted or fetch-union grew)"]
+
+
+def record_compile(site: str, kind: str, key, ms: float, extra=None) -> dict:
+    """Record one compile event. ``site`` identifies the compile cache
+    (e.g. ``jit:train_step.<locals>.f``); ``kind`` is jit / executor /
+    executor_aot / train_step; ``key`` the cache key; ``ms`` the wall
+    time of trace+compile (first dispatch)."""
+    with _lock:
+        prev = _last_key.get(site)
+        _last_key[site] = key
+        ev = {"site": site, "kind": kind, "ms": round(float(ms), 3),
+              "key": repr(key), "diff": key_diff(prev, key),
+              "wall": time.time()}
+        if extra:
+            ev.update(extra)
+        _ring.append(ev)
+        w = _get_writer()
+    stat_add("jit_compile_count")
+    stat_add("jit_compile_ms_total", int(round(ms)))
+    if w is not None:
+        w.add_event("jit/compile", ev)
+    return ev
+
+
+def record_cache_hit(site: str) -> None:
+    stat_add("jit_cache_hit")
+
+
+def compile_events(site: Optional[str] = None):
+    """Snapshot of recorded compile events, newest last."""
+    with _lock:
+        evs = list(_ring)
+    if site is None:
+        return evs
+    return [e for e in evs if e["site"] == site]
+
+
+def clear() -> None:
+    """Drop recorded events and per-site key memory (tests)."""
+    with _lock:
+        _ring.clear()
+        _last_key.clear()

@@ -13,6 +13,7 @@ programs (initializers) run eagerly, matching their one-shot nature.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -20,6 +21,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.tensor import Tensor
+from ..profiler import ledger as _ledger
+from ..profiler import profiling_enabled as _prof_on
+from ..profiler import span as _span
 from .program import Program, Variable, default_main_program
 
 
@@ -271,10 +275,11 @@ class Executor:
 
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in fetch_list]
-        feed_items = sorted(feed.items())
-        feed_names = [k for k, _ in feed_items]
-        feed_vals = [v._value if isinstance(v, Tensor) else jnp.asarray(v)
-                     for _, v in feed_items]
+        with _span("executor::data_feed"):
+            feed_items = sorted(feed.items())
+            feed_names = [k for k, _ in feed_items]
+            feed_vals = [v._value if isinstance(v, Tensor)
+                         else jnp.asarray(v) for _, v in feed_items]
 
         persist_names = self._persistable_names(program)
         written = [n for n in persist_names
@@ -290,7 +295,10 @@ class Executor:
                tuple((n, v.shape, str(v.dtype))
                      for n, v in zip(feed_names, feed_vals)))
         entry = self._cache.get(key) if use_program_cache else None
-        if entry is None or not set(fetch_names) <= set(entry[0]):
+        fresh = entry is None or not set(fetch_names) <= set(entry[0])
+        aot_loaded = False
+        if fresh:
+            t_compile = time.perf_counter()
             union = list(entry[0]) if entry else []
             union += [n for n in fetch_names if n not in union]
             replay = self._build_replay(program, feed_names, union,
@@ -306,9 +314,11 @@ class Executor:
                                               feed_vals, union,
                                               persist_names, pv)
                     jitted = self._aot_load(digest)
+                    aot_loaded = jitted is not None
                     if jitted is None:
-                        compiled_exe = jax.jit(replay).lower(
-                            feed_vals, pv).compile()
+                        with _span("executor::compile"):
+                            compiled_exe = jax.jit(replay).lower(
+                                feed_vals, pv).compile()
                         self._aot_save(digest, compiled_exe)
                         jitted = compiled_exe
                         from ..utils.monitor import stat_add
@@ -332,15 +342,36 @@ class Executor:
             from ..parallel.api import batch_sharding
             from ..parallel.mesh import get_mesh
             mesh = get_mesh()
-            feed_vals = [jax.device_put(v, batch_sharding(mesh, ndim=max(v.ndim, 1)))
-                         for v in feed_vals]
+            with _span("executor::data_feed"):
+                feed_vals = [jax.device_put(
+                    v, batch_sharding(mesh, ndim=max(v.ndim, 1)))
+                    for v in feed_vals]
 
-        fetches, updates = jitted(feed_vals, persist_vals)
+        site = f"executor:{program._uid}"
+        if fresh:
+            # trace + XLA compile happen inside this first dispatch (the
+            # AOT path compiled above; a deserialized executable skipped
+            # it) — ledger the wall time and the cache-key diff
+            with _span("executor::compile"):
+                fetches, updates = jitted(feed_vals, persist_vals)
+            _ledger.record_compile(
+                site, "executor_aot" if aot_loaded else "executor",
+                key + (tuple(union),),
+                (time.perf_counter() - t_compile) * 1e3)
+        else:
+            _ledger.record_cache_hit(site)
+            with _span("executor::device_execute"):
+                fetches, updates = jitted(feed_vals, persist_vals)
+                if _prof_on():
+                    # fence so the span reflects device time, not just
+                    # async dispatch
+                    jax.block_until_ready((fetches, updates))
         for n, val in zip(written, updates):
             scope.set_var(n, val)
         picked = [fetches[i] for i in fetch_pos]
         if return_numpy:
-            return [np.asarray(f) for f in picked]
+            with _span("executor::fetch"):
+                return [np.asarray(f) for f in picked]
         return [Tensor(f) for f in picked]
 
     # -- dataset-driven training (Trainer/DeviceWorker runtime) -------------
@@ -469,6 +500,8 @@ class Executor:
         def upload(chunk):
             """Pad to a stable bucket, ship to device (async H2D)."""
             from ..distributed.ps.device_cache import pad_adaptive
+            sp = _span("executor::dataset_upload")
+            sp.begin()
             n = len(chunk[feed_names[0]])
             # tail buckets never exceed the full-chunk shape (the documented
             # device budget), and near-full tails reuse the full compile
@@ -490,6 +523,7 @@ class Executor:
                 feeds.append(jax.device_put(v))
             self._train_stats["max_chunk_bytes"] = max(
                 self._train_stats["max_chunk_bytes"], nbytes)
+            sp.end()
             return tuple(feeds), jax.device_put(mask), n
 
         persist_vals = tuple(_collect_persistables(program, scope,
@@ -504,7 +538,9 @@ class Executor:
             while pending is not None:
                 feeds, mask, n_valid = pending
                 nxt = next(chunks, None)
-                persist_vals, fetches = jitted(persist_vals, feeds, mask)
+                with _span("executor::dataset_scan"):
+                    persist_vals, fetches = jitted(persist_vals, feeds,
+                                                   mask)
                 # double buffer: ship chunk i+1 while chunk i scans
                 pending = upload(nxt) if nxt is not None else None
                 self._train_stats["chunks"] += 1

@@ -48,6 +48,17 @@ def all_stats() -> Dict[str, int]:
         return dict(_stats)
 
 
+def reset_stats(prefix: str = "") -> None:
+    """Zero the registry (tests / run boundaries); with a prefix, only
+    matching gauges are dropped."""
+    with _lock:
+        if not prefix:
+            _stats.clear()
+        else:
+            for k in [k for k in _stats if k.startswith(prefix)]:
+                del _stats[k]
+
+
 class LogWriter:
     """Minimal VisualDL LogWriter: scalars/metadata to JSONL.
 
@@ -77,6 +88,14 @@ class LogWriter:
         with self._lock:
             self._f.write(json.dumps(rec) + "\n")
 
+    def add_event(self, tag: str, event: dict, walltime: float = None):
+        """Structured (non-scalar) JSONL event — the recompile ledger and
+        other telemetry ride this channel; read back with read_events."""
+        rec = {"tag": tag, "event": event,
+               "wall": walltime if walltime is not None else time.time()}
+        with self._lock:
+            self._f.write(json.dumps(rec, default=repr) + "\n")
+
     def flush(self):
         self._f.flush()
 
@@ -99,7 +118,21 @@ class LogWriter:
             with open(os.path.join(logdir, fn)) as f:
                 for line in f:
                     rec = json.loads(line)
-                    if "tag" in rec:
+                    if "tag" in rec and "value" in rec:
                         out.setdefault(rec["tag"], []).append(
                             (rec["step"], rec["value"]))
+        return out
+
+    @staticmethod
+    def read_events(logdir: str):
+        """Load structured events (add_event) -> {tag: [event dicts]}."""
+        out = {}
+        for fn in sorted(os.listdir(logdir)):
+            if not fn.endswith(".jsonl"):
+                continue
+            with open(os.path.join(logdir, fn)) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if "tag" in rec and "event" in rec:
+                        out.setdefault(rec["tag"], []).append(rec["event"])
         return out

@@ -792,9 +792,10 @@ def test_list_capacity_budget():
         set_tensor_array_capacity(old)
 
 
-def test_list_negative_index_and_capacity_truthful():
-    """Review regressions: l[-1] counts from the live size; length()
-    saturates at capacity when appends overflow the budget."""
+def test_list_negative_index_and_capacity_overflow_raises():
+    """Review regressions: l[-1] counts from the live size; appends past
+    the capacity budget raise host-side through the fetched-assert
+    channel instead of silently overwriting the last slot."""
     def f(x, n):
         l = []
         i = 0
@@ -812,7 +813,11 @@ def test_list_negative_index_and_capacity_truthful():
     old = get_tensor_array_capacity()
     try:
         set_tensor_array_capacity(4)
-        _, ln2 = to_static(f)(x, paddle.to_tensor(7))
-        assert int(ln2.numpy()) == 4      # truthful: buffer holds 4
+        # exactly at capacity: fine
+        _, ln2 = to_static(f)(x, paddle.to_tensor(4))
+        assert int(ln2.numpy()) == 4
+        # past capacity: host-side raise, not a silent overwrite
+        with pytest.raises(AssertionError, match="tensor array capacity"):
+            to_static(f)(x, paddle.to_tensor(7))
     finally:
         set_tensor_array_capacity(old)

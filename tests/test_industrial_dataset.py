@@ -256,3 +256,49 @@ def test_global_shuffle_three_workers_peer_to_peer(tmp_path):
     # at least one worker's shard actually changed
     assert "moved" in {results[r][2] for r in range(3)}
     assert all(np.isfinite(r[3]) for r in results.values())
+
+
+def test_shuffle_exchange_hmac_rejects_unauthenticated(monkeypatch):
+    """_ShuffleExchange hardening: deliveries without the round key (or
+    with a wrong MAC) are rejected before unpickling; keyed deliveries
+    flow through."""
+    import pickle
+    import socket
+    from paddle_tpu.distributed.dataset import _ShuffleExchange
+    from paddle_tpu.distributed.ps.service import _send_msg, _recv_msg
+
+    monkeypatch.setenv("PADDLE_TPU_SHUFFLE_LOCAL", "1")
+    srv = _ShuffleExchange()
+    key = b"round-secret"
+    srv.expect("1/1", 1, key)
+    host, port = srv.endpoint.rsplit(":", 1)
+    blob = pickle.dumps([("rec",)], protocol=pickle.HIGHEST_PROTOCOL)
+
+    def deliver(tag, mac):
+        with socket.create_connection((host, int(port)), timeout=10) as s:
+            _send_msg(s, {"tag": tag, "src": 0, "blob": blob, "mac": mac})
+            return _recv_msg(s)
+
+    import hashlib
+    import hmac as hm
+    # unknown round tag -> rejected
+    out = deliver("9/9", hm.new(key, blob, hashlib.sha256).digest())
+    assert out and not out.get("ok") and out["err"] == "unknown round"
+    # wrong mac -> rejected
+    out = deliver("1/1", b"\x00" * 32)
+    assert out and not out.get("ok") and out["err"] == "bad mac"
+    # correct mac -> accepted and collectable
+    out = deliver("1/1", hm.new(key, blob, hashlib.sha256).digest())
+    assert out and out.get("ok")
+    assert srv.collect("1/1", timeout=10) == [("rec",)]
+
+
+def test_shuffle_exchange_binds_advertised_interface(monkeypatch):
+    """The exchange socket binds the PADDLE_CURRENT_ENDPOINT interface,
+    not 0.0.0.0 (ADVICE round-5 hardening)."""
+    from paddle_tpu.distributed.dataset import _ShuffleExchange
+    monkeypatch.delenv("PADDLE_TPU_SHUFFLE_LOCAL", raising=False)
+    monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6999")
+    srv = _ShuffleExchange()
+    assert srv.endpoint.startswith("127.0.0.1:")
+    assert srv._sock.getsockname()[0] == "127.0.0.1"

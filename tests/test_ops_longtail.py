@@ -527,3 +527,17 @@ def test_ledger_has_zero_absent():
     from paddle_tpu.ops.coverage import OP_LEDGER
     absent = [k for k, (cls, _) in OP_LEDGER.items() if cls == "absent"]
     assert absent == [], absent
+
+
+def test_xxh32_reference_vectors():
+    """pyramid_hash hashes n-grams with real XXH32 (pyramid_hash_op.cc:229)
+    so row assignments match the reference; spec test vectors."""
+    assert L.xxh32(b"") == 0x02CC5D05
+    assert L.xxh32(b"a") == 0x550D7456
+    assert L.xxh32(b"abc") == 0x32D153FF
+    assert L.xxh32(b"Nobody inspects the spammish repetition") == 0xE2293B2F
+    # seed changes the hash; >=16-byte input exercises the lane loop
+    assert L.xxh32(b"abc", seed=1) != L.xxh32(b"abc")
+    data = bytes(range(40))
+    assert L.xxh32(data) == L.xxh32(data)
+    assert L.xxh32(data) != L.xxh32(data, seed=7)
