@@ -157,8 +157,17 @@ def bench_lenet_static(on_tpu):
             float(np.asarray(out[loss.name]).sum())   # D2H fence
             dt = time.perf_counter() - t0
             best = dt if best is None else min(best, dt)
-        v = batch * steps / best
+        host_v = batch * steps / best
+        # in-graph primary (VERDICT r5 #8 schema change): the scanned
+        # epoch is one dispatch + one fence, so subtracting THIS run's
+        # measured dispatch floor leaves pure chip time — round deltas
+        # then measure the framework, not tunnel weather (the metric
+        # whipsawed 76k→262k→195k across rounds on weather alone)
+        floor_s = _dispatch_floor_ms(10) / 1e3
+        v = batch * steps / max(best - floor_s, best * 0.1)
         return {"value": round(v, 1), "unit": "img/s",
+                "value_source": "in_graph",
+                "host_value": round(host_v, 1),
                 "vs_baseline": round(v / NOMINAL["mnist_lenet_static"], 3)}
     finally:
         paddle.disable_static()
@@ -195,7 +204,9 @@ def bench_resnet50(on_tpu):
 
     dt = _timed(lambda: step((x,), y), iters, float)
     v = batch * iters / dt
+    from paddle_tpu.ops.pallas import fused_conv
     res = {"value": round(v, 2), "unit": "img/s",
+           "pallas_conv": fused_conv.enabled(),
            "vs_baseline": round(v / NOMINAL["resnet50_dygraph"], 3)}
     if on_tpu:
         import numpy as _np
@@ -354,11 +365,26 @@ def bench_wide_deep(on_tpu):
         loss = trainer.step_async(ids, dense, labels)
     loss = float(loss)
     dt = time.perf_counter() - t0
-    trainer.flush()
     assert np.isfinite(loss)
-    v = batch * iters / dt
-    return {"value": round(v, 1), "unit": "examples/s",
-            "vs_baseline": round(v / NOMINAL["wide_deep_ctr"], 3)}
+    host_v = batch * iters / dt
+    # in-graph primary (VERDICT r5 #2/#8): Wide&Deep was the one workload
+    # with NO in-graph control — its host loop pays the id hash + tunnel
+    # RTT every step.  The chained-K probe times the compiled sparse+dense
+    # step alone, so the primary value stops being a weather plot; the
+    # host-path number stays as the secondary field it demotes to.
+    res = {"unit": "examples/s", "host_value": round(host_v, 1)}
+    try:
+        sec = trainer.in_graph_step_s(ids, dense, labels)
+        res["value"] = round(batch / sec, 1)
+        res["value_source"] = "in_graph"
+    except Exception as e:               # noqa: BLE001 — diagnostic only
+        print(f"[bench] wide_deep in-graph probe skipped: {e}",
+              file=sys.stderr, flush=True)
+        res["value"] = round(host_v, 1)
+        res["value_source"] = "host"
+    trainer.flush()
+    res["vs_baseline"] = round(res["value"] / NOMINAL["wide_deep_ctr"], 3)
+    return res
 
 
 WORKLOADS = [

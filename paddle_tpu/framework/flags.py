@@ -101,6 +101,16 @@ define_flag("use_pallas_fused_bn", False,
             "measured SLOWER end-to-end than XLA's own epilogue fusion on "
             "the v5e bench chip (974 vs 1971 img/s ResNet-50) -- see "
             "PERF.md's round-4 roofline correction.")
+define_flag("use_pallas_fused_conv", False,
+            "Route eligible NHWC train-mode conv+BN(+ReLU) chains (and the "
+            "space-to-depth ResNet stem) through the fused Pallas conv "
+            "pipeline (ops/pallas/fused_conv.py). OFF by default under the "
+            "measured-crossover honesty rule: the default flips only with "
+            "an end-to-end ResNet-50 win recorded on the bench chip in "
+            "PERF.md round-6 (the BN-only predecessor measured 974 vs 1971 "
+            "img/s because opaque customs break XLA's conv fusion; this "
+            "kernel owns the whole chain precisely to beat that). Legacy "
+            "env PADDLE_TPU_PALLAS_CONV=1 also honored.")
 define_flag("allocator_strategy", "auto_growth",
             "allocator_strategy parity (allocator_strategy.h:21); informational "
             "on TPU -- PJRT owns HBM via BFC.")

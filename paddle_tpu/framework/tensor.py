@@ -70,7 +70,7 @@ class Tensor:
     __slots__ = ("_value", "stop_gradient", "persistable", "name", "grad",
                  "_node", "_out_index", "_retain_grads", "_hooks", "is_leaf",
                  "_bwd_done", "_version", "_consumers", "_consumers_cap",
-                 "_lod", "__weakref__")
+                 "_lod", "_conv_epilogue", "_bn_act_upgrade", "__weakref__")
 
     def __init__(self, value, stop_gradient=True, name=None, persistable=False):
         # capture LoD BEFORE coercion: jnp.asarray strips LoDArray attrs

@@ -488,6 +488,70 @@ def convert_getitem(x, i):
     return x[int(iv)]
 
 
+def _concrete_bound(v):
+    """A non-traced slice bound as the plain-python value x[a:b] expects."""
+    if v is None or isinstance(v, int):
+        return v
+    u = unwrap(v) if _is_tensorish(v) else v
+    return int(u) if hasattr(u, "shape") else u
+
+
+def convert_slice(x, lo, up, st, size=None):
+    """slice_transformer parity: ``x[lo:up]`` where a bound may be a
+    traced loop carry.  Static bounds keep exact Python semantics; traced
+    bounds lower to lax.dynamic_slice with the SYNTACTICALLY derived
+    window size (the AST pass recognizes ``x[i:i+k]`` / ``x[k+i:i]``-
+    shaped pairs) — the reference's slice_op.cc StartsTensor: runtime
+    starts, static extent."""
+    if not (_is_traced(lo) or _is_traced(up)):
+        return x[slice(_concrete_bound(lo), _concrete_bound(up),
+                       _concrete_bound(st))]
+    if st is not None and _concrete_bound(st) != 1:
+        raise Dy2StaticError(
+            "a traced-bound slice must be contiguous (step 1)")
+    if size is None or _is_traced(size):
+        raise Dy2StaticError(
+            "slice bounds derived from a traced value need a statically-"
+            "derivable window size: write x[i:i+k] (or x[i-k:i]) with a "
+            "constant k so the extent is known at trace time "
+            "(slice_op.cc StartsTensor semantics)")
+    from ..ops.manipulation import dynamic_slice
+    size = int(size)
+    if _is_tensorish(x):
+        return dynamic_slice(x, lo, size, axis=0)
+    return jax.lax.dynamic_slice_in_dim(jnp.asarray(x), unwrap(lo), size,
+                                        axis=0)
+
+
+def convert_setslice(x, lo, up, st, value, size=None):
+    """``x[lo:up] = value`` as a functional rebind (the AST pass emits
+    ``x = _jst_setslice(...)``), so a traced start lowers to
+    lax.dynamic_update_slice and the write survives inside lowered
+    control flow."""
+    if not (_is_traced(lo) or _is_traced(up)):
+        x[slice(_concrete_bound(lo), _concrete_bound(up),
+                _concrete_bound(st))] = value
+        return x
+    if st is not None and _concrete_bound(st) != 1:
+        raise Dy2StaticError(
+            "a traced-bound slice must be contiguous (step 1)")
+    if size is None or _is_traced(size):
+        raise Dy2StaticError(
+            "slice bounds derived from a traced value need a statically-"
+            "derivable window size: write x[i:i+k] = v with a constant k "
+            "(set_value_op StartsTensorList semantics)")
+    from ..framework.tensor import Tensor
+    from ..ops.manipulation import dynamic_update_slice
+    size = int(size)
+    xv = unwrap(x)
+    vv = jnp.broadcast_to(jnp.asarray(unwrap(value), xv.dtype),
+                          (size,) + xv.shape[1:])
+    if _is_tensorish(x):
+        return dynamic_update_slice(x, Tensor(vv), lo, axis=0)
+    return jax.lax.dynamic_update_slice_in_dim(jnp.asarray(xv), vv,
+                                               unwrap(lo), axis=0)
+
+
 _cb_verdict = []   # memo: [bool] once probed OUTSIDE any trace
 
 
@@ -682,6 +746,8 @@ _JST = {
     "_jst_more": convert_more,
     "_jst_len": convert_len,
     "_jst_getitem": convert_getitem,
+    "_jst_slice": convert_slice,
+    "_jst_setslice": convert_setslice,
     "_jst_assert": convert_assert,
     "_jst_print": convert_print,
     "_jst_int": convert_int,
@@ -1202,6 +1268,73 @@ class _ListAppendTransformer(ast.NodeTransformer):
         return node
 
 
+class _SliceTransformer(ast.NodeTransformer):
+    """slice_transformer.py parity: two-bound subscripts become converter
+    calls carrying the syntactically-derived window size, so traced-bound
+    slicing (``x[i:i+k]`` with ``i`` a loop carry) lowers to
+    lax.dynamic_slice instead of crashing on a traced Python ``slice``.
+    Static bounds round-trip through the converter unchanged."""
+
+    def __init__(self):
+        self.count = 0
+
+    @staticmethod
+    def _size_expr(lo, up):
+        """The static window size when the bounds differ by a constant
+        expression: x[i:i+k] / x[i:k+i] → k; x[i-k:i] → k."""
+        d = ast.dump
+        if isinstance(up, ast.BinOp) and isinstance(up.op, ast.Add):
+            if d(up.left) == d(lo):
+                return up.right
+            if d(up.right) == d(lo):
+                return up.left
+        if isinstance(lo, ast.BinOp) and isinstance(lo.op, ast.Sub) \
+                and d(lo.left) == d(up):
+            return lo.right
+        return None
+
+    @staticmethod
+    def _two_bound(node):
+        return (isinstance(node, ast.Subscript)
+                and isinstance(node.slice, ast.Slice)
+                and node.slice.lower is not None
+                and node.slice.upper is not None)
+
+    def _args(self, node):
+        sl = node.slice
+        size = self._size_expr(sl.lower, sl.upper)
+        return [node.value, sl.lower, sl.upper,
+                sl.step if sl.step is not None else ast.Constant(None),
+                size if size is not None else ast.Constant(None)]
+
+    def visit_Subscript(self, node):
+        self.generic_visit(node)
+        if self._two_bound(node) and isinstance(node.ctx, ast.Load):
+            self.count += 1
+            return ast.copy_location(ast.Call(
+                func=ast.Name(id="_jst_slice", ctx=ast.Load()),
+                args=self._args(node), keywords=[]), node)
+        return node
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        tgt = node.targets[0]
+        if (len(node.targets) == 1 and self._two_bound(tgt)
+                and isinstance(tgt.value, ast.Name)):
+            self.count += 1
+            base = tgt.value.id
+            tgt2 = ast.Subscript(value=ast.Name(id=base, ctx=ast.Load()),
+                                 slice=tgt.slice, ctx=ast.Load())
+            return ast.copy_location(ast.Assign(
+                targets=[ast.Name(id=base, ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Name(id="_jst_setslice", ctx=ast.Load()),
+                    args=self._args(tgt2)[:4] + [node.value,
+                                                 self._args(tgt2)[4]],
+                    keywords=[])), node)
+        return node
+
+
 class _AssertPrintCastTransformer(ast.NodeTransformer):
     """The assert/print/cast leg of the reference pipeline
     (assert_transformer.py, print_transformer.py, cast_transformer.py):
@@ -1288,6 +1421,8 @@ def ast_transform(func):
     tree = pc.visit(tree)
     la = _ListAppendTransformer()
     tree = la.visit(tree)
+    sl = _SliceTransformer()
+    tree = sl.visit(tree)
     if pc.count:
         # probe host-callback support NOW, outside any trace (probing
         # inside convert_assert/print would inline the probe's callback
@@ -1308,7 +1443,7 @@ def ast_transform(func):
     new_tree = t.visit(tree)
     fname, first = _src_location(raw)
     if (t._n == 0 and ft.count == 0 and et.count == 0 and not did_ret
-            and pc.count == 0 and la.count == 0):
+            and pc.count == 0 and la.count == 0 and sl.count == 0):
         # nothing to rewrite — still attach the runtime diagnostic guard so
         # unconvertible dynamic control flow reports guidance, not a bare
         # tracer error

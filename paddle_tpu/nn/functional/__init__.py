@@ -7,7 +7,7 @@ from .common import (  # noqa: F401
 )
 from .conv import (  # noqa: F401
     conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
-    conv3d_transpose,
+    conv3d_transpose, conv_bn_act, conv_bn_fusable,
 )
 from .pooling import (  # noqa: F401
     avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d, max_pool2d, max_pool3d,

@@ -57,6 +57,12 @@ _glu_p = Primitive("glu", lambda x, axis=-1: (
 
 
 def relu(x, name=None):
+    up = getattr(x, "_bn_act_upgrade", None)
+    if up is not None:
+        # conv-epilogue handshake tail (nn/layer/norm.py): rebuild the
+        # conv+BN site with the ReLU fused into the Pallas apply pass; the
+        # relu-less BN result this replaces is dead code under jit
+        return up()
     return _relu(x)
 
 

@@ -97,6 +97,83 @@ _conv_nb_p = Primitive("conv2d_nobias",
                        lambda x, w, **kw: _conv_fn(x, w, None, **kw))
 
 
+def _conv_bn_act_fn(x, w, gamma, beta, rmean, rvar, momentum=0.9, eps=1e-5,
+                    stride=1, padding=0, relu=True, s2d=False):
+    """Fused NHWC conv+BN(+ReLU) through the Pallas pipeline
+    (ops/pallas/fused_conv.py), with the batch_norm_train running-stat
+    contract.  ``s2d=True`` applies the space-to-depth stem reorg (7×7/s2
+    → 4×4/s1 over 12 channels) INSIDE the op so the reorged conv feeds
+    the fused kernel directly — s2d at the XLA level alone was measured
+    slower (PERF.md r3) and must not ship without the kernel."""
+    from ...ops.pallas import fused_conv
+    from .norm import _running_update
+    if s2d:
+        x = fused_conv.stem_s2d_input(x)
+        w = fused_conv.stem_s2d_weight(w)
+        stride, padding = 1, 0
+    y, mean, var = fused_conv.fused_conv_bn_act(
+        x, w, gamma.astype(jnp.float32), beta.astype(jnp.float32),
+        int(stride), int(padding), float(eps), bool(relu))
+    new_rmean, new_rvar = _running_update(rmean, rvar, mean, var, momentum)
+    return y, new_rmean, new_rvar
+
+
+_conv_bn_act_p = Primitive("conv2d_bn_act", _conv_bn_act_fn,
+                           multi_output=True)
+
+
+def conv_bn_fusable(x, weight, stride, padding, dilation, groups,
+                    data_format, s2d=False):
+    """One cheap static check deciding the fused-vs-XLA branch (the
+    off-path must stay one branch — ISSUE 2 acceptance)."""
+    from ...framework import core
+    from ...framework.tensor import Tensor
+    from ...ops.pallas import fused_conv
+    if not fused_conv.enabled() or core.in_static_mode():
+        return False
+    xv, wv = unwrap(x), unwrap(weight)
+    if s2d:
+        return fused_conv.stem_supported(tuple(xv.shape), tuple(wv.shape))
+    return fused_conv.supports(
+        tuple(xv.shape), tuple(wv.shape), stride, padding, dilation, groups,
+        channel_last=data_format in ("NHWC",))
+
+
+def conv_bn_act(x, weight, gamma, beta, running_mean, running_var,
+                momentum=0.9, epsilon=1e-5, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", act=None, training=True,
+                s2d=False, name=None):
+    """conv2d → batch_norm → activation, fused through the Pallas
+    conv+BN+ReLU pipeline when ``FLAGS_use_pallas_fused_conv`` is on and
+    the site is eligible; otherwise the exact XLA composition (reference:
+    operators/fused/conv_fusion_op.cc).  Running stats update with the
+    shared momentum convention either way."""
+    relu = act == "relu"
+    if training and act in (None, "relu") and conv_bn_fusable(
+            x, weight, stride, padding, dilation, groups, data_format, s2d):
+        def _i(v):
+            return int(v[0]) if isinstance(v, (tuple, list)) else int(v)
+        out, nm, nv = _conv_bn_act_p(
+            x, weight, gamma, beta, running_mean, running_var,
+            momentum=float(momentum), eps=float(epsilon), stride=_i(stride),
+            padding=_i(padding), relu=relu, s2d=bool(s2d))
+        # functional-state write-back, same as F.batch_norm's train path
+        if isinstance(running_mean, Tensor) and isinstance(nm, Tensor):
+            running_mean.set_value(nm._value)
+            running_var.set_value(nv._value)
+        return out
+    from .norm import batch_norm
+    y = conv2d(x, weight, None, stride, padding, dilation, groups,
+               data_format)
+    y = batch_norm(y, running_mean, running_var, gamma, beta,
+                   training=training, momentum=momentum, epsilon=epsilon,
+                   data_format=data_format)
+    if act is not None:
+        from . import activation as A
+        y = getattr(A, act)(y)
+    return y
+
+
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCL", name=None):
     df = "NWC" if data_format in ("NLC",) else "NCW"

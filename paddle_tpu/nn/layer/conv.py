@@ -70,9 +70,22 @@ class Conv2D(_ConvNd):
                          bias_attr, data_format, 2)
 
     def forward(self, x):
-        return F.conv2d(x, self.weight, self.bias, self._stride,
-                        self._padding, self._dilation, self._groups,
-                        self._data_format)
+        out = F.conv2d(x, self.weight, self.bias, self._stride,
+                       self._padding, self._dilation, self._groups,
+                       self._data_format)
+        if self.bias is None and self._data_format == "NHWC":
+            from ...ops.pallas import fused_conv
+            if fused_conv.enabled():
+                # conv-epilogue handshake: a downstream train-mode BN may
+                # rebuild this site through the fused Pallas
+                # conv+BN(+ReLU) pipeline; under jit the plain conv above
+                # is then dead code and XLA drops it (one branch when the
+                # gate is off)
+                out._conv_epilogue = dict(
+                    x=x, weight=self.weight, stride=self._stride,
+                    padding=self._padding, dilation=self._dilation,
+                    groups=self._groups, data_format=self._data_format)
+        return out
 
 
 class Conv3D(_ConvNd):

@@ -39,6 +39,38 @@ class _BatchNormBase(Layer):
     _sync = False          # SyncBatchNorm dispatches the sync primitive
 
     def forward(self, x):
+        ep = getattr(x, "_conv_epilogue", None)
+        if (ep is not None and self.training and not self._sync
+                and not self._use_global_stats):
+            # conv-epilogue handshake (see Conv2D.forward): rebuild the
+            # conv+BN site through the fused Pallas pipeline; eligibility
+            # is one static check, and F.conv_bn_act itself falls back to
+            # the exact XLA composition when the kernel declines
+            if F.conv_bn_fusable(ep["x"], ep["weight"], ep["stride"],
+                                 ep["padding"], ep["dilation"], ep["groups"],
+                                 ep["data_format"]):
+                import functools as _ft
+                fused = _ft.partial(
+                    F.conv_bn_act, ep["x"], ep["weight"], self.weight,
+                    self.bias, self._mean, self._variance,
+                    momentum=self._momentum, epsilon=self._epsilon,
+                    stride=ep["stride"], padding=ep["padding"],
+                    dilation=ep["dilation"], groups=ep["groups"],
+                    data_format=ep["data_format"], training=True)
+                m0, v0 = self._mean._value, self._variance._value
+                out = fused(act=None)
+
+                def upgrade():
+                    # a directly-following ReLU re-runs the site with the
+                    # ReLU fused into the apply pass (the relu-less result
+                    # becomes dead code under jit); the running stats roll
+                    # back first so the momentum update applies once
+                    self._mean.set_value(m0)
+                    self._variance.set_value(v0)
+                    return fused(act="relu")
+
+                out._bn_act_upgrade = upgrade
+                return out
         return F.batch_norm(x, self._mean, self._variance, self.weight,
                             self.bias, training=self.training,
                             momentum=self._momentum, epsilon=self._epsilon,

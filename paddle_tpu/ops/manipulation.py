@@ -231,6 +231,36 @@ def slice(x, axes, starts, ends, name=None):
     return _slice(x, spec=tuple(spec))
 
 
+_dynslice_p = Primitive(
+    "slice_dynamic",
+    lambda x, start, size=1, axis=0:
+    jax.lax.dynamic_slice_in_dim(x, start, size, axis))
+
+
+def dynamic_slice(x, start, size, axis=0, name=None):
+    """Fixed-``size`` window at a runtime (possibly traced) ``start`` —
+    slice_op.cc's StartsTensor leg: the reference takes starts as a
+    tensor at run time while the extent stays static.  Lowers to
+    lax.dynamic_slice, so the start clamps to [0, dim-size] (the
+    reference's slice clamps the same way) and the VJP is a
+    dynamic_update_slice, not a scatter.  The dy2static getitem converter
+    routes traced-bound ``x[i:i+k]`` here."""
+    return _dynslice_p(x, start, size=int(size), axis=int(axis))
+
+
+_dynupdate_p = Primitive(
+    "set_slice_dynamic",
+    lambda x, v, start, axis=0:
+    jax.lax.dynamic_update_slice_in_dim(x, v.astype(x.dtype), start, axis))
+
+
+def dynamic_update_slice(x, value, start, axis=0, name=None):
+    """Functional ``x[start:start+len(value)] = value`` with a runtime
+    start (set_value_op StartsTensorList parity); dual of
+    ``dynamic_slice``."""
+    return _dynupdate_p(x, value, start, axis=int(axis))
+
+
 def strided_slice(x, axes, starts, ends, strides, name=None):
     axes, starts, ends, strides = map(_ints, (axes, starts, ends, strides))
     nd = x.ndim if isinstance(x, Tensor) else jnp.ndim(unwrap(x))

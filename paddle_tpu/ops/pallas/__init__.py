@@ -30,5 +30,7 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None):
     return _flash_bias_prim(q, k, v, bias, causal=bool(causal), scale=scale)
 
 
+from . import fused_bn, fused_conv  # noqa: F401  (kernel families)
+
 __all__ = ["flash_attention", "flash_attention_fn", "supports",
-           "DEFAULT_BLOCK"]
+           "DEFAULT_BLOCK", "fused_bn", "fused_conv"]

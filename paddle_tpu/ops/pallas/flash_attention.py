@@ -28,6 +28,10 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams (~0.6); support both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 DEFAULT_BLOCK = 128
 # Measured on v5e (chained-dispatch, bf16): larger blocks feed the MXU much
 # better — bq=512/bk=1024 reaches 64 TF/s at S=4096 vs 10 TF/s with 128x128
@@ -170,7 +174,7 @@ def _flash_fwd_call(q3, k3, v3, bias4, n_heads, scale, causal, bq, bk):
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, H), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=4 * BN * Sq * Sk * H // (2 if causal else 1),
@@ -326,7 +330,7 @@ def _flash_bwd_call(q3, k3, v3, bias4, out3, lse, do3, n_heads, scale,
         out_specs=[pl.BlockSpec((1, bq, H), lambda b, i, j: (b, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((BN, Sq, H), q3.dtype)],
         scratch_shapes=[pltpu.VMEM((bq, H), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interp,
     )(*args)[0]
@@ -347,7 +351,7 @@ def _flash_bwd_call(q3, k3, v3, bias4, out3, lse, do3, n_heads, scale,
             pltpu.VMEM((bk, H), jnp.float32),
             pltpu.VMEM((bk, H), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interp,
     )(*args)

@@ -177,13 +177,14 @@ def _fwd_rule(x2d, gamma, beta, eps, relu):
     return (y, mean, var), (x2d, gamma, beta_tag, mean, inv, scale, shift)
 
 
-def _bwd_rule(eps, relu, res, cts):
-    x2d, gamma, beta_tag, mean, inv, scale, shift = res
-    dy, dmean, dvar = cts
+def bn_bwd_reduce(x2d, dy, scale, shift, relu, tm=None):
+    """Per-channel (Σdy'·x, Σdy') over a [M, C] activation, dy' masked by
+    the recomputed relu gate.  One streaming read of (x, dy) — shared by
+    the fused-BN and fused-conv backward passes (fused_conv.py reuses it
+    on the conv output)."""
     m, c = x2d.shape
-    tm = _pick_tile(m, c)
-    interp = _interpret()
-    red = pl.pallas_call(
+    tm = tm or _pick_tile(m, c)
+    return pl.pallas_call(
         functools.partial(_bwd_reduce_kernel, relu=relu),
         grid=(m // tm,),
         in_specs=[pl.BlockSpec((tm, c), lambda i: (i, 0)),
@@ -194,26 +195,17 @@ def _bwd_rule(eps, relu, res, cts):
                    pl.BlockSpec((c,), lambda i: (0,))],
         out_shape=[jax.ShapeDtypeStruct((c,), jnp.float32),
                    jax.ShapeDtypeStruct((c,), jnp.float32)],
-        interpret=interp,
-    )
-    sum_dyx, dbeta = red(x2d, dy, scale, shift)
-    # dgamma = Σ dy'·x̂ = inv·(Σdy'·x − mean·Σdy')
-    dgamma = inv * (sum_dyx - mean * dbeta)
-    # dx in per-channel coefficient form (x̂ = (x−mean)·inv):
-    #   dx = γ·inv·dy' − γ·inv/M·dbeta − γ·inv/M·x̂·dgamma
-    #      = a·dy' + b·x + c
-    #   a = γ·inv,  b = −γ·inv²·dgamma/M,  c = −γ·inv·dbeta/M − b·mean
-    g = gamma.astype(jnp.float32)
-    a = g * inv
-    b = -(g * inv) * (inv * dgamma) / m
-    cc = -(g * inv) * (dbeta / m) - b * mean
-    # cotangents THROUGH the returned statistics (∂mean/∂x = 1/M,
-    # ∂var/∂x = 2(x−mean)/M) fold into the same coefficient form
-    dmean = dmean.astype(jnp.float32)
-    dvar = dvar.astype(jnp.float32)
-    b = b + 2.0 * dvar / m
-    cc = cc + dmean / m - 2.0 * dvar * mean / m
-    dx = pl.pallas_call(
+        interpret=_interpret(),
+    )(x2d, dy, scale, shift)
+
+
+def bn_bwd_dx(x2d, dy, scale, shift, a, b, cc, relu, tm=None):
+    """dx = a·dy' + b·x + c as one fused multiply-add pass (the
+    per-channel coefficient form of the BN backward; also shared with
+    fused_conv.py)."""
+    m, c = x2d.shape
+    tm = tm or _pick_tile(m, c)
+    return pl.pallas_call(
         functools.partial(_bwd_dx_kernel, relu=relu),
         grid=(m // tm,),
         in_specs=[pl.BlockSpec((tm, c), lambda i: (i, 0)),
@@ -225,8 +217,41 @@ def _bwd_rule(eps, relu, res, cts):
                   pl.BlockSpec((c,), lambda i: (0,))],
         out_specs=pl.BlockSpec((tm, c), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((m, c), x2d.dtype),
-        interpret=interp,
+        interpret=_interpret(),
     )(x2d, dy, scale, shift, a, b, cc)
+
+
+def bn_dx_coeffs(gamma, inv, mean, dbeta, sum_dyx, m, dmean=None, dvar=None):
+    """(dgamma, a, b, c) of the coefficient-form BN backward.
+
+    dx = γ·inv·dy' − γ·inv/M·dbeta − γ·inv/M·x̂·dgamma  =  a·dy' + b·x + c
+      a = γ·inv,  b = −γ·inv²·dgamma/M,  c = −γ·inv·dbeta/M − b·mean
+    Cotangents THROUGH the returned statistics (∂mean/∂x = 1/M,
+    ∂var/∂x = 2(x−mean)/M) fold into the same coefficient form."""
+    # dgamma = Σ dy'·x̂ = inv·(Σdy'·x − mean·Σdy')
+    dgamma = inv * (sum_dyx - mean * dbeta)
+    g = gamma.astype(jnp.float32)
+    a = g * inv
+    b = -(g * inv) * (inv * dgamma) / m
+    cc = -(g * inv) * (dbeta / m) - b * mean
+    if dvar is not None:
+        dvar = dvar.astype(jnp.float32)
+        b = b + 2.0 * dvar / m
+        cc = cc - 2.0 * dvar * mean / m
+    if dmean is not None:
+        cc = cc + dmean.astype(jnp.float32) / m
+    return dgamma, a, b, cc
+
+
+def _bwd_rule(eps, relu, res, cts):
+    x2d, gamma, beta_tag, mean, inv, scale, shift = res
+    dy, dmean, dvar = cts
+    m, c = x2d.shape
+    tm = _pick_tile(m, c)
+    sum_dyx, dbeta = bn_bwd_reduce(x2d, dy, scale, shift, relu, tm)
+    dgamma, a, b, cc = bn_dx_coeffs(gamma, inv, mean, dbeta, sum_dyx, m,
+                                    dmean, dvar)
+    dx = bn_bwd_dx(x2d, dy, scale, shift, a, b, cc, relu, tm)
     # cotangent dtypes must match the PRIMAL inputs (custom_vjp contract);
     # dbeta follows beta's dtype, not gamma's
     return dx, dgamma.astype(gamma.dtype), dbeta.astype(beta_tag.dtype)

@@ -49,7 +49,8 @@ def _encode_index(idx, nd):
             spec.append(("mask",) if it.dtype == jnp.bool_ else ("arr",))
             dynamic.append(it)
         elif isinstance(it, builtins_slice):
-            spec.append(("slice", it.start, it.stop, it.step))
+            spec.append(("slice", _slice_bound(it.start),
+                         _slice_bound(it.stop), _slice_bound(it.step)))
         elif it is None:
             spec.append(("none",))
         elif it is Ellipsis:
@@ -60,6 +61,23 @@ def _encode_index(idx, nd):
 
 
 builtins_slice = slice
+
+
+def _slice_bound(v):
+    """Normalize a slice bound into the hashable static spec.  Concrete
+    tensors collapse to ints; a TRACED bound has no static window size at
+    this level and must go through the dy2static converter (which carries
+    the syntactic ``i:i+k`` size) or ops.manipulation.dynamic_slice."""
+    if v is None or isinstance(v, (int, np.integer)):
+        return None if v is None else int(v)
+    u = unwrap(v) if isinstance(v, Tensor) else v
+    if isinstance(u, jax.core.Tracer):
+        raise TypeError(
+            "slice bounds cannot be traced values at the tensor level: "
+            "the window size would be dynamic. Use paddle.slice/"
+            "dynamic_slice with a static size, or write x[i:i+k] with a "
+            "constant k inside @to_static (slice_op.cc StartsTensor)")
+    return int(u)
 
 
 def _decode_index(spec, dynamic):
@@ -79,7 +97,23 @@ def _decode_index(spec, dynamic):
     return tuple(out)
 
 
+def _scalar_int_index(x, spec, dynamic):
+    """True for ``x[i]`` with a single scalar integer index — the case
+    that lowers to lax.dynamic_slice instead of a gather (slice_op.cc
+    StartsTensor parity): same value, but the VJP becomes a
+    dynamic_update_slice rather than a serialized TPU scatter."""
+    if len(spec) != 1 or spec[0][0] != "arr" or len(dynamic) != 1:
+        return False
+    d = dynamic[0]
+    return (jnp.ndim(x) >= 1 and hasattr(d, "dtype")
+            and jnp.issubdtype(d.dtype, jnp.integer) and jnp.ndim(d) == 0)
+
+
 def _getitem_fn(x, *dynamic, spec=()):
+    if _scalar_int_index(x, spec, dynamic):
+        d = dynamic[0]
+        i = jnp.where(d < 0, d + x.shape[0], d)
+        return jax.lax.dynamic_index_in_dim(x, i, axis=0, keepdims=False)
     return x[_decode_index(spec, list(dynamic))]
 
 
@@ -105,6 +139,11 @@ def _tensor_getitem(self, idx):
 
 
 def _setitem_fn(x, v, *dynamic, spec=()):
+    if _scalar_int_index(x, spec, dynamic):
+        d = dynamic[0]
+        i = jnp.where(d < 0, d + x.shape[0], d)
+        vv = jnp.broadcast_to(jnp.asarray(v, x.dtype), x.shape[1:])
+        return jax.lax.dynamic_update_index_in_dim(x, vv, i, axis=0)
     return x.at[_decode_index(spec, list(dynamic))].set(v)
 
 

@@ -104,9 +104,12 @@ class TrainStep:
         # warmup boundary: before it, every step syncs (adaptive ramp-in).
         self.localsgd_k = int(localsgd_k)
         self.localsgd_begin = int(localsgd_begin)
-        if self.localsgd_k > 1 and (zero or accumulate_steps > 1):
-            raise ValueError("localsgd composes with neither sharding (zero) "
-                             "nor gradient_merge in this engine")
+        if self.localsgd_k > 1 and zero:
+            raise ValueError(
+                "localsgd does not compose with sharding (zero) in this "
+                "engine: per-rank replicas need the whole parameter tree "
+                "local, ZeRO shards it over the same dp axis "
+                "(strategy-ledger row localsgd+sharding)")
         # DGC (meta_optimizers/dgc_optimizer.py / operators/dgc_op.h
         # parity as an ENGINE mode): per-dp-rank momentum correction +
         # residual accumulation + sampled top-k sparsification BEFORE the
@@ -118,10 +121,12 @@ class TrainStep:
         self.dgc_sparsity = float(dgc_sparsity)
         self.dgc_momentum = float(dgc_momentum)
         self.dgc_rampup_begin = int(dgc_rampup_begin)
-        if self.dgc_sparsity > 0 and (zero or accumulate_steps > 1
-                                      or self.localsgd_k > 1):
-            raise ValueError("dgc composes with neither sharding (zero), "
-                             "gradient_merge, nor localsgd in this engine")
+        if self.dgc_sparsity > 0 and (zero or self.localsgd_k > 1):
+            raise ValueError(
+                "dgc composes with neither sharding (zero) nor localsgd in "
+                "this engine: its per-rank u/v state assumes replicated "
+                "params and a single compression point per step; localsgd "
+                "has no per-step gradient exchange to compress")
         if not (0.0 <= self.dgc_sparsity < 1.0):
             raise ValueError("dgc_sparsity must be in [0, 1)")
         if self.dgc_sparsity > 0 and getattr(optimizer, "_momentum", 0):
@@ -380,6 +385,41 @@ class TrainStep:
             loss = self.loss_fn(out, label)
         return loss.astype(jnp.float32).mean(), new_buffers
 
+    def _rank_grad(self, loss_of, params, buffers, mb_in, mb_lb, key):
+        """(loss, grads, new_buffers) for ONE dp rank's batch shard,
+        gradient-merging over ``accumulate_steps`` microbatches first when
+        configured.  This is GradientMergeOptimizer composed INSIDE the
+        per-rank leg of localsgd/dgc (VERDICT r5 #7): the accumulation
+        happens strictly BEFORE any compression or replica averaging, the
+        same ordering fleet's strategy_compiler.py ranks the reference
+        meta-optimizers in."""
+        grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+        k = self.accumulate_steps
+        if k <= 1:
+            (loss, nb), g = grad_fn(params, buffers, mb_in, mb_lb, key)
+            return loss, g, nb
+
+        def split(x):
+            if x is None:
+                return None
+            return x.reshape((k, x.shape[0] // k) + x.shape[1:])
+
+        def micro(carry, mb):
+            g_acc, l_acc, buf = carry
+            mi, ml = mb
+            (loss, buf), g = grad_fn(params, buf, mi, ml, key)
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+            return (g_acc, l_acc + loss, buf), None
+
+        g0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (g, loss, nb), _ = jax.lax.scan(
+            micro, (g0, jnp.float32(0.0), buffers),
+            (tuple(split(x) for x in mb_in),
+             None if mb_lb is None else split(mb_lb)))
+        g = jax.tree_util.tree_map(lambda q: q / k, g)
+        return loss / k, g, nb
+
     def _build_localsgd_step(self):
         """LocalSGD step: vmap the (grad + update) over the per-rank leading
         axis — each dp rank advances its own replica from its own batch
@@ -400,8 +440,8 @@ class TrainStep:
 
             def per_rank(p, b, o, mb_in, mb_lb, ridx):
                 key = jax.random.fold_in(base_key, ridx)
-                (loss, nb), g = jax.value_and_grad(loss_of, has_aux=True)(
-                    p, b, mb_in, mb_lb, key)
+                loss, g, nb = self._rank_grad(loss_of, p, b, mb_in, mb_lb,
+                                              key)
                 np_, no = self.optimizer.functional_apply(p, g, o, new_step,
                                                           lr)
                 return loss, np_, nb, no
@@ -478,10 +518,12 @@ class TrainStep:
 
             def per_rank(mb_in, mb_lb, ridx):
                 key = jax.random.fold_in(base_key, ridx)
-                (loss, nb), g = jax.value_and_grad(
-                    loss_of, has_aux=True)(state["params"],
-                                           state["buffers"], mb_in,
-                                           mb_lb, key)
+                # gradient_merge composes INSIDE the rank leg: the mean
+                # microbatch gradient forms BEFORE momentum correction /
+                # sparsification, so compression sees the merged gradient
+                loss, g, nb = self._rank_grad(loss_of, state["params"],
+                                              state["buffers"], mb_in,
+                                              mb_lb, key)
                 return loss, g, nb
 
             mb_in = tuple(split(x) for x in inputs)
@@ -625,13 +667,18 @@ class TrainStep:
             is_global = isinstance(x0, jax.Array) and \
                 not x0.is_fully_addressable
             need = dp if is_global else max(1, local_dp)
+            # with gradient_merge composed into the rank leg, each rank's
+            # shard further splits into accumulate_steps microbatches
+            need *= max(1, self.accumulate_steps)
             if x0.shape[0] % need != 0:
                 raise ValueError(
                     f"localsgd/dgc need the "
                     f"{'global' if is_global else 'per-process'} batch "
                     f"({x0.shape[0]}) divisible by the "
                     f"{'dp degree' if is_global else 'local dp slots'} "
-                    f"({need}; dp={dp} over {nproc} processes)")
+                    f"× accumulate_steps "
+                    f"({need}; dp={dp} over {nproc} processes, "
+                    f"accumulate_steps={self.accumulate_steps})")
 
         def put(x):
             if x is None:

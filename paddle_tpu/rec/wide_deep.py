@@ -271,6 +271,8 @@ class WideDeepTrainer:
                 d_ar = rule_and_scatter(d_ar, slots_d, d_rows, gd, hy_d)
                 return new_p, new_adam, w_ar, d_ar, loss
 
+            # raw (unjitted) body kept for the in-graph chained-K probe
+            self._fused_cached_raw = fused_cached
             self._fused_cached = jax.jit(fused_cached,
                                          donate_argnums=(0, 1, 2, 3))
 
@@ -313,7 +315,10 @@ class WideDeepTrainer:
             return self._step_cached(sparse_ids, dense_x, labels)
         return self._step_pullpush(sparse_ids, dense_x, labels)
 
-    def _step_cached(self, sparse_ids, dense_x, labels):
+    def _prep_cached(self, sparse_ids):
+        """Host side of a cached-mode step: id dedup, slot resolution,
+        miss fill/scatter, octave-padded slot vector, wire-compressed
+        inverse map.  Returns device (slots, inv)."""
         ids = np.asarray(sparse_ids)
         uniq, inv = np.unique(ids, return_inverse=True)
         # ONE id→slot resolution for both tables, then per-table row moves.
@@ -347,16 +352,61 @@ class WideDeepTrainer:
         # wire compression: indices uint16 when they fit, features bf16
         inv_w = inv.reshape(ids.shape)
         inv_w = inv_w.astype(np.uint16 if u_pad <= 65536 else np.int32)
+        return jnp.asarray(slots_p), jnp.asarray(inv_w)
+
+    def _step_cached(self, sparse_ids, dense_x, labels):
+        slots_dev, inv_dev = self._prep_cached(sparse_ids)
         dense_w = np.asarray(dense_x, self._feature_wire_dtype)
         lab_w = np.asarray(labels, np.float32)
-        slots_dev = jnp.asarray(slots_p)
         self._params, self._adam, self._w_ar, self._d_ar, loss = \
             self._fused_cached(self._params, self._adam, self._w_ar,
                                self._d_ar, slots_dev, slots_dev,
-                               jnp.asarray(inv_w), jnp.asarray(dense_w),
+                               inv_dev, jnp.asarray(dense_w),
                                jnp.asarray(lab_w))
         self.sync_params()
         return loss
+
+    def in_graph_step_s(self, sparse_ids, dense_x, labels, k_small=2,
+                        k_large=6, reps=2):
+        """Seconds per device-side train step, measured as the DELTA of
+        two chained in-graph loop lengths over the cached-mode fused step
+        (one dispatch per K, loss riding the carry so no step can be
+        dead-code-eliminated — the bench.py/mfu_audit methodology).  This
+        is Wide&Deep's in-graph control number (VERDICT r5 #2/#8): what
+        the framework's compiled sparse+dense step costs with the host
+        hash/dedup and tunnel RTT factored out."""
+        import time
+        import jax
+        if not self._use_cache:
+            raise RuntimeError("in-graph probe needs device-cache mode")
+        slots_dev, inv_dev = self._prep_cached(sparse_ids)
+        dense_dev = jnp.asarray(np.asarray(dense_x,
+                                           self._feature_wire_dtype))
+        lab_dev = jnp.asarray(np.asarray(labels, np.float32))
+        raw = self._fused_cached_raw
+
+        def loop(params, adam, w_ar, d_ar, k):
+            def one(_, c):
+                p, a, w, d, acc = c
+                p, a, w, d, loss = raw(p, a, w, d, slots_dev, slots_dev,
+                                       inv_dev, dense_dev, lab_dev)
+                return (p, a, w, d, acc + loss.astype(jnp.float32))
+            init = (params, adam, w_ar, d_ar, jnp.float32(0.0))
+            return jax.lax.fori_loop(0, k, one, init)[4]
+
+        f = jax.jit(loop, static_argnums=(4,))
+        times = {}
+        for k in (k_small, k_large):
+            float(f(self._params, self._adam, self._w_ar, self._d_ar, k))
+            best = None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                float(f(self._params, self._adam, self._w_ar, self._d_ar,
+                        k))
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            times[k] = best
+        return (times[k_large] - times[k_small]) / (k_large - k_small)
 
     def _step_pullpush(self, sparse_ids, dense_x, labels):
         if self._async_push:

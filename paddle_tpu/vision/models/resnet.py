@@ -113,6 +113,29 @@ class ResNet(nn.Layer):
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
+    def _stem(self, x):
+        """7×7/s2 stem + maxpool.  With the fused-conv gate on (NHWC,
+        training), the input reorganizes space-to-depth (C_in 3 → 12,
+        lane utilization ~4×) and the equivalent 4×4/s1 conv+BN+ReLU runs
+        through the Pallas pipeline — fed directly, so XLA's im2col can't
+        undo the reorg the way it did the rejected r3 s2d-at-XLA attempt.
+        Parameters stay on conv1/bn1 (state-dict compatible); off-path is
+        one branch."""
+        from ...nn import functional as NF
+        if (self.data_format == "NHWC" and self.training
+                and self.conv1._kernel_size == (7, 7)
+                and NF.conv_bn_fusable(x, self.conv1.weight, 2, 3, 1, 1,
+                                       "NHWC", s2d=True)):
+            x = NF.conv_bn_act(
+                x, self.conv1.weight, self.bn1.weight, self.bn1.bias,
+                self.bn1._mean, self.bn1._variance,
+                momentum=self.bn1._momentum, epsilon=self.bn1._epsilon,
+                stride=2, padding=3, data_format="NHWC", act="relu",
+                training=True, s2d=True)
+        else:
+            x = self.relu(self.bn1(self.conv1(x)))
+        return self.maxpool(x)
+
     def _make_layer(self, block, planes, blocks, stride=1):
         norm_layer = self._norm_layer
         df = self.data_format
@@ -133,7 +156,7 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self._stem(x)
         x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
         if self.with_pool:
             x = self.avgpool(x)

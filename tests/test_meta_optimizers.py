@@ -331,3 +331,83 @@ def test_dgc_momentum_swap_no_double_momentum():
         TrainStep(m, Momentum(learning_rate=0.1,
                               parameters=m.parameters()),
                   loss_fn=nn.MSELoss(), mesh=mesh, dgc_sparsity=0.9)
+
+
+# -- engine-mode composition (VERDICT r5 #7) ----------------------------------
+
+def test_localsgd_composes_with_gradient_merge():
+    """LocalSGD × gradient_merge: accumulation happens inside the per-rank
+    leg, so with a mean-based loss the k-microbatch trajectory is EXACTLY
+    the unmerged one (mean of half-batch mean-grads == full-batch mean
+    grad) — the strategy_compiler ordering, as a trajectory gate."""
+    import numpy as np
+    from paddle_tpu.parallel import init_mesh, TrainStep
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype("float32")
+    Y = rng.randn(64, 1).astype("float32")
+
+    def run(acc):
+        paddle.seed(5)
+        mesh = init_mesh({"dp": 8})
+        m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                        parameters=m.parameters())
+        step = TrainStep(m, opt, loss_fn=nn.MSELoss(), mesh=mesh,
+                         localsgd_k=4, localsgd_begin=2,
+                         accumulate_steps=acc)
+        return [float(step((X,), Y)) for _ in range(8)]
+
+    np.testing.assert_allclose(run(1), run(2), rtol=1e-4, atol=1e-5)
+
+
+def test_dgc_composes_with_gradient_merge():
+    """DGC × gradient_merge: the merged mean gradient forms BEFORE the
+    momentum correction / top-k sparsification — same trajectory gate."""
+    import numpy as np
+    from paddle_tpu.parallel import init_mesh, TrainStep
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(64, 8).astype("float32")
+    Y = rng.randn(64, 1).astype("float32")
+
+    def run(acc):
+        paddle.seed(5)
+        mesh = init_mesh({"dp": -1})
+        m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters())
+        step = TrainStep(m, opt, loss_fn=nn.MSELoss(), mesh=mesh,
+                         dgc_sparsity=0.9, dgc_rampup_begin=1,
+                         accumulate_steps=acc)
+        return [float(step((X,), Y)) for _ in range(8)]
+
+    np.testing.assert_allclose(run(1), run(2), rtol=1e-4, atol=1e-5)
+
+
+def test_composition_guards_still_ledgered():
+    """The remaining refusals stay loud with their written reasons, and
+    the batch-divisibility guard accounts for accumulate_steps."""
+    import pytest
+    import numpy as np
+    from paddle_tpu.parallel import init_mesh, TrainStep
+
+    paddle.seed(0)
+    mesh = init_mesh({"dp": 8})
+    m = nn.Sequential(nn.Linear(8, 4), nn.Tanh(), nn.Linear(4, 1))
+    opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                    parameters=m.parameters())
+    with pytest.raises(ValueError, match="sharding"):
+        TrainStep(m, opt, loss_fn=nn.MSELoss(), mesh=mesh, localsgd_k=4,
+                  zero=1)
+    with pytest.raises(ValueError, match="localsgd"):
+        TrainStep(m, paddle.optimizer.SGD(learning_rate=0.05,
+                                          parameters=m.parameters()),
+                  loss_fn=nn.MSELoss(), mesh=mesh, dgc_sparsity=0.5,
+                  localsgd_k=4)
+    step = TrainStep(m, opt, loss_fn=nn.MSELoss(), mesh=mesh,
+                     localsgd_k=4, accumulate_steps=3)
+    X = np.random.RandomState(0).randn(64, 8).astype("float32")
+    Y = np.zeros((64, 1), "float32")
+    with pytest.raises(ValueError, match="accumulate_steps"):
+        step((X,), Y)

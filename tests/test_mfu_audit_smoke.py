@@ -1,0 +1,55 @@
+"""Smoke-gate for the MFU harness (ISSUE 2 satellite: CI/tooling).
+
+``tools/mfu_audit.py --dry`` runs every workload at a tiny CPU
+configuration — TrainStep build, AOT lower, cost_analysis, chained
+delta-of-K loop, JSON emit — so the measurement harness can't silently
+rot between perf rounds.  slow-marked: the dry resnet18 step still costs
+minutes of CPU conv time, which tier-1 (``-m 'not slow'``) must not pay.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_mfu_audit_dry_runs_end_to_end():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mfu_audit.py"),
+         "--dry"],
+        capture_output=True, text=True, timeout=840, env=env, cwd=REPO)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [json.loads(ln) for ln in p.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 4, p.stdout
+    names = {r["workload"] for r in lines}
+    assert names == {"resnet50_dygraph", "bert_base_pretrain",
+                     "transformer_big", "mnist_lenet_static"}
+    for r in lines:
+        assert r["dry"] is True
+        assert r["ms_per_step"] > 0
+        assert r["binding_bound"] in ("compute", "memory")
+        assert "flops_per_step" in r and "throughput" in r
+    # the conv-path provenance field rides on the resnet record
+    rn = next(r for r in lines if r["workload"] == "resnet50_dygraph")
+    assert rn["pallas_conv"] is False
+
+
+@pytest.mark.slow
+def test_mfu_audit_dry_single_workload():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mfu_audit.py"),
+         "--dry", "mnist_lenet_static"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [json.loads(ln) for ln in p.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1 and lines[0]["workload"] == "mnist_lenet_static"

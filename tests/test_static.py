@@ -711,7 +711,10 @@ def test_sync_batch_norm_stats_are_global_on_mesh():
     stats — the exact sync_batch_norm_op.cu contract."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.6
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
     import paddle_tpu.distributed as dist
     from paddle_tpu.nn.functional.norm import _bn_train_fn, _sync_bn_train_fn

@@ -17,9 +17,16 @@ NB: bytes/step from cost_analysis is PRE-FUSION algorithmic traffic
 (every HLO op's operands counted as HBM accesses) — an upper bound, not
 achieved HBM traffic; the memory fraction is indicative only.
 
-Usage: PYTHONPATH=/root/repo python tools/mfu_audit.py [workload ...]
+Usage: PYTHONPATH=/root/repo python tools/mfu_audit.py [--dry] [workload ...]
 Prints one JSON line per workload: flops/step, bytes/step, ms/step,
 achieved TFLOP/s + GB/s, fraction of each bound, and which bound binds.
+
+``--dry``: run every workload at a tiny CPU-safe configuration (resnet18
+@32px b4, BERT-tiny, 2-layer transformer, 5-step LeNet epoch) so the whole
+harness — TrainStep build, AOT lower, cost_analysis, chained delta-of-K
+loop, JSON emit — is exercised end-to-end on the 8-virtual-device CPU
+mesh.  The numbers are meaningless as MFU; the run proves the harness
+can't silently rot between perf rounds (tests/test_mfu_audit_smoke.py).
 """
 from __future__ import annotations
 
@@ -56,12 +63,12 @@ def _loop_time(body, state, args, k_small=K_SMALL, k_large=K_LARGE,
     return (times[k_large] - times[k_small]) / (k_large - k_small)
 
 
-def _emit(name, flops, bytes_, sec, units_per_step, unit):
+def _emit(name, flops, bytes_, sec, units_per_step, unit, extra=None):
     tf = flops / sec / 1e12
     gbs = bytes_ / sec / 1e9
     frac_c = tf / PEAK_TFLOPS
     frac_m = gbs / BW_HI_GBS
-    print(json.dumps({
+    rec = {
         "workload": name,
         "flops_per_step": flops, "bytes_per_step": bytes_,
         "ms_per_step": round(sec * 1e3, 3),
@@ -70,22 +77,28 @@ def _emit(name, flops, bytes_, sec, units_per_step, unit):
         "frac_of_peak_tflops": round(frac_c, 3),
         "frac_of_peak_gbs": round(frac_m, 3),
         "binding_bound": "compute" if frac_c >= frac_m else "memory",
-    }), flush=True)
+    }
+    rec.update(extra or {})
+    print(json.dumps(rec), flush=True)
 
 
-def audit_resnet50():
+def audit_resnet50(dry=False):
     import jax.numpy as jnp
     import paddle_tpu as paddle
+    from paddle_tpu.ops.pallas import fused_conv
     from paddle_tpu.parallel import init_mesh, TrainStep
-    from paddle_tpu.vision.models import resnet50
+    from paddle_tpu.vision.models import resnet50, resnet18
 
-    batch, hw = 256, 224
+    if dry:
+        model, batch, hw = resnet18(data_format="NHWC"), 4, 32
+    else:
+        model, batch, hw = resnet50(data_format="NHWC"), 256, 224
     mesh = init_mesh({"dp": -1})
-    model = resnet50(data_format="NHWC")
     opt = paddle.optimizer.Momentum(parameters=model.parameters(),
                                     learning_rate=0.1, momentum=0.9)
     step = TrainStep(model, opt, loss_fn=paddle.nn.CrossEntropyLoss(),
-                     mesh=mesh, compute_dtype=jnp.bfloat16, donate=False)
+                     mesh=mesh, compute_dtype=None if dry else jnp.bfloat16,
+                     donate=False)
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(batch, hw, hw, 3).astype("float32"))
     y = jnp.asarray(rng.randint(0, 1000, (batch,)))
@@ -94,22 +107,31 @@ def audit_resnet50():
     body = step._build_step()
     lowered = jax.jit(body).lower(step.state, (x,), y, np.float32(0.1))
     flops, bytes_ = _cost(lowered.compile())
-    sec = _loop_time(body, step.state, ((x,), y, np.float32(0.1)))
-    _emit("resnet50_dygraph", flops, bytes_, sec, batch, "img/s")
+    ks = (1, 2) if dry else (K_SMALL, K_LARGE)
+    sec = _loop_time(body, step.state, ((x,), y, np.float32(0.1)),
+                     k_small=ks[0], k_large=ks[1], reps=1 if dry else 3)
+    # record which conv path produced the number — a fused-conv
+    # measurement must never be mistaken for an XLA-path one
+    _emit("resnet50_dygraph", flops, bytes_, sec, batch, "img/s",
+          extra={"pallas_conv": fused_conv.enabled(), "dry": dry})
 
 
-def audit_bert():
+def audit_bert(dry=False):
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.parallel import init_mesh, TrainStep
     from paddle_tpu.text.models.bert import BertConfig, BertForPretraining
 
-    cfg, batch, seq = BertConfig.base(), 64, 128
+    if dry:
+        cfg, batch, seq = BertConfig.tiny(seq=32), 8, 32
+    else:
+        cfg, batch, seq = BertConfig.base(), 64, 128
     mesh = init_mesh({"dp": -1})
     model = BertForPretraining(cfg)
     opt = paddle.optimizer.AdamW(parameters=model.parameters(),
                                  learning_rate=1e-4, weight_decay=0.01)
-    step = TrainStep(model, opt, mesh=mesh, compute_dtype=jnp.bfloat16,
+    step = TrainStep(model, opt, mesh=mesh,
+                     compute_dtype=None if dry else jnp.bfloat16,
                      donate=False)
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
@@ -125,18 +147,24 @@ def audit_bert():
     lowered = __import__("jax").jit(body).lower(
         step.state, inputs, None, np.float32(1e-4))
     flops, bytes_ = _cost(lowered.compile())
-    sec = _loop_time(body, step.state, (inputs, None, np.float32(1e-4)))
-    _emit("bert_base_pretrain", flops, bytes_, sec, batch, "seq/s")
+    ks = (1, 2) if dry else (K_SMALL, K_LARGE)
+    sec = _loop_time(body, step.state, (inputs, None, np.float32(1e-4)),
+                     k_small=ks[0], k_large=ks[1], reps=1 if dry else 3)
+    _emit("bert_base_pretrain", flops, bytes_, sec, batch, "seq/s",
+          extra={"dry": dry})
 
 
-def audit_transformer_big():
+def audit_transformer_big(dry=False):
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.parallel import init_mesh, TrainStep
     from bench import bench_transformer_big  # noqa: F401  (same model class)
     import paddle_tpu.nn as nn
 
-    vocab, dm, nh, nl, ffn, batch, seq = 32768, 1024, 16, 6, 4096, 64, 64
+    if dry:
+        vocab, dm, nh, nl, ffn, batch, seq = 128, 32, 2, 2, 64, 2, 16
+    else:
+        vocab, dm, nh, nl, ffn, batch, seq = 32768, 1024, 16, 6, 4096, 64, 64
 
     class Seq2SeqLM(nn.Layer):
         def __init__(self):
@@ -162,7 +190,8 @@ def audit_transformer_big():
     model = Seq2SeqLM()
     opt = paddle.optimizer.Adam(parameters=model.parameters(),
                                 learning_rate=1e-4)
-    step = TrainStep(model, opt, mesh=mesh, compute_dtype=jnp.bfloat16,
+    step = TrainStep(model, opt, mesh=mesh,
+                     compute_dtype=None if dry else jnp.bfloat16,
                      donate=False)
     rng = np.random.RandomState(0)
     src = jnp.asarray(rng.randint(0, vocab, (batch, seq)))
@@ -173,19 +202,22 @@ def audit_transformer_big():
     lowered = __import__("jax").jit(body).lower(
         step.state, (src, tgt, lbl), None, np.float32(1e-4))
     flops, bytes_ = _cost(lowered.compile())
+    ks = (1, 2) if dry else (K_SMALL, K_LARGE)
     sec = _loop_time(body, step.state, ((src, tgt, lbl), None,
-                                        np.float32(1e-4)))
-    _emit("transformer_big", flops, bytes_, sec, batch * seq, "tok/s")
+                                        np.float32(1e-4)),
+                     k_small=ks[0], k_large=ks[1], reps=1 if dry else 3)
+    _emit("transformer_big", flops, bytes_, sec, batch * seq, "tok/s",
+          extra={"dry": dry})
 
 
-def audit_lenet():
+def audit_lenet(dry=False):
     """LeNet's scanned epoch is ONE dispatch; FLOPs from cost_analysis of
     the same scanned program, per-step time from epoch time / steps."""
     import jax.numpy as jnp
     import paddle_tpu as paddle
     import paddle_tpu.static as static
 
-    batch, steps = 128, 200
+    batch, steps = (8, 5) if dry else (128, 200)
     paddle.enable_static()
     try:
         main, startup = static.Program(), static.Program()
@@ -211,7 +243,7 @@ def audit_lenet():
                                        .astype("int64"))}
         exe.train_from_dataset(main, dataset=stacks, fetch_list=[loss])
         best = None
-        for _ in range(3):
+        for _ in range(1 if dry else 3):
             t0 = time.perf_counter()
             out = exe.train_from_dataset(main, dataset=stacks,
                                          fetch_list=[loss])
@@ -226,7 +258,8 @@ def audit_lenet():
                    + 400 * 120 + 120 * 84 + 84 * 10)
         flops = 3 * fwd * batch
         sec = best / steps
-        _emit("mnist_lenet_static", float(flops), 0.0, sec, batch, "img/s")
+        _emit("mnist_lenet_static", float(flops), 0.0, sec, batch, "img/s",
+              extra={"dry": dry})
     finally:
         paddle.disable_static()
 
@@ -240,7 +273,9 @@ AUDITS = {
 
 
 if __name__ == "__main__":
-    names = sys.argv[1:] or list(AUDITS)
+    argv = sys.argv[1:]
+    dry = "--dry" in argv
+    names = [a for a in argv if a != "--dry"] or list(AUDITS)
     for n in names:
         print(f"[mfu] {n} ...", file=sys.stderr, flush=True)
-        AUDITS[n]()
+        AUDITS[n](dry=dry)
