@@ -138,6 +138,15 @@ class GradScaler:
     def update(self):
         pass  # folded into step()
 
+    def on_step_result(self, found_inf: bool):
+        """Drive the dynamic-scale state machine from OUTSIDE the eager
+        step()/unscale_() path — the compiled TrainStep's in-graph
+        numerics sentinel reports each step's verdict here, so a skipped
+        (non-finite) step backs the scale off exactly like the reference's
+        update_loss_scaling op, and a good-step streak grows it."""
+        self._found_inf = bool(found_inf)
+        self._update_scale()
+
     def _update_scale(self):
         if not self._dynamic:
             return

@@ -1,0 +1,21 @@
+"""paddle_tpu.checkpoint: step-level atomic, sharded checkpointing.
+
+The durability contract lives in :mod:`.atomic` (temp+fsync+``os.replace``
+writes with sha256 verification); :mod:`.manager` builds the step-dir
+layout, the commit-last manifest, async saves and retention on top of it.
+``incubate.checkpoint.auto_checkpoint`` and the TrainStep/hapi hooks are
+thin consumers of this subsystem.
+"""
+from .atomic import (  # noqa: F401
+    CheckpointCorruptError, atomic_pickle_save, atomic_write_bytes,
+    sha256_file, verified_pickle_load)
+from .manager import (  # noqa: F401
+    CheckpointManager, complete_steps, is_complete, latest_complete_step,
+    read_manifest)
+
+__all__ = [
+    "CheckpointManager", "CheckpointCorruptError", "atomic_write_bytes",
+    "atomic_pickle_save", "verified_pickle_load", "sha256_file",
+    "complete_steps", "is_complete", "latest_complete_step",
+    "read_manifest",
+]

@@ -1,0 +1,119 @@
+"""Atomic, checksummed file writes — the durability primitives every
+checkpoint path in the framework routes through.
+
+Reference parity: the reference's checkpoint-notify machinery
+(incubate/checkpoint/checkpoint_saver.py) relies on HDFS rename atomicity;
+on a posix/local filesystem the equivalent contract is
+
+    write temp (same dir) -> flush -> fsync(file) -> os.replace -> fsync(dir)
+
+so a crash at ANY point leaves either the old file or the new file, never
+a torn hybrid.  The directory fsync makes the rename itself durable (a
+power cut after replace but before the dirent hits disk would otherwise
+resurrect the old file).
+
+Every payload additionally carries a sha256 so the LOADER can tell a
+complete file from a corrupt one — rename atomicity protects against
+crashes mid-write, checksums protect against everything else (partial
+scp, bit rot, a writer that died before the replace but whose temp file
+was mistaken for real data).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+from typing import Any, Tuple
+
+
+def fsync_dir(path: str) -> None:
+    """Durably commit a directory's entries (rename targets)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platforms/filesystems without dir-open support
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def atomic_write_bytes(path: str, data: bytes, durable: bool = True) -> str:
+    """Write ``data`` to ``path`` atomically; returns the sha256 hexdigest.
+
+    The temp file lives in the SAME directory as the target — os.replace
+    is only atomic within a filesystem, and a same-dir temp also means GC
+    of debris is local to the checkpoint dir.
+    """
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".tmp.",
+                               dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            if durable:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if durable:
+        fsync_dir(d)
+    return hashlib.sha256(data).hexdigest()
+
+
+def atomic_pickle_save(obj: Any, path: str, protocol: int = 4,
+                       durable: bool = True) -> Tuple[str, int]:
+    """Serialize ``obj`` in the framework checkpoint format (the same
+    magic-tagged pickle ``framework.io_state.save`` emits, so either
+    loader reads either writer) and commit it atomically.
+
+    Returns (sha256, byte size).
+    """
+    from ..framework.io_state import _MAGIC, _to_saveable
+    payload = pickle.dumps({"magic": _MAGIC, "obj": _to_saveable(obj)},
+                           protocol=protocol)
+    return atomic_write_bytes(path, payload, durable=durable), len(payload)
+
+
+def verified_pickle_load(path: str, expect_sha256: str = None,
+                         return_numpy: bool = False) -> Any:
+    """Load a checkpoint payload, optionally verifying its checksum first.
+
+    Raises ``CheckpointCorruptError`` on mismatch so callers can
+    distinguish "corrupt file" (fall back to an older checkpoint) from
+    genuine IO errors.
+    """
+    if expect_sha256 is not None:
+        actual = sha256_file(path)
+        if actual != expect_sha256:
+            raise CheckpointCorruptError(
+                f"checksum mismatch for {path}: "
+                f"expected {expect_sha256[:12]}…, got {actual[:12]}…")
+    from ..framework.io_state import load
+    return load(path, return_numpy=return_numpy)
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file exists but fails verification (torn write, bit
+    rot, truncation).  Loaders treat this as "checkpoint absent" and fall
+    back to the previous complete step."""

@@ -99,7 +99,20 @@ class PaddleCloudRoleMaker(RoleMakerBase):
                 "127.0.0.1" if self.is_first_worker() else host, port,
                 world_size=self._worker_num,
                 is_master=self.is_first_worker(), timeout=timeout)
+            self._maybe_start_heartbeat()
         return self._store
+
+    def _maybe_start_heartbeat(self):
+        """Elastic liveness: when the launcher runs a hung-rank watchdog
+        it exports ``PADDLE_ELASTIC_HEARTBEAT_S``; every worker then
+        publishes ``__hb/<rank>`` from a daemon thread as soon as it has
+        a store (fleet init / rendezvous)."""
+        interval = float(os.getenv("PADDLE_ELASTIC_HEARTBEAT_S", "0") or 0)
+        if interval <= 0 or getattr(self, "_heartbeat", None) is not None:
+            return
+        from ..elastic import HeartbeatReporter
+        self._heartbeat = HeartbeatReporter(
+            self._store, self._worker_index, interval=interval).start()
 
     def rendezvous(self, timeout=120.0):
         """Exchange endpoints through the store and wait for the full

@@ -11,6 +11,7 @@ barrier-on-store semantics.
 """
 from __future__ import annotations
 
+import random
 import socket
 import struct
 import threading
@@ -47,6 +48,12 @@ class _Server(threading.Thread):
     def __init__(self, port):
         super().__init__(daemon=True)
         self._kv = {}
+        # add-dedup ledger: req_id -> cached reply.  add is the one
+        # non-idempotent op; a client retrying after a lost reply resends
+        # the SAME req_id and gets the recorded result instead of
+        # double-counting (which would skew barrier arrival windows).
+        self._applied = {}
+        self._applied_order = []
         self._cv = threading.Condition()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -96,10 +103,20 @@ class _Server(threading.Thread):
             _send_msg(conn, b"ok", v if v is not None else b"",
                       b"1" if v is not None else b"0")
         elif cmd == b"add":
+            req_id = args[2] if len(args) > 2 else None
             with self._cv:
-                cur = int(self._kv.get(args[0], b"0")) + int(args[1])
-                self._kv[args[0]] = str(cur).encode()
-                self._cv.notify_all()
+                if req_id is not None and req_id in self._applied:
+                    cur = self._applied[req_id]     # retried: replay reply
+                else:
+                    cur = int(self._kv.get(args[0], b"0")) + int(args[1])
+                    self._kv[args[0]] = str(cur).encode()
+                    if req_id is not None:
+                        self._applied[req_id] = cur
+                        self._applied_order.append(req_id)
+                        while len(self._applied_order) > 4096:
+                            self._applied.pop(
+                                self._applied_order.pop(0), None)
+                    self._cv.notify_all()
             _send_msg(conn, b"ok", str(cur).encode())
         elif cmd == b"delprefix":
             with self._cv:
@@ -129,7 +146,17 @@ class _Server(threading.Thread):
 
 
 class TCPStore:
-    """c10d-style store. Rank 0 passes is_master=True and serves."""
+    """c10d-style store. Rank 0 passes is_master=True and serves.
+
+    Client hardening (ISSUE 3): every op retries transient socket
+    failures (ECONNRESET, timeouts, a bounced server) with exponential
+    backoff + jitter, RECONNECTING between attempts — a reply lost
+    mid-flight desyncs the length-prefixed protocol, so the old
+    connection is never reused after an error.  Retry budget comes from
+    ``FLAGS_store_max_retries`` / ``FLAGS_store_retry_backoff``; the
+    deterministic fault harness (testing/faults.py ``store_drop``
+    clauses) injects drops right before the send to exercise this path.
+    """
 
     def __init__(self, host, port, world_size=1, is_master=False,
                  timeout=120.0):
@@ -140,20 +167,95 @@ class TCPStore:
             self._server.start()
             port = self._server.port
         self.host, self.port = host, port
-        deadline = time.time() + timeout
+        self._sock = None
+        self._connect(timeout)
+        self._lock = threading.Lock()
+
+    def _connect(self, budget=None):
+        """(Re)establish the client connection, retrying refusals until
+        ``budget`` seconds elapse (a restarting master needs a moment to
+        re-listen)."""
+        deadline = time.time() + (budget if budget is not None
+                                  else self._timeout)
         last = None
         while True:
             try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=timeout)
-                break
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=self._timeout)
+                return
             except OSError as e:
                 last = e
                 if time.time() > deadline:
                     raise ConnectionError(
-                        f"store at {host}:{port} unreachable: {last}")
+                        f"store at {self.host}:{self.port} unreachable: "
+                        f"{last}")
                 time.sleep(0.05)
-        self._lock = threading.Lock()
+
+    def _reconnect(self):
+        """Drop the (possibly desynced) connection and start a clean one:
+        the length-prefixed protocol has no resync point mid-stream, so
+        after ANY client-side error the only safe recovery is a fresh
+        socket — which also restores the default timeout."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._connect()
+
+    def _retry_budget(self):
+        from ....framework import flags as _flags
+        return (int(_flags.flag("store_max_retries")),
+                float(_flags.flag("store_retry_backoff")))
+
+    def _maybe_inject_drop(self, op: str):
+        from ....testing.faults import active_plan
+        plan = active_plan()
+        if plan is not None and plan.should_drop_store_op(op):
+            try:
+                self._sock.close()      # next send/recv fails -> retry path
+            except OSError:
+                pass
+
+    def _request(self, op: str, make_parts, reply_timeout=None):
+        """One store round-trip with the retry/reconnect policy.
+        ``make_parts`` is re-evaluated per attempt (wait shrinks its
+        remaining time); ``reply_timeout`` likewise callable-or-None.
+        Server-side "err" replies (RuntimeError) are NOT retried —
+        they're malformed requests, not transport faults."""
+        retries, base = self._retry_budget()
+        attempt = 0
+        while True:
+            self._maybe_inject_drop(op)
+            try:
+                with self._lock:
+                    t = reply_timeout() if callable(reply_timeout) \
+                        else reply_timeout
+                    if t is not None:
+                        self._sock.settimeout(t)
+                    try:
+                        _send_msg(self._sock, *make_parts())
+                        return self._reply()
+                    finally:
+                        if t is not None:
+                            try:
+                                self._sock.settimeout(self._timeout)
+                            except OSError:
+                                pass    # dead socket: reconnect handles it
+            except (ConnectionError, OSError):
+                # transport fault: the stream may hold a half-read or
+                # late reply — resync by reconnecting, even on the final
+                # attempt (the NEXT call must start clean)
+                with self._lock:
+                    try:
+                        self._reconnect()
+                    except ConnectionError:
+                        if attempt >= retries:
+                            raise
+                if attempt >= retries:
+                    raise
+                delay = base * (2 ** attempt)
+                time.sleep(delay + random.uniform(0, delay * 0.5))
+                attempt += 1
 
     def _reply(self):
         parts = _recv_msg(self._sock)
@@ -165,32 +267,29 @@ class TCPStore:
         return parts[1:]
 
     def set(self, key: str, value: bytes):
-        with self._lock:
-            _send_msg(self._sock, b"set", key.encode(),
-                      value if isinstance(value, bytes) else
-                      str(value).encode())
-            self._reply()
+        payload = value if isinstance(value, bytes) else str(value).encode()
+        self._request("set",
+                      lambda: (b"set", key.encode(), payload))
 
     def get(self, key: str, wait=True):
         if wait and not self.wait(key, self._timeout):
             raise TimeoutError(f"store key {key!r} never set")
-        with self._lock:
-            _send_msg(self._sock, b"get", key.encode())
-            v, present = self._reply()
+        v, present = self._request("get", lambda: (b"get", key.encode()))
         return v if present == b"1" else None
 
     def add(self, key: str, amount: int = 1) -> int:
-        with self._lock:
-            _send_msg(self._sock, b"add", key.encode(),
-                      str(amount).encode())
-            (v,) = self._reply()
+        import os
+        # one req_id per LOGICAL add, constant across retries: the server
+        # dedups it, so a lost-reply resend can't double-count
+        req_id = os.urandom(8)
+        (v,) = self._request("add", lambda: (b"add", key.encode(),
+                                             str(amount).encode(), req_id))
         return int(v)
 
     def delete_prefix(self, prefix: str) -> int:
         """Delete every key starting with ``prefix``; returns the count."""
-        with self._lock:
-            _send_msg(self._sock, b"delprefix", prefix.encode())
-            (n,) = self._reply()
+        (n,) = self._request("delprefix",
+                             lambda: (b"delprefix", prefix.encode()))
         return int(n)
 
     def reset_barrier(self, name: str = ""):
@@ -218,17 +317,20 @@ class TCPStore:
 
     def wait(self, key: str, timeout: float = None) -> bool:
         t = timeout or self._timeout
-        with self._lock:
-            # the server's wait deadline starts when it RECEIVES the
-            # request; the socket recv timeout must outlive it or the late
-            # '0' reply desyncs the connection protocol
-            self._sock.settimeout(t + 30.0)
-            try:
-                _send_msg(self._sock, b"wait", key.encode(),
-                          str(t).encode())
-                (ok,) = self._reply()
-            finally:
-                self._sock.settimeout(self._timeout)
+        deadline = time.time() + t
+        # the server's wait deadline starts when it RECEIVES the request;
+        # the socket recv timeout must outlive it or the late '0' reply
+        # desyncs the connection protocol.  Hardening: each retry re-sends
+        # wait with only the REMAINING time (the overall deadline is the
+        # caller's contract), and any mid-wait transport error — reply
+        # lost, server bounced — reconnects inside _request, so neither
+        # the inflated t+30 timeout nor a desynced stream can leak into
+        # the next call.
+        left = lambda: max(0.1, deadline - time.time())  # noqa: E731
+        (ok,) = self._request("wait",
+                              lambda: (b"wait", key.encode(),
+                                       str(left()).encode()),
+                              reply_timeout=lambda: left() + 30.0)
         return ok == b"1"
 
     def barrier(self, name: str, world_size: int, timeout: float = None):

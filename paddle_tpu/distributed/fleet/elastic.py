@@ -63,6 +63,16 @@ class HeartbeatMonitor:
         return out
 
 
+def _stat_add(name, value=1):
+    from ...utils.monitor import stat_add
+    stat_add(name, value)
+
+
+def _stat_set(name, value):
+    from ...utils.monitor import stat_set
+    stat_set(name, value)
+
+
 class ElasticLaunch:
     """Bounded-restart supervision of local worker processes
     (fleet/elastic ElasticManager semantics, local scope). Two modes:
@@ -78,7 +88,8 @@ class ElasticLaunch:
     max_restarts=0)."""
 
     def __init__(self, spawn_fn, nprocs, max_restarts=3, poll_s=0.5,
-                 gang=None, on_restart=None):
+                 gang=None, on_restart=None, monitor=None,
+                 watchdog_warmup=30.0):
         self._spawn = spawn_fn     # spawn_fn(local_rank) -> Popen
         self._n = nprocs
         self._max_restarts = max_restarts
@@ -88,6 +99,16 @@ class ElasticLaunch:
         # outlives the workers should clear rendezvous state here, e.g.
         # lambda: store.delete_prefix("__barrier/")
         self._on_restart = on_restart
+        # hung-rank watchdog: a HeartbeatMonitor (or a zero-arg factory
+        # returning one — lazy, because the store usually lives inside
+        # rank 0 and only exists once the gang is up).  A rank whose
+        # heartbeat goes stale is treated exactly like a crashed rank:
+        # the gang is evicted (SIGKILL — it is by definition not
+        # responding) and relaunched under the restart budget.  The
+        # warmup window after each (re)spawn gives workers time to reach
+        # rendezvous and publish their first heartbeat.
+        self._monitor = monitor
+        self._watchdog_warmup = watchdog_warmup
         # restart generation, exported to children (spawn_fn closures read
         # it via this attribute or the PADDLE_RESTART_GENERATION env the
         # launcher sets): TCPStore.barrier scopes its keys by it so a
@@ -100,11 +121,31 @@ class ElasticLaunch:
             return self._run_gang()
         return self._run_independent()
 
+    def _poll_stale(self, spawned_at):
+        """Watchdog poll: ranks whose heartbeat is stale, or [] while the
+        watchdog is off / warming up / the store is unreachable (a dead
+        store usually means rank 0 died — the process poll catches that;
+        the watchdog exists for ranks that are alive-but-hung)."""
+        if self._monitor is None:
+            return []
+        if time.time() - spawned_at < self._watchdog_warmup:
+            return []
+        mon = self._monitor() if callable(self._monitor) else self._monitor
+        if mon is None:
+            return []
+        try:
+            stale = mon.stale_ranks()
+        except Exception:
+            return []
+        _stat_set("elastic_stale_ranks", len(stale))
+        return stale
+
     def _run_gang(self):
         import signal
         restarts = 0
         while True:
             procs = [self._spawn(i) for i in range(self._n)]
+            spawned_at = time.time()
             rc = 0
             while procs:
                 time.sleep(self._poll_s)
@@ -122,12 +163,32 @@ class ElasticLaunch:
                             q.wait()
                         procs = []
                         break
+                if procs and rc == 0:
+                    stale = self._poll_stale(spawned_at)
+                    if stale:
+                        # hung-rank eviction: the gang is wedged (a live
+                        # collective cannot survive a lost member anyway)
+                        # — SIGKILL, not SIGTERM: a hung rank may not
+                        # service signals, and the crash model under test
+                        # is preemption, not graceful shutdown
+                        import sys
+                        print(f"[elastic] evicting gang: stale ranks "
+                              f"{stale} (no heartbeat)", file=sys.stderr)
+                        rc = -signal.SIGKILL
+                        for q in procs:
+                            if q.poll() is None:
+                                q.send_signal(signal.SIGKILL)
+                        for q in procs:
+                            q.wait()
+                        procs = []
             if rc == 0:
                 return 0, {i: restarts for i in range(self._n)}
             if restarts >= self._max_restarts:
                 return rc, {i: restarts for i in range(self._n)}
             restarts += 1
             self.generation = restarts
+            _stat_add("elastic_restart_count")
+            _stat_set("elastic_restart_generation", self.generation)
             if self._on_restart is not None:
                 try:
                     self._on_restart()
@@ -158,6 +219,7 @@ class ElasticLaunch:
                         continue
                     if restarts[i] < self._max_restarts:
                         restarts[i] += 1
+                        _stat_add("elastic_restart_count")
                         procs[i] = self._spawn(i)
                     else:
                         for j, q in procs.items():

@@ -48,6 +48,15 @@ def _parse_args(argv=None):
                    help="0 = fail-fast (default); 1 = restart dead local "
                         "ranks up to --max_restarts (fleet/elastic parity)")
     p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--elastic_stale_after", type=float, default=0.0,
+                   help="hung-rank watchdog: evict and restart the gang "
+                        "when a rank's heartbeat is older than this many "
+                        "seconds (0 = watchdog off). Workers auto-start "
+                        "HeartbeatReporters via PADDLE_ELASTIC_HEARTBEAT_S")
+    p.add_argument("--elastic_watchdog_warmup", type=float, default=30.0,
+                   help="seconds after each (re)spawn before the watchdog "
+                        "starts judging heartbeats (workers need to reach "
+                        "rendezvous first)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -84,6 +93,10 @@ def launch_collective(args):
             "PADDLE_RESTART_GENERATION": str(
                 supervisor[0].generation if supervisor else 0),
         })
+        if args.elastic_level >= 1 and args.elastic_stale_after > 0:
+            # workers publish heartbeats at ~1/3 the staleness horizon
+            env["PADDLE_ELASTIC_HEARTBEAT_S"] = str(
+                max(args.elastic_stale_after / 3.0, 0.5))
         cmd = [sys.executable, "-u", args.training_script] + \
             args.training_script_args
         out = None
@@ -101,10 +114,41 @@ def launch_collective(args):
         if args.elastic_level >= 1:
             # bounded-restart supervision (fleet/elastic parity)
             from .elastic import ElasticLaunch
+            monitor = None
+            if args.elastic_stale_after > 0:
+                # lazy: the store lives inside rank 0, so the monitor's
+                # client connection can only be made once a gang is up —
+                # and must be retried if it isn't yet
+                state = {}
+
+                def monitor(_state=state):
+                    if "m" in _state:
+                        return _state["m"]
+                    try:
+                        from .base.tcp_store import TCPStore
+                        from .elastic import HeartbeatMonitor
+                        ep = os.getenv("PADDLE_STORE_ENDPOINT")
+                        if ep:
+                            host, port = ep.rsplit(":", 1)
+                            port = int(port)
+                        else:
+                            host = (endpoints[0].rsplit(":", 1)[0]
+                                    or "127.0.0.1")
+                            port = int(os.getenv("PADDLE_STORE_PORT",
+                                                 "61001"))
+                        store = TCPStore(host, port, timeout=2.0)
+                        _state["m"] = HeartbeatMonitor(
+                            store, nranks,
+                            stale_after=args.elastic_stale_after)
+                    except Exception:
+                        return None
+                    return _state["m"]
             # collective jobs are always gangs, even at 1 proc per host:
             # a lone restarted rank cannot rejoin collectives mid-flight
             el = ElasticLaunch(spawn, args.nproc_per_node,
-                               max_restarts=args.max_restarts, gang=True)
+                               max_restarts=args.max_restarts, gang=True,
+                               monitor=monitor,
+                               watchdog_warmup=args.elastic_watchdog_warmup)
             supervisor.append(el)
             rc, restarts = el.run()
             if any(restarts.values()):

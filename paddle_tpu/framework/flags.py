@@ -133,6 +133,37 @@ define_flag("enable_profiler",
             "profiler.Profiler record window. Seeded by FLAGS_enable_"
             "profiler or PADDLE_TPU_PROFILE; a Profiler's record phase "
             "turns the spans on regardless of this flag.")
+define_flag("train_sentinel",
+            os.environ.get("PADDLE_TPU_SENTINEL", "").lower()
+            in ("1", "true", "yes", "on"),
+            "In-graph numerics sentinel: one fused isfinite reduction over "
+            "loss + gradients inside the jitted train step; a non-finite "
+            "step is skipped in-graph (params/opt state keep their old "
+            "values) and counted in the train_skipped_steps gauge. "
+            "Off-path cost when disabled: one Python branch at trace "
+            "time, zero graph change. Seeded by PADDLE_TPU_SENTINEL.")
+define_flag("sentinel_max_bad_steps", 8,
+            "Abort bound for the numerics sentinel: this many CONSECUTIVE "
+            "skipped (non-finite) steps raises FloatingPointError with a "
+            "diagnostic dump (offending tensor, step, last-good "
+            "checkpoint) instead of silently burning the job.",
+            validator=lambda v: int(v) >= 1)
+define_flag("ckpt_keep", 3,
+            "Checkpoint retention: the CheckpointManager keeps this many "
+            "newest COMPLETE step checkpoints and GCs the rest (plus "
+            "crashed-save debris older than the newest complete step). "
+            "0 keeps everything.",
+            validator=lambda v: int(v) >= 0)
+define_flag("store_max_retries", 3,
+            "TCPStore client ops (set/get/add/wait) retry transient "
+            "socket errors (ECONNRESET, timeouts) this many times with "
+            "exponential backoff + jitter, reconnecting between attempts "
+            "— a bounced rendezvous server no longer kills workers.",
+            validator=lambda v: int(v) >= 0)
+define_flag("store_retry_backoff", 0.05,
+            "Base delay (seconds) of the TCPStore retry backoff; attempt "
+            "k sleeps base * 2^k plus up to 50% deterministic jitter.",
+            validator=lambda v: float(v) > 0)
 define_flag("jit_ledger_dir",
             os.environ.get("PADDLE_TPU_JIT_LEDGER_DIR", ""),
             "When non-empty, recompile-ledger events (profiler.ledger) "

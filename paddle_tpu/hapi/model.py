@@ -124,8 +124,25 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, checkpoint_dir=None,
+            checkpoint_every_n_steps=0):
         from ..io import DataLoader, Dataset
+        # step-level fault tolerance (paddle_tpu.checkpoint): atomic,
+        # checksummed, async step checkpoints + auto-resume.  Unlike
+        # ``save_dir`` (epoch-end eager save() files), these are the
+        # compiled TrainStep's full state — params, optimizer
+        # accumulators, BN buffers and the step counter — written with
+        # the manifest-commit-last protocol, so a preempted run restarts
+        # from the newest COMPLETE step instead of epoch 0.
+        if checkpoint_dir:
+            from ..checkpoint import CheckpointManager
+            tstep = self._ensure_train_step()
+            tstep.attach_checkpoint_manager(
+                CheckpointManager(checkpoint_dir, async_save=True))
+            try:
+                tstep.restore_from_checkpoint()
+            except FileNotFoundError:
+                pass                    # fresh run
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,
@@ -150,6 +167,7 @@ class Model:
 
         cbks.on_train_begin()
         it = 0
+        logs = {}
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             logs = {}
@@ -160,6 +178,9 @@ class Model:
                 logs = {"loss": loss[0]}
                 cbks.on_train_batch_end(step_i, logs)
                 it += 1
+                if checkpoint_dir and checkpoint_every_n_steps and \
+                        it % checkpoint_every_n_steps == 0:
+                    self._train_step.save_checkpoint()
                 if num_iters is not None and it >= num_iters:
                     break
             if save_dir and (epoch + 1) % save_freq == 0:
@@ -174,6 +195,10 @@ class Model:
                                       and it >= num_iters):
                 break
         cbks.on_train_end(logs)
+        if checkpoint_dir:
+            # final step checkpoint; wait=True also fences any in-flight
+            # async save so fit() never returns with an uncommitted write
+            self._train_step.save_checkpoint(wait=True)
         if save_dir:
             self.save(os.path.join(save_dir, "final"))
 

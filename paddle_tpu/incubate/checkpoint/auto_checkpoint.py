@@ -4,17 +4,22 @@ Reference parity: python/paddle/fluid/incubate/checkpoint/
 auto_checkpoint.py:598 (train_epoch_range generator) + :71 — checkpoints
 exe+epoch state keyed by job env to HDFS and auto-resumes after restart.
 
-TPU version: the checkpoint unit is (layer/model state_dict + optimizer
-state + epoch counter) written to a local/posix dir (PADDLE_TPU_CHECKPOINT_DIR
-or the job-id env the launcher sets). Multi-host: rank 0 writes; restart on
-any host resumes from the last complete epoch (fail-fast launcher restarts
-the whole job, matching the reference's model).
+TPU version: a thin wrapper over :mod:`paddle_tpu.checkpoint` — each
+checkpointed epoch is one atomic ``step_XXXXXXXX`` dir (temp+fsync+
+``os.replace`` payload writes, sha256 per file, manifest committed last),
+so a crash mid-save can never leave corrupt params that a restart happily
+loads: the torn epoch simply has no manifest and the loader resumes from
+the previous complete one.  ``status.json`` remains as a human-readable
+summary (and legacy-layout marker) but is no longer the source of truth.
+
+Multi-host: rank 0 writes; restart on any host resumes from the last
+complete epoch (fail-fast launcher restarts the whole job, matching the
+reference's model).
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Optional
 
 
 class ExeTrainStatus:
@@ -35,30 +40,57 @@ def _status_path():
     return os.path.join(_ckpt_dir(), "status.json")
 
 
+def _manager():
+    from ...checkpoint import CheckpointManager
+    # the epoch loop is single-writer (rank 0) by construction, so the
+    # manager runs in degenerate single-rank mode regardless of topology
+    return CheckpointManager(_ckpt_dir(), rank=0, world_size=1)
+
+
 def _save_status(epoch, payloads):
-    from ...framework.io_state import save
-    d = _ckpt_dir()
-    os.makedirs(d, exist_ok=True)
-    for name, obj in payloads.items():
-        if hasattr(obj, "state_dict"):
-            save(obj.state_dict(), os.path.join(d, f"{name}.pdparams"))
-    tmp = _status_path() + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump({"epoch_no": epoch}, f)
-    os.replace(tmp, _status_path())  # atomic: no torn checkpoints
+    states = {name: obj.state_dict() for name, obj in payloads.items()
+              if hasattr(obj, "state_dict")}
+    m = _manager()
+    if states:
+        m.save(int(epoch), states)
+    else:
+        os.makedirs(_ckpt_dir(), exist_ok=True)
+    # summary sidecar (atomic like everything else); readers wanting the
+    # real atomicity point must look at the step-dir manifests
+    from ...checkpoint.atomic import atomic_write_bytes
+    atomic_write_bytes(_status_path(),
+                       json.dumps({"epoch_no": int(epoch)}).encode())
 
 
-def _load_status(payloads) -> int:
+def _load_legacy(payloads) -> int:
+    """Pre-ISSUE-3 layout: flat ``<name>.pdparams`` + status.json with no
+    step dirs.  Best-effort restore so old job dirs still resume."""
     from ...framework.io_state import load
-    if not os.path.exists(_status_path()):
+    try:
+        with open(_status_path()) as f:
+            epoch = json.load(f)["epoch_no"]
+    except (OSError, ValueError, KeyError):
         return -1
-    with open(_status_path()) as f:
-        epoch = json.load(f)["epoch_no"]
     d = _ckpt_dir()
     for name, obj in payloads.items():
         path = os.path.join(d, f"{name}.pdparams")
         if hasattr(obj, "set_state_dict") and os.path.exists(path):
             obj.set_state_dict(load(path))
+    return epoch
+
+
+def _load_status(payloads) -> int:
+    """Resume point: newest COMPLETE, checksum-verified epoch checkpoint
+    (falling back across epochs when the newest is corrupt), else the
+    legacy flat layout, else -1 (fresh run)."""
+    m = _manager()
+    try:
+        epoch, states = m.load()
+    except FileNotFoundError:
+        return _load_legacy(payloads)
+    for name, obj in payloads.items():
+        if hasattr(obj, "set_state_dict") and name in states:
+            obj.set_state_dict(states[name])
     return epoch
 
 

@@ -31,6 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..framework.tensor import Tensor
 from ..framework import functional as F
+from ..framework import flags as _flags
 from ..profiler import RecordEvent, ledger as _ledger
 from ..profiler import profiling_enabled as _prof_on
 from ..profiler import span as _span
@@ -85,7 +86,9 @@ class TrainStep:
                  batch_spec=None, compute_dtype=None,
                  localsgd_k: int = 0, localsgd_begin: int = 1,
                  dgc_sparsity: float = 0.0, dgc_momentum: float = 0.9,
-                 dgc_rampup_begin: int = 1):
+                 dgc_rampup_begin: int = 1,
+                 sentinel: bool = None, grad_scaler=None,
+                 checkpoint_manager=None):
         self.layer = layer
         self.optimizer = optimizer
         self.loss_fn = _wrap_loss(loss_fn) if loss_fn is not None else None
@@ -139,6 +142,21 @@ class TrainStep:
         self._compiled = None
         self._donate = donate
         self._seen_sigs = set()     # input signatures already compiled
+        # -- fault-tolerance runtime (ISSUE 3) --------------------------------
+        # numerics sentinel: None = follow FLAGS_train_sentinel at compile
+        # time; an explicit True composes only with the standard engine
+        # path (checked in compile()). grad_scaler: an amp.GradScaler —
+        # when enabled, the loss is scaled IN-GRAPH (scale rides as a
+        # traced operand, so scale changes never recompile), grads are
+        # unscaled before the optimizer, and the sentinel verdict drives
+        # the scaler's dynamic backoff.
+        self._sentinel_requested = sentinel
+        self._sentinel_active = False
+        self._sentinel_names = ["loss"]
+        self._bad_streak = 0
+        self._host_step = 0
+        self.grad_scaler = grad_scaler
+        self.checkpoint_manager = checkpoint_manager
 
         from .pipeline import PipelineModule
         self._pipe = layer if isinstance(layer, PipelineModule) else None
@@ -434,7 +452,7 @@ class TrainStep:
         k = self.localsgd_k
         begin = self.localsgd_begin
 
-        def step(state, inputs, label, lr):
+        def step(state, inputs, label, lr, scale):
             new_step = state["step"] + 1
             base_key = jax.random.fold_in(jax.random.key(self.seed), new_step)
 
@@ -506,7 +524,7 @@ class TrainStep:
             shape = (D,) + (1,) * (v.ndim - 1)
             return (jnp.abs(v) >= thr.reshape(shape)).astype(v.dtype)
 
-        def step(state, inputs, label, lr):
+        def step(state, inputs, label, lr, scale):
             new_step = state["step"] + 1
             base_key = jax.random.fold_in(jax.random.key(self.seed),
                                           new_step)
@@ -567,6 +585,37 @@ class TrainStep:
 
         return step
 
+    # -- numerics sentinel ----------------------------------------------------
+    def _resolve_sentinel(self) -> bool:
+        """Static (trace-time) sentinel decision — the off-path cost is
+        exactly this one Python branch, like PR 1's profiler gates."""
+        req = self._sentinel_requested
+        incompatible = self.dgc_sparsity > 0 or self._localsgd_degree() > 1
+        if req is None:
+            req = bool(_flags.flag("train_sentinel"))
+            if req and incompatible:
+                import warnings
+                warnings.warn(
+                    "FLAGS_train_sentinel: the in-graph numerics sentinel "
+                    "does not compose with the localsgd/dgc engine paths "
+                    "yet (per-rank replica state has no single "
+                    "skip-step select point); running without it")
+                req = False
+        elif req and incompatible:
+            raise ValueError(
+                "sentinel=True does not compose with localsgd/dgc: their "
+                "per-rank replica state has no single skip-step select "
+                "point in this engine")
+        return bool(req)
+
+    def _fault_nan_steps(self):
+        """Trace-time fault plan consultation (testing/faults.py): steps
+        at which every gradient leaf is overwritten with NaN IN-GRAPH, so
+        injected blow-ups travel the exact path a real one does."""
+        from ..testing.faults import active_plan
+        plan = active_plan()
+        return tuple(plan.nan_grad_steps()) if plan is not None else ()
+
     def _build_step(self):
         if self.dgc_sparsity > 0:
             return self._build_dgc_step()
@@ -583,6 +632,10 @@ class TrainStep:
                 loss_of = jax.checkpoint(loss_of, static_argnums=())
 
         acc_k = self.accumulate_steps
+        sentinel = self._sentinel_active
+        use_scaler = self.grad_scaler is not None and \
+            self.grad_scaler.is_enable()
+        nan_steps = self._fault_nan_steps()
 
         def constrain_grads(grads):
             if self._grad_shardings is None:
@@ -590,11 +643,20 @@ class TrainStep:
             return {n: jax.lax.with_sharding_constraint(
                 g, self._grad_shardings[n]) for n, g in grads.items()}
 
-        def step(state, inputs, label, lr):
+        def step(state, inputs, label, lr, scale):
             new_step = state["step"] + 1
             rng_key = jax.random.fold_in(jax.random.key(self.seed),
                                          new_step)
-            grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+            if use_scaler:
+                # loss scaling INSIDE the graph (loss_scaler.py parity for
+                # fp16): scale is a traced operand, so dynamic-scale
+                # changes never force a recompile
+                def scaled_loss_of(p, b, i, l, k):
+                    loss, nb = loss_of(p, b, i, l, k)
+                    return loss * scale, nb
+                grad_fn = jax.value_and_grad(scaled_loss_of, has_aux=True)
+            else:
+                grad_fn = jax.value_and_grad(loss_of, has_aux=True)
 
             if acc_k > 1:
                 # GradientMerge: microbatch scan accumulating grads; the
@@ -623,12 +685,47 @@ class TrainStep:
             else:
                 (loss, new_buffers), grads = grad_fn(
                     state["params"], state["buffers"], inputs, label, rng_key)
+            if use_scaler:
+                # check_finite_and_unscale parity: grads (and the reported
+                # loss) leave the scaled domain before the sentinel check
+                # and the optimizer update
+                inv = 1.0 / scale
+                grads = {n: g * inv for n, g in grads.items()}
+                loss = loss * inv
+            if nan_steps:
+                bad = jnp.zeros((), bool)
+                for s in nan_steps:
+                    bad = jnp.logical_or(bad, new_step == s)
+                grads = {n: jnp.where(bad, jnp.full_like(g, jnp.nan), g)
+                         for n, g in grads.items()}
             grads = constrain_grads(grads)
 
             new_params, new_opt = self.optimizer.functional_apply(
                 state["params"], grads, state["opt"], new_step, lr)
-            return {"params": new_params, "buffers": new_buffers,
-                    "opt": new_opt, "step": new_step}, loss
+            new_state = {"params": new_params, "buffers": new_buffers,
+                         "opt": new_opt, "step": new_step}
+            if not sentinel:
+                return new_state, loss
+            # ONE fused reduction over loss + every gradient leaf (sorted
+            # order matches self._sentinel_names); XLA folds the per-leaf
+            # isfinite/all into the epilogue of the grad all-reduce it
+            # already schedules — there is no extra HBM pass
+            finite_vec = jnp.stack(
+                [jnp.all(jnp.isfinite(loss))] +
+                [jnp.all(jnp.isfinite(grads[n])) for n in sorted(grads)])
+            finite = jnp.all(finite_vec)
+            bad_idx = jnp.argmax(jnp.logical_not(finite_vec))
+            # skip-step: a non-finite step commits NOTHING — params, opt
+            # accumulators and BN buffers all keep their previous values
+            # (a poisoned batch must not leak through running stats); the
+            # step counter alone advances so rng streams/logs move on
+            select = lambda new, old: jax.tree_util.tree_map(  # noqa: E731
+                lambda n, o: jnp.where(finite, n, o), new, old)
+            new_state = {"params": select(new_params, state["params"]),
+                         "buffers": select(new_buffers, state["buffers"]),
+                         "opt": select(new_opt, state["opt"]),
+                         "step": new_step}
+            return new_state, (loss, finite, bad_idx)
 
         return step
 
@@ -636,12 +733,18 @@ class TrainStep:
         if self._compiled is not None:
             return self._compiled
         self.state  # materialize
+        self._sentinel_active = self._resolve_sentinel()
+        if self._sentinel_active:
+            self._sentinel_names = ["loss"] + sorted(
+                self._state["params"])   # stack order of finite_vec
         step = self._build_step()
         state_shardings = dict(self._shardings)
+        rep = NamedSharding(self.mesh, P())
+        loss_out = (rep, rep, rep) if self._sentinel_active else rep
         self._compiled = jax.jit(
             step,
-            in_shardings=(state_shardings, None, None, None),
-            out_shardings=(state_shardings, NamedSharding(self.mesh, P())),
+            in_shardings=(state_shardings, None, None, None, None),
+            out_shardings=(state_shardings, loss_out),
             donate_argnums=(0,) if self._donate else (),
         )
         return self._compiled
@@ -735,9 +838,13 @@ class TrainStep:
             inputs = tuple(put(x) for x in inputs)
             label = put(label)
         fn = self.compile()
-        # host scalar (not a committed device array) so the jit treats it as
-        # process-replicated under a multi-host mesh
+        # host scalars (not committed device arrays) so the jit treats them
+        # as process-replicated under a multi-host mesh; the loss scale is
+        # a traced operand so GradScaler backoff never recompiles
         lr = np.float32(self.optimizer.get_lr())
+        scaler = self.grad_scaler if (self.grad_scaler is not None and
+                                      self.grad_scaler.is_enable()) else None
+        scale = np.float32(scaler.get_loss_scaling() if scaler else 1.0)
         # retrace detection: jax.jit silently recompiles on a new input
         # signature — ledger it like any other cache miss
         sig = (tuple(None if x is None
@@ -750,7 +857,7 @@ class TrainStep:
             self._seen_sigs.add(sig)
             t0 = time.perf_counter()
             with _span("train_step::compile"):
-                self._state, loss = fn(self.state, inputs, label, lr)
+                self._state, out = fn(self.state, inputs, label, lr, scale)
             _ledger.record_compile(site, "train_step", sig,
                                    (time.perf_counter() - t0) * 1e3)
         else:
@@ -759,12 +866,138 @@ class TrainStep:
                 # fence on the loss so the span is device time, not the
                 # async dispatch
                 with RecordEvent("train_step::device_execute"):
-                    self._state, loss = fn(self.state, inputs, label, lr)
-                    jax.block_until_ready(loss)
+                    self._state, out = fn(self.state, inputs, label, lr,
+                                          scale)
+                    jax.block_until_ready(out)
             else:
-                self._state, loss = fn(self.state, inputs, label, lr)
+                self._state, out = fn(self.state, inputs, label, lr, scale)
         self.optimizer._step_count += 1
+        self._host_step += 1
+        if self._sentinel_active:
+            loss, finite, bad_idx = out
+            self._sentinel_host_update(finite, bad_idx, scaler)
+        else:
+            loss = out
+        from ..testing.faults import active_plan as _fault_plan
+        if _fault_plan() is not None:
+            from ..testing.faults import step_hook
+            step_hook(self._host_step)
         return Tensor(loss)
+
+    # -- sentinel host side ---------------------------------------------------
+    def _sentinel_host_update(self, finite, bad_idx, scaler):
+        """Per-step bookkeeping for the in-graph sentinel: skipped-step
+        gauge, GradScaler backoff, and the bounded consecutive-bad-step
+        abort with a diagnostic dump."""
+        from ..utils.monitor import stat_add
+        if bool(finite):            # one scalar device→host read per step
+            self._bad_streak = 0
+            if scaler is not None:
+                scaler.on_step_result(False)
+            return
+        stat_add("train_skipped_steps")
+        self._bad_streak += 1
+        if scaler is not None:
+            scaler.on_step_result(True)   # decr-on-nan backoff
+        bad_name = self._sentinel_names[int(bad_idx)]
+        limit = int(_flags.flag("sentinel_max_bad_steps"))
+        if self._bad_streak < limit:
+            return
+        info = self._dump_sentinel_abort(bad_name, scaler)
+        raise FloatingPointError(
+            f"numerics sentinel: {self._bad_streak} consecutive non-finite "
+            f"train steps (limit FLAGS_sentinel_max_bad_steps={limit}); "
+            f"first non-finite tensor this step: {bad_name!r} at step "
+            f"{self._host_step}; last good checkpoint: "
+            f"{info.get('last_good_checkpoint')}")
+
+    def _dump_sentinel_abort(self, bad_name, scaler):
+        """Diagnostic dump next to the checkpoints (or PADDLE_TPU_DIAG_DIR)
+        so the post-mortem has which tensor, which step, and where to
+        resume from."""
+        import json
+        import os
+        last_good = None
+        if self.checkpoint_manager is not None:
+            s = self.checkpoint_manager.latest_step()
+            if s is not None:
+                from ..checkpoint.manager import _step_dirname
+                last_good = os.path.join(self.checkpoint_manager.root,
+                                         _step_dirname(s))
+        info = {"step": self._host_step, "bad_tensor": bad_name,
+                "consecutive_bad_steps": self._bad_streak,
+                "loss_scale": (scaler.get_loss_scaling()
+                               if scaler is not None else None),
+                "last_good_checkpoint": last_good, "wall": time.time()}
+        dump_dir = (self.checkpoint_manager.root
+                    if self.checkpoint_manager is not None
+                    else os.environ.get("PADDLE_TPU_DIAG_DIR", ""))
+        if dump_dir:
+            try:
+                from ..checkpoint.atomic import atomic_write_bytes
+                atomic_write_bytes(
+                    os.path.join(dump_dir, "sentinel_abort.json"),
+                    json.dumps(info, indent=1).encode())
+            except OSError:
+                pass                    # the raise must not be masked
+        return info
+
+    # -- checkpoint hooks -----------------------------------------------------
+    def attach_checkpoint_manager(self, manager):
+        """Bind a ``checkpoint.CheckpointManager``: save_checkpoint /
+        restore_from_checkpoint use it by default and the sentinel's
+        abort dump can name the last good checkpoint."""
+        self.checkpoint_manager = manager
+        return manager
+
+    def save_checkpoint(self, manager=None, wait=False):
+        """Atomically checkpoint the compiled state at its current step
+        (params + buffers + optimizer accumulators + step counter).
+        Returns the step number saved."""
+        m = manager or self.checkpoint_manager
+        if m is None:
+            raise ValueError("no CheckpointManager attached or passed")
+        step_no = int(self.state["step"])
+        payload = {"params": self.state["params"],
+                   "buffers": self.state["buffers"],
+                   "opt": self.state["opt"],
+                   "step": np.asarray(step_no, np.int64)}
+        for tag in ("dgc_u", "dgc_v"):  # engine-mode extras ride along
+            if tag in self.state:
+                payload[tag] = self.state[tag]
+        m.save(step_no, payload, wait=wait)
+        return step_no
+
+    def restore_from_checkpoint(self, manager=None, step=None):
+        """Restore params/buffers/opt/step from the newest complete (or
+        an explicit ``step``) checkpoint, placing every leaf back under
+        its compiled sharding.  Returns the restored step number."""
+        m = manager or self.checkpoint_manager
+        if m is None:
+            raise ValueError("no CheckpointManager attached or passed")
+        step_no, payload = m.load(step=step, return_numpy=True)
+        self.state                      # materialize shardings
+        sh = self._shardings
+        self._state = {
+            "params": {n: _global_put(np.asarray(v), sh["params"][n])
+                       for n, v in payload["params"].items()},
+            "buffers": {n: _global_put(np.asarray(v), sh["buffers"][n])
+                        for n, v in payload["buffers"].items()},
+            "opt": {s: {n: _global_put(np.asarray(v), sh["opt"][s][n])
+                        for n, v in acc.items()}
+                    for s, acc in payload["opt"].items()},
+            "step": _global_put(np.asarray(int(payload["step"]), np.int32),
+                                sh["step"]),
+        }
+        for tag in ("dgc_u", "dgc_v"):  # engine-mode extras ride along
+            if tag in payload:
+                self._state[tag] = {
+                    n: _global_put(np.asarray(v), sh[tag][n])
+                    for n, v in payload[tag].items()}
+        self.optimizer._step_count = int(payload["step"])
+        self._host_step = int(payload["step"])
+        self._bad_streak = 0
+        return step_no
 
     def sync_to_layer(self):
         """Write compiled-state params/buffers back into the eager Layer and

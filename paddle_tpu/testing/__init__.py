@@ -1,0 +1,15 @@
+"""paddle_tpu.testing: deterministic fault injection for recovery tests.
+
+SURVEY.md's failure-detection gap note: the reference ships liveness
+monitoring but *no fault injection framework* — recovery paths rot
+because nothing exercises them.  :mod:`.faults` closes that gap: an
+env-driven (``PADDLE_TPU_FAULT_PLAN``) plan of rank kills, store
+connection drops, NaN gradients and slow ranks, deterministic per seed,
+consumed by the TrainStep / TCPStore hooks and runnable standalone via
+``tools/fault_drill.py``.
+"""
+from .faults import (  # noqa: F401
+    Fault, FaultPlan, active_plan, clear_plan, install_plan, step_hook)
+
+__all__ = ["Fault", "FaultPlan", "active_plan", "install_plan",
+           "clear_plan", "step_hook"]

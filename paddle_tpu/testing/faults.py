@@ -1,0 +1,222 @@
+"""Deterministic fault-injection plans (``PADDLE_TPU_FAULT_PLAN``).
+
+A plan is a semicolon-separated list of fault clauses::
+
+    kill:rank=1,step=5; nan_grad:step=3; store_drop:op=set,at=2,count=3;
+    slow:rank=0,step=4,seconds=2; seed=7
+
+Clause kinds and their knobs:
+
+``kill``        SIGKILL this process when the step hook runs at
+                ``step`` on ``rank`` (rank omitted = every rank).
+``nan_grad``    the TrainStep injects NaN into every gradient leaf at
+                ``step`` — IN-GRAPH, so the numerics sentinel is
+                exercised exactly the way a real blow-up reaches it.
+``store_drop``  the TCPStore client hard-drops its connection right
+                before the ``at``-th matching op (1-based over ops of
+                kind ``op``; ``op=any`` matches all), ``count`` times
+                in a row — exercising the retry/reconnect path.
+``slow``        the step hook sleeps ``seconds`` at ``step`` on
+                ``rank`` — a straggler for the heartbeat watchdog.
+``seed=N``      scopes probabilistic triggers: a clause with ``p=0.3``
+                fires iff a hash of (seed, kind, occurrence-counter)
+                lands under p — deterministic across reruns and ranks,
+                no global RNG state touched.
+
+The plan is installed from the env at first use (or programmatically
+via :func:`install_plan`); every trigger decision is pure in
+(plan string, seed, call counters), so a drill reproduces bit-for-bit.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import signal
+import threading
+import time
+from typing import Dict, List, Optional
+
+_ENV = "PADDLE_TPU_FAULT_PLAN"
+
+
+def _hash01(seed: int, *parts) -> float:
+    """Deterministic uniform in [0,1) from (seed, parts)."""
+    h = hashlib.sha256(
+        ("/".join([str(seed)] + [str(p) for p in parts])).encode()).digest()
+    return int.from_bytes(h[:8], "little") / 2.0 ** 64
+
+
+class Fault:
+    """One parsed clause: ``kind`` + keyword fields."""
+
+    __slots__ = ("kind", "fields", "fired", "index")
+
+    def __init__(self, kind: str, fields: Dict[str, str], index: int = 0):
+        self.kind = kind
+        self.fields = fields
+        self.fired = 0
+        self.index = index      # clause position: the stable counter key
+
+    def get_int(self, key, default=None):
+        v = self.fields.get(key)
+        return default if v is None else int(v)
+
+    def get_float(self, key, default=None):
+        v = self.fields.get(key)
+        return default if v is None else float(v)
+
+    def matches_rank_step(self, rank: int, step: int) -> bool:
+        frank = self.get_int("rank")
+        if frank is not None and frank != rank:
+            return False
+        return self.get_int("step") == step
+
+    def __repr__(self):
+        kv = ",".join(f"{k}={v}" for k, v in sorted(self.fields.items()))
+        return f"Fault({self.kind}:{kv})"
+
+
+class FaultPlan:
+    """Parsed plan + the mutable occurrence counters trigger decisions
+    consume.  Thread-safe: store ops arrive from many threads."""
+
+    def __init__(self, faults: List[Fault], seed: int = 0, spec: str = ""):
+        self.faults = faults
+        self.seed = seed
+        self.spec = spec
+        self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- parsing ------------------------------------------------------------
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        faults, seed = [], 0
+        for clause in spec.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            if clause.startswith("seed="):
+                seed = int(clause[5:])
+                continue
+            kind, _, rest = clause.partition(":")
+            kind = kind.strip()
+            if kind not in ("kill", "nan_grad", "store_drop", "slow"):
+                raise ValueError(f"unknown fault kind {kind!r} in plan "
+                                 f"{spec!r}")
+            fields = {}
+            for kv in rest.split(","):
+                kv = kv.strip()
+                if not kv:
+                    continue
+                k, _, v = kv.partition("=")
+                fields[k.strip()] = v.strip()
+            faults.append(Fault(kind, fields, index=len(faults)))
+        return cls(faults, seed=seed, spec=spec)
+
+    def of_kind(self, kind: str) -> List[Fault]:
+        return [f for f in self.faults if f.kind == kind]
+
+    def _sampled(self, f: Fault, counter_key: str) -> bool:
+        """Apply the optional p= gate deterministically."""
+        p = f.get_float("p")
+        if p is None:
+            return True
+        with self._lock:
+            n = self._counters[counter_key] = \
+                self._counters.get(counter_key, 0) + 1
+        return _hash01(self.seed, f.kind, counter_key, n) < p
+
+    # -- trigger queries ----------------------------------------------------
+    def should_kill(self, rank: int, step: int) -> bool:
+        return any(f.matches_rank_step(rank, step)
+                   and self._sampled(f, f"kill/{rank}")
+                   for f in self.of_kind("kill"))
+
+    def nan_grad_steps(self) -> List[int]:
+        """Steps at which the TrainStep injects NaN gradients (consumed
+        at trace time: the injection is part of the compiled graph)."""
+        return [f.get_int("step") for f in self.of_kind("nan_grad")
+                if f.get_int("step") is not None]
+
+    def slow_delay(self, rank: int, step: int) -> float:
+        return sum(f.get_float("seconds", 1.0)
+                   for f in self.of_kind("slow")
+                   if f.matches_rank_step(rank, step))
+
+    def should_drop_store_op(self, op: str) -> bool:
+        """True when the TCPStore client must sever its connection before
+        sending this op.  ``at`` counts 1-based occurrences of the
+        matching op kind; ``count`` drops that many consecutive
+        occurrences (default 1)."""
+        hit = False
+        for f in self.of_kind("store_drop"):
+            fop = f.fields.get("op", "any")
+            if fop not in ("any", op):
+                continue
+            key = f"store/{fop}/{f.index}"
+            with self._lock:
+                n = self._counters[key] = self._counters.get(key, 0) + 1
+            at = f.get_int("at", 1)
+            if at <= n < at + f.get_int("count", 1) and \
+                    self._sampled(f, key + "/p"):
+                f.fired += 1
+                hit = True
+        return hit
+
+    def __repr__(self):
+        return f"FaultPlan(seed={self.seed}, {self.faults})"
+
+
+# -- process-wide active plan ------------------------------------------------
+_state = {"plan": None, "env": None, "installed": False}
+
+
+def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Programmatically set the active plan (overrides the env until
+    :func:`clear_plan`); ``install_plan(None)`` suppresses any env plan."""
+    _state["plan"] = plan
+    _state["installed"] = True
+    return plan
+
+
+def clear_plan() -> None:
+    """Drop any plan (installed or env-parsed); the env is re-read on the
+    next :func:`active_plan` call."""
+    _state["plan"] = None
+    _state["installed"] = False
+    _state["env"] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The active plan: programmatically installed, else parsed from
+    ``PADDLE_TPU_FAULT_PLAN``.  Re-parses when the env var CHANGES (so
+    monkeypatched tests get fresh counters) but keeps the same instance
+    — and its counters — while it is stable."""
+    if _state["installed"]:
+        return _state["plan"]
+    env = os.environ.get(_ENV, "")
+    if env != _state["env"]:
+        _state["env"] = env
+        _state["plan"] = FaultPlan.parse(env) if env.strip() else None
+    return _state["plan"]
+
+
+def step_hook(step: int, rank: Optional[int] = None) -> None:
+    """Host-side per-step injection point (TrainStep calls this; a custom
+    loop or drill script can too): applies ``slow`` then ``kill``.
+
+    SIGKILL — not sys.exit — because the scenario under test is a
+    preempted/OOM-killed worker: no atexit handlers, no flushes, no
+    chance for a half-written checkpoint to be 'cleaned up' into looking
+    valid.
+    """
+    plan = active_plan()
+    if plan is None:
+        return
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    delay = plan.slow_delay(rank, step)
+    if delay > 0:
+        time.sleep(delay)
+    if plan.should_kill(rank, step):
+        os.kill(os.getpid(), signal.SIGKILL)

@@ -76,3 +76,70 @@ def test_save_interval(tmp_path, monkeypatch):
     import json, os
     with open(os.path.join(str(tmp_path), "status.json")) as f:
         assert json.load(f)["epoch_no"] == 3
+
+
+def _corrupt_payload(step_dir):
+    import os
+    name = [f for f in os.listdir(step_dir) if f.endswith(".pdparams")][0]
+    with open(os.path.join(step_dir, name), "r+b") as f:
+        f.seek(12)
+        orig = f.read(2)
+        f.seek(12)
+        f.write(bytes(b ^ 0xFF for b in orig))
+
+
+def test_torn_newest_epoch_falls_back(tmp_path, monkeypatch):
+    """ISSUE 3 satellite: a corrupted newest checkpoint (the old in-place
+    .pdparams torn-write bug) must not be loaded — resume falls back to
+    the previous complete epoch."""
+    import os
+    monkeypatch.setenv("PADDLE_TPU_CHECKPOINT_DIR", str(tmp_path))
+    model, opt = _make(0)
+    rng = np.random.RandomState(0)
+    snap = {}
+    for epoch in train_epoch_range(3, model=model, opt=opt):
+        _train_one_epoch(model, opt, rng)
+        snap[epoch] = model.weight.numpy().copy()
+    # epochs 0..2 checkpointed as atomic step dirs; tear the newest
+    _corrupt_payload(str(tmp_path / "step_00000002"))
+    model2, opt2 = _make(1)
+    resumed = []
+    for epoch in train_epoch_range(5, model=model2, opt=opt2):
+        if not resumed:
+            # epoch 2's checkpoint is corrupt -> restored to epoch 1
+            assert np.allclose(model2.weight.numpy(), snap[1])
+        resumed.append(epoch)
+        _train_one_epoch(model2, opt2, rng)
+    assert resumed == [2, 3, 4]
+
+
+def test_interrupted_epoch_save_is_invisible(tmp_path, monkeypatch):
+    """A save that died before its manifest commit never resumes — the
+    manifest is the atomicity point."""
+    import os
+    monkeypatch.setenv("PADDLE_TPU_CHECKPOINT_DIR", str(tmp_path))
+    model, opt = _make(0)
+    rng = np.random.RandomState(0)
+    for epoch in train_epoch_range(2, model=model, opt=opt):
+        _train_one_epoch(model, opt, rng)
+    os.remove(str(tmp_path / "step_00000001" / "MANIFEST.json"))
+    model2, opt2 = _make(1)
+    resumed = list(train_epoch_range(4, model=model2, opt=opt2))
+    assert resumed == [1, 2, 3]        # epoch 1 save was torn: redo it
+
+
+def test_legacy_flat_layout_still_resumes(tmp_path, monkeypatch):
+    """Pre-ISSUE-3 job dirs (flat <name>.pdparams + status.json) keep
+    resuming after the wrapper became a checkpoint-subsystem consumer."""
+    import json, os
+    monkeypatch.setenv("PADDLE_TPU_CHECKPOINT_DIR", str(tmp_path))
+    from paddle_tpu.framework.io_state import save
+    model, opt = _make(0)
+    legacy_w = model.weight.numpy().copy()
+    save(model.state_dict(), str(tmp_path / "model.pdparams"))
+    with open(str(tmp_path / "status.json"), "w") as f:
+        json.dump({"epoch_no": 1}, f)
+    model2, _ = _make(1)
+    epochs = list(train_epoch_range(4, model=model2, opt=_make(1)[1]))
+    assert epochs == [2, 3]
+    assert np.allclose(model2.weight.numpy(), legacy_w)

@@ -112,3 +112,94 @@ def test_elastic_gang_restart(tmp_path):
     assert restarts[0] >= 1       # at least one whole-gang restart
     import glob as _glob
     assert len(_glob.glob(str(tmp_path / "attempt.*"))) >= 2
+
+
+def test_role_maker_auto_heartbeat(monkeypatch):
+    """PADDLE_ELASTIC_HEARTBEAT_S (exported by the launcher when its
+    watchdog is on) makes every worker publish liveness as soon as it has
+    a store — no training-script changes."""
+    from paddle_tpu.distributed.fleet.base.role_maker import \
+        PaddleCloudRoleMaker
+    import socket as _socket
+    s = _socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    monkeypatch.setenv("PADDLE_ELASTIC_HEARTBEAT_S", "0.1")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    monkeypatch.setenv("PADDLE_STORE_PORT", str(port))
+    rm = PaddleCloudRoleMaker(is_collective=True)
+    store = rm._ensure_store()
+    try:
+        time.sleep(0.3)
+        assert HeartbeatMonitor(store, 1, stale_after=1.0).stale_ranks() \
+            == []
+    finally:
+        rm._heartbeat.stop()
+        store.close()
+
+
+def test_elastic_watchdog_real_heartbeats(tmp_path):
+    """ISSUE 3 satellite E2E: a rank that hangs before ever reaching
+    rendezvous (no heartbeat) is evicted by the launcher-side monitor and
+    the whole gang relaunched — process polling alone would wait forever.
+    Uses real HeartbeatReporter/TCPStore traffic, the lazy monitor
+    factory the launch CLI uses, and SIGKILL eviction."""
+    import socket as _socket
+    import subprocess
+    from paddle_tpu.distributed.fleet.base.tcp_store import TCPStore
+    s = _socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    worker = (
+        "import os, sys, time\n"
+        "sys.path.insert(0, {repo!r})\n"
+        "from paddle_tpu.distributed.fleet.base.tcp_store import TCPStore\n"
+        "from paddle_tpu.distributed.fleet.elastic import HeartbeatReporter\n"
+        "rank, port, attempt = (int(a) for a in sys.argv[1:4])\n"
+        "if rank == 1 and attempt == 0:\n"
+        "    time.sleep(120)            # hung before rendezvous: no store,"
+        " no heartbeat\n"
+        "store = TCPStore('127.0.0.1', port, is_master=(rank == 0),"
+        " timeout=30.0)\n"
+        "hb = HeartbeatReporter(store, rank, interval=0.1).start()\n"
+        "time.sleep(1.0)\n"
+        "hb.stop()\n"
+        "raise SystemExit(0)\n").format(
+            repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    script = tmp_path / "hb_worker.py"
+    script.write_text(worker)
+
+    supervisor = []
+
+    def spawn(local):
+        attempt = supervisor[0].generation if supervisor else 0
+        return subprocess.Popen(
+            [sys.executable, str(script), str(local), str(port),
+             str(attempt)])
+
+    state = {}
+
+    def monitor_factory():
+        if "m" in state:
+            return state["m"]
+        try:
+            client = TCPStore("127.0.0.1", port, timeout=1.0)
+            state["m"] = HeartbeatMonitor(client, 2, stale_after=1.0)
+        except Exception:
+            return None
+        return state["m"]
+
+    el = ElasticLaunch(spawn, 2, max_restarts=2, poll_s=0.1, gang=True,
+                       monitor=monitor_factory, watchdog_warmup=1.5)
+    supervisor.append(el)
+    t0 = time.time()
+    rc, restarts = el.run()
+    assert rc == 0
+    assert restarts[0] == 1
+    assert time.time() - t0 < 60
+    from paddle_tpu.utils.monitor import stat_get
+    assert stat_get("elastic_restart_generation") >= 1

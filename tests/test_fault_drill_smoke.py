@@ -1,0 +1,51 @@
+"""Smoke-gate for the fault drill (ISSUE 3 satellite: CI/tooling).
+
+``tools/fault_drill.py --dry`` runs every fault scenario — torn
+checkpoint, in-graph NaN, store connection drops, slow rank, SIGKILL +
+elastic resume — at toy scale on the CPU mesh, so the recovery harness
+can't silently rot between rounds (the exact failure SURVEY.md flags in
+the reference: liveness machinery with no fault injection exercising
+it).  slow-marked: kill_resume spawns four interpreter+jax startups,
+which tier-1 (``-m 'not slow'``) must not pay.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_fault_drill_dry_runs_end_to_end():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PADDLE_TPU_FAULT_PLAN", None)   # the drill owns its plans
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fault_drill.py"),
+         "--dry"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [json.loads(ln) for ln in p.stdout.splitlines() if ln.strip()]
+    names = {r["scenario"] for r in lines}
+    assert names == {"torn_checkpoint", "nan_sentinel", "store_drop",
+                     "slow_step", "kill_resume"}
+    for r in lines:
+        assert r["ok"] is True, r
+        assert r["dry"] is True
+    kr = next(r for r in lines if r["scenario"] == "kill_resume")
+    assert kr["restarts"] >= 1 and kr["params_match_uninterrupted"]
+
+
+@pytest.mark.slow
+def test_fault_drill_single_scenario():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PADDLE_TPU_FAULT_PLAN", None)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fault_drill.py"),
+         "--dry", "store_drop"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [json.loads(ln) for ln in p.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1 and lines[0]["scenario"] == "store_drop"

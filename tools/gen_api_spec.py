@@ -35,6 +35,8 @@ NAMESPACES = [
     ("paddle_tpu.inference", None),
     ("paddle_tpu.regularizer", None),
     ("paddle_tpu.incubate", None),
+    ("paddle_tpu.checkpoint", None),
+    ("paddle_tpu.testing", None),
 ]
 
 
