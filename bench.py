@@ -387,12 +387,224 @@ def bench_wide_deep(on_tpu):
     return res
 
 
+# -- 6. Inference serving (Predictor latency suite, VERDICT r5 #4) -----------
+
+def _infer_lat_ms(predictor, x, iters):
+    """Best-of-iters single-run latency through Predictor.run (the host
+    serving path: feed dict + dispatch + D2H fetch per call)."""
+    best = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = predictor.run(x)
+        float(np.asarray(out[0]).ravel()[0])      # D2H fence
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best * 1e3
+
+
+def _in_graph_infer_ms(predictor, x, k=8, reps=2):
+    """Per-inference time with K forwards chained into ONE dispatch (the
+    in-graph-first discipline of the train benches, PERF.md round-5): a
+    tiny carry-scaled perturbation of the float input chains iteration
+    i+1 on iteration i's output so XLA cannot hoist the loop-invariant
+    call, and one scalar fence ends the dispatch.  Float-input models
+    only (perturbing token ids would change the gather)."""
+    import jax
+    import jax.numpy as jnp
+    tl = predictor._translated
+    if tl is None or not np.issubdtype(np.asarray(x[0]).dtype, np.floating):
+        raise RuntimeError("in-graph probe needs a jit-served float-input "
+                           "model")
+    arrs = [jnp.asarray(a) for a in x]
+    params = [jnp.asarray(p) for p in tl._params]
+    call = tl._exported.call
+
+    def loop(x0, kk):
+        def one(_, c):
+            xc, acc = c
+            out = call(xc, *arrs[1:], *params)
+            o0 = out[0] if isinstance(out, (list, tuple)) else out
+            s = jnp.sum(o0.astype(jnp.float32))
+            return xc + s * jnp.float32(1e-24), acc + s
+        return jax.lax.fori_loop(0, kk, one, (x0, jnp.float32(0.0)))[1]
+
+    f = jax.jit(loop, static_argnums=(1,))
+    float(f(arrs[0], k))                         # compile + warm
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(f(arrs[0], k))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best / k * 1e3
+
+
+def _bench_one_served_model(name, build, spec_of, batch1, batch_max,
+                            unit, on_tpu, int8=False):
+    """Export (jit.save; frozen int8 form when ``int8``), serve through
+    the Predictor, and measure the four serving numbers: cold-compile
+    latency, warm-cache latency (same persistent compilation cache — the
+    jax analogue of a second serving process over one AOT cache dir),
+    batch-1 latency, max-batch throughput."""
+    import tempfile
+    import paddle_tpu as paddle
+    from paddle_tpu import inference
+    from paddle_tpu.framework.flags import set_flags
+
+    model, make_inputs = build()
+    model.eval()
+    res = {"int8": int8}
+
+    def export(prefix, spec):
+        if int8:
+            from paddle_tpu.quantization import save_int8_model
+            save_int8_model(model, prefix, input_spec=spec)
+        else:
+            paddle.jit.save(model, prefix, input_spec=spec)
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "m")
+        if int8:
+            from paddle_tpu.quantization import PostTrainingQuantization
+            x_cal = make_inputs(batch1)
+
+            def loader():
+                for _ in range(4):
+                    yield tuple(paddle.to_tensor(a) for a in x_cal)
+
+            PostTrainingQuantization(model=model, data_loader=loader(),
+                                     batch_nums=4).quantize()
+            set_flags({"FLAGS_use_int8_inference": True})
+        export(prefix, spec_of(batch1))
+        try:
+            x1 = make_inputs(batch1)
+            t0 = time.perf_counter()
+            p = inference.create_predictor(inference.Config(d))
+            p.run(x1)
+            res["cold_compile_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+            t0 = time.perf_counter()
+            p2 = inference.create_predictor(inference.Config(d))
+            p2.run(x1)
+            res["warm_cache_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+            iters = 20 if on_tpu else 3
+            res["batch1_ms"] = round(_infer_lat_ms(p, x1, iters), 3)
+            try:
+                res["batch1_in_graph_ms"] = round(
+                    _in_graph_infer_ms(p, x1), 3)
+                res["value_source"] = "in_graph"
+            except Exception as e:       # noqa: BLE001 — diagnostic only
+                _note(f"[bench] inference/{name} in-graph probe "
+                      f"skipped: {e}")
+            xmax = make_inputs(batch_max)
+            try:
+                lat_s = _infer_lat_ms(p, xmax, iters) / 1e3
+            except Exception:
+                # fixed-batch export (shape-poly unsupported model, e.g.
+                # the transformer mask compare): re-export at batch_max
+                d2 = os.path.join(d, "maxb")
+                os.makedirs(d2, exist_ok=True)
+                export(os.path.join(d2, "m"), spec_of(batch_max))
+                pmax = inference.create_predictor(inference.Config(d2))
+                pmax.run(xmax)           # compile outside the timed region
+                lat_s = _infer_lat_ms(pmax, xmax, iters) / 1e3
+            res["max_batch"] = batch_max
+            res["max_batch_throughput"] = round(batch_max / lat_s, 1)
+            res["throughput_unit"] = unit
+        finally:
+            if int8:
+                set_flags({"FLAGS_use_int8_inference": False})
+    return res
+
+
+def bench_inference(on_tpu):
+    """Serving latency/throughput for three deploy shapes (LeNet /
+    ResNet-block / BERT) plus the frozen-int8 LeNet (ISSUE 4): the
+    numbers PERF.md's int8 section tracks round-over-round."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+
+    rng = np.random.RandomState(0)
+
+    def lenet():
+        from paddle_tpu.vision.models import LeNet
+        m = LeNet()
+        return m, lambda b: [rng.randn(b, 1, 28, 28).astype("float32")]
+
+    lenet_spec = lambda b: [InputSpec([None, 1, 28, 28])]   # noqa: E731
+
+    ch, hw = (64, 56) if on_tpu else (8, 8)
+
+    def resnet_block():
+        class Block(nn.Layer):
+            """One residual conv-BN-ReLU pair — the high-res ResNet
+            stage shape the fused-conv rounds profile."""
+
+            def __init__(self):
+                super().__init__()
+                self.c1 = nn.Conv2D(ch, ch, 3, padding=1, bias_attr=False)
+                self.b1 = nn.BatchNorm2D(ch)
+                self.c2 = nn.Conv2D(ch, ch, 3, padding=1, bias_attr=False)
+                self.b2 = nn.BatchNorm2D(ch)
+                self.relu = nn.ReLU()
+
+            def forward(self, x):
+                h = self.relu(self.b1(self.c1(x)))
+                return self.relu(self.b2(self.c2(h)) + x)
+
+        m = Block()
+        return m, lambda b: [rng.randn(b, ch, hw, hw).astype("float32")]
+
+    resnet_spec = lambda b: [InputSpec([None, ch, hw, hw])]   # noqa: E731
+
+    from paddle_tpu.text.models.bert import BertConfig, BertModel
+    cfg = BertConfig.base() if on_tpu else BertConfig.tiny(seq=32)
+    seq = 128 if on_tpu else 32
+
+    def bert():
+        m = BertModel(cfg)
+        return m, lambda b: [rng.randint(
+            0, cfg.vocab_size, (b, seq)).astype("int64")]
+
+    # fixed batch: the encoder's additive-mask compare defeats shape
+    # polymorphism, so each serving batch is its own export
+    bert_spec = lambda b: [InputSpec([b, seq], dtype="int64")]  # noqa: E731
+
+    b1 = 1
+    plans = [
+        ("lenet", lenet, lenet_spec, b1, 2048 if on_tpu else 8, "img/s"),
+        ("lenet_int8", lenet, lenet_spec, b1, 2048 if on_tpu else 8,
+         "img/s"),
+        ("resnet_block", resnet_block, resnet_spec, b1,
+         256 if on_tpu else 4, "img/s"),
+        ("bert", bert, bert_spec, b1, 64 if on_tpu else 2, "seq/s"),
+    ]
+    models = {}
+    for name, build, spec, bs1, bsmax, unit in plans:
+        try:
+            models[name] = _bench_one_served_model(
+                name, build, spec, bs1, bsmax, unit, on_tpu,
+                int8=name.endswith("_int8"))
+        except Exception as e:           # noqa: BLE001 — per-model record
+            _note(f"[bench] inference/{name}: {type(e).__name__}: {e}")
+            models[name] = {"error": f"{type(e).__name__}: {e}"}
+    res = {"unit": "ms", "models": models}
+    f32 = models.get("lenet", {}).get("batch1_ms")
+    i8 = models.get("lenet_int8", {}).get("batch1_ms")
+    if f32 and i8:
+        res["lenet_int8_speedup_batch1"] = round(f32 / i8, 3)
+    return res
+
+
 WORKLOADS = [
     ("mnist_lenet_static", bench_lenet_static),
     ("resnet50_dygraph", bench_resnet50),
     ("bert_base_pretrain", bench_bert),
     ("transformer_big", bench_transformer_big),
     ("wide_deep_ctr", bench_wide_deep),
+    ("inference", bench_inference),
 ]
 
 

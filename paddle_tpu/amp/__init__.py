@@ -33,6 +33,10 @@ BLACK_LIST = {"exp", "log", "softmax", "log_softmax",
               "batch_norm_eval", "reduce_sum", "reduce_mean", "cumsum",
               "elementwise_pow", "p_norm", "frobenius_norm", "bce_loss",
               "kldiv_loss", "log_loss"}
+# int8 inference sites (ops/int8.py): autocast must neither down-cast the
+# fp32 scale/bias epilogue operands nor up-cast the int8 tensors — the
+# integer dot IS the precision contract.  Exempt even under O2.
+AMP_EXEMPT = {"linear_int8", "conv2d_int8", "matmul_int8"}
 
 
 class AmpState:
@@ -51,6 +55,8 @@ class AmpState:
         """'low' -> cast fp32 inputs to amp dtype; 'high' -> cast to fp32;
         None -> leave as-is. O2 casts everything but the black list."""
         if not self.enable:
+            return None
+        if op_name in AMP_EXEMPT:
             return None
         if op_name in self.black:
             return "high"

@@ -164,6 +164,25 @@ define_flag("store_retry_backoff", 0.05,
             "Base delay (seconds) of the TCPStore retry backoff; attempt "
             "k sleeps base * 2^k plus up to 50% deterministic jitter.",
             validator=lambda v: float(v) > 0)
+define_flag("use_int8_inference",
+            os.environ.get("PADDLE_TPU_INT8", "").lower()
+            in ("1", "true", "yes", "on"),
+            "Serve frozen int8 inference programs: the Predictor prefers a "
+            "model prefix's '.int8' sibling artifact (quantization/"
+            "freeze.py save_int8_model) and keys its AOT executable cache "
+            "on the quant signature so int8 and float executables never "
+            "collide. Off-path cost: one Python branch at predictor "
+            "construction. Seeded by PADDLE_TPU_INT8.")
+define_flag("wide_deep_device_dedup",
+            os.environ.get("PADDLE_TPU_WD_DEDUP", "").lower()
+            in ("1", "true", "yes", "on"),
+            "Wide&Deep cached-mode id dedup runs ON DEVICE (static-shape "
+            "sort-based unique + segment-ids, rec/wide_deep.py) instead of "
+            "host np.unique over the full B*S id block; the host resolves "
+            "only the deduped prefix against the hot-row cache. OFF by "
+            "default pending a chip measurement (PERF.md int8/dedup "
+            "round); the hot-row cache and capacity behavior are "
+            "unchanged. Seeded by PADDLE_TPU_WD_DEDUP.")
 define_flag("jit_ledger_dir",
             os.environ.get("PADDLE_TPU_JIT_LEDGER_DIR", ""),
             "When non-empty, recompile-ledger events (profiler.ledger) "

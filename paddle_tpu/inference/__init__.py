@@ -36,6 +36,7 @@ class Config:
         self._memory_optim = True
         self._glog_info = False
         self._optim_cache_dir = None
+        self._quant_signature = None
 
     def set_optim_cache_dir(self, path):
         """AnalysisConfig::SetOptimCacheDir parity: compiled PJRT
@@ -46,10 +47,22 @@ class Config:
     def optim_cache_dir(self):
         return self._optim_cache_dir
 
+    def set_quant_signature(self, signature):
+        """Pin the quantization signature mixed into the AOT executable
+        cache key (quantization.freeze.quant_signature). Normally read
+        from the model's ``.quant.json`` sidecar automatically; set it
+        explicitly for hand-assembled int8 programs."""
+        self._quant_signature = signature
+
+    def quant_signature(self):
+        return self._quant_signature
+
     def set_model(self, prog_file, params_file=None):
         cache_dir = self._optim_cache_dir
+        quant_sig = self._quant_signature
         self.__init__(prog_file, params_file)
         self._optim_cache_dir = cache_dir
+        self._quant_signature = quant_sig
 
     def model_dir(self):
         return self._model_dir
@@ -106,6 +119,8 @@ class Predictor:
     """AnalysisPredictor parity over the static Executor's compiled replay."""
 
     def __init__(self, config: Config):
+        from ..framework.flags import flag
+        from ..quantization.freeze import load_quant_sidecar
         from ..static.io import load_inference_model
         from ..static.executor import Executor
         d = config.model_dir() or config.prog_file()
@@ -113,8 +128,18 @@ class Predictor:
             raise ValueError("Config needs a model dir (save_inference_model"
                              " output or jit.save prefix dir)")
         self._translated = None
+        self._quant_info = None
         prefix = self._jit_prefix(d)
         if prefix is not None:
+            # int8 serving (FLAGS_use_int8_inference / PADDLE_TPU_INT8):
+            # prefer the frozen '.int8' sibling artifact when present —
+            # the off-path is this one branch
+            if flag("use_int8_inference") and not prefix.endswith(".int8") \
+                    and os.path.isfile(prefix + ".int8.pdmodel"):
+                self._quant_info = load_quant_sidecar(prefix)
+                prefix = prefix + ".int8"
+            elif prefix.endswith(".int8"):
+                self._quant_info = load_quant_sidecar(prefix[:-len(".int8")])
             # jit.save'd model (StableHLO + params): dynamic dims exported
             # as symbolic shapes, so any batch size runs without recompile
             from .. import jit as _jit
@@ -130,8 +155,20 @@ class Predictor:
             self._exe = Executor()
             if config.optim_cache_dir():
                 self._exe.set_aot_cache_dir(config.optim_cache_dir())
+            # AOT executable cache keys on the quant signature so int8 and
+            # float programs sharing one cache dir never collide
+            sig = config.quant_signature()
+            if sig is None and self._quant_info:
+                sig = self._quant_info.get("signature")
+            if sig is not None:
+                self._exe.set_cache_extra_key(f"quant:{sig}")
         self._feeds: Dict[str, np.ndarray] = {}
         self._results: Dict[str, np.ndarray] = {}
+
+    def quant_info(self):
+        """The served model's quantization sidecar (quant.json) when the
+        int8 artifact was selected; None on the float path."""
+        return self._quant_info
 
     def clone(self):
         """AnalysisPredictor::Clone parity (analysis_predictor.h:214):
@@ -155,9 +192,17 @@ class Predictor:
             return d[:-len(".pdmodel")]
         if os.path.isfile(d + ".pdmodel"):
             return d
+        if os.path.isfile(d + ".int8.pdmodel"):
+            return d + ".int8"      # int8-only export: serve what exists
         if os.path.isdir(d) and not os.path.exists(
                 os.path.join(d, "__model__")):
-            pdm = sorted(glob.glob(os.path.join(d, "*.pdmodel")))
+            # '.int8' siblings are variants of a float prefix, not models
+            # of their own — the int8 branch above opts into them
+            pdm = sorted(p for p in glob.glob(os.path.join(d, "*.pdmodel"))
+                         if not p.endswith(".int8.pdmodel"))
+            if pdm:
+                return pdm[0][:-len(".pdmodel")]
+            pdm = sorted(glob.glob(os.path.join(d, "*.int8.pdmodel")))
             if pdm:
                 return pdm[0][:-len(".pdmodel")]
         return None

@@ -285,6 +285,13 @@ class TranslatedLayer:
 
     forward = __call__
 
+    def mlir_module(self):
+        """The exported StableHLO as text — inspection surface for deploy
+        checks (e.g. asserting a frozen model really lowered to integer
+        dot/conv: look for i8 operands feeding stablehlo.dot_general /
+        stablehlo.convolution with an i32 accumulator)."""
+        return str(self._exported.mlir_module())
+
     def eval(self):
         return self
 

@@ -34,6 +34,9 @@ from .industrial import (  # noqa: F401
     attention_lstm, filter_by_instag, match_matrix_tensor,
     sequence_topk_avg_pooling, var_conv_2d,
 )
+from .int8 import (  # noqa: F401
+    linear_int8, conv2d_int8, matmul_int8,
+)
 from .longtail import (  # noqa: F401
     rank_attention, pyramid_hash, tree_conv, correlation, prroi_pool,
     similarity_focus, deformable_psroi_pooling, roi_perspective_transform,
@@ -41,7 +44,7 @@ from .longtail import (  # noqa: F401
 )
 from . import (  # noqa: F401
     creation, math, manipulation, linalg, control_flow, math_ext, sequence,
-    detection, vision, decode,
+    detection, vision, decode, int8,
 )
 from .patch import apply_patches as _apply_patches
 

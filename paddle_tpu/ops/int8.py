@@ -1,0 +1,157 @@
+"""Int8 inference primitives: true integer MXU compute.
+
+Reference parity: the execution half of the slim deploy story —
+QuantizationFreezePass rewrites matmul/conv sites to the int8 kernels of
+operators/fake_dequantize_op.cc + the cuDNN/TensorRT int8 engines.  On TPU
+the MXU consumes int8 operands natively: ``lax.dot_general`` /
+``lax.conv_general_dilated`` with ``preferred_element_type=jnp.int32``
+emit integer dot/convolution StableHLO (i8×i8→i32 systolic passes, 2-4x
+the bf16 MACs/cycle), and the requantize/dequantize epilogue is a cheap
+VPU multiply fused by XLA onto the accumulator tiles.
+
+These primitives are the first place the repo emits integer-compute HLO
+rather than float-with-simulated-rounding.  They are inference-only
+(``differentiable=False``) and AMP-exempt: autocast must never touch the
+int8 operands or the fp32 scale epilogue (amp/__init__.py AMP_EXEMPT).
+
+Quantization convention (shared with quantization/functional.py):
+symmetric, qmax = 2^(bits-1)-1 = 127; activations clip to [-scale, scale]
+before rounding (the fake-QDQ contract, so frozen numerics match the QAT
+simulation bit-for-bit up to float associativity).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.primitive import Primitive
+
+QMAX_INT8 = 127.0
+
+
+def _quantize_act(x, scale, qmax):
+    """fp -> int8 on the activation path: clip to the calibrated range,
+    round-half-away like the fake-QDQ ops (jnp.round matches)."""
+    s = jnp.maximum(scale, 1e-9).astype(jnp.float32)
+    q = jnp.round(jnp.clip(x.astype(jnp.float32) / s, -1.0, 1.0) * qmax)
+    return q.astype(jnp.int8), s
+
+
+def _epilogue(acc_i32, s_x, s_w, qmax, bias, out_scale, out_dtype):
+    """ONE fused requantize/dequantize epilogue over the int32 accumulator:
+    dequant by (s_x/qmax)*(s_w/qmax), add bias, and — when the freeze pass
+    recorded an out-scale for this site — requantize the output onto the
+    int8 grid of the NEXT layer's input (the reference's quantize_op after
+    dequantize fold, one round+mul here instead of a QDQ pair)."""
+    deq = acc_i32.astype(jnp.float32) * (s_x / qmax) * (s_w / qmax)
+    if bias is not None:
+        deq = deq + bias.astype(jnp.float32)
+    if out_scale is not None:
+        so = jnp.maximum(out_scale, 1e-9).astype(jnp.float32)
+        deq = jnp.round(jnp.clip(deq / so, -1.0, 1.0) * qmax) * (so / qmax)
+    return deq.astype(out_dtype)
+
+
+def _linear_int8_fn(x, w_q, s_x, s_w, *rest, bits=8, has_bias=False,
+                    has_out_scale=False, dynamic=False):
+    """x [.., in] fp; w_q [in, out] int8; s_w [1, out] (per-channel) or
+    scalar (per-tensor); s_x scalar.  int8×int8→int32 on the MXU."""
+    qmax = float(2 ** (bits - 1) - 1)
+    rest = list(rest)
+    bias = rest.pop(0) if has_bias else None
+    out_scale = rest.pop(0) if has_out_scale else None
+    if dynamic:
+        s_x = jnp.max(jnp.abs(x))
+    x_q, s_x = _quantize_act(x, s_x, qmax)
+    acc = jax.lax.dot_general(
+        x_q, w_q,
+        dimension_numbers=(((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    s_w = jnp.reshape(s_w.astype(jnp.float32), (-1,))   # broadcast over out
+    return _epilogue(acc, s_x, s_w, qmax, bias, out_scale, x.dtype)
+
+
+_linear_int8_p = Primitive("linear_int8", _linear_int8_fn,
+                           differentiable=False)
+
+
+def linear_int8(x, w_q, s_x, s_w, bias=None, out_scale=None, bits=8,
+                dynamic=False):
+    """Frozen linear site: quantize input at ``s_x`` (or dynamically when
+    ``dynamic``), int8 matmul with int32 accumulation, fused epilogue."""
+    args = [x, w_q, s_x, s_w]
+    if bias is not None:
+        args.append(bias)
+    if out_scale is not None:
+        args.append(out_scale)
+    return _linear_int8_p(*args, bits=int(bits), has_bias=bias is not None,
+                          has_out_scale=out_scale is not None,
+                          dynamic=bool(dynamic))
+
+
+def _conv2d_int8_fn(x, w_q, s_x, s_w, *rest, bits=8, has_bias=False,
+                    has_out_scale=False, dynamic=False, stride=(1, 1),
+                    padding="VALID", dilation=(1, 1), groups=1,
+                    channel_last=False):
+    """x NCHW/NHWC fp; w_q OIHW int8; s_w [O] per-channel or scalar."""
+    qmax = float(2 ** (bits - 1) - 1)
+    rest = list(rest)
+    bias = rest.pop(0) if has_bias else None
+    out_scale = rest.pop(0) if has_out_scale else None
+    if dynamic:
+        s_x = jnp.max(jnp.abs(x))
+    x_q, s_x = _quantize_act(x, s_x, qmax)
+    if channel_last:
+        w_q = jnp.transpose(w_q, (2, 3, 1, 0))          # OIHW -> HWIO
+        specs = ("NHWC", "HWIO", "NHWC")
+    else:
+        specs = ("NCHW", "OIHW", "NCHW")
+    dn = jax.lax.conv_dimension_numbers(x_q.shape, w_q.shape, specs)
+    acc = jax.lax.conv_general_dilated(
+        x_q, w_q, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups, preferred_element_type=jnp.int32)
+    cshape = (1, 1, 1, -1) if channel_last else (1, -1, 1, 1)
+    s_w = jnp.reshape(s_w.astype(jnp.float32), cshape)
+    if bias is not None:
+        bias = jnp.reshape(bias, cshape)
+    if out_scale is not None and out_scale.ndim:
+        out_scale = jnp.reshape(out_scale, ())
+    return _epilogue(acc, s_x, s_w, qmax, bias, out_scale, x.dtype)
+
+
+_conv2d_int8_p = Primitive("conv2d_int8", _conv2d_int8_fn,
+                           differentiable=False)
+
+
+def conv2d_int8(x, w_q, s_x, s_w, bias=None, out_scale=None, bits=8,
+                dynamic=False, stride=(1, 1), padding="VALID",
+                dilation=(1, 1), groups=1, channel_last=False):
+    """Frozen conv2d site (weights OIHW int8, per-output-channel scales)."""
+    args = [x, w_q, s_x, s_w]
+    if bias is not None:
+        args.append(bias)
+    if out_scale is not None:
+        args.append(out_scale)
+    return _conv2d_int8_p(
+        *args, bits=int(bits), has_bias=bias is not None,
+        has_out_scale=out_scale is not None, dynamic=bool(dynamic),
+        stride=tuple(int(s) for s in stride), padding=padding,
+        dilation=tuple(int(d) for d in dilation), groups=int(groups),
+        channel_last=bool(channel_last))
+
+
+def _matmul_int8_fn(a_q, b_q):
+    return jax.lax.dot_general(
+        a_q, b_q, dimension_numbers=(((a_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+_matmul_int8_p = Primitive("matmul_int8", _matmul_int8_fn,
+                           differentiable=False)
+
+
+def matmul_int8(a_q, b_q):
+    """Raw int8×int8→int32 matmul (no epilogue) — the building block the
+    frozen sites compose; exposed for custom int8 graphs."""
+    return _matmul_int8_p(a_q, b_q)

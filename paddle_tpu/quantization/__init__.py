@@ -18,3 +18,7 @@ from .quant_layers import (  # noqa: F401
 )
 from .qat import ImperativeQuantAware, ImperativeCalcOutScale  # noqa: F401
 from .ptq import PostTrainingQuantization, WeightQuantization  # noqa: F401
+from .freeze import (  # noqa: F401
+    QuantizationFreezePass, FrozenQuantizedLinear, FrozenQuantizedConv2D,
+    freeze, save_int8_model, quant_signature, load_quant_sidecar,
+)

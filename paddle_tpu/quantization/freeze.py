@@ -1,0 +1,294 @@
+"""Quantization freeze pass: QAT/PTQ artifacts -> deployable int8 programs.
+
+Reference parity: python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py:1045 (QuantizationFreezePass: fold collected scales
+into true int8 weight tensors, strip fake_quantize_dequantize ops, rewrite
+the consuming matmul/conv to the int8 kernels, insert one dequantize with
+the recorded out-scale) plus ConvertToInt8Pass (:1352).
+
+TPU-shape: the pass walks the imperative model (the repo's QAT/PTQ form)
+instead of an IrGraph.  Each ``QuantizedLinear``/``QuantizedConv2D`` —
+optionally under an out-scale collector — becomes a Frozen* layer holding
+int8 weights + fp32 scales whose forward is ONE int8 primitive
+(ops/int8.py): quantize-at-scale, i8×i8→i32 MXU dot/conv, fused
+requantize/dequantize epilogue.  ``jit.save`` of the frozen model then
+exports integer-compute StableHLO, which is the "frozen Program" the
+Predictor serves (see inference/__init__.py int8 selection).
+
+Numerics contract: with the same collected scales the frozen output equals
+the fake-QDQ simulation up to float associativity — the int8 rounding
+happens at the same two points (input at s_x, weight at s_w), only the
+compute dtype changes from simulated-fp32 to real int8/int32.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import functional as QF
+from .quant_layers import (FakeQuantAbsMax, FakeQuantMovingAverage,
+                           FakeChannelWiseQuantDequantAbsMax,
+                           QuantizedConv2D, QuantizedLinear)
+
+
+def _static_input_scale(fq):
+    """Collected input scale of a QAT/PTQ activation quantizer, or None
+    when the quantizer is dynamic (per-batch abs-max)."""
+    if isinstance(fq, FakeQuantMovingAverage):
+        return np.asarray(fq.scale.numpy(), np.float32).reshape(())
+    return None
+
+
+def _weight_quant(fq, weight, default_axis, bits):
+    """(w_q int8, s_w fp32, per_channel) folding the weight quantizer's
+    config into true int8 storage (quantize_weight_int8)."""
+    if isinstance(fq, FakeChannelWiseQuantDequantAbsMax):
+        axis = getattr(fq, "_quant_axis", default_axis)
+        q, s = QF.quantize_weight_int8(weight, quant_axis=axis,
+                                       bit_length=bits)
+        return q, s, True
+    q, s = QF.quantize_weight_int8(weight, quant_axis=None, bit_length=bits)
+    return q, s, False
+
+
+class FrozenQuantizedLinear(Layer):
+    """A frozen linear rewrite site: int8 weight [in, out], per-channel
+    (axis=1) or per-tensor scales, forward = ops.int8.linear_int8.
+
+    The collected out-scale is always recorded in the ``out_scale``
+    buffer (engines and the quant signature read it); it only enters the
+    epilogue as a requantize step when ``fold_out_scale`` — strict int8
+    activation dataflow, an EXTRA rounding vs the fake-quant training
+    simulation (see QuantizationFreezePass)."""
+
+    def __init__(self, qlayer: QuantizedLinear, weight_bits=8,
+                 activation_bits=8, out_scale=None, fold_out_scale=False):
+        super().__init__()
+        self._weight_bits = int(weight_bits)
+        self._activation_bits = int(activation_bits)
+        w_q, s_w, self._per_channel = _weight_quant(
+            qlayer._fake_quant_weight, qlayer.weight, 1, weight_bits)
+        self.register_buffer("weight_int8", w_q)
+        self.register_buffer("weight_scale",
+                             Tensor(np.asarray(s_w.numpy(), np.float32)
+                                    .reshape(-1)))
+        s_x = _static_input_scale(qlayer._fake_quant_input)
+        self._dynamic = s_x is None
+        self.register_buffer("input_scale", Tensor(
+            np.float32(1.0) if s_x is None else s_x))
+        self.bias = qlayer.bias
+        self._has_out_scale = out_scale is not None and fold_out_scale
+        self.register_buffer("out_scale", Tensor(
+            np.float32(out_scale) if out_scale is not None
+            else np.float32(0.0)))
+
+    def forward(self, x):
+        from ..ops import int8 as I8
+        return I8.linear_int8(
+            x, self.weight_int8, self.input_scale, self.weight_scale,
+            bias=self.bias,
+            out_scale=self.out_scale if self._has_out_scale else None,
+            bits=self._activation_bits, dynamic=self._dynamic)
+
+
+class FrozenQuantizedConv2D(Layer):
+    """A frozen conv2d rewrite site: int8 OIHW weight, per-output-channel
+    scales (quant_axis=0), forward = ops.int8.conv2d_int8."""
+
+    def __init__(self, qlayer: QuantizedConv2D, weight_bits=8,
+                 activation_bits=8, out_scale=None, fold_out_scale=False):
+        super().__init__()
+        self._weight_bits = int(weight_bits)
+        self._activation_bits = int(activation_bits)
+        w_q, s_w, self._per_channel = _weight_quant(
+            qlayer._fake_quant_weight, qlayer.weight, 0, weight_bits)
+        self.register_buffer("weight_int8", w_q)
+        self.register_buffer("weight_scale",
+                             Tensor(np.asarray(s_w.numpy(), np.float32)
+                                    .reshape(-1)))
+        s_x = _static_input_scale(qlayer._fake_quant_input)
+        self._dynamic = s_x is None
+        self.register_buffer("input_scale", Tensor(
+            np.float32(1.0) if s_x is None else s_x))
+        self.bias = qlayer.bias
+        self._stride = qlayer._stride
+        self._padding = qlayer._padding
+        self._dilation = qlayer._dilation
+        self._groups = qlayer._groups
+        self._data_format = qlayer._data_format
+        self._has_out_scale = out_scale is not None and fold_out_scale
+        self.register_buffer("out_scale", Tensor(
+            np.float32(out_scale) if out_scale is not None
+            else np.float32(0.0)))
+
+    def forward(self, x):
+        from ..nn.functional.conv import _norm_padding, _norm_tuple
+        from ..ops import int8 as I8
+        return I8.conv2d_int8(
+            x, self.weight_int8, self.input_scale, self.weight_scale,
+            bias=self.bias,
+            out_scale=self.out_scale if self._has_out_scale else None,
+            bits=self._activation_bits, dynamic=self._dynamic,
+            stride=_norm_tuple(self._stride, 2),
+            padding=_norm_padding(self._padding, 2),
+            dilation=_norm_tuple(self._dilation, 2),
+            groups=int(self._groups),
+            channel_last=self._data_format in ("NHWC",))
+
+
+_FROZEN = {QuantizedLinear: FrozenQuantizedLinear,
+           QuantizedConv2D: FrozenQuantizedConv2D}
+
+
+def _collected_out_scale(wrapper):
+    """The out-scale a collector actually observed, or None when it never
+    saw a train/calibration forward (state buffer still at its 1.0 init) —
+    folding an unobserved scale would clip every output to [-1, 1]."""
+    st = float(np.asarray(wrapper._out_scale.state.numpy()))
+    if st == 1.0:
+        return None
+    return float(np.asarray(wrapper._out_scale.scale.numpy()))
+
+
+class QuantizationFreezePass:
+    """quantization_pass.py:1045 parity over the imperative model.
+
+    ``apply(model)`` swaps every fake-quantized site for its frozen int8
+    form in place (idempotent — frozen layers are left alone), recording
+    collected out-scales (from an enclosing ImperativeCalcOutScale
+    collector or PTQ calibration) on each site.  ``frozen_sites`` counts
+    the rewrites.
+
+    ``fold_out_scales=True`` additionally REQUANTIZES each site's output
+    onto its out-scale int8 grid inside the fused epilogue — the strict
+    int8-activation dataflow of the ConvertToInt8/TensorRT engines.
+    That is one extra rounding per activation relative to the fake-quant
+    training simulation (which only rounds at the next site's input
+    quantizer), so the default keeps the reference freeze behavior:
+    dequantize to float in the epilogue, out thresholds recorded as
+    attributes for whoever consumes them."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 fold_out_scales=False):
+        self._weight_bits = int(weight_bits)
+        self._activation_bits = int(activation_bits)
+        self._fold_out_scales = bool(fold_out_scales)
+        self.frozen_sites = 0
+
+    def _freeze_one(self, layer, out_scale=None):
+        if out_scale is None:
+            # PTQ calibration records the observed output scale directly
+            # on the site (ptq.py); QAT sites get theirs from the
+            # enclosing ImperativeCalcOutScale collector instead
+            out_scale = getattr(layer, "_frozen_out_scale", None)
+        for cls, fcls in _FROZEN.items():
+            if isinstance(layer, cls):
+                self.frozen_sites += 1
+                return fcls(layer, weight_bits=self._weight_bits,
+                            activation_bits=self._activation_bits,
+                            out_scale=out_scale,
+                            fold_out_scale=self._fold_out_scales)
+        return None
+
+    def apply(self, model):
+        self._walk(model)
+        return model
+
+    def _walk(self, layer):
+        from .qat import _OutScaleWrapper
+        for name, child in list(layer._sub_layers.items()):
+            if isinstance(child, _OutScaleWrapper):
+                frozen = self._freeze_one(child._inner,
+                                          out_scale=_collected_out_scale(
+                                              child))
+                if frozen is not None:
+                    # the collector's job is done: its scale now lives in
+                    # the frozen epilogue, so the wrapper goes away too
+                    setattr(layer, name, frozen)
+                else:
+                    self._walk(child)
+                continue
+            frozen = self._freeze_one(child)
+            if frozen is not None:
+                setattr(layer, name, frozen)
+            else:
+                self._walk(child)
+
+
+def freeze(model, weight_bits=8, activation_bits=8, fold_out_scales=False):
+    """Freeze a QAT/PTQ-calibrated model to int8 execution, in place.
+
+    Raises when the model has no fake-quantized site (the pass would be a
+    silent no-op — run ImperativeQuantAware/PostTrainingQuantization
+    first)."""
+    p = QuantizationFreezePass(weight_bits=weight_bits,
+                               activation_bits=activation_bits,
+                               fold_out_scales=fold_out_scales)
+    p.apply(model)
+    if p.frozen_sites == 0:
+        raise ValueError(
+            "freeze: no QuantizedLinear/QuantizedConv2D sites found — "
+            "quantize the model (QAT or PTQ) before freezing")
+    model.eval()
+    return model
+
+
+def quant_signature(model):
+    """Stable digest of a frozen model's quantization state (bits, site
+    layout, scales) — the Predictor mixes it into the AOT executable
+    cache key so int8 and float executables never collide."""
+    import hashlib
+    h = hashlib.sha1()
+    for name, sub in model.named_sublayers():
+        if isinstance(sub, (FrozenQuantizedLinear, FrozenQuantizedConv2D)):
+            h.update(name.encode())
+            h.update(bytes([sub._weight_bits, sub._activation_bits,
+                            sub._per_channel, sub._dynamic,
+                            sub._has_out_scale]))
+            h.update(np.asarray(sub.weight_scale.numpy()).tobytes())
+            h.update(np.asarray(sub.input_scale.numpy()).tobytes())
+            h.update(np.asarray(sub.out_scale.numpy()).tobytes())
+    return h.hexdigest()
+
+
+def save_int8_model(model, path, input_spec=None, **configs):
+    """Freeze (if not already frozen) and export the int8 inference
+    artifact NEXT TO a float export: ``<path>.int8.pdmodel`` (integer
+    StableHLO via jit.save) + ``<path>.quant.json`` (the quant signature
+    sidecar the Predictor keys its executable cache on).
+
+    The Predictor picks the ``.int8`` sibling transparently when
+    ``FLAGS_use_int8_inference`` is on — serving configs that never heard
+    of int8 keep loading ``<path>.pdmodel``."""
+    from .. import jit
+    has_frozen = any(isinstance(s, (FrozenQuantizedLinear,
+                                    FrozenQuantizedConv2D))
+                     for s in model.sublayers())
+    if not has_frozen:
+        freeze(model)
+    model.eval()
+    jit.save(model, path + ".int8", input_spec=input_spec, **configs)
+    sig = quant_signature(model)
+    sites = sum(1 for s in model.sublayers()
+                if isinstance(s, (FrozenQuantizedLinear,
+                                  FrozenQuantizedConv2D)))
+    with open(path + ".quant.json", "w") as f:
+        json.dump({"int8": True, "signature": sig, "sites": sites,
+                   "weight_bits": 8, "format": "jit_stablehlo"}, f)
+    return path + ".int8"
+
+
+def load_quant_sidecar(prefix):
+    """The quant.json sidecar for a model prefix, or None."""
+    p = prefix + ".quant.json"
+    if not os.path.isfile(p):
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

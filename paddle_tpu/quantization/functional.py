@@ -133,12 +133,16 @@ def moving_average_abs_max_scale(x, accum, state, moving_rate=0.9,
 
 
 def quantize_weight_int8(w, quant_axis=0, bit_length=8):
-    """True int8 weight quantization for PTQ storage: returns
-    (int8 weights, fp32 per-channel scales). Dequantize with
+    """True int8 weight quantization for PTQ storage and the freeze pass:
+    returns (int8 weights, fp32 scales) — per-channel along ``quant_axis``,
+    or per-tensor when ``quant_axis=None``. Dequantize with
     ``dequantize_weight`` (fake_dequantize_op.cc DequantizeMaxAbs)."""
     wv = unwrap(w)
     qmax = float(2 ** (bit_length - 1) - 1)
-    axes = tuple(i for i in range(wv.ndim) if i != quant_axis)
+    if quant_axis is None:
+        axes = tuple(range(wv.ndim))
+    else:
+        axes = tuple(i for i in range(wv.ndim) if i != quant_axis)
     scale = jnp.maximum(jnp.max(jnp.abs(wv), axis=axes, keepdims=True), 1e-9)
     q = jnp.round(wv / scale * qmax).astype(jnp.int8)
     return Tensor(q), Tensor(scale)

@@ -46,15 +46,25 @@ class PostTrainingQuantization:
         self._hist_percent = hist_percent
         self._weight_bits = weight_bits
         self._activation_bits = activation_bits
+        self._weight_quantize_type = weight_quantize_type
         self._observed = {}
+        self._observed_out = {}
 
     # -- calibration ---------------------------------------------------------
     def _observe(self, name):
         store = self._observed.setdefault(name, [])
+        store_out = self._observed_out.setdefault(name, [])
 
         def hook(layer, inputs, output):
             x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
             store.append(float(np.max(np.abs(np.asarray(x.numpy())))))
+            # out-scale observation: the freeze pass folds it into the
+            # int8 site's requantize epilogue (quantization_pass.py
+            # out_scale), so PTQ flows get epilogue scales without QAT
+            y = output[0] if isinstance(output, (tuple, list)) else output
+            if hasattr(y, "numpy"):
+                store_out.append(
+                    float(np.max(np.abs(np.asarray(y.numpy())))))
             return None
 
         return hook
@@ -77,20 +87,22 @@ class PostTrainingQuantization:
         for h in hooks:
             h.remove()
         # reduce observations to one scale per layer
-        self._scales = {}
-        for name, obs in self._observed.items():
+        def _reduce(obs):
             a = np.asarray(obs, "float64")
             if self._algo == "avg":
-                s = float(a.mean())
-            elif self._algo in ("hist", "KL", "mse"):
-                s = float(np.quantile(a, self._hist_percent))
-            else:
-                s = float(a.max())
-            self._scales[name] = s
+                return float(a.mean())
+            if self._algo in ("hist", "KL", "mse"):
+                return float(np.quantile(a, self._hist_percent))
+            return float(a.max())
+
+        self._scales = {n: _reduce(o) for n, o in self._observed.items()}
+        self._out_scales = {n: _reduce(o)
+                            for n, o in self._observed_out.items() if o}
         # swap to QAT layers in test mode with the calibrated input scale
         ImperativeQuantAware(
             weight_bits=self._weight_bits,
-            activation_bits=self._activation_bits).quantize(model)
+            activation_bits=self._activation_bits,
+            weight_quantize_type=self._weight_quantize_type).quantize(model)
         for name, sub in model.named_sublayers():
             fq = getattr(sub, "_fake_quant_input", None)
             if fq is not None and hasattr(fq, "scale"):
@@ -101,6 +113,17 @@ class PostTrainingQuantization:
                     fq.scale._value = fq.scale._value * 0 + s
                     fq.accum._value = fq.accum._value * 0 + s
                     fq.state._value = fq.state._value * 0 + 1.0
+        # record the calibrated OUTPUT scale on each quantized site (the
+        # module tree stays intact — no wrapper insertion post-swap); the
+        # freeze pass folds it into the int8 requantize epilogue, so PTQ
+        # flows reach freeze with an out-scale at every rewrite site just
+        # like the QAT calc_out_scale flow
+        from .quant_layers import QuantizedConv2D, QuantizedLinear
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, (QuantizedConv2D, QuantizedLinear)):
+                s = self._out_scales.get(name)
+                if s is not None:
+                    sub._frozen_out_scale = float(s)
         model.eval()
         return model
 

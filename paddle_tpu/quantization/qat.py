@@ -67,11 +67,24 @@ class ImperativeQuantAware:
 class ImperativeCalcOutScale:
     """Attach out-scale collectors after activation-producing layers
     (qat.py:299). Collected scales live in each collector's ``scale``
-    buffer and are saved with state_dict."""
+    buffer and are saved with state_dict.
 
-    _OUT_SCALE_TYPES = ("ReLU", "ReLU6", "LeakyReLU", "Sigmoid", "Softmax",
-                        "Tanh", "Swish", "Conv2D", "Linear", "BatchNorm2D",
-                        "BatchNorm")
+    Coverage spans every layer the freeze pass can rewrite or whose
+    output feeds a rewrite site — including the already-swapped
+    QuantizedConv2D/QuantizedLinear wrappers, so the canonical
+    ``quantize(model)`` → ``calc_out_scale(model)`` order leaves each
+    int8 site with a recorded out-scale for its requantize epilogue
+    (quantization_pass.py out_scale fold)."""
+
+    _OUT_SCALE_TYPES = ("ReLU", "ReLU6", "LeakyReLU", "PReLU", "ELU",
+                        "GELU", "Hardswish", "Hardsigmoid", "Sigmoid",
+                        "Softmax", "Tanh", "Swish", "Mish",
+                        "Conv2D", "Conv2DTranspose", "Linear",
+                        "QuantizedConv2D", "QuantizedLinear",
+                        "BatchNorm", "BatchNorm1D", "BatchNorm2D",
+                        "BatchNorm3D", "SyncBatchNorm", "LayerNorm",
+                        "MaxPool2D", "AvgPool2D", "AdaptiveAvgPool2D",
+                        "AdaptiveMaxPool2D")
 
     def __init__(self, moving_rate=0.9):
         self._moving_rate = moving_rate
@@ -82,6 +95,8 @@ class ImperativeCalcOutScale:
 
     def _walk(self, layer):
         for name, child in list(layer._sub_layers.items()):
+            if isinstance(child, _OutScaleWrapper):
+                continue                      # idempotent: already collected
             if type(child).__name__ in self._OUT_SCALE_TYPES:
                 setattr(layer, name, _OutScaleWrapper(
                     child, self._moving_rate))

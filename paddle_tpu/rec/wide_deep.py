@@ -63,6 +63,34 @@ class WideDeep(nn.Layer):
         self.deep_emb.flush_grads()
 
 
+def sort_unique_static(ids_flat, cap):
+    """Static-shape sort-based unique on DEVICE (the XLA replacement for
+    the host np.unique every cached-mode step pays over the full B*S id
+    block): sort, boundary flags, segment ids by cumsum, then one
+    segment-sum for per-unique occurrence counts.
+
+    Returns ``(uniq [cap], inv [N], count, counts [cap])`` — ``uniq`` is
+    sorted-unique padded to the static ``cap`` (padding untouched beyond
+    ``count``; compare count host-side and re-run at a bigger octave when
+    it overflows), ``inv`` maps each input position to its unique slot
+    exactly like ``np.unique(return_inverse=True)`` (np.unique also
+    sorts, so the two paths produce bit-identical gathers), and
+    ``counts`` is the segment-sum occupancy histogram (hot-id stats /
+    dedup ratio gauges)."""
+    import jax
+    order = jnp.argsort(ids_flat)
+    s = ids_flat[order]
+    flags = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                             (s[1:] != s[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(flags) - 1                   # unique index, sorted order
+    count = seg[-1] + 1
+    uniq = jnp.zeros((cap,), s.dtype).at[jnp.clip(seg, 0, cap - 1)].set(s)
+    inv = jnp.zeros_like(seg).at[order].set(seg)
+    counts = jax.ops.segment_sum(jnp.ones_like(seg), seg,
+                                 num_segments=cap)
+    return uniq, inv, count, counts
+
+
 def bce_with_logits_mean(x, labels):
     """Numerically stable mean BCE-with-logits (shared by the CTR
     trainers)."""
@@ -217,6 +245,10 @@ class WideDeepTrainer:
             # ONE slot directory: both tables share the id space, so ids
             # resolve to slots once per step
             self._slot_dir = SlotDirectory(cache_capacity)
+            # device-dedup state (FLAGS_wide_deep_device_dedup): static-
+            # shape octave cap + one jitted sort_unique_static per shape
+            self._dedup_cap = None
+            self._dedup_fns = {}
 
             def mk_cache(emb):
                 kw = {k: v for k, v in emb.table_kw.items()
@@ -315,12 +347,49 @@ class WideDeepTrainer:
             return self._step_cached(sparse_ids, dense_x, labels)
         return self._step_pullpush(sparse_ids, dense_x, labels)
 
+    def _dedup_device(self, ids):
+        """Sort-based unique + segment-sum on DEVICE (VERDICT #5 relief,
+        FLAGS_wide_deep_device_dedup): the chip dedups the B*S id block at
+        a static octave cap; the host reads back only the deduped prefix
+        (plus one count scalar) for hot-row-cache slot resolution, instead
+        of running np.unique over the full block every step.  Cap
+        overflow re-runs one octave up (compile count stays bounded by
+        the octave ladder).  Returns (uniq np [count], inv device [B,S
+        flat])."""
+        import functools
+        import jax
+        flat = jnp.asarray(ids.reshape(-1))
+        n = flat.size
+        if self._dedup_cap is None:
+            # seed the octave from a one-time host count
+            u0 = len(np.unique(ids))
+            self._dedup_cap = self._pad_adaptive(min(max(2 * u0, 16), n))
+        while True:
+            cap = min(self._dedup_cap, n)
+            fn = self._dedup_fns.get((n, cap))
+            if fn is None:
+                fn = jax.jit(functools.partial(sort_unique_static, cap=cap))
+                self._dedup_fns[(n, cap)] = fn
+            uniq_dev, inv_dev, count_dev, _counts = fn(flat)
+            count = int(count_dev)           # one scalar D2H
+            if count <= cap or cap >= n:
+                break
+            # overflow: grow to the octave holding count (strictly > cap)
+            self._dedup_cap = self._pad_adaptive(min(count, n))
+        return np.asarray(uniq_dev[:count]), inv_dev
+
     def _prep_cached(self, sparse_ids):
         """Host side of a cached-mode step: id dedup, slot resolution,
         miss fill/scatter, octave-padded slot vector, wire-compressed
         inverse map.  Returns device (slots, inv)."""
+        from ..framework.flags import flag
         ids = np.asarray(sparse_ids)
-        uniq, inv = np.unique(ids, return_inverse=True)
+        if flag("wide_deep_device_dedup"):
+            # np.unique also sorts, so both paths produce identical
+            # (uniq, inv) and the step numerics are bit-identical
+            uniq, inv = self._dedup_device(ids)
+        else:
+            uniq, inv = np.unique(ids, return_inverse=True)
         # ONE id→slot resolution for both tables, then per-table row moves.
         # A failure before the miss rows land in BOTH arenas rolls the
         # resolution back, so a retried step re-misses instead of hitting

@@ -115,6 +115,7 @@ class Executor:
         self.place = place
         self._cache = {}
         self._aot_dir = None
+        self._cache_extra_key = None
         # train_from_dataset replays, keyed per (program, feeds, fetches):
         # re-jitting the epoch scan every call would pay a full XLA
         # recompile per epoch (jit caching lives on the jitted callable)
@@ -130,9 +131,15 @@ class Executor:
         os.makedirs(path, exist_ok=True)
         self._aot_dir = path
 
-    @staticmethod
-    def _aot_digest(program, feed_names, feed_vals, union, persist_names,
-                    persist_vals):
+    def set_cache_extra_key(self, key):
+        """Fold an extra token into the AOT executable digest — the
+        Predictor passes the model's quantization signature here so int8
+        and float programs sharing one optim-cache dir never collide onto
+        each other's serialized executables."""
+        self._cache_extra_key = None if key is None else str(key)
+
+    def _aot_digest(self, program, feed_names, feed_vals, union,
+                    persist_names, persist_vals):
         """Restart-stable executable key: program structure + IO signature
         (program._uid is per-process, useless across restarts)."""
         import hashlib
@@ -159,6 +166,8 @@ class Executor:
             h.update(f"{n}:{getattr(v, 'shape', ())}:"
                      f"{getattr(v, 'dtype', '')}".encode())
         h.update(repr(tuple(union)).encode())
+        if self._cache_extra_key is not None:
+            h.update(self._cache_extra_key.encode())
         return h.hexdigest()
 
     def _aot_load(self, digest):

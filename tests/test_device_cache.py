@@ -373,3 +373,73 @@ def test_rollback_reclaims_fresh_slots():
         res = d.resolve(np.array([10, 11, 12]))
         d.rollback(res)
     assert d._n_used == used_before
+
+
+# -- device dedup (FLAGS_wide_deep_device_dedup) ------------------------------
+
+def test_sort_unique_static_matches_np_unique():
+    import jax.numpy as jnp
+    from paddle_tpu.rec.wide_deep import sort_unique_static
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50, size=(96,)).astype(np.int64)
+    u_np, inv_np = np.unique(ids, return_inverse=True)
+    u, inv, cnt, counts = sort_unique_static(jnp.asarray(ids), cap=96)
+    cnt = int(cnt)
+    assert cnt == len(u_np)
+    np.testing.assert_array_equal(np.asarray(u[:cnt]), u_np)
+    np.testing.assert_array_equal(np.asarray(inv), inv_np)
+    # segment-sum occupancy == per-unique occurrence counts
+    np.testing.assert_array_equal(np.asarray(counts[:cnt]),
+                                  np.bincount(inv_np))
+
+
+def test_device_dedup_trainer_bit_identical():
+    """np.unique also sorts, so the device path must reproduce the host
+    path's (uniq, inv) exactly — losses bit-identical step for step."""
+    from paddle_tpu.framework.flags import set_flags
+
+    def run(flag):
+        set_flags({"FLAGS_wide_deep_device_dedup": flag})
+        paddle.seed(11)
+        m = WideDeep(emb_dim=4, num_slots=6, dense_dim=3, hidden=(16,))
+        t = WideDeepTrainer(m)
+        assert t._use_cache
+        losses = []
+        for i in range(4):
+            ids, dense, label = synthetic_ctr_batch(32, 6, 3, vocab=600,
+                                                    seed=i)
+            losses.append(t.step(ids, dense, label))
+        return losses
+
+    try:
+        assert run(False) == run(True)
+    finally:
+        set_flags({"FLAGS_wide_deep_device_dedup": False})
+
+
+def test_device_dedup_cap_grows_on_overflow():
+    """A batch with far more uniques than the seeded octave must re-run
+    one octave up, not truncate (silent truncation would gather wrong
+    rows)."""
+    from paddle_tpu.framework.flags import set_flags
+    try:
+        set_flags({"FLAGS_wide_deep_device_dedup": True})
+        paddle.seed(12)
+        m = WideDeep(emb_dim=4, num_slots=4, dense_dim=3, hidden=(8,))
+        t = WideDeepTrainer(m)
+        # step 1: tiny unique set seeds a small cap
+        ids = np.zeros((16, 4), np.int64)
+        dense = np.zeros((16, 3), np.float32)
+        label = np.zeros((16, 1), np.float32)
+        t.step(ids, dense, label)
+        small_cap = t._dedup_cap
+        # step 2: all-distinct ids overflow the cap -> octave growth
+        ids2 = np.arange(16 * 4, dtype=np.int64).reshape(16, 4)
+        uniq, inv = t._dedup_device(ids2)
+        assert t._dedup_cap > small_cap
+        u_np, inv_np = np.unique(ids2, return_inverse=True)
+        np.testing.assert_array_equal(uniq, u_np)
+        np.testing.assert_array_equal(np.asarray(inv),
+                                      inv_np.reshape(-1))
+    finally:
+        set_flags({"FLAGS_wide_deep_device_dedup": False})

@@ -1,0 +1,381 @@
+"""Int8 freeze pass + inference engine tests (ISSUE 4).
+
+Reference strategy parity: test_quantization_pass.py (freeze graph
+rewrite + numerics vs the fake-quant simulation), test_imperative_qat.py
+(accuracy budget), analyzer_*_tester.cc (predictor output agreement).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    ImperativeQuantAware, ImperativeCalcOutScale, PostTrainingQuantization,
+    QuantizationFreezePass, FrozenQuantizedConv2D, FrozenQuantizedLinear,
+    QuantizedConv2D, QuantizedLinear, freeze, save_int8_model,
+    quant_signature,
+)
+from paddle_tpu.static import InputSpec
+
+
+class _Net(nn.Layer):
+    def __init__(self, conv_kw=None):
+        super().__init__()
+        self.conv = nn.Conv2D(2, 4, 3, padding=1, **(conv_kw or {}))
+        self.relu = nn.ReLU()
+        self.fc = nn.Linear(4 * 4 * 4, 10)
+
+    def forward(self, x):
+        h = self.relu(self.conv(x))
+        h = paddle.flatten(h, 1)
+        return self.fc(h)
+
+
+def _qat_converged(model, x, steps=20):
+    model.train()
+    for _ in range(steps):
+        model(x)
+    model.eval()
+    return model
+
+
+def test_freeze_swaps_sites_and_is_idempotent():
+    paddle.seed(0)
+    m = _Net()
+    ImperativeQuantAware().quantize(m)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 2, 4, 4).astype("float32"))
+    _qat_converged(m, x)
+    freeze(m)
+    assert isinstance(m.conv, FrozenQuantizedConv2D)
+    assert isinstance(m.fc, FrozenQuantizedLinear)
+    assert m.conv.weight_int8.numpy().dtype == np.int8
+    # int8 storage really replaced the fp32 weight tensor
+    assert not any(n.endswith("conv.weight")
+                   for n, _ in m.named_parameters())
+    # idempotent: a second pass finds nothing to rewrite
+    p = QuantizationFreezePass()
+    p.apply(m)
+    assert p.frozen_sites == 0
+    # and freezing an unquantized model is an error, not a silent no-op
+    with pytest.raises(ValueError, match="no Quantized"):
+        freeze(_Net())
+
+
+def test_frozen_matches_fake_quant_simulation():
+    """The int8 program and the fake-QDQ simulation quantize at the same
+    two points with the same scales — outputs agree to float rounding
+    (the acceptance atol=1e-2 bound with ~1e-6 to spare)."""
+    paddle.seed(1)
+    rng = np.random.RandomState(1)
+    m = _Net()
+    ImperativeQuantAware().quantize(m)
+    x = paddle.to_tensor(rng.randn(8, 2, 4, 4).astype("float32"))
+    _qat_converged(m, x)
+    sim = m(x).numpy()
+    freeze(m)
+    got = m(x).numpy()
+    assert np.abs(got - sim).max() < 1e-2, np.abs(got - sim).max()
+
+
+@pytest.mark.parametrize("wtype", ["abs_max", "channel_wise_abs_max"])
+def test_per_tensor_and_per_channel_vs_fp32_oracle(wtype):
+    paddle.seed(2)
+    rng = np.random.RandomState(2)
+    m = nn.Linear(16, 8)
+    # wildly different per-output-channel magnitudes: the case per-channel
+    # quantization exists for
+    w = rng.randn(16, 8).astype("float32") * \
+        np.logspace(-2, 0, 8, dtype="float32")
+    m.weight.set_value(paddle.to_tensor(w))
+    x = paddle.to_tensor(rng.randn(32, 16).astype("float32"))
+    ref = m(x).numpy()
+
+    q = ImperativeQuantAware(weight_quantize_type=wtype)
+    holder = nn.Sequential(m)
+    q.quantize(holder)
+    _qat_converged(holder, x)
+    sim = holder(x).numpy()
+    freeze(holder)
+    frozen = holder[0]
+    assert frozen._per_channel == (wtype == "channel_wise_abs_max")
+    got = holder(x).numpy()
+    assert np.abs(got - sim).max() < 1e-2
+    # against the fp32 oracle the error is bounded by the quant grid
+    err = np.abs(got - ref).max()
+    assert err < 0.35, err
+    if wtype == "channel_wise_abs_max":
+        # per-channel scales shrink the small channels' grid: tighter
+        # than any per-tensor bound on the low-magnitude channels
+        small = np.abs(got[:, :4] - ref[:, :4]).max()
+        assert small < 0.05, small
+
+
+def test_frozen_conv_stride_padding_groups():
+    paddle.seed(3)
+    rng = np.random.RandomState(3)
+    for kw in ({"stride": 2}, {"padding": 2}, {"groups": 2}):
+        conv = nn.Conv2D(4, 4, 3, **kw)
+        m = nn.Sequential(conv)
+        ImperativeQuantAware().quantize(m)
+        x = paddle.to_tensor(rng.randn(2, 4, 8, 8).astype("float32"))
+        _qat_converged(m, x, steps=8)
+        sim = m(x).numpy()
+        freeze(m)
+        got = m(x).numpy()
+        assert np.abs(got - sim).max() < 1e-2, (kw, np.abs(got - sim).max())
+
+
+def test_out_scale_folds_into_epilogue():
+    paddle.seed(4)
+    rng = np.random.RandomState(4)
+    m = _Net()
+    ImperativeQuantAware().quantize(m)
+    ImperativeCalcOutScale().calc_out_scale(m)
+    x = paddle.to_tensor(rng.randn(4, 2, 4, 4).astype("float32"))
+    _qat_converged(m, x)
+    freeze(m, fold_out_scales=True)
+    assert m.fc._has_out_scale       # collector scale folded + stripped
+    so = float(m.fc.out_scale.numpy())
+    assert so > 0
+    out = m(x).numpy()
+    # the epilogue requantizes onto the out-scale int8 grid
+    grid = so / 127.0
+    snapped = np.round(out / grid) * grid
+    assert np.abs(out - snapped).max() < 1e-4
+    # default freeze records the scale but does NOT add the rounding
+    paddle.seed(4)
+    m2 = _Net()
+    ImperativeQuantAware().quantize(m2)
+    ImperativeCalcOutScale().calc_out_scale(m2)
+    _qat_converged(m2, x)
+    freeze(m2)
+    assert not m2.fc._has_out_scale
+    assert float(m2.fc.out_scale.numpy()) > 0    # still recorded
+
+
+def test_dynamic_input_scale_when_quantizer_stateless():
+    """abs_max activation quant has no collected scale — freeze falls
+    back to in-graph dynamic quantization (per-batch abs-max)."""
+    paddle.seed(5)
+    rng = np.random.RandomState(5)
+    m = nn.Sequential(nn.Linear(8, 4))
+    ImperativeQuantAware(activation_quantize_type="abs_max").quantize(m)
+    m.eval()
+    x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+    sim = m(x).numpy()
+    freeze(m)
+    assert m[0]._dynamic
+    got = m(x).numpy()
+    assert np.abs(got - sim).max() < 1e-2
+
+
+def test_amp_autocast_exempts_int8_sites():
+    """O2 autocast must not down-cast the fp32 scale epilogue or touch
+    the int8 operands (AMP_EXEMPT) — output stays fp32 and exact."""
+    import jax.numpy as jnp
+    paddle.seed(6)
+    rng = np.random.RandomState(6)
+    m = nn.Sequential(nn.Linear(8, 4))
+    ImperativeQuantAware().quantize(m)
+    x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+    _qat_converged(m, x, steps=8)
+    freeze(m)
+    ref = m(x).numpy()
+    with paddle.amp.auto_cast(level="O2"):
+        out = m(x)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(out.numpy(), ref, rtol=0, atol=0)
+
+
+class _LeNetFlat(nn.Layer):
+    """LeNet with the export-friendly flatten (vision.models.LeNet)."""
+
+    def __init__(self):
+        super().__init__()
+        from paddle_tpu.vision.models import LeNet
+        self.net = LeNet()
+
+    def forward(self, x):
+        return self.net(x)
+
+
+def _blob_task(rng):
+    """10-class synthetic 'digits': one fixed prototype per class plus
+    noise — separable enough that fp32 LeNet trains to ~100% in a few
+    steps, so the int8 accuracy budget is measured against a real
+    decision boundary rather than random-init noise.  Train and eval
+    sets share the prototypes (one task, two draws)."""
+    protos = rng.randn(10, 1, 28, 28).astype("float32")
+
+    def draw(n):
+        y = rng.randint(0, 10, (n,))
+        x = protos[y] + 0.3 * rng.randn(n, 1, 28, 28).astype("float32")
+        return x.astype("float32"), y.astype("int64")
+
+    return draw
+
+
+def _train_lenet(model, x, y, steps=60):
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=3e-3)
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+    model.train()
+    for _ in range(steps):
+        loss = paddle.nn.functional.cross_entropy(model(xt), yt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    model.eval()
+    return model
+
+
+def _acc(model, x, y, bs=64):
+    correct = 0
+    for i in range(0, len(x), bs):
+        logits = model(paddle.to_tensor(x[i:i + bs])).numpy()
+        correct += int((logits.argmax(-1) == y[i:i + bs]).sum())
+    return correct / len(x)
+
+
+def test_frozen_lenet_hlo_accuracy_and_roundtrip(tmp_path):
+    """The acceptance gate: freezing a PTQ-calibrated LeNet yields a
+    Program whose StableHLO contains integer dot/conv, whose outputs
+    match the fake-quant simulation within 1e-2, and whose top-1
+    accuracy drop vs fp32 stays ≤ 1% on the synthetic eval set — and the
+    frozen Program round-trips through jit.save/load."""
+    paddle.seed(7)
+    rng = np.random.RandomState(7)
+    draw = _blob_task(rng)
+    xtr, ytr = draw(64)
+    xev, yev = draw(256)
+    m = _LeNetFlat()
+    _train_lenet(m, xtr, ytr)
+    acc_fp32 = _acc(m, xev, yev)
+    assert acc_fp32 > 0.9, acc_fp32      # the oracle actually trained
+
+    def loader():
+        for i in range(4):
+            yield (paddle.to_tensor(xtr[i * 16:(i + 1) * 16]),)
+
+    PostTrainingQuantization(model=m, data_loader=loader(),
+                             batch_nums=4).quantize()
+    xb = paddle.to_tensor(xev[:8])
+    sim = m(xb).numpy()
+    freeze(m)
+    got = m(xb).numpy()
+    assert np.abs(got - sim).max() < 1e-2, np.abs(got - sim).max()
+    # PTQ recorded an out-scale on the final fc even without folding
+    assert float(m.net.fc[2].out_scale.numpy()) > 0
+
+    acc_int8 = _acc(m, xev, yev)
+    assert acc_fp32 - acc_int8 <= 0.01, (acc_fp32, acc_int8)
+
+    # frozen Program round-trip + integer-compute StableHLO assertion
+    prefix = str(tmp_path / "lenet")
+    out_prefix = save_int8_model(m, prefix,
+                                 input_spec=[InputSpec([None, 1, 28, 28])])
+    loaded = paddle.jit.load(out_prefix)
+    mlir = loaded.mlir_module()
+    assert "xi8>" in mlir, "no int8 tensors in the exported StableHLO"
+    assert "stablehlo.convolution" in mlir and "stablehlo.dot_general" in mlir
+    assert "xi32>" in mlir, "no int32 accumulator in the exported StableHLO"
+    re_out = loaded(xb).numpy()
+    np.testing.assert_allclose(re_out, got, rtol=0, atol=1e-5)
+
+
+def test_predictor_serves_int8_behind_flag(tmp_path):
+    """Predictor int8-vs-float output agreement + transparent artifact
+    selection: same Config/dir, FLAGS_use_int8_inference decides."""
+    from paddle_tpu import inference
+    from paddle_tpu.framework.flags import set_flags
+    paddle.seed(8)
+    rng = np.random.RandomState(8)
+    m = _Net()
+    x = rng.randn(4, 2, 4, 4).astype("float32")
+    prefix = str(tmp_path / "m")
+    spec = [InputSpec([None, 2, 4, 4])]
+    paddle.jit.save(m, prefix, input_spec=spec)      # float artifact
+    ImperativeQuantAware().quantize(m)
+    _qat_converged(m, paddle.to_tensor(x))
+    save_int8_model(m, prefix, input_spec=spec)      # int8 sibling
+
+    p_f = inference.create_predictor(inference.Config(str(tmp_path)))
+    assert p_f.quant_info() is None
+    out_f = p_f.run([x])[0]
+    try:
+        set_flags({"FLAGS_use_int8_inference": True})
+        p_8 = inference.create_predictor(inference.Config(str(tmp_path)))
+        info = p_8.quant_info()
+        assert info and info["int8"] and info["sites"] == 2
+        assert info["signature"] == quant_signature(m)
+        out_8 = p_8.run([x])[0]
+    finally:
+        set_flags({"FLAGS_use_int8_inference": False})
+    # int8 serving agrees with the float program within the quant budget
+    assert np.abs(out_8 - out_f).max() < 0.25, np.abs(out_8 - out_f).max()
+    assert np.abs(out_8 - out_f).max() > 0    # and really took the int8 path
+
+
+def test_executor_aot_digest_keys_on_quant_signature(tmp_path):
+    """Two executors over one program whose only difference is the quant
+    signature extra key must produce different AOT digests — int8 and
+    float executables can share a cache dir without collisions."""
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 4], "float32")
+            out = static.nn.fc(x, 3)
+        exe = static.Executor()
+        exe.run(startup)
+        from paddle_tpu.static.executor import global_scope
+        feed_vals = [np.zeros((2, 4), "float32")]
+        persist = [n for n in main._parameters]
+        pv = [global_scope().find_var(n) for n in persist]
+        d0 = exe._aot_digest(main, ["x"], feed_vals, [out.name], persist, pv)
+        exe.set_cache_extra_key("quant:abc")
+        d1 = exe._aot_digest(main, ["x"], feed_vals, [out.name], persist, pv)
+        exe.set_cache_extra_key(None)
+        d2 = exe._aot_digest(main, ["x"], feed_vals, [out.name], persist, pv)
+        assert d0 != d1
+        assert d0 == d2
+    finally:
+        paddle.disable_static()
+
+
+@pytest.mark.slow
+def test_end_to_end_ptq_freeze_predictor_smoke(tmp_path):
+    """E2E deploy walkthrough (README): train fp32 → PTQ calibrate →
+    freeze → save_int8_model → Predictor serves int8 transparently, with
+    batch-1 and batched serving agreeing with the eager frozen model."""
+    from paddle_tpu import inference
+    from paddle_tpu.framework.flags import set_flags
+    paddle.seed(9)
+    rng = np.random.RandomState(9)
+    xtr, ytr = _blob_task(rng)(64)
+    m = _LeNetFlat()
+    _train_lenet(m, xtr, ytr, steps=15)
+
+    def loader():
+        for i in range(4):
+            yield (paddle.to_tensor(xtr[i * 16:(i + 1) * 16]),)
+
+    PostTrainingQuantization(model=m, data_loader=loader(),
+                             batch_nums=4).quantize()
+    prefix = str(tmp_path / "lenet")
+    save_int8_model(m, prefix, input_spec=[InputSpec([None, 1, 28, 28])])
+    eager = m(paddle.to_tensor(xtr[:4])).numpy()
+    try:
+        set_flags({"FLAGS_use_int8_inference": True})
+        p = inference.create_predictor(inference.Config(str(tmp_path)))
+        assert p.quant_info()["int8"]
+        for batch in (1, 4):             # symbolic batch: one executable
+            out = p.run([xtr[:batch]])[0]
+            np.testing.assert_allclose(out, eager[:batch], rtol=0,
+                                       atol=1e-5)
+    finally:
+        set_flags({"FLAGS_use_int8_inference": False})
