@@ -44,7 +44,7 @@ import importlib as _importlib
 _SUBPACKAGES = ["nn", "optimizer", "static", "io", "metric", "amp", "jit",
                 "distributed", "vision", "text", "autograd", "hapi",
                 "incubate", "inference", "profiler", "device",
-                "quantization", "utils", "distribution", "onnx",
+                "quantization", "analysis", "utils", "distribution", "onnx",
                 "tensor", "regularizer", "compat", "sysconfig", "version",
                 "fluid"]
 for _name in _SUBPACKAGES:

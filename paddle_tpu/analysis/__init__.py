@@ -1,0 +1,102 @@
+"""paddle_tpu.analysis — static analysis over traced programs (graph lint).
+
+Reference parity: paddle/fluid/framework/ir — the ~150 pass registry that
+made Fluid's IR *inspectable*: programs were validated, rewritten and
+rejected before execution.  The TPU reproduction executes traced jaxprs;
+this package closes the inspection gap with a diagnostic pass suite that
+runs at trace time over (a) the closed jaxpr captured at jit / Executor /
+TrainStep compile and (b) dy2static Python ASTs before transformation.
+
+Wiring (all off-path = one Python branch on ``FLAGS_graph_lint``):
+
+  * always-on cheap passes inside jit/__init__.py, static/executor.py and
+    parallel/train_step.py, gated ``off|warn|error``
+    (env ``PADDLE_TPU_GRAPH_LINT``);
+  * ``tools/graph_lint.py`` — CLI tracing any zoo model in abstract-eval
+    mode (no device execution) and emitting a JSON/text report;
+  * monitor gauges (``graph_lint_warnings`` + per-pass counts) and a
+    LogWriter JSONL sink next to the recompile ledger
+    (``FLAGS_graph_lint_dir`` / ``PADDLE_TPU_GRAPH_LINT_DIR``).
+
+Contract: ``off`` adds no per-step work and one branch per compile;
+``warn`` emits GraphLintWarning + gauges/JSONL; ``error`` raises
+EnforceError (PreconditionNotMet) at trace time when any ERROR-severity
+finding fires.  Every pass id is a stable suppression key
+(``FLAGS_graph_lint_suppress="layout,dead-fetch"`` or the ``suppress()``
+context manager).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .diagnostics import (Diagnostic, GraphLintWarning, LintReport,  # noqa: F401
+                          Severity)
+from .manager import (LintContext, PassManager, default_pass_manager,  # noqa: F401
+                      emit, lint_enabled, lint_mode, register_pass,
+                      set_lint_dir, suppress)
+from . import passes as _passes  # noqa: F401  (registers the built-ins)
+from .passes import PASS_IDS  # noqa: F401
+from .ast_lint import lint_function_ast, run_ast_lint  # noqa: F401
+
+__all__ = [
+    "Severity", "Diagnostic", "LintReport", "GraphLintWarning",
+    "LintContext", "PassManager", "default_pass_manager",
+    "register_pass", "suppress", "set_lint_dir", "lint_mode",
+    "lint_enabled", "lint_jaxpr", "lint_traced", "run_ast_lint",
+    "lint_function_ast", "PASS_IDS",
+]
+
+
+def lint_jaxpr(closed_jaxpr, *, site: str = "lint", kind: str = "cli",
+               suppress=(), **ctx_fields) -> LintReport:
+    """Run the pass suite over an already-captured closed jaxpr and return
+    the report (no gating, no emission — the inspection API the CLI and
+    tests build on)."""
+    ctx = LintContext(site=site, kind=kind, closed_jaxpr=closed_jaxpr,
+                      **ctx_fields)
+    return default_pass_manager().run(ctx, suppress=suppress)
+
+
+def lint_traced(fn, args, *, site: str, kind: str,
+                cache_key: Any = None, prev_key: Any = None,
+                donate: Optional[bool] = None,
+                params: Optional[Dict[str, Any]] = None,
+                partition_specs: Optional[Dict[str, Any]] = None,
+                arg_paths=None, mesh=None,
+                program_info=None) -> Optional[LintReport]:
+    """The runtime integration point: abstract-eval ``fn(*args)`` into a
+    closed jaxpr (no device execution), run the pass suite, and emit
+    through the standard channel.
+
+    Called from the FRESH-compile paths only, behind ``lint_enabled()``,
+    so the cost is amortized per XLA compile and is zero per step.  In
+    ``error`` mode an ERROR-severity finding raises EnforceError before
+    the program ever executes; any *internal* lint failure (an
+    untraceable fn) degrades to a single crash diagnostic instead of
+    breaking the compile.
+    """
+    if not lint_enabled():
+        return None
+    import jax
+    from ..framework.tensor import Tensor
+
+    def unwrap(x):
+        return x._value if isinstance(x, Tensor) else x
+
+    try:
+        closed = jax.make_jaxpr(fn)(*(unwrap(a) for a in args))
+    except Exception as e:   # noqa: BLE001 — lint must not break compile
+        report = LintReport(site=site, kind=kind)
+        report.extend([Diagnostic(
+            pass_id="graph-lint", severity=Severity.WARNING,
+            message=f"could not abstract-eval the program for linting: "
+                    f"{type(e).__name__}: {e}", site=site, kind=kind)])
+        return emit(report)
+    ctx = LintContext(site=site, kind=kind, closed_jaxpr=closed,
+                      cache_key=cache_key, prev_key=prev_key,
+                      donate=donate, params=params,
+                      partition_specs=partition_specs,
+                      arg_paths=list(arg_paths) if arg_paths else None,
+                      mesh=mesh, program_info=program_info)
+    report = default_pass_manager().run(ctx)
+    return emit(report)

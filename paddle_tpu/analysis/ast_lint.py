@@ -1,0 +1,132 @@
+"""AST-level lint over @to_static Python source, before transformation.
+
+Reference parity: the dy2static transformer pipeline
+(dygraph_to_static/ast_transformer.py) runs *validation* visitors before
+rewriting — e.g. break_continue/return checks that reject untransformable
+source with a pointed error.  Here the same pre-transformation walk flags
+TPU hazards visible in the *Python* text that the jaxpr can never show,
+because they happen at trace time and leave no equation behind:
+
+  * ``x.numpy()`` / ``x.item()`` / ``x.tolist()`` / ``np.asarray(x)``
+    inside a traced function force a device→host transfer per trace (and
+    a tracer error or a silently-frozen constant under jit) —
+    host-transfer pass, AST flavor.
+  * ``float(x)`` / ``int(x)`` on a non-literal: concretizes a traced
+    value — recompile-hazard pass, AST flavor (each concretized value can
+    bake a new constant into the program).
+
+Findings carry real ``file:line`` provenance from the live AST node plus
+the function's source offset, so the warning points at the user's line.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import List, Optional
+
+from .diagnostics import Diagnostic, Severity
+from .manager import LintContext, default_pass_manager
+
+_HOST_METHODS = ("numpy", "item", "tolist", "cpu")
+_NUMPY_COERCERS = ("asarray", "array")
+
+
+def _loc(ctx: LintContext, node: ast.AST) -> Optional[str]:
+    if ctx.filename is None:
+        return None
+    line = ctx.firstlineno + getattr(node, "lineno", 1) - 1
+    return f"{ctx.filename}:{line}"
+
+
+class _AstHazardVisitor(ast.NodeVisitor):
+    def __init__(self, ctx: LintContext):
+        self.ctx = ctx
+        self.diagnostics: List[Diagnostic] = []
+
+    def _add(self, pass_id: str, node: ast.AST, message: str):
+        self.diagnostics.append(Diagnostic(
+            pass_id=pass_id, severity=Severity.WARNING, message=message,
+            location=_loc(self.ctx, node), kind="ast"))
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        # x.numpy() / x.item() / x.tolist() / x.cpu()
+        if isinstance(fn, ast.Attribute) and fn.attr in _HOST_METHODS:
+            self._add(
+                "host-transfer", node,
+                f".{fn.attr}() inside a @to_static function pulls the "
+                f"tensor to HOST at trace time: under jit this either "
+                f"errors on the tracer or freezes a stale constant into "
+                f"the graph — keep the computation on device or move the "
+                f"call outside the compiled function")
+        # np.asarray(x) / numpy.array(x)
+        elif (isinstance(fn, ast.Attribute)
+              and fn.attr in _NUMPY_COERCERS
+              and isinstance(fn.value, ast.Name)
+              and fn.value.id in ("np", "numpy")):
+            self._add(
+                "host-transfer", node,
+                f"{fn.value.id}.{fn.attr}(...) inside a @to_static "
+                f"function coerces a traced tensor through HOST numpy — "
+                f"use paddle/jnp ops so the value stays in the graph")
+        # float(x) / int(x) on a non-literal argument
+        elif (isinstance(fn, ast.Name) and fn.id in ("float", "int")
+              and node.args
+              and not isinstance(node.args[0], ast.Constant)):
+            self._add(
+                "recompile-hazard", node,
+                f"{fn.id}(...) on a traced value concretizes it at trace "
+                f"time: each distinct value bakes a new constant into the "
+                f"compiled program (a recompile per value) — keep it a "
+                f"0-d tensor instead")
+        self.generic_visit(node)
+
+
+def lint_function_ast(fn, site: str = "") -> List[Diagnostic]:
+    """Parse ``fn``'s source and run the AST hazard visitor.  Returns raw
+    diagnostics (ungated — callers go through ``run_ast_lint`` for flag
+    gating/emission).  Unparseable source (REPL lambdas) lints clean."""
+    try:
+        raw = getattr(fn, "__func__", fn)
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+        filename = inspect.getsourcefile(raw)
+        firstlineno = raw.__code__.co_firstlineno
+    except (OSError, TypeError, SyntaxError):
+        return []
+    ctx = LintContext(site=site or f"ast:{getattr(fn, '__qualname__', fn)}",
+                      kind="ast", ast_root=tree, filename=filename,
+                      firstlineno=firstlineno)
+    visitor = _AstHazardVisitor(ctx)
+    visitor.visit(tree)
+    # route through the manager so per-pass suppression/severity apply
+    mgr = default_pass_manager()
+    suppressed = set()
+    from .manager import _suppressed_ids
+    suppressed |= _suppressed_ids()
+    out = []
+    for d in visitor.diagnostics:
+        if d.pass_id in suppressed:
+            continue
+        try:
+            d.severity = mgr.severity_of(d.pass_id)
+        except KeyError:
+            pass
+        d.site = d.site or ctx.site
+        out.append(d)
+    return out
+
+
+def run_ast_lint(fn, site: str = ""):
+    """Gated entry used by dy2static: lint ``fn``'s source and emit
+    through the standard channel (gauges/JSONL/warn/raise)."""
+    from .diagnostics import LintReport
+    from .manager import emit, lint_enabled
+    if not lint_enabled():
+        return None
+    diags = lint_function_ast(fn, site=site)
+    report = LintReport(site=site or f"ast:{getattr(fn, '__qualname__', fn)}",
+                        kind="ast")
+    report.extend(diags)
+    return emit(report)

@@ -1,0 +1,112 @@
+"""Diagnostic records for the graph-lint pass suite.
+
+Reference parity: paddle/fluid/framework/ir/pass.h turns every graph pass
+into graph-in/graph-out with AnalysisPass diagnostics surfaced through glog;
+here every finding is a structured :class:`Diagnostic` carrying the pass id,
+severity, human message and — crucially — *user-level source provenance*
+(jax ``source_info`` → ``file:line``) so a warning printed at trace time
+points at the model code that caused it, not at framework internals.
+"""
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class Severity(enum.IntEnum):
+    """Per-pass severity ladder (pass.h's error/warning split)."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self):  # "warning", not "Severity.WARNING", in reports
+        return self.name.lower()
+
+
+class GraphLintWarning(UserWarning):
+    """Category for warn-mode findings (filterable via warnings.filter)."""
+
+
+@dataclass
+class Diagnostic:
+    """One finding from one pass over one traced program."""
+
+    pass_id: str
+    severity: Severity
+    message: str
+    site: str = ""                 # compile-cache site, e.g. "jit:forward"
+    location: Optional[str] = None  # user "file.py:123 (fn)" when known
+    kind: str = ""                 # jit | executor | train_step | cli | ast
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"pass": self.pass_id, "severity": str(self.severity),
+                "message": self.message, "site": self.site,
+                "location": self.location, "kind": self.kind,
+                **({"extra": self.extra} if self.extra else {})}
+
+    def __str__(self):
+        loc = f"{self.location}: " if self.location else ""
+        return (f"[{self.pass_id}] {str(self.severity).upper()} {loc}"
+                f"{self.message}" + (f" (at {self.site})" if self.site
+                                     else ""))
+
+
+class LintReport:
+    """All findings from one PassManager.run over one traced program."""
+
+    def __init__(self, site: str = "", kind: str = ""):
+        self.site = site
+        self.kind = kind
+        self.diagnostics: List[Diagnostic] = []
+
+    def extend(self, diags):
+        self.diagnostics.extend(diags)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __bool__(self):
+        return bool(self.diagnostics)
+
+    def by_severity(self, severity: Severity) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == severity]
+
+    @property
+    def n_errors(self) -> int:
+        return len(self.by_severity(Severity.ERROR))
+
+    @property
+    def n_warnings(self) -> int:
+        return len(self.by_severity(Severity.WARNING))
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for d in self.diagnostics:
+            out[d.pass_id] = out.get(d.pass_id, 0) + 1
+        return out
+
+    def format(self) -> str:
+        head = f"graph-lint: {len(self.diagnostics)} finding(s)" + \
+            (f" at {self.site}" if self.site else "")
+        if not self.diagnostics:
+            return head.replace("finding(s)", "findings — clean")
+        lines = [head]
+        for d in sorted(self.diagnostics, key=lambda d: -d.severity):
+            lines.append("  " + str(d))
+        return "\n".join(lines)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"site": self.site, "kind": self.kind,
+                "counts": self.counts(),
+                "n_errors": self.n_errors, "n_warnings": self.n_warnings,
+                "diagnostics": [d.as_dict() for d in self.diagnostics]}
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.as_dict(), **kw)

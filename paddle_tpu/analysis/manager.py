@@ -1,0 +1,261 @@
+"""PassManager: registration, gating, suppression and emission.
+
+Reference parity: paddle/fluid/framework/ir/pass.h + pass_builder — the
+~150 framework/inference passes register into a global registry and a
+PassBuilder decides which run; severity/suppression here plays the role of
+``GetPassesWhiteList``.  The TPU-shape differences:
+
+  * passes are *diagnostic only* (graph-in, findings-out) — rewriting is
+    XLA's job; linting runs at trace time where it is amortized per
+    compile and costs zero per step;
+  * gating is one Python branch (``lint_enabled``) off the
+    ``FLAGS_graph_lint`` tri-state ``off|warn|error``, exactly the PR-1
+    profiler-gate discipline;
+  * findings surface three ways: python warnings (warn mode) or an
+    EnforceError (error mode), StatRegistry gauges
+    (``graph_lint_warnings`` + per-pass counts), and a LogWriter JSONL
+    sink next to the recompile ledger (``FLAGS_graph_lint_dir`` /
+    ``PADDLE_TPU_GRAPH_LINT_DIR``).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..framework import flags as _flags
+from .diagnostics import (Diagnostic, GraphLintWarning, LintReport,
+                          Severity)
+
+_MODES = ("off", "warn", "error")
+
+
+# ---------------------------------------------------------------------------
+# Lint context: everything a pass may inspect about one traced program.
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LintContext:
+    """One traced program + its compile-site metadata.
+
+    ``closed_jaxpr`` is the program body (may be None for pure context
+    passes); the rest is optional per-site metadata each pass consults
+    when present and skips when absent — a pass must never assume a field
+    is populated.
+    """
+
+    site: str                                  # compile-cache site name
+    kind: str = "cli"                          # jit|executor|train_step|cli|ast
+    closed_jaxpr: Any = None
+    cache_key: Any = None                      # this compile's cache key
+    prev_key: Any = None                       # previous key at this site
+    mesh: Any = None                           # jax Mesh (or None)
+    donate: Optional[bool] = None              # train-step donation switch
+    params: Optional[Dict[str, Any]] = None    # param name -> array/aval
+    partition_specs: Optional[Dict[str, Any]] = None  # name -> spec|None
+    arg_paths: Optional[List[str]] = None      # names of jaxpr invars
+    program_info: Optional[Dict[str, Any]] = None     # static Program view
+    ast_root: Any = None                       # dy2static: parsed AST
+    filename: Optional[str] = None             # dy2static source file
+    firstlineno: int = 1                       # dy2static source offset
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Pass registry
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LintPass:
+    pass_id: str
+    fn: Callable[[LintContext], List[Diagnostic]]
+    severity: Severity
+    kinds: Tuple[str, ...]      # context kinds the pass applies to; () = all
+    doc: str = ""
+
+
+class PassManager:
+    """Ordered registry of lint passes with per-pass suppression and
+    severity overrides (``pass.h`` + pass_builder in one object)."""
+
+    def __init__(self):
+        self._passes: Dict[str, LintPass] = {}
+        self._severity_override: Dict[str, Severity] = {}
+
+    # -- registration --------------------------------------------------------
+    def register(self, pass_id: str, *, severity: Severity = Severity.WARNING,
+                 kinds: Tuple[str, ...] = (), doc: str = ""):
+        """Decorator registering ``fn(ctx) -> [Diagnostic]`` under
+        ``pass_id``.  Re-registration replaces (tests monkey-patch)."""
+        def deco(fn):
+            self._passes[pass_id] = LintPass(pass_id, fn, severity,
+                                             tuple(kinds), doc)
+            return fn
+        return deco
+
+    def passes(self) -> List[LintPass]:
+        return list(self._passes.values())
+
+    def pass_ids(self) -> List[str]:
+        return list(self._passes)
+
+    def set_severity(self, pass_id: str, severity: Severity) -> None:
+        if pass_id not in self._passes:
+            raise KeyError(f"unknown lint pass {pass_id!r}")
+        self._severity_override[pass_id] = Severity(severity)
+
+    def severity_of(self, pass_id: str) -> Severity:
+        if pass_id in self._severity_override:
+            return self._severity_override[pass_id]
+        return self._passes[pass_id].severity
+
+    # -- execution -----------------------------------------------------------
+    def run(self, ctx: LintContext, suppress=()) -> LintReport:
+        """Run every applicable, unsuppressed pass over ``ctx``.  A pass
+        that raises is reported as its own WARNING diagnostic — a broken
+        lint must never break a compile."""
+        suppressed = set(suppress) | _suppressed_ids()
+        report = LintReport(site=ctx.site, kind=ctx.kind)
+        for p in self._passes.values():
+            if p.pass_id in suppressed:
+                continue
+            if p.kinds and ctx.kind not in p.kinds:
+                continue
+            try:
+                diags = p.fn(ctx) or []
+            except Exception as e:   # noqa: BLE001 — lint must not crash
+                diags = [Diagnostic(
+                    pass_id=p.pass_id, severity=Severity.WARNING,
+                    message=f"lint pass crashed: {type(e).__name__}: {e}",
+                    site=ctx.site, kind=ctx.kind)]
+            sev = self.severity_of(p.pass_id)
+            for d in diags:
+                d.pass_id = p.pass_id
+                d.severity = sev      # pass-level severity (with override)
+                d.site = d.site or ctx.site
+                d.kind = d.kind or ctx.kind
+            report.extend(diags)
+        return report
+
+
+_default_manager = PassManager()
+
+
+def default_pass_manager() -> PassManager:
+    return _default_manager
+
+
+def register_pass(pass_id: str, *, severity: Severity = Severity.WARNING,
+                  kinds: Tuple[str, ...] = (), doc: str = ""):
+    """Register onto the default manager (module-level decorator)."""
+    return _default_manager.register(pass_id, severity=severity,
+                                     kinds=kinds, doc=doc)
+
+
+# ---------------------------------------------------------------------------
+# Gating + suppression
+# ---------------------------------------------------------------------------
+
+def lint_mode() -> str:
+    """The ``off|warn|error`` tri-state from FLAGS_graph_lint."""
+    mode = str(_flags.flag("graph_lint")).lower()
+    return mode if mode in _MODES else "off"
+
+
+def lint_enabled() -> bool:
+    """The one off-path branch every integration point checks."""
+    return lint_mode() != "off"
+
+
+_tls = threading.local()
+
+
+def _suppressed_ids() -> set:
+    """Flag-level plus context-manager suppression set."""
+    out = {s.strip() for s in
+           str(_flags.flag("graph_lint_suppress")).split(",") if s.strip()}
+    out |= getattr(_tls, "suppressed", set())
+    return out
+
+
+@contextlib.contextmanager
+def suppress(*pass_ids: str):
+    """Scoped per-pass suppression::
+
+        with analysis.suppress("layout", "dead-fetch"):
+            step(x, y)   # compiles without those passes
+    """
+    prev = getattr(_tls, "suppressed", set())
+    _tls.suppressed = prev | set(pass_ids)
+    try:
+        yield
+    finally:
+        _tls.suppressed = prev
+
+
+# ---------------------------------------------------------------------------
+# Emission: gauges + JSONL + warn/raise
+# ---------------------------------------------------------------------------
+
+_writer_lock = threading.Lock()
+_dir_override: List[Optional[str]] = [None]
+_writer: List[Any] = [None, None]   # [dir it was opened for, LogWriter]
+
+
+def set_lint_dir(path: Optional[str]) -> None:
+    """Route lint findings to JSONL under ``path`` (None reverts to the
+    ``graph_lint_dir`` flag / PADDLE_TPU_GRAPH_LINT_DIR)."""
+    with _writer_lock:
+        _dir_override[0] = path
+        _get_writer()       # eagerly close/reopen for the new destination
+
+
+def _get_writer():
+    d = _dir_override[0]
+    if d is None:
+        d = _flags.flag("graph_lint_dir") or None
+    if d != _writer[0]:
+        if _writer[1] is not None:
+            try:
+                _writer[1].close()
+            except Exception:
+                pass
+        from ..utils.monitor import LogWriter
+        _writer[0] = d
+        _writer[1] = LogWriter(logdir=d, filename_suffix=".lint") \
+            if d else None
+    return _writer[1]
+
+
+def _gauge_name(pass_id: str) -> str:
+    return "graph_lint_" + pass_id.replace("-", "_")
+
+
+def emit(report: LintReport, mode: Optional[str] = None) -> LintReport:
+    """Publish a report: gauges + JSONL always; python warnings in warn
+    mode; EnforceError (PreconditionNotMet) in error mode when any finding
+    is ERROR-severity.  Returns the report for chaining."""
+    from ..utils.monitor import stat_add
+    mode = mode or lint_mode()
+    if report:
+        stat_add("graph_lint_warnings", len(report.diagnostics))
+        for pid, n in report.counts().items():
+            stat_add(_gauge_name(pid), n)
+    with _writer_lock:
+        w = _get_writer()
+    if w is not None and report:
+        for d in report.diagnostics:
+            w.add_event("graph_lint/diagnostic", d.as_dict())
+    if not report:
+        return report
+    errors = report.by_severity(Severity.ERROR)
+    if mode == "error" and errors:
+        from ..framework.enforce import PreconditionNotMetError
+        raise PreconditionNotMetError(
+            "graph lint failed at trace time (FLAGS_graph_lint=error):\n"
+            + "\n".join("  " + str(d) for d in report.diagnostics))
+    for d in report.diagnostics:
+        warnings.warn(str(d), GraphLintWarning, stacklevel=3)
+    return report

@@ -8,7 +8,9 @@ from .dtype import (  # noqa: F401
     bfloat16, float16, float32, float64, int8, int16, int32, int64, uint8,
     bool_, complex64, convert_dtype, set_default_dtype, get_default_dtype,
 )
-from .flags import set_flags, get_flags, define_flag, flag  # noqa: F401
+from .flags import (  # noqa: F401
+    set_flags, get_flags, define_flag, flag, flags_snapshot, flags_restore,
+)
 from .place import (  # noqa: F401
     Place, CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
     set_device, get_device, current_place, device_count,

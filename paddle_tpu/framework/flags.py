@@ -44,8 +44,46 @@ class _Flag:
 def define_flag(name: str, default: Any, doc: str = "",
                 validator: Optional[Callable[[Any], bool]] = None,
                 writable: bool = True) -> None:
-    if name not in _REGISTRY:
-        _REGISTRY[name] = _Flag(name, default, doc, validator, writable)
+    if name in _REGISTRY:
+        # Re-registration with the SAME default is an idempotent no-op
+        # (module reload); a DIFFERENT default used to silently overwrite
+        # nothing -- the second caller believed its default won when the
+        # first registration's value stayed live.  Make the conflict loud.
+        prev = _REGISTRY[name]
+        if prev.default != default or type(prev.default) is not type(default):
+            raise ValueError(
+                f"flag {name!r} is already registered with default "
+                f"{prev.default!r}; re-registration with a different "
+                f"default {default!r} would be silently ignored -- "
+                f"rename the flag or reuse the existing registration")
+        return
+    _REGISTRY[name] = _Flag(name, default, doc, validator, writable)
+
+
+def flags_snapshot() -> Dict[str, Any]:
+    """Snapshot every flag's CURRENT value -> {name: value}.  Pair with
+    :func:`flags_restore` so tests mutate flags without hand-rolled
+    try/finally bookkeeping::
+
+        snap = flags_snapshot()
+        try:
+            set_flags({"FLAGS_graph_lint": "error"})
+            ...
+        finally:
+            flags_restore(snap)
+    """
+    return {name: f.value for name, f in _REGISTRY.items()}
+
+
+def flags_restore(snapshot: Dict[str, Any]) -> None:
+    """Restore values captured by :func:`flags_snapshot`.  Bypasses the
+    writable/validator gates (the values were live before, so they are
+    valid by construction); flags registered after the snapshot keep
+    their current value."""
+    for name, value in snapshot.items():
+        f = _REGISTRY.get(name)
+        if f is not None:
+            f.value = value
 
 
 def set_flags(flags: Dict[str, Any]) -> None:
@@ -190,3 +228,24 @@ define_flag("jit_ledger_dir",
             "into this directory. The in-memory event ring and the "
             "jit_compile_count/jit_cache_hit/jit_compile_ms_total stats "
             "are always maintained.")
+define_flag("graph_lint",
+            os.environ.get("PADDLE_TPU_GRAPH_LINT", "off").lower()
+            or "off",
+            "Graph-lint tri-state (paddle_tpu.analysis): 'off' = no "
+            "analysis (one Python branch per compile, zero per step); "
+            "'warn' = run the pass suite over every fresh jit/Executor/"
+            "TrainStep trace and emit GraphLintWarning + gauges/JSONL; "
+            "'error' = additionally raise EnforceError at trace time on "
+            "ERROR-severity findings (host-transfer, donation, "
+            "collective-consistency). Seeded by PADDLE_TPU_GRAPH_LINT.",
+            validator=lambda v: str(v).lower() in ("off", "warn", "error"))
+define_flag("graph_lint_suppress", "",
+            "Comma-separated lint pass ids to skip (e.g. "
+            "'layout,dead-fetch'); the scoped analysis.suppress() context "
+            "manager composes with this.")
+define_flag("graph_lint_dir",
+            os.environ.get("PADDLE_TPU_GRAPH_LINT_DIR", ""),
+            "When non-empty, every lint diagnostic additionally streams "
+            "as JSONL via utils.monitor.LogWriter into this directory "
+            "(next to the recompile ledger's PADDLE_TPU_JIT_LEDGER_DIR "
+            "sink). Gauges are always maintained.")

@@ -39,13 +39,23 @@ from ..profiler import ledger as _ledger
 from ..profiler import span as _span
 
 
+def _weak_bit(a):
+    # weak-typed operands (python scalars promoted at trace time) compile
+    # DIFFERENT programs than committed arrays of the same dtype; the bit
+    # must live in the cache key so the recompile ledger's diff names the
+    # true culprit instead of reporting "key unchanged"
+    return "weak" if getattr(a, "weak_type", False) else "strong"
+
+
 def _sig_of(args):
     sig = []
     for a in args:
         if isinstance(a, Tensor):
-            sig.append(("t", tuple(a._value.shape), str(a._value.dtype)))
+            sig.append(("t", tuple(a._value.shape), str(a._value.dtype),
+                        _weak_bit(a._value)))
         elif hasattr(a, "shape"):
-            sig.append(("a", tuple(a.shape), str(getattr(a, "dtype", "?"))))
+            sig.append(("a", tuple(a.shape), str(getattr(a, "dtype", "?")),
+                        _weak_bit(a)))
         else:
             # include the type: baked constants must not alias across
             # 1 / True / 1.0 (equal under ==, different programs)
@@ -94,6 +104,14 @@ class StaticFunction:
                     raise   # deliberate diagnostic, not a fallback case
                 new = None
             out = new if (new is not None and new is not raw) else raw
+            from ..analysis import lint_enabled as _lint_on
+            if _lint_on():
+                # AST-level graph lint BEFORE transformation: hazards that
+                # happen at trace time leave no jaxpr equation behind
+                # (.numpy()/float() concretization), so only the source
+                # shows them.  Amortized: once per StaticFunction.
+                from ..analysis import run_ast_lint
+                run_ast_lint(raw, site=f"jit:{getattr(raw, '__qualname__', 'fn')}")
             self._ast_fn = out.__get__(bound) if bound is not None else out
         return self._ast_fn
 
@@ -183,6 +201,25 @@ class StaticFunction:
                + [params[n] for n in param_names] + [key])
         site = f"jit:{getattr(self._function, '__qualname__', 'fn')}"
         if fresh:
+            from ..analysis import lint_enabled as _lint_on
+            if _lint_on():
+                # graph lint over the about-to-compile program (abstract
+                # eval only); in error mode this raises BEFORE the first
+                # dispatch -- drop the cache entry so a retried call
+                # re-lints instead of silently hitting the cache
+                from ..analysis import lint_traced
+                paths = ([f"args[{i}]" for i in t_idx]
+                         + [f"kwargs[{k}]" for k in tkw_names]
+                         + [f"param:{n}" for n in param_names]
+                         + ["rng_key"])
+                try:
+                    lint_traced(prim.fn, ins, site=site, kind="jit",
+                                cache_key=sig,
+                                prev_key=_ledger.last_key(site),
+                                arg_paths=paths)
+                except Exception:
+                    self._cache.pop(sig, None)
+                    raise
             # the trace + XLA compile happen inside this first dispatch;
             # ledger the wall time and the signature diff (the "why did
             # this recompile" record)

@@ -738,6 +738,7 @@ class TrainStep:
             self._sentinel_names = ["loss"] + sorted(
                 self._state["params"])   # stack order of finite_vec
         step = self._build_step()
+        self._step_fn = step     # raw (unjitted) step: graph-lint traces it
         state_shardings = dict(self._shardings)
         rep = NamedSharding(self.mesh, P())
         loss_out = (rep, rep, rep) if self._sentinel_active else rep
@@ -846,14 +847,47 @@ class TrainStep:
                                       self.grad_scaler.is_enable()) else None
         scale = np.float32(scaler.get_loss_scaling() if scaler else 1.0)
         # retrace detection: jax.jit silently recompiles on a new input
-        # signature — ledger it like any other cache miss
-        sig = (tuple(None if x is None
-                     else (tuple(x.shape), str(x.dtype)) for x in inputs),
-               None if label is None
-               else (tuple(label.shape), str(label.dtype)))
+        # signature — ledger it like any other cache miss.  Entries are
+        # path-labeled and carry the weak-type bit: a python scalar fed
+        # one step and a committed array the next LOOK identical by
+        # shape/dtype but compile different programs, and the ledger diff
+        # must name the argument that moved, not say "key unchanged".
+        def _arg_sig(path, x):
+            # "arg:" prefix = the ledger's labeled-leaf convention: the
+            # cache-key diff prints this path instead of a positional index
+            if x is None:
+                return ("arg:" + path, "none")
+            return ("arg:" + path, tuple(x.shape), str(x.dtype),
+                    "weak" if getattr(x, "weak_type", False) else "strong")
+
+        sig = (tuple(_arg_sig(f"inputs[{i}]", x)
+                     for i, x in enumerate(inputs))
+               + (_arg_sig("label", label),))
         fresh = sig not in self._seen_sigs
         site = f"train_step:{type(self.layer).__name__}:{id(self):#x}"
         if fresh:
+            from ..analysis import lint_enabled as _lint_on
+            if _lint_on():
+                # graph lint over the about-to-compile step (abstract
+                # eval only, amortized per retrace): donation and
+                # sharding-coverage read the compile-site metadata; in
+                # error mode this raises BEFORE the step ever runs
+                from ..analysis import lint_traced
+                from .api import get_partition_spec
+                specs = None
+                if self._pipe is None:
+                    try:
+                        specs = {n: get_partition_spec(p) for n, p in
+                                 self.layer.named_parameters()}
+                    except Exception:
+                        specs = None
+                lint_traced(self._step_fn,
+                            (self.state, inputs, label, lr, scale),
+                            site=site, kind="train_step", cache_key=sig,
+                            prev_key=_ledger.last_key(site),
+                            donate=self._donate, mesh=self.mesh,
+                            params=self.state["params"],
+                            partition_specs=specs)
             self._seen_sigs.add(sig)
             t0 = time.perf_counter()
             with _span("train_step::compile"):

@@ -64,7 +64,19 @@ def _get_writer():
 
 def _leaves(key, path=""):
     """Flatten a nested cache key into (path, repr) leaves so the diff
-    points at the exact entry that changed."""
+    points at the exact entry that changed.
+
+    Self-describing entries — tuples whose first element is an
+    ``"arg:<path>"`` label (the TrainStep/jit signature convention) —
+    flatten to ONE leaf under that label, so the diff reads
+    ``inputs[0]: ((8,16),'float32','weak') -> ...`` instead of a bare
+    positional ``[0][3]``: the ledger and the graph-lint recompile-hazard
+    pass then name the same culprit argument."""
+    if isinstance(key, (tuple, list)) and key \
+            and isinstance(key[0], str) and key[0].startswith("arg:"):
+        label = key[0][4:]
+        yield (f"{path}.{label}" if path else label, repr(tuple(key[1:])))
+        return
     if isinstance(key, (tuple, list)) and any(
             isinstance(e, (tuple, list, dict)) for e in key):
         for i, e in enumerate(key):
@@ -110,6 +122,15 @@ def record_compile(site: str, kind: str, key, ms: float, extra=None) -> dict:
 
 def record_cache_hit(site: str) -> None:
     stat_add("jit_cache_hit")
+
+
+def last_key(site: str):
+    """The most recent cache key recorded at ``site`` (None before the
+    first compile there) — the graph-lint recompile-hazard pass diffs the
+    incoming key against this so the lint and the ledger's own diff name
+    the same culprit."""
+    with _lock:
+        return _last_key.get(site)
 
 
 def compile_events(site: Optional[str] = None):

@@ -346,6 +346,28 @@ class Executor:
 
         persist_vals = _collect_persistables(program, scope,
                                              persist_names)
+        site = f"executor:{program._uid}"
+
+        if fresh:
+            from ..analysis import lint_enabled as _lint_on
+            if _lint_on():
+                # graph lint over the fresh program (abstract eval only,
+                # amortized per compile): the jaxpr passes see the whole
+                # replay; program_info adds the op-level fetch view so
+                # dead-fetch names the op, not a jaxpr equation
+                from ..analysis import lint_traced
+                _ops = [(getattr(op, "type", op.prim),
+                         tuple(op.input_names), tuple(op.output_names))
+                        for op in program.global_block().ops]
+                lint_traced(
+                    replay, (feed_vals, persist_vals),
+                    site=site, kind="executor",
+                    cache_key=key + (tuple(union),),
+                    prev_key=_ledger.last_key(site),
+                    program_info={"ops": _ops, "fetches": union,
+                                  "written": written,
+                                  "persistable": persist_names,
+                                  "feeds": feed_names})
 
         if compiled is not None and compiled._data_parallel:
             from ..parallel.api import batch_sharding
@@ -356,7 +378,6 @@ class Executor:
                     v, batch_sharding(mesh, ndim=max(v.ndim, 1)))
                     for v in feed_vals]
 
-        site = f"executor:{program._uid}"
         if fresh:
             # trace + XLA compile happen inside this first dispatch (the
             # AOT path compiled above; a deserialized executable skipped

@@ -396,7 +396,8 @@ def test_sort_unique_static_matches_np_unique():
 def test_device_dedup_trainer_bit_identical():
     """np.unique also sorts, so the device path must reproduce the host
     path's (uniq, inv) exactly — losses bit-identical step for step."""
-    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.framework.flags import (flags_restore, flags_snapshot,
+                                            set_flags)
 
     def run(flag):
         set_flags({"FLAGS_wide_deep_device_dedup": flag})
@@ -411,17 +412,20 @@ def test_device_dedup_trainer_bit_identical():
             losses.append(t.step(ids, dense, label))
         return losses
 
+    snap = flags_snapshot()
     try:
         assert run(False) == run(True)
     finally:
-        set_flags({"FLAGS_wide_deep_device_dedup": False})
+        flags_restore(snap)
 
 
 def test_device_dedup_cap_grows_on_overflow():
     """A batch with far more uniques than the seeded octave must re-run
     one octave up, not truncate (silent truncation would gather wrong
     rows)."""
-    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.framework.flags import (flags_restore, flags_snapshot,
+                                            set_flags)
+    snap = flags_snapshot()
     try:
         set_flags({"FLAGS_wide_deep_device_dedup": True})
         paddle.seed(12)
@@ -442,4 +446,4 @@ def test_device_dedup_cap_grows_on_overflow():
         np.testing.assert_array_equal(np.asarray(inv),
                                       inv_np.reshape(-1))
     finally:
-        set_flags({"FLAGS_wide_deep_device_dedup": False})
+        flags_restore(snap)

@@ -254,11 +254,11 @@ def test_sentinel_opt_state_frozen_on_bad_step():
 
 
 def test_sentinel_bounded_abort_with_diagnostic_dump(tmp_path, request):
-    from paddle_tpu.framework.flags import set_flags, flag as _flag
-    old = _flag("sentinel_max_bad_steps")
+    from paddle_tpu.framework.flags import (flags_restore, flags_snapshot,
+                                            set_flags)
+    snap = flags_snapshot()
     set_flags({"sentinel_max_bad_steps": 2})
-    request.addfinalizer(
-        lambda: set_flags({"sentinel_max_bad_steps": old}))
+    request.addfinalizer(lambda: flags_restore(snap))
     step, x, y = _sentinel_step()
     step.attach_checkpoint_manager(
         CheckpointManager(str(tmp_path), keep=0))

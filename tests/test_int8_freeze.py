@@ -290,7 +290,8 @@ def test_predictor_serves_int8_behind_flag(tmp_path):
     """Predictor int8-vs-float output agreement + transparent artifact
     selection: same Config/dir, FLAGS_use_int8_inference decides."""
     from paddle_tpu import inference
-    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.framework.flags import (flags_restore, flags_snapshot,
+                                            set_flags)
     paddle.seed(8)
     rng = np.random.RandomState(8)
     m = _Net()
@@ -305,6 +306,7 @@ def test_predictor_serves_int8_behind_flag(tmp_path):
     p_f = inference.create_predictor(inference.Config(str(tmp_path)))
     assert p_f.quant_info() is None
     out_f = p_f.run([x])[0]
+    snap = flags_snapshot()
     try:
         set_flags({"FLAGS_use_int8_inference": True})
         p_8 = inference.create_predictor(inference.Config(str(tmp_path)))
@@ -313,7 +315,7 @@ def test_predictor_serves_int8_behind_flag(tmp_path):
         assert info["signature"] == quant_signature(m)
         out_8 = p_8.run([x])[0]
     finally:
-        set_flags({"FLAGS_use_int8_inference": False})
+        flags_restore(snap)
     # int8 serving agrees with the float program within the quant budget
     assert np.abs(out_8 - out_f).max() < 0.25, np.abs(out_8 - out_f).max()
     assert np.abs(out_8 - out_f).max() > 0    # and really took the int8 path
@@ -353,7 +355,8 @@ def test_end_to_end_ptq_freeze_predictor_smoke(tmp_path):
     freeze → save_int8_model → Predictor serves int8 transparently, with
     batch-1 and batched serving agreeing with the eager frozen model."""
     from paddle_tpu import inference
-    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.framework.flags import (flags_restore, flags_snapshot,
+                                            set_flags)
     paddle.seed(9)
     rng = np.random.RandomState(9)
     xtr, ytr = _blob_task(rng)(64)
@@ -369,6 +372,7 @@ def test_end_to_end_ptq_freeze_predictor_smoke(tmp_path):
     prefix = str(tmp_path / "lenet")
     save_int8_model(m, prefix, input_spec=[InputSpec([None, 1, 28, 28])])
     eager = m(paddle.to_tensor(xtr[:4])).numpy()
+    snap = flags_snapshot()
     try:
         set_flags({"FLAGS_use_int8_inference": True})
         p = inference.create_predictor(inference.Config(str(tmp_path)))
@@ -378,4 +382,4 @@ def test_end_to_end_ptq_freeze_predictor_smoke(tmp_path):
             np.testing.assert_allclose(out, eager[:batch], rtol=0,
                                        atol=1e-5)
     finally:
-        set_flags({"FLAGS_use_int8_inference": False})
+        flags_restore(snap)
