@@ -598,6 +598,215 @@ def bench_inference(on_tpu):
     return res
 
 
+# -- 7. Serving engine (sustained QPS through continuous batching, ISSUE 6) --
+
+# p99 SLO bounds per model on the bench chip; the CPU smoke gets one slack
+# bound (it measures wiring, not the chip)
+SERVING_SLO_P99_MS = {"lenet": 50.0, "resnet_block": 100.0, "bert": 250.0}
+SERVING_SLO_CPU_MS = 2000.0
+
+
+def _serving_traffic(server, name, specs, duration_s, clients, max_rows,
+                     vocab, seed=0):
+    """Concurrent mixed-row clients against one served model; returns
+    per-client error strings (empty = clean run)."""
+    import threading
+    errors = []
+    deadline = time.perf_counter() + duration_s
+
+    def gen(rng, rows):
+        out = []
+        for shape, dtype in specs:
+            s = (rows,) + tuple(shape[1:])
+            if np.issubdtype(np.dtype(dtype), np.integer):
+                out.append(rng.randint(0, vocab or 100, s).astype(dtype))
+            else:
+                out.append(rng.randn(*s).astype(dtype))
+        return out
+
+    def client(i):
+        rng = np.random.RandomState(seed + i)
+        while time.perf_counter() < deadline:
+            rows = int(rng.randint(1, max_rows + 1))
+            try:
+                out = server.submit(name, gen(rng, rows)).result(timeout=60)
+                if out[0].shape[0] != rows:
+                    raise AssertionError("padding leaked into a result")
+            except Exception as e:   # noqa: BLE001 — recorded per client
+                errors.append(f"client{i}: {type(e).__name__}: {e}")
+                return
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errors
+
+
+def _bench_serve_one(name, build, specs, variant, buckets, duration_s,
+                     clients, max_rows, on_tpu):
+    """Export one (model, variant) for serving, warm it, sustain traffic,
+    and report QPS/p50/p99 + the zero-steady-state-recompile assert."""
+    import tempfile
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import serving
+    from paddle_tpu.framework.flags import (flags_restore, flags_snapshot,
+                                            set_flags)
+
+    model, vocab = build()
+    model.eval()
+    snap = flags_snapshot()
+    try:
+        if variant == "int8":
+            from paddle_tpu.quantization import PostTrainingQuantization
+            rng = np.random.RandomState(0)
+            cal = []
+            for shape, dtype in specs:
+                s = (buckets[0],) + tuple(shape[1:])
+                cal.append(rng.randint(0, vocab or 100, s).astype(dtype)
+                           if np.issubdtype(np.dtype(dtype), np.integer)
+                           else rng.randn(*s).astype(dtype))
+
+            def loader():
+                for _ in range(4):
+                    yield tuple(paddle.to_tensor(a) for a in cal)
+
+            PostTrainingQuantization(model=model, data_loader=loader(),
+                                     batch_nums=4).quantize()
+            set_flags({"FLAGS_use_int8_inference": True})
+        else:
+            # bf16 weights + bf16 float inputs, f32 outputs (the TPU
+            # serving dtype); int feeds (token ids) pass through
+            paddle.amp.decorate(models=model, level="O2", dtype="bfloat16")
+            inner = model
+
+            class _BF16Serve(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.inner = inner
+
+                def forward(self, *xs):
+                    xs = [paddle.cast(x, "bfloat16")
+                          if "float" in str(x.dtype) else x for x in xs]
+                    out = self.inner(*xs)
+                    if isinstance(out, (list, tuple)):
+                        return [paddle.cast(o, "float32") for o in out]
+                    return paddle.cast(out, "float32")
+
+            model = _BF16Serve()
+            model.eval()
+        with tempfile.TemporaryDirectory() as d:
+            prefix = os.path.join(d, name)
+            manifest = serving.export_for_serving(
+                model, prefix, specs, buckets=buckets,
+                int8=(variant == "int8"))
+            server = serving.Server(serving.ServingConfig(
+                workers=2, buckets=buckets))
+            server.register(name, prefix, buckets=buckets)
+            t0 = time.perf_counter()
+            server.start()
+            warmup_s = time.perf_counter() - t0
+            errors = _serving_traffic(server, name, specs, duration_s,
+                                      clients, max_rows, vocab)
+            st = server.stats(name)
+            server.stop()
+            steady = len(server.compile_events_since_warmup())
+            slo = SERVING_SLO_P99_MS.get(name, 100.0) if on_tpu \
+                else SERVING_SLO_CPU_MS
+            res = {"variant": variant, "backend": st["backend"],
+                   "export_mode": manifest["mode"],
+                   "buckets": list(buckets),
+                   "warmup_s": round(warmup_s, 3),
+                   "qps": st["qps"], "p50_ms": st["p50_ms"],
+                   "p99_ms": st["p99_ms"],
+                   "completed": st["completed"],
+                   "avg_batch_rows": st["avg_batch_rows"],
+                   "padding_ratio": st["padding_ratio"],
+                   "slo_p99_ms": slo, "slo_met": st["p99_ms"] <= slo,
+                   "steady_compiles": steady}
+            if errors:
+                res["traffic_errors"] = errors[:4]
+            # the acceptance invariant: ZERO XLA compiles after warm-up
+            # during the steady-state window
+            assert steady == 0, (
+                f"{name}/{variant}: {steady} steady-state recompile(s)")
+            return res
+    finally:
+        flags_restore(snap)
+
+
+def bench_serving(on_tpu):
+    """Sustained-QPS serving suite: lenet / resnet_block / bert served
+    through the continuous-batching engine at bf16 vs int8, with p50/p99
+    SLOs and the zero-steady-state-recompile assert (the ledger-proven
+    bucketing invariant)."""
+    import paddle_tpu.nn as nn
+
+    if on_tpu:
+        ch, hw, seq = 64, 56, 128
+        buckets, duration_s, clients, max_rows = (1, 2, 4, 8, 16), 8.0, 8, 4
+    else:
+        ch, hw, seq = 8, 8, 32
+        buckets, duration_s, clients, max_rows = (1, 2, 4), 1.0, 3, 2
+
+    def lenet():
+        from paddle_tpu.vision.models import LeNet
+        return LeNet(), None
+
+    def resnet_block():
+        class Block(nn.Layer):
+            """One residual conv-BN-ReLU pair (the fused-conv stage)."""
+
+            def __init__(self):
+                super().__init__()
+                self.c1 = nn.Conv2D(ch, ch, 3, padding=1, bias_attr=False)
+                self.b1 = nn.BatchNorm2D(ch)
+                self.c2 = nn.Conv2D(ch, ch, 3, padding=1, bias_attr=False)
+                self.b2 = nn.BatchNorm2D(ch)
+                self.relu = nn.ReLU()
+
+            def forward(self, x):
+                h = self.relu(self.b1(self.c1(x)))
+                return self.relu(self.b2(self.c2(h)) + x)
+
+        return Block(), None
+
+    def bert():
+        from paddle_tpu.text.models.bert import BertConfig, BertModel
+        cfg = BertConfig.base() if on_tpu else BertConfig.tiny(seq=seq)
+        return BertModel(cfg), cfg.vocab_size
+
+    plans = [
+        ("lenet", lenet, [([None, 1, 28, 28], "float32")]),
+        ("resnet_block", resnet_block, [([None, ch, hw, hw], "float32")]),
+        ("bert", bert, [([None, seq], "int32")]),
+    ]
+    models = {}
+    for name, build, specs in plans:
+        for variant in ("bf16", "int8"):
+            key = f"{name}_{variant}"
+            try:
+                models[key] = _bench_serve_one(
+                    name, build, specs, variant, buckets, duration_s,
+                    clients, max_rows, on_tpu)
+            except Exception as e:       # noqa: BLE001 — per-model record
+                _note(f"[bench] serving/{key}: {type(e).__name__}: {e}")
+                models[key] = {"error": f"{type(e).__name__}: {e}"}
+    ok = [m for m in models.values() if "error" not in m]
+    res = {"unit": "qps", "models": models,
+           "zero_steady_state_recompiles":
+               bool(ok) and all(m["steady_compiles"] == 0 for m in ok),
+           "all_slos_met": bool(ok) and all(m["slo_met"] for m in ok)}
+    f32 = models.get("lenet_bf16", {}).get("qps")
+    i8 = models.get("lenet_int8", {}).get("qps")
+    if f32 and i8:
+        res["lenet_int8_qps_speedup"] = round(i8 / f32, 3)
+    return res
+
+
 WORKLOADS = [
     ("mnist_lenet_static", bench_lenet_static),
     ("resnet50_dygraph", bench_resnet50),
@@ -605,6 +814,7 @@ WORKLOADS = [
     ("transformer_big", bench_transformer_big),
     ("wide_deep_ctr", bench_wide_deep),
     ("inference", bench_inference),
+    ("serving", bench_serving),
 ]
 
 

@@ -43,7 +43,7 @@ import importlib as _importlib
 
 _SUBPACKAGES = ["nn", "optimizer", "static", "io", "metric", "amp", "jit",
                 "distributed", "vision", "text", "autograd", "hapi",
-                "incubate", "inference", "profiler", "device",
+                "incubate", "inference", "serving", "profiler", "device",
                 "quantization", "analysis", "utils", "distribution", "onnx",
                 "tensor", "regularizer", "compat", "sysconfig", "version",
                 "fluid"]

@@ -249,3 +249,44 @@ define_flag("graph_lint_dir",
             "as JSONL via utils.monitor.LogWriter into this directory "
             "(next to the recompile ledger's PADDLE_TPU_JIT_LEDGER_DIR "
             "sink). Gauges are always maintained.")
+
+# ---- Serving engine (paddle_tpu.serving) ------------------------------------
+define_flag("serving_buckets", "1,2,4,8,16,32,64",
+            "Default batch-bucket ladder for the serving engine: pending "
+            "requests continuously batch into the smallest bucket that "
+            "holds them and pad up, so steady-state serving only ever "
+            "executes shapes compiled at warm-up (zero recompiles). "
+            "Per-model override via ModelSpec(buckets=...).",
+            validator=lambda v: all(int(b) > 0 for b in
+                                    str(v).split(",") if b.strip()))
+define_flag("serving_workers", 2,
+            "Serving worker threads per Server; each worker runs its own "
+            "Predictor.clone() (AnalysisPredictor::Clone seat: shared "
+            "weights + executables, per-clone IO buffers).",
+            validator=lambda v: int(v) >= 1)
+define_flag("serving_queue_capacity", 1024,
+            "Bound on requests pending in the serving queue; submit() past "
+            "it blocks up to its timeout then raises UnavailableError "
+            "(backpressure instead of unbounded host memory).",
+            validator=lambda v: int(v) >= 1)
+define_flag("serving_batch_timeout_ms", 2.0,
+            "How long the continuous batcher holds a non-full batch open "
+            "for more arrivals before dispatching what it has. 0 "
+            "dispatches immediately (lowest latency, smallest batches).",
+            validator=lambda v: float(v) >= 0)
+define_flag("serving_pipeline_depth", 2,
+            "Batches a worker keeps in flight on device before fencing "
+            "the oldest: H2D + dispatch of batch N+1 overlap execution "
+            "of batch N (jit-served models; the executor path is "
+            "synchronous). 1 disables pipelining.",
+            validator=lambda v: int(v) >= 1)
+define_flag("serving_strict", True,
+            "Steady-state shape discipline: a batch whose bucket has no "
+            "warm-up-compiled executable FAILS (its requests get "
+            "EnforceError) instead of compiling on the fly. Disable only "
+            "for debugging; any fallback compile is ledgered and counted "
+            "in the serving_steady_compiles gauge either way.")
+define_flag("serving_metrics_window", 2048,
+            "Sliding-window size (completed requests) of the per-model "
+            "serving latency reservoir behind the p50/p99 gauges.",
+            validator=lambda v: int(v) >= 16)

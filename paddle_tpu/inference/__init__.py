@@ -101,17 +101,73 @@ class PredictorTensor:
         self._is_input = is_input
 
     def copy_from_cpu(self, arr):
-        self._p._feeds[self.name] = np.asarray(arr)
+        from ..framework.enforce import InvalidArgumentError
+        if not self._is_input:
+            raise InvalidArgumentError(
+                f"copy_from_cpu on fetch {self.name!r}: only feed handles "
+                "accept input data (use copy_to_cpu to read outputs)")
+        arr = np.asarray(arr)
+        declared = self._p._declared_shapes.get(self.name)
+        if declared is not None and tuple(arr.shape) != declared:
+            # ZeroCopyTensor::Reshape contract: the declared shape is a
+            # promise the next copy must keep (was a silent no-op)
+            raise InvalidArgumentError(
+                f"feed {self.name!r}: copy_from_cpu got shape "
+                f"{list(arr.shape)} but reshape() declared "
+                f"{list(declared)}")
+        self._p._feeds[self.name] = arr
 
     def copy_to_cpu(self):
+        from ..framework.enforce import NotFoundError
+        if self._is_input:
+            if self.name not in self._p._feeds:
+                raise NotFoundError(
+                    f"feed {self.name!r} has no value yet — "
+                    "copy_from_cpu() it first")
+            return np.asarray(self._p._feeds[self.name])
+        if self.name not in self._p._results:
+            raise NotFoundError(
+                f"fetch {self.name!r} has no value yet — call run() "
+                "before copy_to_cpu()")
         return np.asarray(self._p._results[self.name])
 
     def reshape(self, shape):
-        pass
+        """ZeroCopyTensor::Reshape parity: declare the shape the next
+        copy_from_cpu must carry.  Validated, not allocated — XLA owns
+        device buffers, so the declaration is a contract, and a
+        mismatching copy_from_cpu raises instead of silently serving the
+        wrong shape."""
+        from ..framework.enforce import InvalidArgumentError
+        if not self._is_input:
+            raise InvalidArgumentError(
+                f"reshape on fetch {self.name!r}: output shapes are "
+                "decided by the compiled program")
+        dims = []
+        for d in shape:
+            d = int(d)
+            if d <= 0:
+                raise InvalidArgumentError(
+                    f"feed {self.name!r}: reshape dims must be concrete "
+                    f"positive ints, got {list(shape)} (dynamic batch is "
+                    "declared at export via InputSpec([None, ...]))")
+            dims.append(d)
+        self._p._declared_shapes[self.name] = tuple(dims)
 
     def shape(self):
+        from ..framework.enforce import NotFoundError
         if self._is_input:
+            declared = self._p._declared_shapes.get(self.name)
+            if declared is not None:
+                return list(declared)
+            if self.name not in self._p._feeds:
+                raise NotFoundError(
+                    f"feed {self.name!r} has no shape yet — reshape() or "
+                    "copy_from_cpu() it first")
             return list(self._p._feeds[self.name].shape)
+        if self.name not in self._p._results:
+            raise NotFoundError(
+                f"fetch {self.name!r} has no shape yet — call run() "
+                "before shape()")
         return list(np.asarray(self._p._results[self.name]).shape)
 
 
@@ -164,6 +220,7 @@ class Predictor:
                 self._exe.set_cache_extra_key(f"quant:{sig}")
         self._feeds: Dict[str, np.ndarray] = {}
         self._results: Dict[str, np.ndarray] = {}
+        self._declared_shapes: Dict[str, tuple] = {}
 
     def quant_info(self):
         """The served model's quantization sidecar (quant.json) when the
@@ -183,6 +240,7 @@ class Predictor:
         c = copy.copy(self)           # aliases program/executor/weights
         c._feeds = {}                 # own IO buffers per serving thread
         c._results = {}
+        c._declared_shapes = {}
         return c
 
     @staticmethod
@@ -234,6 +292,28 @@ class Predictor:
         else:
             outs = self._exe.run(self._program, feed=dict(self._feeds),
                                  fetch_list=self._fetch_names)
+        self._results = dict(zip(self._fetch_names, outs))
+        return [self._results[n] for n in self._fetch_names]
+
+    def run_async(self, inputs=None):
+        """run() without the host fence: outputs stay device-backed jax
+        arrays (dispatch is asynchronous), so a serving worker can overlap
+        H2D + execution of the next batch with this one — ``np.asarray``
+        (or copy_to_cpu) on a result is the fence.  Results land in the
+        same per-predictor buffers run() uses."""
+        if inputs is not None:
+            for name, arr in zip(self._feed_names, inputs):
+                self._feeds[name] = arr.numpy() if isinstance(arr, Tensor) \
+                    else arr
+        if self._translated is not None:
+            out = self._translated(
+                *[self._feeds[n] for n in self._feed_names])
+            outs = [o._value for o in
+                    (out if isinstance(out, (list, tuple)) else [out])]
+        else:
+            outs = [t._value for t in self._exe.run(
+                self._program, feed=dict(self._feeds),
+                fetch_list=self._fetch_names, return_numpy=False)]
         self._results = dict(zip(self._fetch_names, outs))
         return [self._results[n] for n in self._fetch_names]
 

@@ -312,7 +312,10 @@ class TranslatedLayer:
         return len(self._exported.out_avals)
 
     def __call__(self, *args):
-        arrs = [a._value if isinstance(a, Tensor) else np.asarray(a)
+        # device arrays pass through untouched: np.asarray would fence a
+        # D2H copy and serialize the serving pipeline's async dispatch
+        arrs = [a._value if isinstance(a, Tensor)
+                else (a if isinstance(a, jax.Array) else np.asarray(a))
                 for a in args]
         out = self._exported.call(*arrs, *self._params)
         if isinstance(out, (list, tuple)):

@@ -341,6 +341,9 @@ def stop_profiler(sorted_key=None, profile_path=None):
 from . import ledger  # noqa: E402,F401
 from .ledger import compile_events, set_ledger_dir  # noqa: E402,F401
 
+# serving instruments (latency percentiles + QPS; see metrics.py)
+from .metrics import LatencyWindow, RateMeter  # noqa: E402,F401
+
 # device-side: direct jax.profiler bridges
 start_trace = jax.profiler.start_trace
 stop_trace = jax.profiler.stop_trace

@@ -1,0 +1,119 @@
+"""Latency/QPS instruments for long-running serving processes.
+
+Reference parity: paddle/fluid/platform/monitor.h keeps int64 gauges only;
+the serving engine needs *distributions* (p50/p99 latency) and *rates*
+(QPS).  This module adds the two missing instruments on top of the same
+StatRegistry so existing readers (``all_stats``) see serving health next
+to the recompile ledger gauges:
+
+  * :class:`LatencyWindow` — a thread-safe sliding reservoir of the last N
+    samples with percentile queries; ``publish(prefix)`` mirrors
+    p50/p99/max into ``<prefix>_p50_us``-style integer gauges.
+  * :class:`RateMeter` — completed-count over a monotonic window →
+    requests/s, mirrored as ``<prefix>_qps_milli`` (int, 1/1000 qps).
+
+Host-side only and off the device hot path: one deque append per
+completed request.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from ..utils.monitor import stat_set
+
+
+class LatencyWindow:
+    """Sliding window of the last ``maxlen`` latency samples (seconds)."""
+
+    def __init__(self, maxlen: int = 2048):
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=int(maxlen))
+        self._count = 0
+        self._max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        s = float(seconds)
+        with self._lock:
+            self._buf.append(s)
+            self._count += 1
+            if s > self._max:
+                self._max = s
+
+    @property
+    def count(self) -> int:
+        """Total samples observed (not just those still in the window)."""
+        with self._lock:
+            return self._count
+
+    def percentile(self, p: float) -> Optional[float]:
+        """p in [0, 100] over the current window; None while empty.
+        Nearest-rank on the sorted window (p99 of 100 samples = the 99th)."""
+        with self._lock:
+            data = sorted(self._buf)
+        if not data:
+            return None
+        if p <= 0:
+            return data[0]
+        if p >= 100:
+            return data[-1]
+        rank = max(0, min(len(data) - 1,
+                          int(round(p / 100.0 * len(data) + 0.5)) - 1))
+        return data[rank]
+
+    def snapshot(self) -> Dict[str, float]:
+        """{count, p50_ms, p99_ms, max_ms} of the current window (zeros
+        while empty) — the schema PERF.md's serving section records."""
+        p50 = self.percentile(50)
+        p99 = self.percentile(99)
+        with self._lock:
+            count, mx = self._count, self._max
+        return {"count": count,
+                "p50_ms": round((p50 or 0.0) * 1e3, 3),
+                "p99_ms": round((p99 or 0.0) * 1e3, 3),
+                "max_ms": round(mx * 1e3, 3)}
+
+    def publish(self, prefix: str) -> None:
+        """Mirror the window into integer gauges: ``<prefix>_p50_us``,
+        ``<prefix>_p99_us``, ``<prefix>_max_us`` (microseconds)."""
+        p50, p99 = self.percentile(50), self.percentile(99)
+        with self._lock:
+            mx = self._max
+        stat_set(prefix + "_p50_us", int((p50 or 0.0) * 1e6))
+        stat_set(prefix + "_p99_us", int((p99 or 0.0) * 1e6))
+        stat_set(prefix + "_max_us", int(mx * 1e6))
+
+
+class RateMeter:
+    """Completed-count → rate (per second) since start() / last reset."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._n = 0
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._n += int(n)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._t0 = time.perf_counter()
+            self._n = 0
+
+    def rate(self) -> float:
+        with self._lock:
+            dt = time.perf_counter() - self._t0
+            n = self._n
+        return n / dt if dt > 0 else 0.0
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    def publish(self, prefix: str) -> None:
+        """Mirror into ``<prefix>_qps_milli`` (int, qps × 1000)."""
+        stat_set(prefix + "_qps_milli", int(self.rate() * 1e3))

@@ -337,6 +337,107 @@ def test_predictor_clone_four_threads(tmp_path):
     np.testing.assert_allclose(primary.run([xd])[0], ref, rtol=1e-5)
 
 
+def test_predictor_tensor_reshape_contract(tmp_path):
+    """ZeroCopyTensor::Reshape parity: reshape() declares the shape the
+    next copy_from_cpu must carry (was a silent no-op), and a mismatch
+    raises instead of serving the wrong shape."""
+    from paddle_tpu.framework.enforce import (EnforceNotMet,
+                                              InvalidArgumentError)
+    xd, ref = _save_tiny_model(tmp_path)
+    from paddle_tpu import inference
+    p = inference.create_predictor(inference.Config(str(tmp_path)))
+    h = p.get_input_handle("x")
+    h.reshape([4, 8])
+    assert h.shape() == [4, 8]            # declared before any data
+    with pytest.raises(InvalidArgumentError, match="declared"):
+        h.copy_from_cpu(np.zeros((2, 8), "float32"))
+    h.copy_from_cpu(xd)                   # matching copy passes
+    p.run()
+    got = p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    # non-concrete dims and output-handle reshape are rejected
+    with pytest.raises(EnforceNotMet):
+        h.reshape([-1, 8])
+    out_h = p.get_output_handle(p.get_output_names()[0])
+    with pytest.raises(EnforceNotMet):
+        out_h.reshape([4, 3])
+    with pytest.raises(EnforceNotMet):
+        out_h.copy_from_cpu(np.zeros((4, 3), "float32"))
+
+
+def test_predictor_tensor_errors_before_run(tmp_path):
+    """shape()/copy_to_cpu() before run() raise a clear EnforceError
+    naming the missing feed/fetch, not a bare KeyError."""
+    from paddle_tpu.framework.enforce import NotFoundError
+    _save_tiny_model(tmp_path)
+    from paddle_tpu import inference
+    p = inference.create_predictor(inference.Config(str(tmp_path)))
+    out_name = p.get_output_names()[0]
+    with pytest.raises(NotFoundError, match=f"{out_name}.*run"):
+        p.get_output_handle(out_name).copy_to_cpu()
+    with pytest.raises(NotFoundError, match=f"{out_name}.*run"):
+        p.get_output_handle(out_name).shape()
+    with pytest.raises(NotFoundError, match="'x'"):
+        p.get_input_handle("x").shape()
+    with pytest.raises(NotFoundError, match="'x'"):
+        p.get_input_handle("x").copy_to_cpu()
+
+
+def test_predictor_clone_threadpool_bit_identical(tmp_path):
+    """Predictor.clone() under real thread concurrency (ISSUE 6
+    satellite): N clones served from a ThreadPool produce bit-identical
+    outputs to sequential runs, and the ledger shows exactly one compile
+    per input signature — clones share one compiled executable."""
+    from concurrent.futures import ThreadPoolExecutor
+    from paddle_tpu.profiler import ledger
+    xd, _ = _save_tiny_model(tmp_path)
+    rng = np.random.RandomState(7)
+    batches = [rng.randn(4, 8).astype("float32") for _ in range(8)] \
+        + [rng.randn(7, 8).astype("float32") for _ in range(8)]
+    from paddle_tpu import inference
+    primary = inference.create_predictor(inference.Config(str(tmp_path)))
+    site = f"executor:{primary._program._uid}"
+    sequential = [primary.run([b])[0] for b in batches]   # compiles 2 sigs
+    n_compiles = len(ledger.compile_events(site))
+    assert n_compiles == 2            # one per signature (batch 4 / 7)
+
+    clones = [primary.clone() for _ in range(4)]
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        futs = [pool.submit(lambda c, b: c.run([b])[0],
+                            clones[i % 4], b)
+                for i, b in enumerate(batches)]
+        concurrent = [f.result() for f in futs]
+    for seq, conc in zip(sequential, concurrent):
+        np.testing.assert_array_equal(seq, conc)      # bit-identical
+    # the ThreadPool run added ZERO compiles: shared executable cache
+    assert len(ledger.compile_events(site)) == n_compiles
+
+
+def test_predictor_run_async_matches_run(tmp_path):
+    """run_async returns device-backed outputs (no host fence) that
+    np.asarray resolves to exactly run()'s results — the serving
+    pipeline's overlap seat."""
+    import jax
+    xd, ref = _save_tiny_model(tmp_path)
+    from paddle_tpu import inference
+    p = inference.create_predictor(inference.Config(str(tmp_path)))
+    outs = p.run_async([xd])
+    assert isinstance(outs[0], jax.Array)
+    np.testing.assert_allclose(np.asarray(outs[0]), ref, rtol=1e-5)
+    # jit-saved path too
+    from paddle_tpu.static import InputSpec
+    net = nn.Sequential(nn.Linear(6, 4), nn.ReLU())
+    prefix = str(tmp_path / "jm")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([None, 6])])
+    pj = inference.create_predictor(inference.Config(prefix))
+    x = np.random.randn(3, 6).astype("float32")
+    outs_j = pj.run_async([x])
+    assert isinstance(outs_j[0], jax.Array)
+    np.testing.assert_allclose(np.asarray(outs_j[0]),
+                               net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_predictor_aot_cache_skips_recompile(tmp_path):
     """SetOptimCacheDir parity: a second predictor over the same cache dir
     deserializes the PJRT executable instead of recompiling (asserted via

@@ -1,0 +1,473 @@
+"""Serving engine tests: bucketing, continuous batching, warm-up/AOT,
+zero-steady-state-recompile invariant, lint admission gate, clone-per-
+worker concurrency, metrics, and the tools/serve.py smoke (slow)."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import serving
+from paddle_tpu.framework.enforce import (EnforceNotMet,
+                                          InvalidArgumentError,
+                                          NotFoundError, OutOfRangeError,
+                                          PreconditionNotMetError,
+                                          UnavailableError)
+from paddle_tpu.framework.flags import (flags_restore, flags_snapshot,
+                                        set_flags)
+from paddle_tpu.static import InputSpec
+
+
+# -- bucketing ---------------------------------------------------------------
+
+def test_bucket_ladder_basic():
+    lad = serving.BucketLadder([8, 1, 4, 4, 2])
+    assert lad.buckets == [1, 2, 4, 8]
+    assert lad.max_rows == 8
+    assert lad.bucket_for(1) == 1
+    assert lad.bucket_for(3) == 4
+    assert lad.bucket_for(8) == 8
+    assert 4 in lad and 3 not in lad
+    with pytest.raises(OutOfRangeError):
+        lad.bucket_for(9)
+    with pytest.raises(InvalidArgumentError):
+        serving.BucketLadder([0, 2])
+
+
+def test_bucket_ladder_from_flag():
+    snap = flags_snapshot()
+    try:
+        set_flags({"FLAGS_serving_buckets": "2, 8,4"})
+        assert serving.BucketLadder.from_flag().buckets == [2, 4, 8]
+    finally:
+        flags_restore(snap)
+    assert serving.BucketLadder.from_flag((4, 2)).buckets == [2, 4]
+
+
+def test_pad_to_bucket():
+    a = np.arange(6, dtype="float32").reshape(2, 3)
+    b = np.ones((2,), dtype="int32")
+    pa, pb = serving.pad_to_bucket([a, b], 2, 4)
+    assert pa.shape == (4, 3) and pb.shape == (4,)
+    np.testing.assert_array_equal(pa[:2], a)
+    np.testing.assert_array_equal(pa[2:], 0)
+    assert pb.dtype == np.int32
+    # exact fit: no copy, same objects
+    same = serving.pad_to_bucket([a], 2, 2)
+    assert same[0] is a
+
+
+def test_pack_fifo():
+    from collections import deque
+    from concurrent.futures import Future
+
+    def req(rows):
+        return serving.Request(model="m", inputs=(), rows=rows,
+                               future=Future())
+
+    dq = deque([req(2), req(3), req(2), req(1)])
+    taken, rows = serving.pack_fifo(dq, 6)
+    assert [r.rows for r in taken] == [2, 3] and rows == 5
+    assert len(dq) == 2          # 2 would overflow 6-5=1; FIFO stops
+    taken2, rows2 = serving.pack_fifo(dq, 6)
+    assert rows2 == 3 and not dq
+
+
+# -- request queue (backpressure, no server needed) --------------------------
+
+def test_request_queue_backpressure():
+    q = serving.RequestQueue(capacity=1)
+    q.put(serving.Request(model="m", inputs=(), rows=1))
+    t0 = time.perf_counter()
+    with pytest.raises(UnavailableError):
+        q.put(serving.Request(model="m", inputs=(), rows=1), timeout=0.05)
+    assert time.perf_counter() - t0 >= 0.04
+    q.close()
+    with pytest.raises(UnavailableError):
+        q.put(serving.Request(model="m", inputs=(), rows=1), timeout=0.05)
+
+
+# -- profiler metrics --------------------------------------------------------
+
+def test_latency_window_percentiles():
+    from paddle_tpu.profiler import LatencyWindow
+    w = LatencyWindow(maxlen=64)
+    assert w.percentile(50) is None
+    for ms in range(1, 101):
+        w.observe(ms / 1e3)
+    # window keeps the last 64 samples: 37..100 ms
+    assert w.count == 100
+    assert abs(w.percentile(50) - 0.069) < 0.003
+    assert w.percentile(100) == 0.100
+    snap = w.snapshot()
+    assert snap["count"] == 100 and snap["max_ms"] == 100.0
+    from paddle_tpu.utils.monitor import stat_get
+    w.publish("test_lat")
+    assert stat_get("test_lat_p99_us") >= stat_get("test_lat_p50_us") > 0
+
+
+def test_rate_meter():
+    from paddle_tpu.profiler import RateMeter
+    m = RateMeter()
+    m.add(10)
+    time.sleep(0.02)
+    assert m.rate() > 0
+    m.reset()
+    assert m.count == 0
+
+
+# -- end-to-end serving ------------------------------------------------------
+
+def _export_mlp(tmp_path, name="m", in_dim=6, out_dim=3, buckets=(1, 2, 4)):
+    net = nn.Sequential(nn.Linear(in_dim, 8), nn.ReLU(),
+                        nn.Linear(8, out_dim))
+    net.eval()
+    prefix = str(tmp_path / name)
+    manifest = serving.export_for_serving(
+        net, prefix, [InputSpec([None, in_dim])], buckets=buckets)
+    return net, prefix, manifest
+
+
+def test_serving_e2e_mixed_rows(tmp_path):
+    """Mixed-row concurrent requests through the jit path: every result
+    matches the eager model bit-for-bit per request (padding never
+    leaks), and the ledger shows zero steady-state compiles."""
+    net, prefix, manifest = _export_mlp(tmp_path, "e2e")
+    assert manifest["mode"] == "poly"
+    srv = serving.Server(serving.ServingConfig(workers=2,
+                                               batch_timeout_ms=1.0))
+    srv.register("e2e", prefix, buckets=(1, 2, 4))
+    srv.start()
+    try:
+        rng = np.random.RandomState(0)
+        futs, refs, rows_seen = [], [], []
+        for _ in range(24):
+            rows = int(rng.randint(1, 5))
+            x = rng.randn(rows, 6).astype("float32")
+            refs.append(net(paddle.to_tensor(x)).numpy())
+            futs.append(srv.submit("e2e", [x]))
+            rows_seen.append(rows)
+        for f, r, rows in zip(futs, refs, rows_seen):
+            out = f.result(timeout=60)
+            assert out[0].shape[0] == rows
+            np.testing.assert_allclose(out[0], r, rtol=1e-5, atol=1e-6)
+        st = srv.stats("e2e")
+        assert st["completed"] == 24 and st["errors"] == 0
+        assert st["steady_compiles"] == 0
+        srv.assert_zero_steady_state_recompiles()
+        # warm-up ledgered exactly one AOT compile per bucket
+        from paddle_tpu.profiler import ledger
+        evs = [e for e in ledger.compile_events("serving:e2e")
+               if e["kind"] == "serving_aot"]
+        assert len(evs) == 3
+        assert sorted(e["bucket"] for e in evs) == [1, 2, 4]
+    finally:
+        srv.stop()
+
+
+def test_serving_continuous_batching_coalesces(tmp_path):
+    """Requests arriving within the batch window ride ONE padded batch
+    (the Orca adaptation: queue pressure grows batches)."""
+    _, prefix, _ = _export_mlp(tmp_path, "co")
+    srv = serving.Server(serving.ServingConfig(workers=1,
+                                               batch_timeout_ms=250.0))
+    srv.register("co", prefix, buckets=(1, 2, 4))
+    srv.start()
+    try:
+        xs = [np.random.randn(1, 6).astype("float32") for _ in range(4)]
+        futs = [srv.submit("co", [x]) for x in xs]
+        for f in futs:
+            f.result(timeout=60)
+        st = srv.stats("co")
+        assert st["completed"] == 4
+        assert st["batches"] < 4          # coalesced, not one-by-one
+        assert st["avg_batch_rows"] > 1.0
+    finally:
+        srv.stop()
+
+
+def test_serving_executor_backend(tmp_path):
+    """Static save_inference_model dir served through Predictor clones;
+    the Executor's program cache is the no-recompile proof."""
+    paddle.enable_static()
+    try:
+        import paddle_tpu.static as static
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8], "float32")
+            out = static.nn.fc(x, 3)
+        exe = static.Executor()
+        exe.run(startup)
+        xd = np.random.RandomState(0).randn(2, 8).astype("float32")
+        ref = exe.run(main, feed={"x": xd}, fetch_list=[out])[0]
+        static.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                    main_program=main)
+    finally:
+        paddle.disable_static()
+
+    srv = serving.Server(serving.ServingConfig(workers=2))
+    srv.register("fc", str(tmp_path), buckets=(1, 2, 4),
+                 input_specs=[([None, 8], "float32")])
+    srv.start()
+    try:
+        assert srv.stats("fc")["backend"] == "executor"
+        for rows in (2, 1, 4, 3):
+            got = srv.run("fc", [xd[:1].repeat(rows, axis=0)])
+            np.testing.assert_allclose(got[0],
+                                       np.repeat(ref[:1], rows, axis=0),
+                                       rtol=1e-5)
+        srv.assert_zero_steady_state_recompiles()
+    finally:
+        srv.stop()
+
+
+def test_serving_executor_requires_input_specs(tmp_path):
+    paddle.enable_static()
+    try:
+        import paddle_tpu.static as static
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            out = static.nn.fc(x, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        static.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                    main_program=main)
+    finally:
+        paddle.disable_static()
+    srv = serving.Server()
+    srv.register("nospec", str(tmp_path))
+    with pytest.raises(PreconditionNotMetError, match="input_specs"):
+        srv.start()
+
+
+def test_serving_per_bucket_fallback(tmp_path):
+    """A model that defeats shape polymorphism exports one artifact per
+    bucket and serves through per-bucket executables."""
+
+    class Mask(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            n = x.shape[0]
+            eye = paddle.eye(n)          # iota over a symbolic dim fails
+            return self.fc(x) + eye[:, :2] * 0
+
+    m = Mask()
+    m.eval()
+    prefix = str(tmp_path / "mk")
+    manifest = serving.export_for_serving(
+        m, prefix, [InputSpec([None, 4])], buckets=(1, 2))
+    assert manifest["mode"] == "per_bucket"
+    assert os.path.exists(prefix + ".b1.pdmodel")
+    assert os.path.exists(prefix + ".b2.pdmodel")
+    srv = serving.Server(serving.ServingConfig(workers=1))
+    srv.register("mask", prefix, buckets=(1, 2))
+    srv.start()
+    try:
+        assert srv.stats("mask")["backend"] == "jit_per_bucket"
+        for rows in (1, 2, 1):
+            xv = np.random.randn(rows, 4).astype("float32")
+            got = srv.run("mask", [xv])[0]
+            np.testing.assert_allclose(got,
+                                       m(paddle.to_tensor(xv)).numpy(),
+                                       rtol=1e-5, atol=1e-6)
+        srv.assert_zero_steady_state_recompiles()
+    finally:
+        srv.stop()
+
+
+def test_serving_multi_model_registry(tmp_path):
+    """Two tenants on one server: independent buckets, shared scheduler
+    and workers, both admitted and both correct."""
+    net_a, prefix_a, _ = _export_mlp(tmp_path, "a", in_dim=5, out_dim=2)
+    net_b, prefix_b, _ = _export_mlp(tmp_path, "b", in_dim=7, out_dim=4,
+                                     buckets=(1, 2))
+    srv = serving.Server(serving.ServingConfig(workers=2))
+    srv.register("a", prefix_a, buckets=(1, 2, 4))
+    srv.register("b", prefix_b, buckets=(1, 2))
+    with pytest.raises(InvalidArgumentError, match="already registered"):
+        srv.register("a", prefix_a)
+    srv.start()
+    try:
+        assert sorted(srv.models()) == ["a", "b"]
+        xa = np.random.randn(3, 5).astype("float32")
+        xb = np.random.randn(2, 7).astype("float32")
+        fa = srv.submit("a", [xa])
+        fb = srv.submit("b", [xb])
+        np.testing.assert_allclose(fa.result(60)[0],
+                                   net_a(paddle.to_tensor(xa)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(fb.result(60)[0],
+                                   net_b(paddle.to_tensor(xb)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        srv.assert_zero_steady_state_recompiles()
+        with pytest.raises(PreconditionNotMetError):
+            srv.register("c", prefix_a)      # registry is sealed post-start
+    finally:
+        srv.stop()
+
+
+def test_serving_submit_validation(tmp_path):
+    _, prefix, _ = _export_mlp(tmp_path, "val")
+    srv = serving.Server(serving.ServingConfig(workers=1))
+    srv.register("val", prefix, buckets=(1, 2))
+    with pytest.raises(PreconditionNotMetError):
+        srv.submit("val", [np.zeros((1, 6), "float32")])   # not started
+    srv.start()
+    try:
+        with pytest.raises(NotFoundError):
+            srv.submit("nope", [np.zeros((1, 6), "float32")])
+        with pytest.raises(InvalidArgumentError, match="takes 1 inputs"):
+            srv.submit("val", [np.zeros((1, 6), "float32")] * 2)
+        with pytest.raises(InvalidArgumentError, match="served shape"):
+            srv.submit("val", [np.zeros((1, 7), "float32")])
+        with pytest.raises(InvalidArgumentError, match="0 rows"):
+            srv.submit("val", [np.zeros((0, 6), "float32")])
+        with pytest.raises(OutOfRangeError):
+            srv.submit("val", [np.zeros((3, 6), "float32")])  # > max bucket
+        # dtype is pinned, not trusted: float64 requests serve as float32
+        out = srv.run("val", [np.zeros((1, 6), "float64")])
+        assert out[0].dtype == np.float32
+        srv.assert_zero_steady_state_recompiles()
+    finally:
+        srv.stop()
+
+
+def test_serving_strict_blocks_steady_compiles(tmp_path):
+    """The zero-recompile invariant end to end: a bucket that lost its
+    executable FAILS in strict mode; in non-strict mode it compiles,
+    but the ledger + assert make the violation loud."""
+    _, prefix, _ = _export_mlp(tmp_path, "strict")
+    srv = serving.Server(serving.ServingConfig(workers=1))
+    srv.register("strict", prefix, buckets=(1, 2))
+    srv.start()
+    try:
+        srv._models["strict"].executables.pop(2)   # simulate a lost bucket
+        with pytest.raises(PreconditionNotMetError, match="no warm-up"):
+            srv.submit("strict",
+                       [np.zeros((2, 6), "float32")]).result(timeout=60)
+        snap = flags_snapshot()
+        try:
+            set_flags({"FLAGS_serving_strict": False})
+            out = srv.run("strict", [np.zeros((2, 6), "float32")])
+            assert out[0].shape == (2, 3)
+        finally:
+            flags_restore(snap)
+        # the fallback compile is a recorded steady-state violation
+        assert srv.stats("strict")["steady_compiles"] == 1
+        evs = srv.compile_events_since_warmup()
+        assert len(evs) == 1 and evs[0]["kind"] == "serving_recompile"
+        with pytest.raises(PreconditionNotMetError,
+                           match="steady-state recompile"):
+            srv.assert_zero_steady_state_recompiles()
+    finally:
+        srv.stop()
+
+
+def test_serving_lint_admission_gate(tmp_path):
+    """Warm-up runs the analysis PassManager per bucket; an ERROR
+    finding refuses admission even in warn mode (gated by
+    FLAGS_graph_lint — off admits)."""
+    from paddle_tpu import analysis
+    _, prefix, _ = _export_mlp(tmp_path, "lintg")
+    mgr = analysis.default_pass_manager()
+
+    @mgr.register("test-serving-veto", severity=analysis.Severity.ERROR,
+                  kinds=("serving",))
+    def veto(ctx):
+        return [analysis.Diagnostic(
+            pass_id="test-serving-veto",
+            severity=analysis.Severity.ERROR,
+            message="vetoed for the admission test")]
+
+    snap = flags_snapshot()
+    try:
+        set_flags({"FLAGS_graph_lint": "warn"})
+        srv = serving.Server(serving.ServingConfig(workers=1))
+        srv.register("lintg", prefix, buckets=(1,))
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", analysis.GraphLintWarning)
+            with pytest.raises(PreconditionNotMetError,
+                               match="refused to admit"):
+                srv.start()
+        # off-path: one branch, model admits
+        set_flags({"FLAGS_graph_lint": "off"})
+        srv2 = serving.Server(serving.ServingConfig(workers=1))
+        srv2.register("lintg2", prefix, buckets=(1,))
+        srv2.start()
+        try:
+            out = srv2.run("lintg2", [np.zeros((1, 6), "float32")])
+            assert out[0].shape == (1, 3)
+        finally:
+            srv2.stop()
+    finally:
+        flags_restore(snap)
+        mgr._passes.pop("test-serving-veto", None)
+
+
+def test_serving_stop_without_drain_fails_pending(tmp_path):
+    _, prefix, _ = _export_mlp(tmp_path, "drain")
+    srv = serving.Server(serving.ServingConfig(workers=1,
+                                               batch_timeout_ms=500.0))
+    srv.register("drain", prefix, buckets=(1, 2, 4))
+    srv.start()
+    fut = srv.submit("drain", [np.zeros((1, 6), "float32")])
+    srv.stop(drain=False)
+    # either it slipped into a batch before the drain or it failed —
+    # never hangs, never leaks a pending future
+    try:
+        out = fut.result(timeout=10)
+        assert out[0].shape == (1, 3)
+    except UnavailableError:
+        pass
+    with pytest.raises(PreconditionNotMetError):
+        srv.submit("drain", [np.zeros((1, 6), "float32")])
+
+
+def test_serving_queue_depth_gauge(tmp_path):
+    from paddle_tpu.utils.monitor import stat_get
+    _, prefix, _ = _export_mlp(tmp_path, "gauge")
+    srv = serving.Server(serving.ServingConfig(workers=1))
+    srv.register("gauge", prefix, buckets=(1, 2))
+    srv.start()
+    try:
+        srv.run("gauge", [np.zeros((1, 6), "float32")])
+        assert stat_get("serving_queue_depth") == 0
+        assert stat_get("serving_gauge_p50_us") > 0
+        assert stat_get("serving_requests_total") >= 1
+    finally:
+        srv.stop()
+
+
+# -- tools/serve.py smoke (CI lane) ------------------------------------------
+
+@pytest.mark.slow
+def test_serve_cli_smoke_end_to_end():
+    """Drive tools/serve.py in a subprocess on the CPU backend: concurrent
+    mixed-shape clients, all requests complete within the SLO, and the
+    ledger records zero post-warm-up compiles."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "serve.py"),
+         "--model", "lenet", "--duration", "1.0", "--clients", "3",
+         "--buckets", "1,2,4", "--p99-slo-ms", "5000", "--json"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    report = json.loads(p.stdout)
+    assert report["steady_compiles"] == 0
+    st = report["models"]["lenet"]
+    assert st["traffic_errors"] == []
+    assert st["errors"] == 0 and st["completed"] > 0
+    assert st["slo_met"] and st["p99_ms"] <= 5000
+    assert st["qps"] > 0

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""serve — export zoo models, warm a serving engine, drive traffic, check SLOs.
+
+The CLI face of ``paddle_tpu.serving``: the whole deploy walkthrough
+(export → warm-up → serve → SLO check) in one command, runnable on any
+backend (defaults to CPU, like tools/graph_lint.py).
+
+    python tools/serve.py --model lenet --duration 2 --clients 4
+    python tools/serve.py --model lenet --model bert --int8 --json
+    python tools/serve.py --model resnet_block --p99-slo-ms 250 --json
+
+Exit code is non-zero when any request errored, any steady-state XLA
+compile was recorded after warm-up (the bucketed-batching invariant), or
+a ``--p99-slo-ms`` bound was violated — so a CI lane can gate on it.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# serving smoke runs anywhere the framework imports; explicit env wins
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build_lenet():
+    from paddle_tpu.vision.models import LeNet
+    return LeNet(), [([None, 1, 28, 28], "float32")]
+
+
+def build_resnet_block(ch=8, hw=8):
+    import paddle_tpu.nn as nn
+
+    class Block(nn.Layer):
+        """One residual conv-BN-ReLU pair (bench.py's high-res stage)."""
+
+        def __init__(self):
+            super().__init__()
+            self.c1 = nn.Conv2D(ch, ch, 3, padding=1, bias_attr=False)
+            self.b1 = nn.BatchNorm2D(ch)
+            self.c2 = nn.Conv2D(ch, ch, 3, padding=1, bias_attr=False)
+            self.b2 = nn.BatchNorm2D(ch)
+            self.relu = nn.ReLU()
+
+        def forward(self, x):
+            h = self.relu(self.b1(self.c1(x)))
+            return self.relu(self.b2(self.c2(h)) + x)
+
+    return Block(), [([None, ch, hw, hw], "float32")]
+
+
+def build_bert(seq=32):
+    from paddle_tpu.text.models.bert import BertConfig, BertModel
+    cfg = BertConfig.tiny(seq=seq)
+    m = BertModel(cfg)
+    m._serve_vocab = cfg.vocab_size
+    return m, [([None, seq], "int32")]
+
+
+ZOO = {
+    "lenet": build_lenet,
+    "resnet_block": build_resnet_block,
+    "bert": build_bert,
+}
+
+
+def _random_inputs(rng, specs, rows, vocab=None):
+    out = []
+    for shape, dtype in specs:
+        s = (rows,) + tuple(shape[1:])
+        if np.issubdtype(np.dtype(dtype), np.integer):
+            out.append(rng.randint(0, vocab or 100, s).astype(dtype))
+        else:
+            out.append(rng.randn(*s).astype(dtype))
+    return out
+
+
+def _traffic(server, name, specs, duration_s, clients, max_rows, vocab,
+             seed):
+    """Concurrent mixed-shape traffic: each client submits random-row
+    requests until the deadline; per-client error capture."""
+    errors = []
+    deadline = time.perf_counter() + duration_s
+
+    def client(i):
+        rng = np.random.RandomState(seed + i)
+        while time.perf_counter() < deadline:
+            rows = int(rng.randint(1, max_rows + 1))
+            try:
+                fut = server.submit(
+                    name, _random_inputs(rng, specs, rows, vocab))
+                outs = fut.result(timeout=60)
+                if outs[0].shape[0] != rows:
+                    raise AssertionError(
+                        f"padding leaked: {outs[0].shape[0]} != {rows}")
+            except Exception as e:   # noqa: BLE001 — reported per client
+                errors.append(f"client{i}: {type(e).__name__}: {e}")
+                return
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errors
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="serve",
+        description="export zoo models, warm the serving engine, drive "
+                    "sustained traffic, report QPS/p50/p99 + the "
+                    "zero-steady-state-recompile check")
+    ap.add_argument("--model", action="append", choices=sorted(ZOO),
+                    help="serve one zoo model (repeatable; default: all)")
+    ap.add_argument("--int8", action="store_true",
+                    help="serve frozen int8 exports (PTQ + freeze)")
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="seconds of sustained traffic per run")
+    ap.add_argument("--clients", type=int, default=4,
+                    help="concurrent client threads")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="serving worker threads (default: flag)")
+    ap.add_argument("--buckets", default="1,2,4",
+                    help="batch bucket ladder, e.g. '1,2,4,8'")
+    ap.add_argument("--max-request-rows", type=int, default=2,
+                    help="clients submit 1..N rows per request")
+    ap.add_argument("--p99-slo-ms", type=float, default=None,
+                    help="fail (rc!=0) when any model's p99 exceeds this")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one JSON report instead of text")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import serving
+    from paddle_tpu.framework.flags import flags_restore, flags_snapshot, \
+        set_flags
+
+    names = list(dict.fromkeys(args.model or sorted(ZOO)))
+    buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+    snap = flags_snapshot()
+    report = {"int8": args.int8, "buckets": list(buckets),
+              "duration_s": args.duration, "clients": args.clients,
+              "models": {}}
+    rc = 0
+    try:
+        if args.int8:
+            set_flags({"FLAGS_use_int8_inference": True})
+        with tempfile.TemporaryDirectory() as d:
+            server = serving.Server(serving.ServingConfig(
+                workers=args.workers, buckets=buckets))
+            model_meta = {}
+            for name in names:
+                layer, specs = ZOO[name]()
+                layer.eval()
+                if args.int8:
+                    import paddle_tpu as paddle
+                    from paddle_tpu.quantization import \
+                        PostTrainingQuantization
+                    rng = np.random.RandomState(args.seed)
+                    cal = _random_inputs(rng, specs, buckets[0],
+                                         getattr(layer, "_serve_vocab",
+                                                 None))
+
+                    def loader():
+                        for _ in range(4):
+                            yield tuple(paddle.to_tensor(a) for a in cal)
+
+                    PostTrainingQuantization(model=layer,
+                                             data_loader=loader(),
+                                             batch_nums=4).quantize()
+                prefix = os.path.join(d, name)
+                manifest = serving.export_for_serving(
+                    layer, prefix, specs, buckets=buckets, int8=args.int8)
+                server.register(name, prefix, buckets=buckets)
+                model_meta[name] = (specs,
+                                    getattr(layer, "_serve_vocab", None),
+                                    manifest["mode"])
+            t0 = time.perf_counter()
+            server.start()
+            warmup_s = round(time.perf_counter() - t0, 3)
+            for name in names:
+                specs, vocab, mode = model_meta[name]
+                errors = _traffic(server, name, specs, args.duration,
+                                  args.clients, args.max_request_rows,
+                                  vocab, args.seed)
+                st = server.stats(name)
+                st["export_mode"] = mode
+                st["traffic_errors"] = errors
+                if errors or st["errors"]:
+                    rc = 1
+                if args.p99_slo_ms is not None:
+                    st["p99_slo_ms"] = args.p99_slo_ms
+                    st["slo_met"] = st["p99_ms"] <= args.p99_slo_ms
+                    if not st["slo_met"]:
+                        rc = 1
+                report["models"][name] = st
+            server.stop()
+            steady = server.compile_events_since_warmup()
+            report["warmup_s"] = warmup_s
+            report["steady_compiles"] = len(steady)
+            if steady:
+                rc = 1
+                report["steady_compile_events"] = [
+                    {"site": e["site"], "kind": e.get("kind"),
+                     "diff": e["diff"]} for e in steady[:8]]
+    finally:
+        flags_restore(snap)
+
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        for name, st in report["models"].items():
+            print(f"{name:>14}: {st['qps']:>8.1f} qps  "
+                  f"p50 {st['p50_ms']:>8.2f} ms  "
+                  f"p99 {st['p99_ms']:>8.2f} ms  "
+                  f"batches {st['batches']}  "
+                  f"avg rows {st['avg_batch_rows']}  "
+                  f"[{st['backend']}/{st['export_mode']}]")
+        print(f"serve: warm-up {report['warmup_s']}s, steady-state "
+              f"compiles {report['steady_compiles']} (must be 0), rc={rc}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
