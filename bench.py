@@ -807,6 +807,128 @@ def bench_serving(on_tpu):
     return res
 
 
+def _bench_decode_one(variant, cfg, prompt_len, steps, batches,
+                      seq_buckets, max_len, reps, on_tpu):
+    """One (variant) decode run: build/quantize the GPT, compile the
+    two-executable generate() set, then time prefill and the scanned
+    decode SEPARATELY (each is one device dispatch, so the phase split
+    is exact, not sampled) at batch 1 and max-batch."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import ledger as _led
+    from paddle_tpu.text.generation import Generator
+    from paddle_tpu.text.models.gpt import GPTModel
+
+    paddle.seed(0)
+    model = GPTModel(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    if variant == "int8":
+        from paddle_tpu.quantization import PostTrainingQuantization
+        from paddle_tpu.quantization.freeze import freeze
+        cal = rng.randint(1, cfg.vocab_size,
+                          (batches[0], prompt_len)).astype(np.int64)
+
+        def loader():
+            for _ in range(4):
+                yield (paddle.to_tensor(cal),)
+
+        PostTrainingQuantization(model=model, data_loader=loader(),
+                                 batch_nums=4).quantize()
+        freeze(model)
+    else:
+        paddle.amp.decorate(models=model, level="O2", dtype="bfloat16")
+    gen = Generator(model, site=f"generate:bench_{variant}",
+                    seq_buckets=seq_buckets, max_len=max_len)
+    res = {"variant": variant, "prompt_len": prompt_len, "steps": steps}
+    for B in batches:
+        ids = rng.randint(1, cfg.vocab_size,
+                          (B, prompt_len)).astype(np.int64)
+        gen.generate(ids, max_new_tokens=steps)       # warm-up compiles
+        mark = len(_led.compile_events(gen.site))
+        P = gen.prefill_bucket(prompt_len)
+        C = gen.cache_bucket(P, steps)
+        packed, start = gen.pack_prompts(list(ids), P)
+
+        def best(fn):
+            b = None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                out = fn()
+                jax.block_until_ready(out)
+                dt = time.perf_counter() - t0
+                b = dt if b is None else min(b, dt)
+            return b, out
+
+        pre_s, (cache, logits0) = best(
+            lambda: gen.prefill(packed, start, C))
+        dec_s, _ = best(
+            lambda: gen.decode(cache, logits0, start, P, steps))
+        total = pre_s + dec_s
+        res[f"batch{B}"] = {
+            "prefill_ms": round(pre_s * 1e3, 3),
+            "decode_ms": round(dec_s * 1e3, 3),
+            "decode_ms_per_tok": round(dec_s * 1e3 / steps, 4),
+            "prefill_fraction": round(pre_s / total, 3),
+            "tok_per_s_decode": round(B * steps / dec_s, 1),
+            "tok_per_s_total": round(B * steps / total, 1),
+        }
+        # the acceptance invariant: the timed window replays the two
+        # warmed executables — zero per-token / per-call compiles
+        steady = len(_led.compile_events(gen.site)) - mark
+        assert steady == 0, (
+            f"decode/{variant} batch{B}: {steady} steady compile(s)")
+    res["zero_steady_state_compiles"] = True
+    return res
+
+
+def bench_decode(on_tpu):
+    """Eighth block: autoregressive decoding tokens/s/chip through the
+    static-shape KV-cache generate() (GPT), batch 1 vs max-batch,
+    prefill-vs-decode split, bf16 vs frozen int8, with zero steady-state
+    compiles asserted (PERF.md decode schema)."""
+    from paddle_tpu.text.models.gpt import GPTConfig
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=768, num_layers=12,
+                        num_heads=12, intermediate_size=3072,
+                        max_position_embeddings=1024, dropout=0.0)
+        prompt_len, steps, batches = 128, 128, (1, 8)
+        seq_buckets, max_len, reps = (128, 256, 512), 512, 3
+    else:
+        cfg = GPTConfig.tiny(vocab_size=128, hidden_size=32, layers=2,
+                             heads=2, seq=128)
+        prompt_len, steps, batches = 16, 16, (1, 4)
+        seq_buckets, max_len, reps = (16, 32, 64), 64, 2
+
+    models = {}
+    for variant in ("bf16", "int8"):
+        try:
+            models[variant] = _bench_decode_one(
+                variant, cfg, prompt_len, steps, batches, seq_buckets,
+                max_len, reps, on_tpu)
+        except Exception as e:           # noqa: BLE001 — per-model record
+            _note(f"[bench] decode/{variant}: {type(e).__name__}: {e}")
+            models[variant] = {"error": f"{type(e).__name__}: {e}"}
+    ok = [m for m in models.values() if "error" not in m]
+    res = {"unit": "tok/s/chip", "models": models,
+           "zero_steady_state_compiles":
+               bool(ok) and all(m["zero_steady_state_compiles"]
+                                for m in ok)}
+    bmax = f"batch{batches[-1]}"
+    f32 = models.get("bf16", {}).get(bmax, {}).get("tok_per_s_decode")
+    i8 = models.get("int8", {}).get(bmax, {}).get("tok_per_s_decode")
+    if f32 and i8:
+        res["int8_decode_speedup_maxbatch"] = round(i8 / f32, 3)
+    b1 = models.get("bf16", {}).get("batch1", {})
+    bN = models.get("bf16", {}).get(bmax, {})
+    if b1 and bN:
+        res["batch_scaling_decode"] = round(
+            bN.get("tok_per_s_decode", 0) /
+            max(b1.get("tok_per_s_decode", 1e-9), 1e-9), 2)
+    return res
+
+
 WORKLOADS = [
     ("mnist_lenet_static", bench_lenet_static),
     ("resnet50_dygraph", bench_resnet50),
@@ -815,6 +937,7 @@ WORKLOADS = [
     ("wide_deep_ctr", bench_wide_deep),
     ("inference", bench_inference),
     ("serving", bench_serving),
+    ("decode", bench_decode),
 ]
 
 

@@ -290,3 +290,31 @@ define_flag("serving_metrics_window", 2048,
             "Sliding-window size (completed requests) of the per-model "
             "serving latency reservoir behind the p50/p99 gauges.",
             validator=lambda v: int(v) >= 16)
+
+# ---- Autoregressive decoding (text.generation + serving decode) -------------
+define_flag("use_flash_decode",
+            os.environ.get("PADDLE_TPU_FLASH_DECODE", "").lower()
+            in ("1", "true", "yes", "on"),
+            "Route single-query cached attention (the decode step of "
+            "generate()) through the Pallas flash-decoding kernel "
+            "(ops/pallas/flash_decode.py): split-K over the cached "
+            "context with an online-softmax merge, so one query row "
+            "still fills the chip. OFF by default under the "
+            "measured-crossover honesty rule — no chip measurement this "
+            "round (PERF.md decode section records the pending state); "
+            "the XLA masked-attention reference path is bit-matched by "
+            "the interpret-mode tests. Seeded by PADDLE_TPU_FLASH_DECODE.")
+define_flag("decode_buckets", "16,32,64,128,256,512,1024",
+            "Sequence-length bucket ladder for incremental decoding: "
+            "prompt lengths pad (left) up to the smallest bucket, and "
+            "KV-cache lengths round up to the smallest bucket holding "
+            "prompt + max_new_tokens, so generate() and the serving "
+            "decode path only ever compile (batch, prefill-bucket, "
+            "cache-bucket) shapes fixed at warm-up.",
+            validator=lambda v: all(int(b) > 0 for b in
+                                    str(v).split(",") if b.strip()))
+define_flag("decode_max_len", 1024,
+            "Hard ceiling on KV-cache length (prompt + generated tokens) "
+            "for generate() and serving decode; requests past it raise "
+            "OutOfRange instead of growing an unbounded cache shape.",
+            validator=lambda v: int(v) >= 1)

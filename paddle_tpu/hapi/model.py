@@ -265,6 +265,17 @@ class Model:
         self._train_step = None
         self._eval_step = None
 
+    # -- decoding ------------------------------------------------------------
+    def generate(self, input_ids, **kwargs):
+        """Autoregressive decoding through the network's static-shape
+        KV-cache incremental forward (text.generation.generate): one
+        prefill executable + one scanned decode executable, zero
+        per-token compiles.  The network must implement the
+        init_cache/forward_cached contract (e.g. text.models.GPTModel)."""
+        self._sync_from_train()
+        from ..text.generation import generate as _generate
+        return _generate(self.network, input_ids, **kwargs)
+
     # -- misc ----------------------------------------------------------------
     def parameters(self, *args, **kwargs):
         return self.network.parameters(*args, **kwargs)

@@ -293,13 +293,15 @@ class BeamSearchDecoder:
                 beam_size=K, end_id=self._end_id, is_accumulated=True)
             ids, scores = unwrap(ids_t).astype(jnp.int32), unwrap(scores_t)
             parents = unwrap(parents_t).astype(jnp.int32)
-            # reorder beam-parallel states by the selected parents
-            flat_parent = (jnp.arange(B)[:, None] * K + parents).reshape(-1)
+            # reorder beam-parallel states by the selected parents (the
+            # shared gather generate(beam_size=...) also reorders its KV
+            # cache with)
+            from ..ops.decode import beam_parent_gather
             for n in cell._state_names:
                 if not cell.needs_reorder(n):
                     continue      # InitState(need_reorder=False) parity
                 sv = unwrap(cell.get_state(n))
-                cell.set_state(n, Tensor(sv[flat_parent]))
+                cell.set_state(n, Tensor(beam_parent_gather(sv, parents)))
             cell.update_states()
             all_ids.append(ids)
             all_parents.append(parents)

@@ -99,6 +99,41 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return transpose(out, [0, 2, 1, 3])
 
 
+def _use_flash_decode(q, k, window):
+    """Dispatch gate for the decode step: FLAGS_use_flash_decode + TPU
+    platform + single-query shapes + a contiguous [start, end) validity
+    window (the kernel masks a window, not an arbitrary dense mask)."""
+    if window is None or not flag("use_flash_decode"):
+        return False
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return False
+    if platform not in ("tpu", "axon"):
+        return False
+    from ...ops.pallas.flash_decode import supports_decode
+    return supports_decode(unwrap(q).shape, unwrap(k).shape)
+
+
+def cached_attention(q, k, v, attn_mask=None, window=None):
+    """Incremental attention: (B, N, Tq, H) new-token queries over the
+    full (B, N, S, H) KV ring cache.
+
+    ``attn_mask`` is the additive validity+causality mask the caller
+    built from cache_position / per-row start offsets.  ``window`` is the
+    optional ``(start[B], end[B])`` contiguous form of the same validity
+    (decode steps: Tq == 1) — when present and eligible, the Pallas
+    flash-decoding kernel (split-K over the cached context) takes over;
+    otherwise the one-expression XLA masked attention runs.
+    """
+    if _use_flash_decode(q, k, window):
+        from ...ops.pallas import flash_decode
+        return flash_decode(q, k, v, window[0], window[1])
+    if attn_mask is not None:
+        return _sdpa_mask(q, k, v, attn_mask)
+    return _sdpa(q, k, v)
+
+
 def attention_bnsh(q, k, v, attn_mask=None, is_causal=False):
     """(B, N, S, H) layout fast path used by our MultiHeadAttention layer."""
     if _use_pallas(q, k, attn_mask, causal=bool(is_causal)):

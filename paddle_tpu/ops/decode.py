@@ -105,6 +105,21 @@ def beam_search_step(pre_ids, pre_scores, probs, beam_size=4, end_id=0,
                              is_accumulated=is_accumulated)
 
 
+def beam_parent_gather(x, parents):
+    """Reorder beam-parallel state rows by the selected beam parents.
+
+    ``x [B*K, ...]`` carries per-beam state (hidden state, KV cache);
+    ``parents [B, K]`` are the parent beam indices ``beam_search_step``
+    selected.  Row ``(b, k)`` of the result is row ``(b, parents[b, k])``
+    of ``x`` — the reference's sequence_expand/LoD beam reorder collapsed
+    to ONE gather (the incubate BeamSearchDecoder state reorder and the
+    generate() beam KV-cache reorder share this exact semantics)."""
+    B, K = parents.shape
+    flat = (jnp.arange(B, dtype=parents.dtype)[:, None] * K
+            + parents).reshape(-1)
+    return jnp.take(x, flat, axis=0)
+
+
 def _beam_search_decode_fn(step_ids, step_parents, step_scores, end_id=0):
     """Assemble final sentences from per-step selections
     (beam_search_decode_op.cc). Returns (sentences [T, B, beam],

@@ -30,7 +30,22 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None):
     return _flash_bias_prim(q, k, v, bias, causal=bool(causal), scale=scale)
 
 
+from .flash_decode import (  # noqa: E402
+    decode_attention_reference, flash_decode_fn, supports_decode)
+
+_flash_decode_prim = Primitive("flash_decode", flash_decode_fn,
+                               differentiable=False)
+
+
+def flash_decode(q, k, v, start, end, scale=None):
+    """Flash-decoding on Tensors: (B, N, 1, H) query vs (B, N, S, H)
+    ring cache, valid window [start, end) per row (inference-only)."""
+    return _flash_decode_prim(q, k, v, start, end, scale=scale)
+
+
 from . import fused_bn, fused_conv  # noqa: F401  (kernel families)
 
 __all__ = ["flash_attention", "flash_attention_fn", "supports",
+           "flash_decode", "flash_decode_fn", "supports_decode",
+           "decode_attention_reference",
            "DEFAULT_BLOCK", "fused_bn", "fused_conv"]

@@ -29,6 +29,7 @@ Bench: ``bench.py``'s ``serving`` block (sustained QPS + p50/p99 SLOs).
 from __future__ import annotations
 
 from .bucketing import BucketLadder, pad_to_bucket  # noqa: F401
+from .decode import DecodeModelSpec, DecodeRequest  # noqa: F401
 from .scheduler import Batch, Request, RequestQueue, pack_fifo  # noqa: F401
 from .server import (ModelSpec, Server, ServingConfig,  # noqa: F401
                      create_server, export_for_serving)
@@ -36,5 +37,5 @@ from .server import (ModelSpec, Server, ServingConfig,  # noqa: F401
 __all__ = [
     "BucketLadder", "pad_to_bucket", "Batch", "Request", "RequestQueue",
     "pack_fifo", "ModelSpec", "Server", "ServingConfig", "create_server",
-    "export_for_serving",
+    "export_for_serving", "DecodeModelSpec", "DecodeRequest",
 ]

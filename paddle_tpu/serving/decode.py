@@ -1,0 +1,261 @@
+"""Batched autoregressive decode through the serving engine.
+
+The decode workload is the serving engine's hardest shape-discipline
+test: every request carries its own prompt length AND runs two phases
+(prefill over the prompt, then a scanned per-token decode), so a naive
+server compiles per (batch, prompt-length, generation-length) triple —
+under real traffic, forever.  The bucketed answer mirrors the dense
+path's ladder, squared:
+
+  * request ROWS pack into the batch-bucket ladder exactly like dense
+    requests (scheduler.py's continuous batcher is reused unchanged);
+  * prompt LENGTHS pad (left) to the FLAGS_decode_buckets sequence
+    ladder; the KV-cache length rounds up to the smallest bucket holding
+    prompt-bucket + max_new_tokens;
+  * warm-up AOT-compiles every (batch-bucket × prefill-bucket) prefill
+    executable and every (batch-bucket × cache-bucket) decode executable
+    through text.generation.Generator, each ledgered at the model's
+    ``serving:<name>`` site — so ``assert_zero_steady_state_recompiles``
+    covers mixed prefill/decode traffic with no special casing.
+
+Left-padding makes results batch-invariant: a row's attention window is
+``[P - len, pos)`` regardless of which rows share its batch, so a served
+greedy decode is bit-identical to a batch-1 ``generate()`` of the same
+prompt (the admission test's oracle).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import flags as _flags
+from ..framework.enforce import (InvalidArgumentError, OutOfRangeError,
+                                 PreconditionNotMetError)
+from ..profiler.metrics import LatencyWindow, RateMeter
+from ..utils.monitor import stat_add
+from .bucketing import BucketLadder
+
+__all__ = ["DecodeModelSpec", "DecodeRequest"]
+
+
+@dataclass
+class DecodeModelSpec:
+    """One served decode model: a LIVE layer implementing the
+    init_cache/forward_cached contract (text.models.GPTModel), not a
+    frozen export — the decode program (a scanned step over a mutable
+    ring cache) is compiled per bucket at warm-up, which is exactly the
+    durable artifact the dense path gets from export_for_serving."""
+
+    name: str
+    layer: Any
+    batch_buckets: Optional[Sequence[int]] = None
+    seq_buckets: Optional[Sequence[int]] = None
+    max_new_tokens: int = 16
+    max_len: Optional[int] = None
+    eos_token_id: Optional[int] = None
+
+
+@dataclass
+class DecodeRequest:
+    """One client decode request: ``rows`` prompts (variable lengths),
+    each to be continued by up to ``max_new`` tokens."""
+
+    model: str
+    prompts: List[np.ndarray]
+    rows: int
+    max_new: int
+    future: Future = field(default_factory=Future)
+    t_enqueue: float = field(default_factory=time.perf_counter)
+
+
+class _DecodeRuntime:
+    """Serving-side runtime for one decode model (the decode analogue of
+    server._ModelRuntime): Generator-backed executables, bucket plans,
+    metrics, and the strict steady-state discipline."""
+
+    kind = "decode"
+    backend = "decode"
+    primary = None                      # no Predictor to clone
+
+    def __init__(self, spec: DecodeModelSpec):
+        self.spec = spec
+        self.name = spec.name
+        self.site = f"serving:{spec.name}"
+        self.ladder = BucketLadder.from_flag(
+            spec.batch_buckets if spec.batch_buckets is not None
+            else _flags.flag("serving_buckets"))
+        self.steps = int(spec.max_new_tokens)
+        self.admitted = False
+        self.gen = None
+        self._warmed_prefill = set()        # {(B, P, C)}
+        self._warmed_decode = set()         # {(B, C)}
+        self.latency = LatencyWindow(
+            int(_flags.flag("serving_metrics_window")))
+        self.rate = RateMeter()
+        self._mlock = threading.Lock()
+        self.counters = {"requests": 0, "completed": 0, "errors": 0,
+                         "batches": 0, "rows": 0, "padded_rows": 0,
+                         "steady_compiles": 0}
+
+    def bump(self, **kw):
+        with self._mlock:
+            for k, v in kw.items():
+                self.counters[k] += v
+
+    # -- loading + warm-up ---------------------------------------------------
+    def load(self):
+        from ..text.generation import Generator
+        self.gen = Generator(self.spec.layer, site=self.site,
+                             seq_buckets=self.spec.seq_buckets,
+                             max_len=self.spec.max_len)
+        # every prompt bucket must leave room for max_new_tokens in some
+        # cache bucket — refuse at registration time, not under traffic
+        self._plan = []
+        for p in self.gen.seq_buckets:
+            try:
+                c = self.gen.cache_bucket(p, self.steps)
+            except OutOfRangeError:
+                continue                # prompts this long are rejected
+            self._plan.append((p, c))
+        if not self._plan:
+            raise PreconditionNotMetError(
+                f"decode model {self.name!r}: no sequence bucket leaves "
+                f"room for max_new_tokens={self.steps} under "
+                f"max_len={self.gen._max_len}")
+        self.max_prompt = max(p for p, _ in self._plan)
+
+    def lint_gate(self, B, P, C):
+        """Graph-lint admission over the prefill program in abstract-eval
+        mode (the dense runtimes' gate, FLAGS_graph_lint): ERROR findings
+        refuse admission.  The ring-cache dynamic_update_slice writes are
+        exactly what the layout pass's KV exemption covers."""
+        from .. import analysis
+        if not analysis.lint_enabled():
+            return
+        import jax
+        import jax.numpy as jnp
+        fn = self.gen._build_prefill(B, P, C)
+        p_avals = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype),
+            self.gen._params)
+        b_avals = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype),
+            self.gen._buffers)
+        try:
+            closed = jax.make_jaxpr(fn)(
+                p_avals, b_avals, jax.ShapeDtypeStruct((B, P), jnp.int32),
+                jax.ShapeDtypeStruct((B,), jnp.int32))
+        except Exception as e:   # noqa: BLE001 — lint must not mask bugs
+            import warnings
+            warnings.warn(
+                f"decode warm-up lint for {self.name!r} b{B} p{P} could "
+                f"not abstract-eval the program: {type(e).__name__}: {e}",
+                analysis.GraphLintWarning, stacklevel=2)
+            return
+        ctx = analysis.LintContext(site=self.site, kind="serving",
+                                   closed_jaxpr=closed)
+        report = analysis.default_pass_manager().run(ctx)
+        analysis.emit(report, mode="warn")
+        errors = report.by_severity(analysis.Severity.ERROR)
+        if errors:
+            raise PreconditionNotMetError(
+                f"serving refused to admit decode model {self.name!r}: "
+                f"graph lint found {len(errors)} ERROR finding(s) at "
+                f"(batch={B}, prompt={P}):\n"
+                + "\n".join("  " + str(d) for d in errors))
+
+    def warmup(self):
+        """AOT-compile the full (batch-bucket × prefill-bucket) prefill
+        set and the (batch-bucket × cache-bucket) decode set, then run
+        each pair once on zeros so dispatch paths are warm too.  Every
+        compile lands in the ledger at this runtime's site — the
+        steady-state mark the server snapshots right after."""
+        import jax
+        eos = self.spec.eos_token_id
+        for B in self.ladder:
+            linted = set()
+            for P, C in self._plan:
+                if P not in linted:
+                    self.lint_gate(B, P, C)
+                    linted.add(P)
+                self.gen.prefill_exec(B, P, C)
+                self._warmed_prefill.add((B, P, C))
+                if (B, C) not in self._warmed_decode:
+                    self.gen.decode_exec(B, C, self.steps, 1, eos)
+                    self._warmed_decode.add((B, C))
+            # one zeros round-trip per batch bucket: warm dispatch/runtime
+            P0, C0 = self._plan[0]
+            ids = np.zeros((B, P0), np.int32)
+            start = np.full((B,), P0 - 1, np.int32)
+            cache, logits0 = self.gen.prefill(ids, start, C0)
+            toks = self.gen.decode(cache, logits0, start, P0, self.steps,
+                                   1, eos)
+            jax.block_until_ready(toks)
+        self.admitted = True
+
+    # -- traffic -------------------------------------------------------------
+    def validate(self, prompts, max_new):
+        if not prompts:
+            raise InvalidArgumentError("empty decode request (0 prompts)")
+        out = []
+        for i, p in enumerate(prompts):
+            a = np.asarray(p)
+            if a.ndim != 1 or a.size == 0 \
+                    or not np.issubdtype(a.dtype, np.integer):
+                raise InvalidArgumentError(
+                    f"decode prompt {i} must be a non-empty 1-D int "
+                    f"array, got shape {a.shape} dtype {a.dtype}")
+            if a.size > self.max_prompt:
+                raise OutOfRangeError(
+                    f"decode prompt {i} has {a.size} tokens; the largest "
+                    f"admissible prompt bucket is {self.max_prompt} "
+                    f"(max_new_tokens={self.steps}, ladder "
+                    f"{self.gen.seq_buckets})")
+            out.append(a.astype(np.int32))
+        mn = self.steps if max_new is None else int(max_new)
+        if mn < 1 or mn > self.steps:
+            raise InvalidArgumentError(
+                f"max_new_tokens must be in [1, {self.steps}] "
+                f"(the engine's warmed decode length), got {mn}")
+        return out, mn
+
+    def execute(self, batch):
+        """Run one packed batch through prefill + scanned decode; returns
+        generated tokens [bucket, steps] (padding rows included — the
+        worker slices per request)."""
+        prompts = [p for r in batch.requests for p in r.prompts]
+        # pad rows up to the batch bucket with 1-token dummy prompts
+        prompts += [np.zeros((1,), np.int32)] * (batch.bucket - batch.rows)
+        P = self.gen.prefill_bucket(max(p.size for p in prompts))
+        C = self.gen.cache_bucket(P, self.steps)
+        B = batch.bucket
+        key_missing = ((B, P, C) not in self._warmed_prefill
+                       or (B, C) not in self._warmed_decode)
+        if key_missing:
+            if bool(_flags.flag("serving_strict")):
+                raise PreconditionNotMetError(
+                    f"decode model {self.name!r}: (batch={B}, prompt="
+                    f"{P}, cache={C}) has no warm-up executable "
+                    "(FLAGS_serving_strict=True refuses steady-state "
+                    "compiles — extend the ladders and re-warm)")
+            # escape hatch: Generator ledgers the compile at this site,
+            # so the zero-recompile invariant visibly fails
+            stat_add("serving_steady_compiles")
+            self.bump(steady_compiles=1)
+        ids, start = self.gen.pack_prompts(prompts, P)
+        cache, logits0 = self.gen.prefill(ids, start, C)
+        toks = self.gen.decode(cache, logits0, start, P, self.steps, 1,
+                               self.spec.eos_token_id)
+        if key_missing:
+            self._warmed_prefill.add((B, P, C))
+            self._warmed_decode.add((B, C))
+        return np.asarray(toks)
+
+    def publish(self):
+        self.latency.publish(f"serving_{self.name}")
+        self.rate.publish(f"serving_{self.name}")

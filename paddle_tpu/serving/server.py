@@ -47,6 +47,7 @@ from ..profiler import span as _span
 from ..profiler.metrics import LatencyWindow, RateMeter
 from ..utils.monitor import stat_add
 from .bucketing import BucketLadder, pad_to_bucket
+from .decode import DecodeModelSpec, DecodeRequest, _DecodeRuntime
 from .scheduler import Batch, Request, RequestQueue
 
 
@@ -437,7 +438,8 @@ class _Worker(threading.Thread):
         super().__init__(name=f"serving-worker-{idx}", daemon=True)
         self._server = server
         self.clones = {name: rt.primary.clone()
-                       for name, rt in server._models.items()}
+                       for name, rt in server._models.items()
+                       if rt.primary is not None}
         self._depth = max(1, int(_flags.flag("serving_pipeline_depth")))
         self._inflight: deque = deque()
 
@@ -445,6 +447,27 @@ class _Worker(threading.Thread):
     def _execute(self, batch: Batch):
         import jax
         rt = self._server._models[batch.model]
+        if getattr(rt, "kind", None) == "decode":
+            # prefill + scanned decode: one long device program — run it
+            # synchronously (the scan IS the pipeline) and slice per
+            # request, honoring each request's own max_new cap
+            toks = rt.execute(batch)
+            now = time.perf_counter()
+            off = 0
+            for r in batch.requests:
+                r.future.set_result([toks[off:off + r.rows, :r.max_new]])
+                rt.latency.observe(now - r.t_enqueue)
+                off += r.rows
+            rt.rate.add(len(batch.requests))
+            rt.bump(completed=len(batch.requests), batches=1,
+                    rows=batch.rows,
+                    padded_rows=batch.bucket - batch.rows)
+            stat_add("serving_completed_total", len(batch.requests))
+            stat_add("serving_batches_total")
+            stat_add("serving_padding_rows_total",
+                     batch.bucket - batch.rows)
+            rt.publish()
+            return
         host = [np.concatenate([r.inputs[i] for r in batch.requests], axis=0)
                 if len(batch.requests) > 1 else batch.requests[0].inputs[i]
                 for i in range(rt.n_inputs)]
@@ -590,6 +613,32 @@ class Server:
         self._specs.append(spec)
         return spec
 
+    def register_decode(self, spec_or_name, layer=None, **kw
+                        ) -> DecodeModelSpec:
+        """Register an autoregressive-decode model (a DecodeModelSpec, or
+        name + live layer + DecodeModelSpec kwargs).  Warm-up compiles
+        the full (batch-bucket × prompt-bucket) prefill set and the
+        (batch-bucket × cache-bucket) decode set; traffic goes through
+        :meth:`submit_decode`."""
+        if self._started:
+            raise PreconditionNotMetError(
+                "register_decode() after start(): the warm-up contract "
+                "admits no un-warmed model — build a new Server")
+        if isinstance(spec_or_name, DecodeModelSpec):
+            spec = spec_or_name
+        else:
+            if layer is None:
+                raise InvalidArgumentError(
+                    "register_decode(name, layer, ...)")
+            kw.setdefault("batch_buckets", self._config.buckets)
+            spec = DecodeModelSpec(name=str(spec_or_name), layer=layer,
+                                   **kw)
+        if spec.name in {s.name for s in self._specs}:
+            raise InvalidArgumentError(
+                f"model {spec.name!r} is already registered")
+        self._specs.append(spec)
+        return spec
+
     def models(self) -> List[str]:
         return [s.name for s in self._specs]
 
@@ -602,7 +651,8 @@ class Server:
         if not self._specs:
             raise PreconditionNotMetError("no models registered")
         for spec in self._specs:
-            rt = _ModelRuntime(spec)
+            rt = _DecodeRuntime(spec) if isinstance(spec, DecodeModelSpec) \
+                else _ModelRuntime(spec)
             rt.load()
             rt.warmup()
             rt.rate.reset()              # QPS clock starts with traffic
@@ -694,6 +744,10 @@ class Server:
             raise PreconditionNotMetError(
                 "Server is not serving (start() it / already stopped)")
         rt = self._runtime(model)
+        if getattr(rt, "kind", None) == "decode":
+            raise InvalidArgumentError(
+                f"model {model!r} is a decode model: use "
+                "submit_decode(model, prompts, max_new_tokens=...)")
         if len(inputs) != rt.n_inputs:
             raise InvalidArgumentError(
                 f"model {model!r} takes {rt.n_inputs} inputs, got "
@@ -725,6 +779,38 @@ class Server:
     def run(self, model: str, inputs, timeout: Optional[float] = 60.0):
         """Synchronous convenience: submit + wait."""
         return self.submit(model, inputs).result(timeout=timeout)
+
+    def submit_decode(self, model: str, prompts,
+                      max_new_tokens: Optional[int] = None,
+                      timeout: Optional[float] = 5.0) -> Future:
+        """Enqueue one decode request: ``prompts`` is a list of 1-D int
+        token arrays (variable lengths — they left-pad to the prompt
+        bucket at execution).  Resolves to ``[ids]`` where ids is an
+        int32 array [len(prompts), max_new_tokens] of generated tokens.
+        Rows of one request ride one batch; the continuous batcher packs
+        concurrent requests exactly like dense traffic."""
+        if not self._started or self._stopped:
+            raise PreconditionNotMetError(
+                "Server is not serving (start() it / already stopped)")
+        rt = self._runtime(model)
+        if getattr(rt, "kind", None) != "decode":
+            raise InvalidArgumentError(
+                f"model {model!r} is not a decode model: use submit()")
+        arrs, max_new = rt.validate(list(prompts), max_new_tokens)
+        rt.ladder.bucket_for(len(arrs))      # raises OutOfRange early
+        req = DecodeRequest(model=model, prompts=arrs, rows=len(arrs),
+                            max_new=max_new)
+        rt.bump(requests=1)
+        stat_add("serving_requests_total")
+        self._queue.put(req, timeout=timeout)
+        return req.future
+
+    def run_decode(self, model: str, prompts,
+                   max_new_tokens: Optional[int] = None,
+                   timeout: Optional[float] = 60.0):
+        """Synchronous convenience: submit_decode + wait."""
+        return self.submit_decode(model, prompts, max_new_tokens) \
+            .result(timeout=timeout)
 
     # -- observability -------------------------------------------------------
     def compile_events_since_warmup(self) -> List[dict]:

@@ -1,0 +1,387 @@
+"""Autoregressive decoding as a first-class workload: static-shape
+KV-cache ``generate()`` compiled as exactly TWO executables.
+
+The reference stack decodes through the contrib beam-search DSL
+(incubate/decoder.py) — a host loop that re-dispatches per token and, on
+a shape-keyed compiler, would recompile per token as the sequence grows.
+The TPU-idiomatic form fixes every shape at compile time:
+
+  * **ring KV cache** — per attention layer a ``(B, N, C, H)`` buffer
+    written in place with ``lax.dynamic_update_slice`` at an explicit
+    ``cache_position`` (nn/layer/transformer.py ``RingCache``); batch and
+    cache length ``C`` are compile-time constants, validity is a mask;
+  * **left-padded prompts** — prompts pad LEFT up to a prefill bucket
+    ``P`` (FLAGS_decode_buckets), so every row's valid cache window is
+    the contiguous ``[P - len_b, pos)`` and the last prefill column is
+    the last prompt token for every row (no per-row gather);
+  * **one prefill executable** per (batch, P, C): embeds the prompt,
+    fills the cache, returns next-token logits;
+  * **one decode executable** per (batch, C, steps, beam): the whole
+    token loop is a single jitted ``lax.scan`` over the step body —
+    greedy argmax, or beam search via ops.decode's ``beam_search_step`` +
+    ``beam_parent_gather`` (the incubate BeamSearchDecoder reorder
+    semantics) + ``gather_tree`` backtrace.
+
+Every compile is recorded in the recompile ledger (site
+``generate:<model>``, kinds ``generate_prefill`` / ``generate_decode``);
+repeat calls at the same buckets are ledgered cache hits — the
+zero-per-token-compile proof the tests and the serving engine assert.
+
+Model contract: ``layer.init_cache(batch, max_len, dtype)`` and
+``layer.forward_cached(input_ids, cache, cache_position,
+start_positions)`` (text.models.gpt implements it over the ring-cache
+transformer stack).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import core
+from ..framework import flags as _flags
+from ..framework.enforce import (InvalidArgumentError, OutOfRangeError,
+                                 PreconditionNotMetError)
+from ..framework.functional import _bound_state, layer_state
+from ..framework.tensor import Tensor, unwrap
+from ..ops.decode import (_beam_search_step_fn, _gather_tree_fn,
+                          beam_parent_gather)
+from ..profiler import ledger as _ledger
+from ..serving.bucketing import BucketLadder
+
+__all__ = ["Generator", "generate"]
+
+
+def _aval(a):
+    return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+
+class Generator:
+    """Compiled incremental decoding for one model.
+
+    Owns the model's functional state snapshot and a cache of AOT
+    executables keyed on (phase, batch, prompt-bucket, cache-bucket,
+    steps, beam) — the warm-up set the serving engine enumerates.  All
+    compiles are ledgered at ``site``; hits at warmed keys are ledgered
+    cache hits (the zero-steady-state-compile invariant).
+    """
+
+    def __init__(self, layer, site: Optional[str] = None,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 max_len: Optional[int] = None):
+        if not hasattr(layer, "forward_cached") \
+                or not hasattr(layer, "init_cache"):
+            raise InvalidArgumentError(
+                f"{type(layer).__name__} does not implement the "
+                "incremental-decoding contract (init_cache + "
+                "forward_cached) — see text.models.GPTModel")
+        layer.eval()
+        self._layer = layer
+        self._site = site or f"generate:{type(layer).__name__.lower()}"
+        self._max_len = int(max_len if max_len is not None
+                            else _flags.flag("decode_max_len"))
+        spec = seq_buckets if seq_buckets is not None \
+            else _flags.flag("decode_buckets")
+        ladder = BucketLadder.from_flag(spec)
+        # cache lengths cap at max_len; max_len itself is the top bucket
+        self._seq_buckets = sorted(
+            {b for b in ladder.buckets if b <= self._max_len}
+            | {self._max_len})
+        self._execs = {}
+        self.refresh_state()
+
+    @property
+    def site(self):
+        return self._site
+
+    @property
+    def seq_buckets(self):
+        return list(self._seq_buckets)
+
+    def refresh_state(self):
+        """Re-snapshot params/buffers from the live layer (after training
+        or loading).  Shapes are unchanged, so no recompile — the fresh
+        arrays just flow through the existing executables."""
+        self._params, self._buffers = layer_state(self._layer)
+
+    # -- bucketing -----------------------------------------------------------
+    def prefill_bucket(self, length: int) -> int:
+        """Smallest sequence bucket holding ``length`` prompt tokens."""
+        for b in self._seq_buckets:
+            if length <= b:
+                return b
+        raise OutOfRangeError(
+            f"prompt length {length} exceeds the largest decode bucket "
+            f"{self._seq_buckets[-1]} (FLAGS_decode_buckets / "
+            "FLAGS_decode_max_len)")
+
+    def cache_bucket(self, prefill: int, steps: int) -> int:
+        """Smallest sequence bucket holding prefill + generated tokens."""
+        need = int(prefill) + int(steps)
+        for b in self._seq_buckets:
+            if need <= b:
+                return b
+        raise OutOfRangeError(
+            f"prompt bucket {prefill} + {steps} new tokens = {need} "
+            f"exceeds FLAGS_decode_max_len={self._max_len}")
+
+    # -- the two pure programs ----------------------------------------------
+    def _apply_cached(self, params, buffers, ids, cache, pos, start):
+        """Raw-array incremental forward: bind the state snapshot into
+        the live layer and run its forward_cached under no-grad (the
+        @to_static pure-fn pattern, jit/__init__.py)."""
+        from ..nn.layer.transformer import MultiHeadAttention
+        layer = self._layer
+        ring = [MultiHeadAttention.RingCache(Tensor(k), Tensor(v))
+                for k, v in cache]
+        with core.no_grad_guard(), _bound_state(layer, params, buffers):
+            logits, new_cache = layer.forward_cached(
+                Tensor(ids), ring, pos, Tensor(start))
+        return unwrap(logits), [(unwrap(c.k), unwrap(c.v))
+                                for c in new_cache]
+
+    def _init_cache_raw(self, B, C):
+        ring = self._layer.init_cache(B, C)
+        return [(unwrap(c.k), unwrap(c.v)) for c in ring]
+
+    def _build_prefill(self, B, P, C):
+        def prefill(params, buffers, ids, start):
+            cache0 = self._init_cache_raw(B, C)
+            logits, cache = self._apply_cached(
+                params, buffers, ids, cache0, jnp.int32(0), start)
+            # left-padding: the last column is the last prompt token for
+            # EVERY row — one static slice, no per-row gather
+            return cache, logits[:, -1, :].astype(jnp.float32)
+        return prefill
+
+    def _build_decode(self, B, C, steps, beam, end):
+        # end == -1 encodes "no eos": argmax tokens are always >= 0, so
+        # the finished mask never trips and the one program serves both
+        apply = self._apply_cached
+
+        def greedy(params, buffers, cache, logits0, start, pos0):
+            def step(carry, _):
+                cache, logits, pos, finished = carry
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                tok = jnp.where(finished, jnp.int32(end), tok)
+                finished = finished | (tok == end)
+                nlogits, ncache = apply(params, buffers, tok[:, None],
+                                        cache, pos, start)
+                return (ncache, nlogits[:, 0].astype(jnp.float32),
+                        pos + 1, finished), tok
+
+            init = (cache, logits0, pos0, jnp.zeros((B,), bool))
+            _, toks = lax.scan(step, init, None, length=steps)
+            return jnp.transpose(toks)                    # [B, steps]
+
+        def beam_decode(params, buffers, cache, logits0, start, pos0):
+            K = beam
+            cache = [(jnp.repeat(k, K, axis=0), jnp.repeat(v, K, axis=0))
+                     for k, v in cache]
+            start_k = jnp.repeat(start, K, axis=0)
+            logp0 = jax.nn.log_softmax(logits0.astype(jnp.float32), axis=-1)
+            V = logp0.shape[-1]
+            # only beam 0 live at t=0 (the incubate BeamSearchDecoder
+            # -inf init), so step 1 expands ONE beam
+            scores0 = jnp.broadcast_to(
+                jnp.where(jnp.arange(K) > 0, -1e9, 0.0), (B, K)
+            ).astype(jnp.float32)
+            logp0 = jnp.broadcast_to(logp0[:, None, :], (B, K, V))
+            pre0 = jnp.full((B, K), end - 1, jnp.int32)   # != end: all live
+
+            def step(carry, _):
+                cache, pre_ids, scores, logp, pos = carry
+                ids_t, scores_t, parents_t = _beam_search_step_fn(
+                    pre_ids, scores, logp, beam_size=K, end_id=end,
+                    is_accumulated=True)
+                # reorder beam-parallel cache rows by the selected
+                # parents — the incubate BeamSearchDecoder gather
+                cache = [(beam_parent_gather(k, parents_t),
+                          beam_parent_gather(v, parents_t))
+                         for k, v in cache]
+                tok = ids_t.reshape(B * K)[:, None]
+                nlogits, ncache = apply(params, buffers, tok, cache, pos,
+                                        start_k)
+                nlogp = jax.nn.log_softmax(
+                    nlogits[:, 0].astype(jnp.float32), axis=-1
+                ).reshape(B, K, V)
+                return (ncache, ids_t, scores_t, nlogp, pos + 1), \
+                    (ids_t, parents_t)
+
+            init = (cache, pre0, scores0, logp0, pos0)
+            (_, _, scores, _, _), (all_ids, all_parents) = lax.scan(
+                step, init, None, length=steps)
+            paths = _gather_tree_fn(all_ids, all_parents)  # [steps, B, K]
+            return jnp.transpose(paths, (1, 2, 0)), scores
+
+        return greedy if beam == 1 else beam_decode
+
+    # -- AOT compile + ledger ------------------------------------------------
+    def _key(self, phase, B, P, C, steps, beam, end=None):
+        return tuple([("arg:phase", phase), ("arg:batch", B)]
+                     + ([("arg:prompt", P)] if P is not None else [])
+                     + [("arg:cache", C)]
+                     + ([("arg:steps", steps), ("arg:beam", beam),
+                         ("arg:eos", end)]
+                        if steps is not None else []))
+
+    def _compile(self, key, kind, fn, arg_avals, extra):
+        ex = self._execs.get(key)
+        if ex is not None:
+            _ledger.record_cache_hit(self._site)
+            return ex
+        t0 = time.perf_counter()
+        p_avals = jax.tree_util.tree_map(_aval, self._params)
+        b_avals = jax.tree_util.tree_map(_aval, self._buffers)
+        ex = jax.jit(fn).lower(p_avals, b_avals, *arg_avals).compile()
+        _ledger.record_compile(self._site, kind, key,
+                               (time.perf_counter() - t0) * 1e3,
+                               extra=extra)
+        self._execs[key] = ex
+        return ex
+
+    def is_compiled(self, phase, B, P=None, C=None, steps=None,
+                    beam=1, eos_token_id=None) -> bool:
+        if steps is None:
+            return self._key(phase, B, P, C, None, None) in self._execs
+        end = -1 if eos_token_id is None else int(eos_token_id)
+        return self._key(phase, B, P, C, steps, beam, end) in self._execs
+
+    def prefill_exec(self, B, P, C):
+        key = self._key("prefill", B, P, C, None, None)
+        fn = self._build_prefill(B, P, C)
+        avals = (jax.ShapeDtypeStruct((B, P), jnp.int32),
+                 jax.ShapeDtypeStruct((B,), jnp.int32))
+        return self._compile(key, "generate_prefill", fn, avals,
+                             {"batch": B, "prompt": P, "cache": C})
+
+    def decode_exec(self, B, C, steps, beam=1, eos_token_id=None):
+        end = -1 if eos_token_id is None else int(eos_token_id)
+        key = self._key("decode", B, None, C, steps, beam, end)
+        fn = self._build_decode(B, C, int(steps), int(beam), end)
+        # the decode program's cache avals are exactly the prefill
+        # program's cache outputs — derive them abstractly
+        cache_avals = jax.eval_shape(lambda: self._init_cache_raw(B, C))
+        cache_avals = [(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                        jax.ShapeDtypeStruct(v.shape, v.dtype))
+                       for k, v in cache_avals]
+        vocab = self._vocab_size()
+        avals = (cache_avals,
+                 jax.ShapeDtypeStruct((B, vocab), jnp.float32),
+                 jax.ShapeDtypeStruct((B,), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32))
+        return self._compile(key, "generate_decode", fn, avals,
+                             {"batch": B, "cache": C, "steps": int(steps),
+                              "beam": int(beam)})
+
+    def _vocab_size(self):
+        cfg = getattr(self._layer, "config", None)
+        v = getattr(cfg, "vocab_size", None)
+        if v is None:
+            raise PreconditionNotMetError(
+                "cannot infer vocab size for the decode executable; the "
+                "layer must expose config.vocab_size")
+        return int(v)
+
+    # -- the two phases, executed --------------------------------------------
+    def prefill(self, ids, start, cache_len):
+        """Run (compiling if new) the prefill executable on LEFT-padded
+        int32 prompts ``ids [B, P]`` with per-row pad offsets ``start
+        [B]``; returns (device cache, next-token logits [B, V])."""
+        ids = jnp.asarray(ids, jnp.int32)
+        B, P = ids.shape
+        ex = self.prefill_exec(B, P, int(cache_len))
+        return ex(self._params, self._buffers, ids,
+                  jnp.asarray(start, jnp.int32))
+
+    def decode(self, cache, logits0, start, pos0, steps, beam_size=1,
+               eos_token_id=None):
+        """Run (compiling if new) the scanned decode executable from a
+        prefill result.  Greedy returns tokens [B, steps]; beam returns
+        (ids [B, K, steps], scores [B, K])."""
+        B = logits0.shape[0]
+        C = cache[0][0].shape[2]
+        ex = self.decode_exec(B, int(C), int(steps), int(beam_size),
+                              eos_token_id)
+        return ex(self._params, self._buffers, cache,
+                  jnp.asarray(logits0, jnp.float32),
+                  jnp.asarray(start, jnp.int32), jnp.int32(pos0))
+
+    # -- host-side prep + the public call ------------------------------------
+    def pack_prompts(self, prompts, bucket):
+        """LEFT-pad variable-length int prompts to [rows, bucket]; returns
+        (ids int32, start int32 [rows]) — start[b] = bucket - len_b is
+        row b's first valid cache column."""
+        rows = len(prompts)
+        ids = np.zeros((rows, bucket), np.int32)
+        start = np.empty((rows,), np.int32)
+        for i, p in enumerate(prompts):
+            p = np.asarray(p).reshape(-1).astype(np.int32)
+            if p.size == 0:
+                raise InvalidArgumentError("empty prompt (0 tokens)")
+            if p.size > bucket:
+                raise OutOfRangeError(
+                    f"prompt of {p.size} tokens exceeds bucket {bucket}")
+            ids[i, bucket - p.size:] = p
+            start[i] = bucket - p.size
+        return ids, start
+
+    def generate(self, input_ids, lengths=None, max_new_tokens=32,
+                 beam_size=1, eos_token_id=None):
+        """Greedy/beam decoding of a batch of prompts.
+
+        ``input_ids`` [B, L] (right-padded; ``lengths`` [B] gives true
+        prompt lengths, default L).  Exactly two executables run: the
+        (batch, prompt-bucket, cache-bucket) prefill and the (batch,
+        cache-bucket, steps, beam) decode scan.  Greedy returns a Tensor
+        of generated ids [B, max_new_tokens]; beam returns (ids
+        [B, beam, max_new_tokens], scores [B, beam]) Tensors.
+        """
+        ids_np = np.asarray(unwrap(input_ids))
+        if ids_np.ndim != 2:
+            raise InvalidArgumentError(
+                f"input_ids must be [batch, length], got {ids_np.shape}")
+        B, L = ids_np.shape
+        steps = int(max_new_tokens)
+        if steps < 1:
+            raise InvalidArgumentError("max_new_tokens must be >= 1")
+        lens = np.full((B,), L, np.int64) if lengths is None \
+            else np.asarray(unwrap(lengths)).reshape(-1).astype(np.int64)
+        if lens.shape[0] != B or (lens < 1).any() or (lens > L).any():
+            raise InvalidArgumentError(
+                f"lengths must be [batch] in [1, {L}], got {lens}")
+        max_pos = getattr(getattr(self._layer, "config", None),
+                          "max_position_embeddings", None)
+        if max_pos is not None and int(lens.max()) + steps > int(max_pos):
+            raise OutOfRangeError(
+                f"prompt ({int(lens.max())}) + max_new_tokens ({steps}) "
+                f"exceeds max_position_embeddings={max_pos}")
+        P = self.prefill_bucket(int(lens.max()))
+        C = self.cache_bucket(P, steps)
+        prompts = [ids_np[b, :lens[b]] for b in range(B)]
+        ids, start = self.pack_prompts(prompts, P)
+        cache, logits0 = self.prefill(ids, start, C)
+        out = self.decode(cache, logits0, start, P, steps,
+                          beam_size=beam_size, eos_token_id=eos_token_id)
+        if beam_size == 1:
+            return Tensor(out)
+        paths, scores = out
+        return Tensor(paths), Tensor(scores)
+
+    __call__ = generate
+
+
+def generate(layer, input_ids, **kwargs):
+    """Module-level convenience: (build and memoize a Generator on the
+    layer, then) decode.  See :class:`Generator`."""
+    gen = getattr(layer, "_paddle_tpu_generator", None)
+    if gen is None or gen._layer is not layer:
+        gen = Generator(layer)
+        layer._paddle_tpu_generator = gen
+    else:
+        gen.refresh_state()          # pick up trained/loaded weights
+    return gen.generate(input_ids, **kwargs)

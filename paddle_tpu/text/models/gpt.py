@@ -58,3 +58,65 @@ class GPTModel(nn.Layer):
         return F.cross_entropy(
             logits[:, :-1].reshape([-1, self.config.vocab_size]),
             labels[:, 1:].reshape([-1]))
+
+    # -- incremental decoding (static-shape KV ring cache) -------------------
+    def init_cache(self, batch, max_len, dtype=None):
+        """Per-layer zero ring caches [batch, heads, max_len, head_dim];
+        ``max_len`` is the compile-time cache length."""
+        if dtype is None:
+            dtype = str(self.wte.weight.dtype)
+        return self.encoder.gen_ring_cache(batch, max_len, dtype)
+
+    def forward_cached(self, input_ids, cache, cache_position,
+                       start_positions):
+        """One incremental step over the ring cache.
+
+        input_ids [B, T] — the tokens to append (the LEFT-padded prompt
+        at prefill, one token per row at decode); ``cache_position`` is
+        the cache column the first new token writes (int or traced int32
+        scalar — the write wraps modulo the static cache length);
+        ``start_positions`` [B] is each row's first valid cache column
+        (its left-pad offset).  Token positions and the additive
+        validity+causality mask are derived from those two, so batch and
+        cache length stay compile-time constants.  Returns
+        (logits [B, T, V], updated cache).
+        """
+        import jax.numpy as jnp
+        from ... import ops
+        from ...framework.tensor import Tensor, unwrap
+        b, t = input_ids.shape
+        C = cache[0].k.shape[2]
+        pos = unwrap(cache_position)
+        pos = jnp.asarray(pos, jnp.int32) if not isinstance(pos, int) \
+            else jnp.int32(pos)
+        start = jnp.asarray(unwrap(start_positions), jnp.int32)
+        row = pos + jnp.arange(t, dtype=jnp.int32)       # global cache cols
+        pos_ids = jnp.clip(row[None, :] - start[:, None], 0,
+                           self.config.max_position_embeddings - 1)
+        h = self.drop(self.wte(input_ids) + self.wpe(Tensor(pos_ids)))
+        # valid key col j for query row i: start_b <= j <= pos + i
+        col = jnp.arange(C, dtype=jnp.int32)
+        valid = ((col[None, None, None, :] <= row[None, None, :, None])
+                 & (col[None, None, None, :] >= start[:, None, None, None]))
+        mask = Tensor(jnp.where(valid, 0.0, -1e30).astype(jnp.float32))
+        window = None
+        if t == 1:
+            # decode step: the mask is a contiguous [start, pos+1) window,
+            # which is what the flash-decoding kernel dispatches on
+            window = (Tensor(start), Tensor(jnp.broadcast_to(pos + 1, (b,))))
+        h, new_cache = self.encoder(
+            h, mask, cache=cache,
+            cache_position=Tensor(pos % jnp.int32(C)),
+            decode_window=window)
+        logits = ops.matmul(h, self.wte.weight, transpose_y=True)
+        return logits, new_cache
+
+    def generate(self, input_ids, lengths=None, max_new_tokens=32,
+                 beam_size=1, eos_token_id=None, **kw):
+        """Autoregressive decoding compiled as exactly two executables
+        (text.generation: one prefill jit + one scanned decode step)."""
+        from ..generation import generate as _generate
+        return _generate(self, input_ids, lengths=lengths,
+                         max_new_tokens=max_new_tokens,
+                         beam_size=beam_size, eos_token_id=eos_token_id,
+                         **kw)

@@ -192,6 +192,79 @@ def test_cell_reuse_across_decoders_reboots_states():
     np.testing.assert_allclose(td(emb(tgt)).numpy(), first, atol=1e-6)
 
 
+def test_need_reorder_matches_numpy_beam_search():
+    """The need_reorder gather path against a hand-rolled NumPy beam
+    search: a linear-tanh cell whose state genuinely steers the logits,
+    decoded step by step in numpy with explicit parent bookkeeping —
+    translation ids AND scores must match exactly (the beam_parent_gather
+    semantics generate(beam_size=...) reuses)."""
+    rng = np.random.RandomState(7)
+    paddle.seed(17)
+    enc = paddle.to_tensor(rng.randn(B, H).astype("float32"))
+    init = InitState(init=enc, need_reorder=True)
+    cell = StateCell(inputs={"x": None}, states={"h": init}, out_state="h")
+    lin_x = nn.Linear(D, H)
+    lin_h = nn.Linear(H, H)
+
+    @cell.state_updater
+    def updater(sc):
+        x = sc.get_input("x")
+        h = sc.get_state("h")
+        sc.set_state("h", paddle.tanh(lin_x(x) + lin_h(h)))
+
+    K, T, START = 3, 5, 2
+    dec = BeamSearchDecoder(cell,
+                            paddle.to_tensor(np.full((B, 1), START,
+                                                     "int64")),
+                            paddle.to_tensor(np.zeros((B, 1), "float32")),
+                            target_dict_dim=V, word_dim=D,
+                            max_len=T, beam_size=K, end_id=END)
+    dec.decode()
+    ids, scores = dec()
+    ids = ids.numpy()                    # [T, B, K] full paths
+    scores = scores.numpy()              # [B, K]
+
+    # numpy replay with the SAME weights
+    w_emb = dec.embedding.parameters()[0].numpy().astype(np.float64)
+    wx, bx = [p.numpy().astype(np.float64) for p in lin_x.parameters()]
+    wh, bh = [p.numpy().astype(np.float64) for p in lin_h.parameters()]
+    ws, bs = [p.numpy().astype(np.float64) for p in dec.score_fc
+              .parameters()]
+
+    def logp(h):                         # [K, H] -> [K, V]
+        lg = h @ ws + bs
+        lg = lg - lg.max(axis=1, keepdims=True)
+        return lg - np.log(np.exp(lg).sum(axis=1, keepdims=True))
+
+    enc_np = enc.numpy().astype(np.float64)
+    for b in range(B):
+        h = np.repeat(enc_np[b][None], K, axis=0)      # tiled to beams
+        cur = np.full((K,), START)
+        sc = np.array([0.0] + [-1e9] * (K - 1))
+        paths = [[] for _ in range(K)]
+        for _ in range(T):
+            # cell update with the PREVIOUS frontier's embeddings, then
+            # score, select, and reorder h by the selected parents
+            h = np.tanh(w_emb[cur] @ wx + bx + h @ wh + bh)
+            total = np.empty((K, V))
+            lp = logp(h)
+            for k in range(K):
+                if cur[k] == END:        # finished: only END at own score
+                    total[k] = -np.inf
+                    total[k, END] = sc[k]
+                else:
+                    total[k] = sc[k] + lp[k]
+            top = np.argsort(-total.reshape(-1), kind="stable")[:K]
+            parents, toks = top // V, top % V
+            sc = total.reshape(-1)[top]
+            h = h[parents]               # THE need_reorder gather
+            paths = [paths[p] + [int(t)] for p, t in zip(parents, toks)]
+            cur = toks
+        want = np.array(paths).T         # [T, K]
+        np.testing.assert_array_equal(ids[:, b, :], want)
+        np.testing.assert_allclose(scores[b], sc, atol=1e-4)
+
+
 def test_init_state_shape_placeholder():
     enc = paddle.to_tensor(np.zeros((3, H), "float32"))
     st = InitState(init_boot=enc, shape=[-1, 5], value=2.0)

@@ -202,6 +202,36 @@ def test_layout_clean():
     assert not _only(r, "layout")
 
 
+def test_layout_lane_dim_dynamic_update_seeded():
+    # a traced start on the LANE dim of an update IS a hazard (cross-tile
+    # masked scatter) — the KV exemption must not swallow it
+    def f(x, v, i):
+        return jax.lax.dynamic_update_slice(x, v, (0, i))  # LINT:dupdate
+    r = _lint(f, jnp.ones((8, 256)), jnp.ones((8, 16)), jnp.int32(3))
+    found = _only(r, "layout")
+    assert len(found) == 1
+    assert "lane" in found[0].message
+    assert f":{_marker_line('dupdate')}" in found[0].location
+
+
+def test_layout_kv_cache_ring_write_clean():
+    # the canonical generate() ring-cache append: dynamic_update_slice at
+    # a TRACED cache_position on the sublane (sequence) dim with the lane
+    # (head) dim fully spanned — a sublane-masked in-tile store, exempt
+    def f(cache, kv, pos):
+        return jax.lax.dynamic_update_slice(cache, kv, (0, 0, pos, 0))
+    r = _lint(f, jnp.ones((2, 4, 64, 128)), jnp.ones((2, 4, 1, 128)),
+              jnp.int32(7))
+    assert not _only(r, "layout")
+    # the in_dim convenience form paddle.dynamic_update_slice lowers to
+    def g(k_cache, k_new, pos):
+        return jax.lax.dynamic_update_slice_in_dim(k_cache, k_new, pos,
+                                                   axis=2)
+    r2 = _lint(g, jnp.ones((1, 2, 32, 128)), jnp.ones((1, 2, 1, 128)),
+               jnp.int32(5))
+    assert not _only(r2, "layout")
+
+
 def test_collective_consistency_seeded():
     from jax.experimental.shard_map import shard_map
     from jax.sharding import Mesh, PartitionSpec as P

@@ -1,0 +1,268 @@
+"""Batched autoregressive decode through the serving engine.
+
+Warm-up compiles exactly the (batch-bucket × prefill-bucket) prefill set
+plus the (batch-bucket × cache-bucket) decode set; mixed-length
+concurrent traffic then runs with ZERO steady-state recompiles; served
+greedy tokens bit-match a batch-1 generate() of the same prompt
+(left-padding batch invariance); validation and strict-mode behavior."""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.framework.enforce import (InvalidArgumentError,
+                                          NotFoundError, OutOfRangeError,
+                                          PreconditionNotMetError)
+from paddle_tpu.framework.flags import flags_restore, flags_snapshot, \
+    set_flags
+from paddle_tpu.profiler import ledger
+from paddle_tpu.text.generation import Generator
+from paddle_tpu.text.models.gpt import GPTConfig, GPTModel
+
+V = 64
+
+
+def _gpt(seed=21):
+    paddle.seed(seed)
+    m = GPTModel(GPTConfig.tiny(vocab_size=V, hidden_size=32, layers=2,
+                                heads=2, seq=64))
+    m.eval()
+    return m
+
+
+def _server(m, batch=(1, 2), seq=(8, 16), steps=4, **kw):
+    srv = serving.Server(serving.ServingConfig(workers=2))
+    srv.register_decode("gpt", m, batch_buckets=batch, seq_buckets=seq,
+                        max_new_tokens=steps, max_len=32, **kw)
+    return srv
+
+
+def test_warmup_compiles_the_full_bucket_grid_then_stays_silent():
+    m = _gpt()
+    ledger.clear()
+    srv = _server(m, batch=(1, 2, 4), seq=(8, 16), steps=4)
+    srv.start()
+    try:
+        evs = ledger.compile_events("serving:gpt")
+        kinds = [e["kind"] for e in evs]
+        # 3 batch buckets x 2 prefill buckets; cache buckets 8+4->16 and
+        # 16+4->32 are distinct, so 2 decode executables per batch bucket
+        assert kinds.count("generate_prefill") == 6
+        assert kinds.count("generate_decode") == 6
+        assert len(evs) == 12
+        rng = np.random.RandomState(0)
+        for _ in range(6):
+            rows = int(rng.randint(1, 4))
+            prompts = [rng.randint(1, V, rng.randint(1, 16))
+                       for _ in range(rows)]
+            out = srv.run_decode("gpt", prompts, max_new_tokens=3)[0]
+            assert out.shape == (rows, 3) and out.dtype == np.int32
+        srv.assert_zero_steady_state_recompiles()
+        assert len(ledger.compile_events("serving:gpt")) == 12
+        st = srv.stats("gpt")
+        assert st["backend"] == "decode" and st["steady_compiles"] == 0
+        assert st["completed"] == 6 and st["errors"] == 0
+    finally:
+        srv.stop()
+
+
+def test_served_tokens_bit_match_batch1_generate():
+    """The padding/batch-invariance contract: whatever batch the
+    continuous batcher packs a prompt into, its greedy continuation is
+    IDENTICAL to a standalone batch-1 generate()."""
+    m = _gpt(seed=23)
+    srv = _server(m, batch=(1, 2, 4), seq=(8, 16), steps=5)
+    srv.start()
+    try:
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(1, V, int(n)) for n in (3, 7, 12, 1, 9)]
+        futs = [srv.submit_decode("gpt", [p], max_new_tokens=5)
+                for p in prompts]
+        served = [f.result(timeout=60)[0][0] for f in futs]
+        oracle = Generator(m, seq_buckets=(8, 16), max_len=32)
+        for p, got in zip(prompts, served):
+            want = np.asarray(oracle.generate(
+                p[None, :].astype(np.int64), max_new_tokens=5).numpy())[0]
+            np.testing.assert_array_equal(got, want)
+        srv.assert_zero_steady_state_recompiles()
+    finally:
+        srv.stop()
+
+
+def test_concurrent_mixed_traffic_zero_steady_compiles():
+    m = _gpt(seed=25)
+    srv = _server(m, batch=(1, 2, 4), seq=(8, 16), steps=4)
+    srv.start()
+    errors = []
+
+    def client(i):
+        rng = np.random.RandomState(100 + i)
+        try:
+            for _ in range(5):
+                rows = int(rng.randint(1, 4))
+                prompts = [rng.randint(1, V, rng.randint(1, 16))
+                           for _ in range(rows)]
+                mn = int(rng.randint(1, 5))
+                out = srv.run_decode("gpt", prompts, max_new_tokens=mn)[0]
+                if out.shape != (rows, mn):
+                    raise AssertionError(f"shape {out.shape} != "
+                                         f"({rows}, {mn})")
+        except Exception as e:   # noqa: BLE001 — recorded per client
+            errors.append(f"client{i}: {type(e).__name__}: {e}")
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        srv.assert_zero_steady_state_recompiles()
+        st = srv.stats("gpt")
+        assert st["completed"] == 20 and st["errors"] == 0
+        assert st["qps"] > 0 and st["p99_ms"] > 0
+    finally:
+        srv.stop()
+
+
+def test_decode_and_dense_models_share_one_server(tmp_path):
+    """Multi-tenant: a dense model and a decode model behind ONE server;
+    each takes its own submit surface and the steady-state invariant
+    covers both."""
+    import paddle_tpu.nn as nn
+    m = _gpt(seed=27)
+    lin = nn.Linear(6, 3)
+    lin.eval()
+    prefix = str(tmp_path / "lin")
+    serving.export_for_serving(lin, prefix, [([None, 6], "float32")],
+                               buckets=(1, 2))
+    srv = _server(m, batch=(1, 2), seq=(8,), steps=3)
+    srv.register("lin", prefix, buckets=(1, 2))
+    srv.start()
+    try:
+        out = srv.run("lin", [np.zeros((2, 6), "float32")])
+        assert out[0].shape == (2, 3)
+        toks = srv.run_decode("gpt", [np.arange(1, 5)])[0]
+        assert toks.shape == (1, 3)
+        # wrong surface for each model type
+        with pytest.raises(InvalidArgumentError):
+            srv.submit("gpt", [np.zeros((1, 6), "float32")])
+        with pytest.raises(InvalidArgumentError):
+            srv.submit_decode("lin", [np.arange(3)])
+        srv.assert_zero_steady_state_recompiles()
+    finally:
+        srv.stop()
+
+
+def test_submit_decode_validation():
+    m = _gpt(seed=29)
+    srv = _server(m, batch=(1, 2), seq=(8,), steps=4)
+    srv.start()
+    try:
+        with pytest.raises(InvalidArgumentError):
+            srv.submit_decode("gpt", [])                      # no prompts
+        with pytest.raises(InvalidArgumentError):
+            srv.submit_decode("gpt", [np.zeros((2, 2), np.int64)])  # 2-D
+        with pytest.raises(InvalidArgumentError):
+            srv.submit_decode("gpt", [np.zeros(0, np.int64)])  # empty
+        with pytest.raises(InvalidArgumentError):
+            srv.submit_decode("gpt", [np.ones(3, np.float32)])  # float
+        with pytest.raises(OutOfRangeError):
+            srv.submit_decode("gpt", [np.ones(9, np.int64)])  # > bucket 8
+        with pytest.raises(InvalidArgumentError):
+            srv.submit_decode("gpt", [np.ones(3, np.int64)],
+                              max_new_tokens=5)               # > warmed 4
+        with pytest.raises(OutOfRangeError):
+            srv.submit_decode("gpt", [np.ones(2, np.int64)] * 3)  # rows
+        with pytest.raises(NotFoundError):
+            srv.submit_decode("nope", [np.ones(2, np.int64)])
+    finally:
+        srv.stop()
+
+
+def test_registration_guards():
+    m = _gpt(seed=31)
+    srv = serving.Server()
+    srv.register_decode("gpt", m, batch_buckets=(1,), seq_buckets=(8,),
+                        max_new_tokens=4, max_len=32)
+    with pytest.raises(InvalidArgumentError):
+        srv.register_decode("gpt", m)             # duplicate name
+    with pytest.raises(InvalidArgumentError):
+        srv.register_decode("other")              # no layer
+    # no room for max_new under max_len: refused at start(), not traffic
+    bad = serving.Server()
+    bad.register_decode("tight", _gpt(seed=33), batch_buckets=(1,),
+                        seq_buckets=(8,), max_new_tokens=8, max_len=8)
+    with pytest.raises(PreconditionNotMetError):
+        bad.start()
+    srv.start()
+    try:
+        with pytest.raises(PreconditionNotMetError):
+            srv.register_decode("late", m)        # after start()
+    finally:
+        srv.stop()
+
+
+# -- tools/serve.py --decode smoke (CI lane) ---------------------------------
+
+@pytest.mark.slow
+def test_serve_cli_decode_smoke_end_to_end():
+    """Drive tools/serve.py --decode in a subprocess: a dense model and
+    the GPT decode model behind one server, warm-up compiles the bucket
+    grid, concurrent mixed prefill/decode traffic completes within the
+    SLO, and the ledger records ZERO post-warm-up compiles (rc!=0 on any
+    violation)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "serve.py"),
+         "--decode", "--model", "lenet", "--duration", "1.0",
+         "--clients", "2", "--buckets", "1,2", "--seq-buckets", "8,16",
+         "--max-new", "4", "--max-request-rows", "2",
+         "--p99-slo-ms", "5000", "--json"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    report = json.loads(p.stdout)
+    assert report["steady_compiles"] == 0
+    st = report["models"]["gpt_decode"]
+    assert st["backend"] == "decode"
+    assert st["traffic_errors"] == []
+    assert st["errors"] == 0 and st["completed"] > 0
+    assert st["slo_met"] and st["qps"] > 0
+    dense = report["models"]["lenet"]
+    assert dense["errors"] == 0 and dense["completed"] > 0
+
+
+def test_strict_mode_vs_escape_hatch():
+    """A (batch, prompt, cache) triple outside the warmed grid fails the
+    request under FLAGS_serving_strict (default) — it can only arise
+    from a ladder/registration mismatch, and the server must not compile
+    under traffic."""
+    m = _gpt(seed=35)
+    srv = _server(m, batch=(1,), seq=(8, 16), steps=4)
+    srv.start()
+    try:
+        rt = srv._models["gpt"]
+        # simulate a hole in the warmed grid (e.g. a re-warm that missed)
+        rt._warmed_prefill.discard((1, 16, 32))
+        rt._warmed_decode.discard((1, 32))
+        with pytest.raises(PreconditionNotMetError):
+            srv.run_decode("gpt", [np.ones(12, np.int64)])
+        snap = flags_snapshot()
+        try:
+            set_flags({"FLAGS_serving_strict": False})
+            out = srv.run_decode("gpt", [np.ones(12, np.int64)])[0]
+            assert out.shape == (1, 4)
+            # the escape-hatch execution is visible: counted as steady
+            assert srv.stats("gpt")["steady_compiles"] == 1
+        finally:
+            flags_restore(snap)
+    finally:
+        srv.stop()

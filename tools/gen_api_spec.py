@@ -31,6 +31,7 @@ NAMESPACES = [
     ("paddle_tpu.distributed", None),
     ("paddle_tpu.distributed.fleet", None),
     ("paddle_tpu.vision.models", None),
+    ("paddle_tpu.text", None),
     ("paddle_tpu.text.models", None),
     ("paddle_tpu.inference", None),
     ("paddle_tpu.serving", None),

@@ -71,6 +71,50 @@ ZOO = {
 }
 
 
+def build_gpt_decode(vocab=128, seq=128):
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTModel
+    m = GPTModel(GPTConfig.tiny(vocab_size=vocab, hidden_size=32,
+                                layers=2, heads=2, seq=seq))
+    m.eval()
+    m._serve_vocab = vocab
+    return m
+
+
+def _decode_traffic(server, name, duration_s, clients, max_rows,
+                    max_prompt, max_new, vocab, seed):
+    """Concurrent mixed prefill/decode traffic: each client submits
+    random-row requests of random-length prompts (spanning the prefill
+    bucket ladder) with random generation budgets, and checks the result
+    shape; per-client error capture."""
+    errors = []
+    deadline = time.perf_counter() + duration_s
+
+    def client(i):
+        rng = np.random.RandomState(seed + i)
+        while time.perf_counter() < deadline:
+            rows = int(rng.randint(1, max_rows + 1))
+            prompts = [rng.randint(1, vocab,
+                                   int(rng.randint(1, max_prompt + 1)))
+                       for _ in range(rows)]
+            mn = int(rng.randint(1, max_new + 1))
+            try:
+                out = server.submit_decode(
+                    name, prompts, max_new_tokens=mn).result(timeout=60)
+                if out[0].shape != (rows, mn):
+                    raise AssertionError(
+                        f"decode shape {out[0].shape} != ({rows}, {mn})")
+            except Exception as e:   # noqa: BLE001 — reported per client
+                errors.append(f"client{i}: {type(e).__name__}: {e}")
+                return
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errors
+
+
 def _random_inputs(rng, specs, rows, vocab=None):
     out = []
     for shape, dtype in specs:
@@ -119,7 +163,17 @@ def main(argv=None):
                     "sustained traffic, report QPS/p50/p99 + the "
                     "zero-steady-state-recompile check")
     ap.add_argument("--model", action="append", choices=sorted(ZOO),
-                    help="serve one zoo model (repeatable; default: all)")
+                    help="serve one zoo model (repeatable; default: all "
+                         "dense models, or none under --decode)")
+    ap.add_argument("--decode", action="store_true",
+                    help="additionally serve a GPT autoregressive-decode "
+                         "model (KV-cache generate through the bucketed "
+                         "prefill/decode executables) and drive mixed "
+                         "prompt-length decode traffic at it")
+    ap.add_argument("--max-new", type=int, default=4,
+                    help="decode model: max generated tokens per request")
+    ap.add_argument("--seq-buckets", default="8,16",
+                    help="decode model: prompt-length bucket ladder")
     ap.add_argument("--int8", action="store_true",
                     help="serve frozen int8 exports (PTQ + freeze)")
     ap.add_argument("--duration", type=float, default=2.0,
@@ -143,8 +197,11 @@ def main(argv=None):
     from paddle_tpu.framework.flags import flags_restore, flags_snapshot, \
         set_flags
 
-    names = list(dict.fromkeys(args.model or sorted(ZOO)))
+    names = list(dict.fromkeys(
+        args.model or ([] if args.decode else sorted(ZOO))))
     buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+    seq_buckets = tuple(int(b) for b in args.seq_buckets.split(",")
+                        if b.strip())
     snap = flags_snapshot()
     report = {"int8": args.int8, "buckets": list(buckets),
               "duration_s": args.duration, "clients": args.clients,
@@ -183,9 +240,31 @@ def main(argv=None):
                 model_meta[name] = (specs,
                                     getattr(layer, "_serve_vocab", None),
                                     manifest["mode"])
+            if args.decode:
+                gpt = build_gpt_decode()
+                server.register_decode(
+                    "gpt_decode", gpt, batch_buckets=buckets,
+                    seq_buckets=seq_buckets, max_new_tokens=args.max_new,
+                    max_len=max(seq_buckets) + args.max_new)
             t0 = time.perf_counter()
             server.start()
             warmup_s = round(time.perf_counter() - t0, 3)
+            if args.decode:
+                errors = _decode_traffic(
+                    server, "gpt_decode", args.duration, args.clients,
+                    args.max_request_rows, max(seq_buckets),
+                    args.max_new, gpt._serve_vocab, args.seed)
+                st = server.stats("gpt_decode")
+                st["export_mode"] = "live_layer"
+                st["traffic_errors"] = errors
+                if errors or st["errors"]:
+                    rc = 1
+                if args.p99_slo_ms is not None:
+                    st["p99_slo_ms"] = args.p99_slo_ms
+                    st["slo_met"] = st["p99_ms"] <= args.p99_slo_ms
+                    if not st["slo_met"]:
+                        rc = 1
+                report["models"]["gpt_decode"] = st
             for name in names:
                 specs, vocab, mode = model_meta[name]
                 errors = _traffic(server, name, specs, args.duration,
