@@ -37,6 +37,7 @@ from .manager import (LintContext, PassManager, default_pass_manager,  # noqa: F
 from . import passes as _passes  # noqa: F401  (registers the built-ins)
 from .passes import PASS_IDS  # noqa: F401
 from .ast_lint import lint_function_ast, run_ast_lint  # noqa: F401
+from . import hlo  # noqa: F401  (compiled-program audit subsystem)
 
 __all__ = [
     "Severity", "Diagnostic", "LintReport", "GraphLintWarning",

@@ -1,0 +1,50 @@
+"""paddle_tpu.analysis.hlo — compiled-program audit (post-lowering HLO).
+
+The subsystem that closes ROADMAP item 1's inspection gap: PR 5's graph
+lint walks the traced jaxpr; this package walks what XLA actually
+*compiled* — the post-SPMD-partitioning HLO module of an AOT-lowered step
+— where de-sharded ZeRO state, per-step full-gathers and collective
+blow-ups first become visible.  Everything is abstract (lower + compile,
+no execution), so pod-scale layouts (16/32/64+ devices) are auditable on
+a CPU host with ``--xla_force_host_platform_device_count``.
+
+Surfaces:
+
+  * :func:`audit_train_step` / :func:`audit_compiled` — run the hlo pass
+    family (hlo-full-gather ERROR, hlo-collective-budget,
+    hlo-memory-budget) over a TrainStep / any ``jax.stages.Compiled``;
+  * :func:`program_stats` + extract helpers — collective census with
+    per-device + ring-model wire bytes, XLA ``cost_analysis()`` FLOPs,
+    ``memory_analysis()`` per-device HBM;
+  * ``FLAGS_hlo_audit`` off|warn|error (``PADDLE_TPU_HLO_AUDIT``) wires
+    the audit into every fresh TrainStep compile, one branch when off;
+    findings reuse the PR-5 PassManager severity/suppression machinery;
+  * ``tools/hlo_audit.py`` — the CLI face (zoo models over virtual wide
+    meshes); ``__graft_entry__.dryrun_multichip`` phase 5 — the
+    8/16/32/64-device partitioning gate + scaling table;
+  * :func:`fixtures.desharded_zero_step` — the seeded negative fixture
+    proving the full-gather detector fires.
+"""
+from __future__ import annotations
+
+from .extract import (CollectiveOp, HloProgramStats,  # noqa: F401
+                      COLLECTIVE_KINDS, collective_census, extract_cost,
+                      extract_memory, hlo_text, parse_collectives,
+                      program_stats)
+from .audit import (HLO_PASS_IDS, HloAuditResult,  # noqa: F401
+                    HloAuditWarning, audit_compile_events, audit_compiled,
+                    audit_enabled, audit_mode, audit_train_step, emit,
+                    hlo_pass_manager, register_hlo_pass, set_audit_dir,
+                    state_leaf_table)
+from .fixtures import desharded_zero_step  # noqa: F401
+
+__all__ = [
+    "CollectiveOp", "HloProgramStats", "COLLECTIVE_KINDS",
+    "parse_collectives", "collective_census", "extract_cost",
+    "extract_memory", "program_stats", "hlo_text",
+    "HLO_PASS_IDS", "HloAuditResult", "HloAuditWarning",
+    "hlo_pass_manager", "register_hlo_pass", "audit_mode",
+    "audit_enabled", "audit_compiled", "audit_train_step",
+    "audit_compile_events", "state_leaf_table", "set_audit_dir", "emit",
+    "desharded_zero_step",
+]

@@ -1,0 +1,448 @@
+"""The HLO audit pass family: pod-scale partitioning hazards, post-lowering.
+
+PR 5's graph lint answers "what did the user trace"; this family answers
+"what did XLA compile" — the two questions diverge exactly where pod jobs
+die: GSPMD decides during partitioning whether a ZeRO-sharded state leaf
+stays sharded or silently materializes (and all-gathers) a full copy per
+device, and whether a mesh reshape turns a cheap collective mix into a
+blow-up.  The audit runs over an AOT-lowered executable (abstract eval +
+XLA compile, NO execution and no hardware), so a 64-device v5e layout is
+checkable on a laptop CPU.
+
+Machinery reuse (ISSUE 8 contract): passes register into a
+:class:`~..manager.PassManager` (the PR-5 registry — per-pass severity,
+``set_severity`` overrides, and the shared suppression surface:
+``FLAGS_graph_lint_suppress`` + the scoped ``analysis.suppress()``
+context both apply to hlo pass ids).  Gating is its own tri-state
+``FLAGS_hlo_audit`` = off|warn|error (env ``PADDLE_TPU_HLO_AUDIT``),
+off-path = one Python branch per fresh TrainStep compile; findings
+surface as :class:`HloAuditWarning` + ``hlo_audit_*`` gauges + a JSONL
+sink (``FLAGS_hlo_audit_dir`` / ``PADDLE_TPU_HLO_AUDIT_DIR``), and error
+mode raises EnforceError (PreconditionNotMet) before the step executes.
+
+Pass inventory (ids are stable suppression keys / gauge names):
+
+  hlo-full-gather       ERROR   a ZeRO-sharded state leaf is stored
+                                replicated in the compiled executable
+                                (the de-shard that turns into a per-step
+                                full-gather and a per-device HBM copy)
+  hlo-collective-budget WARNING the program is collective-bound: ring-model
+                                wire bytes exceed the configured fraction
+                                of the program's total byte traffic
+  hlo-memory-budget     WARNING per-device peak (args+outputs+temps+code)
+                                exceeds the configured HBM budget
+"""
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...framework import flags as _flags
+from ..diagnostics import Diagnostic, GraphLintWarning, LintReport, Severity
+from ..manager import LintContext, PassManager
+from .extract import HloProgramStats, program_stats
+
+__all__ = [
+    "HLO_PASS_IDS", "HloAuditWarning", "HloAuditResult",
+    "hlo_pass_manager", "register_hlo_pass", "audit_mode", "audit_enabled",
+    "audit_compiled", "audit_train_step", "audit_compile_events",
+    "state_leaf_table", "set_audit_dir", "emit",
+]
+
+HLO_PASS_IDS = ("hlo-full-gather", "hlo-collective-budget",
+                "hlo-memory-budget")
+_MODES = ("off", "warn", "error")
+
+
+class HloAuditWarning(GraphLintWarning):
+    """Warn-mode HLO-audit findings (a GraphLintWarning subclass so one
+    warnings filter governs both analysis families)."""
+
+
+_hlo_manager = PassManager()
+
+
+def hlo_pass_manager() -> PassManager:
+    """The HLO audit's own PassManager (separate registry from the trace
+    -time lint so kinds/severities never collide; same machinery)."""
+    return _hlo_manager
+
+
+def register_hlo_pass(pass_id: str, *, severity: Severity = Severity.WARNING,
+                      kinds: Tuple[str, ...] = ("hlo",), doc: str = ""):
+    return _hlo_manager.register(pass_id, severity=severity, kinds=kinds,
+                                 doc=doc)
+
+
+# ---------------------------------------------------------------------------
+# Gating
+# ---------------------------------------------------------------------------
+
+def audit_mode() -> str:
+    mode = str(_flags.flag("hlo_audit")).lower()
+    return mode if mode in _MODES else "off"
+
+
+def audit_enabled() -> bool:
+    """The one off-path branch the TrainStep compile site checks."""
+    return audit_mode() != "off"
+
+
+# ---------------------------------------------------------------------------
+# State-leaf table: the ZeRO sharding contract vs. the compiled layout
+# ---------------------------------------------------------------------------
+
+def _spec_view(sharding) -> Tuple[Optional[Tuple], bool]:
+    """(spec entries | None, is_fully_replicated) for any jax sharding."""
+    spec = getattr(sharding, "spec", None)
+    if spec is not None:
+        entries = tuple(spec)
+        return entries, not any(e is not None for e in entries)
+    try:
+        return None, bool(sharding.is_fully_replicated)
+    except Exception:
+        return None, False
+
+
+def _leaf_rows(tree_vals, tree_in, tree_out, category, prefix):
+    rows = []
+    for name in sorted(tree_vals):
+        v = tree_vals[name]
+        in_spec, in_rep = _spec_view(tree_in[name])
+        out_spec, out_rep = _spec_view(tree_out[name])
+        rows.append({
+            "path": f"{prefix}/{name}", "category": category,
+            "shape": tuple(getattr(v, "shape", ())),
+            "dtype": str(getattr(v, "dtype", "")),
+            "in_spec": in_spec, "in_replicated": in_rep,
+            "out_spec": out_spec, "out_replicated": out_rep,
+        })
+    return rows
+
+
+def state_leaf_table(state, compiled) -> Optional[List[Dict[str, Any]]]:
+    """Flatten the train-step state's params + optimizer accumulators
+    against the COMPILED executable's input/output shardings — the ground
+    truth of how XLA stores each leaf, independent of any annotation the
+    framework *meant* to apply."""
+    try:
+        in_state = compiled.input_shardings[0][0]
+        out_state = compiled.output_shardings[0]
+        rows = _leaf_rows(state["params"], in_state["params"],
+                          out_state["params"], "param", "params")
+        for sname in sorted(state.get("opt", ())):
+            rows += _leaf_rows(state["opt"][sname], in_state["opt"][sname],
+                               out_state["opt"][sname], "opt",
+                               f"opt/{sname}")
+        return rows
+    except Exception:
+        return None       # non-TrainStep layout: the full-gather pass skips
+
+
+# ---------------------------------------------------------------------------
+# Passes
+# ---------------------------------------------------------------------------
+
+def _diag(pass_id, message, **extra):
+    return Diagnostic(pass_id=pass_id, severity=Severity.WARNING,
+                      message=message, extra=extra)
+
+
+def _has_axis(spec: Optional[Tuple], axis: str) -> bool:
+    if spec is None:
+        return False
+    for e in spec:
+        if e == axis or (isinstance(e, (tuple, list)) and axis in e):
+            return True
+    return False
+
+
+def _itemsize(dtype: str) -> int:
+    try:
+        return np.dtype(dtype).itemsize
+    except Exception:
+        return 4
+
+
+@register_hlo_pass("hlo-full-gather", severity=Severity.ERROR,
+                   doc="ZeRO-sharded state stored replicated in the "
+                       "compiled executable (per-step full-gather + "
+                       "per-device full HBM copy)")
+def _full_gather(ctx: LintContext) -> List[Diagnostic]:
+    """The ZeRO layout contract, re-derived independently and checked
+    against the compiled layout: with ``zero>=1`` every optimizer
+    accumulator (and with ``zero>=3`` every parameter) that HAS a
+    dp-divisible dim left unsharded must carry the dp axis in the
+    executable's input AND output sharding.  A leaf that fails is stored
+    full on every device — the 'silent de-shard' that multiplies
+    per-device HBM by dp and inserts a full all-gather every step."""
+    out: List[Diagnostic] = []
+    table = ctx.extra.get("state_leaves") or ()
+    dp = int(ctx.extra.get("dp_degree") or 0)
+    zero = int(ctx.extra.get("zero") or 0)
+    stats: Optional[HloProgramStats] = ctx.extra.get("stats")
+    if dp <= 1 or zero < 1:
+        return out
+    for leaf in table:
+        if leaf["category"] == "opt":
+            must = zero >= 1
+        else:
+            must = zero >= 3
+        shape = leaf["shape"]
+        if not must or not shape or int(np.prod(shape)) < dp:
+            continue
+        for side in ("in", "out"):
+            spec, replicated = leaf[f"{side}_spec"], \
+                leaf[f"{side}_replicated"]
+            if spec is not None and _has_axis(spec, "dp"):
+                continue              # honest ZeRO layout
+            if spec is None and not replicated:
+                continue              # opaque but sharded: benefit of doubt
+            # the leaf carries no dp shard: is there a dim the ZeRO rule
+            # COULD have sharded (free in the spec, divisible by dp)?
+            entries = tuple(spec) if spec is not None else (None,) * len(shape)
+            entries = entries + (None,) * (len(shape) - len(entries))
+            free_div = [d for d in range(len(shape))
+                        if entries[d] is None and shape[d] % dp == 0]
+            if not free_div:
+                continue              # nothing to shard: exempt
+            full = int(np.prod(shape)) * _itemsize(leaf["dtype"])
+            evidence = 0
+            if stats is not None:
+                evidence = sum(1 for op in stats.ops
+                               if op.kind == "all-gather"
+                               and op.result_bytes == full)
+            out.append(_diag(
+                "hlo-full-gather",
+                f"ZeRO-{zero} state leaf '{leaf['path']}' "
+                f"{tuple(shape)} is stored REPLICATED in the compiled "
+                f"executable ({side}put sharding {spec if spec is not None else 'opaque/replicated'}): "
+                f"dim(s) {free_div} divide the dp degree {dp} and should "
+                f"be dp-sharded — every device holds the full "
+                f"{full / 1024:.1f} KiB copy and the program full-gathers "
+                f"it each step"
+                + (f" ({evidence} all-gather op(s) of exactly this size "
+                   f"in the partitioned HLO)" if evidence else ""),
+                path=leaf["path"], shape=tuple(shape), side=side,
+                full_bytes=full, evidence_gathers=evidence))
+            break                     # one finding per leaf is enough
+    return out
+
+
+@register_hlo_pass("hlo-collective-budget", severity=Severity.WARNING,
+                   doc="collective-bound program: interconnect wire bytes "
+                       "exceed the budgeted fraction of total traffic")
+def _collective_budget(ctx: LintContext) -> List[Diagnostic]:
+    stats: Optional[HloProgramStats] = ctx.extra.get("stats")
+    if stats is None or not stats.cost.get("available"):
+        return []
+    total = float(stats.cost.get("bytes_accessed") or 0.0)
+    if total <= 0 or stats.collective_wire_bytes <= 0:
+        return []
+    frac = stats.collective_wire_bytes / total
+    budget = float(_flags.flag("hlo_audit_collective_budget"))
+    if frac <= budget:
+        return []
+    return [_diag(
+        "hlo-collective-budget",
+        f"collective-bound: ring-model wire traffic "
+        f"{stats.collective_wire_bytes / 1024:.1f} KiB/step is "
+        f"{frac:.2f}x the program's total byte traffic "
+        f"({total / 1024:.1f} KiB; budget "
+        f"FLAGS_hlo_audit_collective_budget={budget}) — the step will "
+        f"scale with the interconnect, not the chip; check the mesh "
+        f"shape / sharding mix ({stats.collective_count} collectives: "
+        f"{ {k: int(v['count']) for k, v in stats.collectives.items()} })",
+        wire_bytes=stats.collective_wire_bytes, bytes_accessed=total,
+        fraction=round(frac, 3))]
+
+
+@register_hlo_pass("hlo-memory-budget", severity=Severity.WARNING,
+                   doc="per-device peak memory exceeds the configured HBM "
+                       "budget")
+def _memory_budget(ctx: LintContext) -> List[Diagnostic]:
+    stats: Optional[HloProgramStats] = ctx.extra.get("stats")
+    if stats is None or not stats.memory.get("available"):
+        return []
+    peak = int(stats.memory.get("peak_bytes") or 0)
+    budget = float(_flags.flag("hlo_audit_hbm_gb")) * (1 << 30)
+    if peak <= budget:
+        return []
+    m = stats.memory
+    return [_diag(
+        "hlo-memory-budget",
+        f"per-device peak {peak / (1 << 30):.3f} GiB exceeds the HBM "
+        f"budget FLAGS_hlo_audit_hbm_gb="
+        f"{_flags.flag('hlo_audit_hbm_gb')} (args "
+        f"{m['argument_bytes'] / (1 << 20):.1f} MiB + outputs "
+        f"{m['output_bytes'] / (1 << 20):.1f} MiB + temps "
+        f"{m['temp_bytes'] / (1 << 20):.1f} MiB + code "
+        f"{m['code_bytes'] / (1 << 20):.1f} MiB − aliased "
+        f"{m['alias_bytes'] / (1 << 20):.1f} MiB): widen the mesh, raise "
+        f"the ZeRO stage, or enable remat",
+        peak_bytes=peak, budget_bytes=int(budget))]
+
+
+# ---------------------------------------------------------------------------
+# Emission (gauges + JSONL + warn/raise) — hlo_audit's own channel
+# ---------------------------------------------------------------------------
+
+_writer_lock = threading.Lock()
+_dir_override: List[Optional[str]] = [None]
+_writer: List[Any] = [None, None]    # [dir it was opened for, LogWriter]
+
+
+def set_audit_dir(path: Optional[str]) -> None:
+    """Route audit findings to JSONL under ``path`` (None reverts to the
+    ``hlo_audit_dir`` flag / PADDLE_TPU_HLO_AUDIT_DIR)."""
+    with _writer_lock:
+        _dir_override[0] = path
+        _get_writer()
+
+
+def _get_writer():
+    d = _dir_override[0]
+    if d is None:
+        d = _flags.flag("hlo_audit_dir") or None
+    if d != _writer[0]:
+        if _writer[1] is not None:
+            try:
+                _writer[1].close()
+            except Exception:
+                pass
+        from ...utils.monitor import LogWriter
+        _writer[0] = d
+        _writer[1] = LogWriter(logdir=d, filename_suffix=".hlo_audit") \
+            if d else None
+    return _writer[1]
+
+
+def emit(report: LintReport, mode: Optional[str] = None) -> LintReport:
+    """Publish an audit report: ``hlo_audit_*`` gauges + JSONL always;
+    HloAuditWarning in warn mode; EnforceError (PreconditionNotMet) in
+    error mode when any finding is ERROR-severity."""
+    from ...utils.monitor import stat_add
+    mode = mode or audit_mode()
+    if report:
+        stat_add("hlo_audit_findings", len(report.diagnostics))
+        for pid, n in report.counts().items():
+            stat_add("hlo_audit_" + pid.replace("-", "_"), n)
+    with _writer_lock:
+        w = _get_writer()
+    if w is not None and report:
+        for d in report.diagnostics:
+            w.add_event("hlo_audit/diagnostic", d.as_dict())
+    if not report:
+        return report
+    if mode == "error" and report.by_severity(Severity.ERROR):
+        from ...framework.enforce import PreconditionNotMetError
+        raise PreconditionNotMetError(
+            "HLO audit failed on the compiled program "
+            "(FLAGS_hlo_audit=error):\n"
+            + "\n".join("  " + str(d) for d in report.diagnostics))
+    for d in report.diagnostics:
+        warnings.warn(str(d), HloAuditWarning, stacklevel=3)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+@dataclass
+class HloAuditResult:
+    """One audit over one compiled executable."""
+
+    site: str
+    report: LintReport
+    stats: HloProgramStats
+    mesh_label: str = ""
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return self.report.n_errors == 0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"site": self.site, "mesh": self.mesh_label,
+                "ok": self.ok, "stats": self.stats.as_dict(),
+                "findings": self.report.as_dict(), **self.extra}
+
+
+def audit_compiled(compiled, *, site: str = "hlo", mesh=None, params=None,
+                   state=None, zero: int = 0, dp_degree: int = 0,
+                   suppress=(), do_emit: bool = True,
+                   mesh_label: str = "") -> HloAuditResult:
+    """Run the HLO pass family over an already-compiled executable.
+
+    ``state``/``zero``/``dp_degree`` feed the full-gather contract check
+    (pass them for train steps; a bare forward audit gets census/budget
+    checks only).  ``do_emit=False`` returns the report without gauges /
+    warnings / raising — the CLI and dryrun aggregate reports themselves.
+    """
+    stats = program_stats(compiled)
+    extra = {"stats": stats, "zero": int(zero), "dp_degree": int(dp_degree)}
+    if state is not None:
+        extra["state_leaves"] = state_leaf_table(state, compiled)
+    ctx = LintContext(site=site, kind="hlo", mesh=mesh, params=params,
+                      extra=extra)
+    report = _hlo_manager.run(ctx, suppress=suppress)
+    res = HloAuditResult(site=site, report=report, stats=stats,
+                         mesh_label=mesh_label)
+    if do_emit:
+        emit(report)
+    return res
+
+
+def _mesh_label(mesh) -> str:
+    try:
+        return "x".join(f"{a}{n}" for a, n in dict(mesh.shape).items())
+    except Exception:
+        return ""
+
+
+def audit_train_step(step, inputs, label=None, *, site: Optional[str] = None,
+                     suppress=(), do_emit: bool = True) -> HloAuditResult:
+    """AOT-lower a :class:`~...parallel.TrainStep` (no execution), compile
+    it, ledger the lowering (kind ``hlo_audit``, mesh-labeled key — the
+    ``assert_zero_steady_state_recompiles`` convention extended to audit
+    runs) and run the pass family over the executable."""
+    from ...profiler import ledger as _ledger
+    if not isinstance(inputs, (tuple, list)):
+        inputs = (inputs,)
+    label_of = _mesh_label(step.mesh)
+    site = site or f"hlo_audit:{type(step.layer).__name__}"
+    t0 = time.perf_counter()
+    compiled = step.aot_compile(inputs, label)
+    ms = (time.perf_counter() - t0) * 1e3
+
+    def sig(x):
+        if x is None:
+            return "none"
+        return (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "")))
+
+    key = (("arg:mesh", label_of),
+           ("arg:zero", int(step.zero)),
+           ("arg:devices", int(np.prod(list(dict(step.mesh.shape).values())))),
+           tuple(sig(x) for x in inputs) + (sig(label),))
+    _ledger.record_compile(site, "hlo_audit", key, ms)
+    dp = int(dict(step.mesh.shape).get("dp", 1))
+    return audit_compiled(
+        compiled, site=site, mesh=step.mesh, params=step.state["params"],
+        state=step.state, zero=step.zero, dp_degree=dp,
+        suppress=suppress, do_emit=do_emit, mesh_label=label_of)
+
+
+def audit_compile_events() -> List[dict]:
+    """Ledger events recorded for audit lowerings (kind ``hlo_audit``) —
+    the cross-link that lets steady-state-recompile checks cover audit
+    runs: every wide-mesh lowering appears here exactly once, keyed with
+    its ``arg:mesh`` label."""
+    from ...profiler import ledger as _ledger
+    return [e for e in _ledger.compile_events()
+            if e.get("kind") == "hlo_audit"]

@@ -1,0 +1,229 @@
+"""Compiled-program extraction: what XLA *actually built* for a step.
+
+Everything upstream of here (graph lint, PR 5) inspects the traced jaxpr —
+the program the user *wrote*.  This module inspects the program XLA
+*compiled*: the post-SPMD-partitioning HLO module of a
+``jax.stages.Compiled``, which is where de-sharding, full-gathers of ZeRO
+parameters and collective blow-ups become visible (GSPMD inserts the
+collectives during partitioning; none of them exist in the jaxpr).
+
+Three extraction surfaces, all read-only and hardware-free (they work on
+an abstract CPU lowering exactly as on a real TPU executable):
+
+  * :func:`parse_collectives` / :func:`collective_census` — walk the
+    optimized HLO text and count collective ops per kind with per-device
+    result bytes and a ring-model wire-byte estimate;
+  * :func:`extract_cost` — XLA's own op-level FLOP/byte accounting
+    (``compiled.cost_analysis()``, the operators/benchmark/op_tester.cc
+    seat) — per-device numbers for an SPMD module;
+  * :func:`extract_memory` — per-device argument/output/temp/code sizes
+    from ``compiled.memory_analysis()`` (the HBM budget a pod job must
+    fit).
+
+:func:`program_stats` bundles all three into one :class:`HloProgramStats`
+record — the data the audit passes (audit.py) and the wide-mesh scaling
+table (dryrun phase 5 / tools/hlo_audit.py) consume.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "CollectiveOp", "HloProgramStats", "COLLECTIVE_KINDS",
+    "parse_collectives", "collective_census", "extract_cost",
+    "extract_memory", "program_stats", "hlo_text",
+]
+
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute",
+                    "collective-broadcast")
+
+# bytes per element of an HLO primitive type (token/opaque fall back to 0)
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "tf32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+}
+
+# one collective definition line of an optimized HLO module, e.g.
+#   %ar = f32[64,64]{1,0} all-reduce(...), replica_groups=[4,2]<=[8], ...
+#   %ag = (f32[8,8]{1,0}, f32[]) all-gather-start(...)
+# the (?!-done) keeps the async completion marker from double-counting the
+# -start that already carries the shape and groups
+_OP_RE = re.compile(
+    r"=\s*(?P<result>\([^)]*\)|[a-z][a-z0-9]*\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?:-start)?\(")
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+# iota v2 form: replica_groups=[G,S]<=[...] — G groups of S devices
+_GROUPS_V2_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+# literal v1 form: replica_groups={{0,1},{2,3}} — size of the first group
+_GROUPS_V1_RE = re.compile(r"replica_groups=\{\{([0-9, ]*)\}")
+
+
+@dataclass
+class CollectiveOp:
+    """One collective in the partitioned module.  ``result_bytes`` is the
+    PER-DEVICE result size (the partitioned module is the per-device
+    program); ``wire_bytes`` is a ring-algorithm estimate of bytes each
+    device moves over the interconnect for this op."""
+
+    kind: str
+    result_bytes: int
+    group_size: int
+    wire_bytes: float
+
+
+def _shape_bytes(result: str) -> int:
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(result):
+        n = 1
+        for d in dims.split(","):
+            if d.strip():
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dt, 0)
+    return total
+
+
+def _wire_factor(kind: str, s: int) -> float:
+    """Ring-model interconnect bytes per device, as a multiple of the
+    per-device RESULT bytes, for a group of ``s`` devices."""
+    if s <= 1:
+        return 0.0
+    if kind == "all-reduce":            # reduce-scatter + all-gather phases
+        return 2.0 * (s - 1) / s
+    if kind in ("all-gather", "all-to-all", "collective-broadcast"):
+        return (s - 1) / s              # result is the full gathered tensor
+    if kind == "reduce-scatter":        # result is one shard of the input
+        return float(s - 1)
+    return 1.0                          # collective-permute: one hop
+
+
+def parse_collectives(text: str) -> List[CollectiveOp]:
+    """Every collective op of an optimized HLO module text (one entry per
+    ``-start`` or sync op; ``-done`` markers carry no shape and are not
+    matched)."""
+    out: List[CollectiveOp] = []
+    for line in text.splitlines():
+        m = _OP_RE.search(line)
+        if m is None:
+            continue
+        kind = m.group("kind")
+        nbytes = _shape_bytes(m.group("result"))
+        g2 = _GROUPS_V2_RE.search(line)
+        if g2 is not None:
+            size = int(g2.group(2))
+        else:
+            g1 = _GROUPS_V1_RE.search(line)
+            size = (len([x for x in g1.group(1).split(",") if x.strip()])
+                    if g1 is not None else 2)
+        out.append(CollectiveOp(kind=kind, result_bytes=nbytes,
+                                group_size=max(1, size),
+                                wire_bytes=nbytes * _wire_factor(kind,
+                                                                 size)))
+    return out
+
+
+def collective_census(ops: List[CollectiveOp]) -> Dict[str, Dict[str, float]]:
+    """Per-kind {count, result_bytes, wire_bytes} over a parsed op list."""
+    census: Dict[str, Dict[str, float]] = {}
+    for op in ops:
+        row = census.setdefault(op.kind, {"count": 0, "result_bytes": 0,
+                                          "wire_bytes": 0.0})
+        row["count"] += 1
+        row["result_bytes"] += op.result_bytes
+        row["wire_bytes"] += op.wire_bytes
+    for row in census.values():
+        row["wire_bytes"] = round(row["wire_bytes"], 1)
+    return census
+
+
+def hlo_text(compiled) -> Optional[str]:
+    """Optimized (post-SPMD) HLO text of a ``jax.stages.Compiled``."""
+    try:
+        return compiled.as_text()
+    except Exception:
+        return None
+
+
+def extract_cost(compiled) -> Dict[str, Any]:
+    """XLA cost analysis as a plain dict: per-device ``flops`` and
+    ``bytes_accessed`` (algorithmic pre-fusion traffic — an upper bound on
+    HBM bytes, see PERF.md round-5), plus availability."""
+    try:
+        c = compiled.cost_analysis()
+        if isinstance(c, (list, tuple)):
+            c = c[0]
+        return {"available": True,
+                "flops": float(c.get("flops", 0.0)),
+                "bytes_accessed": float(c.get("bytes accessed", 0.0))}
+    except Exception:
+        return {"available": False, "flops": 0.0, "bytes_accessed": 0.0}
+
+
+def extract_memory(compiled) -> Dict[str, Any]:
+    """Per-device memory analysis: argument/output/temp/generated-code
+    bytes and a peak estimate (args + outputs + temps + code − aliased),
+    from ``compiled.memory_analysis()``."""
+    try:
+        m = compiled.memory_analysis()
+        arg = int(m.argument_size_in_bytes)
+        out = int(m.output_size_in_bytes)
+        tmp = int(m.temp_size_in_bytes)
+        code = int(m.generated_code_size_in_bytes)
+        alias = int(m.alias_size_in_bytes)
+        return {"available": True, "argument_bytes": arg,
+                "output_bytes": out, "temp_bytes": tmp,
+                "code_bytes": code, "alias_bytes": alias,
+                "peak_bytes": max(0, arg + out + tmp + code - alias)}
+    except Exception:
+        return {"available": False, "argument_bytes": 0, "output_bytes": 0,
+                "temp_bytes": 0, "code_bytes": 0, "alias_bytes": 0,
+                "peak_bytes": 0}
+
+
+@dataclass
+class HloProgramStats:
+    """Everything the audit extracts from one compiled step (per-device
+    numbers throughout — the SPMD module is the per-device program)."""
+
+    collectives: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    collective_count: int = 0
+    collective_result_bytes: int = 0
+    collective_wire_bytes: float = 0.0
+    cost: Dict[str, Any] = field(default_factory=dict)
+    memory: Dict[str, Any] = field(default_factory=dict)
+    ops: List[CollectiveOp] = field(default_factory=list)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "collectives": self.collectives,
+            "collective_count": self.collective_count,
+            "collective_result_bytes": self.collective_result_bytes,
+            "collective_wire_bytes": round(self.collective_wire_bytes, 1),
+            "flops": self.cost.get("flops", 0.0),
+            "bytes_accessed": self.cost.get("bytes_accessed", 0.0),
+            "memory": {k: v for k, v in self.memory.items()
+                       if k != "available"},
+        }
+
+
+def program_stats(compiled) -> HloProgramStats:
+    """One-stop extraction over a compiled executable: collective census
+    from the partitioned HLO text + cost analysis + memory analysis."""
+    text = hlo_text(compiled) or ""
+    ops = parse_collectives(text)
+    census = collective_census(ops)
+    return HloProgramStats(
+        collectives=census,
+        collective_count=sum(int(r["count"]) for r in census.values()),
+        collective_result_bytes=sum(int(r["result_bytes"])
+                                    for r in census.values()),
+        collective_wire_bytes=sum(float(r["wire_bytes"])
+                                  for r in census.values()),
+        cost=extract_cost(compiled),
+        memory=extract_memory(compiled),
+        ops=ops)

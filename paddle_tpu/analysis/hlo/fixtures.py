@@ -1,0 +1,79 @@
+"""Seeded negative fixtures for the HLO audit gate.
+
+A gate that has only ever passed clean code proves nothing — these
+fixtures construct programs that MUST fail the audit, so CI checks the
+detector fires, not merely that the zoo is clean (the same discipline as
+testing/faults.py: inject the failure, assert the machinery catches it).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def desharded_zero_step(mesh, *, zero: int = 1, feature: int = 128,
+                        layers: int = 2):
+    """A deliberately DE-SHARDED ZeRO train step: builds a normal
+    ``TrainStep(zero=...)`` over ``mesh``, then drops the dp sharding
+    annotation from every optimizer accumulator (and, for ``zero>=3``,
+    every parameter) — exactly what a refactor that loses the
+    ``_zero_spec`` call would do silently.  The compiled executable then
+    stores the full state on every device, and the ``hlo-full-gather``
+    pass must flag it at ERROR.
+
+    Returns ``(step, inputs, label)`` ready for
+    :func:`~.audit.audit_train_step`.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from ...parallel import TrainStep
+
+    class _Probe(nn.Layer):
+        """MLP regression net whose weight dims divide any dp degree the
+        fixture meshes use (feature=128 covers dp up to 128)."""
+
+        def __init__(self):
+            super().__init__()
+            self.blocks = nn.LayerList(
+                [nn.Linear(feature, feature) for _ in range(layers)])
+
+        def forward(self, x, y):
+            h = x
+            for blk in self.blocks:
+                h = nn.functional.relu(blk(h))
+            return ((h - y) ** 2).mean()
+
+    paddle.seed(0)
+    model = _Probe()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3)
+    step = TrainStep(model, opt, mesh=mesh, zero=zero, donate=True)
+    state = step.state                      # materialize the honest layout
+    rep = NamedSharding(step.mesh, P())
+
+    def deshard(tree_key):
+        step._shardings[tree_key] = {
+            s: {n: rep for n in acc}
+            for s, acc in step._shardings[tree_key].items()
+        } if tree_key == "opt" else {
+            n: rep for n in step._shardings[tree_key]}
+        src = state[tree_key]
+        if tree_key == "opt":
+            state[tree_key] = {
+                s: {n: jax.device_put(np.asarray(v), rep)
+                    for n, v in acc.items()}
+                for s, acc in src.items()}
+        else:
+            state[tree_key] = {n: jax.device_put(np.asarray(v), rep)
+                               for n, v in src.items()}
+
+    deshard("opt")
+    if zero >= 3:
+        deshard("params")
+
+    dp = dict(step.mesh.shape).get("dp", 1)
+    rng = np.random.RandomState(0)
+    x = rng.randn(2 * max(1, dp), feature).astype("float32")
+    y = rng.randn(2 * max(1, dp), feature).astype("float32")
+    return step, (x, y), None

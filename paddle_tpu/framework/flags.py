@@ -243,6 +243,40 @@ define_flag("graph_lint_suppress", "",
             "Comma-separated lint pass ids to skip (e.g. "
             "'layout,dead-fetch'); the scoped analysis.suppress() context "
             "manager composes with this.")
+define_flag("hlo_audit",
+            os.environ.get("PADDLE_TPU_HLO_AUDIT", "off").lower()
+            or "off",
+            "Compiled-program audit tri-state (paddle_tpu.analysis.hlo): "
+            "'off' = no audit (one Python branch per fresh TrainStep "
+            "compile, zero per step); 'warn' = AOT-relower every fresh "
+            "train-step signature, inspect the partitioned HLO "
+            "(collective census, ZeRO layout contract, per-device "
+            "memory) and emit HloAuditWarning + hlo_audit_* gauges/"
+            "JSONL; 'error' = additionally raise EnforceError BEFORE "
+            "the step executes when an ERROR-severity finding fires "
+            "(hlo-full-gather: de-sharded ZeRO state). NB: warn/error "
+            "add one extra XLA compile per fresh signature (the audit "
+            "lowers its own executable). Seeded by PADDLE_TPU_HLO_AUDIT.",
+            validator=lambda v: str(v).lower() in ("off", "warn", "error"))
+define_flag("hlo_audit_dir",
+            os.environ.get("PADDLE_TPU_HLO_AUDIT_DIR", ""),
+            "When non-empty, every HLO-audit diagnostic additionally "
+            "streams as JSONL via utils.monitor.LogWriter into this "
+            "directory (next to the recompile ledger's "
+            "PADDLE_TPU_JIT_LEDGER_DIR sink). Gauges are always "
+            "maintained.")
+define_flag("hlo_audit_hbm_gb", 16.0,
+            "Per-device HBM budget (GiB) for the hlo-memory-budget audit "
+            "pass: a compiled step whose per-device args+outputs+temps+"
+            "code exceed it is flagged. Default 16 GiB (v5e).",
+            validator=lambda v: float(v) > 0)
+define_flag("hlo_audit_collective_budget", 0.9,
+            "Collective-bound threshold for the hlo-collective-budget "
+            "audit pass: flagged when ring-model interconnect wire bytes "
+            "exceed this fraction of the program's total bytes accessed "
+            "(cost_analysis) — the step scales with the network, not the "
+            "chip.",
+            validator=lambda v: float(v) > 0)
 define_flag("graph_lint_dir",
             os.environ.get("PADDLE_TPU_GRAPH_LINT_DIR", ""),
             "When non-empty, every lint diagnostic additionally streams "

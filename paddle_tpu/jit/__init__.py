@@ -265,6 +265,36 @@ class StaticFunction:
         import inspect
         return inspect.getsource(self._function)
 
+    def aot_lowered(self, *args, **kwargs):
+        """AOT-lower this @to_static function's pure program for ``args``
+        WITHOUT dispatching it: returns ``jax.stages.Lowered`` whose
+        ``.compile()`` exposes ``cost_analysis()`` /
+        ``memory_analysis()`` / ``as_text()`` — the lowered-executable
+        access surface the HLO audit (analysis.hlo) and MFU accounting
+        build on.  Params and an rng key are bound exactly like a real
+        call (the key is consumed from the default generator, as a
+        dispatch would)."""
+        tkw = {k: v for k, v in kwargs.items() if isinstance(v, Tensor)}
+        const_kw = tuple(sorted((k, v) for k, v in kwargs.items()
+                                if k not in tkw))
+        sig = (_sig_of(args), const_kw,
+               tuple((k, _sig_of([v])) for k, v in sorted(tkw.items())))
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._concrete(args, kwargs)
+            self._cache[sig] = entry
+        prim, param_names, layer, tkw_names, t_idx, _holder = entry
+        params = dict(layer.named_parameters()) if layer else {}
+        key = random_mod.default_generator.next_key()
+
+        def uw(x):
+            return x._value if isinstance(x, Tensor) else x
+
+        ins = ([uw(args[i]) for i in t_idx]
+               + [uw(kwargs[k]) for k in tkw_names]
+               + [uw(params[n]) for n in param_names] + [key])
+        return jax.jit(prim.fn).lower(*ins)
+
     def concrete_program_specify_input_spec(self, *a, **k):
         return None
 

@@ -750,39 +750,52 @@ class TrainStep:
         )
         return self._compiled
 
-    # -- eager entry ---------------------------------------------------------
-    def __call__(self, inputs, label=None):
+    # -- AOT access (lowered-executable surface, ISSUE 8) --------------------
+    def aot_lower(self, inputs, label=None):
+        """AOT-lower the compiled sharded step for example ``inputs``
+        WITHOUT executing it.  Returns ``jax.stages.Lowered``;
+        ``.compile()`` yields the executable whose ``as_text()`` /
+        ``cost_analysis()`` / ``memory_analysis()`` the HLO audit
+        (``analysis.hlo``) inspects — abstract eval + XLA compile only,
+        so pod-width virtual meshes work with no hardware attached."""
         if not isinstance(inputs, (tuple, list)):
             inputs = (inputs,)
-        inputs = tuple(_as_array(x) for x in inputs)
-        label = None if label is None else _as_array(label)
 
+        def conv(x):
+            if x is None or isinstance(x, jax.ShapeDtypeStruct):
+                return x
+            return _as_array(x)
+
+        inputs = tuple(conv(x) for x in inputs)
+        label = conv(label)
+        # place real arrays under the same batch shardings the eager entry
+        # uses — the audited program must shard its feed exactly like the
+        # executed one (ShapeDtypeStructs pass through unplaced)
+        if inputs and not isinstance(inputs[0], jax.ShapeDtypeStruct):
+            put = self._feed_placer(inputs)
+            inputs = tuple(put(x) for x in inputs)
+            label = put(label) if not isinstance(
+                label, jax.ShapeDtypeStruct) else label
+        fn = self.compile()
+        lr = np.float32(self.optimizer.get_lr())
+        return fn.lower(self.state, inputs, label, lr, np.float32(1.0))
+
+    def aot_compile(self, inputs, label=None):
+        """``aot_lower(...).compile()`` — the compiled executable, never
+        dispatched."""
+        return self.aot_lower(inputs, label).compile()
+
+    # -- eager entry ---------------------------------------------------------
+    def _feed_placer(self, inputs):
+        """The batch-placement rule shared by the eager entry and the AOT
+        lowering path (the audited program must shard its feed exactly
+        like the executed one): returns ``put(x)`` mapping one host/global
+        array onto its mesh sharding."""
         dp = self.mesh.shape.get(DP_AXIS, 1)
         lead_ndim = inputs[0].ndim
         nproc = jax.process_count()
         local_dp = dp // nproc if (nproc > 1 and dp > 1 and
                                    dp % nproc == 0) else dp
-        if self._localsgd_degree() > 1 or self.dgc_sparsity > 0:
-            # each rank computes over its own shard, so there is no
-            # replicate fallback; a caller-built global array carries the
-            # GLOBAL batch while a host-fed array carries this process's
-            # local slice — validate each against the dp slots it covers
-            x0 = inputs[0]
-            is_global = isinstance(x0, jax.Array) and \
-                not x0.is_fully_addressable
-            need = dp if is_global else max(1, local_dp)
-            # with gradient_merge composed into the rank leg, each rank's
-            # shard further splits into accumulate_steps microbatches
-            need *= max(1, self.accumulate_steps)
-            if x0.shape[0] % need != 0:
-                raise ValueError(
-                    f"localsgd/dgc need the "
-                    f"{'global' if is_global else 'per-process'} batch "
-                    f"({x0.shape[0]}) divisible by the "
-                    f"{'dp degree' if is_global else 'local dp slots'} "
-                    f"× accumulate_steps "
-                    f"({need}; dp={dp} over {nproc} processes, "
-                    f"accumulate_steps={self.accumulate_steps})")
 
         def put(x):
             if x is None:
@@ -833,6 +846,43 @@ class TrainStep:
                     return jax.make_array_from_process_local_data(
                         sh, np.asarray(x))
             return jax.device_put(x, sh)
+
+        return put
+
+    def __call__(self, inputs, label=None):
+        if not isinstance(inputs, (tuple, list)):
+            inputs = (inputs,)
+        inputs = tuple(_as_array(x) for x in inputs)
+        label = None if label is None else _as_array(label)
+
+        dp = self.mesh.shape.get(DP_AXIS, 1)
+        lead_ndim = inputs[0].ndim
+        nproc = jax.process_count()
+        local_dp = dp // nproc if (nproc > 1 and dp > 1 and
+                                   dp % nproc == 0) else dp
+        if self._localsgd_degree() > 1 or self.dgc_sparsity > 0:
+            # each rank computes over its own shard, so there is no
+            # replicate fallback; a caller-built global array carries the
+            # GLOBAL batch while a host-fed array carries this process's
+            # local slice — validate each against the dp slots it covers
+            x0 = inputs[0]
+            is_global = isinstance(x0, jax.Array) and \
+                not x0.is_fully_addressable
+            need = dp if is_global else max(1, local_dp)
+            # with gradient_merge composed into the rank leg, each rank's
+            # shard further splits into accumulate_steps microbatches
+            need *= max(1, self.accumulate_steps)
+            if x0.shape[0] % need != 0:
+                raise ValueError(
+                    f"localsgd/dgc need the "
+                    f"{'global' if is_global else 'per-process'} batch "
+                    f"({x0.shape[0]}) divisible by the "
+                    f"{'dp degree' if is_global else 'local dp slots'} "
+                    f"× accumulate_steps "
+                    f"({need}; dp={dp} over {nproc} processes, "
+                    f"accumulate_steps={self.accumulate_steps})")
+
+        put = self._feed_placer(inputs)
 
         prof = _prof_on()
         with _span("train_step::data_feed"):
@@ -888,6 +938,17 @@ class TrainStep:
                             donate=self._donate, mesh=self.mesh,
                             params=self.state["params"],
                             partition_specs=specs)
+            from ..analysis.hlo import audit_enabled as _hlo_audit_on
+            if _hlo_audit_on():
+                # compiled-program audit (analysis.hlo): AOT-relower the
+                # exact signature about to compile and inspect the
+                # partitioned HLO (collective census, ZeRO layout
+                # contract, per-device memory) BEFORE the step executes —
+                # error mode raises with the state untouched.  Costs one
+                # extra XLA compile per fresh signature; one branch when
+                # off.
+                from ..analysis.hlo import audit_train_step
+                audit_train_step(self, inputs, label, site="hlo:" + site)
             self._seen_sigs.add(sig)
             t0 = time.perf_counter()
             with _span("train_step::compile"):

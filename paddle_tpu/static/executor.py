@@ -404,6 +404,79 @@ class Executor:
                 return [np.asarray(f) for f in picked]
         return [Tensor(f) for f in picked]
 
+    def _epoch_entry(self, program, feed_names, fetch_names):
+        """The jitted scanned-epoch function for ``program`` — one per
+        (program, feed/fetch set): later calls (and later EPOCHS through
+        them) hit jax.jit's executable cache instead of retracing +
+        recompiling the epoch program every time.  Keyed like exe.run's
+        compile cache (program _uid + _version: rewrite passes bump
+        _version, compiler.py:110); FIFO-bounded so a long-lived Executor
+        over many programs cannot grow unboundedly.  Returns
+        ``(jitted_epoch_fn, persist_names)``."""
+        persist_names = self._persistable_names(program)
+        ck = (program._uid, program._version,
+              tuple(op.type for op in program.global_block().ops),
+              tuple(feed_names), tuple(fetch_names), tuple(persist_names))
+        cached = self._epoch_fn_cache.get(ck)
+        if cached is None and len(self._epoch_fn_cache) >= 8:
+            self._epoch_fn_cache.pop(next(iter(self._epoch_fn_cache)))
+        if cached is None:
+            written = [n for n in persist_names
+                       if any(n in op.output_names
+                              for op in program.global_block().ops)]
+            replay = self._build_replay(program, feed_names, fetch_names,
+                                        persist_names, written)
+            w_pos = [persist_names.index(n) for n in written]
+
+            def epoch_fn(persist_vals, feed_stacks, mask):
+                def step(carry, xs):
+                    feeds, m = xs[:-1], xs[-1]
+                    fetches, updates = replay(list(feeds), list(carry))
+                    carry = list(carry)
+                    for p, u in zip(w_pos, updates):
+                        # masked tail steps keep the carry (padding must
+                        # not apply optimizer updates)
+                        carry[p] = jnp.where(m, u, carry[p])
+                    return tuple(carry), fetches
+                return jax.lax.scan(step, tuple(persist_vals),
+                                    (*feed_stacks, mask))
+
+            cached = (jax.jit(epoch_fn), program)
+            self._epoch_fn_cache[ck] = cached
+        return cached[0], persist_names
+
+    def epoch_executable(self, program=None, dataset=None, fetch_list=None,
+                         scope=None, chunk_steps=256):
+        """AOT-lower the scanned epoch program for ``dataset`` and return
+        the compiled executable WITHOUT running the epoch — the
+        lowered-executable access surface for the dataset-training engine
+        (the HLO audit and tools/mfu_audit.py read ``cost_analysis()`` /
+        ``memory_analysis()`` / ``as_text()`` off it; the hand-maintained
+        FLOP models this replaces could silently drift from the program).
+
+        ``dataset`` must be a dict of pre-stacked arrays
+        ``{var_name: [steps, ...]}`` (the bench/mfu shape); at most
+        ``chunk_steps`` leading steps are lowered.
+        """
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        if not isinstance(dataset, dict) or not dataset:
+            raise TypeError("epoch_executable needs a dict of pre-stacked "
+                            "arrays {var_name: [steps, ...]}")
+        feed_names = sorted(dataset)
+        fetch_list = fetch_list or []
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+        jitted, persist_names = self._epoch_entry(program, feed_names,
+                                                  fetch_names)
+        k = min(int(chunk_steps),
+                len(next(iter(dataset.values()))))
+        feeds = tuple(jnp.asarray(dataset[n][:k]) for n in feed_names)
+        mask = jnp.ones((k,), bool)
+        persist_vals = tuple(_collect_persistables(program, scope,
+                                                   persist_names))
+        return jitted.lower(persist_vals, feeds, mask).compile()
+
     # -- dataset-driven training (Trainer/DeviceWorker runtime) -------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -489,43 +562,8 @@ class Executor:
             raise ValueError("train_from_dataset: empty dataset")
         feed_names = sorted(first)
 
-        persist_names = self._persistable_names(program)
-        # one jitted scan per (program, feed/fetch set): later calls (and
-        # later EPOCHS through them) hit jax.jit's executable cache instead
-        # of retracing + recompiling the epoch program every time.  Keyed
-        # like exe.run's compile cache (program _uid + _version: rewrite
-        # passes bump _version, compiler.py:110); FIFO-bounded so a
-        # long-lived Executor over many programs cannot grow unboundedly.
-        ck = (program._uid, program._version,
-              tuple(op.type for op in program.global_block().ops),
-              tuple(feed_names), tuple(fetch_names), tuple(persist_names))
-        cached = self._epoch_fn_cache.get(ck)
-        if cached is None and len(self._epoch_fn_cache) >= 8:
-            self._epoch_fn_cache.pop(next(iter(self._epoch_fn_cache)))
-        if cached is None:
-            written = [n for n in persist_names
-                       if any(n in op.output_names
-                              for op in program.global_block().ops)]
-            replay = self._build_replay(program, feed_names, fetch_names,
-                                        persist_names, written)
-            w_pos = [persist_names.index(n) for n in written]
-
-            def epoch_fn(persist_vals, feed_stacks, mask):
-                def step(carry, xs):
-                    feeds, m = xs[:-1], xs[-1]
-                    fetches, updates = replay(list(feeds), list(carry))
-                    carry = list(carry)
-                    for p, u in zip(w_pos, updates):
-                        # masked tail steps keep the carry (padding must
-                        # not apply optimizer updates)
-                        carry[p] = jnp.where(m, u, carry[p])
-                    return tuple(carry), fetches
-                return jax.lax.scan(step, tuple(persist_vals),
-                                    (*feed_stacks, mask))
-
-            cached = (jax.jit(epoch_fn), program)
-            self._epoch_fn_cache[ck] = cached
-        jitted = cached[0]
+        jitted, persist_names = self._epoch_entry(program, feed_names,
+                                                  fetch_names)
 
         def upload(chunk):
             """Pad to a stable bucket, ship to device (async H2D)."""

@@ -1,0 +1,502 @@
+"""Compiled-program audit tests (paddle_tpu.analysis.hlo, ISSUE 8).
+
+HLO-text extraction (collective census, wire-byte model), cost/memory
+extraction, the ZeRO full-gather gate (seeded de-sharded fixture at ERROR
++ honest control clean), budget passes, emission/gating/suppression
+through the shared PassManager machinery, the TrainStep runtime wiring
+(FLAGS_hlo_audit error mode raises BEFORE execution with state
+untouched), the lowered-executable access satellites (TrainStep.aot_*,
+StaticFunction.aot_lowered, Executor.epoch_executable), the mesh-labeled
+hlo_audit ledger cross-link, flag registration/snapshot coverage, and the
+tools/hlo_audit.py CLI in-process.  Wide-mesh (16+ virtual device)
+subprocess smokes live in test_hlo_audit_smoke.py (slow-marked).
+"""
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import analysis
+from paddle_tpu.analysis import Severity, suppress
+from paddle_tpu.analysis import hlo
+from paddle_tpu.analysis.hlo import (HloAuditWarning, audit_compile_events,
+                                     audit_train_step, collective_census,
+                                     desharded_zero_step, extract_cost,
+                                     extract_memory, parse_collectives,
+                                     program_stats)
+from paddle_tpu.framework.enforce import EnforceNotMet
+from paddle_tpu.framework.flags import (define_flag, flags_restore,
+                                        flags_snapshot, set_flags)
+from paddle_tpu.parallel import TrainStep
+from paddle_tpu.parallel.mesh import make_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def flags_guard():
+    snap = flags_snapshot()
+    yield
+    flags_restore(snap)
+
+
+class _Probe(nn.Layer):
+    """MLP whose weight dims divide every dp degree the tests use."""
+
+    def __init__(self, feature=128, layers=2):
+        super().__init__()
+        self.blocks = nn.LayerList(
+            [nn.Linear(feature, feature) for _ in range(layers)])
+
+    def forward(self, x, y):
+        h = x
+        for blk in self.blocks:
+            h = nn.functional.relu(blk(h))
+        return ((h - y) ** 2).mean()
+
+
+def _probe_step(mesh, zero=1):
+    paddle.seed(0)
+    model = _Probe()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3)
+    step = TrainStep(model, opt, mesh=mesh, zero=zero, donate=True)
+    dp = dict(mesh.shape).get("dp", 1)
+    rng = np.random.RandomState(0)
+    x = rng.randn(2 * dp, 128).astype("float32")
+    y = rng.randn(2 * dp, 128).astype("float32")
+    return step, (x, y), None
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh({"dp": 4, "mp": 2}, devices=jax.devices()[:8])
+
+
+@pytest.fixture(scope="module")
+def clean_audit(mesh8):
+    step, inputs, label = _probe_step(mesh8, zero=1)
+    return audit_train_step(step, inputs, label,
+                            site="hlo_audit:test_clean", do_emit=False)
+
+
+@pytest.fixture(scope="module")
+def bad_audit(mesh8):
+    step, inputs, label = desharded_zero_step(mesh8, zero=1)
+    return audit_train_step(step, inputs, label,
+                            site="hlo_audit:test_bad", do_emit=False)
+
+
+# ---------------------------------------------------------------------------
+# HLO-text extraction
+# ---------------------------------------------------------------------------
+
+SYNTH_HLO = """
+HloModule jit_step, num_partitions=8
+%ar = f32[64,128]{1,0} all-reduce(f32[64,128]{1,0} %p0), channel_id=1, replica_groups=[2,4]<=[8], use_global_device_ids=true, to_apply=%add
+%ag = f32[64,64]{1,0} all-gather(f32[16,64]{1,0} %p1), channel_id=2, replica_groups=[2,4]<=[4,2]T(1,0), dimensions={0}
+%rs = f32[8,64]{1,0} reduce-scatter(f32[32,64]{1,0} %p2), channel_id=3, replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}, to_apply=%add
+%a2a = bf16[16,16]{1,0} all-to-all(bf16[16,16]{1,0} %p3), channel_id=4, replica_groups=[4,2]<=[8]
+%cp = f32[4,4]{1,0} collective-permute(f32[4,4]{1,0} %p4), channel_id=5, source_target_pairs={{0,1},{1,0}}
+%ars = (f32[8,8]{1,0}, f32[]) all-reduce-start(f32[8,8]{1,0} %p5), channel_id=6, replica_groups=[4,2]<=[8], to_apply=%add
+%ard = f32[8,8]{1,0} all-reduce-done(f32[8,8]{1,0} %ars)
+%not_a_collective = f32[8,8]{1,0} add(f32[8,8]{1,0} %x, f32[8,8]{1,0} %y)
+"""
+
+
+def test_parse_collectives_synthetic():
+    ops = parse_collectives(SYNTH_HLO)
+    kinds = [op.kind for op in ops]
+    # -done must NOT double-count the -start
+    assert kinds == ["all-reduce", "all-gather", "reduce-scatter",
+                     "all-to-all", "collective-permute", "all-reduce"]
+    ar, ag, rs, a2a, cp, ars = ops
+    assert ar.result_bytes == 64 * 128 * 4 and ar.group_size == 4
+    assert ar.wire_bytes == pytest.approx(ar.result_bytes * 2 * 3 / 4)
+    assert ag.result_bytes == 64 * 64 * 4
+    assert ag.wire_bytes == pytest.approx(ag.result_bytes * 3 / 4)
+    # v1 literal replica_groups: size of the first group
+    assert rs.group_size == 4
+    assert rs.wire_bytes == pytest.approx(rs.result_bytes * 3)
+    assert a2a.result_bytes == 16 * 16 * 2          # bf16
+    assert cp.wire_bytes == cp.result_bytes         # one hop
+    # tuple-result async start counts the full tuple payload
+    assert ars.result_bytes == 8 * 8 * 4 + 4
+
+
+def test_collective_census_totals():
+    census = collective_census(parse_collectives(SYNTH_HLO))
+    assert census["all-reduce"]["count"] == 2
+    assert set(census) == {"all-reduce", "all-gather", "reduce-scatter",
+                           "all-to-all", "collective-permute"}
+    assert all(row["wire_bytes"] > 0 for row in census.values())
+
+
+def test_program_stats_on_compiled(clean_audit):
+    stats = clean_audit.stats
+    assert stats.collective_count > 0
+    assert "all-reduce" in stats.collectives
+    assert stats.cost["available"] and stats.cost["flops"] > 0
+    assert stats.memory["available"] and stats.memory["peak_bytes"] > 0
+    d = stats.as_dict()
+    assert d["collective_wire_bytes"] > 0 and "memory" in d
+
+
+def test_extract_on_plain_jit():
+    comp = jax.jit(lambda a, b: a @ b).lower(
+        jax.ShapeDtypeStruct((8, 16), np.float32),
+        jax.ShapeDtypeStruct((16, 4), np.float32)).compile()
+    assert extract_cost(comp)["flops"] > 0
+    assert extract_memory(comp)["argument_bytes"] > 0
+    res = hlo.audit_compiled(comp, site="plain", do_emit=False)
+    assert res.ok and res.stats.collective_count == 0
+
+
+# ---------------------------------------------------------------------------
+# The full-gather gate: seeded de-shard at ERROR, honest control clean
+# ---------------------------------------------------------------------------
+
+def test_clean_zero1_step_passes(clean_audit):
+    assert clean_audit.ok
+    assert len(clean_audit.report) == 0
+
+
+def test_seeded_desharded_zero_flagged_error(bad_audit):
+    errs = bad_audit.report.by_severity(Severity.ERROR)
+    assert errs and not bad_audit.ok
+    assert all(d.pass_id == "hlo-full-gather" for d in errs)
+    # one finding per de-sharded accumulator leaf (2 moments x 2 layers
+    # x weight+bias), each naming its path and the shardable dim
+    paths = {d.extra["path"] for d in errs}
+    assert any(p.startswith("opt/moment1/") for p in paths)
+    assert any(p.startswith("opt/moment2/") for p in paths)
+    d0 = errs[0]
+    assert d0.extra["full_bytes"] > 0
+    assert "dp degree 4" in d0.message
+
+
+def test_seeded_zero3_flags_params(mesh8):
+    step, inputs, label = desharded_zero_step(mesh8, zero=3, layers=1)
+    res = audit_train_step(step, inputs, label,
+                           site="hlo_audit:test_z3", do_emit=False)
+    paths = {d.extra["path"]
+             for d in res.report.by_severity(Severity.ERROR)}
+    assert any(p.startswith("params/") for p in paths), paths
+
+
+def test_state_leaf_table_shapes(clean_audit, mesh8):
+    # the honest layout: every dp-shardable opt leaf carries dp somewhere
+    step, inputs, label = _probe_step(mesh8, zero=1)
+    compiled = step.aot_compile(inputs, label)
+    table = hlo.state_leaf_table(step.state, compiled)
+    opt_rows = [r for r in table if r["category"] == "opt"]
+    assert opt_rows
+    for r in opt_rows:
+        has_dp = any(e == "dp" or (isinstance(e, (tuple, list))
+                                   and "dp" in e)
+                     for e in (r["in_spec"] or ()))
+        assert has_dp, r
+
+
+# ---------------------------------------------------------------------------
+# Budget passes
+# ---------------------------------------------------------------------------
+
+def _rerun_passes(stats, **extra):
+    from paddle_tpu.analysis.manager import LintContext
+    ctx = LintContext(site="t", kind="hlo",
+                      extra={"stats": stats, **extra})
+    return hlo.hlo_pass_manager().run(ctx)
+
+
+def test_collective_budget_pass(flags_guard, clean_audit):
+    assert not _rerun_passes(clean_audit.stats)      # default: clean
+    set_flags({"FLAGS_hlo_audit_collective_budget": 1e-9})
+    report = _rerun_passes(clean_audit.stats)
+    diags = [d for d in report if d.pass_id == "hlo-collective-budget"]
+    assert len(diags) == 1 and diags[0].severity == Severity.WARNING
+    assert diags[0].extra["fraction"] > 0
+
+
+def test_memory_budget_pass(flags_guard, clean_audit):
+    set_flags({"FLAGS_hlo_audit_hbm_gb": 1e-7})
+    report = _rerun_passes(clean_audit.stats)
+    diags = [d for d in report if d.pass_id == "hlo-memory-budget"]
+    assert len(diags) == 1
+    assert diags[0].extra["peak_bytes"] > diags[0].extra["budget_bytes"]
+
+
+def test_suppression_via_shared_machinery(flags_guard, bad_audit, mesh8):
+    # the PR-5 scoped suppress() context governs hlo pass ids too
+    step, inputs, label = desharded_zero_step(mesh8, zero=1)
+    with suppress("hlo-full-gather"):
+        res = audit_train_step(step, inputs, label,
+                               site="hlo_audit:test_sup", do_emit=False)
+    assert res.ok
+    # and the flag-level suppression list
+    set_flags({"FLAGS_graph_lint_suppress": "hlo-full-gather"})
+    res2 = audit_train_step(step, inputs, label,
+                            site="hlo_audit:test_sup2", do_emit=False)
+    assert res2.ok
+
+
+def test_severity_override(bad_audit):
+    mgr = hlo.hlo_pass_manager()
+    mgr.set_severity("hlo-full-gather", Severity.WARNING)
+    try:
+        report = _rerun_passes(
+            bad_audit.stats,
+            state_leaves=[{"path": "opt/m/w", "category": "opt",
+                           "shape": (128,), "dtype": "float32",
+                           "in_spec": (), "in_replicated": True,
+                           "out_spec": (), "out_replicated": True}],
+            dp_degree=4, zero=1)
+        diags = [d for d in report if d.pass_id == "hlo-full-gather"]
+        assert diags and diags[0].severity == Severity.WARNING
+    finally:
+        mgr.set_severity("hlo-full-gather", Severity.ERROR)
+
+
+# ---------------------------------------------------------------------------
+# Emission: modes, gauges, JSONL
+# ---------------------------------------------------------------------------
+
+def _error_report():
+    from paddle_tpu.analysis.diagnostics import Diagnostic, LintReport
+    r = LintReport(site="t", kind="hlo")
+    r.extend([Diagnostic(pass_id="hlo-full-gather",
+                         severity=Severity.ERROR, message="seeded")])
+    return r
+
+
+def test_emit_warn_mode_warns(flags_guard):
+    set_flags({"FLAGS_hlo_audit": "warn"})
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        hlo.emit(_error_report())
+    assert any(issubclass(x.category, HloAuditWarning) for x in w)
+
+
+def test_emit_error_mode_raises(flags_guard):
+    set_flags({"FLAGS_hlo_audit": "error"})
+    with pytest.raises(EnforceNotMet, match="hlo-full-gather"):
+        hlo.emit(_error_report())
+
+
+def test_emit_gauges_and_jsonl(flags_guard, tmp_path):
+    from paddle_tpu.utils.monitor import reset_stats, stat_get
+    reset_stats("hlo_audit")
+    set_flags({"FLAGS_hlo_audit": "warn"})
+    hlo.set_audit_dir(str(tmp_path))
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            hlo.emit(_error_report())
+        assert stat_get("hlo_audit_findings") == 1
+        assert stat_get("hlo_audit_hlo_full_gather") == 1
+    finally:
+        hlo.set_audit_dir(None)
+    files = [f for f in os.listdir(tmp_path) if "hlo_audit" in f]
+    assert files
+    body = open(os.path.join(tmp_path, files[0])).read()
+    assert "hlo-full-gather" in body
+
+
+def test_mode_default_off():
+    assert hlo.audit_mode() == "off"
+    assert not hlo.audit_enabled()
+
+
+# ---------------------------------------------------------------------------
+# Runtime wiring (TrainStep fresh-compile path)
+# ---------------------------------------------------------------------------
+
+def test_runtime_error_mode_blocks_desharded_step(flags_guard, mesh8):
+    """The pod-incident-to-CI-failure contract: a de-sharded ZeRO step
+    raises at compile time, BEFORE the first step executes."""
+    set_flags({"FLAGS_hlo_audit": "error"})
+    step, inputs, label = desharded_zero_step(mesh8, zero=1)
+    with pytest.raises(EnforceNotMet, match="hlo-full-gather"):
+        step(inputs, label)
+    assert int(np.asarray(step.state["step"])) == 0   # never executed
+
+
+def test_runtime_warn_mode_audits_and_ledgers(flags_guard, mesh8):
+    set_flags({"FLAGS_hlo_audit": "warn"})
+    step, inputs, label = _probe_step(mesh8, zero=1)
+    before = len([e for e in audit_compile_events()
+                  if e["site"].startswith("hlo:train_step")])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        loss = step(inputs, label)
+    assert np.isfinite(float(loss))
+    events = [e for e in audit_compile_events()
+              if e["site"].startswith("hlo:train_step")]
+    assert len(events) == before + 1
+    assert "arg:mesh" in events[-1]["key"]
+    # steady state: the cached signature path never re-audits
+    step(inputs, label)
+    assert len([e for e in audit_compile_events()
+                if e["site"].startswith("hlo:train_step")]) == before + 1
+
+
+def test_ledger_cross_link_mesh_label(clean_audit):
+    events = [e for e in audit_compile_events()
+              if e["site"] == "hlo_audit:test_clean"]
+    assert len(events) == 1
+    assert e_has_mesh(events[0])
+
+
+def e_has_mesh(ev):
+    return "arg:mesh" in ev["key"] and "dp4" in ev["key"]
+
+
+# ---------------------------------------------------------------------------
+# Lowered-executable access satellites
+# ---------------------------------------------------------------------------
+
+def test_trainstep_aot_lower_no_execution(mesh8):
+    step, inputs, label = _probe_step(mesh8, zero=1)
+    lowered = step.aot_lower(inputs, label)
+    comp = lowered.compile()
+    assert extract_cost(comp)["flops"] > 0
+    assert int(np.asarray(step.state["step"])) == 0   # nothing dispatched
+
+
+def test_jit_aot_lowered():
+    from paddle_tpu.jit import to_static
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 8)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = to_static(M())
+    x = paddle.to_tensor(np.random.randn(4, 16).astype("float32"))
+    comp = m.forward.aot_lowered(x).compile()
+    assert extract_cost(comp)["flops"] > 0
+    # a real call still works and reuses the concrete cache
+    out = m(x)
+    assert tuple(out.shape) == (4, 8)
+
+
+def test_executor_epoch_executable():
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            img = static.data("img", [None, 16], "float32")
+            label = static.data("label", [None], "int64")
+            h = static.nn.fc(img, 8, activation="relu")
+            logits = static.nn.fc(h, 4)
+            loss = paddle.nn.functional.cross_entropy(logits, label)
+            paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        stacks = {"img": rng.randn(5, 4, 16).astype("float32"),
+                  "label": rng.randint(0, 4, (5, 4)).astype("int64")}
+        comp = exe.epoch_executable(main, dataset=stacks,
+                                    fetch_list=[loss])
+        assert extract_cost(comp)["flops"] > 0
+        with pytest.raises(TypeError):
+            exe.epoch_executable(main, dataset=[{"img": None}])
+    finally:
+        paddle.disable_static()
+
+
+# ---------------------------------------------------------------------------
+# Flags satellite: registration, validators, snapshot/restore
+# ---------------------------------------------------------------------------
+
+def test_flag_idempotent_reregistration():
+    # same default: no-op (module reload contract)
+    define_flag("hlo_audit", "off")
+    define_flag("hlo_audit_hbm_gb", 16.0)
+    # different default: loud failure
+    with pytest.raises(ValueError, match="already registered"):
+        define_flag("hlo_audit", "warn")
+    with pytest.raises(ValueError, match="already registered"):
+        define_flag("hlo_audit_hbm_gb", 32.0)
+
+
+def test_flag_validators(flags_guard):
+    with pytest.raises(ValueError):
+        set_flags({"FLAGS_hlo_audit": "loud"})
+    with pytest.raises(ValueError):
+        set_flags({"FLAGS_hlo_audit_hbm_gb": -1.0})
+    with pytest.raises(ValueError):
+        set_flags({"FLAGS_hlo_audit_collective_budget": 0.0})
+    set_flags({"FLAGS_hlo_audit": "warn",
+               "FLAGS_hlo_audit_hbm_gb": 8.0})
+    assert hlo.audit_mode() == "warn"
+
+
+def test_flag_snapshot_restore():
+    from paddle_tpu.framework.flags import flag
+    snap = flags_snapshot()
+    set_flags({"FLAGS_hlo_audit": "error",
+               "FLAGS_hlo_audit_collective_budget": 0.5,
+               "FLAGS_hlo_audit_dir": "/tmp/x"})
+    assert flag("hlo_audit") == "error"
+    flags_restore(snap)
+    assert flag("hlo_audit") == snap["hlo_audit"]
+    assert flag("hlo_audit_collective_budget") == \
+        snap["hlo_audit_collective_budget"]
+    assert flag("hlo_audit_dir") == snap["hlo_audit_dir"]
+
+
+# ---------------------------------------------------------------------------
+# CLI (in-process; subprocess smokes are slow-marked elsewhere)
+# ---------------------------------------------------------------------------
+
+def _cli(argv):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import hlo_audit as cli
+        return cli.main(argv)
+    finally:
+        sys.path.pop(0)
+
+
+def test_cli_single_model_clean(capsys):
+    rc = _cli(["--model", "lenet", "--mesh", "4x2", "--strict"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 error(s)" in out
+
+
+def test_cli_seeded_fails_strict(capsys):
+    rc = _cli(["--seeded", "--mesh", "4x2", "--strict", "--json"])
+    assert rc == 1
+    import json
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["n_errors"] > 0
+    bad = [r for r in payload["results"]
+           if r["model"] == "seeded_desharded_zero"]
+    assert bad and not bad[0]["ok"]
+    assert any("arg:mesh" in e["key"] for e in payload["ledger"])
+
+
+def test_cli_mesh_parse():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from hlo_audit import parse_mesh
+        assert parse_mesh("16x2") == {"dp": 16, "mp": 2}
+        assert parse_mesh("8x2x2") == {"dp": 8, "mp": 2, "sp": 2}
+        assert parse_mesh("4") == {"dp": 4}
+        with pytest.raises(ValueError):
+            parse_mesh("0x2")
+        with pytest.raises(ValueError):
+            parse_mesh("2x2x2x2")
+    finally:
+        sys.path.pop(0)

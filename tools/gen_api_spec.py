@@ -41,6 +41,7 @@ NAMESPACES = [
     ("paddle_tpu.checkpoint", None),
     ("paddle_tpu.testing", None),
     ("paddle_tpu.analysis", None),
+    ("paddle_tpu.analysis.hlo", None),
 ]
 
 
