@@ -929,6 +929,44 @@ def bench_decode(on_tpu):
     return res
 
 
+def bench_autoshard(on_tpu):
+    """Plan-time overhead of the rules-driven auto-sharding transform
+    (analysis.autoshard): propose() regex-matches the whole param pytree
+    and apply() writes the annotations — both run ONCE per TrainStep
+    state init (zero per step), so the number that matters is
+    milliseconds per plan at real model sizes.  Headline value:
+    BERT-base propose ms."""
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import autoshard
+    from paddle_tpu.text.models.bert import BertConfig, BertForPretraining
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTModel
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(0)
+    zoo = {
+        "bert_base": BertForPretraining(
+            BertConfig.base() if on_tpu else BertConfig.tiny()),
+        "gpt": GPTModel(GPTConfig() if on_tpu else GPTConfig.tiny()),
+        "resnet18": resnet18(),
+    }
+    detail = {}
+    for name, model in zoo.items():
+        n_leaves = len(list(model.named_parameters()))
+        t0 = time.perf_counter()
+        plan = autoshard.propose(model)
+        propose_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        autoshard.apply(model, plan=plan)
+        apply_ms = (time.perf_counter() - t0) * 1e3
+        detail[name] = {"leaves": n_leaves,
+                        "sharded": len(plan.sharded),
+                        "unmatched": len(plan.unmatched),
+                        "propose_ms": round(propose_ms, 2),
+                        "apply_ms": round(apply_ms, 2)}
+    return {"value": detail["bert_base"]["propose_ms"],
+            "unit": "ms/plan (bert propose)", "models": detail}
+
+
 WORKLOADS = [
     ("mnist_lenet_static", bench_lenet_static),
     ("resnet50_dygraph", bench_resnet50),
@@ -938,6 +976,7 @@ WORKLOADS = [
     ("inference", bench_inference),
     ("serving", bench_serving),
     ("decode", bench_decode),
+    ("autoshard", bench_autoshard),
 ]
 
 

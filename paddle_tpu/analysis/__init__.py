@@ -38,13 +38,14 @@ from . import passes as _passes  # noqa: F401  (registers the built-ins)
 from .passes import PASS_IDS  # noqa: F401
 from .ast_lint import lint_function_ast, run_ast_lint  # noqa: F401
 from . import hlo  # noqa: F401  (compiled-program audit subsystem)
+from . import autoshard  # noqa: F401  (rules-driven transform pass)
 
 __all__ = [
     "Severity", "Diagnostic", "LintReport", "GraphLintWarning",
     "LintContext", "PassManager", "default_pass_manager",
     "register_pass", "suppress", "set_lint_dir", "lint_mode",
     "lint_enabled", "lint_jaxpr", "lint_traced", "run_ast_lint",
-    "lint_function_ast", "PASS_IDS",
+    "lint_function_ast", "PASS_IDS", "autoshard",
 ]
 
 
@@ -64,7 +65,7 @@ def lint_traced(fn, args, *, site: str, kind: str,
                 params: Optional[Dict[str, Any]] = None,
                 partition_specs: Optional[Dict[str, Any]] = None,
                 arg_paths=None, mesh=None,
-                program_info=None) -> Optional[LintReport]:
+                program_info=None, extra=None) -> Optional[LintReport]:
     """The runtime integration point: abstract-eval ``fn(*args)`` into a
     closed jaxpr (no device execution), run the pass suite, and emit
     through the standard channel.
@@ -98,6 +99,7 @@ def lint_traced(fn, args, *, site: str, kind: str,
                       donate=donate, params=params,
                       partition_specs=partition_specs,
                       arg_paths=list(arg_paths) if arg_paths else None,
-                      mesh=mesh, program_info=program_info)
+                      mesh=mesh, program_info=program_info,
+                      extra=dict(extra) if extra else {})
     report = default_pass_manager().run(ctx)
     return emit(report)

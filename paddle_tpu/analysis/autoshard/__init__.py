@@ -1,0 +1,56 @@
+"""paddle_tpu.analysis.autoshard — rules-driven auto-sharding (ISSUE 9).
+
+The analysis family's first *transform* pass: where PR 5's
+sharding-coverage lint could only complain that a >=2-d parameter matched
+no partition rule, this package ships the rules.  An ordered
+regex-over-param-path -> PartitionSpec table (``PartitionRules``, the
+SNIPPETS.md [1] ``match_partition_rules`` discipline with [3]'s
+canonical-role layout) drives two operations:
+
+  * :func:`propose` — walk a model's param pytree and return a
+    :class:`ShardingPlan` with per-leaf rule provenance, an
+    unmatched-leaf report and hand-annotation conflicts (read-only);
+  * :func:`apply` — write the plan's specs onto the params through
+    ``parallel.api.shard_parameter`` (hand annotations always win),
+    stamped with provenance so lint can tell rule from hand.
+
+Runtime wiring (off-path = one branch on ``FLAGS_autoshard``
+off|propose|apply, env ``PADDLE_TPU_AUTOSHARD``):
+
+  * ``TrainStep.init_state`` calls :func:`maybe_autoshard` before the
+    sharding tree is built, so ``FLAGS_autoshard=apply`` shards any zoo
+    model from the active ``FLAGS_autoshard_rules`` table with zero
+    model-code changes;
+  * the ``autoshard-conflict`` lint pass (analysis.passes, ERROR) raises
+    at trace time when a rule contradicts a hand annotation; the
+    sharding-coverage pass names the rule that *would* match each
+    unannotated leaf;
+  * ``tools/autoshard.py`` — CLI: propose/apply plans for zoo models
+    over virtual meshes and verify applied plans with the PR-8 HLO
+    audit (``--strict`` exits non-zero on conflicts or audit ERRORs).
+
+The shipped tables replace hand annotation: ``text.models.bert.
+apply_tensor_parallel`` (and gpt's) now delegate here — one transformer
+table instead of per-model shard_parameter lists, verified bit-identical.
+"""
+from __future__ import annotations
+
+from .rules import (Rule, PartitionRules, active_rules,  # noqa: F401
+                    conv_rules, default_rules, embedding_rules,
+                    register_rules_table, rules_table, rules_table_names,
+                    spec_repr, transformer_rules)
+from .plan import (LeafPlan, ShardingPlan, propose,  # noqa: F401
+                   specs_equivalent)
+from .transform import (AUTOSHARD_SOURCE_ATTR, AutoshardWarning,  # noqa: F401
+                        apply, autoshard_enabled, autoshard_mode,
+                        maybe_autoshard, publish_plan)
+
+__all__ = [
+    "Rule", "PartitionRules", "transformer_rules", "conv_rules",
+    "embedding_rules", "default_rules", "rules_table",
+    "register_rules_table", "rules_table_names", "active_rules",
+    "spec_repr", "LeafPlan", "ShardingPlan", "propose",
+    "specs_equivalent", "apply", "maybe_autoshard", "autoshard_mode",
+    "autoshard_enabled", "publish_plan", "AutoshardWarning",
+    "AUTOSHARD_SOURCE_ATTR",
+]

@@ -1,0 +1,246 @@
+"""ShardingPlan: the propose() side of the auto-sharding transform.
+
+``propose`` walks a model's parameter pytree (a Layer or a plain
+``{name: array}`` dict), consults a :class:`~.rules.PartitionRules`
+table, and returns a :class:`ShardingPlan` — one :class:`LeafPlan` per
+parameter carrying the matched rule's provenance (role + table), the
+proposed spec, the *effective* spec after cleaning against the target
+mesh, any existing annotation, and whether the two conflict.  Nothing is
+mutated: propose is the inspection half; ``transform.apply`` is the
+rewrite half.
+
+Leaf discipline (the ``match_partition_rules`` contract, SNIPPETS.md [1],
+hardened):
+
+  * scalars (rank 0 or one element) never consult the rules — they
+    replicate by construction (``exempt``);
+  * 1-d leaves consult the rules (QKV biases DO shard over mp) but an
+    unmatched vector is ``exempt``, not an error — vectors replicate by
+    design;
+  * unmatched >=2-d leaves land in ``plan.unmatched`` — reported, never
+    silently defaulted (the sharding-coverage lint names them);
+  * a matched leaf with a differing HAND annotation is a ``conflict`` —
+    the hand annotation always wins, and the ``autoshard-conflict`` lint
+    pass raises it at trace time in error mode.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+from .rules import PartitionRules, Rule, spec_repr
+
+__all__ = ["LeafPlan", "ShardingPlan", "propose", "specs_equivalent"]
+
+# annotation-provenance attr stamped by transform.apply (read off Parameter
+# objects so a rule-applied spec is never mistaken for a hand one)
+AUTOSHARD_SOURCE_ATTR = "_autoshard_rule"
+
+
+def _norm_spec(spec: Optional[P], mesh=None) -> Tuple:
+    """Canonical comparable form of a spec: cleaned against ``mesh`` when
+    given (axes the mesh lacks drop — a TP annotation on a pure-DP mesh
+    is equivalent to replicated), 1-tuples collapsed, trailing Nones
+    stripped.  None (no annotation) normalizes like P() — replicated."""
+    if spec is None:
+        return ()
+    entries = list(tuple(spec))
+    if mesh is not None:
+        axes = set(getattr(mesh, "shape", {}) or {})
+        cleaned = []
+        for e in entries:
+            if isinstance(e, (tuple, list)):
+                kept = tuple(a for a in e if a in axes)
+                cleaned.append(kept if kept else None)
+            else:
+                cleaned.append(e if (e is None or e in axes) else None)
+        entries = cleaned
+    out = []
+    for e in entries:
+        if isinstance(e, (tuple, list)):
+            e = tuple(e)
+            e = e[0] if len(e) == 1 else (None if not e else e)
+        out.append(e)
+    while out and out[-1] is None:
+        out.pop()
+    return tuple(out)
+
+
+def specs_equivalent(a: Optional[P], b: Optional[P], mesh=None) -> bool:
+    """True when two specs place every dim identically (over ``mesh``
+    when given): P(None,'mp') == P(None,('mp',)) == P(None,'mp',None)."""
+    return _norm_spec(a, mesh) == _norm_spec(b, mesh)
+
+
+@dataclass
+class LeafPlan:
+    """One parameter's row of the plan."""
+
+    name: str
+    shape: Tuple[int, ...]
+    rule: Optional[str] = None          # matched rule role (provenance)
+    table: Optional[str] = None         # rules-table name
+    spec: Optional[P] = None            # the rule's proposed spec
+    existing: Optional[P] = None        # annotation already on the param
+    existing_source: Optional[str] = None  # None = hand; else autoshard role
+    status: str = "unmatched"           # matched|hand|exempt|unmatched
+    conflict: bool = False              # hand annotation != rule proposal
+
+    @property
+    def final_spec(self) -> Optional[P]:
+        """The spec the model ends up with: hand annotations win."""
+        if self.existing is not None and self.existing_source is None:
+            return self.existing
+        return self.spec if self.spec is not None else self.existing
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "shape": list(self.shape),
+                "rule": self.rule, "table": self.table,
+                "spec": spec_repr(self.spec),
+                "existing": spec_repr(self.existing),
+                "existing_source": self.existing_source,
+                "status": self.status, "conflict": self.conflict}
+
+
+class ShardingPlan:
+    """propose()'s result: per-leaf provenance plus the three reports
+    every consumer wants — sharded, unmatched, conflicts."""
+
+    def __init__(self, entries: List[LeafPlan], table: str,
+                 mesh_axes: Optional[Dict[str, int]] = None):
+        self.entries = entries
+        self.table = table
+        self.mesh_axes = dict(mesh_axes or {})
+
+    # -- views ---------------------------------------------------------------
+    @property
+    def matched(self) -> List[LeafPlan]:
+        return [e for e in self.entries if e.status == "matched"]
+
+    @property
+    def sharded(self) -> List[LeafPlan]:
+        """Matched leaves whose proposal actually splits a dim."""
+        return [e for e in self.matched
+                if any(x is not None for x in tuple(e.spec or ()))]
+
+    @property
+    def unmatched(self) -> List[LeafPlan]:
+        return [e for e in self.entries if e.status == "unmatched"]
+
+    @property
+    def conflicts(self) -> List[LeafPlan]:
+        return [e for e in self.entries if e.conflict]
+
+    def specs(self) -> Dict[str, Optional[P]]:
+        """{name: final spec} — what apply() would leave on the model."""
+        return {e.name: e.final_spec for e in self.entries}
+
+    def entry(self, name: str) -> Optional[LeafPlan]:
+        for e in self.entries:
+            if e.name == name:
+                return e
+        return None
+
+    def __len__(self):
+        return len(self.entries)
+
+    def __iter__(self):
+        return iter(self.entries)
+
+    # -- reports -------------------------------------------------------------
+    def as_dict(self) -> Dict[str, Any]:
+        return {"table": self.table, "mesh_axes": self.mesh_axes,
+                "n_leaves": len(self.entries),
+                "n_sharded": len(self.sharded),
+                "n_matched": len(self.matched),
+                "n_unmatched": len(self.unmatched),
+                "n_conflicts": len(self.conflicts),
+                "entries": [e.as_dict() for e in self.entries]}
+
+    def format(self) -> str:
+        head = (f"autoshard plan (table={self.table}, "
+                f"mesh={self.mesh_axes or 'none'}): "
+                f"{len(self.entries)} leaves, {len(self.sharded)} sharded, "
+                f"{len(self.unmatched)} unmatched, "
+                f"{len(self.conflicts)} conflict(s)")
+        lines = [head]
+        for e in self.entries:
+            if e.status == "exempt":
+                continue
+            mark = "!" if e.conflict else (
+                "?" if e.status == "unmatched" else " ")
+            rule = f"{e.rule}" if e.rule else "(no rule)"
+            extra = ""
+            if e.existing is not None:
+                who = e.existing_source or "hand"
+                extra = f"  [existing {who}: {spec_repr(e.existing)}]"
+            lines.append(f" {mark} {e.name} {tuple(e.shape)} <- {rule} "
+                         f"{spec_repr(e.spec)}{extra}")
+        return "\n".join(lines)
+
+
+def _named_leaves(target, existing, sources):
+    """Normalize a Layer / {name: array} target into
+    [(name, shape, existing_spec, existing_source, param_obj)]."""
+    rows = []
+    if isinstance(target, Mapping):
+        existing = existing or {}
+        sources = sources or {}
+        for name in target:
+            v = target[name]
+            rows.append((name, tuple(getattr(v, "shape", ())),
+                         existing.get(name), sources.get(name), None))
+        return rows
+    # a Layer: read annotations (and their provenance) off the params
+    from ...parallel.api import get_partition_spec
+    for name, p in target.named_parameters():
+        rows.append((name, tuple(p.shape), get_partition_spec(p),
+                     getattr(p, AUTOSHARD_SOURCE_ATTR, None), p))
+    return rows
+
+
+def propose(target, *, rules: Optional[PartitionRules] = None,
+            mesh=None, existing: Optional[Dict[str, Any]] = None,
+            sources: Optional[Dict[str, Optional[str]]] = None
+            ) -> ShardingPlan:
+    """Walk ``target``'s parameters and produce a full sharding plan.
+
+    ``target`` is an nn.Layer (annotations + provenance read off the
+    Parameter objects) or a ``{name: array}`` dict (then ``existing``
+    maps names to current specs and ``sources`` to their provenance —
+    the lint-pass path, where only arrays survive tracing).
+    ``rules=None`` uses the FLAGS_autoshard_rules table; ``mesh=None``
+    compares specs raw (no axis cleaning).
+    """
+    if rules is None:
+        from .rules import active_rules
+        rules = active_rules()
+    entries: List[LeafPlan] = []
+    for name, shape, cur, cur_src, _p in _named_leaves(target, existing,
+                                                       sources):
+        size = 1
+        for d in shape:
+            size *= int(d)
+        if len(shape) == 0 or size <= 1:
+            entries.append(LeafPlan(name=name, shape=shape, status="exempt",
+                                    existing=cur, existing_source=cur_src))
+            continue
+        rule = rules.match(name, shape)
+        if rule is None:
+            status = "exempt" if len(shape) < 2 else "unmatched"
+            if cur is not None and cur_src is None:
+                status = "hand"      # hand annotation covers the gap
+            entries.append(LeafPlan(name=name, shape=shape, status=status,
+                                    existing=cur, existing_source=cur_src))
+            continue
+        conflict = (cur is not None and cur_src is None
+                    and not specs_equivalent(cur, rule.spec, mesh))
+        entries.append(LeafPlan(
+            name=name, shape=shape, rule=rule.role, table=rules.name,
+            spec=rule.spec, existing=cur, existing_source=cur_src,
+            status="matched", conflict=conflict))
+    mesh_axes = dict(getattr(mesh, "shape", {}) or {}) if mesh is not None \
+        else {}
+    return ShardingPlan(entries, table=rules.name, mesh_axes=mesh_axes)

@@ -1,0 +1,252 @@
+"""PartitionRules: ordered regex-over-param-path -> PartitionSpec tables.
+
+Reference parity: the rule-based partitioning discipline of the JAX LLM
+stacks (SNIPPETS.md [1] ``match_partition_rules``: first regex over the
+dotted parameter path wins; [3] ``SpecLayout``: one canonical spec per
+layer *role*), expressed over THIS repo's mesh axes (parallel.mesh):
+``mp`` carries the tensor-parallel split, ``dp``/ZeRO sharding is layered
+on afterwards by ``TrainStep._zero_spec`` exactly as for hand
+annotations, so one table covers every ZeRO stage.
+
+A :class:`Rule` binds a human-readable *role* (the provenance string every
+diagnostic and plan entry carries), a regex matched with ``re.search``
+against the dotted parameter path, an optional rank filter (``ndim`` —
+how "any 4-d kernel" is expressed without regexing shapes), and the
+proposed :class:`~jax.sharding.PartitionSpec`.  ``P()`` is a real rule:
+"this role replicates BY DESIGN" is a matched decision, distinct from an
+unmatched leaf (which the plan reports and sharding-coverage lints).
+
+Shipped tables (``FLAGS_autoshard_rules`` names them):
+
+  ``transformer``  Megatron-style TP: vocab-sharded embeddings,
+                   column-parallel QKV/FFN-in, row-parallel
+                   attn-out/FFN-out — byte-for-byte the layout
+                   ``text.models.bert.apply_tensor_parallel`` used to
+                   hand-annotate.
+  ``conv``         conv kernels replicate under TP (data parallel is the
+                   conv scaling axis); classifier heads column-shard.
+  ``embedding``    recommender tables: embedding matrices vocab-sharded,
+                   CTR MLP towers replicated (they scale by data, not TP).
+  ``default``      transformer + conv + embedding, in that order.
+
+User escape hatch: :meth:`PartitionRules.with_overrides` prepends rules
+(first match wins, so overrides shadow the shipped roles);
+:func:`register_rules_table` publishes a custom table under a name the
+flag can select.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+__all__ = [
+    "Rule", "PartitionRules", "transformer_rules", "conv_rules",
+    "embedding_rules", "default_rules", "rules_table",
+    "register_rules_table", "rules_table_names", "active_rules",
+    "spec_repr",
+]
+
+# the repo's tensor-parallel mesh axis (parallel.mesh.MP_AXIS; literal here
+# so importing a rules table never forces the parallel package to load)
+MP = "mp"
+
+
+def spec_repr(spec: Optional[P]) -> str:
+    """Stable human form of a PartitionSpec for plans/diagnostics:
+    ``P('mp', None)``; ``None`` (no annotation) prints as ``-``."""
+    if spec is None:
+        return "-"
+    ents = []
+    for e in tuple(spec):
+        if isinstance(e, (tuple, list)):
+            ents.append("(" + ",".join(repr(a) for a in e) + ")")
+        else:
+            ents.append(repr(e))
+    return "P(" + ", ".join(ents) + ")"
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One partitioning decision: role name, path regex, optional rank
+    filter, proposed spec."""
+
+    role: str
+    pattern: str
+    spec: P
+    ndim: Optional[int] = None      # only match leaves of this rank
+    _rx: re.Pattern = field(init=False, repr=False, compare=False,
+                            default=None)
+
+    def __post_init__(self):
+        object.__setattr__(self, "_rx", re.compile(self.pattern))
+
+    def matches(self, name: str, shape: Sequence[int]) -> bool:
+        if self.ndim is not None and len(shape) != self.ndim:
+            return False
+        return self._rx.search(name) is not None
+
+
+class PartitionRules:
+    """An ORDERED rule table — first match wins (``match_partition_rules``
+    semantics), so specific roles go before catch-alls and user overrides
+    are prepended."""
+
+    def __init__(self, rules: Iterable[Rule], name: str = "custom"):
+        self._rules: Tuple[Rule, ...] = tuple(rules)
+        self.name = name
+        roles = [r.role for r in self._rules]
+        dup = {r for r in roles if roles.count(r) > 1}
+        if dup:
+            raise ValueError(
+                f"rules table {name!r} has duplicate role names {sorted(dup)}"
+                " — roles are provenance keys and must be unique")
+
+    # -- lookup --------------------------------------------------------------
+    def match(self, name: str, shape: Sequence[int]) -> Optional[Rule]:
+        """First rule whose regex (and rank filter) matches the dotted
+        parameter path; None when nothing matches."""
+        for r in self._rules:
+            if r.matches(name, shape):
+                return r
+        return None
+
+    def spec_for(self, name: str, shape: Sequence[int]) -> Optional[P]:
+        r = self.match(name, shape)
+        return r.spec if r is not None else None
+
+    # -- composition ---------------------------------------------------------
+    def with_overrides(self, rules: Iterable, name: Optional[str] = None
+                       ) -> "PartitionRules":
+        """New table with ``rules`` PREPENDED (they shadow the shipped
+        roles — the user escape hatch).  Each entry is a :class:`Rule` or
+        a ``(role, pattern, spec[, ndim])`` tuple."""
+        extra = [r if isinstance(r, Rule) else Rule(*r) for r in rules]
+        return PartitionRules(extra + list(self._rules),
+                              name=name or f"{self.name}+overrides")
+
+    def __iter__(self):
+        return iter(self._rules)
+
+    def __len__(self):
+        return len(self._rules)
+
+    def roles(self) -> List[str]:
+        return [r.role for r in self._rules]
+
+    def __repr__(self):
+        return f"PartitionRules({self.name!r}, {len(self._rules)} rules)"
+
+
+# ---------------------------------------------------------------------------
+# shipped canonical tables
+# ---------------------------------------------------------------------------
+
+def transformer_rules() -> PartitionRules:
+    """Megatron-style TP over ``mp`` for the nn.TransformerEncoder layer
+    naming (bert/gpt zoo models).  Linear weights are (in, out), so
+    column-parallel = shard dim 1, row-parallel = shard dim 0; Embedding
+    weights are (vocab, hidden), vocab-sharded."""
+    return PartitionRules([
+        Rule("tp-vocab-embedding",
+             r"word_embeddings\.weight$|(^|\.)wte\.weight$",
+             P(MP, None)),
+        Rule("replicated-pos-embedding",
+             r"position_embeddings\.weight$|(^|\.)wpe\.weight$"
+             r"|token_type_embeddings\.weight$",
+             P()),
+        Rule("tp-qkv-column",
+             r"self_attn\.(q|k|v)_proj\.weight$", P(None, MP)),
+        Rule("tp-qkv-bias",
+             r"self_attn\.(q|k|v)_proj\.bias$", P(MP)),
+        Rule("tp-attn-out-row",
+             r"self_attn\.out_proj\.weight$", P(MP, None)),
+        Rule("tp-ffn-in-column", r"(^|\.)linear1\.weight$", P(None, MP)),
+        Rule("tp-ffn-in-bias", r"(^|\.)linear1\.bias$", P(MP)),
+        Rule("tp-ffn-out-row", r"(^|\.)linear2\.weight$", P(MP, None)),
+        Rule("replicated-head-dense",
+             r"(pooler\.dense|cls\.transform|seq_relationship"
+             r"|(^|\.)decoder)\.weight$",
+             P()),
+    ], name="transformer")
+
+
+def conv_rules() -> PartitionRules:
+    """Conv workloads: kernels replicate under TP (dp/ZeRO is the conv
+    scaling axis — TrainStep layers it on); classifier heads
+    column-shard over mp."""
+    return PartitionRules([
+        Rule("conv-kernel-replicated", r"\.weight$", P(), ndim=4),
+        Rule("classifier-column",
+             r"(^|\.)(fc|head|classifier)(\.\d+)?\.weight$",
+             P(None, MP), ndim=2),
+        Rule("classifier-bias",
+             r"(^|\.)(fc|head|classifier)(\.\d+)?\.bias$", P(MP), ndim=1),
+    ], name="conv")
+
+
+def embedding_rules() -> PartitionRules:
+    """Recommender tables: device-resident embedding matrices vocab(row)-
+    sharded; CTR MLP towers and wide parts replicate (they scale by data
+    and by the PS, not by TP)."""
+    return PartitionRules([
+        Rule("row-sharded-embedding",
+             r"(^|\.)emb\w*\.weight$|(^|\.)embedding\.weight$",
+             P(MP, None), ndim=2),
+        Rule("rec-mlp-replicated", r"(^|\.)dnn\.\d+\.(weight|bias)$", P()),
+        Rule("rec-wide-replicated", r"(^|\.)wide\w*\.(weight|bias)$", P()),
+    ], name="embedding")
+
+
+def default_rules() -> PartitionRules:
+    """The union table every zoo model shards from: transformer roles
+    first (most specific names), then conv, then recommender."""
+    return PartitionRules(
+        list(transformer_rules()) + list(conv_rules())
+        + list(embedding_rules()),
+        name="default")
+
+
+# ---------------------------------------------------------------------------
+# named-table registry (FLAGS_autoshard_rules resolves here)
+# ---------------------------------------------------------------------------
+
+_TABLES: Dict[str, Callable[[], PartitionRules]] = {
+    "default": default_rules,
+    "transformer": transformer_rules,
+    "conv": conv_rules,
+    "embedding": embedding_rules,
+}
+
+
+def register_rules_table(name: str,
+                         factory: Callable[[], PartitionRules]) -> None:
+    """Publish a custom table under ``name`` so FLAGS_autoshard_rules
+    (and the tools) can select it."""
+    if not str(name).strip():
+        raise ValueError("rules table name must be non-empty")
+    _TABLES[str(name)] = factory
+
+
+def rules_table_names() -> List[str]:
+    return sorted(_TABLES)
+
+
+def rules_table(name: str) -> PartitionRules:
+    """Resolve a table name (shipped or registered) to a fresh table."""
+    key = str(name).strip()
+    if key not in _TABLES:
+        raise KeyError(
+            f"unknown autoshard rules table {name!r}; known tables: "
+            f"{rules_table_names()} (register_rules_table adds custom ones)")
+    return _TABLES[key]()
+
+
+def active_rules() -> PartitionRules:
+    """The table FLAGS_autoshard_rules selects (independent of the
+    FLAGS_autoshard mode — sharding-coverage names would-match rules even
+    when the transform is off)."""
+    from ...framework import flags as _flags
+    return rules_table(_flags.flag("autoshard_rules"))

@@ -1,0 +1,120 @@
+"""apply(): the analysis family's first TRANSFORM pass.
+
+Where every pass since PR 5 was read-only (graph-in, findings-out), this
+one closes the loop the sharding-coverage lint opened: it takes the plan
+``propose`` produced and WRITES the PartitionSpec annotations onto the
+model's parameters (``parallel.api.shard_parameter`` — the single
+annotation point TrainStep/named_shardings already honor), stamping each
+with rule provenance so a later propose/lint can tell rule-applied specs
+from hand ones.
+
+Contract (framework/ir rewrite-pass discipline, TPU-shape):
+
+  * hand annotations are NEVER overwritten — a differing hand spec is a
+    ``conflict`` in the returned plan, surfaced by the
+    ``autoshard-conflict`` lint pass (ERROR at trace time in error mode)
+    and by ``tools/autoshard.py --strict``;
+  * pure-replication matches (spec ``P()``) annotate nothing — they mark
+    the leaf *decided* without touching the param, so a rules-driven
+    model stays attribute-identical to the hand-annotated layout it
+    replaces (the bit-identity guarantee);
+  * re-applying is idempotent: a spec this pass wrote is re-derived, not
+    conflicted, even if the table changed (latest table wins).
+
+``maybe_autoshard`` is the one-branch runtime hook TrainStep.init_state
+calls: ``FLAGS_autoshard`` ``off`` returns immediately; ``propose``
+computes + publishes the plan without mutating; ``apply`` additionally
+annotates before the sharding tree is built.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, Optional
+
+from .plan import AUTOSHARD_SOURCE_ATTR, ShardingPlan, propose
+from .rules import PartitionRules, spec_repr
+
+__all__ = [
+    "AutoshardWarning", "apply", "maybe_autoshard", "autoshard_mode",
+    "autoshard_enabled", "publish_plan", "AUTOSHARD_SOURCE_ATTR",
+]
+
+_MODES = ("off", "propose", "apply")
+
+
+class AutoshardWarning(UserWarning):
+    """Conflict/unmatched findings surfaced outside the lint channel."""
+
+
+def autoshard_mode() -> str:
+    """The ``off|propose|apply`` tri-state from FLAGS_autoshard."""
+    from ...framework import flags as _flags
+    mode = str(_flags.flag("autoshard")).lower()
+    return mode if mode in _MODES else "off"
+
+
+def autoshard_enabled() -> bool:
+    """The one off-path branch every integration point checks."""
+    return autoshard_mode() != "off"
+
+
+def apply(layer, *, rules: Optional[PartitionRules] = None, mesh=None,
+          plan: Optional[ShardingPlan] = None) -> ShardingPlan:
+    """Annotate ``layer``'s parameters from a rules table and return the
+    plan (with conflict/unmatched reports).  Hand annotations win; only
+    dim-splitting proposals write an attribute."""
+    if plan is None:
+        plan = propose(layer, rules=rules, mesh=mesh)
+    from ...parallel.api import shard_parameter
+    by_name = {e.name: e for e in plan.entries}
+    for name, p in layer.named_parameters():
+        e = by_name.get(name)
+        if e is None or e.status != "matched" or e.conflict:
+            continue
+        if e.existing is not None and e.existing_source is None:
+            continue                     # equivalent hand annotation: keep
+        if not any(x is not None for x in tuple(e.spec or ())):
+            continue                     # pure replication: annotate nothing
+        shard_parameter(p, e.spec)
+        setattr(p, AUTOSHARD_SOURCE_ATTR, f"{e.table}:{e.rule}")
+    return plan
+
+
+def publish_plan(plan: ShardingPlan, site: str = "autoshard") -> None:
+    """Gauges + JSONL (the graph-lint sink) for one plan — the propose
+    mode's observable output and the apply mode's audit trail."""
+    from ...utils.monitor import stat_add
+    stat_add("autoshard_planned", len(plan.sharded))
+    stat_add("autoshard_unmatched", len(plan.unmatched))
+    stat_add("autoshard_conflicts", len(plan.conflicts))
+    from ..manager import _get_writer, _writer_lock
+    with _writer_lock:
+        w = _get_writer()
+    if w is not None:
+        w.add_event("autoshard/plan", {"site": site, **plan.as_dict()})
+
+
+def maybe_autoshard(layer, *, mesh=None, site: str = "autoshard"
+                    ) -> Optional[ShardingPlan]:
+    """TrainStep's integration hook.  ``off`` = one flag read, nothing
+    else.  ``propose`` computes + publishes the plan (no mutation) and
+    warns on conflicts; ``apply`` additionally writes the annotations.
+    Returns the plan (None when off) so the compile-site lint can reuse
+    it without re-matching."""
+    mode = autoshard_mode()
+    if mode == "off":
+        return None
+    if mode == "apply":
+        plan = apply(layer, mesh=mesh)
+    else:
+        plan = propose(layer, mesh=mesh)
+    publish_plan(plan, site=site)
+    for e in plan.conflicts:
+        warnings.warn(
+            f"autoshard: hand annotation {spec_repr(e.existing)} on "
+            f"'{e.name}' contradicts rule '{e.rule}' (table {e.table}) "
+            f"proposing {spec_repr(e.spec)}; the hand annotation wins — "
+            f"delete it or override the rule "
+            f"(PartitionRules.with_overrides)", AutoshardWarning,
+            stacklevel=3)
+    return plan

@@ -25,7 +25,12 @@ Pass inventory (ids are stable API — suppression keys, gauge names):
   dead-fetch              computed-but-unfetched outputs (dead subgraphs)
   sharding-coverage       param leaves no partition rule matched while the
                           mesh has live model-parallel axes
-                          (match_partition_rules discipline)
+                          (match_partition_rules discipline); names the
+                          autoshard rule that WOULD cover each leaf
+  autoshard-conflict      a hand shard_parameter annotation contradicts
+                          the active autoshard rules table (ERROR: the
+                          rules engine and the model disagree about the
+                          layout — one of them is wrong)
 """
 from __future__ import annotations
 
@@ -42,7 +47,7 @@ __all__ = ["PASS_IDS"]
 
 PASS_IDS = ("recompile-hazard", "host-transfer", "dtype-promotion",
             "donation", "layout", "collective-consistency", "dead-fetch",
-            "sharding-coverage")
+            "sharding-coverage", "autoshard-conflict")
 
 
 def _diag(pass_id: str, message: str, location: Optional[str] = None,
@@ -538,6 +543,22 @@ def _sharding_coverage(ctx: LintContext) -> List[Diagnostic]:
         entries = tuple(spec) if spec is not None else ()
         if any(e is not None for e in entries):
             continue
+        # name the autoshard rule that WOULD cover this leaf so the
+        # warning is actionable (a matched pure-replication rule means
+        # replication is the DECIDED layout for this role — no finding)
+        rule = _autoshard_rule_for(name, shape)
+        if rule is not None and not any(
+                e is not None for e in tuple(rule.spec)):
+            continue
+        if rule is not None:
+            from .autoshard import spec_repr
+            hint = (f"; autoshard rule '{rule.role}' proposes "
+                    f"{spec_repr(rule.spec)} — FLAGS_autoshard=apply "
+                    f"closes this (=propose to review the plan first)")
+        else:
+            hint = ("; no autoshard rule matches — extend the "
+                    "FLAGS_autoshard_rules table "
+                    "(PartitionRules.with_overrides)")
         out.append(_diag(
             pid,
             f"parameter '{name}' {shape} matched no partition rule: it "
@@ -546,6 +567,61 @@ def _sharding_coverage(ctx: LintContext) -> List[Diagnostic]:
             f"{live_model_axes} are live — annotate it "
             f"(shard_parameter) or extend the partition rules "
             f"(match_partition_rules discipline: unmatched leaves are "
-            f"a lint, not a silent default)",
-            param=name, shape=shape))
+            f"a lint, not a silent default)" + hint,
+            param=name, shape=shape,
+            autoshard_rule=rule.role if rule is not None else None))
+    return out
+
+
+def _autoshard_rule_for(name, shape):
+    """The active-table rule that would match one leaf (None when the
+    table is unresolvable — sharding-coverage must not depend on a valid
+    FLAGS_autoshard_rules value)."""
+    try:
+        from .autoshard import active_rules
+        return active_rules().match(name, shape)
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# autoshard-conflict
+# ---------------------------------------------------------------------------
+
+@register_pass("autoshard-conflict", severity=Severity.ERROR,
+               doc="a hand shard_parameter annotation contradicts the "
+                   "active autoshard rules table")
+def _autoshard_conflict(ctx: LintContext) -> List[Diagnostic]:
+    """Fires when the rules engine and a hand annotation disagree about a
+    parameter's layout.  Active when the compile site carries an
+    autoshard plan (TrainStep under FLAGS_autoshard != off) or when
+    autoshard is enabled and the context has params to re-derive one
+    from; silent otherwise, so the pass costs nothing while the
+    transform is off."""
+    out: List[Diagnostic] = []
+    plan = (ctx.extra or {}).get("autoshard_plan")
+    if plan is None:
+        from .autoshard import autoshard_enabled
+        if not autoshard_enabled() or ctx.params is None:
+            return out
+        from .autoshard import propose
+        plan = propose(ctx.params, mesh=ctx.mesh,
+                       existing=ctx.partition_specs,
+                       sources=(ctx.extra or {}).get("autoshard_sources"))
+    from .autoshard import spec_repr
+    pid = "autoshard-conflict"
+    for e in plan.conflicts:
+        out.append(_diag(
+            pid,
+            f"hand annotation {spec_repr(e.existing)} on parameter "
+            f"'{e.name}' {tuple(e.shape)} contradicts autoshard rule "
+            f"'{e.rule}' (table {e.table}) proposing "
+            f"{spec_repr(e.spec)}: the rules engine and the model "
+            f"disagree about this layout — delete the shard_parameter "
+            f"call, or override the rule "
+            f"(PartitionRules.with_overrides) so the table owns the "
+            f"decision",
+            param=e.name, shape=tuple(e.shape), rule=e.rule,
+            table=e.table, hand=spec_repr(e.existing),
+            proposed=spec_repr(e.spec)))
     return out

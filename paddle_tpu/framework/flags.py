@@ -283,6 +283,33 @@ define_flag("graph_lint_dir",
             "as JSONL via utils.monitor.LogWriter into this directory "
             "(next to the recompile ledger's PADDLE_TPU_JIT_LEDGER_DIR "
             "sink). Gauges are always maintained.")
+define_flag("autoshard",
+            os.environ.get("PADDLE_TPU_AUTOSHARD", "off").lower()
+            or "off",
+            "Auto-sharding tri-state (paddle_tpu.analysis.autoshard): "
+            "'off' = no rule matching (one Python branch per TrainStep "
+            "state init, zero per step); 'propose' = compute the "
+            "rules-table sharding plan for every TrainStep model and "
+            "publish it (autoshard_* gauges + graph-lint JSONL sink) "
+            "WITHOUT mutating annotations; 'apply' = additionally write "
+            "the proposed PartitionSpecs onto unannotated parameters "
+            "before the sharding tree is built (hand shard_parameter "
+            "annotations always win; a contradicting rule is an "
+            "autoshard-conflict lint finding, ERROR severity). Seeded "
+            "by PADDLE_TPU_AUTOSHARD.",
+            validator=lambda v: str(v).lower() in ("off", "propose",
+                                                   "apply"))
+define_flag("autoshard_rules",
+            os.environ.get("PADDLE_TPU_AUTOSHARD_RULES", "default")
+            or "default",
+            "Which PartitionRules table drives auto-sharding (and the "
+            "rule-naming in sharding-coverage diagnostics): 'default' "
+            "(transformer+conv+embedding), 'transformer', 'conv', "
+            "'embedding', or any name published via "
+            "analysis.autoshard.register_rules_table. Resolution is "
+            "lazy, so custom tables may register after import. Seeded "
+            "by PADDLE_TPU_AUTOSHARD_RULES.",
+            validator=lambda v: bool(str(v).strip()))
 
 # ---- Serving engine (paddle_tpu.serving) ------------------------------------
 define_flag("serving_buckets", "1,2,4,8,16,32,64",

@@ -322,7 +322,7 @@ class Tensor:
 class Parameter(Tensor):
     """framework.py:5311 (ParamBase) parity: trainable persistable tensor."""
     __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
-                 "_partition_spec")
+                 "_partition_spec", "_autoshard_rule")
 
     def __init__(self, value, name=None, trainable=True, regularizer=None,
                  need_clip=True):

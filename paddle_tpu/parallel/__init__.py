@@ -21,8 +21,8 @@ from .mesh import (  # noqa: F401
     DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS,
 )
 from .api import (  # noqa: F401
-    shard_parameter, get_partition_spec, named_shardings, batch_sharding,
-    replicated_sharding, shard_tensor,
+    shard_parameter, get_partition_spec, annotation_source,
+    named_shardings, batch_sharding, replicated_sharding, shard_tensor,
 )
 from .train_step import TrainStep, EvalStep  # noqa: F401
 from .pipeline import GPipe, PipelineModule  # noqa: F401

@@ -17,6 +17,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .mesh import get_mesh, DP_AXIS, SP_AXIS
 
 SPEC_ATTR = "_partition_spec"
+# rule-provenance attr analysis.autoshard stamps on specs IT applied; a
+# hand shard_parameter call clears it so "hand wins" survives re-annotation
+AUTOSHARD_SOURCE_ATTR = "_autoshard_rule"
 
 
 def shard_parameter(param, spec):
@@ -26,7 +29,20 @@ def shard_parameter(param, spec):
     if not isinstance(spec, P):
         spec = P(*spec) if isinstance(spec, (tuple, list)) else P(spec)
     setattr(param, SPEC_ATTR, spec)
+    if getattr(param, AUTOSHARD_SOURCE_ATTR, None) is not None:
+        # a direct (hand) annotation supersedes rule provenance — the
+        # autoshard transform re-stamps the attr itself after calling here
+        try:
+            delattr(param, AUTOSHARD_SOURCE_ATTR)
+        except AttributeError:
+            pass
     return param
+
+
+def annotation_source(param) -> Optional[str]:
+    """``'<table>:<rule>'`` when analysis.autoshard applied this param's
+    spec, None for hand annotations (or no annotation)."""
+    return getattr(param, AUTOSHARD_SOURCE_ATTR, None)
 
 
 def get_partition_spec(param) -> Optional[P]:

@@ -142,6 +142,7 @@ class TrainStep:
         self._compiled = None
         self._donate = donate
         self._seen_sigs = set()     # input signatures already compiled
+        self._autoshard_plan = None  # set by init_state when autoshard on
         # -- fault-tolerance runtime (ISSUE 3) --------------------------------
         # numerics sentinel: None = follow FLAGS_train_sentinel at compile
         # time; an explicit True composes only with the standard engine
@@ -233,6 +234,16 @@ class TrainStep:
         if self._pipe is not None:
             params, buffers = self._pipe.flat_state()
         else:
+            # rules-driven auto-sharding (analysis.autoshard, ISSUE 9):
+            # FLAGS_autoshard=apply annotates unannotated params from the
+            # active PartitionRules table BEFORE the sharding tree below
+            # reads the annotations; =propose publishes the plan without
+            # mutating. One branch when off. The plan rides to the
+            # compile-site lint (autoshard-conflict / sharding-coverage).
+            from ..analysis.autoshard import maybe_autoshard
+            self._autoshard_plan = maybe_autoshard(
+                self.layer, mesh=self.mesh,
+                site=f"train_step:{type(self.layer).__name__}")
             params, buffers = F.layer_state(self.layer)
         D = self._localsgd_degree()
         if D > 1:
@@ -923,21 +934,29 @@ class TrainStep:
                 # sharding-coverage read the compile-site metadata; in
                 # error mode this raises BEFORE the step ever runs
                 from ..analysis import lint_traced
-                from .api import get_partition_spec
+                from .api import annotation_source, get_partition_spec
                 specs = None
+                extra = {}
                 if self._pipe is None:
                     try:
-                        specs = {n: get_partition_spec(p) for n, p in
-                                 self.layer.named_parameters()}
+                        named = list(self.layer.named_parameters())
+                        specs = {n: get_partition_spec(p)
+                                 for n, p in named}
+                        # hand-vs-rule provenance for autoshard-conflict
+                        extra["autoshard_sources"] = {
+                            n: annotation_source(p) for n, p in named}
                     except Exception:
                         specs = None
+                        extra = {}
+                if self._autoshard_plan is not None:
+                    extra["autoshard_plan"] = self._autoshard_plan
                 lint_traced(self._step_fn,
                             (self.state, inputs, label, lr, scale),
                             site=site, kind="train_step", cache_key=sig,
                             prev_key=_ledger.last_key(site),
                             donate=self._donate, mesh=self.mesh,
                             params=self.state["params"],
-                            partition_specs=specs)
+                            partition_specs=specs, extra=extra)
             from ..analysis.hlo import audit_enabled as _hlo_audit_on
             if _hlo_audit_on():
                 # compiled-program audit (analysis.hlo): AOT-relower the

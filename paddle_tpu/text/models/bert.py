@@ -235,21 +235,15 @@ def apply_tensor_parallel(model: BertModel):
     embedding sharded on vocab. ≙ paddle.distributed.split's
     _parallel_linear/_parallel_embedding (collective.py:492,526) without the
     manual allreduce insertion.
-    """
-    from jax.sharding import PartitionSpec as P
-    from ...parallel.api import shard_parameter
 
-    bert = model.bert if hasattr(model, "bert") else model
-    shard_parameter(bert.embeddings.word_embeddings.weight, P("mp", None))
-    for layer in bert.encoder.layers:
-        att = layer.self_attn
-        for proj in (att.q_proj, att.k_proj, att.v_proj):
-            shard_parameter(proj.weight, P(None, "mp"))
-            if proj.bias is not None:
-                shard_parameter(proj.bias, P("mp"))
-        shard_parameter(att.out_proj.weight, P("mp", None))
-        shard_parameter(layer.linear1.weight, P(None, "mp"))
-        if layer.linear1.bias is not None:
-            shard_parameter(layer.linear1.bias, P("mp"))
-        shard_parameter(layer.linear2.weight, P("mp", None))
+    Rules-driven since ISSUE 9: the hand per-param shard_parameter list
+    this function used to carry is now ONE table —
+    ``analysis.autoshard.transformer_rules()`` — applied through the
+    transform pass (verified bit-identical to the deleted hand layout;
+    tests/test_autoshard.py keeps the control inline).  The plan's
+    unmatched-leaf report must stay empty for the zoo.
+    """
+    from ...analysis.autoshard import apply as _autoshard_apply
+    from ...analysis.autoshard import transformer_rules
+    _autoshard_apply(model, rules=transformer_rules())
     return model

@@ -120,3 +120,16 @@ class GPTModel(nn.Layer):
                          max_new_tokens=max_new_tokens,
                          beam_size=beam_size, eos_token_id=eos_token_id,
                          **kw)
+
+
+def apply_tensor_parallel(model: GPTModel):
+    """Megatron-style TP over ``mp`` for the decoder-only stack — the
+    SAME ``analysis.autoshard.transformer_rules()`` table BERT shards
+    from (vocab-sharded ``wte``, column-parallel QKV/FFN-in,
+    row-parallel attn-out/FFN-out; ``wpe`` replicated).  GPT never had a
+    hand annotation list: the table covered it from day one — the tied
+    ``wte`` output projection rides the embedding's vocab shard."""
+    from ...analysis.autoshard import apply as _autoshard_apply
+    from ...analysis.autoshard import transformer_rules
+    _autoshard_apply(model, rules=transformer_rules())
+    return model

@@ -1,0 +1,391 @@
+"""Auto-sharding transform tests (paddle_tpu.analysis.autoshard, ISSUE 9).
+
+Rule matching (ordering/precedence, rank filters, scalar & 1-d
+exemptions, unmatched-leaf reporting), propose/apply semantics (hand
+wins, provenance stamping, idempotence), the FLAGS_autoshard TrainStep
+hook, the autoshard-conflict lint pass ERRORing at trace time with
+state untouched, flags coverage, and the headline acceptance gate:
+auto-sharded BERT trains BIT-IDENTICAL to the hand-annotated control
+(the annotation list deleted from text.models.bert lives on here as the
+control) on the 8-device mesh.
+"""
+import warnings
+
+import numpy as np
+import pytest
+import jax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import analysis
+from paddle_tpu.analysis import autoshard
+from paddle_tpu.analysis.autoshard import (
+    AutoshardWarning, PartitionRules, Rule, default_rules, propose,
+    rules_table, specs_equivalent, transformer_rules)
+from paddle_tpu.framework.enforce import EnforceNotMet
+from paddle_tpu.framework.flags import (define_flag, flags_restore,
+                                        flags_snapshot, set_flags)
+from paddle_tpu.parallel import (annotation_source, get_partition_spec,
+                                 make_mesh, shard_parameter)
+from paddle_tpu.text.models.bert import BertConfig, BertForPretraining
+
+
+@pytest.fixture()
+def flags_guard():
+    snap = flags_snapshot()
+    yield
+    flags_restore(snap)
+
+
+def _tiny_cfg():
+    cfg = BertConfig.tiny(vocab_size=64, hidden_size=16, layers=2,
+                          heads=2, seq=32)
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    return cfg
+
+
+def _hand_annotate(model):
+    """The OLD hand annotation list deleted from
+    text.models.bert.apply_tensor_parallel — kept verbatim as the
+    bit-identity control."""
+    bert = model.bert if hasattr(model, "bert") else model
+    shard_parameter(bert.embeddings.word_embeddings.weight, P("mp", None))
+    for layer in bert.encoder.layers:
+        att = layer.self_attn
+        for proj in (att.q_proj, att.k_proj, att.v_proj):
+            shard_parameter(proj.weight, P(None, "mp"))
+            if proj.bias is not None:
+                shard_parameter(proj.bias, P("mp"))
+        shard_parameter(att.out_proj.weight, P("mp", None))
+        shard_parameter(layer.linear1.weight, P(None, "mp"))
+        if layer.linear1.bias is not None:
+            shard_parameter(layer.linear1.bias, P("mp"))
+        shard_parameter(layer.linear2.weight, P("mp", None))
+    return model
+
+
+# ---------------------------------------------------------------------------
+# rule matching
+# ---------------------------------------------------------------------------
+
+def test_rule_ordering_first_match_wins():
+    rules = PartitionRules([
+        Rule("specific", r"special\.weight$", P("mp", None)),
+        Rule("generic", r"\.weight$", P(None, "mp")),
+    ], name="t")
+    assert rules.match("a.special.weight", (8, 8)).role == "specific"
+    assert rules.match("a.other.weight", (8, 8)).role == "generic"
+    # reversed order: the catch-all shadows the specific rule
+    rev = PartitionRules(list(rules)[::-1], name="rev")
+    assert rev.match("a.special.weight", (8, 8)).role == "generic"
+
+
+def test_rule_ndim_filter():
+    rules = PartitionRules([
+        Rule("conv-only", r"\.weight$", P(), ndim=4),
+    ], name="t")
+    assert rules.match("c1.weight", (8, 8, 3, 3)).role == "conv-only"
+    assert rules.match("fc.weight", (8, 8)) is None
+
+
+def test_with_overrides_prepends_and_shadows():
+    base = transformer_rules()
+    over = base.with_overrides([
+        ("my-qkv", r"self_attn\.(q|k|v)_proj\.weight$", P("mp", None)),
+    ])
+    assert over.match("x.self_attn.q_proj.weight", (8, 8)).role == "my-qkv"
+    # untouched roles still resolve
+    assert over.match("wte.weight", (64, 8)).role == "tp-vocab-embedding"
+    # the base table is NOT mutated
+    assert base.match("x.self_attn.q_proj.weight",
+                      (8, 8)).role == "tp-qkv-column"
+
+
+def test_duplicate_role_rejected():
+    with pytest.raises(ValueError, match="duplicate role"):
+        PartitionRules([Rule("r", r"a", P()), Rule("r", r"b", P())],
+                       name="dup")
+
+
+def test_rules_table_registry():
+    assert set(autoshard.rules_table_names()) >= {
+        "default", "transformer", "conv", "embedding"}
+    with pytest.raises(KeyError, match="unknown autoshard rules table"):
+        rules_table("no-such-table")
+    autoshard.register_rules_table(
+        "test-custom", lambda: PartitionRules(
+            [Rule("all", r".", P())], name="test-custom"))
+    assert rules_table("test-custom").match("anything", (4, 4)).role == "all"
+
+
+def test_scalar_and_1d_exemptions_and_unmatched_report():
+    rules = PartitionRules([
+        Rule("bias", r"\.special_bias$", P("mp")),
+    ], name="t")
+    params = {
+        "scalar": np.zeros(()),                 # exempt: rank 0
+        "one_elem": np.zeros((1, 1)),           # exempt: one element
+        "vec": np.zeros((8,)),                  # unmatched 1-d -> exempt
+        "a.special_bias": np.zeros((8,)),       # 1-d CAN match a rule
+        "mat": np.zeros((8, 8)),                # unmatched >=2-d: reported
+    }
+    plan = propose(params, rules=rules)
+    st = {e.name: e.status for e in plan}
+    assert st["scalar"] == "exempt" and st["one_elem"] == "exempt"
+    assert st["vec"] == "exempt"
+    assert st["a.special_bias"] == "matched"
+    assert plan.entry("a.special_bias").rule == "bias"
+    assert [e.name for e in plan.unmatched] == ["mat"]
+
+
+def test_specs_equivalent_normalization():
+    assert specs_equivalent(P(None, "mp"), P(None, ("mp",)))
+    assert specs_equivalent(P(None, "mp"), P(None, "mp", None))
+    assert specs_equivalent(None, P())
+    assert not specs_equivalent(P("mp", None), P(None, "mp"))
+    # cleaning over a mesh: axes the mesh lacks drop
+    mesh = make_mesh({"dp": 8})
+    assert specs_equivalent(P(None, "mp"), P(), mesh=mesh)
+    mesh2 = make_mesh({"dp": 4, "mp": 2})
+    assert not specs_equivalent(P(None, "mp"), P(), mesh=mesh2)
+
+
+# ---------------------------------------------------------------------------
+# propose / apply on real models
+# ---------------------------------------------------------------------------
+
+def test_propose_bert_matches_hand_layout_exactly():
+    paddle.seed(0)
+    hand = BertForPretraining(_tiny_cfg())
+    _hand_annotate(hand)
+    hand_specs = {n: get_partition_spec(p)
+                  for n, p in hand.named_parameters()}
+
+    paddle.seed(0)
+    auto = BertForPretraining(_tiny_cfg())
+    plan = autoshard.apply(auto, rules=transformer_rules())
+    assert not plan.unmatched and not plan.conflicts
+    assert len(plan.sharded) == 21          # 1 vocab emb + 2 layers x 10
+    for n, p in auto.named_parameters():
+        assert specs_equivalent(get_partition_spec(p), hand_specs[n]), n
+
+
+def test_apply_provenance_and_hand_precedence():
+    paddle.seed(0)
+    m = BertForPretraining(_tiny_cfg())
+    q = m.bert.encoder.layers[0].self_attn.q_proj.weight
+    autoshard.apply(m, rules=transformer_rules())
+    assert annotation_source(q) == "transformer:tp-qkv-column"
+    # replication roles decide without annotating (bit-identity with the
+    # hand layout, which never touched these params)
+    pooler = m.bert.pooler.dense.weight
+    assert get_partition_spec(pooler) is None
+    # a later HAND annotation supersedes and clears the provenance
+    shard_parameter(q, P("mp", None))
+    assert annotation_source(q) is None
+    # re-propose now sees a conflicting hand annotation
+    plan = propose(m, rules=transformer_rules())
+    assert [e.name for e in plan.conflicts] == \
+        ["bert.encoder.layers.0.self_attn.q_proj.weight"]
+
+
+def test_apply_idempotent_and_table_swap_wins():
+    paddle.seed(0)
+    m = BertForPretraining(_tiny_cfg())
+    autoshard.apply(m, rules=transformer_rules())
+    plan2 = autoshard.apply(m, rules=transformer_rules())
+    assert not plan2.conflicts               # own specs re-derive, no fight
+    # a changed table overwrites ITS OWN annotations (latest table wins)
+    over = transformer_rules().with_overrides(
+        [("flip-qkv", r"self_attn\.(q|k|v)_proj\.weight$", P("mp", None))])
+    plan3 = autoshard.apply(m, rules=over)
+    assert not plan3.conflicts
+    q = m.bert.encoder.layers[0].self_attn.q_proj.weight
+    assert specs_equivalent(get_partition_spec(q), P("mp", None))
+    assert annotation_source(q) == "transformer+overrides:flip-qkv"
+
+
+def test_propose_dict_target_with_sources():
+    params = {"w": np.zeros((8, 8)), "wte.weight": np.zeros((64, 8))}
+    plan = propose(params, rules=transformer_rules(),
+                   existing={"wte.weight": P(None, "mp")},
+                   sources={"wte.weight": None})      # hand annotation
+    e = plan.entry("wte.weight")
+    assert e.conflict and e.rule == "tp-vocab-embedding"
+    # same spec but autoshard-sourced: re-derived, not a conflict
+    plan2 = propose(params, rules=transformer_rules(),
+                    existing={"wte.weight": P(None, "mp")},
+                    sources={"wte.weight": "transformer:old-rule"})
+    assert not plan2.entry("wte.weight").conflict
+
+
+# ---------------------------------------------------------------------------
+# flags + the TrainStep hook
+# ---------------------------------------------------------------------------
+
+def test_flags_registered_with_validators(flags_guard):
+    with pytest.raises(ValueError):
+        set_flags({"FLAGS_autoshard": "bogus"})
+    with pytest.raises(ValueError):
+        set_flags({"FLAGS_autoshard_rules": "  "})
+    set_flags({"FLAGS_autoshard": "propose"})
+    assert autoshard.autoshard_mode() == "propose"
+    assert autoshard.autoshard_enabled()
+    set_flags({"FLAGS_autoshard": "off"})
+    assert not autoshard.autoshard_enabled()
+    # idempotent re-registration (module reload semantics)
+    define_flag("autoshard", "off")
+    with pytest.raises(ValueError, match="already registered"):
+        define_flag("autoshard", "propose")
+
+
+def test_flags_snapshot_restore_roundtrip():
+    snap = flags_snapshot()
+    set_flags({"FLAGS_autoshard": "apply",
+               "FLAGS_autoshard_rules": "transformer"})
+    assert autoshard.autoshard_mode() == "apply"
+    flags_restore(snap)
+    assert autoshard.autoshard_mode() == snap["autoshard"] or \
+        autoshard.autoshard_mode() == "off"
+
+
+def _bert_step(mesh, **kw):
+    paddle.seed(7)
+    model = BertForPretraining(_tiny_cfg())
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3)
+    from paddle_tpu.parallel import TrainStep
+    step = TrainStep(model, opt, mesh=mesh, zero=1, **kw)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, (8, 16))
+    labels = np.where(rng.rand(*ids.shape) < 0.15, ids, -100)
+    return model, step, (ids, None, None, labels)
+
+
+def test_maybe_autoshard_off_propose_apply(flags_guard):
+    mesh = make_mesh({"dp": 4, "mp": 2})
+    from paddle_tpu.utils.monitor import reset_stats, stat_get
+    reset_stats("autoshard")
+    set_flags({"FLAGS_autoshard": "off"})
+    paddle.seed(0)
+    m = BertForPretraining(_tiny_cfg())
+    assert autoshard.maybe_autoshard(m, mesh=mesh) is None
+    assert get_partition_spec(m.bert.embeddings.word_embeddings.weight) \
+        is None
+
+    set_flags({"FLAGS_autoshard": "propose"})
+    plan = autoshard.maybe_autoshard(m, mesh=mesh)
+    assert plan is not None and len(plan.sharded) == 21
+    # propose NEVER mutates
+    assert get_partition_spec(m.bert.embeddings.word_embeddings.weight) \
+        is None
+    assert stat_get("autoshard_planned") >= 21
+
+    set_flags({"FLAGS_autoshard": "apply"})
+    autoshard.maybe_autoshard(m, mesh=mesh)
+    assert specs_equivalent(
+        get_partition_spec(m.bert.embeddings.word_embeddings.weight),
+        P("mp", None))
+
+
+def test_train_step_hook_applies_and_trains(flags_guard):
+    set_flags({"FLAGS_autoshard": "apply"})
+    mesh = make_mesh({"dp": 4, "mp": 2})
+    model, step, feed = _bert_step(mesh, remat=True)
+    losses = [float(step(feed)) for _ in range(3)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+    assert step._autoshard_plan is not None
+    assert len(step._autoshard_plan.sharded) == 21
+    assert annotation_source(
+        model.bert.embeddings.word_embeddings.weight) == \
+        "default:tp-vocab-embedding"
+
+
+def test_autoshard_bert_bit_identical_to_hand_control(flags_guard):
+    """THE acceptance gate: rules-driven sharding must compile the very
+    same program as the deleted hand annotations — identical loss
+    trajectory, float-equal, on the 8-device dp4xmp2 mesh."""
+    mesh = make_mesh({"dp": 4, "mp": 2})
+    set_flags({"FLAGS_autoshard": "off"})
+    hand_model, hand_step, feed = _bert_step(mesh, remat=True)
+    _hand_annotate(hand_model)
+    hand_losses = [float(hand_step(feed)) for _ in range(4)]
+
+    set_flags({"FLAGS_autoshard": "apply",
+               "FLAGS_autoshard_rules": "transformer"})
+    auto_model, auto_step, feed2 = _bert_step(mesh, remat=True)
+    auto_losses = [float(auto_step(feed2)) for _ in range(4)]
+
+    assert auto_losses == hand_losses, (hand_losses, auto_losses)
+    # and the sharding trees really are the same
+    hs = hand_step._shardings["params"]
+    as_ = auto_step._shardings["params"]
+    assert set(hs) == set(as_)
+    for n in hs:
+        assert hs[n].spec == as_[n].spec, n
+
+
+# ---------------------------------------------------------------------------
+# autoshard-conflict lint pass
+# ---------------------------------------------------------------------------
+
+def test_conflict_pass_registered():
+    assert "autoshard-conflict" in analysis.PASS_IDS
+    mgr = analysis.default_pass_manager()
+    assert "autoshard-conflict" in mgr.pass_ids()
+    from paddle_tpu.analysis import Severity
+    assert mgr.severity_of("autoshard-conflict") == Severity.ERROR
+
+
+def test_conflict_lint_error_at_trace_time_state_untouched(flags_guard):
+    set_flags({"FLAGS_autoshard": "apply", "FLAGS_graph_lint": "error"})
+    mesh = make_mesh({"dp": 4, "mp": 2})
+    model, step, feed = _bert_step(mesh)
+    # contradict the column-parallel rule with a row-parallel hand spec
+    shard_parameter(model.bert.encoder.layers[0].self_attn.q_proj.weight,
+                    P("mp", None))
+    with pytest.raises(EnforceNotMet, match="autoshard-conflict"):
+        step(feed)
+    # the violation raised at trace time: nothing ever executed
+    assert int(step.state["step"]) == 0
+
+
+def test_conflict_lint_warn_mode_still_runs(flags_guard):
+    set_flags({"FLAGS_autoshard": "apply", "FLAGS_graph_lint": "warn"})
+    mesh = make_mesh({"dp": 4, "mp": 2})
+    model, step, feed = _bert_step(mesh)
+    shard_parameter(model.bert.encoder.layers[0].self_attn.q_proj.weight,
+                    P("mp", None))
+    with pytest.warns(UserWarning, match="autoshard"):
+        loss = float(step(feed))
+    assert np.isfinite(loss)
+    assert int(step.state["step"]) == 1
+
+
+def test_conflict_silent_when_autoshard_off(flags_guard):
+    set_flags({"FLAGS_autoshard": "off", "FLAGS_graph_lint": "error"})
+    mesh = make_mesh({"dp": 4, "mp": 2})
+    model, step, feed = _bert_step(mesh)
+    _hand_annotate(model)
+    shard_parameter(model.bert.encoder.layers[0].self_attn.q_proj.weight,
+                    P("mp", None))       # contradicts the (inactive) rules
+    assert np.isfinite(float(step(feed)))     # no raise: transform off
+
+
+def test_maybe_autoshard_warns_on_conflict(flags_guard):
+    set_flags({"FLAGS_autoshard": "apply"})
+    paddle.seed(0)
+    m = BertForPretraining(_tiny_cfg())
+    shard_parameter(m.bert.encoder.layers[0].self_attn.q_proj.weight,
+                    P("mp", None))
+    with pytest.warns(AutoshardWarning, match="hand annotation"):
+        plan = autoshard.maybe_autoshard(m)
+    assert len(plan.conflicts) == 1
+    # the hand annotation survived (hand wins)
+    assert specs_equivalent(
+        get_partition_spec(
+            m.bert.encoder.layers[0].self_attn.q_proj.weight),
+        P("mp", None))

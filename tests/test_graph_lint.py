@@ -317,6 +317,46 @@ def test_sharding_coverage_seeded_and_clean():
     assert not _only(dp_only, "sharding-coverage")
 
 
+def test_sharding_coverage_names_autoshard_rule():
+    """ISSUE 9: warn-mode coverage output is actionable — each finding
+    names the autoshard rule that WOULD shard the leaf (or says no rule
+    matches), and a leaf a replication rule explicitly covers is a
+    DECIDED layout, not a finding."""
+    mgr = default_pass_manager()
+    mesh = make_mesh({"dp": 4, "mp": 2})
+    params = {
+        # matches tp-qkv-column in the default table
+        "encoder.layers.0.self_attn.q_proj.weight":
+            np.zeros((16, 16), np.float32),
+        # matches no rule at all
+        "mystery.w": np.zeros((8, 8), np.float32),
+        # matches the rec-mlp-replicated P() rule: decided, no finding
+        "dnn.0.weight": np.zeros((16, 16), np.float32),
+    }
+    r = mgr.run(LintContext(
+        site="s", kind="train_step", mesh=mesh, params=params,
+        partition_specs={n: None for n in params}))
+    found = {d.extra.get("param"): d for d in _only(r, "sharding-coverage")}
+    assert set(found) == {"encoder.layers.0.self_attn.q_proj.weight",
+                          "mystery.w"}
+    named = found["encoder.layers.0.self_attn.q_proj.weight"]
+    assert "tp-qkv-column" in named.message
+    assert "FLAGS_autoshard=apply" in named.message
+    assert named.extra.get("autoshard_rule") == "tp-qkv-column"
+    norule = found["mystery.w"]
+    assert "no autoshard rule matches" in norule.message
+    assert norule.extra.get("autoshard_rule") is None
+    # clean fixture: an annotated leaf stays silent regardless of rules
+    from jax.sharding import PartitionSpec as P
+    clean = mgr.run(LintContext(
+        site="s", kind="train_step", mesh=mesh,
+        params={"encoder.layers.0.self_attn.q_proj.weight":
+                np.zeros((16, 16), np.float32)},
+        partition_specs={"encoder.layers.0.self_attn.q_proj.weight":
+                         P(None, "mp")}))
+    assert not _only(clean, "sharding-coverage")
+
+
 # ---------------------------------------------------------------------------
 # dy2static AST lint
 # ---------------------------------------------------------------------------

@@ -38,14 +38,14 @@ def test_cli_zoo_wide_mesh_strict_clean():
     payload = json.loads(p.stdout)
     assert payload["n_errors"] == 0
     models = {r["model"] for r in payload["results"]}
-    assert models == {"lenet", "resnet_block", "bert"}
+    assert models == {"lenet", "resnet_block", "bert", "gpt"}
     for r in payload["results"]:
         assert r["ok"] and r["mesh"] == "dp8xmp2"
         assert r["stats"]["collective_count"] > 0
         assert r["stats"]["memory"]["peak_bytes"] > 0
     # every lowering ledgered once with its mesh label (the
     # zero-steady-state-recompile convention extended to audit runs)
-    assert len(payload["ledger"]) == 3
+    assert len(payload["ledger"]) == 4
     assert all("arg:mesh" in e["key"] and "dp8xmp2" in e["key"]
                for e in payload["ledger"])
 
@@ -64,8 +64,9 @@ def test_cli_seeded_wide_mesh_exits_nonzero():
 @pytest.mark.slow
 def test_dryrun_phase5_worker_width16():
     """One width of the dryrun's phase 5 end-to-end: all mesh mixes
-    (dp×mp×sp z1, dp×mp z3, pure-dp resnet, pp×dp pipeline) audit clean,
-    the seeded de-sharded fixture fails at ERROR, and the rows carry the
+    (dp×mp×sp z1, dp×mp z3, pure-dp resnet, pp×dp pipeline, plus the
+    FLAGS_autoshard=apply rules-sharded GPT) audit clean, the seeded
+    de-sharded fixture fails at ERROR, and the rows carry the
     scaling-table fields."""
     code = "import __graft_entry__ as g; g._hlo_audit_impl(16)"
     p = subprocess.run([sys.executable, "-c", code], capture_output=True,
@@ -79,7 +80,8 @@ def test_dryrun_phase5_worker_width16():
     assert rows is not None
     cfgs = {r["config"] for r in rows}
     assert cfgs == {"bert_z1_dp_mp_sp", "bert_z3_dp_mp",
-                    "resnet18_z1_dp", "bert_pp2_dp"}
+                    "resnet18_z1_dp", "bert_pp2_dp",
+                    "gpt_autoshard_dp_mp"}
     for r in rows:
         assert r["n_devices"] == 16
         for field in ("collective_count", "collective_wire_bytes",

@@ -42,6 +42,7 @@ NAMESPACES = [
     ("paddle_tpu.testing", None),
     ("paddle_tpu.analysis", None),
     ("paddle_tpu.analysis.hlo", None),
+    ("paddle_tpu.analysis.autoshard", None),
 ]
 
 

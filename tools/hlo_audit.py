@@ -31,7 +31,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-ZOO_MODELS = ("lenet", "resnet_block", "bert")
+ZOO_MODELS = ("lenet", "resnet_block", "bert", "gpt")
+
+# --autoshard: shard models through the FLAGS_autoshard=apply TrainStep
+# hook (analysis.autoshard rules engine) instead of the models' explicit
+# annotation entry points — audits the rules-driven path end-to-end
+_AUTOSHARD = [False]
 
 
 def parse_mesh(spec: str):
@@ -129,7 +134,8 @@ def _build_bert(mesh, zero):
     cfg.attention_probs_dropout_prob = 0.0
     paddle.seed(0)
     model = BertForPretraining(cfg)
-    apply_tensor_parallel(model)
+    if not _AUTOSHARD[0]:
+        apply_tensor_parallel(model)
     opt = paddle.optimizer.AdamW(parameters=model.parameters(),
                                  learning_rate=1e-3)
     step = TrainStep(model, opt, mesh=mesh, zero=zero, remat=True)
@@ -140,8 +146,30 @@ def _build_bert(mesh, zero):
     return step, (ids, None, None, labels), None
 
 
+def _build_gpt(mesh, zero):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.parallel import TrainStep
+    from paddle_tpu.text.models.gpt import (GPTConfig, GPTModel,
+                                            apply_tensor_parallel)
+    cfg = GPTConfig.tiny(vocab_size=64, hidden_size=16, layers=2,
+                         heads=2, seq=32)
+    cfg.dropout = 0.0
+    paddle.seed(0)
+    model = GPTModel(cfg)
+    if not _AUTOSHARD[0]:
+        apply_tensor_parallel(model)
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3)
+    step = TrainStep(model, opt, mesh=mesh, zero=zero, remat=True)
+    dp = dict(mesh.shape).get("dp", 1)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4 * dp, 16))
+    return step, (ids, ids.copy()), None
+
+
 BUILDERS = {"lenet": _build_lenet, "resnet_block": _build_resnet_block,
-            "bert": _build_bert}
+            "bert": _build_bert, "gpt": _build_gpt}
 
 
 def audit_model(name: str, axes: dict, zero: int, suppress=()):
@@ -193,6 +221,10 @@ def main(argv=None):
     ap.add_argument("--seeded", action="store_true",
                     help="also audit the de-sharded-ZeRO negative "
                          "fixture (must produce ERROR findings)")
+    ap.add_argument("--autoshard", action="store_true",
+                    help="shard models via the FLAGS_autoshard=apply "
+                         "rules engine (analysis.autoshard) instead of "
+                         "their explicit annotation entry points")
     ap.add_argument("--strict", action="store_true",
                     help="exit non-zero if any ERROR finding fires")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -213,6 +245,10 @@ def main(argv=None):
     _provision(max(1, need))
 
     from paddle_tpu.analysis import hlo as hlo_audit
+    if args.autoshard:
+        from paddle_tpu.framework.flags import set_flags
+        _AUTOSHARD[0] = True
+        set_flags({"FLAGS_autoshard": "apply"})
 
     results, n_errors = [], 0
     for axes in meshes:
