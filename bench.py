@@ -384,7 +384,90 @@ def bench_wide_deep(on_tpu):
         res["value_source"] = "host"
     trainer.flush()
     res["vs_baseline"] = round(res["value"] / NOMINAL["wide_deep_ctr"], 3)
+    # ISSUE 10 (BENCH_r08 schema): mesh-sharded deep table vs the
+    # replicated control — same batches, same cache, the deep table
+    # row-partitioned over the mesh with in-graph all-to-all routing.
+    try:
+        res["sharded_embedding"] = _bench_wide_deep_sharded(on_tpu)
+    except Exception as e:                # noqa: BLE001 — diagnostic only
+        print(f"[bench] wide_deep sharded block skipped: {e}",
+              file=sys.stderr, flush=True)
     return res
+
+
+def _bench_wide_deep_sharded(on_tpu):
+    """Sharded-embedding sub-block: tok-rows/s sharded vs replicated
+    control (host path AND the in-graph chained-K probe), all-to-all
+    bytes/step from the compiled step's collective census, and a
+    zero-steady-state-recompile assertion over the timed window (no new
+    padded-shape/cap signatures, no cache growth in any compiled fn)."""
+    from paddle_tpu.rec.wide_deep import (WideDeep, WideDeepTrainer,
+                                          synthetic_ctr_batch)
+    vocab = 2_000_000 if on_tpu else 100_000
+    batch, iters = (16384, 8) if on_tpu else (64, 3)
+    cap = (1 << 18) if on_tpu else (1 << 12)
+    batches = [synthetic_ctr_batch(batch, vocab=vocab, seed=s)
+               for s in range(4)]
+
+    def drive(sharded):
+        import paddle_tpu as paddle
+        paddle.seed(7)
+        model = WideDeep()
+        t = WideDeepTrainer(model, device_cache=True, cache_capacity=cap,
+                            sharded_embedding=sharded,
+                            sharded_vocab=vocab if sharded else None)
+        for _ in range(2):       # two passes: fill the cache, then reach
+            for ids, dense, lab in batches:  # the all-hit steady shapes
+                t.step(ids, dense, lab)
+        if sharded:
+            # steady-state shape discipline: the timed window must add no
+            # compiled signatures and grow no jit cache
+            keys0 = set(t._sharded_fns)
+            sizes0 = {k: getattr(f, "_cache_size", lambda: -1)()
+                      for k, f in t._sharded_fns.items()}
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(iters):
+            ids, dense, lab = batches[i % len(batches)]
+            loss = t.step_async(ids, dense, lab)
+        loss = float(loss)
+        dt = time.perf_counter() - t0
+        assert np.isfinite(loss)
+        out = {"host_examples_s": round(batch * iters / dt, 1)}
+        try:
+            sec = t.in_graph_step_s(*batches[0])
+            out["in_graph_examples_s"] = round(batch / sec, 1)
+        except Exception as e:            # noqa: BLE001 — diagnostic only
+            print(f"[bench] wide_deep sharded in-graph probe skipped: {e}",
+                  file=sys.stderr, flush=True)
+        if sharded:
+            new_keys = set(t._sharded_fns) - keys0
+            grew = [k for k in keys0
+                    if getattr(t._sharded_fns[k], "_cache_size",
+                               lambda: -1)() != sizes0[k]]
+            assert not new_keys and not grew, (
+                f"sharded wide_deep recompiled in the timed window: "
+                f"new signatures {sorted(map(str, new_keys))}, "
+                f"grown caches {grew}")
+            out["steady_new_compiles"] = 0
+            stats = t.sharded_step_stats(*batches[0])
+            out["a2a_count"] = stats["all_to_all_count"]
+            out["a2a_wire_bytes_per_step"] = round(
+                stats["all_to_all_wire_bytes"], 1)
+            out["collective_wire_bytes_per_step"] = \
+                stats["collective_wire_bytes"]
+            out["route"] = stats["route"]
+            out["n_shards"] = stats["n_shards"]
+        t.flush()
+        return out
+
+    control = drive(False)
+    sharded = drive(True)
+    ratio = (sharded["host_examples_s"] / control["host_examples_s"]
+             if control["host_examples_s"] else 0.0)
+    return {"vocab": vocab, "batch": batch,
+            "control": control, "sharded": sharded,
+            "sharded_vs_control_host": round(ratio, 3)}
 
 
 # -- 6. Inference serving (Predictor latency suite, VERDICT r5 #4) -----------

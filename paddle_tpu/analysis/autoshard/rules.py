@@ -52,6 +52,10 @@ __all__ = [
 # the repo's tensor-parallel mesh axis (parallel.mesh.MP_AXIS; literal here
 # so importing a rules table never forces the parallel package to load)
 MP = "mp"
+# the data-parallel axis — mesh-sharded embedding tables (rec.sharded_
+# embedding) row-partition over it: CTR meshes are dp-wide, and the
+# lookup's all-to-all rides the widest axis
+DP = "dp"
 
 
 def spec_repr(spec: Optional[P]) -> str:
@@ -190,8 +194,14 @@ def conv_rules() -> PartitionRules:
 def embedding_rules() -> PartitionRules:
     """Recommender tables: device-resident embedding matrices vocab(row)-
     sharded; CTR MLP towers and wide parts replicate (they scale by data
-    and by the PS, not by TP)."""
+    and by the PS, not by TP).  ``rec-embedding`` is the mesh-sharded
+    table seat (rec.sharded_embedding.ShardedEmbedding stores its table
+    under a ``.table`` path): row-partitioned over dp — the all-to-all
+    lookup's owner axis — so a table built WITHOUT the layer's own
+    annotation still lands the production layout under
+    ``FLAGS_autoshard=apply``."""
     return PartitionRules([
+        Rule("rec-embedding", r"(^|\.)table$", P(DP, None), ndim=2),
         Rule("row-sharded-embedding",
              r"(^|\.)emb\w*\.weight$|(^|\.)embedding\.weight$",
              P(MP, None), ndim=2),

@@ -169,10 +169,79 @@ def _itemsize(dtype: str) -> int:
         return 4
 
 
+def _spec_axes(entries) -> set:
+    axes = set()
+    for e in entries or ():
+        if isinstance(e, (tuple, list)):
+            axes.update(a for a in e if a)
+        elif e is not None:
+            axes.add(e)
+    return axes
+
+
+def _annotated_desharded(ctx: LintContext,
+                         stats: Optional[HloProgramStats]
+                         ) -> List[Diagnostic]:
+    """The ANNOTATION contract (sharded-embedding seat, ISSUE 10): a
+    parameter the model annotated with a live mesh axis (``P('dp',
+    None)`` row-sharded tables, TP layouts) must carry that axis in the
+    compiled executable's input AND output shardings.  A leaf that lost
+    it is stored full on every device — for a billion-row embedding
+    table that is THE failure the partitioning exists to prevent: a
+    full-table copy per device plus a full-table all-gather each step."""
+    out: List[Diagnostic] = []
+    annotated = ctx.extra.get("annotated_specs") or {}
+    if not annotated:
+        return out
+    mesh_axes = dict(ctx.extra.get("mesh_axes") or {})
+    leaves = {leaf["path"]: leaf
+              for leaf in (ctx.extra.get("state_leaves") or ())}
+    for path in sorted(annotated):
+        leaf = leaves.get(path)
+        if leaf is None:
+            continue
+        want = {a for a in _spec_axes(annotated[path])
+                if mesh_axes.get(a, 1) > 1}
+        if not want:
+            continue               # annotation names no live axis: moot
+        for side in ("in", "out"):
+            spec, replicated = leaf[f"{side}_spec"], \
+                leaf[f"{side}_replicated"]
+            have = _spec_axes(spec)
+            if spec is None and not replicated:
+                continue           # opaque but sharded: benefit of doubt
+            if have & want:
+                continue           # honest layout
+            shape = leaf["shape"]
+            full = int(np.prod(shape)) * _itemsize(leaf["dtype"])
+            evidence = 0
+            if stats is not None:
+                evidence = sum(1 for op in stats.ops
+                               if op.kind == "all-gather"
+                               and op.result_bytes == full)
+            out.append(_diag(
+                "hlo-full-gather",
+                f"parameter '{path}' {tuple(shape)} is ANNOTATED "
+                f"{tuple(annotated[path])} but the compiled executable "
+                f"stores it replicated ({side}put sharding "
+                f"{spec if spec is not None else 'opaque/replicated'}): "
+                f"the {sorted(want)} partition was dropped — every "
+                f"device holds the full {full / 1024:.1f} KiB copy and "
+                f"the program full-gathers the whole table each step"
+                + (f" ({evidence} all-gather op(s) of exactly this size "
+                   f"in the partitioned HLO)" if evidence else ""),
+                path=path, shape=tuple(shape), side=side,
+                full_bytes=full, evidence_gathers=evidence,
+                annotated=tuple(str(a) for a in _spec_axes(
+                    annotated[path]))))
+            break                  # one finding per leaf is enough
+    return out
+
+
 @register_hlo_pass("hlo-full-gather", severity=Severity.ERROR,
-                   doc="ZeRO-sharded state stored replicated in the "
-                       "compiled executable (per-step full-gather + "
-                       "per-device full HBM copy)")
+                   doc="ZeRO-sharded or annotation-sharded state stored "
+                       "replicated in the compiled executable (per-step "
+                       "full-gather + per-device full HBM copy)")
 def _full_gather(ctx: LintContext) -> List[Diagnostic]:
     """The ZeRO layout contract, re-derived independently and checked
     against the compiled layout: with ``zero>=1`` every optimizer
@@ -180,15 +249,23 @@ def _full_gather(ctx: LintContext) -> List[Diagnostic]:
     dp-divisible dim left unsharded must carry the dp axis in the
     executable's input AND output sharding.  A leaf that fails is stored
     full on every device — the 'silent de-shard' that multiplies
-    per-device HBM by dp and inserts a full all-gather every step."""
-    out: List[Diagnostic] = []
+    per-device HBM by dp and inserts a full all-gather every step.
+
+    Second contract (:func:`_annotated_desharded`): explicitly annotated
+    sharded parameters — row-partitioned embedding tables, TP layouts —
+    must keep their live annotated axes in the compiled layout,
+    independent of any ZeRO stage."""
+    stats: Optional[HloProgramStats] = ctx.extra.get("stats")
+    out: List[Diagnostic] = list(_annotated_desharded(ctx, stats))
+    flagged = {d.extra.get("path") for d in out}
     table = ctx.extra.get("state_leaves") or ()
     dp = int(ctx.extra.get("dp_degree") or 0)
     zero = int(ctx.extra.get("zero") or 0)
-    stats: Optional[HloProgramStats] = ctx.extra.get("stats")
     if dp <= 1 or zero < 1:
         return out
     for leaf in table:
+        if leaf["path"] in flagged:
+            continue
         if leaf["category"] == "opt":
             must = zero >= 1
         else:
@@ -376,17 +453,28 @@ class HloAuditResult:
 
 def audit_compiled(compiled, *, site: str = "hlo", mesh=None, params=None,
                    state=None, zero: int = 0, dp_degree: int = 0,
-                   suppress=(), do_emit: bool = True,
+                   annotated_specs=None, suppress=(), do_emit: bool = True,
                    mesh_label: str = "") -> HloAuditResult:
     """Run the HLO pass family over an already-compiled executable.
 
     ``state``/``zero``/``dp_degree`` feed the full-gather contract check
     (pass them for train steps; a bare forward audit gets census/budget
-    checks only).  ``do_emit=False`` returns the report without gauges /
-    warnings / raising — the CLI and dryrun aggregate reports themselves.
+    checks only).  ``annotated_specs`` ({'params/<name>': spec-entry
+    tuple}) feeds the annotation contract: explicitly sharded params —
+    row-partitioned embedding tables, TP layouts — must keep their live
+    axes in the compiled layout.  ``do_emit=False`` returns the report
+    without gauges / warnings / raising — the CLI and dryrun aggregate
+    reports themselves.
     """
     stats = program_stats(compiled)
     extra = {"stats": stats, "zero": int(zero), "dp_degree": int(dp_degree)}
+    if annotated_specs:
+        extra["annotated_specs"] = dict(annotated_specs)
+    if mesh is not None:
+        try:
+            extra["mesh_axes"] = dict(mesh.shape)
+        except Exception:
+            pass
     if state is not None:
         extra["state_leaves"] = state_leaf_table(state, compiled)
     ctx = LintContext(site=site, kind="hlo", mesh=mesh, params=params,
@@ -432,10 +520,22 @@ def audit_train_step(step, inputs, label=None, *, site: Optional[str] = None,
            tuple(sig(x) for x in inputs) + (sig(label),))
     _ledger.record_compile(site, "hlo_audit", key, ms)
     dp = int(dict(step.mesh.shape).get("dp", 1))
+    # annotation contract: the specs the MODEL declares (shard_parameter /
+    # autoshard provenance) — the executable must not silently drop them
+    annotated = {}
+    try:
+        from ...parallel.api import get_partition_spec
+        for name, p in step.layer.named_parameters():
+            spec = get_partition_spec(p)
+            if spec is not None and any(e is not None for e in tuple(spec)):
+                annotated[f"params/{name}"] = tuple(spec)
+    except Exception:
+        annotated = {}
     return audit_compiled(
         compiled, site=site, mesh=step.mesh, params=step.state["params"],
         state=step.state, zero=step.zero, dp_degree=dp,
-        suppress=suppress, do_emit=do_emit, mesh_label=label_of)
+        annotated_specs=annotated, suppress=suppress, do_emit=do_emit,
+        mesh_label=label_of)
 
 
 def audit_compile_events() -> List[dict]:

@@ -77,3 +77,53 @@ def desharded_zero_step(mesh, *, zero: int = 1, feature: int = 128,
     x = rng.randn(2 * max(1, dp), feature).astype("float32")
     y = rng.randn(2 * max(1, dp), feature).astype("float32")
     return step, (x, y), None
+
+
+def desharded_table_step(mesh, *, vocab: int = 1024, emb_dim: int = 8,
+                         num_slots: int = 8, dense_dim: int = 4):
+    """A deliberately DE-SHARDED embedding-table train step: builds a
+    ``ShardedWideDeep`` whose table parameter is annotated
+    ``P(axis, None)`` (row-partitioned over the mesh), then drops the
+    sharding from the compiled state — the table is stored FULL on every
+    device, exactly what a refactor that loses the annotation→layout
+    plumbing would do silently.  The ``hlo-full-gather`` pass must flag
+    the full-table replication at ERROR (the annotation contract: the
+    model says sharded, the executable stores replicated).
+
+    Returns ``(step, inputs, label)`` ready for
+    :func:`~.audit.audit_train_step`.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import paddle_tpu as paddle
+    from ...parallel import TrainStep
+    from ...rec.sharded_embedding import ShardedWideDeep
+
+    paddle.seed(0)
+    model = ShardedWideDeep(vocab=vocab, emb_dim=emb_dim,
+                            num_slots=num_slots, dense_dim=dense_dim,
+                            hidden=(16,), mesh=mesh)
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=1e-3)
+    step = TrainStep(model, opt, mesh=mesh, zero=0, donate=True)
+    state = step.state                      # materialize the honest layout
+    rep = NamedSharding(step.mesh, P())
+    # drop the table's sharding (param + its optimizer accumulators) —
+    # the layer's annotation stays, so the audit sees the contradiction
+    for name in list(step._shardings["params"]):
+        if name.endswith("table"):
+            step._shardings["params"][name] = rep
+            state["params"][name] = jax.device_put(
+                np.asarray(state["params"][name]), rep)
+            for s in step._shardings["opt"]:
+                if name in step._shardings["opt"][s]:
+                    step._shardings["opt"][s][name] = rep
+                    state["opt"][s][name] = jax.device_put(
+                        np.asarray(state["opt"][s][name]), rep)
+
+    dp = dict(step.mesh.shape).get("dp", 1)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (2 * max(1, dp), num_slots))
+    dense = rng.randn(2 * max(1, dp), dense_dim).astype("float32")
+    labels = (rng.rand(2 * max(1, dp), 1) > 0.5).astype("float32")
+    return step, (ids, dense, labels), None

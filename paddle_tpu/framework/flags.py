@@ -311,6 +311,39 @@ define_flag("autoshard_rules",
             "by PADDLE_TPU_AUTOSHARD_RULES.",
             validator=lambda v: bool(str(v).strip()))
 
+# ---- Mesh-sharded embedding tables (paddle_tpu.rec.sharded_embedding) -------
+define_flag("sharded_embedding",
+            os.environ.get("PADDLE_TPU_SHARDED_EMB", "").lower()
+            in ("1", "true", "yes", "on"),
+            "Row-partition the CTR deep-leg embedding table over a mesh "
+            "axis with in-graph all-to-all lookup (rec/sharded_embedding."
+            "py): deduped ids bucket by owner shard, route via "
+            "lax.all_to_all inside shard_map, gather from the local table "
+            "slice and route back — the HeterPS hashtable seat done "
+            "TPU-style, opening tables single-chip HBM cannot hold. "
+            "Consumed by WideDeepTrainer (cached mode: the hot-row device "
+            "cache short-circuits the all-to-all for the skewed head; "
+            "only cache misses route) and HeterTrainer (device service "
+            "leg). OFF by default: the replicated/host-table path is "
+            "unchanged and bit-identical (one Python branch at trainer "
+            "construction). Seeded by PADDLE_TPU_SHARDED_EMB.")
+define_flag("sharded_embedding_axis", "dp",
+            "Mesh axis the sharded embedding tables row-partition over "
+            "(P(axis, None) on the table parameter, so ZeRO/autoshard "
+            "layering composes). 'dp' rides the widest axis of CTR "
+            "meshes; any named axis of the live mesh is accepted.",
+            validator=lambda v: str(v) in ("dp", "mp", "pp", "sp"))
+define_flag("sharded_embedding_bucket_cap", 0,
+            "Static per-destination bucket capacity for the all-to-all "
+            "routing (ids each shard may send to one owner per step). 0 "
+            "= auto: the safe cap (the shard's whole request slice — no "
+            "overflow possible). A positive cap shrinks the routed "
+            "buffers for flat id distributions; the trainers detect "
+            "overflow (one scalar D2H, the device-dedup protocol) and "
+            "re-run one octave up, so a too-small cap costs recompiles, "
+            "never correctness.",
+            validator=lambda v: int(v) >= 0)
+
 # ---- Serving engine (paddle_tpu.serving) ------------------------------------
 define_flag("serving_buckets", "1,2,4,8,16,32,64",
             "Default batch-bucket ladder for the serving engine: pending "

@@ -37,6 +37,7 @@ from .industrial import (  # noqa: F401
 from .int8 import (  # noqa: F401
     linear_int8, conv2d_int8, matmul_int8,
 )
+from . import routing  # noqa: F401  (mesh all-to-all row routing, ISSUE 10)
 from .longtail import (  # noqa: F401
     rank_attention, pyramid_hash, tree_conv, correlation, prroi_pool,
     similarity_focus, deformable_psroi_pooling, roi_perspective_transform,

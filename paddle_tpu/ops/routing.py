@@ -1,0 +1,286 @@
+"""Mesh routing primitives: static-cap owner bucketing + all-to-all row moves.
+
+Reference parity: the HeterPS sparse-table shards
+(framework/fleet/heter_ps/hashtable.h — per-GPU hash shards, ids routed to
+the owning card before the gather) and the PS shard rule
+(distributed/ps — ``id % shard_num`` picks the server).  TPU-first: there
+is no RPC hop; the table is ONE array row-partitioned over a mesh axis
+(``P(axis, None)``) and the id routing is a ``lax.all_to_all`` inside
+``shard_map``, entirely inside the jitted step — steady state moves only
+ICI bytes, zero host bytes.
+
+Layout contract (every helper here shares it):
+
+  * a table of ``vocab`` logical rows over ``n`` shards stores
+    ``rps = ceil(vocab / n)`` real rows **plus one scratch row** per shard
+    — global shape ``[(rps + 1) * n, dim]``, sharded ``P(axis, None)``.
+    The scratch row (local index ``rps``) absorbs every padded/sentinel
+    request, so masked routing never needs a select against a real row
+    (duplicate-index scatter hazards collapse onto a row nobody reads).
+  * logical id ``i`` lives on shard ``i // rps`` at local row ``i % rps``;
+    :func:`storage_index` maps logical ids to rows of the global array.
+  * request vectors carry sentinel ``-1`` for padding; their length must
+    divide by ``n`` (each shard owns a ``U / n`` slice of the requests).
+
+Bucketing is STATIC-shape: each shard packs its requests into an
+``[n, cap]`` send buffer grouped by owner shard.  ``cap`` defaults to the
+whole per-shard slice (overflow impossible); a smaller cap shrinks the
+routed buffers and the pack reports ``overflow`` so callers can re-run an
+octave up (the device-dedup protocol of ``rec.wide_deep``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:                                     # jax >= 0.6
+    from jax import shard_map as _shard_map
+except ImportError:                      # pragma: no cover - version compat
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+__all__ = [
+    "PackPlan", "rows_per_shard", "storage_table_rows", "storage_index",
+    "pad_requests", "pack_by_owner", "all_to_all_gather", "all_to_all_set",
+    "all_to_all_apply_rule", "a2a_wire_bytes",
+]
+
+
+def rows_per_shard(vocab: int, n_shards: int) -> int:
+    """Real rows each shard owns for a ``vocab``-row table."""
+    return max(1, -(-int(vocab) // int(n_shards)))
+
+
+def storage_table_rows(vocab: int, n_shards: int) -> int:
+    """Global row count of the storage array (incl. per-shard scratch)."""
+    return (rows_per_shard(vocab, n_shards) + 1) * int(n_shards)
+
+
+def storage_index(ids, rps: int):
+    """Logical id -> row of the ``[(rps+1)*n, D]`` storage array (works on
+    numpy and jnp arrays; ids must be >= 0)."""
+    owner = ids // rps
+    return owner * (rps + 1) + (ids - owner * rps)
+
+
+def pad_requests(n: int, n_shards: int, pad) -> int:
+    """Octave-pad a request count AND round up to a shard multiple, so the
+    padded vector splits evenly over the routing axis.  ``pad`` is the
+    octave function (``pad_adaptive``-style); compile count stays bounded
+    by the octave ladder."""
+    base = max(int(n_shards), int(pad(max(1, n))))
+    return -(-base // n_shards) * n_shards
+
+
+class PackPlan(NamedTuple):
+    """One shard's static-shape owner bucketing of its request slice."""
+
+    send_ids: jnp.ndarray    # [n*cap] int32, grouped by owner, -1 padding
+    pos: jnp.ndarray         # [u] int32 slot of each request (-1 = dropped)
+    counts: jnp.ndarray      # [n] int32 per-owner request counts
+    overflow: jnp.ndarray    # bool: some owner's count exceeded cap
+
+
+def pack_by_owner(ids, *, n_shards: int, rps: int, cap: int) -> PackPlan:
+    """Group a request slice by owner shard into a ``[n*cap]`` send buffer.
+
+    ``ids`` is ``[u]`` int (sentinel ``< 0`` entries are excluded and never
+    consume cap).  Pure jnp — usable outside any mesh for tests, and
+    traced inside shard_map bodies for the real thing.
+    """
+    u = ids.shape[0]
+    ids = ids.astype(jnp.int32)
+    valid = ids >= 0
+    # sentinels sort AFTER every real owner so the grouped prefix is dense
+    owner = jnp.where(valid, ids // rps, n_shards)
+    order = jnp.argsort(owner)
+    so = owner[order]
+    rank = jnp.arange(u, dtype=jnp.int32) - jnp.searchsorted(
+        so, so, side="left").astype(jnp.int32)
+    ok = (so < n_shards) & (rank < cap)
+    # the +1 tail slot absorbs every dropped write (OOB-free scatter)
+    slot = jnp.where(ok, so.astype(jnp.int32) * cap + rank, n_shards * cap)
+    send = jnp.full((n_shards * cap + 1,), -1, jnp.int32).at[slot].set(
+        ids[order])[:-1]
+    pos = jnp.full((u,), -1, jnp.int32).at[order].set(
+        jnp.where(ok, slot, -1).astype(jnp.int32))
+    counts = jax.ops.segment_sum(valid.astype(jnp.int32),
+                                 jnp.clip(owner, 0, n_shards - 1),
+                                 num_segments=n_shards)
+    return PackPlan(send, pos, counts, jnp.max(counts) > cap)
+
+
+def _scatter_to_slots(values, pos, n_slots):
+    """Place per-request rows at their send-buffer slots (pos -1 dropped)."""
+    width = values.shape[1:]
+    buf = jnp.zeros((n_slots + 1,) + width, values.dtype)
+    slot = jnp.where(pos >= 0, pos, n_slots)
+    return buf.at[slot].set(values)[:-1]
+
+
+def _local_rows(req, rps: int, axis: str):
+    """Received request ids -> local row indices (scratch for sentinels)."""
+    me = lax.axis_index(axis)
+    return jnp.where(req >= 0, req - me * rps, rps)
+
+
+# ---------------------------------------------------------------------------
+# shard_map bodies
+# ---------------------------------------------------------------------------
+
+def _gather_body(ids_loc, *arrs_loc, axis, n, rps, cap):
+    plan = pack_by_owner(ids_loc, n_shards=n, rps=rps, cap=cap)
+    req = lax.all_to_all(plan.send_ids.reshape(n, cap), axis, 0, 0,
+                         tiled=True)                  # [n, cap] asks for MY rows
+    local = _local_rows(req, rps, axis)
+    outs = []
+    for a in arrs_loc:                                # each [rps+1, D]
+        rows = a[local]                               # [n, cap, D]
+        back = lax.all_to_all(rows, axis, 0, 0, tiled=True)
+        flat = back.reshape((n * cap,) + back.shape[2:])
+        got = flat[jnp.clip(plan.pos, 0, n * cap - 1)]
+        outs.append(jnp.where((plan.pos >= 0).reshape(
+            (-1,) + (1,) * (got.ndim - 1)), got, 0))
+    ovf = lax.pmax(plan.overflow.astype(jnp.int32), axis)
+    return (ovf,) + tuple(outs)
+
+
+def _set_body(ids_loc, rows_and_tables, axis, n, rps, cap, n_arrays):
+    rows_loc = rows_and_tables[:n_arrays]
+    arrs_loc = rows_and_tables[n_arrays:]
+    plan = pack_by_owner(ids_loc, n_shards=n, rps=rps, cap=cap)
+    req = lax.all_to_all(plan.send_ids.reshape(n, cap), axis, 0, 0,
+                         tiled=True)
+    local = _local_rows(req, rps, axis)
+    outs = []
+    for a, r in zip(arrs_loc, rows_loc):
+        buf = _scatter_to_slots(r, plan.pos, n * cap)
+        recv = lax.all_to_all(buf.reshape((n, cap) + buf.shape[1:]),
+                              axis, 0, 0, tiled=True)
+        outs.append(a.at[local].set(recv))
+    ovf = lax.pmax(plan.overflow.astype(jnp.int32), axis)
+    return (ovf,) + tuple(outs)
+
+
+def _apply_body(ids_loc, grads_loc, table_loc, *state_loc, axis, n, rps,
+                cap, opt, hyper, state_names):
+    plan = pack_by_owner(ids_loc, n_shards=n, rps=rps, cap=cap)
+    req = lax.all_to_all(plan.send_ids.reshape(n, cap), axis, 0, 0,
+                         tiled=True)
+    local = _local_rows(req, rps, axis)
+    gbuf = _scatter_to_slots(grads_loc, plan.pos, n * cap)
+    grecv = lax.all_to_all(gbuf.reshape((n, cap) + gbuf.shape[1:]),
+                           axis, 0, 0, tiled=True)
+    flat_local = local.reshape(-1)
+    rows = table_loc[flat_local]
+    st = {k: s[flat_local] for k, s in zip(state_names, state_loc)}
+    from ..distributed.ps.device_cache import apply_rule_device
+    new_rows, new_st = apply_rule_device(
+        opt, rows, st, grecv.reshape((n * cap,) + grecv.shape[2:]), **hyper)
+    # scratch entries carry zero grads: the rule is a no-op there, and
+    # duplicate scratch writes all land the same (irrelevant) value
+    new_table = table_loc.at[flat_local].set(new_rows)
+    new_state = tuple(state_loc[i].at[flat_local].set(new_st[k])
+                      for i, k in enumerate(state_names))
+    ovf = lax.pmax(plan.overflow.astype(jnp.int32), axis)
+    return (ovf, new_table) + new_state
+
+
+# ---------------------------------------------------------------------------
+# public wrappers
+# ---------------------------------------------------------------------------
+
+def _route_params(mesh, axis: str, n_ids: int, cap: Optional[int]):
+    n = int(dict(mesh.shape)[axis])
+    if n_ids % n:
+        raise ValueError(
+            f"routing over axis {axis!r} (size {n}) needs the request "
+            f"vector length ({n_ids}) divisible by the axis size — pad "
+            f"with sentinel -1 (ops.routing.pad_requests)")
+    u = n_ids // n
+    cap = u if not cap else min(int(cap), u)
+    return n, cap
+
+
+def all_to_all_gather(arrays: Sequence, ids, *, mesh, axis: str, rps: int,
+                      cap: Optional[int] = None):
+    """Routed multi-array row lookup.
+
+    ``arrays``: sharded ``[(rps+1)*n, D_i]`` storage arrays (rows +
+    optimizer-state planes travel in ONE routed exchange of ids).
+    ``ids``: ``[U]`` logical ids (sentinel -1), ``U % n == 0``.
+    Returns ``(rows_list, overflow)`` — each ``[U, D_i]`` aligned with
+    ``ids`` (zeros at sentinel slots), overflow an int32 scalar (>0 when
+    some shard's per-owner count exceeded ``cap``).
+    """
+    n, cap = _route_params(mesh, axis, ids.shape[0], cap)
+    body = functools.partial(_gather_body, axis=axis, n=n, rps=rps, cap=cap)
+    fn = _shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis),) + (P(axis, None),) * len(arrays),
+        out_specs=(P(),) + (P(axis, None),) * len(arrays),
+        check_rep=False)
+    out = fn(ids, *arrays)
+    return list(out[1:]), out[0]
+
+
+def all_to_all_set(arrays: Sequence, ids, rows: Sequence, *, mesh,
+                   axis: str, rps: int, cap: Optional[int] = None):
+    """Routed row import: write ``rows[i]`` (``[U, D_i]``, aligned with
+    ``ids``) into each storage array at the owner shards.  Sentinel ids
+    land on the owner's scratch row.  Returns ``(new_arrays, overflow)``.
+    """
+    n, cap = _route_params(mesh, axis, ids.shape[0], cap)
+
+    def wrapped(ids_loc, *packed):
+        return _set_body(ids_loc, packed, axis=axis, n=n, rps=rps, cap=cap,
+                         n_arrays=len(arrays))
+
+    fn = _shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(P(axis),) + (P(axis, None),) * len(arrays)
+        + (P(axis, None),) * len(arrays),
+        out_specs=(P(),) + (P(axis, None),) * len(arrays),
+        check_rep=False)
+    out = fn(ids, *rows, *arrays)
+    return list(out[1:]), out[0]
+
+
+def all_to_all_apply_rule(table, state: dict, ids, grads, *, opt: str,
+                          hyper: dict, mesh, axis: str, rps: int,
+                          cap: Optional[int] = None):
+    """Routed sparse-optimizer update: route ``(id, grad)`` pairs to the
+    owner shards, apply the on-chip rule (``device_cache.DEVICE_RULES``)
+    to the local rows + state, scatter in place.  The backward leg of the
+    all-to-all lookup: updates touch ONLY the owning shard's slice.
+    Returns ``(new_table, new_state, overflow)``."""
+    n, cap = _route_params(mesh, axis, ids.shape[0], cap)
+    names = tuple(sorted(state))
+    body = functools.partial(_apply_body, axis=axis, n=n, rps=rps, cap=cap,
+                             opt=opt, hyper=dict(hyper), state_names=names)
+    fn = _shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P(axis, None)) + (P(axis, None),) * (1 + len(names)),
+        out_specs=(P(),) + (P(axis, None),) * (1 + len(names)),
+        check_rep=False)
+    out = fn(ids, grads, table, *[state[k] for k in names])
+    new_state = {k: out[2 + i] for i, k in enumerate(names)}
+    return out[1], new_state, out[0]
+
+
+def a2a_wire_bytes(n_requests: int, dim: int, n_shards: int, cap: int,
+                   itemsize: int = 4, n_planes: int = 1) -> int:
+    """Ring-model per-device interconnect bytes of one routed gather:
+    ids out + ids' worth of row planes back (and the same shape again for
+    a set/update leg).  ``(n-1)/n`` of an all-to-all buffer actually
+    crosses the wire."""
+    n = int(n_shards)
+    if n <= 1:
+        return 0
+    buf_ids = n * cap * 4
+    buf_rows = n * cap * dim * itemsize * n_planes
+    return int((buf_ids + buf_rows) * (n - 1) / n)

@@ -5,6 +5,10 @@ from .heter import (  # noqa: F401
     HeterTrainer, create_trainer,
     TRAINER_LEDGER, DEVICE_WORKER_LEDGER, FLEET_WRAPPER_LEDGER,
 )
+from .sharded_embedding import (  # noqa: F401
+    ShardedEmbedding, ShardedTable, ShardedWideDeep,
+)
 
 __all__ = ["WideDeep", "WideDeepTrainer", "HogwildTrainer",
-           "PSGPUTrainer", "synthetic_ctr_batch"]
+           "PSGPUTrainer", "synthetic_ctr_batch", "ShardedEmbedding",
+           "ShardedTable", "ShardedWideDeep", "HeterTrainer"]

@@ -39,8 +39,11 @@ class HeterTrainer:
     (sparse_ids, dense_x, labels) batches; returns losses in completion
     order.  ``end_pass()`` drains and returns (the reference's EndPass)."""
 
-    def __init__(self, model: WideDeep, lr: float = 1e-3):
+    def __init__(self, model: WideDeep, lr: float = 1e-3,
+                 sharded_embedding: bool = None, sharded_vocab: int = None,
+                 mesh=None):
         from ..framework import functional as F
+        from ..framework.flags import flag as _flag
         self.model = model
         self.lr = float(lr)
         core = _DenseCore(model)
@@ -66,32 +69,166 @@ class HeterTrainer:
 
         self._step = jax.jit(step_fn)
 
+        # -- mesh-sharded deep leg (FLAGS_sharded_embedding) ------------------
+        # The heter pipeline's TPU-scale variant: the deep table lives
+        # row-partitioned ON the accelerator mesh, so the cpu workers stop
+        # pulling deep rows (host RPC leg shrinks to wide + ids), the
+        # device service routes the lookup via all-to-all inside its one
+        # jitted step, and the backward leg routes row gradients to the
+        # owner shards and applies the sparse rule to the local slice only
+        # — no deep push ever crosses the host boundary.
+        self._sharded = (bool(_flag("sharded_embedding"))
+                         if sharded_embedding is None
+                         else bool(sharded_embedding))
+        if self._sharded:
+            if sharded_vocab is None:
+                raise ValueError(
+                    "sharded embedding mode needs sharded_vocab: the id "
+                    "bound sizing the mesh-partitioned deep table")
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from .sharded_embedding import ShardedTable
+            de = model.deep_emb
+            kw = {k: v for k, v in de.table_kw.items()
+                  if k in ("eps", "l1", "l2", "lr_power")}
+            self._dtab = ShardedTable(de.dim, sharded_vocab,
+                                      optimizer=de.optimizer, lr=de.lr,
+                                      mesh=mesh, **kw)
+            self._dtab_tree = self._dtab.init_tree()
+            self._rep_sh = NamedSharding(self._dtab.mesh, P())
+            rep_put = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda v: jax.device_put(v, self._rep_sh), t)
+            self._params = rep_put(self._params)
+            self._adam = rep_put(self._adam)
+            self._sharded_fns = {}
+            dtab = self._dtab
+
+            def make_sharded_step(cap_u, cap_f):
+                def step(params, adam, dtree, wide_rows, uniq_r,
+                         fill_ids, fill_rows, fill_state, inv, dense_x,
+                         labels):
+                    # cold fill: first-sighting rows imported at owners
+                    dtree = dtab.set_rows(dtree, fill_ids, fill_rows,
+                                          fill_state, cap=cap_f)
+                    # routed lookup (rows only — state stays put)
+                    deep_rows, _st, _ovf = dtab.gather(
+                        dtree, uniq_r, cap=cap_u, with_state=False)
+
+                    def loss_of(p, wr, dr):
+                        out = apply(p, buffers, wr, dr, inv, inv, dense_x)
+                        x = out[0] if isinstance(out, tuple) else out
+                        return bce_with_logits_mean(x, labels)
+
+                    loss, (gp, gw, gd) = jax.value_and_grad(
+                        loss_of, argnums=(0, 1, 2))(params, wide_rows,
+                                                    deep_rows)
+                    new_params, new_adam = make_adam_update(self.lr)(
+                        params, adam, gp)
+                    # backward leg: row grads route to the owner shard
+                    dtree = dtab.apply_rule(dtree, uniq_r, gd, cap=cap_u)
+                    return loss, new_params, new_adam, dtree, gw
+                return step
+
+            self._make_sharded_step = make_sharded_step
+
     # -- pipeline stages ------------------------------------------------------
     def _cpu_leg(self, ids, dense_x, labels):
-        """HeterCpuWorker: unique + PS pull (host RPC leg)."""
+        """HeterCpuWorker: unique + PS pull (host RPC leg).  Sharded mode
+        pulls only the WIDE rows — deep rows live on the mesh; the leg
+        ships ids (padded for routing) plus first-sighting cold rows."""
         we, de = self.model.wide_emb, self.model.deep_emb
         ids = np.asarray(ids)
         uniq, inv = np.unique(ids, return_inverse=True)
         w_rows = jnp.asarray(we.pull_padded_rows(uniq))
-        d_rows = jnp.asarray(de.pull_padded_rows(uniq))
         inv_dev = jnp.asarray(inv.reshape(ids.shape), jnp.int32)
-        return (uniq, w_rows, d_rows, inv_dev, jnp.asarray(dense_x),
-                jnp.asarray(labels))
+        if not self._sharded:
+            d_rows = jnp.asarray(de.pull_padded_rows(uniq))
+            return (uniq, w_rows, d_rows, inv_dev, jnp.asarray(dense_x),
+                    jnp.asarray(labels))
+        from ..distributed.ps.device_cache import pad_adaptive
+        from ..ops.routing import pad_requests
+        self._dtab.check_ids(uniq)
+        n_sh = self._dtab.n_shards
+        u_pad = pad_requests(len(uniq), n_sh, pad_adaptive)
+        uniq_r = np.full(u_pad, -1, np.int32)
+        uniq_r[:len(uniq)] = uniq
+        # candidate cold ids: residency is CONFIRMED on the device thread
+        # (single owner of the table state), the export here just keeps
+        # the host RPC off the device leg's critical path
+        cold, _warm = self._dtab.split_cold_warm(uniq)
+        if len(cold):
+            c_rows, c_state = de.client.export_rows(de.table_id, cold)
+        else:
+            c_rows = np.zeros((0, de.dim), np.float32)
+            c_state = {k: np.zeros((0, de.dim), np.float32)
+                       for k in self._dtab.state_names}
+        f_pad = pad_requests(len(cold), n_sh, pad_adaptive)
+        fill_ids = np.full(f_pad, -1, np.int32)
+        fill_ids[:len(cold)] = cold
+        fill_rows = np.zeros((f_pad, de.dim), np.float32)
+        fill_rows[:len(cold)] = c_rows
+        fill_state = {}
+        for k in self._dtab.state_names:
+            buf = np.zeros((f_pad, de.dim), np.float32)
+            buf[:len(cold)] = c_state[k]
+            fill_state[k] = buf
+        return (uniq, w_rows, uniq_r, fill_ids, fill_rows, fill_state,
+                inv_dev, np.asarray(dense_x, np.float32),
+                np.asarray(labels, np.float32))
 
     def _device_leg(self, task):
         """RunTask: the dense section on the chip; owns param state."""
+        if self._sharded:
+            return self._device_leg_sharded(task)
         uniq, w_rows, d_rows, inv_dev, dense_x, labels = task
         loss, self._params, self._adam, gw, gd = self._step(
             self._params, self._adam, w_rows, d_rows, inv_dev, dense_x,
             labels)
         return uniq, gw, gd, loss
 
+    def _device_leg_sharded(self, task):
+        """Sharded RunTask: the ONE thread that owns the table state also
+        owns residency, so double-fills from racing cpu workers are
+        dropped here (a stale fill would overwrite on-device training)."""
+        import jax as _jax
+        (uniq, w_rows, uniq_r, fill_ids, fill_rows, fill_state, inv_dev,
+         dense_x, labels) = task
+        live = fill_ids >= 0
+        if live.any():
+            resident = np.fromiter(
+                (int(i) in self._dtab.resident for i in fill_ids[live]),
+                bool, int(live.sum()))
+            if resident.any():
+                drop = np.zeros_like(live)
+                drop[np.nonzero(live)[0][resident]] = True
+                fill_ids = np.where(drop, -1, fill_ids)
+            self._dtab.resident.update(int(i) for i in fill_ids[fill_ids >= 0])
+        n_sh = self._dtab.n_shards
+        cap_u = (self._dtab.cap_for(uniq, len(uniq_r) // n_sh)
+                 if self._dtab.bucket_cap else len(uniq_r) // n_sh)
+        cap_f = len(fill_ids) // n_sh
+        key = (len(uniq_r), len(fill_ids), inv_dev.shape, cap_u)
+        fn = self._sharded_fns.get(key)
+        if fn is None:
+            fn = _jax.jit(self._make_sharded_step(cap_u, cap_f),
+                          donate_argnums=(2,))
+            self._sharded_fns[key] = fn
+        rep = lambda x: _jax.device_put(jnp.asarray(x),  # noqa: E731
+                                        self._rep_sh)
+        loss, self._params, self._adam, self._dtab_tree, gw = fn(
+            self._params, self._adam, self._dtab_tree, rep(w_rows),
+            rep(uniq_r), rep(fill_ids), rep(fill_rows),
+            {k: rep(v) for k, v in fill_state.items()}, rep(inv_dev),
+            rep(dense_x), rep(labels))
+        return uniq, gw, None, loss
+
     def _push_leg(self, uniq, gw, gd):
-        """Sparse push back to the PS (host RPC leg)."""
+        """Sparse push back to the PS (host RPC leg).  Sharded mode has no
+        deep push — the rule already ran on the owner shards."""
         we, de = self.model.wide_emb, self.model.deep_emb
         n = len(uniq)
         we.client.push_sparse(we.table_id, uniq, np.asarray(gw)[:n])
-        de.client.push_sparse(de.table_id, uniq, np.asarray(gd)[:n])
+        if gd is not None:
+            de.client.push_sparse(de.table_id, uniq, np.asarray(gd)[:n])
 
     # -- drive ----------------------------------------------------------------
     def train(self, batches: Iterable, num_cpu_workers: int = 2,
@@ -168,8 +305,14 @@ class HeterTrainer:
         return losses
 
     def end_pass(self):
-        """EndPass: nothing buffered outside the queues once train()
-        returns; provided for factory-API parity."""
+        """EndPass: drain trained state the host can't see — in sharded
+        mode the mesh-resident deep rows (+optimizer state) write back to
+        the host PS table; otherwise nothing is buffered outside the
+        queues once train() returns."""
+        if self._sharded:
+            de = self.model.deep_emb
+            self._dtab.flush_to_client(self._dtab_tree, de.client,
+                                       de.table_id)
 
     def sync_params(self):
         """MergeToRootScope: point the eager model's dense params at the

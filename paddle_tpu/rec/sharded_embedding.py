@@ -1,0 +1,331 @@
+"""Mesh-sharded embedding tables with in-graph all-to-all lookup.
+
+Reference parity: the HeterPS hash-table shards
+(framework/fleet/heter_ps/hashtable.h — each GPU owns a shard of the
+sparse table; ids route to the owning card, gather there, and route back)
+and the PS shard rule (distributed/ps/ ``id % shard_num``).  The reference
+needs that machinery because CTR embedding tables outgrow one device; the
+TPU-native answer keeps the table ON the mesh: row-partitioned over a mesh
+axis (``P(axis, None)`` on the parameter, so ZeRO/autoshard layering
+composes) with the id routing as ``lax.all_to_all`` inside ``shard_map``
+(ops/routing.py), entirely inside the jitted step.  A billion-row table
+single-chip HBM cannot hold becomes ``rows / axis_size`` per chip, and the
+lookup costs ICI bytes instead of a parameter-server RPC.
+
+Three consumption tiers:
+
+  * :class:`ShardedEmbedding` — an ``nn.Layer`` whose ``table`` parameter
+    is the sharded storage; ``forward`` dedups ids on device
+    (``sort_unique_static``), routes the unique set, gathers and scatters
+    back to row order.  Differentiable end-to-end (the all-to-all
+    transposes to the reverse route), so ``TrainStep``/autoshard/ZeRO all
+    compose — the generic-autodiff tier, used by the HLO-audit and bench
+    builders.
+  * :class:`ShardedTable` — the trainer-facing runtime: the same storage
+    plus per-row optimizer-state planes and host-side residency
+    bookkeeping, with routed gather / set / rule-update entry points that
+    trainers call INSIDE their own jitted steps (manual sparse updates:
+    row gradients route to the owner shard and update only its slice —
+    no dense vocab-sized gradient ever materializes).
+  * ``WideDeepTrainer`` / ``HeterTrainer`` integration (rec/wide_deep.py,
+    rec/heter.py) behind ``FLAGS_sharded_embedding``: the deep-leg table
+    lives on the mesh, composed with the hot-row device cache
+    (distributed/ps/device_cache.py) so the skewed head short-circuits
+    the all-to-all — only cache misses route.
+
+Storage layout: see ops/routing.py (``rps = ceil(vocab / n)`` real rows
+plus one scratch row per shard; :func:`~..ops.routing.storage_index` maps
+logical ids to storage rows).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import nn
+from ..framework import flags as _flags
+from ..ops import routing as _routing
+
+__all__ = ["ShardedEmbedding", "ShardedTable", "ShardedWideDeep",
+           "sharded_axis", "sharded_bucket_cap"]
+
+
+def sharded_axis() -> str:
+    return str(_flags.flag("sharded_embedding_axis"))
+
+
+def sharded_bucket_cap() -> int:
+    return int(_flags.flag("sharded_embedding_bucket_cap"))
+
+
+def _axis_size(mesh, axis: str) -> int:
+    n = dict(mesh.shape).get(axis, 0)
+    if n < 1:
+        raise ValueError(
+            f"sharded embedding axis {axis!r} is not an axis of the mesh "
+            f"{dict(mesh.shape)} (FLAGS_sharded_embedding_axis)")
+    return int(n)
+
+
+class ShardedEmbedding(nn.Layer):
+    """Embedding whose table is row-partitioned over a mesh axis.
+
+    The ``table`` parameter has storage shape ``[(rps+1)*n, dim]``
+    (per-shard scratch rows included) and carries a ``P(axis, None)``
+    annotation, so ``TrainStep`` stores it sharded, ZeRO layers its own
+    dp shard on top idempotently, and the ``rec-embedding`` autoshard
+    rule recognizes the ``.table`` path.  ``forward(ids)`` runs the full
+    dedup → all-to-all route → gather → inverse-scatter chain in-graph.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, *,
+                 mesh=None, axis: Optional[str] = None,
+                 bucket_cap: Optional[int] = None, weight_attr=None,
+                 annotate: bool = True):
+        super().__init__()
+        from ..parallel.mesh import get_mesh
+        from .. import nn as _nn
+        self.mesh = mesh or get_mesh()
+        self.axis = axis or sharded_axis()
+        self.n_shards = _axis_size(self.mesh, self.axis)
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.rps = _routing.rows_per_shard(num_embeddings, self.n_shards)
+        self.bucket_cap = (sharded_bucket_cap() if bucket_cap is None
+                           else int(bucket_cap))
+        rows = _routing.storage_table_rows(num_embeddings, self.n_shards)
+        self.table = self.create_parameter(
+            [rows, embedding_dim], attr=weight_attr,
+            default_initializer=_nn.initializer.XavierUniform())
+        # scratch rows zero: they absorb sentinel routing and must not
+        # leak initializer noise into masked slots
+        scratch = _routing.storage_index(
+            np.arange(self.n_shards) * self.rps, self.rps) + self.rps
+        self.table.set_value(self.table._value.at[jnp.asarray(scratch)]
+                             .set(0.0))
+        if annotate:
+            from ..parallel.api import shard_parameter
+            shard_parameter(self.table, P(self.axis, None))
+
+    # -- in-graph pieces -----------------------------------------------------
+    def lookup_unique(self, uniq_ids, table=None):
+        """Routed gather of already-unique ids ``[U]`` (sentinel -1,
+        ``U % n_shards == 0``) -> ``([U, D] rows, overflow)``."""
+        t = self.table._value if table is None else table
+        rows, ovf = _routing.all_to_all_gather(
+            [t], uniq_ids, mesh=self.mesh, axis=self.axis, rps=self.rps,
+            cap=self.bucket_cap or None)
+        return rows[0], ovf
+
+    def forward(self, ids, table=None):
+        from ..framework.tensor import Tensor
+        from .wide_deep import sort_unique_static
+        x = ids._value if isinstance(ids, Tensor) else jnp.asarray(ids)
+        flat = x.reshape(-1).astype(jnp.int32)
+        u_pad = _routing.pad_requests(flat.shape[0], self.n_shards,
+                                      lambda n: n)
+        uniq, inv, count, _counts = sort_unique_static(
+            jnp.pad(flat, (0, u_pad - flat.shape[0]),
+                    constant_values=0) if u_pad != flat.shape[0] else flat,
+            cap=u_pad)
+        # dedup pads uniq with zeros beyond count — sentinel them so the
+        # router drops them instead of hammering row 0
+        uniq = jnp.where(jnp.arange(u_pad) < count, uniq.astype(jnp.int32),
+                         -1)
+        rows, _ovf = self.lookup_unique(uniq, table=table)
+        out = rows[inv[:flat.shape[0]]].reshape(tuple(x.shape)
+                                                + (self.embedding_dim,))
+        return Tensor(out) if isinstance(ids, Tensor) else out
+
+    def extra_repr(self):
+        return (f"{self.num_embeddings}, {self.embedding_dim}, "
+                f"axis={self.axis!r}, shards={self.n_shards}")
+
+
+class ShardedTable:
+    """Trainer-facing mesh-sharded row store: rows + per-row optimizer
+    state on the mesh, host-side residency bookkeeping.
+
+    The device arrays are OWNED BY THE CALLER's jitted step (pass the
+    tree in, get the updated tree back, donate for in-place HBM reuse) —
+    the ``DeviceEmbeddingCache`` arena discipline, at mesh scale.  The
+    ``resident`` set tracks which logical ids currently live in the
+    device table (vs. the host PS table / a hot-row cache arena), so
+    trainers can split cold misses (host fetch, once per id) from warm
+    misses (in-graph all-to-all, zero host row bytes).
+    """
+
+    def __init__(self, dim: int, vocab: int, *, optimizer: str = "adagrad",
+                 mesh=None, axis: Optional[str] = None,
+                 bucket_cap: Optional[int] = None, lr: float = 0.05,
+                 eps: float = 1e-8, l1: float = 0.0, l2: float = 0.0,
+                 lr_power: float = -0.5):
+        from ..distributed.ps.device_cache import DEVICE_RULES
+        from ..distributed.ps.table import _STATE_SPEC
+        from ..parallel.mesh import get_mesh
+        if optimizer not in DEVICE_RULES:
+            raise ValueError(
+                f"sharded table rule {optimizer!r} not in {DEVICE_RULES}")
+        self.mesh = mesh or get_mesh()
+        self.axis = axis or sharded_axis()
+        self.n_shards = _axis_size(self.mesh, self.axis)
+        self.dim = int(dim)
+        self.vocab = int(vocab)
+        self.rps = _routing.rows_per_shard(vocab, self.n_shards)
+        self.opt = optimizer
+        self.hyper = dict(lr=lr, eps=eps, l1=l1, l2=l2, lr_power=lr_power)
+        self.state_names = tuple(_STATE_SPEC[optimizer])
+        self.bucket_cap = (sharded_bucket_cap() if bucket_cap is None
+                           else int(bucket_cap))
+        self.resident: set = set()
+        self._sharding = NamedSharding(self.mesh, P(self.axis, None))
+
+    # -- storage -------------------------------------------------------------
+    def init_tree(self) -> Dict:
+        rows = _routing.storage_table_rows(self.vocab, self.n_shards)
+        z = lambda: jax.device_put(  # noqa: E731
+            jnp.zeros((rows, self.dim), jnp.float32), self._sharding)
+        return {"rows": z(), "state": {k: z() for k in self.state_names}}
+
+    def _leaves(self, tree):
+        return [tree["rows"]] + [tree["state"][k] for k in self.state_names]
+
+    def _tree_of(self, leaves):
+        return {"rows": leaves[0],
+                "state": dict(zip(self.state_names, leaves[1:]))}
+
+    # -- host-side bookkeeping ----------------------------------------------
+    def check_ids(self, ids: np.ndarray) -> None:
+        if len(ids) and int(ids.max()) >= self.rps * self.n_shards:
+            raise ValueError(
+                f"id {int(ids.max())} exceeds the sharded table's row "
+                f"space ({self.rps * self.n_shards}; vocab={self.vocab}) "
+                f"— raise the table's vocab bound")
+
+    def split_cold_warm(self, ids: np.ndarray):
+        """(cold, warm) partition of a miss-id vector by residency."""
+        if not len(ids):
+            return ids, ids
+        res = self.resident
+        warm = np.fromiter((int(i) in res for i in ids), bool, len(ids))
+        return ids[~warm], ids[warm]
+
+    def cap_for(self, ids: np.ndarray, u: int) -> int:
+        """Static routing cap for one padded request vector: per-owner
+        host counts picked up to the octave, floored by the flag cap —
+        overflow is impossible by construction, so the step never needs a
+        D2H overflow fence (``u`` = per-shard slice length bounds it)."""
+        from ..distributed.ps.device_cache import pad_adaptive
+        need = 1
+        if len(ids):
+            need = int(np.bincount(ids // self.rps,
+                                   minlength=self.n_shards).max())
+        cap = max(self.bucket_cap or 0, pad_adaptive(need))
+        return int(min(cap, u))
+
+    # -- in-graph entry points (call inside the trainer's jitted step) -------
+    def gather(self, tree, ids, cap=None, with_state: bool = True):
+        """Routed lookup of rows (and, with ``with_state``, the optimizer
+        state planes): ``[U]`` ids (sentinel -1) ->
+        (rows [U,D], state {k: [U,D]} | {}, overflow)."""
+        leaves = self._leaves(tree) if with_state else [tree["rows"]]
+        outs, ovf = _routing.all_to_all_gather(
+            leaves, ids, mesh=self.mesh, axis=self.axis,
+            rps=self.rps, cap=cap)
+        state = dict(zip(self.state_names, outs[1:])) if with_state else {}
+        return outs[0], state, ovf
+
+    def set_rows(self, tree, ids, rows, state, cap=None):
+        """Routed import of rows + state at their owner shards (victim
+        writeback / cold fill); sentinel ids land on scratch."""
+        new, _ovf = _routing.all_to_all_set(
+            self._leaves(tree), ids,
+            [rows] + [state[k] for k in self.state_names],
+            mesh=self.mesh, axis=self.axis, rps=self.rps, cap=cap)
+        return self._tree_of(new)
+
+    def apply_rule(self, tree, ids, grads, cap=None):
+        """Routed sparse-optimizer update: the backward leg — row grads
+        route to the owner shard and update ONLY its local slice."""
+        new_rows, new_state, _ovf = _routing.all_to_all_apply_rule(
+            tree["rows"], dict(tree["state"]), ids, grads, opt=self.opt,
+            hyper=self.hyper, mesh=self.mesh, axis=self.axis, rps=self.rps,
+            cap=cap)
+        return {"rows": new_rows, "state": new_state}
+
+    # -- host data movement --------------------------------------------------
+    def host_read(self, tree, ids: np.ndarray):
+        """Device gather + D2H of rows (and state) for logical ids — the
+        flush/eval read path; no routing (storage_index is global)."""
+        idx = jnp.asarray(_routing.storage_index(
+            np.asarray(ids, np.int64), self.rps))
+        rows = np.asarray(tree["rows"][idx])
+        state = {k: np.asarray(tree["state"][k][idx])
+                 for k in self.state_names}
+        return rows, state
+
+    def host_write(self, tree, ids: np.ndarray, rows, state):
+        """Direct (unrouted) H2D import at logical ids — init/prefill."""
+        idx = jnp.asarray(_routing.storage_index(
+            np.asarray(ids, np.int64), self.rps))
+        new = {"rows": tree["rows"].at[idx].set(jnp.asarray(rows)),
+               "state": {k: tree["state"][k].at[idx].set(
+                   jnp.asarray(state[k])) for k in self.state_names}}
+        return new
+
+    def flush_to_client(self, tree, client, table_id: int) -> int:
+        """Write every resident row (+state) back to the host PS table —
+        the EndPass leg for the mesh-resident tail.  Returns row count."""
+        ids = np.fromiter(self.resident, np.int64, len(self.resident))
+        if not len(ids):
+            return 0
+        rows, state = self.host_read(tree, ids)
+        client.import_rows(table_id, ids, rows, state)
+        return len(ids)
+
+
+class ShardedWideDeep(nn.Layer):
+    """Dense Wide&Deep CTR core over a :class:`ShardedEmbedding` deep leg
+    — the generic-autodiff tier: one ``TrainStep`` carries the routed
+    lookup, the dense MLP, and the table update (as a dense sharded
+    gradient) in a single SPMD program.  This is the HLO-audit / bench /
+    autoshard seat; the production trainers use the manual sparse-update
+    path instead (``WideDeepTrainer`` + ``ShardedTable``).
+
+    ``forward(ids, dense_x)`` -> logits; with ``labels`` -> mean BCE loss.
+    """
+
+    def __init__(self, vocab: int = 4096, emb_dim: int = 16,
+                 num_slots: int = 26, dense_dim: int = 13,
+                 hidden=(64, 32), *, mesh=None, axis: Optional[str] = None):
+        super().__init__()
+        self.num_slots = int(num_slots)
+        self.deep_emb = ShardedEmbedding(vocab, emb_dim, mesh=mesh,
+                                         axis=axis)
+        layers = []
+        in_dim = num_slots * emb_dim + dense_dim
+        for h in hidden:
+            layers += [nn.Linear(in_dim, h), nn.ReLU()]
+            in_dim = h
+        layers.append(nn.Linear(in_dim, 1))
+        self.dnn = nn.Sequential(*layers)
+        self.wide_dense = nn.Linear(dense_dim, 1)
+
+    def forward(self, sparse_ids, dense_x, labels=None):
+        from .. import ops
+        from .wide_deep import bce_with_logits_mean
+        deep = self.deep_emb(sparse_ids)
+        deep_in = deep.reshape([deep.shape[0], -1])
+        logits = self.dnn(ops.concat([deep_in, dense_x], axis=-1)) \
+            + self.wide_dense(dense_x)
+        if labels is None:
+            return logits
+        from ..framework.tensor import Tensor
+        lab = labels._value if isinstance(labels, Tensor) else labels
+        lg = logits._value if isinstance(logits, Tensor) else logits
+        loss = bce_with_logits_mean(lg, lab)
+        return Tensor(loss) if isinstance(logits, Tensor) else loss

@@ -144,9 +144,12 @@ class WideDeepTrainer:
     def __init__(self, model: WideDeep, lr: float = 1e-3,
                  async_push: bool = False, device_cache: bool = None,
                  cache_capacity: int = 1 << 20,
-                 feature_wire_dtype="float32"):
+                 feature_wire_dtype="float32",
+                 sharded_embedding: bool = None,
+                 sharded_vocab: int = None, mesh=None):
         import jax
         from ..framework import functional as F
+        from ..framework.flags import flag as _flag
         from ..distributed.ps.device_cache import (
             DeviceEmbeddingCache, SlotDirectory, DEVICE_RULES,
             apply_rule_device, pad_adaptive)
@@ -308,6 +311,108 @@ class WideDeepTrainer:
             self._fused_cached = jax.jit(fused_cached,
                                          donate_argnums=(0, 1, 2, 3))
 
+        # -- mesh-sharded deep table (FLAGS_sharded_embedding) ---------------
+        # The HeterPS hashtable seat done TPU-style: the deep-leg table is
+        # row-partitioned over a mesh axis; the hot-row cache arena keeps
+        # the skewed head replicated (zero routing for hits), warm misses
+        # route via lax.all_to_all INSIDE the jitted step (zero host row
+        # bytes), and only cold ids (first sighting) pay a host PS fetch.
+        # Off-path = this one branch; the replicated path is unchanged.
+        self._sharded = (bool(_flag("sharded_embedding"))
+                         if sharded_embedding is None
+                         else bool(sharded_embedding))
+        if self._sharded and not self._use_cache:
+            raise ValueError(
+                "FLAGS_sharded_embedding composes with device-cache mode "
+                "only (the hot-row arena is the short-circuit for the "
+                "skewed head); pull/push + sharded tables is the "
+                "HeterTrainer seat")
+        if self._sharded:
+            if sharded_vocab is None:
+                raise ValueError(
+                    "sharded embedding mode needs sharded_vocab: the id "
+                    "bound sizing the mesh-partitioned deep table")
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from .sharded_embedding import ShardedTable
+            de = model.deep_emb
+            kw = {k: v for k, v in de.table_kw.items()
+                  if k in ("eps", "l1", "l2", "lr_power")}
+            self._dtab = ShardedTable(de.dim, sharded_vocab,
+                                      optimizer=de.optimizer, lr=de.lr,
+                                      mesh=mesh, **kw)
+            self._dtab_tree = self._dtab.init_tree()
+            # one jitted program must see consistently-placed operands:
+            # dense state + arenas replicate onto the table's mesh
+            self._rep_sh = NamedSharding(self._dtab.mesh, P())
+            rep_put = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda v: jax.device_put(v, self._rep_sh), t)
+            self._params = rep_put(self._params)
+            self._adam = rep_put(self._adam)
+            self._w_ar = rep_put(self._w_ar)
+            self._d_ar = rep_put(self._d_ar)
+            self._sharded_fns = {}       # (shape/cap key) -> jitted step
+            de._cache_read = self._sharded_read
+            dtab = self._dtab
+
+            def make_sharded_fused(cap_v, cap_w):
+                """One compiled sharded step per (padded-shape, cap)
+                signature — caps are static routing-buffer bounds, octave
+                -laddered host-side so the compile count stays bounded."""
+                def fused(params, adam, w_ar, d_ar, dtree, slots_w,
+                          slots_d, inv, dense_x, labels, vic_ids,
+                          vic_slots, warm_ids, warm_slots, cold_slots,
+                          cold_rows, cold_state):
+                    inv32 = inv.astype(jnp.int32)
+                    dense32 = dense_x.astype(jnp.float32)
+                    lab32 = labels.astype(jnp.float32)
+                    # 1. victims: arena -> sharded table (routed SET; the
+                    # arena reads precede every arena scatter this step)
+                    vrows = d_ar["rows"][vic_slots]
+                    vstate = {k: d_ar["state"][k][vic_slots]
+                              for k in d_ar["state"]}
+                    dtree = dtab.set_rows(dtree, vic_ids, vrows, vstate,
+                                          cap=cap_v)
+                    # 2. cold misses (first sighting, host-fetched rows)
+                    d_ar = {"rows": d_ar["rows"].at[cold_slots].set(
+                                cold_rows),
+                            "state": {k: d_ar["state"][k].at[
+                                cold_slots].set(cold_state[k])
+                                for k in d_ar["state"]}}
+                    # 3. warm misses: routed all-to-all fetch, table ->
+                    # arena — the steady-state tail traffic; the cached
+                    # head never reaches this exchange
+                    wrows, wstate, _ovf = dtab.gather(dtree, warm_ids,
+                                                      cap=cap_w)
+                    d_ar = {"rows": d_ar["rows"].at[warm_slots].set(
+                                wrows),
+                            "state": {k: d_ar["state"][k].at[
+                                warm_slots].set(wstate[k])
+                                for k in d_ar["state"]}}
+                    # 4. dense fwd/bwd + on-chip sparse rule (the
+                    # fused_cached body, unchanged numerics)
+                    w_rows = w_ar["rows"][slots_w]
+                    d_rows = d_ar["rows"][slots_d]
+
+                    def loss_of(p, wr, dr):
+                        out = apply(p, buffers, wr, dr, inv32, inv32,
+                                    dense32)
+                        x = out[0] if isinstance(out, tuple) else out
+                        return bce_mean(x, lab32)
+
+                    (loss), grads = jax.value_and_grad(
+                        loss_of, argnums=(0, 1, 2))(params, w_rows,
+                                                    d_rows)
+                    gp, gw, gd = grads
+                    new_p, new_adam = adam_update(params, adam, gp)
+                    w_ar = rule_and_scatter(w_ar, slots_w, w_rows, gw,
+                                            hy_w)
+                    d_ar = rule_and_scatter(d_ar, slots_d, d_rows, gd,
+                                            hy_d)
+                    return new_p, new_adam, w_ar, d_ar, dtree, loss
+                return fused
+
+            self._make_sharded_fused = make_sharded_fused
+
     def _raise_push_errors(self):
         if self._push_err:
             errs = list(self._push_err)
@@ -423,7 +528,177 @@ class WideDeepTrainer:
         inv_w = inv_w.astype(np.uint16 if u_pad <= 65536 else np.int32)
         return jnp.asarray(slots_p), jnp.asarray(inv_w)
 
+    # -- mesh-sharded deep leg (FLAGS_sharded_embedding) ----------------------
+    def _pad_routed(self, ids, slots, scratch_slot):
+        """Pad an (ids, slots) pair for routing: octave length rounded to
+        a shard multiple, sentinel -1 ids (the router drops them) and
+        scratch arena slots (their scatters land on the arena's spare
+        row).  Returns (ids [P] int32, slots [P] int32)."""
+        from ..distributed.ps.device_cache import pad_adaptive
+        from ..ops.routing import pad_requests
+        n = len(ids)
+        p = pad_requests(n, self._dtab.n_shards, pad_adaptive)
+        out_ids = np.full(p, -1, np.int32)
+        out_ids[:n] = ids
+        out_slots = np.full(p, scratch_slot, np.int32)
+        out_slots[:n] = slots
+        return out_ids, out_slots
+
+    def _prep_sharded(self, sparse_ids):
+        """Host side of a sharded cached step: dedup + ONE slot
+        resolution (shared with the wide table), wide fill from the host
+        PS exactly as the replicated path, then the deep-side three-way
+        split — victims route arena→table, warm misses route table→arena
+        (both in-graph), cold misses pay the one-time host fetch."""
+        from ..framework.flags import flag
+        ids = np.asarray(sparse_ids)
+        if flag("wide_deep_device_dedup"):
+            uniq, inv = self._dedup_device(ids)
+        else:
+            uniq, inv = np.unique(ids, return_inverse=True)
+        self._dtab.check_ids(uniq)
+        res = self._slot_dir.resolve(uniq)
+        try:
+            # wide leg: unchanged host fill (incl. wide victim writeback)
+            mw_slots, mw_rows, mw_state = self._w_cache.fill(res,
+                                                             self._w_ar)
+            # deep cold misses: ids never seen by the device table yet
+            miss_ids = res.uniq[res.miss_idx]
+            miss_slots = np.asarray(res.slots[res.miss_idx], np.int64)
+            cold_sel = np.fromiter(
+                (int(i) not in self._dtab.resident for i in miss_ids),
+                bool, len(miss_ids))
+            cold_ids, cold_slots = miss_ids[cold_sel], miss_slots[cold_sel]
+            warm_ids, warm_slots = (miss_ids[~cold_sel],
+                                    miss_slots[~cold_sel])
+            de = self.model.deep_emb
+            if len(cold_ids):
+                c_rows, c_state = de.client.export_rows(de.table_id,
+                                                        cold_ids)
+            else:
+                c_rows = np.zeros((0, de.dim), np.float32)
+                c_state = {k: np.zeros((0, de.dim), np.float32)
+                           for k in self._d_cache._state_names}
+        except Exception:
+            self._slot_dir.rollback(res)
+            raise
+        if mw_slots is not None:
+            self._w_ar = self._scatter(
+                self._w_ar, jnp.asarray(mw_slots), jnp.asarray(mw_rows),
+                {k: jnp.asarray(v) for k, v in mw_state.items()})
+        cap = self._slot_dir.cap          # the arena scratch slot
+        # cold pad: bucket-padded like DeviceEmbeddingCache.fill (a tiny
+        # fixed shape when there are none, so the steady state ships ~0
+        # host bytes instead of a zero-filled bucket)
+        from ..distributed.ps.device_cache import _pad_to_bucket
+        nc = len(cold_ids)
+        c_pad = 8 if nc == 0 else _pad_to_bucket(nc,
+                                                 self._d_cache.miss_bucket)
+        cold_slots_p = np.full(c_pad, cap, np.int32)
+        cold_slots_p[:nc] = cold_slots
+        cold_rows_p = np.zeros((c_pad, de.dim), np.float32)
+        cold_rows_p[:nc] = c_rows
+        cold_state_p = {}
+        for k in self._d_cache._state_names:
+            buf = np.zeros((c_pad, de.dim), np.float32)
+            buf[:nc] = c_state[k]
+            cold_state_p[k] = buf
+        # routed pads (victims / warm misses) + static routing caps
+        vic_ids_p, vic_slots_p = self._pad_routed(res.victim_ids,
+                                                  res.victim_slots, cap)
+        warm_ids_p, warm_slots_p = self._pad_routed(warm_ids, warm_slots,
+                                                    cap)
+        n_sh = self._dtab.n_shards
+        cap_v = (self._dtab.cap_for(np.asarray(res.victim_ids, np.int64),
+                                    len(vic_ids_p) // n_sh)
+                 if self._dtab.bucket_cap else len(vic_ids_p) // n_sh)
+        cap_w = (self._dtab.cap_for(np.asarray(warm_ids, np.int64),
+                                    len(warm_ids_p) // n_sh)
+                 if self._dtab.bucket_cap else len(warm_ids_p) // n_sh)
+        # residency bookkeeping: victims now live in the table; warm (and
+        # cold) misses move into the arena, which becomes authoritative
+        self._dtab.resident.update(int(i) for i in res.victim_ids)
+        self._dtab.resident.difference_update(int(i) for i in warm_ids)
+        # slot vector + wire-compressed inverse (replicated-path shapes)
+        u = len(uniq)
+        u_pad = self._pad_adaptive(u)
+        slots_p = np.full(u_pad, cap, np.int32)
+        slots_p[:u] = res.slots
+        inv_w = inv.reshape(ids.shape)
+        inv_w = inv_w.astype(np.uint16 if u_pad <= 65536 else np.int32)
+        import jax
+        rep = lambda x: jax.device_put(jnp.asarray(x),  # noqa: E731
+                                       self._rep_sh)
+        return {
+            "slots": rep(slots_p), "inv": rep(inv_w),
+            "vic_ids": rep(vic_ids_p), "vic_slots": rep(vic_slots_p),
+            "warm_ids": rep(warm_ids_p), "warm_slots": rep(warm_slots_p),
+            "cold_slots": rep(cold_slots_p), "cold_rows": rep(cold_rows_p),
+            "cold_state": {k: rep(v) for k, v in cold_state_p.items()},
+            "caps": (int(cap_v), int(cap_w)),
+            "stats": {"cold": nc, "warm": len(warm_ids),
+                      "victims": len(res.victim_ids)},
+        }
+
+    def _step_sharded(self, sparse_ids, dense_x, labels):
+        import jax
+        prep = self._prep_sharded(sparse_ids)
+        self._last_route_stats = prep["stats"]
+        key = (prep["vic_ids"].shape[0], prep["warm_ids"].shape[0],
+               prep["cold_rows"].shape[0], prep["slots"].shape[0],
+               tuple(np.asarray(sparse_ids).shape), prep["caps"])
+        fn = self._sharded_fns.get(key)
+        if fn is None:
+            fn = jax.jit(self._make_sharded_fused(*prep["caps"]),
+                         donate_argnums=(0, 1, 2, 3, 4))
+            self._sharded_fns[key] = fn
+        dense_w = jax.device_put(
+            jnp.asarray(np.asarray(dense_x, self._feature_wire_dtype)),
+            self._rep_sh)
+        lab_w = jax.device_put(
+            jnp.asarray(np.asarray(labels, np.float32)), self._rep_sh)
+        (self._params, self._adam, self._w_ar, self._d_ar,
+         self._dtab_tree, loss) = fn(
+            self._params, self._adam, self._w_ar, self._d_ar,
+            self._dtab_tree, prep["slots"], prep["slots"], prep["inv"],
+            dense_w, lab_w, prep["vic_ids"], prep["vic_slots"],
+            prep["warm_ids"], prep["warm_slots"], prep["cold_slots"],
+            prep["cold_rows"], prep["cold_state"])
+        self.sync_params()
+        return loss
+
+    def _sharded_read(self, uniq):
+        """Deep-table eval read-through for sharded mode: cache arena for
+        cached ids, the mesh table for resident ids, host PS else."""
+        uniq = np.asarray(uniq, np.int64).ravel()
+        get = self._slot_dir._slot_of.get
+        slots = np.fromiter((get(i, -1) for i in uniq.tolist()),
+                            np.int64, len(uniq))
+        de = self.model.deep_emb
+        out = np.empty((len(uniq), de.dim), np.float32)
+        hit = slots >= 0
+        if hit.any():
+            out[hit] = np.asarray(
+                self._d_ar["rows"][jnp.asarray(slots[hit])])
+        cold = ~hit
+        if cold.any():
+            resident = np.fromiter(
+                (int(i) in self._dtab.resident for i in uniq[cold]),
+                bool, int(cold.sum()))
+            cold_ids = uniq[cold]
+            block = np.empty((len(cold_ids), de.dim), np.float32)
+            if resident.any():
+                block[resident], _ = self._dtab.host_read(
+                    self._dtab_tree, cold_ids[resident])
+            if (~resident).any():
+                block[~resident] = de.client.pull_sparse(
+                    de.table_id, cold_ids[~resident])
+            out[cold] = block
+        return out
+
     def _step_cached(self, sparse_ids, dense_x, labels):
+        if getattr(self, "_sharded", False):
+            return self._step_sharded(sparse_ids, dense_x, labels)
         slots_dev, inv_dev = self._prep_cached(sparse_ids)
         dense_w = np.asarray(dense_x, self._feature_wire_dtype)
         lab_w = np.asarray(labels, np.float32)
@@ -448,6 +723,9 @@ class WideDeepTrainer:
         import jax
         if not self._use_cache:
             raise RuntimeError("in-graph probe needs device-cache mode")
+        if getattr(self, "_sharded", False):
+            return self._in_graph_sharded_s(sparse_ids, dense_x, labels,
+                                            k_small, k_large, reps)
         slots_dev, inv_dev = self._prep_cached(sparse_ids)
         dense_dev = jnp.asarray(np.asarray(dense_x,
                                            self._feature_wire_dtype))
@@ -477,6 +755,90 @@ class WideDeepTrainer:
             times[k] = best
         return (times[k_large] - times[k_small]) / (k_large - k_small)
 
+    def _in_graph_sharded_s(self, sparse_ids, dense_x, labels, k_small,
+                            k_large, reps):
+        """Sharded-mode in-graph probe: the chained-K delta over the full
+        sharded step body (victim route + warm all-to-all fetch + dense
+        fwd/bwd + on-chip rule), so the number includes the routing legs
+        a steady-state step actually pays."""
+        import time
+        import jax
+        prep = self._prep_sharded(sparse_ids)
+        raw = self._make_sharded_fused(*prep["caps"])
+        dense_dev = jax.device_put(
+            jnp.asarray(np.asarray(dense_x, self._feature_wire_dtype)),
+            self._rep_sh)
+        lab_dev = jax.device_put(
+            jnp.asarray(np.asarray(labels, np.float32)), self._rep_sh)
+        p = prep
+
+        def loop(params, adam, w_ar, d_ar, dtree, k):
+            def one(_, c):
+                pr, a, w, d, t, acc = c
+                pr, a, w, d, t, loss = raw(
+                    pr, a, w, d, t, p["slots"], p["slots"], p["inv"],
+                    dense_dev, lab_dev, p["vic_ids"], p["vic_slots"],
+                    p["warm_ids"], p["warm_slots"], p["cold_slots"],
+                    p["cold_rows"], p["cold_state"])
+                return (pr, a, w, d, t, acc + loss.astype(jnp.float32))
+            init = (params, adam, w_ar, d_ar, dtree, jnp.float32(0.0))
+            return jax.lax.fori_loop(0, k, one, init)[5]
+
+        f = jax.jit(loop, static_argnums=(5,))
+        times = {}
+        for k in (k_small, k_large):
+            args = (self._params, self._adam, self._w_ar, self._d_ar,
+                    self._dtab_tree, k)
+            float(f(*args))
+            best = None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                float(f(*args))
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            times[k] = best
+        return (times[k_large] - times[k_small]) / (k_large - k_small)
+
+    def sharded_step_stats(self, sparse_ids, dense_x, labels):
+        """Collective census of the compiled sharded step for this batch
+        signature (AOT lower + compile, NO execution): per-kind counts,
+        result bytes and ring-model wire bytes — the bytes/step
+        accounting bench.py and PERF.md record.  Call with an
+        already-trained batch so the prep pass leaves cache state
+        effectively unchanged (all ids hit)."""
+        if not getattr(self, "_sharded", False):
+            raise RuntimeError("sharded_step_stats needs sharded mode "
+                               "(FLAGS_sharded_embedding)")
+        import jax
+        from ..analysis.hlo.extract import program_stats
+        prep = self._prep_sharded(sparse_ids)
+        dense_w = jax.device_put(
+            jnp.asarray(np.asarray(dense_x, self._feature_wire_dtype)),
+            self._rep_sh)
+        lab_w = jax.device_put(
+            jnp.asarray(np.asarray(labels, np.float32)), self._rep_sh)
+        # a fresh un-donated jit so the lowering never invalidates live
+        # trainer state
+        fn = jax.jit(self._make_sharded_fused(*prep["caps"]))
+        compiled = fn.lower(
+            self._params, self._adam, self._w_ar, self._d_ar,
+            self._dtab_tree, prep["slots"], prep["slots"], prep["inv"],
+            dense_w, lab_w, prep["vic_ids"], prep["vic_slots"],
+            prep["warm_ids"], prep["warm_slots"], prep["cold_slots"],
+            prep["cold_rows"], prep["cold_state"]).compile()
+        stats = program_stats(compiled)
+        return {
+            "collectives": stats.collectives,
+            "all_to_all_count": int(
+                stats.collectives.get("all-to-all", {}).get("count", 0)),
+            "all_to_all_wire_bytes": float(
+                stats.collectives.get("all-to-all", {}).get("wire_bytes",
+                                                            0.0)),
+            "collective_wire_bytes": round(stats.collective_wire_bytes, 1),
+            "route": dict(prep["stats"]),
+            "n_shards": self._dtab.n_shards,
+        }
+
     def _step_pullpush(self, sparse_ids, dense_x, labels):
         if self._async_push:
             # surface background push failures BEFORE advancing dense
@@ -502,10 +864,17 @@ class WideDeepTrainer:
     def flush(self):
         """Barrier before eval/save: drain pending async pushes, or in
         device-cache mode write every cached row back to the host table
-        (PSGPU EndPass)."""
+        (PSGPU EndPass).  Sharded mode additionally drains the
+        mesh-resident tail of the deep table (resident ids' rows + state)
+        back to the host PS — cache and table populations are disjoint by
+        construction, so nothing double-writes."""
         if self._use_cache:
             self._w_cache.writeback_all(self._w_ar)
             self._d_cache.writeback_all(self._d_ar)
+            if getattr(self, "_sharded", False):
+                de = self.model.deep_emb
+                self._dtab.flush_to_client(self._dtab_tree, de.client,
+                                           de.table_id)
         if self._push_queue is not None:
             self._push_queue.join()
         self._raise_push_errors()

@@ -38,14 +38,18 @@ def test_cli_zoo_wide_mesh_strict_clean():
     payload = json.loads(p.stdout)
     assert payload["n_errors"] == 0
     models = {r["model"] for r in payload["results"]}
-    assert models == {"lenet", "resnet_block", "bert", "gpt"}
+    assert models == {"lenet", "resnet_block", "bert", "gpt", "wide_deep"}
     for r in payload["results"]:
         assert r["ok"] and r["mesh"] == "dp8xmp2"
         assert r["stats"]["collective_count"] > 0
         assert r["stats"]["memory"]["peak_bytes"] > 0
+    # the sharded-embedding CTR step must carry the all-to-all routing
+    # pattern the transformer zoo never produces (ISSUE 10)
+    wd = [r for r in payload["results"] if r["model"] == "wide_deep"][0]
+    assert wd["stats"]["collectives"]["all-to-all"]["count"] > 0
     # every lowering ledgered once with its mesh label (the
     # zero-steady-state-recompile convention extended to audit runs)
-    assert len(payload["ledger"]) == 4
+    assert len(payload["ledger"]) == 5
     assert all("arg:mesh" in e["key"] and "dp8xmp2" in e["key"]
                for e in payload["ledger"])
 
@@ -59,6 +63,10 @@ def test_cli_seeded_wide_mesh_exits_nonzero():
         cwd=REPO)
     assert p.returncode == 1, (p.stdout[-1500:], p.stderr[-1500:])
     assert "hlo-full-gather" in p.stdout
+    # both negative fixtures must fire: the de-sharded ZeRO state AND the
+    # de-sharded annotated embedding table (ISSUE 10 annotation contract)
+    assert "seeded_desharded_zero" in p.stdout
+    assert "seeded_desharded_table" in p.stdout
 
 
 @pytest.mark.slow
@@ -73,6 +81,7 @@ def test_dryrun_phase5_worker_width16():
                        text=True, timeout=840, env=_wide_env(16), cwd=REPO)
     assert p.returncode == 0, p.stderr[-3000:]
     assert "seeded de-sharded-ZeRO fixture flagged at ERROR" in p.stdout
+    assert "seeded de-sharded-table fixture flagged at ERROR" in p.stdout
     rows = None
     for ln in p.stdout.splitlines():
         if ln.startswith("HLO_AUDIT_ROWS "):
@@ -81,7 +90,10 @@ def test_dryrun_phase5_worker_width16():
     cfgs = {r["config"] for r in rows}
     assert cfgs == {"bert_z1_dp_mp_sp", "bert_z3_dp_mp",
                     "resnet18_z1_dp", "bert_pp2_dp",
-                    "gpt_autoshard_dp_mp"}
+                    "gpt_autoshard_dp_mp", "wide_deep_sharded_emb"}
+    # the sharded-embedding config must carry all-to-all traffic
+    wd = [r for r in rows if r["config"] == "wide_deep_sharded_emb"][0]
+    assert wd["collectives"]["all-to-all"]["count"] > 0
     for r in rows:
         assert r["n_devices"] == 16
         for field in ("collective_count", "collective_wire_bytes",

@@ -38,6 +38,7 @@ NAMESPACES = [
     ("paddle_tpu.quantization", None),
     ("paddle_tpu.regularizer", None),
     ("paddle_tpu.incubate", None),
+    ("paddle_tpu.rec", None),
     ("paddle_tpu.checkpoint", None),
     ("paddle_tpu.testing", None),
     ("paddle_tpu.analysis", None),

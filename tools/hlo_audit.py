@@ -31,7 +31,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-ZOO_MODELS = ("lenet", "resnet_block", "bert", "gpt")
+ZOO_MODELS = ("lenet", "resnet_block", "bert", "gpt", "wide_deep")
 
 # --autoshard: shard models through the FLAGS_autoshard=apply TrainStep
 # hook (analysis.autoshard rules engine) instead of the models' explicit
@@ -168,8 +168,33 @@ def _build_gpt(mesh, zero):
     return step, (ids, ids.copy()), None
 
 
+def _build_wide_deep(mesh, zero):
+    """Sharded-embedding CTR step (ISSUE 10): the deep-leg table is
+    row-partitioned over dp via ShardedEmbedding, so the compiled step
+    carries the all-to-all routing pattern — dot-light, all-to-all-heavy,
+    the collective mix the transformer zoo never produces.  The batch is
+    FIXED across mesh widths (strong scaling: the table grows, the batch
+    does not have to), so per-device routed bytes stay ~flat."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.parallel import TrainStep
+    from paddle_tpu.rec.sharded_embedding import ShardedWideDeep
+    paddle.seed(0)
+    model = ShardedWideDeep(vocab=4096, emb_dim=16, num_slots=8,
+                            dense_dim=8, hidden=(32, 16), mesh=mesh)
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=1e-3)
+    step = TrainStep(model, opt, mesh=mesh, zero=zero)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 4096, (128, 8))
+    dense = rng.randn(128, 8).astype("float32")
+    labels = (rng.rand(128, 1) > 0.5).astype("float32")
+    return step, (ids, dense, labels), None
+
+
 BUILDERS = {"lenet": _build_lenet, "resnet_block": _build_resnet_block,
-            "bert": _build_bert, "gpt": _build_gpt}
+            "bert": _build_bert, "gpt": _build_gpt,
+            "wide_deep": _build_wide_deep}
 
 
 def audit_model(name: str, axes: dict, zero: int, suppress=()):
@@ -201,6 +226,23 @@ def audit_seeded(axes: dict, zero: int):
     step, inputs, label = desharded_zero_step(mesh, zero=zero)
     return hlo_audit.audit_train_step(
         step, inputs, label, site="hlo_audit:seeded", do_emit=False)
+
+
+def audit_seeded_table(axes: dict):
+    """Second negative gate: the de-sharded embedding-TABLE fixture —
+    an annotated ``P('dp', None)`` table stored replicated must fail the
+    annotation contract at ERROR, independent of any ZeRO stage."""
+    import jax
+    from paddle_tpu.analysis import hlo as hlo_audit
+    from paddle_tpu.analysis.hlo.fixtures import desharded_table_step
+    from paddle_tpu.parallel import make_mesh
+    n = 1
+    for v in axes.values():
+        n *= v
+    mesh = make_mesh(dict(axes), devices=jax.devices()[:n])
+    step, inputs, label = desharded_table_step(mesh)
+    return hlo_audit.audit_train_step(
+        step, inputs, label, site="hlo_audit:seeded_table", do_emit=False)
 
 
 def main(argv=None):
@@ -261,6 +303,9 @@ def main(argv=None):
             res = audit_seeded(axes, args.zero or 1)
             n_errors += res.report.n_errors
             results.append(("seeded_desharded_zero", label, res))
+            res_t = audit_seeded_table(axes)
+            n_errors += res_t.report.n_errors
+            results.append(("seeded_desharded_table", label, res_t))
 
     total = sum(len(r.report) for _, _, r in results)
     if args.as_json:
