@@ -228,7 +228,9 @@ class CheckpointManager:
         """Rank 0: wait for every non-zero rank's commit marker."""
         merged = {}
         pending = set(range(1, self.world_size))
-        deadline = time.time() + self.commit_timeout
+        # monotonic deadline: a wall-clock jump must not spuriously time
+        # out (or extend) a commit wait
+        deadline = time.monotonic() + self.commit_timeout
         while pending:
             for r in sorted(pending):
                 path = os.path.join(step_dir, _commit_marker(r))
@@ -240,7 +242,7 @@ class CheckpointManager:
                     continue
             if not pending:
                 break
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"checkpoint commit: ranks {sorted(pending)} never "
                     f"committed under {step_dir} "

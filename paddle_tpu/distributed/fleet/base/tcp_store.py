@@ -126,10 +126,12 @@ class _Server(threading.Thread):
             _send_msg(conn, b"ok", str(len(dead)).encode())
         elif cmd == b"wait":
             key, timeout = args[0], float(args[1])
-            deadline = time.time() + timeout
+            # monotonic deadlines throughout: a wall-clock jump must not
+            # spuriously expire (or extend) a rendezvous wait
+            deadline = time.monotonic() + timeout
             with self._cv:
                 while key not in self._kv:
-                    left = deadline - time.time()
+                    left = deadline - time.monotonic()
                     if left <= 0 or not self._cv.wait(left):
                         break
                 ok = key in self._kv
@@ -175,8 +177,8 @@ class TCPStore:
         """(Re)establish the client connection, retrying refusals until
         ``budget`` seconds elapse (a restarting master needs a moment to
         re-listen)."""
-        deadline = time.time() + (budget if budget is not None
-                                  else self._timeout)
+        deadline = time.monotonic() + (budget if budget is not None
+                                       else self._timeout)
         last = None
         while True:
             try:
@@ -185,7 +187,7 @@ class TCPStore:
                 return
             except OSError as e:
                 last = e
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     raise ConnectionError(
                         f"store at {self.host}:{self.port} unreachable: "
                         f"{last}")
@@ -317,7 +319,7 @@ class TCPStore:
 
     def wait(self, key: str, timeout: float = None) -> bool:
         t = timeout or self._timeout
-        deadline = time.time() + t
+        deadline = time.monotonic() + t
         # the server's wait deadline starts when it RECEIVES the request;
         # the socket recv timeout must outlive it or the late '0' reply
         # desyncs the connection protocol.  Hardening: each retry re-sends
@@ -326,7 +328,7 @@ class TCPStore:
         # lost, server bounced — reconnects inside _request, so neither
         # the inflated t+30 timeout nor a desynced stream can leak into
         # the next call.
-        left = lambda: max(0.1, deadline - time.time())  # noqa: E731
+        left = lambda: max(0.1, deadline - time.monotonic())  # noqa: E731
         (ok,) = self._request("wait",
                               lambda: (b"wait", key.encode(),
                                        str(left()).encode()),

@@ -128,7 +128,10 @@ class ElasticLaunch:
         the watchdog exists for ranks that are alive-but-hung)."""
         if self._monitor is None:
             return []
-        if time.time() - spawned_at < self._watchdog_warmup:
+        # monotonic: the warmup window is local process time, immune to
+        # wall-clock jumps (heartbeat staleness itself stays wall-clocked
+        # — those stamps cross processes)
+        if time.monotonic() - spawned_at < self._watchdog_warmup:
             return []
         mon = self._monitor() if callable(self._monitor) else self._monitor
         if mon is None:
@@ -145,7 +148,7 @@ class ElasticLaunch:
         restarts = 0
         while True:
             procs = [self._spawn(i) for i in range(self._n)]
-            spawned_at = time.time()
+            spawned_at = time.monotonic()
             rc = 0
             while procs:
                 time.sleep(self._poll_s)

@@ -385,6 +385,43 @@ define_flag("serving_metrics_window", 2048,
             "serving latency reservoir behind the p50/p99 gauges.",
             validator=lambda v: int(v) >= 16)
 
+# ---- Request tracing + typed metrics plane (paddle_tpu.profiler) ------------
+define_flag("trace",
+            os.environ.get("PADDLE_TPU_TRACE", "off").lower() or "off",
+            "Request-scoped span tracing tri-state (profiler.tracing): "
+            "'off' = no spans (one Python branch per instrumentation "
+            "point); 'sample' = trace every k-th request/step where k = "
+            "round(1/FLAGS_trace_sample_rate); 'full' = trace every "
+            "request and training step.  Spans cover the whole serving "
+            "path (submit -> queue wait -> pack -> H2D -> execute -> D2H "
+            "-> reply), the train-step phase breakdown, and generate()'s "
+            "prefill/decode scan boundary; recompile-ledger events "
+            "auto-attach to the active span.  Host-side timing only: "
+            "tracing never changes a traced program or adds a compile "
+            "key.  Seeded by PADDLE_TPU_TRACE.",
+            validator=lambda v: str(v).lower() in ("off", "sample",
+                                                   "full"))
+define_flag("trace_sample_rate", 0.01,
+            "Fraction of requests/steps traced under FLAGS_trace=sample "
+            "(deterministic stride sampling: every round(1/rate)-th root "
+            "span is kept, so long runs converge to the rate without a "
+            "per-request RNG draw).",
+            validator=lambda v: 0.0 < float(v) <= 1.0)
+define_flag("trace_dir",
+            os.environ.get("PADDLE_TPU_TRACE_DIR", ""),
+            "When non-empty, every finished span additionally streams as "
+            "JSONL via utils.monitor.LogWriter into this directory "
+            "(tools/obs_report.py joins these with metrics snapshots "
+            "into per-request waterfalls).  The bounded in-memory span "
+            "ring is always maintained while tracing is on.")
+define_flag("log_writer_max_mb", 64.0,
+            "Size cap (MiB) per LogWriter JSONL sink file (recompile "
+            "ledger, graph-lint, hlo-audit, trace dirs): past the cap "
+            "the file rotates ('f.jsonl' -> 'f.jsonl.1' -> 'f.jsonl.2', "
+            "two rollovers kept), so a long-running serve process "
+            "cannot grow any sink without bound.  0 disables rotation.",
+            validator=lambda v: float(v) >= 0)
+
 # ---- Autoregressive decoding (text.generation + serving decode) -------------
 define_flag("use_flash_decode",
             os.environ.get("PADDLE_TPU_FLASH_DECODE", "").lower()

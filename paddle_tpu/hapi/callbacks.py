@@ -79,7 +79,8 @@ class ProgBarLogger(Callback):
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
         self.steps = self.params.get("steps")
-        self._t0 = time.time()
+        # monotonic: an NTP step mid-epoch must not bend the ms/step rate
+        self._t0 = time.monotonic()
         if self.verbose:
             print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}")
 
@@ -89,7 +90,7 @@ class ProgBarLogger(Callback):
             items = " - ".join(f"{k}: {v:.4f}" if isinstance(v, float)
                                else f"{k}: {v}" for k, v in logs.items())
             total = f"/{self.steps}" if self.steps else ""
-            dt = time.time() - self._t0
+            dt = time.monotonic() - self._t0
             print(f"step {step + 1}{total} - {dt * 1000 / (step + 1):.0f}"
                   f"ms/step - {items}")
             sys.stdout.flush()

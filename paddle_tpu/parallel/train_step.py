@@ -22,6 +22,7 @@ Options map to reference strategies:
 """
 from __future__ import annotations
 
+import contextlib
 import time
 
 import jax
@@ -35,8 +36,24 @@ from ..framework import flags as _flags
 from ..profiler import RecordEvent, ledger as _ledger
 from ..profiler import profiling_enabled as _prof_on
 from ..profiler import span as _span
+from ..profiler import tracing as _tracing
+from ..profiler.metrics import default_registry as _registry
 from .mesh import get_mesh, DP_AXIS
 from .api import named_shardings, batch_sharding
+
+# per-phase step-time breakdown (FLAGS_trace gates observation: the
+# device_fence segment needs a block_until_ready the untraced hot path
+# must not pay).  host_prep = feed placement; dispatch = handing the
+# compiled step to the runtime (async); device_fence = blocking on the
+# step's outputs.  Purely host-side timing — observing a step never
+# changes the traced program or adds a compile key.
+_STEP_PHASE = _registry().histogram(
+    "train_step_phase_seconds",
+    "Per-phase train-step wall segments under FLAGS_trace "
+    "(host_prep / dispatch / device_fence).",
+    labels=("phase",))
+
+_NULL_CM = contextlib.nullcontext()     # shared no-op (reentrant, stateless)
 
 
 def _as_array(x):
@@ -896,9 +913,16 @@ class TrainStep:
         put = self._feed_placer(inputs)
 
         prof = _prof_on()
+        # per-step sampling decision for the phase breakdown (off = one
+        # branch; sample mode keeps every k-th step)
+        tr = _tracing.should_sample() if _tracing.enabled() else False
+        t_prep0 = time.monotonic() if tr else 0.0
         with _span("train_step::data_feed"):
             inputs = tuple(put(x) for x in inputs)
             label = put(label)
+        if tr:
+            _STEP_PHASE.labels(phase="host_prep").observe(
+                time.monotonic() - t_prep0)
         fn = self.compile()
         # host scalars (not committed device arrays) so the jit treats them
         # as process-replicated under a multi-host mesh; the loss scale is
@@ -976,13 +1000,24 @@ class TrainStep:
                                    (time.perf_counter() - t0) * 1e3)
         else:
             _ledger.record_cache_hit(site)
-            if prof:
+            if prof or tr:
                 # fence on the loss so the span is device time, not the
-                # async dispatch
-                with RecordEvent("train_step::device_execute"):
+                # async dispatch; the same fence splits the traced
+                # dispatch / device_fence histogram segments
+                rec = RecordEvent("train_step::device_execute") if prof \
+                    else _NULL_CM
+                t_d0 = time.monotonic()
+                with rec:
                     self._state, out = fn(self.state, inputs, label, lr,
                                           scale)
+                    t_d1 = time.monotonic()
                     jax.block_until_ready(out)
+                if tr:
+                    t_d2 = time.monotonic()
+                    _STEP_PHASE.labels(phase="dispatch").observe(
+                        t_d1 - t_d0)
+                    _STEP_PHASE.labels(phase="device_fence").observe(
+                        t_d2 - t_d1)
             else:
                 self._state, out = fn(self.state, inputs, label, lr, scale)
         self.optimizer._step_count += 1

@@ -305,6 +305,10 @@ def export_chrome_tracing(dir_name, worker_name=None):
         trace = [{"name": name, "ph": "X", "ts": t0 / 1000,
                   "dur": dur / 1000, "pid": 0, "tid": 0, "cat": "host"}
                  for name, t0, dur in _events()]
+        # merge finished request spans (profiler.tracing) into the same
+        # timeline: pid 0 = host RecordEvents, pid 1 = request traces
+        from .tracing import chrome_trace_events
+        trace += chrome_trace_events()
         if worker_name:
             rnd = getattr(prof, "round_count", 0) or 1
             fname = f"{worker_name}_r{rnd}.json"
@@ -341,8 +345,15 @@ def stop_profiler(sorted_key=None, profile_path=None):
 from . import ledger  # noqa: E402,F401
 from .ledger import compile_events, set_ledger_dir  # noqa: E402,F401
 
-# serving instruments (latency percentiles + QPS; see metrics.py)
-from .metrics import LatencyWindow, RateMeter  # noqa: E402,F401
+# typed metrics plane + serving instruments (see metrics.py)
+from . import metrics  # noqa: E402,F401
+from .metrics import (Counter, Gauge, Histogram,  # noqa: E402,F401
+                      LatencyWindow, MetricsRegistry, RateMeter,
+                      default_registry, serve_metrics, write_textfile)
+
+# request-scoped span tracing (FLAGS_trace; see tracing.py)
+from . import tracing  # noqa: E402,F401
+from .tracing import Span, export_chrome_trace, set_trace_dir  # noqa: E402,F401
 
 # device-side: direct jax.profiler bridges
 start_trace = jax.profiler.start_trace

@@ -117,6 +117,10 @@ def record_compile(site: str, kind: str, key, ms: float, extra=None) -> dict:
     stat_add("jit_compile_ms_total", int(round(ms)))
     if w is not None:
         w.add_event("jit/compile", ev)
+    # first-class trace annotation: a compile that runs inside a traced
+    # request/step pins itself to that span (one branch when no span)
+    from .tracing import attach_compile_event
+    attach_compile_event(ev)
     return ev
 
 

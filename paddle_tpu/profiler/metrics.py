@@ -1,29 +1,549 @@
-"""Latency/QPS instruments for long-running serving processes.
+"""Typed metrics plane + latency/QPS instruments.
 
-Reference parity: paddle/fluid/platform/monitor.h keeps int64 gauges only;
-the serving engine needs *distributions* (p50/p99 latency) and *rates*
-(QPS).  This module adds the two missing instruments on top of the same
-StatRegistry so existing readers (``all_stats``) see serving health next
-to the recompile ledger gauges:
+Reference parity: paddle/fluid/platform/monitor.h keeps int64 gauges only
+(StatRegistry + the STAT_INT macro family).  Production observability
+needs three typed instruments with label sets — Counter, Gauge,
+Histogram — and a scrape surface.  This module layers them ON TOP of the
+same registry so every existing reader keeps working:
 
-  * :class:`LatencyWindow` — a thread-safe sliding reservoir of the last N
-    samples with percentile queries; ``publish(prefix)`` mirrors
+  * :class:`MetricsRegistry` — typed metric families with label sets.
+    Counter/Gauge updates (and Histogram counts) mirror into
+    ``utils.monitor`` stats under a flattened name
+    (``<name>[_<label-value>...]``), so ``all_stats()`` sees the typed
+    plane next to the legacy gauges;
+  * Prometheus text exposition (:meth:`MetricsRegistry.prometheus_text`)
+    with HELP/TYPE lines and cumulative histogram buckets, served from a
+    stdlib-http endpoint (:func:`serve_metrics`) or written atomically as
+    a textfile (:func:`write_textfile`) for scrape-less CI;
+  * the registry knows every family's (name, type, labels, owning
+    module) — ``tools/gen_metrics_doc.py`` freezes that inventory into
+    docs/METRICS.md the way gen_api_spec freezes signatures.
+
+Plus the two serving instruments PR 6 introduced:
+
+  * :class:`LatencyWindow` — a thread-safe sliding reservoir of the last
+    N samples with percentile queries; ``publish(prefix)`` mirrors
     p50/p99/max into ``<prefix>_p50_us``-style integer gauges.
   * :class:`RateMeter` — completed-count over a monotonic window →
     requests/s, mirrored as ``<prefix>_qps_milli`` (int, 1/1000 qps).
 
-Host-side only and off the device hot path: one deque append per
-completed request.
+Everything here is host-side and off the device hot path: an update is
+one lock + a few integer adds.  All rate/duration math uses
+``time.monotonic()`` — a wall-clock jump must never bend a rate.
 """
 from __future__ import annotations
 
+import os
+import re
+import sys
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
-from ..utils.monitor import stat_set
+from ..utils.monitor import all_stats, stat_add, stat_set
 
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "default_registry", "serve_metrics", "write_textfile",
+    "LatencyWindow", "RateMeter",
+]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# Prometheus-conventional latency buckets (seconds), widened at the top
+# for CPU-control runs where a cold batch can take whole seconds.
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _flat_stat_name(name: str, label_values: Tuple[str, ...]) -> str:
+    """Flattened utils.monitor key for a labeled child: the family name
+    with sanitized label VALUES appended (``train_step_phase_seconds``
+    + ('host_prep',) -> ``train_step_phase_seconds_host_prep``)."""
+    parts = [name] + [re.sub(r"[^a-zA-Z0-9_]", "_", str(v))
+                      for v in label_values]
+    return "_".join(parts)
+
+
+class _Metric:
+    """One metric family: fixed label names, per-label-set children."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, doc: str, labels: Sequence[str],
+                 module: str):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for lb in labels:
+            if not _LABEL_RE.match(lb):
+                raise ValueError(f"invalid label name {lb!r} on {name!r}")
+        self.name = name
+        self.doc = " ".join(str(doc).split())      # HELP must be one line
+        self.label_names = tuple(labels)
+        self.module = module
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def labels(self, *values, **kw):
+        """Child for one label-value set (created on first use)."""
+        if kw:
+            if values:
+                raise ValueError("pass label values positionally OR by "
+                                 "name, not both")
+            try:
+                values = tuple(str(kw[k]) for k in self.label_names)
+            except KeyError as e:
+                raise ValueError(
+                    f"metric {self.name!r} takes labels "
+                    f"{self.label_names}, got {sorted(kw)}") from e
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"metric {self.name!r} takes {len(self.label_names)} "
+                f"label(s) {self.label_names}, got {len(values)}")
+        with self._lock:
+            ch = self._children.get(values)
+            if ch is None:
+                ch = self._make_child(values)
+                self._children[values] = ch
+            return ch
+
+    def _default_child(self):
+        if self.label_names:
+            raise ValueError(
+                f"metric {self.name!r} is labeled {self.label_names}: "
+                "use .labels(...)")
+        return self.labels()
+
+    def _make_child(self, values):
+        raise NotImplementedError
+
+    def children(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def describe(self) -> dict:
+        return {"name": self.name, "type": self.kind,
+                "labels": list(self.label_names), "module": self.module,
+                "doc": self.doc}
+
+
+class _CounterChild:
+    __slots__ = ("_lock", "_value", "_stat")
+
+    def __init__(self, stat_name):
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._stat = stat_name
+
+    def inc(self, amount: float = 1.0) -> None:
+        a = float(amount)
+        if a < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += a
+        stat_add(self._stat, int(a))
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (requests served, rows routed)."""
+
+    kind = "counter"
+
+    def _make_child(self, values):
+        return _CounterChild(_flat_stat_name(self.name, values))
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "_value", "_stat")
+
+    def __init__(self, stat_name):
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._stat = stat_name
+
+    def set(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._value = v
+        stat_set(self._stat, int(v))
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += float(amount)
+            v = self._value
+        stat_set(self._stat, int(v))
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge(_Metric):
+    """Point-in-time value that can go both ways (queue depth)."""
+
+    kind = "gauge"
+
+    def _make_child(self, values):
+        return _GaugeChild(_flat_stat_name(self.name, values))
+
+    def set(self, value: float) -> None:
+        self._default_child().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default_child().dec(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count", "_stat")
+
+    def __init__(self, bounds, stat_name):
+        self._lock = threading.Lock()
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)       # +1: the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._stat = stat_name
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bisect_left(self._bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+        stat_add(self._stat)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def snapshot(self):
+        """(cumulative bucket counts aligned to bounds+[+Inf], sum,
+        count) — the exposition/quantile surface."""
+        with self._lock:
+            counts = list(self._counts)
+            s, n = self._sum, self._count
+        cum, acc = [], 0
+        for c in counts:
+            acc += c
+            cum.append(acc)
+        return cum, s, n
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile estimate in [0, 1]; None while
+        empty.  Exact enough for SLO sanity ('p99 is in the right
+        bucket'), not a reservoir replacement."""
+        cum, _, n = self.snapshot()
+        if n == 0:
+            return None
+        rank = q * n
+        lo = 0.0
+        for i, b in enumerate(self._bounds):
+            if cum[i] >= rank:
+                prev = cum[i - 1] if i else 0
+                inb = cum[i] - prev
+                frac = (rank - prev) / inb if inb else 1.0
+                return lo + (b - lo) * min(1.0, max(0.0, frac))
+            lo = b
+        return self._bounds[-1] if self._bounds else 0.0
+
+
+class Histogram(_Metric):
+    """Distribution with fixed bucket boundaries (latency, batch
+    occupancy).  The flattened stat mirror carries ``_count`` only —
+    int gauges cannot express a distribution."""
+
+    kind = "histogram"
+
+    def __init__(self, name, doc, labels, module,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, doc, labels, module)
+        bs = sorted(float(b) for b in buckets)
+        if not bs or len(set(bs)) != len(bs):
+            raise ValueError(f"invalid histogram buckets {buckets!r}")
+        self.buckets = tuple(bs)
+
+    def _make_child(self, values):
+        return _HistogramChild(self.buckets,
+                               _flat_stat_name(self.name, values)
+                               + "_count")
+
+    def observe(self, value: float) -> None:
+        self._default_child().observe(value)
+
+    @property
+    def count(self) -> int:
+        return self._default_child().count
+
+    @property
+    def sum(self) -> float:
+        return self._default_child().sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        return self._default_child().quantile(q)
+
+
+def _esc_label(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace("\n", r"\n") \
+        .replace('"', r'\"')
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class MetricsRegistry:
+    """Typed metric families keyed by name.  Registration is idempotent
+    for an identical (type, labels, buckets) re-declaration — module
+    reloads must not fail — and loud for a conflicting one."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    # -- registration --------------------------------------------------------
+    def _register(self, cls, name, doc, labels, module, **kw):
+        if module is None:
+            module = sys._getframe(2).f_globals.get("__name__", "?")
+        with self._lock:
+            prev = self._metrics.get(name)
+            if prev is not None:
+                same = (type(prev) is cls
+                        and prev.label_names == tuple(labels)
+                        and getattr(prev, "buckets", None)
+                        == (tuple(sorted(float(b) for b in kw["buckets"]))
+                            if "buckets" in kw else None))
+                if not same:
+                    raise ValueError(
+                        f"metric {name!r} is already registered as "
+                        f"{prev.kind}{prev.label_names}; re-registration "
+                        "with a different type/labels/buckets would "
+                        "silently fork the family")
+                return prev
+            m = cls(name, doc, labels, module, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, doc: str = "",
+                labels: Sequence[str] = (),
+                module: Optional[str] = None) -> Counter:
+        return self._register(Counter, name, doc, labels, module)
+
+    def gauge(self, name: str, doc: str = "",
+              labels: Sequence[str] = (),
+              module: Optional[str] = None) -> Gauge:
+        return self._register(Gauge, name, doc, labels, module)
+
+    def histogram(self, name: str, doc: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  module: Optional[str] = None) -> Histogram:
+        return self._register(Histogram, name, doc, labels, module,
+                              buckets=buckets)
+
+    # -- introspection -------------------------------------------------------
+    def collect(self) -> List[_Metric]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def describe(self) -> List[dict]:
+        """Inventory rows (name, type, labels, module, doc) — the
+        docs/METRICS.md contract."""
+        return [m.describe() for m in self.collect()]
+
+    def snapshot(self) -> dict:
+        """Nested value snapshot for reports: {name: {labels-repr:
+        value-or-histogram-dict}}."""
+        out = {}
+        for m in self.collect():
+            fam = {}
+            for values, ch in m.children():
+                key = ",".join(f"{k}={v}" for k, v in
+                               zip(m.label_names, values)) or ""
+                if m.kind == "histogram":
+                    cum, s, n = ch.snapshot()
+                    fam[key] = {"count": n, "sum": round(s, 6),
+                                "p50": ch.quantile(0.5),
+                                "p99": ch.quantile(0.99)}
+                else:
+                    fam[key] = ch.value
+            out[m.name] = fam
+        return out
+
+    def _mirrored_stat_names(self) -> set:
+        """Flattened utils.monitor keys owned by typed metrics (so the
+        exposition's legacy-stat section never double-reports them)."""
+        out = set()
+        for m in self.collect():
+            for values, _ in m.children():
+                flat = _flat_stat_name(m.name, values)
+                out.add(flat + "_count" if m.kind == "histogram"
+                        else flat)
+        return out
+
+    # -- exposition ----------------------------------------------------------
+    def prometheus_text(self, include_stats: bool = True) -> str:
+        """Prometheus text format 0.0.4.  Typed families render with
+        HELP/TYPE and cumulative histogram buckets; with
+        ``include_stats`` the legacy monitor.h gauges follow as one
+        ``paddle_tpu_stat{name=...}`` family (minus keys the typed plane
+        already mirrors)."""
+        lines: List[str] = []
+        for m in self.collect():
+            children = m.children()
+            if not children:
+                continue
+            lines.append(f"# HELP {m.name} {m.doc or m.name}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for values, ch in children:
+                base = ",".join(
+                    f'{k}="{_esc_label(v)}"'
+                    for k, v in zip(m.label_names, values))
+                if m.kind == "histogram":
+                    cum, s, n = ch.snapshot()
+                    for b, c in zip(m.buckets, cum):
+                        le = f'le="{_fmt_value(b)}"'
+                        lab = f"{base},{le}" if base else le
+                        lines.append(f"{m.name}_bucket{{{lab}}} {c}")
+                    lab = f'{base},le="+Inf"' if base else 'le="+Inf"'
+                    lines.append(f"{m.name}_bucket{{{lab}}} {cum[-1]}")
+                    sfx = f"{{{base}}}" if base else ""
+                    lines.append(f"{m.name}_sum{sfx} {_fmt_value(s)}")
+                    lines.append(f"{m.name}_count{sfx} {n}")
+                else:
+                    sfx = f"{{{base}}}" if base else ""
+                    lines.append(f"{m.name}{sfx} {_fmt_value(ch.value)}")
+        if include_stats:
+            skip = self._mirrored_stat_names()
+            stats = {k: v for k, v in all_stats().items() if k not in skip}
+            if stats:
+                lines.append("# HELP paddle_tpu_stat monitor.h StatRegistry"
+                             " int64 gauges (legacy untyped plane)")
+                lines.append("# TYPE paddle_tpu_stat gauge")
+                for k in sorted(stats):
+                    lines.append(
+                        f'paddle_tpu_stat{{name="{_esc_label(k)}"}} '
+                        f"{stats[k]}")
+        return "\n".join(lines) + "\n"
+
+
+_default = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem publishes into."""
+    return _default
+
+
+def write_textfile(path: str,
+                   registry: Optional[MetricsRegistry] = None) -> str:
+    """Atomically write the exposition to ``path`` (node-exporter
+    textfile-collector convention — scrape-less CI reads the file)."""
+    reg = registry or _default
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(reg.prometheus_text())
+    os.replace(tmp, path)
+    return path
+
+
+class _MetricsServer:
+    """Handle for a running exposition endpoint (close() to stop)."""
+
+    def __init__(self, httpd, thread):
+        self._httpd = httpd
+        self._thread = thread
+        self.port = httpd.server_address[1]
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def serve_metrics(port: int = 0, addr: str = "127.0.0.1",
+                  registry: Optional[MetricsRegistry] = None
+                  ) -> _MetricsServer:
+    """Serve ``GET /metrics`` (Prometheus text) from a stdlib http server
+    on a daemon thread; ``port=0`` binds an ephemeral port (the handle's
+    ``.port`` reports it).  No dependency beyond http.server."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    reg = registry or _default
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            body = reg.prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):      # no stderr chatter per scrape
+            pass
+
+    httpd = ThreadingHTTPServer((addr, int(port)), Handler)
+    t = threading.Thread(target=httpd.serve_forever,
+                         name="paddle-tpu-metrics", daemon=True)
+    t.start()
+    return _MetricsServer(httpd, t)
+
+
+# ---------------------------------------------------------------------------
+# Serving instruments (PR 6)
+# ---------------------------------------------------------------------------
 
 class LatencyWindow:
     """Sliding window of the last ``maxlen`` latency samples (seconds)."""
@@ -87,11 +607,15 @@ class LatencyWindow:
 
 
 class RateMeter:
-    """Completed-count → rate (per second) since start() / last reset."""
+    """Completed-count → rate (per second) since start() / last reset.
+
+    Clocked by ``time.monotonic()``: the denominator is elapsed process
+    time, so an NTP step or DST jump in the wall clock cannot spike or
+    zero the reported rate."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._t0 = time.perf_counter()
+        self._t0 = time.monotonic()
         self._n = 0
 
     def add(self, n: int = 1) -> None:
@@ -100,12 +624,12 @@ class RateMeter:
 
     def reset(self) -> None:
         with self._lock:
-            self._t0 = time.perf_counter()
+            self._t0 = time.monotonic()
             self._n = 0
 
     def rate(self) -> float:
         with self._lock:
-            dt = time.perf_counter() - self._t0
+            dt = time.monotonic() - self._t0
             n = self._n
         return n / dt if dt > 0 else 0.0
 

@@ -20,6 +20,18 @@ import jax.numpy as jnp
 from .. import nn, optimizer as opt_mod
 from ..framework.tensor import Tensor
 from ..distributed.ps import DistributedEmbedding, LocalPsEndpoint
+from ..profiler.metrics import default_registry as _registry
+
+# storage-tier attribution for the cached/sharded embedding step: every
+# deduped id is served by exactly one tier — the hot-row cache arena
+# (hit, zero routing), the mesh table (warm miss, in-graph all-to-all),
+# or the host PS (cold miss, one-time host fetch).  Counting ids per
+# tier is what makes cache-hit claims auditable from /metrics.
+_TIER_HITS = _registry().counter(
+    "wide_deep_tier_hits_total",
+    "Deduped embedding ids served per storage tier (cache_arena / "
+    "mesh_table / host_ps) by the Wide&Deep cached and sharded steps.",
+    labels=("tier",))
 
 
 class WideDeep(nn.Layer):
@@ -517,6 +529,13 @@ class WideDeepTrainer:
             self._d_ar = self._scatter(
                 self._d_ar, jnp.asarray(md_slots), jnp.asarray(md_rows),
                 {k: jnp.asarray(v) for k, v in md_state.items()})
+        # tier attribution (replicated cached mode has two tiers: the
+        # arena for hits, the host PS for every miss)
+        n_miss = len(res.miss_idx)
+        if len(uniq) - n_miss:
+            _TIER_HITS.labels(tier="cache_arena").inc(len(uniq) - n_miss)
+        if n_miss:
+            _TIER_HITS.labels(tier="host_ps").inc(n_miss)
         # eighth-octave-pad the slot vector (≤8 compiled shapes per
         # doubling of U); padding points at the scratch slot
         u = len(uniq)
@@ -619,6 +638,14 @@ class WideDeepTrainer:
         # cold) misses move into the arena, which becomes authoritative
         self._dtab.resident.update(int(i) for i in res.victim_ids)
         self._dtab.resident.difference_update(int(i) for i in warm_ids)
+        # tier attribution: arena short-circuit / routed table / host PS
+        n_hit = len(uniq) - len(miss_ids)
+        if n_hit:
+            _TIER_HITS.labels(tier="cache_arena").inc(n_hit)
+        if len(warm_ids):
+            _TIER_HITS.labels(tier="mesh_table").inc(len(warm_ids))
+        if nc:
+            _TIER_HITS.labels(tier="host_ps").inc(nc)
         # slot vector + wire-compressed inverse (replicated-path shapes)
         u = len(uniq)
         u_pad = self._pad_adaptive(u)

@@ -36,6 +36,7 @@ import numpy as np
 from ..framework import flags as _flags
 from ..framework.enforce import (InvalidArgumentError, OutOfRangeError,
                                  PreconditionNotMetError)
+from ..profiler import tracing as _tracing
 from ..profiler.metrics import LatencyWindow, RateMeter
 from ..utils.monitor import stat_add
 from .bucketing import BucketLadder
@@ -71,6 +72,10 @@ class DecodeRequest:
     max_new: int
     future: Future = field(default_factory=Future)
     t_enqueue: float = field(default_factory=time.perf_counter)
+    # root span (Server.submit_decode) + monotonic enqueue stamp — the
+    # same tracing contract as the dense Request
+    trace: Optional[object] = None
+    t_enqueue_mono: float = field(default_factory=time.monotonic)
 
 
 class _DecodeRuntime:
@@ -248,13 +253,46 @@ class _DecodeRuntime:
             stat_add("serving_steady_compiles")
             self.bump(steady_compiles=1)
         ids, start = self.gen.pack_prompts(prompts, P)
-        cache, logits0 = self.gen.prefill(ids, start, C)
-        toks = self.gen.decode(cache, logits0, start, P, self.steps, 1,
-                               self.spec.eos_token_id)
+        traced = [r for r in batch.requests
+                  if getattr(r, "trace", None) is not None]
+        if not traced:                     # off-path: one branch, no fence
+            cache, logits0 = self.gen.prefill(ids, start, C)
+            toks = self.gen.decode(cache, logits0, start, P, self.steps,
+                                   1, self.spec.eos_token_id)
+            out = np.asarray(toks)
+        else:
+            import jax
+            t_p0 = time.monotonic()
+            cache, logits0 = self.gen.prefill(ids, start, C)
+            # fence so the prefill/decode split is honest device time
+            # (only traced batches pay this extra sync point)
+            jax.block_until_ready(logits0)
+            t_p1 = time.monotonic()
+            toks = self.gen.decode(cache, logits0, start, P, self.steps,
+                                   1, self.spec.eos_token_id)
+            out = np.asarray(toks)         # fences the scanned token loop
+            t_d1 = time.monotonic()
+            dt = (t_d1 - t_p1) / self.steps
+            for r in traced:
+                _tracing.child(r.trace, "prefill", t_p0, t_p1,
+                               prompt_bucket=P, cache_bucket=C, batch=B)
+                d = _tracing.start_span("decode", parent=r.trace,
+                                        t0=t_p1, steps=self.steps,
+                                        cache_bucket=C, batch=B,
+                                        per_token_ms=round(dt * 1e3, 4))
+                if d is not None:
+                    # per-token events, attributed at the scan boundary:
+                    # the whole token loop is ONE jitted lax.scan (one
+                    # device program), so the host never observes token k
+                    # alone — timestamps spread uniformly across the
+                    # fenced scan window
+                    for k in range(r.max_new):
+                        d.event("token", t=t_p1 + (k + 1) * dt, index=k)
+                    _tracing.finish(d, end=t_d1)
         if key_missing:
             self._warmed_prefill.add((B, P, C))
             self._warmed_decode.add((B, C))
-        return np.asarray(toks)
+        return out
 
     def publish(self):
         self.latency.publish(f"serving_{self.name}")

@@ -24,7 +24,26 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..framework.enforce import UnavailableError
+from ..profiler import tracing as _tracing
+from ..profiler.metrics import default_registry as _registry
 from ..utils.monitor import stat_set
+
+# typed serving histograms (docs/METRICS.md inventory): where a request
+# waits, how full the batches run, how much of each bucket is padding
+_QUEUE_WAIT = _registry().histogram(
+    "serving_queue_wait_seconds",
+    "Time a request spends in the RequestQueue between submit() and the "
+    "continuous batcher packing it (per request).")
+_BATCH_ROWS = _registry().histogram(
+    "serving_batch_occupancy_rows",
+    "Real (un-padded) rows per scheduler-formed batch — how big the "
+    "continuous batcher actually runs under load.",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+_PAD_EFFICIENCY = _registry().histogram(
+    "serving_padding_efficiency_ratio",
+    "rows / bucket per batch: 1.0 = the padded bucket was full, low "
+    "values = the ladder is paying for zeros.",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
 
 
 @dataclass
@@ -36,6 +55,11 @@ class Request:
     rows: int
     future: Future = field(default_factory=Future)
     t_enqueue: float = field(default_factory=time.perf_counter)
+    # request-scoped tracing: the root span opened by Server.submit (None
+    # when FLAGS_trace is off / the request was not sampled) plus the
+    # monotonic enqueue stamp the queue-wait span/histogram is cut from
+    trace: Optional[object] = None
+    t_enqueue_mono: float = field(default_factory=time.monotonic)
 
 
 @dataclass
@@ -134,12 +158,24 @@ class RequestQueue:
                         break
                     self._cond.wait(remaining)
                 dq = self._pending[model]
+            t_pack0 = time.monotonic()
             taken, rows = pack_fifo(dq, limit)
             self._depth -= len(taken)
             stat_set("serving_queue_depth", self._depth)
             self._cond.notify_all()
-        return Batch(model=model, requests=taken, rows=rows,
-                     bucket=bucket_of(model, rows))
+        bucket = bucket_of(model, rows)
+        t_pack1 = time.monotonic()
+        _BATCH_ROWS.observe(rows)
+        _PAD_EFFICIENCY.observe(rows / bucket if bucket else 0.0)
+        for r in taken:
+            _QUEUE_WAIT.observe(t_pack0 - r.t_enqueue_mono)
+            if r.trace is not None:
+                _tracing.child(r.trace, "queue_wait",
+                               r.t_enqueue_mono, t_pack0)
+                _tracing.child(r.trace, "pack", t_pack0, t_pack1,
+                               bucket=bucket, batch_rows=rows,
+                               padding_rows=bucket - rows)
+        return Batch(model=model, requests=taken, rows=rows, bucket=bucket)
 
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:

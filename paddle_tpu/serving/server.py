@@ -44,8 +44,27 @@ from ..framework.enforce import (InvalidArgumentError, NotFoundError,
                                  PreconditionNotMetError, UnavailableError)
 from ..profiler import ledger as _ledger
 from ..profiler import span as _span
+from ..profiler import tracing as _tracing
 from ..profiler.metrics import LatencyWindow, RateMeter
 from ..utils.monitor import stat_add
+
+
+def _trace_batch(batch, name, t0, t1, **attrs):
+    """Emit one ``name`` child span [t0, t1] onto every traced request of
+    a batch (batch phases are shared work: each request's waterfall shows
+    the phase it rode).  One branch per request when tracing is off."""
+    for r in batch.requests:
+        if r.trace is not None:
+            _tracing.child(r.trace, name, t0, t1, **attrs)
+
+
+def _first_trace(batch):
+    """The batch's ambient span target: the first traced request (ledger
+    compile events attach there while the batch executes)."""
+    for r in batch.requests:
+        if r.trace is not None:
+            return r.trace
+    return None
 from .bucketing import BucketLadder, pad_to_bucket
 from .decode import DecodeModelSpec, DecodeRequest, _DecodeRuntime
 from .scheduler import Batch, Request, RequestQueue
@@ -450,14 +469,21 @@ class _Worker(threading.Thread):
         if getattr(rt, "kind", None) == "decode":
             # prefill + scanned decode: one long device program — run it
             # synchronously (the scan IS the pipeline) and slice per
-            # request, honoring each request's own max_new cap
-            toks = rt.execute(batch)
+            # request, honoring each request's own max_new cap.  The
+            # runtime emits prefill/decode spans (+ per-token events at
+            # the scan boundary); an eventual escape-hatch compile lands
+            # on the ambient request span
+            with _tracing.use_span(_first_trace(batch)):
+                toks = rt.execute(batch)
             now = time.perf_counter()
+            t_r0 = time.monotonic()
             off = 0
             for r in batch.requests:
                 r.future.set_result([toks[off:off + r.rows, :r.max_new]])
                 rt.latency.observe(now - r.t_enqueue)
                 off += r.rows
+            _trace_batch(batch, "reply", t_r0, time.monotonic())
+            self._finish_traces(batch)
             rt.rate.add(len(batch.requests))
             rt.bump(completed=len(batch.requests), batches=1,
                     rows=batch.rows,
@@ -468,6 +494,7 @@ class _Worker(threading.Thread):
                      batch.bucket - batch.rows)
             rt.publish()
             return
+        t_h0 = time.monotonic()
         host = [np.concatenate([r.inputs[i] for r in batch.requests], axis=0)
                 if len(batch.requests) > 1 else batch.requests[0].inputs[i]
                 for i in range(rt.n_inputs)]
@@ -477,23 +504,39 @@ class _Worker(threading.Thread):
             # synchronous path: the Executor fences internally; its cache
             # hit is the ledger proof that steady state never recompiles
             clone = self.clones[batch.model]
-            outs = clone.run(padded)
+            t_e0 = time.monotonic()
+            with _tracing.use_span(_first_trace(batch)):
+                outs = clone.run(padded)
+            t_e1 = time.monotonic()
+            _trace_batch(batch, "h2d", t_h0, t_e0, bucket=batch.bucket)
+            _trace_batch(batch, "execute", t_e0, t_e1,
+                         bucket=batch.bucket, backend="executor")
             self._complete(batch, outs)
             return
         if ex is None:
-            ex = rt.late_compile(batch.bucket)
+            with _tracing.use_span(_first_trace(batch)):
+                ex = rt.late_compile(batch.bucket)
         with _span("serving::h2d"):
             dev = [jax.device_put(a) for a in padded]
+        t_e0 = time.monotonic()
+        _trace_batch(batch, "h2d", t_h0, t_e0, bucket=batch.bucket)
         with _span("serving::dispatch"):
             outs = ex(dev)
-        self._inflight.append((batch, outs))
+        self._inflight.append((batch, outs, t_e0))
         while len(self._inflight) > self._depth:
             self._fence_oldest()
 
     def _fence_oldest(self):
-        batch, outs = self._inflight.popleft()
+        batch, outs, t_e0 = self._inflight.popleft()
+        t_f0 = time.monotonic()
         with _span("serving::fence"):
-            self._complete(batch, [np.asarray(o) for o in outs])
+            outs_np = [np.asarray(o) for o in outs]
+        t_f1 = time.monotonic()
+        # execute = dispatch → fence start (the async pipeline residency
+        # window); d2h = the blocking fetch that fences it
+        _trace_batch(batch, "execute", t_e0, t_f0, bucket=batch.bucket)
+        _trace_batch(batch, "d2h", t_f0, t_f1)
+        self._complete(batch, outs_np)
 
     def _drain(self):
         while self._inflight:
@@ -502,11 +545,14 @@ class _Worker(threading.Thread):
     def _complete(self, batch: Batch, outs_np):
         rt = self._server._models[batch.model]
         now = time.perf_counter()
+        t_r0 = time.monotonic()
         off = 0
         for r in batch.requests:
             r.future.set_result([o[off:off + r.rows] for o in outs_np])
             rt.latency.observe(now - r.t_enqueue)
             off += r.rows
+        _trace_batch(batch, "reply", t_r0, time.monotonic())
+        self._finish_traces(batch)
         rt.rate.add(len(batch.requests))
         rt.bump(completed=len(batch.requests), batches=1, rows=batch.rows,
                 padded_rows=batch.bucket - batch.rows)
@@ -515,11 +561,22 @@ class _Worker(threading.Thread):
         stat_add("serving_padding_rows_total", batch.bucket - batch.rows)
         rt.publish()
 
+    @staticmethod
+    def _finish_traces(batch: Batch, error: Optional[str] = None):
+        for r in batch.requests:
+            if r.trace is not None:
+                r.trace.set_attr(bucket=batch.bucket,
+                                 batch_rows=batch.rows)
+                if error is not None:
+                    r.trace.set_attr(error=error)
+                _tracing.finish(r.trace)
+
     def _fail(self, batch: Batch, exc: Exception):
         rt = self._server._models[batch.model]
         for r in batch.requests:
             if not r.future.done():
                 r.future.set_exception(exc)
+        self._finish_traces(batch, error=type(exc).__name__)
         rt.bump(errors=len(batch.requests))
         stat_add("serving_errors_total", len(batch.requests))
 
@@ -770,7 +827,9 @@ class Server:
         if rows == 0:
             raise InvalidArgumentError("empty request (0 rows)")
         rt.ladder.bucket_for(rows)           # raises OutOfRange early
-        req = Request(model=model, inputs=tuple(arrs), rows=rows)
+        req = Request(model=model, inputs=tuple(arrs), rows=rows,
+                      trace=_tracing.start_span(
+                          "request", model=model, rows=rows, kind="dense"))
         rt.bump(requests=1)
         stat_add("serving_requests_total")
         self._queue.put(req, timeout=timeout)
@@ -799,7 +858,10 @@ class Server:
         arrs, max_new = rt.validate(list(prompts), max_new_tokens)
         rt.ladder.bucket_for(len(arrs))      # raises OutOfRange early
         req = DecodeRequest(model=model, prompts=arrs, rows=len(arrs),
-                            max_new=max_new)
+                            max_new=max_new,
+                            trace=_tracing.start_span(
+                                "request", model=model, rows=len(arrs),
+                                kind="decode", max_new=max_new))
         rt.bump(requests=1)
         stat_add("serving_requests_total")
         self._queue.put(req, timeout=timeout)

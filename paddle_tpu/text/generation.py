@@ -51,6 +51,7 @@ from ..framework.tensor import Tensor, unwrap
 from ..ops.decode import (_beam_search_step_fn, _gather_tree_fn,
                           beam_parent_gather)
 from ..profiler import ledger as _ledger
+from ..profiler import tracing as _tracing
 from ..serving.bucketing import BucketLadder
 
 __all__ = ["Generator", "generate"]
@@ -364,9 +365,39 @@ class Generator:
         C = self.cache_bucket(P, steps)
         prompts = [ids_np[b, :lens[b]] for b in range(B)]
         ids, start = self.pack_prompts(prompts, P)
-        cache, logits0 = self.prefill(ids, start, C)
-        out = self.decode(cache, logits0, start, P, steps,
-                          beam_size=beam_size, eos_token_id=eos_token_id)
+        tr = _tracing.start_span("generate", model=self._site, rows=B,
+                                 steps=steps, beam=beam_size)
+        if tr is None:                     # off-path: one branch, no fence
+            cache, logits0 = self.prefill(ids, start, C)
+            out = self.decode(cache, logits0, start, P, steps,
+                              beam_size=beam_size,
+                              eos_token_id=eos_token_id)
+        else:
+            # traced call: fence at the scan boundary so the
+            # prefill/decode split (and the per-token attribution across
+            # the scanned token loop) is honest device time; any compile
+            # the call pays lands on this span via the ledger hook
+            with _tracing.use_span(tr):
+                t0 = time.monotonic()
+                cache, logits0 = self.prefill(ids, start, C)
+                jax.block_until_ready(logits0)
+                t1 = time.monotonic()
+                _tracing.child(tr, "prefill", t0, t1, prompt_bucket=P,
+                               cache_bucket=C)
+                out = self.decode(cache, logits0, start, P, steps,
+                                  beam_size=beam_size,
+                                  eos_token_id=eos_token_id)
+                jax.block_until_ready(out)
+                t2 = time.monotonic()
+            dt = (t2 - t1) / steps
+            d = _tracing.start_span("decode", parent=tr, t0=t1,
+                                    steps=steps, cache_bucket=C,
+                                    per_token_ms=round(dt * 1e3, 4))
+            if d is not None:
+                for k in range(steps):
+                    d.event("token", t=t1 + (k + 1) * dt, index=k)
+                _tracing.finish(d, end=t2)
+            _tracing.finish(tr, end=t2)
         if beam_size == 1:
             return Tensor(out)
         paths, scores = out

@@ -64,7 +64,16 @@ class LogWriter:
 
     with LogWriter(logdir="runs/exp1") as w:
         w.add_scalar("train/loss", loss_value, step)
+
+    Sinks are size-capped (FLAGS_log_writer_max_mb, default 64 MiB):
+    past the cap the file rotates — ``f.jsonl`` → ``f.jsonl.1`` →
+    ``f.jsonl.2``, two rollovers kept — so a long-running serve process
+    streaming ledger/lint/audit/trace events cannot grow any file
+    without bound.  ``read_scalars``/``read_events`` read rotated files
+    too (oldest first), so nothing recent is lost to a rollover.
     """
+
+    _ROLLOVERS = 2
 
     def __init__(self, logdir: str, filename_suffix: str = ""):
         self.logdir = logdir
@@ -73,28 +82,54 @@ class LogWriter:
                 f"{filename_suffix}.jsonl"
         self._path = os.path.join(logdir, fname)
         self._f = open(self._path, "a", buffering=1)
+        self._bytes = self._f.tell()
         self._lock = threading.Lock()
+
+    def _cap_bytes(self):
+        try:
+            from ..framework import flags as _flags
+            return int(float(_flags.flag("log_writer_max_mb")) * 1048576)
+        except Exception:
+            return 0
+
+    def _rotate_locked(self):
+        """Shift f.jsonl.1 -> f.jsonl.2, f.jsonl -> f.jsonl.1, reopen
+        fresh; must be called with _lock held."""
+        self._f.close()
+        for i in range(self._ROLLOVERS, 1, -1):
+            src = f"{self._path}.{i - 1}"
+            if os.path.exists(src):
+                os.replace(src, f"{self._path}.{i}")
+        os.replace(self._path, f"{self._path}.1")
+        self._f = open(self._path, "a", buffering=1)
+        self._bytes = 0
+
+    def _write(self, rec: dict, default=None):
+        line = json.dumps(rec, default=default) + "\n"
+        cap = self._cap_bytes()
+        with self._lock:
+            if cap and self._bytes + len(line) > cap and self._bytes:
+                self._rotate_locked()
+            self._f.write(line)
+            self._bytes += len(line)
 
     def add_scalar(self, tag: str, value, step: int = 0,
                    walltime: float = None):
-        rec = {"tag": tag, "value": float(value), "step": int(step),
-               "wall": walltime if walltime is not None else time.time()}
-        with self._lock:
-            self._f.write(json.dumps(rec) + "\n")
+        self._write({"tag": tag, "value": float(value), "step": int(step),
+                     "wall": walltime if walltime is not None
+                     else time.time()})
 
     def add_hparams(self, hparams: dict, metrics: dict = None):
-        rec = {"hparams": {k: repr(v) for k, v in hparams.items()},
-               "metrics": {k: float(v) for k, v in (metrics or {}).items()}}
-        with self._lock:
-            self._f.write(json.dumps(rec) + "\n")
+        self._write({"hparams": {k: repr(v) for k, v in hparams.items()},
+                     "metrics": {k: float(v)
+                                 for k, v in (metrics or {}).items()}})
 
     def add_event(self, tag: str, event: dict, walltime: float = None):
         """Structured (non-scalar) JSONL event — the recompile ledger and
         other telemetry ride this channel; read back with read_events."""
-        rec = {"tag": tag, "event": event,
-               "wall": walltime if walltime is not None else time.time()}
-        with self._lock:
-            self._f.write(json.dumps(rec, default=repr) + "\n")
+        self._write({"tag": tag, "event": event,
+                     "wall": walltime if walltime is not None
+                     else time.time()}, default=repr)
 
     def flush(self):
         self._f.flush()
@@ -109,13 +144,28 @@ class LogWriter:
         self.close()
 
     @staticmethod
+    def _log_files(logdir: str):
+        """Sink files oldest-first, rotated generations (.jsonl.2,
+        .jsonl.1) before each live .jsonl so readers see event order."""
+
+        def key(fn):
+            if fn.endswith(".jsonl"):
+                return (fn, 0)
+            base, gen = fn.rsplit(".", 1)
+            return (base, -int(gen))
+
+        names = [fn for fn in os.listdir(logdir)
+                 if fn.endswith(".jsonl")
+                 or (fn.rsplit(".", 1)[-1].isdigit()
+                     and ".jsonl." in fn)]
+        return [os.path.join(logdir, fn) for fn in sorted(names, key=key)]
+
+    @staticmethod
     def read_scalars(logdir: str):
         """Load all scalar records from a log dir -> {tag: [(step, value)]}."""
         out = {}
-        for fn in sorted(os.listdir(logdir)):
-            if not fn.endswith(".jsonl"):
-                continue
-            with open(os.path.join(logdir, fn)) as f:
+        for path in LogWriter._log_files(logdir):
+            with open(path) as f:
                 for line in f:
                     rec = json.loads(line)
                     if "tag" in rec and "value" in rec:
@@ -127,10 +177,8 @@ class LogWriter:
     def read_events(logdir: str):
         """Load structured events (add_event) -> {tag: [event dicts]}."""
         out = {}
-        for fn in sorted(os.listdir(logdir)):
-            if not fn.endswith(".jsonl"):
-                continue
-            with open(os.path.join(logdir, fn)) as f:
+        for path in LogWriter._log_files(logdir):
+            with open(path) as f:
                 for line in f:
                     rec = json.loads(line)
                     if "tag" in rec and "event" in rec:

@@ -141,6 +141,28 @@ def test_cached_trainer_eviction_equivalence():
     np.testing.assert_array_equal(a, b)
 
 
+def test_cached_trainer_publishes_tier_hit_counters():
+    """ISSUE 11: the cached step attributes every deduped id to a
+    storage tier — first sight pays the host PS, a re-seen batch is all
+    cache-arena hits (the typed wide_deep_tier_hits_total counter)."""
+    from paddle_tpu.profiler.metrics import default_registry
+    tiers = default_registry().get("wide_deep_tier_hits_total")
+    arena = tiers.labels(tier="cache_arena")
+    ps = tiers.labels(tier="host_ps")
+    paddle.seed(3)
+    m = WideDeep(hidden=(16,), emb_dim=4)
+    t = WideDeepTrainer(m, device_cache=True)
+    ids, dense, label = synthetic_ctr_batch(64, vocab=10_000, seed=0)
+    n_uniq = len(np.unique(ids))
+    a0, p0 = arena.value, ps.value
+    t.step(ids, dense, label)               # cold: every id misses
+    assert ps.value - p0 == n_uniq
+    assert arena.value - a0 == 0
+    t.step(ids, dense, label)               # warm: every id hits the arena
+    assert arena.value - a0 == n_uniq
+    assert ps.value - p0 == n_uniq
+
+
 def test_cached_trainer_matches_pullpush_mode():
     """The on-chip sparse rule + cached dataflow must track the host-side
     pull/push path: same init, same batches, f32 wire -> near-identical

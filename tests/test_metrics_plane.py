@@ -1,0 +1,296 @@
+"""Typed metrics plane (profiler.metrics): Counter/Gauge/Histogram with
+label sets and the stat_set mirror, Prometheus text exposition (validated
+by a strict parser), the stdlib-http endpoint + textfile export,
+LogWriter size-capped rotation, concurrent-update safety matching the
+serving clone-per-worker pattern, the docs/METRICS.md inventory drift
+gate, and the wall-clock-jump regression for monotonic rate/duration
+math."""
+import importlib.util
+import os
+import sys
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from paddle_tpu.framework.flags import (flags_restore, flags_snapshot,
+                                        set_flags)
+from paddle_tpu.profiler.metrics import (Counter, Gauge, Histogram,
+                                         LatencyWindow, MetricsRegistry,
+                                         RateMeter, default_registry,
+                                         serve_metrics, write_textfile)
+from paddle_tpu.utils.monitor import LogWriter, stat_get
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def flags_guard():
+    snap = flags_snapshot()
+    try:
+        yield
+    finally:
+        flags_restore(snap)
+
+
+# -- typed instruments --------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests_total", "requests", labels=("model",))
+    c.labels(model="a").inc()
+    c.labels(model="a").inc(4)
+    c.labels(model="b").inc(2)
+    assert c.labels(model="a").value == 5
+    assert c.labels("b").value == 2
+    with pytest.raises(ValueError):
+        c.labels(model="a").inc(-1)            # counters only go up
+    g = reg.gauge("t_depth", "queue depth")
+    g.set(7)
+    g.inc()
+    g.dec(3)
+    assert g.value == 5
+    h = reg.histogram("t_latency_seconds", "lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count == 4 and abs(h.sum - 5.555) < 1e-9
+    cum, s, n = h._default_child().snapshot()
+    assert cum == [1, 2, 3, 4]                 # cumulative, +Inf last
+    q = h.quantile(0.5)
+    assert 0.01 <= q <= 1.0
+
+
+def test_labels_validation_and_registration_conflicts():
+    reg = MetricsRegistry()
+    c = reg.counter("t_c", "d", labels=("x",))
+    with pytest.raises(ValueError):
+        c.inc()                                 # labeled: must use labels()
+    with pytest.raises(ValueError):
+        c.labels("a", "b")                      # arity
+    with pytest.raises(ValueError):
+        c.labels(y="a")                         # unknown label name
+    with pytest.raises(ValueError):
+        reg.counter("bad name", "d")
+    with pytest.raises(ValueError):
+        reg.counter("t_c2", "d", labels=("le bad",))
+    # idempotent re-registration returns the SAME family
+    assert reg.counter("t_c", "d", labels=("x",)) is c
+    # conflicting type / labels / buckets are loud
+    with pytest.raises(ValueError):
+        reg.gauge("t_c", "d", labels=("x",))
+    with pytest.raises(ValueError):
+        reg.counter("t_c", "d", labels=("y",))
+    h = reg.histogram("t_h", "d", buckets=(1, 2))
+    assert reg.histogram("t_h", "d", buckets=(1, 2)) is h
+    with pytest.raises(ValueError):
+        reg.histogram("t_h", "d", buckets=(1, 2, 3))
+
+
+def test_typed_metrics_mirror_into_stat_registry():
+    reg = MetricsRegistry()          # mirror goes to the GLOBAL stats
+    c = reg.counter("t_mirror_total", "d", labels=("tier",))
+    c.labels(tier="cache arena").inc(3)        # value sanitized for key
+    assert stat_get("t_mirror_total_cache_arena") == 3
+    g = reg.gauge("t_mirror_g", "d")
+    g.set(11)
+    assert stat_get("t_mirror_g") == 11
+    h = reg.histogram("t_mirror_h", "d", buckets=(1.0,))
+    h.observe(0.5)
+    h.observe(2.5)
+    assert stat_get("t_mirror_h_count") == 2
+
+
+# -- exposition ---------------------------------------------------------------
+
+def test_prometheus_text_parses_and_is_consistent():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "a counter", labels=("model",))
+    c.labels(model='we"ird\\m').inc(2)
+    h = reg.histogram("t_lat", "a histogram", labels=("phase",),
+                      buckets=(0.1, 1.0))
+    h.labels(phase="p1").observe(0.05)
+    h.labels(phase="p1").observe(0.5)
+    h.labels(phase="p1").observe(5.0)
+    reg.gauge("t_g", "a gauge").set(-3)
+    text = reg.prometheus_text()
+    obs = _load_tool("obs_report")
+    fams = obs.parse_prometheus_text(text)     # raises on malformed lines
+    assert fams["t_total"] == {'model="we\\"ird\\\\m"': 2.0}
+    assert fams["t_g"][""] == -3.0
+    buckets = fams["t_lat_bucket"]
+    assert buckets['phase="p1",le="0.1"'] == 1.0
+    assert buckets['phase="p1",le="1"'] == 2.0
+    assert buckets['phase="p1",le="+Inf"'] == 3.0
+    assert fams["t_lat_count"]['phase="p1"'] == 3.0
+    assert abs(fams["t_lat_sum"]['phase="p1"'] - 5.55) < 1e-9
+    # legacy stats ride along as the paddle_tpu_stat family, minus keys
+    # the typed plane mirrors
+    from paddle_tpu.utils.monitor import stat_set
+    stat_set("t_legacy_gauge", 42)
+    full = default_registry().prometheus_text()
+    fams = obs.parse_prometheus_text(full)
+    assert fams["paddle_tpu_stat"]['name="t_legacy_gauge"'] == 42.0
+    mirrored = default_registry()._mirrored_stat_names()
+    for k in fams.get("paddle_tpu_stat", {}):
+        assert k[len('name="'):-1] not in mirrored
+
+
+def test_metrics_http_endpoint_and_textfile(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("t_http_total", "d").inc(9)
+    with serve_metrics(port=0, registry=reg) as srv:
+        assert srv.port > 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            body = r.read().decode()
+            assert r.status == 200
+            assert "version=0.0.4" in r.headers["Content-Type"]
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=10)
+    assert "t_http_total 9" in body
+    path = str(tmp_path / "sub" / "m.prom")
+    write_textfile(path, registry=reg)
+    with open(path) as f:
+        assert f.read() == reg.prometheus_text()
+    assert not os.path.exists(path + ".tmp")   # atomic: no debris
+
+
+def test_metrics_doc_inventory_is_frozen():
+    """docs/METRICS.md must list every registered metric — regenerating
+    the inventory in-memory and diffing is the gen_api_spec discipline:
+    add a metric without re-freezing the doc and this fails."""
+    gen = _load_tool("gen_metrics_doc")
+    rendered = gen.render()
+    with open(os.path.join(REPO, "docs", "METRICS.md")) as f:
+        committed = f.read()
+    assert rendered == committed, (
+        "docs/METRICS.md is stale: run "
+        "`python tools/gen_metrics_doc.py > docs/METRICS.md`")
+    # and the pillar metrics are actually in the inventory
+    for name in ("serving_queue_wait_seconds", "train_step_phase_seconds",
+                 "wide_deep_tier_hits_total"):
+        assert f"`{name}`" in committed
+
+
+# -- concurrency (the serving clone-per-worker pattern) -----------------------
+
+def test_concurrent_updates_lose_nothing():
+    """8 writer threads × 500 updates hammering LatencyWindow, RateMeter
+    and a labeled Histogram concurrently (the serving pattern: every
+    worker thread observes into the same family): exact counts, sane
+    percentiles."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t_conc_seconds", "d", labels=("phase",),
+                      buckets=(0.001, 0.01, 0.1, 1.0))
+    c = reg.counter("t_conc_total", "d")
+    lw = LatencyWindow(maxlen=8192)
+    rm = RateMeter()
+    N, W = 500, 8
+
+    def hammer(w):
+        rng = np.random.RandomState(w)
+        for i in range(N):
+            v = float(rng.uniform(0.002, 0.5))
+            h.labels(phase="exec").observe(v)
+            lw.observe(v)
+            rm.add()
+            c.inc()
+        return w
+
+    with ThreadPoolExecutor(max_workers=W) as pool:
+        assert sorted(pool.map(hammer, range(W))) == list(range(W))
+    assert h.labels(phase="exec").count == N * W
+    assert c.value == N * W
+    assert lw.count == N * W
+    assert rm.count == N * W
+    cum, s, n = h.labels(phase="exec").snapshot()
+    assert cum[-1] == n == N * W               # no lost bucket increments
+    assert 0.002 * N * W <= s <= 0.5 * N * W
+    p50 = lw.percentile(50)
+    p99 = lw.percentile(99)
+    assert 0.002 <= p50 <= p99 <= 0.5
+    q = h.labels(phase="exec").quantile(0.5)
+    assert 0.001 <= q <= 1.0
+    assert rm.rate() > 0
+
+
+# -- LogWriter rotation -------------------------------------------------------
+
+def test_log_writer_rotation_caps_file_size(flags_guard, tmp_path):
+    set_flags({"FLAGS_log_writer_max_mb": 0.001})      # ~1 KiB cap
+    d = str(tmp_path / "sink")
+    with LogWriter(logdir=d, filename_suffix=".t") as w:
+        for i in range(200):
+            w.add_event("trace/span", {"i": i, "pad": "x" * 64})
+    files = sorted(os.listdir(d))
+    live = [f for f in files if f.endswith(".jsonl")]
+    rolled = [f for f in files if ".jsonl." in f]
+    assert len(live) == 1
+    # two rollovers kept, never more (the cap bounds total disk)
+    assert 1 <= len(rolled) <= 2
+    assert all(f.endswith((".1", ".2")) for f in rolled)
+    cap = 0.001 * 1048576
+    for f in files:
+        # every file obeys the cap (+ one record of slack)
+        assert os.path.getsize(os.path.join(d, f)) <= cap + 256, f
+    # readers see rotated generations too, oldest first
+    evs = LogWriter.read_events(d)["trace/span"]
+    assert len(evs) > 2
+    idxs = [e["i"] for e in evs]
+    assert idxs == sorted(idxs)
+    assert idxs[-1] == 199                     # newest record never lost
+
+
+def test_log_writer_no_rotation_when_disabled(flags_guard, tmp_path):
+    set_flags({"FLAGS_log_writer_max_mb": 0})
+    d = str(tmp_path / "sink")
+    with LogWriter(logdir=d) as w:
+        for i in range(200):
+            w.add_event("e", {"i": i, "pad": "x" * 64})
+    assert len(os.listdir(d)) == 1
+    assert len(LogWriter.read_events(d)["e"]) == 200
+
+
+# -- wall-clock jump regression ----------------------------------------------
+
+def test_rate_and_duration_math_survives_wall_clock_jump(monkeypatch,
+                                                         flags_guard):
+    """Regression (ISSUE 11 satellite): RateMeter rates and span
+    durations are monotonic-clocked — a mocked NTP-style wall-clock jump
+    mid-measurement must not bend either.  Timestamps may (and do) stay
+    wall-clock."""
+    from paddle_tpu.profiler import tracing
+    set_flags({"FLAGS_trace": "full"})
+    tracing.clear()
+    real_time = time.time
+    jumped = [False]
+
+    def fake_time():
+        return real_time() + (86400.0 if jumped[0] else 0.0)
+
+    rm = RateMeter()
+    rm.add(10)
+    s = tracing.start_span("jump_span")
+    monkeypatch.setattr(time, "time", fake_time)
+    jumped[0] = True                 # the wall clock leaps a day forward
+    time.sleep(0.01)
+    rate = rm.rate()
+    assert rate > 1.0                # 10 / ~0.01s, NOT 10 / ~86400s
+    tracing.finish(s)
+    rec = tracing.finished_spans()[-1]
+    assert rec["dur_ms"] < 1000.0    # duration is monotonic, not a day
+    assert rec["wall"] > 0           # the timestamp annotation remains
+    lw = LatencyWindow()
+    lw.observe(0.005)
+    assert lw.percentile(50) == 0.005

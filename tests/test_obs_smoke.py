@@ -1,0 +1,77 @@
+"""Slow observability smoke (ISSUE 11): tools/serve.py under mixed
+dense+decode traffic with --metrics-port, --metrics-textfile and
+--trace-dir and FLAGS_trace=full — the live scrape parses as valid
+Prometheus text, every completed request has a complete well-nested span
+chain (tools/obs_report.py is the judge), and ZERO steady-state
+recompiles happen with tracing ON."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_serve_traced_metrics_smoke_end_to_end(tmp_path):
+    trace_dir = str(tmp_path / "traces")
+    prom = str(tmp_path / "metrics.prom")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "--decode", "--model", "lenet", "--duration", "1.0",
+         "--clients", "2", "--buckets", "1,2", "--seq-buckets", "8,16",
+         "--max-new", "4", "--max-request-rows", "2",
+         "--metrics-port", "0", "--metrics-textfile", prom,
+         "--trace-dir", trace_dir, "--json"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PADDLE_TPU_TRACE": "full"})
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    report = json.loads(p.stdout)
+    # zero steady-state recompiles with tracing ON: instrumenting the
+    # path never adds a compile key (acceptance criterion)
+    assert report["trace_mode"] == "full"
+    assert report["steady_compiles"] == 0
+    assert report["metrics_scrape_ok"] is True
+    assert report["metrics_port"] > 0
+    for name in ("gpt_decode", "lenet"):
+        st = report["models"][name]
+        assert st["errors"] == 0 and st["completed"] > 0
+        assert st["traffic_errors"] == []
+
+    # the textfile is strictly-parseable Prometheus text carrying the
+    # serving histograms + legacy gauges
+    obs_py = os.path.join(REPO, "tools", "obs_report.py")
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("obs_report", obs_py)
+    obs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs)
+    with open(prom) as f:
+        fams = obs.parse_prometheus_text(f.read())
+    assert fams["serving_queue_wait_seconds_count"][""] >= \
+        report["models"]["lenet"]["completed"]
+    assert "serving_batch_occupancy_rows_bucket" in fams
+    assert "paddle_tpu_stat" in fams
+
+    # every completed request left a complete, well-nested span chain —
+    # obs_report exits non-zero otherwise
+    q = subprocess.run(
+        [sys.executable, obs_py, "--trace-dir", trace_dir,
+         "--metrics", prom, "--json"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert q.returncode == 0, q.stdout[-2000:] + q.stderr[-2000:]
+    rep = json.loads(q.stdout)
+    completed = sum(report["models"][m]["completed"]
+                    for m in report["models"])
+    # the per-model counters are snapshotted before stop() drains the
+    # queue, so traces (written at completion) may exceed them slightly;
+    # every trace must still be a complete chain
+    assert rep["traces"] == rep["complete"] >= completed
+    assert not rep["incomplete"]
+    assert set(rep["kinds"]) == {"dense", "decode"}
+    assert rep["phases_ms"]["queue_wait"]["count"] == rep["complete"]
+    assert "prefill" in rep["phases_ms"] and "decode" in rep["phases_ms"]
+    assert rep["metrics"]

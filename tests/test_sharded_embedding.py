@@ -342,9 +342,22 @@ def test_sharded_trainer_steady_state_routes_nothing():
                             sharded_embedding=True, sharded_vocab=3000,
                             mesh=_mesh())
         ids, dense, label = synthetic_ctr_batch(64, vocab=3000, seed=0)
+        from paddle_tpu.profiler.metrics import default_registry
+        tiers = default_registry().get("wide_deep_tier_hits_total")
+        arena = tiers.labels(tier="cache_arena")
+        mesh_t = tiers.labels(tier="mesh_table")
+        ps = tiers.labels(tier="host_ps")
+        n_uniq = len(np.unique(ids))
+        a0, m0, p0 = arena.value, mesh_t.value, ps.value
         t.step(ids, dense, label)
+        # first sight: every deduped id is a host-PS cold fetch
+        assert ps.value - p0 == n_uniq
+        assert mesh_t.value - m0 == 0 and arena.value - a0 == 0
         t.step(ids, dense, label)
         assert t._last_route_stats == {"cold": 0, "warm": 0, "victims": 0}
+        # steady state: the typed per-tier counters agree — all arena hits
+        assert arena.value - a0 == n_uniq
+        assert mesh_t.value - m0 == 0 and ps.value - p0 == n_uniq
         stats = t.sharded_step_stats(ids, dense, label)
         assert stats["all_to_all_count"] > 0          # legs still compiled
         assert stats["n_shards"] == N_DEV

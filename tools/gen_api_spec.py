@@ -38,6 +38,9 @@ NAMESPACES = [
     ("paddle_tpu.quantization", None),
     ("paddle_tpu.regularizer", None),
     ("paddle_tpu.incubate", None),
+    ("paddle_tpu.profiler", None),
+    ("paddle_tpu.profiler.metrics", None),
+    ("paddle_tpu.profiler.tracing", None),
     ("paddle_tpu.rec", None),
     ("paddle_tpu.checkpoint", None),
     ("paddle_tpu.testing", None),

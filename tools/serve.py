@@ -188,6 +188,22 @@ def main(argv=None):
                     help="clients submit 1..N rows per request")
     ap.add_argument("--p99-slo-ms", type=float, default=None,
                     help="fail (rc!=0) when any model's p99 exceeds this")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve GET /metrics (Prometheus text) from a "
+                         "stdlib http endpoint on this port while "
+                         "traffic runs (0 = ephemeral; the bound port "
+                         "lands in the report).  The report records a "
+                         "self-scrape so CI can gate on exposition "
+                         "health without its own scraper")
+    ap.add_argument("--metrics-textfile", default=None, metavar="PATH",
+                    help="atomically write the final Prometheus "
+                         "exposition to PATH (textfile-collector "
+                         "convention — scrape-less CI)")
+    ap.add_argument("--trace-dir", default=None, metavar="DIR",
+                    help="stream request spans as LogWriter JSONL into "
+                         "DIR (sets FLAGS_trace=full unless FLAGS_trace "
+                         "/ PADDLE_TPU_TRACE already enabled a mode); "
+                         "join with tools/obs_report.py")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit one JSON report instead of text")
     ap.add_argument("--seed", type=int, default=0)
@@ -207,9 +223,22 @@ def main(argv=None):
               "duration_s": args.duration, "clients": args.clients,
               "models": {}}
     rc = 0
+    metrics_srv = None
     try:
         if args.int8:
             set_flags({"FLAGS_use_int8_inference": True})
+        if args.trace_dir:
+            from paddle_tpu.framework.flags import flag as _flag
+            from paddle_tpu.profiler import tracing as _tracing
+            if str(_flag("trace")).lower() == "off":
+                set_flags({"FLAGS_trace": "full"})
+            _tracing.set_trace_dir(args.trace_dir)
+            report["trace_dir"] = args.trace_dir
+            report["trace_mode"] = str(_flag("trace")).lower()
+        if args.metrics_port is not None:
+            from paddle_tpu.profiler.metrics import serve_metrics
+            metrics_srv = serve_metrics(port=args.metrics_port)
+            report["metrics_port"] = metrics_srv.port
         with tempfile.TemporaryDirectory() as d:
             server = serving.Server(serving.ServingConfig(
                 workers=args.workers, buckets=buckets))
@@ -290,7 +319,34 @@ def main(argv=None):
                 report["steady_compile_events"] = [
                     {"site": e["site"], "kind": e.get("kind"),
                      "diff": e["diff"]} for e in steady[:8]]
+            if metrics_srv is not None:
+                # self-scrape: the endpoint must serve parseable
+                # Prometheus text while the process is still up
+                import urllib.request
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{metrics_srv.port}/metrics",
+                            timeout=10) as resp:
+                        body = resp.read().decode()
+                    report["metrics_scrape_ok"] = (
+                        resp.status == 200
+                        and "serving_queue_wait_seconds_bucket" in body)
+                except Exception as e:   # noqa: BLE001 — reported, gated
+                    report["metrics_scrape_ok"] = False
+                    report["metrics_scrape_error"] = \
+                        f"{type(e).__name__}: {e}"
+                if not report["metrics_scrape_ok"]:
+                    rc = 1
+            if args.metrics_textfile:
+                from paddle_tpu.profiler.metrics import write_textfile
+                report["metrics_textfile"] = \
+                    write_textfile(args.metrics_textfile)
     finally:
+        if metrics_srv is not None:
+            metrics_srv.close()
+        if args.trace_dir:
+            from paddle_tpu.profiler import tracing as _tracing
+            _tracing.set_trace_dir(None)
         flags_restore(snap)
 
     if args.as_json:
