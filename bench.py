@@ -965,22 +965,150 @@ def _bench_decode_one(variant, cfg, prompt_len, steps, batches,
     return res
 
 
+def _bench_decode_speculative(cfg, draft_cfg, prompt_len, steps, batches,
+                              seq_buckets, max_len, reps, plain):
+    """Speculative sub-run: draft/target SpeculativeGenerator vs the
+    plain bf16 decode numbers, accepted-tokens/s/chip at batch 1 and
+    max batch plus acceptance rate, with the same zero-steady-compile
+    assertion inside the timed window; cache plane bytes/token measured
+    for bf16 vs int8 KV storage (PERF.md speculative schema)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import (flags_restore, flags_snapshot,
+                                            set_flags)
+    from paddle_tpu.profiler import ledger as _led
+    from paddle_tpu.text.generation import Generator
+    from paddle_tpu.text.models.gpt import GPTModel
+    from paddle_tpu.text.speculative import SpeculativeGenerator
+
+    paddle.seed(0)
+    target = GPTModel(cfg)
+    target.eval()
+    paddle.seed(1)
+    draft = GPTModel(draft_cfg)
+    draft.eval()
+    gen = SpeculativeGenerator(target, draft,
+                               site="generate:bench_speculative",
+                               seq_buckets=seq_buckets, max_len=max_len)
+    res = {"gamma": gen.gamma,
+           "draft_params_fraction": round(gen._draft_fraction, 4)}
+    rng = np.random.RandomState(0)
+    for B in batches:
+        ids = rng.randint(1, cfg.vocab_size,
+                          (B, prompt_len)).astype(np.int64)
+        gen.generate(ids, max_new_tokens=steps)       # warm-up compiles
+        mark = len(_led.compile_events(gen.site))
+        P = gen.prefill_bucket(prompt_len)
+        C = gen.cache_bucket(P, steps)
+        packed, start = gen.pack_prompts(list(ids), P)
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            cache, logits0 = gen.prefill(packed, start, C)
+            toks = gen.decode(cache, logits0, start, P, steps)
+            jax.block_until_ready(toks)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        st = dict(gen.last_stats)
+        entry = {
+            "total_ms": round(best * 1e3, 3),
+            "tok_per_s_accepted": round(B * steps / best, 1),
+            "acceptance_rate": st["acceptance_rate"],
+            "spec_steps": st["spec_steps"],
+            "tokens_per_target_pass": round(steps / max(st["spec_steps"],
+                                                        1), 2),
+        }
+        ref = plain.get(f"batch{B}", {})
+        if ref.get("tok_per_s_total"):
+            entry["speedup_vs_plain"] = round(
+                entry["tok_per_s_accepted"] / ref["tok_per_s_total"], 3)
+        res[f"batch{B}"] = entry
+        steady = len(_led.compile_events(gen.site)) - mark
+        assert steady == 0, (
+            f"decode/speculative batch{B}: {steady} steady compile(s)")
+    res["zero_steady_state_compiles"] = True
+
+    # acceptance ceiling: draft == target accepts every proposal, so
+    # batch-1 runs at gamma+1 tokens per target pass — the upper bound a
+    # REAL (distilled) draft approaches; the random-weight draft above
+    # is the floor (its ~0 acceptance is honest CPU-control
+    # anti-evidence, like the sharded-embedding 0.18x entry)
+    ceil_gen = SpeculativeGenerator(target, target,
+                                    site="generate:bench_spec_ceiling",
+                                    seq_buckets=seq_buckets,
+                                    max_len=max_len)
+    ids1 = rng.randint(1, cfg.vocab_size, (1, prompt_len)).astype(np.int64)
+    ceil_gen.generate(ids1, max_new_tokens=steps)
+    mark = len(_led.compile_events(ceil_gen.site))
+    P = ceil_gen.prefill_bucket(prompt_len)
+    C = ceil_gen.cache_bucket(P, steps)
+    packed, start = ceil_gen.pack_prompts(list(ids1), P)
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        cache, logits0 = ceil_gen.prefill(packed, start, C)
+        toks = ceil_gen.decode(cache, logits0, start, P, steps)
+        jax.block_until_ready(toks)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    stc = dict(ceil_gen.last_stats)
+    assert len(_led.compile_events(ceil_gen.site)) == mark
+    res["self_draft_ceiling_batch1"] = {
+        "tok_per_s_accepted": round(steps / best, 1),
+        "acceptance_rate": stc["acceptance_rate"],
+        "tokens_per_target_pass": round(steps / max(stc["spec_steps"], 1),
+                                        2),
+    }
+
+    # cache plane bytes/token: the int8 claim is a layout fact, measured
+    # from the abstract cache planes (no chip needed)
+    def bytes_per_token(g, C):
+        planes = jax.eval_shape(lambda: g._init_cache_raw(1, C))
+        return sum(p.size * p.dtype.itemsize
+                   for c in planes for p in c) / C
+
+    C0 = seq_buckets[-1]
+    snap = flags_snapshot()
+    try:
+        plain_gen = Generator(target, site="generate:bench_kv_bytes",
+                              seq_buckets=seq_buckets, max_len=max_len)
+        full = bytes_per_token(plain_gen, C0)
+        set_flags({"FLAGS_kv_cache_dtype": "int8"})
+        int8 = bytes_per_token(plain_gen, C0)
+    finally:
+        flags_restore(snap)
+    res["kv_cache_bytes_per_token"] = {
+        "full_precision": int(full), "int8": int(int8),
+        "ratio": round(int8 / full, 3),
+        # rows alone halve vs bf16 planes (quarter vs the f32 planes the
+        # CPU control stores); the remainder is the per-head f32 scales
+    }
+    res["variant"] = "speculative"
+    return res
+
+
 def bench_decode(on_tpu):
     """Eighth block: autoregressive decoding tokens/s/chip through the
     static-shape KV-cache generate() (GPT), batch 1 vs max-batch,
     prefill-vs-decode split, bf16 vs frozen int8, with zero steady-state
     compiles asserted (PERF.md decode schema)."""
-    from paddle_tpu.text.models.gpt import GPTConfig
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTModel
 
     if on_tpu:
         cfg = GPTConfig(vocab_size=32000, hidden_size=768, num_layers=12,
                         num_heads=12, intermediate_size=3072,
                         max_position_embeddings=1024, dropout=0.0)
+        draft_cfg = GPTConfig(vocab_size=32000, hidden_size=256,
+                              num_layers=4, num_heads=4,
+                              intermediate_size=1024,
+                              max_position_embeddings=1024, dropout=0.0)
         prompt_len, steps, batches = 128, 128, (1, 8)
         seq_buckets, max_len, reps = (128, 256, 512), 512, 3
     else:
         cfg = GPTConfig.tiny(vocab_size=128, hidden_size=32, layers=2,
                              heads=2, seq=128)
+        draft_cfg = GPTConfig.tiny(vocab_size=128, hidden_size=16,
+                                   layers=1, heads=2, seq=128)
         prompt_len, steps, batches = 16, 16, (1, 4)
         seq_buckets, max_len, reps = (16, 32, 64), 64, 2
 
@@ -993,6 +1121,13 @@ def bench_decode(on_tpu):
         except Exception as e:           # noqa: BLE001 — per-model record
             _note(f"[bench] decode/{variant}: {type(e).__name__}: {e}")
             models[variant] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        models["speculative"] = _bench_decode_speculative(
+            cfg, draft_cfg, prompt_len, steps, batches, seq_buckets,
+            max_len, reps, models.get("bf16", {}))
+    except Exception as e:               # noqa: BLE001 — per-model record
+        _note(f"[bench] decode/speculative: {type(e).__name__}: {e}")
+        models["speculative"] = {"error": f"{type(e).__name__}: {e}"}
     ok = [m for m in models.values() if "error" not in m]
     res = {"unit": "tok/s/chip", "models": models,
            "zero_steady_state_compiles":

@@ -328,15 +328,19 @@ def _layout(ctx: LintContext) -> List[Diagnostic]:
                     start = starts[d] if d < len(starts) else None
                     if start is None or _static(start):
                         continue
-                    if (name == "dynamic_update_slice" and d == ndim - 2
-                            and len(sizes) == ndim
+                    if (d == ndim - 2 and len(sizes) == ndim
                             and sizes[ndim - 1] == operand.shape[ndim - 1]):
-                        # ring-buffer KV-cache append: a traced start on
+                        # ring-buffer KV-cache access: a traced start on
                         # the SUBLANE dim with the lane dim fully spanned
-                        # lowers to a sublane-masked store within tiles,
-                        # not a cross-tile gather — the canonical
-                        # generate() cache write is not a hazard; only a
-                        # traced lane-dim start is
+                        # lowers to a sublane-masked store/load within
+                        # tiles, not a cross-tile gather.  Covers both
+                        # the canonical generate() cache append
+                        # (dynamic_update_slice, PR 7) and the quantized
+                        # KV reads the fused-dequant path issues — int8
+                        # rows and per-head scale planes read by
+                        # dynamic_slice at the traced cache_position
+                        # with their (full) lane extent.  Only a traced
+                        # lane-dim start is a hazard
                         continue
                     which = "lane (last)" if d == ndim - 1 else "sublane"
                     key = (user_source(eqn), name, d)

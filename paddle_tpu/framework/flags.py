@@ -449,3 +449,43 @@ define_flag("decode_max_len", 1024,
             "for generate() and serving decode; requests past it raise "
             "OutOfRange instead of growing an unbounded cache shape.",
             validator=lambda v: int(v) >= 1)
+
+# ---- Speculative decoding + quantized KV cache (text.speculative) -----------
+define_flag("spec_decode",
+            os.environ.get("PADDLE_TPU_SPEC_DECODE", "").lower()
+            in ("1", "true", "yes", "on"),
+            "Serve decode models through draft/target speculative "
+            "decoding (text/speculative.py) when the DecodeModelSpec "
+            "carries a draft layer: a small GPT drafts FLAGS_spec_gamma "
+            "tokens per step, the target verifies all of them in ONE "
+            "batched forward, and greedy acceptance walks the longest "
+            "agreeing prefix — output tokens are bit-identical to plain "
+            "greedy decode of the target (acceptance/rollback is "
+            "lossless by construction), at up to gamma+1 tokens per "
+            "target pass.  OFF by default: the plain Generator path is "
+            "unchanged (one Python branch at decode-runtime load).  An "
+            "explicit generate(draft_model=...) call opts in regardless "
+            "of the flag.  Seeded by PADDLE_TPU_SPEC_DECODE.")
+define_flag("spec_gamma", 4,
+            "Tokens the draft model proposes per speculative step "
+            "(gamma).  Each step costs gamma+1 draft forwards plus ONE "
+            "gamma+1-wide target verify forward and commits 1..gamma+1 "
+            "tokens; higher gamma pays off when draft/target agreement "
+            "is high.  Per-call override via "
+            "SpeculativeGenerator(gamma=...).",
+            validator=lambda v: 1 <= int(v) <= 16)
+define_flag("kv_cache_dtype",
+            os.environ.get("PADDLE_TPU_KV_CACHE_DTYPE", "bf16").lower()
+            or "bf16",
+            "Storage dtype of the decode KV ring cache: 'bf16' (native "
+            "model dtype planes — today's layout) or 'int8' (int8 rows "
+            "+ per-(token, head) f32 scales as extra cache planes "
+            "written at the same traced cache_position), halving "
+            "cached-context HBM.  The dequant is fused into the "
+            "flash-decode kernel's split-K loop when "
+            "FLAGS_use_flash_decode dispatches, and falls back to a "
+            "dequantize-then-attend XLA read otherwise.  One Python "
+            "branch at cache init; flipping it recompiles the generate "
+            "executables (the cache dtype is part of the compile key). "
+            "Seeded by PADDLE_TPU_KV_CACHE_DTYPE.",
+            validator=lambda v: str(v).lower() in ("bf16", "int8"))

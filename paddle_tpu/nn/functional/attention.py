@@ -115,7 +115,8 @@ def _use_flash_decode(q, k, window):
     return supports_decode(unwrap(q).shape, unwrap(k).shape)
 
 
-def cached_attention(q, k, v, attn_mask=None, window=None):
+def cached_attention(q, k, v, attn_mask=None, window=None, k_scale=None,
+                     v_scale=None):
     """Incremental attention: (B, N, Tq, H) new-token queries over the
     full (B, N, S, H) KV ring cache.
 
@@ -125,7 +126,23 @@ def cached_attention(q, k, v, attn_mask=None, window=None):
     (decode steps: Tq == 1) — when present and eligible, the Pallas
     flash-decoding kernel (split-K over the cached context) takes over;
     otherwise the one-expression XLA masked attention runs.
+
+    With ``k_scale``/``v_scale`` given (FLAGS_kv_cache_dtype=int8), k/v
+    are int8 row planes and the scales are the per-(token, head) f32
+    planes: the eligible kernel path fuses the dequant into its split-K
+    loop (flash_decode_quant); the XLA fallback dequantizes the cache
+    then attends (decode is inference-only, so the raw read costs no
+    tape).
     """
+    if k_scale is not None:
+        if _use_flash_decode(q, k, window):
+            from ...ops.pallas import flash_decode_quant
+            return flash_decode_quant(q, k, v, k_scale, v_scale,
+                                      window[0], window[1])
+        from ..layer.transformer import dequantize_kv_rows
+        dt = unwrap(q).dtype
+        k = Tensor(dequantize_kv_rows(k, k_scale, dtype=dt))
+        v = Tensor(dequantize_kv_rows(v, v_scale, dtype=dt))
     if _use_flash_decode(q, k, window):
         from ...ops.pallas import flash_decode
         return flash_decode(q, k, v, window[0], window[1])

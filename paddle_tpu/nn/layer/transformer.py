@@ -11,13 +11,112 @@ from __future__ import annotations
 
 import collections
 
-from ...framework.tensor import Tensor
+from ...framework.tensor import Tensor, unwrap
 from ...ops import concat, reshape, transpose
 from .. import functional as F
 from ..functional.attention import attention_bnsh
 from .common import Dropout, Linear
 from .layers import Layer
 from .norm import LayerNorm
+
+
+def _static_int(x):
+    """Concrete scalar value of ``x`` or None when traced."""
+    try:
+        return int(x)
+    except Exception:                      # jax tracer: value unknown
+        return None
+
+
+def ring_block_write(plane, new, pos, axis=None):
+    """Write a ``T``-wide token block into a ``C``-long ring-buffer plane
+    at the (already wrapped, possibly traced) position ``pos``.
+
+    A plain ``lax.dynamic_update_slice`` CLAMPS its start to ``C - T``,
+    so a multi-token block landing near the ring boundary would silently
+    shift instead of wrapping — correct for the single-token decode
+    write (width 1 never crosses), wrong for the γ-wide speculative
+    verify write.  The wrap-aware form splits the write into TWO
+    dynamic_update_slice legs of static width ``T`` each:
+
+      * leg 1 at ``min(pos, C - T)``: the tail run ``[pos, C)``, with
+        the columns below ``pos`` (only touched when wrapping forces the
+        clamped start) re-written with their own current contents;
+      * leg 2 at static 0: the wrapped head run ``[0, pos + T - C)``,
+        a no-op rewrite of current contents when nothing wrapped.
+
+    Both legs keep the traced start on the SUBLANE (sequence) dim with
+    the lane dim fully spanned — the in-tile masked store/load pattern
+    the graph-lint layout pass exempts.  Shapes: ``plane [..., C, L]``,
+    ``new [..., T, L]``; ``axis`` defaults to ``ndim - 2``.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    p, n = unwrap(plane), unwrap(new)
+    wrap = isinstance(plane, Tensor) or isinstance(new, Tensor)
+    ax = p.ndim - 2 if axis is None else int(axis)
+    C, T = p.shape[ax], n.shape[ax]
+    if T > C:
+        raise ValueError(
+            f"ring block of {T} tokens cannot fit a cache of length {C}")
+    pos = unwrap(pos)
+    sp = _static_int(pos)
+    if T == 1 or (sp is not None and sp + T <= C):
+        # width-1 writes never cross the boundary (pos is pre-wrapped),
+        # and a statically in-range block (the prefill fill at pos 0)
+        # needs no second leg — the existing single-store lowering
+        out = lax.dynamic_update_slice_in_dim(p, n.astype(p.dtype), pos, ax)
+        return Tensor(out) if wrap else out
+    pos = jnp.asarray(pos, jnp.int32)
+    n = n.astype(p.dtype)
+    idx_shape = [1] * p.ndim
+    idx_shape[ax] = T
+    idx = jnp.arange(T, dtype=jnp.int32).reshape(idx_shape)
+    pad = jnp.zeros_like(n)
+    # leg 1: tail run [pos, C) — blend the clamped window's leading
+    # columns back to their current values so clamping never corrupts
+    s1 = jnp.minimum(pos, jnp.int32(C - T))
+    off = pos - s1                                  # 0 unless wrapping
+    cur1 = lax.dynamic_slice_in_dim(p, s1, T, ax)
+    v1 = lax.dynamic_slice_in_dim(jnp.concatenate([pad, n], axis=ax),
+                                  jnp.int32(T) - off, T, ax)
+    out = lax.dynamic_update_slice_in_dim(
+        p, jnp.where(idx < off, cur1, v1), s1, ax)
+    # leg 2: wrapped head run [0, pos + T - C) at a STATIC start
+    w = pos + jnp.int32(T - C)                      # <= 0: nothing wrapped
+    cur2 = lax.slice_in_dim(out, 0, T, axis=ax)
+    v2 = lax.dynamic_slice_in_dim(jnp.concatenate([n, pad], axis=ax),
+                                  jnp.minimum(jnp.int32(C) - pos,
+                                              jnp.int32(T)), T, ax)
+    out = lax.dynamic_update_slice_in_dim(
+        out, jnp.where(idx < w, v2, cur2), 0, ax)
+    return Tensor(out) if wrap else out
+
+
+def quantize_kv_rows(x):
+    """Per-(token, head) symmetric int8 quantization of a K/V block
+    ``[B, N, T, H]``: one f32 scale per head-row (the dequant is a
+    rank-1 broadcast the flash-decode split-K loop fuses).  Returns
+    (int8 rows ``[B, N, T, H]``, f32 scales ``[B, N, T, 1]``)."""
+    import jax.numpy as jnp
+    xv = unwrap(x)
+    scale = jnp.max(jnp.abs(xv).astype(jnp.float32), axis=-1,
+                    keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(xv.astype(jnp.float32) / scale), -127, 127) \
+        .astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv_rows(q, scale, dtype=None):
+    """Inverse of :func:`quantize_kv_rows` (the XLA fallback's
+    dequantize-then-attend read; the Pallas kernel fuses the same
+    product into its split-K loop)."""
+    import jax.numpy as jnp
+    out = unwrap(q).astype(jnp.float32) * unwrap(scale)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
 
 
 class MultiHeadAttention(Layer):
@@ -27,8 +126,15 @@ class MultiHeadAttention(Layer):
     # in place with lax.dynamic_update_slice at an explicit (possibly
     # traced) cache_position — unlike Cache's concat, the shape never
     # grows, so one decode executable serves every step (zero per-token
-    # recompiles; the single-token write wraps modulo max_len)
+    # recompiles; single-token writes wrap modulo max_len and wider
+    # blocks split into two legs at the boundary via ring_block_write)
     RingCache = collections.namedtuple("RingCache", ["k", "v"])
+    # int8-quantized ring cache (FLAGS_kv_cache_dtype=int8): k/v hold
+    # int8 rows, k_scale/v_scale the per-(token, head) f32 scales as
+    # extra (B, N, max_len, 1) cache planes written at the SAME traced
+    # position — cached-context HBM halves (plus the scale overhead)
+    QuantRingCache = collections.namedtuple(
+        "QuantRingCache", ["k", "v", "k_scale", "v_scale"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
                  vdim=None, need_weights=False, weight_attr=None,
@@ -71,8 +177,19 @@ class MultiHeadAttention(Layer):
     def gen_ring_cache(self, batch, max_len, dtype="float32"):
         """Zero-initialized static-shape KV ring cache (B, N, max_len, H).
         ``max_len`` is a compile-time constant; validity is tracked by the
-        caller's cache_position/window, not by the shape."""
+        caller's cache_position/window, not by the shape.  Under
+        ``FLAGS_kv_cache_dtype=int8`` the planes are int8 rows plus
+        per-(token, head) f32 scale planes (QuantRingCache) — one Python
+        branch here, zero graph change on the default path."""
+        from ...framework import flags as _flags
         from ...ops import zeros
+        if str(_flags.flag("kv_cache_dtype")).lower() == "int8":
+            rows = [batch, self.num_heads, max_len, self.head_dim]
+            scales = [batch, self.num_heads, max_len, 1]
+            return self.QuantRingCache(
+                zeros(rows, dtype="int8"), zeros(rows, dtype="int8"),
+                zeros(scales, dtype="float32"),
+                zeros(scales, dtype="float32"))
         k = zeros([batch, self.num_heads, max_len, self.head_dim],
                   dtype=dtype)
         v = zeros([batch, self.num_heads, max_len, self.head_dim],
@@ -82,20 +199,36 @@ class MultiHeadAttention(Layer):
     def _forward_ring(self, query, attn_mask, cache, cache_position,
                       decode_window):
         """Incremental attention over the ring cache: project the new
-        tokens, write their K/V at cache_position (dynamic_update_slice on
-        the sequence dim — sublane-masked store, full lanes), and attend
-        the new queries over the WHOLE cache under the caller's validity
-        mask.  Returns (out, updated RingCache)."""
-        from ...ops.manipulation import dynamic_update_slice
+        tokens, write their K/V at cache_position (ring_block_write on
+        the sequence dim — sublane-masked store, full lanes, two legs at
+        the ring boundary for multi-token blocks), and attend the new
+        queries over the WHOLE cache under the caller's validity mask.
+        Quantized caches additionally write int8 rows + scale planes at
+        the same position and dequantize at the attention read (fused
+        into the flash-decode kernel when it dispatches).  Returns
+        (out, updated RingCache/QuantRingCache)."""
         from ..functional.attention import cached_attention
         q = self._split_heads(self.q_proj(query))
         k_new = self._split_heads(self.k_proj(query))
         v_new = self._split_heads(self.v_proj(query))
-        k = dynamic_update_slice(cache.k, k_new, cache_position, axis=2)
-        v = dynamic_update_slice(cache.v, v_new, cache_position, axis=2)
-        cache = self.RingCache(k, v)
-        out = cached_attention(q, k, v, attn_mask=attn_mask,
-                               window=decode_window)
+        if isinstance(cache, self.QuantRingCache):
+            kq, ks = quantize_kv_rows(k_new)
+            vq, vs = quantize_kv_rows(v_new)
+            cache = self.QuantRingCache(
+                ring_block_write(cache.k, Tensor(kq), cache_position),
+                ring_block_write(cache.v, Tensor(vq), cache_position),
+                ring_block_write(cache.k_scale, Tensor(ks), cache_position),
+                ring_block_write(cache.v_scale, Tensor(vs), cache_position))
+            out = cached_attention(q, cache.k, cache.v, attn_mask=attn_mask,
+                                   window=decode_window,
+                                   k_scale=cache.k_scale,
+                                   v_scale=cache.v_scale)
+        else:
+            k = ring_block_write(cache.k, k_new, cache_position)
+            v = ring_block_write(cache.v, v_new, cache_position)
+            cache = self.RingCache(k, v)
+            out = cached_attention(q, k, v, attn_mask=attn_mask,
+                                   window=decode_window)
         if self.dropout:
             out = F.dropout(out, self.dropout, training=self.training)
         return self.out_proj(self._merge_heads(out)), cache
@@ -119,7 +252,7 @@ class MultiHeadAttention(Layer):
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None,
                 cache_position=None, decode_window=None):
         import os
-        if isinstance(cache, self.RingCache):
+        if isinstance(cache, (self.RingCache, self.QuantRingCache)):
             return self._forward_ring(query, attn_mask, cache,
                                       cache_position, decode_window)
         # measured on v5e (BERT-base b64 s128): fused 1040 seq/s vs three

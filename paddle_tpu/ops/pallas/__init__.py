@@ -31,10 +31,14 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None):
 
 
 from .flash_decode import (  # noqa: E402
-    decode_attention_reference, flash_decode_fn, supports_decode)
+    decode_attention_reference, dequantize_kv, flash_decode_fn,
+    flash_decode_quant_fn, supports_decode)
 
 _flash_decode_prim = Primitive("flash_decode", flash_decode_fn,
                                differentiable=False)
+_flash_decode_quant_prim = Primitive("flash_decode_quant",
+                                     flash_decode_quant_fn,
+                                     differentiable=False)
 
 
 def flash_decode(q, k, v, start, end, scale=None):
@@ -43,9 +47,18 @@ def flash_decode(q, k, v, start, end, scale=None):
     return _flash_decode_prim(q, k, v, start, end, scale=scale)
 
 
+def flash_decode_quant(q, k, v, k_scale, v_scale, start, end, scale=None):
+    """Flash-decoding over an int8-quantized ring cache on Tensors: the
+    per-(token, head) dequant is fused into the kernel's split-K loop
+    (inference-only)."""
+    return _flash_decode_quant_prim(q, k, v, k_scale, v_scale, start, end,
+                                    scale=scale)
+
+
 from . import fused_bn, fused_conv  # noqa: F401  (kernel families)
 
 __all__ = ["flash_attention", "flash_attention_fn", "supports",
            "flash_decode", "flash_decode_fn", "supports_decode",
+           "flash_decode_quant", "flash_decode_quant_fn", "dequantize_kv",
            "decode_attention_reference",
            "DEFAULT_BLOCK", "fused_bn", "fused_conv"]

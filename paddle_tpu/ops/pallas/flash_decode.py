@@ -156,6 +156,115 @@ def flash_decode_fn(q, k, v, start=None, end=None, *, scale=None,
     return out[:, :1, :].reshape(B, N, 1, H)
 
 
+def _decode_kernel_quant(q_ref, k_ref, v_ref, ks_ref, vs_ref, s_ref, e_ref,
+                         o_ref, m_ref, l_ref, *, scale, bk):
+    """Quantized-KV variant of one (sequence*head, split) cell: the
+    split's ``bk`` int8 cached rows dequantize INSIDE the split-K loop —
+    ``int8 row * per-(token, head) f32 scale`` is a rank-1 broadcast
+    against the (bk, H) block, so the f32 K/V tile exists only in VMEM
+    for the lifetime of this cell and HBM traffic stays int8."""
+    isplit = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                    # [8, H]
+    k = k_ref[0].astype(jnp.float32) * ks_ref[0]        # fused dequant
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    col = lax.broadcasted_iota(jnp.int32, (_SUBLANES, bk), 1) + isplit * bk
+    valid = (col >= s_ref[0, 0]) & (col < e_ref[0, 0])
+    s = jnp.where(valid, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)              # [8, 1]
+    p = jnp.exp(s - m) * valid.astype(jnp.float32)
+    l = jnp.sum(p, axis=-1, keepdims=True)              # [8, 1]
+    v = v_ref[0].astype(jnp.float32) * vs_ref[0]        # fused dequant
+    acc = lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    o_ref[0, 0] = acc
+    m_ref[0, 0] = jnp.broadcast_to(m, (_SUBLANES, 128))
+    l_ref[0, 0] = jnp.broadcast_to(l, (_SUBLANES, 128))
+
+
+def flash_decode_quant_fn(q, k, v, k_scale, v_scale, start=None, end=None,
+                          *, scale=None,
+                          block_k: int = DEFAULT_BLOCK_K_DECODE):
+    """Pure-jax flash decoding over an int8-quantized KV ring cache.
+
+    q ``(B, N, 1, H)`` float; k/v ``(B, N, S, H)`` int8 rows with
+    ``k_scale``/``v_scale`` ``(B, N, S, 1)`` f32 per-(token, head)
+    scales; ``start``/``end`` int32 ``[B]`` bound the valid window per
+    row.  Must bit-match ``decode_attention_reference`` over the
+    dequantized cache (``dequantize_kv`` below) — the dequant moves
+    inside the kernel, the math does not change.  Returns
+    ``(B, N, 1, H)`` in q's dtype.
+    """
+    B, N, Sq, H = q.shape
+    S = k.shape[2]
+    if Sq != 1:
+        raise ValueError(f"flash_decode takes a single query row, got Sq={Sq}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(H)
+    bk = _pick_block(S, block_k)
+    nsplit = S // bk
+    BN = B * N
+    q3 = jnp.broadcast_to(q.reshape(BN, 1, H), (BN, _SUBLANES, H))
+    k3 = k.reshape(BN, S, H)
+    v3 = v.reshape(BN, S, H)
+    ks3 = k_scale.reshape(BN, S, 1)
+    vs3 = v_scale.reshape(BN, S, 1)
+    start2 = (jnp.zeros((B, 1), jnp.int32) if start is None
+              else jnp.asarray(start, jnp.int32).reshape(B, 1))
+    end2 = (jnp.full((B, 1), S, jnp.int32) if end is None
+            else jnp.asarray(end, jnp.int32).reshape(B, 1))
+
+    o_part, m_part, l_part = pl.pallas_call(
+        functools.partial(_decode_kernel_quant, scale=float(scale), bk=bk),
+        grid=(BN, nsplit),
+        in_specs=[
+            pl.BlockSpec((1, _SUBLANES, H), lambda b, s: (b, 0, 0)),
+            pl.BlockSpec((1, bk, H), lambda b, s: (b, s, 0)),
+            pl.BlockSpec((1, bk, H), lambda b, s: (b, s, 0)),
+            pl.BlockSpec((1, bk, 1), lambda b, s: (b, s, 0)),
+            pl.BlockSpec((1, bk, 1), lambda b, s: (b, s, 0)),
+            pl.BlockSpec((1, 1), lambda b, s, n=N: (b // n, 0)),
+            pl.BlockSpec((1, 1), lambda b, s, n=N: (b // n, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, _SUBLANES, H), lambda b, s: (b, s, 0, 0)),
+            pl.BlockSpec((1, 1, _SUBLANES, 128), lambda b, s: (b, s, 0, 0)),
+            pl.BlockSpec((1, 1, _SUBLANES, 128), lambda b, s: (b, s, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BN, nsplit, _SUBLANES, H), jnp.float32),
+            jax.ShapeDtypeStruct((BN, nsplit, _SUBLANES, 128), jnp.float32),
+            jax.ShapeDtypeStruct((BN, nsplit, _SUBLANES, 128), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * BN * S * H,
+            # the point of the fused dequant: K/V stream at 1 byte/elt
+            bytes_accessed=(k3.size + v3.size
+                            + (ks3.size + vs3.size + q3.size) * 4),
+            transcendentals=BN * S),
+        interpret=_interpret(),
+    )(q3, k3, v3, ks3, vs3, start2, end2)
+
+    m = m_part[:, :, :, 0]                       # (BN, nsplit, 8)
+    l = l_part[:, :, :, 0]
+    g = jnp.max(m, axis=1)                       # (BN, 8)
+    alpha = jnp.exp(m - g[:, None, :])
+    l_tot = jnp.sum(l * alpha, axis=1)           # (BN, 8)
+    o = jnp.sum(o_part * alpha[..., None], axis=1)
+    l_safe = jnp.where(l_tot == 0.0, 1.0, l_tot)
+    out = (o / l_safe[..., None]).astype(q.dtype)
+    return out[:, :1, :].reshape(B, N, 1, H)
+
+
+def dequantize_kv(q8, scales, dtype=jnp.float32):
+    """Dequantize int8 KV rows with their per-(token, head) scales — the
+    XLA fallback read, and the reference the fused kernel must match."""
+    return (jnp.asarray(q8).astype(jnp.float32)
+            * jnp.asarray(scales)).astype(dtype)
+
+
 def decode_attention_reference(q, k, v, start=None, end=None, *, scale=None):
     """The XLA reference the kernel must match: one masked softmax
     attention over the full cache, f32 logits/accumulation (the same

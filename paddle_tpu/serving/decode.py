@@ -50,7 +50,17 @@ class DecodeModelSpec:
     init_cache/forward_cached contract (text.models.GPTModel), not a
     frozen export — the decode program (a scanned step over a mutable
     ring cache) is compiled per bucket at warm-up, which is exactly the
-    durable artifact the dense path gets from export_for_serving."""
+    durable artifact the dense path gets from export_for_serving.
+
+    ``draft_layer`` turns the spec into a draft/target PAIR: under
+    ``FLAGS_spec_decode`` the runtime serves through speculative
+    decoding (text/speculative.py — the draft proposes ``gamma`` tokens
+    per step, the target verifies them in one forward; served tokens
+    stay bit-identical to plain greedy decode), and the warm-up grid
+    AOT-compiles the speculative step per (batch-bucket × cache-bucket)
+    so ``assert_zero_steady_state_recompiles`` holds under mixed
+    traffic exactly as before.  With the flag off (the default) the
+    draft is ignored — one Python branch at load."""
 
     name: str
     layer: Any
@@ -59,6 +69,8 @@ class DecodeModelSpec:
     max_new_tokens: int = 16
     max_len: Optional[int] = None
     eos_token_id: Optional[int] = None
+    draft_layer: Any = None
+    gamma: Optional[int] = None
 
 
 @dataclass
@@ -115,9 +127,17 @@ class _DecodeRuntime:
     # -- loading + warm-up ---------------------------------------------------
     def load(self):
         from ..text.generation import Generator
-        self.gen = Generator(self.spec.layer, site=self.site,
-                             seq_buckets=self.spec.seq_buckets,
-                             max_len=self.spec.max_len)
+        if self.spec.draft_layer is not None \
+                and bool(_flags.flag("spec_decode")):
+            from ..text.speculative import SpeculativeGenerator
+            self.gen = SpeculativeGenerator(
+                self.spec.layer, self.spec.draft_layer, site=self.site,
+                seq_buckets=self.spec.seq_buckets,
+                max_len=self.spec.max_len, gamma=self.spec.gamma)
+        else:
+            self.gen = Generator(self.spec.layer, site=self.site,
+                                 seq_buckets=self.spec.seq_buckets,
+                                 max_len=self.spec.max_len)
         # every prompt bucket must leave room for max_new_tokens in some
         # cache bucket — refuse at registration time, not under traffic
         self._plan = []
@@ -145,15 +165,10 @@ class _DecodeRuntime:
         import jax
         import jax.numpy as jnp
         fn = self.gen._build_prefill(B, P, C)
-        p_avals = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype),
-            self.gen._params)
-        b_avals = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype),
-            self.gen._buffers)
         try:
             closed = jax.make_jaxpr(fn)(
-                p_avals, b_avals, jax.ShapeDtypeStruct((B, P), jnp.int32),
+                *self.gen._state_avals(),
+                jax.ShapeDtypeStruct((B, P), jnp.int32),
                 jax.ShapeDtypeStruct((B,), jnp.int32))
         except Exception as e:   # noqa: BLE001 — lint must not mask bugs
             import warnings
@@ -273,6 +288,7 @@ class _DecodeRuntime:
             out = np.asarray(toks)         # fences the scanned token loop
             t_d1 = time.monotonic()
             dt = (t_d1 - t_p1) / self.steps
+            spec = getattr(self.gen, "last_stats", None)
             for r in traced:
                 _tracing.child(r.trace, "prefill", t_p0, t_p1,
                                prompt_bucket=P, cache_bucket=C, batch=B)
@@ -281,6 +297,24 @@ class _DecodeRuntime:
                                         cache_bucket=C, batch=B,
                                         per_token_ms=round(dt * 1e3, 4))
                 if d is not None:
+                    if spec:
+                        # speculative runtime: estimated draft/verify
+                        # children (the scan is one device program; the
+                        # parameter-byte ratio splits the window) plus
+                        # the measured acceptance stats
+                        tm = t_p1 + (t_d1 - t_p1) * spec["draft_fraction"]
+                        _tracing.child(d, "draft", t_p1, tm,
+                                       estimated=True,
+                                       gamma=spec["gamma"],
+                                       proposed=spec["proposed"],
+                                       spec_steps=spec["spec_steps"])
+                        _tracing.child(d, "verify", tm, t_d1,
+                                       estimated=True,
+                                       accepted=spec["accepted"],
+                                       acceptance_rate=spec[
+                                           "acceptance_rate"])
+                        d.set_attr(gamma=spec["gamma"], acceptance_rate=
+                                   spec["acceptance_rate"])
                     # per-token events, attributed at the scan boundary:
                     # the whole token loop is ONE jitted lax.scan (one
                     # device program), so the host never observes token k

@@ -4,11 +4,12 @@ Reference parity: python/paddle/text/ (RNN-era model zoo + datasets). The TPU
 build additionally ships the transformer-LM family (bert.py) because BERT-base
 pretraining is a headline benchmark workload (BASELINE.json config 3).
 """
-from . import models, datasets, generation  # noqa: F401
+from . import models, datasets, generation, speculative  # noqa: F401
 from .models import (  # noqa: F401
     BertModel, BertConfig, BertForPretraining, GPTModel, GPTConfig,
 )
 from .generation import Generator, generate  # noqa: F401
+from .speculative import SpeculativeGenerator  # noqa: F401
 from .datasets import (  # noqa: F401
     Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
 )

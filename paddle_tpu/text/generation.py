@@ -61,6 +61,29 @@ def _aval(a):
     return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
 
 
+def _rebuild_ring(cache):
+    """Raw plane tuples -> per-layer RingCache/QuantRingCache namedtuples
+    (arity decides: 2 planes = bf16 rows, 4 = int8 rows + scale planes)."""
+    from ..nn.layer.transformer import MultiHeadAttention as _MHA
+    out = []
+    for c in cache:
+        cls = _MHA.RingCache if len(c) == 2 else _MHA.QuantRingCache
+        out.append(cls(*(Tensor(p) for p in c)))
+    return out
+
+
+def _apply_layer(layer, params, buffers, ids, cache, pos, start):
+    """Raw-array incremental forward of ONE model: bind the state
+    snapshot into the live layer and run its forward_cached under
+    no-grad (the @to_static pure-fn pattern, jit/__init__.py).  Shared
+    by the Generator (target) and the speculative draft."""
+    ring = _rebuild_ring(cache)
+    with core.no_grad_guard(), _bound_state(layer, params, buffers):
+        logits, new_cache = layer.forward_cached(
+            Tensor(ids), ring, pos, Tensor(start))
+    return unwrap(logits), [tuple(unwrap(p) for p in c) for c in new_cache]
+
+
 class Generator:
     """Compiled incremental decoding for one model.
 
@@ -132,22 +155,12 @@ class Generator:
 
     # -- the two pure programs ----------------------------------------------
     def _apply_cached(self, params, buffers, ids, cache, pos, start):
-        """Raw-array incremental forward: bind the state snapshot into
-        the live layer and run its forward_cached under no-grad (the
-        @to_static pure-fn pattern, jit/__init__.py)."""
-        from ..nn.layer.transformer import MultiHeadAttention
-        layer = self._layer
-        ring = [MultiHeadAttention.RingCache(Tensor(k), Tensor(v))
-                for k, v in cache]
-        with core.no_grad_guard(), _bound_state(layer, params, buffers):
-            logits, new_cache = layer.forward_cached(
-                Tensor(ids), ring, pos, Tensor(start))
-        return unwrap(logits), [(unwrap(c.k), unwrap(c.v))
-                                for c in new_cache]
+        return _apply_layer(self._layer, params, buffers, ids, cache, pos,
+                            start)
 
     def _init_cache_raw(self, B, C):
         ring = self._layer.init_cache(B, C)
-        return [(unwrap(c.k), unwrap(c.v)) for c in ring]
+        return [tuple(unwrap(p) for p in c) for c in ring]
 
     def _build_prefill(self, B, P, C):
         def prefill(params, buffers, ids, start):
@@ -181,8 +194,8 @@ class Generator:
 
         def beam_decode(params, buffers, cache, logits0, start, pos0):
             K = beam
-            cache = [(jnp.repeat(k, K, axis=0), jnp.repeat(v, K, axis=0))
-                     for k, v in cache]
+            cache = [tuple(jnp.repeat(p, K, axis=0) for p in c)
+                     for c in cache]
             start_k = jnp.repeat(start, K, axis=0)
             logp0 = jax.nn.log_softmax(logits0.astype(jnp.float32), axis=-1)
             V = logp0.shape[-1]
@@ -201,9 +214,8 @@ class Generator:
                     is_accumulated=True)
                 # reorder beam-parallel cache rows by the selected
                 # parents — the incubate BeamSearchDecoder gather
-                cache = [(beam_parent_gather(k, parents_t),
-                          beam_parent_gather(v, parents_t))
-                         for k, v in cache]
+                cache = [tuple(beam_parent_gather(p, parents_t) for p in c)
+                         for c in cache]
                 tok = ids_t.reshape(B * K)[:, None]
                 nlogits, ncache = apply(params, buffers, tok, cache, pos,
                                         start_k)
@@ -223,12 +235,27 @@ class Generator:
 
     # -- AOT compile + ledger ------------------------------------------------
     def _key(self, phase, B, P, C, steps, beam, end=None):
-        return tuple([("arg:phase", phase), ("arg:batch", B)]
+        # the cache storage dtype is part of the program: flipping
+        # FLAGS_kv_cache_dtype recompiles (ledgered, loud under
+        # serving_strict) instead of silently serving stale planes
+        kv = str(_flags.flag("kv_cache_dtype")).lower()
+        return tuple([("arg:phase", phase), ("arg:batch", B),
+                      ("arg:kv", kv)]
                      + ([("arg:prompt", P)] if P is not None else [])
                      + [("arg:cache", C)]
                      + ([("arg:steps", steps), ("arg:beam", beam),
                          ("arg:eos", end)]
                         if steps is not None else []))
+
+    def _state_avals(self):
+        """Avals of the leading state arguments every generate program
+        takes (params, buffers) — the speculative subclass appends the
+        draft model's pair."""
+        return (jax.tree_util.tree_map(_aval, self._params),
+                jax.tree_util.tree_map(_aval, self._buffers))
+
+    def _state_args(self):
+        return (self._params, self._buffers)
 
     def _compile(self, key, kind, fn, arg_avals, extra):
         ex = self._execs.get(key)
@@ -236,9 +263,8 @@ class Generator:
             _ledger.record_cache_hit(self._site)
             return ex
         t0 = time.perf_counter()
-        p_avals = jax.tree_util.tree_map(_aval, self._params)
-        b_avals = jax.tree_util.tree_map(_aval, self._buffers)
-        ex = jax.jit(fn).lower(p_avals, b_avals, *arg_avals).compile()
+        ex = jax.jit(fn).lower(*self._state_avals(),
+                               *arg_avals).compile()
         _ledger.record_compile(self._site, kind, key,
                                (time.perf_counter() - t0) * 1e3,
                                extra=extra)
@@ -267,9 +293,9 @@ class Generator:
         # the decode program's cache avals are exactly the prefill
         # program's cache outputs — derive them abstractly
         cache_avals = jax.eval_shape(lambda: self._init_cache_raw(B, C))
-        cache_avals = [(jax.ShapeDtypeStruct(k.shape, k.dtype),
-                        jax.ShapeDtypeStruct(v.shape, v.dtype))
-                       for k, v in cache_avals]
+        cache_avals = [tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
+                             for p in c)
+                       for c in cache_avals]
         vocab = self._vocab_size()
         avals = (cache_avals,
                  jax.ShapeDtypeStruct((B, vocab), jnp.float32),
@@ -296,7 +322,7 @@ class Generator:
         ids = jnp.asarray(ids, jnp.int32)
         B, P = ids.shape
         ex = self.prefill_exec(B, P, int(cache_len))
-        return ex(self._params, self._buffers, ids,
+        return ex(*self._state_args(), ids,
                   jnp.asarray(start, jnp.int32))
 
     def decode(self, cache, logits0, start, pos0, steps, beam_size=1,
@@ -308,7 +334,7 @@ class Generator:
         C = cache[0][0].shape[2]
         ex = self.decode_exec(B, int(C), int(steps), int(beam_size),
                               eos_token_id)
-        return ex(self._params, self._buffers, cache,
+        return ex(*self._state_args(), cache,
                   jnp.asarray(logits0, jnp.float32),
                   jnp.asarray(start, jnp.int32), jnp.int32(pos0))
 
@@ -394,8 +420,7 @@ class Generator:
                                     steps=steps, cache_bucket=C,
                                     per_token_ms=round(dt * 1e3, 4))
             if d is not None:
-                for k in range(steps):
-                    d.event("token", t=t1 + (k + 1) * dt, index=k)
+                self._annotate_decode_span(d, t1, t2, steps)
                 _tracing.finish(d, end=t2)
             _tracing.finish(tr, end=t2)
         if beam_size == 1:
@@ -403,12 +428,35 @@ class Generator:
         paths, scores = out
         return Tensor(paths), Tensor(scores)
 
+    def _annotate_decode_span(self, d, t1, t2, steps):
+        """Fill the traced decode span: one event per generated token,
+        spread uniformly across the fenced scan window (the token loop
+        is ONE device program; the host never observes token k alone).
+        The speculative subclass adds draft/verify children here."""
+        dt = (t2 - t1) / steps
+        for k in range(steps):
+            d.event("token", t=t1 + (k + 1) * dt, index=k)
+
     __call__ = generate
 
 
-def generate(layer, input_ids, **kwargs):
+def generate(layer, input_ids, draft_model=None, **kwargs):
     """Module-level convenience: (build and memoize a Generator on the
-    layer, then) decode.  See :class:`Generator`."""
+    layer, then) decode.  With ``draft_model`` (a second, smaller layer
+    implementing the same init_cache/forward_cached contract) the call
+    runs draft/target speculative decoding instead — bit-identical
+    greedy output at up to gamma+1 tokens per target forward.  See
+    :class:`Generator` / text.speculative.SpeculativeGenerator."""
+    if draft_model is not None:
+        from .speculative import SpeculativeGenerator
+        gen = getattr(layer, "_paddle_tpu_spec_generator", None)
+        if gen is None or gen._layer is not layer \
+                or gen._draft is not draft_model:
+            gen = SpeculativeGenerator(layer, draft_model)
+            layer._paddle_tpu_spec_generator = gen
+        else:
+            gen.refresh_state()      # pick up trained/loaded weights
+        return gen.generate(input_ids, **kwargs)
     gen = getattr(layer, "_paddle_tpu_generator", None)
     if gen is None or gen._layer is not layer:
         gen = Generator(layer)

@@ -112,12 +112,16 @@ class GPTModel(nn.Layer):
         return logits, new_cache
 
     def generate(self, input_ids, lengths=None, max_new_tokens=32,
-                 beam_size=1, eos_token_id=None, **kw):
+                 beam_size=1, eos_token_id=None, draft_model=None, **kw):
         """Autoregressive decoding compiled as exactly two executables
-        (text.generation: one prefill jit + one scanned decode step)."""
+        (text.generation: one prefill jit + one scanned decode step).
+        With ``draft_model`` (a smaller GPT over the same vocab) the two
+        executables become the joint prefill + the speculative
+        propose/verify scan (text.speculative) — same greedy output, up
+        to gamma+1 tokens per target forward."""
         from ..generation import generate as _generate
-        return _generate(self, input_ids, lengths=lengths,
-                         max_new_tokens=max_new_tokens,
+        return _generate(self, input_ids, draft_model=draft_model,
+                         lengths=lengths, max_new_tokens=max_new_tokens,
                          beam_size=beam_size, eos_token_id=eos_token_id,
                          **kw)
 

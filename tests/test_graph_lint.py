@@ -214,6 +214,35 @@ def test_layout_lane_dim_dynamic_update_seeded():
     assert f":{_marker_line('dupdate')}" in found[0].location
 
 
+def test_layout_quantized_kv_scale_read_clean():
+    """The fused-dequant read pattern (PR 12): dynamic_slice at a TRACED
+    cache position on the sublane (sequence) dim with the lane dim fully
+    read — the canonical quantized-KV access (int8 rows and their
+    per-head scale planes) is a sublane-masked in-tile load, exempt the
+    same way PR 7 exempted the KV write."""
+    def f(scales, pos):                    # per-head scale plane read
+        return jax.lax.dynamic_slice(scales, (0, 0, pos, 0), (2, 4, 8, 1))
+    r = _lint(f, jnp.ones((2, 4, 64, 1)), jnp.int32(3))
+    assert not _only(r, "layout")
+
+    def g(k_rows, pos):                    # int8 row-plane read
+        return jax.lax.dynamic_slice_in_dim(k_rows, pos, 8, axis=2)
+    r2 = _lint(g, jnp.ones((2, 4, 64, 128), jnp.int8), jnp.int32(5))
+    assert not _only(r2, "layout")
+
+
+def test_layout_sublane_dynamic_slice_partial_lane_seeded():
+    # the exemption requires the lane dim FULLY read: a partial-lane
+    # slice at a traced sublane start is still a cross-tile gather
+    def f(x, i):
+        return jax.lax.dynamic_slice(x, (0, 0, i, 0), (2, 4, 8, 64))  # LINT:dslice_sub
+    r = _lint(f, jnp.ones((2, 4, 64, 128)), jnp.int32(3))
+    found = _only(r, "layout")
+    assert len(found) == 1
+    assert "sublane" in found[0].message
+    assert f":{_marker_line('dslice_sub')}" in found[0].location
+
+
 def test_layout_kv_cache_ring_write_clean():
     # the canonical generate() ring-cache append: dynamic_update_slice at
     # a TRACED cache_position on the sublane (sequence) dim with the lane

@@ -1,0 +1,354 @@
+"""Draft/target speculative decoding tests.
+
+The losslessness contract (speculative output is bit-identical to plain
+greedy generate() of the target, whatever the draft proposes), the
+two-executable compile proof through the recompile ledger, acceptance
+accounting (a self-draft accepts everything; a random draft accepts
+little), ring-boundary block writes, serving integration under
+FLAGS_spec_decode with zero steady-state recompiles, telemetry, and the
+new flags' registration hygiene."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.enforce import InvalidArgumentError
+from paddle_tpu.framework.flags import (define_flag, flag, flags_restore,
+                                        flags_snapshot, set_flags)
+from paddle_tpu.nn.layer.transformer import ring_block_write
+from paddle_tpu.profiler import ledger
+from paddle_tpu.text.generation import Generator, generate
+from paddle_tpu.text.models.gpt import GPTConfig, GPTModel
+from paddle_tpu.text.speculative import SpeculativeGenerator
+
+V = 64
+
+
+def _target(seed=7):
+    paddle.seed(seed)
+    m = GPTModel(GPTConfig.tiny(vocab_size=V, hidden_size=32, layers=2,
+                                heads=2, seq=64))
+    m.eval()
+    return m
+
+
+def _draft(seed=101):
+    """Deliberately-bad draft: same vocab, unrelated tiny weights — the
+    acceptance rate should be near zero and the OUTPUT unchanged."""
+    paddle.seed(seed)
+    m = GPTModel(GPTConfig.tiny(vocab_size=V, hidden_size=16, layers=1,
+                                heads=2, seq=64))
+    m.eval()
+    return m
+
+
+def _prompts(rng, b, l):
+    return rng.randint(2, V, (b, l)).astype(np.int64)
+
+
+# -- losslessness -------------------------------------------------------------
+
+@pytest.mark.parametrize("gamma", [1, 3])
+def test_bad_draft_output_bit_matches_plain_greedy(gamma):
+    m, d = _target(), _draft()
+    rng = np.random.RandomState(0)
+    ids = _prompts(rng, 3, 5)
+    lens = np.array([5, 3, 4])
+    plain = Generator(m, seq_buckets=(8, 16, 32), max_len=64)
+    ref = np.asarray(plain.generate(ids, lengths=lens,
+                                    max_new_tokens=8).numpy())
+    spec = SpeculativeGenerator(m, d, seq_buckets=(8, 16, 32), max_len=64,
+                                gamma=gamma)
+    out = np.asarray(spec.generate(ids, lengths=lens,
+                                   max_new_tokens=8).numpy())
+    np.testing.assert_array_equal(out, ref)
+    # a bad draft costs speed, never correctness: proposals were made,
+    # few (possibly none) were accepted
+    st = spec.last_stats
+    assert st["proposed"] == st["spec_steps"] * gamma
+    assert 0 <= st["accepted"] <= st["proposed"]
+
+
+def test_self_draft_accepts_everything():
+    """Draft == target: every proposal agrees with the verifier, so each
+    speculative step commits gamma+1 tokens and acceptance is 1.0."""
+    m = _target(seed=9)
+    rng = np.random.RandomState(1)
+    ids = _prompts(rng, 2, 5)
+    spec = SpeculativeGenerator(m, m, site="generate:self-draft",
+                                seq_buckets=(8, 16, 32), max_len=64,
+                                gamma=3)
+    out = np.asarray(spec.generate(ids, max_new_tokens=8).numpy())
+    ref = np.asarray(Generator(m, seq_buckets=(8, 16, 32), max_len=64)
+                     .generate(ids, max_new_tokens=8).numpy())
+    np.testing.assert_array_equal(out, ref)
+    st = spec.last_stats
+    assert st["acceptance_rate"] == 1.0
+    # 8 tokens at 4 per step = 2 speculative steps (vs 8 greedy steps)
+    assert st["spec_steps"] == 2
+
+
+def test_eos_freezing_matches_greedy():
+    m, d = _target(seed=5), _draft(seed=11)
+    rng = np.random.RandomState(3)
+    ids = _prompts(rng, 4, 4)
+    plain = Generator(m, seq_buckets=(4, 16, 32), max_len=64)
+    free = np.asarray(plain.generate(ids, max_new_tokens=8).numpy())
+    eos = int(free[0, 2])                  # force an early hit on row 0
+    ref = np.asarray(plain.generate(ids, max_new_tokens=8,
+                                    eos_token_id=eos).numpy())
+    spec = SpeculativeGenerator(m, d, seq_buckets=(4, 16, 32), max_len=64,
+                                gamma=2)
+    out = np.asarray(spec.generate(ids, max_new_tokens=8,
+                                   eos_token_id=eos).numpy())
+    np.testing.assert_array_equal(out, ref)
+    for b in range(4):
+        hits = np.where(out[b] == eos)[0]
+        if len(hits):
+            assert (out[b, hits[0]:] == eos).all()
+
+
+def test_generate_surface_and_memoization():
+    m, d = _target(seed=13), _draft(seed=17)
+    rng = np.random.RandomState(4)
+    ids = _prompts(rng, 2, 4)
+    snap = flags_snapshot()
+    try:
+        set_flags({"FLAGS_decode_buckets": "8,16,32",
+                   "FLAGS_decode_max_len": 64})
+        a = generate(m, ids, draft_model=d, max_new_tokens=4)
+        b = m.generate(ids, max_new_tokens=4, draft_model=d)
+        c = paddle.Model(m).generate(ids, max_new_tokens=4, draft_model=d)
+        plain = m.generate(ids, max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(a.numpy()),
+                                      np.asarray(b.numpy()))
+        np.testing.assert_array_equal(np.asarray(a.numpy()),
+                                      np.asarray(c.numpy()))
+        np.testing.assert_array_equal(np.asarray(a.numpy()),
+                                      np.asarray(plain.numpy()))
+        assert m._paddle_tpu_spec_generator is not None
+        assert m._paddle_tpu_spec_generator._draft is d
+    finally:
+        flags_restore(snap)
+
+
+# -- the two-executable compile contract -------------------------------------
+
+def test_ledger_shows_exactly_spec_prefill_plus_spec_decode():
+    m, d = _target(seed=19), _draft(seed=23)
+    spec = SpeculativeGenerator(m, d, site="generate:spec-ledger",
+                                seq_buckets=(8, 16, 32), max_len=64,
+                                gamma=2)
+    ledger.clear()
+    ids = _prompts(np.random.RandomState(5), 2, 5)
+    spec.generate(ids, max_new_tokens=4)
+    evs = ledger.compile_events("generate:spec-ledger")
+    # one joint prefill (both caches) + ONE scanned speculative step —
+    # zero per-token, per-proposal, or per-verify compiles
+    assert [e["kind"] for e in evs] == ["spec_prefill", "spec_decode"]
+    assert evs[0]["gamma"] == 2 and evs[1]["gamma"] == 2
+    for _ in range(3):
+        spec.generate(ids, max_new_tokens=4)
+    assert len(ledger.compile_events("generate:spec-ledger")) == 2
+
+
+def test_validation_and_beam_rejection():
+    m, d = _target(seed=25), _draft(seed=29)
+    spec = SpeculativeGenerator(m, d, seq_buckets=(8, 16, 32), max_len=64,
+                                gamma=2)
+    rng = np.random.RandomState(6)
+    with pytest.raises(InvalidArgumentError, match="greedy-only"):
+        spec.generate(_prompts(rng, 1, 4), max_new_tokens=4, beam_size=2)
+    with pytest.raises(InvalidArgumentError):
+        SpeculativeGenerator(m, paddle.nn.Linear(4, 4))   # no contract
+    paddle.seed(0)
+    other = GPTModel(GPTConfig.tiny(vocab_size=32, hidden_size=16,
+                                    layers=1, heads=2, seq=64))
+    with pytest.raises(InvalidArgumentError, match="vocab"):
+        SpeculativeGenerator(m, other)                    # vocab mismatch
+    with pytest.raises(InvalidArgumentError, match="gamma"):
+        SpeculativeGenerator(m, d, gamma=0)
+
+
+# -- ring-boundary block writes (satellite) ----------------------------------
+
+@pytest.mark.parametrize("width", [1, 2, 3, 4, 5])
+def test_ring_block_write_wraps_at_every_boundary_offset(width):
+    """A width-T block write at every traced position of a C-long ring
+    must land exactly where token-by-token modular writes would — the
+    two-leg split, not dynamic_update_slice's silent clamp."""
+    rng = np.random.RandomState(width)
+    C = 8
+    wrapped = 0
+    for pos in range(C):
+        plane = rng.randn(2, 3, C, 4).astype(np.float32)
+        new = rng.randn(2, 3, width, 4).astype(np.float32)
+        ref = plane.copy()
+        for i in range(width):
+            ref[:, :, (pos + i) % C, :] = new[:, :, i, :]
+        out = jax.jit(ring_block_write)(plane, new, jnp.int32(pos))
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        wrapped += pos + width > C
+    assert width == 1 or wrapped > 0      # the boundary was exercised
+
+
+def test_ring_block_write_static_position_fast_path():
+    # a statically in-range block (the prefill fill) takes the single
+    # dynamic_update_slice store
+    rng = np.random.RandomState(0)
+    plane = rng.randn(1, 2, 8, 4).astype(np.float32)
+    new = rng.randn(1, 2, 3, 4).astype(np.float32)
+    out = np.asarray(ring_block_write(plane, new, 0))
+    ref = plane.copy()
+    ref[:, :, :3, :] = new
+    np.testing.assert_array_equal(out, ref)
+    with pytest.raises(ValueError, match="cannot fit"):
+        ring_block_write(plane, rng.randn(1, 2, 9, 4).astype(np.float32), 0)
+
+
+# -- serving integration ------------------------------------------------------
+
+def test_serving_speculative_zero_steady_recompiles_and_bit_match():
+    from paddle_tpu import serving
+    m, d = _target(seed=21), _draft(seed=33)
+    snap = flags_snapshot()
+    try:
+        set_flags({"FLAGS_spec_decode": True, "FLAGS_spec_gamma": 2})
+        ledger.clear()
+        srv = serving.Server(serving.ServingConfig(workers=2))
+        srv.register_decode("gpt", m, draft_layer=d, batch_buckets=(1, 2),
+                            seq_buckets=(8, 16), max_new_tokens=4,
+                            max_len=32)
+        srv.start()
+        try:
+            evs = ledger.compile_events("serving:gpt")
+            kinds = [e["kind"] for e in evs]
+            # 2 batch buckets x 2 prefill buckets; the speculative cache
+            # buckets (8+4+gamma+1 -> 16, 16+7 -> 32) stay distinct
+            assert kinds.count("spec_prefill") == 4
+            assert kinds.count("spec_decode") == 4
+            rng = np.random.RandomState(0)
+            for _ in range(6):
+                rows = int(rng.randint(1, 3))
+                prompts = [rng.randint(1, V, rng.randint(1, 12))
+                           for _ in range(rows)]
+                out = srv.run_decode("gpt", prompts, max_new_tokens=3)[0]
+                assert out.shape == (rows, 3) and out.dtype == np.int32
+            srv.assert_zero_steady_state_recompiles()
+            assert len(ledger.compile_events("serving:gpt")) == len(evs)
+            # served speculative tokens == standalone batch-1 greedy
+            p = rng.randint(1, V, 7)
+            served = srv.run_decode("gpt", [p], max_new_tokens=4)[0][0]
+            ref = np.asarray(
+                Generator(m, seq_buckets=(8, 16), max_len=32)
+                .generate(np.asarray([p]), max_new_tokens=4).numpy())[0]
+            np.testing.assert_array_equal(served, ref)
+        finally:
+            srv.stop()
+    finally:
+        flags_restore(snap)
+
+
+def test_serving_flag_off_ignores_draft():
+    """FLAGS_spec_decode off (the default): a spec carrying a draft
+    serves through the plain Generator — one Python branch."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving.decode import _DecodeRuntime, DecodeModelSpec
+    m, d = _target(seed=35), _draft(seed=37)
+    rt = _DecodeRuntime(DecodeModelSpec(
+        name="g", layer=m, draft_layer=d, batch_buckets=(1,),
+        seq_buckets=(8,), max_new_tokens=4, max_len=16))
+    rt.load()
+    assert type(rt.gen) is Generator
+    assert serving is not None
+
+
+# -- telemetry ---------------------------------------------------------------
+
+def test_acceptance_counters_and_histogram_publish():
+    from paddle_tpu.profiler.metrics import default_registry
+    m = _target(seed=39)
+    site = "generate:spec-metrics"
+    spec = SpeculativeGenerator(m, m, site=site, seq_buckets=(8, 16, 32),
+                                max_len=64, gamma=3)
+    reg = default_registry()
+    prop = reg.get("spec_proposed_tokens_total").labels(model=site)
+    acc = reg.get("spec_accepted_tokens_total").labels(model=site)
+    hist = reg.get("spec_acceptance_ratio").labels(model=site)
+    p0, a0, h0 = prop.value, acc.value, hist.count
+    ids = _prompts(np.random.RandomState(7), 1, 4)
+    spec.generate(ids, max_new_tokens=8)
+    assert prop.value - p0 == spec.last_stats["proposed"]
+    assert acc.value - a0 == spec.last_stats["accepted"]
+    assert hist.count == h0 + 1
+
+
+def test_traced_decode_span_gains_draft_and_verify_children():
+    from paddle_tpu.profiler import tracing
+    m = _target(seed=41)
+    spec = SpeculativeGenerator(m, m, site="generate:spec-trace",
+                                seq_buckets=(8, 16, 32), max_len=64,
+                                gamma=2)
+    snap = flags_snapshot()
+    tracing.clear()
+    try:
+        set_flags({"FLAGS_trace": "full"})
+        spec.generate(_prompts(np.random.RandomState(8), 1, 4),
+                      max_new_tokens=4)
+    finally:
+        flags_restore(snap)
+    spans = tracing.finished_spans()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    assert "decode" in by_name and "draft" in by_name \
+        and "verify" in by_name
+    dec = by_name["decode"][-1]
+    dr, ve = by_name["draft"][-1], by_name["verify"][-1]
+    assert dr["parent_id"] == dec["span_id"]
+    assert ve["parent_id"] == dec["span_id"]
+    assert dr["attrs"]["estimated"] and ve["attrs"]["estimated"]
+    assert dec["attrs"]["acceptance_rate"] == 1.0
+    assert ve["attrs"]["accepted"] == dec["attrs"]["spec_steps"] * 2
+
+
+# -- flags hygiene (satellite) -----------------------------------------------
+
+def test_spec_flags_registered_with_defaults():
+    assert flag("spec_decode") is False            # gated OFF
+    assert flag("spec_gamma") == 4
+    assert flag("kv_cache_dtype") == "bf16"
+
+
+def test_spec_flags_idempotent_reregistration():
+    define_flag("spec_decode", False, "dup")
+    define_flag("spec_gamma", 4, "dup")
+    define_flag("kv_cache_dtype", "bf16", "dup")
+    with pytest.raises(ValueError):
+        define_flag("spec_decode", True, "conflicting")
+    with pytest.raises(ValueError):
+        define_flag("spec_gamma", 8, "conflicting")
+    with pytest.raises(ValueError):
+        define_flag("kv_cache_dtype", "int8", "conflicting")
+
+
+def test_spec_flags_snapshot_restore_and_validators():
+    snap = flags_snapshot()
+    set_flags({"FLAGS_spec_decode": True, "FLAGS_spec_gamma": 2,
+               "FLAGS_kv_cache_dtype": "int8"})
+    assert flag("spec_decode") is True
+    assert flag("spec_gamma") == 2
+    assert flag("kv_cache_dtype") == "int8"
+    # the generator reads the mutated gamma
+    m = _target(seed=43)
+    assert SpeculativeGenerator(m, m, seq_buckets=(8, 16, 32),
+                                max_len=64).gamma == 2
+    flags_restore(snap)
+    assert flag("spec_decode") is False
+    assert flag("spec_gamma") == 4
+    assert flag("kv_cache_dtype") == "bf16"
+    with pytest.raises(ValueError):
+        set_flags({"FLAGS_spec_gamma": 0})         # validator
+    with pytest.raises(ValueError):
+        set_flags({"FLAGS_kv_cache_dtype": "int4"})

@@ -33,6 +33,7 @@ NAMESPACES = [
     ("paddle_tpu.vision.models", None),
     ("paddle_tpu.text", None),
     ("paddle_tpu.text.models", None),
+    ("paddle_tpu.text.speculative", None),
     ("paddle_tpu.inference", None),
     ("paddle_tpu.serving", None),
     ("paddle_tpu.quantization", None),
