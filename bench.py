@@ -1185,6 +1185,137 @@ def bench_autoshard(on_tpu):
             "unit": "ms/plan (bert propose)", "models": detail}
 
 
+def _serve_boot(models, decode, cache_dir, buckets="1,2,4",
+                seq_buckets="8,16", duration=0.3, timeout_s=600):
+    """One tools/serve.py subprocess boot (export → warm → brief traffic)
+    with the persistent executable cache at ``cache_dir``; returns its
+    JSON report.  A fresh process per boot is the point: 'warm' means a
+    genuinely restarted server loading serialized executables, not an
+    in-process jit cache hit.  jax's own compilation cache is unset in
+    the child so the cold number is a real compile."""
+    import subprocess
+    serve_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "serve.py")
+    cmd = [sys.executable, serve_py]
+    for m in models:
+        cmd += ["--model", m]
+    if decode:
+        cmd += ["--decode"]
+    cmd += ["--duration", str(duration), "--clients", "2",
+            "--buckets", buckets, "--seq-buckets", seq_buckets,
+            "--cache-dir", cache_dir, "--seed", "0", "--json"]
+    env = dict(os.environ)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    p = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=timeout_s, env=env)
+    if p.returncode != 0:
+        raise RuntimeError(f"serve.py rc={p.returncode}: "
+                           f"{p.stderr[-1500:]}")
+    return json.loads(p.stdout)
+
+
+def bench_startup(on_tpu):
+    """Tenth block: cold vs warm server boot through the persistent
+    executable cache (FLAGS_executable_cache).  Cold boot AOT-compiles
+    the full zoo grid (lenet/resnet_block/bert dense buckets + the GPT
+    decode prefill/decode grids) and serializes every executable; warm
+    boot is a fresh PROCESS over the same cache dir and must load every
+    one (all ledger events kind cache_load, warmup_fresh_compiles == 0).
+    Headline value: warm/cold boot ratio on the bert grid (target >=5x).
+    CPU-control caveat (PERF.md convention): XLA:CPU compile seconds
+    stand in for XLA:TPU's — the RATIO and the zero-fresh-compile proof
+    are the claim, absolute seconds are not.  Also measures
+    restart-under-traffic recovery: a warm server killed mid-traffic,
+    rebooted from the cache, to first successful reply."""
+    import shutil
+    import tempfile
+    import threading
+
+    out = {}
+    for label, (models, decode) in {
+            "bert": (["bert"], False),
+            "zoo_full": (["lenet", "resnet_block", "bert"], True)}.items():
+        cache_dir = tempfile.mkdtemp(prefix=f"exec_cache_{label}_")
+        try:
+            cold = _serve_boot(models, decode, cache_dir)
+            warm = _serve_boot(models, decode, cache_dir)
+            out[label] = {
+                "cold_warmup_s": cold["warmup_s"],
+                "warm_warmup_s": warm["warmup_s"],
+                "warm_cold_ratio": round(
+                    cold["warmup_s"] / max(warm["warmup_s"], 1e-9), 2),
+                "cold_compile_kinds": cold.get("warmup_compile_kinds"),
+                "warm_compile_kinds": warm.get("warmup_compile_kinds"),
+                "warm_fresh_compiles": warm.get("warmup_fresh_compiles"),
+                "steady_compiles": warm.get("steady_compiles"),
+                "cache_entries": len([f for f in os.listdir(cache_dir)
+                                      if f.endswith(".pjrt")]),
+            }
+        finally:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+    # restart-under-traffic: a warm server killed mid-traffic, rebooted
+    # from the cache in-process; recovery = stop() -> first reply
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.framework.flags import (flags_restore, flags_snapshot,
+                                            set_flags)
+    snap = flags_snapshot()
+    cache_dir = tempfile.mkdtemp(prefix="exec_cache_restart_")
+    export_dir = tempfile.mkdtemp(prefix="exec_cache_model_")
+    try:
+        set_flags({"FLAGS_executable_cache": "readwrite",
+                   "FLAGS_executable_cache_dir": cache_dir})
+        paddle.seed(0)
+        from paddle_tpu.vision.models import LeNet
+        net = LeNet()
+        net.eval()
+        prefix = os.path.join(export_dir, "lenet")
+        serving.export_for_serving(
+            net, prefix, [([None, 1, 28, 28], "float32")], buckets=(1, 2))
+
+        def boot():
+            srv = serving.Server(serving.ServingConfig(buckets=(1, 2),
+                                                       workers=1))
+            srv.register("lenet", prefix, buckets=(1, 2))
+            srv.start()
+            return srv
+
+        x = np.zeros((1, 1, 28, 28), np.float32)
+        srv = boot()                      # fills the cache
+        stop_evt = threading.Event()
+
+        def traffic():
+            while not stop_evt.is_set():
+                try:
+                    srv.run("lenet", [x], timeout=5)
+                except Exception:
+                    return                # server went away: clients drain
+        threads = [threading.Thread(target=traffic) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        t0 = time.perf_counter()
+        stop_evt.set()
+        srv.stop(drain=False)
+        srv2 = boot()                     # warm: loads from the cache
+        srv2.run("lenet", [x], timeout=30)
+        recovery_s = time.perf_counter() - t0
+        srv2.assert_zero_steady_state_recompiles()
+        srv2.stop()
+        for t in threads:
+            t.join(timeout=5)
+        out["restart_under_traffic_recovery_s"] = round(recovery_s, 3)
+    finally:
+        flags_restore(snap)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        shutil.rmtree(export_dir, ignore_errors=True)
+
+    return {"value": out["bert"]["warm_cold_ratio"],
+            "unit": "x cold/warm boot (bert grid)",
+            "cpu_control": not on_tpu, "detail": out}
+
+
 WORKLOADS = [
     ("mnist_lenet_static", bench_lenet_static),
     ("resnet50_dygraph", bench_resnet50),
@@ -1195,6 +1326,7 @@ WORKLOADS = [
     ("serving", bench_serving),
     ("decode", bench_decode),
     ("autoshard", bench_autoshard),
+    ("startup", bench_startup),
 ]
 
 

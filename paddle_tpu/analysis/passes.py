@@ -31,6 +31,13 @@ Pass inventory (ids are stable API — suppression keys, gauge names):
                           the active autoshard rules table (ERROR: the
                           rules engine and the model disagree about the
                           layout — one of them is wrong)
+  cache-key-hygiene       weak-typed or scalar-baked jit invars that
+                          fragment the PERSISTENT executable cache key
+                          space (jit/persistent_cache.py): what the
+                          recompile-hazard pass reports as in-process
+                          churn becomes on-disk fan-out — one serialized
+                          executable per variant — once
+                          FLAGS_executable_cache is on (silent while off)
 """
 from __future__ import annotations
 
@@ -47,7 +54,8 @@ __all__ = ["PASS_IDS"]
 
 PASS_IDS = ("recompile-hazard", "host-transfer", "dtype-promotion",
             "donation", "layout", "collective-consistency", "dead-fetch",
-            "sharding-coverage", "autoshard-conflict")
+            "sharding-coverage", "autoshard-conflict",
+            "cache-key-hygiene")
 
 
 def _diag(pass_id: str, message: str, location: Optional[str] = None,
@@ -142,6 +150,88 @@ def _recompile_hazard(ctx: LintContext) -> List[Diagnostic]:
                 f"if this argument varies per step (e.g. a growing "
                 f"sequence length), pad/bucket it to a stable shape",
                 diff=line))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cache-key-hygiene
+# ---------------------------------------------------------------------------
+
+def _weak_key_leaves(key):
+    """Weak-typed signature leaves of a compile-cache key: both the jit
+    signature convention ('t'|'a', shape, dtype, 'weak') and the ledger's
+    labeled-leaf convention ('arg:<path>', shape, dtype, 'weak')."""
+    out = []
+
+    def walk(k, path=""):
+        if isinstance(k, (tuple, list)):
+            if len(k) == 4 and k[3] == "weak":
+                if k[0] in ("t", "a"):
+                    out.append((path or "operand", k[1], k[2]))
+                    return
+                if isinstance(k[0], str) and k[0].startswith("arg:"):
+                    out.append((k[0][4:], k[1], k[2]))
+                    return
+            for i, e in enumerate(k):
+                walk(e, f"{path}[{i}]")
+    walk(key)
+    return out
+
+
+@register_pass("cache-key-hygiene", severity=Severity.WARNING,
+               doc="weak-typed / scalar-baked jit invars that fragment "
+                   "the persistent executable cache key space")
+def _cache_key_hygiene(ctx: LintContext) -> List[Diagnostic]:
+    """The recompile-hazard findings, re-read through the persistent
+    executable cache (jit/persistent_cache.py): a key leaf that churns
+    in-process costs a recompile per variant, but under
+    FLAGS_executable_cache=readwrite it also SERIALIZES one on-disk
+    executable per variant — the cache dir fans out and warm starts stop
+    hitting.  Silent (one branch) while the cache flag is off."""
+    from ..framework import flags as _flags
+    try:
+        if str(_flags.flag("executable_cache")).lower() == "off":
+            return []
+    except KeyError:
+        return []
+    if ctx.cache_key is None:
+        return []
+    pid = "cache-key-hygiene"
+    out: List[Diagnostic] = []
+    for path, tname, val in _scalar_const_entries(ctx.cache_key):
+        out.append(_diag(
+            pid,
+            f"python {tname} {val!r} is baked into the compile key at "
+            f"{path}: every distinct value serializes ANOTHER executable "
+            f"into FLAGS_executable_cache_dir and none of them load on a "
+            f"warm start with a different value — pass it as an array "
+            f"operand so one cached entry serves all values",
+            key_path=path))
+    for path, shape, dtype in _weak_key_leaves(ctx.cache_key):
+        out.append(_diag(
+            pid,
+            f"{path} enters the compile key weak-typed "
+            f"({dtype}{list(shape)}): a python scalar at trace time keys "
+            f"a DIFFERENT persistent cache entry than the committed "
+            f"array a warm start feeds — commit the dtype (e.g. "
+            f"np.float32(x)) so cold and warm starts share one entry",
+            operand=path))
+    # ledger cross-check (the recompile-hazard pass's machinery): a key
+    # that already churned at this site is already fanning out on disk
+    if ctx.prev_key is not None:
+        from ..profiler import ledger as _ledger
+        churn = [ln for ln in _ledger.key_diff(ctx.prev_key,
+                                               ctx.cache_key)
+                 if "first compile" not in ln
+                 and "key unchanged" not in ln]
+        if churn:
+            out.append(_diag(
+                pid,
+                f"this site's cache key churns ({churn[0]}): each "
+                f"variant persists its own executable — the "
+                f"recompile-hazard fix (stable shapes/dtypes/buckets) "
+                f"is also the disk-footprint fix",
+                diff=churn[0]))
     return out
 
 

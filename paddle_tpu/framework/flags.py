@@ -450,6 +450,46 @@ define_flag("decode_max_len", 1024,
             "OutOfRange instead of growing an unbounded cache shape.",
             validator=lambda v: int(v) >= 1)
 
+# ---- Persistent executable cache (paddle_tpu.jit.persistent_cache) ----------
+define_flag("executable_cache",
+            os.environ.get("PADDLE_TPU_EXEC_CACHE", "off").lower()
+            or "off",
+            "Persistent on-disk AOT executable cache tri-state "
+            "(jit/persistent_cache.py): 'off' = every fresh compile "
+            "pays XLA (one Python branch per fresh-compile path, zero "
+            "per step); 'read' = fresh compiles first probe "
+            "FLAGS_executable_cache_dir for a serialized executable "
+            "with a matching (ledger key, program identity, "
+            "jaxlib/device fingerprint, lowering flags) digest and a "
+            "verified sha256 — hits deserialize in O(load) and are "
+            "ledgered as kind 'cache_load'; 'readwrite' additionally "
+            "serializes every fresh compile back into the dir (one "
+            "host compiles, N hosts load).  Wired into @to_static "
+            "dispatch, the static Executor, TrainStep.aot_compile "
+            "(and so HLO-audit lowerings), and the serving warm-up "
+            "grids (dense + decode + speculative).  Seeded by "
+            "PADDLE_TPU_EXEC_CACHE.",
+            validator=lambda v: str(v).lower() in ("off", "read",
+                                                   "readwrite"))
+define_flag("executable_cache_dir",
+            os.environ.get("PADDLE_TPU_EXEC_CACHE_DIR", ""),
+            "Directory of the persistent executable cache (entries: "
+            "<digest>.pjrt payload + <digest>.json sha256 manifest, "
+            "written with the checkpoint subsystem's atomic "
+            "temp+fsync+rename discipline).  Empty disables the cache "
+            "regardless of FLAGS_executable_cache — both must be set "
+            "(tools/serve.py --cache-dir sets both).  Seeded by "
+            "PADDLE_TPU_EXEC_CACHE_DIR.")
+define_flag("executable_cache_max_gb",
+            float(os.environ.get("PADDLE_TPU_EXEC_CACHE_MAX_GB", "0")
+                  or 0),
+            "Payload-size cap (GiB) for the persistent executable "
+            "cache: after each store, least-recently-used entries are "
+            "evicted until the cache fits.  0 = unbounded (GC via "
+            "tools/exec_cache.py gc --max-gb/--max-age).  Seeded by "
+            "PADDLE_TPU_EXEC_CACHE_MAX_GB.",
+            validator=lambda v: float(v) >= 0)
+
 # ---- Speculative decoding + quantized KV cache (text.speculative) -----------
 define_flag("spec_decode",
             os.environ.get("PADDLE_TPU_SPEC_DECODE", "").lower()

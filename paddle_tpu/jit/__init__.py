@@ -63,6 +63,27 @@ def _sig_of(args):
     return tuple(sig)
 
 
+class _FallbackExec:
+    """A persistent-cache-seeded compiled forward for one @to_static
+    signature: replays the exact avals it was compiled for, and falls
+    back to a fresh ``jax.jit`` on any mismatch (e.g. an AMP-cast
+    operand) instead of failing the call — a cache seed may never change
+    observable behavior."""
+
+    __slots__ = ("_ex", "_fn", "_jit")
+
+    def __init__(self, ex, fn):
+        self._ex, self._fn, self._jit = ex, fn, None
+
+    def __call__(self, *args):
+        try:
+            return self._ex(*args)
+        except Exception:
+            if self._jit is None:
+                self._jit = jax.jit(self._fn)
+            return self._jit(*args)
+
+
 class StaticFunction:
     """@to_static wrapper (dygraph/jit.py:160 + ConcreteProgram cache)."""
 
@@ -220,13 +241,24 @@ class StaticFunction:
                 except Exception:
                     self._cache.pop(sig, None)
                     raise
+            # persistent executable cache (one branch when off): load —
+            # or AOT-compile-and-store — the forward executable and seed
+            # it into the primitive's fwd cache, so the first dispatch
+            # below replays instead of compiling.  A load is ledgered as
+            # kind cache_load inside the helper; a miss compiles here
+            # and is ledgered as a normal "jit" event below.
+            loaded = False
+            from . import persistent_cache as _pcache
+            if _pcache.enabled():
+                loaded = self._seed_from_cache(prim, ins, sig, site)
             # the trace + XLA compile happen inside this first dispatch;
             # ledger the wall time and the signature diff (the "why did
             # this recompile" record)
             with _span("jit::trace_compile"):
                 out = prim(*ins)
-            _ledger.record_compile(site, "jit", sig,
-                                   (time.perf_counter() - t0) * 1e3)
+            if not loaded:
+                _ledger.record_compile(site, "jit", sig,
+                                       (time.perf_counter() - t0) * 1e3)
         else:
             _ledger.record_cache_hit(site)
             with _span("jit::execute"):
@@ -259,6 +291,42 @@ class StaticFunction:
         if isinstance(out, tuple) and len(out) == 1:
             return out[0]
         return out
+
+    def _source_digest(self):
+        """Program identity for the persistent cache: the function's own
+        source (a code edit must never replay a stale executable; the
+        signature alone cannot see one)."""
+        if not hasattr(self, "_src_sha"):
+            import hashlib
+            import inspect
+            try:
+                src = inspect.getsource(self._function)
+            except Exception:
+                src = getattr(self._function, "__qualname__", "fn")
+            self._src_sha = hashlib.sha256(src.encode()).hexdigest()
+        return self._src_sha
+
+    def _seed_from_cache(self, prim, ins, sig, site):
+        """Persistent-cache seat of the @to_static first dispatch: load
+        (or AOT-compile-and-store) the forward executable and seed the
+        primitive's fwd cache.  Returns True when it came from the cache
+        (dispatch is then O(load)).  Backward programs trace on demand
+        exactly as before — inference-style calls never build them."""
+        from . import persistent_cache as _pcache
+        from ..framework.primitive import _attrs_key
+        uw = [x._value if isinstance(x, Tensor) else x for x in ins]
+        try:
+            ex, loaded = _pcache.load_or_compile(
+                lambda: jax.jit(prim.fn).lower(*uw).compile(),
+                site=site, kind="jit", key=sig,
+                extra_key=("to_static",
+                           getattr(self._function, "__qualname__", "fn"),
+                           self._source_digest()),
+                ledger_miss=False)
+        except Exception:
+            return False    # any cache trouble: the dispatch compiles
+        prim._fwd_cache[_attrs_key({})] = _FallbackExec(ex, prim.fn)
+        return loaded
 
     @property
     def code(self):

@@ -810,8 +810,33 @@ class TrainStep:
 
     def aot_compile(self, inputs, label=None):
         """``aot_lower(...).compile()`` — the compiled executable, never
-        dispatched."""
-        return self.aot_lower(inputs, label).compile()
+        dispatched.  Under FLAGS_executable_cache the XLA compile is
+        served from the persistent executable cache, keyed by the sha256
+        of the lowered StableHLO module itself — exact program identity
+        (mesh, shardings, donation, sentinel and every lowering flag are
+        all in the module text), so the cache can never substitute a
+        different program; lowering (the cheap half) always runs, the
+        XLA compile (the expensive half) loads.  HLO-audit lowerings
+        ride this path, so pod-scale audits pay one compile per
+        signature per CLUSTER, not per host."""
+        lowered = self.aot_lower(inputs, label)
+        from ..jit import persistent_cache as _pcache
+        if not _pcache.enabled():       # off-path: one branch
+            return lowered.compile()
+        import hashlib
+        hlo_sha = hashlib.sha256(
+            lowered.as_text().encode()).hexdigest()
+        site = f"train_step:{type(self.layer).__name__}:{id(self):#x}"
+        compiled, _loaded = _pcache.load_or_compile(
+            lowered.compile,
+            site=site, kind="train_step_aot",
+            key=(("arg:hlo_sha256", hlo_sha[:16]),),
+            extra_key=("train_step_hlo", hlo_sha),
+            # aot_compile never ledgered its compiles (the HLO audit
+            # ledgers its own lowering at kind hlo_audit) — keep that;
+            # loads still ledger as cache_load per the warm-start proof
+            ledger_miss=False)
+        return compiled
 
     # -- eager entry ---------------------------------------------------------
     def _feed_placer(self, inputs):

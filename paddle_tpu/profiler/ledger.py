@@ -101,8 +101,12 @@ def key_diff(prev, cur):
 def record_compile(site: str, kind: str, key, ms: float, extra=None) -> dict:
     """Record one compile event. ``site`` identifies the compile cache
     (e.g. ``jit:train_step.<locals>.f``); ``kind`` is jit / executor /
-    executor_aot / train_step; ``key`` the cache key; ``ms`` the wall
-    time of trace+compile (first dispatch)."""
+    train_step / serving_aot / generate_* / hlo_audit — or
+    ``cache_load`` when the persistent executable cache
+    (jit/persistent_cache.py) satisfied the site without a fresh XLA
+    compile (``extra.orig_kind`` keeps the avoided kind); ``key`` the
+    cache key; ``ms`` the wall time of trace+compile (first dispatch),
+    or of verify+deserialize for a load."""
     with _lock:
         prev = _last_key.get(site)
         _last_key[site] = key

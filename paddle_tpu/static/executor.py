@@ -126,10 +126,25 @@ class Executor:
         """Persist compiled PJRT executables under ``path`` so a process
         restart replays them instead of recompiling — the TPU seat of the
         reference's optimization-cache dir (analysis_config SetOptimCacheDir)
-        and TensorRT engine serialization."""
+        and TensorRT engine serialization.  Entries go through
+        ``jit.persistent_cache`` (atomic writes + sha256 manifests, the
+        checkpoint discipline), so a torn write can never poison a load."""
         import os
         os.makedirs(path, exist_ok=True)
         self._aot_dir = path
+
+    def _exec_cache(self):
+        """(cache, writable): the legacy per-predictor optim-cache dir
+        (always readwrite — the caller asked for it explicitly) or the
+        FLAGS_executable_cache global dir; (None, False) when neither is
+        configured — the one off-path branch."""
+        from ..jit import persistent_cache as _pcache
+        if self._aot_dir is not None:
+            return _pcache.cache_at(self._aot_dir), True
+        c = _pcache.get_cache()
+        if c is not None:
+            return c, _pcache.mode() == "readwrite"
+        return None, False
 
     def set_cache_extra_key(self, key):
         """Fold an extra token into the AOT executable digest — the
@@ -170,46 +185,6 @@ class Executor:
             h.update(self._cache_extra_key.encode())
         return h.hexdigest()
 
-    def _aot_load(self, digest):
-        import os
-        import pickle
-        path = os.path.join(self._aot_dir, digest + ".pjrt")
-        if not os.path.exists(path):
-            return None
-        try:
-            from jax.experimental.serialize_executable import (
-                deserialize_and_load)
-            with open(path, "rb") as f:
-                blob, in_tree, out_tree, n_dev = pickle.load(f)
-            # pin execution to the same device count the executable was
-            # built for (deserialize defaults to ALL client devices)
-            return deserialize_and_load(
-                blob, in_tree, out_tree,
-                execution_devices=jax.devices()[:n_dev])
-        except Exception:
-            # different runtime/PJRT/machine: fall back to a fresh compile
-            return None
-
-    def _aot_save(self, digest, compiled):
-        import os
-        import pickle
-        from jax.experimental.serialize_executable import serialize
-        path = os.path.join(self._aot_dir, digest + ".pjrt")
-        try:
-            import tempfile
-            blob, in_tree, out_tree = serialize(compiled)
-            n_dev = len(compiled._executable.xla_executable
-                        .local_devices()) \
-                if hasattr(compiled, "_executable") else 1
-            # unique tmp per writer: concurrent cold-starting processes
-            # sharing one cache dir must not interleave into one file
-            fd, tmp = tempfile.mkstemp(dir=self._aot_dir,
-                                       suffix=".pjrt.tmp")
-            with os.fdopen(fd, "wb") as f:
-                pickle.dump((blob, in_tree, out_tree, n_dev), f)
-            os.replace(tmp, path)
-        except Exception:
-            pass   # cache is best-effort; serving continues uncached
 
     # -- eager interpretation (startup programs / debugging) -----------------
     def _run_eager(self, program: Program, scope: Scope):
@@ -313,22 +288,37 @@ class Executor:
             replay = self._build_replay(program, feed_names, union,
                                         persist_names, written)
             jitted = None
-            if self._aot_dir is not None and compiled is None:
+            pcache, pc_writable = (self._exec_cache() if compiled is None
+                                   else (None, False))
+            if pcache is not None:
                 # AOT executable cache: lowering needs the persist values,
                 # so gather them here (run() re-gathers below — cheap dict
                 # reads)
                 pv = [scope.find_var(n) for n in persist_names]
                 if all(v is not None for v in pv):
-                    digest = self._aot_digest(program, feed_names,
-                                              feed_vals, union,
-                                              persist_names, pv)
-                    jitted = self._aot_load(digest)
+                    from ..jit import persistent_cache as _pcache
+                    digest = _pcache.digest_for(
+                        ("executor",),
+                        extra_key=self._aot_digest(program, feed_names,
+                                                   feed_vals, union,
+                                                   persist_names, pv))
+                    t_load = time.perf_counter()
+                    jitted = pcache.load(digest)
                     aot_loaded = jitted is not None
-                    if jitted is None:
+                    if aot_loaded:
+                        _pcache.note_hit("executor_aot",
+                                         time.perf_counter() - t_load)
+                    else:
+                        _pcache.note_miss("executor_aot")
                         with _span("executor::compile"):
                             compiled_exe = jax.jit(replay).lower(
                                 feed_vals, pv).compile()
-                        self._aot_save(digest, compiled_exe)
+                        if pc_writable:
+                            pcache.store(
+                                digest, compiled_exe,
+                                key=key + (tuple(union),),
+                                site=f"executor:{program._uid}",
+                                kind="executor_aot")
                         jitted = compiled_exe
                         from ..utils.monitor import stat_add
                         stat_add("STAT_executor_compiles")
@@ -381,13 +371,18 @@ class Executor:
         if fresh:
             # trace + XLA compile happen inside this first dispatch (the
             # AOT path compiled above; a deserialized executable skipped
-            # it) — ledger the wall time and the cache-key diff
+            # it) — ledger the wall time and the cache-key diff.  A
+            # persistent-cache load is ledgered as ``cache_load`` so warm
+            # starts show zero fresh XLA compiles while the steady-state
+            # checks keep counting events at this site unchanged.
             with _span("executor::compile"):
                 fetches, updates = jitted(feed_vals, persist_vals)
             _ledger.record_compile(
-                site, "executor_aot" if aot_loaded else "executor",
+                site, "cache_load" if aot_loaded else "executor",
                 key + (tuple(union),),
-                (time.perf_counter() - t_compile) * 1e3)
+                (time.perf_counter() - t_compile) * 1e3,
+                extra={"orig_kind": "executor_aot"} if aot_loaded
+                else None)
         else:
             _ledger.record_cache_hit(site)
             with _span("executor::device_execute"):

@@ -257,17 +257,31 @@ class Generator:
     def _state_args(self):
         return (self._params, self._buffers)
 
+    def _program_identity(self):
+        """Restart-stable architecture identity for the persistent
+        executable cache: layer class + config + state avals.  Weights
+        are runtime arguments, so two processes decoding the same
+        architecture share executables regardless of parameter values —
+        the cold host compiles the grid, every warm host loads it."""
+        cfg = getattr(self._layer, "config", None)
+        cfg_r = repr(sorted(vars(cfg).items())) \
+            if cfg is not None and hasattr(cfg, "__dict__") else repr(cfg)
+        avals = jax.tree_util.tree_map(
+            lambda a: (tuple(a.shape), str(a.dtype)), self._state_avals())
+        return ("generator", type(self._layer).__name__, cfg_r,
+                repr(avals), self._max_len, tuple(self._seq_buckets))
+
     def _compile(self, key, kind, fn, arg_avals, extra):
         ex = self._execs.get(key)
         if ex is not None:
             _ledger.record_cache_hit(self._site)
             return ex
-        t0 = time.perf_counter()
-        ex = jax.jit(fn).lower(*self._state_avals(),
-                               *arg_avals).compile()
-        _ledger.record_compile(self._site, kind, key,
-                               (time.perf_counter() - t0) * 1e3,
-                               extra=extra)
+        from ..jit import persistent_cache as _pcache
+        ex, _loaded = _pcache.load_or_compile(
+            lambda: jax.jit(fn).lower(*self._state_avals(),
+                                      *arg_avals).compile(),
+            site=self._site, kind=kind, key=key,
+            extra_key=self._program_identity(), extra=extra)
         self._execs[key] = ex
         return ex
 

@@ -273,6 +273,16 @@ class SpeculativeGenerator(_Generator):
         return super()._key(phase, B, P, C, steps, beam, end) \
             + (("arg:gamma", self._gamma),)
 
+    def _program_identity(self):
+        # the joint program bakes the draft architecture too (its params
+        # ride _state_avals already; the class/config pin the code path)
+        d_cfg = getattr(self._draft, "config", None)
+        d_cfg_r = repr(sorted(vars(d_cfg).items())) \
+            if d_cfg is not None and hasattr(d_cfg, "__dict__") \
+            else repr(d_cfg)
+        return super()._program_identity() + (
+            "draft", type(self._draft).__name__, d_cfg_r, self._gamma)
+
     def prefill_exec(self, B, P, C):
         key = self._key("prefill", B, P, C, None, None)
         fn = self._build_prefill(B, P, C)

@@ -107,6 +107,54 @@ def test_recompile_hazard_clean():
     assert not _only(r, "recompile-hazard")
 
 
+def test_cache_key_hygiene_seeded(flags_guard):
+    """Weak-typed + scalar-baked key leaves fragment the PERSISTENT
+    executable cache: one on-disk entry per variant.  The pass fires
+    only while FLAGS_executable_cache is on."""
+    set_flags({"FLAGS_executable_cache": "read"})
+
+    def f(x):
+        return x + 1.0
+    r = _lint(f, jnp.ones(4),
+              cache_key=(("t", (4,), "float32", "weak"),
+                         ("c", "float", 0.5)))
+    found = _only(r, "cache-key-hygiene")
+    assert len(found) == 2
+    msgs = " | ".join(d.message for d in found)
+    assert "0.5" in msgs and "executable_cache_dir" in msgs
+    assert "weak-typed" in msgs and "one entry" in msgs
+
+
+def test_cache_key_hygiene_ledger_cross_check(flags_guard):
+    set_flags({"FLAGS_executable_cache": "readwrite"})
+
+    def f(x):
+        return x * 2
+    prev = (("arg:inputs[0]", (8, 4), "float32", "strong"),)
+    cur = (("arg:inputs[0]", (16, 4), "float32", "strong"),)
+    r = _lint(f, jnp.ones((16, 4)), cache_key=cur, prev_key=prev)
+    found = _only(r, "cache-key-hygiene")
+    assert len(found) == 1
+    assert "churns" in found[0].message
+    assert "inputs[0]" in found[0].message
+
+
+def test_cache_key_hygiene_clean_and_gated(flags_guard):
+    def f(x):
+        return x + 1
+    committed = (("t", (4,), "float32", "strong"),)
+    # clean key with the cache on: silent
+    set_flags({"FLAGS_executable_cache": "read"})
+    assert not _only(_lint(f, jnp.ones(4), cache_key=committed),
+                     "cache-key-hygiene")
+    # hazardous key with the cache OFF: the pass costs nothing / says
+    # nothing — the fragmentation hazard only exists with a cache dir
+    set_flags({"FLAGS_executable_cache": "off"})
+    assert not _only(_lint(f, jnp.ones(4),
+                           cache_key=(("c", "float", 0.5),)),
+                     "cache-key-hygiene")
+
+
 def _twice(a):
     return np.asarray(a) * 2
 

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""exec_cache — inspect, verify, and GC the persistent executable cache.
+
+The CLI face of ``paddle_tpu.jit.persistent_cache`` (the on-disk AOT
+executable cache behind ``FLAGS_executable_cache``):
+
+    python tools/exec_cache.py list   --dir /cache [--json]
+    python tools/exec_cache.py verify --dir /cache [--json]
+    python tools/exec_cache.py gc     --dir /cache --max-gb 2 --max-age-days 7
+
+``list`` prints one row per entry (digest, kind, site, payload size, age,
+hit count, ledger-key head).  ``verify`` re-hashes every payload against
+its sha256 manifest — rc != 0 on any torn/corrupt entry, so a CI lane can
+gate a shared cache dir (the loader would invalidate these lazily at the
+next warm start; verify surfaces them eagerly).  ``gc`` evicts entries
+unused for ``--max-age-days``, then least-recently-used entries until the
+payload total fits ``--max-gb``; orphan payloads (a dead writer's debris,
+never loadable) always go.
+
+``--dir`` defaults to ``PADDLE_TPU_EXEC_CACHE_DIR`` /
+``FLAGS_executable_cache_dir``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+
+
+def _fmt_age(s):
+    if s < 120:
+        return f"{s:.0f}s"
+    if s < 7200:
+        return f"{s / 60:.0f}m"
+    if s < 172800:
+        return f"{s / 3600:.1f}h"
+    return f"{s / 86400:.1f}d"
+
+
+def cmd_list(cache, args):
+    rows = cache.entries()
+    report = {"dir": cache.dir, "entries": len(rows),
+              "total_payload_bytes": cache.total_bytes(),
+              "rows": [{k: m.get(k) for k in
+                        ("digest", "kind", "site", "size", "age_s",
+                         "hits", "key")} for m in rows]}
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+        return 0
+    if not rows:
+        print(f"{cache.dir}: empty")
+        return 0
+    for m in rows:
+        key = (m.get("key") or "")[:48]
+        print(f"{m['digest'][:16]}  {m.get('kind') or '?':>16}  "
+              f"{_fmt_bytes(int(m.get('size', 0))):>9}  "
+              f"age {_fmt_age(float(m['age_s'])):>6}  "
+              f"hits {int(m.get('hits', 0)):>4}  "
+              f"{m.get('site') or '?'}  {key}")
+    print(f"{len(rows)} entries, "
+          f"{_fmt_bytes(cache.total_bytes())} of payloads")
+    return 0
+
+
+def cmd_verify(cache, args):
+    rows = cache.entries()
+    bad = []
+    for m in rows:
+        ok, reason = cache.verify_entry(m["digest"])
+        if not ok:
+            bad.append({"digest": m["digest"], "reason": reason})
+    # manifest-less payloads are torn writes: report them too
+    orphans = []
+    try:
+        known = {m["digest"] for m in rows}
+        for n in os.listdir(cache.dir):
+            if n.endswith(".pjrt") and n[:-5] not in known:
+                orphans.append(n)
+    except OSError:
+        pass
+    report = {"dir": cache.dir, "entries": len(rows),
+              "corrupt": bad, "orphan_payloads": orphans,
+              "ok": not bad and not orphans}
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        for b in bad:
+            print(f"CORRUPT {b['digest'][:16]}: {b['reason']}")
+        for o in orphans:
+            print(f"ORPHAN  {o} (payload with no manifest)")
+        print(f"verify: {len(rows)} entries, {len(bad)} corrupt, "
+              f"{len(orphans)} orphaned")
+    return 0 if report["ok"] else 1
+
+
+def cmd_gc(cache, args):
+    max_bytes = int(args.max_gb * (1 << 30)) if args.max_gb else None
+    max_age_s = args.max_age_days * 86400 if args.max_age_days else None
+    before = cache.total_bytes()
+    removed = cache.gc(max_bytes=max_bytes, max_age_s=max_age_s)
+    report = {"dir": cache.dir, "removed": removed,
+              "bytes_before": before, "bytes_after": cache.total_bytes()}
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"gc: evicted {len(removed)} entries "
+              f"({_fmt_bytes(before)} -> "
+              f"{_fmt_bytes(report['bytes_after'])})")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="exec_cache",
+        description="inspect/verify/GC the persistent executable cache")
+    ap.add_argument("command", choices=("list", "verify", "gc"))
+    ap.add_argument("--dir", default=None,
+                    help="cache directory (default: "
+                         "PADDLE_TPU_EXEC_CACHE_DIR / "
+                         "FLAGS_executable_cache_dir)")
+    ap.add_argument("--max-gb", type=float, default=None,
+                    help="gc: evict LRU entries until payloads fit")
+    ap.add_argument("--max-age-days", type=float, default=None,
+                    help="gc: evict entries unused for this many days")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.jit import persistent_cache as pcache
+    d = args.dir or pcache.cache_dir()
+    if not d:
+        ap.error("--dir is required (or set PADDLE_TPU_EXEC_CACHE_DIR)")
+    if not os.path.isdir(d):
+        print(f"exec_cache: no such directory: {d}", file=sys.stderr)
+        return 2
+    cache = pcache.cache_at(d)
+    if args.command == "gc" and args.max_gb is None \
+            and args.max_age_days is None:
+        ap.error("gc needs --max-gb and/or --max-age-days")
+    return {"list": cmd_list, "verify": cmd_verify,
+            "gc": cmd_gc}[args.command](cache, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

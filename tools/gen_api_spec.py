@@ -28,6 +28,7 @@ NAMESPACES = [
     ("paddle_tpu.metric", None),
     ("paddle_tpu.amp", None),
     ("paddle_tpu.jit", None),
+    ("paddle_tpu.jit.persistent_cache", None),
     ("paddle_tpu.distributed", None),
     ("paddle_tpu.distributed.fleet", None),
     ("paddle_tpu.vision.models", None),

@@ -204,6 +204,15 @@ def main(argv=None):
                          "DIR (sets FLAGS_trace=full unless FLAGS_trace "
                          "/ PADDLE_TPU_TRACE already enabled a mode); "
                          "join with tools/obs_report.py")
+    ap.add_argument("--cache-dir", default=None, metavar="DIR",
+                    help="persistent executable cache: warm-up loads "
+                         "serialized executables from DIR instead of "
+                         "compiling, and stores what it compiles "
+                         "(FLAGS_executable_cache=readwrite + "
+                         "FLAGS_executable_cache_dir).  The report "
+                         "gains exec_cache hit/miss tallies and a "
+                         "warm-up compile-kind census — a warm boot "
+                         "shows warmup_fresh_compiles == 0")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit one JSON report instead of text")
     ap.add_argument("--seed", type=int, default=0)
@@ -239,7 +248,17 @@ def main(argv=None):
             from paddle_tpu.profiler.metrics import serve_metrics
             metrics_srv = serve_metrics(port=args.metrics_port)
             report["metrics_port"] = metrics_srv.port
+        if args.cache_dir:
+            os.makedirs(args.cache_dir, exist_ok=True)
+            set_flags({"FLAGS_executable_cache": "readwrite",
+                       "FLAGS_executable_cache_dir": args.cache_dir})
+            report["cache_dir"] = args.cache_dir
         with tempfile.TemporaryDirectory() as d:
+            # deterministic builds: the exported program (and so the
+            # cache identity and the served outputs) must match across
+            # cold/warm runs of this CLI
+            import paddle_tpu as _paddle
+            _paddle.seed(args.seed)
             server = serving.Server(serving.ServingConfig(
                 workers=args.workers, buckets=buckets))
             model_meta = {}
@@ -278,6 +297,21 @@ def main(argv=None):
             t0 = time.perf_counter()
             server.start()
             warmup_s = round(time.perf_counter() - t0, 3)
+            if args.cache_dir:
+                # warm-up compile census: a warm boot over a filled
+                # cache dir must show ONLY cache_load events (zero
+                # fresh XLA compiles) at the server-owned sites
+                from collections import Counter
+                from paddle_tpu.jit import persistent_cache as _pcache
+                from paddle_tpu.profiler import ledger as _pledger
+                kinds = Counter()
+                for site, mark in server._warmup_marks.items():
+                    for e in _pledger.compile_events(site)[:mark]:
+                        kinds[e.get("kind", "?")] += 1
+                report["exec_cache"] = _pcache.stats()
+                report["warmup_compile_kinds"] = dict(kinds)
+                report["warmup_fresh_compiles"] = sum(
+                    n for k, n in kinds.items() if k != "cache_load")
             if args.decode:
                 errors = _decode_traffic(
                     server, "gpt_decode", args.duration, args.clients,
