@@ -37,8 +37,8 @@ from __future__ import annotations
 
 from .rules import (Rule, PartitionRules, active_rules,  # noqa: F401
                     conv_rules, default_rules, embedding_rules,
-                    register_rules_table, rules_table, rules_table_names,
-                    spec_repr, transformer_rules)
+                    expert_rules, register_rules_table, rules_table,
+                    rules_table_names, spec_repr, transformer_rules)
 from .plan import (LeafPlan, ShardingPlan, propose,  # noqa: F401
                    specs_equivalent)
 from .transform import (AUTOSHARD_SOURCE_ATTR, AutoshardWarning,  # noqa: F401
@@ -47,7 +47,7 @@ from .transform import (AUTOSHARD_SOURCE_ATTR, AutoshardWarning,  # noqa: F401
 
 __all__ = [
     "Rule", "PartitionRules", "transformer_rules", "conv_rules",
-    "embedding_rules", "default_rules", "rules_table",
+    "embedding_rules", "expert_rules", "default_rules", "rules_table",
     "register_rules_table", "rules_table_names", "active_rules",
     "spec_repr", "LeafPlan", "ShardingPlan", "propose",
     "specs_equivalent", "apply", "maybe_autoshard", "autoshard_mode",

@@ -44,7 +44,7 @@ from jax.sharding import PartitionSpec as P
 
 __all__ = [
     "Rule", "PartitionRules", "transformer_rules", "conv_rules",
-    "embedding_rules", "default_rules", "rules_table",
+    "embedding_rules", "expert_rules", "default_rules", "rules_table",
     "register_rules_table", "rules_table_names", "active_rules",
     "spec_repr",
 ]
@@ -210,12 +210,35 @@ def embedding_rules() -> PartitionRules:
     ], name="embedding")
 
 
+def expert_rules() -> PartitionRules:
+    """Mixture-of-Experts roles (nn.layer.moe): stacked expert FFN
+    planes shard WHOLE experts over the expert-parallel axis (leading
+    ``E`` dim — ``P(ep, None, None)``), the gate projection replicates
+    (every shard gates its own tokens).  The axis is read from
+    ``FLAGS_moe_axis`` at table-construction time so rule proposals
+    always agree with the layer's own annotations (default ``ep``;
+    ``dp`` for EP=DP meshes)."""
+    from ...framework import flags as _flags
+    try:
+        ep = str(_flags.flag("moe_axis"))
+    except KeyError:                         # pragma: no cover - early import
+        ep = "ep"
+    return PartitionRules([
+        Rule("moe-expert-ffn", r"(^|\.)experts\.(w1|w2)$",
+             P(ep, None, None), ndim=3),
+        Rule("moe-expert-bias", r"(^|\.)experts\.(b1|b2)$",
+             P(ep, None), ndim=2),
+        Rule("moe-gate-replicated", r"(^|\.)gate\.(weight|bias)$", P()),
+    ], name="expert")
+
+
 def default_rules() -> PartitionRules:
-    """The union table every zoo model shards from: transformer roles
-    first (most specific names), then conv, then recommender."""
+    """The union table every zoo model shards from: expert roles first
+    (most specific paths), then transformer, then conv, then
+    recommender."""
     return PartitionRules(
-        list(transformer_rules()) + list(conv_rules())
-        + list(embedding_rules()),
+        list(expert_rules()) + list(transformer_rules())
+        + list(conv_rules()) + list(embedding_rules()),
         name="default")
 
 
@@ -228,6 +251,7 @@ _TABLES: Dict[str, Callable[[], PartitionRules]] = {
     "transformer": transformer_rules,
     "conv": conv_rules,
     "embedding": embedding_rules,
+    "expert": expert_rules,
 }
 
 

@@ -344,6 +344,39 @@ define_flag("sharded_embedding_bucket_cap", 0,
             "never correctness.",
             validator=lambda v: int(v) >= 0)
 
+# ---- Expert-parallel Mixture-of-Experts (paddle_tpu.nn.layer.moe) -----------
+define_flag("moe_capacity_factor",
+            float(os.environ.get("PADDLE_TPU_MOE_CAPACITY_FACTOR", "1.25")
+                  or 1.25),
+            "Default capacity factor of MoE token dispatch: each routing "
+            "group may park at most ceil(cf * tokens * top_k / E) "
+            "assignments on one expert; overflow assignments DROP (the "
+            "token keeps its residual) and are counted in the "
+            "moe_tokens_dropped_total metric.  1.0 = exactly-balanced "
+            "budget, 1.25 = the usual head-room.  Only consulted when a "
+            "MoELayer/GPTMoEConfig leaves capacity_factor unset; models "
+            "without MoE layers are untouched (dense FFN is the default "
+            "everywhere).  Seeded by PADDLE_TPU_MOE_CAPACITY_FACTOR.",
+            validator=lambda v: float(v) > 0)
+define_flag("moe_top_k", 2,
+            "Default top-k of MoE softmax gating (k experts per token; "
+            "k=2 renormalizes the chosen pair, k=1 is the Switch rule). "
+            "Only consulted when a MoELayer/GPTMoEConfig leaves top_k "
+            "unset.  Seeded by FLAGS_moe_top_k.",
+            validator=lambda v: int(v) in (1, 2))
+define_flag("moe_axis",
+            os.environ.get("PADDLE_TPU_MOE_AXIS", "ep") or "ep",
+            "Mesh axis MoE expert stacks shard over (P(axis, None, None) "
+            "on the stacked expert parameters) and token rows route "
+            "across: 'ep' is the dedicated expert-parallel axis "
+            "(parallel.mesh.EP_AXIS); 'dp' rides the data axis (classic "
+            "EP=DP).  A mesh without the axis falls back to the meshless "
+            "local dispatch (single shard, no all_to_all).  The "
+            "autoshard 'expert' rules table reads this flag, so rule "
+            "proposals and layer annotations always name the same axis. "
+            "Seeded by PADDLE_TPU_MOE_AXIS.",
+            validator=lambda v: str(v) in ("ep", "dp", "mp", "pp", "sp"))
+
 # ---- Serving engine (paddle_tpu.serving) ------------------------------------
 define_flag("serving_buckets", "1,2,4,8,16,32,64",
             "Default batch-bucket ladder for the serving engine: pending "

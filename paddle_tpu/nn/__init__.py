@@ -42,6 +42,9 @@ from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
+from .layer.moe import (  # noqa: F401
+    MoELayer, MoEEncoderLayer, ExpertFFN,
+)
 from .layer.rnn import (  # noqa: F401
     RNNCellBase, SimpleRNNCell, LSTMCell, LSTMPCell, GRUCell, RNN, BiRNN, SimpleRNN,
     LSTM, GRU,

@@ -342,14 +342,26 @@ class TransformerEncoderLayer(Layer):
 
 
 class TransformerEncoder(Layer):
-    def __init__(self, encoder_layer, num_layers, norm=None):
+    def __init__(self, encoder_layer, num_layers=None, norm=None):
         super().__init__()
         from .container import LayerList
         import copy
-        self.layers = LayerList(
-            [encoder_layer if i == 0 else _clone_layer(encoder_layer)
-             for i in range(num_layers)])
-        self.num_layers = num_layers
+        if isinstance(encoder_layer, (list, tuple)):
+            # pre-built heterogeneous stack (e.g. alternating dense/MoE
+            # blocks — text.models.GPTMoEModel); each entry keeps its
+            # own parameters, no cloning
+            layers = list(encoder_layer)
+            if num_layers is not None and int(num_layers) != len(layers):
+                raise ValueError(
+                    f"TransformerEncoder got {len(layers)} layers but "
+                    f"num_layers={num_layers}")
+            self.layers = LayerList(layers)
+            self.num_layers = len(layers)
+        else:
+            self.layers = LayerList(
+                [encoder_layer if i == 0 else _clone_layer(encoder_layer)
+                 for i in range(num_layers)])
+            self.num_layers = num_layers
         self.norm = norm
 
     def forward(self, src, src_mask=None, cache=None, cache_position=None,

@@ -47,6 +47,8 @@ __all__ = [
     "PackPlan", "rows_per_shard", "storage_table_rows", "storage_index",
     "pad_requests", "pack_by_owner", "all_to_all_gather", "all_to_all_set",
     "all_to_all_apply_rule", "a2a_wire_bytes",
+    "ExpertPlan", "moe_capacity", "expert_dispatch_plan",
+    "all_to_all_experts", "local_experts", "moe_a2a_wire_bytes",
 ]
 
 
@@ -270,6 +272,141 @@ def all_to_all_apply_rule(table, state: dict, ids, grads, *, opt: str,
     out = fn(ids, grads, table, *[state[k] for k in names])
     new_state = {k: out[2 + i] for i, k in enumerate(names)}
     return out[1], new_state, out[0]
+
+
+# ---------------------------------------------------------------------------
+# expert-parallel token routing (Mixture-of-Experts, ISSUE 14)
+#
+# The embedding movers above route *ids* to the shard that OWNS a table
+# row; MoE routes *token vectors* to the shard that owns an expert,
+# computes there, and routes the results back — the same static-cap
+# owner bucketing with owner = expert, ``rps = 1`` (each "row" of the
+# virtual table is one expert), and TWO all_to_alls per layer: tokens
+# expert-ward, results token-ward.  Buffers are ``[E, cap]`` slots per
+# source shard, so wire bytes scale with capacity, never with vocab or
+# d_model beyond the row width.
+# ---------------------------------------------------------------------------
+
+
+def moe_capacity(tokens_per_group: int, top_k: int, n_experts: int,
+                 capacity_factor: float) -> int:
+    """Static per-(source shard, expert) slot count: each of the ``G``
+    token groups (one per shard of the routing axis) may park at most
+    ``cap`` of its ``tokens * k`` assignments on any one expert; the
+    rest drop (residual passthrough).  ``capacity_factor`` 1.0 is the
+    exactly-balanced budget; 1.25 is the usual head-room."""
+    t = int(tokens_per_group) * int(top_k)
+    return max(1, -(-int(t * float(capacity_factor)) // int(n_experts)))
+
+
+class ExpertPlan(NamedTuple):
+    """Per-group static dispatch plan (pure function of the expert ids,
+    shared verbatim by the routed mover and the dense-dispatch
+    control so both drop the same assignments)."""
+
+    pos: jnp.ndarray      # [G, S] slot in the per-group [E*cap] buffer
+    counts: jnp.ndarray   # [G, E] pre-drop per-expert demand
+    dropped: jnp.ndarray  # [G] int32 assignments past capacity (dropped)
+
+
+def expert_dispatch_plan(expert_ids, *, n_experts: int,
+                         cap: int) -> ExpertPlan:
+    """Owner-bucket each group's assignment slice ``expert_ids [G, S]``
+    (entries in ``[0, E)``; sentinel ``< 0`` never consumes cap) into
+    per-group ``[E * cap]`` send buffers — :func:`pack_by_owner` with
+    owner = expert (``rps = 1``), vmapped over the group axis."""
+    eids = jnp.asarray(expert_ids, jnp.int32)
+    plan = jax.vmap(functools.partial(
+        pack_by_owner, n_shards=int(n_experts), rps=1, cap=int(cap)))(eids)
+    kept = jnp.sum((plan.pos >= 0).astype(jnp.int32), axis=1)
+    valid = jnp.sum((eids >= 0).astype(jnp.int32), axis=1)
+    return ExpertPlan(plan.pos, plan.counts, valid - kept)
+
+
+def _expert_body(x_loc, pos_loc, *w_loc, axis, n, n_experts, cap, expert_fn):
+    """Per-shard leg of the routed expert exchange: scatter my ``S``
+    token rows into the ``[E, cap]`` dispatch buffer, all_to_all so each
+    shard receives every group's slots for ITS experts, run the local
+    expert stack, all_to_all the results back, gather rows to token
+    order (dropped slots read zero)."""
+    E, eps = n_experts, n_experts // n
+    tail = x_loc.shape[1:]                                # feature dims (D,)
+    perm = (1, 0, 2) + tuple(range(3, 3 + len(tail)))
+    pos = pos_loc.reshape(-1)
+    buf = _scatter_to_slots(x_loc, pos, E * cap)          # [E*cap, D]
+    buf = buf.reshape((n, eps * cap) + tail)
+    recv = lax.all_to_all(buf, axis, 0, 0, tiled=True)    # [n, eps*cap, D]
+    rows = recv.reshape((n, eps, cap) + tail).transpose(perm)
+    rows = rows.reshape((eps, n * cap) + tail)            # [eps, n*cap, D]
+    out = expert_fn(rows, *w_loc)                         # [eps, n*cap, D]
+    out = out.reshape((eps, n, cap) + tail).transpose(perm)
+    out = out.reshape((n, eps * cap) + tail)
+    back = lax.all_to_all(out, axis, 0, 0, tiled=True)
+    flat = back.reshape((E * cap,) + tail)
+    got = flat[jnp.clip(pos, 0, E * cap - 1)]
+    return jnp.where((pos >= 0).reshape((-1,) + (1,) * (got.ndim - 1)),
+                     got, 0)
+
+
+def all_to_all_experts(x_dup, pos, expert_params: Sequence, expert_fn, *,
+                       mesh, axis: str, n_experts: int, cap: int):
+    """Routed expert application: move token rows to the shard owning
+    their expert, apply the expert stack there, move results back.
+
+    ``x_dup``: ``[G*S, D]`` token rows (one row per (token, top-k slot)
+    assignment; ``G`` = routing-axis size, each shard owns a contiguous
+    ``S`` slice).  ``pos``: ``[G, S]`` dispatch plan from
+    :func:`expert_dispatch_plan`.  ``expert_params``: stacked
+    ``[E, ...]`` arrays sharded ``P(axis, ...)`` — each shard holds its
+    ``E / n`` experts.  ``expert_fn(rows [e, m, D], *params_local)``
+    must be expert-row-independent (a stacked FFN).  Returns
+    ``[G*S, D]`` result rows aligned with ``x_dup`` (zeros at dropped
+    slots).  Exactly TWO all_to_alls.
+    """
+    n = int(dict(mesh.shape)[axis])
+    if n_experts % n:
+        raise ValueError(
+            f"expert routing over axis {axis!r} (size {n}) needs the "
+            f"expert count ({n_experts}) divisible by the axis size")
+    body = functools.partial(_expert_body, axis=axis, n=n,
+                             n_experts=int(n_experts), cap=int(cap),
+                             expert_fn=expert_fn)
+    specs = tuple(P(axis, *([None] * (w.ndim - 1))) for w in expert_params)
+    fn = _shard_map(body, mesh=mesh,
+                    in_specs=(P(axis), P(axis, None)) + specs,
+                    out_specs=P(axis), check_rep=False)
+    return fn(x_dup, pos, *expert_params)
+
+
+def local_experts(x_dup, pos, expert_params: Sequence, expert_fn, *,
+                  n_experts: int, cap: int):
+    """Meshless (single-shard) expert application — the same scatter →
+    stacked-expert compute → gather as :func:`all_to_all_experts` with
+    the two all_to_alls elided (``G = n = 1``); the decode/serving path
+    when no expert axis is live."""
+    E = int(n_experts)
+    p = jnp.asarray(pos).reshape(-1)
+    buf = _scatter_to_slots(x_dup, p, E * cap)
+    rows = buf.reshape((E, cap) + buf.shape[1:])
+    out = expert_fn(rows, *expert_params)
+    flat = out.reshape((E * cap,) + out.shape[2:])
+    got = flat[jnp.clip(p, 0, E * cap - 1)]
+    return jnp.where((p >= 0).reshape((-1,) + (1,) * (got.ndim - 1)),
+                     got, 0)
+
+
+def moe_a2a_wire_bytes(n_experts: int, cap: int, dim: int, n_shards: int,
+                       itemsize: int = 4) -> int:
+    """Ring-model per-device interconnect bytes of one MoE layer's two
+    all_to_alls (tokens out + results back): each leg moves the
+    ``[E, cap, D]`` dispatch buffer, of which ``(n-1)/n`` crosses the
+    wire.  Wire bytes scale with capacity (∝ tokens routed), never with
+    vocab."""
+    n = int(n_shards)
+    if n <= 1:
+        return 0
+    leg = int(n_experts) * int(cap) * int(dim) * int(itemsize)
+    return int(2 * leg * (n - 1) / n)
 
 
 def a2a_wire_bytes(n_requests: int, dim: int, n_shards: int, cap: int,

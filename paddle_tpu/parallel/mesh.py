@@ -27,8 +27,12 @@ DP_AXIS = "dp"
 MP_AXIS = "mp"
 PP_AXIS = "pp"
 SP_AXIS = "sp"
+# expert parallel (Mixture-of-Experts): expert stacks shard over it,
+# token rows all_to_all across it (nn/layer/moe.py; absent in the
+# reference — its MoE seat is the parameter-server sparse table)
+EP_AXIS = "ep"
 
-_AXIS_ORDER = (DP_AXIS, PP_AXIS, MP_AXIS, SP_AXIS)
+_AXIS_ORDER = (DP_AXIS, EP_AXIS, PP_AXIS, MP_AXIS, SP_AXIS)
 
 _current_mesh: Optional[Mesh] = None
 

@@ -1,2 +1,3 @@
 from .bert import BertModel, BertConfig, BertForPretraining  # noqa: F401
 from .gpt import GPTModel, GPTConfig  # noqa: F401
+from .gpt import GPTMoEModel, GPTMoEConfig  # noqa: F401

@@ -7,6 +7,7 @@ need a decoder-only config). Shares TP annotation logic with bert.py.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 from ... import nn
 from ...nn import functional as F
@@ -124,6 +125,106 @@ class GPTModel(nn.Layer):
                          lengths=lengths, max_new_tokens=max_new_tokens,
                          beam_size=beam_size, eos_token_id=eos_token_id,
                          **kw)
+
+
+@dataclasses.dataclass
+class GPTMoEConfig(GPTConfig):
+    """GPT config with every ``moe_every``-th block's FFN replaced by an
+    expert-parallel MoE layer (nn.layer.moe).  ``moe_top_k`` /
+    ``moe_capacity_factor`` default to the FLAGS_moe_* values and are
+    RESOLVED at model construction, so the config (and therefore the
+    persistent executable cache's program-identity key, which hashes
+    these fields) always names the concrete gating program."""
+
+    moe_num_experts: int = 8
+    moe_top_k: Optional[int] = None           # None -> FLAGS_moe_top_k
+    moe_capacity_factor: Optional[float] = None  # None -> FLAGS value
+    moe_every: int = 2                        # every other block is MoE
+    moe_aux_weight: float = 1e-2
+
+    @classmethod
+    def tiny(cls, vocab_size=128, hidden_size=32, layers=2, heads=2,
+             seq=64, experts=8, top_k=None, capacity_factor=None,
+             moe_every=2):
+        return cls(vocab_size=vocab_size, hidden_size=hidden_size,
+                   num_layers=layers, num_heads=heads,
+                   intermediate_size=hidden_size * 4,
+                   max_position_embeddings=seq, moe_num_experts=experts,
+                   moe_top_k=top_k, moe_capacity_factor=capacity_factor,
+                   moe_every=moe_every)
+
+
+class GPTMoEModel(GPTModel):
+    """Decoder-only LM with alternating dense / Mixture-of-Experts
+    blocks: block ``i`` is MoE when ``(i + 1) % moe_every == 0`` (so
+    ``moe_every=2`` replaces every other block's FFN), expert FFNs are
+    stacked ``[E, ...]`` parameters sharded over the expert-parallel
+    axis, and the training loss carries the gates' load-balance aux
+    term.  Shares GPTModel's incremental-decoding contract verbatim —
+    ``generate()``, flash-decode and the serving decode grid run
+    unchanged (the MoE dispatch is just more ops inside the same two
+    executables).
+
+    ``dispatch="dense"`` builds the bit-match control: identical
+    parameters and gating, GShard dense-dispatch instead of the
+    all-to-all movers.
+    """
+
+    def __init__(self, cfg: GPTMoEConfig = None, *, mesh=None,
+                 dispatch: str = "routed", annotate: bool = True,
+                 **kwargs):
+        from ... import nn
+        from ...nn.layer.moe import (MoEEncoderLayer, moe_capacity_factor,
+                                     moe_top_k)
+        nn.Layer.__init__(self)
+        cfg = cfg or GPTMoEConfig(**kwargs)
+        # resolve flag-defaulted gating knobs NOW: the config is the
+        # program identity (persistent cache) and must be concrete
+        if cfg.moe_top_k is None:
+            cfg.moe_top_k = moe_top_k()
+        if cfg.moe_capacity_factor is None:
+            cfg.moe_capacity_factor = moe_capacity_factor()
+        if cfg.moe_every < 1:
+            raise ValueError(f"moe_every must be >= 1, got {cfg.moe_every}")
+        self.config = cfg
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings,
+                                cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        blocks = []
+        for i in range(cfg.num_layers):
+            if (i + 1) % cfg.moe_every == 0:
+                blocks.append(MoEEncoderLayer(
+                    cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
+                    cfg.moe_num_experts, dropout=cfg.dropout,
+                    activation="gelu", normalize_before=True,
+                    top_k=cfg.moe_top_k,
+                    capacity_factor=cfg.moe_capacity_factor, mesh=mesh,
+                    dispatch=dispatch, annotate=annotate))
+            else:
+                blocks.append(nn.TransformerEncoderLayer(
+                    cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
+                    dropout=cfg.dropout, activation="gelu",
+                    normalize_before=True))
+        self.encoder = nn.TransformerEncoder(
+            blocks, norm=nn.LayerNorm(cfg.hidden_size))
+
+    def forward(self, input_ids, labels=None):
+        from ...nn.layer.moe import total_aux_loss
+        from ...framework.tensor import Tensor
+        out = GPTModel.forward(self, input_ids, labels)
+        if labels is None:
+            return out
+        # loss plumbing: the gates train through the aux term riding the
+        # same scalar TrainStep already consumes
+        aux = total_aux_loss(self)
+        return out + Tensor(aux) * self.config.moe_aux_weight
+
+    def moe_aux_loss(self):
+        """Summed load-balance loss of the last forward (traced inside
+        a step; concrete after an eager call — the bench probe)."""
+        from ...nn.layer.moe import total_aux_loss
+        return total_aux_loss(self)
 
 
 def apply_tensor_parallel(model: GPTModel):

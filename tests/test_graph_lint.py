@@ -434,6 +434,46 @@ def test_sharding_coverage_names_autoshard_rule():
     assert not _only(clean, "sharding-coverage")
 
 
+def test_sharding_coverage_names_expert_rule():
+    """ISSUE 14: an unannotated stacked expert parameter on a mesh with
+    a live ep axis is named by the ``moe-expert-ffn`` rule ('FLAGS_
+    autoshard=apply closes this'); the gate matches the replication rule
+    (a DECIDED layout, no finding); an annotated expert stack is
+    silent."""
+    mgr = default_pass_manager()
+    mesh = make_mesh({"dp": 4, "ep": 2})
+    params = {
+        "encoder.layers.1.moe.experts.w1": np.zeros((8, 16, 32),
+                                                    np.float32),
+        "encoder.layers.1.moe.experts.b1": np.zeros((8, 32), np.float32),
+        "encoder.layers.1.moe.gate.weight": np.zeros((16, 8), np.float32),
+    }
+    seeded = mgr.run(LintContext(
+        site="s", kind="train_step", mesh=mesh, params=params,
+        partition_specs={n: None for n in params}))
+    found = {d.extra.get("param"): d
+             for d in _only(seeded, "sharding-coverage")}
+    # gate.weight is covered by moe-gate-replicated (pure P()): silent
+    assert set(found) == {"encoder.layers.1.moe.experts.w1",
+                          "encoder.layers.1.moe.experts.b1"}
+    w1 = found["encoder.layers.1.moe.experts.w1"]
+    assert "moe-expert-ffn" in w1.message
+    assert "P('ep', None, None)" in w1.message
+    assert "FLAGS_autoshard=apply closes this" in w1.message
+    assert w1.extra.get("autoshard_rule") == "moe-expert-ffn"
+    assert found["encoder.layers.1.moe.experts.b1"].extra.get(
+        "autoshard_rule") == "moe-expert-bias"
+    # clean fixture: the annotated expert stack stays silent
+    from jax.sharding import PartitionSpec as P
+    clean = mgr.run(LintContext(
+        site="s", kind="train_step", mesh=mesh,
+        params={"encoder.layers.1.moe.experts.w1":
+                np.zeros((8, 16, 32), np.float32)},
+        partition_specs={"encoder.layers.1.moe.experts.w1":
+                         P("ep", None, None)}))
+    assert not _only(clean, "sharding-coverage")
+
+
 # ---------------------------------------------------------------------------
 # dy2static AST lint
 # ---------------------------------------------------------------------------

@@ -38,7 +38,8 @@ def test_cli_zoo_wide_mesh_strict_clean():
     payload = json.loads(p.stdout)
     assert payload["n_errors"] == 0
     models = {r["model"] for r in payload["results"]}
-    assert models == {"lenet", "resnet_block", "bert", "gpt", "wide_deep"}
+    assert models == {"lenet", "resnet_block", "bert", "gpt", "gpt_moe",
+                      "wide_deep"}
     for r in payload["results"]:
         assert r["ok"] and r["mesh"] == "dp8xmp2"
         assert r["stats"]["collective_count"] > 0
@@ -47,9 +48,13 @@ def test_cli_zoo_wide_mesh_strict_clean():
     # pattern the transformer zoo never produces (ISSUE 10)
     wd = [r for r in payload["results"] if r["model"] == "wide_deep"][0]
     assert wd["stats"]["collectives"]["all-to-all"]["count"] > 0
+    # the expert-parallel MoE step routes tokens over EP=DP here
+    # (ISSUE 14): the token all_to_alls must survive compilation
+    moe = [r for r in payload["results"] if r["model"] == "gpt_moe"][0]
+    assert moe["stats"]["collectives"]["all-to-all"]["count"] >= 4
     # every lowering ledgered once with its mesh label (the
     # zero-steady-state-recompile convention extended to audit runs)
-    assert len(payload["ledger"]) == 5
+    assert len(payload["ledger"]) == 6
     assert all("arg:mesh" in e["key"] and "dp8xmp2" in e["key"]
                for e in payload["ledger"])
 
@@ -67,6 +72,26 @@ def test_cli_seeded_wide_mesh_exits_nonzero():
     # de-sharded annotated embedding table (ISSUE 10 annotation contract)
     assert "seeded_desharded_zero" in p.stdout
     assert "seeded_desharded_table" in p.stdout
+
+
+@pytest.mark.slow
+def test_cli_gpt_moe_expert_mesh_strict_clean():
+    """ISSUE 14: the gpt_moe builder over a dedicated 16-wide expert-
+    parallel mesh (named-axis spec 'ep16') audits clean in strict mode
+    and the compiled step carries the token-routing all_to_alls."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "hlo_audit.py"),
+         "--model", "gpt_moe", "--mesh", "ep16", "--strict", "--json"],
+        capture_output=True, text=True, timeout=840, env=_wide_env(16),
+        cwd=REPO)
+    assert p.returncode == 0, p.stderr[-3000:]
+    payload = json.loads(p.stdout)
+    assert payload["n_errors"] == 0
+    (r,) = payload["results"]
+    assert r["model"] == "gpt_moe" and r["ok"] and r["mesh"] == "ep16"
+    assert r["stats"]["collectives"]["all-to-all"]["count"] == 4
+    assert len(payload["ledger"]) == 1
+    assert "arg:mesh" in payload["ledger"][0]["key"]
 
 
 @pytest.mark.slow
@@ -90,10 +115,19 @@ def test_dryrun_phase5_worker_width16():
     cfgs = {r["config"] for r in rows}
     assert cfgs == {"bert_z1_dp_mp_sp", "bert_z3_dp_mp",
                     "resnet18_z1_dp", "bert_pp2_dp",
-                    "gpt_autoshard_dp_mp", "wide_deep_sharded_emb"}
+                    "gpt_autoshard_dp_mp", "wide_deep_sharded_emb",
+                    "gpt_moe_ep"}
     # the sharded-embedding config must carry all-to-all traffic
     wd = [r for r in rows if r["config"] == "wide_deep_sharded_emb"][0]
     assert wd["collectives"]["all-to-all"]["count"] > 0
+    # the MoE config: 4 all_to_alls in the train step (2 fwd + 2
+    # transposed bwd for its one MoE block), and the forward-census
+    # exactly-two-per-block assert printed its line (ISSUE 14)
+    moe = [r for r in rows if r["config"] == "gpt_moe_ep"][0]
+    assert moe["mesh"] == "ep16"
+    assert moe["collectives"]["all-to-all"]["count"] == 4
+    assert "gpt_moe_ep forward census 2 all-to-alls == 2 x 1 MoE " \
+        "block(s)" in p.stdout
     for r in rows:
         assert r["n_devices"] == 16
         for field in ("collective_count", "collective_wire_bytes",

@@ -19,6 +19,7 @@ NAMESPACES = [
     ("paddle_tpu.nn", None),
     ("paddle_tpu.nn.functional", None),
     ("paddle_tpu.nn.initializer", None),
+    ("paddle_tpu.nn.layer.moe", None),
     ("paddle_tpu.tensor", None),
     ("paddle_tpu.optimizer", None),
     ("paddle_tpu.optimizer.lr", None),

@@ -31,7 +31,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-ZOO_MODELS = ("lenet", "resnet_block", "bert", "gpt", "wide_deep")
+ZOO_MODELS = ("lenet", "resnet_block", "bert", "gpt", "gpt_moe",
+              "wide_deep")
 
 # --autoshard: shard models through the FLAGS_autoshard=apply TrainStep
 # hook (analysis.autoshard rules engine) instead of the models' explicit
@@ -40,15 +41,34 @@ _AUTOSHARD = [False]
 
 
 def parse_mesh(spec: str):
-    """'16x2' -> {dp:16, mp:2}; '8x2x2' -> {dp:8, mp:2, sp:2}."""
-    parts = [int(p) for p in spec.lower().replace("*", "x").split("x") if p]
-    if not parts or any(p < 1 for p in parts) or len(parts) > 3:
-        raise ValueError(f"bad mesh spec {spec!r}: want DP[xMP[xSP]]")
-    axes = {"dp": parts[0]}
-    if len(parts) > 1:
-        axes["mp"] = parts[1]
-    if len(parts) > 2:
-        axes["sp"] = parts[2]
+    """'16x2' -> {dp:16, mp:2}; '8x2x2' -> {dp:8, mp:2, sp:2}.  Parts
+    may also NAME their axis ('ep8', 'dp4xep2' — the expert-parallel
+    meshes MoE shards over); bare numbers keep the positional
+    DP[xMP[xSP]] meaning."""
+    import re
+    raw = [p for p in spec.lower().replace("*", "x").split("x") if p]
+    named = {}
+    positional = []
+    for p in raw:
+        m = re.fullmatch(r"([a-z]+)(\d+)", p)
+        if m:
+            named[m.group(1)] = int(m.group(2))
+        else:
+            positional.append(int(p))
+    if len(positional) > 3 or any(p < 1 for p in positional) \
+            or any(v < 1 for v in named.values()):
+        raise ValueError(
+            f"bad mesh spec {spec!r}: want DP[xMP[xSP]] or named parts "
+            f"like ep8")
+    axes = {}
+    for name, v in zip(("dp", "mp", "sp"), positional):
+        axes[name] = v
+    for name, v in named.items():
+        if name in axes:
+            raise ValueError(f"axis {name!r} given twice in {spec!r}")
+        axes[name] = v
+    if not axes:
+        raise ValueError(f"empty mesh spec {spec!r}")
     return axes
 
 
@@ -168,6 +188,42 @@ def _build_gpt(mesh, zero):
     return step, (ids, ids.copy()), None
 
 
+def _build_gpt_moe(mesh, zero):
+    """Expert-parallel GPT-MoE step (ISSUE 14): every other block's FFN
+    is a MoELayer whose stacked expert parameters shard over the mesh's
+    expert axis ('ep' when the mesh has one, else EP=DP over 'dp'), and
+    whose token dispatch is two lax.all_to_alls inside shard_map — the
+    fourth collective pattern (token-routing-heavy, wire bytes ∝
+    capacity, never vocab).  The batch is FIXED across widths (strong
+    scaling), so per-device routed bytes stay ~flat as the mesh widens.
+    Expert count adapts to the axis (2 experts per shard) so every
+    width keeps whole experts per device."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.parallel import TrainStep
+    from paddle_tpu.text.models.gpt import GPTMoEConfig, GPTMoEModel
+    axes = dict(mesh.shape)
+    axis = "ep" if axes.get("ep", 1) > 1 else "dp"
+    n = max(1, axes.get(axis, 1))
+    # the rules table reads FLAGS_moe_axis, so proposals and the
+    # layer's own annotations must name the same axis
+    set_flags({"FLAGS_moe_axis": axis})
+    paddle.seed(0)
+    cfg = GPTMoEConfig.tiny(vocab_size=64, hidden_size=16, layers=2,
+                            heads=2, seq=32, experts=max(4, 2 * n),
+                            top_k=2, capacity_factor=1.25)
+    cfg.dropout = 0.0
+    model = GPTMoEModel(cfg, mesh=mesh, dispatch="routed",
+                        annotate=not _AUTOSHARD[0])
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3)
+    step = TrainStep(model, opt, mesh=mesh, zero=zero)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (8, 32))   # 256 tokens, fixed
+    return step, (ids, ids.copy()), None
+
+
 def _build_wide_deep(mesh, zero):
     """Sharded-embedding CTR step (ISSUE 10): the deep-leg table is
     row-partitioned over dp via ShardedEmbedding, so the compiled step
@@ -194,7 +250,7 @@ def _build_wide_deep(mesh, zero):
 
 BUILDERS = {"lenet": _build_lenet, "resnet_block": _build_resnet_block,
             "bert": _build_bert, "gpt": _build_gpt,
-            "wide_deep": _build_wide_deep}
+            "gpt_moe": _build_gpt_moe, "wide_deep": _build_wide_deep}
 
 
 def audit_model(name: str, axes: dict, zero: int, suppress=()):
