@@ -46,17 +46,32 @@ class HeartbeatReporter:
 
 
 class HeartbeatMonitor:
-    """Launcher side: which ranks are stale?"""
+    """Launcher side: which ranks are stale?
 
-    def __init__(self, store, world_size, stale_after=15.0):
+    ``ranks`` generalizes the watched set beyond ``range(world_size)``
+    for members that join/leave dynamically — the serving router watches
+    replica ids (``replica:<id>``) through the same store keys the
+    elastic launcher watches integer ranks through.
+    """
+
+    def __init__(self, store, world_size=0, stale_after=15.0, ranks=None):
         self._store = store
         self._world = world_size
         self._stale_after = stale_after
+        self._ranks = None if ranks is None else list(ranks)
+
+    def set_ranks(self, ranks):
+        """Replace the watched id set (replica join/evict)."""
+        self._ranks = list(ranks)
+
+    def watched(self):
+        return list(self._ranks) if self._ranks is not None \
+            else list(range(self._world))
 
     def stale_ranks(self):
         now = time.time()
         out = []
-        for r in range(self._world):
+        for r in self.watched():
             v = self._store.get(f"__hb/{r}", wait=False)
             if v is None or now - float(v) > self._stale_after:
                 out.append(r)

@@ -68,7 +68,17 @@ class UnimplementedError(EnforceNotMet):
 
 
 class UnavailableError(EnforceNotMet):
+    """Transient refusal (backpressure, closed queue).  A rejection that
+    expects the caller to come back carries a machine-readable
+    ``retry_after_s`` hint so a router can back off the one saturated
+    replica instead of treating the rejection as a death and evicting
+    it; ``None`` means "no estimate" (e.g. the resource is gone)."""
+
     code = "UNAVAILABLE"
+
+    def __init__(self, msg, op=None, retry_after_s=None):
+        super().__init__(msg, op)
+        self.retry_after_s = retry_after_s
 
 
 class FatalError(EnforceNotMet):

@@ -418,6 +418,48 @@ define_flag("serving_metrics_window", 2048,
             "serving latency reservoir behind the p50/p99 gauges.",
             validator=lambda v: int(v) >= 16)
 
+# ---- Multi-host cluster serving (paddle_tpu.serving.cluster) ----------------
+define_flag("serving_replicas",
+            int(os.environ.get("PADDLE_TPU_SERVING_REPLICAS", "1")),
+            "Replica count the cluster serving CLI (tools/serve.py "
+            "--router) spawns behind the front-end router. 1 (the "
+            "default) is the single-process path — no router, no RPC, "
+            "one branch.",
+            validator=lambda v: int(v) >= 1)
+define_flag("serving_role",
+            os.environ.get("PADDLE_TPU_SERVING_ROLE", "both").lower()
+            or "both",
+            "Worker-pool role of this serving process: 'both' (default; "
+            "full prefill+decode grids, single-process behavior "
+            "unchanged), 'prefill' (compute-bound pool: warm-up compiles "
+            "ONLY the prefill grid, serves prefill_handoff), or 'decode' "
+            "(memory-bound pool: ONLY the decode grid, serves "
+            "decode_from_handoff). Disaggregation is these two pools "
+            "plus the explicit KV-cache handoff between them.",
+            validator=lambda v: str(v).lower() in ("both", "prefill",
+                                                   "decode"))
+define_flag("router_heartbeat_s",
+            float(os.environ.get("PADDLE_TPU_ROUTER_HEARTBEAT_S", "2.0")),
+            "Interval at which a cluster replica publishes liveness to "
+            "the rendezvous TCPStore (the elastic HeartbeatReporter "
+            "reused for serving).",
+            validator=lambda v: float(v) > 0)
+define_flag("router_stale_after_s",
+            float(os.environ.get("PADDLE_TPU_ROUTER_STALE_AFTER_S",
+                                 "10.0")),
+            "Router-side eviction threshold: a replica whose heartbeat "
+            "is older than this is evicted from dispatch (its in-flight "
+            "requests re-dispatch to surviving replicas; nothing is "
+            "lost past the submit ack).",
+            validator=lambda v: float(v) > 0)
+define_flag("router_retry_backoff_s",
+            float(os.environ.get("PADDLE_TPU_ROUTER_RETRY_BACKOFF_S",
+                                 "0.05")),
+            "Default per-replica backoff after an UNAVAILABLE "
+            "backpressure rejection that carried no retry-after hint "
+            "(rejections normally carry the queue's own estimate).",
+            validator=lambda v: float(v) >= 0)
+
 # ---- Request tracing + typed metrics plane (paddle_tpu.profiler) ------------
 define_flag("trace",
             os.environ.get("PADDLE_TPU_TRACE", "off").lower() or "off",

@@ -33,9 +33,10 @@ from .decode import DecodeModelSpec, DecodeRequest  # noqa: F401
 from .scheduler import Batch, Request, RequestQueue, pack_fifo  # noqa: F401
 from .server import (ModelSpec, Server, ServingConfig,  # noqa: F401
                      create_server, export_for_serving)
+from . import cluster  # noqa: F401  (multi-host disaggregated serving)
 
 __all__ = [
     "BucketLadder", "pad_to_bucket", "Batch", "Request", "RequestQueue",
     "pack_fifo", "ModelSpec", "Server", "ServingConfig", "create_server",
-    "export_for_serving", "DecodeModelSpec", "DecodeRequest",
+    "export_for_serving", "DecodeModelSpec", "DecodeRequest", "cluster",
 ]

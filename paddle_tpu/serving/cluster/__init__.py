@@ -1,0 +1,57 @@
+"""paddle_tpu.serving.cluster — multi-host disaggregated serving.
+
+The reference's inference seat is multi-instance ``AnalysisPredictor``
+clones fronted by the ``distributed/`` RPC layer; this package is that
+shape done TPU-style, turning four single-process subsystems (serving
+engine PR 6, decode runtime PR 7, elastic runtime PR 3, persistent
+executable cache PR 13) into one serving *system*:
+
+  * **KV-cache handoff** (handoff.py): prefill is compute-bound, decode
+    is memory-bound, and the continuous batcher already compiles them as
+    separate executables — so they can run on separate worker pools with
+    an explicit cache handoff.  Device-to-device when both pools share
+    one process/mesh; serialized ring-cache plane transfer (bf16 or
+    int8 + scale planes, PR 12) across processes, carrying
+    ``cache_position`` / per-row validity-window metadata so decode
+    resumes bit-identically.
+  * **replicas** (replica.py + rpc.py): one serving process = a
+    ``serving.Server`` behind a tiny length-prefixed RPC endpoint,
+    registered through the fleet TCPStore rendezvous and heartbeating
+    like an elastic training rank.  ``FLAGS_serving_role`` restricts a
+    replica to the prefill or decode pool (warm-up then compiles only
+    that pool's grid).
+  * **sharded replicas** (sharding.py): a replica serving a model too
+    big for one chip AOT-compiles its bucket grids over a TP/dp mesh
+    with params sharded by the same autoshard rules tables training
+    uses, HLO-audited at admission, loaded through the persistent
+    executable cache so replica N boots O(load).
+  * **front-end router** (router.py): health-checked least-loaded
+    dispatch over N replicas, heartbeat-evicting dead ones and
+    re-dispatching their in-flight work (no request is lost past the
+    submit ack), honoring per-replica retry-after backpressure hints,
+    and propagating ``trace_id`` across the process boundary.
+
+CLI: ``tools/serve.py --router --replicas N``.  Flags:
+``FLAGS_serving_replicas`` / ``FLAGS_serving_role`` /
+``FLAGS_router_heartbeat_s`` / ``FLAGS_router_stale_after_s`` /
+``FLAGS_router_retry_backoff_s`` (all off-by-default; a bare Server
+never takes the cluster branch).
+"""
+from __future__ import annotations
+
+from .handoff import (KVHandoff, deserialize_kv,  # noqa: F401
+                      serialize_kv)
+from .replica import Replica, replica_main  # noqa: F401
+from .router import (LocalReplica, RemoteReplica,  # noqa: F401
+                     ReplicaHandle, Router)
+from .rpc import RpcClient, RpcError, RpcServer  # noqa: F401
+from .sharding import (ShardedModelSpec, serving_shard_specs,  # noqa: F401
+                       shard_admission_audit)
+
+__all__ = [
+    "KVHandoff", "serialize_kv", "deserialize_kv",
+    "RpcServer", "RpcClient", "RpcError",
+    "Replica", "replica_main",
+    "Router", "ReplicaHandle", "LocalReplica", "RemoteReplica",
+    "ShardedModelSpec", "serving_shard_specs", "shard_admission_audit",
+]

@@ -1,0 +1,190 @@
+"""KV-cache handoff between prefill and decode worker pools.
+
+A prefill pool's product is exactly the decode pool's working set: the
+per-layer ring-cache planes (bf16 ``(k, v)`` or int8 ``(k, v, k_scale,
+v_scale)`` — PR 12's quantized planes ride unchanged), the next-token
+logits, and the validity-window metadata (``cache_position`` to resume
+at, per-row ``start`` offsets).  Two transports:
+
+  * **device** — both pools share one process/mesh: the handoff is the
+    device arrays themselves, zero copies (the decode executable's input
+    shardings match the prefill executable's pinned output shardings,
+    sharding.py's KV layout rule);
+  * **wire** — pools in different processes: planes serialize to one
+    contiguous blob (JSON header + raw row-major plane bytes, exact to
+    the bit — bf16/int8 planes move as their raw 2/1-byte payloads, so a
+    deserialized cache is byte-identical and decode resumes
+    bit-identically to the in-process continuation).
+
+Both transports feed the ``kv_handoff_bytes_total`` counter and the
+``kv_handoff_seconds`` histogram (docs/METRICS.md inventory).
+"""
+from __future__ import annotations
+
+import io
+import json
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Any, List, Tuple
+
+import numpy as np
+
+from ...framework.enforce import InvalidArgumentError
+from ...profiler.metrics import default_registry as _registry
+
+__all__ = ["KVHandoff", "serialize_kv", "deserialize_kv"]
+
+_MAGIC = b"PTKV1\n"
+
+_HANDOFF_BYTES = _registry().counter(
+    "kv_handoff_bytes_total",
+    "KV-cache plane bytes moved between the prefill and decode pools, "
+    "by transport (wire = serialized cross-process blob, device = "
+    "same-mesh device-to-device pass-through).",
+    labels=("transport",))
+_HANDOFF_SECONDS = _registry().histogram(
+    "kv_handoff_seconds",
+    "Wall time of one prefill→decode KV-cache handoff leg (serialize, "
+    "deserialize, or device pass-through).",
+    buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype by name, resolving the ml_dtypes extension types
+    (bfloat16, float8_*) numpy itself does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _host(plane) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(plane))
+
+
+@dataclass
+class KVHandoff:
+    """One prefill result in flight to a decode pool.
+
+    ``cache`` is the Generator-shape plane list (one tuple of 2 or 4
+    planes per attention layer), ``logits0`` the [B, V] next-token
+    logits, ``start`` the per-row first-valid-cache-column offsets and
+    ``pos`` the traced ``cache_position`` decode resumes at (== the
+    prompt bucket the prefill ran).  ``meta`` carries request context
+    across the process boundary (model name, max_new, eos, trace_id).
+    """
+
+    cache: List[Tuple[Any, ...]]
+    logits0: Any
+    start: Any
+    pos: int
+    meta: dict = field(default_factory=dict)
+
+    def nbytes(self) -> int:
+        n = sum(_nbytes(p) for c in self.cache for p in c)
+        return n + (_nbytes(self.logits0) if self.logits0 is not None else 0)
+
+    # -- transports ----------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        return serialize_kv(self)
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "KVHandoff":
+        return deserialize_kv(blob)
+
+    def device(self, kv_sharding_of=None) -> "KVHandoff":
+        """Place every plane on device (the decode pool's ingest step).
+        ``kv_sharding_of(shape)`` maps a plane's shape to its sharding
+        (sharded replicas pin the KV layout; None = default device).
+        Metered as the device transport leg."""
+        import jax
+        t0 = time.monotonic()
+        put = (jax.device_put if kv_sharding_of is None
+               else lambda p: jax.device_put(p, kv_sharding_of(np.shape(p))))
+        cache = [tuple(put(np.asarray(p)) for p in c) for c in self.cache]
+        logits = None if self.logits0 is None \
+            else jax.device_put(np.asarray(self.logits0))
+        out = KVHandoff(cache=cache, logits0=logits,
+                        start=np.asarray(self.start, np.int32),
+                        pos=self.pos, meta=dict(self.meta))
+        _HANDOFF_BYTES.labels("device").inc(out.nbytes())
+        _HANDOFF_SECONDS.observe(time.monotonic() - t0)
+        return out
+
+
+def _nbytes(plane) -> int:
+    sz = int(np.prod(np.shape(plane))) if np.ndim(plane) else 1
+    return sz * _np_dtype(str(np.asarray(plane).dtype
+                              if isinstance(plane, np.ndarray)
+                              else plane.dtype)).itemsize
+
+
+def serialize_kv(h: KVHandoff) -> bytes:
+    """One contiguous blob: magic + length-prefixed JSON header + raw
+    row-major plane bytes (layer-major, plane order, then logits).  The
+    payload is the planes' exact storage bytes — bf16 rows, int8 rows
+    and f32 scale planes alike — so the roundtrip is bit-exact."""
+    t0 = time.monotonic()
+    planes_meta, buf = [], io.BytesIO()
+    for c in h.cache:
+        layer_meta = []
+        for p in c:
+            a = _host(p)
+            layer_meta.append({"shape": list(a.shape),
+                               "dtype": str(a.dtype)})
+            buf.write(a.tobytes())
+        planes_meta.append(layer_meta)
+    logits_meta = None
+    if h.logits0 is not None:
+        a = _host(h.logits0)
+        logits_meta = {"shape": list(a.shape), "dtype": str(a.dtype)}
+        buf.write(a.tobytes())
+    start = np.asarray(h.start, np.int32).reshape(-1)
+    header = json.dumps({
+        "version": 1, "planes": planes_meta, "logits": logits_meta,
+        "start": start.tolist(), "pos": int(h.pos),
+        "meta": dict(h.meta),
+    }).encode()
+    out = _MAGIC + struct.pack("<I", len(header)) + header + buf.getvalue()
+    _HANDOFF_BYTES.labels("wire").inc(len(out))
+    _HANDOFF_SECONDS.observe(time.monotonic() - t0)
+    return out
+
+
+def deserialize_kv(blob: bytes) -> KVHandoff:
+    """Inverse of :func:`serialize_kv`; returns host-resident planes
+    (np.frombuffer views reshaped — call :meth:`KVHandoff.device` to
+    ingest onto the decode pool's mesh)."""
+    t0 = time.monotonic()
+    if not blob.startswith(_MAGIC):
+        raise InvalidArgumentError(
+            "not a KV handoff blob (bad magic); refusing to parse")
+    off = len(_MAGIC)
+    (hlen,) = struct.unpack_from("<I", blob, off)
+    off += 4
+    header = json.loads(blob[off:off + hlen].decode())
+    if header.get("version") != 1:
+        raise InvalidArgumentError(
+            f"KV handoff version {header.get('version')!r} is not "
+            "supported (this build speaks version 1)")
+    off += hlen
+
+    def take(meta):
+        nonlocal off
+        dt = _np_dtype(meta["dtype"])
+        shape = tuple(meta["shape"])
+        n = int(np.prod(shape)) * dt.itemsize if shape else dt.itemsize
+        a = np.frombuffer(blob, dtype=dt, count=max(1, int(np.prod(shape))),
+                          offset=off).reshape(shape)
+        off += n
+        return a
+
+    cache = [tuple(take(m) for m in layer) for layer in header["planes"]]
+    logits = take(header["logits"]) if header["logits"] is not None else None
+    h = KVHandoff(cache=cache, logits0=logits,
+                  start=np.asarray(header["start"], np.int32),
+                  pos=int(header["pos"]), meta=dict(header.get("meta") or {}))
+    _HANDOFF_SECONDS.observe(time.monotonic() - t0)
+    return h

@@ -1,0 +1,157 @@
+"""One cluster serving replica: a Server behind an RPC endpoint.
+
+The fleet-inference seat of the reference (multi-instance
+``AnalysisPredictor`` behind the ``distributed/`` RPC layer): a replica
+process owns ONE ``serving.Server`` (warm-up, continuous batching,
+steady-state discipline all unchanged), exposes it over the cluster RPC
+dialect, announces itself through the TCPStore rendezvous the elastic
+runtime already uses (``__serving_replica/<n>`` entries under a
+monotonic ``add`` counter — the same idempotent-join discipline as
+barrier generations) and heartbeats like an elastic rank
+(``__hb/replica:<id>``), so the router's join/evict loop is literally
+PR 3's HeartbeatMonitor pointed at replica ids.
+
+``FLAGS_serving_role`` decides the worker pool: a ``prefill`` replica
+serves ``prefill`` RPCs only (and warmed only the prefill grid), a
+``decode`` replica serves ``decode_from``; ``both`` serves everything.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ...framework import flags as _flags
+from .rpc import RpcServer, decode_arrays, encode_arrays
+
+__all__ = ["Replica", "replica_main", "REPLICA_PREFIX"]
+
+REPLICA_PREFIX = "__serving_replica"
+
+
+class Replica:
+    """Wrap a (started or startable) Server as one cluster replica."""
+
+    def __init__(self, server, replica_id: Optional[str] = None,
+                 store=None, host: str = "127.0.0.1", port: int = 0):
+        self.server = server
+        self.id = str(replica_id if replica_id is not None
+                      else f"r{os.getpid()}")
+        self.role = str(_flags.flag("serving_role")).lower()
+        self.host = host
+        self.port = int(port)
+        self._store = store
+        self._rpc: Optional[RpcServer] = None
+        self._reporter = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "Replica":
+        if not self.server._started:
+            self.server.start()
+        self._rpc = RpcServer(self._handlers(), port=self.port)
+        self.port = self._rpc.port
+        if self._store is not None:
+            self._register()
+        return self
+
+    def _register(self):
+        """Rendezvous: reserve a slot on the monotonic counter, publish
+        the endpoint under it, start heartbeating.  A restarted replica
+        re-registers under a fresh slot with the SAME id — the router
+        treats that as a rejoin (update the endpoint), not a twin."""
+        from ...distributed.fleet.elastic import HeartbeatReporter
+        entry = {"id": self.id, "host": self.host, "port": self.port,
+                 "role": self.role, "pid": os.getpid(),
+                 "models": self.server.models()}
+        idx = self._store.add(f"{REPLICA_PREFIX}/seq", 1)
+        self._store.set(f"{REPLICA_PREFIX}/{idx}",
+                        json.dumps(entry).encode())
+        self._reporter = HeartbeatReporter(
+            self._store, f"replica:{self.id}",
+            interval=float(_flags.flag("router_heartbeat_s"))).start()
+
+    def stop(self, drain: bool = True):
+        if self._reporter is not None:
+            self._reporter.stop()
+        if self._rpc is not None:
+            self._rpc.close()
+        self.server.stop(drain=drain)
+
+    # -- RPC surface ---------------------------------------------------------
+    def _handlers(self) -> Dict[str, Any]:
+        return {"ping": self._op_ping, "health": self._op_health,
+                "stats": self._op_stats, "infer": self._op_infer,
+                "decode": self._op_decode, "prefill": self._op_prefill,
+                "decode_from": self._op_decode_from}
+
+    def _op_ping(self, meta, parts):
+        return {"id": self.id, "role": self.role}, []
+
+    def _op_health(self, meta, parts):
+        q = self.server._queue
+        steady = sum(rt.counters.get("steady_compiles", 0)
+                     for rt in self.server._models.values())
+        return {"id": self.id, "role": self.role,
+                "models": self.server.models(),
+                "queue_depth": q.depth() if q is not None else 0,
+                "steady_compiles": steady, "pid": os.getpid()}, []
+
+    def _op_stats(self, meta, parts):
+        return {"stats": self.server.stats(meta.get("model"))}, []
+
+    def _op_infer(self, meta, parts):
+        inputs = decode_arrays(meta["arrays"], parts)
+        fut = self.server.submit(meta["model"], inputs,
+                                 timeout=meta.get("timeout", 5.0),
+                                 trace_id=meta.get("trace_id"))
+        outs = fut.result(timeout=meta.get("result_timeout", 60.0))
+        ometa, oparts = encode_arrays([np.asarray(o) for o in outs])
+        return {"arrays": ometa}, oparts
+
+    def _op_decode(self, meta, parts):
+        prompts = decode_arrays(meta["prompts"], parts)
+        fut = self.server.submit_decode(
+            meta["model"], prompts, max_new_tokens=meta.get("max_new"),
+            timeout=meta.get("timeout", 5.0),
+            trace_id=meta.get("trace_id"))
+        outs = fut.result(timeout=meta.get("result_timeout", 60.0))
+        ometa, oparts = encode_arrays([np.asarray(outs[0])])
+        return {"arrays": ometa}, oparts
+
+    def _op_prefill(self, meta, parts):
+        prompts = decode_arrays(meta["prompts"], parts)
+        h = self.server.prefill_handoff(meta["model"], prompts,
+                                        meta.get("max_new"))
+        if meta.get("trace_id"):
+            h.meta["trace_id"] = meta["trace_id"]
+        blob = h.to_bytes()
+        return {"rows": int(h.meta.get("rows", 0)),
+                "max_new": int(h.meta.get("max_new", 0)),
+                "nbytes": len(blob)}, [blob]
+
+    def _op_decode_from(self, meta, parts):
+        toks = self.server.decode_from_handoff(meta["model"], parts[0])
+        ometa, oparts = encode_arrays([np.asarray(toks)])
+        return {"arrays": ometa}, oparts
+
+
+def replica_main(server, replica_id: Optional[str] = None,
+                 store_host: Optional[str] = None,
+                 store_port: Optional[int] = None, port: int = 0,
+                 block: bool = True) -> Replica:
+    """Process entry for a spawned replica (tools/serve.py --router
+    children): build the store client, start the replica, and (by
+    default) serve until the process is killed — the router's heartbeat
+    eviction is the shutdown path, exactly like an elastic rank."""
+    store = None
+    if store_host is not None:
+        from ...distributed.fleet.base.tcp_store import TCPStore
+        store = TCPStore(store_host, int(store_port), is_master=False)
+    rep = Replica(server, replica_id=replica_id, store=store,
+                  port=port).start()
+    if block:
+        threading.Event().wait()
+    return rep

@@ -1,0 +1,521 @@
+"""Front-end router: health-checked least-loaded dispatch over replicas.
+
+The cluster's single client-facing door.  Dispatch policy, in order:
+
+  * only **live** replicas whose pool role can serve the op (full dense
+    / decode traffic needs ``both``; disaggregated decode routes
+    ``prefill`` to the prefill pool and ``decode_from`` to the decode
+    pool, carrying the serialized KV handoff between them);
+  * a replica that rejected with UNAVAILABLE backpressure is **backed
+    off** until its machine-readable ``retry_after_s`` hint expires —
+    backpressure is a full queue, not a death, so the router waits
+    instead of evicting;
+  * among candidates, **least-loaded** wins: fewest router-side
+    in-flight requests, then the smallest last-reported queue depth
+    (the per-replica gauge the health poll refreshes);
+  * a transport error mid-request marks the replica suspect (out of
+    rotation until the heartbeat verdict) and the request **retries on
+    another replica** — requests are pure (dense inference / greedy
+    decode), so re-dispatch is safe and nothing is lost past the
+    submit ack;
+  * the watch thread discovers joins through the TCPStore rendezvous
+    and **evicts** replicas whose heartbeat went stale (PR 3's
+    HeartbeatMonitor pointed at ``replica:<id>`` ranks).
+
+Every request gets a root ``route`` span whose ``trace_id`` crosses the
+process boundary in the RPC meta — the replica's ``request`` span joins
+the same trace, so one waterfall covers submit → dispatch → replica →
+reply.  Typed metrics: ``router_replicas_live``,
+``router_dispatch_total{replica}``, ``router_evictions_total``,
+``router_replica_queue_depth{replica}`` (docs/METRICS.md).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...framework import flags as _flags
+from ...framework.enforce import UnavailableError
+from ...profiler import tracing as _tracing
+from ...profiler.metrics import default_registry as _registry
+from .replica import REPLICA_PREFIX
+from .rpc import RpcClient, RpcError, decode_arrays, encode_arrays
+
+__all__ = ["Router", "ReplicaHandle", "LocalReplica", "RemoteReplica"]
+
+_REPLICAS_LIVE = _registry().gauge(
+    "router_replicas_live",
+    "Replicas currently in the router's dispatch rotation (joined and "
+    "heartbeat-fresh).")
+_DISPATCH_TOTAL = _registry().counter(
+    "router_dispatch_total",
+    "Requests the router dispatched, by replica (retries on another "
+    "replica count again — the metric is dispatch attempts that "
+    "reached a replica).",
+    labels=("replica",))
+_EVICTIONS_TOTAL = _registry().counter(
+    "router_evictions_total",
+    "Replicas evicted from the dispatch rotation (stale heartbeat or "
+    "explicit evict); their in-flight requests re-dispatch to "
+    "survivors.")
+_REPLICA_QDEPTH = _registry().gauge(
+    "router_replica_queue_depth",
+    "Last health-reported serving-queue depth per replica — the "
+    "least-loaded dispatch signal beyond the router's own in-flight "
+    "counts.",
+    labels=("replica",))
+
+
+class ReplicaHandle:
+    """Router-side view of one replica: identity, pool role, liveness,
+    backoff state and load accounting.  Subclasses implement the ops."""
+
+    def __init__(self, replica_id: str, role: str = "both"):
+        self.id = str(replica_id)
+        self.role = str(role)
+        self.alive = True
+        self.backoff_until = 0.0         # monotonic; 0 = in rotation
+        self.inflight = 0
+        self.queue_depth = 0
+        self.dispatched = 0
+        self._lock = threading.Lock()
+
+    def serves(self, op: str) -> bool:
+        if op == "decode":               # full prefill+decode request
+            return self.role == "both"
+        if op == "prefill":
+            return self.role in ("both", "prefill")
+        if op == "decode_from":
+            return self.role in ("both", "decode")
+        return True                      # dense ops ignore the pool role
+
+    # subclass surface -------------------------------------------------------
+    def submit(self, model, inputs, trace_id=None, timeout=60.0):
+        raise NotImplementedError
+
+    def submit_decode(self, model, prompts, max_new=None, trace_id=None,
+                      timeout=60.0):
+        raise NotImplementedError
+
+    def prefill(self, model, prompts, max_new=None, trace_id=None,
+                timeout=60.0):
+        raise NotImplementedError
+
+    def decode_from(self, model, handoff, trace_id=None, timeout=60.0):
+        raise NotImplementedError
+
+    def health(self) -> dict:
+        raise NotImplementedError
+
+    def model_stats(self) -> dict:
+        """Per-model serving stats of the replica (Server.stats())."""
+        return {}
+
+    def close(self):
+        pass
+
+
+class LocalReplica(ReplicaHandle):
+    """An in-process Server as a replica (single-process clusters,
+    tests): the device KV-handoff path — no serialization between the
+    pools when they share the process."""
+
+    def __init__(self, server, replica_id: str, role: Optional[str] = None):
+        super().__init__(replica_id,
+                         role or str(_flags.flag("serving_role")).lower())
+        self.server = server
+
+    def submit(self, model, inputs, trace_id=None, timeout=60.0):
+        fut = self.server.submit(model, inputs, trace_id=trace_id)
+        return [np.asarray(o) for o in fut.result(timeout=timeout)]
+
+    def submit_decode(self, model, prompts, max_new=None, trace_id=None,
+                      timeout=60.0):
+        fut = self.server.submit_decode(model, prompts,
+                                        max_new_tokens=max_new,
+                                        trace_id=trace_id)
+        return np.asarray(fut.result(timeout=timeout)[0])
+
+    def prefill(self, model, prompts, max_new=None, trace_id=None,
+                timeout=60.0):
+        h = self.server.prefill_handoff(model, prompts, max_new)
+        if trace_id:
+            h.meta["trace_id"] = trace_id
+        return h                        # device transport (same process)
+
+    def decode_from(self, model, handoff, trace_id=None, timeout=60.0):
+        return np.asarray(self.server.decode_from_handoff(model, handoff))
+
+    def health(self) -> dict:
+        q = self.server._queue
+        return {"id": self.id, "role": self.role,
+                "queue_depth": q.depth() if q is not None else 0,
+                "models": self.server.models()}
+
+    def model_stats(self) -> dict:
+        return self.server.stats()
+
+
+class RemoteReplica(ReplicaHandle):
+    """A replica process reached over the cluster RPC; the KV handoff
+    crosses as its serialized wire blob."""
+
+    def __init__(self, replica_id: str, host: str, port: int,
+                 role: str = "both", timeout: float = 60.0):
+        super().__init__(replica_id, role)
+        self.host, self.port = host, int(port)
+        self._client = RpcClient(host, port, timeout=timeout)
+
+    def submit(self, model, inputs, trace_id=None, timeout=60.0):
+        ameta, parts = encode_arrays([np.asarray(a) for a in inputs])
+        meta, rparts = self._client.request(
+            "infer", {"model": model, "arrays": ameta,
+                      "trace_id": trace_id, "result_timeout": timeout},
+            parts, timeout=timeout)
+        return decode_arrays(meta["arrays"], rparts)
+
+    def submit_decode(self, model, prompts, max_new=None, trace_id=None,
+                      timeout=60.0):
+        pmeta, parts = encode_arrays([np.asarray(p) for p in prompts])
+        meta, rparts = self._client.request(
+            "decode", {"model": model, "prompts": pmeta,
+                       "max_new": max_new, "trace_id": trace_id,
+                       "result_timeout": timeout},
+            parts, timeout=timeout)
+        return decode_arrays(meta["arrays"], rparts)[0]
+
+    def prefill(self, model, prompts, max_new=None, trace_id=None,
+                timeout=60.0):
+        pmeta, parts = encode_arrays([np.asarray(p) for p in prompts])
+        _meta, rparts = self._client.request(
+            "prefill", {"model": model, "prompts": pmeta,
+                        "max_new": max_new, "trace_id": trace_id},
+            parts, timeout=timeout)
+        return rparts[0]                # the serialized handoff blob
+
+    def decode_from(self, model, handoff, trace_id=None, timeout=60.0):
+        if not isinstance(handoff, (bytes, bytearray, memoryview)):
+            handoff = handoff.to_bytes()
+        meta, rparts = self._client.request(
+            "decode_from", {"model": model, "trace_id": trace_id},
+            [bytes(handoff)], timeout=timeout)
+        return decode_arrays(meta["arrays"], rparts)[0]
+
+    def health(self) -> dict:
+        meta, _ = self._client.request("health", {}, timeout=5.0)
+        return meta
+
+    def model_stats(self) -> dict:
+        meta, _ = self._client.request("stats", {}, timeout=10.0)
+        return meta["stats"]
+
+    def close(self):
+        self._client.close()
+
+
+class Router:
+    """Health-checked least-loaded dispatch over N replica handles.
+
+    Construct with explicit handles (in-process clusters), a rendezvous
+    ``store`` to discover replicas as they join (spawned clusters), or
+    both.  ``close()`` stops the watch thread and the dispatch pool;
+    replica Servers are not owned and keep running.
+    """
+
+    def __init__(self, replicas: Tuple[ReplicaHandle, ...] = (),
+                 store=None, stale_after_s: Optional[float] = None,
+                 watch: bool = True, dispatch_workers: int = 8):
+        self._handles: Dict[str, ReplicaHandle] = {}
+        self._lock = threading.Lock()
+        self._store = store
+        self._seen_seq = 0
+        self._stale_after = float(
+            stale_after_s if stale_after_s is not None
+            else _flags.flag("router_stale_after_s"))
+        self._monitor = None
+        self._stop = threading.Event()
+        self._watcher = None
+        self._pool = ThreadPoolExecutor(max_workers=int(dispatch_workers),
+                                        thread_name_prefix="router")
+        for h in replicas:
+            self.add_replica(h)
+        if store is not None:
+            from ...distributed.fleet.elastic import HeartbeatMonitor
+            self._monitor = HeartbeatMonitor(
+                store, stale_after=self._stale_after, ranks=[])
+            self.poll()                  # pick up already-joined replicas
+            if watch:
+                self._watcher = threading.Thread(
+                    target=self._watch_loop, name="router-watch",
+                    daemon=True)
+                self._watcher.start()
+
+    # -- membership ----------------------------------------------------------
+    def add_replica(self, handle: ReplicaHandle) -> ReplicaHandle:
+        with self._lock:
+            old = self._handles.get(handle.id)
+            if old is not None and old is not handle:
+                old.alive = False
+                old.close()              # rejoin: endpoint superseded
+            self._handles[handle.id] = handle
+        _REPLICAS_LIVE.set(self.replicas_live())
+        return handle
+
+    def evict(self, replica_id: str, reason: str = "stale") -> bool:
+        """Remove a replica from rotation.  In-flight requests on it
+        will fail their transport op and re-dispatch to survivors."""
+        with self._lock:
+            h = self._handles.get(str(replica_id))
+            if h is None or not h.alive:
+                return False
+            h.alive = False
+        h.close()
+        _EVICTIONS_TOTAL.inc()
+        _REPLICAS_LIVE.set(self.replicas_live())
+        _tracing.event("router_evict", replica=str(replica_id),
+                       reason=reason)
+        return True
+
+    def handles(self) -> List[ReplicaHandle]:
+        with self._lock:
+            return list(self._handles.values())
+
+    def _alive(self) -> List[ReplicaHandle]:
+        return [h for h in self.handles() if h.alive]
+
+    def replicas_live(self) -> int:
+        return len(self._alive())
+
+    # -- discovery + heartbeat eviction --------------------------------------
+    def poll(self) -> None:
+        """One watch-loop iteration, callable directly (tests, or a
+        caller owning its own cadence): discover joins, refresh health,
+        evict stale heartbeats."""
+        if self._store is not None:
+            self._discover()
+            self._evict_stale()
+        self._refresh_health()
+
+    def _discover(self):
+        raw = self._store.get(f"{REPLICA_PREFIX}/seq", wait=False)
+        n = int(raw) if raw else 0
+        for i in range(self._seen_seq + 1, n + 1):
+            raw = self._store.get(f"{REPLICA_PREFIX}/{i}", wait=False)
+            if raw is None:
+                # reserved but not yet published: retry next poll
+                n = i - 1
+                break
+            info = json.loads(raw.decode())
+            self.add_replica(RemoteReplica(
+                info["id"], info["host"], info["port"],
+                role=info.get("role", "both")))
+        self._seen_seq = max(self._seen_seq, n)
+
+    def _evict_stale(self):
+        alive = self._alive()
+        self._monitor.set_ranks([f"replica:{h.id}" for h in alive])
+        for rank in self._monitor.stale_ranks():
+            self.evict(str(rank)[len("replica:"):], reason="stale")
+
+    def _refresh_health(self):
+        for h in self._alive():
+            try:
+                info = h.health()
+                h.queue_depth = int(info.get("queue_depth", 0))
+                _REPLICA_QDEPTH.labels(h.id).set(h.queue_depth)
+            except Exception:   # noqa: BLE001 — the heartbeat decides death
+                h.backoff_until = time.monotonic() + self._stale_after
+
+    def _watch_loop(self):
+        interval = float(_flags.flag("router_heartbeat_s"))
+        while not self._stop.is_set():
+            try:
+                self.poll()
+            except Exception:   # noqa: BLE001 — watching must not die
+                pass
+            self._stop.wait(interval)
+
+    # -- dispatch core -------------------------------------------------------
+    def _pick(self, op: str):
+        """(handle, wake_monotonic): the least-loaded live replica that
+        serves ``op`` and is not backed off; handle=None with a wake
+        time means every candidate is backing off; both None means no
+        live replica can ever serve the op."""
+        now = time.monotonic()
+        best, wake = None, None
+        for h in self._alive():
+            if not h.serves(op):
+                continue
+            if h.backoff_until > now:
+                wake = h.backoff_until if wake is None \
+                    else min(wake, h.backoff_until)
+                continue
+            key = (h.inflight, h.queue_depth, h.dispatched)
+            if best is None or key < (best.inflight, best.queue_depth,
+                                      best.dispatched):
+                best = h
+        return best, wake
+
+    def _dispatch(self, op: str, call, timeout: float, span=None):
+        """Retry loop: pick → call → (backoff | suspect | return)."""
+        deadline = time.monotonic() + timeout
+        last_err: Optional[Exception] = None
+        while True:
+            h, wake = self._pick(op)
+            if h is None:
+                now = time.monotonic()
+                if wake is None or now >= deadline:
+                    hint = None if wake is None else max(0.0, wake - now)
+                    raise last_err if isinstance(last_err,
+                                                 UnavailableError) else \
+                        UnavailableError(
+                            f"no live replica can serve {op!r} "
+                            f"({self.replicas_live()} live)",
+                            retry_after_s=hint)
+                time.sleep(min(wake - now, deadline - now))
+                continue
+            with h._lock:
+                h.inflight += 1
+                h.dispatched += 1
+            _DISPATCH_TOTAL.labels(h.id).inc()
+            t0 = time.monotonic()
+            try:
+                out = call(h)
+                if span is not None:
+                    _tracing.child(span, "dispatch", t0, time.monotonic(),
+                                   replica=h.id, op=op)
+                return out
+            except UnavailableError as e:
+                # backpressure: honor the replica's retry-after hint —
+                # back off THIS replica, try another
+                hint = getattr(e, "retry_after_s", None)
+                if hint is None:
+                    hint = float(_flags.flag("router_retry_backoff_s"))
+                h.backoff_until = time.monotonic() + float(hint)
+                if span is not None:
+                    _tracing.child(span, "backpressure", t0,
+                                   time.monotonic(), replica=h.id,
+                                   retry_after_s=float(hint))
+                last_err = e
+            except (ConnectionError, OSError, RpcError) as e:
+                # transport/replica fault: out of rotation until the
+                # heartbeat verdict; the request retries elsewhere
+                h.backoff_until = time.monotonic() + self._stale_after
+                if span is not None:
+                    _tracing.child(span, "redispatch", t0,
+                                   time.monotonic(), replica=h.id,
+                                   error=type(e).__name__)
+                last_err = e
+            finally:
+                with h._lock:
+                    h.inflight -= 1
+
+    # -- traffic -------------------------------------------------------------
+    def submit(self, model: str, inputs,
+               timeout: float = 60.0) -> Future:
+        """Dense inference through the cluster: returns a Future of the
+        per-output numpy arrays, exactly Server.submit's contract."""
+        return self._pool.submit(self._run_dense, model,
+                                 [np.asarray(a) for a in inputs], timeout)
+
+    def run(self, model: str, inputs, timeout: float = 60.0):
+        return self._run_dense(model, [np.asarray(a) for a in inputs],
+                               timeout)
+
+    def _run_dense(self, model, inputs, timeout):
+        tr = _tracing.start_span("route", model=model, kind="dense")
+        try:
+            out = self._dispatch(
+                "infer",
+                lambda h: h.submit(model, inputs,
+                                   trace_id=getattr(tr, "trace_id", None),
+                                   timeout=timeout),
+                timeout, span=tr)
+            _tracing.finish(tr)
+            return out
+        except Exception:
+            if tr is not None:
+                tr.set_attr(error=True)
+                _tracing.finish(tr)
+            raise
+
+    def submit_decode(self, model: str, prompts,
+                      max_new_tokens: Optional[int] = None,
+                      timeout: float = 60.0) -> Future:
+        """Decode through the cluster: full-decode replicas when the
+        pools are unified; prefill-pool → KV handoff → decode-pool when
+        disaggregated (mixed clusters prefer the disaggregated path
+        only when no 'both' replica is live)."""
+        return self._pool.submit(
+            self._run_decode, model,
+            [np.asarray(p) for p in prompts], max_new_tokens, timeout)
+
+    def run_decode(self, model: str, prompts,
+                   max_new_tokens: Optional[int] = None,
+                   timeout: float = 60.0):
+        return self._run_decode(model,
+                                [np.asarray(p) for p in prompts],
+                                max_new_tokens, timeout)
+
+    def _run_decode(self, model, prompts, max_new, timeout):
+        tr = _tracing.start_span("route", model=model, kind="decode")
+        tid = getattr(tr, "trace_id", None)
+        try:
+            if any(h.serves("decode") for h in self._alive()):
+                out = self._dispatch(
+                    "decode",
+                    lambda h: h.submit_decode(model, prompts,
+                                              max_new=max_new,
+                                              trace_id=tid,
+                                              timeout=timeout),
+                    timeout, span=tr)
+            else:
+                handoff = self._dispatch(
+                    "prefill",
+                    lambda h: h.prefill(model, prompts, max_new=max_new,
+                                        trace_id=tid, timeout=timeout),
+                    timeout, span=tr)
+                out = self._dispatch(
+                    "decode_from",
+                    lambda h: h.decode_from(model, handoff,
+                                            trace_id=tid,
+                                            timeout=timeout),
+                    timeout, span=tr)
+            _tracing.finish(tr)
+            return [np.asarray(out)]     # Server.submit_decode parity
+        except Exception:
+            if tr is not None:
+                tr.set_attr(error=True)
+                _tracing.finish(tr)
+            raise
+
+    # -- observability + lifecycle -------------------------------------------
+    def stats(self) -> dict:
+        out = {"replicas_live": self.replicas_live(), "replicas": {}}
+        for h in self.handles():
+            out["replicas"][h.id] = {
+                "alive": h.alive, "role": h.role,
+                "dispatched": h.dispatched, "inflight": h.inflight,
+                "queue_depth": h.queue_depth,
+                "backing_off": h.backoff_until > time.monotonic(),
+            }
+        return out
+
+    def close(self):
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=5)
+        self._pool.shutdown(wait=True)
+        for h in self.handles():
+            h.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,227 @@
+"""Length-prefixed request/reply RPC between the router and replicas.
+
+The fleet TCPStore (distributed/fleet/base/tcp_store.py) already proved
+the framing discipline — ``<I`` part counts + length-prefixed byte parts
+over one TCP stream — so the serving plane speaks the same dialect
+rather than inventing another: a request is ``[op, json_meta,
+binary_part...]``, a reply is ``[b"ok"|b"err", json_meta,
+binary_part...]``.  Numpy arrays ride as raw row-major bytes with their
+shape/dtype in the JSON meta (the KV handoff blob is itself one opaque
+binary part).
+
+The client never reuses a connection after a transport error (no
+mid-stream resync point, the TCPStore lesson) and surfaces server-side
+``err`` replies as :class:`RpcError` carrying the error code and the
+machine-readable ``retry_after_s`` backpressure hint the router's
+per-replica backoff honors.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...framework.enforce import UnavailableError
+
+__all__ = ["RpcServer", "RpcClient", "RpcError",
+           "encode_arrays", "decode_arrays"]
+
+
+def _send_msg(sock, *parts: bytes):
+    payload = struct.pack("<I", len(parts))
+    for p in parts:
+        payload += struct.pack("<I", len(p)) + p
+    sock.sendall(payload)
+
+
+def _recv_exact(sock, n):
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(1 << 20, n - got))
+        if not chunk:
+            raise ConnectionError("rpc connection closed")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    parts = []
+    for _ in range(n):
+        (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
+        parts.append(_recv_exact(sock, ln))
+    return parts
+
+
+def encode_arrays(arrays: Sequence[np.ndarray]
+                  ) -> Tuple[List[dict], List[bytes]]:
+    """Arrays -> ([{shape, dtype}, ...], [raw bytes, ...])."""
+    meta, parts = [], []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        meta.append({"shape": list(a.shape), "dtype": str(a.dtype)})
+        parts.append(a.tobytes())
+    return meta, parts
+
+
+def decode_arrays(meta: Sequence[dict], parts: Sequence[bytes]
+                  ) -> List[np.ndarray]:
+    from .handoff import _np_dtype
+    out = []
+    for m, raw in zip(meta, parts):
+        dt = _np_dtype(m["dtype"])
+        shape = tuple(m["shape"])
+        out.append(np.frombuffer(raw, dtype=dt,
+                                 count=max(1, int(np.prod(shape)))
+                                 ).reshape(shape))
+    return out
+
+
+class RpcError(RuntimeError):
+    """A replica-side failure, re-raised router-side with the replica's
+    error taxonomy code and (for UNAVAILABLE backpressure rejections)
+    the machine-readable retry-after hint."""
+
+    def __init__(self, code: str, message: str,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+        self.retry_after_s = retry_after_s
+
+
+class RpcServer:
+    """Thread-per-connection RPC endpoint over ``handlers``:
+    ``{op: fn(meta: dict, parts: List[bytes]) -> (meta, parts)}``.
+    Handler exceptions become ``err`` replies carrying the enforce
+    error-code taxonomy (and the UnavailableError retry-after hint);
+    the connection survives, matching the store server's discipline."""
+
+    def __init__(self, handlers: Dict[str, Callable], port: int = 0,
+                 host: str = "0.0.0.0"):
+        self._handlers = handlers
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self.port = self._srv.getsockname()[1]
+        self._srv.listen(64)
+        self._stop = False
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="cluster-rpc", daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                op, meta_raw, *parts = _recv_msg(conn)
+                try:
+                    fn = self._handlers.get(op.decode())
+                    if fn is None:
+                        raise KeyError(f"unknown rpc op {op.decode()!r}")
+                    rmeta, rparts = fn(json.loads(meta_raw.decode()), parts)
+                    _send_msg(conn, b"ok", json.dumps(rmeta).encode(),
+                              *rparts)
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as e:   # noqa: BLE001 — reply, don't die
+                    err = {"code": getattr(e, "code", type(e).__name__),
+                           "message": str(e)}
+                    hint = getattr(e, "retry_after_s", None)
+                    if hint is not None:
+                        err["retry_after_s"] = float(hint)
+                    _send_msg(conn, b"err", json.dumps(err).encode())
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class RpcClient:
+    """One replica connection: serialized request/reply with a lock (the
+    router opens one client per replica; concurrency comes from the
+    router's dispatch threads fanning out over replicas).  Any transport
+    error poisons the socket — the next call reconnects fresh."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.host, self.port = host, int(port)
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _ensure(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self._timeout)
+
+    def _drop(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def request(self, op: str, meta: Optional[dict] = None,
+                parts: Sequence[bytes] = (),
+                timeout: Optional[float] = None
+                ) -> Tuple[dict, List[bytes]]:
+        with self._lock:
+            try:
+                self._ensure()
+                if timeout is not None:
+                    self._sock.settimeout(timeout)
+                _send_msg(self._sock, op.encode(),
+                          json.dumps(meta or {}).encode(), *parts)
+                status, rmeta_raw, *rparts = _recv_msg(self._sock)
+                if timeout is not None:
+                    self._sock.settimeout(self._timeout)
+            except (ConnectionError, OSError):
+                self._drop()
+                raise
+        rmeta = json.loads(rmeta_raw.decode())
+        if status == b"err":
+            code = rmeta.get("code", "RPC")
+            exc = RpcError(code, rmeta.get("message", "?"),
+                           rmeta.get("retry_after_s"))
+            if code == UnavailableError.code:
+                # preserve the backpressure taxonomy across the wire so
+                # router-side policy matches the in-process behavior
+                ue = UnavailableError(rmeta.get("message", "?"))
+                ue.retry_after_s = rmeta.get("retry_after_s")
+                raise ue
+            raise exc
+        if status != b"ok":
+            self._drop()
+            raise ConnectionError("rpc protocol desync")
+        return rmeta, rparts
+
+    def close(self):
+        with self._lock:
+            self._drop()
+
+
+def encode_handoff_part(blob: bytes) -> List[bytes]:
+    """A KV handoff blob is already a self-describing binary frame — it
+    rides as one opaque part."""
+    return [blob]

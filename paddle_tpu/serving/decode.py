@@ -71,6 +71,12 @@ class DecodeModelSpec:
     eos_token_id: Optional[int] = None
     draft_layer: Any = None
     gamma: Optional[int] = None
+    # sharded replicas (serving/cluster/sharding.py): AOT-compile the
+    # grids SPMD over ``mesh`` with params sharded by the autoshard
+    # rules table (``rules`` = a PartitionRules / table name; None =
+    # the active table).  mesh=None is the single-device path.
+    mesh: Any = None
+    rules: Any = None
 
 
 @dataclass
@@ -109,6 +115,7 @@ class _DecodeRuntime:
         self.steps = int(spec.max_new_tokens)
         self.admitted = False
         self.gen = None
+        self.role = "both"              # resolved from the flag at load()
         self._warmed_prefill = set()        # {(B, P, C)}
         self._warmed_decode = set()         # {(B, C)}
         self.latency = LatencyWindow(
@@ -127,7 +134,26 @@ class _DecodeRuntime:
     # -- loading + warm-up ---------------------------------------------------
     def load(self):
         from ..text.generation import Generator
-        if self.spec.draft_layer is not None \
+        # pool role (FLAGS_serving_role): a prefill-pool replica warms
+        # and serves only the prefill grid, a decode-pool replica only
+        # the decode grid (full submit_decode traffic needs "both");
+        # resolved at load so one process = one role, like one mesh
+        self.role = str(_flags.flag("serving_role")).lower()
+        if self.spec.mesh is not None:
+            if self.spec.draft_layer is not None \
+                    and bool(_flags.flag("spec_decode")):
+                raise PreconditionNotMetError(
+                    f"decode model {self.name!r}: speculative decoding "
+                    "and a sharded mesh cannot combine (the draft runs "
+                    "per-replica unsharded) — drop one")
+            from .cluster.sharding import serving_shard_specs
+            specs = serving_shard_specs(self.spec.layer, self.spec.mesh,
+                                        self.spec.rules)
+            self.gen = Generator(self.spec.layer, site=self.site,
+                                 seq_buckets=self.spec.seq_buckets,
+                                 max_len=self.spec.max_len,
+                                 mesh=self.spec.mesh, param_specs=specs)
+        elif self.spec.draft_layer is not None \
                 and bool(_flags.flag("spec_decode")):
             from ..text.speculative import SpeculativeGenerator
             self.gen = SpeculativeGenerator(
@@ -190,33 +216,81 @@ class _DecodeRuntime:
                 + "\n".join("  " + str(d) for d in errors))
 
     def warmup(self):
-        """AOT-compile the full (batch-bucket × prefill-bucket) prefill
-        set and the (batch-bucket × cache-bucket) decode set, then run
-        each pair once on zeros so dispatch paths are warm too.  Every
-        compile lands in the ledger at this runtime's site — the
-        steady-state mark the server snapshots right after."""
+        """AOT-compile the (batch-bucket × prefill-bucket) prefill set
+        and/or the (batch-bucket × cache-bucket) decode set — the pool
+        role decides which (a prefill-pool replica never compiles the
+        decode grid and vice versa; "both" compiles everything) — then
+        run each warmed phase once on zeros so dispatch paths are warm
+        too.  Every compile lands in the ledger at this runtime's site —
+        the steady-state mark the server snapshots right after.  Under
+        ``spec.mesh`` the grids compile SPMD and each executable is
+        HLO-audited at admission (cluster/sharding.py)."""
         import jax
         eos = self.spec.eos_token_id
+        warm_prefill = self.role in ("both", "prefill")
+        warm_decode = self.role in ("both", "decode")
         for B in self.ladder:
             linted = set()
             for P, C in self._plan:
-                if P not in linted:
-                    self.lint_gate(B, P, C)
-                    linted.add(P)
-                self.gen.prefill_exec(B, P, C)
-                self._warmed_prefill.add((B, P, C))
-                if (B, C) not in self._warmed_decode:
-                    self.gen.decode_exec(B, C, self.steps, 1, eos)
+                if warm_prefill:
+                    if P not in linted:
+                        self.lint_gate(B, P, C)
+                        linted.add(P)
+                    ex = self.gen.prefill_exec(B, P, C)
+                    self._audit_gate(ex, B, P)
+                    self._warmed_prefill.add((B, P, C))
+                if warm_decode and (B, C) not in self._warmed_decode:
+                    ex = self.gen.decode_exec(B, C, self.steps, 1, eos)
+                    self._audit_gate(ex, B, None)
                     self._warmed_decode.add((B, C))
             # one zeros round-trip per batch bucket: warm dispatch/runtime
+            # for exactly the phases this pool owns
             P0, C0 = self._plan[0]
             ids = np.zeros((B, P0), np.int32)
             start = np.full((B,), P0 - 1, np.int32)
-            cache, logits0 = self.gen.prefill(ids, start, C0)
-            toks = self.gen.decode(cache, logits0, start, P0, self.steps,
-                                   1, eos)
-            jax.block_until_ready(toks)
+            if warm_prefill:
+                cache, logits0 = self.gen.prefill(ids, start, C0)
+                if warm_decode:
+                    toks = self.gen.decode(cache, logits0, start, P0,
+                                           self.steps, 1, eos)
+                    jax.block_until_ready(toks)
+                else:
+                    jax.block_until_ready(logits0)
+            else:
+                cache = self._zero_cache(B, C0)
+                logits0 = np.zeros((B, self.gen._vocab_size()),
+                                   np.float32)
+                toks = self.gen.decode(cache, logits0, start, P0,
+                                       self.steps, 1, eos)
+                jax.block_until_ready(toks)
         self.admitted = True
+
+    def _audit_gate(self, compiled, B, P):
+        """Admission HLO audit of one warmed grid executable (sharded
+        replicas only; FLAGS_hlo_audit-gated — off-path = one branch)."""
+        if self.spec.mesh is None:
+            return
+        from .cluster.sharding import shard_admission_audit
+        shard_admission_audit(
+            compiled, site=self.site, mesh=self.spec.mesh,
+            param_specs=self.gen._param_specs,
+            mesh_label=self.gen._mesh_label())
+
+    def _zero_cache(self, B, C):
+        """An all-zeros ring cache at the warmed layout — the decode-only
+        pool's warm-dispatch stand-in for a prefill it will never run."""
+        import jax
+        from .cluster.handoff import _np_dtype
+        shapes = jax.eval_shape(lambda: self.gen._init_cache_raw(B, C))
+        out = []
+        for c in shapes:
+            planes = []
+            for p in c:
+                z = np.zeros(tuple(p.shape), _np_dtype(str(p.dtype)))
+                planes.append(jax.device_put(
+                    z, self.gen.kv_plane_sharding(tuple(p.shape))))
+            out.append(tuple(planes))
+        return out
 
     # -- traffic -------------------------------------------------------------
     def validate(self, prompts, max_new):
@@ -327,6 +401,86 @@ class _DecodeRuntime:
             self._warmed_prefill.add((B, P, C))
             self._warmed_decode.add((B, C))
         return out
+
+    # -- disaggregated pools: explicit prefill → handoff → decode ------------
+    def _steady_guard(self, warmed, key, what):
+        if key in warmed:
+            return False
+        if bool(_flags.flag("serving_strict")):
+            raise PreconditionNotMetError(
+                f"decode model {self.name!r}: {what} {key} has no "
+                "warm-up executable (FLAGS_serving_strict=True refuses "
+                "steady-state compiles — extend the ladders and re-warm)")
+        stat_add("serving_steady_compiles")
+        self.bump(steady_compiles=1)
+        return True
+
+    def prefill_handoff(self, prompts, max_new_tokens=None):
+        """Run ONLY the prefill phase over ``prompts`` and return the
+        :class:`~.cluster.handoff.KVHandoff` a decode pool resumes from:
+        device-resident ring planes (bf16 or int8+scales), next-token
+        logits, per-row validity offsets and the cache_position.  The
+        prefill-pool entry point (roles "both"/"prefill")."""
+        if self.role == "decode":
+            raise PreconditionNotMetError(
+                f"decode model {self.name!r}: this replica is in the "
+                "decode pool (FLAGS_serving_role=decode) — prefill "
+                "belongs to the prefill pool")
+        from .cluster.handoff import KVHandoff
+        arrs, mn = self.validate(list(prompts), max_new_tokens)
+        rows = len(arrs)
+        B = self.ladder.bucket_for(rows)
+        padded = arrs + [np.zeros((1,), np.int32)] * (B - rows)
+        P = self.gen.prefill_bucket(max(p.size for p in padded))
+        C = self.gen.cache_bucket(P, self.steps)
+        missed = self._steady_guard(self._warmed_prefill, (B, P, C),
+                                    "prefill grid point")
+        ids, start = self.gen.pack_prompts(padded, P)
+        t0 = time.monotonic()
+        cache, logits0 = self.gen.prefill(ids, start, C)
+        h = KVHandoff(cache=cache, logits0=logits0,
+                      start=np.asarray(start, np.int32), pos=P,
+                      meta={"model": self.name, "rows": rows,
+                            "max_new": mn, "batch": B,
+                            "prompt_bucket": P, "cache_bucket": C,
+                            "prefill_s": round(time.monotonic() - t0, 6)})
+        if missed:
+            self._warmed_prefill.add((B, P, C))
+        return h
+
+    def decode_from_handoff(self, handoff):
+        """Resume a decode from a prefill pool's handoff: ingest the
+        planes (device pass-through when already resident, device_put at
+        the pinned KV layout when they arrived serialized), then run the
+        scanned decode executable from the carried ``cache_position`` /
+        validity window.  Returns generated ids [rows, max_new] — bit-
+        identical to the same prompts run through the in-process
+        ``generate()`` (the acceptance oracle).  The decode-pool entry
+        point (roles "both"/"decode")."""
+        if self.role == "prefill":
+            raise PreconditionNotMetError(
+                f"decode model {self.name!r}: this replica is in the "
+                "prefill pool (FLAGS_serving_role=prefill) — decode "
+                "belongs to the decode pool")
+        cache = handoff.cache
+        if not cache:
+            raise InvalidArgumentError("empty KV handoff (no planes)")
+        if isinstance(cache[0][0], np.ndarray):
+            handoff = handoff.device(self.gen.kv_plane_sharding)
+            cache = handoff.cache
+        B = int(np.shape(handoff.logits0)[0])
+        C = int(np.shape(cache[0][0])[2])
+        missed = self._steady_guard(self._warmed_decode, (B, C),
+                                    "decode grid point")
+        toks = self.gen.decode(cache, handoff.logits0, handoff.start,
+                               int(handoff.pos), self.steps, 1,
+                               self.spec.eos_token_id)
+        out = np.asarray(toks)
+        if missed:
+            self._warmed_decode.add((B, C))
+        rows = int(handoff.meta.get("rows", B))
+        mn = int(handoff.meta.get("max_new", self.steps))
+        return out[:rows, :mn]
 
     def publish(self):
         self.latency.publish(f"serving_{self.name}")

@@ -102,6 +102,22 @@ class RequestQueue:
         self._pending: "OrderedDict[str, deque]" = OrderedDict()
         self._depth = 0
         self._closed = False
+        # drain-rate EWMA (requests/s popped by the batcher): the basis
+        # of the machine-readable retry-after hint a backpressure
+        # rejection carries — "one slot frees in about 1/rate seconds"
+        self._drain_ewma = 0.0
+        self._last_pop_mono: Optional[float] = None
+
+    def suggest_retry_after(self) -> float:
+        """Estimated seconds until a queue slot frees, from the observed
+        drain rate (clamped to [10 ms, 5 s]; 100 ms before any batch has
+        drained).  Callers attach this to UnavailableError rejections so
+        a router backs off THIS replica instead of evicting it."""
+        with self._cond:
+            rate = self._drain_ewma
+        if rate <= 0:
+            return 0.1
+        return min(5.0, max(0.01, 1.0 / rate))
 
     # -- producer ------------------------------------------------------------
     def put(self, req: Request, timeout: Optional[float] = None) -> None:
@@ -111,11 +127,18 @@ class RequestQueue:
                 remaining = None if deadline is None \
                     else deadline - time.perf_counter()
                 if remaining is not None and remaining <= 0:
+                    rate = self._drain_ewma
+                    hint = 0.1 if rate <= 0 \
+                        else min(5.0, max(0.01, 1.0 / rate))
                     raise UnavailableError(
                         f"serving queue full ({self._capacity} pending); "
-                        "backpressure timeout expired")
+                        "backpressure timeout expired "
+                        f"(retry after ~{hint:.3f}s)",
+                        retry_after_s=hint)
                 self._cond.wait(remaining)
             if self._closed:
+                # no hint: a closed queue is not coming back — callers
+                # should fail over, not retry here
                 raise UnavailableError("serving queue is closed")
             self._pending.setdefault(req.model, deque()).append(req)
             self._depth += 1
@@ -161,6 +184,13 @@ class RequestQueue:
             t_pack0 = time.monotonic()
             taken, rows = pack_fifo(dq, limit)
             self._depth -= len(taken)
+            if taken and self._last_pop_mono is not None:
+                inst = len(taken) / max(1e-6,
+                                        t_pack0 - self._last_pop_mono)
+                self._drain_ewma = inst if self._drain_ewma <= 0 \
+                    else 0.8 * self._drain_ewma + 0.2 * inst
+            if taken:
+                self._last_pop_mono = t_pack0
             stat_set("serving_queue_depth", self._depth)
             self._cond.notify_all()
         bucket = bucket_of(model, rows)

@@ -679,7 +679,11 @@ class Server:
             raise PreconditionNotMetError(
                 "register() after start(): the warm-up contract admits "
                 "no un-warmed model — build a new Server")
-        if isinstance(spec_or_name, ModelSpec):
+        if isinstance(spec_or_name, ModelSpec) \
+                or hasattr(spec_or_name, "make_runtime"):
+            # ModelSpec, or any spec that builds its own runtime (the
+            # cluster ShardedModelSpec seat) — duck-typed so server.py
+            # never imports the cluster package
             spec = spec_or_name
         else:
             if path is None:
@@ -731,8 +735,12 @@ class Server:
         if not self._specs:
             raise PreconditionNotMetError("no models registered")
         for spec in self._specs:
-            rt = _DecodeRuntime(spec) if isinstance(spec, DecodeModelSpec) \
-                else _ModelRuntime(spec)
+            if hasattr(spec, "make_runtime"):
+                rt = spec.make_runtime()
+            elif isinstance(spec, DecodeModelSpec):
+                rt = _DecodeRuntime(spec)
+            else:
+                rt = _ModelRuntime(spec)
             rt.load()
             rt.warmup()
             rt.rate.reset()              # QPS clock starts with traffic
@@ -814,12 +822,33 @@ class Server:
                 f"{self.models()})")
         return rt
 
-    def submit(self, model: str, inputs, timeout: Optional[float] = 5.0
-               ) -> Future:
+    def _put(self, rt, req):
+        """Enqueue with honest rejection accounting: a backpressure
+        rejection (UnavailableError, carrying the queue's machine-
+        readable retry-after hint) closes the request's trace span and
+        counts an error before propagating — the router reads the hint
+        and backs off this replica instead of evicting it."""
+        try:
+            self._queue.put(req, timeout=req._put_timeout)
+        except UnavailableError as e:
+            if req.trace is not None:
+                req.trace.set_attr(error="UnavailableError",
+                                   retry_after_s=getattr(
+                                       e, "retry_after_s", None))
+                _tracing.finish(req.trace)
+            rt.bump(errors=1)
+            stat_add("serving_errors_total")
+            raise
+
+    def submit(self, model: str, inputs, timeout: Optional[float] = 5.0,
+               trace_id: Optional[str] = None) -> Future:
         """Enqueue one request of ``rows`` examples (rows = leading dim);
         returns a Future resolving to per-output numpy arrays with
         exactly ``rows`` rows (padding never leaks).  Blocks up to
-        ``timeout`` under backpressure, then raises UnavailableError."""
+        ``timeout`` under backpressure, then raises UnavailableError
+        carrying the queue's retry-after hint.  ``trace_id`` joins this
+        request to a caller-owned trace (the router's cross-process
+        propagation seat)."""
         if not self._started or self._stopped:
             raise PreconditionNotMetError(
                 "Server is not serving (start() it / already stopped)")
@@ -852,10 +881,12 @@ class Server:
         rt.ladder.bucket_for(rows)           # raises OutOfRange early
         req = Request(model=model, inputs=tuple(arrs), rows=rows,
                       trace=_tracing.start_span(
-                          "request", model=model, rows=rows, kind="dense"))
+                          "request", trace_id=trace_id, model=model,
+                          rows=rows, kind="dense"))
         rt.bump(requests=1)
         stat_add("serving_requests_total")
-        self._queue.put(req, timeout=timeout)
+        req._put_timeout = timeout
+        self._put(rt, req)
         return req.future
 
     def run(self, model: str, inputs, timeout: Optional[float] = 60.0):
@@ -864,7 +895,8 @@ class Server:
 
     def submit_decode(self, model: str, prompts,
                       max_new_tokens: Optional[int] = None,
-                      timeout: Optional[float] = 5.0) -> Future:
+                      timeout: Optional[float] = 5.0,
+                      trace_id: Optional[str] = None) -> Future:
         """Enqueue one decode request: ``prompts`` is a list of 1-D int
         token arrays (variable lengths — they left-pad to the prompt
         bucket at execution).  Resolves to ``[ids]`` where ids is an
@@ -878,16 +910,24 @@ class Server:
         if getattr(rt, "kind", None) != "decode":
             raise InvalidArgumentError(
                 f"model {model!r} is not a decode model: use submit()")
+        if getattr(rt, "role", "both") != "both":
+            raise PreconditionNotMetError(
+                f"model {model!r}: this replica serves the "
+                f"{rt.role!r} pool only (FLAGS_serving_role) — full "
+                "decode requests need role 'both', or route "
+                "prefill_handoff → decode_from_handoff across the pools")
         arrs, max_new = rt.validate(list(prompts), max_new_tokens)
         rt.ladder.bucket_for(len(arrs))      # raises OutOfRange early
         req = DecodeRequest(model=model, prompts=arrs, rows=len(arrs),
                             max_new=max_new,
                             trace=_tracing.start_span(
-                                "request", model=model, rows=len(arrs),
-                                kind="decode", max_new=max_new))
+                                "request", trace_id=trace_id, model=model,
+                                rows=len(arrs), kind="decode",
+                                max_new=max_new))
         rt.bump(requests=1)
         stat_add("serving_requests_total")
-        self._queue.put(req, timeout=timeout)
+        req._put_timeout = timeout
+        self._put(rt, req)
         return req.future
 
     def run_decode(self, model: str, prompts,
@@ -896,6 +936,39 @@ class Server:
         """Synchronous convenience: submit_decode + wait."""
         return self.submit_decode(model, prompts, max_new_tokens) \
             .result(timeout=timeout)
+
+    # -- disaggregated pools (serving/cluster) -------------------------------
+    def _decode_runtime(self, model: str):
+        rt = self._runtime(model)
+        if getattr(rt, "kind", None) != "decode":
+            raise InvalidArgumentError(
+                f"model {model!r} is not a decode model — KV handoff is "
+                "a prefill/decode-pool operation")
+        return rt
+
+    def prefill_handoff(self, model: str, prompts,
+                        max_new_tokens: Optional[int] = None):
+        """Prefill-pool entry: run ONLY the prefill phase and return the
+        KVHandoff (device planes + logits + validity metadata) a decode
+        pool resumes from — serialize with ``.to_bytes()`` to cross a
+        process boundary."""
+        if not self._started or self._stopped:
+            raise PreconditionNotMetError(
+                "Server is not serving (start() it / already stopped)")
+        return self._decode_runtime(model).prefill_handoff(
+            prompts, max_new_tokens)
+
+    def decode_from_handoff(self, model: str, handoff):
+        """Decode-pool entry: resume generation from a prefill pool's
+        handoff (a KVHandoff, or its serialized bytes); returns ids
+        [rows, max_new] bit-identical to the in-process generate()."""
+        if not self._started or self._stopped:
+            raise PreconditionNotMetError(
+                "Server is not serving (start() it / already stopped)")
+        if isinstance(handoff, (bytes, bytearray, memoryview)):
+            from .cluster.handoff import deserialize_kv
+            handoff = deserialize_kv(bytes(handoff))
+        return self._decode_runtime(model).decode_from_handoff(handoff)
 
     # -- observability -------------------------------------------------------
     def compile_events_since_warmup(self) -> List[dict]:

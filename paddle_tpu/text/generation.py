@@ -96,15 +96,28 @@ class Generator:
 
     def __init__(self, layer, site: Optional[str] = None,
                  seq_buckets: Optional[Sequence[int]] = None,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None, mesh=None,
+                 param_specs=None):
         if not hasattr(layer, "forward_cached") \
                 or not hasattr(layer, "init_cache"):
             raise InvalidArgumentError(
                 f"{type(layer).__name__} does not implement the "
                 "incremental-decoding contract (init_cache + "
                 "forward_cached) — see text.models.GPTModel")
+        if mesh is not None and type(self) is not Generator:
+            raise InvalidArgumentError(
+                "sharded decoding (mesh=) supports the plain Generator "
+                f"only; {type(self).__name__} must run per-replica "
+                "unsharded")
         layer.eval()
         self._layer = layer
+        # sharded serving (serving/cluster): params placed per the
+        # autoshard-derived specs, KV planes pinned to the cluster-wide
+        # layout rule, all avals carrying shardings so the AOT programs
+        # compile SPMD over the mesh.  mesh=None (the default) is the
+        # single-device path, byte-identical to before.
+        self._mesh = mesh
+        self._param_specs = dict(param_specs or {})
         self._site = site or f"generate:{type(layer).__name__.lower()}"
         self._max_len = int(max_len if max_len is not None
                             else _flags.flag("decode_max_len"))
@@ -131,6 +144,27 @@ class Generator:
         or loading).  Shapes are unchanged, so no recompile — the fresh
         arrays just flow through the existing executables."""
         self._params, self._buffers = layer_state(self._layer)
+        if self._mesh is not None:
+            self._params = {n: jax.device_put(
+                v, self._sharding(self._param_specs.get(n)))
+                for n, v in self._params.items()}
+            self._buffers = {n: jax.device_put(v, self._sharding())
+                             for n, v in self._buffers.items()}
+
+    # -- sharded-serving layout (serving/cluster/sharding.py) ----------------
+    def _sharding(self, spec=None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self._mesh,
+                             spec if spec is not None else P())
+
+    def kv_plane_sharding(self, shape):
+        """The pinned ring-plane sharding at this generator's mesh (None
+        on the single-device path) — handoff ingest and the decode
+        avals both consult it, so cross-pool layouts always agree."""
+        if self._mesh is None:
+            return None
+        from ..serving.cluster.sharding import kv_plane_spec
+        return self._sharding(kv_plane_spec(shape, self._mesh))
 
     # -- bucketing -----------------------------------------------------------
     def prefill_bucket(self, length: int) -> int:
@@ -241,16 +275,32 @@ class Generator:
         kv = str(_flags.flag("kv_cache_dtype")).lower()
         return tuple([("arg:phase", phase), ("arg:batch", B),
                       ("arg:kv", kv)]
+                     + ([("arg:mesh", self._mesh_label())]
+                        if self._mesh is not None else [])
                      + ([("arg:prompt", P)] if P is not None else [])
                      + [("arg:cache", C)]
                      + ([("arg:steps", steps), ("arg:beam", beam),
                          ("arg:eos", end)]
                         if steps is not None else []))
 
+    def _mesh_label(self):
+        if self._mesh is None:
+            return ""
+        return "x".join(f"{a}{n}" for a, n in dict(self._mesh.shape).items())
+
     def _state_avals(self):
         """Avals of the leading state arguments every generate program
         takes (params, buffers) — the speculative subclass appends the
-        draft model's pair."""
+        draft model's pair.  Under a mesh the avals carry the param
+        shardings, so the AOT programs lower SPMD."""
+        if self._mesh is not None:
+            return ({n: jax.ShapeDtypeStruct(
+                        tuple(a.shape), a.dtype,
+                        sharding=self._sharding(self._param_specs.get(n)))
+                     for n, a in self._params.items()},
+                    {n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype,
+                                             sharding=self._sharding())
+                     for n, a in self._buffers.items()})
         return (jax.tree_util.tree_map(_aval, self._params),
                 jax.tree_util.tree_map(_aval, self._buffers))
 
@@ -268,18 +318,26 @@ class Generator:
             if cfg is not None and hasattr(cfg, "__dict__") else repr(cfg)
         avals = jax.tree_util.tree_map(
             lambda a: (tuple(a.shape), str(a.dtype)), self._state_avals())
+        mesh_id = () if self._mesh is None else (
+            self._mesh_label(),
+            tuple(sorted((n, repr(s))
+                         for n, s in self._param_specs.items())))
         return ("generator", type(self._layer).__name__, cfg_r,
-                repr(avals), self._max_len, tuple(self._seq_buckets))
+                repr(avals), self._max_len, tuple(self._seq_buckets),
+                *mesh_id)
 
-    def _compile(self, key, kind, fn, arg_avals, extra):
+    def _compile(self, key, kind, fn, arg_avals, extra,
+                 out_shardings=None):
         ex = self._execs.get(key)
         if ex is not None:
             _ledger.record_cache_hit(self._site)
             return ex
         from ..jit import persistent_cache as _pcache
+        jit_kw = {} if out_shardings is None \
+            else {"out_shardings": out_shardings}
         ex, _loaded = _pcache.load_or_compile(
-            lambda: jax.jit(fn).lower(*self._state_avals(),
-                                      *arg_avals).compile(),
+            lambda: jax.jit(fn, **jit_kw).lower(*self._state_avals(),
+                                                *arg_avals).compile(),
             site=self._site, kind=kind, key=key,
             extra_key=self._program_identity(), extra=extra)
         self._execs[key] = ex
@@ -295,10 +353,23 @@ class Generator:
     def prefill_exec(self, B, P, C):
         key = self._key("prefill", B, P, C, None, None)
         fn = self._build_prefill(B, P, C)
-        avals = (jax.ShapeDtypeStruct((B, P), jnp.int32),
-                 jax.ShapeDtypeStruct((B,), jnp.int32))
+        out_sh = None
+        if self._mesh is not None:
+            repl = self._sharding()
+            avals = (jax.ShapeDtypeStruct((B, P), jnp.int32, sharding=repl),
+                     jax.ShapeDtypeStruct((B,), jnp.int32, sharding=repl))
+            # pin the cache output planes to the cluster-wide KV layout
+            # (and the logits replicated) so the decode executable — and
+            # a decode POOL in another process — ingests without guessing
+            shapes = jax.eval_shape(lambda: self._init_cache_raw(B, C))
+            out_sh = ([tuple(self.kv_plane_sharding(p.shape) for p in c)
+                       for c in shapes], repl)
+        else:
+            avals = (jax.ShapeDtypeStruct((B, P), jnp.int32),
+                     jax.ShapeDtypeStruct((B,), jnp.int32))
         return self._compile(key, "generate_prefill", fn, avals,
-                             {"batch": B, "prompt": P, "cache": C})
+                             {"batch": B, "prompt": P, "cache": C},
+                             out_shardings=out_sh)
 
     def decode_exec(self, B, C, steps, beam=1, eos_token_id=None):
         end = -1 if eos_token_id is None else int(eos_token_id)
@@ -307,6 +378,24 @@ class Generator:
         # the decode program's cache avals are exactly the prefill
         # program's cache outputs — derive them abstractly
         cache_avals = jax.eval_shape(lambda: self._init_cache_raw(B, C))
+        if self._mesh is not None:
+            repl = self._sharding()
+            cache_avals = [tuple(jax.ShapeDtypeStruct(
+                                     p.shape, p.dtype,
+                                     sharding=self.kv_plane_sharding(
+                                         p.shape))
+                                 for p in c)
+                           for c in cache_avals]
+            vocab = self._vocab_size()
+            avals = (cache_avals,
+                     jax.ShapeDtypeStruct((B, vocab), jnp.float32,
+                                          sharding=repl),
+                     jax.ShapeDtypeStruct((B,), jnp.int32, sharding=repl),
+                     jax.ShapeDtypeStruct((), jnp.int32, sharding=repl))
+            return self._compile(key, "generate_decode", fn, avals,
+                                 {"batch": B, "cache": C,
+                                  "steps": int(steps), "beam": int(beam)},
+                                 out_shardings=repl)
         cache_avals = [tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
                              for p in c)
                        for c in cache_avals]
@@ -333,6 +422,15 @@ class Generator:
         """Run (compiling if new) the prefill executable on LEFT-padded
         int32 prompts ``ids [B, P]`` with per-row pad offsets ``start
         [B]``; returns (device cache, next-token logits [B, V])."""
+        if self._mesh is not None:
+            # host arrays: the SPMD executable places them per its own
+            # (replicated) input shardings — a pre-committed single-
+            # device array would be a layout mismatch
+            ids = np.asarray(ids, np.int32)
+            B, P = ids.shape
+            ex = self.prefill_exec(B, P, int(cache_len))
+            return ex(*self._state_args(), ids,
+                      np.asarray(start, np.int32))
         ids = jnp.asarray(ids, jnp.int32)
         B, P = ids.shape
         ex = self.prefill_exec(B, P, int(cache_len))
@@ -348,6 +446,10 @@ class Generator:
         C = cache[0][0].shape[2]
         ex = self.decode_exec(B, int(C), int(steps), int(beam_size),
                               eos_token_id)
+        if self._mesh is not None:
+            return ex(*self._state_args(), cache,
+                      np.asarray(logits0, np.float32),
+                      np.asarray(start, np.int32), np.int32(pos0))
         return ex(*self._state_args(), cache,
                   jnp.asarray(logits0, jnp.float32),
                   jnp.asarray(start, jnp.int32), jnp.int32(pos0))

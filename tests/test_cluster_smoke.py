@@ -1,0 +1,83 @@
+"""Slow subprocess smokes for the cluster serving CLI: sustained mixed
+traffic across ≥2 real replica processes behind the router, zero
+steady-state recompiles on every replica, the SIGKILL-a-replica
+heartbeat-eviction drill, and the disaggregated prefill/decode pools
+with the serialized cross-process KV handoff."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE = os.path.join(ROOT, "tools", "serve.py")
+
+
+def _run(extra, env_extra=None, timeout=540):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)           # replicas are single-device CPU
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FLAGS_router_heartbeat_s"] = "0.5"
+    env["FLAGS_router_stale_after_s"] = "2.5"
+    env.update(env_extra or {})
+    p = subprocess.run(
+        [sys.executable, SERVE, "--router", "--decode", "--json",
+         "--buckets", "1,2", "--seq-buckets", "8,16", "--max-new", "3",
+         "--clients", "3"] + extra,
+        capture_output=True, text=True, timeout=timeout, env=env)
+    tail = p.stdout[p.stdout.index("{"):] if "{" in p.stdout else p.stdout
+    try:
+        report = json.loads(tail)
+    except Exception:
+        raise AssertionError(
+            f"no JSON report (rc={p.returncode}):\n{p.stdout[-2000:]}\n"
+            f"{p.stderr[-2000:]}")
+    return p.returncode, report
+
+
+@pytest.mark.slow
+def test_router_mixed_traffic_kill_drill():
+    """Sustained MIXED dense+decode traffic across 3 replica processes
+    with a p99 SLO bound, plus the eviction drill in the same run: the
+    victim SIGKILL'd mid-traffic, heartbeat evict, traffic
+    redistributed with zero client-visible errors, and zero
+    steady-state recompiles on every survivor."""
+    rc, report = _run(["--replicas", "3", "--duration", "4",
+                       "--model", "lenet", "--p99-slo-ms", "5000",
+                       "--kill-one"])
+    assert rc == 0, json.dumps(report, indent=1)[:3000]
+    assert report["traffic_errors"] == []
+    assert report["steady_compiles"] == 0
+    assert report["kill_one"]["evicted"] is True
+    assert report["router_stats"]["replicas_live"] == 2
+    live = [rid for rid, st in report["router_stats"]["replicas"].items()
+            if st["alive"]]
+    assert len(live) == 2
+    # every live replica actually served traffic
+    for rid in live:
+        assert report["router_stats"]["replicas"][rid]["dispatched"] > 0
+    for rid, st in report["replica_stats"].items():
+        for model in ("gpt_decode", "lenet"):       # mixed pillars
+            assert st[model]["steady_compiles"] == 0
+            assert st[model]["completed"] > 0
+
+
+@pytest.mark.slow
+def test_router_disaggregated_pools_across_processes():
+    """Prefill pool and decode pool in separate OS processes: every
+    decode request runs prefill on one process, ships the serialized
+    KV-cache handoff, and resumes decode on the other — sustained
+    traffic, no errors, zero steady recompiles on both."""
+    rc, report = _run(["--replicas", "2", "--duration", "3",
+                       "--disaggregate"])
+    assert rc == 0, json.dumps(report, indent=1)[:3000]
+    assert report["traffic_errors"] == []
+    assert report["steady_compiles"] == 0
+    roles = {st["role"] for st in
+             report["router_stats"]["replicas"].values()}
+    assert roles == {"prefill", "decode"}
+    # both pools took every request (one prefill + one decode leg each)
+    counts = [st["dispatched"] for st in
+              report["router_stats"]["replicas"].values()]
+    assert min(counts) > 0 and counts[0] == counts[1]

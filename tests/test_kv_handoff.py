@@ -1,0 +1,210 @@
+"""KV-cache plane handoff: serialization roundtrips are bit-exact and a
+transferred cache resumes decode bit-identically to the in-process
+generate() control — bf16 and int8+scale ring planes, device and wire
+transports, including the ring-wrap block-write path (PR 12's two-leg
+split) landing in a roundtripped cache."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.framework.enforce import (InvalidArgumentError,
+                                          PreconditionNotMetError)
+from paddle_tpu.framework.flags import flags_restore, flags_snapshot, \
+    set_flags
+from paddle_tpu.serving.cluster import KVHandoff, deserialize_kv, \
+    serialize_kv
+from paddle_tpu.text.generation import Generator
+from paddle_tpu.text.models.gpt import GPTConfig, GPTModel
+
+V = 64
+
+
+def _gpt(seed=21):
+    paddle.seed(seed)
+    m = GPTModel(GPTConfig.tiny(vocab_size=V, hidden_size=32, layers=2,
+                                heads=2, seq=64))
+    m.eval()
+    return m
+
+
+def _server(m, steps=4):
+    srv = serving.Server(serving.ServingConfig(workers=1))
+    srv.register_decode("gpt", m, batch_buckets=(1, 2), seq_buckets=(8, 16),
+                        max_new_tokens=steps, max_len=32)
+    return srv
+
+
+def _prompts(rng, lens):
+    return [rng.randint(1, V, int(n)).astype(np.int32) for n in lens]
+
+
+# ---------------------------------------------------------------------------
+# pure serialization
+# ---------------------------------------------------------------------------
+
+def test_roundtrip_is_bit_exact_f32_and_bf16():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    f32 = rng.randn(1, 2, 8, 4).astype(np.float32)
+    bf16 = jnp.asarray(rng.randn(1, 2, 8, 4), jnp.bfloat16)
+    h = KVHandoff(cache=[(f32, f32 * 2), (bf16, bf16 + 1)],
+                  logits0=rng.randn(1, V).astype(np.float32),
+                  start=np.array([3], np.int32), pos=8,
+                  meta={"model": "m", "rows": 1, "max_new": 4})
+    h2 = deserialize_kv(serialize_kv(h))
+    for c, c2 in zip(h.cache, h2.cache):
+        for p, p2 in zip(c, c2):
+            assert str(p2.dtype) == str(np.asarray(p).dtype)
+            assert np.asarray(p).tobytes() == np.asarray(p2).tobytes()
+    assert h2.logits0.tobytes() == h.logits0.tobytes()
+    assert h2.pos == 8 and list(h2.start) == [3]
+    assert h2.meta == h.meta
+
+
+def test_roundtrip_int8_scale_planes_bit_exact():
+    rng = np.random.RandomState(1)
+    k = rng.randint(-128, 128, (2, 2, 8, 4)).astype(np.int8)
+    ks = rng.rand(2, 2, 8, 1).astype(np.float32)
+    h = KVHandoff(cache=[(k, k[::-1].copy(), ks, ks * 2)],
+                  logits0=None, start=np.array([0, 2], np.int32), pos=4)
+    h2 = deserialize_kv(serialize_kv(h))
+    assert h2.logits0 is None
+    assert len(h2.cache[0]) == 4
+    for p, p2 in zip(h.cache[0], h2.cache[0]):
+        assert p.tobytes() == np.asarray(p2).tobytes()
+
+
+def test_bad_blob_rejected():
+    with pytest.raises(InvalidArgumentError):
+        deserialize_kv(b"not a handoff")
+
+
+def test_ring_wrap_block_write_survives_roundtrip():
+    """The PR-12 two-leg wrap write, applied identically to a cache and
+    its serialize/deserialize image, stays bitwise equal — transferred
+    caches are indistinguishable from local ones even at the wrap."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nn.layer.transformer import ring_block_write
+    rng = np.random.RandomState(2)
+    C, T = 8, 3
+    plane = jnp.asarray(rng.randn(1, 2, C, 4), jnp.bfloat16)
+    block = jnp.asarray(rng.randn(1, 2, T, 4), jnp.bfloat16)
+    h2 = deserialize_kv(serialize_kv(KVHandoff(
+        cache=[(plane,)], logits0=None,
+        start=np.array([0], np.int32), pos=C - 1)))
+    restored = jnp.asarray(np.asarray(h2.cache[0][0]))
+    write = jax.jit(lambda p, n, pos: ring_block_write(p, n, pos))
+    for pos in range(C):                       # incl. the wrapping tail
+        a = write(plane, block, jnp.int32(pos))
+        b = write(restored, block, jnp.int32(pos))
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), pos
+
+
+# ---------------------------------------------------------------------------
+# end-to-end continuation bit-match
+# ---------------------------------------------------------------------------
+
+def _continuation_case(steps=4):
+    m = _gpt()
+    srv = _server(m, steps=steps)
+    srv.start()
+    rng = np.random.RandomState(3)
+    prompts = _prompts(rng, (5, 11))
+    oracle = Generator(m, seq_buckets=(8, 16), max_len=32)
+    want = np.concatenate(
+        [np.asarray(oracle.generate(p[None, :], max_new_tokens=steps))
+         for p in prompts], axis=0)
+    return srv, prompts, want
+
+
+@pytest.fixture(scope="module")
+def continuation():
+    """One warmed server + its prompts/oracle, shared by every
+    default-dtype continuation test (the grids compile once)."""
+    srv, prompts, want = _continuation_case()
+    yield srv, prompts, want
+    srv.stop()
+
+
+def test_wire_transfer_resumes_bit_identically(continuation):
+    """prefill → serialize → deserialize → decode == in-process
+    generate(), bitwise; the handoff carries the traced cache_position
+    and per-row validity offsets that make the resume exact."""
+    srv, prompts, want = continuation
+    h = srv.prefill_handoff("gpt", prompts, 4)
+    blob = h.to_bytes()
+    h2 = deserialize_kv(blob)
+    # the wire image is host-resident and byte-exact
+    assert isinstance(h2.cache[0][0], np.ndarray)
+    assert h2.pos == h.pos
+    assert np.array_equal(h2.start, np.asarray(h.start))
+    got = srv.decode_from_handoff("gpt", blob)
+    assert got.dtype == np.int32 and np.array_equal(got, want)
+    srv.assert_zero_steady_state_recompiles()
+
+
+def test_device_transfer_resumes_bit_identically(continuation):
+    srv, prompts, want = continuation
+    h = srv.prefill_handoff("gpt", prompts, 4)
+    got = srv.decode_from_handoff("gpt", h)       # device pass-through
+    assert np.array_equal(got, want)
+    srv.assert_zero_steady_state_recompiles()
+
+
+def test_int8_kv_handoff_resumes_bit_identically():
+    """Quantized ring caches (int8 rows + f32 scale planes, PR 12) ride
+    the same handoff: 4 planes per layer serialized, transferred, and
+    the continuation still bit-matches the (equally int8-cached)
+    in-process generate()."""
+    snap = flags_snapshot()
+    try:
+        set_flags({"FLAGS_kv_cache_dtype": "int8"})
+        srv, prompts, want = _continuation_case()
+        try:
+            h = srv.prefill_handoff("gpt", prompts, 4)
+            assert len(h.cache[0]) == 4           # k, v, k_scale, v_scale
+            blob = h.to_bytes()
+            h2 = deserialize_kv(blob)
+            assert str(np.asarray(h2.cache[0][0]).dtype) == "int8"
+            got = srv.decode_from_handoff("gpt", blob)
+            assert np.array_equal(got, want)
+            srv.assert_zero_steady_state_recompiles()
+        finally:
+            srv.stop()
+    finally:
+        flags_restore(snap)
+
+
+def test_handoff_respects_max_new_and_rows(continuation):
+    srv, prompts, _ = continuation
+    h = srv.prefill_handoff("gpt", prompts, 2)
+    assert h.meta["rows"] == 2 and h.meta["max_new"] == 2
+    got = srv.decode_from_handoff("gpt", h.to_bytes())
+    assert got.shape == (2, 2)
+
+
+def test_handoff_requires_decode_model_and_started_server(continuation):
+    m = _gpt()
+    unstarted = _server(m)
+    with pytest.raises(PreconditionNotMetError):
+        unstarted.prefill_handoff("gpt", [np.array([1, 2], np.int32)])
+    srv = continuation[0]
+    with pytest.raises(InvalidArgumentError):
+        srv.decode_from_handoff("gpt", b"not a handoff")
+
+
+def test_handoff_metrics_flow(continuation):
+    from paddle_tpu.profiler.metrics import default_registry
+    reg = default_registry()
+    counter = reg.get("kv_handoff_bytes_total")
+    hist = reg.get("kv_handoff_seconds")
+    assert counter is not None and hist is not None
+    before_wire = counter.labels("wire").value
+    before_n = hist.count
+    srv, prompts, _ = continuation
+    blob = srv.prefill_handoff("gpt", prompts, 4).to_bytes()
+    srv.decode_from_handoff("gpt", blob)
+    assert counter.labels("wire").value >= before_wire + len(blob)
+    assert hist.count > before_n

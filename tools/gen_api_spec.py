@@ -38,6 +38,7 @@ NAMESPACES = [
     ("paddle_tpu.text.speculative", None),
     ("paddle_tpu.inference", None),
     ("paddle_tpu.serving", None),
+    ("paddle_tpu.serving.cluster", None),
     ("paddle_tpu.quantization", None),
     ("paddle_tpu.regularizer", None),
     ("paddle_tpu.incubate", None),

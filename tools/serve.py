@@ -156,6 +156,220 @@ def _traffic(server, name, specs, duration_s, clients, max_rows, vocab,
     return errors
 
 
+def _replica_child(cfg_path):
+    """Replica-process entry (spawned by --router): build the configured
+    models, start a Server, and serve RPC until killed.  Deterministic
+    by construction — every replica seeds identically, so all replicas
+    hold bit-identical weights and the router's answers do not depend
+    on which replica served them."""
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.serving.cluster import replica_main
+    set_flags({"FLAGS_serving_role": cfg.get("role", "both"),
+               "FLAGS_router_heartbeat_s": float(cfg["heartbeat_s"])})
+    if cfg.get("cache_dir"):
+        set_flags({"FLAGS_executable_cache": "readwrite",
+                   "FLAGS_executable_cache_dir": cfg["cache_dir"]})
+    paddle.seed(cfg["seed"])
+    buckets = tuple(cfg["buckets"])
+    server = serving.Server(serving.ServingConfig(
+        workers=cfg.get("workers"), buckets=buckets))
+    with tempfile.TemporaryDirectory() as d:
+        for name in cfg["models"]:
+            layer, specs = ZOO[name]()
+            layer.eval()
+            prefix = os.path.join(d, name)
+            serving.export_for_serving(layer, prefix, specs,
+                                       buckets=buckets)
+            server.register(name, prefix, buckets=buckets)
+        if cfg.get("decode"):
+            seq_buckets = tuple(cfg["seq_buckets"])
+            gpt = build_gpt_decode()
+            server.register_decode(
+                "gpt_decode", gpt, batch_buckets=buckets,
+                seq_buckets=seq_buckets, max_new_tokens=cfg["max_new"],
+                max_len=max(seq_buckets) + cfg["max_new"])
+        replica_main(server, replica_id=cfg["id"],
+                     store_host=cfg["store_host"],
+                     store_port=cfg["store_port"], block=True)
+    return 0
+
+
+def _router_main(args):
+    """--router mode: spawn FLAGS_serving_replicas replica subprocesses,
+    rendezvous them through a TCPStore, route sustained traffic through
+    the front-end Router, optionally SIGKILL one replica mid-traffic
+    (--kill-one: the heartbeat evict + redistribution drill), and gate
+    the exit code on traffic errors, per-replica steady-state compiles,
+    SLOs, and the eviction actually happening."""
+    import signal
+    import subprocess
+
+    from paddle_tpu.distributed.fleet.base.tcp_store import TCPStore
+    from paddle_tpu.framework.flags import flag as _flag
+    from paddle_tpu.serving.cluster import Router
+
+    n = args.replicas if args.replicas is not None \
+        else int(_flag("serving_replicas"))
+    if args.disaggregate and (not args.decode or n < 2):
+        print("--disaggregate needs --decode and --replicas >= 2",
+              file=sys.stderr)
+        return 2
+    names = list(dict.fromkeys(
+        args.model or ([] if args.decode else ["lenet"])))
+    buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+    seq_buckets = tuple(int(b) for b in args.seq_buckets.split(",")
+                        if b.strip())
+    report = {"router": True, "replicas": n,
+              "disaggregate": bool(args.disaggregate),
+              "duration_s": args.duration, "clients": args.clients,
+              "models": {}, "replica_stats": {}}
+    rc = 0
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    children, router = [], None
+    cfg_dir = tempfile.mkdtemp(prefix="serve_router_")
+    try:
+        for i in range(n):
+            role = "both"
+            if args.disaggregate:
+                # alternate so both pools exist at every cluster size
+                role = "prefill" if i % 2 == 0 else "decode"
+            cfg = {"id": f"replica{i}", "role": role, "seed": args.seed,
+                   "models": names, "decode": bool(args.decode),
+                   "buckets": list(buckets),
+                   "seq_buckets": list(seq_buckets),
+                   "max_new": args.max_new, "workers": args.workers,
+                   "store_host": "127.0.0.1", "store_port": store.port,
+                   "heartbeat_s": float(_flag("router_heartbeat_s")),
+                   "cache_dir": args.cache_dir}
+            path = os.path.join(cfg_dir, f"replica{i}.json")
+            with open(path, "w") as f:
+                json.dump(cfg, f)
+            children.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--replica-config", path],
+                stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT))
+        router = Router(store=store)
+        t0 = time.perf_counter()
+        deadline = t0 + 300
+        while router.replicas_live() < n:
+            if time.perf_counter() > deadline:
+                report["error"] = (f"only {router.replicas_live()}/{n} "
+                                   "replicas joined within 300s")
+                return _router_report(report, args, 1)
+            for p in children:
+                if p.poll() not in (None, 0):
+                    report["error"] = \
+                        f"replica exited rc={p.returncode} during warm-up"
+                    return _router_report(report, args, 1)
+            time.sleep(0.2)
+        report["warmup_s"] = round(time.perf_counter() - t0, 3)
+
+        killed = {"id": None}
+        if args.kill_one:
+            # kill mid-traffic from a side thread: the drill is traffic
+            # REDISTRIBUTING, not a clean restart
+            def killer():
+                time.sleep(max(0.2, args.duration / 3))
+                victim = children[-1]
+                killed["id"] = f"replica{n - 1}"
+                victim.send_signal(signal.SIGKILL)
+            threading.Thread(target=killer, daemon=True).start()
+
+        model_meta = {name: ZOO[name]() for name in names}
+        errors = []
+        if args.decode:
+            errors += _decode_traffic(
+                router, "gpt_decode", args.duration, args.clients,
+                args.max_request_rows, max(seq_buckets), args.max_new,
+                128, args.seed)
+        for name in names:
+            layer, specs = model_meta[name]
+            errors += _traffic(router, name, specs, args.duration,
+                               args.clients, args.max_request_rows,
+                               getattr(layer, "_serve_vocab", None),
+                               args.seed)
+        report["traffic_errors"] = errors
+        if errors:
+            rc = 1
+
+        if args.kill_one:
+            # the dead replica must be EVICTED by heartbeat, traffic
+            # already redistributed (no errors above past the ack)
+            stale = float(_flag("router_stale_after_s"))
+            hb = float(_flag("router_heartbeat_s"))
+            evict_deadline = time.perf_counter() + stale + 4 * hb + 10
+            while router.replicas_live() > n - 1:
+                if time.perf_counter() > evict_deadline:
+                    break
+                time.sleep(0.2)
+            report["kill_one"] = {
+                "victim": killed["id"],
+                "evicted": router.replicas_live() == n - 1}
+            if not report["kill_one"]["evicted"]:
+                rc = 1
+
+        steady_total = 0
+        for h in router.handles():
+            if not h.alive:
+                continue
+            try:
+                st = h.model_stats()
+                hl = h.health()
+            except Exception as e:   # noqa: BLE001 — reported, gated
+                report["replica_stats"][h.id] = \
+                    {"error": f"{type(e).__name__}: {e}"}
+                rc = 1
+                continue
+            steady_total += int(hl.get("steady_compiles", 0))
+            report["replica_stats"][h.id] = st
+            if args.p99_slo_ms is not None:
+                worst = max((m["p99_ms"] for m in st.values()
+                             if m.get("completed")), default=0.0)
+                if worst > args.p99_slo_ms:
+                    rc = 1
+        report["steady_compiles"] = steady_total
+        if steady_total:
+            rc = 1
+        report["router_stats"] = router.stats()
+    finally:
+        if router is not None:
+            router.close()
+        for p in children:
+            if p.poll() is None:
+                p.terminate()
+        for p in children:
+            try:
+                p.wait(timeout=10)
+            except Exception:   # noqa: BLE001 — last resort
+                p.kill()
+        store.close()
+    return _router_report(report, args, rc)
+
+
+def _router_report(report, args, rc):
+    report["rc"] = rc
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        for rid, st in report.get("replica_stats", {}).items():
+            if "error" in st:
+                print(f"{rid:>10}: ERROR {st['error']}")
+                continue
+            for name, m in st.items():
+                print(f"{rid:>10} {name:>12}: {m['qps']:>8.1f} qps  "
+                      f"p50 {m['p50_ms']:>8.2f} ms  "
+                      f"p99 {m['p99_ms']:>8.2f} ms  "
+                      f"completed {m['completed']}")
+        print(f"router: {report.get('router_stats', {}).get('replicas_live')}"
+              f" live, steady compiles {report.get('steady_compiles')} "
+              f"(must be 0), rc={rc}")
+    return rc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="serve",
@@ -216,7 +430,32 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit one JSON report instead of text")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--router", action="store_true",
+                    help="cluster mode: spawn --replicas serving "
+                         "subprocesses behind the front-end Router "
+                         "(TCPStore rendezvous + heartbeat eviction) "
+                         "and drive the traffic through it; rc gates "
+                         "additionally on per-replica steady compiles "
+                         "and (with --kill-one) the eviction drill")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="replica subprocess count under --router "
+                         "(default: FLAGS_serving_replicas)")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="under --router --decode: split replicas into "
+                         "prefill/decode worker pools; decode requests "
+                         "route prefill-pool → KV handoff → decode-pool")
+    ap.add_argument("--kill-one", action="store_true", dest="kill_one",
+                    help="under --router: SIGKILL one replica "
+                         "mid-traffic and require heartbeat eviction + "
+                         "traffic redistribution (rc!=0 otherwise)")
+    ap.add_argument("--replica-config", default=None,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+
+    if args.replica_config:
+        return _replica_child(args.replica_config)
+    if args.router:
+        return _router_main(args)
 
     from paddle_tpu import serving
     from paddle_tpu.framework.flags import flags_restore, flags_snapshot, \
