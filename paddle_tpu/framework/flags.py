@@ -489,6 +489,29 @@ define_flag("trace_dir",
             "(tools/obs_report.py joins these with metrics snapshots "
             "into per-request waterfalls).  The bounded in-memory span "
             "ring is always maintained while tracing is on.")
+define_flag("flight_dir",
+            os.environ.get("PADDLE_TPU_FLIGHT_DIR", ""),
+            "When non-empty, arm the per-process flight recorder "
+            "(profiler.flight): a bounded in-memory ring of recent "
+            "spans, recompile-ledger events and metric snapshots, "
+            "atomically persisted into this directory as "
+            "postmortem_<id>.json — rewritten every "
+            "FLAGS_flight_interval_s and on SIGTERM/fatal paths — so "
+            "even a SIGKILLed replica leaves evidence "
+            "(tools/obs_report.py --postmortem reads it).  Empty = "
+            "recorder fully off (zero hot-path cost).  Seeded by "
+            "PADDLE_TPU_FLIGHT_DIR.")
+define_flag("flight_interval_s", 1.0,
+            "Flight-recorder persistence cadence: the background dumper "
+            "rewrites the postmortem artifact (atomic replace, "
+            "checkpoint discipline) this often, bounding how much "
+            "history an uncatchable SIGKILL can destroy.",
+            validator=lambda v: float(v) > 0)
+define_flag("flight_spans", 256,
+            "How many most-recent finished spans (and ledger events, "
+            "capped at half this) a flight-recorder dump carries — the "
+            "artifact stays a bounded postmortem, not a trace archive.",
+            validator=lambda v: int(v) > 0)
 define_flag("log_writer_max_mb", 64.0,
             "Size cap (MiB) per LogWriter JSONL sink file (recompile "
             "ledger, graph-lint, hlo-audit, trace dirs): past the cap "

@@ -1,0 +1,203 @@
+"""Flight recorder: a bounded postmortem ring every process can leave
+behind.
+
+The PR-3 numerics sentinel established the discipline — when a process
+dies for a reason it can explain, it atomically writes a small JSON
+artifact (``sentinel_abort.json``) instead of leaving operators to
+reconstruct state from logs.  This module generalizes that to the whole
+observability plane: while armed (``FLAGS_flight_dir`` /
+PADDLE_TPU_FLIGHT_DIR non-empty), a background thread periodically
+persists a bounded snapshot of
+
+  * the most recent finished trace spans (``tracing.finished_spans``),
+  * the recompile-ledger tail (``ledger.compile_events``),
+  * the full typed-metrics registry dump + legacy monitor stats,
+
+as ``postmortem_<id>.json`` via ``checkpoint.atomic.atomic_write_bytes``
+(same-dir temp + os.replace, so the artifact is never half-written).
+
+Three triggers, by survivability class:
+
+  * **periodic** — every ``FLAGS_flight_interval_s``.  This is what makes
+    the SIGKILL drill yield evidence from the victim: SIGKILL is
+    uncatchable, but os.replace has already landed a snapshot at most one
+    interval old.  A killed process cannot write; a killed process's
+    last atomic write survives.
+  * **sigterm** — a chained SIGTERM handler dumps before the previous
+    disposition runs (cooperative shutdown leaves fresh evidence).
+  * **uncaught** — a chained ``sys.excepthook`` dumps on any fatal
+    uncaught exception (EnforceNotMet/FatalError included), tagging the
+    artifact with the exception type.
+
+Everything here is host-side, off the device path, and fail-open: a
+recorder error must never take down the process it exists to explain.
+``tools/obs_report.py --postmortem`` is the read side.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+from ..framework import flags as _flags
+from . import metrics as _metrics
+
+__all__ = ["FlightRecorder", "install", "uninstall", "active", "dump"]
+
+_lock = threading.Lock()
+_rec = [None]          # the installed singleton (one artifact per process)
+
+_DUMPS = _metrics.default_registry().counter(
+    "flight_dumps_total",
+    "Flight-recorder postmortem artifacts written, by trigger "
+    "(periodic / sigterm / uncaught / manual / watchdog_evict).",
+    labels=("reason",))
+
+
+class FlightRecorder:
+    """Periodic + on-signal atomic dumper of recent observability state.
+
+    One instance owns one artifact path; ``install()`` manages the
+    process-wide singleton and the signal/excepthook chaining."""
+
+    def __init__(self, dump_dir: str, ident: Optional[str] = None,
+                 interval_s: Optional[float] = None,
+                 cap: Optional[int] = None):
+        self.ident = str(ident) if ident else str(os.getpid())
+        self.path = os.path.join(
+            dump_dir, f"postmortem_{self.ident}.json")
+        self._interval = float(interval_s
+                               if interval_s is not None
+                               else _flags.flag("flight_interval_s"))
+        self._cap = int(cap if cap is not None
+                        else _flags.flag("flight_spans"))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._dumps = 0
+        os.makedirs(dump_dir, exist_ok=True)
+
+    # -- snapshot ------------------------------------------------------------
+    def snapshot(self, reason: str) -> dict:
+        from . import ledger as _ledger
+        from . import tracing as _tracing
+        spans = _tracing.finished_spans()[-self._cap:]
+        led = _ledger.compile_events()[-max(1, self._cap // 2):]
+        return {
+            "schema": "paddle_tpu/flight-recorder/1",
+            "reason": reason,
+            "id": self.ident,
+            "pid": os.getpid(),
+            "wall": time.time(),
+            "monotonic": time.monotonic(),
+            "argv": list(sys.argv),
+            "dumps": self._dumps,
+            "trace_mode": _tracing.mode(),
+            "spans": spans,
+            "ledger": led,
+            "metrics": _metrics.default_registry().dump(
+                include_stats=True),
+        }
+
+    def dump(self, reason: str = "manual") -> Optional[str]:
+        """Atomically (re)write the postmortem artifact; returns its path
+        or None on failure — the recorder is fail-open by contract."""
+        try:
+            body = json.dumps(self.snapshot(reason), default=str)
+            from ..checkpoint.atomic import atomic_write_bytes
+            atomic_write_bytes(self.path, body.encode(), durable=False)
+            self._dumps += 1
+            _DUMPS.labels(reason).inc()
+            return self.path
+        except Exception:
+            return None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="paddle-tpu-flight", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.dump("periodic")
+
+    def close(self, final_dump: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if final_dump:
+            self.dump("shutdown")
+
+
+def active() -> Optional[FlightRecorder]:
+    """The installed per-process recorder, or None while disarmed."""
+    return _rec[0]
+
+
+def dump(reason: str = "manual") -> Optional[str]:
+    """Dump through the installed recorder (no-op None while disarmed) —
+    the one-line hook for fatal paths (watchdog evictions, aborts)."""
+    fr = _rec[0]
+    return fr.dump(reason) if fr is not None else None
+
+
+def install(dump_dir: Optional[str] = None, ident: Optional[str] = None,
+            interval_s: Optional[float] = None,
+            cap: Optional[int] = None) -> Optional[FlightRecorder]:
+    """Arm the process flight recorder (idempotent): start the periodic
+    dumper and chain SIGTERM + sys.excepthook triggers.  ``dump_dir``
+    defaults to ``FLAGS_flight_dir``; empty means stay disarmed and
+    return None — arming is always an explicit operator choice."""
+    d = str(dump_dir if dump_dir is not None
+            else (_flags.flag("flight_dir") or ""))
+    if not d:
+        return None
+    with _lock:
+        if _rec[0] is not None:
+            return _rec[0]
+        fr = FlightRecorder(d, ident=ident, interval_s=interval_s,
+                            cap=cap)
+        fr.start()
+        fr.dump("install")          # evidence exists from second zero
+        _rec[0] = fr
+
+    prev_hook = sys.excepthook
+
+    def _hook(tp, val, tb):
+        fr.dump(f"uncaught:{getattr(tp, '__name__', tp)}")
+        prev_hook(tp, val, tb)
+
+    sys.excepthook = _hook
+
+    try:                    # signals only wire from the main thread
+        prev_term = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            fr.dump("sigterm")
+            if callable(prev_term):
+                prev_term(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass
+    return fr
+
+
+def uninstall(final_dump: bool = False) -> None:
+    """Stop the periodic dumper and drop the singleton (tests).  The
+    signal/excepthook chains stay in place but become no-ops through the
+    closed recorder's fail-open dump."""
+    with _lock:
+        fr, _rec[0] = _rec[0], None
+    if fr is not None:
+        fr.close(final_dump=final_dump)

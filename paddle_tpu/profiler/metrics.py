@@ -47,6 +47,7 @@ from ..utils.monitor import all_stats, stat_add, stat_set
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "default_registry", "serve_metrics", "write_textfile",
+    "merge_histogram_payloads", "merge_dumps",
     "LatencyWindow", "RateMeter",
 ]
 
@@ -263,6 +264,16 @@ class _HistogramChild:
             cum.append(acc)
         return cum, s, n
 
+    def raw(self):
+        """(raw per-bucket counts aligned to bounds+[+Inf], sum, count).
+
+        Raw — not cumulative — counts are the mergeable form: two
+        processes observing into the SAME fixed bucket layout can be
+        federated by summing bucket-wise (:func:`merge_histogram_payloads`),
+        which the reservoir :class:`LatencyWindow` can never support."""
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
     def quantile(self, q: float) -> Optional[float]:
         """Bucket-interpolated quantile estimate in [0, 1]; None while
         empty.  Exact enough for SLO sanity ('p99 is in the right
@@ -409,6 +420,37 @@ class MetricsRegistry:
             out[m.name] = fam
         return out
 
+    def dump(self, include_stats: bool = True) -> dict:
+        """Portable, JSON-serializable snapshot of every family — the
+        unit of cluster federation (shipped over the ``scrape`` RPC op).
+
+        Histogram children carry RAW per-bucket counts (``raw()``), so a
+        Router can bucket-sum dumps from N replicas into one cluster
+        distribution; counters/gauges carry their float value.  With
+        ``include_stats`` the legacy ``utils.monitor`` int gauges ride
+        along under ``"stats"``."""
+        fams = []
+        for m in self.collect():
+            fam = {"name": m.name, "kind": m.kind, "doc": m.doc,
+                   "labels": list(m.label_names)}
+            if m.kind == "histogram":
+                fam["buckets"] = list(m.buckets)
+            children = []
+            for values, ch in m.children():
+                if m.kind == "histogram":
+                    counts, s, n = ch.raw()
+                    payload = {"counts": counts, "sum": s, "count": n}
+                else:
+                    payload = ch.value
+                children.append([list(values), payload])
+            fam["children"] = children
+            fams.append(fam)
+        out = {"wall": time.time(), "pid": os.getpid(),
+               "families": fams}
+        if include_stats:
+            out["stats"] = dict(all_stats())
+        return out
+
     def _mirrored_stat_names(self) -> set:
         """Flattened utils.monitor keys owned by typed metrics (so the
         exposition's legacy-stat section never double-reports them)."""
@@ -487,6 +529,97 @@ def write_textfile(path: str,
         f.write(reg.prometheus_text())
     os.replace(tmp, path)
     return path
+
+
+# ---------------------------------------------------------------------------
+# Federation: merging registry dumps across processes (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def merge_histogram_payloads(payloads: Sequence[dict]) -> dict:
+    """Bucket-sum merge of histogram child payloads that share one fixed
+    bucket layout (``{"counts": raw per-bucket, "sum", "count"}``).
+
+    Associative and commutative — merge order across replicas cannot
+    change the cluster distribution.  Raises ValueError on a bucket-count
+    mismatch rather than silently mis-binning."""
+    it = iter(payloads)
+    try:
+        first = next(it)
+    except StopIteration:
+        raise ValueError("merge_histogram_payloads needs >= 1 payload")
+    counts = [int(c) for c in first["counts"]]
+    total_sum = float(first["sum"])
+    total_count = int(first["count"])
+    for p in it:
+        if len(p["counts"]) != len(counts):
+            raise ValueError(
+                f"histogram bucket layouts disagree: {len(counts)} vs "
+                f"{len(p['counts'])} buckets — refusing to mis-bin")
+        counts = [a + int(b) for a, b in zip(counts, p["counts"])]
+        total_sum += float(p["sum"])
+        total_count += int(p["count"])
+    return {"counts": counts, "sum": total_sum, "count": total_count}
+
+
+def merge_dumps(dumps: Dict[str, dict]) -> Dict[str, dict]:
+    """Federate per-process registry dumps (``{source_id: dump}``, each
+    from :meth:`MetricsRegistry.dump`) into one cluster view:
+
+        {family_name: {"kind", "doc", "labels", "buckets",
+                       "per_source": {source: {label_values: payload}},
+                       "rollup": {label_values: payload}}}
+
+    Children with the same label values are merged across sources into
+    ``rollup`` — sum for counters, bucket-sum for histograms, and
+    ``{"max", "min"}`` for gauges (a cluster-summed queue depth hides the
+    hot replica; max/min is the honest aggregate).  Label sets may
+    overlap partially or not at all: the rollup is the union.  A family
+    whose type/labels/buckets disagree across sources raises ValueError —
+    federation must not silently fork a family."""
+    fams: Dict[str, dict] = {}
+    for src in sorted(dumps):
+        for fam in dumps[src].get("families", []):
+            name = fam["name"]
+            buckets = tuple(fam.get("buckets", ())) or None
+            f = fams.get(name)
+            if f is None:
+                f = {"name": name, "kind": fam["kind"],
+                     "doc": fam.get("doc", ""),
+                     "labels": tuple(fam["labels"]),
+                     "buckets": buckets,
+                     "per_source": {}, "rollup": {}}
+                fams[name] = f
+            elif (f["kind"] != fam["kind"]
+                  or f["labels"] != tuple(fam["labels"])
+                  or f["buckets"] != buckets):
+                raise ValueError(
+                    f"family {name!r} disagrees across sources "
+                    f"({f['kind']}{f['labels']} vs "
+                    f"{fam['kind']}{tuple(fam['labels'])}) — refusing "
+                    "to merge forked families")
+            f["per_source"][src] = {
+                tuple(v): p for v, p in fam["children"]}
+    for f in fams.values():
+        roll: Dict[Tuple[str, ...], object] = {}
+        for src in sorted(f["per_source"]):
+            for values, payload in f["per_source"][src].items():
+                cur = roll.get(values)
+                if f["kind"] == "histogram":
+                    roll[values] = (dict(payload) if cur is None else
+                                    merge_histogram_payloads(
+                                        [cur, payload]))
+                elif f["kind"] == "counter":
+                    roll[values] = float(payload) + (
+                        float(cur) if cur is not None else 0.0)
+                else:
+                    v = float(payload)
+                    if cur is None:
+                        roll[values] = {"max": v, "min": v}
+                    else:
+                        cur["max"] = max(cur["max"], v)
+                        cur["min"] = min(cur["min"], v)
+        f["rollup"] = roll
+    return fams
 
 
 class _MetricsServer:

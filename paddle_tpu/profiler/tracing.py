@@ -53,11 +53,18 @@ __all__ = [
     "Span", "enabled", "mode", "should_sample", "start_span", "span",
     "child", "current_span", "use_span", "finish", "event",
     "attach_compile_event", "finished_spans", "clear",
+    "enable_span_export", "disable_span_export", "drain_exported_spans",
     "set_trace_dir", "export_chrome_trace", "chrome_trace_events",
 ]
 
 _lock = threading.Lock()
 _ring: deque = deque(maxlen=1 << 16)      # finished span dicts, newest last
+# span export (cluster trace shipping): a bounded drain-once buffer a
+# replica hands to the Router's scrape poll.  None while disabled — the
+# cost of the feature being off is one `is None` check inside finish().
+_export_buf: Optional[deque] = None
+_export_cap = 4096
+_export_drops = 0
 _ids = itertools.count(1)
 _sample_tick = itertools.count()
 _dir_override = [None]
@@ -170,8 +177,14 @@ def finish(s: Optional[Span], end: Optional[float] = None) -> None:
     s._finished = True
     s.dur = max(0.0, (time.monotonic() if end is None else end) - s.t0)
     rec = s.to_dict()
+    global _export_drops
     with _lock:
         _ring.append(rec)
+        if _export_buf is not None:
+            if len(_export_buf) >= _export_cap:
+                _export_buf.popleft()
+                _export_drops += 1
+            _export_buf.append(rec)
         w = _get_writer()
     if w is not None:
         w.add_event("trace/span", rec)
@@ -286,8 +299,44 @@ def finished_spans(trace_id: Optional[str] = None) -> List[dict]:
 
 def clear() -> None:
     """Drop ring state (tests)."""
+    global _export_drops
     with _lock:
         _ring.clear()
+        if _export_buf is not None:
+            _export_buf.clear()
+        _export_drops = 0
+
+
+def enable_span_export(cap: int = 4096) -> None:
+    """Start buffering finished spans for cross-process shipping.  The
+    buffer is BOUNDED: past ``cap`` undrained spans the oldest are
+    dropped and counted (``drain_exported_spans`` reports the running
+    drop total) — a dead Router must never grow replica memory."""
+    global _export_buf, _export_cap
+    with _lock:
+        _export_cap = max(1, int(cap))
+        if _export_buf is None:
+            _export_buf = deque()
+
+
+def disable_span_export() -> None:
+    global _export_buf, _export_drops
+    with _lock:
+        _export_buf = None
+        _export_drops = 0
+
+
+def drain_exported_spans(limit: Optional[int] = None):
+    """Drain-once read of the export buffer -> (span dicts oldest first,
+    cumulative drop count).  Each span is returned exactly once; drops
+    are cumulative so the reader can publish a monotonic counter."""
+    with _lock:
+        if _export_buf is None:
+            return [], _export_drops
+        n = len(_export_buf) if limit is None \
+            else min(int(limit), len(_export_buf))
+        out = [_export_buf.popleft() for _ in range(n)]
+        return out, _export_drops
 
 
 def chrome_trace_events() -> List[dict]:

@@ -41,6 +41,9 @@ from __future__ import annotations
 
 from .handoff import (KVHandoff, deserialize_kv,  # noqa: F401
                       serialize_kv)
+from .obs import (ClusterObserver, ClusterSignals,  # noqa: F401
+                  ReplicaSignals, federated_prometheus_text,
+                  serve_cluster_metrics)
 from .replica import Replica, replica_main  # noqa: F401
 from .router import (LocalReplica, RemoteReplica,  # noqa: F401
                      ReplicaHandle, Router)
@@ -53,5 +56,7 @@ __all__ = [
     "RpcServer", "RpcClient", "RpcError",
     "Replica", "replica_main",
     "Router", "ReplicaHandle", "LocalReplica", "RemoteReplica",
+    "ClusterObserver", "ClusterSignals", "ReplicaSignals",
+    "federated_prometheus_text", "serve_cluster_metrics",
     "ShardedModelSpec", "serving_shard_specs", "shard_admission_audit",
 ]

@@ -1,0 +1,510 @@
+"""Cluster observability plane: federation, trace assembly, signals.
+
+PR 15 made the repo a multi-process serving cluster; this module makes
+that cluster *observable as one system* instead of N processes that each
+keep secrets.  Three planes, all Router-side and pull-based (the replica
+RPC dialect is request/reply — a replica never needs a client back to
+the router):
+
+  * **Federated metrics** — every poll, the :class:`ClusterObserver`
+    issues the ``scrape`` RPC op and receives each live replica's full
+    typed-registry dump (:meth:`MetricsRegistry.dump` — raw per-bucket
+    histogram counts, the mergeable form the reservoir ``LatencyWindow``
+    can never provide).  :func:`federated_prometheus_text` renders the
+    merged view as ONE strict Prometheus exposition: every family
+    re-emitted with a ``replica`` label per source, plus ``cluster_*``
+    rollup families — sum for counters, bucket-sum for histograms
+    (cluster counts equal the sum of per-replica counts by
+    construction), and ``_max``/``_min`` gauges (a summed queue depth
+    would hide the hot replica).
+  * **Cross-process trace assembly** — replicas buffer finished spans in
+    a bounded drop-counted export buffer
+    (``profiler.tracing.enable_span_export``) which the scrape drains;
+    the router re-stamps each span onto its own wall timeline and writes
+    one merged trace JSONL that ``tools/obs_report.py --cluster`` joins
+    by trace_id.  Clock-skew correction rides the scrape request/reply
+    itself: the reply carries the replica's ``time.monotonic()`` at
+    serve time, and the router pins it to the midpoint of its own
+    send/recv walls — ``delta = (t_send + t_recv)/2 - replica_mono``
+    maps replica-monotonic span starts directly onto router wall time
+    (error bounded by half the RTT asymmetry), immune to the fact that
+    cross-thread child spans stamp ``wall`` at creation rather than at
+    their monotonic ``t0``.
+  * **ClusterSignals** — the typed snapshot ROADMAP item 4's autoscaler
+    polls: per-replica queue depth, retry-after EWMA, batch occupancy,
+    heartbeat staleness, steady-compile count, and the live-replica set,
+    published as ``cluster_replica_*`` gauges on every poll.
+
+Everything is host-side and fail-open per replica: one replica failing
+its scrape increments ``cluster_scrape_errors_total{replica}`` and the
+rest of the cluster stays observable.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ...profiler import tracing as _tracing
+from ...profiler.metrics import (_esc_label, _flat_stat_name, _fmt_value,
+                                 default_registry as _registry,
+                                 merge_dumps)
+
+__all__ = ["ClusterObserver", "ClusterSignals", "ReplicaSignals",
+           "federated_prometheus_text", "serve_cluster_metrics"]
+
+_SCRAPE_ERRORS = _registry().counter(
+    "cluster_scrape_errors_total",
+    "Failed scrape polls per replica — the federation stays partial "
+    "(and says so) instead of dying with one replica.",
+    labels=("replica",))
+_SPANS_SHIPPED = _registry().counter(
+    "cluster_trace_spans_shipped_total",
+    "Spans shipped from a replica's bounded export buffer into the "
+    "router's merged cluster trace, by replica.",
+    labels=("replica",))
+_SPAN_DROPS = _registry().gauge(
+    "cluster_trace_span_drops",
+    "Cumulative spans a replica dropped from its bounded export buffer "
+    "before any scrape drained them (a dead or slow router must never "
+    "grow replica memory).",
+    labels=("replica",))
+_SIG_QDEPTH = _registry().gauge(
+    "cluster_replica_queue_depth",
+    "ClusterSignals: serving-queue depth per live replica (scrape-poll "
+    "fresh — the autoscaler's primary load input).",
+    labels=("replica",))
+_SIG_RETRY = _registry().gauge(
+    "cluster_replica_retry_after_seconds",
+    "ClusterSignals: the replica queue's own drain-EWMA retry-after "
+    "estimate — the backpressure signal, before any rejection happens.",
+    labels=("replica",))
+_SIG_STALENESS = _registry().gauge(
+    "cluster_replica_heartbeat_staleness_seconds",
+    "ClusterSignals: seconds since the replica's last rendezvous-store "
+    "heartbeat at poll time (eviction fires past "
+    "FLAGS_router_stale_after_s).",
+    labels=("replica",))
+_SIG_STEADY = _registry().gauge(
+    "cluster_replica_steady_compiles",
+    "ClusterSignals: post-warm-up XLA recompiles per replica — any "
+    "nonzero value is a bucketing bug surfaced cluster-wide.",
+    labels=("replica",))
+_SIG_OCCUPANCY = _registry().gauge(
+    "cluster_replica_batch_occupancy_rows",
+    "ClusterSignals: average real rows per executed batch on the "
+    "replica (capacity-utilization input to scale-down decisions).",
+    labels=("replica",))
+_SIG_CLOCK = _registry().gauge(
+    "cluster_replica_clock_offset_seconds",
+    "Estimated replica wall-clock offset vs the router (scrape "
+    "request/reply midpoint) — the trace-assembly skew correction, "
+    "exposed so operators can see clock drift before it lies to them.",
+    labels=("replica",))
+_SIG_LIVE = _registry().gauge(
+    "cluster_signals_replicas_live",
+    "ClusterSignals: live-replica count at the last signals snapshot "
+    "(the scrape-plane view; router_replicas_live is the dispatch "
+    "plane's).")
+
+
+# ---------------------------------------------------------------------------
+# ClusterSignals: the autoscaler's typed snapshot
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ReplicaSignals:
+    """One replica's control inputs, as of the last scrape poll."""
+
+    replica_id: str
+    role: str
+    alive: bool
+    queue_depth: int
+    retry_after_s: float
+    batch_occupancy_rows: float
+    steady_compiles: int
+    heartbeat_staleness_s: float
+    inflight: int
+    dispatched: int
+    clock_offset_s: float
+
+
+@dataclass(frozen=True)
+class ClusterSignals:
+    """The cluster-wide snapshot ROADMAP item 4's autoscaler polls.
+
+    Scalar rollups are derived, never authoritative: ``replicas`` is the
+    ground truth and the rollups are what a threshold rule needs without
+    re-deriving (total backlog, worst backpressure, worst staleness)."""
+
+    wall: float
+    replicas_live: int
+    live_replicas: Tuple[str, ...]
+    total_queue_depth: int
+    max_retry_after_s: float
+    max_heartbeat_staleness_s: float
+    total_steady_compiles: int
+    replicas: Tuple[ReplicaSignals, ...] = field(default_factory=tuple)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# Federated exposition rendering
+# ---------------------------------------------------------------------------
+
+def _render_hist(lines: List[str], name: str, buckets, base: str,
+                 payload: dict) -> None:
+    """Append cumulative-bucket exposition lines for one histogram child
+    whose ``payload`` carries RAW per-bucket counts."""
+    acc = 0
+    cum = []
+    for c in payload["counts"]:
+        acc += int(c)
+        cum.append(acc)
+    for b, c in zip(buckets, cum):
+        le = f'le="{_fmt_value(b)}"'
+        lab = f"{base},{le}" if base else le
+        lines.append(f"{name}_bucket{{{lab}}} {c}")
+    lab = f'{base},le="+Inf"' if base else 'le="+Inf"'
+    lines.append(f"{name}_bucket{{{lab}}} {cum[-1] if cum else 0}")
+    sfx = f"{{{base}}}" if base else ""
+    lines.append(f"{name}_sum{sfx} {_fmt_value(payload['sum'])}")
+    lines.append(f"{name}_count{sfx} {int(payload['count'])}")
+
+
+def _labels_str(names, values) -> str:
+    return ",".join(f'{k}="{_esc_label(v)}"'
+                    for k, v in zip(names, values))
+
+
+def federated_prometheus_text(dumps: Dict[str, dict],
+                              include_stats: bool = True) -> str:
+    """One cluster exposition from per-source registry dumps
+    (``{source_id: MetricsRegistry.dump()}``).
+
+    Per family: every source's children re-emitted with a ``replica``
+    label (unless the family already carries one — router-owned
+    ``cluster_replica_*`` gauges pass through as-is), then a
+    ``cluster_<name>`` rollup — counter sum, histogram bucket-sum,
+    gauge ``_max``/``_min``.  With ``include_stats``, each source's
+    legacy monitor gauges follow as ``paddle_tpu_stat{name=,replica=}``
+    minus the keys its typed plane already mirrors.  Output parses under
+    ``tools/obs_report.py``'s strict parser — that IS the format gate."""
+    merged = merge_dumps(dumps)
+    lines: List[str] = []
+    for name in sorted(merged):
+        fam = merged[name]
+        has_children = any(fam["per_source"].values())
+        if not has_children:
+            continue
+        pass_through = "replica" in fam["labels"]
+        lines.append(f"# HELP {name} {fam['doc'] or name}")
+        lines.append(f"# TYPE {name} {fam['kind']}")
+        if pass_through:
+            # router-owned per-replica family: rollup would double-label
+            for values, payload in sorted(fam["rollup"].items()):
+                base = _labels_str(fam["labels"], values)
+                if fam["kind"] == "histogram":
+                    _render_hist(lines, name, fam["buckets"], base,
+                                 payload)
+                elif fam["kind"] == "gauge":
+                    sfx = f"{{{base}}}" if base else ""
+                    lines.append(
+                        f"{name}{sfx} {_fmt_value(payload['max'])}")
+                else:
+                    sfx = f"{{{base}}}" if base else ""
+                    lines.append(f"{name}{sfx} {_fmt_value(payload)}")
+            continue
+        for src in sorted(fam["per_source"]):
+            for values, payload in sorted(fam["per_source"][src].items()):
+                base = _labels_str(fam["labels"] + ("replica",),
+                                   values + (src,))
+                if fam["kind"] == "histogram":
+                    _render_hist(lines, name, fam["buckets"], base,
+                                 payload)
+                else:
+                    lines.append(
+                        f"{name}{{{base}}} {_fmt_value(payload)}")
+        # cluster rollup family
+        roll = f"cluster_{name}"
+        if fam["kind"] == "histogram":
+            lines.append(f"# HELP {roll} Cluster bucket-sum of {name}.")
+            lines.append(f"# TYPE {roll} histogram")
+            for values, payload in sorted(fam["rollup"].items()):
+                _render_hist(lines, roll, fam["buckets"],
+                             _labels_str(fam["labels"], values), payload)
+        elif fam["kind"] == "counter":
+            lines.append(f"# HELP {roll} Cluster sum of {name}.")
+            lines.append(f"# TYPE {roll} counter")
+            for values, payload in sorted(fam["rollup"].items()):
+                base = _labels_str(fam["labels"], values)
+                sfx = f"{{{base}}}" if base else ""
+                lines.append(f"{roll}{sfx} {_fmt_value(payload)}")
+        else:
+            for agg in ("max", "min"):
+                lines.append(f"# HELP {roll}_{agg} Cluster {agg} "
+                             f"of {name}.")
+                lines.append(f"# TYPE {roll}_{agg} gauge")
+                for values, payload in sorted(fam["rollup"].items()):
+                    base = _labels_str(fam["labels"], values)
+                    sfx = f"{{{base}}}" if base else ""
+                    lines.append(
+                        f"{roll}_{agg}{sfx} {_fmt_value(payload[agg])}")
+    if include_stats:
+        emitted_help = False
+        for src in sorted(dumps):
+            d = dumps[src]
+            stats = d.get("stats") or {}
+            if not stats:
+                continue
+            skip = set()
+            for fam in d.get("families", []):
+                for values, _ in fam["children"]:
+                    flat = _flat_stat_name(fam["name"], tuple(values))
+                    skip.add(flat + "_count"
+                             if fam["kind"] == "histogram" else flat)
+            if not emitted_help:
+                lines.append("# HELP paddle_tpu_stat monitor.h "
+                             "StatRegistry int64 gauges (legacy untyped "
+                             "plane, federated per replica)")
+                lines.append("# TYPE paddle_tpu_stat gauge")
+                emitted_help = True
+            for k in sorted(stats):
+                if k in skip:
+                    continue
+                lines.append(
+                    f'paddle_tpu_stat{{name="{_esc_label(k)}",'
+                    f'replica="{_esc_label(src)}"}} {stats[k]}')
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# The Router-side observer
+# ---------------------------------------------------------------------------
+
+class ClusterObserver:
+    """Polls every live replica's ``scrape`` op; owns federation state,
+    the merged cluster trace sink, and the ClusterSignals snapshot.
+
+    Attach to a :class:`Router` (``router.attach_observer(obs)`` makes
+    the watch loop drive it at heartbeat cadence) or call :meth:`poll`
+    on your own clock.  ``trace_dir`` arms cross-process trace assembly:
+    shipped spans land in ONE merged JSONL, re-stamped onto the router
+    wall timeline, tagged with their origin process."""
+
+    def __init__(self, router, trace_dir: Optional[str] = None,
+                 max_spans_per_poll: int = 2048):
+        self._router = router
+        self._lock = threading.Lock()
+        self._dumps: Dict[str, dict] = {}      # source -> last dump
+        self._deltas: Dict[str, float] = {}    # source mono -> router wall
+        self._offsets: Dict[str, float] = {}   # replica wall - router wall
+        self._shipped: Dict[str, int] = {}
+        self._signals: Optional[ClusterSignals] = None
+        self._max_spans = int(max_spans_per_poll)
+        self._trace_dir = trace_dir
+        self._writer = None
+        if trace_dir:
+            from ...utils.monitor import LogWriter
+            self._writer = LogWriter(logdir=trace_dir,
+                                     filename_suffix=".cluster")
+            # the router's own spans (route/dispatch) join the merged
+            # trace through the same export buffer mechanism
+            _tracing.enable_span_export()
+
+    # -- polling -------------------------------------------------------------
+    def poll(self) -> ClusterSignals:
+        """One federation round: scrape live replicas, merge metrics,
+        assemble shipped spans, publish signal gauges.  Per-replica
+        failures count and skip — never raise."""
+        per_replica: List[ReplicaSignals] = []
+        handles = self._router.handles()
+        staleness = self._heartbeat_staleness(
+            [h.id for h in handles if h.alive])
+        for h in handles:
+            if not h.alive:
+                continue
+            try:
+                t_send = time.time()
+                scrape = h.scrape(max_spans=self._max_spans)
+                t_recv = time.time()
+            except Exception:   # noqa: BLE001 — observability is fail-open
+                _SCRAPE_ERRORS.labels(h.id).inc()
+                continue
+            mid = 0.5 * (t_send + t_recv)
+            delta = mid - float(scrape.get("mono", mid))
+            offset = float(scrape.get("wall", mid)) - mid
+            with self._lock:
+                # EWMA over polls: each estimate is midpoint-noisy by
+                # half the RTT; smoothing converges on the true offset
+                prev = self._deltas.get(h.id)
+                self._deltas[h.id] = delta if prev is None \
+                    else 0.5 * prev + 0.5 * delta
+                prevo = self._offsets.get(h.id)
+                self._offsets[h.id] = offset if prevo is None \
+                    else 0.5 * prevo + 0.5 * offset
+                if scrape.get("dump"):
+                    self._dumps[h.id] = scrape["dump"]
+                delta = self._deltas[h.id]
+                offset = self._offsets[h.id]
+            self._sink_spans(h.id, scrape.get("spans") or [], delta)
+            drops = int(scrape.get("span_drops", 0))
+            if drops:
+                _SPAN_DROPS.labels(h.id).set(drops)
+            sig = scrape.get("signals") or {}
+            rs = ReplicaSignals(
+                replica_id=h.id, role=h.role, alive=True,
+                queue_depth=int(sig.get("queue_depth", 0)),
+                retry_after_s=float(sig.get("retry_after_s", 0.0)),
+                batch_occupancy_rows=float(
+                    sig.get("batch_occupancy_rows", 0.0)),
+                steady_compiles=int(sig.get("steady_compiles", 0)),
+                heartbeat_staleness_s=float(staleness.get(h.id, 0.0)),
+                inflight=int(h.inflight), dispatched=int(h.dispatched),
+                clock_offset_s=offset)
+            per_replica.append(rs)
+            _SIG_QDEPTH.labels(h.id).set(rs.queue_depth)
+            _SIG_RETRY.labels(h.id).set(rs.retry_after_s)
+            _SIG_STALENESS.labels(h.id).set(rs.heartbeat_staleness_s)
+            _SIG_STEADY.labels(h.id).set(rs.steady_compiles)
+            _SIG_OCCUPANCY.labels(h.id).set(rs.batch_occupancy_rows)
+            _SIG_CLOCK.labels(h.id).set(rs.clock_offset_s)
+        if self._writer is not None:
+            # the router's own finished spans, mono -> own wall
+            spans, _ = _tracing.drain_exported_spans()
+            self._sink_spans("router", spans,
+                             time.time() - time.monotonic())
+        sig = ClusterSignals(
+            wall=time.time(),
+            replicas_live=len(per_replica),
+            live_replicas=tuple(sorted(r.replica_id
+                                       for r in per_replica)),
+            total_queue_depth=sum(r.queue_depth for r in per_replica),
+            max_retry_after_s=max(
+                [r.retry_after_s for r in per_replica] or [0.0]),
+            max_heartbeat_staleness_s=max(
+                [r.heartbeat_staleness_s for r in per_replica] or [0.0]),
+            total_steady_compiles=sum(r.steady_compiles
+                                      for r in per_replica),
+            replicas=tuple(per_replica))
+        _SIG_LIVE.set(sig.replicas_live)
+        with self._lock:
+            self._signals = sig
+        return sig
+
+    def _heartbeat_staleness(self, ids) -> Dict[str, float]:
+        store = getattr(self._router, "_store", None)
+        if store is None:
+            return {}
+        out = {}
+        now = time.time()
+        for rid in ids:
+            try:
+                raw = store.get(f"__hb/replica:{rid}", wait=False)
+                if raw:
+                    out[rid] = max(0.0, now - float(raw.decode()))
+            except Exception:   # noqa: BLE001 — staleness is best-effort
+                pass
+        return out
+
+    def _sink_spans(self, source: str, spans, delta: float) -> None:
+        """Re-stamp spans from ``source``'s monotonic domain onto the
+        router wall timeline (t0 += delta) and append to the merged
+        trace JSONL.  Original stamps ride along for forensics."""
+        if self._writer is None or not spans:
+            return
+        for s in spans:
+            rec = dict(s)
+            rec["t0_mono"] = rec["t0"]
+            rec["t0"] = float(rec["t0"]) + delta
+            rec["process"] = source
+            self._writer.add_event("trace/span", rec)
+        _SPANS_SHIPPED.labels(source).inc(len(spans))
+        with self._lock:
+            self._shipped[source] = \
+                self._shipped.get(source, 0) + len(spans)
+
+    # -- read surface --------------------------------------------------------
+    def signals(self) -> Optional[ClusterSignals]:
+        """Latest ClusterSignals snapshot (None before the first poll) —
+        the API the autoscaler polls."""
+        with self._lock:
+            return self._signals
+
+    def dumps(self) -> Dict[str, dict]:
+        """Last-known registry dump per source, router's own included
+        (the federation input set)."""
+        with self._lock:
+            out = dict(self._dumps)
+        out["router"] = _registry().dump(include_stats=True)
+        return out
+
+    def federated_text(self) -> str:
+        """The cluster ``/metrics`` exposition (strict Prometheus
+        0.0.4): replica-labeled families + ``cluster_*`` rollups."""
+        return federated_prometheus_text(self.dumps())
+
+    def write_textfile(self, path: str) -> str:
+        """Atomically persist the federated exposition (node-exporter
+        textfile convention, same as profiler.metrics.write_textfile)."""
+        import os
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.federated_text())
+        os.replace(tmp, path)
+        return path
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"sources": sorted(self._dumps),
+                    "spans_shipped": dict(self._shipped),
+                    "clock_offset_s": dict(self._offsets),
+                    "trace_dir": self._trace_dir}
+
+    def close(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:   # noqa: BLE001
+                pass
+            self._writer = None
+
+
+def serve_cluster_metrics(observer: ClusterObserver, port: int = 0,
+                          addr: str = "127.0.0.1"):
+    """Serve the FEDERATED exposition over HTTP (``GET /metrics``) —
+    the cluster's single scrape door, same stdlib server as
+    profiler.metrics.serve_metrics; ``.port`` on the handle reports the
+    bound port."""
+    import threading as _threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from ...profiler.metrics import _MetricsServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            body = observer.federated_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):      # no stderr chatter per scrape
+            pass
+
+    httpd = ThreadingHTTPServer((addr, int(port)), Handler)
+    t = _threading.Thread(target=httpd.serve_forever,
+                          name="paddle-tpu-cluster-metrics", daemon=True)
+    t.start()
+    return _MetricsServer(httpd, t)

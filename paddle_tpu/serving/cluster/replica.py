@@ -20,11 +20,15 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 from ...framework import flags as _flags
+from ...profiler import flight as _flight
+from ...profiler import tracing as _tracing
+from ...profiler.metrics import default_registry as _metrics_registry
 from .rpc import RpcServer, decode_arrays, encode_arrays
 
 __all__ = ["Replica", "replica_main", "REPLICA_PREFIX"]
@@ -51,6 +55,12 @@ class Replica:
     def start(self) -> "Replica":
         if not self.server._started:
             self.server.start()
+        # cluster observability: buffer finished spans for the router's
+        # scrape to drain (bounded + drop-counted; empty while tracing
+        # is off) and arm the flight recorder when FLAGS_flight_dir is
+        # set — a replica that dies must leave evidence.
+        _tracing.enable_span_export()
+        _flight.install(ident=self.id)
         self._rpc = RpcServer(self._handlers(), port=self.port)
         self.port = self._rpc.port
         if self._store is not None:
@@ -83,8 +93,9 @@ class Replica:
     # -- RPC surface ---------------------------------------------------------
     def _handlers(self) -> Dict[str, Any]:
         return {"ping": self._op_ping, "health": self._op_health,
-                "stats": self._op_stats, "infer": self._op_infer,
-                "decode": self._op_decode, "prefill": self._op_prefill,
+                "stats": self._op_stats, "scrape": self._op_scrape,
+                "infer": self._op_infer, "decode": self._op_decode,
+                "prefill": self._op_prefill,
                 "decode_from": self._op_decode_from}
 
     def _op_ping(self, meta, parts):
@@ -101,6 +112,19 @@ class Replica:
 
     def _op_stats(self, meta, parts):
         return {"stats": self.server.stats(meta.get("model"))}, []
+
+    def _op_scrape(self, meta, parts):
+        """The federation op: full typed-registry dump (mergeable raw
+        histogram counts), the drained span export buffer (bounded,
+        drop-counted), this replica's signal snapshot, and a
+        (monotonic, wall) clock pair for the router's skew estimate."""
+        spans, drops = _tracing.drain_exported_spans(
+            limit=meta.get("max_spans"))
+        return {"id": self.id, "role": self.role,
+                "wall": time.time(), "mono": time.monotonic(),
+                "dump": _metrics_registry().dump(include_stats=True),
+                "spans": spans, "span_drops": drops,
+                "signals": self.server.signals()}, []
 
     def _op_infer(self, meta, parts):
         inputs = decode_arrays(meta["arrays"], parts)
@@ -122,18 +146,42 @@ class Replica:
         return {"arrays": ometa}, oparts
 
     def _op_prefill(self, meta, parts):
+        # the prefill leg of a disaggregated chain joins the router's
+        # trace: a "prefill" span for the compute, a "handoff" child for
+        # the serialize leg — obs_report --cluster reassembles
+        # route -> prefill -> handoff -> decode across processes
         prompts = decode_arrays(meta["prompts"], parts)
-        h = self.server.prefill_handoff(meta["model"], prompts,
-                                        meta.get("max_new"))
+        tr = _tracing.start_span("prefill", trace_id=meta.get("trace_id"),
+                                 replica=self.id, pool="prefill",
+                                 model=meta["model"])
+        with _tracing.use_span(tr):
+            h = self.server.prefill_handoff(meta["model"], prompts,
+                                            meta.get("max_new"))
         if meta.get("trace_id"):
             h.meta["trace_id"] = meta["trace_id"]
+        t0 = time.monotonic()
         blob = h.to_bytes()
+        _tracing.child(tr, "handoff", t0, time.monotonic(),
+                       leg="serialize", nbytes=len(blob),
+                       replica=self.id)
+        _tracing.finish(tr)
         return {"rows": int(h.meta.get("rows", 0)),
                 "max_new": int(h.meta.get("max_new", 0)),
                 "nbytes": len(blob)}, [blob]
 
     def _op_decode_from(self, meta, parts):
-        toks = self.server.decode_from_handoff(meta["model"], parts[0])
+        from .handoff import deserialize_kv
+        tr = _tracing.start_span("decode", trace_id=meta.get("trace_id"),
+                                 replica=self.id, pool="decode",
+                                 model=meta["model"])
+        t0 = time.monotonic()
+        handoff = deserialize_kv(bytes(parts[0]))
+        _tracing.child(tr, "handoff", t0, time.monotonic(),
+                       leg="deserialize", nbytes=len(parts[0]),
+                       replica=self.id)
+        with _tracing.use_span(tr):
+            toks = self.server.decode_from_handoff(meta["model"], handoff)
+        _tracing.finish(tr)
         ometa, oparts = encode_arrays([np.asarray(toks)])
         return {"arrays": ometa}, oparts
 

@@ -41,6 +41,7 @@ import numpy as np
 
 from ...framework import flags as _flags
 from ...framework.enforce import UnavailableError
+from ...profiler import flight as _flight
 from ...profiler import tracing as _tracing
 from ...profiler.metrics import default_registry as _registry
 from .replica import REPLICA_PREFIX
@@ -68,6 +69,12 @@ _REPLICA_QDEPTH = _registry().gauge(
     "Last health-reported serving-queue depth per replica — the "
     "least-loaded dispatch signal beyond the router's own in-flight "
     "counts.",
+    labels=("replica",))
+_STATS_POLL_ERRORS = _registry().counter(
+    "router_stats_poll_errors_total",
+    "Health/stats polls that raised, by replica.  The heartbeat still "
+    "decides death — but a replica whose stats are silently stale is "
+    "visible here BEFORE the eviction verdict.",
     labels=("replica",))
 
 
@@ -116,6 +123,14 @@ class ReplicaHandle:
         """Per-model serving stats of the replica (Server.stats())."""
         return {}
 
+    def scrape(self, max_spans: Optional[int] = None) -> dict:
+        """Observability pull (cluster/obs.py federation): the replica's
+        registry dump, drained export-buffer spans + drop count, signal
+        snapshot, and a (mono, wall) clock pair for skew estimation."""
+        return {"id": self.id, "role": self.role, "wall": time.time(),
+                "mono": time.monotonic(), "dump": None, "spans": [],
+                "span_drops": 0, "signals": {}}
+
     def close(self):
         pass
 
@@ -159,6 +174,20 @@ class LocalReplica(ReplicaHandle):
 
     def model_stats(self) -> dict:
         return self.server.stats()
+
+    def scrape(self, max_spans: Optional[int] = None) -> dict:
+        """In-process scrape: same contract as the RPC op.  NOTE: local
+        replicas share one process, hence ONE registry/span buffer — the
+        first local handle scraped per poll drains it; the federation
+        sees process-truth, not per-handle fiction."""
+        from ...profiler import tracing as _tr
+        from ...profiler.metrics import default_registry
+        spans, drops = _tr.drain_exported_spans(limit=max_spans)
+        return {"id": self.id, "role": self.role, "wall": time.time(),
+                "mono": time.monotonic(),
+                "dump": default_registry().dump(include_stats=True),
+                "spans": spans, "span_drops": drops,
+                "signals": self.server.signals()}
 
 
 class RemoteReplica(ReplicaHandle):
@@ -214,6 +243,11 @@ class RemoteReplica(ReplicaHandle):
         meta, _ = self._client.request("stats", {}, timeout=10.0)
         return meta["stats"]
 
+    def scrape(self, max_spans: Optional[int] = None) -> dict:
+        meta, _ = self._client.request(
+            "scrape", {"max_spans": max_spans}, timeout=10.0)
+        return meta
+
     def close(self):
         self._client.close()
 
@@ -238,6 +272,7 @@ class Router:
             stale_after_s if stale_after_s is not None
             else _flags.flag("router_stale_after_s"))
         self._monitor = None
+        self._observer = None
         self._stop = threading.Event()
         self._watcher = None
         self._pool = ThreadPoolExecutor(max_workers=int(dispatch_workers),
@@ -279,6 +314,9 @@ class Router:
         _REPLICAS_LIVE.set(self.replicas_live())
         _tracing.event("router_evict", replica=str(replica_id),
                        reason=reason)
+        # an eviction is a postmortem-worthy cluster event: snapshot the
+        # router's own flight recorder (no-op while disarmed)
+        _flight.dump("watchdog_evict")
         return True
 
     def handles(self) -> List[ReplicaHandle]:
@@ -300,6 +338,11 @@ class Router:
             self._discover()
             self._evict_stale()
         self._refresh_health()
+        if self._observer is not None:
+            try:
+                self._observer.poll()
+            except Exception:   # noqa: BLE001 — observability is fail-open
+                pass
 
     def _discover(self):
         raw = self._store.get(f"{REPLICA_PREFIX}/seq", wait=False)
@@ -329,6 +372,7 @@ class Router:
                 h.queue_depth = int(info.get("queue_depth", 0))
                 _REPLICA_QDEPTH.labels(h.id).set(h.queue_depth)
             except Exception:   # noqa: BLE001 — the heartbeat decides death
+                _STATS_POLL_ERRORS.labels(h.id).inc()
                 h.backoff_until = time.monotonic() + self._stale_after
 
     def _watch_loop(self):
@@ -495,6 +539,18 @@ class Router:
             raise
 
     # -- observability + lifecycle -------------------------------------------
+    def attach_observer(self, observer):
+        """Attach a cluster observer (cluster/obs.ClusterObserver): the
+        watch loop drives ``observer.poll()`` at heartbeat cadence right
+        after health refresh, so federation/trace-assembly/signals share
+        the liveness view they were sampled under.  The observer's
+        lifetime stays the caller's."""
+        self._observer = observer
+        return observer
+
+    def observer(self):
+        return self._observer
+
     def stats(self) -> dict:
         out = {"replicas_live": self.replicas_live(), "replicas": {}}
         for h in self.handles():

@@ -217,6 +217,19 @@ class RequestQueue:
         with self._cond:
             return self._depth
 
+    def signals(self) -> dict:
+        """The queue's autoscaling inputs in one locked read: current
+        depth, the drain-rate EWMA (requests/s the batcher is actually
+        popping), and the same retry-after estimate backpressure
+        rejections carry — what cluster/obs.ClusterSignals publishes
+        per replica."""
+        with self._cond:
+            depth, rate = self._depth, self._drain_ewma
+        retry = 0.1 if rate <= 0 else min(5.0, max(0.01, 1.0 / rate))
+        return {"queue_depth": depth,
+                "drain_rate_rps": round(rate, 3),
+                "retry_after_s": round(retry, 4)}
+
     def drain(self) -> List[Request]:
         """Pop everything still pending (stop without serving them)."""
         with self._cond:

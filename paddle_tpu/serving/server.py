@@ -1012,6 +1012,27 @@ class Server:
             "steady_compiles": c["steady_compiles"],
         }
 
+    def signals(self) -> dict:
+        """This process's autoscaling-signal snapshot (the per-replica
+        leg of cluster/obs.ClusterSignals): queue depth + retry-after
+        EWMA from the RequestQueue, average batch occupancy and the
+        steady-state recompile count summed over models."""
+        out = {"queue_depth": 0, "retry_after_s": 0.0,
+               "drain_rate_rps": 0.0}
+        if self._queue is not None:
+            out.update(self._queue.signals())
+        rows = batches = steady = 0
+        for rt in self._models.values():
+            c = rt.counters
+            rows += c.get("rows", 0)
+            batches += c.get("batches", 0)
+            steady += c.get("steady_compiles", 0)
+        out["batch_occupancy_rows"] = round(rows / batches, 3) \
+            if batches else 0.0
+        out["steady_compiles"] = steady
+        out["models"] = self.models()
+        return out
+
 
 def create_server(config: Optional[ServingConfig] = None) -> Server:
     """Factory mirroring inference.create_predictor."""

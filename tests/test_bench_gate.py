@@ -1,0 +1,107 @@
+"""tools/bench_gate.py: the noise-aware regression gate between two
+bench.py --json rounds — tolerance bands, per-metric overrides,
+dispersion widening off the rounds' own dispatch-floor health, missing
+metrics failing loud, and the CLI's --json / rc contract on two
+synthetic rounds (the fast self-test the slow bench lane gates with)."""
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate", os.path.join(REPO, "tools", "bench_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _round(values, degraded=False, floor_ms=100.0, n=1):
+    wl = {name: {"value": v, "unit": "img/s", "vs_baseline": 1.0}
+          for name, v in values.items()}
+    parsed = {"metric": sorted(values)[0], "value": list(values.values())[0],
+              "unit": "img/s", "dispatch_floor_ms": floor_ms,
+              "workloads": wl}
+    if degraded:
+        parsed["degraded"] = True
+        parsed["floor_ratio"] = 20.0
+    return {"n": n, "cmd": "bench", "rc": 0, "tail": "", "parsed": parsed}
+
+
+def test_within_tolerance_passes_and_improvement_tagged():
+    bg = _load()
+    old = _round({"a": 1000.0, "b": 500.0})
+    new = _round({"a": 970.0, "b": 800.0})       # -3% and +60%
+    report, rc = bg.compare(old, new, default_tol_pct=5.0)
+    assert rc == 0
+    assert report["metrics"]["a"]["verdict"] == "ok"
+    assert report["metrics"]["b"]["verdict"] == "improved"
+    assert report["dispersed"] is False
+
+
+def test_regression_outside_tolerance_fails():
+    bg = _load()
+    old = _round({"a": 1000.0})
+    new = _round({"a": 900.0})                   # -10% > 5% band
+    report, rc = bg.compare(old, new, default_tol_pct=5.0)
+    assert rc == 1
+    assert report["metrics"]["a"]["verdict"] == "regression"
+    assert report["metrics"]["a"]["delta_pct"] == -10.0
+
+
+def test_dispersion_widens_tolerance():
+    bg = _load()
+    old = _round({"a": 1000.0})
+    new_clean = _round({"a": 900.0})
+    new_degraded = _round({"a": 900.0}, degraded=True)
+    _, rc_clean = bg.compare(old, new_clean, default_tol_pct=5.0,
+                             dispersion_widen=3.0)
+    report, rc_deg = bg.compare(old, new_degraded, default_tol_pct=5.0,
+                                dispersion_widen=3.0)
+    assert rc_clean == 1                 # -10% fails the 5% band
+    assert rc_deg == 0                   # ... but rides the widened 15%
+    assert report["dispersed"] is True
+    assert report["metrics"]["a"]["tolerance_pct"] == 15.0
+    # floor drift between rounds also flags dispersion, degraded or not
+    drifted = _round({"a": 900.0}, floor_ms=150.0)
+    report, rc = bg.compare(old, drifted, default_tol_pct=5.0,
+                            floor_drift_pct=20.0)
+    assert report["dispersed"] is True and rc == 0
+
+
+def test_missing_metric_is_a_regression_new_metric_is_not():
+    bg = _load()
+    old = _round({"a": 1000.0, "gone": 10.0})
+    new = _round({"a": 1000.0, "fresh": 5.0})
+    report, rc = bg.compare(old, new)
+    assert rc == 1
+    assert report["metrics"]["gone"]["verdict"] == "missing"
+    assert report["metrics"]["fresh"]["verdict"] == "new"
+    assert report["metrics"]["a"]["verdict"] == "ok"
+
+
+def test_per_metric_tolerance_override():
+    bg = _load()
+    old = _round({"jittery": 1000.0, "stable": 1000.0})
+    new = _round({"jittery": 800.0, "stable": 800.0})
+    report, rc = bg.compare(old, new, default_tol_pct=5.0,
+                            per_metric={"jittery": 30.0})
+    assert rc == 1
+    assert report["metrics"]["jittery"]["verdict"] == "ok"
+    assert report["metrics"]["stable"]["verdict"] == "regression"
+
+
+def test_cli_json_mode_and_rc(tmp_path, capsys):
+    bg = _load()
+    po, pn = tmp_path / "old.json", tmp_path / "new.json"
+    po.write_text(json.dumps(_round({"a": 1000.0})))
+    pn.write_text(json.dumps(_round({"a": 940.0})))
+    rc = bg.main([str(po), str(pn), "--tolerance-pct", "10", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["rc"] == 0
+    assert out["metrics"]["a"]["verdict"] == "ok"
+    rc = bg.main([str(po), str(pn), "--tolerance-pct", "2"])
+    text = capsys.readouterr().out
+    assert rc == 1 and "REGRESSION" in text

@@ -1,0 +1,444 @@
+"""Cluster observability plane (serving.cluster.obs + profiler.flight +
+profiler.tracing span export): mergeable histogram math, the federated
+Prometheus exposition round-tripping through the strict parser with
+cluster counts equal to the sum of per-replica counts, the bounded
+drop-counted span export buffer, clock-skew-corrected cross-process
+trace assembly judged by obs_report's cluster checker, ClusterSignals
+snapshots + gauges, fail-open scrape errors, the router's stats-poll
+error counter, the scrape RPC op end to end, and the flight recorder's
+atomic postmortem artifacts read by obs_report --postmortem."""
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from paddle_tpu.framework.flags import (flags_restore, flags_snapshot,
+                                        set_flags)
+from paddle_tpu.profiler import flight as flight_mod
+from paddle_tpu.profiler import tracing
+from paddle_tpu.profiler.metrics import (MetricsRegistry,
+                                         merge_dumps,
+                                         merge_histogram_payloads)
+from paddle_tpu.serving.cluster import obs as obs_mod
+from paddle_tpu.serving.cluster import (ClusterObserver, Router,
+                                        federated_prometheus_text)
+from paddle_tpu.serving.cluster.router import ReplicaHandle
+from paddle_tpu.utils.monitor import LogWriter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def flags_guard():
+    snap = flags_snapshot()
+    try:
+        yield
+    finally:
+        flags_restore(snap)
+
+
+@pytest.fixture
+def trace_guard(flags_guard):
+    """Full tracing into the (cleared) export buffer; everything off and
+    empty again afterwards."""
+    set_flags({"FLAGS_trace": "full"})
+    tracing.enable_span_export()
+    tracing.clear()
+    tracing.drain_exported_spans()
+    try:
+        yield
+    finally:
+        tracing.clear()
+        tracing.disable_span_export()
+
+
+# -- mergeable histogram math -------------------------------------------------
+
+def test_histogram_merge_is_associative_and_commutative():
+    a = {"counts": [1, 2, 3], "sum": 1.5, "count": 6}
+    b = {"counts": [0, 4, 1], "sum": 2.0, "count": 5}
+    c = {"counts": [2, 0, 0], "sum": 0.1, "count": 2}
+    ab_c = merge_histogram_payloads(
+        [merge_histogram_payloads([a, b]), c])
+    a_bc = merge_histogram_payloads(
+        [a, merge_histogram_payloads([b, c])])
+    ba = merge_histogram_payloads([b, a])
+    assert ab_c == a_bc
+    assert ba == merge_histogram_payloads([a, b])
+    assert ab_c["counts"] == [3, 6, 4]
+    assert ab_c["count"] == 13
+    assert abs(ab_c["sum"] - 3.6) < 1e-9
+    with pytest.raises(ValueError):
+        merge_histogram_payloads([a, {"counts": [1, 2], "sum": 0,
+                                      "count": 3}])
+
+
+def test_merge_dumps_rollups_partial_and_empty_label_sets():
+    r1, r2, r3 = MetricsRegistry(), MetricsRegistry(), MetricsRegistry()
+    for reg in (r1, r2):
+        c = reg.counter("t_req_total", "reqs", labels=("model",))
+        g = reg.gauge("t_depth", "depth")
+        h = reg.histogram("t_lat_seconds", "lat", buckets=(0.1, 1.0))
+        del c, g, h
+    r1.counter("t_req_total", "reqs", labels=("model",)) \
+        .labels(model="a").inc(3)
+    r1.gauge("t_depth", "depth").set(5)
+    r1.histogram("t_lat_seconds", "lat",
+                 buckets=(0.1, 1.0)).observe(0.05)
+    # partial overlap: r2 only saw model=b, and a different gauge value
+    r2.counter("t_req_total", "reqs", labels=("model",)) \
+        .labels(model="b").inc(2)
+    r2.counter("t_req_total", "reqs", labels=("model",)) \
+        .labels(model="a").inc(10)
+    r2.gauge("t_depth", "depth").set(2)
+    r2.histogram("t_lat_seconds", "lat", buckets=(0.1, 1.0)).observe(5.0)
+    # r3 is an EMPTY source: registered families, no observations at all
+    merged = merge_dumps({"r1": r1.dump(), "r2": r2.dump(),
+                          "r3": r3.dump()})
+    cnt = merged["t_req_total"]
+    assert cnt["rollup"][("a",)] == 13.0          # cross-source sum
+    assert cnt["rollup"][("b",)] == 2.0           # r1 never saw b
+    assert merged["t_depth"]["rollup"][()] == {"max": 5.0, "min": 2.0}
+    hist = merged["t_lat_seconds"]["rollup"][()]
+    assert hist["counts"] == [1, 0, 1] and hist["count"] == 2
+    # cross-source schema disagreement is loud, not silently merged
+    r4 = MetricsRegistry()
+    r4.histogram("t_lat_seconds", "lat", buckets=(0.5,)).observe(0.2)
+    with pytest.raises(ValueError):
+        merge_dumps({"r1": r1.dump(), "r4": r4.dump()})
+
+
+def test_federated_exposition_round_trips_and_sums():
+    obs_report = _load_tool("obs_report")
+    regs = {f"replica{i}": MetricsRegistry() for i in range(3)}
+    for i, (rid, reg) in enumerate(sorted(regs.items())):
+        h = reg.histogram("t_wait_seconds", "wait",
+                          buckets=(0.01, 0.1, 1.0))
+        for k in range(i + 1):
+            h.observe(0.05 * (k + 1))
+        reg.counter("t_total", "total").inc(10 * (i + 1))
+        reg.gauge("t_gauge", "g").set(float(i))
+    text = federated_prometheus_text(
+        {rid: reg.dump() for rid, reg in regs.items()})
+    fams = obs_report.parse_prometheus_text(text)   # strict: raises on bad
+    # cluster histogram count == sum of per-replica counts
+    per_replica = fams["t_wait_seconds_count"]
+    assert len(per_replica) == 3
+    assert sum(per_replica.values()) == 1 + 2 + 3
+    assert fams["cluster_t_wait_seconds_count"][""] == 6.0
+    # cluster bucket values are bucket sums
+    assert sum(v for k, v in fams["t_wait_seconds_bucket"].items()
+               if 'le="+Inf"' in k) == 6.0
+    assert fams["cluster_t_wait_seconds_bucket"]['le="+Inf"'] == 6.0
+    assert fams["cluster_t_total"][""] == 60.0
+    assert fams["cluster_t_gauge_max"][""] == 2.0
+    assert fams["cluster_t_gauge_min"][""] == 0.0
+    for labels in fams["t_total"]:
+        assert 'replica="' in labels
+
+
+# -- span export buffer -------------------------------------------------------
+
+def test_span_export_buffer_bounded_and_drop_counted(trace_guard):
+    tracing.enable_span_export(cap=4)
+    for i in range(6):
+        tracing.finish(tracing.start_span(f"s{i}"))
+    spans, drops = tracing.drain_exported_spans()
+    assert [s["name"] for s in spans] == ["s2", "s3", "s4", "s5"]
+    assert drops == 2                       # oldest two displaced
+    again, drops2 = tracing.drain_exported_spans()
+    assert again == [] and drops2 == 2      # drain-once; drops cumulative
+    tracing.finish(tracing.start_span("late"))
+    spans, _ = tracing.drain_exported_spans(limit=5)
+    assert [s["name"] for s in spans] == ["late"]
+
+
+def test_span_export_disabled_is_inert(flags_guard):
+    tracing.disable_span_export()
+    set_flags({"FLAGS_trace": "full"})
+    tracing.finish(tracing.start_span("unbuffered"))
+    spans, drops = tracing.drain_exported_spans()
+    assert spans == [] and drops == 0
+
+
+# -- ClusterObserver: skew correction, signals, fail-open ---------------------
+
+class _StubHandle(ReplicaHandle):
+    """A fake live replica whose scrape reply the test scripts."""
+
+    def __init__(self, replica_id, reply=None, role="both",
+                 fail=False):
+        super().__init__(replica_id, role)
+        self.reply = reply or {}
+        self.fail = fail
+        self.scrapes = 0
+
+    def scrape(self, max_spans=None):
+        self.scrapes += 1
+        if self.fail:
+            raise ConnectionError("replica gone")
+        out = {"id": self.id, "role": self.role, "wall": time.time(),
+               "mono": time.monotonic(), "dump": None, "spans": [],
+               "span_drops": 0, "signals": {}}
+        out.update(self.reply() if callable(self.reply) else self.reply)
+        return out
+
+
+class _StubRouter:
+    _store = None
+
+    def __init__(self, handles):
+        self._h = handles
+
+    def handles(self):
+        return self._h
+
+
+def _replica_span(name, trace_id, t0, dur_s, wall, **attrs):
+    return {"trace_id": trace_id, "span_id": id(name) % 100000,
+            "parent_id": None, "name": name, "t0": t0,
+            "dur_ms": dur_s * 1e3, "wall": wall, "attrs": attrs,
+            "events": []}
+
+
+def test_clock_skew_correction_reassembles_cluster_chain(
+        trace_guard, tmp_path):
+    """Replica spans arrive in a monotonic domain skewed by minutes; the
+    scrape-midpoint delta must land them back inside the route window so
+    the disaggregated chain judges complete and well-nested."""
+    obs_report = _load_tool("obs_report")
+    skew = 123.456                      # replica mono = router mono + skew
+    wall_off = 7.0                      # replica wall clock runs 7 s fast
+    now_m = time.monotonic()
+
+    # the router's OWN route span: real tracing, real export buffer
+    route = tracing.start_span("route", t0=now_m - 1.0, kind="decode")
+    tid = route.trace_id
+    tracing.child(route, "dispatch", now_m - 0.95, now_m - 0.5,
+                  replica="rp", op="prefill")
+    tracing.child(route, "dispatch", now_m - 0.5, now_m - 0.05,
+                  replica="rd", op="decode_from")
+    tracing.finish(route, end=now_m)
+
+    def prefill_reply():
+        m = time.monotonic() + skew
+        return {"mono": m, "wall": time.time() + wall_off,
+                "spans": [
+                    _replica_span("prefill", tid, m - 0.95 + 0.01, 0.4,
+                                  time.time() + wall_off),
+                    _replica_span("handoff", tid, m - 0.6, 0.05,
+                                  time.time() + wall_off,
+                                  leg="serialize")]}
+
+    def decode_reply():
+        m = time.monotonic() + skew
+        return {"mono": m,
+                "spans": [_replica_span("decode", tid, m - 0.45, 0.35,
+                                        time.time())]}
+
+    router = _StubRouter([_StubHandle("rp", prefill_reply,
+                                      role="prefill"),
+                          _StubHandle("rd", decode_reply,
+                                      role="decode")])
+    obs = ClusterObserver(router, trace_dir=str(tmp_path))
+    for _ in range(3):                  # EWMA has polls to converge over
+        obs.poll()
+    obs.close()
+
+    spans = LogWriter.read_events(str(tmp_path)).get("trace/span", [])
+    chain = [s for s in spans if s["trace_id"] == tid]
+    names = {s["name"] for s in chain}
+    assert {"route", "dispatch", "prefill", "handoff",
+            "decode"} <= names
+    ok, problems = obs_report.check_cluster_chain(chain)
+    assert ok, problems
+    # every shipped span re-aligned onto the router wall timeline
+    by_name = {s["name"]: s for s in chain}
+    root = by_name["route"]
+    pf = by_name["prefill"]
+    assert abs(pf["t0"] - (root["t0"] + 0.06)) < 0.05
+    assert pf["process"] == "rp" and pf["t0_mono"] != pf["t0"]
+    # the exposed clock-offset gauge converged on the walls' difference
+    off = obs_mod._SIG_CLOCK.labels("rp").value
+    assert abs(off - wall_off) < 0.5
+    # and the report machinery judges the assembled trace cluster-shaped
+    report, rc = obs_report.build_report({tid: chain}, cluster=True)
+    assert rc == 0
+    assert report["shapes"] == {"disaggregated": 1}
+    assert report["max_processes"] >= 2
+
+
+def test_cluster_signals_snapshot_and_gauges(flags_guard):
+    router = _StubRouter([
+        _StubHandle("r0", {"signals": {"queue_depth": 4,
+                                       "retry_after_s": 0.25,
+                                       "batch_occupancy_rows": 1.5,
+                                       "steady_compiles": 0}}),
+        _StubHandle("r1", {"signals": {"queue_depth": 1,
+                                       "retry_after_s": 0.1,
+                                       "batch_occupancy_rows": 2.0,
+                                       "steady_compiles": 2}}),
+        _StubHandle("dead", fail=True),
+    ])
+    router._h[2].alive = False          # not live: never scraped
+    obs = ClusterObserver(router)
+    sig = obs.poll()
+    assert sig is obs.signals()
+    assert sig.replicas_live == 2
+    assert sig.live_replicas == ("r0", "r1")
+    assert sig.total_queue_depth == 5
+    assert sig.max_retry_after_s == 0.25
+    assert sig.total_steady_compiles == 2
+    assert {r.replica_id: r.queue_depth
+            for r in sig.replicas} == {"r0": 4, "r1": 1}
+    assert obs_mod._SIG_QDEPTH.labels("r0").value == 4
+    assert obs_mod._SIG_STEADY.labels("r1").value == 2
+    assert obs_mod._SIG_LIVE.value == 2
+    assert router._h[2].scrapes == 0
+    # the snapshot serializes (the autoscaler API is JSON-able)
+    d = json.loads(json.dumps(sig.to_dict()))
+    assert d["total_queue_depth"] == 5 and len(d["replicas"]) == 2
+
+
+def test_scrape_failure_is_fail_open_and_counted(flags_guard):
+    good = _StubHandle("ok", {"signals": {"queue_depth": 3}})
+    bad = _StubHandle("flaky", fail=True)
+    obs = ClusterObserver(_StubRouter([bad, good]))
+    before = obs_mod._SCRAPE_ERRORS.labels("flaky").value
+    sig = obs.poll()                     # must not raise
+    assert obs_mod._SCRAPE_ERRORS.labels("flaky").value == before + 1
+    assert sig.replicas_live == 1 and sig.live_replicas == ("ok",)
+
+
+def test_router_stats_poll_errors_total_counts(flags_guard):
+    from paddle_tpu.serving.cluster import router as router_mod
+
+    class _BadHealth(ReplicaHandle):
+        def health(self):
+            raise ConnectionError("stats endpoint wedged")
+
+    h = _BadHealth("sick")
+    r = Router(replicas=(h,))
+    try:
+        before = router_mod._STATS_POLL_ERRORS.labels("sick").value
+        r.poll()
+        assert router_mod._STATS_POLL_ERRORS.labels("sick").value \
+            == before + 1
+        assert h.backoff_until > time.monotonic()  # out of rotation
+        assert h.alive                             # heartbeat decides death
+    finally:
+        r.close()
+
+
+# -- the scrape RPC op end to end ---------------------------------------------
+
+class _StubServer:
+    """The minimum Server surface Replica needs for the scrape op."""
+
+    _started = True
+
+    def signals(self):
+        return {"queue_depth": 2, "drain_rate_rps": 8.0,
+                "retry_after_s": 0.125, "batch_occupancy_rows": 1.5,
+                "steady_compiles": 0, "models": ["m"]}
+
+    def models(self):
+        return ["m"]
+
+    def stop(self, drain=True):
+        pass
+
+
+def test_replica_scrape_op_over_real_rpc(trace_guard):
+    from paddle_tpu.serving.cluster.replica import Replica
+    from paddle_tpu.serving.cluster.rpc import RpcClient
+
+    rep = Replica(_StubServer(), replica_id="rz").start()
+    try:
+        tracing.finish(tracing.start_span("warm"))
+        cli = RpcClient("127.0.0.1", rep.port, timeout=10.0)
+        t_send = time.time()
+        meta, parts = cli.request("scrape", {"max_spans": 10})
+        t_recv = time.time()
+        cli.close()
+        assert parts == []
+        assert meta["id"] == "rz"
+        assert t_send <= meta["wall"] <= t_recv
+        # the (mono, wall) pair the skew estimate needs, both fresh
+        assert abs(meta["mono"] - time.monotonic()) < 5.0
+        assert meta["signals"]["queue_depth"] == 2
+        assert any(s["name"] == "warm" for s in meta["spans"])
+        assert meta["span_drops"] == 0
+        fams = {f["name"] for f in meta["dump"]["families"]}
+        assert "serving_queue_wait_seconds" in fams
+    finally:
+        rep.stop()
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def test_flight_recorder_atomic_dump_and_postmortem_read(
+        trace_guard, tmp_path):
+    obs_report = _load_tool("obs_report")
+    tracing.finish(tracing.start_span("doomed_request"))
+    fr = flight_mod.FlightRecorder(str(tmp_path), ident="victim",
+                                   interval_s=60.0, cap=32)
+    path = fr.dump("manual")
+    assert path == str(tmp_path / "postmortem_victim.json")
+    rec = json.loads(open(path).read())
+    assert rec["schema"] == "paddle_tpu/flight-recorder/1"
+    assert rec["reason"] == "manual" and rec["id"] == "victim"
+    assert any(s["name"] == "doomed_request" for s in rec["spans"])
+    assert rec["metrics"]["families"]
+    report, rc = obs_report.postmortem_report(path)
+    assert rc == 0 and report["problems"] == []
+    assert report["reason"] == "manual" and report["spans"] >= 1
+    # a torn / alien artifact is loud
+    bad = tmp_path / "postmortem_bad.json"
+    bad.write_text(json.dumps({"schema": "who/knows", "wall": 0}))
+    _, rc = obs_report.postmortem_report(str(bad))
+    assert rc == 1
+
+
+def test_flight_recorder_periodic_rewrites(tmp_path):
+    fr = flight_mod.FlightRecorder(str(tmp_path), ident="p",
+                                   interval_s=0.05, cap=8)
+    fr.start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if os.path.exists(fr.path) and \
+                json.loads(open(fr.path).read())["dumps"] >= 2:
+            break
+        time.sleep(0.05)
+    fr.close(final_dump=True)
+    rec = json.loads(open(fr.path).read())
+    assert rec["reason"] == "shutdown"
+    assert rec["dumps"] >= 2            # periodic rewrites happened
+
+
+def test_flight_install_requires_explicit_arming(flags_guard, tmp_path):
+    flight_mod.uninstall()
+    assert flight_mod.install() is None           # FLAGS_flight_dir empty
+    assert flight_mod.dump("manual") is None      # disarmed: no-op
+    set_flags({"FLAGS_flight_dir": str(tmp_path),
+               "FLAGS_flight_interval_s": 30.0})
+    fr = flight_mod.install(ident="armed")
+    try:
+        assert fr is not None and flight_mod.active() is fr
+        assert flight_mod.install() is fr         # idempotent
+        assert os.path.exists(fr.path)            # install dump landed
+        assert flight_mod.dump("watchdog_evict") == fr.path
+        assert json.loads(open(fr.path).read())["reason"] \
+            == "watchdog_evict"
+    finally:
+        flight_mod.uninstall()

@@ -1,8 +1,10 @@
 """Slow subprocess smokes for the cluster serving CLI: sustained mixed
 traffic across ≥2 real replica processes behind the router, zero
 steady-state recompiles on every replica, the SIGKILL-a-replica
-heartbeat-eviction drill, and the disaggregated prefill/decode pools
-with the serialized cross-process KV handoff."""
+heartbeat-eviction drill (with the victim's flight-recorder postmortem
+surviving the kill), and the disaggregated prefill/decode pools with
+the serialized cross-process KV handoff — traced end to end into ONE
+merged cluster trace and ONE federated metrics exposition."""
 import json
 import os
 import subprocess
@@ -12,6 +14,20 @@ import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SERVE = os.path.join(ROOT, "tools", "serve.py")
+OBS_REPORT = os.path.join(ROOT, "tools", "obs_report.py")
+
+
+def _obs_report(extra):
+    p = subprocess.run(
+        [sys.executable, OBS_REPORT, "--json"] + extra,
+        capture_output=True, text=True, timeout=120)
+    try:
+        report = json.loads(p.stdout)
+    except Exception:
+        raise AssertionError(
+            f"obs_report emitted no JSON (rc={p.returncode}):\n"
+            f"{p.stdout[-2000:]}\n{p.stderr[-2000:]}")
+    return p.returncode, report
 
 
 def _run(extra, env_extra=None, timeout=540):
@@ -37,15 +53,19 @@ def _run(extra, env_extra=None, timeout=540):
 
 
 @pytest.mark.slow
-def test_router_mixed_traffic_kill_drill():
+def test_router_mixed_traffic_kill_drill(tmp_path):
     """Sustained MIXED dense+decode traffic across 3 replica processes
     with a p99 SLO bound, plus the eviction drill in the same run: the
     victim SIGKILL'd mid-traffic, heartbeat evict, traffic
     redistributed with zero client-visible errors, and zero
-    steady-state recompiles on every survivor."""
+    steady-state recompiles on every survivor.  Every replica runs its
+    flight recorder, so the SIGKILL victim leaves a readable postmortem
+    artifact behind — the kill is uncatchable, the last atomic rewrite
+    is not."""
+    flight_dir = str(tmp_path / "flight")
     rc, report = _run(["--replicas", "3", "--duration", "4",
                        "--model", "lenet", "--p99-slo-ms", "5000",
-                       "--kill-one"])
+                       "--kill-one", "--flight-dir", flight_dir])
     assert rc == 0, json.dumps(report, indent=1)[:3000]
     assert report["traffic_errors"] == []
     assert report["steady_compiles"] == 0
@@ -61,19 +81,42 @@ def test_router_mixed_traffic_kill_drill():
         for model in ("gpt_decode", "lenet"):       # mixed pillars
             assert st[model]["steady_compiles"] == 0
             assert st[model]["completed"] > 0
+    # the victim's postmortem survived the SIGKILL and reads clean
+    pm = report["kill_one"]["postmortem"]
+    assert report["kill_one"]["postmortem_exists"] is True
+    prc, preport = _obs_report(["--postmortem", pm])
+    assert prc == 0, preport
+    assert preport["problems"] == []
+    assert preport["id"] == report["kill_one"]["victim"]
+    assert preport["metric_families"] > 0
+    # ClusterSignals published: the scrape plane saw the survivors
+    sig = report["cluster_signals"]
+    assert sig["replicas_live"] == 2
+    assert report["kill_one"]["victim"] not in sig["live_replicas"]
+    assert sig["total_steady_compiles"] == 0
 
 
 @pytest.mark.slow
-def test_router_disaggregated_pools_across_processes():
+def test_router_disaggregated_pools_across_processes(tmp_path):
     """Prefill pool and decode pool in separate OS processes: every
     decode request runs prefill on one process, ships the serialized
     KV-cache handoff, and resumes decode on the other — sustained
-    traffic, no errors, zero steady recompiles on both."""
+    traffic, no errors, zero steady recompiles on both.  With tracing
+    ON, the replicas ship their spans to the router over the scrape RPC
+    and obs_report --cluster must reassemble complete skew-corrected
+    route→prefill→handoff→decode chains spanning ≥2 processes; the
+    federated metrics textfile must parse strictly with cluster
+    histogram counts equal to the sum of the per-replica counts."""
+    trace_dir = str(tmp_path / "trace")
+    textfile = str(tmp_path / "cluster.prom")
     rc, report = _run(["--replicas", "2", "--duration", "3",
-                       "--disaggregate"])
+                       "--disaggregate", "--trace-dir", trace_dir,
+                       "--metrics-textfile", textfile],
+                      env_extra={"PADDLE_TPU_TRACE": "full"})
     assert rc == 0, json.dumps(report, indent=1)[:3000]
     assert report["traffic_errors"] == []
     assert report["steady_compiles"] == 0
+    assert report["trace_mode"] == "full"
     roles = {st["role"] for st in
              report["router_stats"]["replicas"].values()}
     assert roles == {"prefill", "decode"}
@@ -81,3 +124,31 @@ def test_router_disaggregated_pools_across_processes():
     counts = [st["dispatched"] for st in
               report["router_stats"]["replicas"].values()]
     assert min(counts) > 0 and counts[0] == counts[1]
+    # zero steady-state recompiles on every replica WITH scraping and
+    # tracing on — observability must not perturb the compile discipline
+    sig = report["cluster_signals"]
+    assert sig["replicas_live"] == 2
+    assert sig["total_steady_compiles"] == 0
+    # cross-process trace assembly: one merged JSONL, complete chains
+    orc, oreport = _obs_report(["--trace-dir", trace_dir, "--cluster"])
+    assert orc == 0, json.dumps(oreport, indent=1)[:3000]
+    assert oreport["complete"] == oreport["traces"] > 0
+    assert oreport["shapes"].get("disaggregated", 0) > 0
+    assert oreport["max_processes"] >= 2
+    for phase in ("dispatch", "prefill", "handoff", "decode"):
+        assert oreport["phases_ms"][phase]["count"] > 0
+    # federated exposition: strict parse + cluster == sum(per-replica)
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import obs_report as obs_mod
+    finally:
+        sys.path.pop(0)
+    fams = obs_mod.parse_prometheus_text(open(textfile).read())
+    # the handoff histogram fires on BOTH pools (serialize on prefill,
+    # deserialize on decode) — the bucket-sum law in the wild
+    per_replica = fams["kv_handoff_seconds_count"]
+    assert len(per_replica) >= 2
+    cluster = fams["cluster_kv_handoff_seconds_count"][""]
+    assert cluster == sum(per_replica.values()) > 0
+    assert "cluster_signals_replicas_live" in fams
+    assert "cluster_replica_queue_depth" in fams

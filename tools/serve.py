@@ -173,6 +173,14 @@ def _replica_child(cfg_path):
     if cfg.get("cache_dir"):
         set_flags({"FLAGS_executable_cache": "readwrite",
                    "FLAGS_executable_cache_dir": cfg["cache_dir"]})
+    if cfg.get("trace") and cfg["trace"] != "off":
+        # spans ship to the router through the scrape op's export
+        # buffer — no per-replica trace dir needed
+        set_flags({"FLAGS_trace": cfg["trace"]})
+    if cfg.get("flight_dir"):
+        set_flags({"FLAGS_flight_dir": cfg["flight_dir"],
+                   "FLAGS_flight_interval_s":
+                       float(cfg.get("flight_interval_s", 0.5))})
     paddle.seed(cfg["seed"])
     buckets = tuple(cfg["buckets"])
     server = serving.Server(serving.ServingConfig(
@@ -209,8 +217,9 @@ def _router_main(args):
     import subprocess
 
     from paddle_tpu.distributed.fleet.base.tcp_store import TCPStore
-    from paddle_tpu.framework.flags import flag as _flag
-    from paddle_tpu.serving.cluster import Router
+    from paddle_tpu.framework.flags import flag as _flag, set_flags
+    from paddle_tpu.serving.cluster import ClusterObserver, Router, \
+        serve_cluster_metrics
 
     n = args.replicas if args.replicas is not None \
         else int(_flag("serving_replicas"))
@@ -228,8 +237,22 @@ def _router_main(args):
               "duration_s": args.duration, "clients": args.clients,
               "models": {}, "replica_stats": {}}
     rc = 0
+    trace_mode = "off"
+    if args.trace_dir:
+        # the router's own route/dispatch spans need tracing ON; they
+        # reach the merged JSONL through the observer's export-buffer
+        # drain, NOT a per-process trace dir (that would double-write)
+        if str(_flag("trace")).lower() == "off":
+            set_flags({"FLAGS_trace": "full"})
+        trace_mode = str(_flag("trace")).lower()
+        report["trace_dir"] = args.trace_dir
+        report["trace_mode"] = trace_mode
+    if args.flight_dir:
+        os.makedirs(args.flight_dir, exist_ok=True)
+        report["flight_dir"] = args.flight_dir
     store = TCPStore("127.0.0.1", 0, is_master=True)
     children, router = [], None
+    obs = cluster_metrics_srv = None
     cfg_dir = tempfile.mkdtemp(prefix="serve_router_")
     try:
         for i in range(n):
@@ -244,7 +267,10 @@ def _router_main(args):
                    "max_new": args.max_new, "workers": args.workers,
                    "store_host": "127.0.0.1", "store_port": store.port,
                    "heartbeat_s": float(_flag("router_heartbeat_s")),
-                   "cache_dir": args.cache_dir}
+                   "cache_dir": args.cache_dir,
+                   "trace": trace_mode,
+                   "flight_dir": args.flight_dir,
+                   "flight_interval_s": 0.5}
             path = os.path.join(cfg_dir, f"replica{i}.json")
             with open(path, "w") as f:
                 json.dump(cfg, f)
@@ -253,6 +279,14 @@ def _router_main(args):
                  "--replica-config", path],
                 stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT))
         router = Router(store=store)
+        # the cluster observability plane: federation + trace assembly
+        # + ClusterSignals, driven by the router's watch loop
+        obs = ClusterObserver(router, trace_dir=args.trace_dir)
+        router.attach_observer(obs)
+        if args.metrics_port is not None:
+            cluster_metrics_srv = serve_cluster_metrics(
+                obs, port=args.metrics_port)
+            report["metrics_port"] = cluster_metrics_srv.port
         t0 = time.perf_counter()
         deadline = t0 + 300
         while router.replicas_live() < n:
@@ -311,6 +345,14 @@ def _router_main(args):
                 "evicted": router.replicas_live() == n - 1}
             if not report["kill_one"]["evicted"]:
                 rc = 1
+            if args.flight_dir and killed["id"]:
+                # SIGKILL leaves no exit path — the victim's evidence is
+                # whatever its flight recorder last persisted atomically
+                pm = os.path.join(args.flight_dir,
+                                  f"postmortem_{killed['id']}.json")
+                report["kill_one"]["postmortem"] = pm
+                report["kill_one"]["postmortem_exists"] = \
+                    os.path.exists(pm)
 
         steady_total = 0
         for h in router.handles():
@@ -335,7 +377,36 @@ def _router_main(args):
         if steady_total:
             rc = 1
         report["router_stats"] = router.stats()
+        # final federation round on OUR clock: drain the last spans and
+        # dumps so the merged trace / textfile include end-of-run state
+        sig = obs.poll()
+        report["cluster_signals"] = sig.to_dict()
+        report["observer"] = obs.stats()
+        if cluster_metrics_srv is not None:
+            import urllib.request
+            try:
+                with urllib.request.urlopen(
+                        "http://127.0.0.1:"
+                        f"{cluster_metrics_srv.port}/metrics",
+                        timeout=10) as resp:
+                    body = resp.read().decode()
+                report["metrics_scrape_ok"] = (
+                    resp.status == 200
+                    and "cluster_signals_replicas_live" in body)
+            except Exception as e:   # noqa: BLE001 — reported, gated
+                report["metrics_scrape_ok"] = False
+                report["metrics_scrape_error"] = \
+                    f"{type(e).__name__}: {e}"
+            if not report["metrics_scrape_ok"]:
+                rc = 1
+        if args.metrics_textfile:
+            report["metrics_textfile"] = \
+                obs.write_textfile(args.metrics_textfile)
     finally:
+        if cluster_metrics_srv is not None:
+            cluster_metrics_srv.close()
+        if obs is not None:
+            obs.close()
         if router is not None:
             router.close()
         for p in children:
@@ -408,16 +479,32 @@ def main(argv=None):
                          "traffic runs (0 = ephemeral; the bound port "
                          "lands in the report).  The report records a "
                          "self-scrape so CI can gate on exposition "
-                         "health without its own scraper")
+                         "health without its own scraper.  Under "
+                         "--router this is the FEDERATED cluster "
+                         "endpoint: replica-labeled families + "
+                         "cluster_* rollups")
     ap.add_argument("--metrics-textfile", default=None, metavar="PATH",
                     help="atomically write the final Prometheus "
                          "exposition to PATH (textfile-collector "
-                         "convention — scrape-less CI)")
+                         "convention — scrape-less CI; the federated "
+                         "cluster exposition under --router)")
     ap.add_argument("--trace-dir", default=None, metavar="DIR",
                     help="stream request spans as LogWriter JSONL into "
                          "DIR (sets FLAGS_trace=full unless FLAGS_trace "
                          "/ PADDLE_TPU_TRACE already enabled a mode); "
-                         "join with tools/obs_report.py")
+                         "join with tools/obs_report.py.  Under "
+                         "--router the replicas ship their spans to the "
+                         "router over the scrape RPC and DIR holds ONE "
+                         "merged skew-corrected cluster trace "
+                         "(obs_report.py --cluster)")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="under --router: arm every replica's flight "
+                         "recorder (FLAGS_flight_dir) so each process "
+                         "keeps an atomically-rewritten "
+                         "postmortem_<id>.json of its recent spans / "
+                         "compile ledger / metrics; with --kill-one the "
+                         "report records the SIGKILL victim's artifact "
+                         "(read it with obs_report.py --postmortem)")
     ap.add_argument("--cache-dir", default=None, metavar="DIR",
                     help="persistent executable cache: warm-up loads "
                          "serialized executables from DIR instead of "
