@@ -1147,6 +1147,148 @@ def bench_decode(on_tpu):
     return res
 
 
+def bench_decode_churn(on_tpu):
+    """Decode-churn block: iteration-level continuous batching (the
+    FLAGS_decode_slots slot loop) vs the run-to-completion scanned
+    decode on HIGH-CHURN mixed-length traffic — a trace where most
+    requests want a handful of tokens but every FIFO batch carries one
+    long generator and every fifth prompt is long.  Run-to-completion
+    pays max(max_new) x batch-width row-steps per batch plus
+    bucket-padded prefill; the slot loop pays actual tokens plus chunk
+    padding, so it wins on BOTH delivered tok/s and TTFT p99 (PERF.md
+    decode_churn schema).  Zero steady-state compiles asserted on both
+    sides.  CPU control caveat: per-dispatch host overhead (~ms) taxes
+    the slot loop's per-token dispatches far more than the scan's fused
+    loop, so CPU ratios UNDERSTATE the chip-round win."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import ledger as _led
+    from paddle_tpu.serving.slots import SlotLoop
+    from paddle_tpu.text.generation import Generator
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTModel
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=768, num_layers=12,
+                        num_heads=12, intermediate_size=3072,
+                        max_position_embeddings=1024, dropout=0.0)
+        S, C, T, n_reqs, reps = 8, 768, 64, 48, 3
+        long_lp, short_lp, long_mn, short_mn = (96, 128), (8, 24), 96, 8
+        seq_buckets, max_len = (32, 128, 768), 768
+    else:
+        cfg = GPTConfig.tiny(vocab_size=128, hidden_size=384, layers=6,
+                             heads=8, seq=128)
+        S, C, T, n_reqs, reps = 4, 384, 32, 20, 3
+        long_lp, short_lp, long_mn, short_mn = (40, 64), (4, 12), 64, 5
+        seq_buckets, max_len = (16, 32, 64, 128), 128
+
+    paddle.seed(21)
+    model = GPTModel(cfg)
+    model.eval()
+    if on_tpu:
+        # CPU control stays f32: x86 bf16 is emulated (~2.5x the step
+        # cost here) and would tax the slot loop's per-token dispatches
+        # asymmetrically vs the scan — the ratio is the metric
+        paddle.amp.decorate(models=model, level="O2", dtype="bfloat16")
+
+    # the churn trace: every 5th prompt long, every 4th request a long
+    # generator — so each FIFO batch of the run-to-completion baseline
+    # is hostage to one straggler while the slot loop retires the short
+    # rows and backfills at token boundaries
+    rng = np.random.RandomState(7)
+    reqs = []
+    for k in range(n_reqs):
+        lp = int(rng.randint(*long_lp)) if k % 5 == 0 \
+            else int(rng.randint(*short_lp))
+        mn = long_mn if k % 4 == 1 else int(rng.randint(2, short_mn))
+        reqs.append((rng.randint(1, cfg.vocab_size, lp).astype(np.int32),
+                     mn))
+    useful = sum(mn for _, mn in reqs)
+
+    gen_rtc = Generator(model, site="bench:churn_rtc",
+                        seq_buckets=seq_buckets, max_len=max_len)
+    gen_slot = Generator(model, site="bench:churn_slot",
+                         seq_buckets=seq_buckets, max_len=max_len)
+
+    def run_rtc():
+        """FIFO batches of S through the scanned generate(); per-batch
+        TTFT = batch completion (run-to-completion holds every token
+        until the scan drains — that IS the baseline's latency model)."""
+        t0 = time.perf_counter()
+        ttfts = []
+        for b in range(0, len(reqs), S):
+            batch = reqs[b:b + S]
+            mx = max(p.size for p, _ in batch)
+            ids = np.zeros((len(batch), mx), np.int32)
+            lens = np.zeros((len(batch),), np.int32)
+            for i, (p, _) in enumerate(batch):
+                ids[i, :p.size] = p
+                lens[i] = p.size
+            mn = max(m for _, m in batch)
+            out = gen_rtc.generate(ids, lengths=lens, max_new_tokens=mn)
+            jax.block_until_ready(out._jax()
+                                  if hasattr(out, "_jax") else out)
+            done = (time.perf_counter() - t0) * 1e3
+            ttfts += [done] * len(batch)
+        return (time.perf_counter() - t0) * 1e3, ttfts
+
+    def run_slot():
+        loop = SlotLoop(gen_slot, S, C, T)
+        t0 = time.perf_counter()
+        futs = [loop.submit(p, mn) for p, mn in reqs]
+        for f in futs:
+            f.result(timeout=600)
+        wall = (time.perf_counter() - t0) * 1e3
+        st = loop.stats()
+        loop.close()
+        return wall, st
+
+    run_rtc()                                    # warm-up compiles
+    run_slot()
+    mark_rtc = len(_led.compile_events(gen_rtc.site))
+    mark_slot = len(_led.compile_events(gen_slot.site))
+    best_rtc = best_slot = None
+    for _ in range(reps):
+        wall, ttfts = run_rtc()
+        if best_rtc is None or wall < best_rtc[0]:
+            best_rtc = (wall, ttfts)
+        wall, st = run_slot()
+        if best_slot is None or wall < best_slot[0]:
+            best_slot = (wall, st)
+    steady = (len(_led.compile_events(gen_rtc.site)) - mark_rtc
+              + len(_led.compile_events(gen_slot.site)) - mark_slot)
+    assert steady == 0, f"decode_churn: {steady} steady compile(s)"
+
+    rtc_wall, rtc_ttfts = best_rtc
+    slot_wall, slot_st = best_slot
+    rtc_p50 = float(np.percentile(rtc_ttfts, 50))
+    rtc_p99 = float(np.percentile(rtc_ttfts, 99))
+    slot_p50 = float(slot_st.get("ttft_p50_ms", 0.0))
+    slot_p99 = float(slot_st.get("ttft_p99_ms", 0.0))
+    res = {
+        "unit": "x slot/rtc tok/s (churn trace)",
+        "cpu_control": not on_tpu,
+        "requests": n_reqs, "useful_tokens": useful,
+        "slots": S, "cache": C, "chunk": T,
+        "rtc": {"wall_ms": round(rtc_wall, 1),
+                "tok_per_s": round(useful / rtc_wall * 1e3, 1),
+                "ttft_p50_ms": round(rtc_p50, 1),
+                "ttft_p99_ms": round(rtc_p99, 1)},
+        "slot": {"wall_ms": round(slot_wall, 1),
+                 "tok_per_s": round(useful / slot_wall * 1e3, 1),
+                 "ttft_p50_ms": round(slot_p50, 1),
+                 "ttft_p99_ms": round(slot_p99, 1),
+                 "occupancy_ewma": slot_st.get("occupancy_ewma"),
+                 "steps": slot_st.get("steps"),
+                 "chunks": slot_st.get("chunks"),
+                 "session_resets": slot_st.get("session_resets")},
+        "tok_per_s_speedup": round(rtc_wall / slot_wall, 3),
+        "ttft_p99_speedup": round(rtc_p99 / max(slot_p99, 1e-9), 3),
+        "zero_steady_state_compiles": True,
+    }
+    res["value"] = res["tok_per_s_speedup"]
+    return res
+
+
 def bench_moe(on_tpu):
     """Eleventh block: expert-parallel Mixture-of-Experts (ISSUE 14) —
     GPT-MoE vs a parameter-matched dense GPT, step time per token at
@@ -1458,6 +1600,7 @@ WORKLOADS = [
     ("inference", bench_inference),
     ("serving", bench_serving),
     ("decode", bench_decode),
+    ("decode_churn", bench_decode_churn),
     ("moe", bench_moe),
     ("autoshard", bench_autoshard),
     ("startup", bench_startup),
@@ -1571,6 +1714,33 @@ def main():
         "workloads": results,
     }
     print(json.dumps(line))
+    return line
+
+
+def _maybe_gate(line, argv):
+    """Opt-in post-run regression gate: ``--gate BENCH_prev.json``
+    compares this run against a saved round through
+    tools/bench_gate.compare (dispersion-aware tolerances) and returns
+    the gate's exit code — nonzero on regression, so CI can chain
+    ``python bench.py --gate BENCH_prev.json`` directly."""
+    if "--gate" not in argv:
+        return 0
+    i = argv.index("--gate")
+    if i + 1 >= len(argv):
+        _note("[bench] --gate needs a path to a previous round's JSON")
+        return 2
+    from tools.bench_gate import compare
+    try:
+        with open(argv[i + 1], encoding="utf-8") as f:
+            prev = json.load(f)
+    except (OSError, ValueError) as e:
+        _note(f"[bench] --gate: cannot read {argv[i + 1]}: {e}")
+        return 2
+    report, rc = compare(prev, line)
+    _note("[bench] gate: " + json.dumps(report))
+    if rc:
+        _note(f"[bench] gate FAILED (rc={rc}) vs {argv[i + 1]}")
+    return rc
 
 
 def _dispatch_floor_ms(iters: int = 30) -> float:
@@ -1593,4 +1763,5 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--workload":
         _run_one(sys.argv[2])
     else:
-        main()
+        _line = main()
+        sys.exit(_maybe_gate(_line, sys.argv[1:]))

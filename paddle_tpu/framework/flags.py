@@ -547,6 +547,29 @@ define_flag("decode_max_len", 1024,
             "for generate() and serving decode; requests past it raise "
             "OutOfRange instead of growing an unbounded cache shape.",
             validator=lambda v: int(v) >= 1)
+define_flag("decode_slots",
+            int(os.environ.get("PADDLE_TPU_DECODE_SLOTS", "0") or 0),
+            "Slot count S of the iteration-level continuous-batching "
+            "decode loop (serving/slots.py): ONE single-token step "
+            "executable per (S, cache-bucket) in which requests occupy "
+            "slots, finished rows retire at token boundaries and queued "
+            "requests join by restarting a row's validity window — no "
+            "recompile, no cache copy.  0 (default) keeps the "
+            "run-to-completion scanned decode path byte-identical to "
+            "before (one Python branch at decode-runtime load).  Seeded "
+            "by PADDLE_TPU_DECODE_SLOTS.",
+            validator=lambda v: 0 <= int(v) <= 256)
+define_flag("prefill_chunk",
+            int(os.environ.get("PADDLE_TPU_PREFILL_CHUNK", "16") or 16),
+            "Chunk width T of Sarathi-style chunked prefill under the "
+            "slot decode loop (FLAGS_decode_slots > 0): a joining "
+            "request's prompt is split into ceil(len/T) LEFT-padded "
+            "chunks interleaved with decode steps — T decode steps, one "
+            "chunk, repeat — so TTFT p99 of short requests is not "
+            "hostage to head-of-line long prompts.  Irrelevant when "
+            "FLAGS_decode_slots == 0.  Seeded by "
+            "PADDLE_TPU_PREFILL_CHUNK.",
+            validator=lambda v: 1 <= int(v) <= 4096)
 
 # ---- Persistent executable cache (paddle_tpu.jit.persistent_cache) ----------
 define_flag("executable_cache",

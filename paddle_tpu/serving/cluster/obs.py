@@ -96,6 +96,12 @@ _SIG_OCCUPANCY = _registry().gauge(
     "ClusterSignals: average real rows per executed batch on the "
     "replica (capacity-utilization input to scale-down decisions).",
     labels=("replica",))
+_SIG_SLOT_OCC = _registry().gauge(
+    "cluster_replica_decode_slot_occupancy",
+    "ClusterSignals: token-level decode-slot occupancy ratio on the "
+    "replica (FLAGS_decode_slots loops; 0.0 on the scanned path) — the "
+    "real decode-load input batch-level queue depth cannot provide.",
+    labels=("replica",))
 _SIG_CLOCK = _registry().gauge(
     "cluster_replica_clock_offset_seconds",
     "Estimated replica wall-clock offset vs the router (scrape "
@@ -128,6 +134,10 @@ class ReplicaSignals:
     inflight: int
     dispatched: int
     clock_offset_s: float
+    # token-level decode-slot occupancy (serving/slots.py; 0.0 when the
+    # replica serves the scanned path).  Appended with a default so
+    # positional constructions from before the slot loop keep working.
+    decode_slot_occupancy_ratio: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -145,6 +155,10 @@ class ClusterSignals:
     max_retry_after_s: float
     max_heartbeat_staleness_s: float
     total_steady_compiles: int
+    # worst token-level decode-slot occupancy across live replicas —
+    # a scale-UP trigger long before queue depth moves (0.0 when every
+    # replica serves the scanned path)
+    max_decode_slot_occupancy: float = 0.0
     replicas: Tuple[ReplicaSignals, ...] = field(default_factory=tuple)
 
     def to_dict(self) -> dict:
@@ -364,13 +378,16 @@ class ClusterObserver:
                 steady_compiles=int(sig.get("steady_compiles", 0)),
                 heartbeat_staleness_s=float(staleness.get(h.id, 0.0)),
                 inflight=int(h.inflight), dispatched=int(h.dispatched),
-                clock_offset_s=offset)
+                clock_offset_s=offset,
+                decode_slot_occupancy_ratio=float(
+                    sig.get("decode_slot_occupancy_ratio", 0.0)))
             per_replica.append(rs)
             _SIG_QDEPTH.labels(h.id).set(rs.queue_depth)
             _SIG_RETRY.labels(h.id).set(rs.retry_after_s)
             _SIG_STALENESS.labels(h.id).set(rs.heartbeat_staleness_s)
             _SIG_STEADY.labels(h.id).set(rs.steady_compiles)
             _SIG_OCCUPANCY.labels(h.id).set(rs.batch_occupancy_rows)
+            _SIG_SLOT_OCC.labels(h.id).set(rs.decode_slot_occupancy_ratio)
             _SIG_CLOCK.labels(h.id).set(rs.clock_offset_s)
         if self._writer is not None:
             # the router's own finished spans, mono -> own wall
@@ -389,6 +406,9 @@ class ClusterObserver:
                 [r.heartbeat_staleness_s for r in per_replica] or [0.0]),
             total_steady_compiles=sum(r.steady_compiles
                                       for r in per_replica),
+            max_decode_slot_occupancy=max(
+                [r.decode_slot_occupancy_ratio for r in per_replica]
+                or [0.0]),
             replicas=tuple(per_replica))
         _SIG_LIVE.set(sig.replicas_live)
         with self._lock:

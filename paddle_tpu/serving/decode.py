@@ -22,6 +22,15 @@ Left-padding makes results batch-invariant: a row's attention window is
 ``[P - len, pos)`` regardless of which rows share its batch, so a served
 greedy decode is bit-identical to a batch-1 ``generate()`` of the same
 prompt (the admission test's oracle).
+
+``FLAGS_decode_slots > 0`` swaps the scanned run-to-completion loop for
+the iteration-level slot loop (serving/slots.py): ONE single-step
+executable per (slot-count, cache-bucket), requests joining and
+retiring at token boundaries, prompts chunked ``FLAGS_prefill_chunk``
+wide and interleaved into decode steps.  Tokens stay bit-identical to
+``generate()``; only the schedule changes.  The flag off (default) is
+one Python branch at load — the scanned path is byte-identical to
+before.
 """
 from __future__ import annotations
 
@@ -116,6 +125,8 @@ class _DecodeRuntime:
         self.admitted = False
         self.gen = None
         self.role = "both"              # resolved from the flag at load()
+        self._loop = None               # slot mode, resolved at load()
+        self.slots = 0
         self._warmed_prefill = set()        # {(B, P, C)}
         self._warmed_decode = set()         # {(B, C)}
         self.latency = LatencyWindow(
@@ -179,6 +190,38 @@ class _DecodeRuntime:
                 f"room for max_new_tokens={self.steps} under "
                 f"max_len={self.gen._max_len}")
         self.max_prompt = max(p for p, _ in self._plan)
+        # iteration-level slot mode (FLAGS_decode_slots): one step loop
+        # at the LARGEST cache bucket replaces the scanned grid; prompts
+        # chunk to FLAGS_prefill_chunk instead of prefill-bucketing
+        self._loop = None
+        self.slots = int(_flags.flag("decode_slots"))
+        self.chunk_width = int(_flags.flag("prefill_chunk"))
+        if self.slots:
+            if self.spec.mesh is not None:
+                raise PreconditionNotMetError(
+                    f"decode model {self.name!r}: the slot loop "
+                    "(FLAGS_decode_slots) runs per-replica unsharded — "
+                    "drop the mesh or set FLAGS_decode_slots=0")
+            if self.role != "both":
+                raise PreconditionNotMetError(
+                    f"decode model {self.name!r}: the slot loop fuses "
+                    "chunked prefill into the decode step, so it cannot "
+                    f"serve a disaggregated {self.role!r} pool — use "
+                    "FLAGS_serving_role=both or FLAGS_decode_slots=0")
+            self._slot_cache = max(c for _, c in self._plan)
+            gamma = int(getattr(self.gen, "_gamma", 0)) \
+                if getattr(self.gen, "_draft", None) is not None else 0
+            span = self._slot_cache - self.steps - gamma
+            T = self.chunk_width
+            # largest admissible prompt: its chunk-padded span plus the
+            # full token budget must fit ONE ring session
+            self.max_prompt = (span // T) * T
+            if self.max_prompt < 1:
+                raise PreconditionNotMetError(
+                    f"decode model {self.name!r}: slot cache "
+                    f"{self._slot_cache} leaves no room for a prompt "
+                    f"chunk (chunk={T}, max_new_tokens={self.steps}, "
+                    f"gamma={gamma})")
 
     def lint_gate(self, B, P, C):
         """Graph-lint admission over the prefill program in abstract-eval
@@ -215,6 +258,57 @@ class _DecodeRuntime:
                 f"(batch={B}, prompt={P}):\n"
                 + "\n".join("  " + str(d) for d in errors))
 
+    def lint_gate_slot(self, S, C):
+        """Graph-lint admission over the slot STEP program — the slot
+        loop's hot path gets the same abstract-eval gate as the scanned
+        grid (ERROR findings refuse admission)."""
+        from .. import analysis
+        if not analysis.lint_enabled():
+            return
+        import jax
+        eos = self.spec.eos_token_id
+        end = -1 if eos is None else int(eos)
+        fn = self.gen._build_step(S, C, end)
+        try:
+            closed = jax.make_jaxpr(fn)(*self.gen._state_avals(),
+                                        *self.gen.step_avals(S, C))
+        except Exception as e:   # noqa: BLE001 — lint must not mask bugs
+            import warnings
+            warnings.warn(
+                f"decode warm-up lint for {self.name!r} slots {S} could "
+                f"not abstract-eval the step program: "
+                f"{type(e).__name__}: {e}",
+                analysis.GraphLintWarning, stacklevel=2)
+            return
+        ctx = analysis.LintContext(site=self.site, kind="serving",
+                                   closed_jaxpr=closed)
+        report = analysis.default_pass_manager().run(ctx)
+        analysis.emit(report, mode="warn")
+        errors = report.by_severity(analysis.Severity.ERROR)
+        if errors:
+            raise PreconditionNotMetError(
+                f"serving refused to admit decode model {self.name!r}: "
+                f"graph lint found {len(errors)} ERROR finding(s) in "
+                f"the slot step program (slots={S}, cache={C}):\n"
+                + "\n".join("  " + str(d) for d in errors))
+
+    def _warmup_slots(self):
+        """Slot-mode warm-up: lint-gate + AOT-compile the step and chunk
+        executables (persistent cache + ledger, like every grid point),
+        build the SlotLoop, run one dummy request end-to-end so every
+        dispatch path is warm, then zero the loop accounting."""
+        from .slots import SlotLoop
+        S, C, T = self.slots, self._slot_cache, self.chunk_width
+        self.lint_gate_slot(S, C)
+        eos = self.spec.eos_token_id
+        self._audit_gate(self.gen.step_exec(S, C, eos), S, None)
+        self._audit_gate(self.gen.chunk_exec(S, T, C), S, None)
+        self._loop = SlotLoop(self.gen, S, C, T, eos_token_id=eos,
+                              model=self.name)
+        self._loop.submit(np.zeros((1,), np.int32), 1).result(timeout=600)
+        self._loop.reset_stats()
+        self.admitted = True
+
     def warmup(self):
         """AOT-compile the (batch-bucket × prefill-bucket) prefill set
         and/or the (batch-bucket × cache-bucket) decode set — the pool
@@ -226,6 +320,9 @@ class _DecodeRuntime:
         ``spec.mesh`` the grids compile SPMD and each executable is
         HLO-audited at admission (cluster/sharding.py)."""
         import jax
+        if self._loop is not None or self.slots:
+            self._warmup_slots()
+            return
         eos = self.spec.eos_token_id
         warm_prefill = self.role in ("both", "prefill")
         warm_decode = self.role in ("both", "decode")
@@ -321,7 +418,24 @@ class _DecodeRuntime:
     def execute(self, batch):
         """Run one packed batch through prefill + scanned decode; returns
         generated tokens [bucket, steps] (padding rows included — the
-        worker slices per request)."""
+        worker slices per request).  In slot mode the rows go through
+        the iteration-level loop instead: each row is its own slot
+        tenancy (joins at a token boundary, retires when done), and the
+        worker-facing [bucket, steps] contract is assembled from the
+        per-row futures — workers and the scheduler don't change."""
+        if self._loop is not None:
+            futs = []
+            for r in batch.requests:
+                for p in r.prompts:
+                    futs.append(self._loop.submit(p, r.max_new))
+            out = np.zeros((batch.bucket, self.steps), np.int32)
+            row = 0
+            for r in batch.requests:
+                for _ in range(len(r.prompts)):
+                    got = futs[row].result(timeout=600)
+                    out[row, :got.size] = got
+                    row += 1
+            return out
         prompts = [p for r in batch.requests for p in r.prompts]
         # pad rows up to the batch bucket with 1-token dummy prompts
         prompts += [np.zeros((1,), np.int32)] * (batch.bucket - batch.rows)
@@ -421,6 +535,11 @@ class _DecodeRuntime:
         device-resident ring planes (bf16 or int8+scales), next-token
         logits, per-row validity offsets and the cache_position.  The
         prefill-pool entry point (roles "both"/"prefill")."""
+        if self._loop is not None:
+            raise PreconditionNotMetError(
+                f"decode model {self.name!r}: disaggregated KV handoff "
+                "rides the scanned run-to-completion path — set "
+                "FLAGS_decode_slots=0 to serve a prefill pool")
         if self.role == "decode":
             raise PreconditionNotMetError(
                 f"decode model {self.name!r}: this replica is in the "
@@ -457,6 +576,11 @@ class _DecodeRuntime:
         identical to the same prompts run through the in-process
         ``generate()`` (the acceptance oracle).  The decode-pool entry
         point (roles "both"/"decode")."""
+        if self._loop is not None:
+            raise PreconditionNotMetError(
+                f"decode model {self.name!r}: disaggregated KV handoff "
+                "rides the scanned run-to-completion path — set "
+                "FLAGS_decode_slots=0 to serve a decode pool")
         if self.role == "prefill":
             raise PreconditionNotMetError(
                 f"decode model {self.name!r}: this replica is in the "
@@ -481,6 +605,15 @@ class _DecodeRuntime:
         rows = int(handoff.meta.get("rows", B))
         mn = int(handoff.meta.get("max_new", self.steps))
         return out[:rows, :mn]
+
+    def slot_signals(self):
+        """Token-level slot accounting for Server.signals(), or None on
+        the scanned path (the ClusterSignals leg is additive)."""
+        return None if self._loop is None else self._loop.signals()
+
+    def close(self):
+        if self._loop is not None:
+            self._loop.close()
 
     def publish(self):
         self.latency.publish(f"serving_{self.name}")

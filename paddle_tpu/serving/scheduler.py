@@ -45,6 +45,35 @@ _PAD_EFFICIENCY = _registry().histogram(
     "values = the ladder is paying for zeros.",
     buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
 
+# token-level slot accounting for the iteration-level decode loop
+# (serving/slots.py, FLAGS_decode_slots): batch-level queue depth says
+# nothing about how full the step executable runs — these do.  Published
+# through Server.signals() into the PR-16 ClusterSignals snapshot.
+SLOT_OCCUPANCY = _registry().gauge(
+    "decode_slot_occupancy_ratio",
+    "Generating rows / total slots at the latest decode step of the "
+    "slot loop — the token-level utilisation of the single-step decode "
+    "executable (1.0 = every slot is emitting).",
+    labels=("model",))
+SLOTS_JOINED = _registry().counter(
+    "decode_slots_joined_total",
+    "Requests admitted into a decode slot at a token boundary (a join "
+    "is a validity-window restart: no recompile, no cache copy).",
+    labels=("model",))
+SLOTS_RETIRED = _registry().counter(
+    "decode_slots_retired_total",
+    "Rows retired from the slot loop (eos or per-request token budget) "
+    "— retirement frees the slot the same step.",
+    labels=("model",))
+SLOT_TTFT = _registry().histogram(
+    "decode_slot_ttft_seconds",
+    "Time from slot-loop submit to the request's first emitted token — "
+    "the metric chunked prefill exists to keep flat under long-prompt "
+    "head-of-line pressure.",
+    labels=("model",),
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+             2.5, 5.0))
+
 
 @dataclass
 class Request:

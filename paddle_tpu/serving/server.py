@@ -803,6 +803,10 @@ class Server:
         self._scheduler.join(timeout=30)
         for w in self._workers:
             w.join(timeout=30)
+        for rt in self._models.values():
+            close = getattr(rt, "close", None)
+            if close is not None:
+                close()
         self._stopped = True
 
     def __enter__(self):
@@ -1010,6 +1014,8 @@ class Server:
                                    (rows + c["padded_rows"]), 4),
             "queue_depth": self._queue.depth() if self._queue else 0,
             "steady_compiles": c["steady_compiles"],
+            **({"slot_loop": rt._loop.stats()}
+               if getattr(rt, "_loop", None) is not None else {}),
         }
 
     def signals(self) -> dict:
@@ -1030,6 +1036,19 @@ class Server:
         out["batch_occupancy_rows"] = round(rows / batches, 3) \
             if batches else 0.0
         out["steady_compiles"] = steady
+        # token-level decode-slot accounting (FLAGS_decode_slots):
+        # occupancy is the max over slot-mode decode models, the
+        # join/retire counters sum — absent entirely on the scanned path
+        slot = [s for s in (getattr(rt, "slot_signals", lambda: None)()
+                            for rt in self._models.values())
+                if s is not None]
+        if slot:
+            out["decode_slot_occupancy_ratio"] = max(
+                s["decode_slot_occupancy_ratio"] for s in slot)
+            out["slots_joined_total"] = sum(
+                s["slots_joined_total"] for s in slot)
+            out["slots_retired_total"] = sum(
+                s["slots_retired_total"] for s in slot)
         out["models"] = self.models()
         return out
 

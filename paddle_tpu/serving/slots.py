@@ -1,0 +1,523 @@
+"""Iteration-level continuous batching: the slot-based decode loop.
+
+The run-to-completion decode path (serving/decode.py ``execute``) runs
+every batch as ONE scanned program: a 5-token request waits on its
+500-token neighbor and arrivals queue until the whole batch drains.
+This module hoists the token loop onto the HOST — Orca-style iteration-
+level scheduling — over TWO slot executables the Generator compiles per
+(slot-count, cache-bucket):
+
+  * ``step_exec(S, C)``: one greedy token step for all ``S`` slot rows
+    (or one speculative propose/verify/accept step under a draft pair);
+  * ``chunk_exec(S, T, C)``: one Sarathi-style prefill chunk — ``T``
+    prompt tokens of ONE joining row, interleaved between decode steps
+    so long prompts never stall the running rows' token cadence.
+
+The scheduling invariants that make slot reuse BIT-EXACT against a
+per-request ``generate()`` of the same prompt:
+
+  * **scalar lockstep position** — every dispatch writes at the shared
+    ``pos``; a joining request is a row whose validity window restarts
+    (``start[s]`` moves), never a recompile or a cache copy;
+  * **dead-column garbage discipline** — the step program does NOT
+    mask its cache write per row (the cache is donated for in-place
+    column updates; a per-row blend would force XLA into a full-plane
+    protective copy every step).  A step therefore writes garbage into
+    inactive rows' lanes of the written column(s) — which is safe
+    because every such column is DEAD: it lies inside the row's
+    pending chunk window ``[act-Pb, act)`` (rewritten by the row's own
+    chunks, scheduled after the last garbage write — see
+    ``_dispatch_chunks``), below the row's ``start`` (never visible),
+    or at ``>= act`` where the row's own active dispatches rewrite it
+    before any commit exposes it;
+  * **planned-activation chunk schedule** — a prompt of ``Lp`` tokens
+    left-pads into ``n = ceil(Lp/T)`` chunks.  Columns are PER-ROW
+    state, so the prompt block is free to end wherever the row starts
+    generating: admission at position ``a`` plans the activation at
+    ``act = max(Pb, a + n)`` (``Pb = n*T``; the ``Pb`` floor keeps the
+    left-padded block at non-negative columns), the chunks write
+    ``[act-Pb, act)``.  Chunk ``k`` dispatches in the iteration at
+    which ``pos > act - n + k`` — one chunk per iteration over the
+    last ``n`` iterations before activation, so a long prompt costs
+    ``n`` iterations of everyone's token cadence, not ``Lp``, AND the
+    chunk rewrite of each column lands strictly after the last decode
+    step that could garbage it (the no-blend invariant above; the
+    interval algebra: chunk ``k`` covers ``[act-Pb+kT, act-Pb+(k+1)T)``
+    and every step from that iteration on writes columns
+    ``>= act-n+k+1``; overlap would need ``n(T-1) < (k+1)(T-1)``,
+    i.e. ``k >= n`` — impossible).  The row
+    activates exactly when the shared ``pos`` reaches ``act``.
+    Speculative strides clamp via ``max_commit`` to land on activation
+    boundaries (committing fewer than accepted is always exact), and a
+    stride that arrives at ``act`` early just bursts the remaining
+    chunks first — chunk writes never depend on ``pos``;
+  * **bounded ring sessions** — the validity mask compares absolute
+    columns, so ``pos`` must stay inside ``[0, C)``: a request admits
+    only if ``act + max_new (+ gamma)`` fits, and when the FIFO head
+    cannot fit the loop drains and restarts the session at ``pos = 0``
+    (amortized cost shrinks with ``C``; documented in the README
+    decoding walkthrough).
+
+Host-side, lock-and-condvar concurrency exactly like scheduler.py; the
+driver thread owns every device dispatch.  Token-level occupancy
+accounting (``decode_slot_occupancy_ratio`` + joined/retired counters,
+scheduler.py instruments) feeds Server.signals() and the PR-16
+ClusterSignals snapshot.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework.enforce import (InvalidArgumentError, OutOfRangeError,
+                                 UnavailableError)
+from .scheduler import (SLOT_OCCUPANCY, SLOT_TTFT, SLOTS_JOINED,
+                        SLOTS_RETIRED)
+
+__all__ = ["SlotLoop", "SlotRequest"]
+
+_EMPTY, _PREFILL, _GEN = 0, 1, 2
+
+
+@dataclass
+class SlotRequest:
+    """One row of slot-loop work: a prompt to continue by ``max_new``
+    tokens.  ``future`` resolves to int32 [max_new] generated ids."""
+
+    prompt: np.ndarray
+    max_new: int
+    future: Future = field(default_factory=Future)
+    t_submit: float = field(default_factory=time.monotonic)
+
+
+class _Slot:
+    __slots__ = ("state", "req", "chunks", "next_chunk", "act",
+                 "start", "emitted", "_act_logits")
+
+    def __init__(self):
+        self.state = _EMPTY
+        self.req: Optional[SlotRequest] = None
+        self.chunks: List[np.ndarray] = []
+        self.next_chunk = 0
+        self.act = 0                    # planned activation position
+        self.start = 0
+        self.emitted: List[int] = []
+
+
+class SlotLoop:
+    """The iteration-level decode loop for one Generator (plain or
+    speculative).  ``submit`` enqueues a request and returns a Future;
+    a dedicated driver thread admits requests into free slots at token
+    boundaries, interleaves prefill chunks, retires finished rows, and
+    keeps the occupancy/TTFT accounting honest.  Unit-testable without
+    a Server — serving/decode.py wires it behind FLAGS_decode_slots."""
+
+    def __init__(self, gen, slots: int, cache_len: int, chunk: int,
+                 eos_token_id: Optional[int] = None,
+                 model: str = "decode"):
+        if slots < 1:
+            raise InvalidArgumentError(
+                f"slot loop needs >= 1 slot, got {slots}")
+        self._gen = gen
+        self.S = int(slots)
+        self.C = int(cache_len)
+        self.T = int(chunk)
+        self._eos = eos_token_id
+        self._end = -1 if eos_token_id is None else int(eos_token_id)
+        self._model = model
+        self._spec = getattr(gen, "_draft", None) is not None
+        self._gamma = int(gen._gamma) if self._spec else 0
+        # compiled once here (ledgered compile or warm cache hit); every
+        # later dispatch is a plain __call__ — zero steady-state compiles
+        self._step = gen.step_exec(self.S, self.C, eos_token_id)
+        self._chunk = gen.chunk_exec(self.S, self.T, self.C)
+        self._cond = threading.Condition()
+        self._pending: "deque[SlotRequest]" = deque()
+        self._slots = [_Slot() for _ in range(self.S)]
+        self._closed = False
+        self._dead: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        # device/host loop state (driver-thread-owned after start)
+        self._reset_session()
+        self.counters = {"joined": 0, "retired": 0, "steps": 0,
+                         "chunks": 0, "session_resets": 0,
+                         "emitted_tokens": 0}
+        # child instruments resolved once — .labels() is a registry
+        # lookup and the step path is hot
+        self._m_occ = SLOT_OCCUPANCY.labels(model=self._model)
+        self._m_joined = SLOTS_JOINED.labels(model=self._model)
+        self._m_retired = SLOTS_RETIRED.labels(model=self._model)
+        self._m_ttft = SLOT_TTFT.labels(model=self._model)
+        self._occupancy = 0.0               # EWMA of generating/S
+        self._ttft: "deque[float]" = deque(maxlen=512)
+        if self._spec:
+            self._accepted = 0
+            self._proposed = 0
+
+    # -- session state -------------------------------------------------------
+    def _reset_session(self):
+        """Fresh ring session: position 0, zero planes (stale data is
+        invisible behind the validity windows, but a cold loop has no
+        planes yet), neutral per-row vectors."""
+        self.pos = 0
+        self._cache = self._gen.init_slot_cache(self.S, self.C)
+        self._start = np.zeros((self.S,), np.int32)
+        self._finished = np.ones((self.S,), bool)
+        self._active = np.zeros((self.S,), bool)
+        if getattr(self, "_spec", False):
+            self._cur = np.zeros((self.S,), np.int32)
+        else:
+            vocab = self._gen._vocab_size()
+            self._logits = np.zeros((self.S, vocab), np.float32)
+
+    def _need(self, prompt_len: int, max_new: int) -> int:
+        """Ring columns a request consumes: padded chunk span + its own
+        token budget (+ the speculative verify block's overshoot)."""
+        n_chunks = -(-int(prompt_len) // self.T)
+        return n_chunks * self.T + int(max_new) + self._gamma
+
+    # -- producer ------------------------------------------------------------
+    def submit(self, prompt, max_new: int) -> Future:
+        p = np.asarray(prompt).reshape(-1).astype(np.int32)
+        if p.size == 0:
+            raise InvalidArgumentError("empty prompt (0 tokens)")
+        mn = int(max_new)
+        if mn < 1:
+            raise InvalidArgumentError("max_new must be >= 1")
+        if self._need(p.size, mn) > self.C:
+            raise OutOfRangeError(
+                f"prompt of {p.size} tokens + max_new {mn} can never fit "
+                f"the slot cache (need {self._need(p.size, mn)} columns, "
+                f"C={self.C}, chunk={self.T}, gamma={self._gamma})")
+        req = SlotRequest(prompt=p, max_new=mn)
+        with self._cond:
+            if self._closed:
+                raise UnavailableError("slot loop is closed")
+            if self._dead is not None:
+                raise UnavailableError(
+                    f"slot loop died: {self._dead!r}")
+            self._pending.append(req)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._drive, name=f"slot-loop-{self._model}",
+                    daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+        return req.future
+
+    def close(self):
+        """Stop the driver once in-flight work drains; pending requests
+        not yet admitted fail with UnavailableError."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30)
+
+    # -- the driver loop -----------------------------------------------------
+    def _drive(self):
+        try:
+            while True:
+                with self._cond:
+                    while (not self._pending
+                           and all(s.state == _EMPTY
+                                   for s in self._slots)):
+                        if self._closed:
+                            return
+                        self._cond.wait(0.05)
+                    if self._closed and not self._any_live():
+                        self._fail_pending(UnavailableError(
+                            "slot loop closed before this request was "
+                            "admitted"))
+                        return
+                    self._admit()
+                self._dispatch_chunks()
+                self._activate()
+                if not any(s.state == _GEN for s in self._slots):
+                    self._fast_forward()
+                    continue
+                self._decode_step()
+        except BaseException as e:   # noqa: BLE001 — fail rows, not host
+            with self._cond:
+                self._dead = e
+                for s in self._slots:
+                    if s.req is not None and not s.req.future.done():
+                        s.req.future.set_exception(e)
+                    s.state, s.req = _EMPTY, None
+                self._fail_pending(e)
+
+    def _any_live(self) -> bool:
+        return bool(self._pending) or any(s.state != _EMPTY
+                                          for s in self._slots)
+
+    def _fail_pending(self, exc):
+        while self._pending:
+            r = self._pending.popleft()
+            if not r.future.done():
+                r.future.set_exception(exc)
+
+    # -- admission (FIFO, no starvation) -------------------------------------
+    def _plan_act(self, prompt_len: int) -> int:
+        """The planned activation position for a prompt admitted NOW:
+        one chunk dispatches per loop iteration and the shared ``pos``
+        advances at most one token boundary per iteration, so the
+        earliest exact meeting point is ``pos + n`` chunks out — floored
+        at ``Pb`` so the left-padded block stays at columns >= 0."""
+        n_chunks = -(-int(prompt_len) // self.T)
+        return max(n_chunks * self.T, self.pos + n_chunks)
+
+    def _admit(self):
+        """Move pending FIFO heads into empty slots at the current token
+        boundary.  Strict FIFO: if the head does not fit the remaining
+        ring columns, nothing behind it jumps the line — the loop drains
+        and restarts the session instead."""
+        for slot in self._slots:
+            if not self._pending or slot.state != _EMPTY:
+                continue
+            head = self._pending[0]
+            if self._plan_act(head.prompt.size) + head.max_new \
+                    + self._gamma > self.C:
+                if all(s.state == _EMPTY for s in self._slots) \
+                        and self.pos > 0:
+                    # whole loop idle: restart the ring session (windows
+                    # restart, planes stay — stale columns are invisible)
+                    self.pos = 0
+                    self.counters["session_resets"] += 1
+                else:
+                    break                        # drain first
+            self._pending.popleft()
+            p = head.prompt
+            n_chunks = -(-p.size // self.T)
+            pb = n_chunks * self.T
+            padded = np.zeros((pb,), np.int32)
+            padded[pb - p.size:] = p
+            slot.req = head
+            slot.chunks = [padded[k * self.T:(k + 1) * self.T]
+                           for k in range(n_chunks)]
+            slot.next_chunk = 0
+            slot.act = self._plan_act(p.size)
+            slot.start = slot.act - p.size
+            slot.emitted = []
+            slot.state = _PREFILL
+            self.counters["joined"] += 1
+            self._m_joined.inc()
+
+    # -- chunked prefill -----------------------------------------------------
+    def _dispatch_chunks(self):
+        """One chunk per prefilling slot per iteration (the Sarathi
+        budget: a joining prompt taxes everyone's token cadence by its
+        chunk count, not its length), scheduled over the LAST ``n``
+        iterations before the row activates: chunk ``k`` dispatches
+        once ``pos > act - n + k``.  That late placement is load-
+        bearing, not cosmetic — the step program writes unmasked
+        garbage into inactive rows' lanes (dead-column discipline, see
+        the module docstring), and dispatching chunk ``k`` only after
+        the step at ``act - n + k`` has retired guarantees the chunk's
+        column block is rewritten strictly after the last step that
+        could garbage it.  Chunk writes carry their own column base,
+        independent of ``pos`` — a speculative stride that lands on an
+        activation boundary early just bursts the remaining chunks
+        back-to-back before the row activates (catch-up dispatches are
+        safe: running a chunk LATER than planned only moves it further
+        from the garbage frontier)."""
+        for i, slot in enumerate(self._slots):
+            if slot.state != _PREFILL:
+                continue
+            n = len(slot.chunks)
+            while (slot.next_chunk < n
+                   and slot.act - n + slot.next_chunk < self.pos):
+                # fresh buffers per dispatch: the CPU runtime may alias
+                # a numpy argument zero-copy and read it asynchronously,
+                # so a buffer handed to a dispatch is immutable forever
+                ids = slot.chunks[slot.next_chunk].reshape(1, self.T)
+                start = np.array([slot.start], np.int32)
+                base = slot.act - len(slot.chunks) * self.T \
+                    + slot.next_chunk * self.T
+                self._cache, logits = self._chunk(
+                    *self._gen._state_args(), self._cache, ids, start,
+                    np.int32(i), np.int32(base))
+                slot.next_chunk += 1
+                self.counters["chunks"] += 1
+                if slot.next_chunk == len(slot.chunks):
+                    # final chunk: its last column is the last prompt
+                    # token — stash the activation logits for this row.
+                    # MUST be a host copy: activation reads it one or
+                    # more dispatches later, after the runtime may have
+                    # reused the output buffer a zero-copy view aliases.
+                    slot._act_logits = np.array(logits, np.float32)
+
+    # -- activation ----------------------------------------------------------
+    def _activate(self):
+        for i, slot in enumerate(self._slots):
+            if slot.state != _PREFILL \
+                    or slot.next_chunk < len(slot.chunks) \
+                    or self.pos != slot.act:
+                continue
+            # copy-on-write: these vectors were handed to earlier
+            # dispatches, which may alias them zero-copy — mutate a
+            # fresh copy, never the buffer a dispatch has seen
+            self._start = self._start.copy()
+            self._start[i] = slot.start
+            self._finished = self._finished.copy()
+            self._finished[i] = False
+            self._active = self._active.copy()
+            self._active[i] = True
+            act = slot._act_logits
+            if self._spec:
+                # first committed token = target argmax over the final
+                # chunk's logits (the joint-prefill cur0 computation)
+                self._cur = self._cur.copy()
+                self._cur[i] = np.int32(np.argmax(act))
+            else:
+                lg = np.array(self._logits)
+                lg[i] = act
+                self._logits = lg
+            slot.state = _GEN
+
+    def _fast_forward(self):
+        """No generating rows: the position counter is host state, so
+        jump it to the EARLIEST planned activation instead of burning
+        empty decode dispatches (never past it — a later row's window
+        must still start exactly at its own ``act``)."""
+        acts = [s.act for s in self._slots if s.state == _PREFILL]
+        if acts:
+            self.pos = max(self.pos, min(acts))
+
+    # -- one decode iteration ------------------------------------------------
+    def _decode_step(self):
+        gen_slots = [i for i, s in enumerate(self._slots)
+                     if s.state == _GEN]
+        ratio = len(gen_slots) / self.S
+        self._occupancy = ratio if self.counters["steps"] == 0 \
+            else 0.9 * self._occupancy + 0.1 * ratio
+        self._m_occ.set(round(ratio, 4))
+        if self._spec:
+            self._spec_step(gen_slots)
+        else:
+            self._plain_step(gen_slots)
+        self.counters["steps"] += 1
+
+    def _plain_step(self, gen_slots):
+        self._cache, self._logits, finished, tok = self._step(
+            *self._gen._state_args(), self._cache, self._logits,
+            self._start, self._finished, self._active,
+            np.int32(self.pos))
+        tok = np.asarray(tok)
+        self._finished = np.array(finished)
+        self.pos += 1
+        for i in gen_slots:
+            slot = self._slots[i]
+            self._emit(slot, [int(tok[i])])
+            if self._finished[i] or len(slot.emitted) >= slot.req.max_new:
+                self._retire(i)
+
+    def _spec_step(self, gen_slots):
+        # clamp the stride so the commit lands exactly on the nearest
+        # activation boundary — a prefilling row's window must start
+        # the moment the frontier reaches its planned position (every
+        # remaining PREFILL act is > pos here: rows AT pos activated or
+        # burst-chunked in this same iteration)
+        boundaries = [s.act - self.pos
+                      for s in self._slots if s.state == _PREFILL]
+        mc = min([self._gamma + 1] + [b for b in boundaries if b > 0])
+        (self._cache, cur, finished, e, ncommit, n) = self._step(
+            *self._gen._state_args(), self._cache, self._cur,
+            self._start, self._finished, self._active,
+            np.int32(self.pos), np.int32(mc))
+        self._cur = np.array(cur)
+        self._finished = np.array(finished)
+        e = np.asarray(e)
+        k = int(ncommit)
+        self.pos += k
+        self._accepted += int(n)
+        self._proposed += self._gamma
+        for i in gen_slots:
+            slot = self._slots[i]
+            self._emit(slot, [int(t) for t in e[i, :k]])
+            if self._finished[i] or len(slot.emitted) >= slot.req.max_new:
+                self._retire(i)
+
+    def _emit(self, slot, toks):
+        if not slot.emitted:
+            dt = time.monotonic() - slot.req.t_submit
+            self._ttft.append(dt)
+            self._m_ttft.observe(dt)
+        take = slot.req.max_new - len(slot.emitted)
+        slot.emitted.extend(toks[:take])
+        self.counters["emitted_tokens"] += min(len(toks), take)
+
+    def _retire(self, i):
+        slot = self._slots[i]
+        req = slot.req
+        out = np.full((req.max_new,), self._end, np.int32)
+        out[:len(slot.emitted)] = slot.emitted
+        # eos freeze: every position after finish reads eos, exactly the
+        # scanned decode's padding — retiring early never changes bytes
+        req.future.set_result(out)
+        slot.state, slot.req = _EMPTY, None
+        slot.emitted = []
+        # copy-on-write for the same aliasing reason as _activate
+        self._finished = self._finished.copy()
+        self._finished[i] = True
+        self._active = self._active.copy()
+        self._active[i] = False
+        if self._spec:
+            self._cur = self._cur.copy()
+            self._cur[i] = 0
+        self.counters["retired"] += 1
+        self._m_retired.inc()
+
+    def reset_stats(self):
+        """Zero the loop-local accounting (the runtime calls this right
+        after its warm-up round-trip so steady-state counters start
+        clean — the registry instruments keep their monotonic totals)."""
+        with self._cond:
+            for k in self.counters:
+                self.counters[k] = 0
+            self._occupancy = 0.0
+            self._ttft.clear()
+            if self._spec:
+                self._accepted = 0
+                self._proposed = 0
+
+    # -- observability -------------------------------------------------------
+    def signals(self) -> dict:
+        """Token-level load snapshot for Server.signals() and the PR-16
+        ClusterSignals leg: the occupancy EWMA plus lifetime
+        joined/retired counters and queue backlog."""
+        with self._cond:
+            c = dict(self.counters)
+            pending = len(self._pending)
+            occ = self._occupancy
+        return {"decode_slot_occupancy_ratio": round(occ, 4),
+                "slots_joined_total": c["joined"],
+                "slots_retired_total": c["retired"],
+                "slot_steps_total": c["steps"],
+                "slot_pending": pending}
+
+    def stats(self) -> dict:
+        with self._cond:
+            c = dict(self.counters)
+            ttft = sorted(self._ttft)
+        out = {"slots": self.S, "cache": self.C, "chunk": self.T,
+               "occupancy_ewma": round(self._occupancy, 4), **c}
+        if ttft:
+            out["ttft_p50_ms"] = round(
+                ttft[len(ttft) // 2] * 1e3, 3)
+            out["ttft_p99_ms"] = round(
+                ttft[min(len(ttft) - 1,
+                         int(len(ttft) * 0.99))] * 1e3, 3)
+        if self._spec:
+            out["spec_accepted"] = self._accepted
+            out["spec_proposed"] = self._proposed
+            if self._proposed:
+                out["spec_acceptance_rate"] = round(
+                    self._accepted / self._proposed, 4)
+        return out

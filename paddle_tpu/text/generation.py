@@ -84,6 +84,25 @@ def _apply_layer(layer, params, buffers, ids, cache, pos, start):
     return unwrap(logits), [tuple(unwrap(p) for p in c) for c in new_cache]
 
 
+def _slice_row(cache, rowidx):
+    """Row ``rowidx`` of every cache plane as a batch-1 cache view (a
+    traced ``dynamic_slice`` — the row index is a runtime scalar)."""
+    return [tuple(lax.dynamic_slice(p, (rowidx,) + (0,) * (p.ndim - 1),
+                                    (1,) + p.shape[1:]) for p in c)
+            for c in cache]
+
+
+def _splice_row(cache, sub, rowidx):
+    """Write a batch-1 cache back into row ``rowidx`` of the full
+    planes — the single-row inverse of :func:`_slice_row`."""
+    return [tuple(lax.dynamic_update_slice(
+                      p, ps, (rowidx,) + (0,) * (p.ndim - 1))
+                  for p, ps in zip(c, cs))
+            for c, cs in zip(cache, sub)]
+
+
+
+
 class Generator:
     """Compiled incremental decoding for one model.
 
@@ -267,6 +286,127 @@ class Generator:
 
         return greedy if beam == 1 else beam_decode
 
+    # -- slot-loop programs (serving/slots.py) -------------------------------
+    def _build_step(self, S, C, end):
+        """ONE greedy token step over ``S`` slot rows — the body of the
+        run-to-completion scan, hoisted so the HOST owns the loop:
+        requests retire/join between dispatches with no recompile and no
+        cache copy.  Inactive rows' logits pass through unchanged so a
+        freshly activated row is never clobbered; their CACHE write is
+        deliberately NOT masked — the cache argument is donated and a
+        per-row blend would force XLA to preserve the donated planes
+        (a full-plane copy every step, measured ~4x the step cost on
+        CPU).  Instead the host guarantees every column a step writes
+        for an inactive row is dead: it lies inside the row's pending
+        chunk window [act-Pb, act) and the slot loop dispatches chunk k
+        only after the step at position act-n+k has retired (see
+        slots._dispatch_chunks), so the chunk rewrite always lands
+        after the last garbage write.  Emitted tokens for active rows
+        are bit-identical to the scanned decode's per-row stream (row
+        independence + the PR-7 batch/bucket invariance)."""
+        apply = self._apply_cached
+
+        def step(params, buffers, cache, logits, start, finished, active,
+                 pos):
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            tok = jnp.where(finished, jnp.int32(end), tok)
+            finished = finished | (tok == end)
+            # inactive rows may carry garbage argmax (end == -1 included)
+            # — clamp their fed token; their write lands in a dead column
+            fed = jnp.where(active, tok, jnp.int32(0))
+            nlogits, ncache = apply(params, buffers, fed[:, None], cache,
+                                    pos, start)
+            nlog = jnp.where(active[:, None],
+                             nlogits[:, 0].astype(jnp.float32), logits)
+            return ncache, nlog, finished, tok
+
+        return step
+
+    def _build_chunk(self, S, T, C):
+        """One Sarathi-style prefill chunk: forward ``T`` prompt tokens
+        of ONE joining row at the block position ``pos``, writing its
+        K/V block without touching any other slot's plane.  The forward
+        runs at batch 1 over the row's sliced planes — rows are
+        independent in forward_cached, so the batch-1 compute is bit-
+        identical to that row's lane in a batched dispatch, and a chunk
+        costs the row's own FLOPs instead of ``S``× them.  Returns the
+        chunk's last-column logits — the final chunk's are the
+        activation logits (= the prefill executable's ``logits[:, -1]``
+        for the same prompt)."""
+        apply = self._apply_cached
+
+        def chunk(params, buffers, cache, ids, start, rowidx, pos):
+            sub = _slice_row(cache, rowidx)
+            logits, nsub = apply(params, buffers, ids, sub, pos, start)
+            return _splice_row(cache, nsub, rowidx), \
+                logits[0, -1, :].astype(jnp.float32)
+
+        return chunk
+
+    def step_exec(self, S, C, eos_token_id=None):
+        """AOT single-step decode executable over ``S`` slots at cache
+        bucket ``C`` (ledger kind ``generate_step``) — the slot loop's
+        hot dispatch."""
+        if self._mesh is not None:
+            raise InvalidArgumentError(
+                "slot decode (FLAGS_decode_slots) runs per-replica "
+                "unsharded — drop the mesh or the slot loop")
+        end = -1 if eos_token_id is None else int(eos_token_id)
+        key = self._key("step2", S, None, C, 1, 1, end)
+        fn = self._build_step(S, C, end)
+        return self._compile(key, "generate_step", fn,
+                             self.step_avals(S, C),
+                             {"slots": S, "cache": C, "eos": end},
+                             donate_argnums=(2,))
+
+    def chunk_exec(self, S, T, C):
+        """AOT prefill-chunk executable over ``S`` slots at chunk width
+        ``T`` and cache bucket ``C`` (ledger kind ``generate_chunk``)."""
+        if self._mesh is not None:
+            raise InvalidArgumentError(
+                "slot decode (FLAGS_decode_slots) runs per-replica "
+                "unsharded — drop the mesh or the slot loop")
+        key = self._key("chunk2", S, T, C, None, None)
+        fn = self._build_chunk(S, T, C)
+        return self._compile(key, "generate_chunk", fn,
+                             self.chunk_avals(S, T, C),
+                             {"slots": S, "chunk": T, "cache": C},
+                             donate_argnums=(2,))
+
+    def step_avals(self, S, C):
+        """Non-state avals of the slot step program (cache, logits,
+        start, finished, active, pos) — shared by the AOT compile and
+        the serving graph-lint admission gate."""
+        vocab = self._vocab_size()
+        return (self._slot_cache_avals(S, C),
+                jax.ShapeDtypeStruct((S, vocab), jnp.float32),
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.bool_),
+                jax.ShapeDtypeStruct((S,), jnp.bool_),
+                jax.ShapeDtypeStruct((), jnp.int32))
+
+    def chunk_avals(self, S, T, C):
+        """Non-state avals of the single-row prefill-chunk program
+        (cache, ids [1, T], start [1], row index, block position)."""
+        return (self._slot_cache_avals(S, C),
+                jax.ShapeDtypeStruct((1, T), jnp.int32),
+                jax.ShapeDtypeStruct((1,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32))
+
+    def _slot_cache_avals(self, S, C):
+        raw = jax.eval_shape(lambda: self._init_cache_raw(S, C))
+        return [tuple(jax.ShapeDtypeStruct(p.shape, p.dtype) for p in c)
+                for c in raw]
+
+    def init_slot_cache(self, S, C):
+        """Zero device planes for a fresh slot session — never compiled
+        as a program of its own (validity windows make the init values
+        unobservable; zeros match the in-graph prefill init)."""
+        raw = jax.eval_shape(lambda: self._init_cache_raw(S, C))
+        return [tuple(jnp.zeros(tuple(p.shape), p.dtype) for p in c)
+                for c in raw]
+
     # -- AOT compile + ledger ------------------------------------------------
     def _key(self, phase, B, P, C, steps, beam, end=None):
         # the cache storage dtype is part of the program: flipping
@@ -327,7 +467,7 @@ class Generator:
                 *mesh_id)
 
     def _compile(self, key, kind, fn, arg_avals, extra,
-                 out_shardings=None):
+                 out_shardings=None, donate_argnums=None):
         ex = self._execs.get(key)
         if ex is not None:
             _ledger.record_cache_hit(self._site)
@@ -335,6 +475,12 @@ class Generator:
         from ..jit import persistent_cache as _pcache
         jit_kw = {} if out_shardings is None \
             else {"out_shardings": out_shardings}
+        if donate_argnums is not None:
+            # slot-loop programs donate the ring cache: XLA aliases the
+            # input planes to the output planes, turning the per-step
+            # column writes into in-place updates instead of full-plane
+            # copies (the host never reuses the donated handle)
+            jit_kw["donate_argnums"] = donate_argnums
         ex, _loaded = _pcache.load_or_compile(
             lambda: jax.jit(fn, **jit_kw).lower(*self._state_avals(),
                                                 *arg_avals).compile(),

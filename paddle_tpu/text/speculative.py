@@ -61,7 +61,7 @@ from ..framework.functional import layer_state as _layer_state
 from ..profiler import tracing as _tracing
 from ..profiler.metrics import default_registry as _registry
 from .generation import Generator as _Generator
-from .generation import _apply_layer, _aval
+from .generation import (_apply_layer, _aval, _slice_row, _splice_row)
 
 __all__ = ["SpeculativeGenerator"]
 SPEC_PROPOSED = _registry().counter(
@@ -267,6 +267,149 @@ class SpeculativeGenerator(_Generator):
             return toks, out[6], out[7], out[8]
 
         return decode
+
+    # -- slot-loop programs (serving/slots.py) -------------------------------
+    def _build_step(self, S, C, end):
+        """ONE speculative step over ``S`` slot rows — the while-loop
+        body hoisted so the host owns the loop.  Two slot-specific
+        inputs: ``active`` keeps empty/mid-prefill rows from pacing the
+        lockstep acceptance (they report gamma, like finished rows);
+        ``max_commit`` clamps the commit
+        count so the variable stride lands EXACTLY on the host's next
+        chunk/activation boundary — committing fewer tokens than the
+        target accepted is always exact (the next token is the target's
+        argmax at the clamped position), it only costs speed."""
+        gamma = self._gamma
+        G1 = gamma + 1
+        target, draft = self._layer, self._draft
+
+        def step(tp, tb, dp, db, caches, cur, start, finished, active,
+                 pos, max_commit):
+            t_cache, d_cache = caches
+            cur_safe = jnp.where(active, cur, jnp.int32(0))
+
+            def dstep(dc, _):
+                cache, tok, p = dc
+                lg, cache = _apply_layer(draft, dp, db, tok[:, None],
+                                         cache, p, start)
+                nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+                return (cache, nxt, p + 1), tok
+
+            (d_new, _, _), fed = lax.scan(
+                dstep, (d_cache, cur_safe, pos), None, length=G1)
+            v_in = jnp.transpose(fed)              # [S, G1]
+            v_logits, t_new = _apply_layer(target, tp, tb, v_in, t_cache,
+                                           pos, start)
+            g = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
+            match = (v_in[:, 1:] == g[:, :-1]).astype(jnp.int32)
+            n_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            n = jnp.min(jnp.where(finished | ~active, gamma, n_row)) \
+                .astype(jnp.int32)
+            ncommit = jnp.minimum(n + 1, max_commit)
+            cur_next = jnp.take_along_axis(
+                g, jnp.broadcast_to(ncommit - 1, (S,))[:, None],
+                axis=1)[:, 0]
+            is_end = (v_in == jnp.int32(end))
+            before = (jnp.cumsum(is_end.astype(jnp.int32), axis=1)
+                      - is_end.astype(jnp.int32))
+            e = jnp.where(finished[:, None] | (before > 0),
+                          jnp.int32(end), v_in)
+            col = jnp.arange(G1, dtype=jnp.int32)
+            finished2 = finished | jnp.any(
+                (e == jnp.int32(end)) & (col[None, :] < ncommit), axis=1)
+            cur_next = jnp.where(finished2, jnp.int32(end), cur_next)
+            # no per-row cache blend: both caches are donated and a
+            # blend would force a full-plane protective copy per step —
+            # inactive rows' garbage block [pos, pos+G1) is dead by the
+            # host chunk schedule (slots._dispatch_chunks) and by the
+            # next active dispatch rewriting [pos', pos'+G1) before any
+            # commit exposes it
+            return (t_new, d_new), cur_next, finished2, e, ncommit, n
+
+        return step
+
+    def _build_chunk(self, S, T, C):
+        """One JOINT prefill chunk: target and draft both consume the
+        joining row's ``T`` prompt tokens at the block position, so the
+        two caches stay position-aligned exactly like the joint prefill
+        executable.  Single-row like the plain chunk — both forwards
+        run at batch 1 over the row's sliced planes.  Returns the
+        target's last-column logits."""
+        target, draft = self._layer, self._draft
+
+        def chunk(tp, tb, dp, db, caches, ids, start, rowidx, pos):
+            t_cache, d_cache = caches
+            t_sub = _slice_row(t_cache, rowidx)
+            d_sub = _slice_row(d_cache, rowidx)
+            t_logits, t_new = _apply_layer(target, tp, tb, ids, t_sub,
+                                           pos, start)
+            _, d_new = _apply_layer(draft, dp, db, ids, d_sub, pos,
+                                    start)
+            return (_splice_row(t_cache, t_new, rowidx),
+                    _splice_row(d_cache, d_new, rowidx)), \
+                t_logits[0, -1, :].astype(jnp.float32)
+
+        return chunk
+
+    def step_exec(self, S, C, eos_token_id=None):
+        """AOT single speculative step over ``S`` slots (ledger kind
+        ``spec_step``)."""
+        end = -1 if eos_token_id is None else int(eos_token_id)
+        key = self._key("step2", S, None, C, 1, 1, end)
+        fn = self._build_step(S, C, end)
+        return self._compile(key, "spec_step", fn, self.step_avals(S, C),
+                             {"slots": S, "cache": C, "eos": end,
+                              "gamma": self._gamma},
+                             donate_argnums=(4,))
+
+    def chunk_exec(self, S, T, C):
+        """AOT joint prefill-chunk executable over ``S`` slots (ledger
+        kind ``spec_chunk``)."""
+        key = self._key("chunk2", S, T, C, None, None)
+        fn = self._build_chunk(S, T, C)
+        return self._compile(key, "spec_chunk", fn,
+                             self.chunk_avals(S, T, C),
+                             {"slots": S, "chunk": T, "cache": C,
+                              "gamma": self._gamma},
+                             donate_argnums=(4,))
+
+    def step_avals(self, S, C):
+        """Non-state avals of the speculative slot step (cache pair,
+        cur, start, finished, active, pos, max_commit)."""
+        caches = (self._slot_cache_avals(S, C),
+                  self._slot_draft_cache_avals(S, C))
+        return (caches,
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.bool_),
+                jax.ShapeDtypeStruct((S,), jnp.bool_),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32))
+
+    def chunk_avals(self, S, T, C):
+        """Non-state avals of the single-row joint prefill-chunk
+        program (cache pair, ids [1, T], start [1], row index, block
+        position)."""
+        caches = (self._slot_cache_avals(S, C),
+                  self._slot_draft_cache_avals(S, C))
+        return (caches,
+                jax.ShapeDtypeStruct((1, T), jnp.int32),
+                jax.ShapeDtypeStruct((1,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32))
+
+    def _slot_draft_cache_avals(self, S, C):
+        raw = jax.eval_shape(lambda: self._init_draft_cache_raw(S, C))
+        return [tuple(jax.ShapeDtypeStruct(p.shape, p.dtype) for p in c)
+                for c in raw]
+
+    def init_slot_cache(self, S, C):
+        """Zero (target, draft) cache pair for a fresh slot session."""
+        t = super().init_slot_cache(S, C)
+        raw = jax.eval_shape(lambda: self._init_draft_cache_raw(S, C))
+        d = [tuple(jnp.zeros(tuple(p.shape), p.dtype) for p in c)
+             for c in raw]
+        return (t, d)
 
     # -- AOT compile + ledger ------------------------------------------------
     def _key(self, phase, B, P, C, steps, beam, end=None):
