@@ -51,22 +51,30 @@ class HeartbeatMonitor:
     ``ranks`` generalizes the watched set beyond ``range(world_size)``
     for members that join/leave dynamically — the serving router watches
     replica ids (``replica:<id>``) through the same store keys the
-    elastic launcher watches integer ranks through.
+    elastic launcher watches integer ranks through.  ``set_ranks`` is
+    safe against a concurrent ``stale_ranks`` scan: the autoscaling
+    controller mutates the watched set while the watchdog thread reads
+    it, so the swap happens under a lock and scans work on a snapshot.
     """
 
     def __init__(self, store, world_size=0, stale_after=15.0, ranks=None):
         self._store = store
         self._world = world_size
         self._stale_after = stale_after
+        self._lock = threading.Lock()
         self._ranks = None if ranks is None else list(ranks)
 
     def set_ranks(self, ranks):
         """Replace the watched id set (replica join/evict)."""
-        self._ranks = list(ranks)
+        snapshot = list(ranks)
+        with self._lock:
+            self._ranks = snapshot
 
     def watched(self):
-        return list(self._ranks) if self._ranks is not None \
-            else list(range(self._world))
+        with self._lock:
+            if self._ranks is not None:
+                return list(self._ranks)
+        return list(range(self._world))
 
     def stale_ranks(self):
         now = time.time()

@@ -460,6 +460,44 @@ define_flag("router_retry_backoff_s",
             "(rejections normally carry the queue's own estimate).",
             validator=lambda v: float(v) >= 0)
 
+# ---- Elastic cluster lifecycle (serving/cluster/lifecycle.py) ---------------
+define_flag("autoscale_queue_high",
+            float(os.environ.get("PADDLE_TPU_AUTOSCALE_QUEUE_HIGH",
+                                 "8.0")),
+            "Scale-up trigger: mean queue depth per live replica above "
+            "which the AutoscaleController spawns another replica "
+            "(subject to its max and cooldown).",
+            validator=lambda v: float(v) > 0)
+define_flag("autoscale_idle_polls",
+            int(os.environ.get("PADDLE_TPU_AUTOSCALE_IDLE_POLLS", "3")),
+            "Scale-down trigger: consecutive controller polls the "
+            "cluster must look idle (empty queues, cold retry hints) "
+            "before one replica is drained and retired.",
+            validator=lambda v: int(v) >= 1)
+define_flag("autoscale_cooldown_polls",
+            int(os.environ.get("PADDLE_TPU_AUTOSCALE_COOLDOWN_POLLS",
+                               "2")),
+            "Polls the controller sits out after any scale action — "
+            "hysteresis so a replica mid-boot is not double-spawned and "
+            "a fresh retirement is not immediately reversed.",
+            validator=lambda v: int(v) >= 0)
+define_flag("drain_timeout_s",
+            float(os.environ.get("PADDLE_TPU_DRAIN_TIMEOUT_S", "30.0")),
+            "Graceful-drain budget: how long a retiring replica may "
+            "take to finish queued batches and slot-loop rows before "
+            "the controller escalates to eviction (the SIGKILL-style "
+            "path graceful retirement exists to avoid).",
+            validator=lambda v: float(v) > 0)
+define_flag("serving_tenant_quota",
+            int(os.environ.get("PADDLE_TPU_SERVING_TENANT_QUOTA", "0")),
+            "Default per-tenant pending-request quota in the "
+            "RequestQueue (admission control): a tenant at its quota "
+            "gets UnavailableError with a retry_after hint while other "
+            "tenants keep their queue slots. 0 (default) = unlimited — "
+            "single-tenant behavior unchanged, one branch. Per-tenant "
+            "overrides via RequestQueue.set_tenant_policy.",
+            validator=lambda v: int(v) >= 0)
+
 # ---- Request tracing + typed metrics plane (paddle_tpu.profiler) ------------
 define_flag("trace",
             os.environ.get("PADDLE_TPU_TRACE", "off").lower() or "off",

@@ -41,6 +41,8 @@ from __future__ import annotations
 
 from .handoff import (KVHandoff, deserialize_kv,  # noqa: F401
                       serialize_kv)
+from .lifecycle import (AutoscaleController, RollingUpdate,  # noqa: F401
+                        RolloutJournal)
 from .obs import (ClusterObserver, ClusterSignals,  # noqa: F401
                   ReplicaSignals, federated_prometheus_text,
                   serve_cluster_metrics)
@@ -56,6 +58,7 @@ __all__ = [
     "RpcServer", "RpcClient", "RpcError",
     "Replica", "replica_main",
     "Router", "ReplicaHandle", "LocalReplica", "RemoteReplica",
+    "AutoscaleController", "RollingUpdate", "RolloutJournal",
     "ClusterObserver", "ClusterSignals", "ReplicaSignals",
     "federated_prometheus_text", "serve_cluster_metrics",
     "ShardedModelSpec", "serving_shard_specs", "shard_admission_audit",

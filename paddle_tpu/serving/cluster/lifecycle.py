@@ -1,0 +1,548 @@
+"""Elastic cluster lifecycle: autoscaling, graceful drain, rollouts.
+
+PR 15 froze the replica set at boot; PR 16 made the cluster legible
+(ClusterSignals); this module makes it *dynamic* — the serving seat of
+the reference's ``distributed/fleet/elastic`` layer (ElasticManager
+scale events), rebuilt around three primitives:
+
+  * :class:`AutoscaleController` — consumes one ClusterSignals snapshot
+    per poll and converges the live replica count toward load: spawns a
+    replica (through a caller-supplied ``spawn`` — tools/serve.py passes
+    an ElasticLaunch-style ``Popen`` closure; tests pass an in-process
+    handle factory) when per-replica queue depth or retry-after pressure
+    crosses ``FLAGS_autoscale_queue_high``, and retires the least-loaded
+    replica after ``FLAGS_autoscale_idle_polls`` consecutive idle polls.
+    Retirement is **graceful drain**: the replica's ``drain`` RPC flips
+    it to stop-accepting (UnavailableError + retry_after, so the Router
+    redirects), queued batches and slot-loop rows finish at token
+    boundaries, then the replica deregisters (rendezvous tombstone) and
+    the router removes it cleanly — SIGKILL eviction becomes the
+    escalation for a drain that wedges past ``FLAGS_drain_timeout_s``,
+    not the default.
+  * :class:`RollingUpdate` — zero-downtime version rollouts behind a
+    canary gate: a held-out replica of the new version must BIT-MATCH a
+    current-version control on held-back traffic before anything enters
+    rotation; then old replicas are replaced one at a time,
+    spawn-before-drain so capacity never dips.  A mismatch (or a
+    ``canary_mismatch`` fault clause) rolls back instantly —
+    ``rollout_rollback_total`` counts it, the flight recorder keeps the
+    evidence.  Every completed step commits to an atomic JSON journal,
+    so a controller killed mid-rollout resumes where it stopped instead
+    of replacing anything twice.
+
+Chaos drills: the PR-3 fault plans grew ``spawn_fail`` / ``drain_hang``
+/ ``canary_mismatch`` clauses; every escalation path here consults them
+and dumps a flight-recorder postmortem when armed.  Deterministic — a
+drill reproduces bit-for-bit.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ...framework import flags as _flags
+from ...framework.enforce import UnavailableError
+from ...profiler import flight as _flight
+from ...profiler.metrics import default_registry as _registry
+from ...testing import faults as _faults
+from .router import ReplicaHandle, Router
+
+__all__ = ["AutoscaleController", "RollingUpdate", "RolloutJournal"]
+
+# -- typed metrics (docs/METRICS.md inventory) --------------------------------
+AUTOSCALE_UP = _registry().counter(
+    "autoscale_up_total",
+    "Replicas the autoscaling controller spawned (scale-up decisions "
+    "that actually launched a replica).")
+AUTOSCALE_DOWN = _registry().counter(
+    "autoscale_down_total",
+    "Replicas the controller retired on the scale-down path (graceful "
+    "drain completed and the router deregistered them cleanly).")
+AUTOSCALE_SPAWN_FAILURES = _registry().counter(
+    "autoscale_spawn_failures_total",
+    "Replica spawns that failed (the spawn callable raised, or a "
+    "spawn_fail fault clause fired); the controller retries on a later "
+    "poll under its retry budget.")
+AUTOSCALE_TARGET = _registry().gauge(
+    "autoscale_target_replicas",
+    "The controller's current target replica count — compare with "
+    "router_replicas_live to see convergence lag.")
+DRAIN_INITIATED = _registry().counter(
+    "drain_initiated_total",
+    "Graceful-drain orders sent to replicas (scale-down retirements "
+    "and rolling-update replacements).")
+DRAIN_COMPLETED = _registry().counter(
+    "drain_completed_total",
+    "Drains that finished inside FLAGS_drain_timeout_s: queue empty, "
+    "every admitted request resolved, replica deregistered cleanly.")
+DRAIN_TIMEOUTS = _registry().counter(
+    "drain_timeouts_total",
+    "Drains that wedged past the budget and were escalated to eviction "
+    "(the drain_hang chaos drill exercises exactly this path).")
+ROLLOUT_STEPS = _registry().counter(
+    "rollout_steps_total",
+    "Rolling-update replacement steps committed (one old replica "
+    "drained out, one new-version replica serving in its place).")
+ROLLOUT_CANARY_CHECKS = _registry().counter(
+    "rollout_canary_checks_total",
+    "Canary bit-match comparisons run against the control replica "
+    "before a rollout was allowed to proceed.")
+ROLLOUT_ROLLBACKS = _registry().counter(
+    "rollout_rollback_total",
+    "Rollouts aborted by the canary gate (bit-mismatch, real or "
+    "fault-injected): the canary was destroyed before entering "
+    "rotation, the old version kept serving.")
+ROLLOUT_ACTIVE = _registry().gauge(
+    "rollout_active",
+    "1 while a rolling update is in progress, else 0 — alert route for "
+    "'a deploy is half-done'.")
+
+
+class AutoscaleController:
+    """Converge the live replica count toward load, politely.
+
+    ``spawn(replica_id, version)`` launches one replica and returns
+    either a :class:`ReplicaHandle` (in-process replicas: the
+    controller adds it to the router immediately) or an opaque process
+    token — anything with ``poll()``/``send_signal()``, typically a
+    ``Popen`` — whose replica rendezvouses through the TCPStore and is
+    discovered by the router's watch loop.  Scale-down picks the
+    least-loaded live replica and retires it through :meth:`retire`'s
+    drain-then-deregister path, escalating to eviction only when the
+    drain wedges.
+
+    The controller itself is poll-driven and thread-free: call
+    :meth:`step` with each ClusterSignals snapshot (the router's
+    observer cadence), or drive :meth:`scale_to` imperatively (the
+    tools/serve.py ``--ramp`` drill).
+    """
+
+    def __init__(self, router: Router,
+                 spawn: Callable[[str, str], Any], *,
+                 min_replicas: int = 1,
+                 max_replicas: int = 8,
+                 version: str = "v0",
+                 queue_high: Optional[float] = None,
+                 idle_polls: Optional[int] = None,
+                 cooldown_polls: Optional[int] = None,
+                 drain_timeout_s: Optional[float] = None,
+                 max_spawn_retries: int = 3,
+                 spawn_grace_s: float = 120.0):
+        self.router = router
+        self._spawn = spawn
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.version = str(version)
+        self._queue_high = float(
+            queue_high if queue_high is not None
+            else _flags.flag("autoscale_queue_high"))
+        self._idle_polls = int(
+            idle_polls if idle_polls is not None
+            else _flags.flag("autoscale_idle_polls"))
+        self._cooldown_polls = int(
+            cooldown_polls if cooldown_polls is not None
+            else _flags.flag("autoscale_cooldown_polls"))
+        self._drain_timeout = float(
+            drain_timeout_s if drain_timeout_s is not None
+            else _flags.flag("drain_timeout_s"))
+        self._max_spawn_retries = int(max_spawn_retries)
+        self._spawn_grace_s = float(spawn_grace_s)
+        self._lock = threading.Lock()
+        self._idle = 0
+        self._cooldown = 0
+        self._spawn_seq = 0
+        self._spawn_failures = 0          # consecutive, reset on success
+        self._spawning: Dict[str, float] = {}   # id -> spawn monotonic
+        self._tokens: Dict[str, Any] = {}       # id -> process token
+        self.decisions: List[dict] = []         # drill-report trail
+        AUTOSCALE_TARGET.set(self.min_replicas)
+
+    # -- membership helpers ---------------------------------------------------
+    def _live(self) -> List[ReplicaHandle]:
+        return [h for h in self.router.handles() if h.alive]
+
+    def _reconcile_spawning(self) -> None:
+        """Forget pending spawns that joined the router (or died)."""
+        live_ids = {h.id for h in self._live()}
+        now = time.monotonic()
+        for rid in list(self._spawning):
+            if rid in live_ids:
+                del self._spawning[rid]
+                continue
+            tok = self._tokens.get(rid)
+            died = tok is not None and getattr(tok, "poll", lambda: None)() \
+                is not None
+            if died or now - self._spawning[rid] > self._spawn_grace_s:
+                # spawned but never rendezvoused: count it failed so a
+                # later poll can try again
+                del self._spawning[rid]
+                self._tokens.pop(rid, None)
+                AUTOSCALE_SPAWN_FAILURES.inc()
+                _flight.dump("spawn_lost")
+
+    def pending_spawns(self) -> int:
+        with self._lock:
+            return len(self._spawning)
+
+    # -- scale actions --------------------------------------------------------
+    def spawn_replica(self, replica_id: Optional[str] = None,
+                      version: Optional[str] = None) -> Optional[str]:
+        """Launch one replica; returns its id, or None when the spawn
+        failed (fault-injected or real) — the caller retries on a later
+        poll under ``max_spawn_retries`` consecutive failures."""
+        with self._lock:
+            rid = replica_id or f"auto{self._spawn_seq}"
+            self._spawn_seq += 1
+        ver = str(version or self.version)
+        plan = _faults.active_plan()
+        failed: Optional[str] = None
+        token: Any = None
+        if plan is not None and plan.should_fail_spawn():
+            failed = "fault:spawn_fail"
+        else:
+            try:
+                token = self._spawn(rid, ver)
+            except Exception as e:   # noqa: BLE001 — spawn is external
+                failed = f"{type(e).__name__}: {e}"
+        if failed is not None:
+            AUTOSCALE_SPAWN_FAILURES.inc()
+            self._spawn_failures += 1
+            _flight.dump("spawn_fail")
+            if self._spawn_failures > self._max_spawn_retries:
+                raise UnavailableError(
+                    f"replica spawn failed {self._spawn_failures} times "
+                    f"in a row (last: {failed}) — scale-up abandoned")
+            return None
+        self._spawn_failures = 0
+        if isinstance(token, ReplicaHandle):
+            token.version = ver
+            self.router.add_replica(token)
+        else:
+            with self._lock:
+                self._spawning[rid] = time.monotonic()
+                if token is not None:
+                    self._tokens[rid] = token
+        AUTOSCALE_UP.inc()
+        return rid
+
+    def retire(self, replica_id: str) -> dict:
+        """Gracefully retire one replica: drain (stop-accepting →
+        in-flight work finishes → rendezvous tombstone), then
+        deregister from the router.  A drain that wedges past the
+        budget escalates to eviction — the SIGKILL-style path the
+        drill asserts we normally avoid."""
+        h = next((x for x in self._live() if x.id == str(replica_id)),
+                 None)
+        if h is None:
+            return {"action": "retire", "replica": str(replica_id),
+                    "skipped": "not live"}
+        DRAIN_INITIATED.inc()
+        t0 = time.monotonic()
+        try:
+            report = h.drain(timeout=self._drain_timeout, retire=True)
+        except Exception as e:   # noqa: BLE001 — transport died mid-drain
+            report = {"drained": False, "error": f"{type(e).__name__}: {e}"}
+        out = {"action": "retire", "replica": h.id,
+               "drained": bool(report.get("drained")),
+               "duration_s": round(time.monotonic() - t0, 3),
+               "report": report}
+        if report.get("drained"):
+            self.router.deregister(h.id, reason="drained")
+            DRAIN_COMPLETED.inc()
+            AUTOSCALE_DOWN.inc()
+            self._await_token_exit(h.id)
+        else:
+            DRAIN_TIMEOUTS.inc()
+            _flight.dump("drain_timeout")
+            self.router.evict(h.id, reason="drain_timeout")
+            self._kill_token(h.id)
+            out["escalated"] = "evict"
+        self.decisions.append(out)
+        return out
+
+    def _await_token_exit(self, rid: str, grace_s: float = 10.0) -> None:
+        tok = self._tokens.pop(rid, None)
+        if tok is None or not hasattr(tok, "poll"):
+            return
+        deadline = time.monotonic() + grace_s
+        while tok.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if tok.poll() is None:           # drained but lingering: SIGTERM
+            try:
+                tok.terminate()
+            except Exception:   # noqa: BLE001
+                pass
+
+    def _kill_token(self, rid: str) -> None:
+        tok = self._tokens.pop(rid, None)
+        if tok is not None and getattr(tok, "poll", lambda: 0)() is None:
+            try:
+                tok.kill()
+            except Exception:   # noqa: BLE001
+                pass
+
+    def _pick_victim(self) -> Optional[ReplicaHandle]:
+        """Least-loaded live replica — the cheapest one to drain."""
+        live = self._live()
+        if not live:
+            return None
+        return min(live, key=lambda h: (h.inflight, h.queue_depth,
+                                        h.dispatched))
+
+    # -- the poll-driven policy ----------------------------------------------
+    def step(self, signals=None) -> dict:
+        """One control decision from one ClusterSignals snapshot (falls
+        back to the router's attached observer when None).  Returns the
+        decision record it also appends to ``self.decisions``."""
+        if signals is None and self.router.observer() is not None:
+            signals = self.router.observer().poll()
+        self._reconcile_spawning()
+        live = self._live()
+        n = len(live)
+        booting = self.pending_spawns()
+        decision = {"action": "none", "live": n, "booting": booting}
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            decision["action"] = "cooldown"
+            self.decisions.append(decision)
+            return decision
+        qdepth = float(getattr(signals, "total_queue_depth", 0) or 0)
+        retry = float(getattr(signals, "max_retry_after_s", 0.0) or 0.0)
+        slot_occ = float(getattr(signals, "max_decode_slot_occupancy",
+                                 0.0) or 0.0)
+        per_replica_q = qdepth / max(1, n)
+        pressured = (per_replica_q >= self._queue_high
+                     or retry >= 1.0 or slot_occ >= 0.95)
+        if pressured and n + booting < self.max_replicas:
+            self._idle = 0
+            rid = self.spawn_replica()
+            decision["action"] = "scale_up" if rid else "spawn_fail"
+            decision["replica"] = rid
+            decision["per_replica_queue"] = round(per_replica_q, 2)
+            AUTOSCALE_TARGET.set(n + booting + (1 if rid else 0))
+            self._cooldown = self._cooldown_polls
+        elif (not pressured and qdepth == 0 and booting == 0
+                and n > self.min_replicas):
+            self._idle += 1
+            if self._idle >= self._idle_polls:
+                self._idle = 0
+                victim = self._pick_victim()
+                if victim is not None:
+                    decision = self.retire(victim.id)
+                    decision["live"] = n
+                    AUTOSCALE_TARGET.set(max(self.min_replicas, n - 1))
+                    self._cooldown = self._cooldown_polls
+                    return decision      # retire() already recorded it
+            decision["action"] = "idle"
+            decision["idle_polls"] = self._idle
+        else:
+            self._idle = 0
+        self.decisions.append(decision)
+        return decision
+
+    # -- imperative scaling (the --ramp drill) --------------------------------
+    def scale_to(self, n: int, version: Optional[str] = None) -> List[dict]:
+        """Imperatively converge toward ``n`` live replicas: spawn up
+        (respecting pending boots) or drain down, one decision list
+        back.  Discovery/boot is asynchronous for process spawns — pair
+        with :meth:`wait_live`."""
+        n = int(n)
+        out: List[dict] = []
+        AUTOSCALE_TARGET.set(n)
+        self._reconcile_spawning()
+        while len(self._live()) + self.pending_spawns() < n:
+            rid = self.spawn_replica(version=version)
+            out.append({"action": "scale_up" if rid else "spawn_fail",
+                        "replica": rid})
+            if rid is None:
+                break                    # retry budget handles repeats
+        while len(self._live()) > n:
+            victim = self._pick_victim()
+            if victim is None:
+                break
+            out.append(self.retire(victim.id))
+        return out
+
+    def wait_live(self, n: int, timeout_s: float = 120.0) -> bool:
+        """Poll the router until ``n`` replicas are live (discovery is
+        the router's watch loop; this just waits on its effect)."""
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            self.router.poll()
+            self._reconcile_spawning()
+            if len(self._live()) >= int(n):
+                return True
+            time.sleep(0.1)
+        return len(self._live()) >= int(n)
+
+
+class RolloutJournal:
+    """Atomic on-disk rollout state: which replicas the rolling update
+    has already replaced, and whether the canary was promoted.  One
+    JSON file, committed with write-temp-then-rename after EVERY step —
+    a controller SIGKILLed mid-rollout resumes from the journal and
+    never replaces (or double-spawns) a replica twice."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.state: Dict[str, Any] = {"version": None, "promoted": None,
+                                      "replaced": [], "done": False}
+        if path and os.path.exists(path):
+            try:
+                with open(path, "r") as f:
+                    self.state.update(json.load(f))
+            except (OSError, ValueError):
+                pass                     # unreadable journal = fresh start
+
+    def reset(self, version: str) -> None:
+        self.state = {"version": str(version), "promoted": None,
+                      "replaced": [], "done": False}
+        self.commit()
+
+    def commit(self) -> None:
+        if not self.path:
+            return
+        from ...checkpoint.atomic import atomic_write_bytes
+        atomic_write_bytes(self.path,
+                           json.dumps(self.state, indent=1).encode())
+
+    def resumable_for(self, version: str) -> bool:
+        return self.state.get("version") == str(version) \
+            and not self.state.get("done")
+
+
+class RollingUpdate:
+    """Replace the cluster's replicas with a new artifact version, one
+    at a time, with zero downtime and a canary gate.
+
+    ``spawn_heldout(replica_id, version)`` must return a LIVE
+    :class:`ReplicaHandle` that is NOT in the router's rotation (an
+    in-process handle, or a RemoteReplica dialed directly at a child
+    started without the rendezvous store) — the canary takes held-back
+    traffic only.  ``canary_requests`` is a list of request specs::
+
+        {"op": "infer",  "model": m, "inputs":  [arr, ...]}
+        {"op": "decode", "model": m, "prompts": [ids, ...], "max_new": k}
+
+    Each spec runs on the canary AND on a current-version control
+    replica; every output must bit-match (``np.array_equal``) or the
+    rollout aborts — canary destroyed, ``rollout_rollback_total``
+    bumped, postmortem dumped, old version untouched.  A
+    ``canary_mismatch`` fault clause forces the mismatch verdict for
+    the drill.  After promotion, replacement steps are
+    spawn-before-drain (capacity never dips below the old count) and
+    journaled atomically for crash resume.
+    """
+
+    def __init__(self, controller: AutoscaleController,
+                 spawn_heldout: Callable[[str, str], ReplicaHandle],
+                 canary_requests: List[dict], *,
+                 journal_path: Optional[str] = None):
+        self._ctrl = controller
+        self._router = controller.router
+        self._spawn_heldout = spawn_heldout
+        self._canary_requests = list(canary_requests)
+        self._journal = RolloutJournal(journal_path)
+
+    # -- canary traffic -------------------------------------------------------
+    @staticmethod
+    def _call(handle: ReplicaHandle, spec: dict):
+        if spec["op"] == "decode":
+            out = handle.submit_decode(
+                spec["model"],
+                [np.asarray(p, np.int32) for p in spec["prompts"]],
+                max_new=spec.get("max_new"))
+            return [np.asarray(out)]
+        return [np.asarray(o) for o in handle.submit(
+            spec["model"], [np.asarray(a) for a in spec["inputs"]])]
+
+    def _canary_matches(self, canary: ReplicaHandle,
+                        control: ReplicaHandle) -> bool:
+        plan = _faults.active_plan()
+        ok = True
+        for spec in self._canary_requests:
+            ROLLOUT_CANARY_CHECKS.inc()
+            got = self._call(canary, spec)
+            want = self._call(control, spec)
+            if plan is not None and plan.should_mismatch_canary():
+                ok = False
+            elif len(got) != len(want) or not all(
+                    np.array_equal(g, w) for g, w in zip(got, want)):
+                ok = False
+            if not ok:
+                break
+        return ok
+
+    # -- the rollout ----------------------------------------------------------
+    def run(self, new_version: str,
+            wait_live_s: float = 120.0) -> dict:
+        """Execute (or resume) the rollout to ``new_version``.  Returns
+        a report: ``rolled_back`` True means the canary gate fired and
+        the old version is still serving everywhere."""
+        new_version = str(new_version)
+        if not self._journal.resumable_for(new_version):
+            self._journal.reset(new_version)
+        st = self._journal.state
+        ROLLOUT_ACTIVE.set(1)
+        try:
+            old = [h for h in self._ctrl._live()
+                   if h.version != new_version]
+            # -- canary gate (skipped on resume past promotion) ------------
+            if st["promoted"] is None:
+                control = next((h for h in old), None)
+                if control is None:
+                    return {"version": new_version, "rolled_back": False,
+                            "updated": 0, "note": "nothing to update"}
+                cid = f"canary-{new_version}"
+                canary = self._spawn_heldout(cid, new_version)
+                if not self._canary_matches(canary, control):
+                    canary.alive = False
+                    canary.close()
+                    ROLLOUT_ROLLBACKS.inc()
+                    self._ctrl._kill_token(cid)
+                    _flight.dump("canary_mismatch")
+                    self._journal.state["done"] = True
+                    self._journal.commit()
+                    return {"version": new_version, "rolled_back": True,
+                            "reason": "canary bit-mismatch", "updated": 0}
+                # promote: the canary is a certified new-version replica
+                # — it enters rotation as the first replacement capacity
+                canary.version = new_version
+                self._router.add_replica(canary)
+                st["promoted"] = cid
+                self._journal.commit()
+            # -- replica-by-replica replacement ----------------------------
+            updated = 0
+            for k, h in enumerate(sorted(old, key=lambda x: x.id)):
+                if h.id in st["replaced"]:
+                    continue
+                _faults.step_hook(step=k)         # mid-rollout kill seat
+                if h.alive:
+                    if updated + 1 < len(old):
+                        # spawn-before-drain: keep capacity flat (the
+                        # promoted canary already covers one slot, later
+                        # steps pre-spawn their replacement)
+                        target = len(self._ctrl._live()) + 1
+                        rid = self._ctrl.spawn_replica(
+                            replica_id=f"{new_version}-{k}",
+                            version=new_version)
+                        if rid is not None:
+                            self._ctrl.wait_live(target,
+                                                 timeout_s=wait_live_s)
+                    self._ctrl.retire(h.id)
+                st["replaced"].append(h.id)
+                self._journal.commit()
+                ROLLOUT_STEPS.inc()
+                updated += 1
+            st["done"] = True
+            self._journal.commit()
+            return {"version": new_version, "rolled_back": False,
+                    "updated": updated,
+                    "live": len(self._ctrl._live())}
+        finally:
+            ROLLOUT_ACTIVE.set(0)

@@ -40,7 +40,8 @@ class Replica:
     """Wrap a (started or startable) Server as one cluster replica."""
 
     def __init__(self, server, replica_id: Optional[str] = None,
-                 store=None, host: str = "127.0.0.1", port: int = 0):
+                 store=None, host: str = "127.0.0.1", port: int = 0,
+                 heldout: bool = False):
         self.server = server
         self.id = str(replica_id if replica_id is not None
                       else f"r{os.getpid()}")
@@ -48,8 +49,17 @@ class Replica:
         self.host = host
         self.port = int(port)
         self._store = store
+        # held-out (canary) mode: heartbeat so the router's liveness
+        # verdict works once the canary is PROMOTED into rotation, but
+        # never write a rendezvous record — discovery must not find it,
+        # so it takes zero traffic until RollingUpdate adds it.
+        self._heldout = bool(heldout)
         self._rpc: Optional[RpcServer] = None
         self._reporter = None
+        self._reg_idx: Optional[int] = None
+        # set when the replica should exit its serve loop (a drain-and-
+        # retire order, or stop()); replica_main blocks on it
+        self._exit = threading.Event()
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "Replica":
@@ -64,7 +74,10 @@ class Replica:
         self._rpc = RpcServer(self._handlers(), port=self.port)
         self.port = self._rpc.port
         if self._store is not None:
-            self._register()
+            if self._heldout:
+                self._start_heartbeat()
+            else:
+                self._register()
         return self
 
     def _register(self):
@@ -72,13 +85,18 @@ class Replica:
         the endpoint under it, start heartbeating.  A restarted replica
         re-registers under a fresh slot with the SAME id — the router
         treats that as a rejoin (update the endpoint), not a twin."""
-        from ...distributed.fleet.elastic import HeartbeatReporter
         entry = {"id": self.id, "host": self.host, "port": self.port,
                  "role": self.role, "pid": os.getpid(),
-                 "models": self.server.models()}
+                 "models": self.server.models(),
+                 "version": self.server.version}
         idx = self._store.add(f"{REPLICA_PREFIX}/seq", 1)
         self._store.set(f"{REPLICA_PREFIX}/{idx}",
                         json.dumps(entry).encode())
+        self._reg_idx = int(idx)
+        self._start_heartbeat()
+
+    def _start_heartbeat(self):
+        from ...distributed.fleet.elastic import HeartbeatReporter
         self._reporter = HeartbeatReporter(
             self._store, f"replica:{self.id}",
             interval=float(_flags.flag("router_heartbeat_s"))).start()
@@ -89,6 +107,20 @@ class Replica:
         if self._rpc is not None:
             self._rpc.close()
         self.server.stop(drain=drain)
+        self._exit.set()
+
+    def deregister(self):
+        """Clean retirement: stop heartbeating and write a tombstone
+        (``__serving_replica/retired/<id>`` = this registration's slot)
+        so a router discovering the rendezvous prefix later skips the
+        stale entry — a rejoin under a FRESH slot still wins, because
+        the tombstone only covers slots up to the retired one."""
+        if self._reporter is not None:
+            self._reporter.stop()
+            self._reporter = None
+        if self._store is not None and self._reg_idx is not None:
+            self._store.set(f"{REPLICA_PREFIX}/retired/{self.id}",
+                            str(self._reg_idx).encode())
 
     # -- RPC surface ---------------------------------------------------------
     def _handlers(self) -> Dict[str, Any]:
@@ -96,7 +128,8 @@ class Replica:
                 "stats": self._op_stats, "scrape": self._op_scrape,
                 "infer": self._op_infer, "decode": self._op_decode,
                 "prefill": self._op_prefill,
-                "decode_from": self._op_decode_from}
+                "decode_from": self._op_decode_from,
+                "drain": self._op_drain}
 
     def _op_ping(self, meta, parts):
         return {"id": self.id, "role": self.role}, []
@@ -108,7 +141,10 @@ class Replica:
         return {"id": self.id, "role": self.role,
                 "models": self.server.models(),
                 "queue_depth": q.depth() if q is not None else 0,
-                "steady_compiles": steady, "pid": os.getpid()}, []
+                "steady_compiles": steady, "pid": os.getpid(),
+                "version": self.server.version,
+                "draining": bool(getattr(self.server, "draining",
+                                         False))}, []
 
     def _op_stats(self, meta, parts):
         return {"stats": self.server.stats(meta.get("model"))}, []
@@ -130,7 +166,9 @@ class Replica:
         inputs = decode_arrays(meta["arrays"], parts)
         fut = self.server.submit(meta["model"], inputs,
                                  timeout=meta.get("timeout", 5.0),
-                                 trace_id=meta.get("trace_id"))
+                                 trace_id=meta.get("trace_id"),
+                                 tenant=meta.get("tenant", "default"),
+                                 priority=meta.get("priority"))
         outs = fut.result(timeout=meta.get("result_timeout", 60.0))
         ometa, oparts = encode_arrays([np.asarray(o) for o in outs])
         return {"arrays": ometa}, oparts
@@ -140,10 +178,41 @@ class Replica:
         fut = self.server.submit_decode(
             meta["model"], prompts, max_new_tokens=meta.get("max_new"),
             timeout=meta.get("timeout", 5.0),
-            trace_id=meta.get("trace_id"))
+            trace_id=meta.get("trace_id"),
+            tenant=meta.get("tenant", "default"),
+            priority=meta.get("priority"))
         outs = fut.result(timeout=meta.get("result_timeout", 60.0))
         ometa, oparts = encode_arrays([np.asarray(outs[0])])
         return {"arrays": ometa}, oparts
+
+    def _op_drain(self, meta, parts):
+        """Graceful-retirement op: flip the server to stop-accepting
+        (new submissions bounce with a retry_after hint so the router
+        redirects), finish everything admitted, and — when the order
+        says ``retire`` and the drain completed — deregister from the
+        rendezvous and schedule process exit AFTER this reply flushes.
+        A ``drain_hang`` fault clause wedges here deterministically:
+        the replica stops accepting but never reports drained, so the
+        caller's timeout/eviction escalation is what gets exercised."""
+        from ...testing import faults as _faults
+        timeout = float(meta.get("timeout",
+                                 _flags.flag("drain_timeout_s")))
+        plan = _faults.active_plan()
+        if plan is not None and plan.should_hang_drain():
+            self.server.request_drain()
+            time.sleep(timeout)
+            _flight.dump("drain_hang")
+            return {"id": self.id, "drained": False, "hang": True}, []
+        report = self.server.drain(timeout_s=timeout)
+        report["id"] = self.id
+        if report.get("drained") and meta.get("retire", True):
+            self.deregister()
+            _flight.dump("drain_retire")
+            # let the RPC reply leave the socket before the serve loop
+            # unblocks and the process exits
+            threading.Timer(0.5, self._exit.set).start()
+            report["retired"] = True
+        return report, []
 
     def _op_prefill(self, meta, parts):
         # the prefill leg of a disaggregated chain joins the router's
@@ -189,7 +258,7 @@ class Replica:
 def replica_main(server, replica_id: Optional[str] = None,
                  store_host: Optional[str] = None,
                  store_port: Optional[int] = None, port: int = 0,
-                 block: bool = True) -> Replica:
+                 block: bool = True, heldout: bool = False) -> Replica:
     """Process entry for a spawned replica (tools/serve.py --router
     children): build the store client, start the replica, and (by
     default) serve until the process is killed — the router's heartbeat
@@ -199,7 +268,9 @@ def replica_main(server, replica_id: Optional[str] = None,
         from ...distributed.fleet.base.tcp_store import TCPStore
         store = TCPStore(store_host, int(store_port), is_master=False)
     rep = Replica(server, replica_id=replica_id, store=store,
-                  port=port).start()
+                  port=port, heldout=heldout).start()
     if block:
-        threading.Event().wait()
+        # serve until killed (heartbeat eviction) OR cleanly retired by
+        # a drain order — the graceful alternative to SIGKILL
+        rep._exit.wait()
     return rep

@@ -64,6 +64,11 @@ _EVICTIONS_TOTAL = _registry().counter(
     "Replicas evicted from the dispatch rotation (stale heartbeat or "
     "explicit evict); their in-flight requests re-dispatch to "
     "survivors.")
+_DEREGISTERED_TOTAL = _registry().counter(
+    "router_deregistered_total",
+    "Replicas removed from rotation CLEANLY after a graceful drain "
+    "(the scale-down path that is not an eviction: nothing was "
+    "in-flight, nothing re-dispatches).")
 _REPLICA_QDEPTH = _registry().gauge(
     "router_replica_queue_depth",
     "Last health-reported serving-queue depth per replica — the "
@@ -90,6 +95,7 @@ class ReplicaHandle:
         self.inflight = 0
         self.queue_depth = 0
         self.dispatched = 0
+        self.version = "v0"              # artifact version (rollouts)
         self._lock = threading.Lock()
 
     def serves(self, op: str) -> bool:
@@ -119,6 +125,14 @@ class ReplicaHandle:
     def health(self) -> dict:
         raise NotImplementedError
 
+    def drain(self, timeout: Optional[float] = None,
+              retire: bool = True) -> dict:
+        """Order a graceful drain: stop accepting, finish in-flight
+        work, and (with ``retire``) deregister from the rendezvous.
+        Returns the replica's drain report; ``drained`` False is the
+        caller's cue to escalate to eviction."""
+        raise NotImplementedError
+
     def model_stats(self) -> dict:
         """Per-model serving stats of the replica (Server.stats())."""
         return {}
@@ -144,17 +158,34 @@ class LocalReplica(ReplicaHandle):
         super().__init__(replica_id,
                          role or str(_flags.flag("serving_role")).lower())
         self.server = server
+        self.version = str(getattr(server, "version", "v0"))
 
-    def submit(self, model, inputs, trace_id=None, timeout=60.0):
-        fut = self.server.submit(model, inputs, trace_id=trace_id)
+    def submit(self, model, inputs, trace_id=None, timeout=60.0,
+               tenant="default", priority=None):
+        fut = self.server.submit(model, inputs, trace_id=trace_id,
+                                 tenant=tenant, priority=priority)
         return [np.asarray(o) for o in fut.result(timeout=timeout)]
 
     def submit_decode(self, model, prompts, max_new=None, trace_id=None,
-                      timeout=60.0):
+                      timeout=60.0, tenant="default", priority=None):
         fut = self.server.submit_decode(model, prompts,
                                         max_new_tokens=max_new,
-                                        trace_id=trace_id)
+                                        trace_id=trace_id,
+                                        tenant=tenant, priority=priority)
         return np.asarray(fut.result(timeout=timeout)[0])
+
+    def drain(self, timeout: Optional[float] = None,
+              retire: bool = True) -> dict:
+        from ...testing import faults as _faults
+        plan = _faults.active_plan()
+        if plan is not None and plan.should_hang_drain():
+            # deterministic wedge: stop accepting, never report drained
+            # — what the controller's timeout escalation is drilled on
+            self.server.request_drain()
+            return {"id": self.id, "drained": False, "hang": True}
+        report = self.server.drain(timeout_s=timeout)
+        report["id"] = self.id
+        return report
 
     def prefill(self, model, prompts, max_new=None, trace_id=None,
                 timeout=60.0):
@@ -195,28 +226,44 @@ class RemoteReplica(ReplicaHandle):
     crosses as its serialized wire blob."""
 
     def __init__(self, replica_id: str, host: str, port: int,
-                 role: str = "both", timeout: float = 60.0):
+                 role: str = "both", timeout: float = 60.0,
+                 version: str = "v0"):
         super().__init__(replica_id, role)
         self.host, self.port = host, int(port)
+        self.version = str(version)
         self._client = RpcClient(host, port, timeout=timeout)
 
-    def submit(self, model, inputs, trace_id=None, timeout=60.0):
+    def submit(self, model, inputs, trace_id=None, timeout=60.0,
+               tenant="default", priority=None):
         ameta, parts = encode_arrays([np.asarray(a) for a in inputs])
         meta, rparts = self._client.request(
             "infer", {"model": model, "arrays": ameta,
-                      "trace_id": trace_id, "result_timeout": timeout},
+                      "trace_id": trace_id, "result_timeout": timeout,
+                      "tenant": tenant, "priority": priority},
             parts, timeout=timeout)
         return decode_arrays(meta["arrays"], rparts)
 
     def submit_decode(self, model, prompts, max_new=None, trace_id=None,
-                      timeout=60.0):
+                      timeout=60.0, tenant="default", priority=None):
         pmeta, parts = encode_arrays([np.asarray(p) for p in prompts])
         meta, rparts = self._client.request(
             "decode", {"model": model, "prompts": pmeta,
                        "max_new": max_new, "trace_id": trace_id,
-                       "result_timeout": timeout},
+                       "result_timeout": timeout,
+                       "tenant": tenant, "priority": priority},
             parts, timeout=timeout)
         return decode_arrays(meta["arrays"], rparts)[0]
+
+    def drain(self, timeout: Optional[float] = None,
+              retire: bool = True) -> dict:
+        if timeout is None:
+            timeout = float(_flags.flag("drain_timeout_s"))
+        # the op itself may lawfully take the whole drain budget (and a
+        # drain-hang drill sleeps it out) — pad the transport deadline
+        meta, _ = self._client.request(
+            "drain", {"timeout": float(timeout), "retire": bool(retire)},
+            timeout=float(timeout) + 15.0)
+        return meta
 
     def prefill(self, model, prompts, max_new=None, trace_id=None,
                 timeout=60.0):
@@ -319,6 +366,23 @@ class Router:
         _flight.dump("watchdog_evict")
         return True
 
+    def deregister(self, replica_id: str, reason: str = "drained") -> bool:
+        """Remove a replica from rotation CLEANLY (graceful-drain
+        retirement): it already reported drained, so nothing is
+        in-flight, nothing re-dispatches, and this is not an eviction —
+        no eviction counter, no postmortem."""
+        with self._lock:
+            h = self._handles.pop(str(replica_id), None)
+        if h is None:
+            return False
+        h.alive = False
+        h.close()
+        _DEREGISTERED_TOTAL.inc()
+        _REPLICAS_LIVE.set(self.replicas_live())
+        _tracing.event("router_deregister", replica=str(replica_id),
+                       reason=reason)
+        return True
+
     def handles(self) -> List[ReplicaHandle]:
         with self._lock:
             return list(self._handles.values())
@@ -354,9 +418,17 @@ class Router:
                 n = i - 1
                 break
             info = json.loads(raw.decode())
+            tomb = self._store.get(
+                f"{REPLICA_PREFIX}/retired/{info['id']}", wait=False)
+            if tomb is not None and int(tomb) >= i:
+                # retired at (or after) this registration: skip the
+                # stale entry — a rejoin claims a fresh slot past the
+                # tombstone and still wins
+                continue
             self.add_replica(RemoteReplica(
                 info["id"], info["host"], info["port"],
-                role=info.get("role", "both")))
+                role=info.get("role", "both"),
+                version=info.get("version", "v0")))
         self._seen_seq = max(self._seen_seq, n)
 
     def _evict_stale(self):
@@ -460,25 +532,32 @@ class Router:
                     h.inflight -= 1
 
     # -- traffic -------------------------------------------------------------
-    def submit(self, model: str, inputs,
-               timeout: float = 60.0) -> Future:
+    def submit(self, model: str, inputs, timeout: float = 60.0,
+               tenant: str = "default",
+               priority: Optional[int] = None) -> Future:
         """Dense inference through the cluster: returns a Future of the
-        per-output numpy arrays, exactly Server.submit's contract."""
+        per-output numpy arrays, exactly Server.submit's contract.
+        ``tenant``/``priority`` ride the RPC meta into the replica's
+        per-tenant admission."""
         return self._pool.submit(self._run_dense, model,
-                                 [np.asarray(a) for a in inputs], timeout)
+                                 [np.asarray(a) for a in inputs], timeout,
+                                 tenant, priority)
 
-    def run(self, model: str, inputs, timeout: float = 60.0):
+    def run(self, model: str, inputs, timeout: float = 60.0,
+            tenant: str = "default", priority: Optional[int] = None):
         return self._run_dense(model, [np.asarray(a) for a in inputs],
-                               timeout)
+                               timeout, tenant, priority)
 
-    def _run_dense(self, model, inputs, timeout):
+    def _run_dense(self, model, inputs, timeout, tenant="default",
+                   priority=None):
         tr = _tracing.start_span("route", model=model, kind="dense")
         try:
             out = self._dispatch(
                 "infer",
                 lambda h: h.submit(model, inputs,
                                    trace_id=getattr(tr, "trace_id", None),
-                                   timeout=timeout),
+                                   timeout=timeout, tenant=tenant,
+                                   priority=priority),
                 timeout, span=tr)
             _tracing.finish(tr)
             return out
@@ -490,23 +569,27 @@ class Router:
 
     def submit_decode(self, model: str, prompts,
                       max_new_tokens: Optional[int] = None,
-                      timeout: float = 60.0) -> Future:
+                      timeout: float = 60.0, tenant: str = "default",
+                      priority: Optional[int] = None) -> Future:
         """Decode through the cluster: full-decode replicas when the
         pools are unified; prefill-pool → KV handoff → decode-pool when
         disaggregated (mixed clusters prefer the disaggregated path
         only when no 'both' replica is live)."""
         return self._pool.submit(
             self._run_decode, model,
-            [np.asarray(p) for p in prompts], max_new_tokens, timeout)
+            [np.asarray(p) for p in prompts], max_new_tokens, timeout,
+            tenant, priority)
 
     def run_decode(self, model: str, prompts,
                    max_new_tokens: Optional[int] = None,
-                   timeout: float = 60.0):
+                   timeout: float = 60.0, tenant: str = "default",
+                   priority: Optional[int] = None):
         return self._run_decode(model,
                                 [np.asarray(p) for p in prompts],
-                                max_new_tokens, timeout)
+                                max_new_tokens, timeout, tenant, priority)
 
-    def _run_decode(self, model, prompts, max_new, timeout):
+    def _run_decode(self, model, prompts, max_new, timeout,
+                    tenant="default", priority=None):
         tr = _tracing.start_span("route", model=model, kind="decode")
         tid = getattr(tr, "trace_id", None)
         try:
@@ -516,7 +599,9 @@ class Router:
                     lambda h: h.submit_decode(model, prompts,
                                               max_new=max_new,
                                               trace_id=tid,
-                                              timeout=timeout),
+                                              timeout=timeout,
+                                              tenant=tenant,
+                                              priority=priority),
                     timeout, span=tr)
             else:
                 handoff = self._dispatch(

@@ -103,6 +103,9 @@ class DecodeRequest:
     # same tracing contract as the dense Request
     trace: Optional[object] = None
     t_enqueue_mono: float = field(default_factory=time.monotonic)
+    # admission class (scheduler.RequestQueue): same contract as Request
+    tenant: str = "default"
+    priority: Optional[int] = None
 
 
 class _DecodeRuntime:

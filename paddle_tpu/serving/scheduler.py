@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..framework import flags as _flags
 from ..framework.enforce import UnavailableError
 from ..profiler import tracing as _tracing
 from ..profiler.metrics import default_registry as _registry
@@ -65,6 +66,21 @@ SLOTS_RETIRED = _registry().counter(
     "Rows retired from the slot loop (eos or per-request token budget) "
     "— retirement frees the slot the same step.",
     labels=("model",))
+# per-tenant admission (cluster lifecycle PR): quotas bound how much of
+# the shared queue one tenant can hold, so a burst from tenant A fills
+# A's allowance and then bounces with a retry_after hint instead of
+# growing everyone's p99
+TENANT_REJECTS = _registry().counter(
+    "serving_tenant_rejections_total",
+    "Requests rejected because the tenant was at its pending-quota "
+    "(UnavailableError with a retry_after hint; the global queue still "
+    "had room for other tenants).",
+    labels=("tenant",))
+TENANT_PENDING = _registry().gauge(
+    "serving_tenant_pending",
+    "Requests currently queued per tenant — the quantity the per-tenant "
+    "quota caps.",
+    labels=("tenant",))
 SLOT_TTFT = _registry().histogram(
     "decode_slot_ttft_seconds",
     "Time from slot-loop submit to the request's first emitted token — "
@@ -89,6 +105,11 @@ class Request:
     # monotonic enqueue stamp the queue-wait span/histogram is cut from
     trace: Optional[object] = None
     t_enqueue_mono: float = field(default_factory=time.monotonic)
+    # admission class: which tenant's quota this request consumes, and
+    # its priority (higher packs first; None = the tenant policy's
+    # priority, default 1).  Resolved at put() time.
+    tenant: str = "default"
+    priority: Optional[int] = None
 
 
 @dataclass
@@ -123,6 +144,14 @@ class RequestQueue:
     UnavailableError); ``next_batch`` blocks until work exists, holds the
     batch open up to ``batch_timeout_s`` for more arrivals, then packs
     FIFO up to the model's bucket ceiling.
+
+    Admission is per-tenant aware: ``set_tenant_policy`` caps how many
+    pending requests one tenant may hold (default from
+    ``FLAGS_serving_tenant_quota``; 0 = unlimited) and assigns a
+    priority class — higher priority inserts ahead of lower within a
+    model's queue (FIFO within a class), so a quota'd burst from one
+    tenant bounces with a retry_after hint while everyone else's wait
+    stays flat.
     """
 
     def __init__(self, capacity: int):
@@ -136,41 +165,124 @@ class RequestQueue:
         # rejection carries — "one slot frees in about 1/rate seconds"
         self._drain_ewma = 0.0
         self._last_pop_mono: Optional[float] = None
+        # staleness epoch for the hint decay: the last instant the queue
+        # made progress while work was pending (a pop, or the put that
+        # took it from empty).  None until work first arrives.
+        self._last_progress_mono: Optional[float] = None
+        # per-tenant admission state
+        self._tenant_pending: Dict[str, int] = {}
+        self._tenant_policy: Dict[str, dict] = {}
+
+    def set_tenant_policy(self, tenant: str,
+                          max_pending: Optional[int] = None,
+                          priority: Optional[int] = None) -> None:
+        """Set a tenant's admission class: ``max_pending`` caps its queued
+        requests (None = fall back to ``FLAGS_serving_tenant_quota``),
+        ``priority`` orders its requests against other classes (higher
+        packs first; default 1)."""
+        with self._cond:
+            pol = self._tenant_policy.setdefault(tenant, {})
+            if max_pending is not None:
+                pol["max_pending"] = int(max_pending)
+            if priority is not None:
+                pol["priority"] = int(priority)
+            self._cond.notify_all()
+
+    def _quota_of(self, tenant: str) -> Optional[int]:
+        pol = self._tenant_policy.get(tenant)
+        if pol and pol.get("max_pending") is not None:
+            return pol["max_pending"]
+        q = int(_flags.flag("serving_tenant_quota"))
+        return q if q > 0 else None
+
+    def _hint_locked(self) -> float:
+        """The retry-after estimate (lock held).  Base: 1/drain-rate
+        clamped to [10 ms, 5 s], 100 ms before any batch has drained.
+        Decay: when work is pending but nothing has drained within
+        ``FLAGS_router_stale_after_s``, the hint ramps linearly toward
+        the 5 s clamp ceiling over one further stale window — a
+        drain-hung replica stops advertising the optimistic cold-start
+        default and the router backs off hard instead of hammering it."""
+        rate = self._drain_ewma
+        hint = 0.1 if rate <= 0 else min(5.0, max(0.01, 1.0 / rate))
+        if self._depth > 0 and self._last_progress_mono is not None:
+            stale = float(_flags.flag("router_stale_after_s"))
+            elapsed = time.monotonic() - self._last_progress_mono
+            if stale > 0 and elapsed > stale:
+                frac = min(1.0, (elapsed - stale) / stale)
+                hint = hint + frac * (5.0 - hint)
+        return hint
 
     def suggest_retry_after(self) -> float:
         """Estimated seconds until a queue slot frees, from the observed
         drain rate (clamped to [10 ms, 5 s]; 100 ms before any batch has
-        drained).  Callers attach this to UnavailableError rejections so
-        a router backs off THIS replica instead of evicting it."""
+        drained, decaying toward the ceiling once the queue is stuck —
+        see ``_hint_locked``).  Callers attach this to UnavailableError
+        rejections so a router backs off THIS replica instead of
+        evicting it."""
         with self._cond:
-            rate = self._drain_ewma
-        if rate <= 0:
-            return 0.1
-        return min(5.0, max(0.01, 1.0 / rate))
+            return self._hint_locked()
 
     # -- producer ------------------------------------------------------------
     def put(self, req: Request, timeout: Optional[float] = None) -> None:
         deadline = None if timeout is None else time.perf_counter() + timeout
+        tenant = req.tenant or "default"
         with self._cond:
-            while self._depth >= self._capacity and not self._closed:
+            quota = self._quota_of(tenant)
+            while not self._closed and (
+                    self._depth >= self._capacity
+                    or (quota is not None
+                        and self._tenant_pending.get(tenant, 0) >= quota)):
                 remaining = None if deadline is None \
                     else deadline - time.perf_counter()
                 if remaining is not None and remaining <= 0:
-                    rate = self._drain_ewma
-                    hint = 0.1 if rate <= 0 \
-                        else min(5.0, max(0.01, 1.0 / rate))
+                    hint = self._hint_locked()
+                    over_quota = quota is not None \
+                        and self._tenant_pending.get(tenant, 0) >= quota \
+                        and self._depth < self._capacity
+                    if over_quota:
+                        TENANT_REJECTS.labels(tenant).inc()
+                        raise UnavailableError(
+                            f"tenant {tenant!r} at pending-quota "
+                            f"({quota}); backpressure timeout expired "
+                            f"(retry after ~{hint:.3f}s)",
+                            retry_after_s=hint)
                     raise UnavailableError(
                         f"serving queue full ({self._capacity} pending); "
                         "backpressure timeout expired "
                         f"(retry after ~{hint:.3f}s)",
                         retry_after_s=hint)
                 self._cond.wait(remaining)
+                quota = self._quota_of(tenant)
             if self._closed:
                 # no hint: a closed queue is not coming back — callers
                 # should fail over, not retry here
                 raise UnavailableError("serving queue is closed")
-            self._pending.setdefault(req.model, deque()).append(req)
+            if req.priority is None:
+                pol = self._tenant_policy.get(tenant)
+                req.priority = int(pol.get("priority", 1)) if pol else 1
+            if self._depth == 0:
+                # fresh epoch: idle time before this arrival is not
+                # drain staleness
+                self._last_progress_mono = time.monotonic()
+            dq = self._pending.setdefault(req.model, deque())
+            if dq and req.priority > (dq[-1].priority or 1):
+                # priority insert: ahead of the first strictly-lower
+                # class, FIFO within its own (deques stay sorted by
+                # priority descending, so one scan suffices)
+                idx = len(dq)
+                for i, r in enumerate(dq):
+                    if (r.priority or 1) < req.priority:
+                        idx = i
+                        break
+                dq.insert(idx, req)
+            else:
+                dq.append(req)
             self._depth += 1
+            self._tenant_pending[tenant] = \
+                self._tenant_pending.get(tenant, 0) + 1
+            TENANT_PENDING.labels(tenant).set(
+                self._tenant_pending[tenant])
             stat_set("serving_queue_depth", self._depth)
             self._cond.notify_all()
 
@@ -220,6 +332,15 @@ class RequestQueue:
                     else 0.8 * self._drain_ewma + 0.2 * inst
             if taken:
                 self._last_pop_mono = t_pack0
+                self._last_progress_mono = t_pack0
+            for r in taken:
+                t = r.tenant or "default"
+                left = self._tenant_pending.get(t, 0) - 1
+                if left > 0:
+                    self._tenant_pending[t] = left
+                else:
+                    self._tenant_pending.pop(t, None)
+                TENANT_PENDING.labels(t).set(max(0, left))
             stat_set("serving_queue_depth", self._depth)
             self._cond.notify_all()
         bucket = bucket_of(model, rows)
@@ -254,10 +375,12 @@ class RequestQueue:
         per replica."""
         with self._cond:
             depth, rate = self._depth, self._drain_ewma
-        retry = 0.1 if rate <= 0 else min(5.0, max(0.01, 1.0 / rate))
+            retry = self._hint_locked()
+            tenants = {t: n for t, n in self._tenant_pending.items() if n}
         return {"queue_depth": depth,
                 "drain_rate_rps": round(rate, 3),
-                "retry_after_s": round(retry, 4)}
+                "retry_after_s": round(retry, 4),
+                "tenant_pending": tenants}
 
     def drain(self) -> List[Request]:
         """Pop everything still pending (stop without serving them)."""
@@ -267,6 +390,9 @@ class RequestQueue:
                 out.extend(dq)
                 dq.clear()
             self._depth = 0
+            for t in list(self._tenant_pending):
+                TENANT_PENDING.labels(t).set(0)
+            self._tenant_pending.clear()
             stat_set("serving_queue_depth", 0)
             self._cond.notify_all()
             return out

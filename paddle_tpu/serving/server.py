@@ -638,6 +638,10 @@ class ServingConfig:
     pipeline_depth: Optional[int] = None
     buckets: Optional[Sequence[int]] = None
     optim_cache_dir: Optional[str] = None
+    # model-artifact version stamp (rolling updates): published in the
+    # replica's rendezvous entry and health report so the rollout
+    # controller can tell old from new; None reads as "v0"
+    version: Optional[str] = None
 
 
 class Server:
@@ -668,7 +672,30 @@ class Server:
         self._workers: List[_Worker] = []
         self._started = False
         self._stopped = False
+        self._draining = False
         self._warmup_marks: Dict[str, int] = {}
+        self._tenant_policies: Dict[str, dict] = {}
+
+    def set_tenant_policy(self, tenant: str, max_pending: Optional[int]
+                          = None, priority: Optional[int] = None) -> None:
+        """Per-tenant admission knobs (quota + priority class); callable
+        before start() — the policy is applied when the queue exists."""
+        pol = self._tenant_policies.setdefault(str(tenant), {})
+        if max_pending is not None:
+            pol["max_pending"] = int(max_pending)
+        if priority is not None:
+            pol["priority"] = int(priority)
+        if self._queue is not None:
+            self._queue.set_tenant_policy(tenant, **pol)
+
+    @property
+    def version(self) -> str:
+        """The served artifact version ("v0" unless configured)."""
+        return str(self._config.version or "v0")
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     # -- registry ------------------------------------------------------------
     def register(self, spec_or_name, path: Optional[str] = None,
@@ -755,6 +782,8 @@ class Server:
         depth = self._config.pipeline_depth \
             or int(_flags.flag("serving_pipeline_depth"))
         self._queue = RequestQueue(cap)
+        for tenant, pol in self._tenant_policies.items():
+            self._queue.set_tenant_policy(tenant, **pol)
         self._dispatch_q = queue.Queue(maxsize=max(1, n_workers * depth))
         self._workers = [_Worker(self, i) for i in range(n_workers)]
         for w in self._workers:
@@ -809,6 +838,60 @@ class Server:
                 close()
         self._stopped = True
 
+    # -- graceful drain (cluster lifecycle) ----------------------------------
+    def request_drain(self) -> None:
+        """Flip to drain mode: new submissions bounce with
+        UnavailableError (retry_after = the staleness window, so a
+        router redirects and backs this replica off) while everything
+        already admitted — queued batches and slot-loop rows — runs to
+        completion.  Idempotent; the server keeps serving in-flight
+        work until :meth:`drain` reports it empty."""
+        self._draining = True
+
+    def _reject_if_draining(self) -> None:
+        if self._draining:
+            raise UnavailableError(
+                "replica is draining (graceful retirement in progress)",
+                retry_after_s=float(_flags.flag("router_stale_after_s")))
+
+    def pending_requests(self) -> int:
+        """Requests admitted but not yet completed or failed, summed
+        over models — slot-loop rows count until their batch future
+        resolves, so 0 means every admitted token was served."""
+        n = 0
+        for rt in self._models.values():
+            with rt._mlock:
+                c = rt.counters
+                n += c["requests"] - c["completed"] - c["errors"]
+        return n
+
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Graceful drain: stop admitting (see :meth:`request_drain`),
+        then wait until the queue is empty and every admitted request
+        has resolved — in-flight batches finish, slot-loop rows retire
+        at token boundaries.  Returns a report dict; ``drained`` False
+        means the timeout expired with work still pending (the caller's
+        escalation path — evict — takes over)."""
+        if timeout_s is None:
+            timeout_s = float(_flags.flag("drain_timeout_s"))
+        t0 = time.monotonic()
+        self.request_drain()
+        if not self._started or self._stopped:
+            return {"drained": True, "pending": 0, "queue_depth": 0,
+                    "waited_s": 0.0}
+        deadline = t0 + max(0.0, float(timeout_s))
+        while True:
+            pending = self.pending_requests()
+            qdepth = self._queue.depth() if self._queue else 0
+            if pending <= 0 and qdepth == 0:
+                return {"drained": True, "pending": 0, "queue_depth": 0,
+                        "waited_s": round(time.monotonic() - t0, 3)}
+            if time.monotonic() >= deadline:
+                return {"drained": False, "pending": int(pending),
+                        "queue_depth": int(qdepth),
+                        "waited_s": round(time.monotonic() - t0, 3)}
+            time.sleep(min(0.02, max(0.001, timeout_s / 50.0)))
+
     def __enter__(self):
         if not self._started:
             self.start()
@@ -845,7 +928,8 @@ class Server:
             raise
 
     def submit(self, model: str, inputs, timeout: Optional[float] = 5.0,
-               trace_id: Optional[str] = None) -> Future:
+               trace_id: Optional[str] = None, tenant: str = "default",
+               priority: Optional[int] = None) -> Future:
         """Enqueue one request of ``rows`` examples (rows = leading dim);
         returns a Future resolving to per-output numpy arrays with
         exactly ``rows`` rows (padding never leaks).  Blocks up to
@@ -856,6 +940,7 @@ class Server:
         if not self._started or self._stopped:
             raise PreconditionNotMetError(
                 "Server is not serving (start() it / already stopped)")
+        self._reject_if_draining()
         rt = self._runtime(model)
         if getattr(rt, "kind", None) == "decode":
             raise InvalidArgumentError(
@@ -884,6 +969,7 @@ class Server:
             raise InvalidArgumentError("empty request (0 rows)")
         rt.ladder.bucket_for(rows)           # raises OutOfRange early
         req = Request(model=model, inputs=tuple(arrs), rows=rows,
+                      tenant=tenant, priority=priority,
                       trace=_tracing.start_span(
                           "request", trace_id=trace_id, model=model,
                           rows=rows, kind="dense"))
@@ -900,7 +986,9 @@ class Server:
     def submit_decode(self, model: str, prompts,
                       max_new_tokens: Optional[int] = None,
                       timeout: Optional[float] = 5.0,
-                      trace_id: Optional[str] = None) -> Future:
+                      trace_id: Optional[str] = None,
+                      tenant: str = "default",
+                      priority: Optional[int] = None) -> Future:
         """Enqueue one decode request: ``prompts`` is a list of 1-D int
         token arrays (variable lengths — they left-pad to the prompt
         bucket at execution).  Resolves to ``[ids]`` where ids is an
@@ -910,6 +998,7 @@ class Server:
         if not self._started or self._stopped:
             raise PreconditionNotMetError(
                 "Server is not serving (start() it / already stopped)")
+        self._reject_if_draining()
         rt = self._runtime(model)
         if getattr(rt, "kind", None) != "decode":
             raise InvalidArgumentError(
@@ -924,6 +1013,7 @@ class Server:
         rt.ladder.bucket_for(len(arrs))      # raises OutOfRange early
         req = DecodeRequest(model=model, prompts=arrs, rows=len(arrs),
                             max_new=max_new,
+                            tenant=tenant, priority=priority,
                             trace=_tracing.start_span(
                                 "request", trace_id=trace_id, model=model,
                                 rows=len(arrs), kind="decode",
@@ -959,6 +1049,7 @@ class Server:
         if not self._started or self._stopped:
             raise PreconditionNotMetError(
                 "Server is not serving (start() it / already stopped)")
+        self._reject_if_draining()
         return self._decode_runtime(model).prefill_handoff(
             prompts, max_new_tokens)
 
@@ -969,6 +1060,7 @@ class Server:
         if not self._started or self._stopped:
             raise PreconditionNotMetError(
                 "Server is not serving (start() it / already stopped)")
+        self._reject_if_draining()
         if isinstance(handoff, (bytes, bytearray, memoryview)):
             from .cluster.handoff import deserialize_kv
             handoff = deserialize_kv(bytes(handoff))
@@ -1050,6 +1142,8 @@ class Server:
             out["slots_retired_total"] = sum(
                 s["slots_retired_total"] for s in slot)
         out["models"] = self.models()
+        out["version"] = self.version
+        out["draining"] = self._draining
         return out
 
 

@@ -18,6 +18,17 @@ Clause kinds and their knobs:
                 in a row — exercising the retry/reconnect path.
 ``slow``        the step hook sleeps ``seconds`` at ``step`` on
                 ``rank`` — a straggler for the heartbeat watchdog.
+``spawn_fail``  the autoscaling controller's next replica spawn raises
+                instead of launching — the ``at``-th query (1-based)
+                matches, ``count`` times in a row — exercising the
+                retry-next-poll path and the spawn-failure postmortem.
+``drain_hang``  a drain order wedges: the replica stops accepting but
+                never reports drained, forcing the controller's
+                drain-timeout escalation (evict + postmortem).
+``canary_mismatch``  the rolling-update canary comparison reports a
+                bit-mismatch regardless of the real outputs, forcing
+                the instant-rollback path.  Same ``at``/``count``
+                occurrence knobs as ``spawn_fail``.
 ``seed=N``      scopes probabilistic triggers: a clause with ``p=0.3``
                 fires iff a hash of (seed, kind, occurrence-counter)
                 lands under p — deterministic across reruns and ranks,
@@ -100,7 +111,8 @@ class FaultPlan:
                 continue
             kind, _, rest = clause.partition(":")
             kind = kind.strip()
-            if kind not in ("kill", "nan_grad", "store_drop", "slow"):
+            if kind not in ("kill", "nan_grad", "store_drop", "slow",
+                            "spawn_fail", "drain_hang", "canary_mismatch"):
                 raise ValueError(f"unknown fault kind {kind!r} in plan "
                                  f"{spec!r}")
             fields = {}
@@ -162,6 +174,35 @@ class FaultPlan:
                 f.fired += 1
                 hit = True
         return hit
+
+    def _counted(self, kind: str) -> bool:
+        """Occurrence-counted trigger shared by the lifecycle drills:
+        the ``at``-th query (1-based) of this kind matches, ``count``
+        consecutive times (default 1), subject to the ``p=`` gate."""
+        hit = False
+        for f in self.of_kind(kind):
+            key = f"{kind}/{f.index}"
+            with self._lock:
+                n = self._counters[key] = self._counters.get(key, 0) + 1
+            at = f.get_int("at", 1)
+            if at <= n < at + f.get_int("count", 1) and \
+                    self._sampled(f, key + "/p"):
+                f.fired += 1
+                hit = True
+        return hit
+
+    def should_fail_spawn(self) -> bool:
+        """True when the controller's next replica spawn must fail."""
+        return self._counted("spawn_fail")
+
+    def should_hang_drain(self) -> bool:
+        """True when this drain order must wedge (stop accepting but
+        never report drained), forcing the caller's timeout path."""
+        return self._counted("drain_hang")
+
+    def should_mismatch_canary(self) -> bool:
+        """True when the canary bit-compare must report a mismatch."""
+        return self._counted("canary_mismatch")
 
     def __repr__(self):
         return f"FaultPlan(seed={self.seed}, {self.faults})"

@@ -170,7 +170,7 @@ class _FakeReplica(ReplicaHandle):
         self._fail = list(fail)
 
     def submit_decode(self, model, prompts, max_new=None, trace_id=None,
-                      timeout=60.0):
+                      timeout=60.0, tenant="default", priority=None):
         self.calls += 1
         if self._fail:
             raise self._fail.pop(0)

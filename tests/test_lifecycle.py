@@ -1,0 +1,541 @@
+"""Elastic cluster lifecycle (serving/cluster/lifecycle.py): the
+autoscaling controller's scale/drain/escalate decisions, rolling
+updates behind the canary bit-match gate (rollback + journal resume),
+per-tenant admission in the RequestQueue, the retry-after staleness
+decay, the new chaos-drill fault kinds, and the concurrency contracts
+they lean on (HeartbeatMonitor.set_ranks, Router re-dispatch around
+evict)."""
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from paddle_tpu import serving
+from paddle_tpu.distributed.fleet.elastic import HeartbeatMonitor
+from paddle_tpu.framework.enforce import UnavailableError
+from paddle_tpu.framework.flags import flags_restore, flags_snapshot, \
+    set_flags
+from paddle_tpu.profiler import flight as _flight
+from paddle_tpu.profiler.metrics import default_registry
+from paddle_tpu.serving.cluster import (AutoscaleController, ReplicaHandle,
+                                        RollingUpdate, RolloutJournal,
+                                        Router)
+from paddle_tpu.serving.scheduler import Request, RequestQueue
+from paddle_tpu.testing import faults as _faults
+
+
+def _counter(name, *labels):
+    m = default_registry().get(name)
+    if m is None:
+        return 0.0
+    return float(m.labels(*labels).value if labels else m.value)
+
+
+def _sig(qdepth=0.0, retry=0.0, slots=0.0):
+    return types.SimpleNamespace(total_queue_depth=qdepth,
+                                 max_retry_after_s=retry,
+                                 max_decode_slot_occupancy=slots)
+
+
+HOT = _sig(qdepth=100.0)
+COLD = _sig()
+
+
+class _Fake(ReplicaHandle):
+    """In-process replica stub: deterministic outputs keyed on the id's
+    first byte, togglable drain verdict, call/drain counters."""
+
+    def __init__(self, rid, version="v1", drain_ok=True, role="both"):
+        super().__init__(rid, role)
+        self.version = version
+        self.drain_ok = drain_ok
+        self.calls = 0
+        self.drains = 0
+
+    def submit(self, model, inputs, trace_id=None, timeout=60.0,
+               tenant="default", priority=None):
+        self.calls += 1
+        return [np.full((1, 2), 7, np.int32)]
+
+    def submit_decode(self, model, prompts, max_new=None, trace_id=None,
+                      timeout=60.0, tenant="default", priority=None):
+        self.calls += 1
+        return np.full((len(prompts), 2), ord(self.id[0]), np.int32)
+
+    def drain(self, timeout=None, retire=True):
+        self.drains += 1
+        return {"id": self.id, "drained": self.drain_ok}
+
+    def health(self):
+        return {"id": self.id, "queue_depth": self.queue_depth}
+
+
+def _ctrl(router, spawn=None, **kw):
+    if spawn is None:
+        spawn = lambda rid, ver: _Fake(rid, version=ver)  # noqa: E731
+    kw.setdefault("idle_polls", 1)
+    kw.setdefault("cooldown_polls", 0)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("version", "v1")
+    return AutoscaleController(router, spawn, **kw)
+
+
+# ---------------------------------------------------------------------------
+# chaos-drill fault kinds
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_lifecycle_kinds_parse_and_count():
+    p = _faults.FaultPlan.parse(
+        "spawn_fail:at=2;drain_hang:;canary_mismatch:at=1,count=2")
+    assert [p.should_fail_spawn() for _ in range(3)] == \
+        [False, True, False]
+    assert [p.should_hang_drain() for _ in range(2)] == [True, False]
+    assert [p.should_mismatch_canary() for _ in range(3)] == \
+        [True, True, False]
+
+
+def test_fault_plan_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        _faults.FaultPlan.parse("melt_down:")
+
+
+# ---------------------------------------------------------------------------
+# retry-after staleness decay (RequestQueue hint)
+# ---------------------------------------------------------------------------
+
+def test_retry_after_decays_toward_ceiling_when_queue_is_stuck():
+    snap = flags_snapshot()
+    set_flags({"FLAGS_router_stale_after_s": 0.05})
+    try:
+        q = RequestQueue(4)
+        # empty queue: no pending work, no decay no matter how long
+        time.sleep(0.12)
+        assert q.suggest_retry_after() == pytest.approx(0.1)
+        q.put(Request(model="m", inputs=(), rows=1), timeout=1.0)
+        assert q.suggest_retry_after() < 1.0     # fresh epoch, no decay
+        time.sleep(0.12)                         # > 2x stale window
+        assert q.suggest_retry_after() == pytest.approx(5.0, abs=0.05)
+        # progress (a pop) resets the epoch: hint returns to the base
+        b = q.next_batch(lambda m: 4, lambda m, r: r, 0.0)
+        assert b is not None and b.rows == 1
+        assert q.suggest_retry_after() < 1.0
+    finally:
+        flags_restore(snap)
+
+
+def test_retry_after_decay_is_partial_mid_window():
+    snap = flags_snapshot()
+    set_flags({"FLAGS_router_stale_after_s": 0.2})
+    try:
+        q = RequestQueue(4)
+        q.put(Request(model="m", inputs=(), rows=1), timeout=1.0)
+        time.sleep(0.26)                 # ~30% into the decay ramp
+        hint = q.suggest_retry_after()
+        assert 0.1 < hint < 5.0
+    finally:
+        flags_restore(snap)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant admission: quotas + priority classes
+# ---------------------------------------------------------------------------
+
+def test_tenant_quota_rejects_with_hint_and_spares_others():
+    q = RequestQueue(8)
+    q.set_tenant_policy("a", max_pending=1)
+    rejects0 = _counter("serving_tenant_rejections_total", "a")
+    q.put(Request(model="m", inputs=(), rows=1, tenant="a"), timeout=0.2)
+    with pytest.raises(UnavailableError) as ei:
+        q.put(Request(model="m", inputs=(), rows=1, tenant="a"),
+              timeout=0.02)
+    assert ei.value.retry_after_s is not None
+    assert "tenant 'a'" in str(ei.value)
+    assert _counter("serving_tenant_rejections_total", "a") == rejects0 + 1
+    # tenant b admits instantly — a's quota holds no slot hostage
+    q.put(Request(model="m", inputs=(), rows=1, tenant="b"), timeout=0.02)
+    assert q.depth() == 2
+    assert q.signals()["tenant_pending"] == {"a": 1, "b": 1}
+
+
+def test_tenant_quota_burst_is_bounded_deterministically():
+    q = RequestQueue(16)
+    q.set_tenant_policy("burst", max_pending=2)
+    admitted = rejected = 0
+    for _ in range(10):
+        try:
+            q.put(Request(model="m", inputs=(), rows=1, tenant="burst"),
+                  timeout=0.001)
+            admitted += 1
+        except UnavailableError:
+            rejected += 1
+    assert (admitted, rejected) == (2, 8)
+    # the steady tenant's admission is untouched by the burst
+    q.put(Request(model="m", inputs=(), rows=1, tenant="steady"),
+          timeout=0.001)
+    assert q.depth() == 3
+
+
+def test_tenant_priority_class_packs_first_fifo_within_class():
+    q = RequestQueue(8)
+    q.set_tenant_policy("vip", priority=5)
+    low = Request(model="m", inputs=(), rows=1, tenant="low")
+    vip1 = Request(model="m", inputs=(), rows=1, tenant="vip")
+    vip2 = Request(model="m", inputs=(), rows=1, tenant="vip")
+    for r in (low, vip1, vip2):
+        q.put(r, timeout=0.2)
+    order = []
+    for _ in range(3):
+        b = q.next_batch(lambda m: 1, lambda m, r: r, 0.0)
+        order.append(b.requests[0])
+    assert order == [vip1, vip2, low]
+
+
+def test_server_tenant_policy_applies_before_start():
+    srv = serving.Server(serving.ServingConfig(version="v7"))
+    srv.set_tenant_policy("a", max_pending=3, priority=2)
+    assert srv.version == "v7"
+    assert srv._tenant_policies == {"a": {"max_pending": 3,
+                                          "priority": 2}}
+    # drain on a never-started server is trivially complete
+    srv.request_drain()
+    assert srv.draining
+    assert srv.drain()["drained"] is True
+
+
+# ---------------------------------------------------------------------------
+# HeartbeatMonitor.set_ranks under concurrent mutation (the controller
+# resizes the watched set while the router's watchdog scans it)
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_set_ranks_concurrent_with_stale_scan():
+    class _DictStore:
+        def __init__(self):
+            self.d = {}
+
+        def get(self, k, wait=True):
+            return self.d.get(k)
+
+    store = _DictStore()
+    fresh = str(time.time() + 1e6)       # heartbeats fresh forever
+    for i in range(64):
+        store.d[f"__hb/replica:{i}"] = fresh
+    mon = HeartbeatMonitor(store, stale_after=5.0,
+                           ranks=[f"replica:{i}" for i in range(4)])
+    stop = threading.Event()
+    errs = []
+
+    def mutate(seed):
+        rng = np.random.RandomState(seed)
+        while not stop.is_set():
+            ids = [f"replica:{i}"
+                   for i in rng.choice(64, size=int(rng.randint(1, 9)),
+                                       replace=False)]
+            mon.set_ranks(ids)
+
+    def scan():
+        while not stop.is_set():
+            try:
+                assert mon.stale_ranks() == []
+                w = mon.watched()
+                assert all(r.startswith("replica:") for r in w)
+            except Exception as e:   # noqa: BLE001 — the test's verdict
+                errs.append(e)
+                return
+
+    threads = [threading.Thread(target=mutate, args=(s,))
+               for s in (1, 2)] + [threading.Thread(target=scan)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert errs == []
+
+
+def test_heartbeat_watched_falls_back_to_world_range():
+    mon = HeartbeatMonitor(store=None, world_size=3)
+    assert mon.watched() == [0, 1, 2]
+    mon.set_ranks(["a"])
+    assert mon.watched() == ["a"]
+
+
+# ---------------------------------------------------------------------------
+# Router: exactly-once re-dispatch around evict; clean deregister
+# ---------------------------------------------------------------------------
+
+def test_router_redispatches_exactly_once_when_evicted_mid_dispatch():
+    class _Blocking(ReplicaHandle):
+        def __init__(self, rid, gate):
+            super().__init__(rid, "both")
+            self.calls = 0
+            self._gate = gate
+
+        def submit_decode(self, model, prompts, max_new=None,
+                          trace_id=None, timeout=60.0, tenant="default",
+                          priority=None):
+            self.calls += 1
+            self._gate.wait(5.0)
+            raise ConnectionError("endpoint died mid-dispatch")
+
+        def health(self):
+            return {"id": self.id, "queue_depth": 0}
+
+    gate = threading.Event()
+    a = _Blocking("a", gate)
+    b = _Fake("b")
+    r = Router(replicas=(a, b))
+    try:
+        fut = r.submit_decode("m", [np.array([1], np.int32)], timeout=10)
+        deadline = time.monotonic() + 5
+        while a.calls == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert a.calls == 1              # in flight on a
+        assert r.evict("a", reason="drill")
+        gate.set()                       # a's transport error lands NOW
+        out = fut.result(timeout=10)[0]
+        assert out[0, 0] == ord("b")     # re-dispatched...
+        assert b.calls == 1 and a.calls == 1   # ...exactly once
+    finally:
+        r.close()
+
+
+def test_router_deregister_is_clean_not_an_eviction():
+    a, b = _Fake("a"), _Fake("b")
+    r = Router(replicas=(a, b))
+    try:
+        ev0 = _counter("router_evictions_total")
+        dr0 = _counter("router_deregistered_total")
+        assert r.deregister("a", reason="drained")
+        assert r.replicas_live() == 1
+        assert "a" not in {h.id for h in r.handles()}   # removed, not
+        assert _counter("router_evictions_total") == ev0       # flagged
+        assert _counter("router_deregistered_total") == dr0 + 1
+        assert not r.deregister("a")     # idempotent
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# AutoscaleController
+# ---------------------------------------------------------------------------
+
+def test_autoscale_scales_up_under_pressure_then_cools_down():
+    r = Router(replicas=(_Fake("a"),))
+    try:
+        c = _ctrl(r, cooldown_polls=1)
+        up0 = _counter("autoscale_up_total")
+        d = c.step(HOT)
+        assert d["action"] == "scale_up" and d["replica"] == "auto0"
+        assert r.replicas_live() == 2
+        assert _counter("autoscale_up_total") == up0 + 1
+        assert c.step(HOT)["action"] == "cooldown"   # hysteresis
+        assert r.replicas_live() == 2
+    finally:
+        r.close()
+
+
+def test_autoscale_retires_least_loaded_via_graceful_drain():
+    a, b = _Fake("a"), _Fake("b")
+    a.queue_depth = 5                    # b is the cheaper victim
+    r = Router(replicas=(a, b))
+    try:
+        c = _ctrl(r)
+        down0 = _counter("autoscale_down_total")
+        dr0 = _counter("router_deregistered_total")
+        ev0 = _counter("router_evictions_total")
+        d = c.step(COLD)
+        assert d["action"] == "retire" and d["replica"] == "b"
+        assert d["drained"] is True and "escalated" not in d
+        assert b.drains == 1 and a.drains == 0
+        assert [h.id for h in r.handles()] == ["a"]
+        assert _counter("autoscale_down_total") == down0 + 1
+        assert _counter("router_deregistered_total") == dr0 + 1
+        assert _counter("router_evictions_total") == ev0   # NOT evicted
+        # at min_replicas: idleness no longer retires anything
+        assert c.step(COLD)["action"] in ("idle", "none")
+        assert r.replicas_live() == 1
+    finally:
+        r.close()
+
+
+def test_autoscale_respects_max_replicas():
+    r = Router(replicas=(_Fake("a"),))
+    try:
+        c = _ctrl(r, max_replicas=2)
+        assert c.step(HOT)["action"] == "scale_up"
+        assert c.step(HOT)["action"] == "none"       # at the ceiling
+        assert r.replicas_live() == 2
+    finally:
+        r.close()
+
+
+def test_spawn_fail_drill_counts_retries_then_abandons():
+    calls = []
+
+    def spawn(rid, ver):
+        calls.append(rid)
+        return _Fake(rid, version=ver)
+
+    r = Router(replicas=(_Fake("a"),))
+    try:
+        c = _ctrl(r, spawn=spawn, max_spawn_retries=2)
+        f0 = _counter("autoscale_spawn_failures_total")
+        _faults.install_plan(_faults.FaultPlan.parse("spawn_fail:count=10"))
+        try:
+            assert c.spawn_replica() is None
+            assert c.spawn_replica() is None
+            with pytest.raises(UnavailableError):
+                c.spawn_replica()        # budget exhausted: abandoned
+        finally:
+            _faults.clear_plan()
+        assert calls == []               # the fault fired BEFORE spawn
+        assert _counter("autoscale_spawn_failures_total") == f0 + 3
+        # a later poll succeeds and resets the consecutive-failure count
+        assert c.spawn_replica() == "auto3"
+        assert c._spawn_failures == 0
+    finally:
+        r.close()
+
+
+def test_drain_hang_escalates_to_eviction_with_postmortem(tmp_path):
+    a = _Fake("a")
+    wedged = _Fake("w", drain_ok=False)  # the drain never completes
+    r = Router(replicas=(a, wedged))
+    rec = _flight.install(dump_dir=str(tmp_path), ident="controller")
+    try:
+        c = _ctrl(r, drain_timeout_s=0.1)
+        to0 = _counter("drain_timeouts_total")
+        ev0 = _counter("router_evictions_total")
+        d = c.retire("w")
+        assert d["drained"] is False and d["escalated"] == "evict"
+        assert _counter("drain_timeouts_total") == to0 + 1
+        assert _counter("router_evictions_total") == ev0 + 1
+        assert not [h for h in r.handles() if h.id == "w" and h.alive]
+        assert (tmp_path / "postmortem_controller.json").exists()
+    finally:
+        _flight.uninstall()
+        r.close()
+    assert rec is not None
+
+
+def test_scale_to_converges_both_directions():
+    r = Router(replicas=(_Fake("a"),))
+    try:
+        c = _ctrl(r)
+        c.scale_to(3)
+        assert c.wait_live(3, timeout_s=5)
+        assert r.replicas_live() == 3
+        c.scale_to(1)
+        assert r.replicas_live() == 1
+        retires = [d for d in c.decisions if d.get("action") == "retire"]
+        assert len(retires) == 2
+        assert all(d["drained"] for d in retires)
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# RollingUpdate: canary gate, rollback, journal resume
+# ---------------------------------------------------------------------------
+
+_CANARY = [{"op": "infer", "model": "m",
+            "inputs": [np.ones((1, 2), np.float32)]}]
+
+
+def test_rolling_update_happy_path_zero_capacity_dip():
+    a, b = _Fake("a"), _Fake("b")
+    r = Router(replicas=(a, b))
+    try:
+        c = _ctrl(r)
+        heldout = []
+
+        def spawn_heldout(rid, ver):
+            heldout.append(rid)
+            return _Fake(rid, version=ver)
+
+        steps0 = _counter("rollout_steps_total")
+        out = RollingUpdate(c, spawn_heldout, _CANARY).run("v2")
+        assert out["rolled_back"] is False and out["updated"] == 2
+        assert heldout == ["canary-v2"]
+        live = [h for h in r.handles() if h.alive]
+        assert len(live) == 2
+        assert {h.version for h in live} == {"v2"}
+        assert a.drains == 1 and b.drains == 1   # replaced gracefully
+        assert _counter("rollout_steps_total") == steps0 + 2
+    finally:
+        r.close()
+
+
+def test_rolling_update_rollback_on_canary_mismatch():
+    a, b = _Fake("a", version="v2"), _Fake("b", version="v2")
+    r = Router(replicas=(a, b))
+    try:
+        c = _ctrl(r)
+        canary = _Fake("canary-v3", version="v3")
+        rb0 = _counter("rollout_rollback_total")
+        _faults.install_plan(_faults.FaultPlan.parse("canary_mismatch:"))
+        try:
+            out = RollingUpdate(c, lambda rid, ver: canary,
+                                _CANARY).run("v3")
+        finally:
+            _faults.clear_plan()
+        assert out["rolled_back"] is True and out["updated"] == 0
+        assert _counter("rollout_rollback_total") == rb0 + 1
+        # the canary never entered rotation; the old version still serves
+        assert canary.alive is False
+        live = [h for h in r.handles() if h.alive]
+        assert {h.id for h in live} == {"a", "b"}
+        assert {h.version for h in live} == {"v2"}
+        assert a.drains == b.drains == 0
+    finally:
+        r.close()
+
+
+def test_rolling_update_resumes_from_journal_without_redoing(tmp_path):
+    journal = tmp_path / "rollout.json"
+    j = RolloutJournal(str(journal))
+    j.reset("v2")
+    j.state["promoted"] = "canary-v2"
+    j.state["replaced"] = ["a"]          # crash happened after step 1
+    j.commit()
+
+    canary = _Fake("canary-v2", version="v2")
+    repl = _Fake("v2-0", version="v2")
+    b = _Fake("b", version="v1")         # the only un-replaced old one
+    r = Router(replicas=(canary, repl, b))
+    try:
+        c = _ctrl(r)
+
+        def no_heldout(rid, ver):
+            raise AssertionError("resume must not re-spawn the canary")
+
+        out = RollingUpdate(c, no_heldout, _CANARY,
+                            journal_path=str(journal)).run("v2")
+        assert out["rolled_back"] is False and out["updated"] == 1
+        assert b.drains == 1             # only the pending one
+        st = RolloutJournal(str(journal)).state
+        assert st["done"] is True
+        assert st["replaced"] == ["a", "b"]
+        live = [h for h in r.handles() if h.alive]
+        assert {h.version for h in live} == {"v2"}
+    finally:
+        r.close()
+
+
+def test_rollout_journal_atomic_roundtrip(tmp_path):
+    p = tmp_path / "j.json"
+    j = RolloutJournal(str(p))
+    assert not j.resumable_for("v5")
+    j.reset("v5")
+    assert j.resumable_for("v5") and not j.resumable_for("v6")
+    j.state["replaced"].append("x")
+    j.commit()
+    j2 = RolloutJournal(str(p))
+    assert j2.state["replaced"] == ["x"]
+    j2.state["done"] = True
+    j2.commit()
+    assert not RolloutJournal(str(p)).resumable_for("v5")

@@ -1,0 +1,83 @@
+"""Slow subprocess smoke for the elastic-lifecycle drill (tools/serve.py
+--ramp): real replica processes scale 1 -> N -> 1 under sustained mixed
+dense+decode traffic with zero client errors and every retirement a
+graceful drain (no SIGKILL eviction); a tenant burst window exercises
+per-tenant admission; the rolling-update legs run the canary bit-match
+gate, a mid-rollout SIGKILL (journal-consistent convergence + readable
+postmortem), and a fault-forced rollback that leaves the old version
+serving."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE = os.path.join(ROOT, "tools", "serve.py")
+
+
+@pytest.mark.slow
+def test_ramp_rollout_and_rollback_drill(tmp_path):
+    flight_dir = str(tmp_path / "flight")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)           # replicas are single-device CPU
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FLAGS_router_heartbeat_s"] = "0.5"
+    env["FLAGS_router_stale_after_s"] = "2.5"
+    p = subprocess.run(
+        [sys.executable, SERVE, "--ramp", "2", "--decode", "--json",
+         "--model", "lenet", "--buckets", "1,2", "--seq-buckets", "8,16",
+         "--max-new", "3", "--clients", "2", "--workers", "2",
+         "--duration", "1", "--rollout", "--rollout-kill",
+         "--flight-dir", flight_dir],
+        capture_output=True, text=True, timeout=540, env=env)
+    tail = p.stdout[p.stdout.index("{"):] if "{" in p.stdout else p.stdout
+    try:
+        report = json.loads(tail)
+    except Exception:
+        raise AssertionError(
+            f"no JSON report (rc={p.returncode}):\n{p.stdout[-2000:]}\n"
+            f"{p.stderr[-2000:]}")
+    assert p.returncode == 0, json.dumps(report, indent=1)[:3000]
+
+    # traffic never stopped and never errored across every leg
+    assert report["traffic_errors"] == []
+    assert report["traffic_completed"] > 0
+    assert report["steady_compiles"] == 0
+
+    # scale-down was graceful drain, not eviction
+    assert len(report["scale_down"]) == 1
+    assert all(d["drained"] for d in report["scale_down"])
+    assert report["scale_down_evictions"] == 0
+
+    # tenant admission: the burst tenant paid, the steady tenant's p99
+    # stayed within tolerance of its no-burst control window
+    tn = report["tenant"]
+    assert tn["burst_errors"] == []
+    assert tn["steady_p99_ms_control"] is not None
+    assert tn["steady_p99_ms_under_burst"] is not None
+    assert "isolation_violated" not in tn
+
+    # rolling update: canary gate passed, all live replicas on the new
+    # version, zero downtime (the traffic gate above covers errors)
+    assert report["rollout"]["rolled_back"] is False
+    assert set(report["rollout"]["versions"]) == {"v2"}
+
+    # mid-rollout SIGKILL: converged anyway, journal consistent, the
+    # victim left a readable flight-recorder postmortem
+    rk = report["rollout_kill"]
+    assert rk["rolled_back"] is False
+    assert rk["journal"]["done"] is True
+    assert rk["victim"] in rk["journal"]["replaced"]
+    assert set(rk["versions"]) == {"v3"}
+    assert rk["postmortem_exists"] is True
+    pm = json.load(open(os.path.join(
+        flight_dir, f"postmortem_{rk['victim']}.json")))
+    assert pm["id"] == rk["victim"]
+    assert pm["schema"].startswith("paddle_tpu/flight-recorder/")
+
+    # forced rollback: the canary died before rotation and the previous
+    # version kept serving everywhere
+    assert report["rollback"]["rolled_back"] is True
+    assert set(report["rollback"]["versions"]) == {"v3"}

@@ -184,7 +184,10 @@ def _replica_child(cfg_path):
     paddle.seed(cfg["seed"])
     buckets = tuple(cfg["buckets"])
     server = serving.Server(serving.ServingConfig(
-        workers=cfg.get("workers"), buckets=buckets))
+        workers=cfg.get("workers"), buckets=buckets,
+        version=cfg.get("version")))
+    for tenant, pol in (cfg.get("tenant_policies") or {}).items():
+        server.set_tenant_policy(tenant, **pol)
     with tempfile.TemporaryDirectory() as d:
         for name in cfg["models"]:
             layer, specs = ZOO[name]()
@@ -202,7 +205,9 @@ def _replica_child(cfg_path):
                 max_len=max(seq_buckets) + cfg["max_new"])
         replica_main(server, replica_id=cfg["id"],
                      store_host=cfg["store_host"],
-                     store_port=cfg["store_port"], block=True)
+                     store_port=cfg["store_port"],
+                     port=int(cfg.get("port", 0)), block=True,
+                     heldout=bool(cfg.get("heldout")))
     return 0
 
 
@@ -421,6 +426,451 @@ def _router_main(args):
     return _router_report(report, args, rc)
 
 
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _BgTraffic:
+    """Open-loop background clients that run until told to stop — the
+    ramp drill's phases (scale-up, drain-down, rollout legs) have no
+    fixed traffic deadline, so the deadline-based _traffic helpers do
+    not fit.  Each success is wall-stamped so the report can compute
+    windowed p99s (the tenant-isolation control/burst comparison);
+    quota rejections (UnavailableError with a retry_after hint, when
+    ``count_rejections``) are tallied, not fatal — every other client
+    exception is a drill-failing error."""
+
+    def __init__(self, router, dense, decode, seq_buckets, max_new,
+                 clients, seed, tenant="default", vocab=128, max_rows=2,
+                 timeout=120.0, count_rejections=False):
+        self._router = router
+        self._dense = dense              # [(name, specs, vocab), ...]
+        self._decode = bool(decode)
+        self._max_prompt = max(seq_buckets)
+        self._max_new = max_new
+        self._clients = int(clients)
+        self._seed = int(seed)
+        self.tenant = str(tenant)
+        self._vocab = int(vocab)
+        self._max_rows = int(max_rows)
+        self._timeout = float(timeout)
+        self._count_rejections = bool(count_rejections)
+        self._stop = threading.Event()
+        self._threads = []
+        self._lock = threading.Lock()
+        self.errors = []
+        self.rejections = 0
+        self.latencies = []              # (wall_ts, seconds) per success
+
+    def _client(self, i):
+        from paddle_tpu.framework.enforce import UnavailableError
+        rng = np.random.RandomState(self._seed + i)
+        while not self._stop.is_set():
+            rows = int(rng.randint(1, self._max_rows + 1))
+            use_decode = self._decode and (not self._dense
+                                           or rng.rand() < 0.5)
+            t0 = time.perf_counter()
+            try:
+                if use_decode:
+                    prompts = [rng.randint(
+                        1, self._vocab,
+                        int(rng.randint(1, self._max_prompt + 1)))
+                        for _ in range(rows)]
+                    mn = int(rng.randint(1, self._max_new + 1))
+                    out = self._router.submit_decode(
+                        "gpt_decode", prompts, max_new_tokens=mn,
+                        timeout=self._timeout,
+                        tenant=self.tenant).result(timeout=self._timeout)
+                    if out[0].shape != (rows, mn):
+                        raise AssertionError(
+                            f"decode shape {out[0].shape} != ({rows},{mn})")
+                else:
+                    name, specs, vocab = \
+                        self._dense[rng.randint(len(self._dense))]
+                    outs = self._router.submit(
+                        name, _random_inputs(rng, specs, rows, vocab),
+                        timeout=self._timeout,
+                        tenant=self.tenant).result(timeout=self._timeout)
+                    if outs[0].shape[0] != rows:
+                        raise AssertionError(
+                            f"padding leaked: {outs[0].shape[0]} != {rows}")
+                with self._lock:
+                    self.latencies.append(
+                        (time.time(), time.perf_counter() - t0))
+            except UnavailableError as e:
+                if self._count_rejections \
+                        and getattr(e, "retry_after_s", None) is not None:
+                    with self._lock:
+                        self.rejections += 1
+                    self._stop.wait(min(1.0, float(e.retry_after_s)))
+                    continue
+                with self._lock:
+                    self.errors.append(
+                        f"{self.tenant}/client{i}: "
+                        f"{type(e).__name__}: {e}")
+                return
+            except Exception as e:   # noqa: BLE001 — reported, gated
+                with self._lock:
+                    self.errors.append(
+                        f"{self.tenant}/client{i}: "
+                        f"{type(e).__name__}: {e}")
+                return
+
+    def start(self):
+        self._threads = [
+            threading.Thread(target=self._client, args=(i,), daemon=True)
+            for i in range(self._clients)]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self._timeout + 30)
+
+    def p99_ms(self, t0=None, t1=None):
+        with self._lock:
+            lats = [s for (ts, s) in self.latencies
+                    if (t0 is None or ts >= t0)
+                    and (t1 is None or ts <= t1)]
+        if not lats:
+            return None
+        return round(float(np.percentile(
+            np.asarray(lats) * 1e3, 99)), 3)
+
+
+def _ramp_main(args):
+    """--ramp N: the elastic-lifecycle drill.  One seed replica boots,
+    sustained mixed traffic starts and NEVER stops; the cluster then
+    scales 1 -> N -> 1 through the AutoscaleController (scale-down is
+    graceful drain — rc gates on every retirement reporting drained,
+    zero heartbeat evictions, zero client errors, zero steady-state
+    compiles).  A tenant-burst window measures per-tenant admission
+    isolation, and --rollout adds zero-downtime rolling-update legs:
+    happy path behind the canary bit-match gate, an optional mid-rollout
+    SIGKILL (--rollout-kill, journal-resume + postmortem gates), and a
+    fault-forced canary rollback that must leave the old version
+    serving."""
+    import signal
+    import subprocess
+
+    from paddle_tpu.distributed.fleet.base.tcp_store import TCPStore
+    from paddle_tpu.framework.flags import flag as _flag
+    from paddle_tpu.profiler.metrics import default_registry
+    from paddle_tpu.serving.cluster import (AutoscaleController,
+                                            ClusterObserver, RemoteReplica,
+                                            RollingUpdate, Router, RpcClient)
+    from paddle_tpu.testing import faults as _faults
+
+    n_top = int(args.ramp)
+    if n_top < 2:
+        print("--ramp needs N >= 2", file=sys.stderr)
+        return 2
+    names = list(dict.fromkeys(
+        args.model or ([] if args.decode else ["lenet"])))
+    buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+    seq_buckets = tuple(int(b) for b in args.seq_buckets.split(",")
+                        if b.strip())
+    report = {"ramp": n_top, "duration_s": args.duration,
+              "clients": args.clients, "models": names,
+              "decode": bool(args.decode), "replica_stats": {}}
+    rc = 0
+    if args.flight_dir:
+        os.makedirs(args.flight_dir, exist_ok=True)
+        report["flight_dir"] = args.flight_dir
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    cfg_dir = tempfile.mkdtemp(prefix="serve_ramp_")
+    # a shared executable cache is what makes elastic scale-up viable:
+    # the seed replica compiles once, every later spawn boots O(load)
+    cache_dir = args.cache_dir or os.path.join(cfg_dir, "exec_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    children = {}                        # replica id -> Popen
+    router = obs = traffic = burst_router = None
+
+    def _cfg_for(rid, version=None, store_on=True, port=0,
+                 heldout=False):
+        return {"id": rid, "role": "both", "seed": args.seed,
+                "heldout": heldout,
+                "models": names, "decode": bool(args.decode),
+                "buckets": list(buckets),
+                "seq_buckets": list(seq_buckets),
+                "max_new": args.max_new, "workers": args.workers,
+                "store_host": "127.0.0.1" if store_on else None,
+                "store_port": store.port, "port": port,
+                "heartbeat_s": float(_flag("router_heartbeat_s")),
+                "cache_dir": cache_dir, "trace": "off",
+                "flight_dir": args.flight_dir,
+                "flight_interval_s": 0.5, "version": version,
+                # per-tenant admission for the burst drill: the bursty
+                # tenant gets a tight pending quota + bottom priority,
+                # the steady tenant a high priority class
+                "tenant_policies": {
+                    "burst": {"max_pending": 2, "priority": 0},
+                    "steady": {"priority": 5}}}
+
+    def _spawn_child(cfg):
+        path = os.path.join(cfg_dir, f"{cfg['id']}.json")
+        with open(path, "w") as f:
+            json.dump(cfg, f)
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--replica-config", path],
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        children[cfg["id"]] = p
+        return p
+
+    def spawn(rid, version):
+        # ElasticLaunch-style: the controller holds the Popen token and
+        # the replica joins through the rendezvous store
+        return _spawn_child(_cfg_for(rid, version=version))
+
+    def spawn_heldout(rid, version):
+        # canary: NO rendezvous record (held out of rotation — discovery
+        # can't find it), but it DOES heartbeat, so once RollingUpdate
+        # promotes it via add_replica the router's liveness verdict
+        # holds; fixed RPC port, dialed directly once it answers ping
+        port = _free_port()
+        _spawn_child(_cfg_for(rid, version=version, port=port,
+                              heldout=True))
+        deadline = time.monotonic() + 600
+        while True:
+            try:
+                c = RpcClient("127.0.0.1", port, timeout=5.0)
+                c.request("ping", {})
+                c.close()
+                break
+            except Exception:   # noqa: BLE001 — still booting
+                if children[rid].poll() is not None:
+                    raise RuntimeError(
+                        f"held-out replica {rid} exited "
+                        f"rc={children[rid].returncode}")
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        return RemoteReplica(rid, "127.0.0.1", port, role="both",
+                             version=version)
+
+    def _evictions():
+        m = default_registry().get("router_evictions_total")
+        return float(m.value) if m is not None else 0.0
+
+    try:
+        router = Router(store=store)
+        obs = ClusterObserver(router, trace_dir=args.trace_dir)
+        router.attach_observer(obs)
+        ctrl = AutoscaleController(router, spawn, min_replicas=1,
+                                   max_replicas=max(n_top, 4),
+                                   version="v1")
+        t0 = time.perf_counter()
+        ctrl.spawn_replica("r0", version="v1")
+        if not ctrl.wait_live(1, timeout_s=600):
+            report["error"] = "seed replica never joined"
+            return _router_report(report, args, 1)
+        report["boot_s"] = round(time.perf_counter() - t0, 3)
+
+        model_meta = {name: ZOO[name]() for name in names}
+        dense = [(name, model_meta[name][1],
+                  getattr(model_meta[name][0], "_serve_vocab", None))
+                 for name in names]
+        traffic = _BgTraffic(router, dense, args.decode, seq_buckets,
+                             args.max_new, clients=args.clients,
+                             seed=args.seed, tenant="steady").start()
+
+        # -- tenant admission: control window, then a burst window ------
+        tc0 = time.time()
+        time.sleep(args.duration)
+        tc1 = time.time()
+        burst_router = Router(store=store)   # the burst tenant's own
+        burst = _BgTraffic(burst_router, dense, args.decode, seq_buckets,
+                           args.max_new, clients=max(4, args.clients),
+                           seed=args.seed + 1000, tenant="burst",
+                           timeout=max(8.0, args.duration),
+                           count_rejections=True).start()
+        tb0 = time.time()
+        time.sleep(args.duration)
+        tb1 = time.time()
+        burst.stop()
+        burst_router.close()
+        burst_router = None
+        p99_ctrl = traffic.p99_ms(tc0, tc1)
+        p99_burst = traffic.p99_ms(tb0, tb1)
+        report["tenant"] = {
+            "steady_p99_ms_control": p99_ctrl,
+            "steady_p99_ms_under_burst": p99_burst,
+            "burst_p99_ms": burst.p99_ms(tb0, tb1),
+            "burst_rejections": burst.rejections,
+            "burst_completed": len(burst.latencies),
+            "burst_errors": burst.errors}
+        if burst.errors:
+            rc = 1
+        if p99_ctrl is not None and p99_burst is not None \
+                and p99_burst > max(10.0 * p99_ctrl, p99_ctrl + 2000.0):
+            report["tenant"]["isolation_violated"] = True
+            rc = 1
+
+        # -- ramp 1 -> N -> 1 under traffic ------------------------------
+        ev0 = _evictions()
+        up0 = time.perf_counter()
+        ctrl.scale_to(n_top, version="v1")
+        if not ctrl.wait_live(n_top, timeout_s=600):
+            report["error"] = f"never reached {n_top} live replicas"
+            return _router_report(report, args, 1)
+        report["ramp_up_s"] = round(time.perf_counter() - up0, 3)
+        time.sleep(args.duration)        # sustain at N
+        down0 = time.perf_counter()
+        ctrl.scale_to(1)
+        report["ramp_down_s"] = round(time.perf_counter() - down0, 3)
+        retires = [d for d in ctrl.decisions
+                   if d.get("action") == "retire"]
+        report["scale_down"] = [
+            {"replica": d.get("replica"),
+             "drained": d.get("drained"),
+             "duration_s": d.get("duration_s"),
+             "escalated": d.get("escalated")} for d in retires]
+        report["scale_down_evictions"] = _evictions() - ev0
+        if len(retires) != n_top - 1 \
+                or not all(d.get("drained") for d in retires) \
+                or report["scale_down_evictions"]:
+            rc = 1
+
+        # -- rolling update legs -----------------------------------------
+        if args.rollout:
+            ctrl.scale_to(2, version="v1")
+            ctrl.wait_live(2, timeout_s=600)
+            rng = np.random.RandomState(12345)
+            canary_reqs = []
+            for name, specs, vocab in dense:
+                canary_reqs.append(
+                    {"op": "infer", "model": name,
+                     "inputs": _random_inputs(rng, specs, 1, vocab)})
+            if args.decode:
+                canary_reqs.append(
+                    {"op": "decode", "model": "gpt_decode",
+                     "prompts": [rng.randint(1, 128, 6)],
+                     "max_new": args.max_new})
+            journal = os.path.join(cfg_dir, "rollout.json")
+            ru = RollingUpdate(ctrl, spawn_heldout, canary_reqs,
+                               journal_path=journal)
+            out = ru.run("v2", wait_live_s=600)
+            out["versions"] = sorted(h.version for h in router.handles()
+                                     if h.alive)
+            report["rollout"] = out
+            if out.get("rolled_back") \
+                    or out["versions"] != ["v2"] * len(out["versions"]):
+                rc = 1
+
+            if args.rollout_kill:
+                # mid-rollout SIGKILL: once the v3 canary is promoted
+                # (journal says so), the old replica that would be
+                # replaced LAST dies hard; the rollout must finish, the
+                # journal must stay consistent, traffic must not error
+                victim = max(h.id for h in router.handles() if h.alive)
+                def _killer():
+                    deadline = time.monotonic() + 600
+                    while time.monotonic() < deadline:
+                        try:
+                            with open(journal) as f:
+                                if json.load(f).get("promoted"):
+                                    break
+                        except (OSError, ValueError):
+                            pass
+                        time.sleep(0.05)
+                    p = children.get(victim)
+                    if p is not None and p.poll() is None:
+                        p.send_signal(signal.SIGKILL)
+                kt = threading.Thread(target=_killer, daemon=True)
+                kt.start()
+                out = RollingUpdate(ctrl, spawn_heldout, canary_reqs,
+                                    journal_path=journal).run(
+                                        "v3", wait_live_s=600)
+                kt.join(timeout=30)
+                with open(journal) as f:
+                    jstate = json.load(f)
+                out["victim"] = victim
+                out["journal"] = jstate
+                out["versions"] = sorted(
+                    h.version for h in router.handles() if h.alive)
+                if args.flight_dir:
+                    pm = os.path.join(args.flight_dir,
+                                      f"postmortem_{victim}.json")
+                    out["postmortem_exists"] = os.path.exists(pm)
+                    if not out["postmortem_exists"]:
+                        rc = 1
+                report["rollout_kill"] = out
+                if out.get("rolled_back") or not jstate.get("done") \
+                        or victim not in jstate.get("replaced", ()) \
+                        or set(out["versions"]) != {"v3"}:
+                    rc = 1
+
+            # forced rollback: the canary_mismatch fault clause fires in
+            # THIS process (the comparison runs router-side), the canary
+            # must die before rotation and the old version keep serving
+            prev = sorted(h.version for h in router.handles() if h.alive)
+            _faults.install_plan(_faults.FaultPlan.parse("canary_mismatch:"))
+            try:
+                out = RollingUpdate(ctrl, spawn_heldout, canary_reqs,
+                                    journal_path=journal).run(
+                                        "v9", wait_live_s=600)
+            finally:
+                _faults.clear_plan()
+            out["versions"] = sorted(h.version for h in router.handles()
+                                     if h.alive)
+            report["rollback"] = out
+            if not out.get("rolled_back") or out["versions"] != prev:
+                rc = 1
+            ctrl.scale_to(1)
+
+        traffic.stop()
+        report["traffic_errors"] = traffic.errors
+        report["traffic_completed"] = len(traffic.latencies)
+        if traffic.errors or not traffic.latencies:
+            rc = 1
+
+        steady_total = 0
+        for h in router.handles():
+            if not h.alive:
+                continue
+            try:
+                hl = h.health()
+                report["replica_stats"][h.id] = h.model_stats()
+            except Exception as e:   # noqa: BLE001 — reported, gated
+                report["replica_stats"][h.id] = \
+                    {"error": f"{type(e).__name__}: {e}"}
+                rc = 1
+                continue
+            steady_total += int(hl.get("steady_compiles", 0))
+        report["steady_compiles"] = steady_total
+        if steady_total:
+            rc = 1
+        report["decisions"] = ctrl.decisions
+        report["router_stats"] = router.stats()
+        sig = obs.poll()
+        report["cluster_signals"] = sig.to_dict()
+    finally:
+        if traffic is not None:
+            traffic.stop()
+        if burst_router is not None:
+            burst_router.close()
+        if obs is not None:
+            obs.close()
+        if router is not None:
+            router.close()
+        for p in children.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in children.values():
+            try:
+                p.wait(timeout=10)
+            except Exception:   # noqa: BLE001 — last resort
+                p.kill()
+        store.close()
+    return _router_report(report, args, rc)
+
+
 def _router_report(report, args, rc):
     report["rc"] = rc
     if args.as_json:
@@ -535,12 +985,36 @@ def main(argv=None):
                     help="under --router: SIGKILL one replica "
                          "mid-traffic and require heartbeat eviction + "
                          "traffic redistribution (rc!=0 otherwise)")
+    ap.add_argument("--ramp", type=int, default=None, metavar="N",
+                    help="elastic-lifecycle drill: boot ONE replica, "
+                         "start sustained traffic that never stops, "
+                         "scale 1 -> N -> 1 through the autoscaling "
+                         "controller (scale-down is graceful drain), "
+                         "and run a tenant-burst admission window; rc "
+                         "gates on zero client errors, zero steady "
+                         "compiles, every retirement drained (no "
+                         "eviction), and tenant isolation")
+    ap.add_argument("--rollout", action="store_true",
+                    help="under --ramp: add zero-downtime rolling-"
+                         "update legs at scale 2 — canary bit-match "
+                         "gate then replica-by-replica replacement, "
+                         "plus a fault-forced canary rollback that "
+                         "must leave the old version serving")
+    ap.add_argument("--rollout-kill", action="store_true",
+                    dest="rollout_kill",
+                    help="under --ramp --rollout: SIGKILL one old "
+                         "replica mid-rollout (after canary "
+                         "promotion); the rollout must still converge, "
+                         "the journal stay consistent, and the victim "
+                         "leave a flight-recorder postmortem")
     ap.add_argument("--replica-config", default=None,
                     help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
     if args.replica_config:
         return _replica_child(args.replica_config)
+    if args.ramp is not None:
+        return _ramp_main(args)
     if args.router:
         return _router_main(args)
 
