@@ -1289,6 +1289,161 @@ def bench_decode_churn(on_tpu):
     return res
 
 
+def bench_prefix_cache(on_tpu):
+    """Prefix/session KV-cache block (serving/prefix_cache.py +
+    serving/sessions.py).  Two claims.  (1) TTFT ∝ uncached suffix:
+    requests sharing a system-prompt prefix of growing length L run
+    through the slot loop twice — plain (chunk-prefill everything) and
+    with the radix prefix cache (restore the L cached tokens' ring
+    planes, chunk only the suffix) — and the TTFT speedup must GROW
+    with L.  (2) HBM-per-conversation: parking ≥1000 idle conversations
+    as host-RAM snapshots leaves device HBM holding only the S slot
+    rows, so ring-bytes-per-resident-conversation drops by
+    (S + parked)/S — the ≥4x claim needs parked ≥ 3S.  Zero
+    steady-state compiles asserted across both timed sides.  CPU
+    control caveat: per-dispatch host overhead (~ms) taxes the cached
+    path's extra pull/push dispatches hardest, so CPU speedups
+    UNDERSTATE the chip-round win; the SHAPE (speedup growing with L)
+    is the portable claim."""
+    import jax.tree_util as tu
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import ledger as _led
+    from paddle_tpu.serving.cluster.handoff import _np_dtype
+    from paddle_tpu.serving.prefix_cache import PrefixCache
+    from paddle_tpu.serving.sessions import SessionStore
+    from paddle_tpu.serving.slots import SlotLoop
+    from paddle_tpu.text.generation import Generator
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTModel
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=768, num_layers=12,
+                        num_heads=12, intermediate_size=3072,
+                        max_position_embeddings=1024, dropout=0.0)
+        S, C, T, n_req, reps = 8, 1024, 64, 6, 3
+        prefix_lens, suffix_len, n_park = (0, 256, 512, 768), 48, 1000
+    else:
+        cfg = GPTConfig.tiny(vocab_size=128, hidden_size=64, layers=2,
+                             heads=2, seq=256)
+        S, C, T, n_req, reps = 4, 256, 16, 6, 2
+        prefix_lens, suffix_len, n_park = (0, 64, 128, 192), 12, 1000
+
+    paddle.seed(23)
+    model = GPTModel(cfg)
+    model.eval()
+    if on_tpu:
+        paddle.amp.decorate(models=model, level="O2", dtype="bfloat16")
+    gen = Generator(model, site="bench:prefix_cache",
+                    seq_buckets=(16,), max_len=C)
+    rng = np.random.RandomState(11)
+
+    def _nbytes(avals):
+        return sum(int(np.prod(tuple(a.shape)))
+                   * _np_dtype(str(a.dtype)).itemsize
+                   for a in tu.tree_leaves(avals))
+
+    block_nbytes = _nbytes(gen._block_avals(S, T, C))
+    ring_nbytes = _nbytes(gen.slot_cache_avals_all(S, C))
+
+    # per shared-prefix length L: one seeding request publishes the
+    # prefix's plane blocks, then n_req requests (same prefix, unique
+    # suffixes) run sequentially — submit-to-first-result wall IS the
+    # TTFT here, because nothing else occupies the loop
+    cases = []
+    for L in prefix_lens:
+        prefix = rng.randint(1, cfg.vocab_size, L).astype(np.int32)
+        sufs = [rng.randint(1, cfg.vocab_size,
+                            suffix_len).astype(np.int32)
+                for _ in range(n_req + 1)]
+        cases.append((L, [np.concatenate([prefix, s]) for s in sufs]))
+
+    def run(cached):
+        out = {}
+        pc = PrefixCache(T, block_nbytes, hbm_budget_mb=1024.0) \
+            if cached else None
+        loop = SlotLoop(gen, S, C, T, prefix_cache=pc)
+        for L, prompts in cases:
+            loop.submit(prompts[0], 2).result(timeout=600)  # publish
+            t0 = time.perf_counter()
+            for p in prompts[1:]:
+                loop.submit(p, 2).result(timeout=600)
+            out[L] = (time.perf_counter() - t0) * 1e3 / n_req
+        st = loop.stats()
+        loop.close()
+        return out, st
+
+    run(False)                                   # warm-up compiles
+    run(True)
+    wloop = SlotLoop(gen, S, C, T,               # row-mover warm-up
+                     session_store=SessionStore(spill_dir="",
+                                                park_after_ms=0))
+    wloop.submit(rng.randint(1, cfg.vocab_size, 8).astype(np.int32), 2,
+                 session_id="warm").result(timeout=600)
+    wloop.close()
+    mark = len(_led.compile_events(gen.site))
+    best_plain = best_cached = st_cached = None
+    for _ in range(reps):
+        plain, _st = run(False)
+        if best_plain is None \
+                or plain[prefix_lens[-1]] < best_plain[prefix_lens[-1]]:
+            best_plain = plain
+        cached, st = run(True)
+        if best_cached is None \
+                or cached[prefix_lens[-1]] < best_cached[prefix_lens[-1]]:
+            best_cached, st_cached = cached, st
+
+    # -- parked-session HBM accounting: 1000 conversations, S slots ----
+    store = SessionStore(spill_dir="", park_after_ms=0)
+    loop = SlotLoop(gen, S, C, T, session_store=store)
+    park_prompt_len = 2 * T + T // 2     # ≥2 full plane blocks/session
+    t0 = time.perf_counter()
+    futs = [loop.submit(rng.randint(1, cfg.vocab_size,
+                                    park_prompt_len).astype(np.int32),
+                        2, session_id=f"bench-s{i}")
+            for i in range(n_park)]
+    for f in futs:
+        f.result(timeout=600)
+    park_s = time.perf_counter() - t0
+    parked = len(store)
+    host_bytes = store.nbytes()
+    loop.close()
+
+    steady = len(_led.compile_events(gen.site)) - mark
+    assert steady == 0, f"prefix_cache: {steady} steady compile(s)"
+
+    ttft = []
+    for L in prefix_lens:
+        ttft.append({"prefix_tokens": L,
+                     "plain_ttft_ms": round(best_plain[L], 2),
+                     "cached_ttft_ms": round(best_cached[L], 2),
+                     "speedup": round(best_plain[L] / best_cached[L],
+                                      3)})
+    res = {
+        "unit": "x TTFT plain/cached @ longest shared prefix",
+        "cpu_control": not on_tpu,
+        "slots": S, "cache": C, "chunk": T,
+        "block_nbytes": block_nbytes,
+        "ttft_by_prefix": ttft,
+        "speedup_grows_with_prefix":
+            ttft[-1]["speedup"] > ttft[0]["speedup"],
+        "prefix_hit_tokens": st_cached.get("prefix_hit_tokens"),
+        "sessions": {
+            "parked": parked,
+            "park_s": round(park_s, 2),
+            "park_per_s": round(parked / park_s, 1),
+            "host_bytes_per_session":
+                int(host_bytes / max(parked, 1)),
+            "ring_hbm_bytes": ring_nbytes,
+            "hbm_per_conversation_slots_only": int(ring_nbytes / S),
+            "hbm_per_conversation_with_store":
+                int(ring_nbytes / (S + parked)),
+            "hbm_reduction_x": round((S + parked) / S, 1),
+        },
+        "zero_steady_state_compiles": True,
+    }
+    res["value"] = ttft[-1]["speedup"]
+    return res
+
+
 def bench_moe(on_tpu):
     """Eleventh block: expert-parallel Mixture-of-Experts (ISSUE 14) —
     GPT-MoE vs a parameter-matched dense GPT, step time per token at
@@ -1601,6 +1756,7 @@ WORKLOADS = [
     ("serving", bench_serving),
     ("decode", bench_decode),
     ("decode_churn", bench_decode_churn),
+    ("prefix_cache", bench_prefix_cache),
     ("moe", bench_moe),
     ("autoshard", bench_autoshard),
     ("startup", bench_startup),

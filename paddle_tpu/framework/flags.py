@@ -608,6 +608,42 @@ define_flag("prefill_chunk",
             "FLAGS_decode_slots == 0.  Seeded by "
             "PADDLE_TPU_PREFILL_CHUNK.",
             validator=lambda v: 1 <= int(v) <= 4096)
+define_flag("prefix_cache", False,
+            "Radix-trie prefix KV cache under the slot decode loop "
+            "(serving/prefix_cache.py): completed prefills publish their "
+            "prompt's ring-cache plane blocks back into a token-prefix "
+            "trie, and a joining request restores the longest cached "
+            "prefix into its validity window, chunk-prefilling only the "
+            "uncached suffix.  Off (default) = the slot loop admits "
+            "exactly as before (one Python branch at admission).  "
+            "Requires FLAGS_decode_slots > 0 to have any effect.")
+define_flag("prefix_cache_hbm_mb", 256.0,
+            "Device-memory budget (MiB) of the prefix KV cache; "
+            "least-recently-used unpinned leaf blocks evict until the "
+            "cache fits.  0 = unbounded (the trie grows until cleared).",
+            validator=lambda v: float(v) >= 0.0)
+define_flag("session_store", False,
+            "Parked-session KV store (serving/sessions.py): a decode "
+            "request carrying a session id parks its ring-cache row as a "
+            "host-RAM snapshot at turn end, and the follow-up turn "
+            "restores the snapshot into a slot and decodes from the "
+            "committed position instead of re-prefilling the whole "
+            "history.  Graceful drain parks in-flight session rows for "
+            "migration instead of waiting them out.  Off (default) = "
+            "session ids are ignored; off-path is one Python branch.")
+define_flag("session_store_dir", "",
+            "Optional disk-spill directory for parked sessions (empty = "
+            "host RAM only).  Snapshots write under the sha256-verified "
+            "atomic-manifest discipline; a directory shared between "
+            "replicas doubles as the migration transport — any replica "
+            "can restore a session a dead replica parked there.")
+define_flag("session_park_after_ms", 0,
+            "Age (ms) a RAM-parked session must reach before it spills "
+            "to FLAGS_session_store_dir.  0 (default) writes through to "
+            "disk at park time — the mode that survives SIGKILL, since "
+            "a lazily-spilled snapshot still in RAM dies with the "
+            "process.  Ignored when the spill directory is unset.",
+            validator=lambda v: int(v) >= 0)
 
 # ---- Persistent executable cache (paddle_tpu.jit.persistent_cache) ----------
 define_flag("executable_cache",

@@ -33,9 +33,11 @@ import numpy as np
 from ...framework.enforce import InvalidArgumentError
 from ...profiler.metrics import default_registry as _registry
 
-__all__ = ["KVHandoff", "serialize_kv", "deserialize_kv"]
+__all__ = ["KVHandoff", "serialize_kv", "deserialize_kv",
+           "serialize_session", "deserialize_session"]
 
 _MAGIC = b"PTKV1\n"
+_SS_MAGIC = b"PTSS1\n"
 
 _HANDOFF_BYTES = _registry().counter(
     "kv_handoff_bytes_total",
@@ -149,6 +151,95 @@ def serialize_kv(h: KVHandoff) -> bytes:
     }).encode()
     out = _MAGIC + struct.pack("<I", len(header)) + header + buf.getvalue()
     _HANDOFF_BYTES.labels("wire").inc(len(out))
+    _HANDOFF_SECONDS.observe(time.monotonic() - t0)
+    return out
+
+
+def _encode_tree(tree, buf) -> Any:
+    """Descriptor of an arbitrary list/tuple pytree of arrays, appending
+    each leaf's raw storage bytes to ``buf``.  Container kinds are part
+    of the descriptor — the slot-cache pytree structure (a LIST of
+    per-layer TUPLEs; the speculative pair is a tuple of two such lists)
+    must survive the roundtrip exactly or jax.tree_util would see a
+    different treedef on restore."""
+    if tree is None:
+        return None
+    if isinstance(tree, (list, tuple)):
+        return {"t": "list" if isinstance(tree, list) else "tuple",
+                "c": [_encode_tree(x, buf) for x in tree]}
+    a = _host(tree)
+    buf.write(a.tobytes())
+    return {"shape": list(a.shape), "dtype": str(a.dtype)}
+
+
+def _decode_tree(desc, take) -> Any:
+    if desc is None:
+        return None
+    if "t" in desc:
+        kids = [_decode_tree(d, take) for d in desc["c"]]
+        return kids if desc["t"] == "list" else tuple(kids)
+    return take(desc)
+
+
+def serialize_session(payload: dict) -> bytes:
+    """Parked-session snapshot wire format (magic ``PTSS1\\n``): same
+    length-prefixed-JSON + raw-plane-bytes discipline as
+    :func:`serialize_kv`, but the plane container is an arbitrary
+    list/tuple pytree (plain slot caches and speculative (target, draft)
+    pairs alike) and the scalar session state (tokens, resume payload,
+    budget) rides the header.  ``payload['planes']`` and
+    ``payload['logits']`` are array pytrees (or None); every other key
+    must be JSON-serializable.  Bit-exact roundtrip — a restored session
+    decodes byte-identically."""
+    t0 = time.monotonic()
+    buf = io.BytesIO()
+    header_doc = {"version": 1}
+    for k, v in payload.items():
+        if k in ("planes", "logits"):
+            header_doc[k] = _encode_tree(v, buf)
+        else:
+            header_doc[k] = v
+    header = json.dumps(header_doc).encode()
+    out = _SS_MAGIC + struct.pack("<I", len(header)) + header \
+        + buf.getvalue()
+    _HANDOFF_BYTES.labels("session").inc(len(out))
+    _HANDOFF_SECONDS.observe(time.monotonic() - t0)
+    return out
+
+
+def deserialize_session(blob: bytes) -> dict:
+    """Inverse of :func:`serialize_session`; plane leaves come back as
+    host np.frombuffer views with the original container structure."""
+    t0 = time.monotonic()
+    if not blob.startswith(_SS_MAGIC):
+        raise InvalidArgumentError(
+            "not a session snapshot blob (bad magic); refusing to parse")
+    off = len(_SS_MAGIC)
+    (hlen,) = struct.unpack_from("<I", blob, off)
+    off += 4
+    header = json.loads(blob[off:off + hlen].decode())
+    if header.get("version") != 1:
+        raise InvalidArgumentError(
+            f"session snapshot version {header.get('version')!r} is not "
+            "supported (this build speaks version 1)")
+    off += hlen
+
+    def take(meta):
+        nonlocal off
+        dt = _np_dtype(meta["dtype"])
+        shape = tuple(meta["shape"])
+        n = int(np.prod(shape)) * dt.itemsize if shape else dt.itemsize
+        a = np.frombuffer(blob, dtype=dt,
+                          count=max(1, int(np.prod(shape))),
+                          offset=off).reshape(shape)
+        off += n
+        return a
+
+    out = {}
+    for k, v in header.items():
+        if k == "version":
+            continue
+        out[k] = _decode_tree(v, take) if k in ("planes", "logits") else v
     _HANDOFF_SECONDS.observe(time.monotonic() - t0)
     return out
 

@@ -243,13 +243,31 @@ class AutoscaleController:
                     "skipped": "not live"}
         DRAIN_INITIATED.inc()
         t0 = time.monotonic()
+        migrated = 0
         try:
-            report = h.drain(timeout=self._drain_timeout, retire=True)
+            if bool(_flags.flag("session_store")):
+                # two-phase session-stateful retirement: phase 1 drains
+                # WITHOUT retiring (live conversations park into the
+                # session store, their futures bounce retryably), then
+                # the router moves the parked sessions to survivors and
+                # rewrites affinity; phase 2 is a short re-drain that
+                # deregisters.  A phase-1 wedge skips migration and
+                # falls through to the eviction escalation unchanged.
+                report = h.drain(timeout=self._drain_timeout,
+                                 retire=False)
+                if report.get("drained"):
+                    migrated = self.router.migrate_sessions_from(h.id)
+                    report = h.drain(timeout=self._drain_timeout,
+                                     retire=True)
+            else:
+                report = h.drain(timeout=self._drain_timeout,
+                                 retire=True)
         except Exception as e:   # noqa: BLE001 — transport died mid-drain
             report = {"drained": False, "error": f"{type(e).__name__}: {e}"}
         out = {"action": "retire", "replica": h.id,
                "drained": bool(report.get("drained")),
                "duration_s": round(time.monotonic() - t0, 3),
+               "migrated_sessions": migrated,
                "report": report}
         if report.get("drained"):
             self.router.deregister(h.id, reason="drained")

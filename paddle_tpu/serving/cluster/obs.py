@@ -102,6 +102,12 @@ _SIG_SLOT_OCC = _registry().gauge(
     "replica (FLAGS_decode_slots loops; 0.0 on the scanned path) — the "
     "real decode-load input batch-level queue depth cannot provide.",
     labels=("replica",))
+_SIG_SESSIONS = _registry().gauge(
+    "cluster_replica_sessions_parked",
+    "ClusterSignals: parked conversations held by the replica's session "
+    "store (FLAGS_session_store; 0 when the store is off) — drain "
+    "planning reads this to size the migration leg.",
+    labels=("replica",))
 _SIG_CLOCK = _registry().gauge(
     "cluster_replica_clock_offset_seconds",
     "Estimated replica wall-clock offset vs the router (scrape "
@@ -138,6 +144,11 @@ class ReplicaSignals:
     # replica serves the scanned path).  Appended with a default so
     # positional constructions from before the slot loop keep working.
     decode_slot_occupancy_ratio: float = 0.0
+    # parked-session accounting (serving/sessions.py; zeros when
+    # FLAGS_session_store is off) — appended with defaults, same
+    # positional-compatibility discipline as the slot field above
+    sessions_parked: int = 0
+    session_store_bytes: int = 0
 
 
 @dataclass(frozen=True)
@@ -160,6 +171,10 @@ class ClusterSignals:
     # replica serves the scanned path)
     max_decode_slot_occupancy: float = 0.0
     replicas: Tuple[ReplicaSignals, ...] = field(default_factory=tuple)
+    # cluster-wide parked-conversation count (FLAGS_session_store) —
+    # appended after ``replicas`` so positional constructions from
+    # before the session store keep working
+    total_sessions_parked: int = 0
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -380,7 +395,10 @@ class ClusterObserver:
                 inflight=int(h.inflight), dispatched=int(h.dispatched),
                 clock_offset_s=offset,
                 decode_slot_occupancy_ratio=float(
-                    sig.get("decode_slot_occupancy_ratio", 0.0)))
+                    sig.get("decode_slot_occupancy_ratio", 0.0)),
+                sessions_parked=int(sig.get("sessions_parked", 0)),
+                session_store_bytes=int(
+                    sig.get("session_store_bytes", 0)))
             per_replica.append(rs)
             _SIG_QDEPTH.labels(h.id).set(rs.queue_depth)
             _SIG_RETRY.labels(h.id).set(rs.retry_after_s)
@@ -388,6 +406,7 @@ class ClusterObserver:
             _SIG_STEADY.labels(h.id).set(rs.steady_compiles)
             _SIG_OCCUPANCY.labels(h.id).set(rs.batch_occupancy_rows)
             _SIG_SLOT_OCC.labels(h.id).set(rs.decode_slot_occupancy_ratio)
+            _SIG_SESSIONS.labels(h.id).set(rs.sessions_parked)
             _SIG_CLOCK.labels(h.id).set(rs.clock_offset_s)
         if self._writer is not None:
             # the router's own finished spans, mono -> own wall
@@ -409,7 +428,9 @@ class ClusterObserver:
             max_decode_slot_occupancy=max(
                 [r.decode_slot_occupancy_ratio for r in per_replica]
                 or [0.0]),
-            replicas=tuple(per_replica))
+            replicas=tuple(per_replica),
+            total_sessions_parked=sum(r.sessions_parked
+                                      for r in per_replica))
         _SIG_LIVE.set(sig.replicas_live)
         with self._lock:
             self._signals = sig

@@ -129,7 +129,10 @@ class Replica:
                 "infer": self._op_infer, "decode": self._op_decode,
                 "prefill": self._op_prefill,
                 "decode_from": self._op_decode_from,
-                "drain": self._op_drain}
+                "drain": self._op_drain,
+                "sessions": self._op_sessions,
+                "session_export": self._op_session_export,
+                "session_import": self._op_session_import}
 
     def _op_ping(self, meta, parts):
         return {"id": self.id, "role": self.role}, []
@@ -180,10 +183,30 @@ class Replica:
             timeout=meta.get("timeout", 5.0),
             trace_id=meta.get("trace_id"),
             tenant=meta.get("tenant", "default"),
-            priority=meta.get("priority"))
+            priority=meta.get("priority"),
+            session_id=meta.get("session_id"))
         outs = fut.result(timeout=meta.get("result_timeout", 60.0))
         ometa, oparts = encode_arrays([np.asarray(outs[0])])
         return {"arrays": ometa}, oparts
+
+    # -- parked-session migration (FLAGS_session_store) ----------------------
+    def _op_sessions(self, meta, parts):
+        store = getattr(self.server, "session_store", None)
+        return {"ids": [] if store is None else store.peek_ids()}, []
+
+    def _op_session_export(self, meta, parts):
+        store = getattr(self.server, "session_store", None)
+        blob = None if store is None \
+            else store.export_bytes(meta["session_id"])
+        if blob is None:
+            return {"found": False}, []
+        return {"found": True, "nbytes": len(blob)}, [blob]
+
+    def _op_session_import(self, meta, parts):
+        store = getattr(self.server, "session_store", None)
+        if store is None or not parts:
+            return {"session_id": None}, []
+        return {"session_id": store.import_bytes(bytes(parts[0]))}, []
 
     def _op_drain(self, meta, parts):
         """Graceful-retirement op: flip the server to stop-accepting

@@ -81,6 +81,11 @@ _STATS_POLL_ERRORS = _registry().counter(
     "decides death — but a replica whose stats are silently stale is "
     "visible here BEFORE the eviction verdict.",
     labels=("replica",))
+SESSION_MIGRATE = _registry().counter(
+    "session_migrate_total",
+    "Parked sessions moved between replicas through the router "
+    "(drain-time migration off a retiring replica; the affinity map "
+    "follows the session to its new owner).")
 
 
 class ReplicaHandle:
@@ -137,6 +142,19 @@ class ReplicaHandle:
         """Per-model serving stats of the replica (Server.stats())."""
         return {}
 
+    # parked-session migration surface (FLAGS_session_store); the base
+    # replies "no sessions" so session-less pools need no override
+    def session_ids(self) -> List[str]:
+        return []
+
+    def session_export(self, sid: str) -> Optional[bytes]:
+        """Serialize-and-remove one parked session (move semantics)."""
+        return None
+
+    def session_import(self, blob: bytes) -> Optional[str]:
+        """Ingest a migrated session; returns its id (None = stale)."""
+        return None
+
     def scrape(self, max_spans: Optional[int] = None) -> dict:
         """Observability pull (cluster/obs.py federation): the replica's
         registry dump, drained export-buffer spans + drop count, signal
@@ -167,11 +185,13 @@ class LocalReplica(ReplicaHandle):
         return [np.asarray(o) for o in fut.result(timeout=timeout)]
 
     def submit_decode(self, model, prompts, max_new=None, trace_id=None,
-                      timeout=60.0, tenant="default", priority=None):
+                      timeout=60.0, tenant="default", priority=None,
+                      session_id=None):
         fut = self.server.submit_decode(model, prompts,
                                         max_new_tokens=max_new,
                                         trace_id=trace_id,
-                                        tenant=tenant, priority=priority)
+                                        tenant=tenant, priority=priority,
+                                        session_id=session_id)
         return np.asarray(fut.result(timeout=timeout)[0])
 
     def drain(self, timeout: Optional[float] = None,
@@ -205,6 +225,18 @@ class LocalReplica(ReplicaHandle):
 
     def model_stats(self) -> dict:
         return self.server.stats()
+
+    def session_ids(self) -> List[str]:
+        store = getattr(self.server, "session_store", None)
+        return [] if store is None else store.peek_ids()
+
+    def session_export(self, sid: str) -> Optional[bytes]:
+        store = getattr(self.server, "session_store", None)
+        return None if store is None else store.export_bytes(sid)
+
+    def session_import(self, blob: bytes) -> Optional[str]:
+        store = getattr(self.server, "session_store", None)
+        return None if store is None else store.import_bytes(blob)
 
     def scrape(self, max_spans: Optional[int] = None) -> dict:
         """In-process scrape: same contract as the RPC op.  NOTE: local
@@ -244,13 +276,15 @@ class RemoteReplica(ReplicaHandle):
         return decode_arrays(meta["arrays"], rparts)
 
     def submit_decode(self, model, prompts, max_new=None, trace_id=None,
-                      timeout=60.0, tenant="default", priority=None):
+                      timeout=60.0, tenant="default", priority=None,
+                      session_id=None):
         pmeta, parts = encode_arrays([np.asarray(p) for p in prompts])
         meta, rparts = self._client.request(
             "decode", {"model": model, "prompts": pmeta,
                        "max_new": max_new, "trace_id": trace_id,
                        "result_timeout": timeout,
-                       "tenant": tenant, "priority": priority},
+                       "tenant": tenant, "priority": priority,
+                       "session_id": session_id},
             parts, timeout=timeout)
         return decode_arrays(meta["arrays"], rparts)[0]
 
@@ -290,6 +324,20 @@ class RemoteReplica(ReplicaHandle):
         meta, _ = self._client.request("stats", {}, timeout=10.0)
         return meta["stats"]
 
+    def session_ids(self) -> List[str]:
+        meta, _ = self._client.request("sessions", {}, timeout=10.0)
+        return list(meta.get("ids") or [])
+
+    def session_export(self, sid: str) -> Optional[bytes]:
+        meta, parts = self._client.request(
+            "session_export", {"session_id": str(sid)}, timeout=30.0)
+        return bytes(parts[0]) if meta.get("found") and parts else None
+
+    def session_import(self, blob: bytes) -> Optional[str]:
+        meta, _ = self._client.request("session_import", {},
+                                       [bytes(blob)], timeout=30.0)
+        return meta.get("session_id")
+
     def scrape(self, max_spans: Optional[int] = None) -> dict:
         meta, _ = self._client.request(
             "scrape", {"max_spans": max_spans}, timeout=10.0)
@@ -313,6 +361,11 @@ class Router:
                  watch: bool = True, dispatch_workers: int = 8):
         self._handles: Dict[str, ReplicaHandle] = {}
         self._lock = threading.Lock()
+        # session affinity (FLAGS_session_store): session_id -> the
+        # replica holding its parked KV planes.  Advisory — a missing or
+        # dead owner degrades to least-loaded dispatch and the turn
+        # re-prefills (bit-identical), never fails.
+        self._affinity: Dict[str, str] = {}
         self._store = store
         self._seen_seq = 0
         self._stale_after = float(
@@ -356,6 +409,7 @@ class Router:
             if h is None or not h.alive:
                 return False
             h.alive = False
+            self._drop_affinity_locked(str(replica_id))
         h.close()
         _EVICTIONS_TOTAL.inc()
         _REPLICAS_LIVE.set(self.replicas_live())
@@ -373,6 +427,7 @@ class Router:
         no eviction counter, no postmortem."""
         with self._lock:
             h = self._handles.pop(str(replica_id), None)
+            self._drop_affinity_locked(str(replica_id))
         if h is None:
             return False
         h.alive = False
@@ -382,6 +437,18 @@ class Router:
         _tracing.event("router_deregister", replica=str(replica_id),
                        reason=reason)
         return True
+
+    def _drop_affinity_locked(self, replica_id: str) -> None:
+        """Forget affinity to a removed replica — the sessions either
+        migrated (affinity rewritten before this) or died with it, and a
+        stale pointer would just cost one wasted preference."""
+        for sid in [s for s, r in self._affinity.items()
+                    if r == replica_id]:
+            del self._affinity[sid]
+
+    def session_affinity(self, session_id: str) -> Optional[str]:
+        with self._lock:
+            return self._affinity.get(str(session_id))
 
     def handles(self) -> List[ReplicaHandle]:
         with self._lock:
@@ -457,11 +524,14 @@ class Router:
             self._stop.wait(interval)
 
     # -- dispatch core -------------------------------------------------------
-    def _pick(self, op: str):
+    def _pick(self, op: str, prefer: Optional[str] = None):
         """(handle, wake_monotonic): the least-loaded live replica that
         serves ``op`` and is not backed off; handle=None with a wake
         time means every candidate is backing off; both None means no
-        live replica can ever serve the op."""
+        live replica can ever serve the op.  ``prefer`` (session
+        affinity) wins outright when that replica is a candidate —
+        restoring parked KV beats load-balance — and silently falls
+        back to least-loaded when it is dead or backed off."""
         now = time.monotonic()
         best, wake = None, None
         for h in self._alive():
@@ -471,18 +541,23 @@ class Router:
                 wake = h.backoff_until if wake is None \
                     else min(wake, h.backoff_until)
                 continue
+            if prefer is not None and h.id == prefer:
+                return h, None
             key = (h.inflight, h.queue_depth, h.dispatched)
             if best is None or key < (best.inflight, best.queue_depth,
                                       best.dispatched):
                 best = h
         return best, wake
 
-    def _dispatch(self, op: str, call, timeout: float, span=None):
+    def _dispatch(self, op: str, call, timeout: float, span=None,
+                  prefer: Optional[str] = None):
         """Retry loop: pick → call → (backoff | suspect | return)."""
         deadline = time.monotonic() + timeout
         last_err: Optional[Exception] = None
         while True:
-            h, wake = self._pick(op)
+            h, wake = self._pick(op, prefer=prefer)
+            prefer = None        # affinity is one preference, not a pin:
+            # a failed attempt on the owner retries least-loaded
             if h is None:
                 now = time.monotonic()
                 if wake is None or now >= deadline:
@@ -570,39 +645,59 @@ class Router:
     def submit_decode(self, model: str, prompts,
                       max_new_tokens: Optional[int] = None,
                       timeout: float = 60.0, tenant: str = "default",
-                      priority: Optional[int] = None) -> Future:
+                      priority: Optional[int] = None,
+                      session_id: Optional[str] = None) -> Future:
         """Decode through the cluster: full-decode replicas when the
         pools are unified; prefill-pool → KV handoff → decode-pool when
         disaggregated (mixed clusters prefer the disaggregated path
-        only when no 'both' replica is live)."""
+        only when no 'both' replica is live).  ``session_id`` routes the
+        turn to the replica holding the conversation's parked KV planes
+        (session affinity) and records the replica that served it."""
         return self._pool.submit(
             self._run_decode, model,
             [np.asarray(p) for p in prompts], max_new_tokens, timeout,
-            tenant, priority)
+            tenant, priority, session_id)
 
     def run_decode(self, model: str, prompts,
                    max_new_tokens: Optional[int] = None,
                    timeout: float = 60.0, tenant: str = "default",
-                   priority: Optional[int] = None):
+                   priority: Optional[int] = None,
+                   session_id: Optional[str] = None):
         return self._run_decode(model,
                                 [np.asarray(p) for p in prompts],
-                                max_new_tokens, timeout, tenant, priority)
+                                max_new_tokens, timeout, tenant,
+                                priority, session_id)
 
     def _run_decode(self, model, prompts, max_new, timeout,
-                    tenant="default", priority=None):
+                    tenant="default", priority=None, session_id=None):
         tr = _tracing.start_span("route", model=model, kind="decode")
         tid = getattr(tr, "trace_id", None)
+        served: List[str] = []
+
+        def _decode_call(h):
+            # forward session identity only when tagged, so replica
+            # handles that predate the session plane keep working for
+            # stateless traffic
+            extra = {} if session_id is None \
+                else {"session_id": session_id}
+            out = h.submit_decode(model, prompts, max_new=max_new,
+                                  trace_id=tid, timeout=timeout,
+                                  tenant=tenant, priority=priority,
+                                  **extra)
+            served.append(h.id)
+            return out
+
         try:
             if any(h.serves("decode") for h in self._alive()):
-                out = self._dispatch(
-                    "decode",
-                    lambda h: h.submit_decode(model, prompts,
-                                              max_new=max_new,
-                                              trace_id=tid,
-                                              timeout=timeout,
-                                              tenant=tenant,
-                                              priority=priority),
-                    timeout, span=tr)
+                prefer = None
+                if session_id is not None:
+                    with self._lock:
+                        prefer = self._affinity.get(str(session_id))
+                out = self._dispatch("decode", _decode_call, timeout,
+                                     span=tr, prefer=prefer)
+                if session_id is not None and served:
+                    with self._lock:
+                        self._affinity[str(session_id)] = served[-1]
             else:
                 handoff = self._dispatch(
                     "prefill",
@@ -622,6 +717,53 @@ class Router:
                 tr.set_attr(error=True)
                 _tracing.finish(tr)
             raise
+
+    # -- session migration (drain-time) --------------------------------------
+    def migrate_sessions_from(self, replica_id: str,
+                              target_id: Optional[str] = None) -> int:
+        """Move every parked session off ``replica_id`` (a drained
+        replica about to retire) into surviving decode replicas and
+        point the affinity map at the new owners.  Returns sessions
+        moved.  Fail-open per session: an export/import that raises
+        leaves that session behind — a shared spill directory still
+        recovers it, and without one the next turn falls back to a
+        plain (bit-identical) re-prefill."""
+        src = next((h for h in self.handles()
+                    if h.id == str(replica_id)), None)
+        if src is None:
+            return 0
+        candidates = [h for h in self._alive()
+                      if h.id != src.id and h.serves("decode")]
+        if target_id is not None:
+            candidates = [h for h in candidates
+                          if h.id == str(target_id)]
+        if not candidates:
+            return 0
+        try:
+            ids = src.session_ids()
+        except Exception:   # noqa: BLE001 — a dead source has nothing
+            return 0
+        moved = 0
+        for sid in ids:
+            dst = min(candidates, key=lambda h: (h.inflight,
+                                                 h.queue_depth,
+                                                 h.dispatched))
+            try:
+                blob = src.session_export(sid)
+                if blob is None:
+                    continue
+                got = dst.session_import(blob)
+            except Exception:   # noqa: BLE001 — per-session fail-open
+                continue
+            if got is not None:
+                with self._lock:
+                    self._affinity[str(got)] = dst.id
+                moved += 1
+                SESSION_MIGRATE.inc()
+        if moved:
+            _tracing.event("session_migrate", source=str(replica_id),
+                           moved=moved)
+        return moved
 
     # -- observability + lifecycle -------------------------------------------
     def attach_observer(self, observer):

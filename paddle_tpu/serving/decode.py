@@ -106,6 +106,9 @@ class DecodeRequest:
     # admission class (scheduler.RequestQueue): same contract as Request
     tenant: str = "default"
     priority: Optional[int] = None
+    # conversation identity (FLAGS_session_store): single-prompt requests
+    # only — the slot loop parks/restores the KV planes under this key
+    session_id: Optional[str] = None
 
 
 class _DecodeRuntime:
@@ -136,6 +139,10 @@ class _DecodeRuntime:
             int(_flags.flag("serving_metrics_window")))
         self.rate = RateMeter()
         self._mlock = threading.Lock()
+        # injected by the Server before warmup (FLAGS_session_store);
+        # the prefix cache is built per-runtime in _warmup_slots
+        self.session_store = None
+        self.prefix_cache = None
         self.counters = {"requests": 0, "completed": 0, "errors": 0,
                          "batches": 0, "rows": 0, "padded_rows": 0,
                          "steady_compiles": 0}
@@ -306,10 +313,25 @@ class _DecodeRuntime:
         eos = self.spec.eos_token_id
         self._audit_gate(self.gen.step_exec(S, C, eos), S, None)
         self._audit_gate(self.gen.chunk_exec(S, T, C), S, None)
+        if bool(_flags.flag("prefix_cache")):
+            import jax.tree_util as tu
+            from .cluster.handoff import _np_dtype
+            from .prefix_cache import PrefixCache
+            block_nbytes = sum(
+                int(np.prod(tuple(a.shape)))
+                * _np_dtype(str(a.dtype)).itemsize
+                for a in tu.tree_leaves(self.gen._block_avals(S, T, C)))
+            self.prefix_cache = PrefixCache(
+                T, block_nbytes,
+                hbm_budget_mb=float(_flags.flag("prefix_cache_hbm_mb")))
         self._loop = SlotLoop(self.gen, S, C, T, eos_token_id=eos,
-                              model=self.name)
+                              model=self.name,
+                              prefix_cache=self.prefix_cache,
+                              session_store=self.session_store)
         self._loop.submit(np.zeros((1,), np.int32), 1).result(timeout=600)
         self._loop.reset_stats()
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()   # drop the warm-up dummy's blocks
         self.admitted = True
 
     def warmup(self):
@@ -429,15 +451,39 @@ class _DecodeRuntime:
         if self._loop is not None:
             futs = []
             for r in batch.requests:
+                sid = getattr(r, "session_id", None)
+                snap = None
+                if sid is not None and self.session_store is not None:
+                    snap = self.session_store.take(sid)
+                    if snap is not None and snap.model != self.name:
+                        # a stale key collision across models: put the
+                        # snapshot back untouched and prefill plainly
+                        self.session_store.put(snap)
+                        snap = None
                 for p in r.prompts:
-                    futs.append(self._loop.submit(p, r.max_new))
+                    try:
+                        futs.append(self._loop.submit(
+                            p, r.max_new, session_id=sid, snapshot=snap))
+                    except (InvalidArgumentError, OutOfRangeError):
+                        # a malformed snapshot must not fail the turn —
+                        # fall back to the plain (bit-identical) prefill
+                        futs.append(self._loop.submit(
+                            p, r.max_new, session_id=sid))
+                    snap = None             # one snapshot, one restore
             out = np.zeros((batch.bucket, self.steps), np.int32)
             row = 0
             for r in batch.requests:
+                err = None
                 for _ in range(len(r.prompts)):
-                    got = futs[row].result(timeout=600)
-                    out[row, :got.size] = got
-                    row += 1
+                    try:
+                        got = futs[row].result(timeout=600)
+                        out[row, :got.size] = got
+                    except Exception as e:   # noqa: BLE001 — per-request
+                        err = e              # isolation: a parked row's
+                    row += 1                 # Unavailable must not fail
+                if err is not None:          # its batch-mates
+                    if not r.future.done():
+                        r.future.set_exception(err)
             return out
         prompts = [p for r in batch.requests for p in r.prompts]
         # pad rows up to the batch bucket with 1-token dummy prompts

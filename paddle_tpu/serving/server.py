@@ -502,7 +502,12 @@ class _Worker(threading.Thread):
             t_r0 = time.monotonic()
             off = 0
             for r in batch.requests:
-                r.future.set_result([toks[off:off + r.rows, :r.max_new]])
+                # a parked session's future was already failed
+                # (UnavailableError) by the slot loop's drain park —
+                # don't double-resolve it
+                if not r.future.done():
+                    r.future.set_result(
+                        [toks[off:off + r.rows, :r.max_new]])
                 rt.latency.observe(now - r.t_enqueue)
                 off += r.rows
             _trace_batch(batch, "reply", t_r0, time.monotonic())
@@ -675,6 +680,7 @@ class Server:
         self._draining = False
         self._warmup_marks: Dict[str, int] = {}
         self._tenant_policies: Dict[str, dict] = {}
+        self._session_store = None      # FLAGS_session_store, at start()
 
     def set_tenant_policy(self, tenant: str, max_pending: Optional[int]
                           = None, priority: Optional[int] = None) -> None:
@@ -761,6 +767,14 @@ class Server:
             raise PreconditionNotMetError("Server already started")
         if not self._specs:
             raise PreconditionNotMetError("no models registered")
+        if bool(_flags.flag("session_store")):
+            # one shared store per process: every slot-mode decode model
+            # parks into and restores from it (cluster migration moves
+            # sessions between these stores through the router)
+            from .sessions import SessionStore
+            self._session_store = SessionStore(
+                spill_dir=str(_flags.flag("session_store_dir")),
+                park_after_ms=int(_flags.flag("session_park_after_ms")))
         for spec in self._specs:
             if hasattr(spec, "make_runtime"):
                 rt = spec.make_runtime()
@@ -768,6 +782,9 @@ class Server:
                 rt = _DecodeRuntime(spec)
             else:
                 rt = _ModelRuntime(spec)
+            if self._session_store is not None \
+                    and hasattr(rt, "session_store"):
+                rt.session_store = self._session_store
             rt.load()
             rt.warmup()
             rt.rate.reset()              # QPS clock starts with traffic
@@ -878,19 +895,45 @@ class Server:
         self.request_drain()
         if not self._started or self._stopped:
             return {"drained": True, "pending": 0, "queue_depth": 0,
-                    "waited_s": 0.0}
+                    "waited_s": 0.0, "parked_sessions": 0}
+        # session-stateful drain (FLAGS_session_store): live slot-loop
+        # conversations PARK to the store instead of running their full
+        # token budget out — their futures fail retryably (Unavailable)
+        # and the router redispatches the turn to a surviving replica,
+        # which restores the snapshot and resumes bit-identically
+        parked = self.park_sessions(timeout_s=float(timeout_s))
         deadline = t0 + max(0.0, float(timeout_s))
         while True:
             pending = self.pending_requests()
             qdepth = self._queue.depth() if self._queue else 0
             if pending <= 0 and qdepth == 0:
                 return {"drained": True, "pending": 0, "queue_depth": 0,
-                        "waited_s": round(time.monotonic() - t0, 3)}
+                        "waited_s": round(time.monotonic() - t0, 3),
+                        "parked_sessions": parked}
             if time.monotonic() >= deadline:
                 return {"drained": False, "pending": int(pending),
                         "queue_depth": int(qdepth),
-                        "waited_s": round(time.monotonic() - t0, 3)}
+                        "waited_s": round(time.monotonic() - t0, 3),
+                        "parked_sessions": parked}
             time.sleep(min(0.02, max(0.001, timeout_s / 50.0)))
+
+    def park_sessions(self, timeout_s: float = 30.0) -> int:
+        """Park every live slot-loop conversation into the session store
+        (no-op without FLAGS_session_store); returns sessions parked."""
+        if self._session_store is None:
+            return 0
+        n = 0
+        for rt in self._models.values():
+            loop = getattr(rt, "_loop", None)
+            if loop is not None:
+                n += loop.park_sessions(timeout=timeout_s)
+        return n
+
+    @property
+    def session_store(self):
+        """The process-wide session store (None without
+        FLAGS_session_store) — the cluster replica's migration seat."""
+        return self._session_store
 
     def __enter__(self):
         if not self._started:
@@ -988,13 +1031,19 @@ class Server:
                       timeout: Optional[float] = 5.0,
                       trace_id: Optional[str] = None,
                       tenant: str = "default",
-                      priority: Optional[int] = None) -> Future:
+                      priority: Optional[int] = None,
+                      session_id: Optional[str] = None) -> Future:
         """Enqueue one decode request: ``prompts`` is a list of 1-D int
         token arrays (variable lengths — they left-pad to the prompt
         bucket at execution).  Resolves to ``[ids]`` where ids is an
         int32 array [len(prompts), max_new_tokens] of generated tokens.
         Rows of one request ride one batch; the continuous batcher packs
-        concurrent requests exactly like dense traffic."""
+        concurrent requests exactly like dense traffic.
+
+        ``session_id`` (FLAGS_session_store) names the conversation:
+        single-prompt requests only, with ``prompts[0]`` the FULL
+        transcript so far (history + new turn) — the slot loop restores
+        the parked KV planes and prefills only the uncached suffix."""
         if not self._started or self._stopped:
             raise PreconditionNotMetError(
                 "Server is not serving (start() it / already stopped)")
@@ -1010,10 +1059,16 @@ class Server:
                 "decode requests need role 'both', or route "
                 "prefill_handoff → decode_from_handoff across the pools")
         arrs, max_new = rt.validate(list(prompts), max_new_tokens)
+        if session_id is not None and len(arrs) != 1:
+            raise InvalidArgumentError(
+                f"session_id={session_id!r} requires exactly one prompt "
+                f"(one conversation = one row), got {len(arrs)}")
         rt.ladder.bucket_for(len(arrs))      # raises OutOfRange early
         req = DecodeRequest(model=model, prompts=arrs, rows=len(arrs),
                             max_new=max_new,
                             tenant=tenant, priority=priority,
+                            session_id=None if session_id is None
+                            else str(session_id),
                             trace=_tracing.start_span(
                                 "request", trace_id=trace_id, model=model,
                                 rows=len(arrs), kind="decode",
@@ -1141,6 +1196,12 @@ class Server:
                 s["slots_joined_total"] for s in slot)
             out["slots_retired_total"] = sum(
                 s["slots_retired_total"] for s in slot)
+            for k in ("prefix_cache_blocks", "prefix_cache_bytes"):
+                if any(k in s for s in slot):
+                    out[k] = sum(s.get(k, 0) for s in slot)
+        if self._session_store is not None:
+            out["sessions_parked"] = len(self._session_store)
+            out["session_store_bytes"] = self._session_store.nbytes()
         out["models"] = self.models()
         out["version"] = self.version
         out["draining"] = self._draining

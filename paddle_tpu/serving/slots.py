@@ -71,7 +71,7 @@ import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Any, List, Optional
 
 import numpy as np
 
@@ -88,17 +88,33 @@ _EMPTY, _PREFILL, _GEN = 0, 1, 2
 @dataclass
 class SlotRequest:
     """One row of slot-loop work: a prompt to continue by ``max_new``
-    tokens.  ``future`` resolves to int32 [max_new] generated ids."""
+    tokens.  ``future`` resolves to int32 [max_new] generated ids.
+
+    The restore fields are filled by ``SlotLoop.submit`` when a session
+    snapshot rides along: ``prompt`` then holds the VIRTUAL prompt (the
+    transcript a full re-prefill would run), ``preseed`` the tokens the
+    parked turn already emitted (they count against ``max_new`` and are
+    replayed into the result), ``planes``/``planes_len`` the host KV
+    pytree covering the leading ``planes_len`` transcript tokens, and
+    ``resume_logits``/``resume_cur`` the activation payload for the
+    no-suffix mid-generation resume (plain / speculative loop)."""
 
     prompt: np.ndarray
     max_new: int
     future: Future = field(default_factory=Future)
     t_submit: float = field(default_factory=time.monotonic)
+    session_id: Optional[str] = None
+    preseed: List[int] = field(default_factory=list)
+    planes: Any = None
+    planes_len: int = 0
+    resume_logits: Optional[np.ndarray] = None
+    resume_cur: Optional[int] = None
+    snapshot: Any = None                # original snapshot (re-park on abort)
 
 
 class _Slot:
     __slots__ = ("state", "req", "chunks", "next_chunk", "act",
-                 "start", "emitted", "_act_logits")
+                 "start", "emitted", "_act_logits", "restore", "pin")
 
     def __init__(self):
         self.state = _EMPTY
@@ -108,6 +124,8 @@ class _Slot:
         self.act = 0                    # planned activation position
         self.start = 0
         self.emitted: List[int] = []
+        self.restore: List[tuple] = []  # pending (block_tree, base) pushes
+        self.pin = None                 # prefix-cache pin held until pushed
 
 
 class SlotLoop:
@@ -120,7 +138,8 @@ class SlotLoop:
 
     def __init__(self, gen, slots: int, cache_len: int, chunk: int,
                  eos_token_id: Optional[int] = None,
-                 model: str = "decode"):
+                 model: str = "decode", prefix_cache=None,
+                 session_store=None):
         if slots < 1:
             raise InvalidArgumentError(
                 f"slot loop needs >= 1 slot, got {slots}")
@@ -137,6 +156,21 @@ class SlotLoop:
         # later dispatch is a plain __call__ — zero steady-state compiles
         self._step = gen.step_exec(self.S, self.C, eos_token_id)
         self._chunk = gen.chunk_exec(self.S, self.T, self.C)
+        # the KV reuse plane (prefix cache / session store): its three
+        # data movers compile HERE, with the step/chunk programs, so an
+        # arbitrary steady-state hit/miss/park/restore mix never
+        # compiles — and a loop with both features off compiles nothing
+        # extra (off-path = this one branch)
+        self._prefix = prefix_cache
+        self._sessions = session_store
+        self._push_block = self._pull_block = self._pull_row = None
+        if prefix_cache is not None or session_store is not None:
+            self._push_block = gen.push_block_exec(self.S, self.T, self.C)
+        if prefix_cache is not None:
+            self._pull_block = gen.pull_block_exec(self.S, self.T, self.C)
+        if session_store is not None:
+            self._pull_row = gen.pull_row_exec(self.S, self.C)
+        self._park_req = None           # (event, out) drain-park handshake
         self._cond = threading.Condition()
         self._pending: "deque[SlotRequest]" = deque()
         self._slots = [_Slot() for _ in range(self.S)]
@@ -147,7 +181,8 @@ class SlotLoop:
         self._reset_session()
         self.counters = {"joined": 0, "retired": 0, "steps": 0,
                          "chunks": 0, "session_resets": 0,
-                         "emitted_tokens": 0}
+                         "emitted_tokens": 0, "parked": 0, "restored": 0,
+                         "prefix_hit_tokens": 0, "restore_pushes": 0}
         # child instruments resolved once — .labels() is a registry
         # lookup and the step path is hot
         self._m_occ = SLOT_OCCUPANCY.labels(model=self._model)
@@ -182,20 +217,90 @@ class SlotLoop:
         n_chunks = -(-int(prompt_len) // self.T)
         return n_chunks * self.T + int(max_new) + self._gamma
 
+    def _min_need(self, req: "SlotRequest") -> int:
+        """Minimum ring columns ``req`` can ever consume (admitted at
+        ``pos = 0``): the plane-restore path needs only the transcript
+        length itself (restored columns are exact, never chunk-padded),
+        the plain path the padded chunk span."""
+        budget = req.max_new - len(req.preseed)
+        if req.planes_len >= self.T:
+            return req.prompt.size + budget + self._gamma
+        return self._need(req.prompt.size, budget)
+
+    def _prepare_restore(self, req: "SlotRequest", snap) -> None:
+        """Fold a session snapshot into the request.  Any mismatch —
+        transcript not a prefix of the prompt, wrong loop flavor, wrong
+        KV storage dtype, missing or sub-chunk planes — quietly degrades
+        (first to plane-less restore, then to a plain submit), which is
+        always bit-identical to the full re-prefill; a snapshot can make
+        the turn cheaper, never wrong."""
+        p = req.prompt
+        toks = np.asarray(snap.tokens, np.int32)
+        preseed: List[int] = []
+        if snap.remaining > 0:
+            # mid-generation park (drain): the client redispatched the
+            # ORIGINAL request; the transcript extends its prompt by the
+            # tokens already emitted — resume, replaying those
+            if toks.size < p.size or toks.size != p.size + len(snap.emitted) \
+                    or not np.array_equal(toks[:p.size], p):
+                return
+            if len(snap.emitted) >= req.max_new:
+                preseed = list(snap.emitted)[:req.max_new]
+            else:
+                preseed = list(snap.emitted)
+            req.prompt = toks
+            req.preseed = preseed
+        else:
+            # completed turn: the follow-up prompt must extend the
+            # transcript (history ++ new turn), leaving a real suffix
+            if toks.size >= p.size \
+                    or not np.array_equal(p[:toks.size], toks):
+                return
+        req.snapshot = snap
+        planes_ok = (snap.planes is not None
+                     and toks.size >= self.T
+                     and bool(snap.spec) == self._spec
+                     and snap.kv_dtype == self._kv_dtype())
+        if not planes_ok:
+            return                      # plane-less: plain chunks, bit-exact
+        if snap.remaining > 0:
+            if self._spec and snap.cur is None:
+                return
+            if not self._spec and snap.logits is None:
+                return
+            req.resume_logits = None if snap.logits is None \
+                else np.asarray(snap.logits, np.float32).reshape(-1)
+            req.resume_cur = None if snap.cur is None else int(snap.cur)
+        req.planes = snap.planes
+        req.planes_len = int(toks.size)
+
+    def _kv_dtype(self) -> str:
+        from ..framework import flags as _flags
+        return str(_flags.flag("kv_cache_dtype")).lower()
+
     # -- producer ------------------------------------------------------------
-    def submit(self, prompt, max_new: int) -> Future:
+    def submit(self, prompt, max_new: int, session_id: Optional[str] = None,
+               snapshot=None) -> Future:
         p = np.asarray(prompt).reshape(-1).astype(np.int32)
         if p.size == 0:
             raise InvalidArgumentError("empty prompt (0 tokens)")
         mn = int(max_new)
         if mn < 1:
             raise InvalidArgumentError("max_new must be >= 1")
-        if self._need(p.size, mn) > self.C:
+        req = SlotRequest(prompt=p, max_new=mn, session_id=session_id)
+        if snapshot is not None:
+            self._prepare_restore(req, snapshot)
+        if len(req.preseed) >= mn:
+            # the parked turn already emitted the whole budget — resolve
+            # without touching a slot (deterministic replay)
+            req.future.set_result(
+                np.asarray(req.preseed[:mn], np.int32))
+            return req.future
+        if self._min_need(req) > self.C:
             raise OutOfRangeError(
                 f"prompt of {p.size} tokens + max_new {mn} can never fit "
-                f"the slot cache (need {self._need(p.size, mn)} columns, "
+                f"the slot cache (need {self._min_need(req)} columns, "
                 f"C={self.C}, chunk={self.T}, gamma={self._gamma})")
-        req = SlotRequest(prompt=p, max_new=mn)
         with self._cond:
             if self._closed:
                 raise UnavailableError("slot loop is closed")
@@ -230,13 +335,25 @@ class SlotLoop:
                            and all(s.state == _EMPTY
                                    for s in self._slots)):
                         if self._closed:
+                            if self._park_req is not None:
+                                self._park_req[0].set()
+                                self._park_req = None
                             return
+                        if self._park_req is not None:
+                            # nothing live to park — ack the handshake
+                            # so a drain never waits on an idle loop
+                            self._park_req[0].set()
+                            self._park_req = None
                         self._cond.wait(0.05)
                     if self._closed and not self._any_live():
                         self._fail_pending(UnavailableError(
                             "slot loop closed before this request was "
                             "admitted"))
                         return
+                    park = self._park_req
+                    self._park_req = None
+                    if park is not None:
+                        self._do_park(park)
                     self._admit()
                 self._dispatch_chunks()
                 self._activate()
@@ -247,6 +364,9 @@ class SlotLoop:
         except BaseException as e:   # noqa: BLE001 — fail rows, not host
             with self._cond:
                 self._dead = e
+                if self._park_req is not None:
+                    self._park_req[0].set()
+                    self._park_req = None
                 for s in self._slots:
                     if s.req is not None and not s.req.future.done():
                         s.req.future.set_exception(e)
@@ -273,6 +393,27 @@ class SlotLoop:
         n_chunks = -(-int(prompt_len) // self.T)
         return max(n_chunks * self.T, self.pos + n_chunks)
 
+    def _plan_act_req(self, req: "SlotRequest") -> int:
+        """Planned activation for a request admitted NOW, by mode.  A
+        plane-restore row prefills only its uncached suffix (``n_s``
+        chunks; zero for a mid-generation resume), floored at the
+        transcript length so the restored block stays at columns >= 0
+        — restored columns are exact, never chunk-padded."""
+        ltot = req.prompt.size
+        if req.planes_len >= self.T:
+            n_s = self._suffix_chunks(req)
+            return max(ltot, self.pos + n_s)
+        return self._plan_act(ltot)
+
+    def _suffix_chunks(self, req: "SlotRequest") -> int:
+        ls = req.prompt.size - (req.planes_len // self.T) * self.T \
+            if req.planes_len < req.prompt.size else 0
+        return -(-ls // self.T)
+
+    def _host_block(self, planes, lo, hi):
+        import jax.tree_util as tu
+        return tu.tree_map(lambda p: p[:, :, lo:hi, :], planes)
+
     def _admit(self):
         """Move pending FIFO heads into empty slots at the current token
         boundary.  Strict FIFO: if the head does not fit the remaining
@@ -282,8 +423,8 @@ class SlotLoop:
             if not self._pending or slot.state != _EMPTY:
                 continue
             head = self._pending[0]
-            if self._plan_act(head.prompt.size) + head.max_new \
-                    + self._gamma > self.C:
+            if self._plan_act_req(head) + head.max_new \
+                    - len(head.preseed) + self._gamma > self.C:
                 if all(s.state == _EMPTY for s in self._slots) \
                         and self.pos > 0:
                     # whole loop idle: restart the ring session (windows
@@ -293,21 +434,84 @@ class SlotLoop:
                 else:
                     break                        # drain first
             self._pending.popleft()
-            p = head.prompt
-            n_chunks = -(-p.size // self.T)
-            pb = n_chunks * self.T
-            padded = np.zeros((pb,), np.int32)
-            padded[pb - p.size:] = p
-            slot.req = head
-            slot.chunks = [padded[k * self.T:(k + 1) * self.T]
-                           for k in range(n_chunks)]
-            slot.next_chunk = 0
-            slot.act = self._plan_act(p.size)
-            slot.start = slot.act - p.size
-            slot.emitted = []
-            slot.state = _PREFILL
-            self.counters["joined"] += 1
-            self._m_joined.inc()
+            self._install(slot, head)
+
+    def _install(self, slot: "_Slot", head: "SlotRequest"):
+        """Stage one admitted request into a slot row: pick the restore
+        source (session planes > prefix-cache hit > none), queue the
+        restore block pushes, and plan the suffix chunks.  All three
+        paths meet the same activation at ``slot.act`` and are
+        bit-identical to the plain full prefill of ``head.prompt``."""
+        p = head.prompt
+        lp = int(p.size)
+        slot.restore = []
+        slot.pin = None
+        if head.planes_len >= self.T:
+            # -- session-snapshot restore (host planes) -------------------
+            lc = head.planes_len
+            m = lc // self.T
+            n_s = self._suffix_chunks(head)
+            slot.act = max(lp, self.pos + n_s)
+            slot.start = slot.act - lp
+            for j in range(m):
+                slot.restore.append(
+                    (self._host_block(head.planes, j * self.T,
+                                      (j + 1) * self.T),
+                     slot.start + j * self.T))
+            if lc % self.T and lc == lp:
+                # mid-generation resume: no suffix chunk will recompute
+                # the partial tail block — restore it as a T-wide
+                # overlap slice ending exactly at the transcript edge
+                slot.restore.append(
+                    (self._host_block(head.planes, lc - self.T, lc),
+                     slot.start + lc - self.T))
+            suffix = p[lp - n_s * self.T:] if n_s else p[:0]
+            slot.chunks = [suffix[k * self.T:(k + 1) * self.T]
+                           for k in range(n_s)]
+            self.counters["restored"] += 1
+        else:
+            blocks, pin = ([], None)
+            if self._prefix is not None and lp > self.T:
+                # clamp so >= 1 true suffix token remains: the final
+                # chunk's last column must be the last prompt token (it
+                # produces the activation logits)
+                blocks, pin = self._prefix.lookup(
+                    p.tolist(), max_blocks=(lp - 1) // self.T)
+            if blocks:
+                # -- prefix-cache hit (device blocks) ---------------------
+                lhit = len(blocks) * self.T
+                ls = lp - lhit
+                n_s = -(-ls // self.T)
+                slot.act = max(lp, self.pos + n_s)
+                slot.start = slot.act - lp
+                slot.restore = [(b, slot.start + j * self.T)
+                                for j, b in enumerate(blocks)]
+                slot.pin = pin
+                # overlap-repeat: the first suffix chunk re-feeds the
+                # last n_s*T - ls cached tokens (recomputed K/V is
+                # bit-identical, so rewriting restored columns is free)
+                suffix = p[lp - n_s * self.T:]
+                slot.chunks = [suffix[k * self.T:(k + 1) * self.T]
+                               for k in range(n_s)]
+                self.counters["prefix_hit_tokens"] += lhit
+            else:
+                if pin:
+                    self._prefix.release(pin)
+                # -- plain path: full left-padded chunked prefill ---------
+                n_chunks = -(-lp // self.T)
+                pb = n_chunks * self.T
+                padded = np.zeros((pb,), np.int32)
+                padded[pb - lp:] = p
+                slot.chunks = [padded[k * self.T:(k + 1) * self.T]
+                               for k in range(n_chunks)]
+                slot.act = self._plan_act(lp)
+                slot.start = slot.act - lp
+        slot.req = head
+        slot.next_chunk = 0
+        slot.emitted = list(head.preseed)
+        slot.state = _PREFILL
+        self.counters["joined"] += 1
+        self._m_joined.inc()
 
     # -- chunked prefill -----------------------------------------------------
     def _dispatch_chunks(self):
@@ -329,6 +533,13 @@ class SlotLoop:
         from the garbage frontier)."""
         for i, slot in enumerate(self._slots):
             if slot.state != _PREFILL:
+                continue
+            self._push_restores(i, slot)
+            if slot.restore:
+                # chunks READ restored columns through attention — hold
+                # them until every pending push has dispatched.  Never
+                # starves: all restore bases are push-eligible by the
+                # first chunk's iteration (Ls >= n_s - 1, see _install)
                 continue
             n = len(slot.chunks)
             while (slot.next_chunk < n
@@ -353,11 +564,29 @@ class SlotLoop:
                     # reused the output buffer a zero-copy view aliases.
                     slot._act_logits = np.array(logits, np.float32)
 
+    def _push_restores(self, i: int, slot: "_Slot"):
+        """Dispatch every push-eligible restore block of one row.  A
+        block ``[base, base+T)`` is eligible once ``base + T <= pos``:
+        every later step writes columns ``>= pos`` (plain and
+        speculative alike), so the pushed columns can never be garbaged
+        by the dead-column discipline again.  The prefix-cache pin
+        releases when the last block is in flight — from then on the
+        restored columns live in the row, not the trie."""
+        while slot.restore and slot.restore[0][1] + self.T <= self.pos:
+            block, base = slot.restore.pop(0)
+            self._cache = self._push_block(
+                self._cache, block, np.int32(i), np.int32(base))
+            self.counters["restore_pushes"] += 1
+        if not slot.restore and slot.pin is not None:
+            self._prefix.release(slot.pin)
+            slot.pin = None
+
     # -- activation ----------------------------------------------------------
     def _activate(self):
         for i, slot in enumerate(self._slots):
             if slot.state != _PREFILL \
                     or slot.next_chunk < len(slot.chunks) \
+                    or slot.restore \
                     or self.pos != slot.act:
                 continue
             # copy-on-write: these vectors were handed to earlier
@@ -369,17 +598,29 @@ class SlotLoop:
             self._finished[i] = False
             self._active = self._active.copy()
             self._active[i] = True
-            act = slot._act_logits
-            if self._spec:
+            if not slot.chunks:
+                # mid-generation resume: no suffix chunk produced the
+                # activation logits — the snapshot carried the payload
+                # (the exact values the pre-park loop held for this row)
+                if self._spec:
+                    self._cur = self._cur.copy()
+                    self._cur[i] = np.int32(slot.req.resume_cur)
+                else:
+                    lg = np.array(self._logits)
+                    lg[i] = slot.req.resume_logits
+                    self._logits = lg
+            elif self._spec:
                 # first committed token = target argmax over the final
                 # chunk's logits (the joint-prefill cur0 computation)
+                act = slot._act_logits
                 self._cur = self._cur.copy()
                 self._cur[i] = np.int32(np.argmax(act))
             else:
                 lg = np.array(self._logits)
-                lg[i] = act
+                lg[i] = slot._act_logits
                 self._logits = lg
             slot.state = _GEN
+            self._publish_prefix(i, slot)
 
     def _fast_forward(self):
         """No generating rows: the position counter is host state, so
@@ -453,11 +694,64 @@ class SlotLoop:
         slot.emitted.extend(toks[:take])
         self.counters["emitted_tokens"] += min(len(toks), take)
 
+    def _publish_prefix(self, i: int, slot: "_Slot"):
+        """Publish the activated row's prompt blocks into the prefix
+        trie.  Dedup lives in the trie — the pull dispatches run only
+        for blocks not already cached, so a hot shared prefix is pulled
+        once and every later activation is pure bookkeeping.  Dispatch
+        ordering makes the pulled copy immune to the row's later column
+        writes (donation creates fresh buffers; the pull reads the
+        pre-donation value)."""
+        if self._prefix is None:
+            return
+        slot_start = slot.start
+        self._prefix.publish(
+            slot.req.prompt.tolist(),
+            lambda j: self._pull_block(self._cache, np.int32(i),
+                                       np.int32(slot_start + j * self.T)))
+
+    def _park(self, i: int, slot: "_Slot", remaining: int):
+        """Snapshot one session row into the store: one full-width row
+        pull, host-sliced to the transcript's validity window (relative
+        positions ``[0, Lc)``), plus the resume payload.  Called at
+        turn-retire (remaining == 0: the follow-up turn restores instead
+        of re-prefilling history) and at drain-park (remaining > 0)."""
+        from .sessions import SessionSnapshot
+        req = slot.req
+        new = slot.emitted[len(req.preseed):]
+        tokens = req.prompt.tolist() + [int(t) for t in new]
+        lc = len(tokens)
+        planes = None
+        if self._pull_row is not None and lc >= self.T:
+            import jax.tree_util as tu
+            row = self._pull_row(self._cache, np.int32(i))
+            planes = tu.tree_map(
+                lambda p: np.asarray(p)[:, :, slot.start:slot.start + lc,
+                                        :].copy(), row)
+        logits = None
+        cur = None
+        if remaining > 0:
+            if self._spec:
+                cur = int(self._cur[i])
+            else:
+                logits = np.array(self._logits[i], np.float32)
+        self._sessions.put(SessionSnapshot(
+            session_id=req.session_id, model=self._model, tokens=tokens,
+            remaining=int(remaining), emitted=[int(t) for t in slot.emitted],
+            planes=planes, logits=logits, cur=cur,
+            kv_dtype=self._kv_dtype(), spec=self._spec))
+        self.counters["parked"] += 1
+
     def _retire(self, i):
         slot = self._slots[i]
         req = slot.req
         out = np.full((req.max_new,), self._end, np.int32)
         out[:len(slot.emitted)] = slot.emitted
+        if req.session_id is not None and self._sessions is not None:
+            # park BEFORE the future resolves and the slot frees: a new
+            # admit could reuse this row and overwrite the columns the
+            # snapshot needs (its padded block may start below pos)
+            self._park(i, slot, remaining=0)
         # eos freeze: every position after finish reads eos, exactly the
         # scanned decode's padding — retiring early never changes bytes
         req.future.set_result(out)
@@ -473,6 +767,84 @@ class SlotLoop:
             self._cur[i] = 0
         self.counters["retired"] += 1
         self._m_retired.inc()
+
+    # -- drain-time parking --------------------------------------------------
+    def park_sessions(self, timeout: float = 30.0) -> int:
+        """Park every session-tagged row and pending request (the
+        graceful-drain fast path: a conversation leaves as a snapshot in
+        milliseconds instead of decoding to completion).  Generating
+        rows snapshot mid-stream (``remaining > 0``) and their futures
+        fail with a retryable UnavailableError — the router backs this
+        replica off and redispatches the turn, which resumes from the
+        snapshot (shared spill dir) or re-prefills (bit-identical
+        either way).  Non-session rows keep decoding normally.  Thread-
+        safe; the driver thread does the actual device pulls (it owns
+        every dispatch).  Returns the number of sessions parked."""
+        if self._sessions is None:
+            return 0
+        evt = threading.Event()
+        out = [0]
+        with self._cond:
+            if self._dead is not None or self._thread is None \
+                    or not self._any_live():
+                return 0
+            self._park_req = (evt, out)
+            self._cond.notify_all()
+        evt.wait(timeout)
+        return out[0]
+
+    def _do_park(self, park):
+        """Driver-thread half of :meth:`park_sessions` (called with the
+        condition held, between dispatch rounds — no dispatch races)."""
+        evt, out = park
+        try:
+            exc = UnavailableError(
+                "session parked for drain; redispatch to another "
+                "replica", retry_after_s=0.05)
+            for i, slot in enumerate(self._slots):
+                if slot.req is None or slot.req.session_id is None:
+                    continue
+                if slot.state == _GEN:
+                    self._park(i, slot,
+                               remaining=slot.req.max_new
+                               - len(slot.emitted))
+                    out[0] += 1
+                elif slot.state == _PREFILL:
+                    # nothing committed yet: put the original snapshot
+                    # back (if one rode in) and let the redispatched
+                    # turn restore or re-prefill from scratch
+                    if slot.req.snapshot is not None:
+                        self._sessions.put(slot.req.snapshot)
+                    if slot.pin is not None:
+                        self._prefix.release(slot.pin)
+                        slot.pin = None
+                    out[0] += 1
+                if not slot.req.future.done():
+                    slot.req.future.set_exception(exc)
+                slot.state, slot.req = _EMPTY, None
+                slot.emitted = []
+                slot.restore = []
+                self._finished = self._finished.copy()
+                self._finished[i] = True
+                self._active = self._active.copy()
+                self._active[i] = False
+                if self._spec:
+                    self._cur = self._cur.copy()
+                    self._cur[i] = 0
+            keep: "deque[SlotRequest]" = deque()
+            while self._pending:
+                r = self._pending.popleft()
+                if r.session_id is not None:
+                    if r.snapshot is not None:
+                        self._sessions.put(r.snapshot)
+                    if not r.future.done():
+                        r.future.set_exception(exc)
+                    out[0] += 1
+                else:
+                    keep.append(r)
+            self._pending = keep
+        finally:
+            evt.set()
 
     def reset_stats(self):
         """Zero the loop-local accounting (the runtime calls this right
@@ -496,11 +868,18 @@ class SlotLoop:
             c = dict(self.counters)
             pending = len(self._pending)
             occ = self._occupancy
-        return {"decode_slot_occupancy_ratio": round(occ, 4),
-                "slots_joined_total": c["joined"],
-                "slots_retired_total": c["retired"],
-                "slot_steps_total": c["steps"],
-                "slot_pending": pending}
+        out = {"decode_slot_occupancy_ratio": round(occ, 4),
+               "slots_joined_total": c["joined"],
+               "slots_retired_total": c["retired"],
+               "slot_steps_total": c["steps"],
+               "slot_pending": pending}
+        if self._sessions is not None:
+            out["sessions_parked"] = len(self._sessions)
+            out["session_store_bytes"] = self._sessions.nbytes()
+        if self._prefix is not None:
+            out["prefix_cache_blocks"] = len(self._prefix)
+            out["prefix_cache_bytes"] = self._prefix.nbytes()
+        return out
 
     def stats(self) -> dict:
         with self._cond:

@@ -399,6 +399,101 @@ class Generator:
         return [tuple(jax.ShapeDtypeStruct(p.shape, p.dtype) for p in c)
                 for c in raw]
 
+    def slot_cache_avals_all(self, S, C):
+        """Abstract values of the FULL slot-cache tree the step program
+        donates — every plane the KV data movers (pull/push below) must
+        cover.  The speculative subclass widens this to its
+        (target, draft) cache pair."""
+        return self._slot_cache_avals(S, C)
+
+    def _block_avals(self, S, T, C):
+        """Avals of one T-column single-row block of the slot cache:
+        every plane is 4-D with the column dim at axis 2 (bf16 k/v and
+        int8 k/v + f32 scales alike), so a block is the same tree with
+        shape (1, heads, T, head_dim-or-1)."""
+        return jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(
+                (1, p.shape[1], T, p.shape[3]), p.dtype),
+            self.slot_cache_avals_all(S, C))
+
+    # -- KV data movers (prefix/session cache, serving/prefix_cache.py +
+    #    serving/sessions.py): pure cache-tree slicing programs, compiled
+    #    once at SlotLoop construction like the step/chunk executables --
+    def pull_block_exec(self, S, T, C):
+        """AOT read of one T-column block of one slot row, every plane
+        (ledger kind ``kv_pull_block``): ``(cache, rowidx, base) ->
+        block tree``.  Read-only — the cache is NOT donated, so the live
+        session planes stay valid; the returned block is the device
+        segment the prefix cache publishes."""
+        if self._mesh is not None:
+            raise InvalidArgumentError(
+                "the prefix/session KV cache runs per-replica unsharded "
+                "(FLAGS_decode_slots) — drop the mesh")
+        key = self._key("pull_block", S, T, C, None, None)
+
+        def pull(cache, rowidx, base):
+            zero = jnp.int32(0)
+            return jax.tree_util.tree_map(
+                lambda p: lax.dynamic_slice(
+                    p, (rowidx, zero, base, zero),
+                    (1, p.shape[1], T, p.shape[3])), cache)
+
+        avals = (self.slot_cache_avals_all(S, C),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32))
+        return self._compile_data(key, "kv_pull_block", pull, avals,
+                                  {"slots": S, "chunk": T, "cache": C})
+
+    def push_block_exec(self, S, T, C):
+        """AOT write of one T-column block into one slot row, every
+        plane (ledger kind ``kv_push_block``): ``(cache, block, rowidx,
+        base) -> cache``.  The cache is donated exactly like the step
+        program's, so a restore is an in-place column write, not a
+        full-plane copy; the block argument is not donated and stays
+        valid (a pinned prefix block can restore into many rows)."""
+        if self._mesh is not None:
+            raise InvalidArgumentError(
+                "the prefix/session KV cache runs per-replica unsharded "
+                "(FLAGS_decode_slots) — drop the mesh")
+        key = self._key("push_block", S, T, C, None, None)
+
+        def push(cache, block, rowidx, base):
+            zero = jnp.int32(0)
+            return jax.tree_util.tree_map(
+                lambda p, b: lax.dynamic_update_slice(
+                    p, b, (rowidx, zero, base, zero)), cache, block)
+
+        avals = (self.slot_cache_avals_all(S, C),
+                 self._block_avals(S, T, C),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32))
+        return self._compile_data(key, "kv_push_block", push, avals,
+                                  {"slots": S, "chunk": T, "cache": C},
+                                  donate_argnums=(0,))
+
+    def pull_row_exec(self, S, C):
+        """AOT read of one slot row's FULL-width planes (ledger kind
+        ``kv_pull_row``): ``(cache, rowidx) -> row tree``.  One dispatch
+        per session park — the host slices the validity window
+        ``[start, pos)`` out of the fetched row."""
+        if self._mesh is not None:
+            raise InvalidArgumentError(
+                "the prefix/session KV cache runs per-replica unsharded "
+                "(FLAGS_decode_slots) — drop the mesh")
+        key = self._key("pull_row", S, None, C, None, None)
+
+        def pull(cache, rowidx):
+            zero = jnp.int32(0)
+            return jax.tree_util.tree_map(
+                lambda p: lax.dynamic_slice(
+                    p, (rowidx, zero, zero, zero),
+                    (1,) + tuple(p.shape[1:])), cache)
+
+        avals = (self.slot_cache_avals_all(S, C),
+                 jax.ShapeDtypeStruct((), jnp.int32))
+        return self._compile_data(key, "kv_pull_row", pull, avals,
+                                  {"slots": S, "cache": C})
+
     def init_slot_cache(self, S, C):
         """Zero device planes for a fresh slot session — never compiled
         as a program of its own (validity windows make the init values
@@ -484,6 +579,28 @@ class Generator:
         ex, _loaded = _pcache.load_or_compile(
             lambda: jax.jit(fn, **jit_kw).lower(*self._state_avals(),
                                                 *arg_avals).compile(),
+            site=self._site, kind=kind, key=key,
+            extra_key=self._program_identity(), extra=extra)
+        self._execs[key] = ex
+        return ex
+
+    def _compile_data(self, key, kind, fn, arg_avals, extra,
+                      donate_argnums=None):
+        """`_compile` for pure data-mover programs (the KV pull/push
+        executables): no model-state avals are prepended, so the program
+        is a function of the cache tree alone and its persistent-cache
+        identity is still keyed on `_program_identity()` (the cache
+        layout derives from the architecture)."""
+        ex = self._execs.get(key)
+        if ex is not None:
+            _ledger.record_cache_hit(self._site)
+            return ex
+        from ..jit import persistent_cache as _pcache
+        jit_kw = {}
+        if donate_argnums is not None:
+            jit_kw["donate_argnums"] = donate_argnums
+        ex, _loaded = _pcache.load_or_compile(
+            lambda: jax.jit(fn, **jit_kw).lower(*arg_avals).compile(),
             site=self._site, kind=kind, key=key,
             extra_key=self._program_identity(), extra=extra)
         self._execs[key] = ex

@@ -403,6 +403,13 @@ class SpeculativeGenerator(_Generator):
         return [tuple(jax.ShapeDtypeStruct(p.shape, p.dtype) for p in c)
                 for c in raw]
 
+    def slot_cache_avals_all(self, S, C):
+        """The speculative step donates the (target, draft) cache PAIR —
+        the KV data movers must pull/push both, or a restored session
+        would decode against a stale draft cache and break acceptance."""
+        return (self._slot_cache_avals(S, C),
+                self._slot_draft_cache_avals(S, C))
+
     def init_slot_cache(self, S, C):
         """Zero (target, draft) cache pair for a fresh slot session."""
         t = super().init_slot_cache(S, C)

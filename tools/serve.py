@@ -170,6 +170,19 @@ def _replica_child(cfg_path):
     from paddle_tpu.serving.cluster import replica_main
     set_flags({"FLAGS_serving_role": cfg.get("role", "both"),
                "FLAGS_router_heartbeat_s": float(cfg["heartbeat_s"])})
+    if cfg.get("session_store"):
+        # stateful replica: slot-loop decode + prefix cache + parked-
+        # session store.  The spill dir is SHARED across the fleet —
+        # that is what makes the SIGKILL drill stateful: a survivor
+        # restores the victim's parked conversations from disk.
+        from paddle_tpu.framework.flags import flag as _flag
+        sess = {"FLAGS_session_store": True,
+                "FLAGS_session_store_dir":
+                    cfg.get("session_store_dir") or "",
+                "FLAGS_prefix_cache": True}
+        if not int(_flag("decode_slots")):
+            sess["FLAGS_decode_slots"] = 4
+        set_flags(sess)
     if cfg.get("cache_dir"):
         set_flags({"FLAGS_executable_cache": "readwrite",
                    "FLAGS_executable_cache_dir": cfg["cache_dir"]})
@@ -257,8 +270,13 @@ def _router_main(args):
         report["flight_dir"] = args.flight_dir
     store = TCPStore("127.0.0.1", 0, is_master=True)
     children, router = [], None
-    obs = cluster_metrics_srv = None
+    obs = cluster_metrics_srv = sess_traffic = None
     cfg_dir = tempfile.mkdtemp(prefix="serve_router_")
+    sess_dir = ""
+    if args.sessions:
+        sess_dir = os.path.join(cfg_dir, "sessions")
+        os.makedirs(sess_dir, exist_ok=True)
+        report["sessions_dir"] = sess_dir
     try:
         for i in range(n):
             role = "both"
@@ -266,6 +284,8 @@ def _router_main(args):
                 # alternate so both pools exist at every cluster size
                 role = "prefill" if i % 2 == 0 else "decode"
             cfg = {"id": f"replica{i}", "role": role, "seed": args.seed,
+                   "session_store": bool(args.sessions),
+                   "session_store_dir": sess_dir,
                    "models": names, "decode": bool(args.decode),
                    "buckets": list(buckets),
                    "seq_buckets": list(seq_buckets),
@@ -320,6 +340,14 @@ def _router_main(args):
 
         model_meta = {name: ZOO[name]() for name in names}
         errors = []
+        if args.decode and args.sessions:
+            # stateful leg rides ALONGSIDE the one-shot traffic (mixed
+            # workload); with --kill-one the SIGKILL lands mid-turn and
+            # the gate below demands zero lost sessions anyway
+            sess_traffic = _SessionTraffic(
+                router, "gpt_decode", seq_buckets, args.max_new,
+                clients=max(2, args.clients // 2),
+                seed=args.seed + 31).start()
         if args.decode:
             errors += _decode_traffic(
                 router, "gpt_decode", args.duration, args.clients,
@@ -334,6 +362,10 @@ def _router_main(args):
         report["traffic_errors"] = errors
         if errors:
             rc = 1
+        if sess_traffic is not None:
+            sess_traffic.stop()
+            report["sessions"] = sess_traffic.report()
+            rc = _gate_sessions(report, args, rc)
 
         if args.kill_one:
             # the dead replica must be EVICTED by heartbeat, traffic
@@ -408,6 +440,8 @@ def _router_main(args):
             report["metrics_textfile"] = \
                 obs.write_textfile(args.metrics_textfile)
     finally:
+        if sess_traffic is not None:
+            sess_traffic.stop()
         if cluster_metrics_srv is not None:
             cluster_metrics_srv.close()
         if obs is not None:
@@ -543,6 +577,174 @@ class _BgTraffic:
             np.asarray(lats) * 1e3, 99)), 3)
 
 
+class _SessionTraffic:
+    """Multi-turn conversation clients (the --sessions traffic mode).
+
+    Each client keeps extending conversations: a turn submits the FULL
+    transcript so far plus a fresh user suffix under a stable
+    ``session_id``, appends whatever the server generated, and comes
+    back for the next turn until the transcript no longer fits the
+    prompt ladder (then that conversation ends and a new one starts).
+    Every ``verify_every``-th follow-up turn the same transcript is
+    ALSO submitted WITHOUT a session_id — the stateless prefill is the
+    bit-exactness oracle: a session restore that is not bit-identical
+    to plain serving is an error, not a slowdown.
+
+    A turn that bounces with a retryable UnavailableError (drain park,
+    replica death mid-flight) retries until the turn deadline; a turn
+    that never lands counts as a LOST session — the stateful drills
+    gate rc on zero of those.  Works against a Server or a Router:
+    both expose ``submit_decode(..., session_id=...) -> Future``.
+    """
+
+    def __init__(self, target, model, seq_buckets, max_new, clients,
+                 seed, vocab=128, verify_every=4, turn_timeout=120.0):
+        self._target = target
+        self._model = model
+        self._max_prompt = max(seq_buckets)
+        self._max_new = int(max_new)
+        self._clients = int(clients)
+        self._seed = int(seed)
+        self._vocab = int(vocab)
+        self._verify_every = max(1, int(verify_every))
+        self._timeout = float(turn_timeout)
+        self._stop = threading.Event()
+        self._threads = []
+        self._lock = threading.Lock()
+        self.errors = []
+        self.lost = 0
+        self.turns = 0
+        self.follow_ups = 0
+        self.conversations = 0
+        self.verified = 0
+        self.mismatches = 0
+        self.latencies = []              # (wall_ts, seconds, turn_idx)
+
+    def _decode(self, prompt, sid):
+        fut = self._target.submit_decode(
+            self._model, [prompt], max_new_tokens=self._max_new,
+            timeout=self._timeout, session_id=sid)
+        return np.asarray(fut.result(timeout=self._timeout)[0])[0]
+
+    def _turn(self, prompt, sid):
+        from paddle_tpu.framework.enforce import UnavailableError
+        deadline = time.monotonic() + self._timeout
+        while True:
+            try:
+                return self._decode(prompt, sid)
+            except UnavailableError as e:
+                # drain bounce / parked mid-flight: the transcript is
+                # client-held state, so the turn is safely retryable
+                if time.monotonic() > deadline or self._stop.is_set():
+                    raise
+                time.sleep(min(1.0,
+                               float(getattr(e, "retry_after_s", None)
+                                     or 0.05)))
+
+    def _client(self, i):
+        rng = np.random.RandomState(self._seed + 7919 * (i + 1))
+        transcript, sid, turn, conv = None, None, 0, 0
+        while not self._stop.is_set():
+            if transcript is None:
+                conv += 1
+                sid = f"client{i}-conv{conv}"
+                turn = 0
+                transcript = rng.randint(
+                    1, self._vocab,
+                    int(rng.randint(2, max(3, self._max_prompt // 4)))
+                ).astype(np.int32)
+                with self._lock:
+                    self.conversations += 1
+            else:
+                transcript = np.concatenate(
+                    [transcript, rng.randint(1, self._vocab,
+                                             int(rng.randint(1, 5))
+                                             ).astype(np.int32)])
+            if transcript.size > self._max_prompt:
+                transcript = None        # conversation outgrew the
+                continue                 # ladder — retire it
+            t0 = time.perf_counter()
+            try:
+                got = self._turn(transcript, sid)
+            except Exception as e:   # noqa: BLE001 — a lost session
+                with self._lock:
+                    self.errors.append(f"{sid} turn{turn}: "
+                                       f"{type(e).__name__}: {e}")
+                    self.lost += 1
+                transcript = None
+                continue
+            with self._lock:
+                self.turns += 1
+                self.follow_ups += bool(turn)
+                self.latencies.append(
+                    (time.time(), time.perf_counter() - t0, turn))
+                check = turn and self.follow_ups % self._verify_every == 0
+            if check:
+                try:
+                    want = self._turn(transcript, None)
+                except Exception:   # noqa: BLE001 — the oracle leg
+                    pass            # bounced; it only counts when run
+                else:
+                    with self._lock:
+                        self.verified += 1
+                        if not np.array_equal(got, want):
+                            self.mismatches += 1
+                            self.errors.append(
+                                f"{sid} turn{turn}: session continuation"
+                                " != stateless prefill")
+            transcript = np.concatenate(
+                [transcript, np.asarray(got, np.int32)])
+            turn += 1
+
+    def start(self):
+        self._threads = [
+            threading.Thread(target=self._client, args=(i,), daemon=True)
+            for i in range(self._clients)]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self._timeout + 30)
+
+    @staticmethod
+    def _p99(lats):
+        if not lats:
+            return None
+        return round(float(np.percentile(np.asarray(lats) * 1e3, 99)), 3)
+
+    def report(self):
+        with self._lock:
+            return {"turns": self.turns, "follow_ups": self.follow_ups,
+                    "conversations": self.conversations,
+                    "lost_sessions": self.lost,
+                    "verified_turns": self.verified,
+                    "bit_mismatches": self.mismatches,
+                    "p99_ms": self._p99(
+                        [s for (_, s, _) in self.latencies]),
+                    "follow_up_p99_ms": self._p99(
+                        [s for (_, s, t) in self.latencies if t]),
+                    "errors": list(self.errors)}
+
+
+def _gate_sessions(report, args, rc):
+    """Shared rc gate of the stateful traffic modes: zero lost
+    sessions, zero bit-exactness mismatches, zero client errors, at
+    least one follow-up turn actually exercised, and (when set) the
+    p99 SLO over whole turns."""
+    sess = report["sessions"]
+    if sess["errors"] or sess["lost_sessions"] or sess["bit_mismatches"] \
+            or not sess["follow_ups"]:
+        rc = 1
+    if args.p99_slo_ms is not None and sess["p99_ms"] is not None \
+            and sess["p99_ms"] > args.p99_slo_ms:
+        sess["p99_slo_violated"] = True
+        rc = 1
+    return rc
+
+
 def _ramp_main(args):
     """--ramp N: the elastic-lifecycle drill.  One seed replica boots,
     sustained mixed traffic starts and NEVER stops; the cluster then
@@ -588,13 +790,23 @@ def _ramp_main(args):
     # the seed replica compiles once, every later spawn boots O(load)
     cache_dir = args.cache_dir or os.path.join(cfg_dir, "exec_cache")
     os.makedirs(cache_dir, exist_ok=True)
+    sess_dir = ""
+    if args.sessions:
+        # one spill dir for the WHOLE fleet: every spawn (including
+        # rollout canaries) sees the same parked sessions, so a
+        # SIGKILLed replica's conversations outlive it on disk
+        sess_dir = os.path.join(cfg_dir, "sessions")
+        os.makedirs(sess_dir, exist_ok=True)
+        report["sessions_dir"] = sess_dir
     children = {}                        # replica id -> Popen
-    router = obs = traffic = burst_router = None
+    router = obs = traffic = burst_router = sess_traffic = None
 
     def _cfg_for(rid, version=None, store_on=True, port=0,
                  heldout=False):
         return {"id": rid, "role": "both", "seed": args.seed,
                 "heldout": heldout,
+                "session_store": bool(args.sessions),
+                "session_store_dir": sess_dir,
                 "models": names, "decode": bool(args.decode),
                 "buckets": list(buckets),
                 "seq_buckets": list(seq_buckets),
@@ -679,6 +891,14 @@ def _ramp_main(args):
         traffic = _BgTraffic(router, dense, args.decode, seq_buckets,
                              args.max_new, clients=args.clients,
                              seed=args.seed, tenant="steady").start()
+        if args.sessions:
+            # conversations run through EVERY leg — ramp, drain-down,
+            # rollout, the mid-rollout SIGKILL — and the exit gate
+            # demands none of them were lost or answered differently
+            sess_traffic = _SessionTraffic(
+                router, "gpt_decode", seq_buckets, args.max_new,
+                clients=max(2, args.clients // 2),
+                seed=args.seed + 31).start()
 
         # -- tenant admission: control window, then a burst window ------
         tc0 = time.time()
@@ -824,6 +1044,12 @@ def _ramp_main(args):
                 rc = 1
             ctrl.scale_to(1)
 
+        if sess_traffic is not None:
+            sess_traffic.stop()
+            report["sessions"] = sess_traffic.report()
+            rc = _gate_sessions(report, args, rc)
+            if args.rollout_kill and report["sessions"]["lost_sessions"]:
+                report["sessions"]["kill_lost_sessions"] = True
         traffic.stop()
         report["traffic_errors"] = traffic.errors
         report["traffic_completed"] = len(traffic.latencies)
@@ -851,6 +1077,8 @@ def _ramp_main(args):
         sig = obs.poll()
         report["cluster_signals"] = sig.to_dict()
     finally:
+        if sess_traffic is not None:
+            sess_traffic.stop()
         if traffic is not None:
             traffic.stop()
         if burst_router is not None:
@@ -885,6 +1113,14 @@ def _router_report(report, args, rc):
                       f"p50 {m['p50_ms']:>8.2f} ms  "
                       f"p99 {m['p99_ms']:>8.2f} ms  "
                       f"completed {m['completed']}")
+        if "sessions" in report:
+            s = report["sessions"]
+            print(f"sessions: {s['turns']} turns "
+                  f"({s['follow_ups']} follow-ups / "
+                  f"{s['conversations']} conversations), "
+                  f"lost {s['lost_sessions']}, verified "
+                  f"{s['verified_turns']} (mismatches "
+                  f"{s['bit_mismatches']}), p99 {s['p99_ms']} ms")
         print(f"router: {report.get('router_stats', {}).get('replicas_live')}"
               f" live, steady compiles {report.get('steady_compiles')} "
               f"(must be 0), rc={rc}")
@@ -1007,12 +1243,33 @@ def main(argv=None):
                          "promotion); the rollout must still converge, "
                          "the journal stay consistent, and the victim "
                          "leave a flight-recorder postmortem")
+    ap.add_argument("--sessions", action="store_true",
+                    help="stateful multi-turn traffic (needs --decode): "
+                         "clients grow conversations under stable "
+                         "session_ids through the prefix/session KV "
+                         "cache (FLAGS_session_store + "
+                         "FLAGS_prefix_cache + the slot decode loop), "
+                         "and a sampled oracle re-submits each "
+                         "transcript statelessly, demanding "
+                         "bit-identical output.  rc additionally "
+                         "gates on zero lost sessions / mismatches; "
+                         "under --ramp --rollout-kill this is the "
+                         "stateful SIGKILL drill — parked sessions "
+                         "spill to a fleet-shared dir and must "
+                         "survive the victim")
     ap.add_argument("--replica-config", default=None,
                     help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
     if args.replica_config:
         return _replica_child(args.replica_config)
+    if args.sessions and not args.decode:
+        print("--sessions needs --decode", file=sys.stderr)
+        return 2
+    if args.sessions and args.disaggregate:
+        print("--sessions needs unified replicas (the slot decode loop "
+              "is per-replica); drop --disaggregate", file=sys.stderr)
+        return 2
     if args.ramp is not None:
         return _ramp_main(args)
     if args.router:
@@ -1036,6 +1293,16 @@ def main(argv=None):
     try:
         if args.int8:
             set_flags({"FLAGS_use_int8_inference": True})
+        if args.sessions:
+            from paddle_tpu.framework.flags import flag as _flag
+            sess_dir = tempfile.mkdtemp(prefix="serve_sessions_")
+            report["sessions_dir"] = sess_dir
+            sess_flags = {"FLAGS_session_store": True,
+                          "FLAGS_session_store_dir": sess_dir,
+                          "FLAGS_prefix_cache": True}
+            if not int(_flag("decode_slots")):
+                sess_flags["FLAGS_decode_slots"] = 4
+            set_flags(sess_flags)
         if args.trace_dir:
             from paddle_tpu.framework.flags import flag as _flag
             from paddle_tpu.profiler import tracing as _tracing
@@ -1113,6 +1380,14 @@ def main(argv=None):
                 report["warmup_fresh_compiles"] = sum(
                     n for k, n in kinds.items() if k != "cache_load")
             if args.decode:
+                strf = None
+                if args.sessions:
+                    # the stateful clients run ALONGSIDE the one-shot
+                    # traffic: restores and plain prefills share slots
+                    strf = _SessionTraffic(
+                        server, "gpt_decode", seq_buckets, args.max_new,
+                        clients=args.clients, seed=args.seed + 31,
+                        vocab=gpt._serve_vocab).start()
                 errors = _decode_traffic(
                     server, "gpt_decode", args.duration, args.clients,
                     args.max_request_rows, max(seq_buckets),
@@ -1122,6 +1397,19 @@ def main(argv=None):
                 st["traffic_errors"] = errors
                 if errors or st["errors"]:
                     rc = 1
+                if strf is not None:
+                    strf.stop()
+                    sess = strf.report()
+                    sl = server.stats("gpt_decode").get("slot_loop") or {}
+                    for k in ("restored", "parked", "prefix_hit_tokens"):
+                        sess[k] = sl.get(k)
+                    report["sessions"] = sess
+                    rc = _gate_sessions(report, args, rc)
+                    if not sess.get("restored"):
+                        # mixed-mode without a single KV restore means
+                        # the session plane silently never engaged
+                        sess["restore_never_engaged"] = True
+                        rc = 1
                 if args.p99_slo_ms is not None:
                     st["p99_slo_ms"] = args.p99_slo_ms
                     st["slo_met"] = st["p99_ms"] <= args.p99_slo_ms
@@ -1193,6 +1481,14 @@ def main(argv=None):
                   f"batches {st['batches']}  "
                   f"avg rows {st['avg_batch_rows']}  "
                   f"[{st['backend']}/{st['export_mode']}]")
+        if "sessions" in report:
+            s = report["sessions"]
+            print(f"      sessions: {s['turns']} turns "
+                  f"({s['follow_ups']} follow-ups), restored "
+                  f"{s.get('restored')}, parked {s.get('parked')}, "
+                  f"prefix-hit tokens {s.get('prefix_hit_tokens')}, "
+                  f"lost {s['lost_sessions']}, mismatches "
+                  f"{s['bit_mismatches']}")
         print(f"serve: warm-up {report['warmup_s']}s, steady-state "
               f"compiles {report['steady_compiles']} (must be 0), rc={rc}")
     return rc
