@@ -1,0 +1,41 @@
+"""paddle_tpu.analysis.protocol — static verification of the cluster
+protocols.
+
+Third tier of the analysis stack (after the jaxpr pass suite and the
+HLO admission audit): the distributed serving plane's protocols are
+declared as data (:mod:`.spec`, registered next to the implementing
+code in ``serving/cluster/`` and ``serving/sessions.py``) and verified
+by exhaustive explicit-state exploration (:mod:`.model_check`,
+:mod:`.models`) under the same injected faults the chaos drills sample.
+:mod:`.mutations` is the seeded-bug corpus that keeps the checker
+honest; ``tools/proto_check.py`` is the CLI/CI face.
+
+Pure Python, no JAX, no devices — importable anywhere.
+"""
+from __future__ import annotations
+
+from .spec import (Invariant, ProtocolSpec, SpecError,  # noqa: F401
+                   Transition, get_protocol, load_builtin_specs,
+                   register_protocol, registered_protocols)
+from .model_check import (Action, CheckResult, ProtocolModel,  # noqa: F401
+                          Violation, check_model)
+from .models import ALL_MODELS, build_model  # noqa: F401
+from . import mutations  # noqa: F401
+
+__all__ = [
+    "ProtocolSpec", "Transition", "Invariant", "SpecError",
+    "register_protocol", "registered_protocols", "get_protocol",
+    "load_builtin_specs", "ProtocolModel", "CheckResult", "Violation",
+    "check_model", "ALL_MODELS", "build_model", "mutations",
+    "check_all",
+]
+
+
+def check_all(mutations=frozenset(), max_states: int = 500_000):
+    """Model-check every protocol (after loading the specs registered
+    in the serving modules).  Returns {protocol: CheckResult}."""
+    load_builtin_specs()
+    muts = frozenset(mutations)
+    return {name: check_model(build_model(name, mutations=muts),
+                              max_states=max_states)
+            for name in sorted(ALL_MODELS)}

@@ -1,0 +1,614 @@
+"""Finite world models of the four cluster protocols.
+
+Each model is a faithful small-world abstraction of the real
+implementation — 2 replicas, 1 router, 1 controller, 1 session, with
+the injected faults the chaos drills use (SIGKILL, drain-hang,
+store-write loss) as one-shot environment actions — small enough to
+exhaust, rich enough that every seeded bug in
+:mod:`.mutations` reaches a violating state.
+
+Timing abstractions (documented, load-bearing):
+
+  * The controller deregisters a cleanly-retired replica synchronously
+    with the drain reply (it blocks on the RPC), so heartbeat staleness
+    cannot fire inside that window — ``retire`` is one atomic action.
+    Heartbeat eviction therefore requires a DEAD process or a ghost
+    registration (no tombstone), which is exactly the real monitor's
+    miss-count window in the limit.
+  * Request completion is abstracted to one in-flight request per
+    replica; the router's retry-elsewhere path is a bounce (no state).
+  * Session versions are the ``t_park`` keep-newer ordering, bounded to
+     3 parks per run (enough to exhibit every stale-replay shape).
+
+Mutations are spelled as string flags (see :mod:`.mutations`): a model
+built with a mutation reproduces the seeded bug's behavior; the checker
+must then find a violating state — mutation-style validation of the
+checker itself.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import FrozenSet, Iterable, List, Tuple
+
+from .model_check import ProtocolModel
+
+__all__ = ["ReplicaLifecycleModel", "SessionModel", "RollingUpdateModel",
+           "KVHandoffModel", "ALL_MODELS", "build_model"]
+
+
+def _mut(mutations, name) -> bool:
+    return name in mutations
+
+
+# ---------------------------------------------------------------------------
+# replica lifecycle: router + 2 replicas + controller
+# ---------------------------------------------------------------------------
+
+Rep = namedtuple("Rep", "phase reg tomb in_rot evicted dereg inflight")
+LifeState = namedtuple("LifeState", "reps sigkill hang wloss bad_exec")
+
+_BOOT, _SERVING, _DRAINING, _DRAINED = "boot", "serving", "draining", "drained"
+_RETIRED, _WEDGED, _DEAD = "retired", "wedged", "dead"
+
+_RL = "replica-lifecycle"
+_RM = "router-membership"
+
+
+class ReplicaLifecycleModel(ProtocolModel):
+    """Boot→serving→draining→retired/evicted across router discovery,
+    dispatch, drain orders, tombstones and heartbeat eviction, under
+    one-shot SIGKILL / drain-hang / registration-write-loss faults."""
+
+    name = "replica-lifecycle"
+    spec_names = (_RL, _RM)
+
+    def __init__(self, n_replicas: int = 2,
+                 mutations: FrozenSet[str] = frozenset()):
+        self.n = int(n_replicas)
+        self.mutations = frozenset(mutations)
+        # the stop-accepting flip on drain is the mutation seat: the
+        # seeded bug keeps accepting through draining/drained/retired
+        if _mut(self.mutations, "lifecycle.accept_while_draining"):
+            self._accepts = (_SERVING, _DRAINING, _DRAINED, _RETIRED)
+        else:
+            self._accepts = (_SERVING,)
+        self.invariants = (
+            ("dispatch-targets-live",
+             "no request is ever EXECUTED by a retired or dead replica "
+             "(bounces/transport errors are fine — executions are not)",
+             lambda s: not s.bad_exec),
+            ("tombstone-evict-exclusive",
+             "tombstone-deregister (clean retirement) and heartbeat "
+             "eviction are mutually exclusive outcomes for one "
+             "registration",
+             lambda s: all(not (r.dereg and r.evicted) for r in s.reps)),
+            ("no-retire-with-inflight",
+             "a replica never retires with a request still in flight "
+             "(drain must actually drain before the tombstone lands)",
+             lambda s: all(r.phase != _RETIRED or not r.inflight
+                           for r in s.reps)),
+        )
+
+    def initial_state(self) -> LifeState:
+        return LifeState(reps=tuple(
+            Rep(_BOOT, False, False, False, False, False, False)
+            for _ in range(self.n)),
+            sigkill=False, hang=False, wloss=False, bad_exec=False)
+
+    def _with(self, s: LifeState, i: int, **kw) -> Tuple[Rep, ...]:
+        reps = list(s.reps)
+        reps[i] = reps[i]._replace(**kw)
+        return tuple(reps)
+
+    def actions(self, s: LifeState) -> Iterable:
+        out: List = []
+        mut = self.mutations
+        for i, r in enumerate(s.reps):
+            # -- boot / registration (store-write loss can eat the
+            #    rendezvous record: the replica serves but is never
+            #    discovered — tolerated: it simply takes no traffic)
+            if r.phase == _BOOT:
+                out.append((f"register(r{i})",
+                            ((_RL, _BOOT, "register", _SERVING),),
+                            s._replace(reps=self._with(
+                                s, i, phase=_SERVING, reg=True))))
+                if not s.wloss:
+                    out.append((f"register_write_lost(r{i})",
+                                ((_RL, _BOOT, "register", _SERVING),),
+                                s._replace(wloss=True, reps=self._with(
+                                    s, i, phase=_SERVING, reg=False))))
+            # -- router discovery: skip tombstoned slots; an evicted
+            #    handle is remembered (discovery never resurrects it)
+            if r.reg and not r.tomb and not r.in_rot and not r.evicted:
+                out.append((f"discover(r{i})",
+                            ((_RM, "unknown", "discover", "in_rotation"),),
+                            s._replace(reps=self._with(s, i, in_rot=True))))
+            # -- dispatch: only a replica whose server still ACCEPTS
+            #    executes work; everything else bounces (the router
+            #    retries elsewhere — not modeled, no state change)
+            if r.in_rot and not r.inflight and r.phase in self._accepts:
+                bad = r.phase in (_RETIRED, _DEAD)
+                out.append((f"dispatch(r{i})", (),
+                            s._replace(
+                                bad_exec=s.bad_exec or bad,
+                                reps=self._with(s, i, inflight=True))))
+            if r.inflight and r.phase in (_SERVING, _DRAINING, _DRAINED,
+                                          _RETIRED):
+                out.append((f"complete(r{i})", (),
+                            s._replace(reps=self._with(
+                                s, i, inflight=False))))
+            # -- controller drain order (only for discovered replicas:
+            #    the controller drains through the router handle)
+            if r.phase == _SERVING and r.in_rot:
+                out.append((f"drain(r{i})",
+                            ((_RL, _SERVING, "drain", _DRAINING),),
+                            s._replace(reps=self._with(
+                                s, i, phase=_DRAINING))))
+                if not s.hang:
+                    out.append((f"drain_hang(r{i})",
+                                ((_RL, _SERVING, "drain", _WEDGED),),
+                                s._replace(hang=True, reps=self._with(
+                                    s, i, phase=_WEDGED))))
+            if r.phase == _DRAINING and (not r.inflight or _mut(
+                    mut, "lifecycle.accept_while_draining")):
+                out.append((f"drain_complete(r{i})",
+                            ((_RL, _DRAINING, "drain_complete", _DRAINED),),
+                            s._replace(reps=self._with(
+                                s, i, phase=_DRAINED))))
+            # -- clean retirement: tombstone + deregister, atomic with
+            #    the drain reply (see module docstring).  The seeded
+            #    bug drops the tombstone store write.
+            retire_ok = r.phase == _DRAINED
+            if _mut(mut, "lifecycle.retire_undrained"):
+                retire_ok = retire_ok or r.phase == _DRAINING
+            if retire_ok:
+                tomb = not _mut(mut, "lifecycle.drop_tombstone_write")
+                out.append((f"retire(r{i})",
+                            ((_RL, r.phase, "retire", _RETIRED),
+                             (_RM, "in_rotation", "deregister",
+                              "deregistered")),
+                            s._replace(reps=self._with(
+                                s, i, phase=_RETIRED, tomb=tomb,
+                                in_rot=False, dereg=True))))
+            # -- drain-hang escalation: the controller's timeout kills
+            #    and evicts the wedged replica (never deregisters it)
+            if r.phase == _WEDGED:
+                out.append((f"drain_timeout_evict(r{i})",
+                            ((_RL, _WEDGED, "evict", _DEAD),
+                             (_RM, "in_rotation", "evict", "evicted")),
+                            s._replace(reps=self._with(
+                                s, i, phase=_DEAD, in_rot=False,
+                                evicted=True, inflight=False))))
+            # -- SIGKILL (one-shot): the process dies in place
+            if not s.sigkill and r.phase in (_SERVING, _DRAINING,
+                                             _DRAINED, _WEDGED):
+                out.append((f"sigkill(r{i})",
+                            ((_RL, r.phase, "sigkill", _DEAD),),
+                            s._replace(sigkill=True, reps=self._with(
+                                s, i, phase=_DEAD, inflight=False))))
+            # -- heartbeat staleness: a dead process stops beating and
+            #    the monitor evicts it; a GHOST (retired without a
+            #    tombstone, rediscovered) goes the same way — which is
+            #    exactly what the exclusivity invariant catches
+            if r.in_rot and r.phase in (_DEAD, _RETIRED):
+                out.append((f"heartbeat_stale_evict(r{i})",
+                            ((_RM, "in_rotation", "evict", "evicted"),),
+                            s._replace(reps=self._with(
+                                s, i, in_rot=False, evicted=True))))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# session: active -> parked -> migrating -> restored, over 2 replicas
+# ---------------------------------------------------------------------------
+
+SessState = namedtuple(
+    "SessState",
+    "sphase r0 r1 s0 s1 wire lastw lastw_to p0 p1 aff clobbered excused "
+    "sk_used")
+
+_SS = "session"
+_UP, _DRN, _GONE = "up", "draining", "gone"
+
+
+class SessionModel(ProtocolModel):
+    """One session over 2 replicas: turn park/restore, drain-time park,
+    router-driven export/import migration with move semantics and the
+    keep-newer rule, duplicate wire delivery, and replica SIGKILL.
+
+    Versions model ``t_park``: -1 = absent, otherwise monotonically
+    increasing park stamps (bounded to 3)."""
+
+    name = "session"
+    spec_names = (_SS,)
+
+    def __init__(self, mutations: FrozenSet[str] = frozenset()):
+        self.mutations = frozenset(mutations)
+        self.invariants = (
+            ("one-owner",
+             "a session never has two owners (RAM copies + wire blob + "
+             "active slots), and reaches zero owners only through a "
+             "SIGKILL loss the protocol documents as re-prefill "
+             "degradation — never through a clean drain",
+             self._inv_one_owner),
+            ("no-stale-clobber",
+             "an import never overwrites a fresher parked copy with an "
+             "older snapshot (the t_park keep-newer rule)",
+             lambda s: not s.clobbered),
+        )
+
+    @staticmethod
+    def _owners(s: SessState) -> int:
+        return ((s.r0 >= 0) + (s.r1 >= 0) + (s.s0 >= 0) + (s.s1 >= 0)
+                + (s.wire >= 0))
+
+    def _inv_one_owner(self, s: SessState) -> bool:
+        n = self._owners(s)
+        return n == 1 or (n == 0 and s.excused)
+
+    def initial_state(self) -> SessState:
+        # born active: mid-turn in replica 0's decode slot, version 0
+        return SessState(sphase="active", r0=-1, r1=-1, s0=0, s1=-1,
+                         wire=-1, lastw=-1, lastw_to=-1, p0=_UP, p1=_UP,
+                         aff=0, clobbered=False, excused=False,
+                         sk_used=False)
+
+    def actions(self, s: SessState) -> Iterable:
+        out: List = []
+        mut = self.mutations
+        rams = (s.r0, s.r1)
+        slots = (s.s0, s.s1)
+        phases = (s.p0, s.p1)
+
+        def upd(**kw):
+            return s._replace(**kw)
+
+        def set_ram(i, v):
+            return {"r0": v} if i == 0 else {"r1": v}
+
+        def set_slot(i, v):
+            return {"s0": v} if i == 0 else {"s1": v}
+
+        for i in range(2):
+            ram, slot, ph = rams[i], slots[i], phases[i]
+            # -- turn end: park the active row (version bumps)
+            if slot >= 0 and ph == _UP and slot + 1 <= 3:
+                out.append((f"park(r{i})",
+                            ((_SS, s.sphase, "park", "parked"),),
+                            upd(sphase="parked", aff=i,
+                                **set_slot(i, -1),
+                                **set_ram(i, slot + 1))))
+            # -- next turn: take() claims the parked copy into a slot
+            if ram >= 0 and slot < 0 and ph == _UP:
+                out.append((f"restore(r{i})",
+                            ((_SS, "parked", "restore", "restored"),),
+                            upd(sphase="restored",
+                                **set_ram(i, -1), **set_slot(i, ram))))
+            # -- drain: park the active row mid-generation.  The seeded
+            #    bug skips the park — the row's state dies with the slot.
+            if ph == _UP:
+                kw = {("p0" if i == 0 else "p1"): _DRN}
+                if slot >= 0:
+                    if _mut(mut, "sessions.skip_park_on_drain"):
+                        kw.update(set_slot(i, -1))   # dropped, not parked
+                        out.append((f"drain_drop(r{i})",
+                                    ((_SS, s.sphase, "park", "parked"),),
+                                    upd(sphase="parked", **kw)))
+                    else:
+                        kw.update(set_slot(i, -1))
+                        kw.update(set_ram(i, min(slot + 1, 3)))
+                        out.append((f"drain_park(r{i})",
+                                    ((_SS, s.sphase, "park", "parked"),),
+                                    upd(sphase="parked", aff=i, **kw)))
+                else:
+                    out.append((f"drain(r{i})", (), upd(**kw)))
+            # -- migration export off a draining replica: move
+            #    semantics (serialize-and-remove).  The seeded bug
+            #    copies instead of moving.
+            if ph == _DRN and ram >= 0 and s.wire < 0:
+                kw = {"wire": ram, "lastw": ram, "lastw_to": 1 - i}
+                if not _mut(mut, "sessions.export_copies"):
+                    kw.update(set_ram(i, -1))
+                out.append((f"export(r{i})",
+                            ((_SS, "parked", "export", "migrating"),),
+                            upd(sphase="migrating", **kw)))
+            # -- SIGKILL (one-shot): RAM + slot copies die with the
+            #    process; the documented degradation is a re-prefill
+            if not s.sk_used and ph != _GONE:
+                kw = {("p0" if i == 0 else "p1"): _GONE, "sk_used": True}
+                lost = ram >= 0 or slot >= 0
+                kw.update(set_ram(i, -1))
+                kw.update(set_slot(i, -1))
+                if lost:
+                    kw["excused"] = True
+                if s.aff == i:
+                    kw["aff"] = -1
+                out.append((f"sigkill(r{i})", (), upd(**kw)))
+
+        # -- migration import into the target replica (keep-newer)
+        if s.wire >= 0:
+            j = s.lastw_to
+            if j >= 0 and phases[j] == _UP:
+                prev = rams[j]
+                if prev > s.wire and not _mut(
+                        mut, "sessions.import_ignores_newer"):
+                    out.append((f"import_dropped_stale(r{j})",
+                                ((_SS, "migrating", "import", "parked"),),
+                                upd(sphase="parked", wire=-1)))
+                else:
+                    kw = {"wire": -1, "aff": j}
+                    kw.update(set_ram(j, s.wire))
+                    if prev > s.wire:
+                        kw["clobbered"] = True
+                    out.append((f"import(r{j})",
+                                ((_SS, "migrating", "import", "parked"),),
+                                upd(sphase="parked", **kw)))
+        # -- duplicate delivery of the last wire blob (network replay
+        #    of the session_import RPC).  Clean keep-newer makes it a
+        #    no-op; the seeded bug clobbers the fresher park.
+        elif s.lastw >= 0 and s.lastw_to >= 0 \
+                and phases[s.lastw_to] == _UP \
+                and s.s0 < 0 and s.s1 < 0:
+            j = s.lastw_to
+            prev = rams[j]
+            if prev > s.lastw:
+                if _mut(mut, "sessions.import_ignores_newer"):
+                    kw = set_ram(j, s.lastw)
+                    out.append((f"import_replay(r{j})", (),
+                                upd(clobbered=True, **kw)))
+                else:
+                    out.append((f"import_replay_dropped(r{j})", (), s))
+        return [a for a in out if a[2] != s]
+
+
+# ---------------------------------------------------------------------------
+# rolling update: canary -> promote | rollback, journaled replacement
+# ---------------------------------------------------------------------------
+
+RollState = namedtuple(
+    "RollState",
+    "canary old0 old1 new0 new1 promoted rep0 rep1 done rolled_back "
+    "mismatch promoted_bad sk_used")
+
+_RU = "rolling-update"
+
+
+class RollingUpdateModel(ProtocolModel):
+    """Canary gate, promote-or-rollback, and the journaled
+    spawn-before-drain replacement loop, with controller crash/resume
+    implicit (every action's enabling condition is derived from the
+    journal + live set, exactly like ``RolloutJournal.resumable_for``),
+    a one-shot replacement SIGKILL, and the canary bit-mismatch fault."""
+
+    name = "rolling-update"
+    spec_names = (_RU,)
+
+    def __init__(self, mutations: FrozenSet[str] = frozenset()):
+        self.mutations = frozenset(mutations)
+        self.invariants = (
+            ("journal-implies-applied",
+             "a journal-committed replacement step is never half-applied:"
+             " replaced[i] implies old i retired AND its replacement was "
+             "spawned (crash+resume must find the step done)",
+             lambda s: all(
+                 (not rep) or (old == "retired" and new != "absent")
+                 for rep, old, new in ((s.rep0, s.old0, s.new0),
+                                       (s.rep1, s.old1, s.new1)))),
+            ("spawn-before-drain",
+             "an old replica is only retired after its replacement was "
+             "spawned (capacity never pays for the update)",
+             lambda s: all(
+                 old != "retired" or new != "absent"
+                 for old, new in ((s.old0, s.new0), (s.old1, s.new1)))),
+            ("no-mismatched-promotion",
+             "a canary that failed the logits bit-match gate is never "
+             "promoted into rotation",
+             lambda s: not s.promoted_bad),
+            ("rollback-is-clean",
+             "a rolled-back update leaves the old fleet serving and "
+             "nothing of the new version behind",
+             lambda s: not s.rolled_back or (
+                 not s.promoted and s.new0 == "absent"
+                 and s.new1 == "absent" and s.old0 == "serving"
+                 and s.old1 == "serving")),
+        )
+
+    def initial_state(self) -> RollState:
+        return RollState(canary="absent", old0="serving", old1="serving",
+                         new0="absent", new1="absent", promoted=False,
+                         rep0=False, rep1=False, done=False,
+                         rolled_back=False, mismatch=False,
+                         promoted_bad=False, sk_used=False)
+
+    def actions(self, s: RollState) -> Iterable:
+        out: List = []
+        mut = self.mutations
+        if s.done:
+            return out
+        # arm the canary bit-mismatch fault before the canary spawns
+        if s.canary == "absent" and not s.mismatch:
+            out.append(("arm_canary_mismatch", (),
+                        s._replace(mismatch=True)))
+        if s.canary == "absent":
+            out.append(("spawn_canary",
+                        ((_RU, "idle", "spawn_canary", "canary_gate"),),
+                        s._replace(
+                            canary="bad" if s.mismatch else "ok")))
+        # the gate: bit-match passes -> promote; fails -> rollback.
+        # The seeded bug promotes without consulting the gate.
+        if s.canary == "ok" or (s.canary == "bad" and _mut(
+                mut, "rollout.skip_canary_gate")):
+            out.append(("promote_canary",
+                        ((_RU, "canary_gate", "promote", "promoting"),),
+                        s._replace(canary="promoted", promoted=True,
+                                   promoted_bad=s.canary == "bad")))
+        if s.canary == "bad":
+            out.append(("rollback",
+                        ((_RU, "canary_gate", "rollback", "rolled_back"),),
+                        s._replace(canary="absent", done=True,
+                                   rolled_back=True)))
+        if s.promoted:
+            for i, (old, new, rep) in enumerate(
+                    ((s.old0, s.new0, s.rep0), (s.old1, s.new1, s.rep1))):
+                def up(i=i, **kw):
+                    if i == 0:
+                        kw = {("old0" if k == "old" else
+                               "new0" if k == "new" else "rep0"): v
+                              for k, v in kw.items()}
+                    else:
+                        kw = {("old1" if k == "old" else
+                               "new1" if k == "new" else "rep1"): v
+                              for k, v in kw.items()}
+                    return s._replace(**kw)
+                if new == "absent" and not rep:
+                    out.append((f"spawn_replacement({i})",
+                                ((_RU, "promoting", "replace_step",
+                                  "promoting"),),
+                                up(new="serving")))
+                # clean gate: replacement serving before the old
+                # replica drains; the seeded bug drains first
+                can_retire = old == "serving" and (
+                    new == "serving"
+                    or _mut(mut, "rollout.drain_before_spawn"))
+                if can_retire:
+                    out.append((f"retire_old({i})",
+                                ((_RU, "promoting", "replace_step",
+                                  "promoting"),),
+                                up(old="retired")))
+                # journal commit AFTER the step is applied; the seeded
+                # bug commits first (crash -> resume skips the step)
+                if not rep:
+                    applied = old == "retired" and new != "absent"
+                    if applied or _mut(mut, "rollout.commit_before_apply"):
+                        out.append((f"journal_commit({i})",
+                                    ((_RU, "promoting", "replace_step",
+                                      "promoting"),),
+                                    up(rep=True)))
+                if new == "serving" and not s.sk_used:
+                    out.append((f"sigkill_replacement({i})", (),
+                                up(new="dead")._replace(sk_used=True)))
+                if new == "dead":
+                    out.append((f"respawn_replacement({i})", (),
+                                up(new="serving")))
+            if s.rep0 and s.rep1:
+                out.append(("finish",
+                            ((_RU, "promoting", "finish", "complete"),),
+                            s._replace(done=True)))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# KV handoff: prefill -> wire blob -> decode, exactly-once reply
+# ---------------------------------------------------------------------------
+
+HandState = namedtuple(
+    "HandState", "req blob P D replies torn_decode retries wloss sk_used")
+
+_KV = "kv-handoff"
+
+
+class KVHandoffModel(ProtocolModel):
+    """One disaggregated request: prefill serializes the KV blob, the
+    wire may tear it (store-write loss), decode ingests it behind the
+    magic/version integrity check, replicas can be SIGKILLed, the
+    router retries retryable failures once."""
+
+    name = "kv-handoff"
+    spec_names = (_KV,)
+
+    def __init__(self, mutations: FrozenSet[str] = frozenset()):
+        self.mutations = frozenset(mutations)
+        self.invariants = (
+            ("no-torn-decode",
+             "decode never executes over a torn handoff blob (the "
+             "magic + header integrity check must reject it)",
+             lambda s: not s.torn_decode),
+            ("reply-at-most-once",
+             "a request is replied to at most once (retries happen only "
+             "from retryable-failure states, never after a reply)",
+             lambda s: s.replies <= 1),
+        )
+
+    def initial_state(self) -> HandState:
+        return HandState(req="pending", blob="none", P="up", D="up",
+                         replies=0, torn_decode=False, retries=0,
+                         wloss=False, sk_used=False)
+
+    def actions(self, s: HandState) -> Iterable:
+        out: List = []
+        mut = self.mutations
+        if s.req == "pending" and s.P == "up":
+            out.append(("prefill",
+                        ((_KV, "pending", "prefill", "in_flight"),),
+                        s._replace(req="in_flight", blob="intact")))
+            if not s.wloss:
+                out.append(("prefill_blob_torn",
+                            ((_KV, "pending", "prefill", "in_flight"),),
+                            s._replace(req="in_flight", blob="torn",
+                                       wloss=True)))
+        if s.req == "in_flight":
+            if s.D == "up":
+                if s.blob == "intact":
+                    out.append(("decode",
+                                ((_KV, "in_flight", "decode", "decoded"),),
+                                s._replace(req="decoded", blob="none")))
+                elif _mut(mut, "handoff.skip_integrity_check"):
+                    # the seeded bug decodes whatever bytes arrive
+                    out.append(("decode_torn",
+                                ((_KV, "in_flight", "decode", "decoded"),),
+                                s._replace(req="decoded", blob="none",
+                                           torn_decode=True)))
+                else:
+                    out.append(("reject_torn_blob",
+                                ((_KV, "in_flight", "reject", "pending"),)
+                                if s.retries < 1 else
+                                ((_KV, "in_flight", "fail", "failed"),),
+                                s._replace(
+                                    req="pending" if s.retries < 1
+                                    else "failed",
+                                    blob="none",
+                                    retries=s.retries + 1)))
+            else:
+                out.append(("decode_transport_error",
+                            ((_KV, "in_flight", "reject", "pending"),)
+                            if s.retries < 1 else
+                            ((_KV, "in_flight", "fail", "failed"),),
+                            s._replace(
+                                req="pending" if s.retries < 1
+                                else "failed",
+                                blob="none", retries=s.retries + 1)))
+        if s.req == "decoded":
+            out.append(("reply",
+                        ((_KV, "decoded", "reply", "replied"),),
+                        s._replace(req="replied",
+                                   replies=s.replies + 1)))
+        # the seeded bug re-dispatches the decode after a reply (a
+        # timeout misclassified as a retryable failure)
+        if s.req == "replied" and _mut(mut, "handoff.retry_after_reply") \
+                and s.D == "up":
+            out.append(("re_decode_after_reply", (),
+                        s._replace(req="decoded")))
+        for name, up in (("P", s.P), ("D", s.D)):
+            if up == "up" and not s.sk_used:
+                out.append((f"sigkill_{name}", (),
+                            s._replace(**{name: "down", "sk_used": True})))
+            if up == "down":
+                out.append((f"respawn_{name}", (),
+                            s._replace(**{name: "up"})))
+        return out
+
+
+ALL_MODELS = {
+    "replica-lifecycle": ReplicaLifecycleModel,
+    "session": SessionModel,
+    "rolling-update": RollingUpdateModel,
+    "kv-handoff": KVHandoffModel,
+}
+
+
+def build_model(name: str,
+                mutations: FrozenSet[str] = frozenset()) -> ProtocolModel:
+    if name not in ALL_MODELS:
+        raise KeyError(f"unknown protocol model {name!r}; "
+                       f"known: {sorted(ALL_MODELS)}")
+    return ALL_MODELS[name](mutations=frozenset(mutations))

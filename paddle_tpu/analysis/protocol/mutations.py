@@ -1,0 +1,196 @@
+"""Seeded-bug corpus: mutation-style validation of the protocol checker.
+
+A model checker that never fires is indistinguishable from one that
+cannot fire.  This corpus seeds ~10 realistic protocol bugs — each one
+a single dropped write, skipped gate, or reordered step of the kind a
+refactor could plausibly introduce — and the validation contract
+(tools/proto_check.py --mutations, tests/test_protocol_check.py) is:
+
+  * the UNMUTATED models check clean (zero false positives), and
+  * every mutation drives at least one declared invariant (or spec
+    conformance) to a violating state (zero false negatives).
+
+Protocol mutations are flags the world models in :mod:`.models`
+interpret; concurrency-lint mutations are source transforms applied to
+real serving code (drop a ``with self._lock:`` guard) or to a
+representative two-lock module (swap a nested acquisition pair), which
+:mod:`..concurrency_lint` must flag.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+__all__ = ["ProtocolMutation", "LintMutation", "PROTOCOL_MUTATIONS",
+           "LINT_MUTATIONS", "all_mutation_ids"]
+
+
+@dataclass(frozen=True)
+class ProtocolMutation:
+    """One seeded protocol bug: a flag the world model interprets."""
+
+    mutation_id: str
+    model: str            # key into models.ALL_MODELS
+    doc: str
+    expect: Tuple[str, ...]   # invariant name(s) that may catch it
+
+
+PROTOCOL_MUTATIONS: Dict[str, ProtocolMutation] = {m.mutation_id: m for m in [
+    ProtocolMutation(
+        "lifecycle.drop_tombstone_write", "replica-lifecycle",
+        "clean retirement skips the __serving_replica/retired/<id> "
+        "tombstone store write — a router discovering the rendezvous "
+        "prefix later resurrects the dead registration as a ghost, which "
+        "then heartbeat-evicts a replica that was ALSO cleanly "
+        "deregistered",
+        ("tombstone-evict-exclusive", "dispatch-targets-live")),
+    ProtocolMutation(
+        "lifecycle.accept_while_draining", "replica-lifecycle",
+        "the drain order does not flip the server to stop-accepting — "
+        "new work keeps landing through draining/drained and the replica "
+        "retires with a request still in flight (the request dies with "
+        "the process exit)",
+        ("no-retire-with-inflight", "dispatch-targets-live")),
+    ProtocolMutation(
+        "lifecycle.retire_undrained", "replica-lifecycle",
+        "the controller retires (tombstone + deregister) off the drain "
+        "ORDER instead of the drain REPORT — admitted work is still in "
+        "flight when the process exit is scheduled",
+        ("no-retire-with-inflight",)),
+    ProtocolMutation(
+        "sessions.skip_park_on_drain", "session",
+        "drain tears down decode slots without parking active rows into "
+        "the session store — a clean drain silently loses the "
+        "conversation (zero owners with no SIGKILL excuse)",
+        ("one-owner",)),
+    ProtocolMutation(
+        "sessions.export_copies", "session",
+        "export_bytes serializes WITHOUT removing (copy semantics) — "
+        "after the import both replicas own the session and the stale "
+        "copy can clobber the live one's next park",
+        ("one-owner",)),
+    ProtocolMutation(
+        "sessions.import_ignores_newer", "session",
+        "import_bytes drops the t_park keep-newer check — a replayed "
+        "migration blob overwrites a fresher turn's parked snapshot",
+        ("no-stale-clobber",)),
+    ProtocolMutation(
+        "rollout.commit_before_apply", "rolling-update",
+        "the rollout journal commits the replacement step BEFORE "
+        "spawn+retire are applied — a crash between commit and apply "
+        "resumes past the step, leaving an old-version replica serving "
+        "while the journal claims it replaced",
+        ("journal-implies-applied",)),
+    ProtocolMutation(
+        "rollout.skip_canary_gate", "rolling-update",
+        "promotion skips the canary logits bit-match gate — a "
+        "mismatched new version enters rotation",
+        ("no-mismatched-promotion",)),
+    ProtocolMutation(
+        "rollout.drain_before_spawn", "rolling-update",
+        "the replacement loop retires the old replica before its "
+        "replacement is spawned — capacity pays for the update and a "
+        "spawn failure strands the fleet a replica short",
+        ("spawn-before-drain",)),
+    ProtocolMutation(
+        "handoff.skip_integrity_check", "kv-handoff",
+        "decode_from skips the magic/header integrity check and decodes "
+        "whatever bytes arrive — a torn wire blob becomes silently "
+        "corrupt KV planes instead of a retryable rejection",
+        ("no-torn-decode",)),
+    ProtocolMutation(
+        "handoff.retry_after_reply", "kv-handoff",
+        "the router's retry loop re-dispatches a decode after the reply "
+        "already left (timeout misclassified as retryable) — the client "
+        "can observe two replies for one request",
+        ("reply-at-most-once",)),
+]}
+
+
+@dataclass(frozen=True)
+class LintMutation:
+    """One seeded concurrency bug: a source transform the lint must
+    flag.  ``apply(source) -> mutated_source`` returns None when the
+    anchor text is missing (the corpus test then fails loudly rather
+    than silently passing)."""
+
+    mutation_id: str
+    doc: str
+    target: str                # repo-relative path or "<corpus>"
+    expect_pass: str           # lint pass id that must fire
+    apply: Callable[[str], Optional[str]]
+
+
+def _drop_guard(source: str) -> Optional[str]:
+    """Neutralize the first ``with self._lock:`` in SessionStore.put —
+    the guarded _ram/_ram_bytes writes become lock-free."""
+    anchor = "with self._lock:\n            sid = snap.session_id"
+    if anchor not in source:
+        return None
+    return source.replace(
+        anchor, "if True:\n            sid = snap.session_id", 1)
+
+
+# a representative two-lock module in the router/store idiom: every
+# cross-structure path takes _route_lock before _table_lock
+_ORDER_CORPUS = '''\
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._route_lock = threading.Lock()
+        self._table_lock = threading.Lock()
+        self._routes = {}      # guarded-by: _route_lock
+        self._table = {}       # guarded-by: _table_lock
+
+    def add(self, key, val):
+        with self._route_lock:
+            self._routes[key] = val
+            with self._table_lock:
+                self._table[key] = val
+
+    def drop(self, key):
+        with self._route_lock:
+            self._routes.pop(key, None)
+            with self._table_lock:
+                self._table.pop(key, None)
+'''
+
+
+def _swap_lock_pair(source: str) -> Optional[str]:
+    """Reverse the nested acquisition order in ``drop`` — the classic
+    AB/BA deadlock when ``add`` and ``drop`` race."""
+    anchor = ("        with self._route_lock:\n"
+              "            self._routes.pop(key, None)\n"
+              "            with self._table_lock:\n"
+              "                self._table.pop(key, None)\n")
+    if anchor not in source:
+        return None
+    return source.replace(anchor, (
+        "        with self._table_lock:\n"
+        "            with self._route_lock:\n"
+        "                self._routes.pop(key, None)\n"
+        "                self._table.pop(key, None)\n"), 1)
+
+
+LINT_MUTATIONS: Dict[str, LintMutation] = {m.mutation_id: m for m in [
+    LintMutation(
+        "lint.drop_guard",
+        "remove the lock acquisition around SessionStore.put's _ram "
+        "bookkeeping — every guarded-by:_lock field write inside "
+        "becomes unguarded",
+        "paddle_tpu/serving/sessions.py",
+        "guarded-field", _drop_guard),
+    LintMutation(
+        "lint.swap_lock_pair",
+        "reverse one nested lock acquisition in a two-lock module — the "
+        "acquisition-order graph gains an AB/BA cycle (deadlock hazard)",
+        "<corpus>", "lock-order-cycle", _swap_lock_pair),
+]}
+
+ORDER_CORPUS_SOURCE = _ORDER_CORPUS
+
+
+def all_mutation_ids() -> Tuple[str, ...]:
+    return tuple(sorted(PROTOCOL_MUTATIONS)) + tuple(sorted(LINT_MUTATIONS))

@@ -1,0 +1,157 @@
+"""Declarative protocol state-machine specs, registered beside the code.
+
+The serving cluster's distributed protocols (replica lifecycle, session
+park/migrate/restore, rolling update, KV handoff) are documented today
+as prose + chaos drills.  This module gives them the same
+``ProgramDesc``-as-data treatment the jaxpr lint applies to traced
+programs: each protocol declares its state machine — states, initial
+state, allowed transitions, and the invariants it promises — as a
+:class:`ProtocolSpec` object defined NEXT TO the implementation
+(``serving/cluster/replica.py`` declares the replica lifecycle,
+``serving/sessions.py`` the session protocol, ...), so a reader of the
+code and the model checker read the same artifact.
+
+The spec is load-bearing, not documentation: the explicit-state model
+checker (:mod:`.model_check`) tags every world-model action with the
+spec transitions it claims to implement, and a step outside the declared
+machine is a conformance error — the spec rejects drift the same way an
+undeclared metric fails docs/METRICS.md freshness.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Optional, Tuple
+
+__all__ = ["Transition", "Invariant", "ProtocolSpec", "register_protocol",
+           "registered_protocols", "get_protocol", "load_builtin_specs",
+           "SpecError"]
+
+
+class SpecError(ValueError):
+    """A structurally invalid ProtocolSpec (unknown state in a
+    transition, duplicate registration, ...)."""
+
+
+@dataclass(frozen=True)
+class Transition:
+    """One allowed edge of a protocol state machine."""
+
+    src: str
+    action: str
+    dst: str
+
+    def __str__(self) -> str:
+        return f"{self.src} --{self.action}--> {self.dst}"
+
+
+@dataclass(frozen=True)
+class Invariant:
+    """A named safety property the protocol promises; the model checker
+    maps each to a state predicate and reports violations under it."""
+
+    name: str
+    doc: str
+
+
+@dataclass(frozen=True)
+class ProtocolSpec:
+    """A protocol's declared state machine.
+
+    ``states`` is the full state vocabulary, ``initial`` the entry
+    state, ``transitions`` the allowed (src, action, dst) edges and
+    ``invariants`` the named safety properties.  ``terminal`` states are
+    documentation (a process may die in any state; SIGKILL is an
+    environment action, not a protocol edge).
+    """
+
+    name: str
+    description: str
+    states: Tuple[str, ...]
+    initial: str
+    transitions: Tuple[Transition, ...]
+    invariants: Tuple[Invariant, ...] = ()
+    terminal: Tuple[str, ...] = ()
+    module: str = ""
+
+    def __post_init__(self):
+        trans = tuple(t if isinstance(t, Transition) else Transition(*t)
+                      for t in self.transitions)
+        object.__setattr__(self, "transitions", trans)
+        invs = tuple(i if isinstance(i, Invariant) else Invariant(*i)
+                     for i in self.invariants)
+        object.__setattr__(self, "invariants", invs)
+        object.__setattr__(self, "states", tuple(self.states))
+        object.__setattr__(self, "terminal", tuple(self.terminal))
+        known = set(self.states)
+        if self.initial not in known:
+            raise SpecError(f"{self.name}: initial state "
+                            f"{self.initial!r} not in states")
+        for t in self.transitions:
+            if t.src not in known or t.dst not in known:
+                raise SpecError(f"{self.name}: transition {t} references "
+                                f"an undeclared state")
+        for s in self.terminal:
+            if s not in known:
+                raise SpecError(f"{self.name}: terminal state {s!r} not "
+                                f"in states")
+
+    # -- queries -------------------------------------------------------------
+    def allows(self, src: str, action: str, dst: str) -> bool:
+        return Transition(src, action, dst) in self.transitions
+
+    def successors(self, src: str) -> Tuple[Transition, ...]:
+        return tuple(t for t in self.transitions if t.src == src)
+
+    def actions(self) -> Tuple[str, ...]:
+        return tuple(sorted({t.action for t in self.transitions}))
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name, "description": self.description,
+            "module": self.module, "states": list(self.states),
+            "initial": self.initial, "terminal": list(self.terminal),
+            "transitions": [[t.src, t.action, t.dst]
+                            for t in self.transitions],
+            "invariants": [{"name": i.name, "doc": i.doc}
+                           for i in self.invariants],
+        }
+
+
+_REGISTRY: Dict[str, ProtocolSpec] = {}
+
+
+def register_protocol(spec: ProtocolSpec) -> ProtocolSpec:
+    """Register ``spec`` (idempotent for an identical re-registration —
+    module reimport must not fail)."""
+    prev = _REGISTRY.get(spec.name)
+    if prev is not None and prev != spec:
+        raise SpecError(f"protocol {spec.name!r} already registered with "
+                        f"a different machine")
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def registered_protocols() -> Dict[str, ProtocolSpec]:
+    return dict(_REGISTRY)
+
+
+def get_protocol(name: str) -> ProtocolSpec:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"protocol {name!r} is not registered; known: "
+            f"{sorted(_REGISTRY)} (did you call load_builtin_specs()?)")
+    return _REGISTRY[name]
+
+
+def load_builtin_specs() -> Dict[str, ProtocolSpec]:
+    """Import the serving modules that declare the four cluster
+    protocols, populating the registry.  Lazy so that importing
+    ``paddle_tpu.analysis`` never drags the serving stack in."""
+    import importlib
+    for mod in ("paddle_tpu.serving.cluster.replica",
+                "paddle_tpu.serving.cluster.router",
+                "paddle_tpu.serving.cluster.lifecycle",
+                "paddle_tpu.serving.cluster.handoff",
+                "paddle_tpu.serving.sessions"):
+        importlib.import_module(mod)
+    return registered_protocols()

@@ -279,3 +279,35 @@ def deserialize_kv(blob: bytes) -> KVHandoff:
                   pos=int(header["pos"]), meta=dict(header.get("meta") or {}))
     _HANDOFF_SECONDS.observe(time.monotonic() - t0)
     return h
+
+
+# -- declared protocol: the prefill->decode handoff ---------------------------
+# serialize_kv/deserialize_kv above are the ``prefill``/``decode`` legs;
+# the magic + version checks are the ``reject`` door (a torn blob is a
+# retryable failure, never input).  Verified by analysis/protocol.
+from ...analysis.protocol.spec import ProtocolSpec, register_protocol
+
+KV_HANDOFF_SPEC = register_protocol(ProtocolSpec(
+    name="kv-handoff",
+    description="One disaggregated request: prefill serializes the KV "
+                "blob, decode ingests it behind the integrity check, "
+                "retryable failures re-enter, replies are "
+                "exactly-once.",
+    module=__name__,
+    states=("pending", "in_flight", "decoded", "replied", "failed"),
+    initial="pending",
+    terminal=("replied", "failed"),
+    transitions=(
+        ("pending", "prefill", "in_flight"),
+        ("in_flight", "decode", "decoded"),
+        ("in_flight", "reject", "pending"),
+        ("in_flight", "fail", "failed"),
+        ("decoded", "reply", "replied"),
+    ),
+    invariants=(
+        ("no-torn-decode",
+         "decode never executes over a torn handoff blob"),
+        ("reply-at-most-once",
+         "a request is replied to at most once"),
+    ),
+))

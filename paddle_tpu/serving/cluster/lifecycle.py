@@ -564,3 +564,41 @@ class RollingUpdate:
                     "live": len(self._ctrl._live())}
         finally:
             ROLLOUT_ACTIVE.set(0)
+
+
+# -- declared protocol: the rolling-update state machine ---------------------
+# RollingUpdate.run() above implements exactly this machine; the
+# journal's resume (``resumable_for``) re-enters at ``promoting`` and
+# derives remaining ``replace_step``s from ``replaced`` — which is why
+# the model checker's journal-implies-applied invariant is the one that
+# matters: a committed step must be fully applied or resume breaks.
+from ...analysis.protocol.spec import ProtocolSpec, register_protocol
+
+ROLLING_UPDATE_SPEC = register_protocol(ProtocolSpec(
+    name="rolling-update",
+    description="Canary gate, promote-or-rollback, then journaled "
+                "spawn-before-drain replacement of the old fleet.",
+    module=__name__,
+    states=("idle", "canary_gate", "promoting", "complete",
+            "rolled_back"),
+    initial="idle",
+    terminal=("complete", "rolled_back"),
+    transitions=(
+        ("idle", "spawn_canary", "canary_gate"),
+        ("canary_gate", "promote", "promoting"),
+        ("canary_gate", "rollback", "rolled_back"),
+        ("promoting", "replace_step", "promoting"),
+        ("promoting", "finish", "complete"),
+    ),
+    invariants=(
+        ("journal-implies-applied",
+         "a journal-committed replacement step is never half-applied"),
+        ("spawn-before-drain",
+         "an old replica retires only after its replacement spawned"),
+        ("no-mismatched-promotion",
+         "a canary that failed the bit-match gate never enters "
+         "rotation"),
+        ("rollback-is-clean",
+         "rollback leaves the old fleet serving, nothing new behind"),
+    ),
+))

@@ -278,6 +278,48 @@ class Replica:
         return {"arrays": ometa}, oparts
 
 
+# -- declared protocol: the replica lifecycle state machine ------------------
+# Registered beside the implementation so the model checker
+# (analysis/protocol) and a reader of this file see the same machine.
+# ``drain`` may land in ``wedged`` (the drain_hang fault clause above);
+# ``retire`` is _op_drain's deregister leg — tombstone + heartbeat stop,
+# atomic with the drain reply; ``sigkill`` is the environment.
+from ...analysis.protocol.spec import ProtocolSpec, register_protocol
+
+REPLICA_LIFECYCLE_SPEC = register_protocol(ProtocolSpec(
+    name="replica-lifecycle",
+    description="One serving replica from rendezvous registration to "
+                "clean retirement (tombstone) or eviction (heartbeat "
+                "staleness / drain-timeout escalation).",
+    module=__name__,
+    states=("boot", "serving", "draining", "drained", "retired",
+            "wedged", "dead"),
+    initial="boot",
+    terminal=("retired", "dead"),
+    transitions=(
+        ("boot", "register", "serving"),
+        ("serving", "drain", "draining"),
+        ("serving", "drain", "wedged"),          # drain_hang fault
+        ("draining", "drain_complete", "drained"),
+        ("drained", "retire", "retired"),
+        ("wedged", "evict", "dead"),             # timeout escalation
+        ("serving", "sigkill", "dead"),
+        ("draining", "sigkill", "dead"),
+        ("drained", "sigkill", "dead"),
+        ("wedged", "sigkill", "dead"),
+    ),
+    invariants=(
+        ("dispatch-targets-live",
+         "no request is ever executed by a retired or dead replica"),
+        ("tombstone-evict-exclusive",
+         "tombstone-deregister and heartbeat-eviction are mutually "
+         "exclusive outcomes for one registration"),
+        ("no-retire-with-inflight",
+         "the tombstone only lands after the drain actually drained"),
+    ),
+))
+
+
 def replica_main(server, replica_id: Optional[str] = None,
                  store_host: Optional[str] = None,
                  store_port: Optional[int] = None, port: int = 0,

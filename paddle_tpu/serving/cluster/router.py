@@ -802,3 +802,33 @@ class Router:
 
     def __exit__(self, *exc):
         self.close()
+
+
+# -- declared protocol: the router's membership view -------------------------
+# The router-side half of the replica lifecycle: a registration is
+# discovered into rotation exactly once, and leaves it through exactly
+# one of two doors — the controller's deregister after a clean drain,
+# or the heartbeat/escalation evict.  Tombstoned slots are never
+# discovered; an evicted handle is remembered, so discovery cannot
+# resurrect it.  Verified by analysis/protocol (model_check).
+from ...analysis.protocol.spec import ProtocolSpec, register_protocol
+
+ROUTER_MEMBERSHIP_SPEC = register_protocol(ProtocolSpec(
+    name="router-membership",
+    description="A replica registration through the router's rotation: "
+                "discovered once, removed through deregister XOR evict.",
+    module=__name__,
+    states=("unknown", "in_rotation", "deregistered", "evicted"),
+    initial="unknown",
+    terminal=("deregistered", "evicted"),
+    transitions=(
+        ("unknown", "discover", "in_rotation"),
+        ("in_rotation", "deregister", "deregistered"),
+        ("in_rotation", "evict", "evicted"),
+    ),
+    invariants=(
+        ("tombstone-evict-exclusive",
+         "one registration exits rotation through deregister or evict, "
+         "never both"),
+    ),
+))

@@ -314,3 +314,35 @@ class SessionStore:
                 return None
         self.put(snap)
         return snap.session_id
+
+
+# -- declared protocol: the parked-session state machine ---------------------
+# put/take above are ``park``/``restore``; export_bytes/import_bytes the
+# ``export``/``import`` migration legs (move semantics + the t_park
+# keep-newer rule).  Verified by analysis/protocol: exactly one owner
+# (RAM copy, wire blob or decode slot) at all times, and an import
+# never clobbers a fresher park.
+from ..analysis.protocol.spec import ProtocolSpec, register_protocol
+
+SESSION_SPEC = register_protocol(ProtocolSpec(
+    name="session",
+    description="A multi-turn conversation across park, restore, and "
+                "router-driven migration between replicas.",
+    module=__name__,
+    states=("active", "parked", "migrating", "restored"),
+    initial="active",
+    transitions=(
+        ("active", "park", "parked"),
+        ("parked", "restore", "restored"),
+        ("restored", "park", "parked"),
+        ("parked", "export", "migrating"),
+        ("migrating", "import", "parked"),
+    ),
+    invariants=(
+        ("one-owner",
+         "a session never has two owners, and loses its last owner "
+         "only through documented SIGKILL degradation"),
+        ("no-stale-clobber",
+         "an import never overwrites a fresher parked copy"),
+    ),
+))
