@@ -478,11 +478,18 @@ class Router:
     def _discover(self):
         raw = self._store.get(f"{REPLICA_PREFIX}/seq", wait=False)
         n = int(raw) if raw else 0
-        for i in range(self._seen_seq + 1, n + 1):
+        with self._lock:
+            # claim the range before walking it: poll() runs on the
+            # watch thread AND directly (tests, wait_live), and two
+            # unsynchronized walks would both add the same registrations
+            start = self._seen_seq + 1
+            self._seen_seq = max(self._seen_seq, n)
+        done = n
+        for i in range(start, n + 1):
             raw = self._store.get(f"{REPLICA_PREFIX}/{i}", wait=False)
             if raw is None:
                 # reserved but not yet published: retry next poll
-                n = i - 1
+                done = i - 1
                 break
             info = json.loads(raw.decode())
             tomb = self._store.get(
@@ -496,7 +503,12 @@ class Router:
                 info["id"], info["host"], info["port"],
                 role=info.get("role", "both"),
                 version=info.get("version", "v0")))
-        self._seen_seq = max(self._seen_seq, n)
+        if done < n:
+            with self._lock:
+                # un-claim the unpublished tail; re-walking an entry a
+                # concurrent poll claimed past this point is harmless
+                # (add_replica supersedes the endpoint idempotently)
+                self._seen_seq = min(self._seen_seq, done)
 
     def _evict_stale(self):
         alive = self._alive()
