@@ -170,20 +170,26 @@ class AutoscaleController:
         """Forget pending spawns that joined the router (or died)."""
         live_ids = {h.id for h in self._live()}
         now = time.monotonic()
-        for rid in list(self._spawning):
-            if rid in live_ids:
-                del self._spawning[rid]
-                continue
-            tok = self._tokens.get(rid)
-            died = tok is not None and getattr(tok, "poll", lambda: None)() \
-                is not None
-            if died or now - self._spawning[rid] > self._spawn_grace_s:
-                # spawned but never rendezvoused: count it failed so a
-                # later poll can try again
-                del self._spawning[rid]
-                self._tokens.pop(rid, None)
-                AUTOSCALE_SPAWN_FAILURES.inc()
-                _flight.dump("spawn_lost")
+        lost = 0
+        with self._lock:
+            # the poll loop and a rolling update's direct spawn/retire
+            # calls race on this bookkeeping — mutate only under _lock
+            for rid in list(self._spawning):
+                if rid in live_ids:
+                    del self._spawning[rid]
+                    continue
+                tok = self._tokens.get(rid)
+                died = tok is not None and \
+                    getattr(tok, "poll", lambda: None)() is not None
+                if died or now - self._spawning[rid] > self._spawn_grace_s:
+                    # spawned but never rendezvoused: count it failed so
+                    # a later poll can try again
+                    del self._spawning[rid]
+                    self._tokens.pop(rid, None)
+                    lost += 1
+        for _ in range(lost):
+            AUTOSCALE_SPAWN_FAILURES.inc()
+            _flight.dump("spawn_lost")
 
     def pending_spawns(self) -> int:
         with self._lock:
@@ -211,14 +217,17 @@ class AutoscaleController:
                 failed = f"{type(e).__name__}: {e}"
         if failed is not None:
             AUTOSCALE_SPAWN_FAILURES.inc()
-            self._spawn_failures += 1
+            with self._lock:
+                self._spawn_failures += 1
+                failures = self._spawn_failures
             _flight.dump("spawn_fail")
-            if self._spawn_failures > self._max_spawn_retries:
+            if failures > self._max_spawn_retries:
                 raise UnavailableError(
-                    f"replica spawn failed {self._spawn_failures} times "
+                    f"replica spawn failed {failures} times "
                     f"in a row (last: {failed}) — scale-up abandoned")
             return None
-        self._spawn_failures = 0
+        with self._lock:
+            self._spawn_failures = 0
         if isinstance(token, ReplicaHandle):
             token.version = ver
             self.router.add_replica(token)
@@ -284,7 +293,8 @@ class AutoscaleController:
         return out
 
     def _await_token_exit(self, rid: str, grace_s: float = 10.0) -> None:
-        tok = self._tokens.pop(rid, None)
+        with self._lock:
+            tok = self._tokens.pop(rid, None)
         if tok is None or not hasattr(tok, "poll"):
             return
         deadline = time.monotonic() + grace_s
@@ -297,7 +307,8 @@ class AutoscaleController:
                 pass
 
     def _kill_token(self, rid: str) -> None:
-        tok = self._tokens.pop(rid, None)
+        with self._lock:
+            tok = self._tokens.pop(rid, None)
         if tok is not None and getattr(tok, "poll", lambda: 0)() is None:
             try:
                 tok.kill()
