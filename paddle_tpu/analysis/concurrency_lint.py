@@ -1,0 +1,423 @@
+"""AST concurrency lint over the lock-using serving modules.
+
+The serving plane is host-side lock-and-condvar code (scheduler queues,
+session stores, router tables, autoscaler bookkeeping).  The jaxpr
+passes can't see it and the chaos drills only sample it; this lint
+makes the locking discipline *declared* and then checks it statically:
+
+  * ``# guarded-by: <lock>`` — a trailing comment on a shared-mutable
+    field's assignment declares which lock protects it.  Every access
+    (read or write) to ``self.<field>`` anywhere in the class must then
+    be lexically under ``with self.<lock>:`` — with three deliberate
+    outs that match the codebase's conventions:
+
+      - ``__init__``/``__new__`` construct before publication;
+      - methods named ``*_locked`` declare "caller holds the lock"
+        (``_spill_locked``, ``_drop_affinity_locked``, ...);
+      - a private helper whose every call site holds the lock (or is
+        itself construction/guarded) inherits the guard — computed as a
+        greatest fixpoint over the class's self-call graph, so
+        ``_publish_bytes`` called only from guarded methods needs no
+        rename.
+
+  * lock-acquisition-order graph — nodes are ``Class.lockattr`` for
+    every ``threading.Lock/RLock/Condition`` attribute, edges are
+    nested acquisitions (lexical ``with`` nesting plus one level of
+    self-calls: a call made while holding A to a method that acquires B
+    adds A→B).  Any cycle — including the 1-cycle of re-acquiring a
+    non-reentrant lock — is a deadlock hazard.
+
+Deliberate non-goals (documented so findings stay trustworthy): code
+inside nested ``def``/``lambda`` is skipped (deferred execution — the
+lint cannot know the locks held when it runs); locks reached through
+other objects (``with h._lock:`` on a handle) are not graph nodes; the
+order graph is per-file.  Zero findings on the real serving tree is a
+tier-1 gate (tools/proto_check.py --strict); the seeded mutations in
+``analysis/protocol/mutations.py`` prove the detectors fire.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .diagnostics import Diagnostic, LintReport, Severity
+
+__all__ = ["CHECKS", "lint_source", "lint_file", "lint_paths",
+           "serving_modules", "lint_serving_tree"]
+
+# check inventory (id -> (severity, doc)) — surfaced in docs/LINT.md
+CHECKS = {
+    "guarded-field": (
+        Severity.ERROR,
+        "an access to a `# guarded-by:` annotated shared-mutable field "
+        "outside its declared lock (not under `with self.<lock>:`, not "
+        "in __init__, not in a *_locked method, and not in a private "
+        "helper whose every call site holds the lock)"),
+    "guard-unknown-lock": (
+        Severity.ERROR,
+        "a `# guarded-by:` annotation naming an attribute that is not a "
+        "recognized threading.Lock/RLock/Condition of the class — the "
+        "declaration would silently protect nothing"),
+    "lock-order-cycle": (
+        Severity.ERROR,
+        "a cycle in the lock-acquisition-order graph (nested `with` "
+        "blocks plus one level of self-calls), including re-acquiring a "
+        "non-reentrant lock — a deadlock hazard two threads can "
+        "realize"),
+}
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition",
+               "Semaphore": "lock", "BoundedSemaphore": "lock"}
+
+
+def _lock_ctor_kind(call: ast.AST) -> Optional[str]:
+    """'lock'/'rlock'/'condition' when ``call`` is threading.Lock() /
+    Lock() / threading.Condition(...) etc., else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_CTORS \
+            and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "threading":
+        return _LOCK_CTORS[fn.attr]
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_CTORS:
+        return _LOCK_CTORS[fn.id]
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclass
+class _MethodFacts:
+    """Lexically-collected facts about one method body."""
+
+    name: str
+    node: ast.AST
+    accesses: List[Tuple[str, ast.AST, FrozenSet[str]]] = field(
+        default_factory=list)      # (field, node, locks held)
+    acquires: List[Tuple[str, ast.AST, FrozenSet[str]]] = field(
+        default_factory=list)      # (lock, with-node, locks held before)
+    calls: List[Tuple[str, ast.AST, FrozenSet[str]]] = field(
+        default_factory=list)      # (callee, node, locks held)
+
+
+@dataclass
+class _ClassFacts:
+    name: str
+    node: ast.ClassDef
+    locks: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    guards: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    methods: Dict[str, _MethodFacts] = field(default_factory=dict)
+
+
+def _scan_method(cls_locks: Dict[str, Tuple[str, int]],
+                 guard_fields: Set[str], meth: ast.AST) -> _MethodFacts:
+    facts = _MethodFacts(name=meth.name, node=meth)
+
+    def visit(node: ast.AST, held: FrozenSet[str]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # deferred execution: skipped (see module docstring)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            newly = []
+            for item in node.items:
+                visit(item.context_expr, held)
+                if item.optional_vars is not None:
+                    visit(item.optional_vars, held)
+                lk = _self_attr(item.context_expr)
+                if lk in cls_locks:
+                    newly.append(lk)
+            for lk in newly:
+                facts.acquires.append((lk, node, held))
+            inner = held | frozenset(newly)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr in guard_fields:
+            facts.accesses.append((attr, node, held))
+        if isinstance(node, ast.Call):
+            callee = _self_attr(node.func)
+            if callee is not None:
+                facts.calls.append((callee, node, held))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in meth.body:
+        visit(stmt, frozenset())
+    return facts
+
+
+def _collect_class(cls: ast.ClassDef, lines: List[str]) -> _ClassFacts:
+    out = _ClassFacts(name=cls.name, node=cls)
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    # pass 1: lock attributes + guarded-by annotations (annotations live
+    # as trailing comments, which ast drops — read the raw source line)
+    for meth in methods:
+        for node in ast.walk(meth):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            value = node.value
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                kind = _lock_ctor_kind(value) if value is not None else None
+                if kind is not None:
+                    out.locks.setdefault(attr, (kind, node.lineno))
+                end = getattr(node, "end_lineno", node.lineno)
+                for ln in range(node.lineno, min(end, len(lines)) + 1):
+                    m = _GUARD_RE.search(lines[ln - 1])
+                    if m:
+                        out.guards.setdefault(attr, (m.group(1),
+                                                     node.lineno))
+                        break
+    # pass 2: per-method facts
+    guard_fields = set(out.guards)
+    for meth in methods:
+        out.methods[meth.name] = _scan_method(out.locks, guard_fields, meth)
+    return out
+
+
+def _safe_contexts(cf: _ClassFacts) -> Dict[str, Dict[str, bool]]:
+    """Greatest fixpoint of safe(method, lock): the method's body may
+    touch lock-guarded state without acquiring — because it IS
+    construction, declares *_locked, or is a private helper whose every
+    call site is itself safe or holds the lock."""
+    locks = list(cf.locks)
+    sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {
+        m: [] for m in cf.methods}
+    for caller, facts in cf.methods.items():
+        for callee, _node, held in facts.calls:
+            if callee in sites:
+                sites[callee].append((caller, held))
+
+    def base(name: str) -> Optional[bool]:
+        """Fixed verdict, or None for fixpoint-computed methods."""
+        if name in ("__init__", "__new__"):
+            return True
+        if name.endswith("_locked"):
+            return True
+        if not name.startswith("_") or name.startswith("__"):
+            return False            # externally callable: assume nothing
+        return None
+
+    safe = {m: {lk: (base(m) if base(m) is not None else True)
+                for lk in locks} for m in cf.methods}
+    changed = True
+    while changed:
+        changed = False
+        for m in cf.methods:
+            if base(m) is not None:
+                continue
+            for lk in locks:
+                if not safe[m][lk]:
+                    continue
+                ok = bool(sites[m]) and all(
+                    lk in held or safe.get(caller, {}).get(lk, False)
+                    for caller, held in sites[m])
+                if not ok:
+                    safe[m][lk] = False
+                    changed = True
+    return safe
+
+
+def _loc(filename: str, node: ast.AST) -> str:
+    return f"{filename}:{getattr(node, 'lineno', 0)}"
+
+
+def _guard_diagnostics(cf: _ClassFacts, filename: str,
+                       site: str) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for fld, (lock, lineno) in sorted(cf.guards.items()):
+        if lock not in cf.locks:
+            diags.append(Diagnostic(
+                pass_id="guard-unknown-lock", severity=Severity.ERROR,
+                message=f"{cf.name}.{fld} declares `guarded-by: {lock}` "
+                        f"but {cf.name} has no threading lock attribute "
+                        f"named {lock!r}",
+                site=site, kind="concurrency",
+                location=f"{filename}:{lineno}"))
+    known_guards = {f: lk for f, (lk, _ln) in cf.guards.items()
+                    if lk in cf.locks}
+    if not known_guards:
+        return diags
+    safe = _safe_contexts(cf)
+    for mname, facts in cf.methods.items():
+        for fld, node, held in facts.accesses:
+            lock = known_guards.get(fld)
+            if lock is None:
+                continue
+            if lock in held or safe[mname].get(lock, False):
+                continue
+            diags.append(Diagnostic(
+                pass_id="guarded-field", severity=Severity.ERROR,
+                message=f"{cf.name}.{mname} touches self.{fld} "
+                        f"(guarded-by: {lock}) without holding "
+                        f"self.{lock} — wrap in `with self.{lock}:`, "
+                        f"rename the helper `*_locked`, or call it only "
+                        f"under the lock",
+                site=site, kind="concurrency",
+                location=_loc(filename, node)))
+    return diags
+
+
+def _order_edges(cf: _ClassFacts) -> Dict[Tuple[str, str],
+                                          Tuple[str, int, str]]:
+    """Directed acquisition-order edges among this class's locks:
+    (A, B) -> (filename-agnostic witness: method, lineno, why)."""
+    # transitive self-acquisitions: locks a call to m may take
+    acq: Dict[str, Set[str]] = {m: {lk for lk, _n, _h in f.acquires}
+                                for m, f in cf.methods.items()}
+    changed = True
+    while changed:
+        changed = False
+        for m, f in cf.methods.items():
+            for callee, _n, _h in f.calls:
+                extra = acq.get(callee, set()) - acq[m]
+                if extra:
+                    acq[m] |= extra
+                    changed = True
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for m, f in cf.methods.items():
+        for lk, node, held in f.acquires:
+            for h in held:
+                edges.setdefault(
+                    (h, lk),
+                    (m, getattr(node, "lineno", 0),
+                     f"`with self.{lk}:` nested under self.{h}"))
+        for callee, node, held in f.calls:
+            for h in held:
+                for lk in acq.get(callee, ()):  # call under h takes lk
+                    edges.setdefault(
+                        (h, lk),
+                        (m, getattr(node, "lineno", 0),
+                         f"call to self.{callee}() (which acquires "
+                         f"self.{lk}) while holding self.{h}"))
+    return edges
+
+
+def _cycles(nodes: Set[str],
+            edges: Set[Tuple[str, str]]) -> List[Tuple[str, ...]]:
+    """All elementary cycles, canonicalized (rotated to min node)."""
+    adj: Dict[str, List[str]] = {n: [] for n in nodes}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    found: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, cur: str, path: List[str], seen: Set[str]):
+        for nxt in adj.get(cur, ()):  # small graphs — plain DFS is fine
+            if nxt == start:
+                cyc = tuple(path)
+                k = cyc.index(min(cyc))
+                found.add(cyc[k:] + cyc[:k])
+            elif nxt not in seen and nxt > start:
+                # only enumerate cycles from their min node
+                dfs(start, nxt, path + [nxt], seen | {nxt})
+
+    for n in sorted(nodes):
+        dfs(n, n, [n], {n})
+    return sorted(found)
+
+
+def _order_diagnostics(classes: List[_ClassFacts], filename: str,
+                       site: str) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    nodes: Set[str] = set()
+    edges: Set[Tuple[str, str]] = set()
+    where: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    kinds: Dict[str, str] = {}
+    for cf in classes:
+        for lk, (kind, _ln) in cf.locks.items():
+            nodes.add(f"{cf.name}.{lk}")
+            kinds[f"{cf.name}.{lk}"] = kind
+        for (a, b), wit in _order_edges(cf).items():
+            qa, qb = f"{cf.name}.{a}", f"{cf.name}.{b}"
+            edges.add((qa, qb))
+            where[(qa, qb)] = wit
+    for cyc in _cycles(nodes, edges):
+        if len(cyc) == 1 and kinds.get(cyc[0]) == "rlock":
+            continue   # re-entrant by construction
+        ring = list(cyc) + [cyc[0]]
+        steps = []
+        lineno = 0
+        for a, b in zip(ring, ring[1:]):
+            m, ln, why = where.get((a, b), ("?", 0, f"{a} -> {b}"))
+            lineno = lineno or ln
+            steps.append(f"{m}:{ln} {why}")
+        what = ("re-acquisition of non-reentrant" if len(cyc) == 1
+                else "acquisition-order cycle among")
+        diags.append(Diagnostic(
+            pass_id="lock-order-cycle", severity=Severity.ERROR,
+            message=f"{what} {' -> '.join(ring)}: " + "; ".join(steps)
+                    + " — two threads interleaving these acquisitions "
+                      "deadlock",
+            site=site, kind="concurrency",
+            location=f"{filename}:{lineno}"))
+    return diags
+
+
+def lint_source(source: str, filename: str = "<module>",
+                site: str = "") -> List[Diagnostic]:
+    """Run the concurrency checks over one module's source text."""
+    site = site or f"concurrency:{os.path.basename(filename)}"
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Diagnostic(
+            pass_id="guarded-field", severity=Severity.WARNING,
+            message=f"could not parse {filename} for concurrency lint: "
+                    f"{e}", site=site, kind="concurrency",
+            location=f"{filename}:{getattr(e, 'lineno', 0)}")]
+    lines = source.splitlines()
+    classes = [_collect_class(n, lines) for n in ast.walk(tree)
+               if isinstance(n, ast.ClassDef)]
+    diags: List[Diagnostic] = []
+    for cf in classes:
+        diags.extend(_guard_diagnostics(cf, filename, site))
+    diags.extend(_order_diagnostics(classes, filename, site))
+    return diags
+
+
+def lint_file(path: str, site: str = "") -> List[Diagnostic]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, filename=path, site=site)
+
+
+def serving_modules(root: Optional[str] = None) -> List[str]:
+    """Every .py under paddle_tpu/serving — the lock-using surface the
+    tier-1 gate lints (modules without locks or annotations are
+    trivially clean)."""
+    if root is None:
+        root = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "serving")
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        for name in sorted(files):
+            if name.endswith(".py"):
+                out.append(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+def lint_paths(paths) -> LintReport:
+    report = LintReport(site="concurrency", kind="concurrency")
+    for p in paths:
+        report.extend(lint_file(p))
+    return report
+
+
+def lint_serving_tree(root: Optional[str] = None) -> LintReport:
+    return lint_paths(serving_modules(root))

@@ -155,10 +155,10 @@ class AutoscaleController:
         self._lock = threading.Lock()
         self._idle = 0
         self._cooldown = 0
-        self._spawn_seq = 0
-        self._spawn_failures = 0          # consecutive, reset on success
-        self._spawning: Dict[str, float] = {}   # id -> spawn monotonic
-        self._tokens: Dict[str, Any] = {}       # id -> process token
+        self._spawn_seq = 0                      # guarded-by: _lock
+        self._spawn_failures = 0                 # guarded-by: _lock
+        self._spawning: Dict[str, float] = {}    # guarded-by: _lock
+        self._tokens: Dict[str, Any] = {}        # guarded-by: _lock
         self.decisions: List[dict] = []         # drill-report trail
         AUTOSCALE_TARGET.set(self.min_replicas)
 

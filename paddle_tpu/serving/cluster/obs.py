@@ -328,11 +328,11 @@ class ClusterObserver:
                  max_spans_per_poll: int = 2048):
         self._router = router
         self._lock = threading.Lock()
-        self._dumps: Dict[str, dict] = {}      # source -> last dump
-        self._deltas: Dict[str, float] = {}    # source mono -> router wall
-        self._offsets: Dict[str, float] = {}   # replica wall - router wall
-        self._shipped: Dict[str, int] = {}
-        self._signals: Optional[ClusterSignals] = None
+        self._dumps: Dict[str, dict] = {}      # guarded-by: _lock
+        self._deltas: Dict[str, float] = {}    # guarded-by: _lock
+        self._offsets: Dict[str, float] = {}   # guarded-by: _lock
+        self._shipped: Dict[str, int] = {}     # guarded-by: _lock
+        self._signals: Optional[ClusterSignals] = None  # guarded-by: _lock
         self._max_spans = int(max_spans_per_poll)
         self._trace_dir = trace_dir
         self._writer = None

@@ -359,15 +359,15 @@ class Router:
     def __init__(self, replicas: Tuple[ReplicaHandle, ...] = (),
                  store=None, stale_after_s: Optional[float] = None,
                  watch: bool = True, dispatch_workers: int = 8):
-        self._handles: Dict[str, ReplicaHandle] = {}
+        self._handles: Dict[str, ReplicaHandle] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         # session affinity (FLAGS_session_store): session_id -> the
         # replica holding its parked KV planes.  Advisory — a missing or
         # dead owner degrades to least-loaded dispatch and the turn
         # re-prefills (bit-identical), never fails.
-        self._affinity: Dict[str, str] = {}
+        self._affinity: Dict[str, str] = {}           # guarded-by: _lock
         self._store = store
-        self._seen_seq = 0
+        self._seen_seq = 0                            # guarded-by: _lock
         self._stale_after = float(
             stale_after_s if stale_after_s is not None
             else _flags.flag("router_stale_after_s"))

@@ -167,7 +167,7 @@ class RpcClient:
         self.host, self.port = host, int(port)
         self._timeout = timeout
         self._lock = threading.Lock()
-        self._sock: Optional[socket.socket] = None
+        self._sock: Optional[socket.socket] = None  # guarded-by: _lock
 
     def _ensure(self):
         if self._sock is None:
@@ -212,7 +212,8 @@ class RpcClient:
                 raise ue
             raise exc
         if status != b"ok":
-            self._drop()
+            with self._lock:
+                self._drop()
             raise ConnectionError("rpc protocol desync")
         return rmeta, rparts
 

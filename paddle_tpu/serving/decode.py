@@ -143,7 +143,8 @@ class _DecodeRuntime:
         # the prefix cache is built per-runtime in _warmup_slots
         self.session_store = None
         self.prefix_cache = None
-        self.counters = {"requests": 0, "completed": 0, "errors": 0,
+        self.counters = {"requests": 0, "completed": 0,  # guarded-by: _mlock
+                         "errors": 0,
                          "batches": 0, "rows": 0, "padded_rows": 0,
                          "steady_compiles": 0}
 

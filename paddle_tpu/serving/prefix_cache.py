@@ -73,31 +73,36 @@ class PrefixCache:
         self.T = int(block_tokens)
         self.block_nbytes = int(block_nbytes)
         self.budget_bytes = int(float(hbm_budget_mb) * 1024 * 1024)
-        self._root = _Node(None, None)
-        self._nodes = 0
-        self._tick = 0
+        self._root = _Node(None, None)   # guarded-by: _lock
+        self._nodes = 0                  # guarded-by: _lock
+        self._tick = 0                   # guarded-by: _lock
         self._lock = threading.Lock()
-        self._hits = 0
-        self._misses = 0
-        self._hit_tokens = 0
-        self._evictions = 0
+        self._hits = 0                   # guarded-by: _lock
+        self._misses = 0                 # guarded-by: _lock
+        self._hit_tokens = 0             # guarded-by: _lock
+        self._evictions = 0              # guarded-by: _lock
 
     # -- internals -----------------------------------------------------------
     def _touch(self, node: _Node) -> None:
         self._tick += 1
         node.last_use = self._tick
 
-    def nbytes(self) -> int:
+    def _nbytes_locked(self) -> int:
         return self._nodes * self.block_nbytes
 
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._nbytes_locked()
+
     def __len__(self) -> int:
-        return self._nodes
+        with self._lock:
+            return self._nodes
 
     def _evict_until_fits(self) -> None:
         if self.budget_bytes <= 0:
             return
         try:
-            while self.nbytes() > self.budget_bytes:
+            while self._nbytes_locked() > self.budget_bytes:
                 victim = None
                 stack = [self._root]
                 while stack:
@@ -115,7 +120,7 @@ class PrefixCache:
                 self._evictions += 1
                 PREFIX_EVICTIONS.labels(reason="capacity").inc()
         finally:
-            PREFIX_BYTES.set(self.nbytes())
+            PREFIX_BYTES.set(self._nbytes_locked())
 
     # -- public API ----------------------------------------------------------
     def lookup(self, tokens: Sequence[int],
@@ -186,7 +191,7 @@ class PrefixCache:
                 self._touch(child)
                 node = child
             self._evict_until_fits()
-            PREFIX_BYTES.set(self.nbytes())
+            PREFIX_BYTES.set(self._nbytes_locked())
         return new
 
     def clear(self) -> None:
@@ -200,7 +205,7 @@ class PrefixCache:
 
     def stats(self) -> dict:
         with self._lock:
-            return {"blocks": self._nodes, "bytes": self.nbytes(),
+            return {"blocks": self._nodes, "bytes": self._nbytes_locked(),
                     "hits": self._hits, "misses": self._misses,
                     "hit_tokens": self._hit_tokens,
                     "evictions": self._evictions}

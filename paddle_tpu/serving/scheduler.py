@@ -157,21 +157,21 @@ class RequestQueue:
     def __init__(self, capacity: int):
         self._capacity = int(capacity)
         self._cond = threading.Condition()
-        self._pending: "OrderedDict[str, deque]" = OrderedDict()
-        self._depth = 0
-        self._closed = False
+        self._pending: "OrderedDict[str, deque]" = OrderedDict()  # guarded-by: _cond
+        self._depth = 0                                           # guarded-by: _cond
+        self._closed = False                                      # guarded-by: _cond
         # drain-rate EWMA (requests/s popped by the batcher): the basis
         # of the machine-readable retry-after hint a backpressure
         # rejection carries — "one slot frees in about 1/rate seconds"
-        self._drain_ewma = 0.0
+        self._drain_ewma = 0.0                                    # guarded-by: _cond
         self._last_pop_mono: Optional[float] = None
         # staleness epoch for the hint decay: the last instant the queue
         # made progress while work was pending (a pop, or the put that
         # took it from empty).  None until work first arrives.
         self._last_progress_mono: Optional[float] = None
         # per-tenant admission state
-        self._tenant_pending: Dict[str, int] = {}
-        self._tenant_policy: Dict[str, dict] = {}
+        self._tenant_pending: Dict[str, int] = {}                 # guarded-by: _cond
+        self._tenant_policy: Dict[str, dict] = {}                 # guarded-by: _cond
 
     def set_tenant_policy(self, tenant: str,
                           max_pending: Optional[int] = None,

@@ -201,7 +201,8 @@ class _ModelRuntime:
         self.latency = LatencyWindow(int(_flags.flag("serving_metrics_window")))
         self.rate = RateMeter()
         self._mlock = threading.Lock()
-        self.counters = {"requests": 0, "completed": 0, "errors": 0,
+        self.counters = {"requests": 0, "completed": 0,  # guarded-by: _mlock
+                         "errors": 0,
                          "batches": 0, "rows": 0, "padded_rows": 0,
                          "steady_compiles": 0}
 

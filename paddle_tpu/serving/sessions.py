@@ -154,10 +154,10 @@ class SessionStore:
     def __init__(self, spill_dir: str = "", park_after_ms: int = 0):
         self._dir = str(spill_dir or "")
         self._park_after_ms = int(park_after_ms)
-        self._ram: Dict[str, SessionSnapshot] = {}
+        self._ram: Dict[str, SessionSnapshot] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._ram_bytes = 0
-        self._disk_bytes: Dict[str, int] = {}
+        self._ram_bytes = 0                         # guarded-by: _lock
+        self._disk_bytes: Dict[str, int] = {}       # guarded-by: _lock
         if self._dir:
             os.makedirs(self._dir, exist_ok=True)
             self._scan_disk()

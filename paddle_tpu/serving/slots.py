@@ -170,13 +170,13 @@ class SlotLoop:
             self._pull_block = gen.pull_block_exec(self.S, self.T, self.C)
         if session_store is not None:
             self._pull_row = gen.pull_row_exec(self.S, self.C)
-        self._park_req = None           # (event, out) drain-park handshake
+        self._park_req = None           # guarded-by: _cond  (drain-park handshake)
         self._cond = threading.Condition()
-        self._pending: "deque[SlotRequest]" = deque()
-        self._slots = [_Slot() for _ in range(self.S)]
-        self._closed = False
-        self._dead: Optional[BaseException] = None
-        self._thread: Optional[threading.Thread] = None
+        self._pending: "deque[SlotRequest]" = deque()       # guarded-by: _cond
+        self._slots = [_Slot() for _ in range(self.S)]  # driver-thread-owned
+        self._closed = False                                # guarded-by: _cond
+        self._dead: Optional[BaseException] = None          # guarded-by: _cond
+        self._thread: Optional[threading.Thread] = None     # guarded-by: _cond
         # device/host loop state (driver-thread-owned after start)
         self._reset_session()
         self.counters = {"joined": 0, "retired": 0, "steps": 0,
@@ -322,7 +322,7 @@ class SlotLoop:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
-        t = self._thread
+            t = self._thread
         if t is not None:
             t.join(timeout=30)
 

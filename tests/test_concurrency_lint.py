@@ -1,0 +1,273 @@
+"""Concurrency lint: guarded-by discipline + lock-acquisition-order
+cycles, on synthetic sources and as the gate over the real serving tree.
+"""
+import textwrap
+
+from paddle_tpu.analysis import concurrency_lint as cl
+
+
+def _lint(src):
+    return cl.lint_source(textwrap.dedent(src), filename="case.py")
+
+
+def _ids(diags):
+    return sorted(d.pass_id for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# guarded-field
+# ---------------------------------------------------------------------------
+
+def test_unguarded_write_flagged():
+    diags = _lint("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}  # guarded-by: _lock
+
+            def put(self, k, v):
+                self._items[k] = v
+    """)
+    assert _ids(diags) == ["guarded-field"]
+    assert "put" in diags[0].message and "_items" in diags[0].message
+
+
+def test_access_under_lock_clean():
+    diags = _lint("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}  # guarded-by: _lock
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+    """)
+    assert not diags
+
+
+def test_init_and_locked_suffix_are_exempt():
+    diags = _lint("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: _lock
+                self._n += 1          # still __init__: no sharing yet
+
+            def _bump_locked(self):
+                self._n += 1          # caller-holds-lock convention
+    """)
+    assert not diags
+
+
+def test_private_helper_fixpoint():
+    # _bump is safe iff every call site holds the lock; one unlocked
+    # call site poisons it.
+    clean = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: _lock
+
+            def _bump(self):
+                self._n += 1
+
+            def tick(self):
+                with self._lock:
+                    self._bump()
+    """
+    assert not _lint(clean)
+    dirty = clean + """
+            def rogue(self):
+                self._bump()
+    """
+    diags = _lint(dirty)
+    assert "guarded-field" in _ids(diags)
+
+
+def test_condition_counts_as_lock():
+    diags = _lint("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._q = []  # guarded-by: _cond
+
+            def pop(self):
+                with self._cond:
+                    return self._q.pop()
+    """)
+    assert not diags
+
+
+# ---------------------------------------------------------------------------
+# guard-unknown-lock
+# ---------------------------------------------------------------------------
+
+def test_annotation_naming_nonexistent_lock_flagged():
+    diags = _lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0  # guarded-by: _lok
+    """)
+    assert _ids(diags) == ["guard-unknown-lock"]
+    assert "_lok" in diags[0].message
+
+
+# ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
+
+def test_two_lock_cycle_flagged():
+    diags = _lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert _ids(diags) == ["lock-order-cycle"]
+
+
+def test_consistent_order_clean():
+    diags = _lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def ab2(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """)
+    assert not diags
+
+
+def test_nonreentrant_self_nest_flagged_rlock_ok():
+    lock_case = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.{ctor}()
+
+            def outer(self):
+                with self._a:
+                    self._inner()
+
+            def _inner(self):
+                with self._a:
+                    pass
+    """
+    assert "lock-order-cycle" in _ids(
+        _lint(lock_case.format(ctor="Lock")))
+    assert not _lint(lock_case.format(ctor="RLock"))
+
+
+def test_cycle_through_call_under_lock():
+    diags = _lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    self.two_body()
+
+            def two_body(self):
+                with self._b:
+                    pass
+
+            def other(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert "lock-order-cycle" in _ids(diags)
+
+
+# ---------------------------------------------------------------------------
+# robustness
+# ---------------------------------------------------------------------------
+
+def test_syntax_error_is_a_warning_not_a_crash():
+    diags = cl.lint_source("def broken(:\n", filename="bad.py")
+    assert len(diags) == 1
+    assert diags[0].severity.name == "WARNING"
+
+
+def test_unannotated_class_is_trivially_clean():
+    assert not _lint("""
+        class Plain:
+            def __init__(self):
+                self.x = 0
+
+            def bump(self):
+                self.x += 1
+    """)
+
+
+# ---------------------------------------------------------------------------
+# the real serving tree is the conformance corpus
+# ---------------------------------------------------------------------------
+
+def test_serving_tree_lints_clean():
+    report = cl.lint_serving_tree()
+    assert len(report) == 0, report.format()
+
+
+def test_serving_tree_covers_the_lock_using_modules():
+    mods = {m.rsplit("/", 1)[-1] for m in cl.serving_modules()}
+    assert {"sessions.py", "scheduler.py", "slots.py", "router.py",
+            "lifecycle.py", "rpc.py", "prefix_cache.py"} <= mods
+
+
+def test_lint_mutations_caught():
+    from paddle_tpu.analysis.protocol import mutations as mu
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for mid, m in sorted(mu.LINT_MUTATIONS.items()):
+        if m.target == "<corpus>":
+            source = mu.ORDER_CORPUS_SOURCE
+        else:
+            with open(os.path.join(repo, m.target), encoding="utf-8") as f:
+                source = f.read()
+        mutated = m.apply(source)
+        assert mutated is not None, f"{mid}: anchor gone — corpus stale"
+        fired = [d for d in cl.lint_source(mutated, filename=m.target)
+                 if d.pass_id == m.expect_pass]
+        assert fired, f"{mid}: {m.expect_pass} did not fire"
