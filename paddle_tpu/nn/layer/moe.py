@@ -353,6 +353,7 @@ class MoELayer(Layer):
         self.experts = ExpertFFN(self.num_experts, self.d_model,
                                  self.d_hidden, activation)
         self._aux = None
+        self._aux_in = None
         self.register_buffer("_moe_dropped",
                              Tensor(jnp.zeros((), jnp.float32)))
         self.register_buffer("_moe_load",
@@ -447,8 +448,10 @@ class MoELayer(Layer):
         # barrier the float gating outputs as well: the gate projection
         # and softmax then live in a fusion region whose contents are
         # identical whatever dispatch runs next door, so the gate
-        # weight's gradient contraction never reassociates
-        probs, gates = _isolate(probs), _isolate(gates)
+        # weight's gradient contraction never reassociates.  probs only
+        # feeds the aux loss, which is deferred to aux_loss() below —
+        # its barrier defers with it
+        gates = _isolate(gates)
         cap = self.capacity_for(U)
         plan = _routing.expert_dispatch_plan(
             eids.reshape(n, (U // n) * k), n_experts=E, cap=cap)
@@ -482,10 +485,14 @@ class MoELayer(Layer):
         rows = _isolate(rows)
         out = jnp.sum(rows.reshape(U, k, D)
                       * gates[..., None].astype(rows.dtype), axis=1)
-        # aux loss + in-graph stats: pre-capacity fractions shape the
-        # gate; dropped/load land in buffers the step donates like any
-        # other state (publish_moe_metrics flushes them host-side)
-        self._aux = load_balance_loss(probs, eids, n)
+        # aux-loss ingredients + in-graph stats: pre-capacity fractions
+        # shape the gate; dropped/load land in buffers the step donates
+        # like any other state (publish_moe_metrics flushes them
+        # host-side).  The loss itself is computed lazily in aux_loss()
+        # — a forward whose caller never sums it (every inference step)
+        # must not trace it as dead compute (graph-lint dead-fetch)
+        self._aux_in = (probs, eids, n)
+        self._aux = None
         self._moe_dropped.set_value(
             Tensor(jnp.sum(plan.dropped).astype(jnp.float32)))
         self._moe_load.set_value(Tensor(
@@ -496,7 +503,12 @@ class MoELayer(Layer):
 
     def aux_loss(self):
         """The load-balance loss of the LAST forward (a traced value
-        inside the same trace; the model sums these into its loss)."""
+        inside the same trace; the model sums these into its loss).
+        Emitted on first call from that forward's stored gating outputs
+        — identical value, but never traced when nothing consumes it."""
+        if self._aux is None and self._aux_in is not None:
+            probs, eids, n = self._aux_in
+            self._aux = load_balance_loss(_isolate(probs), eids, n)
         return self._aux
 
     def wire_bytes(self, n_tokens: int, itemsize: int = 4) -> int:

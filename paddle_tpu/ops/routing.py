@@ -87,12 +87,18 @@ class PackPlan(NamedTuple):
     overflow: jnp.ndarray    # bool: some owner's count exceeded cap
 
 
-def pack_by_owner(ids, *, n_shards: int, rps: int, cap: int) -> PackPlan:
+def pack_by_owner(ids, *, n_shards: int, rps: int, cap: int,
+                  with_send: bool = True) -> PackPlan:
     """Group a request slice by owner shard into a ``[n*cap]`` send buffer.
 
     ``ids`` is ``[u]`` int (sentinel ``< 0`` entries are excluded and never
     consume cap).  Pure jnp — usable outside any mesh for tests, and
-    traced inside shard_map bodies for the real thing.
+    traced inside shard_map bodies for the real thing.  Callers that only
+    need the slot/count bookkeeping (expert_dispatch_plan) pass
+    ``with_send=False`` and get ``send_ids=None``/``overflow=None`` —
+    the send-buffer scatter and the overflow reduction would otherwise
+    be built and thrown away every step (such callers count drops from
+    ``pos`` directly).
     """
     u = ids.shape[0]
     ids = ids.astype(jnp.int32)
@@ -106,14 +112,17 @@ def pack_by_owner(ids, *, n_shards: int, rps: int, cap: int) -> PackPlan:
     ok = (so < n_shards) & (rank < cap)
     # the +1 tail slot absorbs every dropped write (OOB-free scatter)
     slot = jnp.where(ok, so.astype(jnp.int32) * cap + rank, n_shards * cap)
-    send = jnp.full((n_shards * cap + 1,), -1, jnp.int32).at[slot].set(
-        ids[order])[:-1]
+    send = None
+    if with_send:
+        send = jnp.full((n_shards * cap + 1,), -1, jnp.int32).at[slot].set(
+            ids[order])[:-1]
     pos = jnp.full((u,), -1, jnp.int32).at[order].set(
         jnp.where(ok, slot, -1).astype(jnp.int32))
     counts = jax.ops.segment_sum(valid.astype(jnp.int32),
                                  jnp.clip(owner, 0, n_shards - 1),
                                  num_segments=n_shards)
-    return PackPlan(send, pos, counts, jnp.max(counts) > cap)
+    overflow = (jnp.max(counts) > cap) if with_send else None
+    return PackPlan(send, pos, counts, overflow)
 
 
 def _scatter_to_slots(values, pos, n_slots):
@@ -317,7 +326,8 @@ def expert_dispatch_plan(expert_ids, *, n_experts: int,
     owner = expert (``rps = 1``), vmapped over the group axis."""
     eids = jnp.asarray(expert_ids, jnp.int32)
     plan = jax.vmap(functools.partial(
-        pack_by_owner, n_shards=int(n_experts), rps=1, cap=int(cap)))(eids)
+        pack_by_owner, n_shards=int(n_experts), rps=1, cap=int(cap),
+        with_send=False))(eids)
     kept = jnp.sum((plan.pos >= 0).astype(jnp.int32), axis=1)
     valid = jnp.sum((eids >= 0).astype(jnp.int32), axis=1)
     return ExpertPlan(plan.pos, plan.counts, valid - kept)

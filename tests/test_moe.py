@@ -252,7 +252,7 @@ def test_layer_routed_bitmatches_dense_control_fwd_bwd(k):
         def loss(p, x):
             out, _ = functional_call(m, p, b, (x,), training=False,
                                      mutable_buffers=True)
-            return jnp.vdot(out, ct) + m._aux
+            return jnp.vdot(out, ct) + m.aux_loss()
         return p, loss
 
     pr, fr = mk(routed)

@@ -90,27 +90,66 @@ def build_wide_deep(batch=8, num_slots=26, dense_dim=13, emb_dim=16):
         ((batch, dense_dim), np.float32))         # dense feats
 
 
+def build_moe(batch=2, seq=32):
+    """Alternating dense/MoE GPT blocks with the routed (all-to-all)
+    dispatch — the gating/top-k/scatter surface the dense zoo never
+    exercises.  Traced with mutable buffers (raw-callable convention):
+    the MoE stats buffers (dropped/load) are graph outputs in serving,
+    and hiding them here would miscount their compute as dead."""
+    import numpy as np
+    from paddle_tpu.text.models.gpt import GPTMoEConfig, GPTMoEModel
+    from paddle_tpu.framework import functional as F
+    cfg = GPTMoEConfig.tiny(seq=seq)
+    apply, params, buffers = F.functionalize(
+        GPTMoEModel(cfg, dispatch="routed"), training=False,
+        with_buffers=True)
+    return apply, (params, buffers,
+                   *_specs(((batch, seq), np.int32)))
+
+
+def build_decode_step(slots=2, cache=32):
+    """The slot loop's single-step decode program (Generator._build_step)
+    — the hot serving dispatch, traced exactly as step_exec compiles it.
+    Returns ``(fn, avals)``: a RAW traceable callable, not a layer — the
+    already-functionalized step takes (params, buffers, cache, logits,
+    start, finished, active, pos)."""
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTModel
+    from paddle_tpu.text.generation import Generator
+    m = GPTModel(GPTConfig.tiny(seq=64))
+    gen = Generator(m, site="zoo:decode_step", seq_buckets=(8, 16, 32),
+                    max_len=64)
+    fn = gen._build_step(slots, cache, -1)
+    return fn, (*gen._state_avals(), *gen.step_avals(slots, cache))
+
+
 ZOO = {
     "lenet": build_lenet,
     "resnet_block": build_resnet_block,
     "bert": build_bert,
     "wide_deep": build_wide_deep,
+    "moe": build_moe,
+    "decode_step": build_decode_step,
 }
 
 
 def lint_model(name: str, suppress=()):
     """Trace zoo model ``name`` abstractly and lint it.  Returns a
-    LintReport."""
+    LintReport.  A builder returns ``(layer, input_specs)`` for the
+    functionalize path, or ``(raw_callable, avals)`` for programs that
+    are already functional (e.g. the slot-loop decode step)."""
     import jax
-    from paddle_tpu import analysis
+    from paddle_tpu import analysis, nn
     from paddle_tpu.framework import functional as F
     layer, specs = ZOO[name]()
-    apply, params, buffers = F.functionalize(layer, training=False)
+    if isinstance(layer, nn.Layer):
+        apply, params, buffers = F.functionalize(layer, training=False)
 
-    def fwd(p, b, *xs):
-        return apply(p, b, *xs)
+        def fwd(p, b, *xs):
+            return apply(p, b, *xs)
 
-    closed = jax.make_jaxpr(fwd)(params, buffers, *specs)
+        closed = jax.make_jaxpr(fwd)(params, buffers, *specs)
+    else:
+        closed = jax.make_jaxpr(layer)(*specs)
     return analysis.lint_jaxpr(closed, site=f"zoo:{name}", kind="cli",
                                suppress=suppress)
 
