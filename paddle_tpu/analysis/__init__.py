@@ -36,16 +36,20 @@ from .manager import (LintContext, PassManager, default_pass_manager,  # noqa: F
                       set_lint_dir, suppress)
 from . import passes as _passes  # noqa: F401  (registers the built-ins)
 from .passes import PASS_IDS  # noqa: F401
-from .ast_lint import lint_function_ast, run_ast_lint  # noqa: F401
+from .ast_lint import (lint_function_ast, lint_jitted_in_file,  # noqa: F401
+                       iter_jitted_functions, run_ast_lint)
 from . import hlo  # noqa: F401  (compiled-program audit subsystem)
 from . import autoshard  # noqa: F401  (rules-driven transform pass)
+from . import concurrency_lint  # noqa: F401  (guarded-by / lock-order)
+from . import protocol  # noqa: F401  (cluster protocol model checker)
 
 __all__ = [
     "Severity", "Diagnostic", "LintReport", "GraphLintWarning",
     "LintContext", "PassManager", "default_pass_manager",
     "register_pass", "suppress", "set_lint_dir", "lint_mode",
     "lint_enabled", "lint_jaxpr", "lint_traced", "run_ast_lint",
-    "lint_function_ast", "PASS_IDS", "autoshard",
+    "lint_function_ast", "lint_jitted_in_file", "iter_jitted_functions",
+    "PASS_IDS", "autoshard", "concurrency_lint", "protocol",
 ]
 
 

@@ -118,6 +118,210 @@ def lint_function_ast(fn, site: str = "") -> List[Diagnostic]:
     return out
 
 
+_FN_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _direct_walk(root: ast.AST):
+    """ast.walk that does not descend into nested function bodies (the
+    root's own body is walked even when the root is a FunctionDef)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _FN_DEFS + (ast.Lambda,)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+class _JitResolver:
+    """Resolve which FunctionDef/Lambda bodies a module hands to
+    ``jax.jit``.  The serving/text code never decorates with ``@jit`` —
+    it builds closures and jits them at a compile site — so the
+    resolver follows the three idioms the repo actually uses, each one
+    bounded step of intra-module dataflow:
+
+      * ``jax.jit(call)`` — a local def passed by name;
+      * ``fn = self._build_step(...)``; ``jax.jit(fn)`` — a builder
+        whose returned inner def is the program (tuple returns and
+        tuple-unpack assigns resolve positionally);
+      * ``def _compile(self, ..., fn, ...): jax.jit(fn)`` — a compile
+        helper whose ``fn`` parameter is bound at each call site.
+
+    Over-approximation is deliberate (every call site of a compile
+    helper contributes), under-approximation is possible for flows the
+    repo does not use (containers of functions, cross-module builders).
+    """
+
+    _MAX_DEPTH = 8
+
+    def __init__(self, tree: ast.AST):
+        self.tree = tree
+        self.parent_fn = {}
+        self.defs_by_name = {}
+        stack = [(tree, None)]
+        while stack:
+            node, fn = stack.pop()
+            if isinstance(node, _FN_DEFS):
+                self.parent_fn[node] = fn
+                self.defs_by_name.setdefault(node.name, []).append(node)
+                fn = node
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, fn))
+
+    def _lookup_def(self, name, scope):
+        cands = self.defs_by_name.get(name, [])
+        for d in cands:  # innermost match first: defined inside scope
+            p = self.parent_fn.get(d)
+            while p is not None:
+                if p is scope:
+                    return d
+                p = self.parent_fn.get(p)
+        return cands[0] if cands else None
+
+    def resolve(self, expr, scope, idx=None, depth=0, seen=None):
+        """Set of FunctionDef/Lambda nodes ``expr`` (evaluated inside
+        function ``scope``) may denote; ``idx`` selects a tuple slot of
+        a call's return value."""
+        seen = set() if seen is None else seen
+        key = (id(expr), id(scope), idx)
+        if depth > self._MAX_DEPTH or key in seen:
+            return set()
+        seen.add(key)
+        if isinstance(expr, ast.Lambda):
+            return {expr}
+        if isinstance(expr, ast.IfExp):  # greedy if beam == 1 else beam_
+            return (self.resolve(expr.body, scope, idx, depth + 1, seen)
+                    | self.resolve(expr.orelse, scope, idx, depth + 1,
+                                   seen))
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(expr.id, scope, idx, depth, seen)
+        if isinstance(expr, ast.Call):
+            callee = None
+            if isinstance(expr.func, ast.Name):
+                callee = self._lookup_def(expr.func.id, scope)
+            elif isinstance(expr.func, ast.Attribute):
+                callee = self._lookup_def(expr.func.attr, scope)
+            if callee is None:
+                return set()
+            return self._resolve_returns(callee, idx, depth + 1, seen)
+        return set()
+
+    def _resolve_name(self, name, scope, idx, depth, seen):
+        d = self._lookup_def(name, scope)
+        if d is not None and idx is None:
+            return {d}
+        out = set()
+        # assignment in the enclosing scopes (module body included):
+        # fn = <expr> / a, fn, b = <call>
+        scopes, s = [], scope
+        while s is not None:
+            scopes.append(s)
+            s = self.parent_fn.get(s)
+        scopes.append(self.tree)
+        for s in scopes:
+            for node in _direct_walk(s):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == name:
+                        out |= self.resolve(node.value, s, idx,
+                                            depth + 1, seen)
+                    elif isinstance(tgt, ast.Tuple):
+                        for i, el in enumerate(tgt.elts):
+                            if isinstance(el, ast.Name) and el.id == name:
+                                out |= self.resolve(node.value, s, i,
+                                                    depth + 1, seen)
+        if out or not isinstance(scope, _FN_DEFS):
+            return out
+        # parameter of ``scope``: bound at each call site of scope
+        params = [a.arg for a in scope.args.args]
+        if name not in params:
+            return out
+        pos = params.index(name)
+        for call, call_scope in self._call_sites(scope.name):
+            actual, api = None, pos
+            if isinstance(call.func, ast.Attribute) and params[:1] == ["self"]:
+                api = pos - 1  # self is the receiver, not an argument
+            if 0 <= api < len(call.args):
+                actual = call.args[api]
+            for kw in call.keywords:
+                if kw.arg == name:
+                    actual = kw.value
+            if actual is not None:
+                out |= self.resolve(actual, call_scope, idx, depth + 1,
+                                    seen)
+        return out
+
+    def _call_sites(self, fname):
+        """(Call, enclosing FunctionDef) pairs calling ``fname``."""
+        stack = [(self.tree, None)]
+        while stack:
+            node, fn = stack.pop()
+            if isinstance(node, _FN_DEFS):
+                fn = node
+            if isinstance(node, ast.Call):
+                f = node.func
+                if ((isinstance(f, ast.Name) and f.id == fname) or
+                        (isinstance(f, ast.Attribute) and f.attr == fname)):
+                    yield node, fn
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, fn))
+
+    def _resolve_returns(self, fndef, idx, depth, seen):
+        out = set()
+        for node in _direct_walk(fndef):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            val = node.value
+            if idx is not None and isinstance(val, ast.Tuple):
+                if idx < len(val.elts):
+                    out |= self.resolve(val.elts[idx], fndef, None,
+                                        depth, seen)
+            else:
+                out |= self.resolve(val, fndef, idx, depth, seen)
+        return out
+
+
+def iter_jitted_functions(tree: ast.AST):
+    """Yield the ``FunctionDef`` / ``Lambda`` nodes of every function the
+    module hands to a ``jit(...)`` / ``jax.jit(...)`` call, following the
+    bounded intra-module dataflow documented on :class:`_JitResolver`."""
+    res = _JitResolver(tree)
+    found, emitted = [], set()
+    for call, scope in res._call_sites("jit"):
+        if not call.args:
+            continue
+        for d in sorted(res.resolve(call.args[0], scope),
+                        key=lambda n: n.lineno):
+            if id(d) not in emitted:
+                emitted.add(id(d))
+                found.append(d)
+    return iter(sorted(found, key=lambda n: n.lineno))
+
+
+def lint_jitted_in_file(path: str, site: str = "") -> List[Diagnostic]:
+    """AST-hazard-lint every jitted function in the module at ``path``.
+    Line numbers are module-absolute (the node comes from the full
+    module parse), so diagnostics point at the real source line."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    diags: List[Diagnostic] = []
+    for node in iter_jitted_functions(tree):
+        name = getattr(node, "name", "<lambda>")
+        ctx = LintContext(
+            site=site or f"ast:{path}:{name}", kind="ast",
+            ast_root=node, filename=path, firstlineno=1)
+        visitor = _AstHazardVisitor(ctx)
+        visitor.visit(node)
+        for d in visitor.diagnostics:
+            d.site = d.site or ctx.site
+        diags.extend(visitor.diagnostics)
+    return diags
+
+
 def run_ast_lint(fn, site: str = ""):
     """Gated entry used by dy2static: lint ``fn``'s source and emit
     through the standard channel (gauges/JSONL/warn/raise)."""
